@@ -1,0 +1,90 @@
+"""Headline benchmark: 1B-prediction MulticlassAccuracy streaming update throughput.
+
+BASELINE.json config 1 / north star: metric-updates/sec/chip on 1B preds,
+``MulticlassAccuracy(task="multiclass", num_classes=5)``. The reference publishes no
+numbers (BASELINE.md), so ``vs_baseline`` is measured locally: throughput of this
+framework's jitted TPU path divided by the reference-equivalent torch-CPU kernel
+(torch argmax-free micro accuracy on int labels) on the same machine.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def bench_tpu(total_elems: int = 1_000_000_000, chunk: int = 1 << 26) -> float:
+    from metrics_tpu.classification import MulticlassAccuracy
+
+    metric = MulticlassAccuracy(num_classes=5, average="micro", validate_args=False)
+    state = metric.init_state()
+
+    update = jax.jit(metric.local_update, donate_argnums=0)
+
+    # pre-generate a few device-resident batches and cycle through them so the
+    # measurement is the metric update, not RNG
+    key = jax.random.PRNGKey(0)
+    n_bufs = 4
+    bufs = []
+    for i in range(n_bufs):
+        k1, k2, key = jax.random.split(key, 3)
+        preds = jax.random.randint(k1, (chunk,), 0, 5, dtype=jnp.int32)
+        target = jax.random.randint(k2, (chunk,), 0, 5, dtype=jnp.int32)
+        bufs.append((preds, target))
+    jax.block_until_ready(bufs)
+
+    # warmup/compile
+    state = update(state, *bufs[0])
+    jax.block_until_ready(state)
+    state = metric.init_state()
+
+    steps = max(1, total_elems // chunk)
+    t0 = time.perf_counter()
+    for i in range(steps):
+        state = update(state, *bufs[i % n_bufs])
+    jax.block_until_ready(state)
+    dt = time.perf_counter() - t0
+
+    value = float(metric.compute_from(state))
+    assert 0.15 < value < 0.25, f"sanity: uniform 5-class accuracy ~0.2, got {value}"
+    return steps * chunk / dt
+
+
+def bench_torch_cpu(total_elems: int = 1 << 26, chunk: int = 1 << 24) -> float:
+    """Reference-equivalent kernel in torch on CPU (the only locally-available
+    baseline; the reference library itself is torch-only)."""
+    import torch
+
+    g = torch.Generator().manual_seed(0)
+    preds = torch.randint(0, 5, (chunk,), generator=g, dtype=torch.int32)
+    target = torch.randint(0, 5, (chunk,), generator=g, dtype=torch.int32)
+    tp = torch.zeros((), dtype=torch.int64)
+    total = torch.zeros((), dtype=torch.int64)
+    # warmup
+    tp += (preds == target).sum()
+    total += preds.numel()
+    steps = max(1, total_elems // chunk)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        tp += (preds == target).sum()
+        total += preds.numel()
+    dt = time.perf_counter() - t0
+    return steps * chunk / dt
+
+
+if __name__ == "__main__":
+    tpu_eps = bench_tpu()
+    cpu_eps = bench_torch_cpu()
+    print(
+        json.dumps(
+            {
+                "metric": "multiclass_accuracy_1B_preds_throughput",
+                "value": round(tpu_eps / 1e9, 4),
+                "unit": "Gpreds/s/chip",
+                "vs_baseline": round(tpu_eps / cpu_eps, 2),
+            }
+        )
+    )

@@ -1,0 +1,15 @@
+"""Root pytest config: pin the CPU backend for all test/doctest runs.
+
+The environment forces ``JAX_PLATFORMS=axon`` (a single tunneled TPU); tests and
+doctests must not compete for it. The env var cannot override the plugin — the config
+call can. Real-TPU execution happens only via bench.py / __graft_entry__.py.
+"""
+import os
+
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+collect_ignore = ["reference"]

@@ -1,0 +1,32 @@
+"""metrics_tpu — a TPU-native (JAX/XLA/Pallas) metrics framework.
+
+A ground-up rebuild of the capabilities of the reference library (torchmetrics
+v1.0.0rc0 fork) designed TPU-first: explicit state pytrees, jit-safe static-shape
+kernels, and jax.lax collectives over device meshes instead of NCCL process groups.
+"""
+__version__ = "0.1.0"
+
+from metrics_tpu.classification import (
+    Accuracy,
+    BinaryAccuracy,
+    BinaryStatScores,
+    MulticlassAccuracy,
+    MulticlassStatScores,
+    MultilabelAccuracy,
+    MultilabelStatScores,
+    StatScores,
+)
+from metrics_tpu.core.metric import CompositionalMetric, Metric
+
+__all__ = [
+    "Accuracy",
+    "BinaryAccuracy",
+    "BinaryStatScores",
+    "CompositionalMetric",
+    "Metric",
+    "MulticlassAccuracy",
+    "MulticlassStatScores",
+    "MultilabelAccuracy",
+    "MultilabelStatScores",
+    "StatScores",
+]

@@ -1,0 +1,136 @@
+"""Accuracy metric classes.
+
+Capability parity with reference ``classification/accuracy.py:30-440`` — thin state
+shells over the stat_scores core, per the framework's shared-state design.
+"""
+from typing import Any, Optional
+
+from jax import Array
+
+from metrics_tpu.classification.stat_scores import (
+    BinaryStatScores,
+    MulticlassStatScores,
+    MultilabelStatScores,
+)
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.classification.accuracy import _accuracy_reduce
+from metrics_tpu.utils.enums import ClassificationTask
+
+
+class BinaryAccuracy(BinaryStatScores):
+    """Binary accuracy (reference: classification/accuracy.py:30-130).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import BinaryAccuracy
+        >>> target = jnp.array([0, 1, 0, 1, 0, 1])
+        >>> preds = jnp.array([0, 0, 1, 1, 0, 1])
+        >>> metric = BinaryAccuracy()
+        >>> metric(preds, target)
+        Array(0.6666667, dtype=float32)
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def compute(self) -> Array:
+        tp, fp, tn, fn = self._final_state()
+        return _accuracy_reduce(tp, fp, tn, fn, average="binary", multidim_average=self.multidim_average)
+
+
+class MulticlassAccuracy(MulticlassStatScores):
+    """Multiclass accuracy (reference: classification/accuracy.py:132-264).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import MulticlassAccuracy
+        >>> target = jnp.array([2, 1, 0, 0])
+        >>> preds = jnp.array([2, 1, 0, 1])
+        >>> metric = MulticlassAccuracy(num_classes=3)
+        >>> metric(preds, target)
+        Array(0.8333334, dtype=float32)
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+    plot_legend_name: str = "Class"
+
+    def compute(self) -> Array:
+        tp, fp, tn, fn = self._final_state()
+        return _accuracy_reduce(tp, fp, tn, fn, average=self.average, multidim_average=self.multidim_average)
+
+
+class MultilabelAccuracy(MultilabelStatScores):
+    """Multilabel accuracy (reference: classification/accuracy.py:266-400).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import MultilabelAccuracy
+        >>> target = jnp.array([[0, 1, 0], [1, 0, 1]])
+        >>> preds = jnp.array([[0, 0, 1], [1, 0, 1]])
+        >>> metric = MultilabelAccuracy(num_labels=3)
+        >>> metric(preds, target)
+        Array(0.6666667, dtype=float32)
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+    plot_legend_name: str = "Label"
+
+    def compute(self) -> Array:
+        tp, fp, tn, fn = self._final_state()
+        return _accuracy_reduce(
+            tp, fp, tn, fn, average=self.average, multidim_average=self.multidim_average, multilabel=True
+        )
+
+
+class Accuracy:
+    """Task dispatcher (reference: classification/accuracy.py:402-440).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import Accuracy
+        >>> target = jnp.array([2, 1, 0, 0])
+        >>> preds = jnp.array([2, 1, 0, 1])
+        >>> accuracy = Accuracy(task="multiclass", num_classes=3)
+        >>> accuracy(preds, target)
+        Array(0.75, dtype=float32)
+    """
+
+    def __new__(  # type: ignore[misc]
+        cls,
+        task: str,
+        threshold: float = 0.5,
+        num_classes: Optional[int] = None,
+        num_labels: Optional[int] = None,
+        average: Optional[str] = "micro",
+        multidim_average: Optional[str] = "global",
+        top_k: Optional[int] = 1,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> Metric:
+        task = ClassificationTask.from_str(task)
+        assert multidim_average is not None
+        kwargs.update(
+            {"multidim_average": multidim_average, "ignore_index": ignore_index, "validate_args": validate_args}
+        )
+        if task == ClassificationTask.BINARY:
+            return BinaryAccuracy(threshold, **kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            assert isinstance(num_classes, int)
+            assert isinstance(top_k, int)
+            return MulticlassAccuracy(num_classes, top_k, average, **kwargs)
+        if task == ClassificationTask.MULTILABEL:
+            assert isinstance(num_labels, int)
+            return MultilabelAccuracy(num_labels, threshold, average, **kwargs)
+        raise ValueError(f"Not handled value: {task}")

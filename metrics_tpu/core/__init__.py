@@ -1,0 +1,3 @@
+from metrics_tpu.core.metric import CompositionalMetric, Metric, jit_distributed_available
+
+__all__ = ["CompositionalMetric", "Metric", "jit_distributed_available"]

@@ -1,0 +1,136 @@
+"""Hamming distance functionals.
+
+Capability parity with reference ``functional/classification/hamming.py``
+(_hamming_distance_reduce :38-87, binary :90-160, multiclass :163-266,
+multilabel :269-372, dispatcher :375-429).
+"""
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.classification.stat_scores import (
+    _binary_stat_scores_arg_validation,
+    _binary_stat_scores_pipeline,
+    _multiclass_stat_scores_arg_validation,
+    _multiclass_stat_scores_pipeline,
+    _multilabel_stat_scores_arg_validation,
+    _multilabel_stat_scores_pipeline,
+)
+from metrics_tpu.utils.compute import _safe_divide
+from metrics_tpu.utils.enums import ClassificationTask
+
+
+def _hamming_distance_reduce(
+    tp: Array,
+    fp: Array,
+    tn: Array,
+    fn: Array,
+    average: Optional[str],
+    multidim_average: str = "global",
+    multilabel: bool = False,
+) -> Array:
+    """Reference: functional/classification/hamming.py:38-87."""
+    if average == "binary":
+        return 1 - _safe_divide(tp + tn, tp + fp + tn + fn)
+    if average == "micro":
+        axis = 0 if multidim_average == "global" else 1
+        _sum = lambda x: x.sum(axis=axis) if x.ndim > axis else x
+        tp, fn = _sum(tp), _sum(fn)
+        if multilabel:
+            fp, tn = _sum(fp), _sum(tn)
+            return 1 - _safe_divide(tp + tn, tp + tn + fp + fn)
+        return 1 - _safe_divide(tp, tp + fn)
+
+    score = 1 - _safe_divide(tp + tn, tp + tn + fp + fn) if multilabel else 1 - _safe_divide(tp, tp + fn)
+    if average is None or average == "none":
+        return score
+    weights = (tp + fn).astype(score.dtype) if average == "weighted" else jnp.ones_like(score)
+    return _safe_divide(weights * score, weights.sum(-1, keepdims=True)).sum(-1)
+
+
+def binary_hamming_distance(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Reference: functional/classification/hamming.py:90-160."""
+    if validate_args:
+        _binary_stat_scores_arg_validation(threshold, multidim_average, ignore_index)
+    tp, fp, tn, fn = _binary_stat_scores_pipeline(
+        preds, target, threshold, multidim_average, ignore_index, validate_args
+    )
+    return _hamming_distance_reduce(tp, fp, tn, fn, average="binary", multidim_average=multidim_average)
+
+
+def multiclass_hamming_distance(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    average: Optional[str] = "macro",
+    top_k: int = 1,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Reference: functional/classification/hamming.py:163-266."""
+    if validate_args:
+        _multiclass_stat_scores_arg_validation(num_classes, top_k, average, multidim_average, ignore_index)
+    tp, fp, tn, fn = _multiclass_stat_scores_pipeline(
+        preds, target, num_classes, average, top_k, multidim_average, ignore_index, validate_args
+    )
+    return _hamming_distance_reduce(tp, fp, tn, fn, average=average, multidim_average=multidim_average)
+
+
+def multilabel_hamming_distance(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    threshold: float = 0.5,
+    average: Optional[str] = "macro",
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Reference: functional/classification/hamming.py:269-372."""
+    if validate_args:
+        _multilabel_stat_scores_arg_validation(num_labels, threshold, average, multidim_average, ignore_index)
+    tp, fp, tn, fn = _multilabel_stat_scores_pipeline(
+        preds, target, num_labels, threshold, multidim_average, ignore_index, validate_args
+    )
+    return _hamming_distance_reduce(tp, fp, tn, fn, average=average, multidim_average=multidim_average, multilabel=True)
+
+
+def hamming_distance(
+    preds: Array,
+    target: Array,
+    task: str,
+    threshold: float = 0.5,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    average: Optional[str] = "micro",
+    multidim_average: Optional[str] = "global",
+    top_k: Optional[int] = 1,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Dispatcher (reference: functional/classification/hamming.py:375-429)."""
+    task = ClassificationTask.from_str(task)
+    assert multidim_average is not None
+    if task == ClassificationTask.BINARY:
+        return binary_hamming_distance(preds, target, threshold, multidim_average, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        assert isinstance(num_classes, int)
+        assert isinstance(top_k, int)
+        return multiclass_hamming_distance(
+            preds, target, num_classes, average, top_k, multidim_average, ignore_index, validate_args
+        )
+    if task == ClassificationTask.MULTILABEL:
+        assert isinstance(num_labels, int)
+        return multilabel_hamming_distance(
+            preds, target, num_labels, threshold, average, multidim_average, ignore_index, validate_args
+        )
+    raise ValueError(f"Not handled value: {task}")

@@ -1,0 +1,45 @@
+"""Distributed execution layer for metrics_tpu.
+
+Two TPU-native data-parallel patterns (replacing the reference's DDP recipe,
+README.md:154-214):
+
+**Pattern A — GSPMD/jit (recommended).** Shard inputs over a ``jax.sharding.Mesh`` and
+call the metric under ``jax.jit``; XLA inserts the psum/all-reduce collectives over ICI
+automatically. No explicit distributed code::
+
+    mesh = jax.make_mesh((8,), ("data",))
+    preds = jax.device_put(preds, NamedSharding(mesh, P("data")))
+    metric.update(preds, target)          # collectives inserted by XLA
+    value = metric.compute()
+
+**Pattern B — shard_map with per-device local states.** Exact parity with the
+reference's rank-local accumulate + lazy sync-at-compute discipline::
+
+    @partial(shard_map, mesh=mesh, in_specs=(P(), P("data"), P("data")), out_specs=P())
+    def step(state, preds, target):
+        state = metric.local_update(state, preds, target)
+        return metric.sync_state(state, axis_name="data")   # psum/all_gather over ICI
+
+See ``collective`` for the reduction-kind -> collective mapping.
+"""
+from metrics_tpu.parallel.collective import (
+    AxisName,
+    ReduceFx,
+    distributed_available,
+    pad_gather,
+    sync_array,
+    sync_pytree,
+)
+from metrics_tpu.parallel.mesh import evaluate_sharded, make_data_mesh, shard_batch
+
+__all__ = [
+    "AxisName",
+    "ReduceFx",
+    "distributed_available",
+    "pad_gather",
+    "sync_array",
+    "sync_pytree",
+    "evaluate_sharded",
+    "make_data_mesh",
+    "shard_batch",
+]

@@ -1,0 +1,154 @@
+"""Input validation helpers.
+
+Capability parity with reference ``utilities/checks.py`` — shape checks, retrieval input
+checks, and the forward-mode benchmark tool. Validation runs on *host* values where it
+needs data-dependent branching; every check is skippable via ``validate_args=False`` on
+the metric for fully-jitted hot paths (mirroring the reference's contract).
+"""
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+
+def _is_concrete(*arrays) -> bool:
+    """True iff every array holds concrete values (not jit/vmap tracers).
+
+    Data-dependent validations are silently skipped under tracing — shapes/dtypes are
+    still checked. This lets metrics built with ``validate_args=True`` run inside
+    ``jit``/``shard_map`` (the reference has no tracing, so no analogue).
+    """
+    import jax.core
+
+    return not any(isinstance(a, jax.core.Tracer) for a in arrays)
+
+
+def _check_same_shape(preds: Array, target: Array) -> None:
+    """Raise if shapes differ (reference: utilities/checks.py:39)."""
+    if preds.shape != target.shape:
+        raise RuntimeError(
+            f"Predictions and targets are expected to have the same shape, "
+            f"but got {preds.shape} and {target.shape}."
+        )
+
+
+def _check_retrieval_functional_inputs(
+    preds: Array, target: Array, allow_non_binary_target: bool = False
+) -> Tuple[Array, Array]:
+    """Validate (preds, target) for functional retrieval metrics.
+
+    Reference: utilities/checks.py:505.
+    """
+    if preds.shape != target.shape:
+        raise ValueError("`preds` and `target` must be of the same shape")
+    if preds.ndim == 0 or preds.size == 0:
+        raise ValueError("`preds` and `target` must be non-empty and non-scalar tensors")
+    return _check_retrieval_target_and_prediction_types(
+        preds, target, allow_non_binary_target=allow_non_binary_target
+    )
+
+
+def _check_retrieval_inputs(
+    indexes: Array,
+    preds: Array,
+    target: Array,
+    allow_non_binary_target: bool = False,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Array]:
+    """Validate (indexes, preds, target) for retrieval metrics.
+
+    Reference: utilities/checks.py:535.
+    """
+    if indexes.shape != preds.shape or preds.shape != target.shape:
+        raise ValueError("`indexes`, `preds` and `target` must be of the same shape")
+    if indexes.ndim == 0 or indexes.size == 0:
+        raise ValueError("`indexes`, `preds` and `target` must be non-empty and non-scalar tensors")
+    if not jnp.issubdtype(indexes.dtype, jnp.integer):
+        raise ValueError("`indexes` must be a tensor of integers")
+    if ignore_index is not None:
+        valid = np.asarray(target) != ignore_index
+        indexes = jnp.asarray(np.asarray(indexes)[valid])
+        preds = jnp.asarray(np.asarray(preds)[valid])
+        target = jnp.asarray(np.asarray(target)[valid])
+    preds, target = _check_retrieval_target_and_prediction_types(
+        preds, target, allow_non_binary_target=allow_non_binary_target
+    )
+    return indexes.ravel().astype(jnp.int32), preds, target
+
+
+def _check_retrieval_target_and_prediction_types(
+    preds: Array, target: Array, allow_non_binary_target: bool = False
+) -> Tuple[Array, Array]:
+    if not (jnp.issubdtype(target.dtype, jnp.bool_) or jnp.issubdtype(target.dtype, jnp.integer)) and not (
+        allow_non_binary_target and jnp.issubdtype(target.dtype, jnp.floating)
+    ):
+        raise ValueError("`target` must be a tensor of booleans or integers")
+    if not allow_non_binary_target and bool(jnp.any((target > 1) | (target < 0))):
+        raise ValueError("`target` must contain `binary` values")
+    target = target.astype(jnp.float32) if jnp.issubdtype(target.dtype, jnp.floating) else target.astype(jnp.int32)
+    return preds.ravel().astype(jnp.float32), target.ravel()
+
+
+def _allclose_recursive(res1, res2, atol: float = 1e-8) -> bool:
+    """Recursive allclose over nested lists/dicts/arrays (reference: checks.py:614)."""
+    if isinstance(res1, (list, tuple)):
+        return all(_allclose_recursive(r1, r2, atol) for r1, r2 in zip(res1, res2))
+    if isinstance(res1, dict):
+        return all(_allclose_recursive(res1[k], res2[k], atol) for k in res1)
+    return np.allclose(np.asarray(res1), np.asarray(res2), atol=atol)
+
+
+def check_forward_full_state_property(
+    metric_class,
+    init_args: Optional[dict] = None,
+    input_args: Optional[dict] = None,
+    num_update_to_compare: int = 10,
+    reps: int = 5,
+) -> None:
+    """Benchmark ``full_state_update=True`` vs ``False`` forward for a metric class and
+    report whether the faster partial-state path is safe (results equal).
+
+    Reference: utilities/checks.py:629 (public perf self-check tool).
+    """
+    import time
+
+    init_args = init_args or {}
+    input_args = input_args or {}
+
+    class FullState(metric_class):
+        full_state_update = True
+
+    class PartState(metric_class):
+        full_state_update = False
+
+    m_full, m_part = FullState(**init_args), PartState(**init_args)
+    equal = True
+    for _ in range(num_update_to_compare):
+        out1 = m_full(**input_args)
+        out2 = m_part(**input_args)
+        equal = equal and _allclose_recursive(out1, out2)
+
+    res_full = m_full.compute()
+    res_part = m_part.compute()
+    equal = equal and _allclose_recursive(res_full, res_part)
+
+    mean_full, mean_part = [], []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(num_update_to_compare):
+            m_full(**input_args)
+        mean_full.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        for _ in range(num_update_to_compare):
+            m_part(**input_args)
+        mean_part.append(time.perf_counter() - t0)
+
+    print(f"Full state for {num_update_to_compare} steps took: {np.mean(mean_full):.6f}s")
+    print(f"Partial state for {num_update_to_compare} steps took: {np.mean(mean_part):.6f}s")
+    faster = bool(np.mean(mean_part) < np.mean(mean_full))
+    print(
+        f"Recommended setting `full_state_update={not (equal and faster)}`"
+        if equal
+        else "Recommended setting `full_state_update=True` (results differ)"
+    )

@@ -1,0 +1,86 @@
+"""Numeric helper kernels, jit-safe.
+
+Capability parity with reference ``utilities/compute.py`` (_safe_divide, _safe_xlogy,
+_auc_compute, auc) — re-expressed as branchless XLA-friendly jnp ops: every helper is
+pure, static-shape, and safe under ``jax.jit`` (no data-dependent Python control flow).
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+
+def _safe_divide(num: Array, denom: Array) -> Array:
+    """Elementwise num/denom with 0/0 -> 0 (reference: utilities/compute.py:47)."""
+    num = jnp.asarray(num)
+    denom = jnp.asarray(denom)
+    dtype = jnp.result_type(num, denom, jnp.float32)
+    if not jnp.issubdtype(dtype, jnp.floating):
+        dtype = jnp.float32
+    num = num.astype(dtype)
+    denom = denom.astype(dtype)
+    zero = denom == 0
+    return jnp.where(zero, jnp.zeros((), dtype), num / jnp.where(zero, jnp.ones((), dtype), denom))
+
+
+def _safe_xlogy(x: Array, y: Array) -> Array:
+    """x * log(y) with x==0 -> 0 even when y==0/inf (reference: utilities/compute.py:31)."""
+    x, y = jnp.asarray(x), jnp.asarray(y)
+    zero = x == 0
+    res = x * jnp.log(jnp.where(zero, jnp.ones_like(y), y))
+    return jnp.where(zero, jnp.zeros_like(res), res)
+
+
+def _safe_log(x: Array, eps: float = 0.0) -> Array:
+    """log with optional clamp floor for numerical safety."""
+    if eps:
+        x = jnp.maximum(x, eps)
+    return jnp.log(x)
+
+
+def _safe_matmul(x: Array, y: Array) -> Array:
+    """Matmul (reference guards fp16-on-CPU, utilities/compute.py:22 — not needed on TPU)."""
+    return jnp.matmul(x, y)
+
+
+def _auc_compute_without_check(x: Array, y: Array, direction: float, axis: int = -1) -> Array:
+    """Trapezoidal area under (x, y); ``direction`` flips sign for descending x.
+
+    Reference: utilities/compute.py:62-84 (_auc_compute).
+    """
+    dx = jnp.diff(x, axis=axis)
+    mean_y = (
+        jax.lax.slice_in_dim(y, 0, y.shape[axis] - 1, axis=axis)
+        + jax.lax.slice_in_dim(y, 1, y.shape[axis], axis=axis)
+    ) / 2.0
+    return (dx * mean_y).sum(axis=axis) * direction
+
+
+def _auc_compute(x: Array, y: Array, reorder: bool = False, axis: int = -1) -> Array:
+    """AUC with optional reordering by x; auto direction from monotonicity.
+
+    Note: the reference raises on non-monotonic x when ``reorder=False``; under jit we
+    cannot branch on data, so non-monotonic unsorted input silently follows sign of the
+    first step. Pass ``reorder=True`` for unsorted inputs.
+    """
+    x = jnp.asarray(x, jnp.float32)
+    y = jnp.asarray(y, jnp.float32)
+    if reorder:
+        order = jnp.argsort(x, axis=axis)
+        x = jnp.take_along_axis(x, order, axis=axis)
+        y = jnp.take_along_axis(y, order, axis=axis)
+        direction = jnp.asarray(1.0)
+    else:
+        dx = jnp.diff(x, axis=axis)
+        direction = jnp.where(jnp.all(dx <= 0), -1.0, 1.0)
+    return _auc_compute_without_check(x, y, direction, axis=axis)
+
+
+def auc(x: Array, y: Array, reorder: bool = False) -> Array:
+    """Public AUC entrypoint (reference: utilities/compute.py:103)."""
+    if x.ndim != 1 or y.ndim != 1:
+        raise ValueError(f"Expected 1d arrays, got x.ndim={x.ndim}, y.ndim={y.ndim}")
+    if x.shape[0] != y.shape[0]:
+        raise ValueError("x and y must have the same length")
+    return _auc_compute(x, y, reorder=reorder)

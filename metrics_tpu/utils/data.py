@@ -1,0 +1,188 @@
+"""State reducers and tensor utilities, jit-safe.
+
+Capability parity with reference ``utilities/data.py`` (dim_zero_* reducers, to_onehot,
+select_topk, to_categorical, _bincount, _cumsum, _flexible_bincount, apply_to_collection).
+
+TPU-first notes:
+- ``_bincount`` is ``jnp.bincount`` with a **static** ``length`` — XLA lowers this to a
+  one-hot matmul / scatter-add that tiles onto the MXU/VPU; no determinism fallback loop
+  is needed (the reference's XLA workaround at utilities/data.py:211-243 is obsolete
+  here because jnp.bincount is already deterministic on TPU).
+- cat-state reduction concatenates eagerly; under jit callers should prefer
+  fixed-capacity buffers (see core.state).
+"""
+from typing import Any, Callable, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+_ArrayLike = Union[Array, np.ndarray, float, int]
+
+
+def _count_dtype():
+    """dtype for unbounded count accumulators (stat-score states).
+
+    The reference uses torch int64 (classification/stat_scores.py:53). On TPU, int64
+    requires ``jax_enable_x64``; when enabled we match the reference exactly. Without
+    it, int32 would silently wrap past 2.147e9 (e.g. the micro-average ``tn`` count at
+    the 1B-prediction benchmark scale), so we accumulate in float32 instead: counts
+    are exact to 2^24 and ratio-level error is bounded by ~6e-8 beyond — inside the
+    1e-6 drift budget (BASELINE.md).
+    """
+    import jax
+
+    return jnp.int64 if jax.config.jax_enable_x64 else jnp.float32
+
+
+def dim_zero_cat(x: Union[Array, List[Array]]) -> Array:
+    """Concatenate list of arrays along dim 0 (reference: utilities/data.py:28)."""
+    if isinstance(x, (jnp.ndarray, np.ndarray)) and not isinstance(x, (list, tuple)):
+        return jnp.asarray(x)
+    x = [jnp.atleast_1d(jnp.asarray(v)) for v in x]
+    if not x:
+        raise ValueError("No samples to concatenate")
+    return jnp.concatenate(x, axis=0)
+
+
+def dim_zero_sum(x: Array) -> Array:
+    return jnp.sum(jnp.asarray(x), axis=0)
+
+
+def dim_zero_mean(x: Array) -> Array:
+    return jnp.mean(jnp.asarray(x), axis=0)
+
+
+def dim_zero_max(x: Array) -> Array:
+    return jnp.max(jnp.asarray(x), axis=0)
+
+
+def dim_zero_min(x: Array) -> Array:
+    return jnp.min(jnp.asarray(x), axis=0)
+
+
+def _flatten(x: Sequence) -> list:
+    """Flatten list of lists one level (reference: utilities/data.py:58)."""
+    return [item for sublist in x for item in sublist]
+
+
+def _flatten_dict(x: dict) -> dict:
+    """Flatten dict of dicts one level (reference: utilities/data.py:63)."""
+    out = {}
+    for key, value in x.items():
+        if isinstance(value, dict):
+            out.update(value)
+        else:
+            out[key] = value
+    return out
+
+
+def to_onehot(label_tensor: Array, num_classes: Optional[int] = None) -> Array:
+    """Integer labels ``(N, ...)`` -> one-hot ``(N, C, ...)``.
+
+    Reference: utilities/data.py:75. TPU: jax.nn.one_hot lowers to a compare+select
+    that fuses into downstream reductions.
+    """
+    label_tensor = jnp.asarray(label_tensor)
+    if num_classes is None:
+        num_classes = int(jnp.max(label_tensor)) + 1
+    onehot = jax.nn.one_hot(label_tensor, num_classes, dtype=jnp.int32)
+    # (N, ..., C) -> (N, C, ...)
+    return jnp.moveaxis(onehot, -1, 1)
+
+
+def select_topk(prob_tensor: Array, topk: int = 1, dim: int = 1) -> Array:
+    """0/1 mask of the top-k entries along ``dim`` (reference: utilities/data.py:109).
+
+    TPU: implemented via ``jax.lax.top_k`` (sorting network on VPU) + scatter-free
+    one-hot sum, keeping static shapes.
+    """
+    prob_tensor = jnp.asarray(prob_tensor)
+    moved = jnp.moveaxis(prob_tensor, dim, -1)
+    _, idx = jax.lax.top_k(moved, topk)
+    mask = jax.nn.one_hot(idx, moved.shape[-1], dtype=jnp.int32).sum(-2)
+    mask = jnp.minimum(mask, 1)
+    return jnp.moveaxis(mask, -1, dim)
+
+
+def to_categorical(x: Array, argmax_dim: int = 1) -> Array:
+    """Probabilities -> class index via argmax (reference: utilities/data.py:135)."""
+    return jnp.argmax(jnp.asarray(x), axis=argmax_dim)
+
+
+def _bincount(x: Array, minlength: int) -> Array:
+    """Count occurrences of each value in ``[0, minlength)``.
+
+    ``minlength`` MUST be static (Python int) — the output shape depends on it.
+    Reference: utilities/data.py:211 (with XLA fallback loop — not needed here).
+    """
+    return jnp.bincount(jnp.asarray(x).ravel(), length=minlength)
+
+
+def _bincount_weighted(x: Array, weights: Array, minlength: int) -> Array:
+    """Weighted bincount with static length; used for masked confusion matrices."""
+    return jnp.bincount(jnp.asarray(x).ravel(), weights=jnp.asarray(weights).ravel(), length=minlength)
+
+
+def _cumsum(x: Array, axis: int = 0) -> Array:
+    """Cumulative sum (deterministic on TPU; reference workaround data.py:244 obsolete)."""
+    return jnp.cumsum(jnp.asarray(x), axis=axis)
+
+
+def _flexible_bincount(x: Array) -> Array:
+    """Count occurrences of each *unique* value (reference: utilities/data.py:256).
+
+    Host-side (non-jit): output size is data-dependent. Use only at compute() time on
+    concrete arrays.
+    """
+    x = np.asarray(x)
+    x = x - x.min()
+    counts = np.bincount(x)
+    return jnp.asarray(counts[counts > 0])
+
+
+def allclose(tensor1: _ArrayLike, tensor2: _ArrayLike, rtol: float = 1e-5, atol: float = 1e-8) -> bool:
+    """Shape- and value-equality check (reference: utilities/data.py:274)."""
+    t1, t2 = jnp.asarray(tensor1), jnp.asarray(tensor2)
+    if t1.shape != t2.shape:
+        return False
+    return bool(jnp.allclose(t1, t2, rtol=rtol, atol=atol))
+
+
+def _squeeze_scalar_element_tensor(x: Array) -> Array:
+    return x.reshape(()) if x.size == 1 else x
+
+
+def _squeeze_if_scalar(data: Any) -> Any:
+    return apply_to_collection(data, (jnp.ndarray, np.ndarray), _squeeze_scalar_element_tensor)
+
+
+def apply_to_collection(
+    data: Any,
+    dtype: Union[type, tuple],
+    function: Callable,
+    *args: Any,
+    wrong_dtype: Optional[Union[type, tuple]] = None,
+    **kwargs: Any,
+) -> Any:
+    """Recursively apply ``function`` to all ``dtype`` elements of a nested collection.
+
+    Reference: utilities/data.py:153 (apply_to_collection).
+    """
+    if isinstance(data, dtype) and (wrong_dtype is None or not isinstance(data, wrong_dtype)):
+        return function(data, *args, **kwargs)
+    if isinstance(data, (list, tuple)) and not hasattr(data, "_fields"):
+        return type(data)(
+            apply_to_collection(d, dtype, function, *args, wrong_dtype=wrong_dtype, **kwargs) for d in data
+        )
+    if isinstance(data, tuple) and hasattr(data, "_fields"):  # namedtuple
+        return type(data)(
+            *(apply_to_collection(d, dtype, function, *args, wrong_dtype=wrong_dtype, **kwargs) for d in data)
+        )
+    if isinstance(data, dict):
+        return {
+            k: apply_to_collection(v, dtype, function, *args, wrong_dtype=wrong_dtype, **kwargs)
+            for k, v in data.items()
+        }
+    return data

@@ -1,0 +1,72 @@
+"""Reduction helpers with the same surface as reference ``utilities/distributed.py``.
+
+``reduce`` / ``class_reduce`` are pure math (kept here for name parity); the actual
+cross-device sync engine lives in ``metrics_tpu.parallel.collective`` and is built on
+``jax.lax`` collectives over mesh axis names instead of NCCL process groups.
+"""
+from typing import List, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.compute import _safe_divide
+
+
+def reduce(x: Array, reduction: str) -> Array:
+    """Reduce tensor by 'elementwise_mean' | 'sum' | 'none'.
+
+    Reference: utilities/distributed.py:22-41.
+    """
+    if reduction == "elementwise_mean":
+        return jnp.mean(x)
+    if reduction == "none" or reduction is None:
+        return x
+    if reduction == "sum":
+        return jnp.sum(x)
+    raise ValueError("Reduction parameter unknown.")
+
+
+def class_reduce(num: Array, denom: Array, weights: Array, class_reduction: str = "none") -> Array:
+    """Class-wise fraction reduction: micro/macro/weighted/none with 0/0 -> 0.
+
+    Reference: utilities/distributed.py:44-89.
+    """
+    valid_reduction = ("micro", "macro", "weighted", "none", None)
+    fraction = (
+        jnp.sum(num) / jnp.sum(denom) if class_reduction == "micro" else _safe_divide(num, denom)
+    )
+
+    if class_reduction == "micro":
+        return fraction
+    if class_reduction == "macro":
+        return jnp.mean(fraction)
+    if class_reduction == "weighted":
+        return jnp.sum(fraction * (weights.astype(fraction.dtype) / jnp.sum(weights)))
+    if class_reduction == "none" or class_reduction is None:
+        return fraction
+
+    raise ValueError(f"Reduction parameter {class_reduction} unknown. Choose between one of these: {valid_reduction}")
+
+
+def gather_all_tensors(result: Array, group: Optional[str] = None) -> List[Array]:
+    """Eager (outside-jit) cross-process all_gather returning a per-process list.
+
+    Reference: utilities/distributed.py:98-148. On TPU pods this rides DCN via
+    ``jax.experimental.multihost_utils``; in a single-process run it returns ``[result]``.
+    Ragged shapes are handled by the underlying allgather (per-process padding is not
+    required because process_allgather stacks equal-shaped arrays; ragged list states
+    are instead pre-padded by the caller — see parallel.collective.pad_gather).
+    """
+    import jax
+
+    if group is not None:
+        raise NotImplementedError(
+            "Process sub-groups are not supported by the eager gather; use a mesh axis"
+            " name with the pure sync tier (Metric.sync_state) for sub-group reductions."
+        )
+    if jax.process_count() == 1:
+        return [result]
+    from jax.experimental import multihost_utils
+
+    stacked = multihost_utils.process_allgather(result)
+    return [stacked[i] for i in range(stacked.shape[0])]

@@ -1,0 +1,13 @@
+"""Exception types for metrics_tpu.
+
+Capability parity with reference ``utilities/exceptions.py`` (TorchMetricsUserError /
+TorchMetricsUserWarning), re-branded for this framework.
+"""
+
+
+class MetricsUserError(Exception):
+    """Error raised by misuse of the metrics API (e.g. double-sync)."""
+
+
+class MetricsUserWarning(UserWarning):
+    """Warning category for metric usage issues (e.g. compute before update)."""

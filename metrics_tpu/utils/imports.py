@@ -1,0 +1,34 @@
+"""Availability flags for optional dependencies.
+
+Capability parity with reference ``utilities/imports.py``. Anything not baked into the
+image is gated behind these flags; metrics that require an unavailable dependency raise
+a clear ImportError at construction time.
+"""
+import importlib.util
+
+
+def _module_available(name: str) -> bool:
+    try:
+        return importlib.util.find_spec(name) is not None
+    except (ImportError, ModuleNotFoundError, ValueError):
+        return False
+
+
+_JAX_AVAILABLE = _module_available("jax")
+_FLAX_AVAILABLE = _module_available("flax")
+_OPTAX_AVAILABLE = _module_available("optax")
+_ORBAX_AVAILABLE = _module_available("orbax")
+_CHEX_AVAILABLE = _module_available("chex")
+_EINOPS_AVAILABLE = _module_available("einops")
+_NUMPY_AVAILABLE = _module_available("numpy")
+_SCIPY_AVAILABLE = _module_available("scipy")
+_SKLEARN_AVAILABLE = _module_available("sklearn")
+_TORCH_AVAILABLE = _module_available("torch")
+_TRANSFORMERS_AVAILABLE = _module_available("transformers")
+_MATPLOTLIB_AVAILABLE = _module_available("matplotlib")
+_NLTK_AVAILABLE = _module_available("nltk")
+_PESQ_AVAILABLE = _module_available("pesq")
+_PYSTOI_AVAILABLE = _module_available("pystoi")
+_PYCOCOTOOLS_AVAILABLE = _module_available("pycocotools")
+_REGEX_AVAILABLE = _module_available("regex")
+_PANDAS_AVAILABLE = _module_available("pandas")

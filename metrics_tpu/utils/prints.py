@@ -1,0 +1,43 @@
+"""Rank-zero-only printing/warnings.
+
+Capability parity with reference ``utilities/prints.py`` — in JAX the rank is
+``jax.process_index()`` (multi-host over DCN), not a torch.distributed rank.
+"""
+import warnings
+from functools import partial, wraps
+from typing import Any, Callable
+
+import jax
+
+
+def rank_zero_only(fn: Callable) -> Callable:
+    """Run ``fn`` only on process 0 (reference: utilities/prints.py:22)."""
+
+    @wraps(fn)
+    def wrapped_fn(*args: Any, **kwargs: Any) -> Any:
+        if jax.process_index() == 0:
+            return fn(*args, **kwargs)
+        return None
+
+    return wrapped_fn
+
+
+@rank_zero_only
+def rank_zero_print(*args: Any, **kwargs: Any) -> None:
+    print(*args, **kwargs)
+
+
+@rank_zero_only
+def rank_zero_warn(message: str, *args: Any, **kwargs: Any) -> None:
+    warnings.warn(message, *args, **kwargs)
+
+
+@rank_zero_only
+def rank_zero_info(*args: Any, **kwargs: Any) -> None:
+    print(*args, **kwargs)
+
+
+def _deprecated_warn(name: str, replacement: str) -> None:
+    rank_zero_warn(
+        f"`{name}` is deprecated, use `{replacement}` instead.", DeprecationWarning
+    )

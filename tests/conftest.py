@@ -1,0 +1,27 @@
+"""Test session config: 8 virtual CPU devices for multi-device (mesh) tests.
+
+Mirrors the reference's DDP test strategy (tests/unittests/conftest.py:25-56 — a
+persistent 2-process gloo pool) the TPU way: a single process with
+``--xla_force_host_platform_device_count=8`` virtual devices and shard_map
+(SURVEY.md §4).
+"""
+import os
+
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+os.environ.setdefault("JAX_TRACEBACK_FILTERING", "off")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+NUM_PROCESSES = 8  # virtual devices in the test mesh
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import numpy as np
+
+    np.random.seed(42)
+    yield

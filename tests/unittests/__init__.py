@@ -1,0 +1,9 @@
+from collections import namedtuple
+
+NUM_BATCHES = 4
+BATCH_SIZE = 32
+NUM_CLASSES = 5
+EXTRA_DIM = 3
+THRESHOLD = 0.5
+
+Input = namedtuple("Input", ["preds", "target"])

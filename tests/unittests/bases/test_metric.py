@@ -1,0 +1,233 @@
+"""Core runtime behavior tests.
+
+Mirrors reference ``tests/unittests/bases/test_metric.py`` coverage: add_state
+validation, reset, compute caching, forward accumulation modes, error handling,
+pickling, state_dict persistence, and the pure-functional tier.
+"""
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.utils.exceptions import MetricsUserError
+
+import sys, os
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+from helpers.testers import DummyListMetric, DummyMetric, DummyMetricDiff, DummyMetricSum  # noqa: E402
+
+
+def test_error_on_wrong_input():
+    with pytest.raises(ValueError, match="Expected keyword argument `dist_sync_fn` to be"):
+        DummyMetric(dist_sync_fn=[2, 3])
+    with pytest.raises(ValueError, match="Expected keyword argument `compute_on_cpu` to be"):
+        DummyMetric(compute_on_cpu=None)
+    with pytest.raises(ValueError, match="Unexpected keyword arguments"):
+        DummyMetric(foo=True)
+
+
+def test_inherit():
+    DummyMetric()
+
+
+def test_add_state():
+    m = DummyMetric()
+    m.add_state("a", jnp.asarray(0), "sum")
+    assert np.asarray(m.a) == 0
+    m.add_state("b", jnp.asarray(0), "mean")
+    m.add_state("c", jnp.asarray(0), "cat")
+    m.add_state("d", [], "cat")
+    with pytest.raises(ValueError):
+        m.add_state("e", jnp.asarray(0), "xyz")
+    with pytest.raises(ValueError):
+        m.add_state("f", jnp.asarray(0), 42)
+    with pytest.raises(ValueError):
+        m.add_state("g", [jnp.asarray(0)], "sum")
+    with pytest.raises(ValueError):
+        m.add_state("h-i", jnp.asarray(0), "sum")
+    # custom reduce fx allowed
+    m.add_state("h", jnp.asarray(0), lambda x: x.sum(0))
+
+
+def test_reset():
+    class A(DummyMetric):
+        pass
+
+    class B(DummyListMetric):
+        pass
+
+    metric = A()
+    metric.x = jnp.asarray(5.0)
+    metric.reset()
+    assert float(metric.x) == 0.0
+
+    metric = B()
+    metric.x = [jnp.asarray(0.5)]
+    metric.reset()
+    assert isinstance(metric.x, list) and len(metric.x) == 0
+
+
+def test_reset_compute():
+    metric = DummyMetricSum()
+    metric.update(1.0)
+    assert float(metric.compute()) == 1.0
+    metric.reset()
+    assert float(metric.compute()) == 0.0
+
+
+def test_update():
+    metric = DummyMetricSum()
+    assert float(metric.x) == 0.0
+    assert metric._update_count == 0
+    metric.update(1.0)
+    assert metric._computed is None
+    assert float(metric.x) == 1.0
+    assert metric._update_count == 1
+    metric.update(2.0)
+    assert float(metric.x) == 3.0
+    assert metric._update_count == 2
+
+
+def test_compute_caching():
+    metric = DummyMetricSum()
+    metric.update(1.0)
+    a = metric.compute()
+    assert metric._computed is not None
+    b = metric.compute()
+    assert float(a) == float(b) == 1.0
+    metric.update(1.0)
+    assert metric._computed is None
+    assert float(metric.compute()) == 2.0
+
+
+def test_forward_full_state():
+    class FullState(DummyMetricSum):
+        full_state_update = True
+
+    metric = FullState()
+    assert float(metric(1.0)) == 1.0  # batch value
+    assert float(metric(2.0)) == 2.0
+    assert float(metric.compute()) == 3.0  # accumulated
+
+
+def test_forward_reduce_state():
+    class ReducedState(DummyMetricSum):
+        full_state_update = False
+
+    metric = ReducedState()
+    assert float(metric(1.0)) == 1.0
+    assert float(metric(2.0)) == 2.0
+    assert float(metric.compute()) == 3.0
+
+
+def test_forward_modes_match():
+    """Both forward strategies must agree for a sum-reducible metric."""
+
+    class FullState(DummyMetricSum):
+        full_state_update = True
+
+    class ReducedState(DummyMetricSum):
+        full_state_update = False
+
+    m1, m2 = FullState(), ReducedState()
+    vals = np.random.default_rng(0).normal(size=10)
+    for v in vals:
+        assert float(m1(v)) == pytest.approx(float(m2(v)))
+    assert float(m1.compute()) == pytest.approx(float(m2.compute()))
+
+
+def test_forward_list_state():
+    metric = DummyListMetric()
+    metric(jnp.asarray([1.0, 2.0]))
+    metric(jnp.asarray([3.0]))
+    out = metric.compute()
+    assert np.allclose(np.concatenate([np.asarray(o).ravel() for o in out]), [1.0, 2.0, 3.0])
+
+
+def test_pickle():
+    metric = DummyMetricSum()
+    metric.update(3.0)
+    loaded = pickle.loads(pickle.dumps(metric))
+    assert float(loaded.compute()) == 3.0
+    loaded.update(2.0)
+    assert float(loaded.compute()) == 5.0
+
+
+def test_state_dict():
+    metric = DummyMetric()
+    assert metric.state_dict() == {}
+    metric.persistent(True)
+    sd = metric.state_dict()
+    assert "x" in sd and float(sd["x"]) == 0.0
+    metric2 = DummyMetricSum()
+    metric2.update(7.0)
+    metric2.persistent(True)
+    metric3 = DummyMetricSum()
+    metric3.load_state_dict(metric2.state_dict())
+    assert float(metric3.x) == 7.0
+
+
+def test_metadata_write_protected():
+    m = DummyMetric()
+    with pytest.raises(RuntimeError, match="Can't change const"):
+        m.higher_is_better = True
+    with pytest.raises(RuntimeError, match="Can't change const"):
+        m.is_differentiable = True
+    with pytest.raises(RuntimeError, match="Can't change const"):
+        m.full_state_update = False
+
+
+def test_sync_errors():
+    m = DummyMetric()
+    with pytest.raises(MetricsUserError, match="has already been un-synced"):
+        m.unsync()
+    m.sync(should_sync=True, distributed_available=lambda: False)
+    assert not m._is_synced
+    # double sync with fake-dist available raises
+    m.sync(should_sync=True, distributed_available=lambda: True, dist_sync_fn=lambda x, group=None: [x])
+    assert m._is_synced
+    with pytest.raises(MetricsUserError, match="has already been synced"):
+        m.sync(should_sync=True, distributed_available=lambda: True, dist_sync_fn=lambda x, group=None: [x])
+    m.unsync()
+    assert not m._is_synced
+
+
+def test_injected_dist_sync_fn():
+    """dist_sync_fn is pluggable (reference metric.py:121); a 2-rank mock gather."""
+    m = DummyMetricSum()
+    m.update(2.0)
+    fake_gather = lambda x, group=None: [x, x]  # pretend 2 identical ranks
+    m.sync(dist_sync_fn=fake_gather, distributed_available=lambda: True)
+    assert float(m.x) == 4.0
+    m.unsync()
+    assert float(m.x) == 2.0
+
+
+def test_compute_before_update_warns():
+    m = DummyMetricSum()
+    with pytest.warns(UserWarning, match="before the ``update`` method"):
+        m.compute()
+
+
+def test_pure_functional_tier():
+    import jax
+
+    m = DummyMetricSum()
+    state = m.init_state()
+    upd = jax.jit(m.local_update)
+    for v in [1.0, 2.0, 3.0]:
+        state = upd(state, v)
+    assert float(m.compute_from(state)) == 6.0
+    # live state untouched
+    assert float(m.x) == 0.0
+
+
+def test_clone_independent():
+    m = DummyMetricSum()
+    m.update(5.0)
+    c = m.clone()
+    c.update(5.0)
+    assert float(m.compute()) == 5.0
+    assert float(c.compute()) == 10.0

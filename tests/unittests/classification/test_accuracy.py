@@ -1,0 +1,187 @@
+"""Differential tests for accuracy vs sklearn (reference: tests/unittests/classification/test_accuracy.py)."""
+import numpy as np
+import pytest
+from scipy.special import expit, softmax
+from sklearn.metrics import accuracy_score, confusion_matrix
+
+from metrics_tpu.classification import Accuracy, BinaryAccuracy, MulticlassAccuracy, MultilabelAccuracy
+from metrics_tpu.functional.classification import binary_accuracy, multiclass_accuracy, multilabel_accuracy
+
+import sys, os
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+from helpers import seed_all  # noqa: E402
+from helpers.testers import BATCH_SIZE, NUM_BATCHES, NUM_CLASSES, THRESHOLD, MetricTester  # noqa: E402
+
+seed_all(42)
+
+_rng = np.random.default_rng(42)
+_binary_prob = (_rng.random((NUM_BATCHES, BATCH_SIZE)), _rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE)))
+_binary_logits = (_rng.normal(size=(NUM_BATCHES, BATCH_SIZE)) * 3, _rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE)))
+_binary_labels = (_rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE)), _rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE)))
+_mc_probs = (
+    softmax(_rng.normal(size=(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)), axis=-1),
+    _rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE)),
+)
+_mc_labels = (
+    _rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE)),
+    _rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE)),
+)
+_ml_probs = (
+    _rng.random((NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)),
+    _rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)),
+)
+
+
+def _ref_binary_accuracy(preds, target):
+    preds = np.asarray(preds)
+    if preds.dtype.kind == "f":
+        if not ((preds >= 0) & (preds <= 1)).all():
+            preds = expit(preds)
+        preds = (preds > THRESHOLD).astype(int)
+    return accuracy_score(target.ravel(), preds.ravel())
+
+
+def _ref_multiclass_accuracy(average):
+    def fn(preds, target):
+        preds = np.asarray(preds)
+        if preds.ndim == target.ndim + 1:
+            preds = preds.argmax(1)
+        preds, target = preds.ravel(), np.asarray(target).ravel()
+        if average == "micro":
+            return accuracy_score(target, preds)
+        cm = confusion_matrix(target, preds, labels=np.arange(NUM_CLASSES))
+        support = cm.sum(1)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            per_class = np.where(support == 0, 0.0, cm.diagonal() / np.maximum(support, 1))
+        if average == "macro":
+            return per_class.mean()
+        if average == "weighted":
+            return (per_class * support / support.sum()).sum()
+        return per_class
+
+    return fn
+
+
+def _ref_multilabel_accuracy(average):
+    def fn(preds, target):
+        preds = np.asarray(preds)
+        if preds.dtype.kind == "f":
+            if not ((preds >= 0) & (preds <= 1)).all():
+                preds = expit(preds)
+            preds = (preds > THRESHOLD).astype(int)
+        target = np.asarray(target)
+        preds = preds.reshape(-1, preds.shape[1]) if preds.ndim == 2 else preds.reshape(preds.shape[0], preds.shape[1], -1).transpose(0, 2, 1).reshape(-1, preds.shape[1])
+        target = target.reshape(-1, target.shape[1]) if target.ndim == 2 else target.reshape(target.shape[0], target.shape[1], -1).transpose(0, 2, 1).reshape(-1, target.shape[1])
+        correct = preds == target
+        per_label = correct.mean(0)
+        if average == "micro":
+            return correct.mean()
+        if average == "macro":
+            return per_label.mean()
+        return per_label
+
+    return fn
+
+
+class TestBinaryAccuracy(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize("inputs", [_binary_prob, _binary_logits, _binary_labels])
+    def test_class(self, inputs):
+        preds, target = inputs
+        self.run_class_metric_test(preds, target, BinaryAccuracy, _ref_binary_accuracy, sharded=True)
+
+    @pytest.mark.parametrize("inputs", [_binary_prob, _binary_logits, _binary_labels])
+    def test_functional(self, inputs):
+        preds, target = inputs
+        self.run_functional_metric_test(preds, target, binary_accuracy, _ref_binary_accuracy)
+
+
+class TestMulticlassAccuracy(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize("average", ["micro", "macro", "weighted", None])
+    @pytest.mark.parametrize("inputs", [_mc_probs, _mc_labels])
+    def test_class(self, inputs, average):
+        preds, target = inputs
+        self.run_class_metric_test(
+            preds,
+            target,
+            MulticlassAccuracy,
+            _ref_multiclass_accuracy(average),
+            metric_args={"num_classes": NUM_CLASSES, "average": average},
+            sharded=True,
+        )
+
+    @pytest.mark.parametrize("average", ["micro", "macro", "weighted", None])
+    @pytest.mark.parametrize("inputs", [_mc_probs, _mc_labels])
+    def test_functional(self, inputs, average):
+        preds, target = inputs
+        self.run_functional_metric_test(
+            preds,
+            target,
+            multiclass_accuracy,
+            _ref_multiclass_accuracy(average),
+            metric_args={"num_classes": NUM_CLASSES, "average": average},
+        )
+
+    def test_ignore_index(self):
+        preds, target = _mc_labels
+        target = np.where(target == 0, -1, target)
+        res = multiclass_accuracy(preds[0], target[0], num_classes=NUM_CLASSES, average="micro", ignore_index=-1)
+        mask = target[0] != -1
+        expected = accuracy_score(target[0][mask], preds[0][mask])
+        np.testing.assert_allclose(np.asarray(res), expected, atol=1e-6)
+
+    def test_top_k(self):
+        preds, target = _mc_probs
+        res = multiclass_accuracy(preds[0], target[0], num_classes=NUM_CLASSES, average="micro", top_k=2)
+        topk = np.argsort(-preds[0], axis=1)[:, :2]
+        expected = np.mean([t in row for t, row in zip(target[0], topk)])
+        np.testing.assert_allclose(np.asarray(res), expected, atol=1e-6)
+
+    def test_samplewise(self):
+        rng = np.random.default_rng(1)
+        preds = rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE, EXTRA_DIM := 3))
+        target = rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE, EXTRA_DIM))
+        res = multiclass_accuracy(
+            preds[0], target[0], num_classes=NUM_CLASSES, average="micro", multidim_average="samplewise"
+        )
+        expected = np.array([accuracy_score(t, p) for p, t in zip(preds[0], target[0])])
+        np.testing.assert_allclose(np.asarray(res), expected, atol=1e-6)
+
+
+class TestMultilabelAccuracy(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize("average", ["micro", "macro", None])
+    def test_class(self, average):
+        preds, target = _ml_probs
+        self.run_class_metric_test(
+            preds,
+            target,
+            MultilabelAccuracy,
+            _ref_multilabel_accuracy(average),
+            metric_args={"num_labels": NUM_CLASSES, "average": average},
+            sharded=True,
+        )
+
+    @pytest.mark.parametrize("average", ["micro", "macro", None])
+    def test_functional(self, average):
+        preds, target = _ml_probs
+        self.run_functional_metric_test(
+            preds,
+            target,
+            multilabel_accuracy,
+            _ref_multilabel_accuracy(average),
+            metric_args={"num_labels": NUM_CLASSES, "average": average},
+        )
+
+
+def test_accuracy_dispatcher():
+    assert isinstance(Accuracy(task="binary"), BinaryAccuracy)
+    assert isinstance(Accuracy(task="multiclass", num_classes=3), MulticlassAccuracy)
+    assert isinstance(Accuracy(task="multilabel", num_labels=3), MultilabelAccuracy)
+    with pytest.raises(ValueError):
+        Accuracy(task="unknown")

@@ -1,0 +1,295 @@
+"""Differential tests for precision/recall/F-beta/specificity/hamming vs sklearn.
+
+Mirrors reference tests/unittests/classification/{test_precision_recall,test_f_beta,
+test_specificity,test_hamming_distance}.py coverage.
+"""
+import numpy as np
+import pytest
+from scipy.special import expit
+from sklearn.metrics import fbeta_score as sk_fbeta, precision_score, recall_score
+
+from metrics_tpu.classification import (
+    BinaryF1Score,
+    BinaryPrecision,
+    BinaryRecall,
+    BinarySpecificity,
+    MulticlassF1Score,
+    MulticlassFBetaScore,
+    MulticlassPrecision,
+    MulticlassRecall,
+    MultilabelPrecision,
+    MultilabelRecall,
+)
+from metrics_tpu.functional.classification import (
+    binary_f1_score,
+    binary_hamming_distance,
+    binary_precision,
+    binary_recall,
+    binary_specificity,
+    multiclass_f1_score,
+    multiclass_fbeta_score,
+    multiclass_hamming_distance,
+    multiclass_precision,
+    multiclass_recall,
+    multiclass_specificity,
+    multilabel_f1_score,
+    multilabel_precision,
+    multilabel_recall,
+)
+
+import sys, os
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+from helpers import seed_all  # noqa: E402
+from helpers.testers import BATCH_SIZE, NUM_BATCHES, NUM_CLASSES, THRESHOLD, MetricTester  # noqa: E402
+
+seed_all(42)
+
+_rng = np.random.default_rng(7)
+_binary = (_rng.random((NUM_BATCHES, BATCH_SIZE)), _rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE)))
+_mc = (
+    _rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE)),
+    _rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE)),
+)
+_ml = (
+    _rng.random((NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)),
+    _rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)),
+)
+
+
+def _binarize(preds):
+    preds = np.asarray(preds)
+    if preds.dtype.kind == "f":
+        if not ((preds >= 0) & (preds <= 1)).all():
+            preds = expit(preds)
+        preds = (preds > THRESHOLD).astype(int)
+    return preds
+
+
+def _sk_binary(fn):
+    return lambda preds, target: fn(target.ravel(), _binarize(preds).ravel(), zero_division=0)
+
+
+def _sk_multiclass(fn, average):
+    def wrapped(preds, target):
+        return fn(
+            target.ravel(),
+            np.asarray(preds).ravel(),
+            average=average if average != "none" else None,
+            labels=np.arange(NUM_CLASSES),
+            zero_division=0,
+        )
+
+    return wrapped
+
+
+def _sk_multilabel(fn, average):
+    def wrapped(preds, target):
+        p = _binarize(preds).reshape(-1, NUM_CLASSES)
+        t = np.asarray(target).reshape(-1, NUM_CLASSES)
+        return fn(t, p, average=average if average != "none" else None, zero_division=0)
+
+    return wrapped
+
+
+class TestBinaryPrecisionRecall(MetricTester):
+    atol = 1e-6
+
+    def test_precision_class(self):
+        preds, target = _binary
+        self.run_class_metric_test(preds, target, BinaryPrecision, _sk_binary(precision_score), sharded=True)
+
+    def test_recall_class(self):
+        preds, target = _binary
+        self.run_class_metric_test(preds, target, BinaryRecall, _sk_binary(recall_score), sharded=True)
+
+    def test_precision_functional(self):
+        preds, target = _binary
+        self.run_functional_metric_test(preds, target, binary_precision, _sk_binary(precision_score))
+
+    def test_recall_functional(self):
+        preds, target = _binary
+        self.run_functional_metric_test(preds, target, binary_recall, _sk_binary(recall_score))
+
+
+class TestMulticlassPrecisionRecall(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize("average", ["micro", "macro", "weighted", "none"])
+    def test_precision_class(self, average):
+        preds, target = _mc
+        self.run_class_metric_test(
+            preds,
+            target,
+            MulticlassPrecision,
+            _sk_multiclass(precision_score, average),
+            metric_args={"num_classes": NUM_CLASSES, "average": average},
+            sharded=True,
+        )
+
+    @pytest.mark.parametrize("average", ["micro", "macro", "weighted", "none"])
+    def test_recall_functional(self, average):
+        preds, target = _mc
+        self.run_functional_metric_test(
+            preds,
+            target,
+            multiclass_recall,
+            _sk_multiclass(recall_score, average),
+            metric_args={"num_classes": NUM_CLASSES, "average": average},
+        )
+
+    @pytest.mark.parametrize("average", ["micro", "macro", "weighted", "none"])
+    def test_precision_functional(self, average):
+        preds, target = _mc
+        self.run_functional_metric_test(
+            preds,
+            target,
+            multiclass_precision,
+            _sk_multiclass(precision_score, average),
+            metric_args={"num_classes": NUM_CLASSES, "average": average},
+        )
+
+    @pytest.mark.parametrize("average", ["micro", "macro", "weighted"])
+    def test_recall_class(self, average):
+        preds, target = _mc
+        self.run_class_metric_test(
+            preds,
+            target,
+            MulticlassRecall,
+            _sk_multiclass(recall_score, average),
+            metric_args={"num_classes": NUM_CLASSES, "average": average},
+            sharded=True,
+        )
+
+
+class TestMultilabelPrecisionRecall(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize("average", ["micro", "macro", "none"])
+    def test_precision(self, average):
+        preds, target = _ml
+        self.run_class_metric_test(
+            preds,
+            target,
+            MultilabelPrecision,
+            _sk_multilabel(precision_score, average),
+            metric_args={"num_labels": NUM_CLASSES, "average": average},
+            sharded=True,
+        )
+        self.run_functional_metric_test(
+            preds,
+            target,
+            multilabel_precision,
+            _sk_multilabel(precision_score, average),
+            metric_args={"num_labels": NUM_CLASSES, "average": average},
+        )
+
+    @pytest.mark.parametrize("average", ["micro", "macro", "none"])
+    def test_recall(self, average):
+        preds, target = _ml
+        self.run_class_metric_test(
+            preds,
+            target,
+            MultilabelRecall,
+            _sk_multilabel(recall_score, average),
+            metric_args={"num_labels": NUM_CLASSES, "average": average},
+            sharded=True,
+        )
+
+
+class TestFBeta(MetricTester):
+    atol = 1e-6
+
+    def test_binary_f1(self):
+        preds, target = _binary
+        ref = lambda p, t: sk_fbeta(t.ravel(), _binarize(p).ravel(), beta=1.0, zero_division=0)
+        self.run_class_metric_test(preds, target, BinaryF1Score, ref, sharded=True)
+        self.run_functional_metric_test(preds, target, binary_f1_score, ref)
+
+    @pytest.mark.parametrize("average", ["micro", "macro", "weighted", "none"])
+    @pytest.mark.parametrize("beta", [0.5, 1.0, 2.0])
+    def test_multiclass_fbeta(self, average, beta):
+        preds, target = _mc
+
+        def ref(p, t):
+            return sk_fbeta(
+                t.ravel(),
+                p.ravel(),
+                beta=beta,
+                average=average if average != "none" else None,
+                labels=np.arange(NUM_CLASSES),
+                zero_division=0,
+            )
+
+        self.run_functional_metric_test(
+            preds,
+            target,
+            multiclass_fbeta_score,
+            ref,
+            metric_args={"num_classes": NUM_CLASSES, "average": average, "beta": beta},
+        )
+
+    def test_multiclass_f1_class(self):
+        preds, target = _mc
+        ref = lambda p, t: sk_fbeta(
+            t.ravel(), p.ravel(), beta=1.0, average="macro", labels=np.arange(NUM_CLASSES), zero_division=0
+        )
+        self.run_class_metric_test(
+            preds, target, MulticlassF1Score, ref, metric_args={"num_classes": NUM_CLASSES}, sharded=True
+        )
+
+    def test_multilabel_f1(self):
+        preds, target = _ml
+
+        def ref(p, t):
+            return sk_fbeta(
+                t.reshape(-1, NUM_CLASSES), _binarize(p).reshape(-1, NUM_CLASSES), beta=1.0, average="macro", zero_division=0
+            )
+
+        self.run_functional_metric_test(
+            preds, target, multilabel_f1_score, ref, metric_args={"num_labels": NUM_CLASSES, "average": "macro"}
+        )
+
+
+class TestSpecificityHamming(MetricTester):
+    atol = 1e-6
+
+    def test_binary_specificity(self):
+        preds, target = _binary
+
+        def ref(p, t):
+            p, t = _binarize(p).ravel(), t.ravel()
+            tn = ((p == 0) & (t == 0)).sum()
+            fp = ((p == 1) & (t == 0)).sum()
+            return tn / (tn + fp)
+
+        self.run_class_metric_test(preds, target, BinarySpecificity, ref, sharded=True)
+        self.run_functional_metric_test(preds, target, binary_specificity, ref)
+
+    def test_multiclass_specificity(self):
+        preds, target = _mc
+
+        def ref(p, t):
+            p, t = p.ravel(), t.ravel()
+            out = []
+            for c in range(NUM_CLASSES):
+                tn = ((p != c) & (t != c)).sum()
+                fp = ((p == c) & (t != c)).sum()
+                out.append(tn / (tn + fp))
+            return np.mean(out)
+
+        self.run_functional_metric_test(
+            preds, target, multiclass_specificity, ref, metric_args={"num_classes": NUM_CLASSES, "average": "macro"}
+        )
+
+    def test_binary_hamming(self):
+        preds, target = _binary
+        ref = lambda p, t: (_binarize(p).ravel() != t.ravel()).mean()
+        self.run_functional_metric_test(preds, target, binary_hamming_distance, ref)
+
+    def test_multiclass_hamming_micro(self):
+        preds, target = _mc
+        ref = lambda p, t: (p.ravel() != t.ravel()).mean()
+        self.run_functional_metric_test(
+            preds, target, multiclass_hamming_distance, ref, metric_args={"num_classes": NUM_CLASSES, "average": "micro"}
+        )
