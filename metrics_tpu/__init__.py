@@ -7,6 +7,22 @@ kernels, and jax.lax collectives over device meshes instead of NCCL process grou
 __version__ = "0.1.0"
 
 from metrics_tpu.classification import (
+    BinaryCohenKappa,
+    BinaryConfusionMatrix,
+    BinaryJaccardIndex,
+    BinaryMatthewsCorrCoef,
+    CohenKappa,
+    ConfusionMatrix,
+    JaccardIndex,
+    MatthewsCorrCoef,
+    MulticlassCohenKappa,
+    MulticlassConfusionMatrix,
+    MulticlassJaccardIndex,
+    MulticlassMatthewsCorrCoef,
+    MultilabelConfusionMatrix,
+    MultilabelJaccardIndex,
+    MultilabelMatthewsCorrCoef,
+
     Accuracy,
     BinaryAccuracy,
     BinaryStatScores,
@@ -19,6 +35,22 @@ from metrics_tpu.classification import (
 from metrics_tpu.core.metric import CompositionalMetric, Metric
 
 __all__ = [
+    "BinaryCohenKappa",
+    "BinaryConfusionMatrix",
+    "BinaryJaccardIndex",
+    "BinaryMatthewsCorrCoef",
+    "CohenKappa",
+    "ConfusionMatrix",
+    "JaccardIndex",
+    "MatthewsCorrCoef",
+    "MulticlassCohenKappa",
+    "MulticlassConfusionMatrix",
+    "MulticlassJaccardIndex",
+    "MulticlassMatthewsCorrCoef",
+    "MultilabelConfusionMatrix",
+    "MultilabelJaccardIndex",
+    "MultilabelMatthewsCorrCoef",
+
     "Accuracy",
     "BinaryAccuracy",
     "BinaryStatScores",

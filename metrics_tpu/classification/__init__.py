@@ -1,3 +1,22 @@
+from metrics_tpu.classification.cohen_kappa import BinaryCohenKappa, CohenKappa, MulticlassCohenKappa
+from metrics_tpu.classification.confusion_matrix import (
+    BinaryConfusionMatrix,
+    ConfusionMatrix,
+    MulticlassConfusionMatrix,
+    MultilabelConfusionMatrix,
+)
+from metrics_tpu.classification.jaccard import (
+    BinaryJaccardIndex,
+    JaccardIndex,
+    MulticlassJaccardIndex,
+    MultilabelJaccardIndex,
+)
+from metrics_tpu.classification.matthews_corrcoef import (
+    BinaryMatthewsCorrCoef,
+    MatthewsCorrCoef,
+    MulticlassMatthewsCorrCoef,
+    MultilabelMatthewsCorrCoef,
+)
 from metrics_tpu.classification.accuracy import Accuracy, BinaryAccuracy, MulticlassAccuracy, MultilabelAccuracy
 from metrics_tpu.classification.f_beta import (
     BinaryF1Score,
@@ -39,6 +58,22 @@ from metrics_tpu.classification.stat_scores import (
 )
 
 __all__ = [
+    "BinaryCohenKappa",
+    "BinaryConfusionMatrix",
+    "BinaryJaccardIndex",
+    "BinaryMatthewsCorrCoef",
+    "CohenKappa",
+    "ConfusionMatrix",
+    "JaccardIndex",
+    "MatthewsCorrCoef",
+    "MulticlassCohenKappa",
+    "MulticlassConfusionMatrix",
+    "MulticlassJaccardIndex",
+    "MulticlassMatthewsCorrCoef",
+    "MultilabelConfusionMatrix",
+    "MultilabelJaccardIndex",
+    "MultilabelMatthewsCorrCoef",
+
     "Accuracy",
     "BinaryAccuracy",
     "BinaryF1Score",

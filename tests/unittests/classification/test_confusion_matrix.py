@@ -1,0 +1,203 @@
+"""Differential tests for confusion matrix + derived metrics vs sklearn.
+
+Mirrors reference tests/unittests/classification/{test_confusion_matrix,
+test_cohen_kappa,test_jaccard,test_matthews_corrcoef}.py coverage.
+"""
+import numpy as np
+import pytest
+from scipy.special import expit
+from sklearn.metrics import (
+    cohen_kappa_score,
+    confusion_matrix as sk_confusion_matrix,
+    jaccard_score,
+    matthews_corrcoef as sk_matthews_corrcoef,
+    multilabel_confusion_matrix as sk_multilabel_confusion_matrix,
+)
+
+from metrics_tpu.classification import (
+    BinaryConfusionMatrix,
+    MulticlassCohenKappa,
+    MulticlassConfusionMatrix,
+    MulticlassMatthewsCorrCoef,
+    MultilabelConfusionMatrix,
+)
+from metrics_tpu.functional.classification import (
+    binary_cohen_kappa,
+    binary_confusion_matrix,
+    binary_jaccard_index,
+    binary_matthews_corrcoef,
+    multiclass_cohen_kappa,
+    multiclass_confusion_matrix,
+    multiclass_jaccard_index,
+    multiclass_matthews_corrcoef,
+    multilabel_confusion_matrix,
+    multilabel_jaccard_index,
+    multilabel_matthews_corrcoef,
+)
+
+import sys, os
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+from helpers import seed_all  # noqa: E402
+from helpers.testers import BATCH_SIZE, NUM_BATCHES, NUM_CLASSES, THRESHOLD, MetricTester  # noqa: E402
+
+seed_all(42)
+_rng = np.random.default_rng(11)
+_binary = (_rng.random((NUM_BATCHES, BATCH_SIZE)), _rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE)))
+_mc = (
+    _rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE)),
+    _rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE)),
+)
+_ml = (
+    _rng.random((NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)),
+    _rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)),
+)
+
+
+def _binarize(preds):
+    preds = np.asarray(preds)
+    if preds.dtype.kind == "f":
+        if not ((preds >= 0) & (preds <= 1)).all():
+            preds = expit(preds)
+        preds = (preds > THRESHOLD).astype(int)
+    return preds
+
+
+def _ref_binary_cm(preds, target):
+    return sk_confusion_matrix(target.ravel(), _binarize(preds).ravel(), labels=[0, 1])
+
+
+def _ref_mc_cm(preds, target):
+    return sk_confusion_matrix(target.ravel(), preds.ravel(), labels=np.arange(NUM_CLASSES))
+
+
+def _ref_ml_cm(preds, target):
+    return sk_multilabel_confusion_matrix(
+        np.asarray(target).reshape(-1, NUM_CLASSES), _binarize(preds).reshape(-1, NUM_CLASSES)
+    )
+
+
+class TestConfusionMatrix(MetricTester):
+    atol = 1e-6
+
+    def test_binary(self):
+        preds, target = _binary
+        self.run_class_metric_test(preds, target, BinaryConfusionMatrix, _ref_binary_cm, sharded=True)
+        self.run_functional_metric_test(preds, target, binary_confusion_matrix, _ref_binary_cm)
+
+    def test_multiclass(self):
+        preds, target = _mc
+        self.run_class_metric_test(preds, target, MulticlassConfusionMatrix, _ref_mc_cm,
+                                   metric_args={"num_classes": NUM_CLASSES}, sharded=True)
+        self.run_functional_metric_test(preds, target, multiclass_confusion_matrix, _ref_mc_cm,
+                                        metric_args={"num_classes": NUM_CLASSES})
+
+    def test_multilabel(self):
+        preds, target = _ml
+        self.run_class_metric_test(preds, target, MultilabelConfusionMatrix, _ref_ml_cm,
+                                   metric_args={"num_labels": NUM_CLASSES}, sharded=True)
+        self.run_functional_metric_test(preds, target, multilabel_confusion_matrix, _ref_ml_cm,
+                                        metric_args={"num_labels": NUM_CLASSES})
+
+    @pytest.mark.parametrize("normalize", ["true", "pred", "all", "none"])
+    def test_multiclass_normalize(self, normalize):
+        preds, target = _mc
+        res = multiclass_confusion_matrix(preds[0], target[0], num_classes=NUM_CLASSES, normalize=normalize)
+        ref = sk_confusion_matrix(
+            target[0], preds[0], labels=np.arange(NUM_CLASSES), normalize=normalize if normalize != "none" else None
+        )
+        np.testing.assert_allclose(np.asarray(res), ref, atol=1e-6)
+
+    def test_ignore_index(self):
+        preds, target = _mc
+        t = np.where(target[0] == 1, -1, target[0])
+        res = multiclass_confusion_matrix(preds[0], t, num_classes=NUM_CLASSES, ignore_index=-1)
+        mask = t != -1
+        ref = sk_confusion_matrix(t[mask], preds[0][mask], labels=np.arange(NUM_CLASSES))
+        np.testing.assert_allclose(np.asarray(res), ref, atol=1e-6)
+
+
+class TestCohenKappa(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize("weights", [None, "linear", "quadratic"])
+    def test_binary_functional(self, weights):
+        preds, target = _binary
+        ref = lambda p, t: cohen_kappa_score(t.ravel(), _binarize(p).ravel(), weights=weights)
+        self.run_functional_metric_test(preds, target, binary_cohen_kappa, ref, metric_args={"weights": weights})
+
+    @pytest.mark.parametrize("weights", [None, "linear", "quadratic"])
+    def test_multiclass(self, weights):
+        preds, target = _mc
+        ref = lambda p, t: cohen_kappa_score(t.ravel(), p.ravel(), weights=weights)
+        self.run_functional_metric_test(
+            preds, target, multiclass_cohen_kappa, ref, metric_args={"num_classes": NUM_CLASSES, "weights": weights}
+        )
+
+    def test_multiclass_class(self):
+        preds, target = _mc
+        ref = lambda p, t: cohen_kappa_score(t.ravel(), p.ravel())
+        self.run_class_metric_test(
+            preds, target, MulticlassCohenKappa, ref, metric_args={"num_classes": NUM_CLASSES}, sharded=True
+        )
+
+
+class TestJaccard(MetricTester):
+    atol = 1e-6
+
+    def test_binary(self):
+        preds, target = _binary
+        ref = lambda p, t: jaccard_score(t.ravel(), _binarize(p).ravel(), zero_division=0)
+        self.run_functional_metric_test(preds, target, binary_jaccard_index, ref)
+
+    @pytest.mark.parametrize("average", ["micro", "macro", "weighted", "none"])
+    def test_multiclass(self, average):
+        preds, target = _mc
+
+        def ref(p, t):
+            return jaccard_score(
+                t.ravel(), p.ravel(), labels=np.arange(NUM_CLASSES),
+                average=average if average != "none" else None, zero_division=0,
+            )
+
+        self.run_functional_metric_test(
+            preds, target, multiclass_jaccard_index, ref, metric_args={"num_classes": NUM_CLASSES, "average": average}
+        )
+
+    @pytest.mark.parametrize("average", ["micro", "macro", "none"])
+    def test_multilabel(self, average):
+        preds, target = _ml
+
+        def ref(p, t):
+            return jaccard_score(
+                np.asarray(t).reshape(-1, NUM_CLASSES), _binarize(p).reshape(-1, NUM_CLASSES),
+                average=average if average != "none" else None, zero_division=0,
+            )
+
+        self.run_functional_metric_test(
+            preds, target, multilabel_jaccard_index, ref, metric_args={"num_labels": NUM_CLASSES, "average": average}
+        )
+
+
+class TestMatthews(MetricTester):
+    atol = 1e-6
+
+    def test_binary(self):
+        preds, target = _binary
+        ref = lambda p, t: sk_matthews_corrcoef(t.ravel(), _binarize(p).ravel())
+        self.run_functional_metric_test(preds, target, binary_matthews_corrcoef, ref)
+
+    def test_multiclass(self):
+        preds, target = _mc
+        ref = lambda p, t: sk_matthews_corrcoef(t.ravel(), p.ravel())
+        self.run_functional_metric_test(
+            preds, target, multiclass_matthews_corrcoef, ref, metric_args={"num_classes": NUM_CLASSES}
+        )
+        self.run_class_metric_test(
+            preds, target, MulticlassMatthewsCorrCoef, ref, metric_args={"num_classes": NUM_CLASSES}, sharded=True
+        )
+
+    def test_multilabel_runs(self):
+        preds, target = _ml
+        res = multilabel_matthews_corrcoef(preds[0], target[0], num_labels=NUM_CLASSES)
+        assert np.isfinite(np.asarray(res))
