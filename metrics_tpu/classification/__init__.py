@@ -1,3 +1,17 @@
+from metrics_tpu.classification.auroc import AUROC, BinaryAUROC, MulticlassAUROC, MultilabelAUROC
+from metrics_tpu.classification.average_precision import (
+    AveragePrecision,
+    BinaryAveragePrecision,
+    MulticlassAveragePrecision,
+    MultilabelAveragePrecision,
+)
+from metrics_tpu.classification.precision_recall_curve import (
+    BinaryPrecisionRecallCurve,
+    MulticlassPrecisionRecallCurve,
+    MultilabelPrecisionRecallCurve,
+    PrecisionRecallCurve,
+)
+from metrics_tpu.classification.roc import ROC, BinaryROC, MulticlassROC, MultilabelROC
 from metrics_tpu.classification.cohen_kappa import BinaryCohenKappa, CohenKappa, MulticlassCohenKappa
 from metrics_tpu.classification.confusion_matrix import (
     BinaryConfusionMatrix,
@@ -58,6 +72,23 @@ from metrics_tpu.classification.stat_scores import (
 )
 
 __all__ = [
+    "AUROC",
+    "AveragePrecision",
+    "BinaryAUROC",
+    "BinaryAveragePrecision",
+    "BinaryPrecisionRecallCurve",
+    "BinaryROC",
+    "MulticlassAUROC",
+    "MulticlassAveragePrecision",
+    "MulticlassPrecisionRecallCurve",
+    "MulticlassROC",
+    "MultilabelAUROC",
+    "MultilabelAveragePrecision",
+    "MultilabelPrecisionRecallCurve",
+    "MultilabelROC",
+    "PrecisionRecallCurve",
+    "ROC",
+
     "BinaryCohenKappa",
     "BinaryConfusionMatrix",
     "BinaryJaccardIndex",

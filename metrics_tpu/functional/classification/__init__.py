@@ -1,3 +1,17 @@
+from metrics_tpu.functional.classification.auroc import auroc, binary_auroc, multiclass_auroc, multilabel_auroc
+from metrics_tpu.functional.classification.average_precision import (
+    average_precision,
+    binary_average_precision,
+    multiclass_average_precision,
+    multilabel_average_precision,
+)
+from metrics_tpu.functional.classification.precision_recall_curve import (
+    binary_precision_recall_curve,
+    multiclass_precision_recall_curve,
+    multilabel_precision_recall_curve,
+    precision_recall_curve,
+)
+from metrics_tpu.functional.classification.roc import binary_roc, multiclass_roc, multilabel_roc, roc
 from metrics_tpu.functional.classification.cohen_kappa import binary_cohen_kappa, cohen_kappa, multiclass_cohen_kappa
 from metrics_tpu.functional.classification.confusion_matrix import (
     binary_confusion_matrix,

@@ -1,0 +1,225 @@
+"""Average precision (AUPRC) functionals.
+
+Capability parity with reference ``functional/classification/average_precision.py``
+(_reduce_average_precision :43-67, binary :70-160, multiclass :163-279, multilabel
+:282-400, dispatcher :403-460). Riemann sum over the shared PR-curve state.
+"""
+from typing import List, Optional, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.functional.classification.auroc import _exact_mode_class_weights
+from metrics_tpu.functional.classification.precision_recall_curve import (
+    _binary_precision_recall_curve_arg_validation,
+    _binary_precision_recall_curve_compute,
+    _binary_precision_recall_curve_format,
+    _binary_precision_recall_curve_tensor_validation,
+    _binary_precision_recall_curve_update,
+    _multiclass_precision_recall_curve_arg_validation,
+    _multiclass_precision_recall_curve_compute,
+    _multiclass_precision_recall_curve_format,
+    _multiclass_precision_recall_curve_tensor_validation,
+    _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_arg_validation,
+    _multilabel_precision_recall_curve_compute,
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_tensor_validation,
+    _multilabel_precision_recall_curve_update,
+)
+from metrics_tpu.functional.classification.roc import _is_confmat_state
+from metrics_tpu.utils.compute import _safe_divide
+from metrics_tpu.utils.enums import ClassificationTask
+from metrics_tpu.utils.prints import rank_zero_warn
+
+
+def _reduce_average_precision(
+    precision: Union[Array, List[Array]],
+    recall: Union[Array, List[Array]],
+    average: Optional[str] = "macro",
+    weights: Optional[Array] = None,
+) -> Array:
+    """Reference: average_precision.py:43-67."""
+    if isinstance(precision, (jnp.ndarray, np.ndarray)) and not isinstance(precision, (list, tuple)):
+        res = -jnp.sum((recall[:, 1:] - recall[:, :-1]) * precision[:, :-1], axis=1)
+    else:
+        res = jnp.stack([-jnp.sum((r[1:] - r[:-1]) * p[:-1]) for p, r in zip(precision, recall)])
+    if average is None or average == "none":
+        return res
+    if bool(jnp.isnan(res).any()):
+        rank_zero_warn(
+            f"Average precision score for one or more classes was `nan`. Ignoring these classes in {average}-average",
+            UserWarning,
+        )
+    idx = ~jnp.isnan(res)
+    if average == "macro":
+        return jnp.where(idx, res, 0.0).sum() / idx.sum()
+    if average == "weighted" and weights is not None:
+        weights = jnp.where(idx, weights, 0.0)
+        weights = _safe_divide(weights, weights.sum())
+        return jnp.where(idx, res * weights, 0.0).sum()
+    raise ValueError("Received an incompatible combinations of inputs to make reduction.")
+
+
+def _binary_average_precision_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    thresholds: Optional[Array],
+) -> Array:
+    """Reference: average_precision.py:70-75."""
+    precision, recall, _ = _binary_precision_recall_curve_compute(state, thresholds)
+    return -jnp.sum((recall[1:] - recall[:-1]) * precision[:-1])
+
+
+def binary_average_precision(
+    preds: Array,
+    target: Array,
+    thresholds=None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Binary AP (reference: average_precision.py:78-160)."""
+    if validate_args:
+        _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds, target, thresholds = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
+    state = _binary_precision_recall_curve_update(preds, target, thresholds)
+    return _binary_average_precision_compute(state, thresholds)
+
+
+def _multiclass_average_precision_arg_validation(
+    num_classes: int,
+    average: Optional[str] = "macro",
+    thresholds=None,
+    ignore_index: Optional[int] = None,
+) -> None:
+    _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index)
+    if average not in ("macro", "weighted", "none", None):
+        raise ValueError(
+            f"Expected argument `average` to be one of ('macro', 'weighted', 'none', None), but got {average}"
+        )
+
+
+def _multiclass_average_precision_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    num_classes: int,
+    average: Optional[str] = "macro",
+    thresholds: Optional[Array] = None,
+) -> Array:
+    """Reference: average_precision.py:163-175."""
+    precision, recall, _ = _multiclass_precision_recall_curve_compute(state, num_classes, thresholds)
+    return _reduce_average_precision(
+        precision,
+        recall,
+        average,
+        weights=(
+            _exact_mode_class_weights(state[1], num_classes)
+            if thresholds is None
+            else state[0][:, 1, :].sum(-1).astype(jnp.float32)
+        ),
+    )
+
+
+def multiclass_average_precision(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    average: Optional[str] = "macro",
+    thresholds=None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Multiclass AP (reference: average_precision.py:178-279)."""
+    if validate_args:
+        _multiclass_average_precision_arg_validation(num_classes, average, thresholds, ignore_index)
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, thresholds = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index
+    )
+    state = _multiclass_precision_recall_curve_update(preds, target, num_classes, thresholds)
+    return _multiclass_average_precision_compute(state, num_classes, average, thresholds)
+
+
+def _multilabel_average_precision_arg_validation(
+    num_labels: int,
+    average: Optional[str],
+    thresholds=None,
+    ignore_index: Optional[int] = None,
+) -> None:
+    _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+    if average not in ("micro", "macro", "weighted", "none", None):
+        raise ValueError(
+            f"Expected argument `average` to be one of ('micro', 'macro', 'weighted', 'none', None), but got {average}"
+        )
+
+
+def _multilabel_average_precision_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    num_labels: int,
+    average: Optional[str],
+    thresholds: Optional[Array],
+    ignore_index: Optional[int] = None,
+) -> Array:
+    """Reference: average_precision.py:282-310."""
+    if average == "micro":
+        if _is_confmat_state(state) and thresholds is not None:
+            return _binary_average_precision_compute(state.sum(1), thresholds)
+        preds = np.asarray(state[0]).ravel()
+        target = np.asarray(state[1]).ravel()
+        if ignore_index is not None:
+            idx = target < 0
+            preds = preds[~idx]
+            target = target[~idx]
+        return _binary_average_precision_compute((preds, target), thresholds)
+
+    precision, recall, _ = _multilabel_precision_recall_curve_compute(state, num_labels, thresholds, ignore_index)
+    if thresholds is None:
+        t = np.asarray(state[1])
+        weights = jnp.asarray((t == 1).sum(0).astype(np.float32))
+    else:
+        weights = state[0][:, 1, :].sum(-1).astype(jnp.float32)
+    return _reduce_average_precision(precision, recall, average, weights=weights)
+
+
+def multilabel_average_precision(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    average: Optional[str] = "macro",
+    thresholds=None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Multilabel AP (reference: average_precision.py:313-400)."""
+    if validate_args:
+        _multilabel_average_precision_arg_validation(num_labels, average, thresholds, ignore_index)
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, thresholds = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    state = _multilabel_precision_recall_curve_update(preds, target, num_labels, thresholds)
+    return _multilabel_average_precision_compute(state, num_labels, average, thresholds, ignore_index)
+
+
+def average_precision(
+    preds: Array,
+    target: Array,
+    task: str,
+    thresholds=None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    average: Optional[str] = "macro",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Task dispatcher (reference: average_precision.py:403-460)."""
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_average_precision(preds, target, thresholds, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        assert isinstance(num_classes, int)
+        return multiclass_average_precision(preds, target, num_classes, average, thresholds, ignore_index, validate_args)
+    if task == ClassificationTask.MULTILABEL:
+        assert isinstance(num_labels, int)
+        return multilabel_average_precision(preds, target, num_labels, average, thresholds, ignore_index, validate_args)
+    raise ValueError(f"Not handled value: {task}")

@@ -1,0 +1,500 @@
+"""Precision-recall curve kernels — the second shared core of classification.
+
+Capability parity with reference ``functional/classification/precision_recall_curve.py``
+(922 LoC: _binary_clf_curve :28-80, binary :94-350, multiclass :353-635, multilabel
+:638-860, dispatcher :863-922). Two state modes, as in the reference:
+
+- ``thresholds=None`` (exact): store all preds/targets (cat states), compute the curve
+  at unique thresholds via sort+cumsum. Output size is data-dependent, so this path is
+  **host-side** (numpy) at compute time — matching the reference's eager behavior.
+- ``thresholds=int/list/array`` (binned): constant-memory multi-threshold confusion
+  tensor ``(T, 2, 2)``. TPU-first redesign: instead of the reference's
+  bincount-of-mapping (:205-219) or python loop over thresholds (:222-243), the
+  confusion entries are **fused broadcast-compare reductions**
+  (``(preds[:,None] >= thr) & target[:,None] -> sum over N``) — XLA fuses the N x T
+  intermediate into the reduction (no materialization, no scatter), which vectorizes on
+  the VPU and shards cleanly under GSPMD. No 50k-element vectorize-vs-loop switch is
+  needed (:198-202) — the fused form is both the fast and the low-memory path.
+"""
+from typing import List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.functional.classification.stat_scores import _is_floating
+from metrics_tpu.utils.checks import _is_concrete
+from metrics_tpu.utils.compute import _safe_divide
+from metrics_tpu.utils.enums import ClassificationTask
+
+
+def _binary_clf_curve(
+    preds: Array,
+    target: Array,
+    sample_weights: Optional[Union[Array, list]] = None,
+    pos_label: int = 1,
+) -> Tuple[Array, Array, Array]:
+    """fps/tps at every unique threshold, sklearn-style (reference: :28-80).
+
+    Host-side: output length is data-dependent (number of distinct scores).
+    """
+    preds = np.asarray(preds)
+    target = np.asarray(target)
+    if sample_weights is not None:
+        sample_weights = np.asarray(sample_weights, dtype=np.float32)
+
+    if preds.ndim > target.ndim:
+        preds = preds[:, 0]
+    desc_score_indices = np.argsort(preds, kind="stable")[::-1]
+    preds = preds[desc_score_indices]
+    target = target[desc_score_indices]
+    weight = sample_weights[desc_score_indices] if sample_weights is not None else 1.0
+
+    distinct_value_indices = np.where(preds[1:] - preds[:-1])[0]
+    threshold_idxs = np.concatenate([distinct_value_indices, [target.size - 1]])
+    target = (target == pos_label).astype(np.int64)
+    tps = np.cumsum(target * weight, axis=0)[threshold_idxs]
+
+    if sample_weights is not None:
+        fps = np.cumsum((1 - target) * weight, axis=0)[threshold_idxs]
+    else:
+        fps = 1 + threshold_idxs - tps
+
+    return jnp.asarray(fps), jnp.asarray(tps), jnp.asarray(preds[threshold_idxs])
+
+
+def _adjust_threshold_arg(
+    thresholds: Optional[Union[int, List[float], Array]] = None, device=None
+) -> Optional[Array]:
+    """int/list/array thresholds -> 1d array (reference: :83-91)."""
+    if isinstance(thresholds, int):
+        return jnp.linspace(0, 1, thresholds)
+    if isinstance(thresholds, list):
+        return jnp.asarray(thresholds)
+    if thresholds is not None:
+        return jnp.asarray(thresholds)
+    return None
+
+
+def _binary_precision_recall_curve_arg_validation(
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+) -> None:
+    if thresholds is not None and not isinstance(thresholds, (list, int, jnp.ndarray, np.ndarray)):
+        raise ValueError(
+            "Expected argument `thresholds` to either be an integer, list of floats or"
+            f" tensor of floats, but got {thresholds}"
+        )
+    if isinstance(thresholds, int) and thresholds < 2:
+        raise ValueError(
+            f"If argument `thresholds` is an integer, expected it to be larger than 1, but got {thresholds}"
+        )
+    if isinstance(thresholds, list) and not all(isinstance(t, float) and 0 <= t <= 1 for t in thresholds):
+        raise ValueError(
+            f"If argument `thresholds` is a list, expected all elements to be floats in the [0,1] range, "
+            f"but got {thresholds}"
+        )
+    if isinstance(thresholds, (jnp.ndarray, np.ndarray)):
+        if np.asarray(thresholds).ndim != 1:
+            raise ValueError("If argument `thresholds` is an tensor, expected the tensor to be 1d")
+        if not bool(np.all((np.asarray(thresholds) >= 0) & (np.asarray(thresholds) <= 1))):
+            raise ValueError("If argument `thresholds` is an tensor, expected all elements to be in [0,1] range")
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+
+
+def _binary_precision_recall_curve_tensor_validation(
+    preds: Array, target: Array, ignore_index: Optional[int] = None
+) -> None:
+    if preds.shape != target.shape:
+        raise ValueError(
+            "Expected `preds` and `target` to have the same shape,"
+            f" but got `preds` with shape={preds.shape} and `target` with shape={target.shape}"
+        )
+    if jnp.issubdtype(jnp.asarray(target).dtype, jnp.floating):
+        raise ValueError(
+            "Expected argument `target` to be an int or long tensor with ground truth labels"
+            f" but got tensor with dtype {target.dtype}"
+        )
+    if not _is_floating(preds):
+        raise ValueError(
+            "Expected argument `preds` to be an floating tensor with probability/logit scores,"
+            f" but got tensor with dtype {preds.dtype}"
+        )
+    if not _is_concrete(preds, target):
+        return
+    unique_values = np.unique(np.asarray(target))
+    if ignore_index is None:
+        check = np.any((unique_values != 0) & (unique_values != 1))
+    else:
+        check = np.any((unique_values != 0) & (unique_values != 1) & (unique_values != ignore_index))
+    if check:
+        raise RuntimeError(
+            f"Detected the following values in `target`: {unique_values} but expected only"
+            f" the following values {[0, 1] if ignore_index is None else [0, 1, ignore_index]}."
+        )
+
+
+def _binary_precision_recall_curve_format(
+    preds: Array,
+    target: Array,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Optional[Array]]:
+    """Flatten, sigmoid-if-logits; ignored targets -> -1 (masked in update)."""
+    preds = jnp.asarray(preds).ravel()
+    target = jnp.asarray(target).ravel()
+    if ignore_index is not None:
+        target = jnp.where(target == ignore_index, -1, target)
+
+    is_prob = jnp.all((preds >= 0) & (preds <= 1))
+    preds = jnp.where(is_prob, preds, jax.nn.sigmoid(preds))
+
+    thresholds = _adjust_threshold_arg(thresholds)
+    return preds, target, thresholds
+
+
+def _binary_precision_recall_curve_update(
+    preds: Array,
+    target: Array,
+    thresholds: Optional[Array],
+) -> Union[Array, Tuple[Array, Array]]:
+    """Binned: (T,2,2) confusion tensor via fused broadcast reductions; exact: passthrough."""
+    if thresholds is None:
+        return preds, target
+    preds_t = preds[:, None] >= thresholds[None, :]  # (N, T) — fused into the sums below
+    t1 = (target == 1)[:, None]
+    t0 = (target == 0)[:, None]
+    tp = (preds_t & t1).sum(0)
+    fp = (preds_t & t0).sum(0)
+    fn = ((~preds_t) & t1).sum(0)
+    tn = ((~preds_t) & t0).sum(0)
+    return jnp.stack([jnp.stack([tn, fp], -1), jnp.stack([fn, tp], -1)], -2)  # (T, 2, 2)
+
+
+def _binary_precision_recall_curve_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    thresholds: Optional[Array],
+    pos_label: int = 1,
+) -> Tuple[Array, Array, Array]:
+    """Final curve from confusion tensor (binned) or raw scores (exact). Reference: :246-272."""
+    if isinstance(state, (jnp.ndarray, np.ndarray)) and not isinstance(state, (tuple, list)):
+        tps = state[:, 1, 1]
+        fps = state[:, 0, 1]
+        fns = state[:, 1, 0]
+        precision = _safe_divide(tps, tps + fps)
+        recall = _safe_divide(tps, tps + fns)
+        precision = jnp.concatenate([precision, jnp.ones(1, dtype=precision.dtype)])
+        recall = jnp.concatenate([recall, jnp.zeros(1, dtype=recall.dtype)])
+        return precision, recall, thresholds
+
+    # exact mode is host-side; drop positions masked to -1 by ignore_index
+    _p, _t = np.asarray(state[0]), np.asarray(state[1])
+    keep = _t >= 0
+    fps, tps, thresholds = _binary_clf_curve(_p[keep], _t[keep], pos_label=pos_label)
+    precision = tps / (tps + fps)
+    recall = tps / tps[-1]
+
+    precision = jnp.concatenate([jnp.flip(precision, 0), jnp.ones(1, dtype=precision.dtype)])
+    recall = jnp.concatenate([jnp.flip(recall, 0), jnp.zeros(1, dtype=recall.dtype)])
+    thresholds = jnp.flip(thresholds, 0)
+    return precision, recall, thresholds
+
+
+def binary_precision_recall_curve(
+    preds: Array,
+    target: Array,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array, Array]:
+    """Precision-recall curve for binary tasks (reference: :275-350).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional.classification import binary_precision_recall_curve
+        >>> preds = jnp.array([0, 0.5, 0.7, 0.8])
+        >>> target = jnp.array([0, 1, 1, 0])
+        >>> prec, rec, thr = binary_precision_recall_curve(preds, target, thresholds=5)
+        >>> prec
+        Array([0.5      , 0.6666667, 0.6666667, 0.       , 0.       , 1.       ],      dtype=float32)
+    """
+    if validate_args:
+        _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds, target, thresholds = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
+    state = _binary_precision_recall_curve_update(preds, target, thresholds)
+    return _binary_precision_recall_curve_compute(state, thresholds)
+
+
+# -------------------------------------------------------------------- multiclass
+
+
+def _multiclass_precision_recall_curve_arg_validation(
+    num_classes: int,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+) -> None:
+    if not isinstance(num_classes, int) or num_classes < 2:
+        raise ValueError(f"Expected argument `num_classes` to be an integer larger than 1, but got {num_classes}")
+    _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+
+
+def _multiclass_precision_recall_curve_tensor_validation(
+    preds: Array, target: Array, num_classes: int, ignore_index: Optional[int] = None
+) -> None:
+    if not preds.ndim == target.ndim + 1:
+        raise ValueError(
+            f"Expected `preds` to have one more dimension than `target` but got {preds.ndim} and {target.ndim}"
+        )
+    if jnp.issubdtype(jnp.asarray(target).dtype, jnp.floating):
+        raise ValueError(
+            f"Expected argument `target` to be an int or long tensor, but got tensor with dtype {target.dtype}"
+        )
+    if not _is_floating(preds):
+        raise ValueError(f"Expected `preds` to be a float tensor, but got {preds.dtype}")
+    if preds.shape[1] != num_classes:
+        raise ValueError(
+            "Expected `preds.shape[1]` to be equal to the number of classes but"
+            f" got {preds.shape[1]} and {num_classes}."
+        )
+    if preds.shape[0] != target.shape[0] or preds.shape[2:] != target.shape[1:]:
+        raise ValueError(
+            "Expected the shape of `preds` should be (N, C, ...) and the shape of `target` should be (N, ...)"
+            f" but got {preds.shape} and {target.shape}"
+        )
+    if not _is_concrete(preds, target):
+        return
+    num_unique_values = len(np.unique(np.asarray(target)))
+    check = num_unique_values > num_classes if ignore_index is None else num_unique_values > num_classes + 1
+    if check:
+        raise RuntimeError(
+            "Detected more unique values in `target` than `num_classes`. Expected only "
+            f"{num_classes if ignore_index is None else num_classes + 1} but found "
+            f"{num_unique_values} in `target`."
+        )
+
+
+def _multiclass_precision_recall_curve_format(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Optional[Array]]:
+    """(N, C, ...) -> (N', C) probs + (N',) labels; ignored targets -> -1."""
+    preds = jnp.moveaxis(jnp.asarray(preds), 0, 1).reshape(num_classes, -1).T
+    target = jnp.asarray(target).ravel()
+    if ignore_index is not None:
+        target = jnp.where(target == ignore_index, -1, target)
+
+    is_prob = jnp.all((preds >= 0) & (preds <= 1))
+    preds = jnp.where(is_prob, preds, jax.nn.softmax(preds, axis=1))
+
+    thresholds = _adjust_threshold_arg(thresholds)
+    return preds, target, thresholds
+
+
+def _multiclass_precision_recall_curve_update(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    thresholds: Optional[Array],
+) -> Union[Array, Tuple[Array, Array]]:
+    """Binned: (T,C,2,2) confusion tensor via fused broadcast reductions."""
+    if thresholds is None:
+        return preds, target
+    valid = (target >= 0)[:, None, None]
+    preds_t = preds[:, :, None] >= thresholds[None, None, :]  # (N, C, T)
+    target_oh = jax.nn.one_hot(target, num_classes, dtype=bool)[:, :, None]  # (N, C, 1)
+    tp = (preds_t & target_oh & valid).sum(0)
+    fp = (preds_t & (~target_oh) & valid).sum(0)
+    fn = ((~preds_t) & target_oh & valid).sum(0)
+    tn = ((~preds_t) & (~target_oh) & valid).sum(0)
+    # (C, T) each -> (T, C, 2, 2)
+    confmat = jnp.stack([jnp.stack([tn, fp], -1), jnp.stack([fn, tp], -1)], -2)
+    return jnp.moveaxis(confmat, 0, 1)
+
+
+def _multiclass_precision_recall_curve_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    num_classes: int,
+    thresholds: Optional[Array],
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    """Reference: :510-535."""
+    if isinstance(state, (jnp.ndarray, np.ndarray)) and not isinstance(state, (tuple, list)):
+        tps = state[:, :, 1, 1]
+        fps = state[:, :, 0, 1]
+        fns = state[:, :, 1, 0]
+        precision = _safe_divide(tps, tps + fps)
+        recall = _safe_divide(tps, tps + fns)
+        precision = jnp.concatenate([precision, jnp.ones((1, num_classes), dtype=precision.dtype)])
+        recall = jnp.concatenate([recall, jnp.zeros((1, num_classes), dtype=recall.dtype)])
+        return precision.T, recall.T, thresholds
+
+    precision, recall, thresholds_out = [], [], []
+    for i in range(num_classes):
+        res = _binary_precision_recall_curve_compute((state[0][:, i], state[1]), thresholds=None, pos_label=i)
+        precision.append(res[0])
+        recall.append(res[1])
+        thresholds_out.append(res[2])
+    return precision, recall, thresholds_out
+
+
+def multiclass_precision_recall_curve(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    """Precision-recall curve for multiclass tasks (reference: :538-635)."""
+    if validate_args:
+        _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index)
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, thresholds = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index
+    )
+    state = _multiclass_precision_recall_curve_update(preds, target, num_classes, thresholds)
+    return _multiclass_precision_recall_curve_compute(state, num_classes, thresholds)
+
+
+# -------------------------------------------------------------------- multilabel
+
+
+def _multilabel_precision_recall_curve_arg_validation(
+    num_labels: int,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+) -> None:
+    _multiclass_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+
+
+def _multilabel_precision_recall_curve_tensor_validation(
+    preds: Array, target: Array, num_labels: int, ignore_index: Optional[int] = None
+) -> None:
+    _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    if preds.shape[1] != num_labels:
+        raise ValueError(
+            "Expected both `target.shape[1]` and `preds.shape[1]` to be equal to the number of labels"
+            f" but got {preds.shape[1]} and expected {num_labels}"
+        )
+
+
+def _multilabel_precision_recall_curve_format(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Optional[Array]]:
+    """(N, C, ...) -> (N', L); ignored positions -> target=-1 (masked in update)."""
+    preds = jnp.moveaxis(jnp.asarray(preds), 0, 1).reshape(num_labels, -1).T
+    target = jnp.moveaxis(jnp.asarray(target), 0, 1).reshape(num_labels, -1).T
+    is_prob = jnp.all((preds >= 0) & (preds <= 1))
+    preds = jnp.where(is_prob, preds, jax.nn.sigmoid(preds))
+
+    thresholds = _adjust_threshold_arg(thresholds)
+    if ignore_index is not None:
+        target = jnp.where(target == ignore_index, -1, target)
+    return preds, target, thresholds
+
+
+def _multilabel_precision_recall_curve_update(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    thresholds: Optional[Array],
+) -> Union[Array, Tuple[Array, Array]]:
+    """Binned: (T,L,2,2) via fused broadcast reductions with validity masking."""
+    if thresholds is None:
+        return preds, target
+    valid = (target >= 0)[:, :, None]
+    preds_t = preds[:, :, None] >= thresholds[None, None, :]  # (N, L, T)
+    t1 = (target == 1)[:, :, None]
+    t0 = (target == 0)[:, :, None]
+    tp = (preds_t & t1 & valid).sum(0)
+    fp = (preds_t & t0 & valid).sum(0)
+    fn = ((~preds_t) & t1 & valid).sum(0)
+    tn = ((~preds_t) & t0 & valid).sum(0)
+    confmat = jnp.stack([jnp.stack([tn, fp], -1), jnp.stack([fn, tp], -1)], -2)  # (L, T, 2, 2)
+    return jnp.moveaxis(confmat, 0, 1)
+
+
+def _multilabel_precision_recall_curve_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    num_labels: int,
+    thresholds: Optional[Array],
+    ignore_index: Optional[int] = None,
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    """Reference: :726-760."""
+    if isinstance(state, (jnp.ndarray, np.ndarray)) and not isinstance(state, (tuple, list)):
+        tps = state[:, :, 1, 1]
+        fps = state[:, :, 0, 1]
+        fns = state[:, :, 1, 0]
+        precision = _safe_divide(tps, tps + fps)
+        recall = _safe_divide(tps, tps + fns)
+        precision = jnp.concatenate([precision, jnp.ones((1, num_labels), dtype=precision.dtype)])
+        recall = jnp.concatenate([recall, jnp.zeros((1, num_labels), dtype=recall.dtype)])
+        return precision.T, recall.T, thresholds
+
+    precision, recall, thresholds_out = [], [], []
+    for i in range(num_labels):
+        preds_i = np.asarray(state[0][:, i])
+        target_i = np.asarray(state[1][:, i])
+        if ignore_index is not None:
+            # format already masked ignored positions to -1
+            idx = target_i < 0
+            preds_i = preds_i[~idx]
+            target_i = target_i[~idx]
+        res = _binary_precision_recall_curve_compute((preds_i, target_i), thresholds=None, pos_label=1)
+        precision.append(res[0])
+        recall.append(res[1])
+        thresholds_out.append(res[2])
+    return precision, recall, thresholds_out
+
+
+def multilabel_precision_recall_curve(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    """Precision-recall curve for multilabel tasks (reference: :763-860)."""
+    if validate_args:
+        _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, thresholds = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    state = _multilabel_precision_recall_curve_update(preds, target, num_labels, thresholds)
+    return _multilabel_precision_recall_curve_compute(state, num_labels, thresholds, ignore_index)
+
+
+def precision_recall_curve(
+    preds: Array,
+    target: Array,
+    task: str,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    """Task dispatcher (reference: :863-922)."""
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_precision_recall_curve(preds, target, thresholds, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_precision_recall_curve(preds, target, num_classes, thresholds, ignore_index, validate_args)
+    if task == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_precision_recall_curve(preds, target, num_labels, thresholds, ignore_index, validate_args)
+    raise ValueError(f"Not handled value: {task}")

@@ -1,0 +1,212 @@
+"""ROC curve functionals.
+
+Capability parity with reference ``functional/classification/roc.py`` (508 LoC:
+binary :40-158, multiclass :161-289, multilabel :292-420, dispatcher :423-508).
+Shares the PR-curve state (binned (T,2,2) confusion tensor or raw scores).
+"""
+from typing import List, Optional, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.functional.classification.precision_recall_curve import (
+    _binary_clf_curve,
+    _binary_precision_recall_curve_arg_validation,
+    _binary_precision_recall_curve_format,
+    _binary_precision_recall_curve_tensor_validation,
+    _binary_precision_recall_curve_update,
+    _multiclass_precision_recall_curve_arg_validation,
+    _multiclass_precision_recall_curve_format,
+    _multiclass_precision_recall_curve_tensor_validation,
+    _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_arg_validation,
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_tensor_validation,
+    _multilabel_precision_recall_curve_update,
+)
+from metrics_tpu.utils.compute import _safe_divide
+from metrics_tpu.utils.enums import ClassificationTask
+from metrics_tpu.utils.prints import rank_zero_warn
+
+
+def _is_confmat_state(state) -> bool:
+    return isinstance(state, (jnp.ndarray, np.ndarray)) and not isinstance(state, (tuple, list))
+
+
+def _binary_roc_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    thresholds: Optional[Array],
+    pos_label: int = 1,
+) -> Tuple[Array, Array, Array]:
+    """Reference: roc.py:40-80."""
+    if _is_confmat_state(state) and thresholds is not None:
+        tps = state[:, 1, 1]
+        fps = state[:, 0, 1]
+        fns = state[:, 1, 0]
+        tns = state[:, 0, 0]
+        tpr = jnp.flip(_safe_divide(tps, tps + fns), 0)
+        fpr = jnp.flip(_safe_divide(fps, fps + tns), 0)
+        thresholds = jnp.flip(thresholds, 0)
+        return fpr, tpr, thresholds
+
+    _p, _t = np.asarray(state[0]), np.asarray(state[1])
+    keep = _t >= 0
+    fps, tps, thresholds = _binary_clf_curve(_p[keep], _t[keep], pos_label=pos_label)
+    tps = jnp.concatenate([jnp.zeros(1, dtype=tps.dtype), tps])
+    fps = jnp.concatenate([jnp.zeros(1, dtype=fps.dtype), fps])
+    thresholds = jnp.concatenate([jnp.ones(1, dtype=thresholds.dtype), thresholds])
+
+    if float(fps[-1]) <= 0:
+        rank_zero_warn(
+            "No negative samples in targets, false positive value should be meaningless."
+            " Returning zero tensor in false positive score",
+            UserWarning,
+        )
+        fpr = jnp.zeros_like(thresholds)
+    else:
+        fpr = fps / fps[-1]
+
+    if float(tps[-1]) <= 0:
+        rank_zero_warn(
+            "No positive samples in targets, true positive value should be meaningless."
+            " Returning zero tensor in true positive score",
+            UserWarning,
+        )
+        tpr = jnp.zeros_like(thresholds)
+    else:
+        tpr = tps / tps[-1]
+
+    return fpr, tpr, thresholds
+
+
+def binary_roc(
+    preds: Array,
+    target: Array,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array, Array]:
+    """Binary ROC (reference: roc.py:83-158)."""
+    if validate_args:
+        _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds, target, thresholds = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
+    state = _binary_precision_recall_curve_update(preds, target, thresholds)
+    return _binary_roc_compute(state, thresholds)
+
+
+def _multiclass_roc_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    num_classes: int,
+    thresholds: Optional[Array],
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    """Reference: roc.py:161-181."""
+    if _is_confmat_state(state) and thresholds is not None:
+        tps = state[:, :, 1, 1]
+        fps = state[:, :, 0, 1]
+        fns = state[:, :, 1, 0]
+        tns = state[:, :, 0, 0]
+        tpr = jnp.flip(_safe_divide(tps, tps + fns), 0).T
+        fpr = jnp.flip(_safe_divide(fps, fps + tns), 0).T
+        thresholds = jnp.flip(thresholds, 0)
+        return fpr, tpr, thresholds
+    fpr, tpr, thresholds_out = [], [], []
+    for i in range(num_classes):
+        res = _binary_roc_compute((state[0][:, i], state[1]), thresholds=None, pos_label=i)
+        fpr.append(res[0])
+        tpr.append(res[1])
+        thresholds_out.append(res[2])
+    return fpr, tpr, thresholds_out
+
+
+def multiclass_roc(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    """Multiclass ROC (reference: roc.py:184-289)."""
+    if validate_args:
+        _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index)
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, thresholds = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index
+    )
+    state = _multiclass_precision_recall_curve_update(preds, target, num_classes, thresholds)
+    return _multiclass_roc_compute(state, num_classes, thresholds)
+
+
+def _multilabel_roc_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    num_labels: int,
+    thresholds: Optional[Array],
+    ignore_index: Optional[int] = None,
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    """Reference: roc.py:292-319."""
+    if _is_confmat_state(state) and thresholds is not None:
+        tps = state[:, :, 1, 1]
+        fps = state[:, :, 0, 1]
+        fns = state[:, :, 1, 0]
+        tns = state[:, :, 0, 0]
+        tpr = jnp.flip(_safe_divide(tps, tps + fns), 0).T
+        fpr = jnp.flip(_safe_divide(fps, fps + tns), 0).T
+        thresholds = jnp.flip(thresholds, 0)
+        return fpr, tpr, thresholds
+    fpr, tpr, thresholds_out = [], [], []
+    for i in range(num_labels):
+        preds_i = np.asarray(state[0][:, i])
+        target_i = np.asarray(state[1][:, i])
+        if ignore_index is not None:
+            idx = target_i < 0
+            preds_i = preds_i[~idx]
+            target_i = target_i[~idx]
+        res = _binary_roc_compute((preds_i, target_i), thresholds=None, pos_label=1)
+        fpr.append(res[0])
+        tpr.append(res[1])
+        thresholds_out.append(res[2])
+    return fpr, tpr, thresholds_out
+
+
+def multilabel_roc(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    """Multilabel ROC (reference: roc.py:322-420)."""
+    if validate_args:
+        _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, thresholds = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    state = _multilabel_precision_recall_curve_update(preds, target, num_labels, thresholds)
+    return _multilabel_roc_compute(state, num_labels, thresholds, ignore_index)
+
+
+def roc(
+    preds: Array,
+    target: Array,
+    task: str,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    """Task dispatcher (reference: roc.py:423-508)."""
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_roc(preds, target, thresholds, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        assert isinstance(num_classes, int)
+        return multiclass_roc(preds, target, num_classes, thresholds, ignore_index, validate_args)
+    if task == ClassificationTask.MULTILABEL:
+        assert isinstance(num_labels, int)
+        return multilabel_roc(preds, target, num_labels, thresholds, ignore_index, validate_args)
+    raise ValueError(f"Not handled value: {task}")

@@ -1,0 +1,198 @@
+"""Differential tests for PR-curve / ROC / AUROC / AveragePrecision vs sklearn.
+
+Mirrors reference tests/unittests/classification/{test_precision_recall_curve,
+test_roc,test_auroc,test_average_precision}.py coverage.
+"""
+import numpy as np
+import pytest
+from scipy.special import expit, softmax
+from sklearn.metrics import (
+    average_precision_score as sk_average_precision,
+    precision_recall_curve as sk_precision_recall_curve,
+    roc_auc_score as sk_roc_auc,
+    roc_curve as sk_roc_curve,
+)
+
+from metrics_tpu.classification import (
+    BinaryAUROC,
+    BinaryAveragePrecision,
+    BinaryPrecisionRecallCurve,
+    BinaryROC,
+    MulticlassAUROC,
+    MulticlassAveragePrecision,
+)
+from metrics_tpu.functional.classification import (
+    binary_auroc,
+    binary_average_precision,
+    binary_precision_recall_curve,
+    binary_roc,
+    multiclass_auroc,
+    multiclass_average_precision,
+    multiclass_precision_recall_curve,
+    multiclass_roc,
+    multilabel_auroc,
+    multilabel_average_precision,
+    multilabel_precision_recall_curve,
+    multilabel_roc,
+)
+
+import sys, os
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+from helpers import seed_all  # noqa: E402
+from helpers.testers import BATCH_SIZE, NUM_BATCHES, NUM_CLASSES, MetricTester  # noqa: E402
+
+seed_all(42)
+_rng = np.random.default_rng(23)
+_binary = (_rng.random((NUM_BATCHES, BATCH_SIZE)), _rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE)))
+_binary_logits = (_rng.normal(size=(NUM_BATCHES, BATCH_SIZE)) * 2, _rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE)))
+_mc = (
+    softmax(_rng.normal(size=(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)), axis=-1),
+    _rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE)),
+)
+_ml = (
+    _rng.random((NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)),
+    _rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)),
+)
+
+
+def _probs(preds):
+    preds = np.asarray(preds)
+    if not ((preds >= 0) & (preds <= 1)).all():
+        preds = expit(preds)
+    return preds
+
+
+class TestBinaryCurves(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize("inputs", [_binary, _binary_logits])
+    def test_pr_curve_exact(self, inputs):
+        preds, target = inputs
+        p, r, t = binary_precision_recall_curve(preds[0], target[0], thresholds=None)
+        sk_p, sk_r, sk_t = sk_precision_recall_curve(target[0], _probs(preds[0]))
+        np.testing.assert_allclose(np.asarray(p), sk_p, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(r), sk_r, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(t), sk_t, atol=1e-6)
+
+    def test_roc_exact(self):
+        preds, target = _binary
+        fpr, tpr, thr = binary_roc(preds[0], target[0], thresholds=None)
+        sk_fpr, sk_tpr, sk_thr = sk_roc_curve(target[0], preds[0], drop_intermediate=False)
+        np.testing.assert_allclose(np.asarray(fpr), sk_fpr, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(tpr), sk_tpr, atol=1e-6)
+
+    def test_auroc_exact(self):
+        preds, target = _binary
+        res = binary_auroc(preds[0], target[0], thresholds=None)
+        np.testing.assert_allclose(np.asarray(res), sk_roc_auc(target[0], preds[0]), atol=1e-6)
+
+    def test_auroc_class_accumulated(self):
+        preds, target = _binary
+        ref = lambda p, t: sk_roc_auc(t.ravel(), _probs(p).ravel())
+        self.run_class_metric_test(preds, target, BinaryAUROC, ref, check_batch=True)
+
+    def test_auroc_binned_close(self):
+        # binned mode approximates the exact value as thresholds densify
+        preds, target = _binary
+        exact = float(binary_auroc(preds[0], target[0], thresholds=None))
+        binned = float(binary_auroc(preds[0], target[0], thresholds=1000))
+        assert abs(exact - binned) < 5e-3
+
+    def test_ap_exact(self):
+        preds, target = _binary
+        res = binary_average_precision(preds[0], target[0], thresholds=None)
+        np.testing.assert_allclose(np.asarray(res), sk_average_precision(target[0], preds[0]), atol=1e-6)
+
+    def test_ap_class(self):
+        preds, target = _binary
+        ref = lambda p, t: sk_average_precision(t.ravel(), _probs(p).ravel())
+        self.run_class_metric_test(preds, target, BinaryAveragePrecision, ref, check_batch=True)
+
+    def test_pr_curve_binned_class_sharded(self):
+        preds, target = _binary
+        m = BinaryPrecisionRecallCurve(thresholds=11)
+        for i in range(NUM_BATCHES):
+            m.update(preds[i], target[i])
+        p1, r1, t1 = m.compute()
+        p2, r2, t2 = binary_precision_recall_curve(
+            np.concatenate(preds), np.concatenate(target), thresholds=11
+        )
+        np.testing.assert_allclose(np.asarray(p1), np.asarray(p2), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(r1), np.asarray(r2), atol=1e-6)
+
+
+class TestMulticlassCurves(MetricTester):
+    atol = 1e-6
+
+    def test_auroc_exact(self):
+        preds, target = _mc
+        for average in ["macro", "weighted"]:
+            res = multiclass_auroc(preds[0], target[0], num_classes=NUM_CLASSES, average=average, thresholds=None)
+            ref = sk_roc_auc(target[0], preds[0], multi_class="ovr", average=average, labels=np.arange(NUM_CLASSES))
+            np.testing.assert_allclose(np.asarray(res), ref, atol=1e-6)
+
+    def test_auroc_class_accumulated(self):
+        preds, target = _mc
+        ref = lambda p, t: sk_roc_auc(t, p, multi_class="ovr", labels=np.arange(NUM_CLASSES))
+        self.run_class_metric_test(
+            preds, target, MulticlassAUROC, ref, metric_args={"num_classes": NUM_CLASSES}, check_batch=True
+        )
+
+    def test_ap_exact(self):
+        preds, target = _mc
+        res = multiclass_average_precision(preds[0], target[0], num_classes=NUM_CLASSES, average="macro", thresholds=None)
+        onehot = np.eye(NUM_CLASSES)[target[0]]
+        ref = sk_average_precision(onehot, preds[0], average="macro")
+        np.testing.assert_allclose(np.asarray(res), ref, atol=1e-6)
+
+    def test_pr_curve_exact_runs(self):
+        preds, target = _mc
+        p, r, t = multiclass_precision_recall_curve(preds[0], target[0], num_classes=NUM_CLASSES, thresholds=None)
+        assert len(p) == NUM_CLASSES
+        for i in range(NUM_CLASSES):
+            sk_p, sk_r, _ = sk_precision_recall_curve((target[0] == i).astype(int), preds[0][:, i])
+            np.testing.assert_allclose(np.asarray(p[i]), sk_p, atol=1e-6)
+            np.testing.assert_allclose(np.asarray(r[i]), sk_r, atol=1e-6)
+
+    def test_roc_binned_vs_exact(self):
+        preds, target = _mc
+        fpr_b, tpr_b, _ = multiclass_roc(preds[0], target[0], num_classes=NUM_CLASSES, thresholds=200)
+        fpr_e, tpr_e, _ = multiclass_roc(preds[0], target[0], num_classes=NUM_CLASSES, thresholds=None)
+        # binned AUC close to exact AUC per class
+        from metrics_tpu.utils.compute import _auc_compute_without_check
+        for i in range(NUM_CLASSES):
+            a_b = float(_auc_compute_without_check(fpr_b[i], tpr_b[i], 1.0))
+            a_e = float(_auc_compute_without_check(fpr_e[i], tpr_e[i], 1.0))
+            assert abs(a_b - a_e) < 2e-2
+
+
+class TestMultilabelCurves(MetricTester):
+    atol = 1e-6
+
+    def test_auroc_exact(self):
+        preds, target = _ml
+        for average in ["micro", "macro"]:
+            res = multilabel_auroc(preds[0], target[0], num_labels=NUM_CLASSES, average=average, thresholds=None)
+            ref = sk_roc_auc(target[0], preds[0], average=average)
+            np.testing.assert_allclose(np.asarray(res), ref, atol=1e-6)
+
+    def test_ap_exact(self):
+        preds, target = _ml
+        res = multilabel_average_precision(preds[0], target[0], num_labels=NUM_CLASSES, average="macro", thresholds=None)
+        ref = sk_average_precision(target[0], preds[0], average="macro")
+        np.testing.assert_allclose(np.asarray(res), ref, atol=1e-6)
+
+    def test_roc_exact(self):
+        preds, target = _ml
+        fpr, tpr, thr = multilabel_roc(preds[0], target[0], num_labels=NUM_CLASSES, thresholds=None)
+        for i in range(NUM_CLASSES):
+            sk_fpr, sk_tpr, _ = sk_roc_curve(target[0][:, i], preds[0][:, i], drop_intermediate=False)
+            np.testing.assert_allclose(np.asarray(fpr[i]), sk_fpr, atol=1e-6)
+            np.testing.assert_allclose(np.asarray(tpr[i]), sk_tpr, atol=1e-6)
+
+    def test_pr_curve_binned_runs(self):
+        preds, target = _ml
+        p, r, t = multilabel_precision_recall_curve(preds[0], target[0], num_labels=NUM_CLASSES, thresholds=20)
+        assert p.shape == (NUM_CLASSES, 21)
+        assert r.shape == (NUM_CLASSES, 21)
