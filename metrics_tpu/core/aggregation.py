@@ -1,0 +1,209 @@
+"""Aggregation metrics: Max/Min/Sum/Cat/Mean over a stream of values.
+
+Capability parity with reference ``aggregation.py`` (BaseAggregator :29-97, MaxMetric
+:100, MinMetric :200, SumMetric :300, CatMetric :399, MeanMetric :459) including the
+``nan_strategy`` options (error/warn/ignore/float-impute, :71-89).
+
+jit note: 'ignore'/'warn' remove NaN elements — a data-dependent operation. On
+concrete (eager) inputs elements are removed exactly as in the reference; under
+tracing NaNs are masked with the reduction's identity instead (0 for sum/mean,
++-inf for min/max), which yields identical results for every aggregator except
+``CatMetric`` (which requires eager input for NaN removal).
+"""
+from typing import Any, Callable, List, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.utils.checks import _is_concrete
+from metrics_tpu.utils.data import dim_zero_cat
+from metrics_tpu.utils.prints import rank_zero_warn
+
+
+class BaseAggregator(Metric):
+    """Base class for aggregation metrics (reference: aggregation.py:29)."""
+
+    value: Array
+    is_differentiable = None
+    higher_is_better = None
+    full_state_update: bool = False
+
+    def __init__(
+        self,
+        fn: Union[Callable, str],
+        default_value: Union[Array, List],
+        nan_strategy: Union[str, float] = "error",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        allowed_nan_strategy = ("error", "warn", "ignore")
+        if nan_strategy not in allowed_nan_strategy and not isinstance(nan_strategy, float):
+            raise ValueError(
+                f"Arg `nan_strategy` should either be a float or one of {allowed_nan_strategy}"
+                f" but got {nan_strategy}."
+            )
+
+        self.nan_strategy = nan_strategy
+        self.add_state("value", default=default_value, dist_reduce_fx=fn)
+
+    def _cast_and_nan_check_input(self, x: Union[float, Array], nan_identity: float = 0.0) -> Array:
+        """Cast to float array; apply the NaN strategy (reference: aggregation.py:71-89)."""
+        x = jnp.asarray(x, dtype=jnp.float32)
+        if self.nan_strategy == "error" or self.nan_strategy == "warn":
+            if _is_concrete(x):
+                has_nan = bool(np.isnan(np.asarray(x)).any())
+                if has_nan:
+                    if self.nan_strategy == "error":
+                        raise RuntimeError("Encounted `nan` values in tensor")
+                    rank_zero_warn("Encounted `nan` values in tensor. Will be removed.", UserWarning)
+                    x = jnp.asarray(np.asarray(x)[~np.isnan(np.asarray(x))])
+            # under tracing: cannot raise on data; mask with the identity
+            else:
+                x = jnp.where(jnp.isnan(x), nan_identity, x)
+        elif self.nan_strategy == "ignore":
+            if _is_concrete(x):
+                x_np = np.asarray(x)
+                x = jnp.asarray(x_np[~np.isnan(x_np)])
+            else:
+                x = jnp.where(jnp.isnan(x), nan_identity, x)
+        else:  # float imputation
+            x = jnp.where(jnp.isnan(x), self.nan_strategy, x)
+        return x.astype(jnp.float32)
+
+    def update(self, value: Union[float, Array]) -> None:
+        pass
+
+    def compute(self) -> Array:
+        return self.value
+
+
+class MaxMetric(BaseAggregator):
+    """Running maximum (reference: aggregation.py:100).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.core.aggregation import MaxMetric
+        >>> metric = MaxMetric()
+        >>> metric.update(1.0)
+        >>> metric.update(jnp.array([2.0, 3.0]))
+        >>> metric.compute()
+        Array(3., dtype=float32)
+    """
+
+    full_state_update: bool = True
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("max", jnp.asarray(-jnp.inf), nan_strategy, **kwargs)
+
+    def update(self, value: Union[float, Array]) -> None:
+        value = self._cast_and_nan_check_input(value, nan_identity=-jnp.inf)
+        if value.size:
+            self.value = jnp.maximum(self.value, jnp.max(value))
+
+
+class MinMetric(BaseAggregator):
+    """Running minimum (reference: aggregation.py:200).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.core.aggregation import MinMetric
+        >>> metric = MinMetric()
+        >>> metric.update(1.0)
+        >>> metric.update(jnp.array([2.0, 3.0]))
+        >>> metric.compute()
+        Array(1., dtype=float32)
+    """
+
+    full_state_update: bool = True
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("min", jnp.asarray(jnp.inf), nan_strategy, **kwargs)
+
+    def update(self, value: Union[float, Array]) -> None:
+        value = self._cast_and_nan_check_input(value, nan_identity=jnp.inf)
+        if value.size:
+            self.value = jnp.minimum(self.value, jnp.min(value))
+
+
+class SumMetric(BaseAggregator):
+    """Running sum (reference: aggregation.py:300).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.core.aggregation import SumMetric
+        >>> metric = SumMetric()
+        >>> metric.update(1.0)
+        >>> metric.update(jnp.array([2.0, 3.0]))
+        >>> metric.compute()
+        Array(6., dtype=float32)
+    """
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("sum", jnp.asarray(0.0), nan_strategy, **kwargs)
+
+    def update(self, value: Union[float, Array]) -> None:
+        value = self._cast_and_nan_check_input(value, nan_identity=0.0)
+        if value.size:
+            self.value = self.value + value.sum()
+
+
+class CatMetric(BaseAggregator):
+    """Concatenate all values (reference: aggregation.py:399).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.core.aggregation import CatMetric
+        >>> metric = CatMetric()
+        >>> metric.update(1.0)
+        >>> metric.update(jnp.array([2.0, 3.0]))
+        >>> metric.compute()
+        Array([1., 2., 3.], dtype=float32)
+    """
+
+    full_state_update: bool = True
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("cat", [], nan_strategy, **kwargs)
+
+    def update(self, value: Union[float, Array]) -> None:
+        value = self._cast_and_nan_check_input(value)
+        if value.size:
+            self.value.append(value)
+
+    def compute(self) -> Array:
+        if isinstance(self.value, list) and self.value:
+            return dim_zero_cat(self.value)
+        return self.value
+
+
+class MeanMetric(BaseAggregator):
+    """Weighted running mean (reference: aggregation.py:459).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.core.aggregation import MeanMetric
+        >>> metric = MeanMetric()
+        >>> metric.update(1.0)
+        >>> metric.update(jnp.array([2.0, 3.0]))
+        >>> metric.compute()
+        Array(2., dtype=float32)
+    """
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("sum", jnp.asarray(0.0), nan_strategy, **kwargs)
+        self.add_state("weight", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, value: Union[float, Array], weight: Union[float, Array] = 1.0) -> None:
+        value = self._cast_and_nan_check_input(value)
+        weight = self._cast_and_nan_check_input(weight)
+        if value.size == 0:
+            return
+        weight = jnp.broadcast_to(weight, value.shape)
+        self.value = self.value + (value * weight).sum()
+        self.weight = self.weight + weight.sum()
+
+    def compute(self) -> Array:
+        return self.value / self.weight

@@ -1,0 +1,28 @@
+"""Concordance correlation functional (reference: functional/regression/concordance.py:20-80)."""
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.regression.pearson import (
+    _pearson_corrcoef_compute,
+    _pearson_corrcoef_update,
+)
+
+
+def _concordance_corrcoef_compute(
+    mean_x: Array, mean_y: Array, var_x: Array, var_y: Array, corr_xy: Array, nb: Array
+) -> Array:
+    """Reference: :20-31."""
+    pearson = _pearson_corrcoef_compute(var_x.copy(), var_y.copy(), corr_xy.copy(), nb)
+    var_x = var_x / (nb - 1)
+    var_y = var_y / (nb - 1)
+    return jnp.squeeze(2.0 * pearson * jnp.sqrt(var_x) * jnp.sqrt(var_y) / (var_x + var_y + (mean_x - mean_y) ** 2))
+
+
+def concordance_corrcoef(preds: Array, target: Array) -> Array:
+    """Concordance correlation coefficient."""
+    d = preds.shape[1] if preds.ndim == 2 else 1
+    z = jnp.zeros(d, dtype=jnp.float32)
+    mean_x, mean_y, var_x, var_y, corr_xy, nb = _pearson_corrcoef_update(
+        preds, target, z, z, z, z, z, z, num_outputs=d
+    )
+    return _concordance_corrcoef_compute(mean_x, mean_y, var_x, var_y, corr_xy, nb)

@@ -1,0 +1,94 @@
+"""Kendall rank correlation functional (reference: functional/regression/kendall.py).
+
+Variants tau-a/b/c. TPU-first design: O(n^2) pairwise concordance via broadcast
+comparisons (sign outer products fused by XLA) — the reference's sort-based O(n log n)
+path is host-bound; for metric-sized n the pairwise form vectorizes better and is
+jit-safe. Optional alternative hypothesis t-test p-value as in the reference.
+"""
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+
+def _kendall_stats_1d(preds: Array, target: Array, variant: str) -> Array:
+    n = preds.shape[0]
+    dx = jnp.sign(preds[:, None] - preds[None, :])
+    dy = jnp.sign(target[:, None] - target[None, :])
+    iu = jnp.triu_indices(n, k=1)
+    dx = dx[iu]
+    dy = dy[iu]
+    con = jnp.sum((dx * dy) > 0)
+    dis = jnp.sum((dx * dy) < 0)
+    n_pairs = n * (n - 1) / 2
+    if variant == "a":
+        return (con - dis) / n_pairs
+    ties_x = jnp.sum((dx == 0) & (dy != 0)) + jnp.sum((dx == 0) & (dy == 0))
+    ties_y = jnp.sum((dy == 0) & (dx != 0)) + jnp.sum((dx == 0) & (dy == 0))
+    if variant == "b":
+        tx = jnp.sum(dx == 0)
+        ty = jnp.sum(dy == 0)
+        denom = jnp.sqrt((n_pairs - tx) * (n_pairs - ty))
+        return (con - dis) / denom
+    # variant c
+    # m = min(number of unique values in x, y)
+    ux = jnp.unique(preds, size=n, fill_value=jnp.inf)
+    uy = jnp.unique(target, size=n, fill_value=jnp.inf)
+    mx = jnp.sum(jnp.isfinite(ux))
+    my = jnp.sum(jnp.isfinite(uy))
+    m = jnp.minimum(mx, my)
+    return 2 * (con - dis) / (n**2 * (m - 1) / m)
+
+
+def kendall_rank_corrcoef(
+    preds: Array,
+    target: Array,
+    variant: str = "b",
+    t_test: bool = False,
+    alternative: Optional[str] = "two-sided",
+):
+    """Kendall rank correlation (tau-a/b/c), optional p-value.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional.regression import kendall_rank_corrcoef
+        >>> target = jnp.array([3., -0.5, 2, 1])
+        >>> preds = jnp.array([2.5, 0.0, 2, 8])
+        >>> kendall_rank_corrcoef(preds, target)
+        Array(0.33333334, dtype=float32)
+    """
+    if variant not in ("a", "b", "c"):
+        raise ValueError(f"Argument `variant` is expected to be one of ('a', 'b', 'c'), but got {variant}")
+    if t_test and alternative not in ("two-sided", "less", "greater"):
+        raise ValueError(
+            f"Argument `alternative` is expected to be one of ('two-sided', 'less', 'greater'), but got {alternative}"
+        )
+    _check_same_shape(preds, target)
+    preds = jnp.asarray(preds, jnp.float32)
+    target = jnp.asarray(target, jnp.float32)
+
+    if preds.ndim == 1:
+        tau = _kendall_stats_1d(preds, target, variant)
+    else:
+        tau = jnp.stack([_kendall_stats_1d(preds[:, i], target[:, i], variant) for i in range(preds.shape[-1])])
+
+    tau = jnp.asarray(tau, jnp.float32)
+    if not t_test:
+        return tau
+
+    # normal-approximation p-value (reference uses the same asymptotic form)
+    n = preds.shape[0]
+    var = (2 * (2 * n + 5)) / (9 * n * (n - 1))
+    z = np.asarray(tau) / np.sqrt(var)
+    from scipy.stats import norm
+
+    if alternative == "two-sided":
+        p = 2 * norm.sf(np.abs(z))
+    elif alternative == "greater":
+        p = norm.sf(z)
+    else:
+        p = norm.cdf(z)
+    return tau, jnp.asarray(p, jnp.float32)

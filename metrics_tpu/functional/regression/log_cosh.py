@@ -1,0 +1,36 @@
+"""LogCosh functional (reference: functional/regression/log_cosh.py:29-93)."""
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+
+def _unsqueeze_tensors(preds: Array, target: Array) -> Tuple[Array, Array]:
+    if preds.ndim == 2:
+        return preds, target
+    return preds[:, None], target[:, None]
+
+
+def _log_cosh_error_update(preds: Array, target: Array, num_outputs: int) -> Tuple[Array, Array]:
+    _check_same_shape(preds, target)
+    preds = jnp.asarray(preds, jnp.float32)
+    target = jnp.asarray(target, jnp.float32)
+    preds, target = _unsqueeze_tensors(preds, target)
+    diff = preds - target
+    # numerically-stable log cosh: |d| + log1p(exp(-2|d|)) - log 2
+    sum_log_cosh_error = jnp.squeeze((jnp.abs(diff) + jnp.log1p(jnp.exp(-2 * jnp.abs(diff))) - jnp.log(2.0)).sum(0))
+    return sum_log_cosh_error, jnp.asarray(target.shape[0])
+
+
+def _log_cosh_error_compute(sum_log_cosh_error: Array, n_obs: Array) -> Array:
+    return jnp.squeeze(sum_log_cosh_error / n_obs)
+
+
+def log_cosh_error(preds: Array, target: Array) -> Array:
+    """LogCosh error."""
+    sum_log_cosh_error, n_obs = _log_cosh_error_update(
+        preds, target, num_outputs=1 if preds.ndim == 1 else preds.shape[-1]
+    )
+    return _log_cosh_error_compute(sum_log_cosh_error, n_obs)

@@ -1,0 +1,24 @@
+"""MSLE functional (reference: functional/regression/log_mse.py:22-74)."""
+from typing import Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+
+def _mean_squared_log_error_update(preds: Array, target: Array) -> Tuple[Array, int]:
+    _check_same_shape(preds, target)
+    preds = jnp.asarray(preds, jnp.float32)
+    target = jnp.asarray(target, jnp.float32)
+    return jnp.sum((jnp.log1p(preds) - jnp.log1p(target)) ** 2), target.size
+
+
+def _mean_squared_log_error_compute(sum_squared_log_error: Array, n_obs: Union[int, Array]) -> Array:
+    return sum_squared_log_error / n_obs
+
+
+def mean_squared_log_error(preds: Array, target: Array) -> Array:
+    """Mean squared log error."""
+    sum_squared_log_error, n_obs = _mean_squared_log_error_update(preds, target)
+    return _mean_squared_log_error_compute(sum_squared_log_error, n_obs)

@@ -1,0 +1,33 @@
+"""MAE functional (reference: functional/regression/mae.py:22-70)."""
+from typing import Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+
+def _mean_absolute_error_update(preds: Array, target: Array) -> Tuple[Array, int]:
+    _check_same_shape(preds, target)
+    preds = jnp.asarray(preds, jnp.float32)
+    target = jnp.asarray(target, jnp.float32)
+    return jnp.sum(jnp.abs(preds - target)), target.size
+
+
+def _mean_absolute_error_compute(sum_abs_error: Array, n_obs: Union[int, Array]) -> Array:
+    return sum_abs_error / n_obs
+
+
+def mean_absolute_error(preds: Array, target: Array) -> Array:
+    """Mean absolute error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional.regression import mean_absolute_error
+        >>> x = jnp.array([0., 1, 2, 3])
+        >>> y = jnp.array([0., 1, 2, 1])
+        >>> mean_absolute_error(x, y)
+        Array(0.5, dtype=float32)
+    """
+    sum_abs_error, n_obs = _mean_absolute_error_update(preds, target)
+    return _mean_absolute_error_compute(sum_abs_error, n_obs)

@@ -1,0 +1,25 @@
+"""Minkowski distance functional (reference: functional/regression/minkowski.py:21-81)."""
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.exceptions import MetricsUserError
+
+
+def _minkowski_distance_update(preds: Array, targets: Array, p: float) -> Array:
+    _check_same_shape(preds, targets)
+    if not (isinstance(p, (float, int)) and p >= 1):
+        raise MetricsUserError(f"Argument ``p`` must be a float or int greater than 1, but got {p}")
+    preds = jnp.asarray(preds, jnp.float32)
+    targets = jnp.asarray(targets, jnp.float32)
+    return jnp.sum(jnp.abs(preds - targets) ** p)
+
+
+def _minkowski_distance_compute(distance: Array, p: float) -> Array:
+    return distance ** (1.0 / p)
+
+
+def minkowski_distance(preds: Array, targets: Array, p: float) -> Array:
+    """Minkowski distance."""
+    minkowski_dist_sum = _minkowski_distance_update(preds, targets, p)
+    return _minkowski_distance_compute(minkowski_dist_sum, p)

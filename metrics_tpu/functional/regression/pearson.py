@@ -1,0 +1,92 @@
+"""Pearson correlation functional (reference: functional/regression/pearson.py:22-140).
+
+The running-update is the Welford-style parallel merge; multi-device sync stacks
+per-device stats and `_final_aggregation` (regression/pearson.py:28-69) merges them —
+this is the canonical custom-``dist_reduce_fx=None`` metric of the framework.
+"""
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+
+def _check_data_shape_to_num_outputs(preds: Array, target: Array, num_outputs: int) -> None:
+    if preds.ndim > 2 or target.ndim > 2:
+        raise ValueError(
+            f"Expected both predictions and target to be either 1- or 2-dimensional tensors,"
+            f" but got {target.ndim} and {preds.ndim}."
+        )
+    if (num_outputs == 1 and preds.ndim != 1) or (num_outputs > 1 and num_outputs != preds.shape[-1]):
+        raise ValueError(
+            f"Expected argument `num_outputs` to match the second dimension of input, but got {num_outputs}"
+            f" and {preds.shape[-1] if preds.ndim > 1 else 1}."
+        )
+
+
+def _pearson_corrcoef_update(
+    preds: Array,
+    target: Array,
+    mean_x: Array,
+    mean_y: Array,
+    var_x: Array,
+    var_y: Array,
+    corr_xy: Array,
+    n_prior: Array,
+    num_outputs: int,
+) -> Tuple[Array, Array, Array, Array, Array, Array]:
+    """Running covariance update (reference: :22-70), branchless for jit."""
+    _check_same_shape(preds, target)
+    _check_data_shape_to_num_outputs(preds, target, num_outputs)
+    preds = jnp.asarray(preds, jnp.float32)
+    target = jnp.asarray(target, jnp.float32)
+    cond = n_prior.mean() > 0
+    n_obs = preds.shape[0]
+
+    mx_new = jnp.where(cond, (n_prior * mean_x + preds.sum(0)) / (n_prior + n_obs), preds.mean(0))
+    my_new = jnp.where(cond, (n_prior * mean_y + target.sum(0)) / (n_prior + n_obs), target.mean(0))
+    n_prior = n_prior + n_obs
+
+    var_x = var_x + jnp.where(
+        cond,
+        ((preds - mx_new) * (preds - mean_x)).sum(0),
+        preds.var(0, ddof=1) * (n_obs - 1),
+    )
+    var_y = var_y + jnp.where(
+        cond,
+        ((target - my_new) * (target - mean_y)).sum(0),
+        target.var(0, ddof=1) * (n_obs - 1),
+    )
+    corr_xy = corr_xy + ((preds - mx_new) * (target - mean_y)).sum(0)
+    return mx_new, my_new, var_x, var_y, corr_xy, n_prior
+
+
+def _pearson_corrcoef_compute(var_x: Array, var_y: Array, corr_xy: Array, nb: Array) -> Array:
+    """Reference: :78-97."""
+    var_x = var_x / (nb - 1)
+    var_y = var_y / (nb - 1)
+    corr_xy = corr_xy / (nb - 1)
+    corrcoef = jnp.squeeze(corr_xy / jnp.sqrt(var_x * var_y))
+    return jnp.clip(corrcoef, -1.0, 1.0)
+
+
+def pearson_corrcoef(preds: Array, target: Array) -> Array:
+    """Pearson correlation coefficient.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional.regression import pearson_corrcoef
+        >>> target = jnp.array([3., -0.5, 2, 7])
+        >>> preds = jnp.array([2.5, 0.0, 2, 8])
+        >>> pearson_corrcoef(preds, target)
+        Array(0.98486954, dtype=float32)
+    """
+    d = preds.shape[1] if preds.ndim == 2 else 1
+    _temp = jnp.zeros(d, dtype=jnp.float32)
+    mean_x, mean_y, var_x = _temp, _temp.copy(), _temp.copy()
+    var_y, corr_xy, nb = _temp.copy(), _temp.copy(), _temp.copy()
+    mean_x, mean_y, var_x, var_y, corr_xy, nb = _pearson_corrcoef_update(
+        preds, target, mean_x, mean_y, var_x, var_y, corr_xy, nb, num_outputs=d
+    )
+    return _pearson_corrcoef_compute(var_x, var_y, corr_xy, nb)

@@ -1,0 +1,66 @@
+"""Tweedie deviance functional (reference: functional/regression/tweedie_deviance.py:23-140).
+
+jit note: the reference raises on invalid (preds, targets) domains per power; value
+checks here run only on concrete inputs (skipped under tracing).
+"""
+from typing import Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_same_shape, _is_concrete
+from metrics_tpu.utils.compute import _safe_xlogy
+
+
+def _domain_check(preds: Array, targets: Array, power: float) -> None:
+    if not _is_concrete(preds, targets):
+        return
+    p, t = np.asarray(preds), np.asarray(targets)
+    if power == 1 and (np.any(p <= 0) or np.any(t < 0)):
+        raise ValueError(f"For power={power}, 'preds' has to be strictly positive and 'targets' cannot be negative.")
+    if power == 2 and (np.any(p <= 0) or np.any(t <= 0)):
+        raise ValueError(f"For power={power}, both 'preds' and 'targets' have to be strictly positive.")
+    if power < 0 and np.any(p <= 0):
+        raise ValueError(f"For power={power}, 'preds' has to be strictly positive.")
+    if 1 < power < 2 and (np.any(p <= 0) or np.any(t < 0)):
+        raise ValueError(
+            f"For power={power}, 'targets' has to be strictly positive and 'preds' cannot be negative."
+        )
+    if power > 2 and (np.any(p <= 0) or np.any(t <= 0)):
+        raise ValueError(f"For power={power}, both 'preds' and 'targets' have to be strictly positive.")
+
+
+def _tweedie_deviance_score_update(preds: Array, targets: Array, power: float = 0.0) -> Tuple[Array, Array]:
+    _check_same_shape(preds, targets)
+    if 0 < power < 1:
+        raise ValueError(f"Deviance Score is not defined for power={power}.")
+    preds = jnp.asarray(preds, jnp.float32)
+    targets = jnp.asarray(targets, jnp.float32)
+
+    if power == 0:
+        deviance_score = (targets - preds) ** 2
+    elif power == 1:
+        _domain_check(preds, targets, power)
+        deviance_score = 2 * (_safe_xlogy(targets, targets / preds) + preds - targets)
+    elif power == 2:
+        _domain_check(preds, targets, power)
+        deviance_score = 2 * (jnp.log(preds / targets) + (targets / preds) - 1)
+    else:
+        _domain_check(preds, targets, power)
+        term_1 = jnp.maximum(targets, 0.0) ** (2 - power) / ((1 - power) * (2 - power))
+        term_2 = targets * preds ** (1 - power) / (1 - power)
+        term_3 = preds ** (2 - power) / (2 - power)
+        deviance_score = 2 * (term_1 - term_2 + term_3)
+
+    return jnp.sum(deviance_score), jnp.asarray(deviance_score.size)
+
+
+def _tweedie_deviance_score_compute(sum_deviance_score: Array, num_observations: Array) -> Array:
+    return sum_deviance_score / num_observations
+
+
+def tweedie_deviance_score(preds: Array, targets: Array, power: float = 0.0) -> Array:
+    """Tweedie deviance score."""
+    sum_deviance_score, num_observations = _tweedie_deviance_score_update(preds, targets, power)
+    return _tweedie_deviance_score_compute(sum_deviance_score, num_observations)

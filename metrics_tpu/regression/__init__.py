@@ -1,0 +1,37 @@
+from metrics_tpu.regression.concordance import ConcordanceCorrCoef
+from metrics_tpu.regression.cosine_similarity import CosineSimilarity
+from metrics_tpu.regression.explained_variance import ExplainedVariance
+from metrics_tpu.regression.kendall import KendallRankCorrCoef
+from metrics_tpu.regression.kl_divergence import KLDivergence
+from metrics_tpu.regression.log_cosh import LogCoshError
+from metrics_tpu.regression.log_mse import MeanSquaredLogError
+from metrics_tpu.regression.mae import MeanAbsoluteError
+from metrics_tpu.regression.mape import MeanAbsolutePercentageError
+from metrics_tpu.regression.minkowski import MinkowskiDistance
+from metrics_tpu.regression.mse import MeanSquaredError
+from metrics_tpu.regression.pearson import PearsonCorrCoef
+from metrics_tpu.regression.r2 import R2Score
+from metrics_tpu.regression.spearman import SpearmanCorrCoef
+from metrics_tpu.regression.symmetric_mape import SymmetricMeanAbsolutePercentageError
+from metrics_tpu.regression.tweedie_deviance import TweedieDevianceScore
+from metrics_tpu.regression.wmape import WeightedMeanAbsolutePercentageError
+
+__all__ = [
+    "ConcordanceCorrCoef",
+    "CosineSimilarity",
+    "ExplainedVariance",
+    "KendallRankCorrCoef",
+    "KLDivergence",
+    "LogCoshError",
+    "MeanAbsoluteError",
+    "MeanAbsolutePercentageError",
+    "MeanSquaredError",
+    "MeanSquaredLogError",
+    "MinkowskiDistance",
+    "PearsonCorrCoef",
+    "R2Score",
+    "SpearmanCorrCoef",
+    "SymmetricMeanAbsolutePercentageError",
+    "TweedieDevianceScore",
+    "WeightedMeanAbsolutePercentageError",
+]

@@ -1,0 +1,33 @@
+"""ConcordanceCorrCoef (reference: regression/concordance.py:27-120)."""
+from jax import Array
+
+from metrics_tpu.functional.regression.concordance import _concordance_corrcoef_compute
+from metrics_tpu.regression.pearson import PearsonCorrCoef, _final_aggregation
+
+
+class ConcordanceCorrCoef(PearsonCorrCoef):
+    """Concordance correlation coefficient (inherits Pearson state machinery).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.regression import ConcordanceCorrCoef
+        >>> target = jnp.array([3., -0.5, 2, 7])
+        >>> preds = jnp.array([2.5, 0.0, 2, 8])
+        >>> metric = ConcordanceCorrCoef()
+        >>> metric(preds, target)
+        Array(0.9777347, dtype=float32)
+    """
+
+    is_differentiable = True
+    higher_is_better = None
+    full_state_update = True
+
+    def compute(self) -> Array:
+        if (self.num_outputs == 1 and self.mean_x.ndim > 1) or (self.num_outputs > 1 and self.mean_x.ndim > 2):
+            mean_x, mean_y, var_x, var_y, corr_xy, n_total = _final_aggregation(
+                self.mean_x, self.mean_y, self.var_x, self.var_y, self.corr_xy, self.n_total
+            )
+        else:
+            mean_x, mean_y = self.mean_x, self.mean_y
+            var_x, var_y, corr_xy, n_total = self.var_x, self.var_y, self.corr_xy, self.n_total
+        return _concordance_corrcoef_compute(mean_x, mean_y, var_x, var_y, corr_xy, n_total)

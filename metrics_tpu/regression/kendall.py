@@ -1,0 +1,61 @@
+"""KendallRankCorrCoef (reference: regression/kendall.py:40-240)."""
+from typing import Any, Optional
+
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.regression.kendall import kendall_rank_corrcoef
+from metrics_tpu.utils.data import dim_zero_cat
+
+
+class KendallRankCorrCoef(Metric):
+    """Kendall rank correlation (tau-a/b/c), optional t-test p-value.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.regression import KendallRankCorrCoef
+        >>> target = jnp.array([3., -0.5, 2, 1])
+        >>> preds = jnp.array([2.5, 0.0, 2, 8])
+        >>> metric = KendallRankCorrCoef()
+        >>> metric(preds, target)
+        Array(0.33333334, dtype=float32)
+    """
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = True
+
+    def __init__(
+        self,
+        variant: str = "b",
+        t_test: bool = False,
+        alternative: Optional[str] = "two-sided",
+        num_outputs: int = 1,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if variant not in ("a", "b", "c"):
+            raise ValueError(f"Argument `variant` is expected to be one of ('a', 'b', 'c'), but got {variant}")
+        if not isinstance(t_test, bool):
+            raise ValueError(f"Argument `t_test` is expected to be of a type `bool`, but got {t_test}")
+        if t_test and alternative not in ("two-sided", "less", "greater"):
+            raise ValueError(
+                "Argument `alternative` is expected to be one of ('two-sided', 'less', 'greater'),"
+                f" but got {alternative}"
+            )
+        self.variant = variant
+        self.alternative = alternative if t_test else None
+        self.t_test = t_test
+        self.num_outputs = num_outputs
+
+        self.add_state("preds", [], dist_reduce_fx="cat")
+        self.add_state("target", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def compute(self):
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        return kendall_rank_corrcoef(preds, target, self.variant, self.t_test, self.alternative or "two-sided")

@@ -1,0 +1,31 @@
+"""MinkowskiDistance (reference: regression/minkowski.py:25-110)."""
+from typing import Any
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.regression.minkowski import _minkowski_distance_compute, _minkowski_distance_update
+from metrics_tpu.utils.exceptions import MetricsUserError
+
+
+class MinkowskiDistance(Metric):
+    """Minkowski distance."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(self, p: float, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(p, (float, int)) and p >= 1):
+            raise MetricsUserError(f"Argument ``p`` must be a float or int greater than 1, but got {p}")
+        self.p = p
+        self.add_state("minkowski_dist_sum", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, targets: Array) -> None:
+        minkowski_dist_sum = _minkowski_distance_update(preds, targets, self.p)
+        self.minkowski_dist_sum = self.minkowski_dist_sum + minkowski_dist_sum
+
+    def compute(self) -> Array:
+        return _minkowski_distance_compute(self.minkowski_dist_sum, self.p)

@@ -1,0 +1,42 @@
+"""MeanSquaredError (reference: regression/mse.py:26-130)."""
+from typing import Any
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.regression.mse import _mean_squared_error_compute, _mean_squared_error_update
+
+
+class MeanSquaredError(Metric):
+    """Mean squared error (RMSE with ``squared=False``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.regression import MeanSquaredError
+        >>> target = jnp.array([2.5, 5.0, 4.0, 8.0])
+        >>> preds = jnp.array([3.0, 5.0, 2.5, 7.0])
+        >>> metric = MeanSquaredError()
+        >>> metric(preds, target)
+        Array(0.875, dtype=float32)
+    """
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(self, squared: bool = True, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(squared, bool):
+            raise ValueError(f"Expected argument `squared` to be a boolean but got {squared}")
+        self.squared = squared
+        self.add_state("sum_squared_error", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        sum_squared_error, n_obs = _mean_squared_error_update(preds, target)
+        self.sum_squared_error = self.sum_squared_error + sum_squared_error
+        self.total = self.total + n_obs
+
+    def compute(self) -> Array:
+        return _mean_squared_error_compute(self.sum_squared_error, self.total, squared=self.squared)

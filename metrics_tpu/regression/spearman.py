@@ -1,0 +1,44 @@
+"""SpearmanCorrCoef (reference: regression/spearman.py:30-150)."""
+from typing import Any
+
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.regression.spearman import _spearman_corrcoef_compute, _spearman_corrcoef_update
+from metrics_tpu.utils.data import dim_zero_cat
+
+
+class SpearmanCorrCoef(Metric):
+    """Spearman rank correlation (cat-state; sorts at compute).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.regression import SpearmanCorrCoef
+        >>> target = jnp.array([3., -0.5, 2, 7])
+        >>> preds = jnp.array([2.5, 0.0, 2, 8])
+        >>> metric = SpearmanCorrCoef()
+        >>> metric(preds, target)
+        Array(0.9999992, dtype=float32)
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(self, num_outputs: int = 1, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(num_outputs, int) or num_outputs < 1:
+            raise ValueError("Expected argument `num_outputs` to be an int larger than 0, but got {num_outputs}")
+        self.num_outputs = num_outputs
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+        self.add_state("target", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        preds, target = _spearman_corrcoef_update(preds, target, self.num_outputs)
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def compute(self) -> Array:
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        return _spearman_corrcoef_compute(preds, target)

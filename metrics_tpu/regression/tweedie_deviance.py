@@ -1,0 +1,35 @@
+"""TweedieDevianceScore (reference: regression/tweedie_deviance.py:26-140)."""
+from typing import Any
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.regression.tweedie_deviance import (
+    _tweedie_deviance_score_compute,
+    _tweedie_deviance_score_update,
+)
+
+
+class TweedieDevianceScore(Metric):
+    """Tweedie deviance score."""
+
+    is_differentiable = True
+    higher_is_better = None
+    full_state_update = False
+
+    def __init__(self, power: float = 0.0, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if 0 < power < 1:
+            raise ValueError(f"Deviance Score is not defined for power={power}.")
+        self.power = power
+        self.add_state("sum_deviance_score", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("num_observations", jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, targets: Array) -> None:
+        sum_deviance_score, num_observations = _tweedie_deviance_score_update(preds, targets, self.power)
+        self.sum_deviance_score = self.sum_deviance_score + sum_deviance_score
+        self.num_observations = self.num_observations + num_observations
+
+    def compute(self) -> Array:
+        return _tweedie_deviance_score_compute(self.sum_deviance_score, self.num_observations)

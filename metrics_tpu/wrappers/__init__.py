@@ -1,0 +1,7 @@
+from metrics_tpu.wrappers.bootstrapping import BootStrapper
+from metrics_tpu.wrappers.classwise import ClasswiseWrapper
+from metrics_tpu.wrappers.minmax import MinMaxMetric
+from metrics_tpu.wrappers.multioutput import MultioutputWrapper
+from metrics_tpu.wrappers.tracker import MetricTracker
+
+__all__ = ["BootStrapper", "ClasswiseWrapper", "MinMaxMetric", "MultioutputWrapper", "MetricTracker"]

@@ -1,0 +1,51 @@
+"""ClasswiseWrapper (reference: wrappers/classwise.py:26-165): splits a per-class
+output tensor into a ``{name_label: scalar}`` dict."""
+from typing import Any, Dict, List, Optional
+
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+
+
+class ClasswiseWrapper(Metric):
+    """Per-class dict output for metrics with ``average=None`` (reference: :26).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.wrappers import ClasswiseWrapper
+        >>> from metrics_tpu.classification import MulticlassAccuracy
+        >>> metric = ClasswiseWrapper(MulticlassAccuracy(num_classes=3, average=None))
+        >>> preds = jnp.array([0, 1, 2, 1])
+        >>> target = jnp.array([0, 1, 2, 2])
+        >>> sorted(metric(preds, target).keys())
+        ['multiclassaccuracy_0', 'multiclassaccuracy_1', 'multiclassaccuracy_2']
+    """
+
+    full_state_update: Optional[bool] = True
+
+    def __init__(self, metric: Metric, labels: Optional[List[str]] = None) -> None:
+        super().__init__()
+        if not isinstance(metric, Metric):
+            raise ValueError(f"Expected argument `metric` to be an instance of `Metric` but got {metric}")
+        if labels is not None and not (isinstance(labels, list) and all(isinstance(lab, str) for lab in labels)):
+            raise ValueError(f"Expected argument `labels` to either be `None` or a list of strings but got {labels}")
+        self.metric = metric
+        self.labels = labels
+
+    def _convert(self, x: Array) -> Dict[str, Array]:
+        name = self.metric.__class__.__name__.lower()
+        if self.labels is None:
+            return {f"{name}_{i}": val for i, val in enumerate(x)}
+        return {f"{name}_{lab}": val for lab, val in zip(self.labels, x)}
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        self.metric.update(*args, **kwargs)
+
+    def compute(self) -> Dict[str, Array]:
+        return self._convert(self.metric.compute())
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        return self._convert(self.metric(*args, **kwargs))
+
+    def reset(self) -> None:
+        self.metric.reset()

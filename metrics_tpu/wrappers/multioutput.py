@@ -1,0 +1,105 @@
+"""MultioutputWrapper (reference: wrappers/multioutput.py:29-192): K copies of a base
+metric, one per output dimension, with optional NaN-row removal per output."""
+from copy import deepcopy
+from typing import Any, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.utils.data import apply_to_collection
+
+
+def _get_nan_indices(*tensors: Array) -> Array:
+    """Rows where any tensor has a NaN (reference: :15-26)."""
+    if len(tensors) == 0:
+        raise ValueError("Must pass at least one tensor as argument")
+    sentinel = tensors[0]
+    nan_idxs = jnp.zeros(len(sentinel), dtype=bool)
+    for tensor in tensors:
+        permuted = tensor.reshape(len(sentinel), -1)
+        nan_idxs = nan_idxs | jnp.any(jnp.isnan(permuted), axis=1)
+    return nan_idxs
+
+
+class MultioutputWrapper(Metric):
+    """Evaluate one metric per output dimension (reference: :29).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.wrappers import MultioutputWrapper
+        >>> from metrics_tpu.regression import MeanSquaredError
+        >>> metric = MultioutputWrapper(MeanSquaredError(), num_outputs=2)
+        >>> target = jnp.array([[0.1, 0.2], [0.3, 0.4]])
+        >>> preds = jnp.array([[0.1, 0.3], [0.5, 0.4]])
+        >>> metric(preds, target)
+        Array([0.02 , 0.005], dtype=float32)
+    """
+
+    is_differentiable = False
+    full_state_update: Optional[bool] = True
+
+    def __init__(
+        self,
+        base_metric: Metric,
+        num_outputs: int,
+        output_dim: int = -1,
+        remove_nans: bool = True,
+        squeeze_outputs: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.metrics = [deepcopy(base_metric) for _ in range(num_outputs)]
+        self.output_dim = output_dim
+        self.remove_nans = remove_nans
+        self.squeeze_outputs = squeeze_outputs
+
+    def _get_args_kwargs_by_output(self, *args: Array, **kwargs: Array) -> List[Tuple[tuple, dict]]:
+        """Slice inputs along output_dim per metric copy (reference: :95-120)."""
+        args_kwargs_by_output = []
+        array_types = (jnp.ndarray, np.ndarray)
+        for i in range(len(self.metrics)):
+            def select(x, i=i):
+                x = jnp.asarray(x)
+                selected = jnp.take(x, jnp.asarray([i]), axis=self.output_dim)
+                if self.squeeze_outputs:
+                    selected = jnp.squeeze(selected, axis=self.output_dim)
+                return selected
+
+            selected_args = apply_to_collection(args, array_types, select)
+            selected_kwargs = apply_to_collection(kwargs, array_types, select)
+            if self.remove_nans:
+                tensors = [a for a in selected_args if isinstance(a, array_types)] + [
+                    v for v in selected_kwargs.values() if isinstance(v, array_types)
+                ]
+                if tensors:
+                    nan_idxs = np.asarray(_get_nan_indices(*tensors))
+                    if nan_idxs.any():
+                        selected_args = tuple(np.asarray(a)[~nan_idxs] for a in selected_args)
+                        selected_kwargs = {k: np.asarray(v)[~nan_idxs] for k, v in selected_kwargs.items()}
+            args_kwargs_by_output.append((selected_args, selected_kwargs))
+        return args_kwargs_by_output
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        reshaped_args_kwargs = self._get_args_kwargs_by_output(*args, **kwargs)
+        for metric, (selected_args, selected_kwargs) in zip(self.metrics, reshaped_args_kwargs):
+            metric.update(*selected_args, **selected_kwargs)
+
+    def compute(self) -> Array:
+        return jnp.stack([jnp.asarray(m.compute()) for m in self.metrics], 0)
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        reshaped_args_kwargs = self._get_args_kwargs_by_output(*args, **kwargs)
+        results = [
+            metric(*selected_args, **selected_kwargs)
+            for metric, (selected_args, selected_kwargs) in zip(self.metrics, reshaped_args_kwargs)
+        ]
+        if results[0] is None:
+            return None
+        return jnp.stack([jnp.asarray(r) for r in results], 0)
+
+    def reset(self) -> None:
+        for metric in self.metrics:
+            metric.reset()
+        super().reset()

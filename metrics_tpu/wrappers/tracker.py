@@ -1,0 +1,150 @@
+"""MetricTracker (reference: wrappers/tracker.py:31-308): tracks a metric (or
+collection) over a sequence of steps; exposes best value/step."""
+from copy import deepcopy
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.core.collections import MetricCollection
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.utils.prints import rank_zero_warn
+
+
+class MetricTracker:
+    """List of metric copies over time steps (reference: :31).
+
+    Example:
+        >>> import numpy as np, jax.numpy as jnp
+        >>> from metrics_tpu.wrappers import MetricTracker
+        >>> from metrics_tpu.classification import MulticlassAccuracy
+        >>> tracker = MetricTracker(MulticlassAccuracy(num_classes=5, average="micro"))
+        >>> rng = np.random.default_rng(42)
+        >>> for epoch in range(3):
+        ...     tracker.increment()
+        ...     for batch in range(5):
+        ...         preds = jnp.asarray(rng.integers(0, 5, 100))
+        ...         target = jnp.asarray(rng.integers(0, 5, 100))
+        ...         _ = tracker.update(preds, target)
+        >>> all_results = tracker.compute_all()
+        >>> all_results.shape
+        (3,)
+    """
+
+    def __init__(self, metric: Union[Metric, MetricCollection], maximize: Union[bool, List[bool]] = True) -> None:
+        if not isinstance(metric, (Metric, MetricCollection)):
+            raise TypeError(
+                "Metric arg need to be an instance of a Metric or MetricCollection" f" but got {metric}"
+            )
+        self._base_metric = metric
+        self._metrics: List[Union[Metric, MetricCollection]] = []
+        if not isinstance(maximize, (bool, list)):
+            raise ValueError("Argument `maximize` should either be a single bool or list of bool")
+        if isinstance(maximize, list) and isinstance(metric, MetricCollection) and len(maximize) != len(metric):
+            raise ValueError("The len of argument `maximize` should match the length of the metric collection")
+        if isinstance(metric, Metric) and not isinstance(maximize, bool):
+            raise ValueError("Argument `maximize` should be a single bool when `metric` is a single Metric")
+        self.maximize = maximize
+        self._increment_called = False
+
+    @property
+    def n_steps(self) -> int:
+        """Number of steps tracked so far (reference: :84-87)."""
+        return len(self._metrics)
+
+    def increment(self) -> None:
+        """Create a new (reset) copy of the base metric for the next step (reference: :89-93)."""
+        self._increment_called = True
+        metric = deepcopy(self._base_metric)
+        metric.reset()
+        self._metrics.append(metric)
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        self._check_for_increment("forward")
+        return self._metrics[-1](*args, **kwargs)
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        return self.forward(*args, **kwargs)
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        self._check_for_increment("update")
+        self._metrics[-1].update(*args, **kwargs)
+
+    def compute(self) -> Any:
+        self._check_for_increment("compute")
+        return self._metrics[-1].compute()
+
+    def compute_all(self) -> Any:
+        """Compute for all tracked steps (reference: :130-148)."""
+        self._check_for_increment("compute_all")
+        res = [metric.compute() for metric in self._metrics]
+        try:
+            if isinstance(self._base_metric, MetricCollection):
+                keys = res[0].keys()
+                return {k: jnp.stack([jnp.asarray(r[k]) for r in res], axis=0) for k in keys}
+            return jnp.stack([jnp.asarray(r) for r in res], axis=0)
+        except TypeError:  # nested/ragged results
+            return res
+
+    def reset(self) -> None:
+        """Reset the current step's metric."""
+        self._metrics[-1].reset()
+
+    def reset_all(self) -> None:
+        for metric in self._metrics:
+            metric.reset()
+
+    def best_metric(
+        self, return_step: bool = False
+    ) -> Union[None, float, Tuple[float, int], Dict[str, Optional[float]], Tuple[Dict, Dict]]:
+        """Best value (and optionally step) across tracked steps (reference: :184-270)."""
+        res = self.compute_all()
+        if isinstance(res, list):
+            rank_zero_warn(
+                "Encounted nested structure. You are probably using a metric collection inside a metric collection,"
+                " or a metric wrapper inside a metric collection, which is not supported by `.best_metric()` method."
+                " Returning `None` instead."
+            )
+            return (None, None) if return_step else None
+
+        if isinstance(self._base_metric, Metric):
+            fn = jnp.argmax if self.maximize else jnp.argmin
+            try:
+                idx = int(fn(res))
+                value = res[idx]
+                if return_step:
+                    return float(value), idx
+                return float(value)
+            except (ValueError, TypeError) as error:
+                rank_zero_warn(
+                    f"Encountered the following error when trying to get the best metric: {error}"
+                    " this is probably due to the 'best' not being defined for this metric."
+                    " Returning `None` instead.",
+                    UserWarning,
+                )
+                return (None, None) if return_step else None
+
+        maximize = self.maximize if isinstance(self.maximize, list) else len(res) * [self.maximize]
+        value, idx = {}, {}
+        for i, (k, v) in enumerate(res.items()):
+            try:
+                fn = jnp.argmax if maximize[i] else jnp.argmin
+                out = int(fn(v))
+                value[k], idx[k] = float(v[out]), out
+            except (ValueError, TypeError) as error:
+                rank_zero_warn(
+                    f"Encountered the following error when trying to get the best metric for metric {k}:"
+                    f" {error} this is probably due to the 'best' not being defined for this metric."
+                    " Returning `None` instead.",
+                    UserWarning,
+                )
+                value[k], idx[k] = None, None
+
+        if return_step:
+            return value, idx
+        return value
+
+    def _check_for_increment(self, method: str) -> None:
+        if not self._increment_called:
+            raise ValueError(f"`{method}` cannot be called before `.increment()` has been called.")

@@ -1,0 +1,228 @@
+"""MetricCollection + wrapper + aggregation tests.
+
+Mirrors reference tests/unittests/bases/{test_collections,test_aggregation}.py and
+tests/unittests/wrappers/* coverage.
+"""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+from sklearn.metrics import accuracy_score, precision_score, recall_score
+
+from metrics_tpu.classification import (
+    MulticlassAccuracy,
+    MulticlassF1Score,
+    MulticlassPrecision,
+    MulticlassRecall,
+)
+from metrics_tpu.core.aggregation import CatMetric, MaxMetric, MeanMetric, MinMetric, SumMetric
+from metrics_tpu.core.collections import MetricCollection
+from metrics_tpu.regression import MeanAbsoluteError, MeanSquaredError
+from metrics_tpu.wrappers import BootStrapper, ClasswiseWrapper, MetricTracker, MinMaxMetric, MultioutputWrapper
+
+import sys, os
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+from helpers import seed_all  # noqa: E402
+
+seed_all(42)
+NUM_CLASSES = 5
+_rng = np.random.default_rng(17)
+_preds = [_rng.integers(0, NUM_CLASSES, 64) for _ in range(4)]
+_target = [_rng.integers(0, NUM_CLASSES, 64) for _ in range(4)]
+
+
+class TestMetricCollection:
+    def test_basic_flow(self):
+        mc = MetricCollection(
+            [
+                MulticlassAccuracy(num_classes=NUM_CLASSES, average="micro"),
+                MulticlassPrecision(num_classes=NUM_CLASSES, average="macro"),
+            ]
+        )
+        for p, t in zip(_preds, _target):
+            out = mc(jnp.asarray(p), jnp.asarray(t))
+            assert set(out.keys()) == {"MulticlassAccuracy", "MulticlassPrecision"}
+        res = mc.compute()
+        all_p, all_t = np.concatenate(_preds), np.concatenate(_target)
+        np.testing.assert_allclose(np.asarray(res["MulticlassAccuracy"]), accuracy_score(all_t, all_p), atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(res["MulticlassPrecision"]),
+            precision_score(all_t, all_p, average="macro", zero_division=0),
+            atol=1e-6,
+        )
+
+    def test_compute_groups_formed(self):
+        """Precision/Recall/F1 share stat-scores state -> one compute group."""
+        mc = MetricCollection(
+            [
+                MulticlassPrecision(num_classes=NUM_CLASSES, average="macro"),
+                MulticlassRecall(num_classes=NUM_CLASSES, average="macro"),
+                MulticlassF1Score(num_classes=NUM_CLASSES, average="macro"),
+            ]
+        )
+        mc.update(jnp.asarray(_preds[0]), jnp.asarray(_target[0]))
+        mc.update(jnp.asarray(_preds[1]), jnp.asarray(_target[1]))
+        assert len(mc.compute_groups) == 1
+        res = mc.compute()
+        all_p = np.concatenate(_preds[:2])
+        all_t = np.concatenate(_target[:2])
+        np.testing.assert_allclose(
+            np.asarray(res["MulticlassPrecision"]),
+            precision_score(all_t, all_p, average="macro", zero_division=0),
+            atol=1e-6,
+        )
+        np.testing.assert_allclose(
+            np.asarray(res["MulticlassRecall"]),
+            recall_score(all_t, all_p, average="macro", zero_division=0),
+            atol=1e-6,
+        )
+
+    def test_update_count_saved(self):
+        """Group members only get the leader's single update per step."""
+        mc = MetricCollection(
+            [
+                MulticlassPrecision(num_classes=NUM_CLASSES, average="macro"),
+                MulticlassRecall(num_classes=NUM_CLASSES, average="macro"),
+            ]
+        )
+        for i in range(3):
+            mc.update(jnp.asarray(_preds[i]), jnp.asarray(_target[i]))
+        for _, m in mc.items(keep_base=True, copy_state=False):
+            assert m._update_count == 3
+
+    def test_prefix_postfix(self):
+        mc = MetricCollection(
+            [MulticlassAccuracy(num_classes=NUM_CLASSES, average="micro")], prefix="val/", postfix="_x"
+        )
+        mc.update(jnp.asarray(_preds[0]), jnp.asarray(_target[0]))
+        assert list(mc.compute().keys()) == ["val/MulticlassAccuracy_x"]
+
+    def test_dict_input_and_kwargs_filter(self):
+        mc = MetricCollection({"acc": MulticlassAccuracy(num_classes=NUM_CLASSES, average="micro")})
+        mc.update(preds=jnp.asarray(_preds[0]), target=jnp.asarray(_target[0]))
+        assert "acc" in mc.compute()
+
+    def test_nested_collections(self):
+        inner = MetricCollection([MulticlassAccuracy(num_classes=NUM_CLASSES, average="micro")], postfix="_micro")
+        outer = MetricCollection([inner], prefix="train/")
+        outer.update(jnp.asarray(_preds[0]), jnp.asarray(_target[0]))
+        assert list(outer.compute().keys()) == ["train/MulticlassAccuracy_micro"]
+
+    def test_getitem_breaks_aliasing(self):
+        mc = MetricCollection(
+            [
+                MulticlassPrecision(num_classes=NUM_CLASSES, average="macro"),
+                MulticlassRecall(num_classes=NUM_CLASSES, average="macro"),
+            ]
+        )
+        mc.update(jnp.asarray(_preds[0]), jnp.asarray(_target[0]))
+        m = mc["MulticlassPrecision"]
+        m.update(jnp.asarray(_preds[1]), jnp.asarray(_target[1]))
+        # the other member must be unaffected (copy_state=True default on getitem)
+        assert mc._state_is_copy
+
+    def test_clone_and_reset(self):
+        mc = MetricCollection([MulticlassAccuracy(num_classes=NUM_CLASSES, average="micro")])
+        mc.update(jnp.asarray(_preds[0]), jnp.asarray(_target[0]))
+        c = mc.clone(prefix="c/")
+        mc.reset()
+        assert float(list(c.compute().values())[0]) > 0
+
+
+class TestAggregation:
+    def test_sum_mean_max_min_cat(self):
+        vals = [1.0, 2.0, 3.0]
+        s, m, mx, mn, c = SumMetric(), MeanMetric(), MaxMetric(), MinMetric(), CatMetric()
+        for v in vals:
+            for metric in (s, m, mx, mn, c):
+                metric.update(v)
+        assert float(s.compute()) == 6.0
+        assert float(m.compute()) == 2.0
+        assert float(mx.compute()) == 3.0
+        assert float(mn.compute()) == 1.0
+        np.testing.assert_allclose(np.asarray(c.compute()), vals)
+
+    def test_weighted_mean(self):
+        m = MeanMetric()
+        m.update(jnp.asarray([1.0, 2.0]), weight=jnp.asarray([0.2, 0.8]))
+        np.testing.assert_allclose(float(m.compute()), (0.2 + 1.6) / 1.0, rtol=1e-6)
+
+    def test_nan_strategies(self):
+        m = SumMetric(nan_strategy="ignore")
+        m.update(jnp.asarray([1.0, float("nan"), 2.0]))
+        assert float(m.compute()) == 3.0
+        m = SumMetric(nan_strategy=5.0)
+        m.update(jnp.asarray([1.0, float("nan")]))
+        assert float(m.compute()) == 6.0
+        m = SumMetric(nan_strategy="error")
+        with pytest.raises(RuntimeError, match="nan"):
+            m.update(jnp.asarray([float("nan")]))
+
+
+class TestWrappers:
+    def test_bootstrapper(self):
+        base = MulticlassAccuracy(num_classes=NUM_CLASSES, average="micro")
+        bs = BootStrapper(base, num_bootstraps=10)
+        for p, t in zip(_preds, _target):
+            bs.update(jnp.asarray(p), jnp.asarray(t))
+        out = bs.compute()
+        ref = accuracy_score(np.concatenate(_target), np.concatenate(_preds))
+        assert abs(float(out["mean"]) - ref) < 0.1
+        assert float(out["std"]) < 0.2
+
+    def test_classwise(self):
+        metric = ClasswiseWrapper(MulticlassAccuracy(num_classes=3, average=None), labels=["a", "b", "c"])
+        out = metric(jnp.asarray([0, 1, 2, 1]), jnp.asarray([0, 1, 2, 2]))
+        assert set(out.keys()) == {"multiclassaccuracy_a", "multiclassaccuracy_b", "multiclassaccuracy_c"}
+
+    def test_minmax(self):
+        from metrics_tpu.classification import BinaryAccuracy
+
+        metric = MinMaxMetric(BinaryAccuracy())
+        metric.update(jnp.array([1, 0, 0, 1]), jnp.array([1, 1, 0, 1]))
+        out1 = metric.compute()
+        metric.update(jnp.array([1, 1, 1, 1]), jnp.array([1, 1, 1, 1]))
+        out2 = metric.compute()
+        assert float(out2["max"]) >= float(out1["max"])
+        assert float(out2["min"]) == float(out1["min"])
+
+    def test_multioutput(self):
+        metric = MultioutputWrapper(MeanSquaredError(), num_outputs=2)
+        target = jnp.array([[0.1, 0.2], [0.3, 0.4]])
+        preds = jnp.array([[0.1, 0.3], [0.5, 0.4]])
+        out = metric(preds, target)
+        np.testing.assert_allclose(np.asarray(out), [0.02, 0.005], atol=1e-6)
+
+    def test_multioutput_nan_removal(self):
+        metric = MultioutputWrapper(MeanAbsoluteError(), num_outputs=2, remove_nans=True)
+        target = jnp.array([[0.0, 1.0], [float("nan"), 2.0], [4.0, 3.0]])
+        preds = jnp.array([[1.0, 1.0], [2.0, 2.0], [5.0, 3.0]])
+        out = metric(preds, target)
+        np.testing.assert_allclose(np.asarray(out), [1.0, 0.0], atol=1e-6)
+
+    def test_tracker(self):
+        tracker = MetricTracker(MulticlassAccuracy(num_classes=NUM_CLASSES, average="micro"))
+        for i in range(3):
+            tracker.increment()
+            tracker.update(jnp.asarray(_preds[i]), jnp.asarray(_target[i]))
+        allres = tracker.compute_all()
+        assert allres.shape == (3,)
+        best, step = tracker.best_metric(return_step=True)
+        assert 0 <= step < 3
+        assert best == pytest.approx(float(allres.max()))
+
+    def test_tracker_with_collection(self):
+        mc = MetricCollection(
+            [
+                MulticlassAccuracy(num_classes=NUM_CLASSES, average="micro"),
+                MulticlassPrecision(num_classes=NUM_CLASSES, average="macro"),
+            ]
+        )
+        tracker = MetricTracker(mc)
+        for i in range(2):
+            tracker.increment()
+            tracker.update(jnp.asarray(_preds[i]), jnp.asarray(_target[i]))
+        res = tracker.compute_all()
+        assert set(res.keys()) == {"MulticlassAccuracy", "MulticlassPrecision"}
+        best = tracker.best_metric()
+        assert set(best.keys()) == {"MulticlassAccuracy", "MulticlassPrecision"}

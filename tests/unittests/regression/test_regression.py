@@ -1,0 +1,249 @@
+"""Differential tests for the regression domain vs sklearn/scipy.
+
+Mirrors reference tests/unittests/regression/* coverage.
+"""
+import numpy as np
+import pytest
+from scipy.stats import kendalltau, pearsonr, spearmanr
+from sklearn.metrics import (
+    explained_variance_score,
+    mean_absolute_error as sk_mae,
+    mean_absolute_percentage_error as sk_mape,
+    mean_squared_error as sk_mse,
+    mean_squared_log_error as sk_msle,
+    mean_tweedie_deviance,
+    r2_score as sk_r2,
+)
+
+from metrics_tpu.functional.regression import (
+    concordance_corrcoef,
+    cosine_similarity,
+    explained_variance,
+    kendall_rank_corrcoef,
+    kl_divergence,
+    log_cosh_error,
+    mean_absolute_error,
+    mean_absolute_percentage_error,
+    mean_squared_error,
+    mean_squared_log_error,
+    minkowski_distance,
+    pearson_corrcoef,
+    r2_score,
+    spearman_corrcoef,
+    symmetric_mean_absolute_percentage_error,
+    tweedie_deviance_score,
+    weighted_mean_absolute_percentage_error,
+)
+from metrics_tpu.regression import (
+    ConcordanceCorrCoef,
+    ExplainedVariance,
+    KendallRankCorrCoef,
+    MeanAbsoluteError,
+    MeanSquaredError,
+    PearsonCorrCoef,
+    R2Score,
+    SpearmanCorrCoef,
+)
+
+import sys, os
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+from helpers import seed_all  # noqa: E402
+from helpers.testers import BATCH_SIZE, NUM_BATCHES, MetricTester  # noqa: E402
+
+seed_all(42)
+_rng = np.random.default_rng(31)
+_preds = _rng.normal(size=(NUM_BATCHES, BATCH_SIZE)).astype(np.float32)
+_target = (_preds + 0.5 * _rng.normal(size=(NUM_BATCHES, BATCH_SIZE))).astype(np.float32)
+_pos_preds = np.abs(_preds) + 0.1
+_pos_target = np.abs(_target) + 0.1
+
+
+class TestBasicRegression(MetricTester):
+    atol = 1e-5
+
+    def test_mse(self):
+        self.run_class_metric_test(_preds, _target, MeanSquaredError, lambda p, t: sk_mse(t.ravel(), p.ravel()), sharded=True)
+        self.run_functional_metric_test(_preds, _target, mean_squared_error, lambda p, t: sk_mse(t.ravel(), p.ravel()))
+
+    def test_rmse(self):
+        res = mean_squared_error(_preds[0], _target[0], squared=False)
+        np.testing.assert_allclose(np.asarray(res), np.sqrt(sk_mse(_target[0], _preds[0])), atol=1e-5)
+
+    def test_mae(self):
+        self.run_class_metric_test(_preds, _target, MeanAbsoluteError, lambda p, t: sk_mae(t.ravel(), p.ravel()), sharded=True)
+        self.run_functional_metric_test(_preds, _target, mean_absolute_error, lambda p, t: sk_mae(t.ravel(), p.ravel()))
+
+    def test_mape(self):
+        res = mean_absolute_percentage_error(_pos_preds[0], _pos_target[0])
+        np.testing.assert_allclose(np.asarray(res), sk_mape(_pos_target[0], _pos_preds[0]), rtol=1e-4)
+
+    def test_smape(self):
+        p, t = _pos_preds[0], _pos_target[0]
+        expected = np.mean(2 * np.abs(p - t) / (np.abs(t) + np.abs(p)))
+        np.testing.assert_allclose(np.asarray(symmetric_mean_absolute_percentage_error(p, t)), expected, rtol=1e-5)
+
+    def test_wmape(self):
+        p, t = _pos_preds[0], _pos_target[0]
+        expected = np.sum(np.abs(p - t)) / np.sum(np.abs(t))
+        np.testing.assert_allclose(np.asarray(weighted_mean_absolute_percentage_error(p, t)), expected, rtol=1e-5)
+
+    def test_msle(self):
+        res = mean_squared_log_error(_pos_preds[0], _pos_target[0])
+        np.testing.assert_allclose(np.asarray(res), sk_msle(_pos_target[0], _pos_preds[0]), rtol=1e-5)
+
+    def test_log_cosh(self):
+        p, t = _preds[0], _target[0]
+        expected = np.mean(np.log(np.cosh(p - t)))
+        np.testing.assert_allclose(np.asarray(log_cosh_error(p, t)), expected, rtol=1e-4)
+
+    def test_minkowski(self):
+        p, t = _preds[0], _target[0]
+        expected = (np.abs(p - t) ** 3).sum() ** (1 / 3)
+        np.testing.assert_allclose(np.asarray(minkowski_distance(p, t, 3)), expected, rtol=1e-4)
+
+    def test_cosine_similarity(self):
+        p = _rng.normal(size=(8, 16)).astype(np.float32)
+        t = _rng.normal(size=(8, 16)).astype(np.float32)
+        res = cosine_similarity(p, t, reduction="none")
+        expected = np.array([np.dot(a, b) / (np.linalg.norm(a) * np.linalg.norm(b)) for a, b in zip(p, t)])
+        np.testing.assert_allclose(np.asarray(res), expected, rtol=1e-5)
+
+    def test_tweedie(self):
+        for power in [0.0, 1.0, 2.0, 1.5]:
+            res = tweedie_deviance_score(_pos_preds[0], _pos_target[0], power=power)
+            expected = mean_tweedie_deviance(_pos_target[0], _pos_preds[0], power=power)
+            np.testing.assert_allclose(np.asarray(res), expected, rtol=1e-4)
+
+    def test_kl_divergence(self):
+        p = _rng.random((16, 8)).astype(np.float32)
+        q = _rng.random((16, 8)).astype(np.float32)
+        pn = p / p.sum(1, keepdims=True)
+        qn = q / q.sum(1, keepdims=True)
+        expected = np.mean((pn * np.log(pn / qn)).sum(1))
+        np.testing.assert_allclose(np.asarray(kl_divergence(p, q)), expected, rtol=1e-4)
+
+
+class TestVarianceRegression(MetricTester):
+    atol = 1e-5
+
+    def test_explained_variance(self):
+        self.run_class_metric_test(
+            _preds, _target, ExplainedVariance, lambda p, t: explained_variance_score(t.ravel(), p.ravel()),
+            sharded=True,
+        )
+        for mo in ["raw_values", "uniform_average", "variance_weighted"]:
+            p = _rng.normal(size=(32, 3)).astype(np.float32)
+            t = (p + 0.3 * _rng.normal(size=(32, 3))).astype(np.float32)
+            res = explained_variance(p, t, multioutput=mo)
+            expected = explained_variance_score(t, p, multioutput=mo)
+            np.testing.assert_allclose(np.asarray(res), expected, rtol=1e-4)
+
+    def test_r2(self):
+        self.run_class_metric_test(
+            _preds, _target, R2Score, lambda p, t: sk_r2(t.ravel(), p.ravel()), sharded=True
+        )
+        p = _rng.normal(size=(32, 3)).astype(np.float32)
+        t = (p + 0.3 * _rng.normal(size=(32, 3))).astype(np.float32)
+        for mo in ["raw_values", "uniform_average", "variance_weighted"]:
+            res = r2_score(p, t, multioutput=mo)
+            np.testing.assert_allclose(np.asarray(res), sk_r2(t, p, multioutput=mo), rtol=1e-4)
+
+    def test_r2_adjusted(self):
+        p, t = _preds[0], _target[0]
+        r2 = sk_r2(t, p)
+        n = len(t)
+        adj = 1 - (1 - r2) * (n - 1) / (n - 5 - 1)
+        np.testing.assert_allclose(np.asarray(r2_score(p, t, adjusted=5)), adj, rtol=1e-4)
+
+
+class TestCorrelations(MetricTester):
+    atol = 1e-4
+
+    def test_pearson_functional(self):
+        res = pearson_corrcoef(_preds[0], _target[0])
+        expected = pearsonr(_target[0], _preds[0])[0]
+        np.testing.assert_allclose(np.asarray(res), expected, rtol=1e-4)
+
+    def test_pearson_class_accumulated(self):
+        self.run_class_metric_test(
+            _preds, _target, PearsonCorrCoef, lambda p, t: pearsonr(t.ravel(), p.ravel())[0], check_batch=True
+        )
+
+    def test_pearson_merge_matches_full(self):
+        """The custom reduce (stacked per-device states -> _final_aggregation) must equal
+        single-pass computation — the core DDP-parity property of PearsonCorrCoef."""
+        from metrics_tpu.regression.pearson import _final_aggregation
+        import jax.numpy as jnp
+
+        m1, m2 = PearsonCorrCoef(), PearsonCorrCoef()
+        m1.update(_preds[0], _target[0])
+        m1.update(_preds[1], _target[1])
+        m2.update(_preds[2], _target[2])
+        m2.update(_preds[3], _target[3])
+        stacked = [jnp.stack([getattr(m1, s), getattr(m2, s)]) for s in ["mean_x", "mean_y", "var_x", "var_y", "corr_xy", "n_total"]]
+        _, _, var_x, var_y, corr_xy, n_total = _final_aggregation(*stacked)
+        from metrics_tpu.functional.regression.pearson import _pearson_corrcoef_compute
+
+        merged = _pearson_corrcoef_compute(var_x, var_y, corr_xy, n_total)
+        expected = pearsonr(_target[:4].ravel(), _preds[:4].ravel())[0]
+        np.testing.assert_allclose(np.asarray(merged), expected, rtol=1e-4)
+
+    def test_spearman(self):
+        res = spearman_corrcoef(_preds[0], _target[0])
+        expected = spearmanr(_target[0], _preds[0])[0]
+        np.testing.assert_allclose(np.asarray(res), expected, rtol=1e-4)
+        # with ties
+        p = np.round(_preds[0] * 2) / 2
+        t = np.round(_target[0] * 2) / 2
+        res = spearman_corrcoef(p.astype(np.float32), t.astype(np.float32))
+        expected = spearmanr(t, p)[0]
+        np.testing.assert_allclose(np.asarray(res), expected, rtol=1e-3)
+
+    def test_spearman_class(self):
+        self.run_class_metric_test(
+            _preds, _target, SpearmanCorrCoef, lambda p, t: spearmanr(t.ravel(), p.ravel())[0],
+            check_batch=False, atol=1e-4,
+        )
+
+    def test_kendall(self):
+        for variant in ["b", "c"]:
+            res = kendall_rank_corrcoef(_preds[0], _target[0], variant=variant)
+            expected = kendalltau(_target[0], _preds[0], variant=variant).statistic
+            np.testing.assert_allclose(np.asarray(res), expected, rtol=1e-4)
+        # variant 'a' (not in scipy): (con - dis) / n_pairs, manual oracle
+        p, t = _target[0], _preds[0]
+        n = len(p)
+        con = dis = 0
+        for i in range(n):
+            for j in range(i + 1, n):
+                s = np.sign(p[i] - p[j]) * np.sign(t[i] - t[j])
+                con += s > 0
+                dis += s < 0
+        expected_a = (con - dis) / (n * (n - 1) / 2)
+        res_a = kendall_rank_corrcoef(_preds[0], _target[0], variant="a")
+        np.testing.assert_allclose(np.asarray(res_a), expected_a, rtol=1e-4)
+        # with ties
+        p = np.round(_preds[0]).astype(np.float32)
+        t = np.round(_target[0]).astype(np.float32)
+        res = kendall_rank_corrcoef(p, t, variant="b")
+        expected = kendalltau(t, p, variant="b").statistic
+        np.testing.assert_allclose(np.asarray(res), expected, rtol=1e-4)
+
+    def test_kendall_class(self):
+        self.run_class_metric_test(
+            _preds, _target, KendallRankCorrCoef, lambda p, t: kendalltau(t.ravel(), p.ravel()).statistic,
+            check_batch=False, atol=1e-4,
+        )
+
+    def test_concordance(self):
+        p, t = _preds[0].astype(np.float64), _target[0].astype(np.float64)
+        mx, my = p.mean(), t.mean()
+        sx, sy = p.var(), t.var()
+        sxy = ((p - mx) * (t - my)).mean()
+        expected = 2 * sxy / (sx + sy + (mx - my) ** 2)
+        res = concordance_corrcoef(_preds[0], _target[0])
+        np.testing.assert_allclose(np.asarray(res), expected, rtol=1e-3)
+        m = ConcordanceCorrCoef()
+        m.update(_preds[0], _target[0])
+        np.testing.assert_allclose(np.asarray(m.compute()), expected, rtol=1e-3)
