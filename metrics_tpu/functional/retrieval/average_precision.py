@@ -1,0 +1,31 @@
+"""Retrieval AP functional (reference: functional/retrieval/average_precision.py:20-60)."""
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_retrieval_functional_inputs
+
+
+def retrieval_average_precision(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """AP for a single query.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional.retrieval import retrieval_average_precision
+        >>> preds = jnp.array([0.2, 0.3, 0.5])
+        >>> target = jnp.array([True, False, True])
+        >>> retrieval_average_precision(preds, target)
+        Array(0.8333334, dtype=float32)
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    top_k = top_k or preds.shape[-1]
+    if not (isinstance(top_k, int) and top_k > 0):
+        raise ValueError(f"Argument ``top_k`` has to be a positive integer or None, but got {top_k}.")
+    k = min(top_k, preds.shape[-1])
+    order = jnp.argsort(-preds)
+    t = (target[order][:k] > 0).astype(jnp.float32)
+    n_rel = t.sum()
+    pos = jnp.arange(1, k + 1, dtype=jnp.float32)
+    cumrel = jnp.cumsum(t)
+    return jnp.where(n_rel > 0, (t * cumrel / pos).sum() / jnp.maximum(n_rel, 1.0), 0.0)

@@ -1,0 +1,21 @@
+"""Retrieval fall-out functional (reference: functional/retrieval/fall_out.py:20-66)."""
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_retrieval_functional_inputs
+
+
+def retrieval_fall_out(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """Fall-out@k for a single query: non-relevant retrieved / all non-relevant."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    if top_k is None:
+        top_k = preds.shape[-1]
+    if not (isinstance(top_k, int) and top_k > 0):
+        raise ValueError("`top_k` has to be a positive integer or None")
+    neg = 1 - (target > 0).astype(jnp.int32)
+    order = jnp.argsort(-preds)
+    nonrel_in_k = neg[order][:top_k].sum().astype(jnp.float32)
+    total_neg = neg.sum().astype(jnp.float32)
+    return jnp.where(total_neg > 0, nonrel_in_k / jnp.maximum(total_neg, 1.0), 0.0)

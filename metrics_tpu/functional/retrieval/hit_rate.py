@@ -1,0 +1,19 @@
+"""Retrieval hit rate functional (reference: functional/retrieval/hit_rate.py:20-62)."""
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_retrieval_functional_inputs
+
+
+def retrieval_hit_rate(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """HitRate@k for a single query."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    if top_k is None:
+        top_k = preds.shape[-1]
+    if not (isinstance(top_k, int) and top_k > 0):
+        raise ValueError("`top_k` has to be a positive integer or None")
+    order = jnp.argsort(-preds)
+    relevant = (target[order][:top_k] > 0).sum()
+    return (relevant > 0).astype(jnp.float32)

@@ -1,0 +1,36 @@
+"""Retrieval NDCG functional (reference: functional/retrieval/ndcg.py:20-70)."""
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_retrieval_functional_inputs
+
+
+def _dcg(target: Array) -> Array:
+    denom = jnp.log2(jnp.arange(target.shape[-1], dtype=jnp.float32) + 2.0)
+    return (target / denom).sum(axis=-1)
+
+
+def retrieval_normalized_dcg(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """NDCG@k for a single query (graded relevance allowed).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional.retrieval import retrieval_normalized_dcg
+        >>> preds = jnp.array([.1, .2, .3, 4, 70.])
+        >>> target = jnp.array([10, 0, 0, 1, 5])
+        >>> retrieval_normalized_dcg(preds, target)
+        Array(0.6956941, dtype=float32)
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target, allow_non_binary_target=True)
+    top_k = preds.shape[-1] if top_k is None else top_k
+    if not (isinstance(top_k, int) and top_k > 0):
+        raise ValueError("`top_k` has to be a positive integer or None")
+    target = target.astype(jnp.float32)
+    sorted_target = target[jnp.argsort(-preds)][:top_k]
+    ideal_target = -jnp.sort(-target)[:top_k]
+    ideal_dcg = _dcg(ideal_target)
+    target_dcg = _dcg(sorted_target)
+    score = jnp.where(ideal_dcg > 0, target_dcg / jnp.maximum(ideal_dcg, 1e-12), 0.0)
+    return jnp.clip(score, 0.0, 1.0)

@@ -1,0 +1,21 @@
+"""Retrieval precision functional (reference: functional/retrieval/precision.py:20-70)."""
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_retrieval_functional_inputs
+
+
+def retrieval_precision(preds: Array, target: Array, top_k: Optional[int] = None, adaptive_k: bool = False) -> Array:
+    """Precision@k for a single query."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    if not isinstance(adaptive_k, bool):
+        raise ValueError("`adaptive_k` has to be a boolean")
+    if top_k is None or (adaptive_k and top_k > preds.shape[-1]):
+        top_k = preds.shape[-1]
+    if not (isinstance(top_k, int) and top_k > 0):
+        raise ValueError("`top_k` has to be a positive integer or None")
+    order = jnp.argsort(-preds)
+    relevant = (target[order][: min(top_k, preds.shape[-1])] > 0).sum().astype(jnp.float32)
+    return jnp.where(target.sum() > 0, relevant / top_k, 0.0)

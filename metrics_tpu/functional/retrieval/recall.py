@@ -1,0 +1,20 @@
+"""Retrieval recall functional (reference: functional/retrieval/recall.py:20-66)."""
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_retrieval_functional_inputs
+
+
+def retrieval_recall(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """Recall@k for a single query."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    if top_k is None:
+        top_k = preds.shape[-1]
+    if not (isinstance(top_k, int) and top_k > 0):
+        raise ValueError("`top_k` has to be a positive integer or None")
+    order = jnp.argsort(-preds)
+    relevant = (target[order][:top_k] > 0).sum().astype(jnp.float32)
+    total = (target > 0).sum().astype(jnp.float32)
+    return jnp.where(total > 0, relevant / jnp.maximum(total, 1.0), 0.0)

@@ -1,0 +1,24 @@
+"""Retrieval MRR functional (reference: functional/retrieval/reciprocal_rank.py:20-56)."""
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_retrieval_functional_inputs
+
+
+def retrieval_reciprocal_rank(preds: Array, target: Array) -> Array:
+    """Reciprocal rank of the first relevant document for a single query.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional.retrieval import retrieval_reciprocal_rank
+        >>> preds = jnp.array([0.2, 0.3, 0.5])
+        >>> target = jnp.array([False, True, False])
+        >>> retrieval_reciprocal_rank(preds, target)
+        Array(0.5, dtype=float32)
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    order = jnp.argsort(-preds)
+    t = target[order] > 0
+    rank = jnp.arange(1, preds.shape[-1] + 1)
+    first = jnp.min(jnp.where(t, rank, preds.shape[-1] + 1))
+    return jnp.where(t.any(), 1.0 / first.astype(jnp.float32), 0.0)

@@ -1,0 +1,3 @@
+from metrics_tpu.ops.segment import grouped_retrieval_scores
+
+__all__ = ["grouped_retrieval_scores"]

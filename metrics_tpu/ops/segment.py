@@ -1,0 +1,146 @@
+"""Segment-grouped retrieval kernels.
+
+The TPU-native replacement for the reference's host-side group-by loop
+(``retrieval/base.py:113-145``: ``_flexible_bincount(...).cpu().tolist()`` +
+``torch.split`` + python loop over queries). Here the whole evaluation is one
+device program:
+
+1. one lexsort groups rows by query and ranks docs by score inside each query,
+2. segment ids come from boundary detection + cumsum,
+3. every per-query retrieval metric becomes a segment reduction (segment_sum /
+   segment_min) over rank-indexed terms — no host round-trips, no ragged splits,
+   O(N log N) total and fully jit-compatible with a static row count.
+"""
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+
+def _segment_layout(indexes: Array, preds: Array, target: Array):
+    """Sort rows by (query, -score); return per-row segment ids and rank info.
+
+    Returns: (seg_id, rank, sorted_preds, sorted_target, n_seg_upper, seg_count)
+    where rank is the 1-based position of the row inside its query's score-ordered
+    list and seg_count[s] is the number of docs of segment s (0 for unused slots).
+    """
+    n = indexes.shape[0]
+    order = jnp.lexsort((-preds, indexes))
+    s_idx = indexes[order]
+    s_preds = preds[order]
+    s_target = target[order]
+
+    new_seg = jnp.concatenate([jnp.ones(1, dtype=bool), s_idx[1:] != s_idx[:-1]])
+    seg_id = jnp.cumsum(new_seg) - 1  # dense 0..n_q-1
+
+    pos = jnp.arange(n)
+    seg_start = jax.ops.segment_min(pos, seg_id, num_segments=n)
+    rank = pos - seg_start[seg_id] + 1  # 1-based within query
+
+    seg_count = jax.ops.segment_sum(jnp.ones(n, jnp.int32), seg_id, num_segments=n)
+    return seg_id, rank, s_preds, s_target, n, seg_count
+
+
+def _segment_cumsum(values: Array, seg_id: Array, num_segments: int) -> Array:
+    """Within-segment inclusive cumsum via global cumsum minus per-segment base."""
+    g = jnp.cumsum(values)
+    pos = jnp.arange(values.shape[0])
+    start = jax.ops.segment_min(pos, seg_id, num_segments=num_segments)
+    base = g[start[seg_id]] - values[start[seg_id]]
+    return g - base
+
+
+def grouped_retrieval_scores(
+    indexes: Array,
+    preds: Array,
+    target: Array,
+    metric: str,
+    top_k: Optional[int] = None,
+    adaptive_k: bool = False,
+) -> Tuple[Array, Array, Array]:
+    """Per-query scores for every query in one fused device pass.
+
+    Returns ``(scores, n_positive, valid)`` each of length N (upper bound on number
+    of queries); only entries where ``valid`` is True correspond to real queries.
+    ``n_positive`` is the per-query count of positive targets (used by the caller
+    for ``empty_target_action`` handling; for ``fall_out`` it counts negatives).
+    """
+    n = indexes.shape[0]
+    seg_id, rank, s_preds, s_target, n_seg, seg_count = _segment_layout(indexes, preds, target)
+    valid = seg_count > 0
+    t = s_target.astype(jnp.float32)
+    binary_t = (s_target > 0).astype(jnp.float32)
+
+    count_f = seg_count.astype(jnp.float32)
+    if top_k is None:
+        k_per_seg = count_f
+        in_k = jnp.ones(n, dtype=bool)
+    else:
+        if adaptive_k:
+            k_per_seg = jnp.minimum(float(top_k), count_f)
+        else:
+            k_per_seg = jnp.full_like(count_f, float(top_k))
+        in_k = rank <= top_k
+
+    seg_sum = partial(jax.ops.segment_sum, segment_ids=seg_id, num_segments=n_seg)
+    n_pos = seg_sum(binary_t)
+    n_neg = seg_sum(1.0 - binary_t)
+
+    if metric == "average_precision":
+        # AP = mean over relevant-in-topk of (j / rank_j), j = within-query relevant index
+        cumrel = _segment_cumsum(binary_t * in_k, seg_id, n_seg)
+        contrib = jnp.where(in_k, binary_t * cumrel / rank, 0.0)
+        rel_in_k = seg_sum(binary_t * in_k)
+        scores = jnp.where(rel_in_k > 0, seg_sum(contrib) / jnp.maximum(rel_in_k, 1.0), 0.0)
+        return scores, n_pos, valid
+
+    if metric == "reciprocal_rank":
+        first_rel = jax.ops.segment_min(
+            jnp.where(binary_t > 0, rank, jnp.iinfo(jnp.int32).max), seg_id, num_segments=n_seg
+        )
+        scores = jnp.where(n_pos > 0, 1.0 / jnp.maximum(first_rel, 1).astype(jnp.float32), 0.0)
+        return scores, n_pos, valid
+
+    if metric == "precision":
+        rel_in_k = seg_sum(binary_t * in_k)
+        scores = jnp.where(n_pos > 0, rel_in_k / jnp.maximum(k_per_seg, 1.0), 0.0)
+        return scores, n_pos, valid
+
+    if metric == "recall":
+        rel_in_k = seg_sum(binary_t * in_k)
+        scores = jnp.where(n_pos > 0, rel_in_k / jnp.maximum(n_pos, 1.0), 0.0)
+        return scores, n_pos, valid
+
+    if metric == "hit_rate":
+        rel_in_k = seg_sum(binary_t * in_k)
+        scores = (rel_in_k > 0).astype(jnp.float32)
+        return scores, n_pos, valid
+
+    if metric == "fall_out":
+        # fraction of non-relevant docs retrieved in top-k among all non-relevant
+        nonrel_in_k = seg_sum((1.0 - binary_t) * in_k)
+        scores = jnp.where(n_neg > 0, nonrel_in_k / jnp.maximum(n_neg, 1.0), 0.0)
+        return scores, n_neg, valid  # n_positive slot carries negatives for empty handling
+
+    if metric == "r_precision":
+        # relevant among top-(n_pos) ranked docs
+        in_r = rank.astype(jnp.float32) <= n_pos[seg_id]
+        rel_in_r = seg_sum(binary_t * in_r)
+        scores = jnp.where(n_pos > 0, rel_in_r / jnp.maximum(n_pos, 1.0), 0.0)
+        return scores, n_pos, valid
+
+    if metric == "ndcg":
+        # DCG over score-ranked targets; IDCG over value-sorted targets
+        disc = 1.0 / jnp.log2(rank.astype(jnp.float32) + 1.0)
+        dcg = seg_sum(jnp.where(in_k, t * disc, 0.0))
+        # ideal ordering: sort by (-target) within query
+        order2 = jnp.lexsort((-target, indexes))
+        s_t2 = target[order2].astype(jnp.float32)
+        idcg = seg_sum(jnp.where(in_k, s_t2 * disc, 0.0))
+        scores = jnp.where(idcg > 0, dcg / jnp.maximum(idcg, 1e-12), 0.0)
+        scores = jnp.clip(scores, 0.0, 1.0)
+        return scores, n_pos, valid
+
+    raise ValueError(f"Unknown grouped retrieval metric: {metric}")

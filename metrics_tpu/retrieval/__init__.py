@@ -1,0 +1,19 @@
+from metrics_tpu.retrieval.average_precision import RetrievalMAP
+from metrics_tpu.retrieval.fall_out import RetrievalFallOut
+from metrics_tpu.retrieval.hit_rate import RetrievalHitRate
+from metrics_tpu.retrieval.ndcg import RetrievalNormalizedDCG
+from metrics_tpu.retrieval.precision import RetrievalPrecision
+from metrics_tpu.retrieval.r_precision import RetrievalRPrecision
+from metrics_tpu.retrieval.recall import RetrievalRecall
+from metrics_tpu.retrieval.reciprocal_rank import RetrievalMRR
+
+__all__ = [
+    "RetrievalFallOut",
+    "RetrievalHitRate",
+    "RetrievalMAP",
+    "RetrievalMRR",
+    "RetrievalNormalizedDCG",
+    "RetrievalPrecision",
+    "RetrievalRPrecision",
+    "RetrievalRecall",
+]

@@ -1,0 +1,30 @@
+"""RetrievalMAP (reference: retrieval/average_precision.py:27-110)."""
+from typing import Any, Optional
+
+from metrics_tpu.retrieval.base import RetrievalMetric
+
+
+class RetrievalMAP(RetrievalMetric):
+    """Mean average precision over queries.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.retrieval import RetrievalMAP
+        >>> indexes = jnp.array([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.array([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.array([False, False, True, False, True, False, True])
+        >>> rmap = RetrievalMAP()
+        >>> rmap(preds, target, indexes=indexes)
+        Array(0.7916667, dtype=float32)
+    """
+
+    _grouped_metric = "average_precision"
+
+    def __init__(self, empty_target_action: str = "neg", ignore_index=None, top_k: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, **kwargs)
+        if top_k is not None and not (isinstance(top_k, int) and top_k > 0):
+            raise ValueError("`top_k` has to be a positive integer or None")
+        self.top_k = top_k
+
+    def _metric_kwargs(self) -> dict:
+        return {"top_k": self.top_k}

@@ -1,0 +1,99 @@
+"""RetrievalMetric base.
+
+Capability parity with reference ``retrieval/base.py:25-145``: cat states
+``indexes/preds/target``, per-query evaluation with ``empty_target_action``
+(neg/pos/skip/error) and ``ignore_index`` filtering.
+
+TPU redesign (SURVEY.md SS2.8): the reference splits queries with a host loop
+(``_flexible_bincount(...).cpu().tolist()`` + ``torch.split``); here compute is one
+fused segment-kernel pass (``metrics_tpu.ops.segment.grouped_retrieval_scores``):
+lexsort -> segment ids -> segment reductions, no per-query host iteration.
+"""
+from abc import ABC
+from typing import Any, Optional
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.ops.segment import grouped_retrieval_scores
+from metrics_tpu.utils.checks import _check_retrieval_inputs
+from metrics_tpu.utils.data import dim_zero_cat
+
+
+class RetrievalMetric(Metric, ABC):
+    """Base class for retrieval metrics (reference: retrieval/base.py:25).
+
+    Subclasses set ``_grouped_metric`` (a key understood by
+    ``grouped_retrieval_scores``) and optional extra kwargs via ``_metric_kwargs``.
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    full_state_update: bool = False
+
+    _grouped_metric: str = ""
+    allow_non_binary_target: bool = False
+    # queries with no positive docs use this action; fall_out flips the meaning
+    _empty_refers_to_negatives: bool = False
+
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        empty_target_action_options = ("error", "skip", "neg", "pos")
+        if empty_target_action not in empty_target_action_options:
+            raise ValueError(f"Argument `empty_target_action` received a wrong value `{empty_target_action}`.")
+        self.empty_target_action = empty_target_action
+        if ignore_index is not None and not isinstance(ignore_index, int):
+            raise ValueError("Argument `ignore_index` must be an integer or None.")
+        self.ignore_index = ignore_index
+
+        self.add_state("indexes", default=[], dist_reduce_fx="cat")
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+        self.add_state("target", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array, indexes: Array) -> None:
+        if indexes is None:
+            raise ValueError("Argument `indexes` cannot be None")
+        indexes, preds, target = _check_retrieval_inputs(
+            indexes, preds, target, allow_non_binary_target=self.allow_non_binary_target, ignore_index=self.ignore_index
+        )
+        self.indexes.append(indexes)
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def _metric_kwargs(self) -> dict:
+        return {}
+
+    def compute(self) -> Array:
+        indexes = dim_zero_cat(self.indexes)
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+
+        scores, n_pos, valid = grouped_retrieval_scores(
+            indexes, preds, target, self._grouped_metric, **self._metric_kwargs()
+        )
+        empty = valid & (n_pos == 0)
+
+        if self.empty_target_action == "error":
+            if bool(jnp.any(empty)):
+                kind = "negative" if self._empty_refers_to_negatives else "positive"
+                raise ValueError(f"`compute` method was provided with a query with no {kind} target.")
+            keep = valid
+        elif self.empty_target_action == "skip":
+            keep = valid & ~empty
+        elif self.empty_target_action == "pos":
+            scores = jnp.where(empty, 1.0, scores)
+            keep = valid
+        else:  # "neg"
+            scores = jnp.where(empty, 0.0, scores)
+            keep = valid
+
+        n_keep = keep.sum()
+        total = jnp.where(keep, scores, 0.0).sum()
+        return jnp.where(n_keep > 0, total / jnp.maximum(n_keep, 1), 0.0).astype(jnp.float32)

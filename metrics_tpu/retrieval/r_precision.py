@@ -1,0 +1,8 @@
+"""RetrievalRPrecision (reference: retrieval/r_precision.py:27-95)."""
+from metrics_tpu.retrieval.base import RetrievalMetric
+
+
+class RetrievalRPrecision(RetrievalMetric):
+    """R-precision over queries."""
+
+    _grouped_metric = "r_precision"

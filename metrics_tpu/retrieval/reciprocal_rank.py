@@ -1,0 +1,19 @@
+"""RetrievalMRR (reference: retrieval/reciprocal_rank.py:27-100)."""
+from metrics_tpu.retrieval.base import RetrievalMetric
+
+
+class RetrievalMRR(RetrievalMetric):
+    """Mean reciprocal rank over queries.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.retrieval import RetrievalMRR
+        >>> indexes = jnp.array([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.array([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.array([False, False, True, False, True, False, True])
+        >>> mrr = RetrievalMRR()
+        >>> mrr(preds, target, indexes=indexes)
+        Array(0.75, dtype=float32)
+    """
+
+    _grouped_metric = "reciprocal_rank"

@@ -1,0 +1,222 @@
+"""Differential tests for retrieval metrics vs sklearn + host-loop oracles.
+
+The oracle re-implements the reference's host group-by loop with numpy/sklearn,
+so passing means the segment-kernel redesign reproduces the reference semantics.
+Mirrors reference tests/unittests/retrieval/* coverage.
+"""
+import numpy as np
+import pytest
+from sklearn.metrics import average_precision_score, ndcg_score
+
+from metrics_tpu.functional.retrieval import (
+    retrieval_average_precision,
+    retrieval_fall_out,
+    retrieval_hit_rate,
+    retrieval_normalized_dcg,
+    retrieval_precision,
+    retrieval_r_precision,
+    retrieval_recall,
+    retrieval_reciprocal_rank,
+)
+from metrics_tpu.retrieval import (
+    RetrievalFallOut,
+    RetrievalHitRate,
+    RetrievalMAP,
+    RetrievalMRR,
+    RetrievalNormalizedDCG,
+    RetrievalPrecision,
+    RetrievalRPrecision,
+    RetrievalRecall,
+)
+
+import sys, os
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+from helpers import seed_all  # noqa: E402
+
+seed_all(42)
+_rng = np.random.default_rng(5)
+
+N_QUERIES = 20
+_sizes = _rng.integers(3, 12, N_QUERIES)
+_indexes = np.concatenate([np.full(s, i) for i, s in enumerate(_sizes)]).astype(np.int64)
+_preds = _rng.random(len(_indexes)).astype(np.float32)
+_target = (_rng.random(len(_indexes)) > 0.6).astype(np.int64)
+_graded = _rng.integers(0, 4, len(_indexes)).astype(np.int64)
+
+# shuffle rows so queries are interleaved (tests the grouping)
+_perm = _rng.permutation(len(_indexes))
+_indexes, _preds, _target, _graded = _indexes[_perm], _preds[_perm], _target[_perm], _graded[_perm]
+
+
+def _group_apply(fn, indexes, preds, target, empty_action="neg", empty_on_neg=False):
+    """Host-loop oracle mirroring reference retrieval/base.py:113-145."""
+    out = []
+    for q in np.unique(indexes):
+        m = indexes == q
+        p, t = preds[m], target[m]
+        relevant = (1 - (t > 0)).sum() if empty_on_neg else (t > 0).sum()
+        if relevant == 0:
+            if empty_action == "skip":
+                continue
+            if empty_action == "pos":
+                out.append(1.0)
+                continue
+            if empty_action == "neg":
+                out.append(0.0)
+                continue
+        out.append(fn(p, t))
+    return np.mean(out) if out else 0.0
+
+
+def _np_ap(p, t):
+    order = np.argsort(-p)
+    t = (t[order] > 0).astype(float)
+    if t.sum() == 0:
+        return 0.0
+    cum = np.cumsum(t)
+    pos = np.arange(1, len(t) + 1)
+    return float((t * cum / pos).sum() / t.sum())
+
+
+def _np_mrr(p, t):
+    order = np.argsort(-p)
+    t = t[order] > 0
+    if not t.any():
+        return 0.0
+    return 1.0 / (np.argmax(t) + 1)
+
+
+def _np_ndcg(p, t):
+    if (t > 0).sum() == 0 and t.sum() == 0:
+        return 0.0
+    return float(ndcg_score(t[None].astype(float), p[None]))
+
+
+class TestFunctionalRetrieval:
+    def test_ap_single_query(self):
+        for q in np.unique(_indexes)[:5]:
+            m = _indexes == q
+            if _target[m].sum() == 0:
+                continue
+            res = retrieval_average_precision(_preds[m], _target[m])
+            expected = average_precision_score(_target[m], _preds[m])
+            np.testing.assert_allclose(np.asarray(res), expected, rtol=1e-5)
+
+    def test_mrr_single_query(self):
+        for q in np.unique(_indexes)[:5]:
+            m = _indexes == q
+            res = retrieval_reciprocal_rank(_preds[m], _target[m])
+            np.testing.assert_allclose(np.asarray(res), _np_mrr(_preds[m], _target[m]), rtol=1e-6)
+
+    def test_ndcg_single_query(self):
+        for q in np.unique(_indexes)[:5]:
+            m = _indexes == q
+            res = retrieval_normalized_dcg(_preds[m], _graded[m])
+            np.testing.assert_allclose(np.asarray(res), _np_ndcg(_preds[m], _graded[m]), rtol=1e-5)
+
+    def test_precision_recall_hitrate(self):
+        q = np.unique(_indexes)[0]
+        m = _indexes == q
+        p, t = _preds[m], _target[m]
+        k = 3
+        order = np.argsort(-p)
+        topk_rel = (t[order][:k] > 0).sum()
+        if t.sum() > 0:
+            np.testing.assert_allclose(np.asarray(retrieval_precision(p, t, top_k=k)), topk_rel / k, rtol=1e-6)
+            np.testing.assert_allclose(np.asarray(retrieval_recall(p, t, top_k=k)), topk_rel / t.sum(), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(retrieval_hit_rate(p, t, top_k=k)), float(topk_rel > 0), rtol=1e-6)
+
+    def test_r_precision_and_fallout(self):
+        q = np.unique(_indexes)[1]
+        m = _indexes == q
+        p, t = _preds[m], _target[m]
+        n_rel = (t > 0).sum()
+        order = np.argsort(-p)
+        if n_rel:
+            expected = (t[order][:n_rel] > 0).sum() / n_rel
+            np.testing.assert_allclose(np.asarray(retrieval_r_precision(p, t)), expected, rtol=1e-6)
+        neg = 1 - (t > 0)
+        if neg.sum():
+            expected = neg[order][:3].sum() / neg.sum()
+            np.testing.assert_allclose(np.asarray(retrieval_fall_out(p, t, top_k=3)), expected, rtol=1e-6)
+
+
+class TestRetrievalClasses:
+    @pytest.mark.parametrize("empty_action", ["neg", "pos", "skip"])
+    def test_map(self, empty_action):
+        metric = RetrievalMAP(empty_target_action=empty_action)
+        # feed in two chunks to test accumulation
+        half = len(_indexes) // 2
+        metric.update(_preds[:half], _target[:half], indexes=_indexes[:half])
+        metric.update(_preds[half:], _target[half:], indexes=_indexes[half:])
+        expected = _group_apply(_np_ap, _indexes, _preds, _target, empty_action)
+        np.testing.assert_allclose(np.asarray(metric.compute()), expected, rtol=1e-5)
+
+    def test_mrr(self):
+        metric = RetrievalMRR()
+        metric.update(_preds, _target, indexes=_indexes)
+        expected = _group_apply(_np_mrr, _indexes, _preds, _target)
+        np.testing.assert_allclose(np.asarray(metric.compute()), expected, rtol=1e-5)
+
+    def test_ndcg(self):
+        metric = RetrievalNormalizedDCG()
+        metric.update(_preds, _graded, indexes=_indexes)
+        expected = _group_apply(_np_ndcg, _indexes, _preds, _graded)
+        np.testing.assert_allclose(np.asarray(metric.compute()), expected, rtol=1e-4)
+
+    @pytest.mark.parametrize("k", [1, 3, None])
+    def test_precision_recall(self, k):
+        for cls, fn in [
+            (RetrievalPrecision, lambda p, t: (t[np.argsort(-p)][: (k or len(p))] > 0).sum() / (k or len(p))),
+            (RetrievalRecall, lambda p, t: (t[np.argsort(-p)][: (k or len(p))] > 0).sum() / max((t > 0).sum(), 1)),
+        ]:
+            metric = cls(top_k=k)
+            metric.update(_preds, _target, indexes=_indexes)
+            expected = _group_apply(fn, _indexes, _preds, _target)
+            np.testing.assert_allclose(np.asarray(metric.compute()), expected, rtol=1e-5)
+
+    def test_hit_rate(self):
+        metric = RetrievalHitRate(top_k=2)
+        metric.update(_preds, _target, indexes=_indexes)
+        expected = _group_apply(lambda p, t: float((t[np.argsort(-p)][:2] > 0).any()), _indexes, _preds, _target)
+        np.testing.assert_allclose(np.asarray(metric.compute()), expected, rtol=1e-5)
+
+    def test_fall_out(self):
+        metric = RetrievalFallOut(top_k=2)
+        metric.update(_preds, _target, indexes=_indexes)
+        expected = _group_apply(
+            lambda p, t: ((1 - (t > 0))[np.argsort(-p)][:2]).sum() / max((1 - (t > 0)).sum(), 1),
+            _indexes,
+            _preds,
+            _target,
+            empty_action="pos",
+            empty_on_neg=True,
+        )
+        np.testing.assert_allclose(np.asarray(metric.compute()), expected, rtol=1e-5)
+
+    def test_r_precision(self):
+        metric = RetrievalRPrecision()
+        metric.update(_preds, _target, indexes=_indexes)
+
+        def rp(p, t):
+            n_rel = (t > 0).sum()
+            return (t[np.argsort(-p)][:n_rel] > 0).sum() / n_rel
+
+        expected = _group_apply(rp, _indexes, _preds, _target)
+        np.testing.assert_allclose(np.asarray(metric.compute()), expected, rtol=1e-5)
+
+    def test_empty_target_error(self):
+        metric = RetrievalMAP(empty_target_action="error")
+        metric.update(np.array([0.1, 0.2]), np.array([0, 0]), indexes=np.array([0, 0]))
+        with pytest.raises(ValueError, match="no positive"):
+            metric.compute()
+
+    def test_ignore_index(self):
+        metric = RetrievalMAP(ignore_index=-1)
+        t = _target.copy()
+        t[:10] = -1
+        metric.update(_preds, t, indexes=_indexes)
+        keep = t != -1
+        expected = _group_apply(_np_ap, _indexes[keep], _preds[keep], _target[keep])
+        np.testing.assert_allclose(np.asarray(metric.compute()), expected, rtol=1e-5)
