@@ -1,0 +1,27 @@
+from metrics_tpu.functional.image.d_lambda import spectral_distortion_index
+from metrics_tpu.functional.image.ergas import error_relative_global_dimensionless_synthesis
+from metrics_tpu.functional.image.gradients import image_gradients
+from metrics_tpu.functional.image.psnr import peak_signal_noise_ratio
+from metrics_tpu.functional.image.rase import relative_average_spectral_error
+from metrics_tpu.functional.image.rmse_sw import root_mean_squared_error_using_sliding_window
+from metrics_tpu.functional.image.sam import spectral_angle_mapper
+from metrics_tpu.functional.image.ssim import (
+    multiscale_structural_similarity_index_measure,
+    structural_similarity_index_measure,
+)
+from metrics_tpu.functional.image.tv import total_variation
+from metrics_tpu.functional.image.uqi import universal_image_quality_index
+
+__all__ = [
+    "error_relative_global_dimensionless_synthesis",
+    "image_gradients",
+    "multiscale_structural_similarity_index_measure",
+    "peak_signal_noise_ratio",
+    "relative_average_spectral_error",
+    "root_mean_squared_error_using_sliding_window",
+    "spectral_angle_mapper",
+    "spectral_distortion_index",
+    "structural_similarity_index_measure",
+    "total_variation",
+    "universal_image_quality_index",
+]

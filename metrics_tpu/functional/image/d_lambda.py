@@ -1,0 +1,40 @@
+"""Spectral distortion index functional (reference: functional/image/d_lambda.py:22-100)."""
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.image.uqi import universal_image_quality_index
+from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.distributed import reduce
+
+
+def spectral_distortion_index(
+    preds: Array, target: Array, p: int = 1, reduction: Optional[str] = "elementwise_mean"
+) -> Array:
+    """D_lambda: spectral distortion between fused and low-res multispectral bands."""
+    _check_same_shape(preds, target)
+    if len(preds.shape) != 4:
+        raise ValueError(f"Expected `preds` and `target` to have BxCxHxW shape. Got preds: {preds.shape}.")
+    if not isinstance(p, int) or p <= 0:
+        raise ValueError(f"Expected `p` to be a positive integer. Got p: {p}.")
+    preds = jnp.asarray(preds, jnp.float32)
+    target = jnp.asarray(target, jnp.float32)
+    length = preds.shape[1]
+
+    m1 = jnp.zeros((length, length))
+    m2 = jnp.zeros((length, length))
+    for k in range(length):
+        for r in range(k, length):
+            q1 = universal_image_quality_index(target[:, k : k + 1], target[:, r : r + 1])
+            q2 = universal_image_quality_index(preds[:, k : k + 1], preds[:, r : r + 1])
+            m1 = m1.at[k, r].set(q1)
+            m2 = m2.at[k, r].set(q2)
+            m1 = m1.at[r, k].set(q1)
+            m2 = m2.at[r, k].set(q2)
+
+    diff = jnp.abs(m1 - m2) ** p
+    # only off-diagonal terms
+    mask = 1.0 - jnp.eye(length)
+    output = (diff * mask).sum() / (length * (length - 1))
+    return output ** (1.0 / p)

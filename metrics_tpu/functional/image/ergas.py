@@ -1,0 +1,37 @@
+"""ERGAS functional (reference: functional/image/ergas.py:22-100)."""
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.distributed import reduce
+
+
+def _ergas_update(preds: Array, target: Array):
+    _check_same_shape(preds, target)
+    if len(preds.shape) != 4:
+        raise ValueError(f"Expected `preds` and `target` to have BxCxHxW shape. Got preds: {preds.shape}.")
+    return jnp.asarray(preds, jnp.float32), jnp.asarray(target, jnp.float32)
+
+
+def _ergas_compute(preds: Array, target: Array, ratio: float = 4, reduction: Optional[str] = "elementwise_mean") -> Array:
+    b, c, h, w = preds.shape
+    preds = preds.reshape(b, c, h * w)
+    target = target.reshape(b, c, h * w)
+
+    diff = preds - target
+    sum_squared_error = jnp.sum(diff * diff, axis=2)
+    rmse_per_band = jnp.sqrt(sum_squared_error / (h * w))
+    mean_target = jnp.mean(target, axis=2)
+
+    ergas_score = 100 * ratio * jnp.sqrt(jnp.sum((rmse_per_band / mean_target) ** 2, axis=1) / c)
+    return reduce(ergas_score, reduction)
+
+
+def error_relative_global_dimensionless_synthesis(
+    preds: Array, target: Array, ratio: float = 4, reduction: Optional[str] = "elementwise_mean"
+) -> Array:
+    """ERGAS."""
+    preds, target = _ergas_update(preds, target)
+    return _ergas_compute(preds, target, ratio, reduction)

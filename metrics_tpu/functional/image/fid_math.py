@@ -1,0 +1,36 @@
+"""Frechet distance math.
+
+Reference ``image/fid.py:160-179`` computes ``tr(sqrtm(S1 @ S2))`` with scipy-style
+eigvals of the (non-symmetric) product. TPU-first redesign (SURVEY.md SS7 hard part c):
+use the symmetric form tr(sqrtm(S1)^T S2 sqrtm(S1)) via two Hermitian ``eigh``
+factorizations — numerically stable on accelerator linear algebra and differentiable.
+Run under ``jax_enable_x64`` for float64 parity with the reference (it requires f64,
+image/fid.py:201-203); in f32 expect ~1e-4 relative drift on ill-conditioned covs.
+"""
+import jax.numpy as jnp
+from jax import Array
+
+
+def _sqrtm_psd(mat: Array) -> Array:
+    """Matrix square root of a symmetric PSD matrix via eigh (clamped eigenvalues)."""
+    vals, vecs = jnp.linalg.eigh(mat)
+    vals = jnp.clip(vals, 0.0, None)
+    return (vecs * jnp.sqrt(vals)) @ vecs.T
+
+
+def _compute_fid(mu1: Array, sigma1: Array, mu2: Array, sigma2: Array) -> Array:
+    """Frechet distance between two multivariate normals (reference: image/fid.py:160-179)."""
+    diff = mu1 - mu2
+    s1_half = _sqrtm_psd(sigma1)
+    inner = s1_half @ sigma2 @ s1_half
+    vals = jnp.linalg.eigvalsh(inner)
+    tr_covmean = jnp.sqrt(jnp.clip(vals, 0.0, None)).sum()
+    return diff @ diff + jnp.trace(sigma1) + jnp.trace(sigma2) - 2 * tr_covmean
+
+
+def _mean_cov_from_sums(feat_sum: Array, feat_cov_sum: Array, n: Array):
+    """(sum x, sum x x^T, n) -> (mean, unbiased covariance); reference image/fid.py:341-353."""
+    mean = (feat_sum / n)[None, :]
+    cov_num = feat_cov_sum - n * mean.T @ mean
+    cov = cov_num / (n - 1)
+    return mean.squeeze(0), cov

@@ -1,0 +1,29 @@
+"""Image gradients functional (reference: functional/image/gradients.py)."""
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+
+def image_gradients(img: Array) -> Tuple[Array, Array]:
+    """(dy, dx) first differences, zero-padded at the far edge.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional.image import image_gradients
+        >>> img = jnp.arange(16.0).reshape(1, 1, 4, 4)
+        >>> dy, dx = image_gradients(img)
+        >>> dy[0, 0, :, :]
+        Array([[4., 4., 4., 4.],
+               [4., 4., 4., 4.],
+               [4., 4., 4., 4.],
+               [0., 0., 0., 0.]], dtype=float32)
+    """
+    if img.ndim != 4:
+        raise RuntimeError(f"The size of the image tensor {img.shape} is not 4-dimensional")
+    img = jnp.asarray(img, jnp.float32)
+    dy = img[..., 1:, :] - img[..., :-1, :]
+    dx = img[..., :, 1:] - img[..., :, :-1]
+    dy = jnp.pad(dy, ((0, 0), (0, 0), (0, 1), (0, 0)))
+    dx = jnp.pad(dx, ((0, 0), (0, 0), (0, 0), (0, 1)))
+    return dy, dx

@@ -1,0 +1,86 @@
+"""Image kernel helpers.
+
+Capability parity with reference ``functional/image/helper.py`` (gaussian/uniform
+kernels) re-expressed on ``lax.conv_general_dilated``: depthwise (grouped) convs use
+``feature_group_count`` and lower straight onto the TPU convolution units.
+"""
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+
+def _gaussian(kernel_size: int, sigma: float, dtype=jnp.float32) -> Array:
+    """1-D gaussian window, normalized (reference: helper.py:11)."""
+    dist = jnp.arange((1 - kernel_size) / 2, (1 + kernel_size) / 2, 1.0, dtype=dtype)
+    gauss = jnp.exp(-((dist / sigma) ** 2) / 2)
+    return (gauss / gauss.sum())[None, :]  # (1, kernel_size)
+
+
+def _gaussian_kernel_2d(channel: int, kernel_size: Sequence[int], sigma: Sequence[float], dtype=jnp.float32) -> Array:
+    """(C, 1, kh, kw) depthwise gaussian kernel (reference: helper.py:29)."""
+    kx = _gaussian(kernel_size[0], sigma[0], dtype)
+    ky = _gaussian(kernel_size[1], sigma[1], dtype)
+    kernel = kx.T @ ky  # (kh, kw)
+    return jnp.broadcast_to(kernel, (channel, 1, kernel_size[0], kernel_size[1]))
+
+
+def _gaussian_kernel_3d(
+    channel: int, kernel_size: Sequence[int], sigma: Sequence[float], dtype=jnp.float32
+) -> Array:
+    """(C, 1, kd, kh, kw) depthwise gaussian kernel (reference: helper.py:~80)."""
+    k2d = _gaussian_kernel_2d(channel, kernel_size[:2], sigma[:2], dtype)[0, 0]
+    kz = _gaussian(kernel_size[2], sigma[2], dtype)[0]
+    kernel = k2d[:, :, None] * kz[None, None, :]
+    return jnp.broadcast_to(kernel, (channel, 1, *kernel.shape))
+
+
+def _depthwise_conv2d(x: Array, kernel: Array) -> Array:
+    """Grouped (per-channel) VALID conv: x (N,C,H,W), kernel (C,1,kh,kw)."""
+    return jax.lax.conv_general_dilated(
+        x,
+        kernel,
+        window_strides=(1, 1),
+        padding="VALID",
+        feature_group_count=x.shape[1],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )
+
+
+def _depthwise_conv3d(x: Array, kernel: Array) -> Array:
+    return jax.lax.conv_general_dilated(
+        x,
+        kernel,
+        window_strides=(1, 1, 1),
+        padding="VALID",
+        feature_group_count=x.shape[1],
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+    )
+
+
+def _reflection_pad_2d(x: Array, pad_h: int, pad_w: int) -> Array:
+    return jnp.pad(x, ((0, 0), (0, 0), (pad_h, pad_h), (pad_w, pad_w)), mode="reflect")
+
+
+def _reflection_pad_3d(x: Array, pad_d: int, pad_w: int, pad_h: int) -> Array:
+    return jnp.pad(x, ((0, 0), (0, 0), (pad_h, pad_h), (pad_w, pad_w), (pad_d, pad_d)), mode="reflect")
+
+
+def _avg_pool(x: Array, window: Tuple[int, ...]) -> Array:
+    """Average pooling with stride == window (reference uses F.avg_pool2d/3d)."""
+    nd = len(window)
+    dims = (1, 1) + window
+    out = jax.lax.reduce_window(x, 0.0, jax.lax.add, dims, dims, "VALID")
+    return out / jnp.prod(jnp.asarray(window))
+
+
+def _uniform_filter(x: Array, window_size: int) -> Array:
+    """Same-size depthwise mean filter with symmetric padding (reference: helper.py:110,
+    whose custom pad takes the first/last rows reversed = numpy 'symmetric')."""
+    left = window_size // 2
+    right = window_size - 1 - left
+    x = jnp.pad(x, ((0, 0), (0, 0), (left, right), (left, right)), mode="symmetric")
+    c = x.shape[1]
+    kernel = jnp.ones((c, 1, window_size, window_size), x.dtype) / (window_size**2)
+    return _depthwise_conv2d(x, kernel)

@@ -1,0 +1,72 @@
+"""PSNR functional (reference: functional/image/psnr.py:20-140)."""
+from typing import Optional, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.distributed import reduce
+
+
+def _psnr_compute(
+    sum_squared_error: Array,
+    n_obs: Array,
+    data_range: Array,
+    base: float = 10.0,
+    reduction: Optional[str] = "elementwise_mean",
+) -> Array:
+    psnr_base_e = 2 * jnp.log(data_range) - jnp.log(sum_squared_error / n_obs)
+    psnr_vals = psnr_base_e * (10 / jnp.log(jnp.asarray(base)))
+    return reduce(psnr_vals, reduction=reduction)
+
+
+def _psnr_update(preds: Array, target: Array, dim=None) -> Tuple[Array, Array]:
+    preds = jnp.asarray(preds, jnp.float32)
+    target = jnp.asarray(target, jnp.float32)
+    if dim is None:
+        sum_squared_error = jnp.sum((preds - target) ** 2)
+        return sum_squared_error, jnp.asarray(target.size)
+    diff = preds - target
+    sum_squared_error = jnp.sum(diff * diff, axis=dim)
+    dim_list = [dim] if isinstance(dim, int) else list(dim)
+    n_obs = 1
+    for d in dim_list:
+        n_obs *= target.shape[d]
+    return sum_squared_error, jnp.asarray(n_obs)
+
+
+def peak_signal_noise_ratio(
+    preds: Array,
+    target: Array,
+    data_range: Optional[Union[float, Tuple[float, float]]] = None,
+    base: float = 10.0,
+    reduction: Optional[str] = "elementwise_mean",
+    dim: Optional[Union[int, Tuple[int, ...]]] = None,
+) -> Array:
+    """PSNR.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional.image import peak_signal_noise_ratio
+        >>> pred = jnp.array([[0.0, 1.0], [2.0, 3.0]])
+        >>> target = jnp.array([[3.0, 2.0], [1.0, 0.0]])
+        >>> peak_signal_noise_ratio(pred, target)
+        Array(2.552725, dtype=float32)
+    """
+    if dim is None and reduction != "elementwise_mean":
+        from metrics_tpu.utils.prints import rank_zero_warn
+
+        rank_zero_warn(f"The `reduction={reduction}` will not have any effect when `dim` is None.")
+    _check_same_shape(preds, target)
+    if data_range is None:
+        if dim is not None:
+            raise ValueError("The `data_range` must be given when `dim` is not None.")
+        data_range = jnp.asarray(target.max() - target.min(), jnp.float32)
+    elif isinstance(data_range, tuple):
+        preds = jnp.clip(preds, data_range[0], data_range[1])
+        target = jnp.clip(target, data_range[0], data_range[1])
+        data_range = jnp.asarray(data_range[1] - data_range[0], jnp.float32)
+    else:
+        data_range = jnp.asarray(float(data_range))
+    sum_squared_error, n_obs = _psnr_update(preds, target, dim=dim)
+    return _psnr_compute(sum_squared_error, n_obs, data_range, base=base, reduction=reduction)

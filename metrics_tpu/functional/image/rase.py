@@ -1,0 +1,47 @@
+"""RASE functional (reference: functional/image/rase.py:20-100)."""
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.image.helper import _uniform_filter
+from metrics_tpu.functional.image.rmse_sw import _rmse_sw_compute, _rmse_sw_update
+
+
+def _rase_update(
+    preds: Array,
+    target: Array,
+    window_size: int,
+    rmse_map: Optional[Array],
+    target_sum: Optional[Array],
+    total_images: Optional[Array],
+) -> Tuple[Array, Array, Array]:
+    """Reference: :24-45 (the /window_size**2 rescale of the already-averaged uniform
+    filter mirrors the reference exactly)."""
+    _, rmse_map, total_images = _rmse_sw_update(
+        preds, target, window_size, rmse_val_sum=None, rmse_map=rmse_map, total_images=total_images
+    )
+    target = jnp.asarray(target, jnp.float32)
+    inc = jnp.sum(_uniform_filter(target, window_size) / (window_size**2), axis=0)
+    target_sum = target_sum + inc if target_sum is not None else inc
+    return rmse_map, target_sum, total_images
+
+
+def _rase_compute(rmse_map: Array, target_sum: Array, total_images: Array, window_size: int) -> Array:
+    """Reference: :48-66."""
+    _, rmse_map = _rmse_sw_compute(rmse_val_sum=None, rmse_map=rmse_map, total_images=total_images)
+    target_mean = target_sum / total_images
+    target_mean = target_mean.mean(0)  # mean over channels
+    rase_map = 100 / target_mean * jnp.sqrt(jnp.mean(rmse_map**2, axis=0))
+    crop_slide = round(window_size / 2)
+    return jnp.mean(rase_map[crop_slide:-crop_slide, crop_slide:-crop_slide])
+
+
+def relative_average_spectral_error(preds: Array, target: Array, window_size: int = 8) -> Array:
+    """RASE (reference: :69-100)."""
+    if not isinstance(window_size, int) or window_size < 1:
+        raise ValueError(f"Argument `window_size` is expected to be a positive integer, but got {window_size}")
+    rmse_map, target_sum, total_images = _rase_update(
+        preds, target, window_size, rmse_map=None, target_sum=None, total_images=None
+    )
+    return _rase_compute(rmse_map, target_sum, total_images, window_size)

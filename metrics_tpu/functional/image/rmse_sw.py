@@ -1,0 +1,63 @@
+"""Sliding-window RMSE functional (reference: functional/image/rmse_sw.py:22-130)."""
+from typing import Optional, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.image.helper import _uniform_filter
+from metrics_tpu.utils.checks import _check_same_shape
+
+
+def _rmse_sw_update(
+    preds: Array,
+    target: Array,
+    window_size: int,
+    rmse_val_sum: Optional[Array],
+    rmse_map: Optional[Array],
+    total_images: Optional[Array],
+) -> Tuple[Array, Array, Array]:
+    """Accumulate per-window RMSE (reference: :26-85)."""
+    _check_same_shape(preds, target)
+    if len(preds.shape) != 4:
+        raise ValueError(f"Expected `preds` and `target` to have BxCxHxW shape. But got {preds.shape}.")
+    if round(window_size / 2) >= target.shape[2] or round(window_size / 2) >= target.shape[3]:
+        raise ValueError(
+            f"Parameter `round(window_size / 2)` is expected to be smaller than"
+            f" {min(target.shape[2], target.shape[3])} but got {round(window_size / 2)}."
+        )
+    preds = jnp.asarray(preds, jnp.float32)
+    target = jnp.asarray(target, jnp.float32)
+
+    total_images = (total_images if total_images is not None else 0) + target.shape[0]
+    error = (target - preds) ** 2
+    error = _uniform_filter(error, window_size)
+    _rmse_map = jnp.sqrt(error)
+    crop_slide = round(window_size / 2)
+
+    val = _rmse_map[:, :, crop_slide:-crop_slide, crop_slide:-crop_slide].sum(0).mean()
+    rmse_val_sum = (rmse_val_sum + val) if rmse_val_sum is not None else val
+    rmse_map = (rmse_map + _rmse_map.sum(0)) if rmse_map is not None else _rmse_map.sum(0)
+    return rmse_val_sum, rmse_map, jnp.asarray(total_images)
+
+
+def _rmse_sw_compute(
+    rmse_val_sum: Optional[Array], rmse_map: Array, total_images: Array
+) -> Tuple[Optional[Array], Array]:
+    rmse = rmse_val_sum / total_images if rmse_val_sum is not None else None
+    rmse_map = rmse_map / total_images
+    return rmse, rmse_map
+
+
+def root_mean_squared_error_using_sliding_window(
+    preds: Array, target: Array, window_size: int = 8, return_rmse_map: bool = False
+) -> Union[Optional[Array], Tuple[Optional[Array], Array]]:
+    """RMSE over sliding windows (reference: :107-130)."""
+    if not isinstance(window_size, int) or window_size < 1:
+        raise ValueError("Argument `window_size` is expected to be a positive integer.")
+    rmse_val_sum, rmse_map, total_images = _rmse_sw_update(
+        preds, target, window_size, rmse_val_sum=None, rmse_map=None, total_images=None
+    )
+    rmse, rmse_map = _rmse_sw_compute(rmse_val_sum, rmse_map, total_images)
+    if return_rmse_map:
+        return rmse, rmse_map
+    return rmse

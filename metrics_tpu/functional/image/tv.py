@@ -1,0 +1,39 @@
+"""Total variation functional (reference: functional/image/tv.py:20-70)."""
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+
+def _total_variation_update(img: Array) -> Tuple[Array, int]:
+    if img.ndim != 4:
+        raise RuntimeError(f"Expected input `img` to be an 4D tensor, but got {img.shape}")
+    diff1 = img[..., 1:, :] - img[..., :-1, :]
+    diff2 = img[..., :, 1:] - img[..., :, :-1]
+    res1 = jnp.abs(diff1).sum((1, 2, 3))
+    res2 = jnp.abs(diff2).sum((1, 2, 3))
+    return res1 + res2, img.shape[0]
+
+
+def _total_variation_compute(score: Array, num_elements: int, reduction: Optional[str]) -> Array:
+    if reduction == "mean":
+        return score.sum() / num_elements
+    if reduction == "sum":
+        return score.sum()
+    if reduction is None or reduction == "none":
+        return score
+    raise ValueError("Expected argument `reduction` to either be 'sum', 'mean', 'none' or None")
+
+
+def total_variation(img: Array, reduction: Optional[str] = "sum") -> Array:
+    """Total variation of an image batch.
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from metrics_tpu.functional.image import total_variation
+        >>> img = jnp.arange(16.0).reshape(1, 1, 4, 4)
+        >>> total_variation(img)
+        Array(60., dtype=float32)
+    """
+    score, num_elements = _total_variation_update(jnp.asarray(img, jnp.float32))
+    return _total_variation_compute(score, num_elements, reduction)

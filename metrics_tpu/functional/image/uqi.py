@@ -1,0 +1,68 @@
+"""Universal Image Quality Index (reference: functional/image/uqi.py:30-140).
+
+UQI = SSIM without the stabilization constants (c1 = c2 = 0).
+"""
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.image.helper import _depthwise_conv2d, _gaussian_kernel_2d, _reflection_pad_2d
+from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.distributed import reduce
+
+
+def universal_image_quality_index(
+    preds: Array,
+    target: Array,
+    kernel_size: Sequence[int] = (11, 11),
+    sigma: Sequence[float] = (1.5, 1.5),
+    reduction: Optional[str] = "elementwise_mean",
+) -> Array:
+    """UQI.
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(0), (1, 1, 16, 16))
+        >>> target = preds * 0.75
+        >>> from metrics_tpu.functional.image import universal_image_quality_index
+        >>> bool(universal_image_quality_index(preds, target) > 0.9)
+        True
+    """
+    _check_same_shape(preds, target)
+    if len(preds.shape) != 4:
+        raise ValueError(f"Expected `preds` and `target` to have BxCxHxW shape. Got preds: {preds.shape}.")
+    if not isinstance(kernel_size, Sequence) or len(kernel_size) != 2:
+        raise ValueError(f"Expected `kernel_size` to be a sequence of length 2. Got {kernel_size}.")
+    if any(x % 2 == 0 or x <= 0 for x in kernel_size):
+        raise ValueError(f"Expected `kernel_size` to have odd positive number. Got {kernel_size}.")
+    preds = jnp.asarray(preds, jnp.float32)
+    target = jnp.asarray(target, jnp.float32)
+
+    channel = preds.shape[1]
+    kernel = _gaussian_kernel_2d(channel, kernel_size, sigma)
+    pad_h = (kernel_size[0] - 1) // 2
+    pad_w = (kernel_size[1] - 1) // 2
+
+    preds = _reflection_pad_2d(preds, pad_h, pad_w)
+    target = _reflection_pad_2d(target, pad_h, pad_w)
+
+    input_list = jnp.concatenate((preds, target, preds * preds, target * target, preds * target))
+    outputs = _depthwise_conv2d(input_list, kernel)
+    b = preds.shape[0]
+    output_list = [outputs[i * b : (i + 1) * b] for i in range(5)]
+
+    mu_pred_sq = output_list[0] ** 2
+    mu_target_sq = output_list[1] ** 2
+    mu_pred_target = output_list[0] * output_list[1]
+
+    sigma_pred_sq = output_list[2] - mu_pred_sq
+    sigma_target_sq = output_list[3] - mu_target_sq
+    sigma_pred_target = output_list[4] - mu_pred_target
+
+    upper = 2 * sigma_pred_target
+    lower = sigma_pred_sq + sigma_target_sq
+    eps = jnp.finfo(jnp.float32).eps
+    uqi_idx = ((2 * mu_pred_target) * upper) / ((mu_pred_sq + mu_target_sq) * lower + eps)
+    uqi_idx = uqi_idx[..., pad_h:-pad_h, pad_w:-pad_w]
+    return reduce(uqi_idx.reshape(uqi_idx.shape[0], -1).mean(-1), reduction)

@@ -1,0 +1,31 @@
+from metrics_tpu.image.d_lambda import SpectralDistortionIndex
+from metrics_tpu.image.ergas import ErrorRelativeGlobalDimensionlessSynthesis
+from metrics_tpu.image.fid import FrechetInceptionDistance
+from metrics_tpu.image.inception import InceptionScore
+from metrics_tpu.image.kid import KernelInceptionDistance
+from metrics_tpu.image.psnr import PeakSignalNoiseRatio
+from metrics_tpu.image.rase import RelativeAverageSpectralError
+from metrics_tpu.image.rmse_sw import RootMeanSquaredErrorUsingSlidingWindow
+from metrics_tpu.image.sam import SpectralAngleMapper
+from metrics_tpu.image.ssim import (
+    MultiScaleStructuralSimilarityIndexMeasure,
+    StructuralSimilarityIndexMeasure,
+)
+from metrics_tpu.image.tv import TotalVariation
+from metrics_tpu.image.uqi import UniversalImageQualityIndex
+
+__all__ = [
+    "ErrorRelativeGlobalDimensionlessSynthesis",
+    "FrechetInceptionDistance",
+    "InceptionScore",
+    "KernelInceptionDistance",
+    "MultiScaleStructuralSimilarityIndexMeasure",
+    "PeakSignalNoiseRatio",
+    "RelativeAverageSpectralError",
+    "RootMeanSquaredErrorUsingSlidingWindow",
+    "SpectralAngleMapper",
+    "SpectralDistortionIndex",
+    "StructuralSimilarityIndexMeasure",
+    "TotalVariation",
+    "UniversalImageQualityIndex",
+]

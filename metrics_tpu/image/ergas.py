@@ -1,0 +1,33 @@
+"""ErrorRelativeGlobalDimensionlessSynthesis (reference: image/ergas.py:31-120)."""
+from typing import Any, Optional
+
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.image.ergas import _ergas_compute, _ergas_update
+from metrics_tpu.utils.data import dim_zero_cat
+
+
+class ErrorRelativeGlobalDimensionlessSynthesis(Metric):
+    """ERGAS for pan-sharpened images."""
+
+    is_differentiable: bool = True
+    higher_is_better: bool = False
+    full_state_update: bool = False
+
+    def __init__(self, ratio: float = 4, reduction: Optional[str] = "elementwise_mean", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.ratio = ratio
+        self.reduction = reduction
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+        self.add_state("target", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        preds, target = _ergas_update(preds, target)
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def compute(self) -> Array:
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        return _ergas_compute(preds, target, self.ratio, self.reduction)

@@ -1,0 +1,75 @@
+"""InceptionScore (reference: image/inception.py:34-160)."""
+from typing import Any, Callable, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.utils.data import dim_zero_cat
+
+
+class InceptionScore(Metric):
+    """IS: exp(E_x KL(p(y|x) || p(y))) over logits (reference: image/inception.py:34).
+
+    ``feature`` accepts a callable producing class logits per image, or the string
+    'logits_unbiased' / int layer for the pretrained InceptionV3 (weights file needed).
+    """
+
+    higher_is_better: bool = True
+    is_differentiable: bool = False
+    full_state_update: bool = False
+
+    def __init__(
+        self,
+        feature: Union[str, int, Callable] = "logits_unbiased",
+        splits: int = 10,
+        normalize: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+
+        if isinstance(feature, (str, int)):
+            from metrics_tpu.models.inception import load_inception_feature_extractor
+
+            self.inception, _ = load_inception_feature_extractor(feature)
+        elif callable(feature):
+            self.inception = feature
+        else:
+            raise TypeError("Got unknown input to argument `feature`")
+
+        if not (isinstance(splits, int) and splits > 0):
+            raise ValueError("Expected argument `splits` to be an integer larger than 0")
+        self.splits = splits
+        if not isinstance(normalize, bool):
+            raise ValueError("Argument `normalize` expected to be a bool")
+        self.normalize = normalize
+
+        self.add_state("features", [], dist_reduce_fx="cat")
+
+    def update(self, imgs: Array) -> None:
+        imgs = (imgs * 255).astype(jnp.uint8) if self.normalize else imgs
+        features = jnp.asarray(self.inception(imgs))
+        self.features.append(features)
+
+    def compute(self) -> Tuple[Array, Array]:
+        """(IS mean, IS std) over splits (reference: image/inception.py:140-158)."""
+        features = dim_zero_cat(self.features)
+        # random permutation of the features (reference uses torch.randperm)
+        idx = np.random.permutation(features.shape[0])
+        features = features[idx]
+
+        prob = jax.nn.softmax(features, axis=1)
+        log_prob = jax.nn.log_softmax(features, axis=1)
+
+        prob_chunks = jnp.array_split(prob, self.splits, axis=0)
+        log_prob_chunks = jnp.array_split(log_prob, self.splits, axis=0)
+
+        kl_ = []
+        for p, log_p in zip(prob_chunks, log_prob_chunks):
+            mean_prob = p.mean(axis=0, keepdims=True)
+            kl = p * (log_p - jnp.log(mean_prob))
+            kl_.append(kl.sum(axis=1).mean())
+        kl = jnp.stack(kl_)
+        return kl.mean(), kl.std(ddof=1)

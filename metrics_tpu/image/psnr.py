@@ -1,0 +1,93 @@
+"""PeakSignalNoiseRatio (reference: image/psnr.py:31-160)."""
+from typing import Any, Optional, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.image.psnr import _psnr_compute, _psnr_update
+from metrics_tpu.utils.data import dim_zero_cat
+
+
+class PeakSignalNoiseRatio(Metric):
+    """PSNR.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.image import PeakSignalNoiseRatio
+        >>> psnr = PeakSignalNoiseRatio()
+        >>> preds = jnp.array([[0.0, 1.0], [2.0, 3.0]])
+        >>> target = jnp.array([[3.0, 2.0], [1.0, 0.0]])
+        >>> psnr(preds, target)
+        Array(2.552725, dtype=float32)
+    """
+
+    is_differentiable: bool = True
+    higher_is_better: bool = True
+    full_state_update: bool = False
+
+    def __init__(
+        self,
+        data_range: Optional[Union[float, Tuple[float, float]]] = None,
+        base: float = 10.0,
+        reduction: Optional[str] = "elementwise_mean",
+        dim: Optional[Union[int, Tuple[int, ...]]] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if dim is None and reduction != "elementwise_mean":
+            from metrics_tpu.utils.prints import rank_zero_warn
+
+            rank_zero_warn(f"The `reduction={reduction}` will not have any effect when `dim` is None.")
+
+        if dim is None:
+            self.add_state("sum_squared_error", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+            self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+        else:
+            self.add_state("sum_squared_error", default=[], dist_reduce_fx="cat")
+            self.add_state("total", default=[], dist_reduce_fx="cat")
+
+        self.clamping_fn = None
+        if data_range is None:
+            if dim is not None:
+                raise ValueError("The `data_range` must be given when `dim` is not None.")
+            self.data_range = None
+            self.add_state("min_target", default=jnp.asarray(0.0), dist_reduce_fx="min")
+            self.add_state("max_target", default=jnp.asarray(0.0), dist_reduce_fx="max")
+        elif isinstance(data_range, tuple):
+            self.add_state("data_range", default=jnp.asarray(data_range[1] - data_range[0]), dist_reduce_fx="mean")
+            self.clamping_fn = lambda x: jnp.clip(x, data_range[0], data_range[1])
+        else:
+            self.add_state("data_range", default=jnp.asarray(float(data_range)), dist_reduce_fx="mean")
+        self.base = base
+        self.reduction = reduction
+        self.dim = tuple(dim) if isinstance(dim, (list, tuple)) else dim
+
+    def update(self, preds: Array, target: Array) -> None:
+        preds = jnp.asarray(preds, jnp.float32)
+        target = jnp.asarray(target, jnp.float32)
+        if self.clamping_fn is not None:
+            preds = self.clamping_fn(preds)
+            target = self.clamping_fn(target)
+
+        sum_squared_error, n_obs = _psnr_update(preds, target, dim=self.dim)
+        if self.dim is None:
+            if self.data_range is None:
+                # keep track of min and max target values
+                self.min_target = jnp.minimum(target.min(), self.min_target)
+                self.max_target = jnp.maximum(target.max(), self.max_target)
+            self.sum_squared_error = self.sum_squared_error + sum_squared_error
+            self.total = self.total + n_obs
+        else:
+            self.sum_squared_error.append(sum_squared_error)
+            self.total.append(jnp.broadcast_to(n_obs, sum_squared_error.shape))
+
+    def compute(self) -> Array:
+        data_range = self.data_range if self.data_range is not None else self.max_target - self.min_target
+        if self.dim is None:
+            sum_squared_error = self.sum_squared_error
+            total = self.total
+        else:
+            sum_squared_error = dim_zero_cat(self.sum_squared_error)
+            total = dim_zero_cat(self.total)
+        return _psnr_compute(sum_squared_error, total, data_range, base=self.base, reduction=self.reduction)

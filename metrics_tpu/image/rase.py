@@ -1,0 +1,45 @@
+"""RelativeAverageSpectralError (reference: image/rase.py:30-110)."""
+from typing import Any
+
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.image.rase import _rase_compute, _rase_update
+
+
+class RelativeAverageSpectralError(Metric):
+    """RASE with streaming sliding-window state."""
+
+    is_differentiable: bool = True
+    higher_is_better: bool = False
+    full_state_update: bool = False
+
+    def __init__(self, window_size: int = 8, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(window_size, int) or window_size < 1:
+            raise ValueError(f"Argument `window_size` is expected to be a positive integer, but got {window_size}")
+        self.window_size = window_size
+        # map-shaped states are lazily initialized on the first update
+        self._initialized = False
+        import jax.numpy as jnp
+
+        self.add_state("rmse_map", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("target_sum", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total_images", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        rmse_map = None if not self._initialized else self.rmse_map
+        target_sum = None if not self._initialized else self.target_sum
+        total = None if not self._initialized else self.total_images
+        rmse_map, target_sum, total_images = _rase_update(
+            preds, target, self.window_size, rmse_map, target_sum, total
+        )
+        self.rmse_map, self.target_sum, self.total_images = rmse_map, target_sum, total_images
+        self._initialized = True
+
+    def compute(self) -> Array:
+        return _rase_compute(self.rmse_map, self.target_sum, self.total_images, self.window_size)
+
+    def reset(self) -> None:
+        super().reset()
+        self._initialized = False

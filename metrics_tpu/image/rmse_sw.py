@@ -1,0 +1,46 @@
+"""RootMeanSquaredErrorUsingSlidingWindow (reference: image/rmse_sw.py:29-110)."""
+from typing import Any, Optional
+
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.image.rmse_sw import _rmse_sw_compute, _rmse_sw_update
+
+
+class RootMeanSquaredErrorUsingSlidingWindow(Metric):
+    """Sliding-window RMSE with streaming state."""
+
+    is_differentiable: bool = True
+    higher_is_better: bool = False
+    full_state_update: bool = False
+
+    def __init__(self, window_size: int = 8, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(window_size, int) or window_size < 1:
+            raise ValueError("Argument `window_size` is expected to be a positive integer.")
+        self.window_size = window_size
+        self._initialized = False
+        import jax.numpy as jnp
+
+        self.add_state("rmse_val_sum", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("rmse_map", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total_images", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        if not self._initialized:
+            rmse_val_sum, rmse_map, total = None, None, None
+        else:
+            rmse_val_sum, rmse_map, total = self.rmse_val_sum, self.rmse_map, self.total_images
+        rmse_val_sum, rmse_map, total_images = _rmse_sw_update(
+            preds, target, self.window_size, rmse_val_sum, rmse_map, total
+        )
+        self.rmse_val_sum, self.rmse_map, self.total_images = rmse_val_sum, rmse_map, total_images
+        self._initialized = True
+
+    def compute(self) -> Optional[Array]:
+        rmse, _ = _rmse_sw_compute(self.rmse_val_sum, self.rmse_map, self.total_images)
+        return rmse
+
+    def reset(self) -> None:
+        super().reset()
+        self._initialized = False

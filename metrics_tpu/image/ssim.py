@@ -1,0 +1,187 @@
+"""SSIM / MS-SSIM metric classes (reference: image/ssim.py:30-330)."""
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.image.ssim import (
+    _multiscale_ssim_compute,
+    _multiscale_ssim_update,
+    _ssim_check_inputs,
+    _ssim_compute,
+    _ssim_update,
+)
+from metrics_tpu.utils.data import dim_zero_cat
+
+
+class StructuralSimilarityIndexMeasure(Metric):
+    """SSIM (reference: image/ssim.py:30-215).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from metrics_tpu.image import StructuralSimilarityIndexMeasure
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(0), (3, 3, 32, 32))
+        >>> target = preds * 0.75
+        >>> ssim = StructuralSimilarityIndexMeasure(data_range=1.0)
+        >>> bool(ssim(preds, target) > 0.9)
+        True
+    """
+
+    higher_is_better: bool = True
+    is_differentiable: bool = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        gaussian_kernel: bool = True,
+        sigma: Union[float, Sequence[float]] = 1.5,
+        kernel_size: Union[int, Sequence[int]] = 11,
+        reduction: Optional[str] = "elementwise_mean",
+        data_range: Optional[Union[float, Tuple[float, float]]] = None,
+        k1: float = 0.01,
+        k2: float = 0.03,
+        return_full_image: bool = False,
+        return_contrast_sensitivity: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        valid_reduction = ("elementwise_mean", "sum", "none", None)
+        if reduction not in valid_reduction:
+            raise ValueError(f"Argument `reduction` must be one of {valid_reduction}, but got {reduction}")
+
+        if reduction in ("elementwise_mean", "sum"):
+            self.add_state("similarity", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        else:
+            self.add_state("similarity", default=[], dist_reduce_fx="cat")
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+        if return_contrast_sensitivity or return_full_image:
+            self.add_state("image_return", default=[], dist_reduce_fx="cat")
+
+        self.gaussian_kernel = gaussian_kernel
+        self.sigma = sigma
+        self.kernel_size = kernel_size
+        self.reduction = reduction
+        self.data_range = data_range
+        self.k1 = k1
+        self.k2 = k2
+        self.return_full_image = return_full_image
+        self.return_contrast_sensitivity = return_contrast_sensitivity
+
+    def update(self, preds: Array, target: Array) -> None:
+        preds, target = _ssim_check_inputs(preds, target)
+        similarity_pack = _ssim_update(
+            preds,
+            target,
+            self.gaussian_kernel,
+            self.sigma,
+            self.kernel_size,
+            self.data_range,
+            self.k1,
+            self.k2,
+            self.return_full_image,
+            self.return_contrast_sensitivity,
+        )
+        if isinstance(similarity_pack, tuple):
+            similarity, image = similarity_pack
+            self.image_return.append(image)
+        else:
+            similarity = similarity_pack
+
+        if self.reduction in ("elementwise_mean", "sum"):
+            self.similarity = self.similarity + similarity.sum()
+            self.total = self.total + preds.shape[0]
+        else:
+            self.similarity.append(similarity)
+
+    def compute(self):
+        if self.reduction == "elementwise_mean":
+            similarity = self.similarity / self.total
+        elif self.reduction == "sum":
+            similarity = self.similarity
+        else:
+            similarity = dim_zero_cat(self.similarity)
+        if self.return_contrast_sensitivity or self.return_full_image:
+            return similarity, dim_zero_cat(self.image_return)
+        return similarity
+
+
+class MultiScaleStructuralSimilarityIndexMeasure(Metric):
+    """MS-SSIM (reference: image/ssim.py:218-330).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from metrics_tpu.image import MultiScaleStructuralSimilarityIndexMeasure
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(42), (2, 1, 192, 192))
+        >>> target = preds * 0.75
+        >>> ms_ssim = MultiScaleStructuralSimilarityIndexMeasure(data_range=1.0)
+        >>> bool(ms_ssim(preds, target) > 0.9)
+        True
+    """
+
+    higher_is_better: bool = True
+    is_differentiable: bool = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        gaussian_kernel: bool = True,
+        kernel_size: Union[int, Sequence[int]] = 11,
+        sigma: Union[float, Sequence[float]] = 1.5,
+        reduction: Optional[str] = "elementwise_mean",
+        data_range: Optional[Union[float, Tuple[float, float]]] = None,
+        k1: float = 0.01,
+        k2: float = 0.03,
+        betas: Tuple[float, ...] = (0.0448, 0.2856, 0.3001, 0.2363, 0.1333),
+        normalize: Optional[str] = "relu",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        valid_reduction = ("elementwise_mean", "sum", "none", None)
+        if reduction not in valid_reduction:
+            raise ValueError(f"Argument `reduction` must be one of {valid_reduction}, but got {reduction}")
+        if reduction in ("elementwise_mean", "sum"):
+            self.add_state("similarity", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        else:
+            self.add_state("similarity", default=[], dist_reduce_fx="cat")
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+        if not (isinstance(kernel_size, (Sequence, int))):
+            raise ValueError("Argument `kernel_size` expected to be an sequence or an int")
+        self.gaussian_kernel = gaussian_kernel
+        self.sigma = sigma
+        self.kernel_size = kernel_size
+        self.reduction = reduction
+        self.data_range = data_range
+        self.k1 = k1
+        self.k2 = k2
+        if not isinstance(betas, tuple) or not all(isinstance(beta, float) for beta in betas):
+            raise ValueError("Argument `betas` is expected to be of a type tuple of floats")
+        self.betas = betas
+        if normalize and normalize not in ("relu", "simple"):
+            raise ValueError("Argument `normalize` to be expected either `None` or one of 'relu' or 'simple'")
+        self.normalize = normalize
+
+    def update(self, preds: Array, target: Array) -> None:
+        preds, target = _ssim_check_inputs(preds, target)
+        similarity = _multiscale_ssim_update(
+            preds, target, self.gaussian_kernel, self.sigma, self.kernel_size,
+            self.data_range, self.k1, self.k2, self.betas, self.normalize,
+        )
+        if self.reduction in ("elementwise_mean", "sum"):
+            self.similarity = self.similarity + similarity.sum()
+            self.total = self.total + preds.shape[0]
+        else:
+            self.similarity.append(similarity)
+
+    def compute(self) -> Array:
+        if self.reduction == "elementwise_mean":
+            return self.similarity / self.total
+        if self.reduction == "sum":
+            return self.similarity
+        return dim_zero_cat(self.similarity)

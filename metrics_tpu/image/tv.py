@@ -1,0 +1,52 @@
+"""TotalVariation (reference: image/tv.py:30-110)."""
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.image.tv import _total_variation_compute, _total_variation_update
+from metrics_tpu.utils.data import dim_zero_cat
+
+
+class TotalVariation(Metric):
+    """Total variation of image batches.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.image import TotalVariation
+        >>> tv = TotalVariation()
+        >>> img = jnp.arange(16.0).reshape(1, 1, 4, 4)
+        >>> tv(img)
+        Array(60., dtype=float32)
+    """
+
+    is_differentiable: bool = True
+    higher_is_better: bool = False
+    full_state_update: bool = False
+
+    def __init__(self, reduction: Optional[str] = "sum", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if reduction is not None and reduction not in ("sum", "mean", "none"):
+            raise ValueError("Expected argument `reduction` to either be 'sum', 'mean', 'none' or None")
+        self.reduction = reduction
+
+        if self.reduction is None or self.reduction == "none":
+            self.add_state("score_list", default=[], dist_reduce_fx="cat")
+        else:
+            self.add_state("score", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("num_elements", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, img: Array) -> None:
+        score, num_elements = _total_variation_update(jnp.asarray(img, jnp.float32))
+        if self.reduction is None or self.reduction == "none":
+            self.score_list.append(score)
+        else:
+            self.score = self.score + score.sum()
+        self.num_elements = self.num_elements + num_elements
+
+    def compute(self) -> Array:
+        if self.reduction is None or self.reduction == "none":
+            score = dim_zero_cat(self.score_list)
+            return score
+        return _total_variation_compute(self.score, self.num_elements, self.reduction)

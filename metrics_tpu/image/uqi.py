@@ -1,0 +1,40 @@
+"""UniversalImageQualityIndex (reference: image/uqi.py:30-120)."""
+from typing import Any, Optional, Sequence
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.image.uqi import universal_image_quality_index
+
+
+class UniversalImageQualityIndex(Metric):
+    """UQI over batches (per-image scores averaged)."""
+
+    is_differentiable: bool = True
+    higher_is_better: bool = True
+    full_state_update: bool = False
+
+    def __init__(
+        self,
+        kernel_size: Sequence[int] = (11, 11),
+        sigma: Sequence[float] = (1.5, 1.5),
+        reduction: Optional[str] = "elementwise_mean",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.kernel_size = kernel_size
+        self.sigma = sigma
+        self.reduction = reduction
+        self.add_state("score_sum", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        score = universal_image_quality_index(preds, target, self.kernel_size, self.sigma, reduction="sum")
+        self.score_sum = self.score_sum + score
+        self.total = self.total + preds.shape[0]
+
+    def compute(self) -> Array:
+        if self.reduction == "sum":
+            return self.score_sum
+        return self.score_sum / self.total

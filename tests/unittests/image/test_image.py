@@ -1,0 +1,243 @@
+"""Differential tests for the image domain vs numpy/scipy oracles.
+
+Mirrors reference tests/unittests/image/* coverage; SSIM oracle is an independent
+scipy.ndimage implementation of the Wang et al. algorithm.
+"""
+import numpy as np
+import pytest
+from scipy.ndimage import gaussian_filter
+
+from metrics_tpu.functional.image import (
+    error_relative_global_dimensionless_synthesis,
+    image_gradients,
+    multiscale_structural_similarity_index_measure,
+    peak_signal_noise_ratio,
+    relative_average_spectral_error,
+    root_mean_squared_error_using_sliding_window,
+    spectral_angle_mapper,
+    spectral_distortion_index,
+    structural_similarity_index_measure,
+    total_variation,
+    universal_image_quality_index,
+)
+from metrics_tpu.image import (
+    FrechetInceptionDistance,
+    InceptionScore,
+    KernelInceptionDistance,
+    PeakSignalNoiseRatio,
+    StructuralSimilarityIndexMeasure,
+    TotalVariation,
+)
+
+import sys, os
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+from helpers import seed_all  # noqa: E402
+
+seed_all(42)
+_rng = np.random.default_rng(3)
+_preds = _rng.random((4, 3, 32, 32)).astype(np.float32)
+_target = np.clip(_preds + 0.1 * _rng.normal(size=_preds.shape), 0, 1).astype(np.float32)
+
+
+def _np_ssim(x, y, data_range=1.0, sigma=1.5, k1=0.01, k2=0.03):
+    """Independent per-image SSIM oracle: gaussian window with edge-excluding
+    reflection (scipy 'mirror'), border cropped as in the reference (:165-167)."""
+    radius = int(3.5 * sigma + 0.5)
+    f = lambda im: gaussian_filter(im, sigma, mode="mirror", radius=radius, axes=(-2, -1))
+    c1, c2 = (k1 * data_range) ** 2, (k2 * data_range) ** 2
+    mu_x, mu_y = f(x), f(y)
+    sxx = f(x * x) - mu_x**2
+    syy = f(y * y) - mu_y**2
+    sxy = f(x * y) - mu_x * mu_y
+    ssim_map = ((2 * mu_x * mu_y + c1) * (2 * sxy + c2)) / ((mu_x**2 + mu_y**2 + c1) * (sxx + syy + c2))
+    ssim_map = ssim_map[..., radius:-radius, radius:-radius]
+    return ssim_map.mean(axis=(-3, -2, -1))
+
+
+class TestSSIM:
+    def test_vs_scipy_oracle(self):
+        res = structural_similarity_index_measure(_preds, _target, data_range=1.0, reduction="none")
+        expected = _np_ssim(_preds.astype(np.float64), _target.astype(np.float64))
+        np.testing.assert_allclose(np.asarray(res), expected, atol=2e-4)
+
+    def test_identical_images(self):
+        res = structural_similarity_index_measure(_preds, _preds, data_range=1.0)
+        np.testing.assert_allclose(float(res), 1.0, atol=1e-5)
+
+    def test_class_accumulation(self):
+        m = StructuralSimilarityIndexMeasure(data_range=1.0)
+        m.update(_preds[:2], _target[:2])
+        m.update(_preds[2:], _target[2:])
+        full = structural_similarity_index_measure(_preds, _target, data_range=1.0)
+        np.testing.assert_allclose(float(m.compute()), float(full), atol=1e-5)
+
+    def test_uniform_kernel(self):
+        res = structural_similarity_index_measure(
+            _preds, _target, data_range=1.0, gaussian_kernel=False, kernel_size=7
+        )
+        assert 0 < float(res) <= 1
+
+    def test_msssim(self):
+        big_p = _rng.random((2, 1, 192, 192)).astype(np.float32)
+        big_t = np.clip(big_p + 0.05 * _rng.normal(size=big_p.shape), 0, 1).astype(np.float32)
+        res = multiscale_structural_similarity_index_measure(big_p, big_t, data_range=1.0)
+        assert 0 < float(res) <= 1
+        res_same = multiscale_structural_similarity_index_measure(big_p, big_p, data_range=1.0)
+        np.testing.assert_allclose(float(res_same), 1.0, atol=1e-5)
+        assert float(res_same) >= float(res)
+
+
+class TestPSNR:
+    def test_vs_numpy(self):
+        mse = np.mean((_preds - _target) ** 2)
+        dr = _target.max() - _target.min()
+        expected = 10 * np.log10(dr**2 / mse)
+        res = peak_signal_noise_ratio(_preds, _target)
+        np.testing.assert_allclose(float(res), expected, rtol=1e-5)
+
+    def test_class_accumulation(self):
+        m = PeakSignalNoiseRatio(data_range=1.0)
+        m.update(_preds[:2], _target[:2])
+        m.update(_preds[2:], _target[2:])
+        mse = np.mean((_preds - _target) ** 2)
+        expected = 10 * np.log10(1.0 / mse)
+        np.testing.assert_allclose(float(m.compute()), expected, rtol=1e-5)
+
+    def test_dim(self):
+        res = peak_signal_noise_ratio(_preds, _target, data_range=1.0, dim=(1, 2, 3), reduction="none")
+        mse = np.mean((_preds - _target) ** 2, axis=(1, 2, 3))
+        expected = 10 * np.log10(1.0 / mse)
+        np.testing.assert_allclose(np.asarray(res), expected, rtol=1e-4)
+
+
+class TestSmallImageMetrics:
+    def test_total_variation(self):
+        img = _preds
+        dy = np.abs(np.diff(img, axis=2)).sum((1, 2, 3))
+        dx = np.abs(np.diff(img, axis=3)).sum((1, 2, 3))
+        expected = (dy + dx).sum()
+        np.testing.assert_allclose(float(total_variation(img)), expected, rtol=1e-4)
+        m = TotalVariation()
+        m.update(img[:2])
+        m.update(img[2:])
+        np.testing.assert_allclose(float(m.compute()), expected, rtol=1e-4)
+
+    def test_sam(self):
+        dot = (_preds * _target).sum(1)
+        denom = np.linalg.norm(_preds, axis=1) * np.linalg.norm(_target, axis=1)
+        expected = np.arccos(np.clip(dot / denom, -1, 1)).mean()
+        res = spectral_angle_mapper(_preds, _target)
+        np.testing.assert_allclose(float(res), expected, rtol=1e-4)
+
+    def test_ergas(self):
+        b, c, h, w = _preds.shape
+        p = _preds.reshape(b, c, -1)
+        t = _target.reshape(b, c, -1)
+        rmse = np.sqrt(((p - t) ** 2).sum(2) / (h * w))
+        expected = (100 * 4 * np.sqrt((((rmse / t.mean(2)) ** 2).sum(1)) / c)).mean()
+        res = error_relative_global_dimensionless_synthesis(_preds, _target)
+        np.testing.assert_allclose(float(res), expected, rtol=1e-4)
+
+    def test_uqi_identity(self):
+        res = universal_image_quality_index(_preds, _preds)
+        np.testing.assert_allclose(float(res), 1.0, atol=1e-4)
+
+    def test_rmse_sw(self):
+        res = root_mean_squared_error_using_sliding_window(_preds, _target, window_size=8)
+        assert 0 < float(res) < 1
+
+    def test_rase_runs(self):
+        res = relative_average_spectral_error(_preds, _target)
+        assert float(res) > 0
+
+    def test_d_lambda_identity(self):
+        res = spectral_distortion_index(_preds, _preds)
+        np.testing.assert_allclose(float(res), 0.0, atol=1e-5)
+
+    def test_image_gradients(self):
+        img = np.arange(16.0, dtype=np.float32).reshape(1, 1, 4, 4)
+        dy, dx = image_gradients(img)
+        assert float(dy[0, 0, 0, 0]) == 4.0
+        assert float(dx[0, 0, 0, 0]) == 1.0
+        assert float(dy[0, 0, -1, 0]) == 0.0
+
+
+class TestGenerativeMetrics:
+    def _extractor(self, imgs):
+        import jax.numpy as jnp
+
+        flat = imgs.reshape(imgs.shape[0], -1).astype(jnp.float32)
+        return flat[:, :8]
+
+    def test_fid_vs_scipy(self):
+        from scipy import linalg
+
+        feats_real = _rng.normal(size=(200, 8)).astype(np.float64)
+        feats_fake = (feats_real * 0.8 + 0.3 * _rng.normal(size=(200, 8))).astype(np.float64)
+
+        mu1, s1 = feats_real.mean(0), np.cov(feats_real, rowvar=False)
+        mu2, s2 = feats_fake.mean(0), np.cov(feats_fake, rowvar=False)
+        diff = mu1 - mu2
+        covmean = linalg.sqrtm(s1 @ s2).real
+        expected = diff @ diff + np.trace(s1) + np.trace(s2) - 2 * np.trace(covmean)
+
+        fid = FrechetInceptionDistance(feature=lambda x: x)
+        fid.update(feats_real, real=True)
+        fid.update(feats_fake, real=False)
+        np.testing.assert_allclose(float(fid.compute()), expected, rtol=5e-3)
+
+    def test_fid_same_distribution_small(self):
+        fid = FrechetInceptionDistance(feature=self._extractor)
+        imgs = _rng.random((64, 3, 8, 8)).astype(np.float32)
+        fid.update(imgs, real=True)
+        fid.update(imgs, real=False)
+        assert float(fid.compute()) < 1e-3
+
+    def test_kid(self):
+        feats = _rng.normal(size=(60, 8)).astype(np.float32)
+        kid = KernelInceptionDistance(feature=lambda x: x, subset_size=20, subsets=5)
+        kid.update(feats, real=True)
+        kid.update(feats + 0.01, real=False)
+        mean, std = kid.compute()
+        # formula correctness is pinned by test_kid_mmd_formula; here just check the
+        # subset machinery yields values in the right range (estimator is noisy and
+        # biased negative for the reference's 2*k_xy/m^2 cross term)
+        assert abs(float(mean)) < 1.0 and float(std) < 1.0
+
+    def test_kid_mmd_formula(self):
+        from metrics_tpu.image.kid import poly_mmd
+
+        f1 = _rng.normal(size=(30, 6)).astype(np.float64)
+        f2 = _rng.normal(size=(30, 6)).astype(np.float64)
+        gamma = 1.0 / 6
+        k_xx = (f1 @ f1.T * gamma + 1) ** 3
+        k_yy = (f2 @ f2.T * gamma + 1) ** 3
+        k_xy = (f1 @ f2.T * gamma + 1) ** 3
+        m = 30
+        expected = (
+            (k_xx.sum() - np.trace(k_xx)) / (m * (m - 1))
+            + (k_yy.sum() - np.trace(k_yy)) / (m * (m - 1))
+            - 2 * k_xy.sum() / m**2
+        )
+        res = poly_mmd(f1.astype(np.float32), f2.astype(np.float32))
+        np.testing.assert_allclose(float(res), expected, rtol=1e-3)
+
+    def test_inception_score(self):
+        logits = _rng.normal(size=(100, 10)).astype(np.float32) * 3
+        m = InceptionScore(feature=lambda x: x, splits=2)
+        m.update(logits)
+        mean, std = m.compute()
+
+        def softmax(x):
+            e = np.exp(x - x.max(1, keepdims=True))
+            return e / e.sum(1, keepdims=True)
+
+        # oracle on the same (unpermuted) data: value should be in same ballpark
+        p = softmax(logits)
+        kl = (p * (np.log(p) - np.log(p.mean(0, keepdims=True)))).sum(1).mean()
+        assert abs(float(mean) - kl) < 0.5
+
+    def test_fid_pretrained_gated(self):
+        with pytest.raises(ModuleNotFoundError, match="weights"):
+            FrechetInceptionDistance(feature=2048)
