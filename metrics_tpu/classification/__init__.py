@@ -1,3 +1,35 @@
+from metrics_tpu.classification.calibration_error import (
+    BinaryCalibrationError,
+    CalibrationError,
+    MulticlassCalibrationError,
+)
+from metrics_tpu.classification.dice import Dice
+from metrics_tpu.classification.exact_match import ExactMatch, MulticlassExactMatch, MultilabelExactMatch
+from metrics_tpu.classification.group_fairness import BinaryFairness, BinaryGroupStatRates
+from metrics_tpu.classification.hinge import BinaryHingeLoss, HingeLoss, MulticlassHingeLoss
+from metrics_tpu.classification.precision_fixed_recall import (
+    BinaryPrecisionAtFixedRecall,
+    MulticlassPrecisionAtFixedRecall,
+    MultilabelPrecisionAtFixedRecall,
+    PrecisionAtFixedRecall,
+)
+from metrics_tpu.classification.ranking import (
+    MultilabelCoverageError,
+    MultilabelRankingAveragePrecision,
+    MultilabelRankingLoss,
+)
+from metrics_tpu.classification.recall_fixed_precision import (
+    BinaryRecallAtFixedPrecision,
+    MulticlassRecallAtFixedPrecision,
+    MultilabelRecallAtFixedPrecision,
+    RecallAtFixedPrecision,
+)
+from metrics_tpu.classification.specificity_sensitivity import (
+    BinarySpecificityAtSensitivity,
+    MulticlassSpecificityAtSensitivity,
+    MultilabelSpecificityAtSensitivity,
+    SpecificityAtSensitivity,
+)
 from metrics_tpu.classification.auroc import AUROC, BinaryAUROC, MulticlassAUROC, MultilabelAUROC
 from metrics_tpu.classification.average_precision import (
     AveragePrecision,
@@ -72,6 +104,34 @@ from metrics_tpu.classification.stat_scores import (
 )
 
 __all__ = [
+    "BinaryCalibrationError",
+    "CalibrationError",
+    "MulticlassCalibrationError",
+    "Dice",
+    "ExactMatch",
+    "MulticlassExactMatch",
+    "MultilabelExactMatch",
+    "BinaryFairness",
+    "BinaryGroupStatRates",
+    "BinaryHingeLoss",
+    "HingeLoss",
+    "MulticlassHingeLoss",
+    "BinaryPrecisionAtFixedRecall",
+    "MulticlassPrecisionAtFixedRecall",
+    "MultilabelPrecisionAtFixedRecall",
+    "PrecisionAtFixedRecall",
+    "MultilabelCoverageError",
+    "MultilabelRankingAveragePrecision",
+    "MultilabelRankingLoss",
+    "BinaryRecallAtFixedPrecision",
+    "MulticlassRecallAtFixedPrecision",
+    "MultilabelRecallAtFixedPrecision",
+    "RecallAtFixedPrecision",
+    "BinarySpecificityAtSensitivity",
+    "MulticlassSpecificityAtSensitivity",
+    "MultilabelSpecificityAtSensitivity",
+    "SpecificityAtSensitivity",
+
     "AUROC",
     "AveragePrecision",
     "BinaryAUROC",

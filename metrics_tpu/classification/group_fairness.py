@@ -1,0 +1,169 @@
+"""Group-fairness metric classes (reference: classification/group_fairness.py:34-296)."""
+from typing import Any, Dict, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.classification.group_fairness import (
+    _binary_groups_stat_scores_update,
+    _compute_binary_demographic_parity,
+    _compute_binary_equal_opportunity,
+    _groups_format,
+    _groups_validation,
+)
+from metrics_tpu.functional.classification.stat_scores import (
+    _binary_stat_scores_arg_validation,
+    _binary_stat_scores_format,
+    _binary_stat_scores_tensor_validation,
+)
+
+
+class _AbstractGroupStatScores(Metric):
+    """Create and update per-group tp/fp/tn/fn states (reference: classification/group_fairness.py:34-51).
+
+    TPU-first: states are four static ``(num_groups,)`` sum tensors filled by one fused
+    scatter-add, instead of the reference's per-group attribute lists.
+    """
+
+    def _create_states(self, num_groups: int) -> None:
+        default = lambda: jnp.zeros(num_groups, dtype=jnp.int32)  # noqa: E731
+        for s in ("tp", "fp", "tn", "fn"):
+            self.add_state(s, default(), dist_reduce_fx="sum")
+
+    def _update_states(self, preds: Array, target: Array, groups: Array) -> None:
+        tp, fp, tn, fn = _binary_groups_stat_scores_update(preds, target, groups, self.num_groups)
+        self.tp = self.tp + tp
+        self.fp = self.fp + fp
+        self.tn = self.tn + tn
+        self.fn = self.fn + fn
+
+
+class BinaryGroupStatRates(_AbstractGroupStatScores):
+    """tp/fp/tn/fn rates by group (reference: classification/group_fairness.py:54-146).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import BinaryGroupStatRates
+        >>> target = jnp.array([0, 1, 0, 1, 0, 1])
+        >>> preds = jnp.array([0, 1, 0, 1, 0, 1])
+        >>> groups = jnp.array([0, 1, 0, 1, 0, 1])
+        >>> metric = BinaryGroupStatRates(num_groups=2)
+        >>> metric(preds, target, groups)
+        {'group_0': Array([0., 0., 1., 0.], dtype=float32), 'group_1': Array([1., 0., 0., 0.], dtype=float32)}
+    """
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        num_groups: int,
+        threshold: float = 0.5,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _binary_stat_scores_arg_validation(threshold, "global", ignore_index)
+        if not isinstance(num_groups, int) and num_groups < 2:
+            raise ValueError(f"Expected argument `num_groups` to be an int larger than 1, but got {num_groups}")
+        self.num_groups = num_groups
+        self.threshold = threshold
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+
+        self._create_states(self.num_groups)
+
+    def update(self, preds: Array, target: Array, groups: Array) -> None:
+        """Update states with the group-segmented confusion counts."""
+        if self.validate_args:
+            _binary_stat_scores_tensor_validation(preds, target, "global", self.ignore_index)
+            _groups_validation(groups, self.num_groups)
+        preds, target = _binary_stat_scores_format(preds, target, self.threshold, self.ignore_index)
+        groups = _groups_format(groups)
+        self._update_states(preds, target, groups)
+
+    def compute(self) -> Dict[str, Array]:
+        """Per-group rates normalized by the group totals."""
+        results = jnp.stack([self.tp, self.fp, self.tn, self.fn], axis=1)
+        return {f"group_{i}": group / group.sum() for i, group in enumerate(results)}
+
+
+class BinaryFairness(_AbstractGroupStatScores):
+    """Demographic parity and equal opportunity (reference: classification/group_fairness.py:149-296).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import BinaryFairness
+        >>> target = jnp.array([0, 1, 0, 1, 0, 1])
+        >>> preds = jnp.array([0, 1, 0, 1, 0, 1])
+        >>> groups = jnp.array([0, 1, 0, 1, 0, 1])
+        >>> metric = BinaryFairness(2)
+        >>> metric(preds, target, groups)
+        {'DP_0_1': Array(0., dtype=float32), 'EO_0_1': Array(0., dtype=float32)}
+    """
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        num_groups: int,
+        task: str = "all",
+        threshold: float = 0.5,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if task not in ["demographic_parity", "equal_opportunity", "all"]:
+            raise ValueError(
+                f"Expected argument `task` to either be ``demographic_parity``,"
+                f"``equal_opportunity`` or ``all`` but got {task}."
+            )
+        if validate_args:
+            _binary_stat_scores_arg_validation(threshold, "global", ignore_index)
+        if not isinstance(num_groups, int) and num_groups < 2:
+            raise ValueError(f"Expected argument `num_groups` to be an int larger than 1, but got {num_groups}")
+        self.num_groups = num_groups
+        self.task = task
+        self.threshold = threshold
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+
+        self._create_states(self.num_groups)
+
+    def update(self, preds: Array, target: Optional[Array], groups: Array) -> None:
+        """Update states; ``target`` is ignored for demographic_parity."""
+        if self.task == "demographic_parity":
+            if target is not None:
+                import warnings
+
+                warnings.warn("The task demographic_parity does not require a target.", UserWarning)
+            target = jnp.zeros(jnp.asarray(preds).shape, dtype=jnp.int32)
+
+        if self.validate_args:
+            _binary_stat_scores_tensor_validation(preds, target, "global", self.ignore_index)
+            _groups_validation(groups, self.num_groups)
+        preds, target = _binary_stat_scores_format(preds, target, self.threshold, self.ignore_index)
+        groups = _groups_format(groups)
+        self._update_states(preds, target, groups)
+
+    def compute(self) -> Dict[str, Array]:
+        """Disparity ratios between the lowest and highest group rates."""
+        if self.task == "demographic_parity":
+            return _compute_binary_demographic_parity(self.tp, self.fp, self.tn, self.fn)
+        if self.task == "equal_opportunity":
+            return _compute_binary_equal_opportunity(self.tp, self.fp, self.tn, self.fn)
+        results = {}
+        results.update(_compute_binary_demographic_parity(self.tp, self.fp, self.tn, self.fn))
+        results.update(_compute_binary_equal_opportunity(self.tp, self.fp, self.tn, self.fn))
+        return results

@@ -1,0 +1,109 @@
+"""Multilabel ranking metric classes (reference: classification/ranking.py:40-276)."""
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.classification.confusion_matrix import (
+    _multilabel_confusion_matrix_arg_validation,
+    _multilabel_confusion_matrix_format,
+)
+from metrics_tpu.functional.classification.ranking import (
+    _multilabel_coverage_error_update,
+    _multilabel_ranking_average_precision_update,
+    _multilabel_ranking_loss_update,
+    _multilabel_ranking_tensor_validation,
+    _ranking_reduce,
+)
+
+
+class _MultilabelRankingMetric(Metric):
+    """Shared scaffolding for the three multilabel ranking metrics."""
+
+    is_differentiable: bool = False
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    _update_fn = None  # set per subclass
+
+    def __init__(
+        self,
+        num_labels: int,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _multilabel_confusion_matrix_arg_validation(num_labels, threshold=0.0, ignore_index=ignore_index)
+        self.num_labels = num_labels
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self.add_state("measure", jnp.zeros((), dtype=jnp.float32), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        if self.validate_args:
+            _multilabel_ranking_tensor_validation(preds, target, self.num_labels, self.ignore_index)
+        preds, target = _multilabel_confusion_matrix_format(
+            preds, target, self.num_labels, threshold=0.0, ignore_index=self.ignore_index, should_threshold=False
+        )
+        measure, total = type(self)._update_fn(preds, target)
+        self.measure = self.measure + measure
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        return _ranking_reduce(self.measure, self.total)
+
+
+class MultilabelCoverageError(_MultilabelRankingMetric):
+    """Multilabel coverage error (reference: classification/ranking.py:40-117).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from metrics_tpu.classification import MultilabelCoverageError
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(0), (10, 5))
+        >>> target = jax.random.randint(jax.random.PRNGKey(1), (10, 5), 0, 2)
+        >>> metric = MultilabelCoverageError(num_labels=5)
+        >>> float(metric(preds, target)) > 0
+        True
+    """
+
+    higher_is_better: bool = False
+    _update_fn = staticmethod(_multilabel_coverage_error_update)
+
+
+class MultilabelRankingAveragePrecision(_MultilabelRankingMetric):
+    """Multilabel label-ranking average precision (reference: classification/ranking.py:119-196).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from metrics_tpu.classification import MultilabelRankingAveragePrecision
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(0), (10, 5))
+        >>> target = jax.random.randint(jax.random.PRNGKey(1), (10, 5), 0, 2)
+        >>> metric = MultilabelRankingAveragePrecision(num_labels=5)
+        >>> 0 <= float(metric(preds, target)) <= 1
+        True
+    """
+
+    higher_is_better: bool = True
+    _update_fn = staticmethod(_multilabel_ranking_average_precision_update)
+
+
+class MultilabelRankingLoss(_MultilabelRankingMetric):
+    """Multilabel ranking loss (reference: classification/ranking.py:198-276).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from metrics_tpu.classification import MultilabelRankingLoss
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(0), (10, 5))
+        >>> target = jax.random.randint(jax.random.PRNGKey(1), (10, 5), 0, 2)
+        >>> metric = MultilabelRankingLoss(num_labels=5)
+        >>> float(metric(preds, target)) >= 0
+        True
+    """
+
+    higher_is_better: bool = False
+    _update_fn = staticmethod(_multilabel_ranking_loss_update)

@@ -1,0 +1,154 @@
+"""Specificity-at-sensitivity metric classes (reference: classification/specificity_sensitivity.py:46-421)."""
+from typing import Any, List, Optional, Tuple, Union
+
+from jax import Array
+
+from metrics_tpu.classification.precision_recall_curve import (
+    BinaryPrecisionRecallCurve,
+    MulticlassPrecisionRecallCurve,
+    MultilabelPrecisionRecallCurve,
+)
+from metrics_tpu.functional.classification.specificity_sensitivity import (
+    _binary_specificity_at_sensitivity_arg_validation,
+    _binary_specificity_at_sensitivity_compute,
+    _multiclass_specificity_at_sensitivity_arg_validation,
+    _multiclass_specificity_at_sensitivity_compute,
+    _multilabel_specificity_at_sensitivity_arg_validation,
+    _multilabel_specificity_at_sensitivity_compute,
+)
+from metrics_tpu.utils.data import dim_zero_cat
+from metrics_tpu.utils.enums import ClassificationTask
+
+
+class BinarySpecificityAtSensitivity(BinaryPrecisionRecallCurve):
+    """Highest specificity with sensitivity >= min_sensitivity (reference: specificity_sensitivity.py:46-145).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import BinarySpecificityAtSensitivity
+        >>> preds = jnp.array([0, 0.5, 0.4, 0.1])
+        >>> target = jnp.array([0, 1, 1, 1])
+        >>> metric = BinarySpecificityAtSensitivity(min_sensitivity=0.5, thresholds=5)
+        >>> metric(preds, target)
+        (Array(1., dtype=float32), Array(0.25, dtype=float32))
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: Optional[bool] = None
+    full_state_update: bool = False
+
+    def __init__(
+        self,
+        min_sensitivity: float,
+        thresholds: Optional[Union[int, List[float], Array]] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(thresholds=thresholds, ignore_index=ignore_index, validate_args=False, **kwargs)
+        if validate_args:
+            _binary_specificity_at_sensitivity_arg_validation(min_sensitivity, thresholds, ignore_index)
+        self.validate_args = validate_args
+        self.min_sensitivity = min_sensitivity
+
+    def compute(self) -> Tuple[Array, Array]:  # type: ignore[override]
+        state = (dim_zero_cat(self.preds), dim_zero_cat(self.target)) if self.thresholds is None else self.confmat
+        return _binary_specificity_at_sensitivity_compute(state, self.thresholds, self.min_sensitivity)
+
+
+class MulticlassSpecificityAtSensitivity(MulticlassPrecisionRecallCurve):
+    """Per-class highest specificity with sensitivity >= min (reference: specificity_sensitivity.py:148-276)."""
+
+    is_differentiable: bool = False
+    higher_is_better: Optional[bool] = None
+    full_state_update: bool = False
+    plot_legend_name: str = "Class"
+
+    def __init__(
+        self,
+        num_classes: int,
+        min_sensitivity: float,
+        thresholds: Optional[Union[int, List[float], Array]] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            num_classes=num_classes, thresholds=thresholds, ignore_index=ignore_index, validate_args=False, **kwargs
+        )
+        if validate_args:
+            _multiclass_specificity_at_sensitivity_arg_validation(
+                num_classes, min_sensitivity, thresholds, ignore_index
+            )
+        self.validate_args = validate_args
+        self.min_sensitivity = min_sensitivity
+
+    def compute(self) -> Tuple[Array, Array]:  # type: ignore[override]
+        state = (dim_zero_cat(self.preds), dim_zero_cat(self.target)) if self.thresholds is None else self.confmat
+        return _multiclass_specificity_at_sensitivity_compute(
+            state, self.num_classes, self.thresholds, self.min_sensitivity
+        )
+
+
+class MultilabelSpecificityAtSensitivity(MultilabelPrecisionRecallCurve):
+    """Per-label highest specificity with sensitivity >= min (reference: specificity_sensitivity.py:279-409)."""
+
+    is_differentiable: bool = False
+    higher_is_better: Optional[bool] = None
+    full_state_update: bool = False
+    plot_legend_name: str = "Label"
+
+    def __init__(
+        self,
+        num_labels: int,
+        min_sensitivity: float,
+        thresholds: Optional[Union[int, List[float], Array]] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            num_labels=num_labels, thresholds=thresholds, ignore_index=ignore_index, validate_args=False, **kwargs
+        )
+        if validate_args:
+            _multilabel_specificity_at_sensitivity_arg_validation(num_labels, min_sensitivity, thresholds, ignore_index)
+        self.validate_args = validate_args
+        self.min_sensitivity = min_sensitivity
+
+    def compute(self) -> Tuple[Array, Array]:  # type: ignore[override]
+        state = (dim_zero_cat(self.preds), dim_zero_cat(self.target)) if self.thresholds is None else self.confmat
+        return _multilabel_specificity_at_sensitivity_compute(
+            state, self.num_labels, self.thresholds, self.ignore_index, self.min_sensitivity
+        )
+
+
+class SpecificityAtSensitivity:
+    """Task dispatcher (reference: classification/specificity_sensitivity.py:411-421)."""
+
+    def __new__(  # type: ignore[misc]
+        cls,
+        task: str,
+        min_sensitivity: float,
+        thresholds: Optional[Union[int, List[float], Array]] = None,
+        num_classes: Optional[int] = None,
+        num_labels: Optional[int] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ):
+        task = ClassificationTask.from_str(task)
+        if task == ClassificationTask.BINARY:
+            return BinarySpecificityAtSensitivity(min_sensitivity, thresholds, ignore_index, validate_args, **kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            return MulticlassSpecificityAtSensitivity(
+                num_classes, min_sensitivity, thresholds, ignore_index, validate_args, **kwargs
+            )
+        if task == ClassificationTask.MULTILABEL:
+            if not isinstance(num_labels, int):
+                raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+            return MultilabelSpecificityAtSensitivity(
+                num_labels, min_sensitivity, thresholds, ignore_index, validate_args, **kwargs
+            )
+        raise ValueError(f"Not handled value: {task}")

@@ -1,3 +1,45 @@
+from metrics_tpu.functional.classification.calibration_error import (
+    binary_calibration_error,
+    calibration_error,
+    multiclass_calibration_error,
+)
+from metrics_tpu.functional.classification.dice import dice
+from metrics_tpu.functional.classification.exact_match import (
+    exact_match,
+    multiclass_exact_match,
+    multilabel_exact_match,
+)
+from metrics_tpu.functional.classification.group_fairness import (
+    binary_fairness,
+    binary_groups_stat_rates,
+    demographic_parity,
+    equal_opportunity,
+)
+from metrics_tpu.functional.classification.hinge import binary_hinge_loss, hinge_loss, multiclass_hinge_loss
+from metrics_tpu.functional.classification.precision_fixed_recall import (
+    binary_precision_at_fixed_recall,
+    multiclass_precision_at_fixed_recall,
+    multilabel_precision_at_fixed_recall,
+    precision_at_fixed_recall,
+)
+from metrics_tpu.functional.classification.ranking import (
+    multilabel_coverage_error,
+    multilabel_ranking_average_precision,
+    multilabel_ranking_loss,
+)
+from metrics_tpu.functional.classification.recall_fixed_precision import (
+    binary_recall_at_fixed_precision,
+    multiclass_recall_at_fixed_precision,
+    multilabel_recall_at_fixed_precision,
+    recall_at_fixed_precision,
+)
+from metrics_tpu.functional.classification.specificity_sensitivity import (
+    binary_specificity_at_sensitivity,
+    multiclass_specificity_at_sensitivity,
+    multilabel_specificity_at_sensitivity,
+    specicity_at_sensitivity,
+    specificity_at_sensitivity,
+)
 from metrics_tpu.functional.classification.auroc import auroc, binary_auroc, multiclass_auroc, multilabel_auroc
 from metrics_tpu.functional.classification.average_precision import (
     average_precision,

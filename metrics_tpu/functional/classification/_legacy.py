@@ -1,0 +1,385 @@
+"""Legacy classification input pipeline, used by ``Dice`` (reference:
+utilities/checks.py:206-452 ``_input_format_classification`` and
+functional/classification/stat_scores.py:845-1060 ``_stat_scores_update`` /
+``_reduce_stat_scores``).
+
+Input-case detection is inherently data/shape-dependent Python dispatch, so it runs
+host-side (NumPy checks); the produced one-hot stat-score reductions are jnp ops.
+"""
+from typing import List, Optional, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.utils.data import select_topk, to_onehot
+from metrics_tpu.utils.enums import AverageMethod, DataType, MDMCAverageMethod
+
+
+def _is_floating(x) -> bool:
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+
+
+def _input_squeeze(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Remove excess dimensions (reference: checks.py:300-309)."""
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    if preds.shape[0] == 1:
+        preds = preds.squeeze()[None, ...]
+        target = target.squeeze()[None, ...]
+    else:
+        preds, target = preds.squeeze(), target.squeeze()
+    return preds, target
+
+
+def _basic_input_validation(
+    preds: Array, target: Array, threshold: float, multiclass: Optional[bool], ignore_index: Optional[int]
+) -> None:
+    """Reference: checks.py:47-73."""
+    if preds.size == 0 and target.size == 0:
+        return
+    if _is_floating(target):
+        raise ValueError("The `target` has to be an integer tensor.")
+    t_min = int(np.asarray(target).min())
+    if (ignore_index is None and t_min < 0) or (ignore_index and ignore_index >= 0 and t_min < 0):
+        raise ValueError("The `target` has to be a non-negative tensor.")
+    preds_float = _is_floating(preds)
+    if not preds_float and int(np.asarray(preds).min()) < 0:
+        raise ValueError("If `preds` are integers, they have to be non-negative.")
+    if not preds.shape[0] == target.shape[0]:
+        raise ValueError("The `preds` and `target` should have the same first dimension.")
+    if multiclass is False and int(np.asarray(target).max()) > 1:
+        raise ValueError("If you set `multiclass=False`, then `target` should not exceed 1.")
+    if multiclass is False and not preds_float and int(np.asarray(preds).max()) > 1:
+        raise ValueError("If you set `multiclass=False` and `preds` are integers, then `preds` should not exceed 1.")
+
+
+def _check_shape_and_type_consistency(preds: Array, target: Array) -> Tuple[DataType, int]:
+    """Reference: checks.py:76-129."""
+    preds_float = _is_floating(preds)
+
+    if preds.ndim == target.ndim:
+        if preds.shape != target.shape:
+            raise ValueError(
+                "The `preds` and `target` should have the same shape,"
+                f" got `preds` with shape={preds.shape} and `target` with shape={target.shape}."
+            )
+        if preds_float and target.size > 0 and int(np.asarray(target).max()) > 1:
+            raise ValueError(
+                "If `preds` and `target` are of shape (N, ...) and `preds` are floats, `target` should be binary."
+            )
+        if preds.ndim == 1 and preds_float:
+            case = DataType.BINARY
+        elif preds.ndim == 1 and not preds_float:
+            case = DataType.MULTICLASS
+        elif preds.ndim > 1 and preds_float:
+            case = DataType.MULTILABEL
+        else:
+            case = DataType.MULTIDIM_MULTICLASS
+        implied_classes = int(np.prod(preds.shape[1:])) if preds.size > 0 else 0
+    elif preds.ndim == target.ndim + 1:
+        if not preds_float:
+            raise ValueError("If `preds` have one dimension more than `target`, `preds` should be a float tensor.")
+        if preds.shape[2:] != target.shape[1:]:
+            raise ValueError(
+                "If `preds` have one dimension more than `target`, the shape of `preds` should be"
+                " (N, C, ...), and the shape of `target` should be (N, ...)."
+            )
+        implied_classes = preds.shape[1] if preds.size > 0 else 0
+        case = DataType.MULTICLASS if preds.ndim == 2 else DataType.MULTIDIM_MULTICLASS
+    else:
+        raise ValueError(
+            "Either `preds` and `target` both should have the (same) shape (N, ...), or `target` should be (N, ...)"
+            " and `preds` should be (N, C, ...)."
+        )
+    return case, implied_classes
+
+
+def _check_classification_inputs(
+    preds: Array,
+    target: Array,
+    threshold: float,
+    num_classes: Optional[int],
+    multiclass: Optional[bool],
+    top_k: Optional[int],
+    ignore_index: Optional[int] = None,
+) -> DataType:
+    """Reference: checks.py:206-297 (condensed: same checks, same errors)."""
+    _basic_input_validation(preds, target, threshold, multiclass, ignore_index)
+    case, implied_classes = _check_shape_and_type_consistency(preds, target)
+
+    if preds.shape != target.shape:
+        if multiclass is False and implied_classes != 2:
+            raise ValueError(
+                "You have set `multiclass=False`, but have more than 2 classes in your data,"
+                " based on the C dimension of `preds`."
+            )
+        if target.size > 0 and int(np.asarray(target).max()) >= implied_classes:
+            raise ValueError(
+                "The highest label in `target` should be smaller than the size of the `C` dimension of `preds`."
+            )
+
+    if num_classes:
+        if case == DataType.BINARY:
+            if num_classes > 2:
+                raise ValueError("Your data is binary, but `num_classes` is larger than 2.")
+            if num_classes == 2 and not multiclass:
+                raise ValueError(
+                    "Your data is binary and `num_classes=2`, but `multiclass` is not True."
+                    " Set it to True if you want to transform binary data to multi-class format."
+                )
+            if num_classes == 1 and multiclass:
+                raise ValueError(
+                    "You have binary data and have set `multiclass=True`, but `num_classes` is 1."
+                    " Either set `multiclass=None`(default) or set `num_classes=2`"
+                    " to transform binary data to multi-class format."
+                )
+        elif case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS):
+            if num_classes == 1 and multiclass is not False:
+                raise ValueError(
+                    "You have set `num_classes=1`, but predictions are integers."
+                    " If you want to convert (multi-dimensional) multi-class data with 2 classes"
+                    " to binary/multi-label, set `multiclass=False`."
+                )
+            if num_classes > 1:
+                if multiclass is False and implied_classes != num_classes:
+                    raise ValueError(
+                        "You have set `multiclass=False`, but the implied number of classes "
+                        " (from shape of inputs) does not match `num_classes`."
+                    )
+                if target.size > 0 and num_classes <= int(np.asarray(target).max()):
+                    raise ValueError("The highest label in `target` should be smaller than `num_classes`.")
+                if preds.shape != target.shape and num_classes != implied_classes:
+                    raise ValueError("The size of C dimension of `preds` does not match `num_classes`.")
+        elif case == DataType.MULTILABEL:
+            if multiclass and num_classes != 2:
+                raise ValueError(
+                    "Your have set `multiclass=True`, but `num_classes` is not equal to 2."
+                    " If you are trying to transform multi-label data to 2 class multi-dimensional"
+                    " multi-class, you should set `num_classes` to either 2 or None."
+                )
+            if not multiclass and num_classes != implied_classes:
+                raise ValueError("The implied number of classes (from shape of inputs) does not match num_classes.")
+
+    if top_k is not None:
+        if case == DataType.BINARY:
+            raise ValueError("You can not use `top_k` parameter with binary data.")
+        if not isinstance(top_k, int) or top_k <= 0:
+            raise ValueError("The `top_k` has to be an integer larger than 0.")
+        if not _is_floating(preds):
+            raise ValueError("You have set `top_k`, but you do not have probability predictions.")
+        if multiclass is False:
+            raise ValueError("If you set `multiclass=False`, you can not set `top_k`.")
+        if case == DataType.MULTILABEL and multiclass:
+            raise ValueError(
+                "If you want to transform multi-label data to 2 class multi-dimensional"
+                "multi-class data using `multiclass=True`, you can not use `top_k`."
+            )
+        if top_k >= implied_classes:
+            raise ValueError("The `top_k` has to be strictly smaller than the `C` dimension of `preds`.")
+
+    return case
+
+
+def _input_format_classification(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, DataType]:
+    """Convert preds/target into common one-hot format (reference: checks.py:313-452)."""
+    preds, target = _input_squeeze(preds, target)
+    if preds.dtype == jnp.float16:
+        preds = preds.astype(jnp.float32)
+
+    case = _check_classification_inputs(
+        preds,
+        target,
+        threshold=threshold,
+        num_classes=num_classes,
+        multiclass=multiclass,
+        top_k=top_k,
+        ignore_index=ignore_index,
+    )
+
+    if case in (DataType.BINARY, DataType.MULTILABEL) and not top_k:
+        preds = (preds >= threshold).astype(jnp.int32) if _is_floating(preds) else preds
+        num_classes = num_classes if not multiclass else 2
+
+    if case == DataType.MULTILABEL and top_k:
+        preds = select_topk(preds, top_k)
+
+    if case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS) or multiclass:
+        if _is_floating(preds):
+            num_classes = preds.shape[1]
+            preds = select_topk(preds, top_k or 1)
+        else:
+            num_classes = num_classes or int(
+                max(int(np.asarray(preds).max()), int(np.asarray(target).max())) + 1
+            )
+            preds = to_onehot(preds, max(2, num_classes))
+        target = to_onehot(target, max(2, num_classes))
+        if multiclass is False:
+            preds, target = preds[:, 1, ...], target[:, 1, ...]
+
+    if preds.size > 0 or target.size > 0:
+        if (case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS) and multiclass is not False) or multiclass:
+            target = target.reshape(target.shape[0], target.shape[1], -1)
+            preds = preds.reshape(preds.shape[0], preds.shape[1], -1)
+        else:
+            target = target.reshape(target.shape[0], -1)
+            preds = preds.reshape(preds.shape[0], -1)
+
+    # torch .squeeze(-1) is a no-op on non-1 dims; mirror that
+    if preds.ndim > 2 and preds.shape[-1] == 1:
+        preds = preds.squeeze(-1)
+    if target.ndim > 2 and target.shape[-1] == 1:
+        target = target.squeeze(-1)
+
+    return preds.astype(jnp.int32), target.astype(jnp.int32), case
+
+
+def _del_column(data: Array, idx: int) -> Array:
+    """Delete the column at index (reference: stat_scores.py:828-830)."""
+    return jnp.concatenate([data[:, :idx], data[:, (idx + 1):]], axis=1)
+
+
+def _drop_negative_ignored_indices(
+    preds: Array, target: Array, ignore_index: int, mode: DataType
+) -> Tuple[Array, Array]:
+    """Remove negative ignored indices (reference: stat_scores.py:833-842)."""
+    if mode == mode.MULTIDIM_MULTICLASS and _is_floating(preds):
+        num_dims = len(preds.shape)
+        preds = jnp.moveaxis(preds, 1, num_dims - 1)
+        keep = np.asarray(target) != ignore_index
+        preds = preds[keep]
+        target = target[keep]
+    elif mode in (mode.MULTICLASS, mode.MULTIDIM_MULTICLASS):
+        keep = np.asarray(target) != ignore_index
+        preds = preds[keep]
+        target = target[keep]
+    return preds, target
+
+
+def _stat_scores(
+    preds: Array,
+    target: Array,
+    reduce: Optional[str] = "micro",
+) -> Tuple[Array, Array, Array, Array]:
+    """tp/fp/tn/fn from one-hot binary tensors (reference: stat_scores.py:845-889)."""
+    dim: Union[int, Tuple[int, ...]] = 1  # for "samples"
+    if reduce == "micro":
+        dim = (0, 1) if preds.ndim == 2 else (1, 2)
+    elif reduce == "macro":
+        dim = 0 if preds.ndim == 2 else 2
+
+    true_pred, false_pred = target == preds, target != preds
+    pos_pred, neg_pred = preds == 1, preds == 0
+
+    tp = (true_pred & pos_pred).sum(axis=dim)
+    fp = (false_pred & pos_pred).sum(axis=dim)
+    tn = (true_pred & neg_pred).sum(axis=dim)
+    fn = (false_pred & neg_pred).sum(axis=dim)
+
+    # int32 keeps the -1 sentinel exact; _count_dtype's float path is unnecessary here
+    # because the legacy one-hot layout is capped well below 2^31 per update
+    return (
+        tp.astype(jnp.int32),
+        fp.astype(jnp.int32),
+        tn.astype(jnp.int32),
+        fn.astype(jnp.int32),
+    )
+
+
+def _stat_scores_update(
+    preds: Array,
+    target: Array,
+    reduce: Optional[str] = "micro",
+    mdmc_reduce: Optional[str] = None,
+    num_classes: Optional[int] = None,
+    top_k: Optional[int] = 1,
+    threshold: float = 0.5,
+    multiclass: Optional[bool] = None,
+    ignore_index: Optional[int] = None,
+    mode: Optional[DataType] = None,
+) -> Tuple[Array, Array, Array, Array]:
+    """Legacy stat-scores update (reference: stat_scores.py:892-980)."""
+    _negative_index_dropped = False
+    if ignore_index is not None and ignore_index < 0 and mode is not None:
+        preds, target = _drop_negative_ignored_indices(preds, target, ignore_index, mode)
+        _negative_index_dropped = True
+
+    preds, target, _ = _input_format_classification(
+        preds,
+        target,
+        threshold=threshold,
+        num_classes=num_classes,
+        multiclass=multiclass,
+        top_k=top_k,
+        ignore_index=ignore_index,
+    )
+
+    if ignore_index is not None and ignore_index >= preds.shape[1]:
+        raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {preds.shape[1]} classes")
+    if ignore_index is not None and preds.shape[1] == 1:
+        raise ValueError("You can not use `ignore_index` with binary data.")
+
+    if preds.ndim == 3:
+        if not mdmc_reduce:
+            raise ValueError(
+                "When your inputs are multi-dimensional multi-class, you have to set the `mdmc_reduce` parameter"
+            )
+        if mdmc_reduce == "global":
+            preds = jnp.swapaxes(preds, 1, 2).reshape(-1, preds.shape[1])
+            target = jnp.swapaxes(target, 1, 2).reshape(-1, target.shape[1])
+
+    if ignore_index is not None and reduce != "macro" and not _negative_index_dropped:
+        preds = _del_column(preds, ignore_index)
+        target = _del_column(target, ignore_index)
+
+    tp, fp, tn, fn = _stat_scores(preds, target, reduce=reduce)
+
+    if ignore_index is not None and reduce == "macro" and not _negative_index_dropped:
+        tp = tp.at[..., ignore_index].set(-1)
+        fp = fp.at[..., ignore_index].set(-1)
+        tn = tn.at[..., ignore_index].set(-1)
+        fn = fn.at[..., ignore_index].set(-1)
+
+    return tp, fp, tn, fn
+
+
+def _reduce_stat_scores(
+    numerator: Array,
+    denominator: Array,
+    weights: Optional[Array],
+    average: Optional[str],
+    mdmc_average: Optional[str],
+    zero_division: int = 0,
+) -> Array:
+    """Score reduction with zero-division/ignore masks (reference: stat_scores.py:1002-1056)."""
+    numerator = jnp.asarray(numerator, jnp.float32)
+    denominator = jnp.asarray(denominator, jnp.float32)
+    zero_div_mask = denominator == 0
+    ignore_mask = denominator < 0
+
+    weights = jnp.ones_like(denominator) if weights is None else jnp.asarray(weights, jnp.float32)
+
+    numerator = jnp.where(zero_div_mask, float(zero_division), numerator)
+    denominator = jnp.where(zero_div_mask | ignore_mask, 1.0, denominator)
+    weights = jnp.where(ignore_mask, 0.0, weights)
+
+    if average not in (AverageMethod.MICRO, AverageMethod.NONE, None):
+        weights = weights / weights.sum(axis=-1, keepdims=True)
+
+    scores = weights * (numerator / denominator)
+    scores = jnp.where(jnp.isnan(scores), float(zero_division), scores)
+
+    if mdmc_average == MDMCAverageMethod.SAMPLEWISE:
+        scores = scores.mean(axis=0)
+        ignore_mask = ignore_mask.sum(axis=0).astype(bool)
+
+    if average in (AverageMethod.NONE, None):
+        return jnp.where(ignore_mask, jnp.nan, scores)
+    return scores.sum()

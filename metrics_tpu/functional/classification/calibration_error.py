@@ -1,0 +1,216 @@
+"""Calibration error functionals (reference: functional/classification/calibration_error.py).
+
+TPU-first design: the reference bins confidences with ``torch.bucketize`` +
+``scatter_add_`` (calibration_error.py:29-59). Here binning is a fused
+``searchsorted`` + one-shot ``.at[].add`` scatter — a single XLA scatter kernel per
+statistic, jit-safe with static ``n_bins``.
+"""
+from typing import Optional, Tuple, Union
+
+import jax
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.classification.confusion_matrix import (
+    _binary_confusion_matrix_format,
+    _binary_confusion_matrix_tensor_validation,
+    _multiclass_confusion_matrix_format,
+    _multiclass_confusion_matrix_tensor_validation,
+)
+from metrics_tpu.functional.classification.stat_scores import _is_floating
+from metrics_tpu.utils.enums import ClassificationTaskNoMultilabel
+
+
+def _binning_bucketize(
+    confidences: Array, accuracies: Array, bin_boundaries: Array, valid: Optional[Array] = None
+) -> Tuple[Array, Array, Array]:
+    """Binned accuracy/confidence/proportion (reference: calibration_error.py:29-59).
+
+    ``valid`` is an optional 0/1 mask: masked-out samples contribute zero weight, which
+    is the jit-safe (static-shape) equivalent of the reference's ignore_index filtering.
+    """
+    accuracies = accuracies.astype(confidences.dtype)
+    n_bins = bin_boundaries.shape[0]
+    indices = jnp.searchsorted(bin_boundaries, confidences, side="right") - 1
+    indices = jnp.clip(indices, 0, n_bins - 1)
+    weight = jnp.ones_like(confidences) if valid is None else valid.astype(confidences.dtype)
+
+    count_bin = jnp.zeros(n_bins, dtype=confidences.dtype).at[indices].add(weight)
+    conf_bin = jnp.zeros(n_bins, dtype=confidences.dtype).at[indices].add(confidences * weight)
+    acc_bin = jnp.zeros(n_bins, dtype=confidences.dtype).at[indices].add(accuracies * weight)
+
+    conf_bin = jnp.nan_to_num(conf_bin / count_bin)
+    acc_bin = jnp.nan_to_num(acc_bin / count_bin)
+    prop_bin = count_bin / count_bin.sum()
+    return acc_bin, conf_bin, prop_bin
+
+
+def _ce_compute(
+    confidences: Array,
+    accuracies: Array,
+    bin_boundaries: Union[Array, int],
+    norm: str = "l1",
+    debias: bool = False,
+    valid: Optional[Array] = None,
+) -> Array:
+    """Calibration error given bin boundaries and norm (reference: calibration_error.py:62-107)."""
+    if isinstance(bin_boundaries, int):
+        bin_boundaries = jnp.linspace(0.0, 1.0, bin_boundaries + 1, dtype=jnp.float32)
+    if norm not in {"l1", "l2", "max"}:
+        raise ValueError(f"Argument `norm` is expected to be one of 'l1', 'l2', 'max' but got {norm}")
+
+    acc_bin, conf_bin, prop_bin = _binning_bucketize(confidences, accuracies, bin_boundaries, valid)
+
+    if norm == "l1":
+        return jnp.sum(jnp.abs(acc_bin - conf_bin) * prop_bin)
+    if norm == "max":
+        return jnp.max(jnp.abs(acc_bin - conf_bin))
+    ce = jnp.sum(jnp.square(acc_bin - conf_bin) * prop_bin)
+    if debias:
+        debias_bins = (acc_bin * (acc_bin - 1) * prop_bin) / (prop_bin * accuracies.shape[0] - 1)
+        ce = ce + jnp.sum(jnp.nan_to_num(debias_bins))
+    return jnp.where(ce > 0, jnp.sqrt(jnp.maximum(ce, 0.0)), 0.0)
+
+
+def _binary_calibration_error_arg_validation(
+    n_bins: int,
+    norm: str = "l1",
+    ignore_index: Optional[int] = None,
+) -> None:
+    if not isinstance(n_bins, int) or n_bins < 1:
+        raise ValueError(f"Expected argument `n_bins` to be an integer larger than 0, but got {n_bins}")
+    allowed_norm = ("l1", "l2", "max")
+    if norm not in allowed_norm:
+        raise ValueError(f"Expected argument `norm` to be one of {allowed_norm}, but got {norm}.")
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+
+
+def _binary_calibration_error_tensor_validation(
+    preds: Array, target: Array, ignore_index: Optional[int] = None
+) -> None:
+    _binary_confusion_matrix_tensor_validation(preds, target, ignore_index)
+    if not _is_floating(preds):
+        raise ValueError(
+            "Expected argument `preds` to be floating tensor with probabilities/logits"
+            f" but got tensor with dtype {preds.dtype}"
+        )
+
+
+def _binary_calibration_error_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    confidences, accuracies = preds, target
+    return confidences, accuracies
+
+
+def binary_calibration_error(
+    preds: Array,
+    target: Array,
+    n_bins: int = 15,
+    norm: str = "l1",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Top-label calibration error for binary tasks (reference: calibration_error.py:140-208).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional.classification import binary_calibration_error
+        >>> preds = jnp.array([0.25, 0.25, 0.55, 0.75, 0.75])
+        >>> target = jnp.array([0, 0, 1, 1, 1])
+        >>> round(float(binary_calibration_error(preds, target, n_bins=2, norm='l1')), 4)
+        0.29
+    """
+    if validate_args:
+        _binary_calibration_error_arg_validation(n_bins, norm, ignore_index)
+        _binary_calibration_error_tensor_validation(preds, target, ignore_index)
+    preds, target = _binary_confusion_matrix_format(
+        preds, target, threshold=0.0, ignore_index=ignore_index, convert_to_labels=False
+    )
+    valid = (jnp.asarray(target) >= 0) if ignore_index is not None else None
+    confidences, accuracies = _binary_calibration_error_update(preds, jnp.maximum(target, 0))
+    return _ce_compute(confidences, accuracies, n_bins, norm, valid=valid)
+
+
+def _multiclass_calibration_error_arg_validation(
+    num_classes: int,
+    n_bins: int,
+    norm: str = "l1",
+    ignore_index: Optional[int] = None,
+) -> None:
+    if not isinstance(num_classes, int) or num_classes < 2:
+        raise ValueError(f"Expected argument `num_classes` to be an integer larger than 1, but got {num_classes}")
+    _binary_calibration_error_arg_validation(n_bins, norm, ignore_index)
+
+
+def _multiclass_calibration_error_tensor_validation(
+    preds: Array, target: Array, num_classes: int, ignore_index: Optional[int] = None
+) -> None:
+    _multiclass_confusion_matrix_tensor_validation(preds, target, num_classes, ignore_index)
+    if not _is_floating(preds):
+        raise ValueError(
+            "Expected argument `preds` to be floating tensor with probabilities/logits"
+            f" but got tensor with dtype {preds.dtype}"
+        )
+
+
+def _multiclass_calibration_error_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Top-1 confidence + correctness (reference: calibration_error.py:235-244)."""
+    if not bool(jnp.all((preds >= 0) & (preds <= 1))):
+        preds = jax.nn.softmax(preds, axis=1)
+    confidences = preds.max(axis=1)
+    predictions = preds.argmax(axis=1)
+    accuracies = (predictions == target).astype(jnp.float32)
+    return confidences.astype(jnp.float32), accuracies
+
+
+def multiclass_calibration_error(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    n_bins: int = 15,
+    norm: str = "l1",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Top-label calibration error for multiclass tasks (reference: calibration_error.py:247-316).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional.classification import multiclass_calibration_error
+        >>> preds = jnp.array([[0.25, 0.20, 0.55],
+        ...                    [0.55, 0.05, 0.40],
+        ...                    [0.10, 0.30, 0.60],
+        ...                    [0.90, 0.05, 0.05]])
+        >>> target = jnp.array([0, 1, 2, 0])
+        >>> round(float(multiclass_calibration_error(preds, target, num_classes=3, n_bins=3, norm='l1')), 4)
+        0.2
+    """
+    if validate_args:
+        _multiclass_calibration_error_arg_validation(num_classes, n_bins, norm, ignore_index)
+        _multiclass_calibration_error_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target = _multiclass_confusion_matrix_format(preds, target, ignore_index, convert_to_labels=False)
+    valid = (jnp.asarray(target) >= 0) if ignore_index is not None else None
+    confidences, accuracies = _multiclass_calibration_error_update(preds, target)
+    return _ce_compute(confidences, accuracies, n_bins, norm, valid=valid)
+
+
+def calibration_error(
+    preds: Array,
+    target: Array,
+    task: str,
+    n_bins: int = 15,
+    norm: str = "l1",
+    num_classes: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Top-label calibration error dispatcher (reference: calibration_error.py:319-384)."""
+    task = ClassificationTaskNoMultilabel.from_str(task)
+    if task == ClassificationTaskNoMultilabel.BINARY:
+        return binary_calibration_error(preds, target, n_bins, norm, ignore_index, validate_args)
+    if task == ClassificationTaskNoMultilabel.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_calibration_error(preds, target, num_classes, n_bins, norm, ignore_index, validate_args)
+    raise ValueError(f"Not handled value: {task}")

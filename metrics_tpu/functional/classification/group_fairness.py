@@ -1,0 +1,237 @@
+"""Group-fairness functionals (reference: functional/classification/group_fairness.py).
+
+TPU-first design: the reference sorts by group, splits on host (`_flexible_bincount
+(...).cpu().tolist()` + ``torch.split``, group_fairness.py:51-81) and loops over the
+groups. Here per-group tp/fp/tn/fn come from ONE fused bincount over the joint index
+``group * 4 + 2*target + preds`` — a single XLA scatter-add, no host round-trip, static
+``(num_groups, 4)`` output shape.
+"""
+from typing import Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.functional.classification.stat_scores import (
+    _binary_stat_scores_arg_validation,
+    _binary_stat_scores_format,
+    _binary_stat_scores_tensor_validation,
+)
+from metrics_tpu.utils.compute import _safe_divide
+
+
+def _groups_validation(groups: Array, num_groups: int) -> None:
+    """Validate group tensor (reference: group_fairness.py:29-43).
+
+    Delta vs reference: ids ``>= num_groups`` and negative ids are rejected outright
+    (the reference's ``> num_groups`` off-by-one lets an id equal to ``num_groups``
+    through and emits a surprise extra group; the static-shape scatter kernel here
+    would silently drop such samples instead, so they are made a hard error).
+    """
+    g = np.asarray(groups)
+    if g.size and g.max() >= num_groups:
+        raise ValueError(
+            f"The largest number in the groups tensor is {g.max()}, which is larger than the specified"
+            f" number of groups {num_groups}. The group identifiers should be ``0, 1, ..., (num_groups - 1)``."
+        )
+    if g.size and g.min() < 0:
+        raise ValueError(
+            f"The smallest number in the groups tensor is {g.min()}; negative group ids are not valid."
+            " The group identifiers should be ``0, 1, ..., (num_groups - 1)``."
+        )
+    if not np.issubdtype(g.dtype, np.integer):
+        raise ValueError(f"Expected dtype of argument groups to be int, not {g.dtype}.")
+
+
+def _groups_format(groups: Array) -> Array:
+    """Reshape groups to correspond to preds and target (reference: group_fairness.py:46-48)."""
+    groups = jnp.asarray(groups)
+    return groups.reshape(groups.shape[0], -1)
+
+
+def _binary_groups_stat_scores_update(
+    preds: Array, target: Array, groups: Array, num_groups: int
+) -> Tuple[Array, Array, Array, Array]:
+    """Per-group (tp, fp, tn, fn), each shape ``(num_groups,)``, via one fused
+    scatter-add. Replaces the reference's host-side sort/split/loop
+    (group_fairness.py:57-81)."""
+    groups = groups.ravel()
+    # out-of-range group ids get zero weight (jit-safe; validation rejects them eagerly)
+    valid = (target.ravel() >= 0) & (groups >= 0) & (groups < num_groups)
+    mapping = jnp.clip(groups, 0, num_groups - 1) * 4 + 2 * jnp.maximum(target, 0).ravel() + preds.ravel()
+    weights = valid.astype(jnp.int32)
+    bins = jnp.zeros(4 * num_groups, dtype=jnp.int32).at[mapping].add(weights)
+    bins = bins.reshape(num_groups, 4)  # columns: t0p0=tn, t0p1=fp, t1p0=fn, t1p1=tp
+    tn, fp, fn, tp = bins[:, 0], bins[:, 1], bins[:, 2], bins[:, 3]
+    return tp, fp, tn, fn
+
+
+def _binary_groups_stat_scores(
+    preds: Array,
+    target: Array,
+    groups: Array,
+    num_groups: int,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> List[Tuple[Array, Array, Array, Array]]:
+    """Group stat scores as a per-group list (reference: group_fairness.py:51-81)."""
+    if validate_args:
+        _binary_stat_scores_arg_validation(threshold, "global", ignore_index)
+        _binary_stat_scores_tensor_validation(preds, target, "global", ignore_index)
+        _groups_validation(groups, num_groups)
+    preds, target = _binary_stat_scores_format(preds, target, threshold, ignore_index)
+    groups = _groups_format(groups)
+    tp, fp, tn, fn = _binary_groups_stat_scores_update(preds, target, groups, num_groups)
+    return [(tp[g], fp[g], tn[g], fn[g]) for g in range(num_groups)]
+
+
+def _groups_reduce(group_stats: List[Tuple[Array, Array, Array, Array]]) -> Dict[str, Array]:
+    """Rates per group (reference: group_fairness.py:84-89)."""
+    return {
+        f"group_{group}": jnp.stack(stats) / jnp.stack(stats).sum() for group, stats in enumerate(group_stats)
+    }
+
+
+def _groups_stat_transform(group_stats: List[Tuple[Array, Array, Array, Array]]) -> Dict[str, Array]:
+    """Stack per-statistic tensors (reference: group_fairness.py:92-100)."""
+    return {
+        "tp": jnp.stack([stat[0] for stat in group_stats]),
+        "fp": jnp.stack([stat[1] for stat in group_stats]),
+        "tn": jnp.stack([stat[2] for stat in group_stats]),
+        "fn": jnp.stack([stat[3] for stat in group_stats]),
+    }
+
+
+def binary_groups_stat_rates(
+    preds: Array,
+    target: Array,
+    groups: Array,
+    num_groups: int,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Dict[str, Array]:
+    """True/false positive and negative rates per group (reference: group_fairness.py:103-160).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional.classification import binary_groups_stat_rates
+        >>> target = jnp.array([0, 1, 0, 1, 0, 1])
+        >>> preds = jnp.array([0, 1, 0, 1, 0, 1])
+        >>> groups = jnp.array([0, 1, 0, 1, 0, 1])
+        >>> binary_groups_stat_rates(preds, target, groups, 2)
+        {'group_0': Array([0., 0., 1., 0.], dtype=float32), 'group_1': Array([1., 0., 0., 0.], dtype=float32)}
+    """
+    group_stats = _binary_groups_stat_scores(preds, target, groups, num_groups, threshold, ignore_index, validate_args)
+    return _groups_reduce(group_stats)
+
+
+def _compute_binary_demographic_parity(tp: Array, fp: Array, tn: Array, fn: Array) -> Dict[str, Array]:
+    """Demographic parity from binary stats (reference: group_fairness.py:163-173)."""
+    pos_rates = _safe_divide(tp + fp, tp + fp + tn + fn)
+    min_pos_rate_id = int(jnp.argmin(pos_rates))
+    max_pos_rate_id = int(jnp.argmax(pos_rates))
+    return {
+        f"DP_{min_pos_rate_id}_{max_pos_rate_id}": _safe_divide(
+            pos_rates[min_pos_rate_id], pos_rates[max_pos_rate_id]
+        )
+    }
+
+
+def demographic_parity(
+    preds: Array,
+    groups: Array,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Dict[str, Array]:
+    """Demographic parity ratio between groups (reference: group_fairness.py:176-236).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional.classification import demographic_parity
+        >>> preds = jnp.array([0.11, 0.84, 0.22, 0.73, 0.33, 0.92])
+        >>> groups = jnp.array([0, 1, 0, 1, 0, 1])
+        >>> demographic_parity(preds, groups)
+        {'DP_0_1': Array(0., dtype=float32)}
+    """
+    num_groups = int(np.asarray(groups).max()) + 1
+    target = jnp.zeros_like(jnp.asarray(preds), dtype=jnp.int32).reshape(jnp.asarray(preds).shape)
+    group_stats = _binary_groups_stat_scores(preds, target, groups, num_groups, threshold, ignore_index, validate_args)
+    transformed_group_stats = _groups_stat_transform(group_stats)
+    return _compute_binary_demographic_parity(**transformed_group_stats)
+
+
+def _compute_binary_equal_opportunity(tp: Array, fp: Array, tn: Array, fn: Array) -> Dict[str, Array]:
+    """Equal opportunity from binary stats (reference: group_fairness.py:239-251)."""
+    true_pos_rates = _safe_divide(tp, tp + fn)
+    min_pos_rate_id = int(jnp.argmin(true_pos_rates))
+    max_pos_rate_id = int(jnp.argmax(true_pos_rates))
+    return {
+        f"EO_{min_pos_rate_id}_{max_pos_rate_id}": _safe_divide(
+            true_pos_rates[min_pos_rate_id], true_pos_rates[max_pos_rate_id]
+        )
+    }
+
+
+def equal_opportunity(
+    preds: Array,
+    target: Array,
+    groups: Array,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Dict[str, Array]:
+    """Equal opportunity ratio between groups (reference: group_fairness.py:254-318).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional.classification import equal_opportunity
+        >>> target = jnp.array([0, 1, 0, 1, 0, 1])
+        >>> preds = jnp.array([0.11, 0.84, 0.22, 0.73, 0.33, 0.92])
+        >>> groups = jnp.array([0, 1, 0, 1, 0, 1])
+        >>> equal_opportunity(preds, target, groups)
+        {'EO_0_1': Array(0., dtype=float32)}
+    """
+    num_groups = int(np.asarray(groups).max()) + 1
+    group_stats = _binary_groups_stat_scores(preds, target, groups, num_groups, threshold, ignore_index, validate_args)
+    transformed_group_stats = _groups_stat_transform(group_stats)
+    return _compute_binary_equal_opportunity(**transformed_group_stats)
+
+
+def binary_fairness(
+    preds: Array,
+    target: Array,
+    groups: Array,
+    task: str = "all",
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Dict[str, Array]:
+    """Demographic parity and/or equal opportunity (reference: group_fairness.py:321-381)."""
+    if task not in ["demographic_parity", "equal_opportunity", "all"]:
+        raise ValueError(
+            f"Expected argument `task` to either be ``demographic_parity``,"
+            f"``equal_opportunity`` or ``all`` but got {task}."
+        )
+    if task == "demographic_parity":
+        if target is not None:
+            import warnings
+
+            warnings.warn("The task demographic_parity does not require a target.", UserWarning)
+        target = jnp.zeros(jnp.asarray(preds).shape, dtype=jnp.int32)
+
+    num_groups = int(np.asarray(groups).max()) + 1
+    group_stats = _binary_groups_stat_scores(preds, target, groups, num_groups, threshold, ignore_index, validate_args)
+    transformed_group_stats = _groups_stat_transform(group_stats)
+
+    if task == "demographic_parity":
+        return _compute_binary_demographic_parity(**transformed_group_stats)
+    if task == "equal_opportunity":
+        return _compute_binary_equal_opportunity(**transformed_group_stats)
+
+    results = {}
+    results.update(_compute_binary_demographic_parity(**transformed_group_stats))
+    results.update(_compute_binary_equal_opportunity(**transformed_group_stats))
+    return results

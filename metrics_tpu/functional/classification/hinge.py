@@ -1,0 +1,187 @@
+"""Hinge loss functionals (reference: functional/classification/hinge.py).
+
+TPU-first: ignore_index handling is a 0-weight mask (static shapes under jit) rather
+than the reference's boolean-index filtering.
+"""
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.classification.confusion_matrix import (
+    _binary_confusion_matrix_format,
+    _binary_confusion_matrix_tensor_validation,
+    _multiclass_confusion_matrix_format,
+    _multiclass_confusion_matrix_tensor_validation,
+)
+from metrics_tpu.functional.classification.stat_scores import _is_floating
+from metrics_tpu.utils.data import to_onehot
+from metrics_tpu.utils.enums import ClassificationTaskNoMultilabel
+
+
+def _hinge_loss_compute(measure: Array, total: Array) -> Array:
+    """Reference: hinge.py:30-31."""
+    return measure / total
+
+
+def _binary_hinge_loss_arg_validation(squared: bool, ignore_index: Optional[int] = None) -> None:
+    if not isinstance(squared, bool):
+        raise ValueError(f"Expected argument `squared` to be an bool but got {squared}")
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+
+
+def _binary_hinge_loss_tensor_validation(preds: Array, target: Array, ignore_index: Optional[int] = None) -> None:
+    _binary_confusion_matrix_tensor_validation(preds, target, ignore_index)
+    if not _is_floating(preds):
+        raise ValueError(
+            "Expected argument `preds` to be floating tensor with probabilities/logits"
+            f" but got tensor with dtype {preds.dtype}"
+        )
+
+
+def _binary_hinge_loss_update(preds: Array, target: Array, squared: bool) -> Tuple[Array, Array]:
+    """Margin sums (reference: hinge.py:50-67). Targets < 0 (ignore_index) get 0 weight."""
+    valid = target >= 0
+    margin = jnp.where(target == 1, preds, -preds)
+    measures = jnp.clip(1 - margin, 0, None)
+    if squared:
+        measures = jnp.square(measures)
+    measures = jnp.where(valid, measures, 0.0)
+    total = valid.sum()
+    return measures.sum(axis=0), total
+
+
+def binary_hinge_loss(
+    preds: Array,
+    target: Array,
+    squared: bool = False,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = False,
+) -> Array:
+    """Mean hinge loss for binary tasks (reference: hinge.py:70-123).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional.classification import binary_hinge_loss
+        >>> preds = jnp.array([0.25, 0.25, 0.55, 0.75, 0.75])
+        >>> target = jnp.array([0, 0, 1, 1, 1])
+        >>> binary_hinge_loss(preds, target)
+        Array(0.69, dtype=float32)
+    """
+    if validate_args:
+        _binary_hinge_loss_arg_validation(squared, ignore_index)
+        _binary_hinge_loss_tensor_validation(preds, target, ignore_index)
+    preds, target = _binary_confusion_matrix_format(
+        preds, target, threshold=0.0, ignore_index=ignore_index, convert_to_labels=False
+    )
+    measures, total = _binary_hinge_loss_update(preds, target, squared)
+    return _hinge_loss_compute(measures, total)
+
+
+def _multiclass_hinge_loss_arg_validation(
+    num_classes: int,
+    squared: bool = False,
+    multiclass_mode: str = "crammer-singer",
+    ignore_index: Optional[int] = None,
+) -> None:
+    _binary_hinge_loss_arg_validation(squared, ignore_index)
+    if not isinstance(num_classes, int) or num_classes < 2:
+        raise ValueError(f"Expected argument `num_classes` to be an integer larger than 1, but got {num_classes}")
+    allowed_mm = ("crammer-singer", "one-vs-all")
+    if multiclass_mode not in allowed_mm:
+        raise ValueError(f"Expected argument `multiclass_mode` to be one of {allowed_mm}, but got {multiclass_mode}.")
+
+
+def _multiclass_hinge_loss_tensor_validation(
+    preds: Array, target: Array, num_classes: int, ignore_index: Optional[int] = None
+) -> None:
+    _multiclass_confusion_matrix_tensor_validation(preds, target, num_classes, ignore_index)
+    if not _is_floating(preds):
+        raise ValueError(
+            "Expected argument `preds` to be floating tensor with probabilities/logits"
+            f" but got tensor with dtype {preds.dtype}"
+        )
+
+
+def _multiclass_hinge_loss_update(
+    preds: Array,
+    target: Array,
+    squared: bool,
+    multiclass_mode: str = "crammer-singer",
+) -> Tuple[Array, Array]:
+    """Margin sums (reference: hinge.py:153-177). Targets < 0 get 0 weight."""
+    if not bool(jnp.all((preds >= 0) & (preds <= 1))):
+        preds = jax.nn.softmax(preds, axis=1)
+
+    valid = target >= 0
+    target_idx = jnp.maximum(target, 0)
+    target_onehot = to_onehot(target_idx, max(2, preds.shape[1])).astype(bool)
+    if multiclass_mode == "crammer-singer":
+        margin = jnp.sum(jnp.where(target_onehot, preds, 0.0), axis=1)
+        margin = margin - jnp.max(jnp.where(target_onehot, -jnp.inf, preds), axis=1)
+    else:
+        target_sign = 2 * target_onehot.astype(preds.dtype) - 1
+        margin = target_sign * preds
+
+    measures = jnp.clip(1 - margin, 0, None)
+    if squared:
+        measures = jnp.square(measures)
+    if measures.ndim > 1:
+        measures = jnp.where(valid[:, None], measures, 0.0)
+    else:
+        measures = jnp.where(valid, measures, 0.0)
+    total = valid.sum()
+    return measures.sum(axis=0), total
+
+
+def multiclass_hinge_loss(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    squared: bool = False,
+    multiclass_mode: str = "crammer-singer",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = False,
+) -> Array:
+    """Mean hinge loss for multiclass tasks (reference: hinge.py:180-245).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional.classification import multiclass_hinge_loss
+        >>> preds = jnp.array([[0.25, 0.20, 0.55],
+        ...                    [0.55, 0.05, 0.40],
+        ...                    [0.10, 0.30, 0.60],
+        ...                    [0.90, 0.05, 0.05]])
+        >>> target = jnp.array([0, 1, 2, 0])
+        >>> multiclass_hinge_loss(preds, target, num_classes=3)
+        Array(0.9125, dtype=float32)
+    """
+    if validate_args:
+        _multiclass_hinge_loss_arg_validation(num_classes, squared, multiclass_mode, ignore_index)
+        _multiclass_hinge_loss_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target = _multiclass_confusion_matrix_format(preds, target, ignore_index, convert_to_labels=False)
+    measures, total = _multiclass_hinge_loss_update(preds, target, squared, multiclass_mode)
+    return _hinge_loss_compute(measures, total)
+
+
+def hinge_loss(
+    preds: Array,
+    target: Array,
+    task: str,
+    num_classes: Optional[int] = None,
+    squared: bool = False,
+    multiclass_mode: str = "crammer-singer",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Hinge loss dispatcher (reference: hinge.py:248-305)."""
+    task = ClassificationTaskNoMultilabel.from_str(task)
+    if task == ClassificationTaskNoMultilabel.BINARY:
+        return binary_hinge_loss(preds, target, squared, ignore_index, validate_args)
+    if task == ClassificationTaskNoMultilabel.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_hinge_loss(preds, target, num_classes, squared, multiclass_mode, ignore_index, validate_args)
+    raise ValueError(f"Not handled value: {task}")

@@ -1,0 +1,139 @@
+"""Precision-at-fixed-recall functionals (reference: functional/classification/precision_fixed_recall.py)."""
+from typing import List, Optional, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.classification.precision_recall_curve import (
+    _binary_precision_recall_curve_format,
+    _binary_precision_recall_curve_tensor_validation,
+    _binary_precision_recall_curve_update,
+    _multiclass_precision_recall_curve_format,
+    _multiclass_precision_recall_curve_tensor_validation,
+    _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_tensor_validation,
+    _multilabel_precision_recall_curve_update,
+)
+from metrics_tpu.functional.classification.recall_fixed_precision import (
+    _binary_recall_at_fixed_precision_arg_validation,
+    _binary_recall_at_fixed_precision_compute,
+    _lexicographic_best,
+    _multiclass_recall_at_fixed_precision_arg_compute,
+    _multiclass_recall_at_fixed_precision_arg_validation,
+    _multilabel_recall_at_fixed_precision_arg_compute,
+    _multilabel_recall_at_fixed_precision_arg_validation,
+)
+from metrics_tpu.utils.enums import ClassificationTask
+
+
+def _precision_at_recall(
+    precision: Array,
+    recall: Array,
+    thresholds: Array,
+    min_recall: float,
+) -> Tuple[Array, Array]:
+    """Reference: precision_fixed_recall.py:42-60 (max precision s.t. recall >= min)."""
+    return _lexicographic_best(precision, recall, thresholds, min_recall)
+
+
+def binary_precision_at_fixed_recall(
+    preds: Array,
+    target: Array,
+    min_recall: float,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Highest precision given minimum recall, binary (reference: precision_fixed_recall.py:63-138).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional.classification import binary_precision_at_fixed_recall
+        >>> preds = jnp.array([0, 0.5, 0.7, 0.8])
+        >>> target = jnp.array([0, 1, 1, 0])
+        >>> binary_precision_at_fixed_recall(preds, target, min_recall=0.5, thresholds=5)
+        (Array(0.6666667, dtype=float32), Array(0.5, dtype=float32))
+    """
+    if validate_args:
+        _binary_recall_at_fixed_precision_arg_validation(min_recall, thresholds, ignore_index)
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds, target, thresholds = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
+    state = _binary_precision_recall_curve_update(preds, target, thresholds)
+    return _binary_recall_at_fixed_precision_compute(
+        state, thresholds, min_recall, reduce_fn=_precision_at_recall
+    )
+
+
+def multiclass_precision_at_fixed_recall(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    min_recall: float,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Highest precision given minimum recall, multiclass (reference: precision_fixed_recall.py:141-231)."""
+    if validate_args:
+        _multiclass_recall_at_fixed_precision_arg_validation(num_classes, min_recall, thresholds, ignore_index)
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, thresholds = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index
+    )
+    state = _multiclass_precision_recall_curve_update(preds, target, num_classes, thresholds)
+    return _multiclass_recall_at_fixed_precision_arg_compute(
+        state, num_classes, thresholds, min_recall, reduce_fn=_precision_at_recall
+    )
+
+
+def multilabel_precision_at_fixed_recall(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    min_recall: float,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Highest precision given minimum recall, multilabel (reference: precision_fixed_recall.py:234-313)."""
+    if validate_args:
+        _multilabel_recall_at_fixed_precision_arg_validation(num_labels, min_recall, thresholds, ignore_index)
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, thresholds = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    state = _multilabel_precision_recall_curve_update(preds, target, num_labels, thresholds)
+    return _multilabel_recall_at_fixed_precision_arg_compute(
+        state, num_labels, thresholds, ignore_index, min_recall, reduce_fn=_precision_at_recall
+    )
+
+
+def precision_at_fixed_recall(
+    preds: Array,
+    target: Array,
+    task: str,
+    min_recall: float,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Union[Tuple[Array, Array], Tuple[List[Array], List[Array]]]:
+    """Dispatcher (reference: precision_fixed_recall.py:316-365)."""
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_precision_at_fixed_recall(preds, target, min_recall, thresholds, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_precision_at_fixed_recall(
+            preds, target, num_classes, min_recall, thresholds, ignore_index, validate_args
+        )
+    if task == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_precision_at_fixed_recall(
+            preds, target, num_labels, min_recall, thresholds, ignore_index, validate_args
+        )
+    raise ValueError(f"Not handled value: {task}")

@@ -1,0 +1,170 @@
+"""Multilabel ranking functionals (reference: functional/classification/ranking.py).
+
+TPU-first design: the reference loops over samples for label-ranking average precision
+(ranking.py:251-268) using ``torch.unique``-based tie ranks. Here ranks-with-ties are
+computed as fully-vectorized pairwise comparison sums over the (small) label axis:
+``rank(x_j) = #{k : x_k <= x_j}`` — an O(N*C^2) batched matmul-shaped kernel that maps
+onto the MXU, with no host loop and no data-dependent shapes.
+"""
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.classification.confusion_matrix import (
+    _multilabel_confusion_matrix_arg_validation,
+    _multilabel_confusion_matrix_format,
+    _multilabel_confusion_matrix_tensor_validation,
+)
+from metrics_tpu.functional.classification.stat_scores import _is_floating
+
+
+def _rank_data(x: Array) -> Array:
+    """Rank with ties resolved to the max rank of the tie group (reference: ranking.py:27-33).
+
+    ``_rank_data(x)[j] = #{k : x_k <= x_j}`` — matches the reference's
+    unique+cumsum-of-counts formulation without data-dependent shapes.
+    """
+    return (x[None, :] <= x[:, None]).sum(axis=1)
+
+
+def _ranking_reduce(score: Array, n_elements: Array) -> Array:
+    return score / n_elements
+
+
+def _multilabel_ranking_tensor_validation(
+    preds: Array, target: Array, num_labels: int, ignore_index: Optional[int] = None
+) -> None:
+    _multilabel_confusion_matrix_tensor_validation(preds, target, num_labels, ignore_index)
+    if not _is_floating(preds):
+        raise ValueError(f"Expected preds tensor to be floating point, but received input with dtype {preds.dtype}")
+
+
+def _multilabel_coverage_error_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Accumulate state for coverage error (reference: ranking.py:48-55)."""
+    offset = jnp.where(target == 0, jnp.abs(preds.min()) + 10, 0.0)
+    preds_mod = preds + offset
+    preds_min = preds_mod.min(axis=1)
+    coverage = (preds >= preds_min[:, None]).sum(axis=1).astype(jnp.float32)
+    return coverage.sum(), coverage.size
+
+
+def multilabel_coverage_error(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Multilabel coverage error (reference: ranking.py:58-108).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from metrics_tpu.functional.classification import multilabel_coverage_error
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(0), (10, 5))
+        >>> target = jax.random.randint(jax.random.PRNGKey(1), (10, 5), 0, 2)
+        >>> float(multilabel_coverage_error(preds, target, num_labels=5)) > 0
+        True
+    """
+    if validate_args:
+        _multilabel_confusion_matrix_arg_validation(num_labels, threshold=0.0, ignore_index=ignore_index)
+        _multilabel_ranking_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target = _multilabel_confusion_matrix_format(
+        preds, target, num_labels, threshold=0.0, ignore_index=ignore_index, should_threshold=False
+    )
+    coverage, total = _multilabel_coverage_error_update(preds, target)
+    return _ranking_reduce(coverage, total)
+
+
+def _multilabel_ranking_average_precision_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Label-ranking AP state (reference: ranking.py:251-268), vectorized.
+
+    For each sample: over relevant labels, mean of
+    (rank among relevant of -pred) / (rank among all of -pred); 1.0 when no relevant
+    labels or all labels relevant.
+    """
+    neg_preds = -preds
+    n_preds, n_labels = neg_preds.shape
+    relevant = target == 1
+
+    # rank(x_j) = #{k: x_k <= x_j}; relevant-only ranks mask the comparison set
+    le = neg_preds[:, None, :] <= neg_preds[:, :, None]  # (N, C, C): le[i, j, k] = x_k <= x_j
+    rank_all = le.sum(axis=2).astype(jnp.float32)
+    rank_rel = (le & relevant[:, None, :]).sum(axis=2).astype(jnp.float32)
+
+    n_relevant = relevant.sum(axis=1)
+    per_label = jnp.where(relevant, rank_rel / rank_all, 0.0)
+    score_idx = jnp.where(n_relevant > 0, per_label.sum(axis=1) / jnp.maximum(n_relevant, 1), 1.0)
+    score_idx = jnp.where((n_relevant > 0) & (n_relevant < n_labels), score_idx, 1.0)
+    return score_idx.sum(), n_preds
+
+
+def multilabel_ranking_average_precision(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Label ranking average precision for multilabel data (reference: ranking.py:271-321).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from metrics_tpu.functional.classification import multilabel_ranking_average_precision
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(0), (10, 5))
+        >>> target = jax.random.randint(jax.random.PRNGKey(1), (10, 5), 0, 2)
+        >>> 0 <= float(multilabel_ranking_average_precision(preds, target, num_labels=5)) <= 1
+        True
+    """
+    if validate_args:
+        _multilabel_confusion_matrix_arg_validation(num_labels, threshold=0.0, ignore_index=ignore_index)
+        _multilabel_ranking_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target = _multilabel_confusion_matrix_format(
+        preds, target, num_labels, threshold=0.0, ignore_index=ignore_index, should_threshold=False
+    )
+    score, n_elements = _multilabel_ranking_average_precision_update(preds, target)
+    return _ranking_reduce(score, n_elements)
+
+
+def _multilabel_ranking_loss_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Label ranking loss state (reference: ranking.py:184-209), vectorized with masks
+    instead of boolean-filtered shapes."""
+    n_preds, n_labels = preds.shape
+    relevant = target == 1
+    n_relevant = relevant.sum(axis=1)
+    mask = (n_relevant > 0) & (n_relevant < n_labels)
+
+    inverse = jnp.argsort(jnp.argsort(preds, axis=1), axis=1)
+    per_label_loss = ((n_labels - inverse) * relevant).astype(jnp.float32)
+    correction = 0.5 * n_relevant * (n_relevant + 1)
+    denom = n_relevant * (n_labels - n_relevant)
+    loss = (per_label_loss.sum(axis=1) - correction) / jnp.maximum(denom, 1)
+    loss = jnp.where(mask, loss, 0.0)
+    return loss.sum(), n_preds
+
+
+def multilabel_ranking_loss(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Label ranking loss for multilabel data (reference: ranking.py:212-263).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from metrics_tpu.functional.classification import multilabel_ranking_loss
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(0), (10, 5))
+        >>> target = jax.random.randint(jax.random.PRNGKey(1), (10, 5), 0, 2)
+        >>> float(multilabel_ranking_loss(preds, target, num_labels=5)) >= 0
+        True
+    """
+    if validate_args:
+        _multilabel_confusion_matrix_arg_validation(num_labels, threshold=0.0, ignore_index=ignore_index)
+        _multilabel_ranking_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target = _multilabel_confusion_matrix_format(
+        preds, target, num_labels, threshold=0.0, ignore_index=ignore_index, should_threshold=False
+    )
+    loss, n_elements = _multilabel_ranking_loss_update(preds, target)
+    return _ranking_reduce(loss, n_elements)

@@ -1,0 +1,248 @@
+"""Specificity-at-sensitivity functionals (reference: functional/classification/specificity_sensitivity.py)."""
+from typing import List, Optional, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.functional.classification.precision_recall_curve import (
+    _binary_precision_recall_curve_arg_validation,
+    _binary_precision_recall_curve_format,
+    _binary_precision_recall_curve_tensor_validation,
+    _binary_precision_recall_curve_update,
+    _multiclass_precision_recall_curve_arg_validation,
+    _multiclass_precision_recall_curve_format,
+    _multiclass_precision_recall_curve_tensor_validation,
+    _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_arg_validation,
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_tensor_validation,
+    _multilabel_precision_recall_curve_update,
+)
+from metrics_tpu.functional.classification.roc import (
+    _binary_roc_compute,
+    _multiclass_roc_compute,
+    _multilabel_roc_compute,
+)
+from metrics_tpu.utils.enums import ClassificationTask
+
+
+def _convert_fpr_to_specificity(fpr: Array) -> Array:
+    """Reference: specificity_sensitivity.py:42-44."""
+    return 1 - fpr
+
+
+def _specificity_at_sensitivity(
+    specificity: Array,
+    sensitivity: Array,
+    thresholds: Array,
+    min_sensitivity: float,
+) -> Tuple[Array, Array]:
+    """Max specificity with sensitivity >= min (reference: specificity_sensitivity.py:47-70)."""
+    spec = np.asarray(specificity, dtype=np.float64)
+    sens = np.asarray(sensitivity, dtype=np.float64)
+    thr = np.asarray(thresholds, dtype=np.float64)
+    indices = sens >= min_sensitivity
+    if not indices.any():
+        return jnp.asarray(0.0, dtype=jnp.float32), jnp.asarray(1e6, dtype=jnp.float32)
+    spec, thr = spec[indices], thr[indices]
+    idx = int(np.argmax(spec))
+    return jnp.asarray(spec[idx], dtype=jnp.float32), jnp.asarray(thr[idx], dtype=jnp.float32)
+
+
+def _binary_specificity_at_sensitivity_arg_validation(
+    min_sensitivity: float,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+) -> None:
+    _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+    if not isinstance(min_sensitivity, float) and not (0 <= min_sensitivity <= 1):
+        raise ValueError(
+            f"Expected argument `min_sensitivity` to be an float in the [0,1] range, but got {min_sensitivity}"
+        )
+
+
+def _binary_specificity_at_sensitivity_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    thresholds: Optional[Array],
+    min_sensitivity: float,
+    pos_label: int = 1,
+) -> Tuple[Array, Array]:
+    """Reference: specificity_sensitivity.py:84-93."""
+    fpr, sensitivity, thresholds = _binary_roc_compute(state, thresholds, pos_label)
+    specificity = _convert_fpr_to_specificity(fpr)
+    return _specificity_at_sensitivity(specificity, sensitivity, thresholds, min_sensitivity)
+
+
+def binary_specificity_at_sensitivity(
+    preds: Array,
+    target: Array,
+    min_sensitivity: float,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Highest specificity given minimum sensitivity, binary (reference: specificity_sensitivity.py:96-170).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional.classification import binary_specificity_at_sensitivity
+        >>> preds = jnp.array([0, 0.5, 0.4, 0.1])
+        >>> target = jnp.array([0, 1, 1, 1])
+        >>> binary_specificity_at_sensitivity(preds, target, min_sensitivity=0.5, thresholds=5)
+        (Array(1., dtype=float32), Array(0.25, dtype=float32))
+    """
+    if validate_args:
+        _binary_specificity_at_sensitivity_arg_validation(min_sensitivity, thresholds, ignore_index)
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds, target, thresholds = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
+    state = _binary_precision_recall_curve_update(preds, target, thresholds)
+    return _binary_specificity_at_sensitivity_compute(state, thresholds, min_sensitivity)
+
+
+def _multiclass_specificity_at_sensitivity_arg_validation(
+    num_classes: int,
+    min_sensitivity: float,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+) -> None:
+    _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index)
+    if not isinstance(min_sensitivity, float) and not (0 <= min_sensitivity <= 1):
+        raise ValueError(
+            f"Expected argument `min_sensitivity` to be an float in the [0,1] range, but got {min_sensitivity}"
+        )
+
+
+def _multiclass_specificity_at_sensitivity_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    num_classes: int,
+    thresholds: Optional[Array],
+    min_sensitivity: float,
+) -> Tuple[Array, Array]:
+    """Reference: specificity_sensitivity.py:184-201."""
+    fpr, sensitivity, thresholds = _multiclass_roc_compute(state, num_classes, thresholds)
+    if isinstance(fpr, list):
+        specificity = [_convert_fpr_to_specificity(f) for f in fpr]
+        res = [
+            _specificity_at_sensitivity(sp, sn, t, min_sensitivity)
+            for sp, sn, t in zip(specificity, sensitivity, thresholds)
+        ]
+    else:
+        specificity = _convert_fpr_to_specificity(fpr)
+        res = [
+            _specificity_at_sensitivity(sp, sn, thresholds, min_sensitivity)
+            for sp, sn in zip(specificity, sensitivity)
+        ]
+    return jnp.stack([r[0] for r in res]), jnp.stack([r[1] for r in res])
+
+
+def multiclass_specificity_at_sensitivity(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    min_sensitivity: float,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Highest specificity given minimum sensitivity, multiclass (reference: specificity_sensitivity.py:204-288)."""
+    if validate_args:
+        _multiclass_specificity_at_sensitivity_arg_validation(num_classes, min_sensitivity, thresholds, ignore_index)
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, thresholds = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index
+    )
+    state = _multiclass_precision_recall_curve_update(preds, target, num_classes, thresholds)
+    return _multiclass_specificity_at_sensitivity_compute(state, num_classes, thresholds, min_sensitivity)
+
+
+def _multilabel_specificity_at_sensitivity_arg_validation(
+    num_labels: int,
+    min_sensitivity: float,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+) -> None:
+    _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+    if not isinstance(min_sensitivity, float) and not (0 <= min_sensitivity <= 1):
+        raise ValueError(
+            f"Expected argument `min_sensitivity` to be an float in the [0,1] range, but got {min_sensitivity}"
+        )
+
+
+def _multilabel_specificity_at_sensitivity_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    num_labels: int,
+    thresholds: Optional[Array],
+    ignore_index: Optional[int],
+    min_sensitivity: float,
+) -> Tuple[Array, Array]:
+    """Reference: specificity_sensitivity.py:302-320."""
+    fpr, sensitivity, thresholds = _multilabel_roc_compute(state, num_labels, thresholds, ignore_index)
+    if isinstance(fpr, list):
+        specificity = [_convert_fpr_to_specificity(f) for f in fpr]
+        res = [
+            _specificity_at_sensitivity(sp, sn, t, min_sensitivity)
+            for sp, sn, t in zip(specificity, sensitivity, thresholds)
+        ]
+    else:
+        specificity = _convert_fpr_to_specificity(fpr)
+        res = [
+            _specificity_at_sensitivity(sp, sn, thresholds, min_sensitivity)
+            for sp, sn in zip(specificity, sensitivity)
+        ]
+    return jnp.stack([r[0] for r in res]), jnp.stack([r[1] for r in res])
+
+
+def multilabel_specificity_at_sensitivity(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    min_sensitivity: float,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Highest specificity given minimum sensitivity, multilabel (reference: specificity_sensitivity.py:323-401)."""
+    if validate_args:
+        _multilabel_specificity_at_sensitivity_arg_validation(num_labels, min_sensitivity, thresholds, ignore_index)
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, thresholds = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    state = _multilabel_precision_recall_curve_update(preds, target, num_labels, thresholds)
+    return _multilabel_specificity_at_sensitivity_compute(state, num_labels, thresholds, ignore_index, min_sensitivity)
+
+
+def specicity_at_sensitivity(
+    preds: Array,
+    target: Array,
+    task: str,
+    min_sensitivity: float,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Union[Tuple[Array, Array], Tuple[List[Array], List[Array]]]:
+    """Dispatcher; the reference public name carries this typo (specificity_sensitivity.py:404)."""
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_specificity_at_sensitivity(
+            preds, target, min_sensitivity, thresholds, ignore_index, validate_args
+        )
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_specificity_at_sensitivity(
+            preds, target, num_classes, min_sensitivity, thresholds, ignore_index, validate_args
+        )
+    if task == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_specificity_at_sensitivity(
+            preds, target, num_labels, min_sensitivity, thresholds, ignore_index, validate_args
+        )
+    raise ValueError(f"Not handled value: {task}")
+
+
+specificity_at_sensitivity = specicity_at_sensitivity

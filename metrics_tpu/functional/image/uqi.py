@@ -65,4 +65,4 @@ def universal_image_quality_index(
     eps = jnp.finfo(jnp.float32).eps
     uqi_idx = ((2 * mu_pred_target) * upper) / ((mu_pred_sq + mu_target_sq) * lower + eps)
     uqi_idx = uqi_idx[..., pad_h:-pad_h, pad_w:-pad_w]
-    return reduce(uqi_idx.reshape(uqi_idx.shape[0], -1).mean(-1), reduction)
+    return reduce(uqi_idx, reduction)

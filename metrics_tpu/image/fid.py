@@ -110,6 +110,8 @@ class FrechetInceptionDistance(Metric):
 
     def compute(self) -> Array:
         """FID from accumulated moments (reference: image/fid.py:341-356)."""
+        if not getattr(self, "_states_ready", False):
+            raise RuntimeError("More than one sample is required for both the real and fake distributed to compute FID")
         if float(self.real_features_num_samples) < 2 or float(self.fake_features_num_samples) < 2:
             raise RuntimeError("More than one sample is required for both the real and fake distributed to compute FID")
         mean_real, cov_real = _mean_cov_from_sums(

@@ -118,7 +118,9 @@ class KernelInceptionDistance(Metric):
             raise ValueError("Argument `subset_size` should be smaller than the number of samples")
 
         kid_scores_ = []
-        rng = np.random.default_rng()
+        # the seedable global state mirrors the reference's torch.randperm +
+        # torch.manual_seed reproducibility contract (image/kid.py:234-247)
+        rng = np.random.default_rng(np.random.randint(0, 2**31))
         for _ in range(self.subsets):
             perm = rng.permutation(n_samples_real)
             f_real = real_features[perm[: self.subset_size]]

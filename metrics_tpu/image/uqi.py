@@ -9,7 +9,12 @@ from metrics_tpu.functional.image.uqi import universal_image_quality_index
 
 
 class UniversalImageQualityIndex(Metric):
-    """UQI over batches (per-image scores averaged)."""
+    """UQI over batches (reference: image/uqi.py:30-120).
+
+    TPU-first delta: instead of the reference's cat-lists of full images
+    (image/uqi.py:92-93), `sum`/`elementwise_mean` reductions accumulate the pixel-level
+    UQI sum + element count (constant memory); `none` keeps the per-batch maps.
+    """
 
     is_differentiable: bool = True
     higher_is_better: bool = True
@@ -26,15 +31,24 @@ class UniversalImageQualityIndex(Metric):
         self.kernel_size = kernel_size
         self.sigma = sigma
         self.reduction = reduction
-        self.add_state("score_sum", default=jnp.asarray(0.0), dist_reduce_fx="sum")
-        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        if reduction in ("none", None):
+            self.add_state("score_maps", default=[], dist_reduce_fx="cat")
+        else:
+            self.add_state("score_sum", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+            self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
-        score = universal_image_quality_index(preds, target, self.kernel_size, self.sigma, reduction="sum")
-        self.score_sum = self.score_sum + score
-        self.total = self.total + preds.shape[0]
+        if self.reduction in ("none", None):
+            score = universal_image_quality_index(preds, target, self.kernel_size, self.sigma, reduction="none")
+            self.score_maps.append(score)
+        else:
+            score_map = universal_image_quality_index(preds, target, self.kernel_size, self.sigma, reduction="none")
+            self.score_sum = self.score_sum + score_map.sum()
+            self.total = self.total + score_map.size
 
     def compute(self) -> Array:
+        if self.reduction in ("none", None):
+            return jnp.concatenate([jnp.asarray(s) for s in self.score_maps], axis=0)
         if self.reduction == "sum":
             return self.score_sum
         return self.score_sum / self.total

@@ -1,0 +1,371 @@
+"""Differential tests for the remaining classification metrics: calibration error,
+exact match, hinge, ranking, group fairness, dice, *-at-fixed-* families.
+
+References: sklearn where available; hand-checked reference doctest values otherwise
+(reference: tests/unittests/classification/test_{calibration_error,exact_match,
+hinge,ranking,group_fairness,dice,recall_fixed_precision}.py).
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.special import expit, softmax
+from sklearn.metrics import coverage_error, label_ranking_average_precision_score, label_ranking_loss
+
+from metrics_tpu.classification import (
+    BinaryCalibrationError,
+    BinaryFairness,
+    BinaryGroupStatRates,
+    BinaryHingeLoss,
+    BinaryPrecisionAtFixedRecall,
+    BinaryRecallAtFixedPrecision,
+    BinarySpecificityAtSensitivity,
+    CalibrationError,
+    Dice,
+    ExactMatch,
+    HingeLoss,
+    MulticlassCalibrationError,
+    MulticlassExactMatch,
+    MulticlassHingeLoss,
+    MulticlassRecallAtFixedPrecision,
+    MultilabelCoverageError,
+    MultilabelExactMatch,
+    MultilabelRankingAveragePrecision,
+    MultilabelRankingLoss,
+)
+from metrics_tpu.functional.classification import (
+    binary_calibration_error,
+    binary_hinge_loss,
+    dice,
+    multiclass_calibration_error,
+    multiclass_exact_match,
+    multiclass_hinge_loss,
+    multilabel_coverage_error,
+    multilabel_exact_match,
+    multilabel_ranking_average_precision,
+    multilabel_ranking_loss,
+)
+
+import sys, os
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+from helpers import seed_all  # noqa: E402
+from helpers.testers import BATCH_SIZE, NUM_BATCHES, NUM_CLASSES, MetricTester  # noqa: E402
+
+seed_all(42)
+
+_rng = np.random.default_rng(42)
+
+
+def _ref_calibration_error(confidences, accuracies, n_bins, norm):
+    """NumPy reimplementation of binned ECE, matching sklearn-style binning."""
+    bins = np.linspace(0, 1, n_bins + 1)
+    idx = np.clip(np.searchsorted(bins, confidences, side="right") - 1, 0, n_bins)
+    acc_bin = np.zeros(n_bins + 1)
+    conf_bin = np.zeros(n_bins + 1)
+    count = np.zeros(n_bins + 1)
+    np.add.at(count, idx, 1)
+    np.add.at(conf_bin, idx, confidences)
+    np.add.at(acc_bin, idx, accuracies)
+    with np.errstate(invalid="ignore"):
+        conf_bin = np.nan_to_num(conf_bin / count)
+        acc_bin = np.nan_to_num(acc_bin / count)
+    prop = count / count.sum()
+    if norm == "l1":
+        return np.sum(np.abs(acc_bin - conf_bin) * prop)
+    if norm == "max":
+        return np.max(np.abs(acc_bin - conf_bin))
+    return np.sqrt(max(np.sum((acc_bin - conf_bin) ** 2 * prop), 0.0))
+
+
+class TestBinaryCalibrationError(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize("norm", ["l1", "l2", "max"])
+    def test_functional(self, norm):
+        preds = _rng.random((NUM_BATCHES, BATCH_SIZE))
+        target = _rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE))
+        self.run_functional_metric_test(
+            preds,
+            target,
+            binary_calibration_error,
+            lambda p, t: _ref_calibration_error(p, t, 15, norm),
+            metric_args={"n_bins": 15, "norm": norm},
+        )
+
+    @pytest.mark.parametrize("norm", ["l1", "l2", "max"])
+    def test_class(self, norm):
+        preds = _rng.random((NUM_BATCHES, BATCH_SIZE))
+        target = _rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE))
+        self.run_class_metric_test(
+            preds,
+            target,
+            BinaryCalibrationError,
+            lambda p, t: _ref_calibration_error(np.asarray(p).ravel(), np.asarray(t).ravel(), 15, norm),
+            metric_args={"n_bins": 15, "norm": norm},
+        )
+
+
+class TestMulticlassCalibrationError(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize("norm", ["l1", "l2"])
+    def test_functional(self, norm):
+        preds = softmax(_rng.normal(size=(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)), axis=-1)
+        target = _rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE))
+
+        def ref(p, t):
+            conf = p.max(axis=1)
+            acc = (p.argmax(axis=1) == t).astype(float)
+            return _ref_calibration_error(conf, acc, 15, norm)
+
+        self.run_functional_metric_test(
+            preds, target, multiclass_calibration_error, ref,
+            metric_args={"num_classes": NUM_CLASSES, "n_bins": 15, "norm": norm},
+        )
+
+
+class TestExactMatch(MetricTester):
+    atol = 1e-6
+
+    def test_multiclass_global(self):
+        preds = _rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE, 4))
+        target = _rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE, 4))
+
+        def ref(p, t):
+            return ((p == t).all(axis=1)).mean()
+
+        self.run_functional_metric_test(
+            preds, target, multiclass_exact_match, ref, metric_args={"num_classes": NUM_CLASSES}
+        )
+        self.run_class_metric_test(
+            preds,
+            target,
+            MulticlassExactMatch,
+            lambda p, t: ((np.asarray(p) == np.asarray(t)).all(axis=1)).mean(),
+            metric_args={"num_classes": NUM_CLASSES},
+        )
+
+    def test_multilabel_global(self):
+        preds = _rng.random((NUM_BATCHES, BATCH_SIZE, NUM_CLASSES))
+        target = _rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES))
+
+        def ref(p, t):
+            ph = (p > 0.5).astype(int)
+            return (ph == t).all(axis=1).mean()
+
+        self.run_functional_metric_test(
+            preds, target, multilabel_exact_match, ref, metric_args={"num_labels": NUM_CLASSES}
+        )
+
+    def test_dispatcher(self):
+        m = ExactMatch(task="multiclass", num_classes=3)
+        assert isinstance(m, MulticlassExactMatch)
+        m = ExactMatch(task="multilabel", num_labels=3)
+        assert isinstance(m, MultilabelExactMatch)
+
+
+def _ref_binary_hinge(preds, target, squared):
+    p = np.asarray(preds, dtype=np.float64)
+    if not ((p >= 0) & (p <= 1)).all():
+        p = expit(p)
+    t = 2 * np.asarray(target) - 1
+    margin = 1 - t * p
+    margin = np.clip(margin, 0, None)
+    if squared:
+        margin = margin**2
+    return margin.mean()
+
+
+class TestHingeLoss(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize("squared", [False, True])
+    def test_binary(self, squared):
+        preds = _rng.random((NUM_BATCHES, BATCH_SIZE))
+        target = _rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE))
+        self.run_functional_metric_test(
+            preds, target, binary_hinge_loss, lambda p, t: _ref_binary_hinge(p, t, squared),
+            metric_args={"squared": squared},
+        )
+        self.run_class_metric_test(
+            preds, target, BinaryHingeLoss, lambda p, t: _ref_binary_hinge(p, t, squared),
+            metric_args={"squared": squared},
+        )
+
+    def test_multiclass_reference_values(self):
+        # reference doctest values (functional/classification/hinge.py:225-236)
+        preds = jnp.array([[0.25, 0.20, 0.55], [0.55, 0.05, 0.40], [0.10, 0.30, 0.60], [0.90, 0.05, 0.05]])
+        target = jnp.array([0, 1, 2, 0])
+        assert np.isclose(float(multiclass_hinge_loss(preds, target, num_classes=3)), 0.9125, atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(multiclass_hinge_loss(preds, target, num_classes=3, multiclass_mode="one-vs-all")),
+            [0.8750, 1.1250, 1.1000],
+            atol=1e-6,
+        )
+        m = HingeLoss(task="multiclass", num_classes=3)
+        assert isinstance(m, MulticlassHingeLoss)
+        assert np.isclose(float(m(preds, target)), 0.9125, atol=1e-6)
+
+
+class TestRanking(MetricTester):
+    atol = 1e-5
+
+    @pytest.mark.parametrize(
+        ("functional", "klass", "ref"),
+        [
+            (multilabel_coverage_error, MultilabelCoverageError, coverage_error),
+            (
+                multilabel_ranking_average_precision,
+                MultilabelRankingAveragePrecision,
+                label_ranking_average_precision_score,
+            ),
+            (multilabel_ranking_loss, MultilabelRankingLoss, label_ranking_loss),
+        ],
+    )
+    def test_vs_sklearn(self, functional, klass, ref):
+        preds = _rng.random((NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)).astype(np.float32)
+        target = _rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES))
+        self.run_functional_metric_test(
+            preds, target, functional, lambda p, t: ref(t, p), metric_args={"num_labels": NUM_CLASSES}
+        )
+        self.run_class_metric_test(
+            preds,
+            target,
+            klass,
+            lambda p, t: ref(np.asarray(t).reshape(-1, NUM_CLASSES), np.asarray(p).reshape(-1, NUM_CLASSES)),
+            metric_args={"num_labels": NUM_CLASSES},
+        )
+
+
+class TestGroupFairness(MetricTester):
+    atol = 1e-6
+
+    def test_stat_rates(self):
+        target = jnp.array([0, 1, 0, 1, 0, 1])
+        preds = jnp.array([0.11, 0.84, 0.22, 0.73, 0.33, 0.92])
+        groups = jnp.array([0, 1, 0, 1, 0, 1])
+        metric = BinaryGroupStatRates(num_groups=2)
+        out = metric(preds, target, groups)
+        np.testing.assert_allclose(np.asarray(out["group_0"]), [0, 0, 1, 0])
+        np.testing.assert_allclose(np.asarray(out["group_1"]), [1, 0, 0, 0])
+
+    def test_fairness_ratios(self):
+        rng = np.random.default_rng(0)
+        preds = rng.random(200)
+        target = rng.integers(0, 2, 200)
+        groups = rng.integers(0, 3, 200)
+        metric = BinaryFairness(3, task="all")
+        out = metric(jnp.array(preds), jnp.array(target), jnp.array(groups))
+
+        ph = (preds > 0.5).astype(int)
+        pos_rates = np.array([(ph[groups == g]).mean() for g in range(3)])
+        dp_key = f"DP_{pos_rates.argmin()}_{pos_rates.argmax()}"
+        assert dp_key in out
+        np.testing.assert_allclose(float(out[dp_key]), pos_rates.min() / pos_rates.max(), atol=1e-6)
+
+        tprs = np.array([(ph[(groups == g) & (target == 1)]).mean() for g in range(3)])
+        eo_key = f"EO_{tprs.argmin()}_{tprs.argmax()}"
+        assert eo_key in out
+        np.testing.assert_allclose(float(out[eo_key]), tprs.min() / tprs.max(), atol=1e-6)
+
+
+class TestDice(MetricTester):
+    atol = 1e-6
+
+    def test_micro_vs_f1(self):
+        from sklearn.metrics import f1_score
+
+        # micro dice == micro f1 on multiclass labels
+        preds = _rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE))
+        target = _rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE))
+        for i in range(NUM_BATCHES):
+            val = dice(jnp.array(preds[i]), jnp.array(target[i]), average="micro")
+            ref = f1_score(target[i], preds[i], average="micro")
+            np.testing.assert_allclose(float(val), ref, atol=1e-6)
+
+    def test_macro(self):
+        from sklearn.metrics import f1_score
+
+        preds = _rng.integers(0, NUM_CLASSES, 200)
+        target = _rng.integers(0, NUM_CLASSES, 200)
+        val = dice(jnp.array(preds), jnp.array(target), average="macro", num_classes=NUM_CLASSES)
+        ref = f1_score(target, preds, average="macro")
+        np.testing.assert_allclose(float(val), ref, atol=1e-6)
+
+    def test_class(self):
+        from sklearn.metrics import f1_score
+
+        preds = _rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE))
+        target = _rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE))
+        metric = Dice(average="micro")
+        for i in range(NUM_BATCHES):
+            metric.update(jnp.array(preds[i]), jnp.array(target[i]))
+        ref = f1_score(target.ravel(), preds.ravel(), average="micro")
+        np.testing.assert_allclose(float(metric.compute()), ref, atol=1e-6)
+
+
+class TestFixedPointMetrics(MetricTester):
+    atol = 1e-6
+
+    def _sk_curve(self, preds, target):
+        from sklearn.metrics import precision_recall_curve as sk_prc
+
+        return sk_prc(target, preds)
+
+    def test_binary_recall_at_fixed_precision_exact_vs_sklearn(self):
+        preds = _rng.random(200).astype(np.float32)
+        target = _rng.integers(0, 2, 200)
+        prec, rec, thr = self._sk_curve(preds, target)
+        min_precision = 0.6
+        valid = [(r, p, t) for p, r, t in zip(prec, rec, thr) if p >= min_precision]
+        exp_recall, _, exp_thr = max(valid)
+
+        metric = BinaryRecallAtFixedPrecision(min_precision=min_precision, thresholds=None)
+        res_recall, res_thr = metric(jnp.array(preds), jnp.array(target))
+        np.testing.assert_allclose(float(res_recall), exp_recall, atol=1e-6)
+        np.testing.assert_allclose(float(res_thr), exp_thr, atol=1e-6)
+
+    def test_binary_precision_at_fixed_recall_exact_vs_sklearn(self):
+        preds = _rng.random(200).astype(np.float32)
+        target = _rng.integers(0, 2, 200)
+        prec, rec, thr = self._sk_curve(preds, target)
+        min_recall = 0.5
+        valid = [(p, r, t) for p, r, t in zip(prec, rec, thr) if r >= min_recall]
+        exp_precision, _, exp_thr = max(valid)
+
+        metric = BinaryPrecisionAtFixedRecall(min_recall=min_recall, thresholds=None)
+        res_precision, res_thr = metric(jnp.array(preds), jnp.array(target))
+        np.testing.assert_allclose(float(res_precision), exp_precision, atol=1e-6)
+
+    def test_binary_specificity_at_sensitivity_exact_vs_sklearn(self):
+        from sklearn.metrics import roc_curve
+
+        preds = _rng.random(200).astype(np.float32)
+        target = _rng.integers(0, 2, 200)
+        fpr, tpr, thr = roc_curve(target, preds)
+        spec = 1 - fpr
+        min_sensitivity = 0.5
+        mask = tpr >= min_sensitivity
+        exp_spec = spec[mask].max()
+
+        metric = BinarySpecificityAtSensitivity(min_sensitivity=min_sensitivity, thresholds=None)
+        res_spec, res_thr = metric(jnp.array(preds), jnp.array(target))
+        np.testing.assert_allclose(float(res_spec), exp_spec, atol=1e-6)
+
+    def test_multiclass_recall_at_fixed_precision_shapes(self):
+        preds = softmax(_rng.normal(size=(BATCH_SIZE, NUM_CLASSES)), axis=-1)
+        target = _rng.integers(0, NUM_CLASSES, BATCH_SIZE)
+        metric = MulticlassRecallAtFixedPrecision(num_classes=NUM_CLASSES, min_precision=0.5, thresholds=20)
+        rec, thr = metric(jnp.array(preds), jnp.array(target))
+        assert rec.shape == (NUM_CLASSES,)
+        assert thr.shape == (NUM_CLASSES,)
+        # binned vs exact should roughly agree
+        metric2 = MulticlassRecallAtFixedPrecision(num_classes=NUM_CLASSES, min_precision=0.5, thresholds=None)
+        rec2, _ = metric2(jnp.array(preds), jnp.array(target))
+        assert np.all(np.asarray(rec2) >= np.asarray(rec) - 1e-6)
+
+    def test_dispatchers(self):
+        m = CalibrationError(task="binary")
+        assert isinstance(m, BinaryCalibrationError)
+        m = CalibrationError(task="multiclass", num_classes=4)
+        assert isinstance(m, MulticlassCalibrationError)
