@@ -31,6 +31,9 @@ def _binning_bucketize(
     is the jit-safe (static-shape) equivalent of the reference's ignore_index filtering.
     """
     accuracies = accuracies.astype(confidences.dtype)
+    # len(bin_boundaries) bins (= n_bins+1): confidences exactly 1.0 land in a final
+    # phantom bin, matching the reference's bucketize(right=True)-1 behavior exactly
+    # (calibration_error.py:44-48; verified equal on saturated probabilities)
     n_bins = bin_boundaries.shape[0]
     indices = jnp.searchsorted(bin_boundaries, confidences, side="right") - 1
     indices = jnp.clip(indices, 0, n_bins - 1)

@@ -1,0 +1,98 @@
+"""Cramer's V functionals (reference: functional/nominal/cramers.py)."""
+import itertools
+from typing import Optional, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.functional.classification.confusion_matrix import _multiclass_confusion_matrix_update
+from metrics_tpu.functional.nominal.utils import (
+    _compute_bias_corrected_values,
+    _compute_chi_squared,
+    _drop_empty_rows_and_cols,
+    _handle_nan_in_data,
+    _nominal_input_validation,
+    _unable_to_use_bias_correction_warning,
+)
+
+
+def _cramers_v_update(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[Union[int, float]] = 0.0,
+) -> Array:
+    """Confusion-matrix bins for Cramer's V (reference: cramers.py:32-55)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    preds = preds.argmax(1) if preds.ndim == 2 else preds
+    target = target.argmax(1) if target.ndim == 2 else target
+    preds, target = _handle_nan_in_data(preds, target, nan_strategy, nan_replace_value)
+    return _multiclass_confusion_matrix_update(
+        preds.astype(jnp.int32).ravel(), target.astype(jnp.int32).ravel(), num_classes
+    )
+
+
+def _cramers_v_compute(confmat: Array, bias_correction: bool) -> Array:
+    """Cramer's V from a confusion matrix (reference: cramers.py:58-85)."""
+    confmat = _drop_empty_rows_and_cols(confmat)
+    cm_sum = confmat.sum()
+    chi_squared = _compute_chi_squared(confmat, bias_correction)
+    phi_squared = chi_squared / cm_sum
+    n_rows, n_cols = confmat.shape
+
+    if bias_correction:
+        phi_squared_corrected, rows_corrected, cols_corrected = _compute_bias_corrected_values(
+            phi_squared, n_rows, n_cols, cm_sum
+        )
+        if float(jnp.minimum(rows_corrected, cols_corrected)) == 1:
+            _unable_to_use_bias_correction_warning(metric_name="Cramer's V")
+            return jnp.asarray(jnp.nan)
+        cramers_v_value = jnp.sqrt(phi_squared_corrected / jnp.minimum(rows_corrected - 1, cols_corrected - 1))
+    else:
+        cramers_v_value = jnp.sqrt(phi_squared / min(n_rows - 1, n_cols - 1))
+    return jnp.clip(cramers_v_value, 0.0, 1.0)
+
+
+def cramers_v(
+    preds: Array,
+    target: Array,
+    bias_correction: bool = True,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[Union[int, float]] = 0.0,
+) -> Array:
+    """Cramer's V statistic of association between two categorical series (reference: cramers.py:88-135).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from metrics_tpu.functional.nominal import cramers_v
+        >>> preds = jax.random.randint(jax.random.PRNGKey(42), (100,), 0, 4)
+        >>> target = (preds + jax.random.randint(jax.random.PRNGKey(43), (100,), 0, 2)) % 4
+        >>> 0 <= float(cramers_v(preds, target)) <= 1
+        True
+    """
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    num_classes = len(np.unique(np.concatenate([np.asarray(preds).ravel(), np.asarray(target).ravel()])))
+    confmat = _cramers_v_update(preds, target, num_classes, nan_strategy, nan_replace_value)
+    return _cramers_v_compute(confmat, bias_correction)
+
+
+def cramers_v_matrix(
+    matrix: Array,
+    bias_correction: bool = True,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[Union[int, float]] = 0.0,
+) -> Array:
+    """Cramer's V between all pairs of columns (reference: cramers.py:138-180)."""
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    matrix = jnp.asarray(matrix)
+    num_variables = matrix.shape[1]
+    out = np.ones((num_variables, num_variables), dtype=np.float32)
+    for i, j in itertools.combinations(range(num_variables), 2):
+        x, y = matrix[:, i], matrix[:, j]
+        num_classes = len(np.unique(np.concatenate([np.asarray(x), np.asarray(y)])))
+        confmat = _cramers_v_update(x, y, num_classes, nan_strategy, nan_replace_value)
+        out[i, j] = out[j, i] = float(_cramers_v_compute(confmat, bias_correction))
+    return jnp.asarray(out)

@@ -1,0 +1,83 @@
+"""Pearson's Contingency Coefficient functionals (reference: functional/nominal/pearson.py)."""
+import itertools
+from typing import Optional, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.functional.classification.confusion_matrix import _multiclass_confusion_matrix_update
+from metrics_tpu.functional.nominal.utils import (
+    _compute_chi_squared,
+    _drop_empty_rows_and_cols,
+    _handle_nan_in_data,
+    _nominal_input_validation,
+)
+
+
+def _pearsons_contingency_coefficient_update(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[Union[int, float]] = 0.0,
+) -> Array:
+    """Confusion-matrix bins (reference: pearson.py:30-53)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    preds = preds.argmax(1) if preds.ndim == 2 else preds
+    target = target.argmax(1) if target.ndim == 2 else target
+    preds, target = _handle_nan_in_data(preds, target, nan_strategy, nan_replace_value)
+    return _multiclass_confusion_matrix_update(
+        preds.astype(jnp.int32).ravel(), target.astype(jnp.int32).ravel(), num_classes
+    )
+
+
+def _pearsons_contingency_coefficient_compute(confmat: Array) -> Array:
+    """Pearson's contingency coefficient from a confusion matrix (reference: pearson.py:56-71)."""
+    confmat = _drop_empty_rows_and_cols(confmat)
+    cm_sum = confmat.sum()
+    chi_squared = _compute_chi_squared(confmat, bias_correction=False)
+    phi_squared = chi_squared / cm_sum
+    value = jnp.sqrt(phi_squared / (1 + phi_squared))
+    return jnp.clip(value, 0.0, 1.0)
+
+
+def pearsons_contingency_coefficient(
+    preds: Array,
+    target: Array,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[Union[int, float]] = 0.0,
+) -> Array:
+    """Pearson's Contingency Coefficient between two categorical series (reference: pearson.py:74-125).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from metrics_tpu.functional.nominal import pearsons_contingency_coefficient
+        >>> preds = jax.random.randint(jax.random.PRNGKey(42), (100,), 0, 4)
+        >>> target = (preds + jax.random.randint(jax.random.PRNGKey(43), (100,), 0, 2)) % 4
+        >>> 0 <= float(pearsons_contingency_coefficient(preds, target)) <= 1
+        True
+    """
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    num_classes = len(np.unique(np.concatenate([np.asarray(preds).ravel(), np.asarray(target).ravel()])))
+    confmat = _pearsons_contingency_coefficient_update(preds, target, num_classes, nan_strategy, nan_replace_value)
+    return _pearsons_contingency_coefficient_compute(confmat)
+
+
+def pearsons_contingency_coefficient_matrix(
+    matrix: Array,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[Union[int, float]] = 0.0,
+) -> Array:
+    """Pearson's contingency coefficient between all pairs of columns (reference: pearson.py:128-170)."""
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    matrix = jnp.asarray(matrix)
+    num_variables = matrix.shape[1]
+    out = np.ones((num_variables, num_variables), dtype=np.float32)
+    for i, j in itertools.combinations(range(num_variables), 2):
+        x, y = matrix[:, i], matrix[:, j]
+        num_classes = len(np.unique(np.concatenate([np.asarray(x), np.asarray(y)])))
+        confmat = _pearsons_contingency_coefficient_update(x, y, num_classes, nan_strategy, nan_replace_value)
+        out[i, j] = out[j, i] = float(_pearsons_contingency_coefficient_compute(confmat))
+    return jnp.asarray(out)

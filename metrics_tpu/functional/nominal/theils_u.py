@@ -1,0 +1,97 @@
+"""Theil's U functionals (reference: functional/nominal/theils_u.py)."""
+import itertools
+from typing import Optional, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.functional.classification.confusion_matrix import _multiclass_confusion_matrix_update
+from metrics_tpu.functional.nominal.utils import (
+    _drop_empty_rows_and_cols,
+    _handle_nan_in_data,
+    _nominal_input_validation,
+)
+
+
+def _conditional_entropy_compute(confmat: Array) -> Array:
+    """Conditional entropy H(X|Y) from a confusion matrix (reference: theils_u.py:30-51)."""
+    confmat = _drop_empty_rows_and_cols(confmat)
+    total_occurrences = confmat.sum()
+    p_xy_m = confmat / total_occurrences
+    p_y = confmat.sum(1) / total_occurrences
+    p_y_m = jnp.repeat(p_y[:, None], p_xy_m.shape[1], axis=1)
+    return jnp.nansum(p_xy_m * jnp.log(p_y_m / p_xy_m))
+
+
+def _theils_u_update(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[Union[int, float]] = 0.0,
+) -> Array:
+    """Confusion-matrix bins (reference: theils_u.py:54-76)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    preds = preds.argmax(1) if preds.ndim == 2 else preds
+    target = target.argmax(1) if target.ndim == 2 else target
+    preds, target = _handle_nan_in_data(preds, target, nan_strategy, nan_replace_value)
+    return _multiclass_confusion_matrix_update(
+        preds.astype(jnp.int32).ravel(), target.astype(jnp.int32).ravel(), num_classes
+    )
+
+
+def _theils_u_compute(confmat: Array) -> Array:
+    """Theil's U from a confusion matrix (reference: theils_u.py:79-101)."""
+    confmat = _drop_empty_rows_and_cols(confmat)
+    s_xy = _conditional_entropy_compute(confmat)
+    total_occurrences = confmat.sum()
+    p_x = confmat.sum(0) / total_occurrences
+    s_x = -jnp.sum(p_x * jnp.log(p_x))
+    if float(s_x) == 0:
+        return jnp.asarray(0.0)
+    return (s_x - s_xy) / s_x
+
+
+def theils_u(
+    preds: Array,
+    target: Array,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[Union[int, float]] = 0.0,
+) -> Array:
+    """Theil's U (uncertainty coefficient) between two categorical series (reference: theils_u.py:104-147).
+
+    Asymmetric: ``theils_u(preds, target) != theils_u(target, preds)`` in general.
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from metrics_tpu.functional.nominal import theils_u
+        >>> preds = jax.random.randint(jax.random.PRNGKey(42), (100,), 0, 4)
+        >>> target = (preds + jax.random.randint(jax.random.PRNGKey(43), (100,), 0, 2)) % 4
+        >>> 0 <= float(theils_u(preds, target)) <= 1
+        True
+    """
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    num_classes = len(np.unique(np.concatenate([np.asarray(preds).ravel(), np.asarray(target).ravel()])))
+    confmat = _theils_u_update(preds, target, num_classes, nan_strategy, nan_replace_value)
+    return _theils_u_compute(confmat)
+
+
+def theils_u_matrix(
+    matrix: Array,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[Union[int, float]] = 0.0,
+) -> Array:
+    """Theil's U between all pairs of columns, asymmetric (reference: theils_u.py:150-190)."""
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    matrix = jnp.asarray(matrix)
+    num_variables = matrix.shape[1]
+    out = np.ones((num_variables, num_variables), dtype=np.float32)
+    for i, j in itertools.combinations(range(num_variables), 2):
+        x, y = matrix[:, i], matrix[:, j]
+        num_classes = len(np.unique(np.concatenate([np.asarray(x), np.asarray(y)])))
+        confmat = _theils_u_update(x, y, num_classes, nan_strategy, nan_replace_value)
+        out[i, j] = float(_theils_u_compute(confmat))
+        out[j, i] = float(_theils_u_compute(confmat.T))
+    return jnp.asarray(out)

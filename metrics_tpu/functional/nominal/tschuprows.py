@@ -1,0 +1,98 @@
+"""Tschuprow's T functionals (reference: functional/nominal/tschuprows.py)."""
+import itertools
+from typing import Optional, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.functional.classification.confusion_matrix import _multiclass_confusion_matrix_update
+from metrics_tpu.functional.nominal.utils import (
+    _compute_bias_corrected_values,
+    _compute_chi_squared,
+    _drop_empty_rows_and_cols,
+    _handle_nan_in_data,
+    _nominal_input_validation,
+    _unable_to_use_bias_correction_warning,
+)
+
+
+def _tschuprows_t_update(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[Union[int, float]] = 0.0,
+) -> Array:
+    """Confusion-matrix bins (reference: tschuprows.py:32-55)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    preds = preds.argmax(1) if preds.ndim == 2 else preds
+    target = target.argmax(1) if target.ndim == 2 else target
+    preds, target = _handle_nan_in_data(preds, target, nan_strategy, nan_replace_value)
+    return _multiclass_confusion_matrix_update(
+        preds.astype(jnp.int32).ravel(), target.astype(jnp.int32).ravel(), num_classes
+    )
+
+
+def _tschuprows_t_compute(confmat: Array, bias_correction: bool) -> Array:
+    """Tschuprow's T from a confusion matrix (reference: tschuprows.py:58-87)."""
+    confmat = _drop_empty_rows_and_cols(confmat)
+    cm_sum = confmat.sum()
+    chi_squared = _compute_chi_squared(confmat, bias_correction)
+    phi_squared = chi_squared / cm_sum
+    n_rows, n_cols = confmat.shape
+
+    if bias_correction:
+        phi_squared_corrected, rows_corrected, cols_corrected = _compute_bias_corrected_values(
+            phi_squared, n_rows, n_cols, cm_sum
+        )
+        if float(jnp.minimum(rows_corrected, cols_corrected)) == 1:
+            _unable_to_use_bias_correction_warning(metric_name="Tschuprow's T")
+            return jnp.asarray(jnp.nan)
+        value = jnp.sqrt(phi_squared_corrected / jnp.sqrt((rows_corrected - 1) * (cols_corrected - 1)))
+    else:
+        value = jnp.sqrt(phi_squared / jnp.sqrt(jnp.asarray((n_rows - 1) * (n_cols - 1), jnp.float32)))
+    return jnp.clip(value, 0.0, 1.0)
+
+
+def tschuprows_t(
+    preds: Array,
+    target: Array,
+    bias_correction: bool = True,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[Union[int, float]] = 0.0,
+) -> Array:
+    """Tschuprow's T between two categorical series (reference: tschuprows.py:90-141).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from metrics_tpu.functional.nominal import tschuprows_t
+        >>> preds = jax.random.randint(jax.random.PRNGKey(42), (100,), 0, 4)
+        >>> target = (preds + jax.random.randint(jax.random.PRNGKey(43), (100,), 0, 2)) % 4
+        >>> 0 <= float(tschuprows_t(preds, target)) <= 1
+        True
+    """
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    num_classes = len(np.unique(np.concatenate([np.asarray(preds).ravel(), np.asarray(target).ravel()])))
+    confmat = _tschuprows_t_update(preds, target, num_classes, nan_strategy, nan_replace_value)
+    return _tschuprows_t_compute(confmat, bias_correction)
+
+
+def tschuprows_t_matrix(
+    matrix: Array,
+    bias_correction: bool = True,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[Union[int, float]] = 0.0,
+) -> Array:
+    """Tschuprow's T between all pairs of columns (reference: tschuprows.py:144-186)."""
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    matrix = jnp.asarray(matrix)
+    num_variables = matrix.shape[1]
+    out = np.ones((num_variables, num_variables), dtype=np.float32)
+    for i, j in itertools.combinations(range(num_variables), 2):
+        x, y = matrix[:, i], matrix[:, j]
+        num_classes = len(np.unique(np.concatenate([np.asarray(x), np.asarray(y)])))
+        confmat = _tschuprows_t_update(x, y, num_classes, nan_strategy, nan_replace_value)
+        out[i, j] = out[j, i] = float(_tschuprows_t_compute(confmat, bias_correction))
+    return jnp.asarray(out)

@@ -1,0 +1,92 @@
+"""Shared helpers for nominal-association metrics (reference: functional/nominal/utils.py)."""
+from typing import Optional, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.utils.prints import rank_zero_warn
+
+
+def _nominal_input_validation(nan_strategy: str, nan_replace_value: Optional[Union[int, float]]) -> None:
+    """Reference: utils.py:23-32."""
+    if nan_strategy not in ["replace", "drop"]:
+        raise ValueError(
+            f"Argument `nan_strategy` is expected to be one of `['replace', 'drop']`, but got {nan_strategy}"
+        )
+    if nan_strategy == "replace" and not isinstance(nan_replace_value, (int, float)):
+        raise ValueError(
+            "Argument `nan_replace` is expected to be of a type `int` or `float` when `nan_strategy = 'replace`, "
+            f"but got {nan_replace_value}"
+        )
+
+
+def _compute_expected_freqs(confmat: Array) -> Array:
+    """Expected frequencies under independence (reference: utils.py:35-38)."""
+    margin_sum_rows, margin_sum_cols = confmat.sum(1), confmat.sum(0)
+    return jnp.outer(margin_sum_rows, margin_sum_cols) / confmat.sum()
+
+
+def _compute_chi_squared(confmat: Array, bias_correction: bool) -> Array:
+    """Chi-square independence statistic (reference: utils.py:41-58)."""
+    expected_freqs = _compute_expected_freqs(confmat)
+    df = expected_freqs.size - sum(expected_freqs.shape) + expected_freqs.ndim - 1
+    if df == 0:
+        return jnp.asarray(0.0)
+    if df == 1 and bias_correction:
+        diff = expected_freqs - confmat
+        direction = jnp.sign(diff)
+        confmat = confmat + direction * jnp.minimum(0.5 * jnp.ones_like(direction), jnp.abs(diff))
+    return jnp.sum((confmat - expected_freqs) ** 2 / expected_freqs)
+
+
+def _drop_empty_rows_and_cols(confmat: Array) -> Array:
+    """Drop all-zero rows/columns; compute-time host op (reference: utils.py:61-79)."""
+    cm = np.asarray(confmat)
+    cm = cm[cm.sum(1) != 0]
+    cm = cm[:, cm.sum(0) != 0]
+    return jnp.asarray(cm)
+
+
+def _compute_phi_squared_corrected(
+    phi_squared: Array, n_rows: int, n_cols: int, confmat_sum: Array
+) -> Array:
+    """Reference: utils.py:82-91."""
+    return jnp.maximum(jnp.asarray(0.0), phi_squared - ((n_rows - 1) * (n_cols - 1)) / (confmat_sum - 1))
+
+
+def _compute_rows_and_cols_corrected(n_rows: int, n_cols: int, confmat_sum: Array) -> Tuple[Array, Array]:
+    """Reference: utils.py:94-98."""
+    rows_corrected = n_rows - (n_rows - 1) ** 2 / (confmat_sum - 1)
+    cols_corrected = n_cols - (n_cols - 1) ** 2 / (confmat_sum - 1)
+    return rows_corrected, cols_corrected
+
+
+def _compute_bias_corrected_values(
+    phi_squared: Array, n_rows: int, n_cols: int, confmat_sum: Array
+) -> Tuple[Array, Array, Array]:
+    """Reference: utils.py:101-107."""
+    phi_squared_corrected = _compute_phi_squared_corrected(phi_squared, n_rows, n_cols, confmat_sum)
+    rows_corrected, cols_corrected = _compute_rows_and_cols_corrected(n_rows, n_cols, confmat_sum)
+    return phi_squared_corrected, rows_corrected, cols_corrected
+
+
+def _handle_nan_in_data(
+    preds: Array,
+    target: Array,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Tuple[Array, Array]:
+    """Replace or drop NaN rows (reference: utils.py:110-137)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if nan_strategy == "replace":
+        return jnp.nan_to_num(preds, nan=nan_replace_value), jnp.nan_to_num(target, nan=nan_replace_value)
+    rows_contain_nan = np.logical_or(np.isnan(np.asarray(preds)), np.isnan(np.asarray(target)))
+    return preds[~rows_contain_nan], target[~rows_contain_nan]
+
+
+def _unable_to_use_bias_correction_warning(metric_name: str) -> None:
+    rank_zero_warn(
+        f"Unable to compute {metric_name} using bias correction. Please consider to set `bias_correction=False`."
+    )

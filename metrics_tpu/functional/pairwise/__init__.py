@@ -1,0 +1,13 @@
+from metrics_tpu.functional.pairwise.cosine import pairwise_cosine_similarity
+from metrics_tpu.functional.pairwise.euclidean import pairwise_euclidean_distance
+from metrics_tpu.functional.pairwise.linear import pairwise_linear_similarity
+from metrics_tpu.functional.pairwise.manhattan import pairwise_manhattan_distance
+from metrics_tpu.functional.pairwise.minkowski import pairwise_minkowski_distance
+
+__all__ = [
+    "pairwise_cosine_similarity",
+    "pairwise_euclidean_distance",
+    "pairwise_linear_similarity",
+    "pairwise_manhattan_distance",
+    "pairwise_minkowski_distance",
+]

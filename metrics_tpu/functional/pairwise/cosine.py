@@ -1,0 +1,43 @@
+"""Pairwise cosine similarity (reference: functional/pairwise/cosine.py)."""
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.pairwise.helpers import _check_input, _reduce_distance_matrix, _zero_diagonal
+from metrics_tpu.utils.compute import _safe_matmul
+
+
+def _pairwise_cosine_similarity_update(
+    x: Array, y: Optional[Array] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    """Pairwise cosine similarity matrix (reference: cosine.py:24-45)."""
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    x = x / jnp.linalg.norm(x, ord=2, axis=1, keepdims=True)
+    y = y / jnp.linalg.norm(y, ord=2, axis=1, keepdims=True)
+    distance = _safe_matmul(x, y)
+    if zero_diagonal:
+        distance = _zero_diagonal(distance)
+    return distance
+
+
+def pairwise_cosine_similarity(
+    x: Array,
+    y: Optional[Array] = None,
+    reduction: Optional[str] = None,
+    zero_diagonal: Optional[bool] = None,
+) -> Array:
+    """Pairwise cosine similarity between rows of ``x`` (and ``y``) (reference: cosine.py:48-95).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional.pairwise import pairwise_cosine_similarity
+        >>> x = jnp.array([[2., 3.], [3., 5.], [5., 8.]])
+        >>> y = jnp.array([[1., 0.], [2., 1.]])
+        >>> pairwise_cosine_similarity(x, y)
+        Array([[0.5547002 , 0.86824316],
+               [0.5144958 , 0.84366155],
+               [0.52999896, 0.85328186]], dtype=float32)
+    """
+    distance = _pairwise_cosine_similarity_update(x, y, zero_diagonal)
+    return _reduce_distance_matrix(distance, reduction)

@@ -1,0 +1,53 @@
+"""Pairwise euclidean distance (reference: functional/pairwise/euclidean.py).
+
+TPU note: the ``x_norm + y_norm - 2 x y^T`` decomposition keeps the O(N*M*d) work in
+one MXU matmul instead of a broadcasted subtract; the reference's float64 upcast maps
+to float64-under-x64 / float32 otherwise (TPU default).
+"""
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.pairwise.helpers import _check_input, _reduce_distance_matrix, _zero_diagonal
+
+
+def _pairwise_euclidean_distance_update(
+    x: Array, y: Optional[Array] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    """Pairwise euclidean distance matrix (reference: euclidean.py:23-43)."""
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    import jax
+
+    _orig_dtype = x.dtype
+    acc_dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    x = x.astype(acc_dtype)
+    y = y.astype(acc_dtype)
+    x_norm = (x * x).sum(axis=1, keepdims=True)
+    y_norm = (y * y).sum(axis=1)
+    distance = (x_norm + y_norm - 2 * x @ y.T).astype(_orig_dtype)
+    if zero_diagonal:
+        distance = _zero_diagonal(distance)
+    return jnp.sqrt(jnp.maximum(distance, 0.0))
+
+
+def pairwise_euclidean_distance(
+    x: Array,
+    y: Optional[Array] = None,
+    reduction: Optional[str] = None,
+    zero_diagonal: Optional[bool] = None,
+) -> Array:
+    """Pairwise euclidean distance between rows of ``x`` (and ``y``) (reference: euclidean.py:46-87).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional.pairwise import pairwise_euclidean_distance
+        >>> x = jnp.array([[2., 3.], [3., 5.], [5., 8.]])
+        >>> y = jnp.array([[1., 0.], [2., 1.]])
+        >>> pairwise_euclidean_distance(x, y)
+        Array([[3.1622777, 2.       ],
+               [5.3851647, 4.1231055],
+               [8.944272 , 7.615773 ]], dtype=float32)
+    """
+    distance = _pairwise_euclidean_distance_update(x, y, zero_diagonal)
+    return _reduce_distance_matrix(distance, reduction)

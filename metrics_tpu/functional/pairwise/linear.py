@@ -1,0 +1,40 @@
+"""Pairwise linear similarity (reference: functional/pairwise/linear.py)."""
+from typing import Optional
+
+from jax import Array
+
+from metrics_tpu.functional.pairwise.helpers import _check_input, _reduce_distance_matrix, _zero_diagonal
+from metrics_tpu.utils.compute import _safe_matmul
+
+
+def _pairwise_linear_similarity_update(
+    x: Array, y: Optional[Array] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    """Pairwise linear similarity matrix (reference: linear.py:23-38)."""
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    distance = _safe_matmul(x, y)
+    if zero_diagonal:
+        distance = _zero_diagonal(distance)
+    return distance
+
+
+def pairwise_linear_similarity(
+    x: Array,
+    y: Optional[Array] = None,
+    reduction: Optional[str] = None,
+    zero_diagonal: Optional[bool] = None,
+) -> Array:
+    """Pairwise linear similarity ``<x_i, y_j>`` (reference: linear.py:41-82).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional.pairwise import pairwise_linear_similarity
+        >>> x = jnp.array([[2., 3.], [3., 5.], [5., 8.]])
+        >>> y = jnp.array([[1., 0.], [2., 1.]])
+        >>> pairwise_linear_similarity(x, y)
+        Array([[ 2.,  7.],
+               [ 3., 11.],
+               [ 5., 18.]], dtype=float32)
+    """
+    distance = _pairwise_linear_similarity_update(x, y, zero_diagonal)
+    return _reduce_distance_matrix(distance, reduction)

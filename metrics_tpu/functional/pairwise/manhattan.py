@@ -1,0 +1,40 @@
+"""Pairwise manhattan distance (reference: functional/pairwise/manhattan.py)."""
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.pairwise.helpers import _check_input, _reduce_distance_matrix, _zero_diagonal
+
+
+def _pairwise_manhattan_distance_update(
+    x: Array, y: Optional[Array] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    """Pairwise manhattan distance matrix (reference: manhattan.py:22-37)."""
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    distance = jnp.abs(x[:, None, :] - y[None, :, :]).sum(axis=-1)
+    if zero_diagonal:
+        distance = _zero_diagonal(distance)
+    return distance
+
+
+def pairwise_manhattan_distance(
+    x: Array,
+    y: Optional[Array] = None,
+    reduction: Optional[str] = None,
+    zero_diagonal: Optional[bool] = None,
+) -> Array:
+    """Pairwise manhattan distance between rows of ``x`` (and ``y``) (reference: manhattan.py:40-81).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional.pairwise import pairwise_manhattan_distance
+        >>> x = jnp.array([[2., 3.], [3., 5.], [5., 8.]])
+        >>> y = jnp.array([[1., 0.], [2., 1.]])
+        >>> pairwise_manhattan_distance(x, y)
+        Array([[ 4.,  2.],
+               [ 7.,  5.],
+               [12., 10.]], dtype=float32)
+    """
+    distance = _pairwise_manhattan_distance_update(x, y, zero_diagonal)
+    return _reduce_distance_matrix(distance, reduction)

@@ -1,0 +1,51 @@
+"""Pairwise minkowski distance (reference: functional/pairwise/minkowski.py)."""
+from typing import Optional, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.pairwise.helpers import _check_input, _reduce_distance_matrix, _zero_diagonal
+from metrics_tpu.utils.exceptions import MetricsUserError
+
+
+def _pairwise_minkowski_distance_update(
+    x: Array, y: Optional[Array] = None, exponent: Union[int, float] = 2, zero_diagonal: Optional[bool] = None
+) -> Array:
+    """Pairwise minkowski distance matrix (reference: minkowski.py:24-46)."""
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    if not (isinstance(exponent, (float, int)) and exponent >= 1):
+        raise MetricsUserError(f"Argument ``p`` must be a float or int greater than 1, but got {exponent}")
+    import jax
+
+    _orig_dtype = x.dtype
+    acc_dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    x = x.astype(acc_dtype)
+    y = y.astype(acc_dtype)
+    distance = (jnp.abs(x[:, None, :] - y[None, :, :]) ** exponent).sum(axis=-1) ** (1.0 / exponent)
+    distance = distance.astype(_orig_dtype)
+    if zero_diagonal:
+        distance = _zero_diagonal(distance)
+    return distance
+
+
+def pairwise_minkowski_distance(
+    x: Array,
+    y: Optional[Array] = None,
+    exponent: Union[int, float] = 2,
+    reduction: Optional[str] = None,
+    zero_diagonal: Optional[bool] = None,
+) -> Array:
+    """Pairwise minkowski distance between rows of ``x`` (and ``y``) (reference: minkowski.py:49-94).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional.pairwise import pairwise_minkowski_distance
+        >>> x = jnp.array([[2., 3.], [3., 5.], [5., 8.]])
+        >>> y = jnp.array([[1., 0.], [2., 1.]])
+        >>> pairwise_minkowski_distance(x, y, exponent=4)
+        Array([[3.0092168, 2.       ],
+               [5.0316973, 4.0039005],
+               [8.122172 , 7.0583053]], dtype=float32)
+    """
+    distance = _pairwise_minkowski_distance_update(x, y, exponent, zero_diagonal)
+    return _reduce_distance_matrix(distance, reduction)

@@ -1,0 +1,11 @@
+from metrics_tpu.nominal.cramers import CramersV
+from metrics_tpu.nominal.pearson import PearsonsContingencyCoefficient
+from metrics_tpu.nominal.theils_u import TheilsU
+from metrics_tpu.nominal.tschuprows import TschuprowsT
+
+__all__ = [
+    "CramersV",
+    "PearsonsContingencyCoefficient",
+    "TheilsU",
+    "TschuprowsT",
+]

@@ -1,0 +1,59 @@
+"""CramersV metric class (reference: nominal/cramers.py:30-120)."""
+from typing import Any, Optional, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.nominal.cramers import _cramers_v_compute, _cramers_v_update
+from metrics_tpu.functional.nominal.utils import _nominal_input_validation
+
+
+class CramersV(Metric):
+    """Cramer's V statistic of association between two categorical series (reference: nominal/cramers.py:30).
+
+    The class variant requires ``num_classes`` up front so the confusion-matrix state
+    has a static shape (the reference infers it per-call in the functional only).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from metrics_tpu.nominal import CramersV
+        >>> preds = jax.random.randint(jax.random.PRNGKey(42), (100,), 0, 4)
+        >>> target = (preds + jax.random.randint(jax.random.PRNGKey(43), (100,), 0, 2)) % 4
+        >>> metric = CramersV(num_classes=4)
+        >>> 0 <= float(metric(preds, target)) <= 1
+        True
+    """
+
+    full_state_update: bool = False
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        num_classes: int,
+        bias_correction: bool = True,
+        nan_strategy: str = "replace",
+        nan_replace_value: Optional[Union[int, float]] = 0.0,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(num_classes, int) or num_classes < 1:
+            raise ValueError("Argument `num_classes` is expected to be a positive integer")
+        self.num_classes = num_classes
+        self.bias_correction = bias_correction
+        _nominal_input_validation(nan_strategy, nan_replace_value)
+        self.nan_strategy = nan_strategy
+        self.nan_replace_value = nan_replace_value
+        self.add_state("confmat", jnp.zeros((num_classes, num_classes)), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate the contingency table."""
+        confmat = _cramers_v_update(preds, target, self.num_classes, self.nan_strategy, self.nan_replace_value)
+        self.confmat = self.confmat + confmat
+
+    def compute(self) -> Array:
+        """Cramer's V from the accumulated table."""
+        return _cramers_v_compute(self.confmat, self.bias_correction)

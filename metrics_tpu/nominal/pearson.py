@@ -1,0 +1,59 @@
+"""PearsonsContingencyCoefficient metric class (reference: nominal/pearson.py:33-120)."""
+from typing import Any, Optional, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.nominal.pearson import (
+    _pearsons_contingency_coefficient_compute,
+    _pearsons_contingency_coefficient_update,
+)
+from metrics_tpu.functional.nominal.utils import _nominal_input_validation
+
+
+class PearsonsContingencyCoefficient(Metric):
+    """Pearson's contingency coefficient between two categorical series (reference: nominal/pearson.py:33).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from metrics_tpu.nominal import PearsonsContingencyCoefficient
+        >>> preds = jax.random.randint(jax.random.PRNGKey(42), (100,), 0, 4)
+        >>> target = (preds + jax.random.randint(jax.random.PRNGKey(43), (100,), 0, 2)) % 4
+        >>> metric = PearsonsContingencyCoefficient(num_classes=4)
+        >>> 0 <= float(metric(preds, target)) <= 1
+        True
+    """
+
+    full_state_update: bool = False
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        num_classes: int,
+        nan_strategy: str = "replace",
+        nan_replace_value: Optional[Union[int, float]] = 0.0,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(num_classes, int) or num_classes < 1:
+            raise ValueError("Argument `num_classes` is expected to be a positive integer")
+        self.num_classes = num_classes
+        _nominal_input_validation(nan_strategy, nan_replace_value)
+        self.nan_strategy = nan_strategy
+        self.nan_replace_value = nan_replace_value
+        self.add_state("confmat", jnp.zeros((num_classes, num_classes)), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate the contingency table."""
+        confmat = _pearsons_contingency_coefficient_update(
+            preds, target, self.num_classes, self.nan_strategy, self.nan_replace_value
+        )
+        self.confmat = self.confmat + confmat
+
+    def compute(self) -> Array:
+        """Pearson's contingency coefficient from the accumulated table."""
+        return _pearsons_contingency_coefficient_compute(self.confmat)

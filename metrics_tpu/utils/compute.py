@@ -40,8 +40,9 @@ def _safe_log(x: Array, eps: float = 0.0) -> Array:
 
 
 def _safe_matmul(x: Array, y: Array) -> Array:
-    """Matmul (reference guards fp16-on-CPU, utilities/compute.py:22 — not needed on TPU)."""
-    return jnp.matmul(x, y)
+    """``x @ y.T`` (the reference also guards fp16-on-CPU, utilities/compute.py:22 —
+    not needed on TPU where bf16/f32 matmuls are native)."""
+    return jnp.matmul(x, y.T)
 
 
 def _auc_compute_without_check(x: Array, y: Array, direction: float, axis: int = -1) -> Array:

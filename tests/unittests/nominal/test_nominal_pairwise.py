@@ -1,0 +1,172 @@
+"""Differential tests for nominal association metrics (vs scipy/pandas-free references)
+and pairwise distance functionals (vs sklearn).
+
+References: tests/unittests/nominal/test_{cramers,pearson,theils_u,tschuprows}.py and
+tests/unittests/pairwise/test_pairwise_distance.py in the reference repo (which use the
+`dython` library and sklearn.metrics.pairwise respectively).
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.stats.contingency import association
+from sklearn.metrics.pairwise import (
+    cosine_similarity,
+    euclidean_distances,
+    linear_kernel,
+    manhattan_distances,
+)
+
+from metrics_tpu.functional.nominal import (
+    cramers_v,
+    cramers_v_matrix,
+    pearsons_contingency_coefficient,
+    theils_u,
+    tschuprows_t,
+)
+from metrics_tpu.functional.pairwise import (
+    pairwise_cosine_similarity,
+    pairwise_euclidean_distance,
+    pairwise_linear_similarity,
+    pairwise_manhattan_distance,
+    pairwise_minkowski_distance,
+)
+from metrics_tpu.nominal import CramersV, PearsonsContingencyCoefficient, TheilsU, TschuprowsT
+
+_rng = np.random.default_rng(42)
+_NUM_CLASSES = 4
+
+
+def _confmat(preds, target, n):
+    cm = np.zeros((n, n), dtype=np.int64)
+    np.add.at(cm, (target, preds), 1)
+    return cm
+
+
+def _sp_association(preds, target, method):
+    # scipy "cramer"/"tschuprow"/"pearson" operate on the contingency table with
+    # empty rows/cols dropped, no bias correction
+    cm = _confmat(preds, target, _NUM_CLASSES)
+    cm = cm[cm.sum(1) > 0][:, cm.sum(0) > 0]
+    return association(cm, method=method, correction=False)
+
+
+class TestNominal:
+    def setup_method(self):
+        self.preds = _rng.integers(0, _NUM_CLASSES, 200)
+        self.target = (self.preds + _rng.integers(0, 2, 200)) % _NUM_CLASSES
+
+    def test_cramers_no_bias_correction(self):
+        val = cramers_v(jnp.array(self.preds), jnp.array(self.target), bias_correction=False)
+        ref = _sp_association(self.preds, self.target, "cramer")
+        np.testing.assert_allclose(float(val), ref, atol=1e-6)
+
+    def test_tschuprows_no_bias_correction(self):
+        val = tschuprows_t(jnp.array(self.preds), jnp.array(self.target), bias_correction=False)
+        ref = _sp_association(self.preds, self.target, "tschuprow")
+        np.testing.assert_allclose(float(val), ref, atol=1e-6)
+
+    def test_pearson(self):
+        val = pearsons_contingency_coefficient(jnp.array(self.preds), jnp.array(self.target))
+        ref = _sp_association(self.preds, self.target, "pearson")
+        np.testing.assert_allclose(float(val), ref, atol=1e-6)
+
+    def test_theils_u_properties(self):
+        # U(x|x) == 1; independence ~ 0; asymmetric in general
+        x = jnp.array(self.preds)
+        assert np.isclose(float(theils_u(x, x)), 1.0, atol=1e-6)
+        indep = jnp.array(_rng.integers(0, _NUM_CLASSES, 5000))
+        other = jnp.array(_rng.integers(0, _NUM_CLASSES, 5000))
+        assert float(theils_u(indep, other)) < 0.01
+
+    def test_theils_u_manual(self):
+        # entropy-based hand computation
+        preds, target = self.preds, self.target
+        cm = _confmat(preds, target, _NUM_CLASSES).astype(float)
+        n = cm.sum()
+        p_xy = cm / n
+        p_y = cm.sum(1) / n  # rows (= target axis in our confmat[target, preds])
+        with np.errstate(divide="ignore", invalid="ignore"):
+            s_xy = np.nansum(p_xy * np.log(p_y[:, None] / p_xy))
+        p_x = cm.sum(0) / n
+        s_x = -np.nansum(p_x * np.log(p_x))
+        ref = (s_x - s_xy) / s_x
+        val = theils_u(jnp.array(preds), jnp.array(target))
+        np.testing.assert_allclose(float(val), ref, atol=1e-6)
+
+    def test_classes_accumulate(self):
+        m = CramersV(num_classes=_NUM_CLASSES, bias_correction=False)
+        half = len(self.preds) // 2
+        m.update(jnp.array(self.preds[:half]), jnp.array(self.target[:half]))
+        m.update(jnp.array(self.preds[half:]), jnp.array(self.target[half:]))
+        ref = _sp_association(self.preds, self.target, "cramer")
+        np.testing.assert_allclose(float(m.compute()), ref, atol=1e-6)
+
+        for cls, fn in [
+            (PearsonsContingencyCoefficient, pearsons_contingency_coefficient),
+            (TheilsU, theils_u),
+        ]:
+            m = cls(num_classes=_NUM_CLASSES)
+            m.update(jnp.array(self.preds), jnp.array(self.target))
+            np.testing.assert_allclose(
+                float(m.compute()), float(fn(jnp.array(self.preds), jnp.array(self.target))), atol=1e-6
+            )
+        m = TschuprowsT(num_classes=_NUM_CLASSES, bias_correction=False)
+        m.update(jnp.array(self.preds), jnp.array(self.target))
+        np.testing.assert_allclose(
+            float(m.compute()),
+            float(tschuprows_t(jnp.array(self.preds), jnp.array(self.target), bias_correction=False)),
+            atol=1e-6,
+        )
+
+    def test_matrix_symmetry(self):
+        matrix = jnp.array(_rng.integers(0, _NUM_CLASSES, (100, 4)))
+        out = cramers_v_matrix(matrix, bias_correction=False)
+        out = np.asarray(out)
+        np.testing.assert_allclose(out, out.T, atol=1e-6)
+        np.testing.assert_allclose(np.diag(out), 1.0)
+
+
+class TestPairwise:
+    def setup_method(self):
+        self.x = _rng.normal(size=(10, 5)).astype(np.float32)
+        self.y = _rng.normal(size=(8, 5)).astype(np.float32)
+
+    @pytest.mark.parametrize(
+        ("ours", "ref"),
+        [
+            (pairwise_cosine_similarity, cosine_similarity),
+            (pairwise_euclidean_distance, euclidean_distances),
+            (pairwise_linear_similarity, linear_kernel),
+            (pairwise_manhattan_distance, manhattan_distances),
+        ],
+    )
+    def test_vs_sklearn(self, ours, ref):
+        np.testing.assert_allclose(
+            np.asarray(ours(jnp.array(self.x), jnp.array(self.y))), ref(self.x, self.y), atol=1e-5
+        )
+        # x-only form zeroes the diagonal
+        got = np.asarray(ours(jnp.array(self.x)))
+        expected = ref(self.x)
+        np.fill_diagonal(expected, 0)
+        np.testing.assert_allclose(got, expected, atol=1e-5)
+
+    def test_minkowski(self):
+        from scipy.spatial.distance import cdist
+
+        for p in (1, 2, 3.5):
+            got = np.asarray(pairwise_minkowski_distance(jnp.array(self.x), jnp.array(self.y), exponent=p))
+            expected = cdist(self.x, self.y, metric="minkowski", p=p)
+            np.testing.assert_allclose(got, expected, atol=1e-4)
+
+    def test_reductions(self):
+        full = np.asarray(pairwise_euclidean_distance(jnp.array(self.x), jnp.array(self.y)))
+        np.testing.assert_allclose(
+            np.asarray(pairwise_euclidean_distance(jnp.array(self.x), jnp.array(self.y), reduction="mean")),
+            full.mean(-1),
+            atol=1e-6,
+        )
+        np.testing.assert_allclose(
+            np.asarray(pairwise_euclidean_distance(jnp.array(self.x), jnp.array(self.y), reduction="sum")),
+            full.sum(-1),
+            atol=1e-5,
+        )
