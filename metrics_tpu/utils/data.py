@@ -111,18 +111,44 @@ def to_categorical(x: Array, argmax_dim: int = 1) -> Array:
     return jnp.argmax(jnp.asarray(x), axis=argmax_dim)
 
 
+def _scatter_out_sharding(x: Array) -> dict:
+    """kwargs for ``.at[].add`` when ``x`` carries an explicit sharded spec.
+
+    Under GSPMD jit with sharding-in-types (jax>=0.9), a scatter whose indices are
+    sharded over a mesh axis cannot resolve its output sharding; supplying a
+    replicated ``out_sharding`` makes XLA materialize the bincount per-shard and
+    all-reduce — exactly the TPU-native semantics we want for a confusion matrix
+    over a data-sharded batch.
+    """
+    try:
+        spec = x.aval.sharding.spec
+        if any(s is not None for s in spec):
+            return {"out_sharding": jax.sharding.PartitionSpec()}
+    except Exception:
+        pass
+    return {}
+
+
 def _bincount(x: Array, minlength: int) -> Array:
     """Count occurrences of each value in ``[0, minlength)``.
 
     ``minlength`` MUST be static (Python int) — the output shape depends on it.
-    Reference: utilities/data.py:211 (with XLA fallback loop — not needed here).
+    Reference: utilities/data.py:211 (with XLA fallback loop — not needed here:
+    the scatter-add is deterministic on TPU). Values outside the range are dropped.
     """
-    return jnp.bincount(jnp.asarray(x).ravel(), length=minlength)
+    x = jnp.asarray(x).ravel()
+    return jnp.zeros((minlength,), jnp.int32).at[x].add(
+        1, mode="drop", wrap_negative_indices=False, **_scatter_out_sharding(x)
+    )
 
 
 def _bincount_weighted(x: Array, weights: Array, minlength: int) -> Array:
     """Weighted bincount with static length; used for masked confusion matrices."""
-    return jnp.bincount(jnp.asarray(x).ravel(), weights=jnp.asarray(weights).ravel(), length=minlength)
+    x = jnp.asarray(x).ravel()
+    weights = jnp.asarray(weights).ravel()
+    return jnp.zeros((minlength,), weights.dtype).at[x].add(
+        weights, mode="drop", wrap_negative_indices=False, **_scatter_out_sharding(x)
+    )
 
 
 def _cumsum(x: Array, axis: int = 0) -> Array:
