@@ -129,9 +129,12 @@ def binary_groups_stat_rates(
 
 def _compute_binary_demographic_parity(tp: Array, fp: Array, tn: Array, fn: Array) -> Dict[str, Array]:
     """Demographic parity from binary stats (reference: group_fairness.py:163-173)."""
-    pos_rates = _safe_divide(tp + fp, tp + fp + tn + fn)
-    min_pos_rate_id = int(jnp.argmin(pos_rates))
-    max_pos_rate_id = int(jnp.argmax(pos_rates))
+    pop = tp + fp + tn + fn
+    pos_rates = _safe_divide(tp + fp, pop)
+    # groups with no samples (e.g. non-contiguous group ids) must not win the
+    # argmin as phantom rate-0 groups (ADVICE r1)
+    min_pos_rate_id = int(jnp.argmin(jnp.where(pop > 0, pos_rates, jnp.inf)))
+    max_pos_rate_id = int(jnp.argmax(jnp.where(pop > 0, pos_rates, -jnp.inf)))
     return {
         f"DP_{min_pos_rate_id}_{max_pos_rate_id}": _safe_divide(
             pos_rates[min_pos_rate_id], pos_rates[max_pos_rate_id]
@@ -165,9 +168,11 @@ def demographic_parity(
 
 def _compute_binary_equal_opportunity(tp: Array, fp: Array, tn: Array, fn: Array) -> Dict[str, Array]:
     """Equal opportunity from binary stats (reference: group_fairness.py:239-251)."""
-    true_pos_rates = _safe_divide(tp, tp + fn)
-    min_pos_rate_id = int(jnp.argmin(true_pos_rates))
-    max_pos_rate_id = int(jnp.argmax(true_pos_rates))
+    pop = tp + fn
+    true_pos_rates = _safe_divide(tp, pop)
+    # exclude zero-population groups from selection (ADVICE r1)
+    min_pos_rate_id = int(jnp.argmin(jnp.where(pop > 0, true_pos_rates, jnp.inf)))
+    max_pos_rate_id = int(jnp.argmax(jnp.where(pop > 0, true_pos_rates, -jnp.inf)))
     return {
         f"EO_{min_pos_rate_id}_{max_pos_rate_id}": _safe_divide(
             true_pos_rates[min_pos_rate_id], true_pos_rates[max_pos_rate_id]

@@ -56,7 +56,7 @@ def _binary_specificity_at_sensitivity_arg_validation(
     ignore_index: Optional[int] = None,
 ) -> None:
     _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
-    if not isinstance(min_sensitivity, float) and not (0 <= min_sensitivity <= 1):
+    if not isinstance(min_sensitivity, float) or not (0 <= min_sensitivity <= 1):
         raise ValueError(
             f"Expected argument `min_sensitivity` to be an float in the [0,1] range, but got {min_sensitivity}"
         )
@@ -107,7 +107,7 @@ def _multiclass_specificity_at_sensitivity_arg_validation(
     ignore_index: Optional[int] = None,
 ) -> None:
     _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index)
-    if not isinstance(min_sensitivity, float) and not (0 <= min_sensitivity <= 1):
+    if not isinstance(min_sensitivity, float) or not (0 <= min_sensitivity <= 1):
         raise ValueError(
             f"Expected argument `min_sensitivity` to be an float in the [0,1] range, but got {min_sensitivity}"
         )
@@ -163,7 +163,7 @@ def _multilabel_specificity_at_sensitivity_arg_validation(
     ignore_index: Optional[int] = None,
 ) -> None:
     _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
-    if not isinstance(min_sensitivity, float) and not (0 <= min_sensitivity <= 1):
+    if not isinstance(min_sensitivity, float) or not (0 <= min_sensitivity <= 1):
         raise ValueError(
             f"Expected argument `min_sensitivity` to be an float in the [0,1] range, but got {min_sensitivity}"
         )

@@ -8,6 +8,8 @@ from jax import Array
 
 from metrics_tpu.functional.classification.confusion_matrix import _multiclass_confusion_matrix_update
 from metrics_tpu.functional.nominal.utils import (
+    _format_and_densify,
+    _validate_dense_labels,
     _drop_empty_rows_and_cols,
     _handle_nan_in_data,
     _nominal_input_validation,
@@ -37,6 +39,7 @@ def _theils_u_update(
     preds = preds.argmax(1) if preds.ndim == 2 else preds
     target = target.argmax(1) if target.ndim == 2 else target
     preds, target = _handle_nan_in_data(preds, target, nan_strategy, nan_replace_value)
+    _validate_dense_labels(preds, target, num_classes)
     return _multiclass_confusion_matrix_update(
         preds.astype(jnp.int32).ravel(), target.astype(jnp.int32).ravel(), num_classes
     )
@@ -73,8 +76,8 @@ def theils_u(
         True
     """
     _nominal_input_validation(nan_strategy, nan_replace_value)
-    num_classes = len(np.unique(np.concatenate([np.asarray(preds).ravel(), np.asarray(target).ravel()])))
-    confmat = _theils_u_update(preds, target, num_classes, nan_strategy, nan_replace_value)
+    preds, target, num_classes = _format_and_densify(preds, target, nan_strategy, nan_replace_value)
+    confmat = _multiclass_confusion_matrix_update(preds, target, num_classes)
     return _theils_u_compute(confmat)
 
 
@@ -90,8 +93,8 @@ def theils_u_matrix(
     out = np.ones((num_variables, num_variables), dtype=np.float32)
     for i, j in itertools.combinations(range(num_variables), 2):
         x, y = matrix[:, i], matrix[:, j]
-        num_classes = len(np.unique(np.concatenate([np.asarray(x), np.asarray(y)])))
-        confmat = _theils_u_update(x, y, num_classes, nan_strategy, nan_replace_value)
+        x, y, num_classes = _format_and_densify(x, y, nan_strategy, nan_replace_value)
+        confmat = _multiclass_confusion_matrix_update(x, y, num_classes)
         out[i, j] = float(_theils_u_compute(confmat))
         out[j, i] = float(_theils_u_compute(confmat.T))
     return jnp.asarray(out)

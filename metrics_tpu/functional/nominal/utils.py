@@ -1,6 +1,7 @@
 """Shared helpers for nominal-association metrics (reference: functional/nominal/utils.py)."""
 from typing import Optional, Tuple, Union
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import Array
@@ -84,6 +85,56 @@ def _handle_nan_in_data(
         return jnp.nan_to_num(preds, nan=nan_replace_value), jnp.nan_to_num(target, nan=nan_replace_value)
     rows_contain_nan = np.logical_or(np.isnan(np.asarray(preds)), np.isnan(np.asarray(target)))
     return preds[~rows_contain_nan], target[~rows_contain_nan]
+
+
+def _format_and_densify(
+    preds: Array,
+    target: Array,
+    nan_strategy: str,
+    nan_replace_value: Optional[Union[int, float]],
+) -> Tuple[Array, Array, int]:
+    """Format inputs and remap labels to a dense 0-based range.
+
+    The public nominal functionals infer ``num_classes`` from the data; scattering
+    with *raw* label values would silently drop non-contiguous or 1-based categories
+    (JAX drops out-of-bounds scatter indices — ADVICE r1). Remapping via
+    ``np.unique(return_inverse=True)`` makes any hashable label set correct.
+    Host-side by design: these one-shot functionals are not jit paths.
+    """
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    preds = preds.argmax(1) if preds.ndim == 2 else preds
+    target = target.argmax(1) if target.ndim == 2 else target
+    preds, target = _handle_nan_in_data(preds, target, nan_strategy, nan_replace_value)
+    p = np.asarray(preds).ravel()
+    t = np.asarray(target).ravel()
+    joint = np.concatenate([p, t])
+    uniq, inv = np.unique(joint, return_inverse=True)
+    inv = inv.astype(np.int32)
+    return jnp.asarray(inv[: p.size]), jnp.asarray(inv[p.size :]), max(len(uniq), 1)
+
+
+def _validate_dense_labels(preds: Array, target: Array, num_classes: int) -> None:
+    """Raise on labels outside ``[0, num_classes)``; skipped under jit tracing.
+
+    The class-based nominal metrics take ``num_classes`` up front; out-of-range
+    labels would be silently dropped by the scatter (the torch reference fails
+    loudly on the same input — ADVICE r1), so fail loudly here too when concrete.
+    """
+    if isinstance(preds, jax.core.Tracer) or isinstance(target, jax.core.Tracer):
+        return
+    p = np.asarray(preds)
+    t = np.asarray(target)
+    if p.size == 0 or t.size == 0:
+        return
+    lo = min(p.min(), t.min())
+    hi = max(p.max(), t.max())
+    if lo < 0 or hi >= num_classes:
+        raise ValueError(
+            f"Nominal metrics expect dense 0-based labels in [0, {num_classes}), but got values "
+            f"in [{lo}, {hi}]. Remap labels first (e.g. np.unique(..., return_inverse=True)) "
+            "or construct the metric with a larger `num_classes`."
+        )
 
 
 def _unable_to_use_bias_correction_warning(metric_name: str) -> None:

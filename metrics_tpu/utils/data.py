@@ -111,22 +111,32 @@ def to_categorical(x: Array, argmax_dim: int = 1) -> Array:
     return jnp.argmax(jnp.asarray(x), axis=argmax_dim)
 
 
-def _scatter_out_sharding(x: Array) -> dict:
-    """kwargs for ``.at[].add`` when ``x`` carries an explicit sharded spec.
+def _scatter_sharding_args(x: Array):
+    """(context manager, kwargs) making a scatter-add over ``x`` sharding-safe.
 
-    Under GSPMD jit with sharding-in-types (jax>=0.9), a scatter whose indices are
-    sharded over a mesh axis cannot resolve its output sharding; supplying a
-    replicated ``out_sharding`` makes XLA materialize the bincount per-shard and
-    all-reduce — exactly the TPU-native semantics we want for a confusion matrix
-    over a data-sharded batch.
+    Under explicit sharding-in-types (jax>=0.9), a scatter whose indices are sharded
+    over a mesh axis cannot resolve its output sharding; supplying a replicated
+    ``out_sharding`` makes XLA materialize the bincount per-shard and all-reduce —
+    exactly the TPU-native semantics we want for a confusion matrix over a
+    data-sharded batch. ``out_sharding`` additionally requires an active mesh
+    context; for an eager explicitly-sharded array outside one, the array's own
+    mesh is activated.
     """
+    import contextlib
+
     try:
         spec = x.aval.sharding.spec
-        if any(s is not None for s in spec):
-            return {"out_sharding": jax.sharding.PartitionSpec()}
+        if not any(s is not None for s in spec):
+            return contextlib.nullcontext(), {}
+        kwargs = {"out_sharding": jax.sharding.PartitionSpec()}
+        if jax.sharding.get_abstract_mesh().axis_names:
+            return contextlib.nullcontext(), kwargs
+        sharding = getattr(x, "sharding", None)
+        if sharding is not None and getattr(sharding, "mesh", None) is not None:
+            return jax.sharding.set_mesh(sharding.mesh), kwargs
     except Exception:
         pass
-    return {}
+    return contextlib.nullcontext(), {}
 
 
 def _bincount(x: Array, minlength: int) -> Array:
@@ -137,18 +147,22 @@ def _bincount(x: Array, minlength: int) -> Array:
     the scatter-add is deterministic on TPU). Values outside the range are dropped.
     """
     x = jnp.asarray(x).ravel()
-    return jnp.zeros((minlength,), jnp.int32).at[x].add(
-        1, mode="drop", wrap_negative_indices=False, **_scatter_out_sharding(x)
-    )
+    ctx, kwargs = _scatter_sharding_args(x)
+    with ctx:
+        return jnp.zeros((minlength,), jnp.int32).at[x].add(
+            1, mode="drop", wrap_negative_indices=False, **kwargs
+        )
 
 
 def _bincount_weighted(x: Array, weights: Array, minlength: int) -> Array:
     """Weighted bincount with static length; used for masked confusion matrices."""
     x = jnp.asarray(x).ravel()
     weights = jnp.asarray(weights).ravel()
-    return jnp.zeros((minlength,), weights.dtype).at[x].add(
-        weights, mode="drop", wrap_negative_indices=False, **_scatter_out_sharding(x)
-    )
+    ctx, kwargs = _scatter_sharding_args(x)
+    with ctx:
+        return jnp.zeros((minlength,), weights.dtype).at[x].add(
+            weights, mode="drop", wrap_negative_indices=False, **kwargs
+        )
 
 
 def _cumsum(x: Array, axis: int = 0) -> Array:

@@ -1,0 +1,69 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md).
+
+1. Nominal metrics silently mis-counted non-contiguous / 1-based labels.
+2. `and`-instead-of-`or` validation let num_groups=0/1 and min_precision=1.5 through.
+3. Fairness selection could key a phantom empty group with non-contiguous group ids.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.classification import BinaryGroupStatRates
+from metrics_tpu.functional.classification import (
+    binary_recall_at_fixed_precision,
+    demographic_parity,
+    equal_opportunity,
+)
+from metrics_tpu.functional.nominal import (
+    cramers_v,
+    pearsons_contingency_coefficient,
+    theils_u,
+    tschuprows_t,
+)
+from metrics_tpu.nominal import CramersV
+
+
+@pytest.mark.parametrize("fn", [cramers_v, pearsons_contingency_coefficient, theils_u, tschuprows_t])
+def test_nominal_label_shift_invariance(fn):
+    """Statistics over categorical series must not depend on the label encoding."""
+    rng = np.random.default_rng(3)
+    target = rng.integers(0, 4, 400)
+    preds = (target + (rng.random(400) < 0.3)) % 4
+    base = float(fn(jnp.asarray(preds), jnp.asarray(target)))
+    shifted = float(fn(jnp.asarray(preds + 1), jnp.asarray(target + 1)))  # 1-based
+    sparse = float(fn(jnp.asarray(preds * 3), jnp.asarray(target * 3)))  # {0,3,6,9}
+    assert base == pytest.approx(shifted, abs=1e-6)
+    assert base == pytest.approx(sparse, abs=1e-6)
+
+
+def test_nominal_class_rejects_out_of_range_labels():
+    metric = CramersV(num_classes=4)
+    with pytest.raises(ValueError, match="dense 0-based labels"):
+        metric.update(jnp.asarray([1, 2, 3, 4]), jnp.asarray([1, 2, 3, 4]))
+
+
+@pytest.mark.parametrize("bad", [0, 1, 1.5, "2"])
+def test_num_groups_validation(bad):
+    with pytest.raises(ValueError):
+        BinaryGroupStatRates(num_groups=bad)
+
+
+@pytest.mark.parametrize("bad", [-0.5, 1.5, 1])
+def test_min_precision_validation(bad):
+    preds = jnp.asarray([0.2, 0.8, 0.6, 0.4])
+    target = jnp.asarray([0, 1, 1, 0])
+    with pytest.raises(ValueError):
+        binary_recall_at_fixed_precision(preds, target, min_precision=bad, thresholds=5)
+
+
+def test_fairness_non_contiguous_groups_skip_empty():
+    preds = jnp.array([0.9, 0.8, 0.2, 0.7, 0.1, 0.9])
+    groups = jnp.array([0, 2, 0, 2, 0, 2])  # group 1 empty
+    dp = demographic_parity(preds, groups, validate_args=False)
+    ((key, val),) = dp.items()
+    assert "1" not in key.split("_")[1:]
+    assert float(val) > 0
+    target = jnp.array([1, 1, 0, 1, 0, 1])
+    eo = equal_opportunity(preds, target, groups, validate_args=False)
+    ((key, _),) = eo.items()
+    assert "1" not in key.split("_")[1:]
