@@ -1,0 +1,16 @@
+from metrics_tpu.detection.ciou import CompleteIntersectionOverUnion
+from metrics_tpu.detection.diou import DistanceIntersectionOverUnion
+from metrics_tpu.detection.giou import GeneralizedIntersectionOverUnion
+from metrics_tpu.detection.iou import IntersectionOverUnion
+from metrics_tpu.detection.mean_ap import MeanAveragePrecision
+from metrics_tpu.detection.panoptic_qualities import ModifiedPanopticQuality, PanopticQuality
+
+__all__ = [
+    "CompleteIntersectionOverUnion",
+    "DistanceIntersectionOverUnion",
+    "GeneralizedIntersectionOverUnion",
+    "IntersectionOverUnion",
+    "MeanAveragePrecision",
+    "ModifiedPanopticQuality",
+    "PanopticQuality",
+]

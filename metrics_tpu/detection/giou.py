@@ -1,0 +1,56 @@
+"""GeneralizedIntersectionOverUnion metric (reference: detection/giou.py:28-179)."""
+from typing import Any, Optional
+
+from jax import Array
+
+from metrics_tpu.detection.iou import IntersectionOverUnion
+from metrics_tpu.functional.detection.giou import _giou_compute, _giou_update
+
+
+class GeneralizedIntersectionOverUnion(IntersectionOverUnion):
+    r"""Computes Generalized Intersection Over Union (GIoU).
+
+    Same input/output contract as :class:`~metrics_tpu.detection.IntersectionOverUnion`;
+    result keys are prefixed ``giou``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.detection import GeneralizedIntersectionOverUnion
+        >>> preds = [
+        ...    {
+        ...        "boxes": jnp.array([[296.55, 93.96, 314.97, 152.79], [298.55, 98.96, 314.97, 151.79]]),
+        ...        "scores": jnp.array([0.236, 0.56]),
+        ...        "labels": jnp.array([4, 5]),
+        ...    }
+        ... ]
+        >>> target = [
+        ...    {
+        ...        "boxes": jnp.array([[300.00, 100.00, 315.00, 150.00]]),
+        ...        "labels": jnp.array([5]),
+        ...    }
+        ... ]
+        >>> metric = GeneralizedIntersectionOverUnion()
+        >>> {k: round(float(v), 4) for k, v in metric(preds, target).items()}
+        {'giou': -0.0694}
+    """
+
+    _iou_type: str = "giou"
+    _invalid_val: float = -1.0
+
+    def __init__(
+        self,
+        box_format: str = "xyxy",
+        iou_threshold: Optional[float] = None,
+        class_metrics: bool = False,
+        respect_labels: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(box_format, iou_threshold, class_metrics, respect_labels, **kwargs)
+
+    @staticmethod
+    def _iou_update_fn(*args: Any, **kwargs: Any) -> Array:
+        return _giou_update(*args, **kwargs)
+
+    @staticmethod
+    def _iou_compute_fn(*args: Any, **kwargs: Any) -> Array:
+        return _giou_compute(*args, **kwargs)

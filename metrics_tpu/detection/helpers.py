@@ -1,0 +1,67 @@
+"""Input validation helpers for detection metrics (reference: detection/helpers.py:19-77)."""
+from typing import Dict, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+_ARRAY_TYPES = (jnp.ndarray, np.ndarray)
+
+
+def _input_validator(preds: Sequence[Dict], targets: Sequence[Dict], iou_type: str = "bbox") -> None:
+    """Ensure the correct input format of ``preds`` and ``targets``."""
+    if iou_type == "bbox":
+        item_val_name = "boxes"
+    elif iou_type == "segm":
+        item_val_name = "masks"
+    else:
+        raise Exception(f"IOU type {iou_type} is not supported")
+
+    if not isinstance(preds, Sequence):
+        raise ValueError(f"Expected argument `preds` to be of type Sequence, but got {preds}")
+    if not isinstance(targets, Sequence):
+        raise ValueError(f"Expected argument `target` to be of type Sequence, but got {targets}")
+    if len(preds) != len(targets):
+        raise ValueError(
+            f"Expected argument `preds` and `target` to have the same length, but got {len(preds)} and {len(targets)}"
+        )
+
+    for k in [item_val_name, "scores", "labels"]:
+        if any(k not in p for p in preds):
+            raise ValueError(f"Expected all dicts in `preds` to contain the `{k}` key")
+
+    for k in [item_val_name, "labels"]:
+        if any(k not in p for p in targets):
+            raise ValueError(f"Expected all dicts in `target` to contain the `{k}` key")
+
+    if any(not isinstance(pred[item_val_name], _ARRAY_TYPES) for pred in preds):
+        raise ValueError(f"Expected all {item_val_name} in `preds` to be of type Array")
+    if any(not isinstance(pred["scores"], _ARRAY_TYPES) for pred in preds):
+        raise ValueError("Expected all scores in `preds` to be of type Array")
+    if any(not isinstance(pred["labels"], _ARRAY_TYPES) for pred in preds):
+        raise ValueError("Expected all labels in `preds` to be of type Array")
+    if any(not isinstance(target[item_val_name], _ARRAY_TYPES) for target in targets):
+        raise ValueError(f"Expected all {item_val_name} in `target` to be of type Array")
+    if any(not isinstance(target["labels"], _ARRAY_TYPES) for target in targets):
+        raise ValueError("Expected all labels in `target` to be of type Array")
+
+    for i, item in enumerate(targets):
+        if item[item_val_name].shape[0] != item["labels"].shape[0]:
+            raise ValueError(
+                f"Input {item_val_name} and labels of sample {i} in targets have a"
+                f" different length (expected {item[item_val_name].shape[0]} labels, got {item['labels'].shape[0]})"
+            )
+    for i, item in enumerate(preds):
+        if not (item[item_val_name].shape[0] == item["labels"].shape[0] == item["scores"].shape[0]):
+            raise ValueError(
+                f"Input {item_val_name}, labels and scores of sample {i} in predictions have a"
+                f" different length (expected {item[item_val_name].shape[0]} labels and scores,"
+                f" got {item['labels'].shape[0]} labels and {item['scores'].shape[0]} scores)"
+            )
+
+
+def _fix_empty_tensors(boxes) -> jnp.ndarray:
+    """Give empty box arrays the canonical ``(0, 4)`` shape (reference :74-77)."""
+    boxes = jnp.asarray(boxes, jnp.float32)
+    if boxes.size == 0:
+        return boxes.reshape(0, 4)
+    return boxes

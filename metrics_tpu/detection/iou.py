@@ -1,0 +1,139 @@
+"""IntersectionOverUnion metric (reference: detection/iou.py:38-242)."""
+from collections import defaultdict
+from typing import Any, Dict, List, Optional
+
+from jax import Array
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.detection.helpers import _fix_empty_tensors, _input_validator
+from metrics_tpu.functional.detection.box_ops import box_convert
+from metrics_tpu.functional.detection.iou import _iou_compute, _iou_update
+from metrics_tpu.utils.data import dim_zero_cat
+
+
+class IntersectionOverUnion(Metric):
+    r"""Computes Intersection Over Union (IoU) between detection and ground-truth boxes.
+
+    ``preds``/``target`` are lists of per-image dicts: preds carry ``boxes`` (N, 4),
+    ``scores`` (N,), ``labels`` (N,); targets carry ``boxes`` and ``labels``.
+    ``compute`` returns ``{"iou": scalar}`` plus per-class entries when
+    ``class_metrics=True``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.detection import IntersectionOverUnion
+        >>> preds = [
+        ...    {
+        ...        "boxes": jnp.array([[296.55, 93.96, 314.97, 152.79], [298.55, 98.96, 314.97, 151.79]]),
+        ...        "scores": jnp.array([0.236, 0.56]),
+        ...        "labels": jnp.array([4, 5]),
+        ...    }
+        ... ]
+        >>> target = [
+        ...    {
+        ...        "boxes": jnp.array([[300.00, 100.00, 315.00, 150.00]]),
+        ...        "labels": jnp.array([5]),
+        ...    }
+        ... ]
+        >>> metric = IntersectionOverUnion()
+        >>> {k: round(float(v), 4) for k, v in metric(preds, target).items()}
+        {'iou': 0.4307}
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: Optional[bool] = True
+    full_state_update: bool = True
+
+    _iou_type: str = "iou"
+    _invalid_val: float = 0.0
+
+    def __init__(
+        self,
+        box_format: str = "xyxy",
+        iou_threshold: Optional[float] = None,
+        class_metrics: bool = False,
+        respect_labels: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+
+        allowed_box_formats = ("xyxy", "xywh", "cxcywh")
+        if box_format not in allowed_box_formats:
+            raise ValueError(f"Expected argument `box_format` to be one of {allowed_box_formats} but got {box_format}")
+
+        self.box_format = box_format
+        self.iou_threshold = iou_threshold
+
+        if not isinstance(class_metrics, bool):
+            raise ValueError("Expected argument `class_metrics` to be a boolean")
+        self.class_metrics = class_metrics
+
+        if not isinstance(respect_labels, bool):
+            raise ValueError("Expected argument `respect_labels` to be a boolean")
+        self.respect_labels = respect_labels
+
+        self.add_state("groundtruth_labels", default=[], dist_reduce_fx=None)
+        self.add_state("results", default=[], dist_reduce_fx=None)
+        self.add_state("labels_eq", default=[], dist_reduce_fx=None)
+
+    @staticmethod
+    def _iou_update_fn(*args: Any, **kwargs: Any) -> Array:
+        return _iou_update(*args, **kwargs)
+
+    @staticmethod
+    def _iou_compute_fn(*args: Any, **kwargs: Any) -> Array:
+        return _iou_compute(*args, **kwargs)
+
+    def update(self, preds: List[Dict[str, Array]], target: List[Dict[str, Array]]) -> None:
+        """Accumulate per-image IoU matrices."""
+        _input_validator(preds, target)
+
+        for p, t in zip(preds, target):
+            det_boxes = self._get_safe_item_values(p["boxes"])
+            gt_boxes = self._get_safe_item_values(t["boxes"])
+            self.groundtruth_labels.append(jnp.asarray(t["labels"]))
+
+            label_eq = bool(
+                p["labels"].shape == t["labels"].shape and jnp.all(jnp.asarray(p["labels"]) == jnp.asarray(t["labels"]))
+            )
+            self.labels_eq.append(jnp.asarray([int(label_eq)], jnp.int32))
+
+            ious = self._iou_update_fn(det_boxes, gt_boxes, self.iou_threshold, self._invalid_val)
+            if self.respect_labels and not label_eq:
+                labels_not_eq = jnp.asarray(p["labels"])[:, None] != jnp.asarray(t["labels"])[None, :]
+                ious = jnp.where(labels_not_eq, self._invalid_val, ious)
+            self.results.append(ious.astype(jnp.float32))
+
+    def _get_safe_item_values(self, boxes: Array) -> Array:
+        boxes = _fix_empty_tensors(boxes)
+        if boxes.size > 0:
+            boxes = box_convert(boxes, in_fmt=self.box_format, out_fmt="xyxy")
+        return boxes
+
+    def _get_gt_classes(self) -> List:
+        """Unique classes found in ground truth data."""
+        if len(self.groundtruth_labels) > 0:
+            return sorted(np.unique(np.concatenate([np.asarray(x) for x in self.groundtruth_labels])).tolist())
+        return []
+
+    def compute(self) -> dict:
+        """Aggregate accumulated IoU matrices into scalar score(s)."""
+        aggregated_iou = dim_zero_cat(
+            [jnp.atleast_1d(self._iou_compute_fn(iou, bool(lbl_eq))) for iou, lbl_eq in zip(self.results, self.labels_eq)]
+        )
+        results: Dict[str, Array] = {f"{self._iou_type}": aggregated_iou.mean()}
+
+        if self.class_metrics:
+            gt_classes = self._get_gt_classes()
+            class_results: Dict[int, List[Array]] = defaultdict(list)
+            for iou, label in zip(self.results, self.groundtruth_labels):
+                for cl in gt_classes:
+                    masked_iou = iou[:, np.asarray(label) == cl]
+                    if masked_iou.size > 0:
+                        class_results[cl].append(jnp.atleast_1d(self._iou_compute_fn(masked_iou, False)))
+            results.update(
+                {f"{self._iou_type}/cl_{cl}": dim_zero_cat(class_results[cl]).mean() for cl in class_results}
+            )
+        return results

@@ -1,0 +1,373 @@
+"""MeanAveragePrecision for object detection (reference: detection/mean_ap.py:150-929).
+
+TPU-first redesign: the reference's per-(image, class) Python greedy-matching loop
+(``_evaluate_image`` mean_ap.py:509-606) becomes one batched device kernel
+(:mod:`metrics_tpu.functional.detection._mean_ap_kernel`) — ``lax.scan`` over
+score-sorted detections, vectorized over IoU thresholds, ``vmap``-ed over area ranges
+and all (image, class) evaluation groups with static power-of-two padded shapes. The
+final precision/recall accumulation (cumsum + precision envelope + recall-threshold
+interpolation, reference ``__calculate_recall_precision_scores`` :773-840) runs on
+host NumPy — it is O(total_detections · log) and feeds fixed 101-point tables.
+
+Differences vs pycocotools kept for parity with the reference: ignored ground truths
+are never matched (no crowd fallback), and ``iou_type="segm"`` (RLE masks via
+pycocotools) is not supported on TPU.
+"""
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+from jax import Array
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.detection.helpers import _fix_empty_tensors, _input_validator
+from metrics_tpu.functional.detection._mean_ap_kernel import _match_groups, _pow2
+from metrics_tpu.functional.detection.box_ops import box_convert
+
+
+class BaseMetricResults(dict):
+    """Dict with attribute access for pre-defined result fields (reference :77-95)."""
+
+    def __getattr__(self, key: str) -> Array:
+        if key in self:
+            return self[key]
+        raise AttributeError(f"No such attribute: {key}")
+
+    def __setattr__(self, key: str, value: Array) -> None:
+        self[key] = value
+
+    def __delattr__(self, key: str) -> None:
+        if key in self:
+            del self[key]
+            return
+        raise AttributeError(f"No such attribute: {key}")
+
+
+class MAPMetricResults(BaseMetricResults):
+    """Final mAP results (reference :98-101)."""
+
+    __slots__ = ("map", "map_50", "map_75", "map_small", "map_medium", "map_large", "classes")
+
+
+class MARMetricResults(BaseMetricResults):
+    """Final mAR results (reference :104-107)."""
+
+    __slots__ = ("mar_1", "mar_10", "mar_100", "mar_small", "mar_medium", "mar_large")
+
+
+class COCOMetricResults(BaseMetricResults):
+    """Full COCO-style result set (reference :110-128)."""
+
+    __slots__ = (
+        "map", "map_50", "map_75", "map_small", "map_medium", "map_large",
+        "mar_1", "mar_10", "mar_100", "mar_small", "mar_medium", "mar_large",
+        "map_per_class", "mar_100_per_class",
+    )
+
+
+_EPS = float(np.finfo(np.float64).eps)
+
+
+class MeanAveragePrecision(Metric):
+    r"""Compute Mean Average Precision / Recall for object detection predictions.
+
+    Follows the COCO evaluation protocol (parity with the reference, which follows
+    pycocotools). ``preds`` is a list of per-image dicts with ``boxes`` (N, 4),
+    ``scores`` (N,) and ``labels`` (N,); ``target`` dicts carry ``boxes`` and
+    ``labels``. ``compute`` returns the COCO result dict.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.detection import MeanAveragePrecision
+        >>> preds = [dict(
+        ...     boxes=jnp.array([[258.0, 41.0, 606.0, 285.0]]),
+        ...     scores=jnp.array([0.536]),
+        ...     labels=jnp.array([0]),
+        ... )]
+        >>> target = [dict(
+        ...     boxes=jnp.array([[214.0, 41.0, 562.0, 285.0]]),
+        ...     labels=jnp.array([0]),
+        ... )]
+        >>> metric = MeanAveragePrecision()
+        >>> metric.update(preds, target)
+        >>> result = metric.compute()
+        >>> round(float(result['map']), 4), round(float(result['map_50']), 4)
+        (0.6, 1.0)
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: Optional[bool] = True
+    full_state_update: bool = True
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        box_format: str = "xyxy",
+        iou_type: str = "bbox",
+        iou_thresholds: Optional[List[float]] = None,
+        rec_thresholds: Optional[List[float]] = None,
+        max_detection_thresholds: Optional[List[int]] = None,
+        class_metrics: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+
+        allowed_box_formats = ("xyxy", "xywh", "cxcywh")
+        if box_format not in allowed_box_formats:
+            raise ValueError(f"Expected argument `box_format` to be one of {allowed_box_formats} but got {box_format}")
+        self.box_format = box_format
+        self.iou_thresholds = iou_thresholds or np.linspace(0.5, 0.95, round((0.95 - 0.5) / 0.05) + 1).tolist()
+        self.rec_thresholds = rec_thresholds or np.linspace(0.0, 1.00, round(1.00 / 0.01) + 1).tolist()
+        self.max_detection_thresholds = sorted(max_detection_thresholds or [1, 10, 100])
+        if iou_type != "bbox":
+            raise ValueError(
+                f"Expected argument `iou_type` to be 'bbox', got {iou_type!r}"
+                " ('segm' needs pycocotools RLE masks, unsupported in the TPU build)"
+            )
+        self.iou_type = iou_type
+        self.bbox_area_ranges = {
+            "all": (float(0**2), float(1e5**2)),
+            "small": (float(0**2), float(32**2)),
+            "medium": (float(32**2), float(96**2)),
+            "large": (float(96**2), float(1e5**2)),
+        }
+
+        if not isinstance(class_metrics, bool):
+            raise ValueError("Expected argument `class_metrics` to be a boolean")
+        self.class_metrics = class_metrics
+
+        self.add_state("detections", default=[], dist_reduce_fx=None)
+        self.add_state("detection_scores", default=[], dist_reduce_fx=None)
+        self.add_state("detection_labels", default=[], dist_reduce_fx=None)
+        self.add_state("groundtruths", default=[], dist_reduce_fx=None)
+        self.add_state("groundtruth_labels", default=[], dist_reduce_fx=None)
+
+    def update(self, preds: List[Dict[str, Array]], target: List[Dict[str, Array]]) -> None:
+        """Append per-image detections and ground truths to the unreduced states."""
+        _input_validator(preds, target, iou_type=self.iou_type)
+
+        for item in preds:
+            self.detections.append(self._get_safe_item_values(item))
+            self.detection_labels.append(jnp.asarray(item["labels"]).reshape(-1))
+            self.detection_scores.append(jnp.asarray(item["scores"]).reshape(-1))
+
+        for item in target:
+            self.groundtruths.append(self._get_safe_item_values(item))
+            self.groundtruth_labels.append(jnp.asarray(item["labels"]).reshape(-1))
+
+    def _get_safe_item_values(self, item: Dict[str, Any]) -> Array:
+        boxes = _fix_empty_tensors(item["boxes"])
+        if boxes.size > 0:
+            boxes = box_convert(boxes, in_fmt=self.box_format, out_fmt="xyxy")
+        return boxes
+
+    def _get_classes(self) -> List:
+        """Unique classes present in detections or ground truth (reference :407-411)."""
+        if len(self.detection_labels) > 0 or len(self.groundtruth_labels) > 0:
+            labels = [np.asarray(x).reshape(-1) for x in list(self.detection_labels) + list(self.groundtruth_labels)]
+            cat = np.concatenate(labels) if labels else np.zeros(0)
+            return sorted(np.unique(cat).astype(np.int64).tolist()) if cat.size else []
+        return []
+
+    # ------------------------------------------------------------- evaluation
+
+    def _build_groups(self, class_ids: List[int]):
+        """Collect non-empty (image, class) evaluation groups as padded arrays."""
+        max_det = self.max_detection_thresholds[-1]
+        det_boxes_np = [np.asarray(b, np.float32).reshape(-1, 4) for b in self.detections]
+        det_scores_np = [np.asarray(s, np.float32).reshape(-1) for s in self.detection_scores]
+        det_labels_np = [np.asarray(l).reshape(-1) for l in self.detection_labels]
+        gt_boxes_np = [np.asarray(b, np.float32).reshape(-1, 4) for b in self.groundtruths]
+        gt_labels_np = [np.asarray(l).reshape(-1) for l in self.groundtruth_labels]
+
+        groups = []  # (img_idx, class_idx, det_boxes, det_scores, gt_boxes)
+        for img in range(len(gt_boxes_np)):
+            for k_idx, cls in enumerate(class_ids):
+                dmask = det_labels_np[img] == cls if img < len(det_labels_np) else np.zeros(0, bool)
+                gmask = gt_labels_np[img] == cls
+                if not dmask.any() and not gmask.any():
+                    continue
+                db = det_boxes_np[img][dmask]
+                ds = det_scores_np[img][dmask]
+                order = np.argsort(-ds, kind="stable")[:max_det]
+                groups.append((img, k_idx, db[order], ds[order], gt_boxes_np[img][gmask]))
+        return groups
+
+    def _calculate(self, class_ids: List[int]) -> Tuple[np.ndarray, np.ndarray]:
+        """Precision/recall tables over (T, R, K, A, M) via the device matching kernel."""
+        num_t = len(self.iou_thresholds)
+        num_r = len(self.rec_thresholds)
+        num_k = len(class_ids)
+        num_a = len(self.bbox_area_ranges)
+        num_m = len(self.max_detection_thresholds)
+        precision = -np.ones((num_t, num_r, num_k, num_a, num_m))
+        recall = -np.ones((num_t, num_k, num_a, num_m))
+
+        groups = self._build_groups(class_ids)
+        if not groups:
+            return precision, recall
+
+        ng = len(groups)
+        pad_n = _pow2(ng)
+        pad_d = _pow2(max(1, max(g[2].shape[0] for g in groups)))
+        pad_g = _pow2(max(1, max(g[4].shape[0] for g in groups)))
+
+        det_boxes = np.zeros((pad_n, pad_d, 4), np.float32)
+        det_scores = np.full((pad_n, pad_d), -np.inf, np.float32)
+        det_valid = np.zeros((pad_n, pad_d), bool)
+        gt_boxes = np.zeros((pad_n, pad_g, 4), np.float32)
+        gt_valid = np.zeros((pad_n, pad_g), bool)
+        group_img = np.zeros(ng, np.int64)
+        group_cls = np.zeros(ng, np.int64)
+        for i, (img, k_idx, db, ds, gb) in enumerate(groups):
+            group_img[i], group_cls[i] = img, k_idx
+            det_boxes[i, : db.shape[0]] = db
+            det_scores[i, : ds.shape[0]] = ds
+            det_valid[i, : db.shape[0]] = True
+            gt_boxes[i, : gb.shape[0]] = gb
+            gt_valid[i, : gb.shape[0]] = True
+
+        area_ranges = np.asarray(list(self.bbox_area_ranges.values()), np.float32)
+        det_matched, det_ignored, npig_ga = jax.device_get(
+            _match_groups(
+                jnp.asarray(det_boxes),
+                jnp.asarray(det_valid),
+                jnp.asarray(gt_boxes),
+                jnp.asarray(gt_valid),
+                jnp.asarray(self.iou_thresholds, jnp.float32),
+                jnp.asarray(area_ranges),
+            )
+        )
+        det_matched = det_matched[:ng]   # (ng, A, T, D)
+        det_ignored = det_ignored[:ng]
+        npig_ga = npig_ga[:ng]           # (ng, A)
+
+        rec_thresholds = np.asarray(self.rec_thresholds)
+        for k_idx in range(num_k):
+            sel = np.nonzero(group_cls == k_idx)[0]
+            if sel.size == 0:
+                continue
+            for a_idx in range(num_a):
+                npig = int(npig_ga[sel, a_idx].sum())
+                if npig == 0:
+                    continue
+                for m_idx, max_det in enumerate(self.max_detection_thresholds):
+                    cap = min(max_det, det_scores.shape[1])
+                    scores_flat = det_scores[sel, :cap].reshape(-1)
+                    matched = det_matched[sel, a_idx, :, :cap].transpose(1, 0, 2).reshape(num_t, -1)
+                    ignored = det_ignored[sel, a_idx, :, :cap].transpose(1, 0, 2).reshape(num_t, -1)
+
+                    order = np.argsort(-scores_flat, kind="stable")
+                    matched = matched[:, order]
+                    ignored = ignored[:, order]
+
+                    tps = np.cumsum(matched & ~ignored, axis=1, dtype=np.float64)
+                    fps = np.cumsum(~matched & ~ignored, axis=1, dtype=np.float64)
+                    nd = tps.shape[1]
+                    rc = tps / npig
+                    pr = tps / (fps + tps + _EPS)
+                    recall[:, k_idx, a_idx, m_idx] = rc[:, -1] if nd else 0.0
+
+                    # precision envelope: running max from the right (reference
+                    # removes zigzags with a while-loop, :826-830 — same fixpoint)
+                    pr = np.maximum.accumulate(pr[:, ::-1], axis=1)[:, ::-1]
+
+                    for t_idx in range(num_t):
+                        inds = np.searchsorted(rc[t_idx], rec_thresholds, side="left")
+                        num_inds = int(inds.argmax()) if inds.max() >= nd else num_r
+                        prec = np.zeros(num_r)
+                        prec[:num_inds] = pr[t_idx][inds[:num_inds]]
+                        precision[t_idx, :, k_idx, a_idx, m_idx] = prec
+
+        return precision, recall
+
+    # ------------------------------------------------------------- summaries
+
+    def _summarize(
+        self,
+        results: Dict[str, np.ndarray],
+        avg_prec: bool = True,
+        iou_threshold: Optional[float] = None,
+        area_range: str = "all",
+        max_dets: int = 100,
+    ) -> Array:
+        """Mean over valid (> -1) table entries for one view (reference :637-679)."""
+        area_inds = [i for i, k in enumerate(self.bbox_area_ranges.keys()) if k == area_range]
+        mdet_inds = [i for i, k in enumerate(self.max_detection_thresholds) if k == max_dets]
+        if avg_prec:
+            prec = results["precision"]
+            if iou_threshold is not None:
+                thr = self.iou_thresholds.index(iou_threshold)
+                prec = prec[thr, :, :, area_inds, mdet_inds]
+            else:
+                prec = prec[:, :, :, area_inds, mdet_inds]
+        else:
+            prec = results["recall"]
+            if iou_threshold is not None:
+                thr = self.iou_thresholds.index(iou_threshold)
+                prec = prec[thr, :, :, area_inds, mdet_inds]
+            else:
+                prec = prec[:, :, area_inds, mdet_inds]
+        valid = prec[prec > -1]
+        return jnp.asarray([-1.0]) if valid.size == 0 else jnp.asarray(valid.mean(), jnp.float32)
+
+    def _summarize_results(self, precisions: np.ndarray, recalls: np.ndarray) -> Tuple[MAPMetricResults, MARMetricResults]:
+        """COCO summary table from precision/recall tables (reference :738-770)."""
+        results = {"precision": precisions, "recall": recalls}
+        map_metrics = MAPMetricResults()
+        last_max_det_thr = self.max_detection_thresholds[-1]
+        map_metrics.map = self._summarize(results, True, max_dets=last_max_det_thr)
+        if 0.5 in self.iou_thresholds:
+            map_metrics.map_50 = self._summarize(results, True, iou_threshold=0.5, max_dets=last_max_det_thr)
+        else:
+            map_metrics.map_50 = jnp.asarray([-1.0])
+        if 0.75 in self.iou_thresholds:
+            map_metrics.map_75 = self._summarize(results, True, iou_threshold=0.75, max_dets=last_max_det_thr)
+        else:
+            map_metrics.map_75 = jnp.asarray([-1.0])
+        map_metrics.map_small = self._summarize(results, True, area_range="small", max_dets=last_max_det_thr)
+        map_metrics.map_medium = self._summarize(results, True, area_range="medium", max_dets=last_max_det_thr)
+        map_metrics.map_large = self._summarize(results, True, area_range="large", max_dets=last_max_det_thr)
+
+        mar_metrics = MARMetricResults()
+        for max_det in self.max_detection_thresholds:
+            mar_metrics[f"mar_{max_det}"] = self._summarize(results, False, max_dets=max_det)
+        mar_metrics.mar_small = self._summarize(results, False, area_range="small", max_dets=last_max_det_thr)
+        mar_metrics.mar_medium = self._summarize(results, False, area_range="medium", max_dets=last_max_det_thr)
+        mar_metrics.mar_large = self._summarize(results, False, area_range="large", max_dets=last_max_det_thr)
+
+        return map_metrics, mar_metrics
+
+    def compute(self) -> dict:
+        """Full COCO result dict from the accumulated detections (reference :842-871)."""
+        classes = self._get_classes()
+        precisions, recalls = self._calculate(classes)
+        map_val, mar_val = self._summarize_results(precisions, recalls)
+
+        map_per_class_values: Array = jnp.asarray([-1.0])
+        mar_max_dets_per_class_values: Array = jnp.asarray([-1.0])
+        if self.class_metrics:
+            map_per_class_list = []
+            mar_max_dets_per_class_list = []
+            for class_idx, _ in enumerate(classes):
+                cls_precisions = precisions[:, :, class_idx][:, :, None]
+                cls_recalls = recalls[:, class_idx][:, None]
+                cls_map, cls_mar = self._summarize_results(cls_precisions, cls_recalls)
+                map_per_class_list.append(cls_map.map)
+                mar_max_dets_per_class_list.append(cls_mar[f"mar_{self.max_detection_thresholds[-1]}"])
+            map_per_class_values = jnp.asarray(
+                [float(np.asarray(x).reshape(-1)[0]) for x in map_per_class_list], jnp.float32
+            )
+            mar_max_dets_per_class_values = jnp.asarray(
+                [float(np.asarray(x).reshape(-1)[0]) for x in mar_max_dets_per_class_list], jnp.float32
+            )
+
+        metrics = COCOMetricResults()
+        metrics.update(map_val)
+        metrics.update(mar_val)
+        metrics.map_per_class = map_per_class_values
+        metrics[f"mar_{self.max_detection_thresholds[-1]}_per_class"] = mar_max_dets_per_class_values
+        metrics.classes = jnp.asarray(classes, jnp.int32)
+        return metrics

@@ -1,0 +1,85 @@
+"""Vectorized COCO-matching kernel for MeanAveragePrecision.
+
+The reference evaluates detections with a Python triple loop — per image x class x
+IoU-threshold greedy matching (``detection/mean_ap.py:509-606``, ``_find_best_gt_match``
+:608-635) — the hottest CPU loop in the whole library. Here the greedy match is a
+single ``lax.scan`` over score-sorted detections (the only true sequential dependency),
+with all IoU thresholds evaluated simultaneously as a vectorized ``(T, G)`` mask
+update, ``vmap``-ed over COCO area ranges and again over all (image, class) evaluation
+groups. Shapes are static (padded to power-of-two buckets by the caller), so XLA
+compiles one fused kernel that runs entirely on device.
+"""
+import functools
+
+import jax
+from jax import Array
+import jax.numpy as jnp
+
+from metrics_tpu.functional.detection.box_ops import box_area, box_iou
+
+
+@jax.jit
+def _match_groups(
+    det_boxes: Array,   # (N, D, 4) score-sorted per group, zero-padded
+    det_valid: Array,   # (N, D) bool
+    gt_boxes: Array,    # (N, G, 4) zero-padded
+    gt_valid: Array,    # (N, G) bool
+    iou_thresholds: Array,  # (T,)
+    area_ranges: Array,     # (A, 2) [lo, hi] area bounds
+):
+    """Greedy COCO matching for all groups x area ranges x IoU thresholds at once.
+
+    Returns ``det_matched (N, A, T, D)``, ``det_ignored (N, A, T, D)`` and
+    ``npig (N, A)`` — the number of non-ignored ground truths per group/area.
+    """
+    num_t = iou_thresholds.shape[0]
+
+    def per_group(db, dv, gb, gv):
+        iou = box_iou(db, gb)  # (D, G)
+        iou = jnp.where(dv[:, None] & gv[None, :], iou, 0.0)
+        d_area = box_area(db)
+        g_area = box_area(gb)
+        num_g = gb.shape[0]
+
+        def per_area(rng):
+            lo, hi = rng[0], rng[1]
+            g_ignore_area = (g_area < lo) | (g_area > hi)
+            # parity: reference sorts gts ignored-last before matching (:558-564)
+            sort_key = g_ignore_area.astype(jnp.int32) + 2 * (~gv).astype(jnp.int32)
+            perm = jnp.argsort(sort_key, stable=True)
+            iou_p = iou[:, perm]
+            g_ignore = (g_ignore_area | ~gv)[perm]  # (G,)
+
+            def step(gt_matches, inp):
+                # one detection, all T thresholds at once; ignored gts never match
+                # (parity with reference _find_best_gt_match :628-635)
+                row, valid_d = inp
+                remove = gt_matches | g_ignore[None, :]
+                cand = jnp.where(remove, 0.0, row[None, :])  # (T, G)
+                m = jnp.argmax(cand, axis=1)
+                best = jnp.take_along_axis(cand, m[:, None], axis=1)[:, 0]
+                matched = (best > iou_thresholds) & valid_d
+                hit = (jnp.arange(num_g)[None, :] == m[:, None]) & matched[:, None]
+                return gt_matches | hit, matched
+
+            gt_matches0 = jnp.zeros((num_t, num_g), bool)
+            _, det_matched = jax.lax.scan(step, gt_matches0, (iou_p, dv))
+            det_matched = det_matched.T  # (T, D)
+            d_outside = (d_area < lo) | (d_area > hi)
+            # unmatched out-of-range dets are ignored (:592-598); padding is always ignored
+            det_ignored = (~det_matched & d_outside[None, :]) | ~dv[None, :]
+            npig = jnp.sum(gv & ~g_ignore_area)
+            return det_matched, det_ignored, npig
+
+        return jax.vmap(per_area)(area_ranges)
+
+    return jax.vmap(per_group)(det_boxes, det_valid, gt_boxes, gt_valid)
+
+
+@functools.lru_cache(maxsize=None)
+def _pow2(n: int) -> int:
+    """Next power of two (>=1) — pads kernel shapes into a small set of buckets."""
+    p = 1
+    while p < n:
+        p *= 2
+    return p

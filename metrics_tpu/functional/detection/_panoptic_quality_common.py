@@ -1,0 +1,250 @@
+"""Shared panoptic-quality machinery.
+
+Behavioral parity with reference ``functional/detection/_panoptic_quality_common.py``
+(``_panoptic_quality_update_sample`` :300-381, ``_panoptic_quality_compute`` :433-454),
+re-designed for TPU: the reference walks Python dicts keyed by ``(category_id,
+instance_id)`` tuples and loops over every pred x target intersection pair. Here each
+sample's segments are relabeled to dense ids once (host ``np.unique`` — segment count
+is data-dependent, so this step cannot be static-shaped), and everything after that is
+a single ``(num_pred_segments, num_target_segments)`` intersection matrix built by one
+bincount over encoded pair-ids, with the matching / TP / FP / FN logic as fused
+vectorized masks over that matrix instead of per-pair Python branching.
+"""
+from typing import Collection, Dict, Optional, Set, Tuple
+
+import jax
+from jax import Array
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.utils.prints import rank_zero_warn
+
+_Color = Tuple[int, int]
+
+
+def _f64() -> jnp.dtype:
+    """Reference accumulates in double (:334); match it under x64, else f32."""
+    return jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+
+
+def _parse_categories(things: Collection[int], stuffs: Collection[int]) -> Tuple[Set[int], Set[int]]:
+    """Validate the ``things``/``stuffs`` category sets (reference :62-89)."""
+    things_parsed = set(things)
+    if len(things_parsed) < len(things):
+        rank_zero_warn("The provided `things` categories contained duplicates, which have been removed.", UserWarning)
+    stuffs_parsed = set(stuffs)
+    if len(stuffs_parsed) < len(stuffs):
+        rank_zero_warn("The provided `stuffs` categories contained duplicates, which have been removed.", UserWarning)
+    if not all(isinstance(val, int) for val in things_parsed):
+        raise TypeError(f"Expected argument `things` to contain `int` categories, but got {things}")
+    if not all(isinstance(val, int) for val in stuffs_parsed):
+        raise TypeError(f"Expected argument `stuffs` to contain `int` categories, but got {stuffs}")
+    if things_parsed & stuffs_parsed:
+        raise ValueError(
+            f"Expected arguments `things` and `stuffs` to have distinct keys, but got {things} and {stuffs}"
+        )
+    if not (things_parsed | stuffs_parsed):
+        raise ValueError("At least one of `things` and `stuffs` must be non-empty.")
+    return things_parsed, stuffs_parsed
+
+
+def _validate_inputs(preds, target) -> None:
+    """Shape validation (reference :92-116)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if preds.shape != target.shape:
+        raise ValueError(
+            f"Expected argument `preds` and `target` to have the same shape, but got {preds.shape} and {target.shape}"
+        )
+    if preds.ndim < 3:
+        raise ValueError(
+            "Expected argument `preds` to have at least one spatial dimension (B, *spatial_dims, 2), "
+            f"got {preds.shape}"
+        )
+    if preds.shape[-1] != 2:
+        raise ValueError(
+            "Expected argument `preds` to have exactly 2 channels in the last dimension (category, instance), "
+            f"got {preds.shape} instead"
+        )
+
+
+def _get_void_color(things: Set[int], stuffs: Set[int]) -> Tuple[int, int]:
+    """An unused (category, instance) color (reference :119-130)."""
+    unused_category_id = 1 + max([0] + list(things) + list(stuffs))
+    return unused_category_id, 0
+
+
+def _get_category_id_to_continuous_id(things: Set[int], stuffs: Set[int]) -> Dict[int, int]:
+    """Map original category ids to dense ids, things first (reference :133-150)."""
+    thing_id_to_continuous_id = {thing_id: idx for idx, thing_id in enumerate(things)}
+    stuff_id_to_continuous_id = {stuff_id: idx + len(things) for idx, stuff_id in enumerate(stuffs)}
+    cat_id_to_continuous_id = {}
+    cat_id_to_continuous_id.update(thing_id_to_continuous_id)
+    cat_id_to_continuous_id.update(stuff_id_to_continuous_id)
+    return cat_id_to_continuous_id
+
+
+def _preprocess_inputs(
+    things: Set[int],
+    stuffs: Set[int],
+    inputs,
+    void_color: Tuple[int, int],
+    allow_unknown_category: bool,
+) -> np.ndarray:
+    """Flatten spatial dims, zero stuff instance-ids, map unknown cats to void.
+
+    Reference ``_prepocess_inputs`` :167-202 (sic). Returns host ``(B, P, 2)`` int64.
+    """
+    out = np.array(inputs, dtype=np.int64, copy=True)
+    out = out.reshape(out.shape[0], -1, 2)
+    mask_stuffs = np.isin(out[:, :, 0], list(stuffs))
+    mask_things = np.isin(out[:, :, 0], list(things))
+    out[:, :, 1] = np.where(mask_stuffs, 0, out[:, :, 1])
+    unknown = ~(mask_things | mask_stuffs)
+    if not allow_unknown_category and unknown.any():
+        raise ValueError(f"Unknown categories found: {out[unknown]}")
+    out[:, :, 0] = np.where(unknown, void_color[0], out[:, :, 0])
+    out[:, :, 1] = np.where(unknown, void_color[1], out[:, :, 1])
+    return out
+
+
+def _panoptic_quality_update_sample(
+    flatten_preds: np.ndarray,
+    flatten_target: np.ndarray,
+    cat_id_to_continuous_id: Dict[int, int],
+    void_color: Tuple[int, int],
+    stuffs_modified_metric: Optional[Set[int]] = None,
+) -> Tuple[Array, Array, Array, Array]:
+    """Per-sample stat scores: (iou_sum, TP, FP, FN) per continuous category.
+
+    Parity target: reference ``_panoptic_quality_update_sample`` :300-381. The whole
+    pred x target matching is vectorized over the dense ``(Np, Nt)`` intersection
+    matrix; segments match when categories agree and IoU > 0.5 (IoU > 0.5 matches are
+    provably unique, so no greedy loop is needed).
+    """
+    stuffs_modified_metric = stuffs_modified_metric or set()
+    n_categories = len(cat_id_to_continuous_id)
+
+    # dense relabel: color (cat, inst) -> segment id (host; counts are data-dependent)
+    enc = np.int64(1) << np.int64(32)
+    pred_keys = flatten_preds[:, 0] * enc + flatten_preds[:, 1]
+    target_keys = flatten_target[:, 0] * enc + flatten_target[:, 1]
+    pred_colors, pred_ids = np.unique(pred_keys, return_inverse=True)
+    target_colors, target_ids = np.unique(target_keys, return_inverse=True)
+    num_p, num_t = len(pred_colors), len(target_colors)
+    pred_cat = (pred_colors // enc).astype(np.int64)
+    target_cat = (target_colors // enc).astype(np.int64)
+
+    void_key = np.int64(void_color[0]) * enc + np.int64(void_color[1])
+    p_void = pred_colors == void_key  # (Np,) one-hot at most
+    t_void = target_colors == void_key
+
+    # areas + intersection matrix: one fused bincount over encoded pair ids
+    pair_ids = jnp.asarray(pred_ids) * num_t + jnp.asarray(target_ids)
+    inter = jnp.bincount(pair_ids, length=num_p * num_t).reshape(num_p, num_t).astype(_f64())
+    pred_area = inter.sum(axis=1)  # == bincount(pred_ids); reuse the matrix
+    target_area = inter.sum(axis=0)
+
+    # IoU with void-corrected union (reference ``_calculate_iou`` :205-241)
+    pred_void_area = jnp.where(jnp.asarray(t_void).any(), inter[:, jnp.argmax(jnp.asarray(t_void))], 0.0)
+    void_target_area = jnp.where(jnp.asarray(p_void).any(), inter[jnp.argmax(jnp.asarray(p_void)), :], 0.0)
+    union = pred_area[:, None] - pred_void_area[:, None] + target_area[None, :] - void_target_area[None, :] - inter
+    iou = jnp.where(union > 0, inter / jnp.where(union > 0, union, 1.0), 0.0)
+
+    same_cat = jnp.asarray(pred_cat)[:, None] == jnp.asarray(target_cat)[None, :]
+    considered = same_cat & (inter > 0) & ~jnp.asarray(t_void)[None, :] & ~jnp.asarray(p_void)[:, None]
+
+    modified_stuff_cat = np.isin(target_cat, list(stuffs_modified_metric)) if stuffs_modified_metric else np.zeros(
+        num_t, dtype=bool
+    )
+    modified_stuff_pair = jnp.asarray(modified_stuff_cat)[None, :]
+
+    matched = considered & (iou > 0.5) & ~modified_stuff_pair
+    modified_matched = considered & (iou > 0) & modified_stuff_pair
+
+    # continuous-id lookup for each target/pred segment (host dict -> dense map)
+    cont_of = np.full(max(cat_id_to_continuous_id) + 2, -1, dtype=np.int64)
+    for cat, cont in cat_id_to_continuous_id.items():
+        cont_of[cat] = cont
+    target_cont = jnp.asarray(np.where((target_cat >= 0) & (target_cat < len(cont_of)), cont_of[np.clip(target_cat, 0, len(cont_of) - 1)], -1))
+    pred_cont = jnp.asarray(np.where((pred_cat >= 0) & (pred_cat < len(cont_of)), cont_of[np.clip(pred_cat, 0, len(cont_of) - 1)], -1))
+
+    pair_cont = jnp.broadcast_to(target_cont[None, :], matched.shape)
+    iou_contrib = jnp.where(matched | modified_matched, iou, 0.0)
+    iou_sum = jnp.zeros(n_categories, _f64()).at[jnp.clip(pair_cont, 0)].add(
+        jnp.where(pair_cont >= 0, iou_contrib, 0.0)
+    )
+    true_positives = jnp.zeros(n_categories, jnp.int32).at[jnp.clip(pair_cont, 0)].add(
+        jnp.where(pair_cont >= 0, matched, False).astype(jnp.int32)
+    )
+
+    # FN: unmatched non-void target segments that are not mostly void in the pred
+    target_matched = matched.any(axis=0)
+    mostly_void_t = void_target_area > 0.5 * target_area
+    fn_mask = (
+        ~target_matched
+        & ~jnp.asarray(t_void)
+        & ~mostly_void_t
+        & ~jnp.asarray(modified_stuff_cat)
+        & (target_cont >= 0)
+    )
+    false_negatives = jnp.zeros(n_categories, jnp.int32).at[jnp.clip(target_cont, 0)].add(fn_mask.astype(jnp.int32))
+
+    # FP: unmatched non-void pred segments that are not mostly void in the target
+    pred_matched = matched.any(axis=1)
+    mostly_void_p = pred_void_area > 0.5 * pred_area
+    modified_stuff_pred = (
+        jnp.asarray(np.isin(pred_cat, list(stuffs_modified_metric))) if stuffs_modified_metric else jnp.zeros(num_p, bool)
+    )
+    fp_mask = ~pred_matched & ~jnp.asarray(p_void) & ~mostly_void_p & ~modified_stuff_pred & (pred_cont >= 0)
+    false_positives = jnp.zeros(n_categories, jnp.int32).at[jnp.clip(pred_cont, 0)].add(fp_mask.astype(jnp.int32))
+
+    # modified PQ: TP counts every target segment of a modified-stuff category
+    if stuffs_modified_metric:
+        seg_mask = jnp.asarray(modified_stuff_cat) & (target_cont >= 0)
+        true_positives = true_positives.at[jnp.clip(target_cont, 0)].add(seg_mask.astype(jnp.int32))
+
+    return iou_sum, true_positives, false_positives, false_negatives
+
+
+def _panoptic_quality_update(
+    flatten_preds: np.ndarray,
+    flatten_target: np.ndarray,
+    cat_id_to_continuous_id: Dict[int, int],
+    void_color: Tuple[int, int],
+    modified_metric_stuffs: Optional[Set[int]] = None,
+) -> Tuple[Array, Array, Array, Array]:
+    """Batch stat scores (reference :384-430). Segments never match across samples."""
+    n_categories = len(cat_id_to_continuous_id)
+    iou_sum = jnp.zeros(n_categories, _f64())
+    true_positives = jnp.zeros(n_categories, jnp.int32)
+    false_positives = jnp.zeros(n_categories, jnp.int32)
+    false_negatives = jnp.zeros(n_categories, jnp.int32)
+
+    for preds_single, target_single in zip(flatten_preds, flatten_target):
+        result = _panoptic_quality_update_sample(
+            preds_single,
+            target_single,
+            cat_id_to_continuous_id,
+            void_color,
+            stuffs_modified_metric=modified_metric_stuffs,
+        )
+        iou_sum = iou_sum + result[0]
+        true_positives = true_positives + result[1]
+        false_positives = false_positives + result[2]
+        false_negatives = false_negatives + result[3]
+
+    return iou_sum, true_positives, false_positives, false_negatives
+
+
+def _panoptic_quality_compute(
+    iou_sum: Array,
+    true_positives: Array,
+    false_positives: Array,
+    false_negatives: Array,
+) -> Array:
+    """PQ = IoU-sum / (TP + FP/2 + FN/2), averaged over seen categories (reference :433-454)."""
+    denominator = (true_positives + 0.5 * false_positives + 0.5 * false_negatives).astype(_f64())
+    panoptic_quality = jnp.where(denominator > 0.0, iou_sum / jnp.where(denominator > 0, denominator, 1.0), 0.0)
+    seen = denominator > 0
+    return jnp.where(seen.any(), panoptic_quality.sum() / jnp.clip(seen.sum(), 1), jnp.nan)

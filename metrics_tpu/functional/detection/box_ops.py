@@ -1,0 +1,107 @@
+"""TPU-native pairwise box kernels.
+
+The reference delegates box geometry to ``torchvision.ops`` (box_iou,
+generalized_box_iou, distance_box_iou, complete_box_iou, box_convert — cited from
+reference ``functional/detection/iou.py:21``, ``giou.py:21``, ``diou.py:21``,
+``ciou.py:21``, ``detection/iou.py:28``). There is no torchvision on TPU; these are
+from-scratch jnp implementations of the same math. Every kernel is a fused
+broadcast-reduction over ``(N, 1, 4) x (1, M, 4)`` — XLA tiles the (N, M) result
+onto the VPU in one pass, no host loop, no scatter.
+
+All boxes are ``(x1, y1, x2, y2)`` with ``0 <= x1 < x2`` and ``0 <= y1 < y2``.
+"""
+from jax import Array
+import jax.numpy as jnp
+
+_EPS = 1e-7  # same stabilizer torchvision uses for the d/c-iou denominators
+
+
+def box_convert(boxes: Array, in_fmt: str, out_fmt: str = "xyxy") -> Array:
+    """Convert ``(N, 4)`` boxes between ``xyxy``/``xywh``/``cxcywh`` formats."""
+    if in_fmt == out_fmt:
+        return boxes
+    if out_fmt != "xyxy":
+        raise ValueError(f"Only conversion to 'xyxy' is supported, got {out_fmt}")
+    boxes = jnp.asarray(boxes, jnp.float32)
+    a, b, c, d = boxes[..., 0], boxes[..., 1], boxes[..., 2], boxes[..., 3]
+    if in_fmt == "xywh":
+        return jnp.stack([a, b, a + c, b + d], axis=-1)
+    if in_fmt == "cxcywh":
+        return jnp.stack([a - c / 2, b - d / 2, a + c / 2, b + d / 2], axis=-1)
+    raise ValueError(f"Unsupported box format {in_fmt!r}; expected one of ('xyxy', 'xywh', 'cxcywh')")
+
+
+def box_area(boxes: Array) -> Array:
+    """Areas of ``(..., 4)`` xyxy boxes."""
+    boxes = jnp.asarray(boxes, jnp.float32)
+    return (boxes[..., 2] - boxes[..., 0]) * (boxes[..., 3] - boxes[..., 1])
+
+
+def _inter_union(preds: Array, target: Array):
+    """Pairwise intersection and union: ``(N, 4), (M, 4) -> (N, M), (N, M)``."""
+    preds = jnp.asarray(preds, jnp.float32)
+    target = jnp.asarray(target, jnp.float32)
+    lt = jnp.maximum(preds[:, None, :2], target[None, :, :2])
+    rb = jnp.minimum(preds[:, None, 2:], target[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = box_area(preds)[:, None] + box_area(target)[None, :] - inter
+    return inter, union
+
+
+def box_iou(preds: Array, target: Array) -> Array:
+    """Pairwise IoU matrix ``(N, M)``."""
+    inter, union = _inter_union(preds, target)
+    return jnp.where(union > 0, inter / jnp.where(union > 0, union, 1.0), 0.0)
+
+
+def _enclosing_wh(preds: Array, target: Array) -> Array:
+    """Width/height of the smallest box enclosing each pair: ``(N, M, 2)``."""
+    lt = jnp.minimum(preds[:, None, :2], target[None, :, :2])
+    rb = jnp.maximum(preds[:, None, 2:], target[None, :, 2:])
+    return jnp.clip(rb - lt, 0)
+
+
+def generalized_box_iou(preds: Array, target: Array) -> Array:
+    """Pairwise GIoU matrix: ``iou - (enclosing_area - union) / enclosing_area``."""
+    preds = jnp.asarray(preds, jnp.float32)
+    target = jnp.asarray(target, jnp.float32)
+    inter, union = _inter_union(preds, target)
+    iou = inter / union
+    whi = _enclosing_wh(preds, target)
+    enclosing = whi[..., 0] * whi[..., 1]
+    return iou - (enclosing - union) / enclosing
+
+
+def _diou_iou(preds: Array, target: Array):
+    iou = box_iou(preds, target)
+    whi = _enclosing_wh(preds, target)
+    diag_sq = whi[..., 0] ** 2 + whi[..., 1] ** 2 + _EPS
+    cx_p = (preds[:, 0] + preds[:, 2]) / 2
+    cy_p = (preds[:, 1] + preds[:, 3]) / 2
+    cx_t = (target[:, 0] + target[:, 2]) / 2
+    cy_t = (target[:, 1] + target[:, 3]) / 2
+    center_sq = (cx_p[:, None] - cx_t[None, :]) ** 2 + (cy_p[:, None] - cy_t[None, :]) ** 2
+    return iou - center_sq / diag_sq, iou
+
+
+def distance_box_iou(preds: Array, target: Array) -> Array:
+    """Pairwise DIoU matrix: ``iou - center_distance² / enclosing_diagonal²``."""
+    preds = jnp.asarray(preds, jnp.float32)
+    target = jnp.asarray(target, jnp.float32)
+    diou, _ = _diou_iou(preds, target)
+    return diou
+
+
+def complete_box_iou(preds: Array, target: Array) -> Array:
+    """Pairwise CIoU matrix: ``diou - alpha * v`` with the aspect-ratio term ``v``."""
+    preds = jnp.asarray(preds, jnp.float32)
+    target = jnp.asarray(target, jnp.float32)
+    diou, iou = _diou_iou(preds, target)
+    w_p = preds[:, 2] - preds[:, 0]
+    h_p = preds[:, 3] - preds[:, 1]
+    w_t = target[:, 2] - target[:, 0]
+    h_t = target[:, 3] - target[:, 1]
+    v = (4 / jnp.pi**2) * (jnp.arctan(w_t / h_t)[None, :] - jnp.arctan(w_p / h_p)[:, None]) ** 2
+    alpha = v / (1 - iou + v + _EPS)
+    return diou - alpha * v

@@ -1,0 +1,41 @@
+"""GIoU functional (reference: functional/detection/giou.py:30-82)."""
+from typing import Optional
+
+from jax import Array
+import jax.numpy as jnp
+
+from metrics_tpu.functional.detection.box_ops import generalized_box_iou
+
+
+def _giou_update(preds: Array, target: Array, iou_threshold: Optional[float], replacement_val: float = 0) -> Array:
+    iou = generalized_box_iou(preds, target)
+    if iou_threshold is not None:
+        iou = jnp.where(iou < iou_threshold, replacement_val, iou)
+    return iou
+
+
+def _giou_compute(iou: Array, labels_eq: bool = True) -> Array:
+    if labels_eq:
+        return jnp.diagonal(iou).mean()
+    return iou.mean()
+
+
+def generalized_intersection_over_union(
+    preds: Array,
+    target: Array,
+    iou_threshold: Optional[float] = None,
+    replacement_val: float = 0,
+    aggregate: bool = True,
+) -> Array:
+    """Compute Generalized Intersection over Union between two sets of xyxy boxes.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional.detection import generalized_intersection_over_union
+        >>> preds = jnp.array([[100.0, 100.0, 200.0, 200.0]])
+        >>> target = jnp.array([[110.0, 110.0, 210.0, 210.0]])
+        >>> generalized_intersection_over_union(preds, target)
+        Array(0.6641434, dtype=float32)
+    """
+    iou = _giou_update(preds, target, iou_threshold, replacement_val)
+    return _giou_compute(iou) if aggregate else iou

@@ -1,0 +1,41 @@
+"""IoU functional (reference: functional/detection/iou.py:29-81)."""
+from typing import Optional
+
+from jax import Array
+import jax.numpy as jnp
+
+from metrics_tpu.functional.detection.box_ops import box_iou
+
+
+def _iou_update(preds: Array, target: Array, iou_threshold: Optional[float], replacement_val: float = 0) -> Array:
+    iou = box_iou(preds, target)
+    if iou_threshold is not None:
+        iou = jnp.where(iou < iou_threshold, replacement_val, iou)
+    return iou
+
+
+def _iou_compute(iou: Array, labels_eq: bool = True) -> Array:
+    if labels_eq:
+        return jnp.diagonal(iou).mean()
+    return iou.mean()
+
+
+def intersection_over_union(
+    preds: Array,
+    target: Array,
+    iou_threshold: Optional[float] = None,
+    replacement_val: float = 0,
+    aggregate: bool = True,
+) -> Array:
+    """Compute Intersection over Union between two sets of xyxy boxes.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional.detection import intersection_over_union
+        >>> preds = jnp.array([[100.0, 100.0, 200.0, 200.0]])
+        >>> target = jnp.array([[110.0, 110.0, 210.0, 210.0]])
+        >>> intersection_over_union(preds, target)
+        Array(0.6806723, dtype=float32)
+    """
+    iou = _iou_update(preds, target, iou_threshold, replacement_val)
+    return _iou_compute(iou) if aggregate else iou

@@ -1,0 +1,408 @@
+"""Detection-domain tests.
+
+mAP parity targets are the official pycocotools numbers on the COCO-subset fixture
+used by the reference test suite (reference tests/unittests/detection/test_map.py:235-293,
+first 10 fake bbox results of the cocoapi repo), atol=1e-2 — the same oracle and
+tolerance the reference holds itself to. IoU-family expectations are the reference
+doctest outputs (torchvision.ops oracles).
+"""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from metrics_tpu.detection import (
+    CompleteIntersectionOverUnion,
+    DistanceIntersectionOverUnion,
+    GeneralizedIntersectionOverUnion,
+    IntersectionOverUnion,
+    MeanAveragePrecision,
+    ModifiedPanopticQuality,
+    PanopticQuality,
+)
+from metrics_tpu.functional.detection import (
+    box_area,
+    box_convert,
+    box_iou,
+    complete_intersection_over_union,
+    distance_intersection_over_union,
+    generalized_intersection_over_union,
+    intersection_over_union,
+    modified_panoptic_quality,
+    panoptic_quality,
+)
+
+
+# -------------------------------------------------------------------- box ops
+
+
+def test_box_convert():
+    xywh = jnp.array([[10.0, 20.0, 30.0, 40.0]])
+    np.testing.assert_allclose(box_convert(xywh, "xywh"), [[10.0, 20.0, 40.0, 60.0]])
+    cxcywh = jnp.array([[25.0, 40.0, 30.0, 40.0]])
+    np.testing.assert_allclose(box_convert(cxcywh, "cxcywh"), [[10.0, 20.0, 40.0, 60.0]])
+    np.testing.assert_allclose(box_convert(cxcywh, "xyxy"), cxcywh)
+    with pytest.raises(ValueError):
+        box_convert(xywh, "bad_fmt")
+
+
+def test_box_iou_matrix():
+    a = jnp.array([[0.0, 0.0, 10.0, 10.0], [5.0, 5.0, 15.0, 15.0]])
+    b = jnp.array([[0.0, 0.0, 10.0, 10.0], [100.0, 100.0, 110.0, 110.0]])
+    iou = box_iou(a, b)
+    assert iou.shape == (2, 2)
+    np.testing.assert_allclose(iou[0, 0], 1.0)
+    np.testing.assert_allclose(iou[0, 1], 0.0)
+    np.testing.assert_allclose(iou[1, 0], 25.0 / 175.0, rtol=1e-6)
+    np.testing.assert_allclose(box_area(a), [100.0, 100.0])
+
+
+@pytest.mark.parametrize(
+    ("fn", "expected"),
+    [
+        (intersection_over_union, 0.6807),
+        (generalized_intersection_over_union, 0.6641),
+        (distance_intersection_over_union, 0.6724),
+        (complete_intersection_over_union, 0.6724),
+    ],
+)
+def test_iou_functional_reference_values(fn, expected):
+    """Reference doctest oracles (functional/detection/*.py)."""
+    preds = jnp.array([[100.0, 100.0, 200.0, 200.0]])
+    target = jnp.array([[110.0, 110.0, 210.0, 210.0]])
+    np.testing.assert_allclose(float(fn(preds, target)), expected, atol=1e-4)
+
+
+def test_iou_functional_threshold_and_matrix():
+    preds = jnp.array([[100.0, 100.0, 200.0, 200.0]])
+    target = jnp.array([[110.0, 110.0, 210.0, 210.0]])
+    assert float(intersection_over_union(preds, target, iou_threshold=0.9)) == 0.0
+    mat = intersection_over_union(preds, target, aggregate=False)
+    assert mat.shape == (1, 1)
+
+
+# ---------------------------------------------------------------- IoU classes
+
+_iou_preds = [
+    {
+        "boxes": jnp.array([[296.55, 93.96, 314.97, 152.79], [298.55, 98.96, 314.97, 151.79]]),
+        "scores": jnp.array([0.236, 0.56]),
+        "labels": jnp.array([4, 5]),
+    }
+]
+_iou_target = [
+    {
+        "boxes": jnp.array([[300.00, 100.00, 315.00, 150.00]]),
+        "labels": jnp.array([5]),
+    }
+]
+
+
+@pytest.mark.parametrize(
+    ("cls", "key", "expected"),
+    [
+        (IntersectionOverUnion, "iou", 0.4307),
+        (GeneralizedIntersectionOverUnion, "giou", -0.0694),
+        (DistanceIntersectionOverUnion, "diou", -0.0694),
+        (CompleteIntersectionOverUnion, "ciou", -0.5694),
+    ],
+)
+def test_iou_class_reference_values(cls, key, expected):
+    """Reference doctest oracles (detection/{iou,giou,diou,ciou}.py)."""
+    metric = cls()
+    result = metric(_iou_preds, _iou_target)
+    np.testing.assert_allclose(float(result[key]), expected, atol=1e-4)
+
+
+def test_iou_class_metrics_and_accumulation():
+    metric = IntersectionOverUnion(class_metrics=True)
+    metric.update(_iou_preds, _iou_target)
+    metric.update(_iou_preds, _iou_target)
+    result = metric.compute()
+    assert "iou" in result and "iou/cl_5" in result
+    np.testing.assert_allclose(float(result["iou"]), 0.4307, atol=1e-4)
+
+
+def test_iou_input_validation():
+    metric = IntersectionOverUnion()
+    with pytest.raises(ValueError, match="Expected argument `preds` and `target` to have the same length"):
+        metric.update(_iou_preds, [])
+    with pytest.raises(ValueError, match="Expected all dicts in `preds` to contain the `scores` key"):
+        metric.update([{"boxes": jnp.zeros((1, 4)), "labels": jnp.zeros(1)}], _iou_target)
+
+
+# ------------------------------------------------------------------------ mAP
+
+_map_preds = [
+    dict(boxes=jnp.array([[258.15, 41.29, 606.41, 285.07]]), scores=jnp.array([0.236]), labels=jnp.array([4])),
+    dict(
+        boxes=jnp.array([[61.00, 22.75, 565.00, 632.42], [12.66, 3.32, 281.26, 275.23]]),
+        scores=jnp.array([0.318, 0.726]),
+        labels=jnp.array([3, 2]),
+    ),
+    dict(
+        boxes=jnp.array(
+            [
+                [87.87, 276.25, 384.29, 379.43],
+                [0.00, 3.66, 142.15, 316.06],
+                [296.55, 93.96, 314.97, 152.79],
+                [328.94, 97.05, 342.49, 122.98],
+                [356.62, 95.47, 372.33, 147.55],
+                [464.08, 105.09, 495.74, 146.99],
+                [276.11, 103.84, 291.44, 150.72],
+            ]
+        ),
+        scores=jnp.array([0.546, 0.3, 0.407, 0.611, 0.335, 0.805, 0.953]),
+        labels=jnp.array([4, 1, 0, 0, 0, 0, 0]),
+    ),
+    dict(
+        boxes=jnp.array(
+            [
+                [72.92, 45.96, 91.23, 80.57],
+                [45.17, 45.34, 66.28, 79.83],
+                [82.28, 47.04, 99.66, 78.50],
+                [59.96, 46.17, 80.35, 80.48],
+                [75.29, 23.01, 91.85, 50.85],
+                [71.14, 1.10, 96.96, 28.33],
+                [61.34, 55.23, 77.14, 79.57],
+                [41.17, 45.78, 60.99, 78.48],
+                [56.18, 44.80, 64.42, 56.25],
+            ]
+        ),
+        scores=jnp.array([0.532, 0.204, 0.782, 0.202, 0.883, 0.271, 0.561, 0.204, 0.349]),
+        labels=jnp.array([49] * 9),
+    ),
+]
+_map_target = [
+    dict(boxes=jnp.array([[214.1500, 41.2900, 562.4100, 285.0700]]), labels=jnp.array([4])),
+    dict(
+        boxes=jnp.array([[13.00, 22.75, 548.98, 632.42], [1.66, 3.32, 270.26, 275.23]]),
+        labels=jnp.array([2, 2]),
+    ),
+    dict(
+        boxes=jnp.array(
+            [
+                [61.87, 276.25, 358.29, 379.43],
+                [2.75, 3.66, 162.15, 316.06],
+                [295.55, 93.96, 313.97, 152.79],
+                [326.94, 97.05, 340.49, 122.98],
+                [356.62, 95.47, 372.33, 147.55],
+                [462.08, 105.09, 493.74, 146.99],
+                [277.11, 103.84, 292.44, 150.72],
+            ]
+        ),
+        labels=jnp.array([4, 1, 0, 0, 0, 0, 0]),
+    ),
+    dict(
+        boxes=jnp.array(
+            [
+                [72.92, 45.96, 91.23, 80.57],
+                [50.17, 45.34, 71.28, 79.83],
+                [81.28, 47.04, 98.66, 78.50],
+                [63.96, 46.17, 84.35, 80.48],
+                [75.29, 23.01, 91.85, 50.85],
+                [56.39, 21.65, 75.66, 45.54],
+                [73.14, 1.10, 98.96, 28.33],
+                [62.34, 55.23, 78.14, 79.57],
+                [44.17, 45.78, 63.99, 78.48],
+                [58.18, 44.80, 66.42, 56.25],
+            ]
+        ),
+        labels=jnp.array([49] * 10),
+    ),
+]
+
+
+def test_map_single_box():
+    """Reference doctest oracle (detection/mean_ap.py:267-301)."""
+    preds = [dict(boxes=jnp.array([[258.0, 41.0, 606.0, 285.0]]), scores=jnp.array([0.536]), labels=jnp.array([0]))]
+    target = [dict(boxes=jnp.array([[214.0, 41.0, 562.0, 285.0]]), labels=jnp.array([0]))]
+    metric = MeanAveragePrecision()
+    metric.update(preds, target)
+    result = metric.compute()
+    np.testing.assert_allclose(float(result["map"]), 0.6, atol=1e-4)
+    np.testing.assert_allclose(float(result["map_50"]), 1.0, atol=1e-4)
+    np.testing.assert_allclose(float(result["map_75"]), 1.0, atol=1e-4)
+    np.testing.assert_allclose(float(result["map_large"]), 0.6, atol=1e-4)
+    np.testing.assert_allclose(float(result["map_small"]), -1.0)
+    np.testing.assert_allclose(float(result["mar_1"]), 0.6, atol=1e-4)
+    np.testing.assert_allclose(int(result["classes"]), 0)
+
+
+def test_map_coco_fixture_pycocotools_parity():
+    """Official pycocotools numbers on the cocoapi fake-bbox subset, atol=1e-2."""
+    metric = MeanAveragePrecision(class_metrics=True)
+    metric.update(_map_preds[:2], _map_target[:2])
+    metric.update(_map_preds[2:], _map_target[2:])
+    result = metric.compute()
+    expected = {
+        "map": 0.637,
+        "map_50": 0.859,
+        "map_75": 0.761,
+        "map_small": 0.622,
+        "map_medium": 0.800,
+        "map_large": 0.635,
+        "mar_1": 0.432,
+        "mar_10": 0.652,
+        "mar_100": 0.652,
+        "mar_small": 0.673,
+        "mar_medium": 0.800,
+        "mar_large": 0.633,
+    }
+    for key, value in expected.items():
+        np.testing.assert_allclose(float(np.asarray(result[key])), value, atol=1e-2, err_msg=key)
+    np.testing.assert_allclose(
+        np.asarray(result["map_per_class"]), [0.725, 0.800, 0.454, -1.000, 0.650, 0.556], atol=1e-2
+    )
+    np.testing.assert_allclose(
+        np.asarray(result["mar_100_per_class"]), [0.780, 0.800, 0.450, -1.000, 0.650, 0.580], atol=1e-2
+    )
+    np.testing.assert_allclose(np.asarray(result["classes"]), [0, 1, 2, 3, 4, 49])
+
+
+def test_map_empty_ground_truth_image():
+    """Image with predictions but empty ground truth (reference _inputs2)."""
+    preds = [
+        dict(boxes=jnp.array([[258.0, 41.0, 606.0, 285.0]]), scores=jnp.array([0.536]), labels=jnp.array([0])),
+        dict(boxes=jnp.array([[258.0, 41.0, 606.0, 285.0]]), scores=jnp.array([0.536]), labels=jnp.array([0])),
+    ]
+    target = [
+        dict(boxes=jnp.array([[214.0, 41.0, 562.0, 285.0]]), labels=jnp.array([0])),
+        dict(boxes=jnp.zeros((0, 4)), labels=jnp.zeros((0,), jnp.int32)),
+    ]
+    metric = MeanAveragePrecision()
+    metric.update(preds, target)
+    result = metric.compute()
+    # the extra FP ranks below the TP at equal score, so interpolated AP is unchanged
+    # (reference issue #943 fixture: map stays 0.6)
+    np.testing.assert_allclose(float(result["map"]), 0.6, atol=1e-4)
+
+
+def test_map_empty_predictions_image():
+    """Image with no predictions at all (reference _inputs3)."""
+    preds = [
+        dict(boxes=jnp.array([[258.0, 41.0, 606.0, 285.0]]), scores=jnp.array([0.536]), labels=jnp.array([0])),
+        dict(boxes=jnp.zeros((0, 4)), scores=jnp.zeros((0,)), labels=jnp.zeros((0,), jnp.int32)),
+    ]
+    target = [
+        dict(boxes=jnp.array([[214.0, 41.0, 562.0, 285.0]]), labels=jnp.array([0])),
+        dict(boxes=jnp.array([[1.0, 2.0, 3.0, 4.0]]), labels=jnp.array([1])),
+    ]
+    metric = MeanAveragePrecision()
+    metric.update(preds, target)
+    result = metric.compute()
+    assert np.isfinite(float(result["map"]))
+
+
+def test_map_no_updates():
+    metric = MeanAveragePrecision()
+    result = metric.compute()
+    np.testing.assert_allclose(float(np.asarray(result["map"]).reshape(-1)[0]), -1.0)
+
+
+def test_map_max_detection_thresholds_ordering():
+    metric = MeanAveragePrecision(max_detection_thresholds=[100, 1, 10])
+    assert metric.max_detection_thresholds == [1, 10, 100]
+
+
+def test_map_errors():
+    with pytest.raises(ValueError, match="Expected argument `class_metrics` to be a boolean"):
+        MeanAveragePrecision(class_metrics="yes")
+    with pytest.raises(ValueError, match="Expected argument `box_format`"):
+        MeanAveragePrecision(box_format="foo")
+    with pytest.raises(ValueError, match="iou_type"):
+        MeanAveragePrecision(iou_type="segm")
+
+
+def test_map_box_format_xywh():
+    """xywh inputs must give identical results to the equivalent xyxy inputs."""
+    preds_xyxy = [dict(boxes=jnp.array([[10.0, 20.0, 40.0, 60.0]]), scores=jnp.array([0.9]), labels=jnp.array([0]))]
+    target_xyxy = [dict(boxes=jnp.array([[10.0, 20.0, 40.0, 60.0]]), labels=jnp.array([0]))]
+    preds_xywh = [dict(boxes=jnp.array([[10.0, 20.0, 30.0, 40.0]]), scores=jnp.array([0.9]), labels=jnp.array([0]))]
+    target_xywh = [dict(boxes=jnp.array([[10.0, 20.0, 30.0, 40.0]]), labels=jnp.array([0]))]
+
+    m1 = MeanAveragePrecision()
+    m1.update(preds_xyxy, target_xyxy)
+    m2 = MeanAveragePrecision(box_format="xywh")
+    m2.update(preds_xywh, target_xywh)
+    np.testing.assert_allclose(float(m1.compute()["map"]), float(m2.compute()["map"]))
+
+
+# --------------------------------------------------------------- panoptic
+
+_pq_preds = jnp.array(
+    [
+        [
+            [[6, 0], [0, 0], [6, 0], [6, 0]],
+            [[0, 0], [0, 0], [6, 0], [0, 1]],
+            [[0, 0], [0, 0], [6, 0], [0, 1]],
+            [[0, 0], [7, 0], [6, 0], [1, 0]],
+            [[0, 0], [7, 0], [7, 0], [7, 0]],
+        ]
+    ]
+)
+_pq_target = jnp.array(
+    [
+        [
+            [[6, 0], [0, 1], [6, 0], [0, 1]],
+            [[0, 1], [0, 1], [6, 0], [0, 1]],
+            [[0, 1], [0, 1], [6, 0], [1, 0]],
+            [[0, 1], [7, 0], [1, 0], [1, 0]],
+            [[0, 1], [7, 0], [7, 0], [7, 0]],
+        ]
+    ]
+)
+
+
+def test_panoptic_quality_reference_value():
+    """Reference doctest oracle: PQ = 0.5463 (functional/detection/panoptic_qualities.py)."""
+    np.testing.assert_allclose(
+        float(panoptic_quality(_pq_preds, _pq_target, things={0, 1}, stuffs={6, 7})), 0.5463, atol=1e-4
+    )
+
+
+def test_modified_panoptic_quality_reference_value():
+    preds = jnp.array([[[0, 0], [0, 1], [6, 0], [7, 0], [0, 2], [1, 0]]])
+    target = jnp.array([[[0, 1], [0, 0], [6, 0], [7, 0], [6, 0], [255, 0]]])
+    np.testing.assert_allclose(
+        float(modified_panoptic_quality(preds, target, things={0, 1}, stuffs={6, 7})), 0.7667, atol=1e-4
+    )
+    np.testing.assert_allclose(
+        float(panoptic_quality(preds, target, things={0, 1}, stuffs={6, 7}, allow_unknown_preds_category=True)),
+        0.6,
+        atol=1e-4,
+    )
+
+
+def test_panoptic_quality_class_accumulation():
+    """Class API accumulates across updates; two identical updates keep the value."""
+    metric = PanopticQuality(things={0, 1}, stuffs={6, 7})
+    metric.update(_pq_preds, _pq_target)
+    metric.update(_pq_preds, _pq_target)
+    np.testing.assert_allclose(float(metric.compute()), 0.5463, atol=1e-4)
+
+    metric2 = ModifiedPanopticQuality(things={0, 1}, stuffs={6, 7})
+    preds = jnp.array([[[0, 0], [0, 1], [6, 0], [7, 0], [0, 2], [1, 0]]])
+    target = jnp.array([[[0, 1], [0, 0], [6, 0], [7, 0], [6, 0], [255, 0]]])
+    metric2.update(preds, target)
+    np.testing.assert_allclose(float(metric2.compute()), 0.7667, atol=1e-4)
+
+
+def test_panoptic_quality_perfect_match():
+    metric = PanopticQuality(things={0, 1}, stuffs={6, 7})
+    metric.update(_pq_target, _pq_target)
+    # identical inputs: every segment is a TP with IoU 1 -> PQ = 1
+    np.testing.assert_allclose(float(metric.compute()), 1.0, atol=1e-6)
+
+
+def test_panoptic_quality_errors():
+    with pytest.raises(ValueError, match="distinct"):
+        PanopticQuality(things={0, 1}, stuffs={1, 2})
+    with pytest.raises(ValueError, match="non-empty"):
+        PanopticQuality(things=set(), stuffs=set())
+    with pytest.raises(TypeError, match="int"):
+        PanopticQuality(things={0.5}, stuffs={1})
+    metric = PanopticQuality(things={0}, stuffs={6})
+    with pytest.raises(ValueError, match="Unknown categories"):
+        metric.update(jnp.array([[[5, 0]]]), jnp.array([[[0, 0]]]))
+    with pytest.raises(ValueError, match="same shape"):
+        metric.update(jnp.zeros((1, 4, 2), jnp.int32), jnp.zeros((1, 5, 2), jnp.int32))
