@@ -1,0 +1,34 @@
+"""Functional text metrics (reference: src/torchmetrics/functional/text/__init__.py)."""
+from metrics_tpu.functional.text.bert import bert_score
+from metrics_tpu.functional.text.bleu import bleu_score
+from metrics_tpu.functional.text.cer import char_error_rate
+from metrics_tpu.functional.text.chrf import chrf_score
+from metrics_tpu.functional.text.eed import extended_edit_distance
+from metrics_tpu.functional.text.infolm import infolm
+from metrics_tpu.functional.text.mer import match_error_rate
+from metrics_tpu.functional.text.perplexity import perplexity
+from metrics_tpu.functional.text.rouge import rouge_score
+from metrics_tpu.functional.text.sacre_bleu import sacre_bleu_score
+from metrics_tpu.functional.text.squad import squad
+from metrics_tpu.functional.text.ter import translation_edit_rate
+from metrics_tpu.functional.text.wer import word_error_rate
+from metrics_tpu.functional.text.wil import word_information_lost
+from metrics_tpu.functional.text.wip import word_information_preserved
+
+__all__ = [
+    "bert_score",
+    "bleu_score",
+    "char_error_rate",
+    "chrf_score",
+    "extended_edit_distance",
+    "infolm",
+    "match_error_rate",
+    "perplexity",
+    "rouge_score",
+    "sacre_bleu_score",
+    "squad",
+    "translation_edit_rate",
+    "word_error_rate",
+    "word_information_lost",
+    "word_information_preserved",
+]

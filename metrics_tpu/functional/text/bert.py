@@ -1,0 +1,173 @@
+"""BERTScore functional (reference: functional/text/bert.py:54-443).
+
+Callable-encoder redesign: instead of hard-wiring HuggingFace ``AutoModel``
+plumbing (reference loads a torch model + tokenizer and drives a DataLoader),
+the encoder is a user-supplied callable
+
+    ``encoder(sentences: Sequence[str]) -> (embeddings [B, S, D], input_ids [B, S],
+    attention_mask [B, S])``
+
+producing HF-style sequences (``[CLS] ... [SEP]`` — positions 0 and the last
+attended position are excluded from scoring exactly as the reference does,
+helper_embedding_metric.py:35-49). When ``transformers`` is installed and
+``model_name_or_path`` is given, a default jit-compiled encoder is built
+automatically. All scoring math — token-level cosine matching with optional IDF
+weighting — runs in jnp and is jit/shard_map-safe.
+
+Delta vs reference: per-call layer selection (``num_layers``/``all_layers``) is
+the encoder's concern here — an encoder can return any representation; the
+scoring math is layer-agnostic.
+"""
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.functional.text.helper import _input_ids_idf, _tokens_idf
+from metrics_tpu.utils.imports import _TRANSFORMERS_AVAILABLE
+
+_DEFAULT_MODEL = "roberta-large"
+
+TextEncoder = Callable[[Sequence[str]], Tuple[Array, np.ndarray, np.ndarray]]
+
+
+def _process_attention_mask_for_special_tokens(attention_mask: np.ndarray) -> np.ndarray:
+    """Zero out [CLS] (position 0) and [SEP] (last attended position) per row."""
+    mask = attention_mask.astype(np.float32).copy()
+    mask[:, 0] = 0
+    sep_positions = np.argmax(np.cumsum(mask - 0.1, axis=-1), axis=-1)
+    mask[np.arange(mask.shape[0]), sep_positions] = 0
+    return mask
+
+
+def _idf_scale(input_ids: np.ndarray, mask: np.ndarray, idf_map: Optional[Dict[int, float]]) -> np.ndarray:
+    """Per-token weights normalized within each sentence (uniform when no idf)."""
+    if idf_map is None:
+        weights = mask.astype(np.float32)
+    else:
+        weights = _input_ids_idf(input_ids, idf_map) * mask
+    return weights / np.maximum(weights.sum(-1, keepdims=True), 1e-30)
+
+
+def _bert_score_from_embeddings(
+    preds_emb: Array,
+    preds_scale: Array,
+    target_emb: Array,
+    target_scale: Array,
+) -> Tuple[Array, Array, Array]:
+    """Greedy token matching: (precision, recall, f1) per sample — pure jnp.
+
+    Embeddings must be L2-normalized with masked-out positions zeroed; scales must
+    be normalized per sentence. NaN f1 (p + r == 0) maps to 0.
+    """
+    cos_sim = jnp.einsum("bpd,brd->bpr", preds_emb, target_emb)
+    precision = jnp.sum(jnp.max(cos_sim, axis=2) * preds_scale, axis=-1)
+    recall = jnp.sum(jnp.max(cos_sim, axis=1) * target_scale, axis=-1)
+    denom = precision + recall
+    f1 = jnp.where(denom > 0, 2 * precision * recall / jnp.where(denom > 0, denom, 1.0), 0.0)
+    return precision, recall, f1
+
+
+def _prepare_embeddings(
+    encoder_output: Tuple[Array, np.ndarray, np.ndarray],
+    idf_map: Optional[Dict[int, float]],
+) -> Tuple[Array, Array]:
+    """L2-normalize, zero special-token positions, build per-token scales."""
+    embeddings, input_ids, attention_mask = encoder_output
+    mask = _process_attention_mask_for_special_tokens(np.asarray(attention_mask))
+    emb = jnp.asarray(embeddings)
+    emb = emb / jnp.maximum(jnp.linalg.norm(emb, axis=-1, keepdims=True), 1e-30)
+    emb = emb * jnp.asarray(mask)[..., None]
+    scale = jnp.asarray(_idf_scale(np.asarray(input_ids), mask, idf_map))
+    return emb, scale
+
+
+def _default_transformers_encoder(model_name_or_path: str, max_length: int = 512) -> TextEncoder:
+    """HF-transformers encoder (last hidden state); requires cached weights."""
+    if not _TRANSFORMERS_AVAILABLE:
+        raise ModuleNotFoundError(
+            "`bert_score` with `model_name_or_path` requires `transformers`. Either install it or pass an `encoder`."
+        )
+    import torch
+    from transformers import AutoModel, AutoTokenizer
+
+    tokenizer = AutoTokenizer.from_pretrained(model_name_or_path)
+    model = AutoModel.from_pretrained(model_name_or_path)
+    model.eval()
+
+    def encoder(sentences: Sequence[str]) -> Tuple[Array, np.ndarray, np.ndarray]:
+        batch = tokenizer(
+            list(sentences), padding=True, truncation=True, max_length=max_length, return_tensors="pt"
+        )
+        with torch.no_grad():
+            out = model(batch["input_ids"], batch["attention_mask"]).last_hidden_state
+        return (
+            jnp.asarray(out.numpy()),
+            batch["input_ids"].numpy(),
+            batch["attention_mask"].numpy(),
+        )
+
+    return encoder
+
+
+def bert_score(
+    preds: Union[str, Sequence[str]],
+    target: Union[str, Sequence[str]],
+    encoder: Optional[TextEncoder] = None,
+    model_name_or_path: Optional[str] = None,
+    idf: bool = False,
+    max_length: int = 512,
+    rescale_with_baseline: bool = False,
+    baseline: Optional[Sequence[float]] = None,
+    return_hash: bool = False,
+) -> Dict[str, Union[Array, str]]:
+    """BERTScore: token-level greedy cosine matching of contextual embeddings.
+
+    Args:
+        preds: predicted sentence(s).
+        target: reference sentence(s).
+        encoder: callable mapping sentences to ``(embeddings, input_ids,
+            attention_mask)``; see module docstring for the contract.
+        model_name_or_path: build a default ``transformers`` encoder (requires
+            locally cached weights; default ``roberta-large`` when neither
+            ``encoder`` nor a name is given).
+        idf: weight tokens by inverse document frequency computed on ``target``.
+        max_length: tokenizer truncation length for the default encoder.
+        rescale_with_baseline: linearly rescale scores with ``baseline``
+            (three floats: precision/recall/f1 baselines).
+        baseline: the baseline values; required when ``rescale_with_baseline``.
+        return_hash: include a config hash in the output dict.
+
+    Returns:
+        Dict with per-sentence ``precision``, ``recall``, ``f1`` arrays.
+    """
+    preds_l = [preds] if isinstance(preds, str) else list(preds)
+    target_l = [target] if isinstance(target, str) else list(target)
+    if len(preds_l) != len(target_l):
+        raise ValueError(
+            f"Expected argument `preds` and `target` to have the same length, got {len(preds_l)} and {len(target_l)}"
+        )
+    if encoder is None:
+        encoder = _default_transformers_encoder(model_name_or_path or _DEFAULT_MODEL, max_length)
+
+    # target embeddings first: idf statistics are computed on references
+    target_output = encoder(target_l)
+    idf_map = _tokens_idf(np.asarray(target_output[1])) if idf else None
+    t_emb, t_scale = _prepare_embeddings(target_output, idf_map)
+    p_emb, p_scale = _prepare_embeddings(encoder(preds_l), idf_map)
+
+    precision, recall, f1 = _bert_score_from_embeddings(p_emb, p_scale, t_emb, t_scale)
+
+    if rescale_with_baseline:
+        if baseline is None:
+            raise ValueError("`rescale_with_baseline` requires the `baseline` argument (no network access).")
+        b = jnp.asarray(baseline, jnp.float32)
+        precision = (precision - b[0]) / (1 - b[0])
+        recall = (recall - b[1]) / (1 - b[1])
+        f1 = (f1 - b[2]) / (1 - b[2])
+
+    output: Dict[str, Union[Array, str]] = {"precision": precision, "recall": recall, "f1": f1}
+    if return_hash:
+        output["hash"] = f"{model_name_or_path}{'_idf' if idf else '_no-idf'}"
+    return output

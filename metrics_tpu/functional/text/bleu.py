@@ -1,0 +1,129 @@
+"""BLEU score functional (reference: functional/text/bleu.py:26-204).
+
+N-gram counting is host-side (string inputs); sufficient statistics are four device
+arrays — clipped-match and total n-gram count vectors of length ``n_gram`` plus the
+two corpus-length scalars — all psum-reducible, so the metric shards over hosts the
+same way scalar metrics do. The final compute is branchless jnp (safe-log + where)
+rather than the reference's data-dependent early return, so ``compute_from`` stays
+jittable.
+"""
+from collections import Counter
+from typing import Callable, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+
+def _count_ngram(tokens: Sequence[str], n_gram: int) -> Counter:
+    """Counter of all 1..n_gram-grams (tuple keys) in a token sequence."""
+    ngram_counter: Counter = Counter()
+    for n in range(1, n_gram + 1):
+        for j in range(len(tokens) - n + 1):
+            ngram_counter[tuple(tokens[j : j + n])] += 1
+    return ngram_counter
+
+
+def _tokenize_fn(sentence: str) -> Sequence[str]:
+    return sentence.split()
+
+
+def _bleu_score_update(
+    preds: Sequence[str],
+    target: Sequence[Sequence[str]],
+    n_gram: int = 4,
+    tokenizer: Callable[[str], Sequence[str]] = _tokenize_fn,
+) -> Tuple[Array, Array, Array, Array]:
+    """Per-call sufficient statistics: (numerator, denominator, preds_len, target_len).
+
+    ``numerator[k]`` = reference-clipped (k+1)-gram matches; ``denominator[k]`` =
+    total candidate (k+1)-grams; ``target_len`` uses the closest-length reference
+    (ties resolved to the first, matching the canonical BLEU definition).
+    """
+    target_tok = [[tokenizer(line) if line else [] for line in t] for t in target]
+    preds_tok = [tokenizer(line) if line else [] for line in preds]
+
+    numerator = [0] * n_gram
+    denominator = [0] * n_gram
+    preds_len = 0
+    target_len = 0
+    for pred, targets in zip(preds_tok, target_tok):
+        preds_len += len(pred)
+        len_diffs = [abs(len(pred) - len(tgt)) for tgt in targets]
+        target_len += len(targets[len_diffs.index(min(len_diffs))])
+
+        preds_counter = _count_ngram(pred, n_gram)
+        target_counter: Counter = Counter()
+        for tgt in targets:
+            target_counter |= _count_ngram(tgt, n_gram)
+        clipped = preds_counter & target_counter
+
+        for key, cnt in clipped.items():
+            numerator[len(key) - 1] += cnt
+        for key, cnt in preds_counter.items():
+            denominator[len(key) - 1] += cnt
+
+    return (
+        jnp.asarray(numerator, jnp.float32),
+        jnp.asarray(denominator, jnp.float32),
+        jnp.asarray(preds_len, jnp.float32),
+        jnp.asarray(target_len, jnp.float32),
+    )
+
+
+def _bleu_score_compute(
+    preds_len: Array,
+    target_len: Array,
+    numerator: Array,
+    denominator: Array,
+    n_gram: int,
+    weights: Sequence[float],
+    smooth: bool,
+) -> Array:
+    if smooth:
+        precision = (numerator + 1.0) / (denominator + 1.0)
+        precision = precision.at[0].set(numerator[0] / denominator[0])
+    else:
+        precision = numerator / denominator
+    # branchless: if any clipped-match count is zero the score is exactly 0
+    any_zero = jnp.min(numerator) == 0.0
+    safe_precision = jnp.where(precision > 0, precision, 1.0)
+    log_precision = jnp.asarray(weights, jnp.float32) * jnp.log(safe_precision)
+    geometric_mean = jnp.exp(jnp.sum(log_precision))
+    brevity_penalty = jnp.where(preds_len > target_len, 1.0, jnp.exp(1 - target_len / preds_len))
+    return jnp.where(any_zero, 0.0, brevity_penalty * geometric_mean)
+
+
+def bleu_score(
+    preds: Union[str, Sequence[str]],
+    target: Sequence[Union[str, Sequence[str]]],
+    n_gram: int = 4,
+    smooth: bool = False,
+    weights: Optional[Sequence[float]] = None,
+) -> Array:
+    """BLEU score of machine-translated text against one or more references.
+
+    Args:
+        preds: machine-translated corpus.
+        target: per-sample iterable of reference translations.
+        n_gram: largest n-gram order (1-4 typical).
+        smooth: apply add-one (Lin & Och) smoothing to orders > 1.
+        weights: per-order weights (default uniform ``1/n_gram``).
+
+    Example:
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
+        >>> bleu_score(preds, target)
+        Array(0.75983, dtype=float32)
+    """
+    preds_ = [preds] if isinstance(preds, str) else preds
+    target_ = [[tgt] if isinstance(tgt, str) else tgt for tgt in target]
+
+    if len(preds_) != len(target_):
+        raise ValueError(f"Corpus has different size {len(preds_)} != {len(target_)}")
+    if weights is not None and len(weights) != n_gram:
+        raise ValueError(f"List of weights has different weights than `n_gram`: {len(weights)} != {n_gram}")
+    if weights is None:
+        weights = [1.0 / n_gram] * n_gram
+
+    numerator, denominator, preds_len, target_len = _bleu_score_update(preds_, target_, n_gram, _tokenize_fn)
+    return _bleu_score_compute(preds_len, target_len, numerator, denominator, n_gram, weights, smooth)

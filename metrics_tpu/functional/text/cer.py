@@ -1,0 +1,34 @@
+"""Character error rate functional (reference: functional/text/cer.py:23-84)."""
+from typing import Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.text.helper import _edit_distance, _validate_text_inputs
+
+
+def _cer_update(preds: Union[str, Sequence[str]], target: Union[str, Sequence[str]]) -> Tuple[Array, Array]:
+    preds_l, target_l = _validate_text_inputs(preds, target)
+    errors = 0
+    total = 0
+    for pred, tgt in zip(preds_l, target_l):
+        errors += _edit_distance(list(pred), list(tgt))
+        total += len(tgt)
+    return jnp.asarray(errors, jnp.float32), jnp.asarray(total, jnp.float32)
+
+
+def _cer_compute(errors: Array, total: Array) -> Array:
+    return errors / total
+
+
+def char_error_rate(preds: Union[str, Sequence[str]], target: Union[str, Sequence[str]]) -> Array:
+    """Character error rate for speech/OCR systems (0 = perfect).
+
+    Example:
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> char_error_rate(preds=preds, target=target)
+        Array(0.34146342, dtype=float32)
+    """
+    errors, total = _cer_update(preds, target)
+    return _cer_compute(errors, total)

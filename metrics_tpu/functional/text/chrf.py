@@ -1,0 +1,226 @@
+"""chrF / chrF++ functional (reference: functional/text/chrf.py:48-637).
+
+TPU-first state redesign: the reference keeps six ``{order: scalar tensor}``
+dictionaries; here the sufficient statistics are six dense vectors —
+``(n_char_order,)`` and ``(n_word_order,)`` counts for preds/target/matching —
+which psum-reduce across a mesh axis in one collective each. Host-side n-gram
+counting, device-side f-score compute.
+
+Behavioral quirk preserved from the reference (chrf.py:360-367): the
+best-reference selection uses a strict ``>`` against an initial 0.0, so when every
+reference scores 0 the target/matching statistics of that sample are NOT
+accumulated.
+"""
+from collections import Counter
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.functional.text.helper import _validate_text_inputs
+
+_EPS_SMOOTHING = 1e-16
+# punctuation set from the published chrF implementation
+_PUNCTUATIONS = set("!\"#$%&'()*+,-./:;<=>?@[\\]^_`{|}~")
+
+
+def _get_characters(sentence: str, whitespace: bool) -> List[str]:
+    if whitespace:
+        return list(sentence)
+    return list(sentence.strip().replace(" ", ""))
+
+
+def _separate_word_and_punctuation(word: str) -> List[str]:
+    """Split a leading/trailing punctuation char off a word (chrF++ word stream)."""
+    if len(word) == 1:
+        return [word]
+    if word[-1] in _PUNCTUATIONS:
+        return [word[:-1], word[-1]]
+    if word[0] in _PUNCTUATIONS:
+        return [word[0], word[1:]]
+    return [word]
+
+
+def _get_words_and_punctuation(sentence: str) -> List[str]:
+    out: List[str] = []
+    for word in sentence.strip().split():
+        out.extend(_separate_word_and_punctuation(word))
+    return out
+
+
+def _ngram_counts(tokens: List[str], n_gram_order: int) -> List[Counter]:
+    """Per-order n-gram Counters, index k = (k+1)-grams."""
+    return [
+        Counter(tuple(tokens[i : i + n]) for i in range(len(tokens) - n + 1))
+        for n in range(1, n_gram_order + 1)
+    ]
+
+
+def _sentence_counts(
+    sentence: str, n_char_order: int, n_word_order: int, lowercase: bool, whitespace: bool
+) -> Tuple[List[Counter], List[Counter], np.ndarray, np.ndarray]:
+    if lowercase:
+        sentence = sentence.lower()
+    char_counts = _ngram_counts(_get_characters(sentence, whitespace), n_char_order)
+    word_counts = _ngram_counts(_get_words_and_punctuation(sentence), n_word_order)
+    char_totals = np.array([sum(c.values()) for c in char_counts], dtype=np.float64)
+    word_totals = np.array([sum(c.values()) for c in word_counts], dtype=np.float64)
+    return char_counts, word_counts, char_totals, word_totals
+
+
+def _matches(hyp_counts: List[Counter], ref_counts: List[Counter]) -> np.ndarray:
+    return np.array([sum((h & r).values()) for h, r in zip(hyp_counts, ref_counts)], dtype=np.float64)
+
+
+def _fscore_from_stats(
+    matching_char: np.ndarray,
+    matching_word: np.ndarray,
+    hyp_char: np.ndarray,
+    hyp_word: np.ndarray,
+    ref_char: np.ndarray,
+    ref_word: np.ndarray,
+    n_order: float,
+    beta: float,
+) -> float:
+    """Mean per-order F-beta over char and word n-gram orders (host NumPy path)."""
+
+    def _per_order(matching: np.ndarray, hyp: np.ndarray, ref: np.ndarray) -> np.ndarray:
+        precision = np.where(hyp > 0, matching / np.maximum(hyp, 1e-300), 0.0)
+        recall = np.where(ref > 0, matching / np.maximum(ref, 1e-300), 0.0)
+        denom = np.maximum(beta**2 * precision + recall, _EPS_SMOOTHING)
+        return (1 + beta**2) * precision * recall / denom
+
+    char_f = _per_order(matching_char, hyp_char, ref_char)
+    word_f = _per_order(matching_word, hyp_word, ref_word)
+    return float((char_f.sum() + word_f.sum()) / n_order)
+
+
+def _chrf_score_update(
+    preds: Union[str, Sequence[str]],
+    target: Union[Sequence[str], Sequence[Sequence[str]]],
+    n_char_order: int,
+    n_word_order: int,
+    beta: float,
+    lowercase: bool,
+    whitespace: bool,
+    collect_sentence_scores: bool = False,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray, Optional[List[float]]]:
+    """Accumulate the six count vectors over a batch; best reference per sample."""
+    if isinstance(preds, str):
+        preds = [preds]
+    target_corpus = [[t] if isinstance(t, str) else list(t) for t in target]
+    _validate_text_inputs(list(preds), ["x"] * len(target_corpus))  # length check only
+
+    n_order = float(n_char_order + n_word_order)
+    total_preds_char = np.zeros(n_char_order)
+    total_preds_word = np.zeros(n_word_order)
+    total_target_char = np.zeros(n_char_order)
+    total_target_word = np.zeros(n_word_order)
+    total_matching_char = np.zeros(n_char_order)
+    total_matching_word = np.zeros(n_word_order)
+    sentence_scores: Optional[List[float]] = [] if collect_sentence_scores else None
+
+    for pred, targets in zip(preds, target_corpus):
+        p_char_counts, p_word_counts, p_char_tot, p_word_tot = _sentence_counts(
+            pred, n_char_order, n_word_order, lowercase, whitespace
+        )
+        total_preds_char += p_char_tot
+        total_preds_word += p_word_tot
+
+        best_f = 0.0
+        best_match_char = np.zeros(n_char_order)
+        best_match_word = np.zeros(n_word_order)
+        best_tgt_char = np.zeros(n_char_order)
+        best_tgt_word = np.zeros(n_word_order)
+        for tgt in targets:
+            t_char_counts, t_word_counts, t_char_tot, t_word_tot = _sentence_counts(
+                tgt, n_char_order, n_word_order, lowercase, whitespace
+            )
+            match_char = _matches(p_char_counts, t_char_counts)
+            match_word = _matches(p_word_counts, t_word_counts)
+            f = _fscore_from_stats(
+                match_char, match_word, p_char_tot, p_word_tot, t_char_tot, t_word_tot, n_order, beta
+            )
+            if f > best_f:
+                best_f = f
+                best_match_char, best_match_word = match_char, match_word
+                best_tgt_char, best_tgt_word = t_char_tot, t_word_tot
+
+        if sentence_scores is not None:
+            sentence_scores.append(best_f)
+        total_target_char += best_tgt_char
+        total_target_word += best_tgt_word
+        total_matching_char += best_match_char
+        total_matching_word += best_match_word
+
+    return (
+        total_preds_char,
+        total_preds_word,
+        total_target_char,
+        total_target_word,
+        total_matching_char,
+        total_matching_word,
+        sentence_scores,
+    )
+
+
+def _chrf_score_compute(
+    total_preds_char: Array,
+    total_preds_word: Array,
+    total_target_char: Array,
+    total_target_word: Array,
+    total_matching_char: Array,
+    total_matching_word: Array,
+    n_order: float,
+    beta: float,
+) -> Array:
+    """Corpus-level chrF from the six count vectors — branchless jnp."""
+
+    def _per_order(matching: Array, hyp: Array, ref: Array) -> Array:
+        precision = jnp.where(hyp > 0, matching / jnp.maximum(hyp, 1e-30), 0.0)
+        recall = jnp.where(ref > 0, matching / jnp.maximum(ref, 1e-30), 0.0)
+        denom = jnp.maximum(beta**2 * precision + recall, _EPS_SMOOTHING)
+        return (1 + beta**2) * precision * recall / denom
+
+    char_f = _per_order(total_matching_char, total_preds_char, total_target_char)
+    word_f = _per_order(total_matching_word, total_preds_word, total_target_word)
+    return ((jnp.sum(char_f) + jnp.sum(word_f)) / n_order).astype(jnp.float32)
+
+
+def chrf_score(
+    preds: Union[str, Sequence[str]],
+    target: Sequence[Union[str, Sequence[str]]],
+    n_char_order: int = 6,
+    n_word_order: int = 2,
+    beta: float = 2.0,
+    lowercase: bool = False,
+    whitespace: bool = False,
+    return_sentence_level_score: bool = False,
+) -> Union[Array, Tuple[Array, Array]]:
+    """chrF (``n_word_order=0``) / chrF++ (``n_word_order=2``, default) score.
+
+    Example:
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
+        >>> chrf_score(preds, target)
+        Array(0.86398, dtype=float32)
+    """
+    if not isinstance(n_char_order, int) or n_char_order < 1:
+        raise ValueError("Expected argument `n_char_order` to be an integer greater than or equal to 1.")
+    if not isinstance(n_word_order, int) or n_word_order < 0:
+        raise ValueError("Expected argument `n_word_order` to be an integer greater than or equal to 0.")
+    if beta < 0:
+        raise ValueError("Expected argument `beta` to be greater than 0.")
+
+    n_order = float(n_char_order + n_word_order)
+    (pc, pw, tc, tw, mc, mw, sentence_scores) = _chrf_score_update(
+        preds, target, n_char_order, n_word_order, beta, lowercase, whitespace, return_sentence_level_score
+    )
+    score = _chrf_score_compute(
+        jnp.asarray(pc), jnp.asarray(pw), jnp.asarray(tc), jnp.asarray(tw), jnp.asarray(mc), jnp.asarray(mw),
+        n_order, beta,
+    )
+    if return_sentence_level_score:
+        return score, jnp.asarray(sentence_scores, jnp.float32)
+    return score

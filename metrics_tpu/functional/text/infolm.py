@@ -1,0 +1,257 @@
+"""InfoLM functional (reference: functional/text/infolm.py:40-635).
+
+Information measures between per-sentence discrete token distributions produced by
+a masked language model (Colombo et al., "InfoLM: A New Metric to Evaluate
+Summarization & Data2Text Generation").
+
+Callable-encoder redesign: the model interface is a single callable
+
+    ``logits_fn(input_ids [B, S], attention_mask [B, S]) -> logits [B, S, V]``
+
+(the HF ``AutoModelForMaskedLM`` forward, or any equivalent). The distribution
+builder masks one position at a time exactly like the reference
+(infolm.py:355-404): softmax of the masked position's logits at ``temperature``,
+optional IDF weighting, averaged over non-special positions. All measure math is
+branchless jnp (``nan_to_num`` like the reference) and jit-safe.
+"""
+from typing import Callable, Dict, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.functional.text.helper import _input_ids_idf, _tokens_idf
+from metrics_tpu.utils.imports import _TRANSFORMERS_AVAILABLE
+
+_ALLOWED_INFORMATION_MEASURE = (
+    "kl_divergence",
+    "alpha_divergence",
+    "beta_divergence",
+    "ab_divergence",
+    "renyi_divergence",
+    "l1_distance",
+    "l2_distance",
+    "l_infinity_distance",
+    "fisher_rao_distance",
+)
+
+LogitsFn = Callable[[np.ndarray, np.ndarray], Array]
+
+
+class _InformationMeasure:
+    """Dispatcher for the nine InfoLM information measures (jnp, nan→0)."""
+
+    def __init__(self, information_measure: str, alpha: Optional[float] = None, beta: Optional[float] = None) -> None:
+        if information_measure not in _ALLOWED_INFORMATION_MEASURE:
+            raise ValueError(
+                f"Argument `information_measure` expected one of {_ALLOWED_INFORMATION_MEASURE}, "
+                f"got {information_measure}."
+            )
+        self.information_measure = information_measure
+        needs_alpha = ("alpha_divergence", "ab_divergence", "renyi_divergence")
+        if information_measure in needs_alpha and not isinstance(alpha, float):
+            raise ValueError(f"Parameter `alpha` is expected to be defined for {information_measure}.")
+        if information_measure in ("beta_divergence", "ab_divergence") and not isinstance(beta, float):
+            raise ValueError(f"Parameter `beta` is expected to be defined for {information_measure}.")
+        if information_measure == "alpha_divergence" and (not isinstance(alpha, float) or alpha in [0, 1]):
+            raise ValueError(
+                f"Parameter `alpha` is expected to be float differened from 0 and 1 for {information_measure}."
+            )
+        if information_measure == "beta_divergence" and (not isinstance(beta, float) or beta in [0, -1]):
+            raise ValueError(
+                f"Parameter `beta` is expected to be float differened from 0 and -1 for {information_measure}."
+            )
+        if information_measure == "ab_divergence" and (
+            alpha is None or beta is None or 0 in [alpha, beta, alpha + beta]
+        ):
+            raise ValueError(
+                f"Parameters `alpha`, `beta` and their sum are expected to be differened from 0 for "
+                f"{information_measure}."
+            )
+        if information_measure == "renyi_divergence" and (not isinstance(alpha, float) or alpha == 1):
+            raise ValueError(f"Parameter `alpha` is expected to be float differened from 1 for {information_measure}.")
+        self.alpha = alpha or 0.0
+        self.beta = beta or 0.0
+
+    def __call__(self, preds_distribution: Array, target_distribution: Array) -> Array:
+        fn = getattr(self, f"_calculate_{self.information_measure}")
+        return jnp.nan_to_num(fn(preds_distribution, target_distribution))
+
+    @staticmethod
+    def _calculate_kl_divergence(p: Array, t: Array) -> Array:
+        return jnp.sum(t * jnp.log(p / t), axis=-1)
+
+    def _calculate_alpha_divergence(self, p: Array, t: Array) -> Array:
+        denom = self.alpha * (self.alpha - 1)
+        return (1 - jnp.sum(t**self.alpha * p ** (1 - self.alpha), axis=-1)) / denom
+
+    def _calculate_ab_divergence(self, p: Array, t: Array) -> Array:
+        a = jnp.log(jnp.sum(t ** (self.beta + self.alpha), axis=-1)) / (self.beta * (self.beta + self.alpha))
+        b = jnp.log(jnp.sum(p ** (self.beta + self.alpha), axis=-1)) / (self.alpha * (self.beta + self.alpha))
+        c = jnp.log(jnp.sum(t**self.alpha * p**self.beta, axis=-1)) / (self.alpha * self.beta)
+        return a + b - c
+
+    def _calculate_beta_divergence(self, p: Array, t: Array) -> Array:
+        self.alpha = 1.0
+        return self._calculate_ab_divergence(p, t)
+
+    def _calculate_renyi_divergence(self, p: Array, t: Array) -> Array:
+        return jnp.log(jnp.sum(t**self.alpha * p ** (1 - self.alpha), axis=-1)) / (self.alpha - 1)
+
+    @staticmethod
+    def _calculate_l1_distance(p: Array, t: Array) -> Array:
+        return jnp.sum(jnp.abs(t - p), axis=-1)
+
+    @staticmethod
+    def _calculate_l2_distance(p: Array, t: Array) -> Array:
+        return jnp.sqrt(jnp.sum((t - p) ** 2, axis=-1))
+
+    @staticmethod
+    def _calculate_l_infinity_distance(p: Array, t: Array) -> Array:
+        return jnp.max(jnp.abs(t - p), axis=-1)
+
+    @staticmethod
+    def _calculate_fisher_rao_distance(p: Array, t: Array) -> Array:
+        return 2 * jnp.arccos(jnp.clip(jnp.sqrt(p * t).sum(-1), 0, 1))
+
+
+def masked_lm_distribution(
+    input_ids: np.ndarray,
+    attention_mask: np.ndarray,
+    logits_fn: LogitsFn,
+    special_tokens_map: Dict[str, int],
+    temperature: float = 0.25,
+    idf_weights: Optional[np.ndarray] = None,
+) -> Array:
+    """Per-sentence discrete distribution over the vocabulary (reference :355-404).
+
+    Masks each position in turn, reads the masked position's softmax at
+    ``temperature``, zeroes special-token positions (pad/sep/cls) and averages
+    (IDF-weighted when ``idf_weights`` given).
+    """
+    input_ids = np.asarray(input_ids)
+    seq_len = input_ids.shape[1]
+    token_mask = ~(
+        (input_ids == special_tokens_map["pad_token_id"])
+        | (input_ids == special_tokens_map["sep_token_id"])
+        | (input_ids == special_tokens_map["cls_token_id"])
+    )
+    per_position = []
+    for mask_idx in range(seq_len):
+        masked = input_ids.copy()
+        masked[:, mask_idx] = special_tokens_map["mask_token_id"]
+        logits = jnp.asarray(logits_fn(masked, attention_mask))[:, mask_idx, :]
+        prob = jax.nn.softmax(logits / temperature, axis=-1)
+        if idf_weights is not None:
+            prob = prob * jnp.asarray(idf_weights)[:, mask_idx, None]
+        per_position.append(prob)
+    stacked = jnp.stack(per_position, axis=1)  # [B, S, V]
+    stacked = stacked * jnp.asarray(token_mask, stacked.dtype)[..., None]
+    if idf_weights is not None:
+        denom = jnp.sum(jnp.asarray(token_mask) * jnp.asarray(idf_weights), axis=1)
+    else:
+        denom = jnp.sum(jnp.asarray(token_mask, stacked.dtype), axis=1)
+    return stacked.sum(axis=1) / denom[:, None]
+
+
+def _load_transformers_mlm(model_name_or_path: str):
+    if not _TRANSFORMERS_AVAILABLE:
+        raise ModuleNotFoundError(
+            "`infolm` with `model_name_or_path` requires `transformers`. Either install it or pass `logits_fn` "
+            "+ `tokenizer_fn` + `special_tokens_map`."
+        )
+    import torch
+    from transformers import AutoModelForMaskedLM, AutoTokenizer
+
+    tokenizer = AutoTokenizer.from_pretrained(model_name_or_path)
+    model = AutoModelForMaskedLM.from_pretrained(model_name_or_path)
+    model.eval()
+
+    def logits_fn(input_ids: np.ndarray, attention_mask: np.ndarray) -> Array:
+        with torch.no_grad():
+            out = model(torch.tensor(input_ids), torch.tensor(attention_mask)).logits
+        return jnp.asarray(out.numpy())
+
+    def tokenizer_fn(sentences: Sequence[str], max_length: int) -> Tuple[np.ndarray, np.ndarray]:
+        batch = tokenizer(
+            list(sentences), padding="max_length", max_length=max_length, truncation=True, return_tensors="np"
+        )
+        return batch["input_ids"], batch["attention_mask"]
+
+    special = {
+        "mask_token_id": tokenizer.mask_token_id,
+        "pad_token_id": tokenizer.pad_token_id,
+        "sep_token_id": tokenizer.sep_token_id,
+        "cls_token_id": tokenizer.cls_token_id,
+    }
+    return logits_fn, tokenizer_fn, special
+
+
+def infolm(
+    preds: Union[str, Sequence[str]],
+    target: Union[str, Sequence[str]],
+    model_name_or_path: str = "bert-base-uncased",
+    temperature: float = 0.25,
+    information_measure: str = "kl_divergence",
+    idf: bool = True,
+    alpha: Optional[float] = None,
+    beta: Optional[float] = None,
+    max_length: Optional[int] = None,
+    return_sentence_level_score: bool = False,
+    logits_fn: Optional[LogitsFn] = None,
+    tokenizer_fn: Optional[Callable[[Sequence[str], int], Tuple[np.ndarray, np.ndarray]]] = None,
+    special_tokens_map: Optional[Dict[str, int]] = None,
+) -> Union[Array, Tuple[Array, Array]]:
+    """InfoLM: information measure between masked-LM token distributions.
+
+    Args:
+        preds: hypothesis corpus.
+        target: reference corpus.
+        model_name_or_path: HF masked-LM to load when no ``logits_fn`` is given.
+        temperature: softmax calibration temperature.
+        information_measure: one of the nine supported measures.
+        idf: weight positions by inverse document frequency (computed on ``target``).
+        alpha: parameter for alpha/AB/Rényi divergences.
+        beta: parameter for beta/AB divergences.
+        max_length: tokenizer pad/truncation length (default 512).
+        return_sentence_level_score: also return per-sentence values.
+        logits_fn: custom masked-LM forward ``(input_ids, attention_mask) -> logits``.
+        tokenizer_fn: custom ``(sentences, max_length) -> (input_ids, attention_mask)``.
+        special_tokens_map: ids for ``mask/pad/sep/cls`` tokens (required with
+            ``logits_fn``).
+    """
+    if temperature <= 0:
+        raise ValueError(f"Argument `temperature` expected to be a positive number, got {temperature}")
+    measure = _InformationMeasure(information_measure, alpha, beta)
+    max_length = max_length or 512
+
+    if logits_fn is None:
+        logits_fn, tokenizer_fn, special_tokens_map = _load_transformers_mlm(model_name_or_path)
+    if tokenizer_fn is None or special_tokens_map is None:
+        raise ValueError("`logits_fn` requires `tokenizer_fn` and `special_tokens_map` to be provided as well.")
+
+    preds_l = [preds] if isinstance(preds, str) else list(preds)
+    target_l = [target] if isinstance(target, str) else list(target)
+    if len(preds_l) != len(target_l):
+        raise ValueError(
+            f"Expected argument `preds` and `target` to have the same length, got {len(preds_l)} and {len(target_l)}"
+        )
+
+    p_ids, p_mask = tokenizer_fn(preds_l, max_length)
+    t_ids, t_mask = tokenizer_fn(target_l, max_length)
+
+    p_idf = t_idf = None
+    if idf:
+        idf_map = _tokens_idf(np.asarray(t_ids))
+        p_idf = _input_ids_idf(np.asarray(p_ids), idf_map)
+        t_idf = _input_ids_idf(np.asarray(t_ids), idf_map)
+
+    preds_distribution = masked_lm_distribution(p_ids, p_mask, logits_fn, special_tokens_map, temperature, p_idf)
+    target_distribution = masked_lm_distribution(t_ids, t_mask, logits_fn, special_tokens_map, temperature, t_idf)
+
+    per_sentence = measure(preds_distribution, target_distribution)
+    score = per_sentence.mean().astype(jnp.float32)
+    if return_sentence_level_score:
+        return score, per_sentence.astype(jnp.float32)
+    return score

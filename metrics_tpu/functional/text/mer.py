@@ -1,0 +1,36 @@
+"""Match error rate functional (reference: functional/text/mer.py:23-88)."""
+from typing import Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.text.helper import _edit_distance, _validate_text_inputs
+
+
+def _mer_update(preds: Union[str, Sequence[str]], target: Union[str, Sequence[str]]) -> Tuple[Array, Array]:
+    preds_l, target_l = _validate_text_inputs(preds, target)
+    errors = 0
+    total = 0
+    for pred, tgt in zip(preds_l, target_l):
+        pred_tokens = pred.split()
+        tgt_tokens = tgt.split()
+        errors += _edit_distance(pred_tokens, tgt_tokens)
+        total += max(len(tgt_tokens), len(pred_tokens))
+    return jnp.asarray(errors, jnp.float32), jnp.asarray(total, jnp.float32)
+
+
+def _mer_compute(errors: Array, total: Array) -> Array:
+    return errors / total
+
+
+def match_error_rate(preds: Union[str, Sequence[str]], target: Union[str, Sequence[str]]) -> Array:
+    """Match error rate: edit errors over max(ref, hyp) length (0 = perfect).
+
+    Example:
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> match_error_rate(preds=preds, target=target)
+        Array(0.44444445, dtype=float32)
+    """
+    errors, total = _mer_update(preds, target)
+    return _mer_compute(errors, total)

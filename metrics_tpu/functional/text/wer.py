@@ -1,0 +1,36 @@
+"""Word error rate functional (reference: functional/text/wer.py:23-84)."""
+from typing import List, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.text.helper import _edit_distance, _validate_text_inputs
+
+
+def _wer_update(preds: Union[str, Sequence[str]], target: Union[str, Sequence[str]]) -> Tuple[Array, Array]:
+    preds_l, target_l = _validate_text_inputs(preds, target)
+    errors = 0
+    total = 0
+    for pred, tgt in zip(preds_l, target_l):
+        pred_tokens: List[str] = pred.split()
+        tgt_tokens: List[str] = tgt.split()
+        errors += _edit_distance(pred_tokens, tgt_tokens)
+        total += len(tgt_tokens)
+    return jnp.asarray(errors, jnp.float32), jnp.asarray(total, jnp.float32)
+
+
+def _wer_compute(errors: Array, total: Array) -> Array:
+    return errors / total
+
+
+def word_error_rate(preds: Union[str, Sequence[str]], target: Union[str, Sequence[str]]) -> Array:
+    """Word error rate for speech recognition (0 = perfect).
+
+    Example:
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> word_error_rate(preds=preds, target=target)
+        Array(0.5, dtype=float32)
+    """
+    errors, total = _wer_update(preds, target)
+    return _wer_compute(errors, total)

@@ -1,0 +1,50 @@
+"""Word information lost functional (reference: functional/text/wil.py:22-91).
+
+The reference accumulates ``errors - total`` (a negative "minus hits" count) and
+relies on sign cancellation in the product; here the state is the non-negative hit
+count ``hits = sum(max(|ref|, |hyp|)) - edit_errors`` directly — numerically
+identical, but meaningful on its own and psum-friendly.
+"""
+from typing import Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.text.helper import _edit_distance, _validate_text_inputs
+
+
+def _wil_update(
+    preds: Union[str, Sequence[str]], target: Union[str, Sequence[str]]
+) -> Tuple[Array, Array, Array]:
+    preds_l, target_l = _validate_text_inputs(preds, target)
+    hits = 0
+    target_total = 0
+    preds_total = 0
+    for pred, tgt in zip(preds_l, target_l):
+        pred_tokens = pred.split()
+        tgt_tokens = tgt.split()
+        hits += max(len(tgt_tokens), len(pred_tokens)) - _edit_distance(pred_tokens, tgt_tokens)
+        target_total += len(tgt_tokens)
+        preds_total += len(pred_tokens)
+    return (
+        jnp.asarray(hits, jnp.float32),
+        jnp.asarray(target_total, jnp.float32),
+        jnp.asarray(preds_total, jnp.float32),
+    )
+
+
+def _wil_compute(hits: Array, target_total: Array, preds_total: Array) -> Array:
+    return 1 - (hits / target_total) * (hits / preds_total)
+
+
+def word_information_lost(preds: Union[str, Sequence[str]], target: Union[str, Sequence[str]]) -> Array:
+    """Word information lost: ``1 - (hits/ref_len) * (hits/hyp_len)`` (0 = perfect).
+
+    Example:
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> word_information_lost(preds=preds, target=target)
+        Array(0.65277773, dtype=float32)
+    """
+    hits, target_total, preds_total = _wil_update(preds, target)
+    return _wil_compute(hits, target_total, preds_total)

@@ -1,0 +1,27 @@
+"""Word information preserved functional (reference: functional/text/wip.py:22-90).
+
+Same hit-count sufficient statistics as :mod:`metrics_tpu.functional.text.wil` —
+the update is shared; only the final ratio differs (WIP = 1 - WIL).
+"""
+from typing import Sequence, Union
+
+from jax import Array
+
+from metrics_tpu.functional.text.wil import _wil_update as _wip_update  # noqa: F401  (shared statistics)
+
+
+def _wip_compute(hits: Array, target_total: Array, preds_total: Array) -> Array:
+    return (hits / target_total) * (hits / preds_total)
+
+
+def word_information_preserved(preds: Union[str, Sequence[str]], target: Union[str, Sequence[str]]) -> Array:
+    """Word information preserved: ``(hits/ref_len) * (hits/hyp_len)`` (1 = perfect).
+
+    Example:
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> word_information_preserved(preds=preds, target=target)
+        Array(0.3472222, dtype=float32)
+    """
+    hits, target_total, preds_total = _wip_update(preds, target)
+    return _wip_compute(hits, target_total, preds_total)

@@ -1,0 +1,34 @@
+"""Text-domain metrics (reference: src/torchmetrics/text/__init__.py)."""
+from metrics_tpu.text.bert import BERTScore
+from metrics_tpu.text.bleu import BLEUScore
+from metrics_tpu.text.cer import CharErrorRate
+from metrics_tpu.text.chrf import CHRFScore
+from metrics_tpu.text.eed import ExtendedEditDistance
+from metrics_tpu.text.infolm import InfoLM
+from metrics_tpu.text.mer import MatchErrorRate
+from metrics_tpu.text.perplexity import Perplexity
+from metrics_tpu.text.rouge import ROUGEScore
+from metrics_tpu.text.sacre_bleu import SacreBLEUScore
+from metrics_tpu.text.squad import SQuAD
+from metrics_tpu.text.ter import TranslationEditRate
+from metrics_tpu.text.wer import WordErrorRate
+from metrics_tpu.text.wil import WordInfoLost
+from metrics_tpu.text.wip import WordInfoPreserved
+
+__all__ = [
+    "BERTScore",
+    "BLEUScore",
+    "CharErrorRate",
+    "CHRFScore",
+    "ExtendedEditDistance",
+    "InfoLM",
+    "MatchErrorRate",
+    "Perplexity",
+    "ROUGEScore",
+    "SacreBLEUScore",
+    "SQuAD",
+    "TranslationEditRate",
+    "WordErrorRate",
+    "WordInfoLost",
+    "WordInfoPreserved",
+]

@@ -1,0 +1,91 @@
+"""BERTScore metric (reference: text/bert.py:55-210).
+
+Accumulates raw sentences host-side across updates (string states cannot ride
+device collectives — the reference equally gathers tokenized tensors, not text)
+and runs the encoder once at ``compute``. For multi-host evaluation, shard the
+corpus per host and combine per-sentence outputs downstream.
+"""
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.text.bert import _DEFAULT_MODEL, TextEncoder, bert_score
+
+
+class BERTScore(Metric):
+    """Token-level greedy cosine matching of contextual embeddings.
+
+    Args:
+        encoder: ``(sentences) -> (embeddings, input_ids, attention_mask)``; see
+            :mod:`metrics_tpu.functional.text.bert` for the contract.
+        model_name_or_path: default ``transformers`` encoder to build lazily when
+            no ``encoder`` is given (requires locally cached weights).
+        idf: weight tokens by inverse document frequency.
+        max_length: tokenizer truncation length for the default encoder.
+        rescale_with_baseline: linearly rescale with ``baseline``.
+        baseline: three floats (precision/recall/f1 baselines).
+        return_hash: include a config hash in the output dict.
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        encoder: Optional[TextEncoder] = None,
+        model_name_or_path: Optional[str] = None,
+        idf: bool = False,
+        max_length: int = 512,
+        rescale_with_baseline: bool = False,
+        baseline: Optional[Sequence[float]] = None,
+        return_hash: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.encoder = encoder
+        self.model_name_or_path = model_name_or_path or _DEFAULT_MODEL
+        self.idf = idf
+        self.max_length = max_length
+        self.rescale_with_baseline = rescale_with_baseline
+        self.baseline = baseline
+        self.return_hash = return_hash
+        # host-side text accumulators (cleared by reset via _defaults registration)
+        self.add_state("_preds_corpus", [], dist_reduce_fx=None)
+        self.add_state("_target_corpus", [], dist_reduce_fx=None)
+
+    def update(self, preds: Union[str, Sequence[str]], target: Union[str, Sequence[str]]) -> None:
+        preds_l = [preds] if isinstance(preds, str) else list(preds)
+        target_l = [target] if isinstance(target, str) else list(target)
+        if len(preds_l) != len(target_l):
+            raise ValueError(
+                f"Expected argument `preds` and `target` to have the same length, got {len(preds_l)}"
+                f" and {len(target_l)}"
+            )
+        self._preds_corpus.extend(preds_l)
+        self._target_corpus.extend(target_l)
+
+    def compute(self) -> Dict[str, Union[Array, str]]:
+        if self.encoder is None:
+            # build (and cache) the default encoder once — from_pretrained per call
+            # would re-read the full model from disk on every compute/forward
+            from metrics_tpu.functional.text.bert import _default_transformers_encoder
+
+            self.encoder = _default_transformers_encoder(self.model_name_or_path, self.max_length)
+        return bert_score(
+            list(self._preds_corpus),
+            list(self._target_corpus),
+            encoder=self.encoder,
+            model_name_or_path=self.model_name_or_path,
+            idf=self.idf,
+            max_length=self.max_length,
+            rescale_with_baseline=self.rescale_with_baseline,
+            baseline=self.baseline,
+            return_hash=self.return_hash,
+        )
+
+    def __hash__(self) -> int:
+        return hash((type(self).__name__, len(self._preds_corpus), len(self._target_corpus)))

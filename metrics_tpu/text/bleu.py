@@ -1,0 +1,71 @@
+"""BLEUScore metric (reference: text/bleu.py:33-155)."""
+from typing import Any, Optional, Sequence, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.text.bleu import _bleu_score_compute, _bleu_score_update, _tokenize_fn
+
+
+class BLEUScore(Metric):
+    """BLEU score of machine-translated text against one or more references.
+
+    Args:
+        n_gram: largest n-gram order.
+        smooth: apply add-one smoothing to orders > 1.
+        weights: per-order weights (default uniform).
+
+    Example:
+        >>> from metrics_tpu.text import BLEUScore
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
+        >>> bleu = BLEUScore()
+        >>> bleu(preds, target)
+        Array(0.75983, dtype=float32)
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        n_gram: int = 4,
+        smooth: bool = False,
+        weights: Optional[Sequence[float]] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.n_gram = n_gram
+        self.smooth = smooth
+        if weights is not None and len(weights) != n_gram:
+            raise ValueError(f"List of weights has different weights than `n_gram`: {len(weights)} != {n_gram}")
+        self.weights = weights if weights is not None else [1.0 / n_gram] * n_gram
+
+        self.add_state("preds_len", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("target_len", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("numerator", jnp.zeros(self.n_gram), dist_reduce_fx="sum")
+        self.add_state("denominator", jnp.zeros(self.n_gram), dist_reduce_fx="sum")
+
+    _tokenizer = staticmethod(_tokenize_fn)
+
+    def update(self, preds: Union[str, Sequence[str]], target: Sequence[Union[str, Sequence[str]]]) -> None:
+        preds_ = [preds] if isinstance(preds, str) else preds
+        target_ = [[tgt] if isinstance(tgt, str) else tgt for tgt in target]
+        if len(preds_) != len(target_):
+            raise ValueError(f"Corpus has different size {len(preds_)} != {len(target_)}")
+        numerator, denominator, preds_len, target_len = _bleu_score_update(
+            preds_, target_, self.n_gram, self._tokenizer
+        )
+        self.numerator = self.numerator + numerator
+        self.denominator = self.denominator + denominator
+        self.preds_len = self.preds_len + preds_len
+        self.target_len = self.target_len + target_len
+
+    def compute(self) -> Array:
+        return _bleu_score_compute(
+            self.preds_len, self.target_len, self.numerator, self.denominator, self.n_gram, self.weights, self.smooth
+        )

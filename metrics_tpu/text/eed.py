@@ -1,0 +1,71 @@
+"""ExtendedEditDistance metric (reference: text/eed.py:28-130)."""
+from typing import Any, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.text.eed import _eed_compute, _eed_update
+
+
+class ExtendedEditDistance(Metric):
+    """Extended edit distance (lower = better; per-sentence scores capped at 1).
+
+    Args:
+        language: ``"en"`` or ``"ja"`` preprocessing.
+        return_sentence_level_score: also return per-sentence scores from ``compute``.
+        alpha: long-jump penalty.
+        rho: coverage (re-visit) penalty.
+        deletion: deletion cost.
+        insertion: insertion/substitution cost.
+
+    Example:
+        >>> from metrics_tpu.text import ExtendedEditDistance
+        >>> preds = ["this is the prediction", "here is an other sample"]
+        >>> target = ["this is the reference", "here is another one"]
+        >>> eed = ExtendedEditDistance()
+        >>> eed(preds=preds, target=target)
+        Array(0.30778, dtype=float32)
+    """
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        language: str = "en",
+        return_sentence_level_score: bool = False,
+        alpha: float = 2.0,
+        rho: float = 0.3,
+        deletion: float = 0.2,
+        insertion: float = 1.0,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if language not in ("en", "ja"):
+            raise ValueError(f"Expected argument `language` to either be `en` or `ja` but got {language}")
+        for param_name, param in zip(["alpha", "rho", "deletion", "insertion"], [alpha, rho, deletion, insertion]):
+            if not isinstance(param, float) or param < 0:
+                raise ValueError(f"Parameter `{param_name}` is expected to be a non-negative float.")
+        self.language = language
+        self.return_sentence_level_score = return_sentence_level_score
+        self.alpha = alpha
+        self.rho = rho
+        self.deletion = deletion
+        self.insertion = insertion
+
+        self.add_state("sentence_eed", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Union[str, Sequence[str]], target: Sequence[Union[str, Sequence[str]]]) -> None:
+        scores = _eed_update(preds, target, self.language, self.alpha, self.rho, self.deletion, self.insertion)
+        self.sentence_eed.append(jnp.asarray(scores, jnp.float32))
+
+    def compute(self) -> Union[Array, Tuple[Array, Array]]:
+        all_scores = jnp.concatenate([jnp.atleast_1d(s) for s in self.sentence_eed]) if self.sentence_eed else jnp.zeros(0)
+        average = _eed_compute(list(all_scores.tolist()))
+        if self.return_sentence_level_score:
+            return average, all_scores
+        return average

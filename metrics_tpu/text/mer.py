@@ -1,0 +1,40 @@
+"""MatchErrorRate metric (reference: text/mer.py:28-117)."""
+from typing import Any, Sequence, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.text.mer import _mer_compute, _mer_update
+
+
+class MatchErrorRate(Metric):
+    """Match error rate: edit errors over max(ref, hyp) length (0 = perfect).
+
+    Example:
+        >>> from metrics_tpu.text import MatchErrorRate
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> mer = MatchErrorRate()
+        >>> mer(preds, target)
+        Array(0.44444445, dtype=float32)
+    """
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("errors", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Union[str, Sequence[str]], target: Union[str, Sequence[str]]) -> None:
+        errors, total = _mer_update(preds, target)
+        self.errors = self.errors + errors
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        return _mer_compute(self.errors, self.total)

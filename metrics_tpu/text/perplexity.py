@@ -1,0 +1,51 @@
+"""Perplexity metric (reference: text/perplexity.py:28-110).
+
+Fully on-device: ``update`` is jit/shard_map-safe through the pure-functional tier
+(``init_state``/``local_update``/``compute_from``).
+"""
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.text.perplexity import _perplexity_compute, _perplexity_update
+
+
+class Perplexity(Metric):
+    """Perplexity of a language model: ``exp(mean NLL)`` over non-ignored tokens.
+
+    Args:
+        ignore_index: target class that does not contribute to the score.
+
+    Example:
+        >>> import jax
+        >>> from metrics_tpu.text import Perplexity
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(22), (2, 8, 5))
+        >>> target = jax.random.randint(jax.random.PRNGKey(89), (2, 8), 0, 5)
+        >>> perp = Perplexity(ignore_index=-100)
+        >>> perp(preds, target)
+        Array(5.2545..., dtype=float32)
+    """
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if ignore_index is not None and not isinstance(ignore_index, int):
+            raise ValueError(f"Argument `ignore_index` expected to be `None` or an `int` but got {ignore_index}")
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self.add_state("total_log_probs", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("count", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        total_log_probs, count = _perplexity_update(preds, target, self.ignore_index, self.validate_args)
+        self.total_log_probs = self.total_log_probs + total_log_probs
+        self.count = self.count + count
+
+    def compute(self) -> Array:
+        return _perplexity_compute(self.total_log_probs, self.count)

@@ -1,0 +1,117 @@
+"""ROUGEScore metric (reference: text/rouge.py:36-190)."""
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.text.rouge import (
+    ALLOWED_ACCUMULATE_VALUES,
+    ALLOWED_ROUGE_KEYS,
+    _rouge_score_compute,
+    _rouge_score_update,
+)
+from metrics_tpu.utils.imports import _NLTK_AVAILABLE
+
+
+class ROUGEScore(Metric):
+    """ROUGE scores for automatic summarization (per-sample cat states).
+
+    Args:
+        use_stemmer: Porter-stem tokens longer than 3 chars (requires nltk).
+        normalizer: custom text normalizer.
+        tokenizer: custom tokenizer.
+        accumulate: multi-reference handling — ``"best"`` or ``"avg"``.
+        rouge_keys: any of ``rouge1``..``rouge9``, ``rougeL``, ``rougeLsum``.
+
+    Example:
+        >>> from metrics_tpu.text import ROUGEScore
+        >>> preds = "My name is John"
+        >>> target = "Is your name John"
+        >>> rouge = ROUGEScore(rouge_keys="rouge1")
+        >>> rouge(preds, target)
+        {'rouge1_fmeasure': Array(0.75, dtype=float32), 'rouge1_precision': Array(0.75, dtype=float32), 'rouge1_recall': Array(0.75, dtype=float32)}
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = True
+
+    def __init__(
+        self,
+        use_stemmer: bool = False,
+        normalizer: Optional[Callable[[str], str]] = None,
+        tokenizer: Optional[Callable[[str], Sequence[str]]] = None,
+        accumulate: str = "best",
+        rouge_keys: Union[str, Tuple[str, ...]] = ("rouge1", "rouge2", "rougeL", "rougeLsum"),
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if use_stemmer and not _NLTK_AVAILABLE:
+            raise ModuleNotFoundError("Stemmer requires that `nltk` is installed. Use `pip install nltk`.")
+        if accumulate not in ALLOWED_ACCUMULATE_VALUES:
+            raise ValueError(
+                f"Got unknown accumulate value {accumulate}. Expected to be one of {ALLOWED_ACCUMULATE_VALUES}"
+            )
+        if not isinstance(rouge_keys, tuple):
+            rouge_keys = (rouge_keys,)
+        for key in rouge_keys:
+            if key not in ALLOWED_ROUGE_KEYS:
+                raise ValueError(
+                    f"Got unknown rouge key {key}. Expected to be one of {list(ALLOWED_ROUGE_KEYS.keys())}"
+                )
+        self.rouge_keys = rouge_keys
+        self.rouge_keys_values = [ALLOWED_ROUGE_KEYS[key] for key in rouge_keys]
+        self.stemmer = None
+        if use_stemmer:
+            import nltk
+
+            self.stemmer = nltk.stem.porter.PorterStemmer()
+        self.normalizer = normalizer
+        self.tokenizer = tokenizer
+        self.accumulate = accumulate
+        for rouge_key in self.rouge_keys:
+            for score in ["fmeasure", "precision", "recall"]:
+                self.add_state(f"{rouge_key}_{score}", [], dist_reduce_fx=None)
+
+    def update(
+        self,
+        preds: Union[str, Sequence[str]],
+        target: Union[str, Sequence[str], Sequence[Sequence[str]]],
+    ) -> None:
+        if isinstance(target, list) and all(isinstance(tgt, str) for tgt in target):
+            target = [target] if isinstance(preds, str) else [[tgt] for tgt in target]
+        if isinstance(preds, str):
+            preds = [preds]
+        if isinstance(target, str):
+            target = [[target]]
+        output = _rouge_score_update(
+            preds,
+            target,
+            self.rouge_keys_values,
+            self.accumulate,
+            self.stemmer,
+            self.normalizer,
+            self.tokenizer,
+        )
+        for rouge_key, metrics in output.items():
+            for metric in metrics:
+                for stat, value in metric.items():
+                    getattr(self, f"rouge{rouge_key}_{stat}").append(jnp.asarray(value, jnp.float32))
+
+    def compute(self) -> Dict[str, Array]:
+        update_output = {}
+        for rouge_key in self.rouge_keys_values:
+            for stat in ["fmeasure", "precision", "recall"]:
+                update_output[f"rouge{rouge_key}_{stat}"] = [
+                    float(v) for v in getattr(self, f"rouge{rouge_key}_{stat}")
+                ]
+        return _rouge_score_compute(update_output)
+
+    def __hash__(self) -> int:
+        # list states hold variable-length score lists; hash on lengths like the reference
+        hash_vals = [type(self).__name__]
+        for key in self._defaults:
+            value = getattr(self, key)
+            hash_vals.append(tuple(value) if isinstance(value, (tuple, list)) else value)
+        return hash(tuple(str(v) for v in hash_vals))

@@ -1,0 +1,40 @@
+"""SacreBLEUScore metric (reference: text/sacre_bleu.py:38-120)."""
+from functools import partial
+from typing import Any, Optional, Sequence
+
+from metrics_tpu.functional.text.sacre_bleu import AVAILABLE_TOKENIZERS, _SacreBLEUTokenizer
+from metrics_tpu.text.bleu import BLEUScore
+
+
+class SacreBLEUScore(BLEUScore):
+    """BLEU with sacrebleu's canonical tokenization.
+
+    Args:
+        n_gram: largest n-gram order.
+        smooth: apply add-one smoothing to orders > 1.
+        tokenize: one of ``'none' | '13a' | 'zh' | 'intl' | 'char'``.
+        lowercase: case-insensitive scoring.
+        weights: per-order weights (default uniform).
+
+    Example:
+        >>> from metrics_tpu.text import SacreBLEUScore
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
+        >>> sacre_bleu = SacreBLEUScore()
+        >>> sacre_bleu(preds, target)
+        Array(0.75983, dtype=float32)
+    """
+
+    def __init__(
+        self,
+        n_gram: int = 4,
+        smooth: bool = False,
+        tokenize: str = "13a",
+        lowercase: bool = False,
+        weights: Optional[Sequence[float]] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(n_gram=n_gram, smooth=smooth, weights=weights, **kwargs)
+        if tokenize not in AVAILABLE_TOKENIZERS:
+            raise ValueError(f"Argument `tokenize` expected to be one of {AVAILABLE_TOKENIZERS} but got {tokenize}.")
+        self._tokenizer = partial(_SacreBLEUTokenizer.tokenize, tokenize=tokenize, lowercase=lowercase)

@@ -1,0 +1,47 @@
+"""SQuAD metric (reference: text/squad.py:34-130)."""
+from typing import Any, Dict, Sequence, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.text.squad import _squad_compute, _squad_input_check, _squad_update
+
+
+class SQuAD(Metric):
+    """SQuAD v1 exact-match and F1 (both in percent).
+
+    Example:
+        >>> from metrics_tpu.text import SQuAD
+        >>> preds = [{"prediction_text": "1976", "id": "56e10a3be3433e1400422b22"}]
+        >>> target = [{"answers": {"answer_start": [97], "text": ["1976"]}, "id": "56e10a3be3433e1400422b22"}]
+        >>> squad = SQuAD()
+        >>> squad(preds, target)
+        {'exact_match': Array(100., dtype=float32), 'f1': Array(100., dtype=float32)}
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 100.0
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("f1_score", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("exact_match", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(
+        self,
+        preds: Union[Dict[str, Any], Sequence[Dict[str, Any]]],
+        target: Union[Dict[str, Any], Sequence[Dict[str, Any]]],
+    ) -> None:
+        preds_dict, qas = _squad_input_check(preds, target)
+        f1, exact_match, total = _squad_update(preds_dict, qas)
+        self.f1_score = self.f1_score + f1
+        self.exact_match = self.exact_match + exact_match
+        self.total = self.total + total
+
+    def compute(self) -> Dict[str, Array]:
+        return _squad_compute(self.f1_score, self.exact_match, self.total)

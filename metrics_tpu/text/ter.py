@@ -1,0 +1,73 @@
+"""TranslationEditRate metric (reference: text/ter.py:29-160)."""
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.text.ter import _TercomTokenizer, _ter_compute, _ter_update
+
+
+class TranslationEditRate(Metric):
+    """Translation edit rate (lower = better, 0 = perfect).
+
+    Args:
+        normalize: apply general Tercom tokenization.
+        no_punctuation: remove punctuation before scoring.
+        lowercase: case-insensitive scoring.
+        asian_support: handle CJK characters.
+        return_sentence_level_score: also return per-sentence scores from ``compute``.
+
+    Example:
+        >>> from metrics_tpu.text import TranslationEditRate
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
+        >>> ter = TranslationEditRate()
+        >>> ter(preds, target)
+        Array(0.15384616, dtype=float32)
+    """
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(
+        self,
+        normalize: bool = False,
+        no_punctuation: bool = False,
+        lowercase: bool = True,
+        asian_support: bool = False,
+        return_sentence_level_score: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(normalize, bool):
+            raise ValueError(f"Expected argument `normalize` to be of type boolean but got {normalize}.")
+        if not isinstance(no_punctuation, bool):
+            raise ValueError(f"Expected argument `no_punctuation` to be of type boolean but got {no_punctuation}.")
+        if not isinstance(lowercase, bool):
+            raise ValueError(f"Expected argument `lowercase` to be of type boolean but got {lowercase}.")
+        if not isinstance(asian_support, bool):
+            raise ValueError(f"Expected argument `asian_support` to be of type boolean but got {asian_support}.")
+        self.tokenizer = _TercomTokenizer(normalize, no_punctuation, lowercase, asian_support)
+        self.return_sentence_level_score = return_sentence_level_score
+
+        self.add_state("total_num_edits", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total_tgt_len", jnp.asarray(0.0), dist_reduce_fx="sum")
+        if self.return_sentence_level_score:
+            self.add_state("sentence_ter", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Union[str, Sequence[str]], target: Sequence[Union[str, Sequence[str]]]) -> None:
+        sentence_scores = [] if self.return_sentence_level_score else None
+        num_edits, tgt_length, sentence_scores = _ter_update(preds, target, self.tokenizer, sentence_scores)
+        self.total_num_edits = self.total_num_edits + num_edits
+        self.total_tgt_len = self.total_tgt_len + tgt_length
+        if self.return_sentence_level_score:
+            self.sentence_ter.append(jnp.asarray(sentence_scores, jnp.float32))
+
+    def compute(self) -> Union[Array, Tuple[Array, Array]]:
+        score = _ter_compute(self.total_num_edits, self.total_tgt_len)
+        if self.return_sentence_level_score:
+            return score, jnp.concatenate([jnp.atleast_1d(s) for s in self.sentence_ter])
+        return score

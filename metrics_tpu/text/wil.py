@@ -1,0 +1,42 @@
+"""WordInfoLost metric (reference: text/wil.py:26-115)."""
+from typing import Any, Sequence, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.text.wil import _wil_compute, _wil_update
+
+
+class WordInfoLost(Metric):
+    """Word information lost (0 = perfect).
+
+    Example:
+        >>> from metrics_tpu.text import WordInfoLost
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> wil = WordInfoLost()
+        >>> wil(preds, target)
+        Array(0.65277773, dtype=float32)
+    """
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("hits", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("target_total", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("preds_total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Union[str, Sequence[str]], target: Union[str, Sequence[str]]) -> None:
+        hits, target_total, preds_total = _wil_update(preds, target)
+        self.hits = self.hits + hits
+        self.target_total = self.target_total + target_total
+        self.preds_total = self.preds_total + preds_total
+
+    def compute(self) -> Array:
+        return _wil_compute(self.hits, self.target_total, self.preds_total)

@@ -1,0 +1,42 @@
+"""WordInfoPreserved metric (reference: text/wip.py:26-115)."""
+from typing import Any, Sequence, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.text.wip import _wip_compute, _wip_update
+
+
+class WordInfoPreserved(Metric):
+    """Word information preserved (1 = perfect).
+
+    Example:
+        >>> from metrics_tpu.text import WordInfoPreserved
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> wip = WordInfoPreserved()
+        >>> wip(preds, target)
+        Array(0.3472222, dtype=float32)
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("hits", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("target_total", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("preds_total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Union[str, Sequence[str]], target: Union[str, Sequence[str]]) -> None:
+        hits, target_total, preds_total = _wip_update(preds, target)
+        self.hits = self.hits + hits
+        self.target_total = self.target_total + target_total
+        self.preds_total = self.preds_total + preds_total
+
+    def compute(self) -> Array:
+        return _wip_compute(self.hits, self.target_total, self.preds_total)

@@ -1,0 +1,27 @@
+"""Import the reference torchmetrics from /root/reference for differential testing.
+
+The reference needs ``lightning_utilities`` (not in this image); a minimal stub is
+vendored under ``tests/helpers/refshim``. Tests that use the reference must be
+skipped gracefully when the tree is absent (e.g. running outside this container).
+"""
+import os
+import sys
+
+_REFERENCE_SRC = "/root/reference/src"
+_SHIM = os.path.join(os.path.dirname(__file__), "refshim")
+
+
+def reference_available() -> bool:
+    return os.path.isdir(_REFERENCE_SRC)
+
+
+def import_reference_text():
+    """Return the reference ``torchmetrics.functional.text`` module (or None)."""
+    if not reference_available():
+        return None
+    for p in (_SHIM, _REFERENCE_SRC):
+        if p not in sys.path:
+            sys.path.insert(0, p)
+    import torchmetrics.functional.text as ref_text  # noqa: PLC0415
+
+    return ref_text

@@ -1,0 +1,26 @@
+from enum import Enum
+
+
+class StrEnum(str, Enum):
+    @classmethod
+    def from_str(cls, value, source="key"):
+        try:
+            return cls[value.replace(" ", "_").replace("-", "_").upper()]
+        except KeyError:
+            pass
+        try:
+            return cls(value)
+        except ValueError:
+            return None
+
+    @classmethod
+    def try_from_str(cls, value, source="key"):
+        return cls.from_str(value, source)
+
+    def __eq__(self, other):
+        if isinstance(other, Enum):
+            other = other.value
+        return self.value.lower() == str(other).lower()
+
+    def __hash__(self):
+        return hash(self.value.lower())

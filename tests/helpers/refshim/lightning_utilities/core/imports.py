@@ -1,0 +1,21 @@
+import importlib
+import importlib.util
+from packaging.version import Version
+
+
+def package_available(name):
+    return importlib.util.find_spec(name) is not None
+
+
+def compare_version(package, op, version, use_base_version=False):
+    try:
+        pkg = importlib.import_module(package)
+    except Exception:
+        return False
+    try:
+        pkg_version = Version(pkg.__version__)
+    except Exception:
+        return False
+    if use_base_version:
+        pkg_version = Version(pkg_version.base_version)
+    return op(pkg_version, Version(version))

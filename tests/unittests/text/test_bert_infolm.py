@@ -1,0 +1,189 @@
+"""BERTScore + InfoLM tests with deterministic fake encoders (no model downloads).
+
+Reference test model: tests/unittests/text/test_bertscore.py / test_infolm.py use
+real HF checkpoints; offline here, the oracle is the reference's own math driven
+through its user-model path (dict inputs + ``user_forward_fn``).
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.bert import bert_score
+from metrics_tpu.functional.text.infolm import (
+    _InformationMeasure,
+    _input_ids_idf,
+    _tokens_idf,
+    infolm,
+    masked_lm_distribution,
+)
+from metrics_tpu.text import BERTScore, InfoLM
+
+import sys, os
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+from helpers.reference import import_reference_text, reference_available  # noqa: E402
+
+needs_ref = pytest.mark.skipif(not reference_available(), reason="reference tree not mounted")
+
+_rng = np.random.RandomState(0)
+EMB = _rng.randn(200, 16).astype(np.float32)
+MLM_W = _rng.randn(50, 30).astype(np.float32)
+SPECIAL = {"mask_token_id": 4, "pad_token_id": 0, "sep_token_id": 3, "cls_token_id": 2}
+
+PREDS = ["the cat sat on the mat", "hello world"]
+TARGET = ["a cat sat on a mat quietly", "hello there world"]
+
+
+def fake_tokenize(texts, max_length=None):
+    rows = [[101] + [hash(w) % 90 + 10 for w in t.split()] + [102] for t in texts]
+    length = max_length or max(len(r) for r in rows)
+    input_ids = np.zeros((len(rows), length), np.int64)
+    mask = np.zeros((len(rows), length), np.int64)
+    for i, r in enumerate(rows):
+        input_ids[i, : len(r)] = r
+        mask[i, : len(r)] = 1
+    return input_ids, mask
+
+
+def fake_encoder(sentences):
+    ids, mask = fake_tokenize(sentences)
+    return jnp.asarray(EMB[ids]), ids, mask
+
+
+def mlm_tokenize(sentences, max_length):
+    rows = [[2] + [hash(w) % 40 + 5 for w in s.split()] + [3] for s in sentences]
+    input_ids = np.zeros((len(rows), max_length), np.int64)
+    mask = np.zeros((len(rows), max_length), np.int64)
+    for i, r in enumerate(rows):
+        input_ids[i, : len(r)] = r
+        mask[i, : len(r)] = 1
+    return input_ids, mask
+
+
+def mlm_logits_fn(input_ids, attention_mask):
+    return jnp.asarray(MLM_W[np.asarray(input_ids) % 50])
+
+
+@needs_ref
+@pytest.mark.parametrize("idf", [False, True])
+def test_bert_score_vs_reference(idf):
+    import torch
+    from torchmetrics.functional.text.bert import bert_score as ref_bert
+
+    class FakeModel(torch.nn.Module):
+        def forward(self, *a, **k):
+            pass
+
+    def fwd(model, batch):
+        return torch.tensor(EMB[batch["input_ids"].numpy()])
+
+    pi, pm = fake_tokenize(PREDS)
+    ti, tm = fake_tokenize(TARGET)
+    t = ref_bert(
+        {"input_ids": torch.tensor(pi), "attention_mask": torch.tensor(pm)},
+        {"input_ids": torch.tensor(ti), "attention_mask": torch.tensor(tm)},
+        model=FakeModel(),
+        user_forward_fn=fwd,
+        idf=idf,
+    )
+    m = bert_score(PREDS, TARGET, encoder=fake_encoder, idf=idf)
+    for k in ("precision", "recall", "f1"):
+        assert np.allclose(np.asarray(m[k]), np.asarray(t[k]), atol=1e-5), k
+
+
+def test_bert_score_class_accumulation():
+    metric = BERTScore(encoder=fake_encoder, idf=True)
+    for p, t in zip(PREDS, TARGET):
+        metric.update([p], [t])
+    out = metric.compute()
+    batch = bert_score(PREDS, TARGET, encoder=fake_encoder, idf=True)
+    for k in ("precision", "recall", "f1"):
+        assert np.allclose(np.asarray(out[k]), np.asarray(batch[k]), atol=1e-6)
+    metric.reset()
+    assert len(metric._preds_corpus) == 0
+
+
+def test_bert_score_rescale_with_baseline():
+    out = bert_score(PREDS, TARGET, encoder=fake_encoder, rescale_with_baseline=True, baseline=[0.5, 0.5, 0.5])
+    raw = bert_score(PREDS, TARGET, encoder=fake_encoder)
+    assert np.allclose(np.asarray(out["f1"]), (np.asarray(raw["f1"]) - 0.5) / 0.5, atol=1e-6)
+
+
+@needs_ref
+@pytest.mark.parametrize("idf", [False, True])
+def test_infolm_distribution_vs_reference(idf):
+    import torch
+    from torchmetrics.functional.text.infolm import _get_batch_distribution
+
+    class FakeOut:
+        def __init__(self, logits):
+            self.logits = logits
+
+    class FakeModel:
+        def __call__(self, input_ids, attention_mask):
+            return FakeOut(torch.tensor(MLM_W[input_ids.numpy() % 50]))
+
+    p_ids, p_mask = mlm_tokenize(PREDS, 10)
+    if idf:
+        idf_map = _tokens_idf(p_ids)
+        p_idf = _input_ids_idf(p_ids, idf_map)
+        batch = {
+            "input_ids": torch.tensor(p_ids),
+            "attention_mask": torch.tensor(p_mask),
+            "input_ids_idf": torch.tensor(p_idf),
+        }
+    else:
+        p_idf = None
+        batch = {"input_ids": torch.tensor(p_ids), "attention_mask": torch.tensor(p_mask)}
+    ref_dist = _get_batch_distribution(FakeModel(), batch, 0.25, idf, SPECIAL).numpy()
+    my_dist = np.asarray(masked_lm_distribution(p_ids, p_mask, mlm_logits_fn, SPECIAL, 0.25, p_idf))
+    assert np.allclose(my_dist, ref_dist, atol=1e-5)
+
+
+@needs_ref
+@pytest.mark.parametrize(
+    "name, alpha, beta",
+    [
+        ("kl_divergence", None, None),
+        ("alpha_divergence", 0.5, None),
+        ("beta_divergence", None, 0.5),
+        ("ab_divergence", 0.5, 0.3),
+        ("renyi_divergence", 0.5, None),
+        ("l1_distance", None, None),
+        ("l2_distance", None, None),
+        ("l_infinity_distance", None, None),
+        ("fisher_rao_distance", None, None),
+    ],
+)
+def test_infolm_measures_vs_reference(name, alpha, beta):
+    import torch
+    from torchmetrics.functional.text.infolm import _InformationMeasure as RefIM
+
+    rng = np.random.RandomState(7)
+    p = rng.dirichlet(np.ones(30), size=4).astype(np.float32)
+    t = rng.dirichlet(np.ones(30), size=4).astype(np.float32)
+    mine = np.asarray(_InformationMeasure(name, alpha, beta)(jnp.asarray(p), jnp.asarray(t)))
+    theirs = RefIM(name, alpha, beta)(torch.tensor(p), torch.tensor(t)).numpy()
+    assert np.allclose(mine, theirs, atol=1e-4), name
+
+
+def test_infolm_measure_validation():
+    with pytest.raises(ValueError, match="alpha"):
+        _InformationMeasure("alpha_divergence", None)
+    with pytest.raises(ValueError, match="beta"):
+        _InformationMeasure("beta_divergence", None, None)
+    with pytest.raises(ValueError, match="information_measure"):
+        _InformationMeasure("not_a_measure")
+
+
+def test_infolm_class_accumulation():
+    kwargs = dict(
+        logits_fn=mlm_logits_fn, tokenizer_fn=mlm_tokenize, special_tokens_map=SPECIAL, idf=True, max_length=10
+    )
+    metric = InfoLM(**kwargs)
+    for p, t in zip(PREDS, TARGET):
+        metric.update([p], [t])
+    out = float(metric.compute())
+    batch = float(infolm(PREDS, TARGET, **kwargs))
+    assert abs(out - batch) < 1e-6

@@ -1,0 +1,366 @@
+"""Text-domain differential tests vs the reference implementation.
+
+Reference test model: tests/unittests/text/* (differential against jiwer/
+sacrebleu/etc.); here the oracle is the reference library itself, importable from
+/root/reference (skipped if absent).
+"""
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.functional.text import (
+    bleu_score,
+    char_error_rate,
+    chrf_score,
+    extended_edit_distance,
+    match_error_rate,
+    perplexity,
+    rouge_score,
+    sacre_bleu_score,
+    squad,
+    translation_edit_rate,
+    word_error_rate,
+    word_information_lost,
+    word_information_preserved,
+)
+from metrics_tpu.functional.text.helper import _edit_distance
+from metrics_tpu.functional.text.sacre_bleu import _SacreBLEUTokenizer, _intl_tokenize_fallback
+from metrics_tpu.text import (
+    BLEUScore,
+    CharErrorRate,
+    CHRFScore,
+    ExtendedEditDistance,
+    MatchErrorRate,
+    Perplexity,
+    ROUGEScore,
+    SacreBLEUScore,
+    SQuAD,
+    TranslationEditRate,
+    WordErrorRate,
+    WordInfoLost,
+    WordInfoPreserved,
+)
+
+import sys, os
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+from helpers.reference import import_reference_text, reference_available  # noqa: E402
+
+ref = import_reference_text()
+needs_ref = pytest.mark.skipif(not reference_available(), reason="reference tree not mounted")
+
+PREDS = ["this is the prediction", "there is an other sample", "a b", ""]
+TARGET = ["this is the reference", "there is another one", "a b c d", "x"]
+
+BLEU_PREDS = ["the cat is on the mat", "there is a big tree near the house"]
+BLEU_TARGET = [
+    ["there is a cat on the mat", "a cat is on the mat"],
+    ["a big tree is near the house", "there is a tree close to the house"],
+]
+
+
+def test_edit_distance_kernel():
+    # vectorized prefix-min DP vs naive DP
+    def naive(a, b):
+        dp = list(range(len(b) + 1))
+        for i in range(1, len(a) + 1):
+            prev, dp[0] = dp[0], i
+            for j in range(1, len(b) + 1):
+                cur = dp[j]
+                dp[j] = min(dp[j] + 1, dp[j - 1] + 1, prev + (a[i - 1] != b[j - 1]))
+                prev = cur
+        return dp[-1]
+
+    rng = np.random.RandomState(0)
+    for _ in range(50):
+        a = [str(x) for x in rng.randint(0, 5, rng.randint(0, 12))]
+        b = [str(x) for x in rng.randint(0, 5, rng.randint(0, 12))]
+        assert _edit_distance(a, b) == naive(a, b)
+
+
+@needs_ref
+@pytest.mark.parametrize(
+    "mine_name, ref_name",
+    [
+        ("word_error_rate", "word_error_rate"),
+        ("char_error_rate", "char_error_rate"),
+        ("match_error_rate", "match_error_rate"),
+        ("word_information_lost", "word_information_lost"),
+        ("word_information_preserved", "word_information_preserved"),
+    ],
+)
+def test_wer_family_vs_reference(mine_name, ref_name):
+    mine = globals()[mine_name]
+    theirs = getattr(ref, ref_name)
+    assert abs(float(mine(PREDS, TARGET)) - float(theirs(PREDS, TARGET))) < 1e-6
+
+
+@pytest.mark.parametrize(
+    "cls, fn",
+    [
+        (WordErrorRate, word_error_rate),
+        (CharErrorRate, char_error_rate),
+        (MatchErrorRate, match_error_rate),
+        (WordInfoLost, word_information_lost),
+        (WordInfoPreserved, word_information_preserved),
+    ],
+)
+def test_wer_family_class_accumulation(cls, fn):
+    metric = cls()
+    for i in range(len(PREDS)):
+        metric.update([PREDS[i]], [TARGET[i]])
+    assert abs(float(metric.compute()) - float(fn(PREDS, TARGET))) < 1e-6
+    metric.reset()
+    metric.update(PREDS, TARGET)
+    assert abs(float(metric.compute()) - float(fn(PREDS, TARGET))) < 1e-6
+    # pickle round-trip
+    m2 = pickle.loads(pickle.dumps(metric))
+    assert abs(float(m2.compute()) - float(metric.compute())) < 1e-6
+
+
+@needs_ref
+@pytest.mark.parametrize("n_gram", [1, 2, 4])
+@pytest.mark.parametrize("smooth", [False, True])
+def test_bleu_vs_reference(n_gram, smooth):
+    m = float(bleu_score(BLEU_PREDS, BLEU_TARGET, n_gram=n_gram, smooth=smooth))
+    t = float(ref.bleu_score(BLEU_PREDS, BLEU_TARGET, n_gram=n_gram, smooth=smooth))
+    assert abs(m - t) < 1e-5
+
+
+def test_bleu_class_accumulation():
+    metric = BLEUScore(n_gram=2, smooth=True)
+    for p, t in zip(BLEU_PREDS, BLEU_TARGET):
+        metric.update([p], [t])
+    assert abs(float(metric.compute()) - float(bleu_score(BLEU_PREDS, BLEU_TARGET, n_gram=2, smooth=True))) < 1e-6
+
+
+@needs_ref
+@pytest.mark.parametrize("tokenize", ["none", "13a", "intl", "char"])
+@pytest.mark.parametrize("lowercase", [False, True])
+def test_sacre_bleu_vs_reference(tokenize, lowercase):
+    preds = ["the cat is on the mat.", "Hello, World! it's 3.50 dollars"]
+    target = [["there is a cat on the mat."], ["Hello world, it is 3.50 dollars!"]]
+    m = float(sacre_bleu_score(preds, target, tokenize=tokenize, lowercase=lowercase, smooth=True))
+    t = float(ref.sacre_bleu_score(preds, target, tokenize=tokenize, lowercase=lowercase, smooth=True))
+    assert abs(m - t) < 1e-5
+
+
+@needs_ref
+def test_sacre_bleu_zh_vs_reference():
+    preds, target = ["猫在垫子上 the cat"], [["猫在垫子上面 a cat"]]
+    m = float(sacre_bleu_score(preds, target, tokenize="zh", smooth=True, n_gram=2))
+    t = float(ref.sacre_bleu_score(preds, target, tokenize="zh", smooth=True, n_gram=2))
+    assert abs(m - t) < 1e-5
+
+
+def test_sacre_bleu_class():
+    preds = ["the cat is on the mat."]
+    target = [["there is a cat on the mat."]]
+    metric = SacreBLEUScore(tokenize="13a", smooth=True)
+    metric.update(preds, target)
+    expected = sacre_bleu_score(preds, target, tokenize="13a", smooth=True)
+    assert abs(float(metric.compute()) - float(expected)) < 1e-6
+
+
+def test_intl_tokenizer_fallback_matches_regex_path():
+    import random, string
+
+    random.seed(0)
+    pool = string.ascii_letters + string.digits + ".,!?'\"$%+«»- ()[]@#&*;:~^|<>=/\\" + "éüñ中文猫"
+    for _ in range(300):
+        line = "".join(random.choice(pool) for _ in range(random.randint(0, 40)))
+        a = _SacreBLEUTokenizer._tokenize_international(line)
+        b = " ".join(_intl_tokenize_fallback(line).split())
+        assert a == b, repr(line)
+
+
+@needs_ref
+@pytest.mark.parametrize("accumulate", ["best", "avg"])
+@pytest.mark.parametrize("use_stemmer", [False, True])
+def test_rouge_vs_reference(accumulate, use_stemmer):
+    keys = ("rouge1", "rouge2", "rougeL")
+    preds = ["My name is John", "The quick brown fox jumps over the lazy dog and runs away"]
+    target = [
+        ["Is your name John", "John is my name"],
+        ["A quick brown fox jumped over the lazy dogs", "the fox runs away quickly"],
+    ]
+    m = rouge_score(preds, target, accumulate=accumulate, use_stemmer=use_stemmer, rouge_keys=keys)
+    t = ref.rouge_score(preds, target, accumulate=accumulate, use_stemmer=use_stemmer, rouge_keys=keys)
+    for k in m:
+        assert abs(float(m[k]) - float(t[k])) < 1e-6, k
+
+
+def test_rouge_lsum_single_sentence_equals_rouge_l():
+    m = rouge_score("My name is John", "Is your name John", rouge_keys=("rougeL", "rougeLsum"))
+    assert abs(float(m["rougeLsum_fmeasure"]) - float(m["rougeL_fmeasure"])) < 1e-7
+    assert abs(float(m["rougeLsum_fmeasure"]) - 0.5) < 1e-6
+
+
+def test_rouge_class_accumulation():
+    preds = ["My name is John", "The quick brown fox"]
+    target = ["Is your name John", "The fast brown fox"]
+    metric = ROUGEScore(rouge_keys=("rouge1", "rougeL"))
+    for p, t in zip(preds, target):
+        metric.update(p, t)
+    batch = rouge_score(preds, [[t] for t in target], rouge_keys=("rouge1", "rougeL"))
+    out = metric.compute()
+    for k in batch:
+        assert abs(float(out[k]) - float(batch[k])) < 1e-6
+
+
+@needs_ref
+@pytest.mark.parametrize("n_char_order, n_word_order", [(6, 2), (6, 0), (4, 1)])
+@pytest.mark.parametrize("whitespace", [False, True])
+def test_chrf_vs_reference(n_char_order, n_word_order, whitespace):
+    preds = ["the cat is on the mat", "Hello, World! don't panic"]
+    target = [["there is a cat on the mat", "a cat is on the mat"], ["Hello world, do not panic!", "hello world"]]
+    m = float(chrf_score(preds, target, n_char_order=n_char_order, n_word_order=n_word_order, whitespace=whitespace))
+    t = float(
+        ref.chrf_score(preds, target, n_char_order=n_char_order, n_word_order=n_word_order, whitespace=whitespace)
+    )
+    assert abs(m - t) < 1e-6
+
+
+@needs_ref
+def test_chrf_sentence_level_vs_reference():
+    preds = ["the cat is on the mat", "Hello, World!"]
+    target = [["there is a cat on the mat"], ["Hello world!"]]
+    m, ms = chrf_score(preds, target, return_sentence_level_score=True)
+    t, ts = ref.chrf_score(preds, target, return_sentence_level_score=True)
+    assert abs(float(m) - float(t)) < 1e-6
+    assert np.allclose(np.asarray(ms), ts.numpy(), atol=1e-6)
+
+
+def test_chrf_class_accumulation():
+    preds = ["the cat is on the mat", "hello there world"]
+    target = [["there is a cat on the mat"], ["hello world"]]
+    metric = CHRFScore()
+    for p, t in zip(preds, target):
+        metric.update([p], [t])
+    assert abs(float(metric.compute()) - float(chrf_score(preds, target))) < 1e-6
+
+
+@needs_ref
+@pytest.mark.parametrize(
+    "kwargs", [{}, {"normalize": True}, {"lowercase": False}, {"no_punctuation": True}]
+)
+def test_ter_vs_reference(kwargs):
+    cases = [
+        (["the cat is on the mat"], [["there is a cat on the mat", "a cat is on the mat"]]),
+        (["a b c d e f", "hello there world"], [["b c d a e f", "f e d c b a"], ["hello world there"]]),
+        (
+            ["the new law will be passed by the parliament next week"],
+            [["next week the parliament will pass the new law", "the new law will pass in parliament next week"]],
+        ),
+    ]
+    for preds, target in cases:
+        m = float(translation_edit_rate(preds, target, **kwargs))
+        t = float(ref.translation_edit_rate(preds, target, **kwargs))
+        assert abs(m - t) < 1e-6, (preds, kwargs)
+
+
+def test_ter_class_accumulation():
+    preds = ["the cat is on the mat", "hello there"]
+    target = [["there is a cat on the mat"], ["hello world"]]
+    metric = TranslationEditRate()
+    for p, t in zip(preds, target):
+        metric.update([p], [t])
+    assert abs(float(metric.compute()) - float(translation_edit_rate(preds, target))) < 1e-6
+
+
+@needs_ref
+@pytest.mark.parametrize("rho", [0.3, 0.5])
+def test_eed_vs_reference(rho):
+    preds = ["this is the prediction", "here is an other sample"]
+    target = ["this is the reference", "here is another one"]
+    m = float(extended_edit_distance(preds, target, rho=rho))
+    t = float(ref.extended_edit_distance(preds, target, rho=rho))
+    assert abs(m - t) < 1e-6
+
+
+@needs_ref
+def test_eed_ja_vs_reference():
+    preds, target = ["ｈｅｌｌｏ　ｗｏｒｌｄ"], [["hello world"]]
+    m = float(extended_edit_distance(preds, target, language="ja"))
+    t = float(ref.extended_edit_distance(preds, target, language="ja"))
+    assert abs(m - t) < 1e-6
+
+
+def test_eed_class_accumulation():
+    preds = ["this is the prediction", "here is an other sample"]
+    target = ["this is the reference", "here is another one"]
+    metric = ExtendedEditDistance()
+    for p, t in zip(preds, target):
+        metric.update([p], [t])
+    assert abs(float(metric.compute()) - float(extended_edit_distance(preds, target))) < 1e-6
+
+
+@needs_ref
+def test_squad_vs_reference():
+    sq_p = [{"prediction_text": "1976", "id": "a"}, {"prediction_text": "the big dog", "id": "b"}]
+    sq_t = [
+        {"answers": {"answer_start": [1], "text": ["1976"]}, "id": "a"},
+        {"answers": {"answer_start": [1], "text": ["a big dog", "big cat"]}, "id": "b"},
+    ]
+    m = squad(sq_p, sq_t)
+    t = ref.squad(sq_p, sq_t)
+    assert abs(float(m["f1"]) - float(t["f1"])) < 1e-4
+    assert abs(float(m["exact_match"]) - float(t["exact_match"])) < 1e-4
+
+
+def test_squad_class_accumulation():
+    sq_p = [{"prediction_text": "1976", "id": "a"}, {"prediction_text": "wrong", "id": "b"}]
+    sq_t = [
+        {"answers": {"answer_start": [1], "text": ["1976"]}, "id": "a"},
+        {"answers": {"answer_start": [1], "text": ["right"]}, "id": "b"},
+    ]
+    metric = SQuAD()
+    for p, t in zip(sq_p, sq_t):
+        metric.update(p, t)
+    out = metric.compute()
+    batch = squad(sq_p, sq_t)
+    assert abs(float(out["f1"]) - float(batch["f1"])) < 1e-5
+    assert abs(float(out["exact_match"]) - float(batch["exact_match"])) < 1e-5
+
+
+@needs_ref
+def test_perplexity_vs_reference():
+    import torch
+
+    g = torch.Generator().manual_seed(0)
+    logits = torch.randn(2, 8, 5, generator=g)
+    tgt = torch.randint(0, 5, (2, 8), generator=g)
+    tgt[0, 6:] = -100
+    m = float(perplexity(jnp.asarray(logits.numpy()), jnp.asarray(tgt.numpy()), ignore_index=-100))
+    t = float(ref.perplexity(logits, tgt, ignore_index=-100))
+    assert abs(m - t) < 1e-4
+
+
+def test_perplexity_class_jit_path():
+    import jax
+
+    rng = np.random.RandomState(3)
+    logits = jnp.asarray(rng.randn(4, 6, 7).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, 7, (4, 6)).astype(np.int32))
+    metric = Perplexity(validate_args=False)
+    update = jax.jit(metric.local_update)
+    state = metric.init_state()
+    state = update(state, logits[:2], target[:2])
+    state = update(state, logits[2:], target[2:])
+    got = float(metric.compute_from(state))
+    want = float(perplexity(logits, target))
+    assert abs(got - want) < 1e-4
+
+    # eager class path agrees
+    metric2 = Perplexity()
+    metric2.update(logits, jnp.asarray(target, jnp.int32))
+    assert abs(float(metric2.compute()) - want) < 1e-4
+
+
+def test_perplexity_validation():
+    with pytest.raises(ValueError, match="expected to have 3 dimensions"):
+        perplexity(jnp.zeros((2, 3)), jnp.zeros((2, 3), jnp.int32))
+    with pytest.raises(TypeError, match="integer dtype"):
+        perplexity(jnp.zeros((2, 3, 4)), jnp.zeros((2, 3)))
