@@ -1,0 +1,52 @@
+"""PerceptualEvaluationSpeechQuality metric (reference: audio/pesq.py:29-140)."""
+from typing import Any
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.audio.pesq import perceptual_evaluation_speech_quality
+from metrics_tpu.utils.imports import _PESQ_AVAILABLE
+
+
+class PerceptualEvaluationSpeechQuality(Metric):
+    """Mean PESQ MOS-LQO over all seen samples (requires the ``pesq`` package).
+
+    Args:
+        fs: sampling rate — 8000 (nb) or 16000 (wb only).
+        mode: ``"wb"`` or ``"nb"``.
+        n_processes: parallel workers for batched evaluation.
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = -0.5
+    plot_upper_bound = 4.5
+
+    def __init__(self, fs: int, mode: str, n_processes: int = 1, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not _PESQ_AVAILABLE:
+            raise ModuleNotFoundError(
+                "PerceptualEvaluationSpeechQuality metric requires that `pesq` is installed."
+                " Install it with `pip install pesq`."
+            )
+        if mode not in ("wb", "nb"):
+            raise ValueError(f"Expected argument `mode` to either be 'wb' or 'nb' but got {mode}")
+        if fs not in (8000, 16000):
+            raise ValueError(f"Expected argument `fs` to either be 8000 or 16000 but got {fs}")
+        self.fs = fs
+        self.mode = mode
+        self.n_processes = n_processes
+        self.add_state("sum_pesq", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        pesq_batch = perceptual_evaluation_speech_quality(
+            preds, target, self.fs, self.mode, n_processes=self.n_processes
+        )
+        self.sum_pesq = self.sum_pesq + jnp.sum(pesq_batch)
+        self.total = self.total + pesq_batch.size
+
+    def compute(self) -> Array:
+        return self.sum_pesq / self.total

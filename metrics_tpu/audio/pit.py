@@ -1,0 +1,63 @@
+"""PermutationInvariantTraining metric (reference: audio/pit.py:30-130)."""
+from typing import Any, Callable
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.audio.pit import permutation_invariant_training
+
+
+class PermutationInvariantTraining(Metric):
+    """Mean best-permutation metric value for multi-talker separation.
+
+    Args:
+        metric_func: pairwise metric ``f(preds[:, i], target[:, j]) -> (batch,)``.
+        eval_func: ``"max"`` (higher better) or ``"min"``.
+        kwargs: additional args bound to ``metric_func``.
+
+    Example:
+        >>> import jax
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.audio import PermutationInvariantTraining
+        >>> from metrics_tpu.functional.audio import scale_invariant_signal_distortion_ratio
+        >>> preds = jax.random.normal(jax.random.PRNGKey(0), (3, 2, 100))
+        >>> target = jax.random.normal(jax.random.PRNGKey(1), (3, 2, 100))
+        >>> pit = PermutationInvariantTraining(scale_invariant_signal_distortion_ratio, 'max')
+        >>> bool(jnp.isfinite(pit(preds, target)))
+        True
+    """
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(self, metric_func: Callable, eval_func: str = "max", **kwargs: Any) -> None:
+        base_kwargs = {
+            k: kwargs.pop(k)
+            for k in (
+                "compute_on_cpu",
+                "dist_sync_on_step",
+                "process_group",
+                "dist_sync_fn",
+                "distributed_available_fn",
+                "sync_on_compute",
+            )
+            if k in kwargs
+        }
+        super().__init__(**base_kwargs)
+        if eval_func not in ("max", "min"):
+            raise ValueError(f'eval_func can only be "max" or "min" but got {eval_func}')
+        self.metric_func = metric_func
+        self.eval_func = eval_func
+        self.kwargs = kwargs
+        self.add_state("sum_pit_metric", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        pit_metric = permutation_invariant_training(preds, target, self.metric_func, self.eval_func, **self.kwargs)[0]
+        self.sum_pit_metric = self.sum_pit_metric + jnp.sum(pit_metric)
+        self.total = self.total + pit_metric.size
+
+    def compute(self) -> Array:
+        return self.sum_pit_metric / self.total

@@ -1,0 +1,76 @@
+"""SignalNoiseRatio / ScaleInvariantSignalNoiseRatio (reference: audio/snr.py:27-220)."""
+from typing import Any
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.audio.snr import scale_invariant_signal_noise_ratio, signal_noise_ratio
+
+
+class SignalNoiseRatio(Metric):
+    """Mean SNR in dB over all seen samples.
+
+    Args:
+        zero_mean: subtract signal means before computing.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.audio import SignalNoiseRatio
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> snr = SignalNoiseRatio()
+        >>> snr(preds, target)
+        Array(16.180424, dtype=float32)
+    """
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(self, zero_mean: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(zero_mean, bool):
+            raise ValueError(f"Expected argument `zero_mean` to be a bool, but got {zero_mean}")
+        self.zero_mean = zero_mean
+        self.add_state("sum_snr", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        snr_batch = signal_noise_ratio(preds=preds, target=target, zero_mean=self.zero_mean)
+        self.sum_snr = self.sum_snr + jnp.sum(snr_batch)
+        self.total = self.total + snr_batch.size
+
+    def compute(self) -> Array:
+        return self.sum_snr / self.total
+
+
+class ScaleInvariantSignalNoiseRatio(Metric):
+    """Mean SI-SNR in dB over all seen samples.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.audio import ScaleInvariantSignalNoiseRatio
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> si_snr = ScaleInvariantSignalNoiseRatio()
+        >>> si_snr(preds, target)
+        Array(15.091805, dtype=float32)
+    """
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_si_snr", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        si_snr_batch = scale_invariant_signal_noise_ratio(preds=preds, target=target)
+        self.sum_si_snr = self.sum_si_snr + jnp.sum(si_snr_batch)
+        self.total = self.total + si_snr_batch.size
+
+    def compute(self) -> Array:
+        return self.sum_si_snr / self.total

@@ -1,0 +1,50 @@
+"""ShortTimeObjectiveIntelligibility metric (reference: audio/stoi.py:29-130)."""
+from typing import Any
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.audio.stoi import short_time_objective_intelligibility
+
+
+class ShortTimeObjectiveIntelligibility(Metric):
+    """Mean STOI intelligibility score over all seen samples (host-side DSP).
+
+    Args:
+        fs: sampling rate in Hz.
+        extended: compute extended (language-independent) STOI.
+
+    Example:
+        >>> import numpy as np
+        >>> from metrics_tpu.audio import ShortTimeObjectiveIntelligibility
+        >>> rng = np.random.RandomState(0)
+        >>> target = rng.randn(12000)
+        >>> preds = target + 0.1 * rng.randn(12000)
+        >>> stoi = ShortTimeObjectiveIntelligibility(10000)
+        >>> float(stoi(preds, target)) > 0.9
+        True
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, fs: int, extended: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(fs, int) or fs <= 0:
+            raise ValueError(f"Expected argument `fs` to be a positive int, but got {fs}")
+        self.fs = fs
+        self.extended = extended
+        self.add_state("sum_stoi", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        stoi_batch = short_time_objective_intelligibility(preds, target, self.fs, self.extended)
+        self.sum_stoi = self.sum_stoi + jnp.sum(stoi_batch)
+        self.total = self.total + stoi_batch.size
+
+    def compute(self) -> Array:
+        return self.sum_stoi / self.total

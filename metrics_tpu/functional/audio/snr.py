@@ -1,0 +1,43 @@
+"""SNR / SI-SNR functionals (reference: functional/audio/snr.py:20-120).
+
+Pure-jnp, fully jit/grad/vmap/shard_map-safe.
+"""
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+
+def signal_noise_ratio(preds: Array, target: Array, zero_mean: bool = False) -> Array:
+    """Signal-to-noise ratio in dB, per sample over the trailing time axis.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> signal_noise_ratio(preds, target)
+        Array(16.180424, dtype=float32)
+    """
+    _check_same_shape(preds, target)
+    eps = jnp.finfo(preds.dtype).eps
+    if zero_mean:
+        target = target - jnp.mean(target, axis=-1, keepdims=True)
+        preds = preds - jnp.mean(preds, axis=-1, keepdims=True)
+    noise = target - preds
+    snr_value = (jnp.sum(target**2, axis=-1) + eps) / (jnp.sum(noise**2, axis=-1) + eps)
+    return 10 * jnp.log10(snr_value)
+
+
+def scale_invariant_signal_noise_ratio(preds: Array, target: Array) -> Array:
+    """Scale-invariant SNR in dB (equals SI-SDR with zero-mean inputs).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> scale_invariant_signal_noise_ratio(preds, target)
+        Array(15.091805, dtype=float32)
+    """
+    from metrics_tpu.functional.audio.sdr import scale_invariant_signal_distortion_ratio
+
+    return scale_invariant_signal_distortion_ratio(preds=preds, target=target, zero_mean=True)

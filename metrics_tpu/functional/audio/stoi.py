@@ -1,0 +1,182 @@
+"""Short-Time Objective Intelligibility functional (reference: functional/audio/stoi.py).
+
+The reference delegates to the ``pystoi`` wheel; this is a from-scratch NumPy port
+of the published algorithm (Taal, Hendriks, Heusdens, Jensen, "An Algorithm for
+Intelligibility Prediction of Time-Frequency Weighted Noisy Speech", 2011):
+
+1. resample both signals to 10 kHz,
+2. remove frames more than 40 dB below the loudest frame (256-sample hann frames,
+   50% overlap, overlap-add reconstruction),
+3. 512-point STFT (256-sample frames, 128 hop) -> 15 one-third-octave bands from
+   150 Hz,
+4. per 30-frame segment and band: scale the degraded segment to the clean energy,
+   clip at -15 dB SDR, and correlate with the clean segment; average everything.
+
+Host-side by nature (silent-frame removal is data-dependent-shape). When the
+``pystoi`` wheel is installed it is used instead for bit-exact community parity;
+this port is the offline default. Delta vs pystoi: the extended-STOI
+normalization omits pystoi's random dithering noise (deterministic eps guards
+instead).
+"""
+import functools
+from typing import Union
+
+import numpy as np
+from jax import Array
+import jax.numpy as jnp
+
+from metrics_tpu.utils.imports import _PYSTOI_AVAILABLE, _SCIPY_AVAILABLE
+from metrics_tpu.utils.prints import rank_zero_warn
+
+FS = 10000  # target sample rate
+N_FRAME = 256  # silence-removal / STFT frame
+NFFT = 512
+NUMBAND = 15
+MINFREQ = 150
+N_SEG = 30  # frames per intelligibility segment
+BETA = -15.0  # lower SDR clip bound (dB)
+DYN_RANGE = 40.0
+_EPS = np.finfo(np.float64).eps
+
+
+@functools.lru_cache(maxsize=8)
+def _thirdoct(fs: int, nfft: int, num_bands: int, min_freq: int) -> np.ndarray:
+    """One-third-octave band matrix over rfft bins (published design)."""
+    f = np.linspace(0, fs, nfft + 1)[: nfft // 2 + 1]
+    k = np.arange(num_bands, dtype=np.float64)
+    freq_low = min_freq * np.power(2.0, (2 * k - 1) / 6)
+    freq_high = min_freq * np.power(2.0, (2 * k + 1) / 6)
+    obm = np.zeros((num_bands, len(f)))
+    for i in range(num_bands):
+        fl_bin = int(np.argmin(np.square(f - freq_low[i])))
+        fh_bin = int(np.argmin(np.square(f - freq_high[i])))
+        obm[i, fl_bin:fh_bin] = 1
+    return obm
+
+
+def _hann(framelen: int) -> np.ndarray:
+    return np.hanning(framelen + 2)[1:-1]
+
+
+def _frame(x: np.ndarray, framelen: int, hop: int) -> np.ndarray:
+    starts = range(0, len(x) - framelen, hop)
+    return np.array([x[i : i + framelen] for i in starts])
+
+
+def _remove_silent_frames(x: np.ndarray, y: np.ndarray, dyn_range: float, framelen: int, hop: int):
+    w = _hann(framelen)
+    x_frames = _frame(x, framelen, hop) * w
+    y_frames = _frame(y, framelen, hop) * w
+    energies = 20 * np.log10(np.linalg.norm(x_frames, axis=1) + _EPS)
+    mask = (np.max(energies) - dyn_range - energies) < 0
+    x_frames, y_frames = x_frames[mask], y_frames[mask]
+    if len(x_frames) == 0:
+        return np.zeros(0), np.zeros(0)
+    n_sil = (len(x_frames) - 1) * hop + framelen
+    x_sil = np.zeros(n_sil)
+    y_sil = np.zeros(n_sil)
+    for i in range(len(x_frames)):
+        x_sil[i * hop : i * hop + framelen] += x_frames[i]
+        y_sil[i * hop : i * hop + framelen] += y_frames[i]
+    return x_sil, y_sil
+
+
+def _stft_bands(x: np.ndarray, obm: np.ndarray) -> np.ndarray:
+    """(bands, frames) one-third-octave magnitudes."""
+    w = _hann(N_FRAME)
+    frames = _frame(x, N_FRAME, N_FRAME // 2) * w
+    spec = np.fft.rfft(frames, n=NFFT, axis=-1)  # (frames, bins)
+    return np.sqrt(obm @ np.square(np.abs(spec)).T)  # (bands, frames)
+
+
+def _segments(tob: np.ndarray, n: int) -> np.ndarray:
+    """(num_segments, bands, n) sliding segments of n frames."""
+    return np.array([tob[:, m - n : m] for m in range(n, tob.shape[1] + 1)])
+
+
+def _stoi_numpy(clean: np.ndarray, degraded: np.ndarray, fs: int, extended: bool) -> float:
+    if clean.shape != degraded.shape:
+        raise ValueError("Clean and degraded signals must have the same shape")
+    if fs != FS:
+        if not _SCIPY_AVAILABLE:
+            raise ModuleNotFoundError("Resampling to 10 kHz requires scipy.")
+        from scipy.signal import resample_poly
+
+        clean = resample_poly(clean, FS, fs)
+        degraded = resample_poly(degraded, FS, fs)
+
+    clean, degraded = _remove_silent_frames(clean, degraded, DYN_RANGE, N_FRAME, N_FRAME // 2)
+    if len(clean) < N_FRAME + 1:
+        # pystoi-compatible degenerate-input behavior: warn + sentinel, not crash
+        rank_zero_warn("Not enough non-silent frames to compute STOI; returning 1e-5.", RuntimeWarning)
+        return 1e-5
+
+    obm = _thirdoct(FS, NFFT, NUMBAND, MINFREQ)
+    x_tob = _stft_bands(clean, obm)
+    y_tob = _stft_bands(degraded, obm)
+    if x_tob.shape[1] < N_SEG:
+        rank_zero_warn(
+            f"Signal too short after silence removal ({x_tob.shape[1]} < {N_SEG} frames); returning 1e-5.",
+            RuntimeWarning,
+        )
+        return 1e-5
+
+    x_seg = _segments(x_tob, N_SEG)  # (M, bands, N)
+    y_seg = _segments(y_tob, N_SEG)
+
+    if extended:
+        # row/col normalize deterministically, then mean correlation
+        def _row_col_normalize(seg: np.ndarray) -> np.ndarray:
+            seg = seg - np.mean(seg, axis=2, keepdims=True)
+            seg = seg / (np.linalg.norm(seg, axis=2, keepdims=True) + _EPS)
+            seg = seg - np.mean(seg, axis=1, keepdims=True)
+            return seg / (np.linalg.norm(seg, axis=1, keepdims=True) + _EPS)
+
+        x_n = _row_col_normalize(x_seg)
+        y_n = _row_col_normalize(y_seg)
+        return float(np.sum(x_n * y_n / N_SEG) / x_n.shape[0])
+
+    norm_const = np.linalg.norm(x_seg, axis=2, keepdims=True) / (
+        np.linalg.norm(y_seg, axis=2, keepdims=True) + _EPS
+    )
+    y_prim = np.minimum(y_seg * norm_const, x_seg * (1 + np.power(10.0, -BETA / 20)))
+
+    y_prim = y_prim - np.mean(y_prim, axis=2, keepdims=True)
+    x_cent = x_seg - np.mean(x_seg, axis=2, keepdims=True)
+    y_prim = y_prim / (np.linalg.norm(y_prim, axis=2, keepdims=True) + _EPS)
+    x_cent = x_cent / (np.linalg.norm(x_cent, axis=2, keepdims=True) + _EPS)
+    correlations = np.sum(y_prim * x_cent, axis=2)  # (M, bands)
+    return float(np.mean(correlations))
+
+
+def short_time_objective_intelligibility(
+    preds: Union[Array, np.ndarray],
+    target: Union[Array, np.ndarray],
+    fs: int,
+    extended: bool = False,
+    keep_same_device: bool = False,
+) -> Array:
+    """STOI intelligibility score in ~[0, 1] (higher = more intelligible).
+
+    Args:
+        preds: degraded signal ``(..., time)``.
+        target: clean reference signal ``(..., time)``.
+        fs: sampling rate of the signals in Hz.
+        extended: compute extended STOI (language-independent variant).
+        keep_same_device: accepted for reference API parity (a no-op: the result
+            is always a host-backed jnp scalar array).
+    """
+    preds_np = np.asarray(preds, dtype=np.float64)
+    target_np = np.asarray(target, dtype=np.float64)
+    if preds_np.shape != target_np.shape:
+        raise RuntimeError("Predictions and targets are expected to have the same shape")
+    flat_p = preds_np.reshape(-1, preds_np.shape[-1])
+    flat_t = target_np.reshape(-1, target_np.shape[-1])
+    if _PYSTOI_AVAILABLE:
+        from pystoi import stoi as _pystoi
+
+        vals = [_pystoi(t, p, fs, extended=extended) for p, t in zip(flat_p, flat_t)]
+    else:
+        vals = [_stoi_numpy(t, p, fs, extended) for p, t in zip(flat_p, flat_t)]
+    out = np.array(vals, dtype=np.float32).reshape(preds_np.shape[:-1])
+    return jnp.asarray(out)

@@ -51,7 +51,7 @@ def _levenshtein_ids(a: np.ndarray, b: np.ndarray) -> int:
         return m
     if m == 0:
         return n
-    if m > n:  # iterate over the longer axis, vectorize the longer row
+    if n > m:  # loop over the shorter sequence, vectorize the longer row
         a, b, n, m = b, a, m, n
     offsets = np.arange(m + 1, dtype=np.int64)
     prev = offsets.copy()

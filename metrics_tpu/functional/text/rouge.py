@@ -19,6 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import Array
 
+from metrics_tpu.functional.text.helper import _token_ids
 from metrics_tpu.utils.imports import _NLTK_AVAILABLE
 
 ALLOWED_ROUGE_KEYS: Dict[str, Union[int, str]] = {
@@ -61,17 +62,13 @@ def _compute_metrics(hits_or_lcs: int, pred_len: int, target_len: int) -> Dict[s
     return {"precision": precision, "recall": recall, "fmeasure": 2 * precision * recall / (precision + recall)}
 
 
-def _ids(tokens: Sequence[str], vocab: Dict[str, int]) -> np.ndarray:
-    return np.fromiter((vocab.setdefault(t, len(vocab)) for t in tokens), dtype=np.int32, count=len(tokens))
-
-
 def _lcs_len(pred_tokens: Sequence[str], target_tokens: Sequence[str]) -> int:
     """LCS length via row-vectorized DP (see module docstring)."""
     vocab: Dict[str, int] = {}
-    a, b = _ids(pred_tokens, vocab), _ids(target_tokens, vocab)
+    a, b = _token_ids(pred_tokens, vocab), _token_ids(target_tokens, vocab)
     if len(a) == 0 or len(b) == 0:
         return 0
-    if len(b) > len(a):
+    if len(a) > len(b):  # loop over the shorter sequence, vectorize the longer row
         a, b = b, a
     prev = np.zeros(len(b) + 1, dtype=np.int32)
     for i in range(1, len(a) + 1):
@@ -115,10 +112,10 @@ def _backtracked_lcs_indices(pred_ids: np.ndarray, target_ids: np.ndarray) -> Li
 def _union_lcs(pred_tokens_list: Sequence[Sequence[str]], target_tokens: Sequence[str]) -> List[str]:
     """Union over pred sentences of LCS index sets against one target sentence."""
     vocab: Dict[str, int] = {}
-    tgt_ids = _ids(target_tokens, vocab)
+    tgt_ids = _token_ids(target_tokens, vocab)
     union: set = set()
     for pred_tokens in pred_tokens_list:
-        union.update(_backtracked_lcs_indices(_ids(pred_tokens, vocab), tgt_ids))
+        union.update(_backtracked_lcs_indices(_token_ids(pred_tokens, vocab), tgt_ids))
     return [target_tokens[i] for i in sorted(union)]
 
 

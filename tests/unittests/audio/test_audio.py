@@ -1,0 +1,273 @@
+"""Audio-domain tests: differential vs the reference (SNR/SDR/PIT are pure torch
+there and run offline) plus property tests for the from-scratch STOI port.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.audio import (
+    PermutationInvariantTraining,
+    ScaleInvariantSignalDistortionRatio,
+    ScaleInvariantSignalNoiseRatio,
+    ShortTimeObjectiveIntelligibility,
+    SignalDistortionRatio,
+    SignalNoiseRatio,
+)
+from metrics_tpu.functional.audio import (
+    permutation_invariant_training,
+    pit_permutate,
+    scale_invariant_signal_distortion_ratio,
+    scale_invariant_signal_noise_ratio,
+    short_time_objective_intelligibility,
+    signal_distortion_ratio,
+    signal_noise_ratio,
+)
+
+import sys, os
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+from helpers.reference import reference_available, import_reference_text  # noqa: E402
+
+if reference_available():
+    import_reference_text()  # ensures sys.path shim
+    import torch
+    import torchmetrics.functional.audio as ref_audio
+needs_ref = pytest.mark.skipif(not reference_available(), reason="reference tree not mounted")
+
+_rng = np.random.RandomState(42)
+PREDS = _rng.randn(4, 1000).astype(np.float32)
+TARGET = (PREDS + 0.3 * _rng.randn(4, 1000)).astype(np.float32)
+
+
+@needs_ref
+@pytest.mark.parametrize("zero_mean", [False, True])
+def test_snr_vs_reference(zero_mean):
+    m = np.asarray(signal_noise_ratio(jnp.asarray(PREDS), jnp.asarray(TARGET), zero_mean=zero_mean))
+    t = ref_audio.signal_noise_ratio(torch.tensor(PREDS), torch.tensor(TARGET), zero_mean=zero_mean).numpy()
+    assert np.allclose(m, t, atol=1e-4)
+
+
+@needs_ref
+@pytest.mark.parametrize("zero_mean", [False, True])
+def test_si_sdr_vs_reference(zero_mean):
+    m = np.asarray(
+        scale_invariant_signal_distortion_ratio(jnp.asarray(PREDS), jnp.asarray(TARGET), zero_mean=zero_mean)
+    )
+    t = ref_audio.scale_invariant_signal_distortion_ratio(
+        torch.tensor(PREDS), torch.tensor(TARGET), zero_mean=zero_mean
+    ).numpy()
+    assert np.allclose(m, t, atol=1e-4)
+
+
+@needs_ref
+def test_si_snr_vs_reference():
+    m = np.asarray(scale_invariant_signal_noise_ratio(jnp.asarray(PREDS), jnp.asarray(TARGET)))
+    t = ref_audio.scale_invariant_signal_noise_ratio(torch.tensor(PREDS), torch.tensor(TARGET)).numpy()
+    assert np.allclose(m, t, atol=1e-4)
+
+
+@needs_ref
+@pytest.mark.parametrize("filter_length", [32, 128])
+@pytest.mark.parametrize("zero_mean", [False, True])
+def test_sdr_vs_reference(filter_length, zero_mean):
+    rng = np.random.RandomState(7)
+    preds = rng.randn(2, 4000).astype(np.float32)
+    target = (0.7 * preds + 0.5 * rng.randn(2, 4000)).astype(np.float32)
+    m = np.asarray(
+        signal_distortion_ratio(jnp.asarray(preds), jnp.asarray(target), filter_length=filter_length, zero_mean=zero_mean)
+    )
+    t = ref_audio.signal_distortion_ratio(
+        torch.tensor(preds), torch.tensor(target), filter_length=filter_length, zero_mean=zero_mean
+    ).numpy()
+    # f32 Toeplitz solve vs the reference's f64: ~1e-3 dB agreement expected
+    assert np.allclose(m, t, atol=5e-3), (m, t)
+
+
+@needs_ref
+@pytest.mark.parametrize("spk_num", [2, 3])
+@pytest.mark.parametrize("eval_func", ["max", "min"])
+def test_pit_vs_reference(spk_num, eval_func):
+    rng = np.random.RandomState(11)
+    preds = rng.randn(4, spk_num, 500).astype(np.float32)
+    # construct permuted targets so the best permutation is non-trivial
+    perm = rng.permutation(spk_num)
+    target = preds[:, perm, :] + 0.2 * rng.randn(4, spk_num, 500).astype(np.float32)
+
+    m_val, m_perm = permutation_invariant_training(
+        jnp.asarray(preds), jnp.asarray(target), scale_invariant_signal_distortion_ratio, eval_func
+    )
+    t_val, t_perm = ref_audio.permutation_invariant_training(
+        torch.tensor(preds), torch.tensor(target), ref_audio.scale_invariant_signal_distortion_ratio, eval_func
+    )
+    assert np.allclose(np.asarray(m_val), t_val.numpy(), atol=1e-4)
+    assert np.array_equal(np.asarray(m_perm), t_perm.numpy())
+
+
+def test_pit_permutate_roundtrip():
+    rng = np.random.RandomState(0)
+    preds = jnp.asarray(rng.randn(3, 4, 16).astype(np.float32))
+    perm = jnp.asarray([[1, 0, 3, 2], [0, 1, 2, 3], [3, 2, 1, 0]], jnp.int32)
+    out = pit_permutate(preds, perm)
+    for b in range(3):
+        for s in range(4):
+            assert np.allclose(np.asarray(out[b, s]), np.asarray(preds[b, perm[b, s]]))
+
+
+def test_pit_finds_planted_permutation():
+    rng = np.random.RandomState(5)
+    clean = rng.randn(2, 3, 400).astype(np.float32)
+    perm = np.array([2, 0, 1])
+    target = clean[:, perm, :]
+    _, best_perm = permutation_invariant_training(
+        jnp.asarray(clean), jnp.asarray(target), scale_invariant_signal_distortion_ratio, "max"
+    )
+    # best_perm[b, t] = prediction index matching target t
+    assert np.array_equal(np.asarray(best_perm[0]), perm)
+    assert np.array_equal(np.asarray(best_perm[1]), perm)
+
+
+def test_pit_jittable():
+    fn = jax.jit(
+        lambda p, t: permutation_invariant_training(p, t, scale_invariant_signal_distortion_ratio, "max")[0]
+    )
+    rng = np.random.RandomState(1)
+    p = jnp.asarray(rng.randn(2, 2, 64).astype(np.float32))
+    t = jnp.asarray(rng.randn(2, 2, 64).astype(np.float32))
+    assert np.all(np.isfinite(np.asarray(fn(p, t))))
+
+
+def test_snr_identical_signals_is_large():
+    x = jnp.asarray(_rng.randn(1000).astype(np.float32))
+    assert float(signal_noise_ratio(x, x)) > 90  # bounded by f32 eps: ~99 dB
+
+
+def test_sdr_gradient():
+    def loss(p, t):
+        return -jnp.mean(scale_invariant_signal_distortion_ratio(p, t))
+
+    g = jax.grad(loss)(jnp.asarray(PREDS), jnp.asarray(TARGET))
+    assert np.all(np.isfinite(np.asarray(g)))
+
+
+# ---------------------------------------------------------------- STOI port
+
+def _speechlike(n, rng, fs=10000):
+    # amplitude-modulated multi-tone + noise, non-silent throughout
+    t = np.arange(n) / fs
+    env = 0.6 + 0.4 * np.sin(2 * np.pi * 3 * t)
+    sig = sum(np.sin(2 * np.pi * f * t + rng.rand() * 6) for f in (220, 450, 900, 1800, 3000))
+    return (env * sig + 0.05 * rng.randn(n)).astype(np.float64)
+
+
+def test_stoi_perfect_and_degraded():
+    rng = np.random.RandomState(0)
+    clean = _speechlike(20000, rng)
+    assert float(short_time_objective_intelligibility(clean, clean, 10000)) > 0.999
+    light = clean + 0.2 * rng.randn(len(clean))
+    heavy = clean + 5.0 * rng.randn(len(clean))
+    s_light = float(short_time_objective_intelligibility(light, clean, 10000))
+    s_heavy = float(short_time_objective_intelligibility(heavy, clean, 10000))
+    assert s_light > s_heavy, (s_light, s_heavy)
+    assert s_heavy < 0.6
+
+
+def test_stoi_extended_mode():
+    rng = np.random.RandomState(1)
+    clean = _speechlike(20000, rng)
+    noisy = clean + 0.5 * rng.randn(len(clean))
+    s = float(short_time_objective_intelligibility(noisy, clean, 10000, extended=True))
+    assert -1.0 <= s <= 1.0
+
+
+def test_stoi_resampling_path():
+    rng = np.random.RandomState(2)
+    clean = _speechlike(32000, rng, fs=16000)
+    noisy = clean + 0.3 * rng.randn(len(clean))
+    s = float(short_time_objective_intelligibility(noisy, clean, 16000))
+    assert 0.0 < s <= 1.0
+
+
+def test_stoi_batched():
+    rng = np.random.RandomState(3)
+    clean = np.stack([_speechlike(15000, rng) for _ in range(3)])
+    noisy = clean + 0.3 * rng.randn(*clean.shape)
+    out = short_time_objective_intelligibility(noisy, clean, 10000)
+    assert out.shape == (3,)
+
+
+# ---------------------------------------------------------------- classes
+
+@pytest.mark.parametrize(
+    "cls, fn, kwargs",
+    [
+        (SignalNoiseRatio, signal_noise_ratio, {}),
+        (ScaleInvariantSignalNoiseRatio, scale_invariant_signal_noise_ratio, {}),
+        (ScaleInvariantSignalDistortionRatio, scale_invariant_signal_distortion_ratio, {}),
+    ],
+)
+def test_audio_class_accumulation(cls, fn, kwargs):
+    metric = cls()
+    for i in range(4):
+        metric.update(jnp.asarray(PREDS[i]), jnp.asarray(TARGET[i]))
+    expected = float(jnp.mean(fn(jnp.asarray(PREDS), jnp.asarray(TARGET), **kwargs)))
+    assert abs(float(metric.compute()) - expected) < 1e-4
+
+
+def test_sdr_class_accumulation():
+    metric = SignalDistortionRatio(filter_length=64)
+    rng = np.random.RandomState(9)
+    preds = rng.randn(2, 2000).astype(np.float32)
+    target = (0.8 * preds + 0.4 * rng.randn(2, 2000)).astype(np.float32)
+    metric.update(jnp.asarray(preds), jnp.asarray(target))
+    expected = float(jnp.mean(signal_distortion_ratio(jnp.asarray(preds), jnp.asarray(target), filter_length=64)))
+    assert abs(float(metric.compute()) - expected) < 1e-4
+
+
+def test_pit_class_accumulation():
+    metric = PermutationInvariantTraining(scale_invariant_signal_distortion_ratio, "max")
+    rng = np.random.RandomState(4)
+    preds = jnp.asarray(rng.randn(3, 2, 200).astype(np.float32))
+    target = jnp.asarray(rng.randn(3, 2, 200).astype(np.float32))
+    metric.update(preds, target)
+    expected = float(
+        jnp.mean(permutation_invariant_training(preds, target, scale_invariant_signal_distortion_ratio, "max")[0])
+    )
+    assert abs(float(metric.compute()) - expected) < 1e-5
+
+
+def test_stoi_class_accumulation():
+    rng = np.random.RandomState(6)
+    clean = np.stack([_speechlike(15000, rng) for _ in range(2)])
+    noisy = clean + 0.3 * rng.randn(*clean.shape)
+    metric = ShortTimeObjectiveIntelligibility(10000)
+    metric.update(noisy, clean)
+    expected = float(jnp.mean(short_time_objective_intelligibility(noisy, clean, 10000)))
+    assert abs(float(metric.compute()) - expected) < 1e-5
+
+
+def test_sharded_snr_matches_single_device():
+    from functools import partial
+
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    from metrics_tpu.parallel import collective, make_data_mesh
+
+    mesh = make_data_mesh(8)
+    metric = SignalNoiseRatio()
+    preds = jnp.asarray(_rng.randn(16, 250).astype(np.float32))
+    target = jnp.asarray((np.asarray(preds) + 0.3 * _rng.randn(16, 250)).astype(np.float32))
+
+    @partial(shard_map, mesh=mesh, in_specs=(P(), P("data"), P("data")), out_specs=P())
+    def step(state, p, t):
+        state = collective.mark_varying(state, "data")
+        state = metric.local_update(state, p, t)
+        return metric.sync_state(state, axis_name="data")
+
+    synced = jax.jit(step)(metric.init_state(), preds, target)
+    sharded = float(metric.compute_from(synced))
+    single = SignalNoiseRatio()
+    single.update(preds, target)
+    assert abs(sharded - float(single.compute())) < 1e-4

@@ -24,6 +24,7 @@ import sys, os
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 from helpers.reference import import_reference_text, reference_available  # noqa: E402
 
+import_reference_text()  # sets up sys.path for `torchmetrics` imports inside tests
 needs_ref = pytest.mark.skipif(not reference_available(), reason="reference tree not mounted")
 
 _rng = np.random.RandomState(0)
