@@ -39,9 +39,11 @@ class PermutationInvariantTraining(Metric):
                 "compute_on_cpu",
                 "dist_sync_on_step",
                 "process_group",
+                "sync_axis",
                 "dist_sync_fn",
                 "distributed_available_fn",
                 "sync_on_compute",
+                "cat_capacity",
             )
             if k in kwargs
         }

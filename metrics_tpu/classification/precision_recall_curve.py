@@ -68,8 +68,8 @@ class BinaryPrecisionRecallCurve(Metric):
         thresholds = _adjust_threshold_arg(thresholds)
         if thresholds is None:
             self.thresholds = None
-            self.add_state("preds", [], dist_reduce_fx="cat")
-            self.add_state("target", [], dist_reduce_fx="cat")
+            self.add_state("preds", [], dist_reduce_fx="cat", cat_dtype=jnp.float32)
+            self.add_state("target", [], dist_reduce_fx="cat", cat_dtype=jnp.int32)
         else:
             self.register_threshold_state(thresholds, (len(thresholds), 2, 2))
 
@@ -118,8 +118,8 @@ class MulticlassPrecisionRecallCurve(Metric):
         thresholds = _adjust_threshold_arg(thresholds)
         if thresholds is None:
             self.thresholds = None
-            self.add_state("preds", [], dist_reduce_fx="cat")
-            self.add_state("target", [], dist_reduce_fx="cat")
+            self.add_state("preds", [], dist_reduce_fx="cat", cat_item_shape=(num_classes,), cat_dtype=jnp.float32)
+            self.add_state("target", [], dist_reduce_fx="cat", cat_dtype=jnp.int32)
         else:
             self.thresholds = thresholds
             self.add_state(
@@ -169,8 +169,8 @@ class MultilabelPrecisionRecallCurve(Metric):
         thresholds = _adjust_threshold_arg(thresholds)
         if thresholds is None:
             self.thresholds = None
-            self.add_state("preds", [], dist_reduce_fx="cat")
-            self.add_state("target", [], dist_reduce_fx="cat")
+            self.add_state("preds", [], dist_reduce_fx="cat", cat_item_shape=(num_labels,), cat_dtype=jnp.float32)
+            self.add_state("target", [], dist_reduce_fx="cat", cat_item_shape=(num_labels,), cat_dtype=jnp.int32)
         else:
             self.thresholds = thresholds
             self.add_state(

@@ -38,6 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import Array
 
+from metrics_tpu.core.state import CatBuffer, cat_merge
 from metrics_tpu.parallel import collective
 from metrics_tpu.utils.data import (
     _flatten,
@@ -120,6 +121,14 @@ class Metric(ABC):
                 f"Expected keyword argument `sync_on_compute` to be a `bool` but got {self.sync_on_compute}"
             )
 
+        # fixed-capacity cat-state mode (SURVEY.md §7): when set, list states become
+        # static-shape CatBuffers so cat metrics run under jit/scan/shard_map
+        self.cat_capacity = kwargs.pop("cat_capacity", None)
+        if self.cat_capacity is not None and (not isinstance(self.cat_capacity, int) or self.cat_capacity < 1):
+            raise ValueError(
+                f"Expected keyword argument `cat_capacity` to be a positive int or None but got {self.cat_capacity}"
+            )
+
         if kwargs:
             kwargs_ = [f"`{a}`" for a in sorted(kwargs)]
             raise ValueError(f"Unexpected keyword arguments: {', '.join(kwargs_)}")
@@ -150,6 +159,9 @@ class Metric(ABC):
         default: Union[Array, list, float, int],
         dist_reduce_fx: collective.ReduceFx = None,
         persistent: bool = False,
+        cat_item_shape: Sequence[int] = (),
+        cat_dtype: Any = None,
+        cat_fill_value: Union[int, float] = 0,
     ) -> None:
         """Register a metric state (reference: metric.py:175-243).
 
@@ -157,6 +169,11 @@ class Metric(ABC):
         ``dist_reduce_fx``) or an empty list (cat-state). ``dist_reduce_fx`` is one of
         ``"sum" | "mean" | "max" | "min" | "cat" | None`` or a custom callable applied
         to the ``(world, ...)`` stacked gather.
+
+        ``cat_item_shape`` / ``cat_dtype`` / ``cat_fill_value`` describe one appended
+        row of a list state; they are only used when the metric was constructed with
+        ``cat_capacity=N``, in which case the state becomes a static-shape
+        :class:`~metrics_tpu.core.state.CatBuffer` (jit/scan/shard_map-safe).
         """
         if not name.isidentifier():
             raise ValueError(f"Argument `name` must be a valid python identifier, got {name!r}")
@@ -174,8 +191,16 @@ class Metric(ABC):
         else:
             reduce_kind = dist_reduce_fx  # None or callable
 
-        setattr(self, name, [] if is_list else default)
-        self._defaults[name] = [] if is_list else default
+        if is_list and self.cat_capacity is not None and reduce_kind == "cat":
+            default = CatBuffer.create(
+                self.cat_capacity, tuple(cat_item_shape), cat_dtype or jnp.float32, cat_fill_value
+            )
+
+        if isinstance(default, CatBuffer):
+            setattr(self, name, default.copy())
+        else:
+            setattr(self, name, [] if is_list else default)
+        self._defaults[name] = [] if is_list and not isinstance(default, CatBuffer) else default
         self._persistent[name] = persistent
         self._reductions[name] = reduce_kind
 
@@ -185,11 +210,24 @@ class Metric(ABC):
         return {attr: getattr(self, attr) for attr in self._defaults}
 
     def state_pytree(self) -> Dict[str, Any]:
-        return {k: (list(v) if isinstance(v, list) else v) for k, v in self.metric_state.items()}
+        out: Dict[str, Any] = {}
+        for k, v in self.metric_state.items():
+            if isinstance(v, CatBuffer):
+                out[k] = v.copy()
+            elif isinstance(v, list):
+                out[k] = list(v)
+            else:
+                out[k] = v
+        return out
 
     def _load_state(self, state: Dict[str, Any]) -> None:
         for name, value in state.items():
-            setattr(self, name, list(value) if isinstance(value, (list, tuple)) else value)
+            if isinstance(value, CatBuffer):
+                # copy: subclass updates rebind buffer fields in place; the caller's
+                # state object must stay untouched (pure-functional contract)
+                setattr(self, name, value.copy())
+            else:
+                setattr(self, name, list(value) if isinstance(value, (list, tuple)) else value)
 
     # ------------------------------------------------- pure-functional tier
 
@@ -197,7 +235,10 @@ class Metric(ABC):
         """Default state pytree — pure, no mutation of ``self``."""
         out: Dict[str, Any] = {}
         for name, default in self._defaults.items():
-            out[name] = [] if isinstance(default, list) else jnp.asarray(default)
+            if isinstance(default, CatBuffer):
+                out[name] = default.copy()
+            else:
+                out[name] = [] if isinstance(default, list) else jnp.asarray(default)
         return out
 
     def local_update(self, state: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:
@@ -401,7 +442,10 @@ class Metric(ABC):
             elif reduce_fn == "min":
                 reduced = jnp.minimum(global_state, local_state)
             elif reduce_fn == "cat":
-                reduced = list(global_state) + list(local_state)
+                if isinstance(global_state, CatBuffer):
+                    reduced = cat_merge(global_state, local_state)
+                else:
+                    reduced = list(global_state) + list(local_state)
             elif reduce_fn is None and isinstance(global_state, (jnp.ndarray, np.ndarray)):
                 reduced = jnp.stack([jnp.asarray(global_state), jnp.asarray(local_state)])
             elif reduce_fn is None and isinstance(global_state, list):
@@ -426,7 +470,11 @@ class Metric(ABC):
         input_dict = {attr: getattr(self, attr) for attr in self._reductions}
 
         for attr, reduction_fn in self._reductions.items():
-            if reduction_fn == "cat" and isinstance(input_dict[attr], list) and len(input_dict[attr]) > 1:
+            if isinstance(input_dict[attr], CatBuffer):
+                # eager path gathers ragged values like the reference; the synced
+                # view is a dense array (unsync restores the live buffer)
+                input_dict[attr] = [input_dict[attr].values()]
+            elif reduction_fn == "cat" and isinstance(input_dict[attr], list) and len(input_dict[attr]) > 1:
                 input_dict[attr] = [dim_zero_cat(input_dict[attr])]
 
         output_dict = apply_to_collection(
@@ -527,7 +575,9 @@ class Metric(ABC):
         self._forward_cache = None
         self._computed = None
         for attr, default in self._defaults.items():
-            if isinstance(default, list):
+            if isinstance(default, CatBuffer):
+                setattr(self, attr, default.copy())
+            elif isinstance(default, list):
                 setattr(self, attr, [])
             else:
                 setattr(self, attr, jnp.asarray(default))
@@ -595,7 +645,9 @@ class Metric(ABC):
         """Move states to a jax device (reference ``Metric._apply``, metric.py:706)."""
         for attr in self._defaults:
             val = getattr(self, attr)
-            if isinstance(val, jnp.ndarray):
+            if isinstance(val, CatBuffer):
+                setattr(self, attr, CatBuffer(jax.device_put(val.data, device), jax.device_put(val.count, device)))
+            elif isinstance(val, jnp.ndarray):
                 setattr(self, attr, jax.device_put(val, device))
             elif isinstance(val, list):
                 setattr(self, attr, [jax.device_put(jnp.asarray(v), device) for v in val])
@@ -610,7 +662,10 @@ class Metric(ABC):
         transfers)."""
         for attr in self._defaults:
             val = getattr(self, attr)
-            if isinstance(val, jnp.ndarray) and jnp.issubdtype(val.dtype, jnp.floating):
+            if isinstance(val, CatBuffer):
+                if jnp.issubdtype(val.data.dtype, jnp.floating):
+                    setattr(self, attr, CatBuffer(val.data.astype(dst_type), val.count))
+            elif isinstance(val, jnp.ndarray) and jnp.issubdtype(val.dtype, jnp.floating):
                 setattr(self, attr, val.astype(dst_type))
             elif isinstance(val, list):
                 setattr(
@@ -639,7 +694,9 @@ class Metric(ABC):
             current_val = getattr(self, key)
             if self._is_synced and self._cache is not None:
                 current_val = self._cache[key]
-            if isinstance(current_val, list):
+            if isinstance(current_val, CatBuffer):
+                out[prefix + key] = {"data": np.asarray(current_val.data), "count": np.asarray(current_val.count)}
+            elif isinstance(current_val, list):
                 out[prefix + key] = [np.asarray(v) for v in current_val]
             else:
                 out[prefix + key] = np.asarray(current_val)
@@ -651,7 +708,9 @@ class Metric(ABC):
             name = prefix + key
             if name in state_dict:
                 value = state_dict[name]
-                if isinstance(value, list):
+                if isinstance(value, dict) and set(value) == {"data", "count"}:
+                    setattr(self, key, CatBuffer(jnp.asarray(value["data"]), jnp.asarray(value["count"])))
+                elif isinstance(value, list):
                     setattr(self, key, [jnp.asarray(v) for v in value])
                 else:
                     setattr(self, key, jnp.asarray(value))
@@ -676,7 +735,9 @@ class Metric(ABC):
         hash_vals = [self.__class__.__name__]
         for key in self._defaults:
             val = getattr(self, key)
-            if isinstance(val, list):
+            if isinstance(val, CatBuffer):
+                hash_vals.append(np.asarray(val.values()).tobytes())
+            elif isinstance(val, list):
                 hash_vals.extend(np.asarray(v).tobytes() for v in val)
             else:
                 hash_vals.append(np.asarray(val).tobytes())

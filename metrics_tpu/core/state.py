@@ -1,0 +1,151 @@
+"""Fixed-capacity cat-state buffers — static-shape ragged state for XLA.
+
+The reference keeps ``cat``-reduced states as unbounded Python lists of tensors and
+ragged-gathers them at sync time (pad to per-dim max, all_gather, trim —
+``utilities/distributed.py:136-148``). XLA requires static shapes, so the
+TPU-native design (SURVEY.md §7) replaces the list with a **preallocated
+``(capacity, *item_shape)`` buffer plus a valid count**:
+
+- ``append`` is a ``dynamic_update_slice`` at the current count — jit/scan/
+  shard_map-safe, no host sync, no reallocation (donation-friendly);
+- cross-device sync is one tiled ``all_gather`` of the buffer and one of the
+  counts, followed by a stable compaction sort that front-packs the valid rows —
+  the static-shape equivalent of the reference's pad/gather/trim;
+- ``values()`` trims to the concrete count for eager (host-side) computes.
+
+Capacity is the knob replacing "unbounded": it must cover the samples one device
+accumulates between resets. Overflow does not crash under jit (XLA cannot raise on
+data): the true count keeps growing past capacity, the newest ``append`` overwrites
+the tail rows, and the eager ``values()`` path warns.
+"""
+from typing import Any, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.utils.prints import rank_zero_warn
+
+
+@jax.tree_util.register_pytree_node_class
+class CatBuffer:
+    """Fixed-capacity append buffer: ``data (capacity, *item)`` + ``count`` scalar."""
+
+    def __init__(self, data: jnp.ndarray, count: jnp.ndarray) -> None:
+        self.data = data
+        self.count = count
+
+    @classmethod
+    def create(
+        cls,
+        capacity: int,
+        item_shape: Sequence[int] = (),
+        dtype: Any = jnp.float32,
+        fill_value: Union[int, float] = 0,
+    ) -> "CatBuffer":
+        data = jnp.full((capacity, *item_shape), fill_value, dtype=dtype)
+        return cls(data, jnp.zeros((), jnp.int32))
+
+    # -------------------------------------------------------------- pytree
+    def tree_flatten(self):
+        return (self.data, self.count), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    # ----------------------------------------------------------- accessors
+    @property
+    def capacity(self) -> int:
+        return self.data.shape[0]
+
+    def valid_count(self) -> jnp.ndarray:
+        return jnp.minimum(self.count, self.capacity)
+
+    def mask(self) -> jnp.ndarray:
+        """Boolean validity mask over the capacity axis (jit-safe)."""
+        return jnp.arange(self.capacity) < self.valid_count()
+
+    def values(self) -> jnp.ndarray:
+        """Trim to the concrete count — EAGER ONLY (dynamic output shape)."""
+        count = int(self.count)
+        if count > self.capacity:
+            rank_zero_warn(
+                f"CatBuffer overflow: {count} elements were appended into capacity {self.capacity}; "
+                "the newest appends overwrote the tail. Increase `cat_capacity`.",
+                RuntimeWarning,
+            )
+        return self.data[: min(count, self.capacity)]
+
+    def copy(self) -> "CatBuffer":
+        """New holder over the same (immutable) arrays — append rebinds, never writes."""
+        return CatBuffer(self.data, self.count)
+
+    def __len__(self) -> int:  # eager only
+        return int(self.valid_count())
+
+    def __repr__(self) -> str:
+        return f"CatBuffer(capacity={self.capacity}, item={self.data.shape[1:]}, dtype={self.data.dtype})"
+
+    # ------------------------------------------------------------ mutation
+    def append(self, values: jnp.ndarray) -> "CatBuffer":
+        """Append rows in place (rebinding fields) — jit-safe, returns self."""
+        values = jnp.asarray(values)
+        if values.ndim == self.data.ndim - 1:
+            values = values[None]
+        values = values.astype(self.data.dtype)
+        n_true = values.shape[0]  # count tracks the TRUE total so overflow is detectable
+        if n_true > self.capacity:
+            values = values[: self.capacity]
+        n = values.shape[0]
+        start = jnp.clip(self.count, 0, self.capacity - n)
+        self.data = jax.lax.dynamic_update_slice_in_dim(self.data, values, start, axis=0)
+        self.count = self.count + n_true
+        return self
+
+    def extend(self, value_list) -> "CatBuffer":
+        for v in value_list:
+            self.append(v)
+        return self
+
+
+def cat_sync(buf: CatBuffer, axis_name) -> CatBuffer:
+    """All-gather a CatBuffer across a mesh axis and front-pack the valid rows.
+
+    Must run inside a mapped context binding ``axis_name``. The result has
+    capacity ``world * capacity`` and count = sum of per-device valid counts.
+    """
+    from metrics_tpu.parallel.collective import replicate_gathered
+
+    data = replicate_gathered(
+        jax.lax.all_gather(buf.data, axis_name, axis=0, tiled=True), axis_name
+    )  # (W*C, ...)
+    counts = replicate_gathered(
+        jax.lax.all_gather(jnp.atleast_1d(buf.valid_count()), axis_name, axis=0, tiled=True), axis_name
+    )  # (W,)
+    capacity = buf.capacity
+    per_device_mask = jnp.arange(capacity)[None, :] < counts[:, None]
+    flat_mask = per_device_mask.reshape(-1)
+    # stable sort: valid rows first, preserving per-device order
+    order = jnp.argsort(~flat_mask, stable=True)
+    return CatBuffer(jnp.take(data, order, axis=0), counts.sum().astype(jnp.int32))
+
+
+def cat_merge(global_buf: CatBuffer, local_buf: CatBuffer) -> CatBuffer:
+    """Eager merge for forward's reduce-state mode: append local's rows to global."""
+    merged = global_buf.copy()
+    merged.append(local_buf.values())
+    return merged
+
+
+def is_cat_buffer(x: Any) -> bool:
+    return isinstance(x, CatBuffer)
+
+
+def cat_values(x: Union[CatBuffer, list, jnp.ndarray, np.ndarray]) -> jnp.ndarray:
+    """Dense concatenated view of any cat-state representation (eager for buffers)."""
+    if isinstance(x, CatBuffer):
+        return x.values()
+    if isinstance(x, (list, tuple)):
+        return jnp.concatenate([jnp.atleast_1d(jnp.asarray(v)) for v in x], axis=0)
+    return jnp.asarray(x)

@@ -1,13 +1,12 @@
 """Permutation-invariant training functionals (reference: functional/audio/pit.py:29-200).
 
-TPU redesign: the exhaustive search is fully vectorized — the pairwise metric
-matrix is built with two stacked ``vmap``-style gathers instead of a Python
-``spk×spk`` loop when the metric function broadcasts, and permutation scoring is
-one gather + mean over a static ``(spk!, spk)`` permutation table, so the whole
-path jits. The scipy linear-sum-assignment route (host-side) kicks in for
-``spk_num > 8`` where ``spk!`` blows up (the reference switches at 3; exhaustive
-up to 8 ≈ 40k permutations is a trivial on-device gather and avoids the host
-round-trip).
+TPU redesign: permutation scoring is fully vectorized — one gather + mean over a
+static ``(spk!, spk)`` permutation table instead of the reference's per-permutation
+loop, so the whole path jits (the pairwise metric matrix itself is still built with
+``spk×spk`` traced calls of the user metric, which XLA fuses). The scipy
+linear-sum-assignment route (host-side) kicks in for ``spk_num > 8`` where ``spk!``
+blows up (the reference switches at 3; exhaustive up to 8 ≈ 40k permutations is a
+trivial on-device gather and avoids the host round-trip).
 """
 from itertools import permutations
 from typing import Any, Callable, Tuple

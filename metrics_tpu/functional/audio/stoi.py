@@ -105,6 +105,12 @@ def _stoi_numpy(clean: np.ndarray, degraded: np.ndarray, fs: int, extended: bool
         clean = resample_poly(clean, FS, fs)
         degraded = resample_poly(degraded, FS, fs)
 
+    if len(clean) <= N_FRAME:
+        rank_zero_warn(
+            f"Signal too short for STOI ({len(clean)} <= {N_FRAME} samples at 10 kHz); returning 1e-5.",
+            RuntimeWarning,
+        )
+        return 1e-5
     clean, degraded = _remove_silent_frames(clean, degraded, DYN_RANGE, N_FRAME, N_FRAME // 2)
     if len(clean) < N_FRAME + 1:
         # pystoi-compatible degenerate-input behavior: warn + sentinel, not crash

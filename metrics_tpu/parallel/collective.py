@@ -53,6 +53,18 @@ def mark_varying(x: Any, axis_name: AxisName) -> Any:
     return jax.tree_util.tree_map(mark, x)
 
 
+def replicate_gathered(x: jnp.ndarray, axis_name: AxisName) -> jnp.ndarray:
+    """Mark an all_gather result as device-invariant for shard_map's vma checker.
+
+    ``all_gather`` output is typed "varying" even though every device holds the
+    same values; a ``pmax`` over the axis is a semantic no-op on identical values
+    and yields the invariant type the caller's ``out_specs=P()`` requires.
+    """
+    if x.dtype == jnp.bool_:
+        return jax.lax.pmax(x.astype(jnp.int32), axis_name).astype(jnp.bool_)
+    return jax.lax.pmax(x, axis_name)
+
+
 def sync_array(x: jnp.ndarray, reduce_fx: ReduceFx, axis_name: AxisName) -> jnp.ndarray:
     """Sync a single array state across ``axis_name`` according to its reduction kind.
 
@@ -68,10 +80,10 @@ def sync_array(x: jnp.ndarray, reduce_fx: ReduceFx, axis_name: AxisName) -> jnp.
         return jax.lax.pmin(x, axis_name)
     if reduce_fx == "cat":
         x = jnp.atleast_1d(x)
-        return jax.lax.all_gather(x, axis_name, axis=0, tiled=True)
+        return replicate_gathered(jax.lax.all_gather(x, axis_name, axis=0, tiled=True), axis_name)
     # None or custom callable: gather the per-device states stacked on a new leading
     # axis (= reference's `torch.stack(gathered)`), then apply the callable if given.
-    stacked = jax.lax.all_gather(jnp.asarray(x), axis_name, axis=0, tiled=False)
+    stacked = replicate_gathered(jax.lax.all_gather(jnp.asarray(x), axis_name, axis=0, tiled=False), axis_name)
     if callable(reduce_fx):
         return reduce_fx(stacked)
     return stacked
@@ -89,10 +101,16 @@ def sync_pytree(
     """
     if axis_name is None:
         return state
+    from metrics_tpu.core.state import CatBuffer, cat_sync
+
     out = {}
     for name, value in state.items():
         fx = reductions.get(name, "sum")
-        if isinstance(value, (list, tuple)):
+        if isinstance(value, CatBuffer):
+            # static-shape ragged gather: tiled all_gather + front-pack (core/state.py)
+            synced = cat_sync(value, axis_name)
+            out[name] = CatBuffer(fx(synced.data), synced.count) if callable(fx) else synced
+        elif isinstance(value, (list, tuple)):
             if len(value) == 0:
                 out[name] = value if fx != "cat" else []
                 continue

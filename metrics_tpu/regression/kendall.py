@@ -48,8 +48,9 @@ class KendallRankCorrCoef(Metric):
         self.t_test = t_test
         self.num_outputs = num_outputs
 
-        self.add_state("preds", [], dist_reduce_fx="cat")
-        self.add_state("target", [], dist_reduce_fx="cat")
+        item = () if num_outputs == 1 else (num_outputs,)
+        self.add_state("preds", [], dist_reduce_fx="cat", cat_item_shape=item)
+        self.add_state("target", [], dist_reduce_fx="cat", cat_item_shape=item)
 
     def update(self, preds: Array, target: Array) -> None:
         self.preds.append(preds)

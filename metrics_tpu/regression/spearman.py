@@ -30,8 +30,9 @@ class SpearmanCorrCoef(Metric):
         if not isinstance(num_outputs, int) or num_outputs < 1:
             raise ValueError("Expected argument `num_outputs` to be an int larger than 0, but got {num_outputs}")
         self.num_outputs = num_outputs
-        self.add_state("preds", default=[], dist_reduce_fx="cat")
-        self.add_state("target", default=[], dist_reduce_fx="cat")
+        item = () if num_outputs == 1 else (num_outputs,)
+        self.add_state("preds", default=[], dist_reduce_fx="cat", cat_item_shape=item)
+        self.add_state("target", default=[], dist_reduce_fx="cat", cat_item_shape=item)
 
     def update(self, preds: Array, target: Array) -> None:
         preds, target = _spearman_corrcoef_update(preds, target, self.num_outputs)

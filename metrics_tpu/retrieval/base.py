@@ -42,9 +42,11 @@ class RetrievalMetric(Metric, ABC):
         self,
         empty_target_action: str = "neg",
         ignore_index: Optional[int] = None,
+        validate_args: bool = True,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
+        self.validate_args = validate_args
         empty_target_action_options = ("error", "skip", "neg", "pos")
         if empty_target_action not in empty_target_action_options:
             raise ValueError(f"Argument `empty_target_action` received a wrong value `{empty_target_action}`.")
@@ -53,15 +55,27 @@ class RetrievalMetric(Metric, ABC):
             raise ValueError("Argument `ignore_index` must be an integer or None.")
         self.ignore_index = ignore_index
 
-        self.add_state("indexes", default=[], dist_reduce_fx="cat")
-        self.add_state("preds", default=[], dist_reduce_fx="cat")
-        self.add_state("target", default=[], dist_reduce_fx="cat")
+        # unused buffer rows (cat_capacity mode) carry index -1: the segment kernel
+        # treats them as an invalid query group, so sharded compute needs no trim
+        self.add_state("indexes", default=[], dist_reduce_fx="cat", cat_dtype=jnp.int32, cat_fill_value=-1)
+        self.add_state("preds", default=[], dist_reduce_fx="cat", cat_dtype=jnp.float32)
+        self.add_state(
+            "target",
+            default=[],
+            dist_reduce_fx="cat",
+            cat_dtype=jnp.float32 if self.allow_non_binary_target else jnp.int32,
+        )
 
     def update(self, preds: Array, target: Array, indexes: Array) -> None:
         if indexes is None:
             raise ValueError("Argument `indexes` cannot be None")
         indexes, preds, target = _check_retrieval_inputs(
-            indexes, preds, target, allow_non_binary_target=self.allow_non_binary_target, ignore_index=self.ignore_index
+            indexes,
+            preds,
+            target,
+            allow_non_binary_target=self.allow_non_binary_target,
+            ignore_index=self.ignore_index,
+            validate_args=self.validate_args,
         )
         self.indexes.append(indexes)
         self.preds.append(preds)

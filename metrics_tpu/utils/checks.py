@@ -7,6 +7,7 @@ the metric for fully-jitted hot paths (mirroring the reference's contract).
 """
 from typing import Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import Array
@@ -55,10 +56,14 @@ def _check_retrieval_inputs(
     target: Array,
     allow_non_binary_target: bool = False,
     ignore_index: Optional[int] = None,
+    validate_args: bool = True,
 ) -> Tuple[Array, Array, Array]:
     """Validate (indexes, preds, target) for retrieval metrics.
 
-    Reference: utilities/checks.py:535.
+    Reference: utilities/checks.py:535. With ``validate_args=False`` the
+    data-dependent binary-values check is skipped (jit/shard_map-safe formatting
+    only); ``ignore_index`` filtering is inherently data-dependent-shape and is
+    rejected under tracing with a clear error.
     """
     if indexes.shape != preds.shape or preds.shape != target.shape:
         raise ValueError("`indexes`, `preds` and `target` must be of the same shape")
@@ -67,24 +72,34 @@ def _check_retrieval_inputs(
     if not jnp.issubdtype(indexes.dtype, jnp.integer):
         raise ValueError("`indexes` must be a tensor of integers")
     if ignore_index is not None:
+        if isinstance(target, jax.core.Tracer):
+            raise ValueError(
+                "`ignore_index` filtering changes the data shape and cannot run under jit/shard_map; "
+                "filter on the host before updating, or leave `ignore_index=None`."
+            )
         valid = np.asarray(target) != ignore_index
         indexes = jnp.asarray(np.asarray(indexes)[valid])
         preds = jnp.asarray(np.asarray(preds)[valid])
         target = jnp.asarray(np.asarray(target)[valid])
     preds, target = _check_retrieval_target_and_prediction_types(
-        preds, target, allow_non_binary_target=allow_non_binary_target
+        preds, target, allow_non_binary_target=allow_non_binary_target, validate_args=validate_args
     )
     return indexes.ravel().astype(jnp.int32), preds, target
 
 
 def _check_retrieval_target_and_prediction_types(
-    preds: Array, target: Array, allow_non_binary_target: bool = False
+    preds: Array, target: Array, allow_non_binary_target: bool = False, validate_args: bool = True
 ) -> Tuple[Array, Array]:
     if not (jnp.issubdtype(target.dtype, jnp.bool_) or jnp.issubdtype(target.dtype, jnp.integer)) and not (
         allow_non_binary_target and jnp.issubdtype(target.dtype, jnp.floating)
     ):
         raise ValueError("`target` must be a tensor of booleans or integers")
-    if not allow_non_binary_target and bool(jnp.any((target > 1) | (target < 0))):
+    if (
+        validate_args
+        and not allow_non_binary_target
+        and not isinstance(target, jax.core.Tracer)
+        and bool(jnp.any((target > 1) | (target < 0)))
+    ):
         raise ValueError("`target` must contain `binary` values")
     target = target.astype(jnp.float32) if jnp.issubdtype(target.dtype, jnp.floating) else target.astype(jnp.int32)
     return preds.ravel().astype(jnp.float32), target.ravel()

@@ -37,7 +37,14 @@ def _count_dtype():
 
 
 def dim_zero_cat(x: Union[Array, List[Array]]) -> Array:
-    """Concatenate list of arrays along dim 0 (reference: utilities/data.py:28)."""
+    """Concatenate list of arrays along dim 0 (reference: utilities/data.py:28).
+
+    CatBuffer states trim to their concrete valid count (eager only).
+    """
+    from metrics_tpu.core.state import CatBuffer
+
+    if isinstance(x, CatBuffer):
+        return x.values()
     if isinstance(x, (jnp.ndarray, np.ndarray)) and not isinstance(x, (list, tuple)):
         return jnp.asarray(x)
     x = [jnp.atleast_1d(jnp.asarray(v)) for v in x]

@@ -1,0 +1,239 @@
+"""Fixed-capacity cat-state buffer tests (core/state.py).
+
+Covers the VERDICT r1 item 4 contract: cat metrics run under jit/scan/shard_map
+with static shapes, sync via tiled all_gather + front-pack, and agree with the
+eager single-device path. Reference behavior being replaced: ragged gather at
+utilities/distributed.py:136-148.
+"""
+import pickle
+import warnings
+from functools import partial
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from metrics_tpu.classification import BinaryPrecisionRecallCurve
+from metrics_tpu.core.state import CatBuffer, cat_merge, cat_sync
+from metrics_tpu.parallel import collective, make_data_mesh
+from metrics_tpu.regression import KendallRankCorrCoef, SpearmanCorrCoef
+from metrics_tpu.retrieval import RetrievalMAP, RetrievalNormalizedDCG
+
+NUM_DEVICES = 8
+_rng = np.random.RandomState(17)
+
+
+# ------------------------------------------------------------- buffer unit ops
+
+def test_append_and_values():
+    buf = CatBuffer.create(10)
+    buf.append(jnp.asarray([1.0, 2.0]))
+    buf.append(jnp.asarray(3.0))  # scalar-as-row
+    assert int(buf.count) == 3
+    assert np.allclose(np.asarray(buf.values()), [1.0, 2.0, 3.0])
+    assert np.array_equal(np.asarray(buf.mask()), [True] * 3 + [False] * 7)
+
+
+def test_append_casts_dtype():
+    buf = CatBuffer.create(4, dtype=jnp.int32)
+    buf.append(jnp.asarray([1.9, 2.1]))
+    assert buf.data.dtype == jnp.int32
+
+
+def test_append_2d_items():
+    buf = CatBuffer.create(6, item_shape=(3,))
+    buf.append(jnp.ones((2, 3)))
+    buf.append(jnp.zeros(3))  # single row
+    assert int(buf.count) == 3
+    assert buf.values().shape == (3, 3)
+
+
+def test_overflow_warns_and_keeps_capacity():
+    buf = CatBuffer.create(4)
+    buf.append(jnp.arange(3.0))
+    buf.append(jnp.arange(3.0))
+    assert int(buf.count) == 6
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        vals = buf.values()
+    assert vals.shape == (4,)
+    assert any("overflow" in str(x.message) for x in w)
+
+
+def test_copy_isolates_mutation():
+    buf = CatBuffer.create(4)
+    buf.append(jnp.asarray([1.0]))
+    snap = buf.copy()
+    buf.append(jnp.asarray([2.0]))
+    assert int(snap.count) == 1
+    assert int(buf.count) == 2
+
+
+def test_buffer_is_pytree():
+    buf = CatBuffer.create(4)
+    leaves = jax.tree_util.tree_leaves(buf)
+    assert len(leaves) == 2
+    mapped = jax.tree_util.tree_map(lambda x: x, buf)
+    assert isinstance(mapped, CatBuffer)
+
+
+def test_jit_scan_accumulation():
+    metric = SpearmanCorrCoef(cat_capacity=40)
+    p = _rng.randn(40).astype(np.float32)
+    t = (p + 0.5 * _rng.randn(40)).astype(np.float32)
+
+    @jax.jit
+    def run(state, bp, bt):
+        def step(s, batch):
+            return metric.local_update(s, *batch), None
+
+        s, _ = jax.lax.scan(step, state, (bp, bt))
+        return s
+
+    state = run(metric.init_state(), jnp.asarray(p.reshape(4, 10)), jnp.asarray(t.reshape(4, 10)))
+    assert int(state["preds"].count) == 40
+    eager = SpearmanCorrCoef()
+    eager.update(jnp.asarray(p), jnp.asarray(t))
+    assert abs(float(metric.compute_from(state)) - float(eager.compute())) < 1e-6
+
+
+# ------------------------------------------------------------------ class mode
+
+def test_eager_class_with_capacity_matches_list_mode():
+    p = _rng.randn(30).astype(np.float32)
+    t = (p + 0.3 * _rng.randn(30)).astype(np.float32)
+    buffered = SpearmanCorrCoef(cat_capacity=64)
+    plain = SpearmanCorrCoef()
+    for lo in range(0, 30, 10):
+        buffered.update(jnp.asarray(p[lo : lo + 10]), jnp.asarray(t[lo : lo + 10]))
+        plain.update(jnp.asarray(p[lo : lo + 10]), jnp.asarray(t[lo : lo + 10]))
+    assert abs(float(buffered.compute()) - float(plain.compute())) < 1e-6
+
+
+def test_forward_reduce_merge_with_buffers():
+    metric = SpearmanCorrCoef(cat_capacity=64)
+    p = _rng.randn(20).astype(np.float32)
+    t = (p + 0.3 * _rng.randn(20)).astype(np.float32)
+    metric(jnp.asarray(p[:10]), jnp.asarray(t[:10]))  # forward path
+    metric(jnp.asarray(p[10:]), jnp.asarray(t[10:]))
+    plain = SpearmanCorrCoef()
+    plain.update(jnp.asarray(p), jnp.asarray(t))
+    assert abs(float(metric.compute()) - float(plain.compute())) < 1e-6
+
+
+def test_reset_restores_empty_buffer():
+    metric = SpearmanCorrCoef(cat_capacity=8)
+    metric.update(jnp.arange(4.0), jnp.arange(4.0))
+    metric.reset()
+    assert int(metric.preds.count) == 0
+
+
+def test_state_dict_roundtrip_with_buffers():
+    metric = SpearmanCorrCoef(cat_capacity=8)
+    metric.persistent(True)
+    metric.update(jnp.arange(4.0), jnp.arange(4.0) * 2)
+    sd = metric.state_dict()
+    fresh = SpearmanCorrCoef(cat_capacity=8)
+    fresh.load_state_dict(sd)
+    assert int(fresh.preds.count) == 4
+    assert np.allclose(np.asarray(fresh.preds.values()), np.arange(4.0))
+
+
+def test_pickle_roundtrip_with_buffers():
+    metric = SpearmanCorrCoef(cat_capacity=8)
+    metric.update(jnp.arange(4.0), jnp.arange(4.0) * 2)
+    clone = pickle.loads(pickle.dumps(metric))
+    assert int(clone.preds.count) == 4
+
+
+def test_invalid_capacity_rejected():
+    with pytest.raises(ValueError, match="cat_capacity"):
+        SpearmanCorrCoef(cat_capacity=0)
+
+
+# ------------------------------------------------------------------- sharded
+
+def _sharded_state(metric, in_arrays, n_in):
+    mesh = make_data_mesh(NUM_DEVICES)
+    specs = (P(),) + (P("data"),) * n_in
+
+    @partial(shard_map, mesh=mesh, in_specs=specs, out_specs=P())
+    def run(state, *arrays):
+        state = collective.mark_varying(state, "data")
+        state = metric.local_update(state, *arrays)
+        return metric.sync_state(state, axis_name="data")
+
+    return jax.jit(run)(metric.init_state(), *in_arrays)
+
+
+def test_sharded_spearman_matches_single_device():
+    p = _rng.randn(64).astype(np.float32)
+    t = (p + 0.5 * _rng.randn(64)).astype(np.float32)
+    metric = SpearmanCorrCoef(cat_capacity=8)
+    synced = _sharded_state(metric, (jnp.asarray(p), jnp.asarray(t)), 2)
+    assert int(synced["preds"].count) == 64
+    single = SpearmanCorrCoef()
+    single.update(jnp.asarray(p), jnp.asarray(t))
+    assert abs(float(metric.compute_from(synced)) - float(single.compute())) < 1e-6
+
+
+def test_sharded_kendall_matches_single_device():
+    p = _rng.randn(64).astype(np.float32)
+    t = (p + 0.5 * _rng.randn(64)).astype(np.float32)
+    metric = KendallRankCorrCoef(cat_capacity=8)
+    synced = _sharded_state(metric, (jnp.asarray(p), jnp.asarray(t)), 2)
+    single = KendallRankCorrCoef()
+    single.update(jnp.asarray(p), jnp.asarray(t))
+    assert abs(float(metric.compute_from(synced)) - float(single.compute())) < 1e-6
+
+
+@pytest.mark.parametrize("metric_class", [RetrievalMAP, RetrievalNormalizedDCG])
+def test_sharded_retrieval_matches_single_device(metric_class):
+    idx = np.repeat(np.arange(8), 8).astype(np.int32)
+    preds = _rng.rand(64).astype(np.float32)
+    target = (_rng.rand(64) > 0.5).astype(np.int32)
+    metric = metric_class(cat_capacity=8, validate_args=False)
+    synced = _sharded_state(metric, (jnp.asarray(preds), jnp.asarray(target), jnp.asarray(idx)), 3)
+    single = metric_class()
+    single.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(idx))
+    assert abs(float(metric.compute_from(synced)) - float(single.compute())) < 1e-6
+
+
+def test_sharded_exact_pr_curve_matches_single_device():
+    preds = _rng.rand(64).astype(np.float32)
+    target = (_rng.rand(64) > 0.5).astype(np.int32)
+    metric = BinaryPrecisionRecallCurve(thresholds=None, validate_args=False, cat_capacity=8)
+    synced = _sharded_state(metric, (jnp.asarray(preds), jnp.asarray(target)), 2)
+    p1, r1, t1 = metric.compute_from(synced)
+    single = BinaryPrecisionRecallCurve(thresholds=None, validate_args=False)
+    single.update(jnp.asarray(preds), jnp.asarray(target))
+    p2, r2, t2 = single.compute()
+    assert np.allclose(np.asarray(p1), np.asarray(p2), atol=1e-6)
+    assert np.allclose(np.asarray(r1), np.asarray(r2), atol=1e-6)
+    assert np.allclose(np.asarray(t1), np.asarray(t2), atol=1e-6)
+
+
+def test_cat_sync_front_packs_partial_buffers():
+    """Devices with different fill levels: valid rows pack to the front in device order."""
+    mesh = make_data_mesh(NUM_DEVICES)
+
+    @partial(shard_map, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P())
+    def run(vals, counts):
+        buf = CatBuffer.create(4)
+        buf.data = vals.reshape(4)
+        buf.count = counts.reshape(())
+        return cat_sync(buf, "data")
+
+    # device d holds rows [d*10 .. d*10+count), count = d % 4 + 1
+    counts = np.array([d % 4 + 1 for d in range(NUM_DEVICES)], np.int32)
+    vals = np.zeros((NUM_DEVICES, 4), np.float32)
+    for d in range(NUM_DEVICES):
+        vals[d, : counts[d]] = d * 10 + np.arange(counts[d])
+    out = jax.jit(run)(jnp.asarray(vals.reshape(-1)), jnp.asarray(counts))
+    expected = np.concatenate([vals[d, : counts[d]] for d in range(NUM_DEVICES)])
+    assert int(out.count) == counts.sum()
+    assert np.allclose(np.asarray(out.values()), expected)
