@@ -1,7 +1,9 @@
 from metrics_tpu.functional.image.d_lambda import spectral_distortion_index
 from metrics_tpu.functional.image.ergas import error_relative_global_dimensionless_synthesis
 from metrics_tpu.functional.image.gradients import image_gradients
+from metrics_tpu.functional.image.lpips import learned_perceptual_image_patch_similarity
 from metrics_tpu.functional.image.psnr import peak_signal_noise_ratio
+from metrics_tpu.functional.image.psnrb import peak_signal_noise_ratio_with_blocked_effect
 from metrics_tpu.functional.image.rase import relative_average_spectral_error
 from metrics_tpu.functional.image.rmse_sw import root_mean_squared_error_using_sliding_window
 from metrics_tpu.functional.image.sam import spectral_angle_mapper
@@ -16,7 +18,9 @@ __all__ = [
     "error_relative_global_dimensionless_synthesis",
     "image_gradients",
     "multiscale_structural_similarity_index_measure",
+    "learned_perceptual_image_patch_similarity",
     "peak_signal_noise_ratio",
+    "peak_signal_noise_ratio_with_blocked_effect",
     "relative_average_spectral_error",
     "root_mean_squared_error_using_sliding_window",
     "spectral_angle_mapper",

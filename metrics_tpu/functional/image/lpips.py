@@ -1,0 +1,47 @@
+"""LPIPS functional (reference: functional/image/lpips.py / image/lpip.py:42).
+
+See :mod:`metrics_tpu.models.lpips` for the network port and weight loading.
+"""
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.models.lpips import load_lpips, lpips_forward
+
+
+def _lpips_valid_img(img: Array, normalize: bool) -> bool:
+    """Shape/value check mirroring reference ``_valid_img``."""
+    value_check = bool(img.max() <= 1.0 and img.min() >= 0.0) if normalize else True
+    return img.ndim == 4 and img.shape[1] == 3 and value_check
+
+
+def learned_perceptual_image_patch_similarity(
+    img1: Array,
+    img2: Array,
+    net_type: str = "alex",
+    reduction: str = "mean",
+    normalize: bool = False,
+    backbone_weights: Optional[str] = None,
+    linear_weights: Optional[str] = None,
+) -> Array:
+    """LPIPS perceptual distance between two NCHW RGB batches (lower = more similar).
+
+    Args:
+        img1 / img2: image batches, in [-1, 1] (or [0, 1] with ``normalize=True``).
+        net_type: ``"vgg"`` | ``"alex"`` | ``"squeeze"`` backbone.
+        reduction: ``"mean"`` or ``"sum"`` over the batch.
+        normalize: inputs are in [0, 1].
+        backbone_weights / linear_weights: local weight files (see models.lpips).
+    """
+    if not (_lpips_valid_img(img1, normalize) and _lpips_valid_img(img2, normalize)):
+        raise ValueError(
+            "Expected both input arguments to be normalized tensors with shape [N, 3, H, W]."
+            f" Got input with shape {img1.shape} and {img2.shape} and values in range"
+            f" {[img1.min(), img1.max()]} and {[img2.min(), img2.max()]} when all values are"
+            f" expected to be in the {[0, 1] if normalize else [-1, 1]} range."
+        )
+    backbone, lins = load_lpips(net_type, backbone_weights, linear_weights)
+    loss = lpips_forward(backbone, lins, img1, img2, net_type, normalize)
+    return loss.mean() if reduction == "mean" else loss.sum()

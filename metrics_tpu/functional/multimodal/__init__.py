@@ -1,0 +1,4 @@
+"""Functional multimodal metrics (reference: src/torchmetrics/functional/multimodal/__init__.py)."""
+from metrics_tpu.functional.multimodal.clip_score import clip_score
+
+__all__ = ["clip_score"]

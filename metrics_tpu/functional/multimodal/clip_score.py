@@ -1,0 +1,107 @@
+"""CLIPScore functional (reference: functional/multimodal/clip_score.py:41-160).
+
+Callable-encoder redesign: instead of hard-wiring the HF ``CLIPModel`` +
+``CLIPProcessor`` pair, the encoder is a user-supplied pair of callables
+
+    ``image_encoder(images [N, C, H, W]) -> (N, D)`` embeddings,
+    ``text_encoder(captions: Sequence[str]) -> (N, D)`` embeddings
+
+(unnormalized — L2 normalization happens here). When ``transformers`` is
+installed and locally cached weights exist for ``model_name_or_path``, a default
+encoder pair is built automatically. The score math is pure jnp:
+``mean(max(100 * cos(E_I, E_C), 0))``.
+"""
+from typing import Callable, List, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.utils.imports import _TRANSFORMERS_AVAILABLE
+
+_DEFAULT_CLIP = "openai/clip-vit-large-patch14"
+
+ImageEncoder = Callable[[Array], Array]
+TextEncoder = Callable[[Sequence[str]], Array]
+
+
+def _default_clip_encoders(model_name_or_path: str) -> Tuple[ImageEncoder, TextEncoder]:
+    if not _TRANSFORMERS_AVAILABLE:
+        raise ModuleNotFoundError(
+            "`clip_score` with `model_name_or_path` requires the `transformers` package. Either install it or "
+            "pass `image_encoder` and `text_encoder` callables."
+        )
+    import torch
+    from transformers import CLIPModel, CLIPProcessor
+
+    model = CLIPModel.from_pretrained(model_name_or_path)
+    processor = CLIPProcessor.from_pretrained(model_name_or_path)
+    model.eval()
+
+    def image_encoder(images: Array) -> Array:
+        batch = processor(images=[np.asarray(i) for i in images], return_tensors="pt")
+        with torch.no_grad():
+            feats = model.get_image_features(batch["pixel_values"])
+        return jnp.asarray(feats.numpy())
+
+    def text_encoder(captions: Sequence[str]) -> Array:
+        batch = processor(text=list(captions), return_tensors="pt", padding=True)
+        with torch.no_grad():
+            feats = model.get_text_features(batch["input_ids"], batch["attention_mask"])
+        return jnp.asarray(feats.numpy())
+
+    return image_encoder, text_encoder
+
+
+def _clip_score_from_features(img_features: Array, txt_features: Array) -> Array:
+    """Per-sample ``100 * cos`` similarity — pure jnp, jit-safe."""
+    img = img_features / jnp.maximum(jnp.linalg.norm(img_features, axis=-1, keepdims=True), 1e-30)
+    txt = txt_features / jnp.maximum(jnp.linalg.norm(txt_features, axis=-1, keepdims=True), 1e-30)
+    return 100.0 * jnp.sum(img * txt, axis=-1)
+
+
+def _clip_score_update(
+    images: Union[Array, List[Array]],
+    text: Union[str, Sequence[str]],
+    image_encoder: ImageEncoder,
+    text_encoder: TextEncoder,
+) -> Tuple[Array, int]:
+    if isinstance(images, (list, tuple)):
+        if not all(i.ndim == 3 for i in images):
+            raise ValueError("Expected all images to be 3d but found image that has either more or less")
+        images = jnp.stack([jnp.asarray(i) for i in images])
+    elif images.ndim == 3:
+        images = images[None]
+    text_l = [text] if isinstance(text, str) else list(text)
+    if len(text_l) != images.shape[0]:
+        raise ValueError(
+            f"Expected the number of images and text examples to be the same but got {images.shape[0]}"
+            f" and {len(text_l)}"
+        )
+    score = _clip_score_from_features(image_encoder(images), text_encoder(text_l))
+    return score, len(text_l)
+
+
+def clip_score(
+    images: Union[Array, List[Array]],
+    text: Union[str, Sequence[str]],
+    model_name_or_path: str = _DEFAULT_CLIP,
+    image_encoder: Optional[ImageEncoder] = None,
+    text_encoder: Optional[TextEncoder] = None,
+) -> Array:
+    """CLIPScore text-image alignment: ``mean(max(100 * cos(E_I, E_C), 0))``.
+
+    Args:
+        images: ``(N, C, H, W)`` array or list of ``(C, H, W)`` arrays.
+        text: caption(s), one per image.
+        model_name_or_path: HF CLIP checkpoint for the default encoders.
+        image_encoder / text_encoder: custom embedding callables (both required
+            together); see module docstring for the contract.
+    """
+    if (image_encoder is None) != (text_encoder is None):
+        raise ValueError("`image_encoder` and `text_encoder` must be provided together.")
+    if image_encoder is None:
+        image_encoder, text_encoder = _default_clip_encoders(model_name_or_path)
+    score, _ = _clip_score_update(images, text, image_encoder, text_encoder)
+    score = score.mean(0)
+    return jnp.maximum(score, jnp.zeros_like(score))

@@ -3,6 +3,7 @@ from metrics_tpu.functional.retrieval.fall_out import retrieval_fall_out
 from metrics_tpu.functional.retrieval.hit_rate import retrieval_hit_rate
 from metrics_tpu.functional.retrieval.ndcg import retrieval_normalized_dcg
 from metrics_tpu.functional.retrieval.precision import retrieval_precision
+from metrics_tpu.functional.retrieval.precision_recall_curve import retrieval_precision_recall_curve
 from metrics_tpu.functional.retrieval.r_precision import retrieval_r_precision
 from metrics_tpu.functional.retrieval.recall import retrieval_recall
 from metrics_tpu.functional.retrieval.reciprocal_rank import retrieval_reciprocal_rank
@@ -13,6 +14,7 @@ __all__ = [
     "retrieval_hit_rate",
     "retrieval_normalized_dcg",
     "retrieval_precision",
+    "retrieval_precision_recall_curve",
     "retrieval_r_precision",
     "retrieval_recall",
     "retrieval_reciprocal_rank",

@@ -1,0 +1,56 @@
+"""Retrieval precision-recall curve functional (reference: functional/retrieval/precision_recall_curve.py:24-99)."""
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_retrieval_functional_inputs
+from metrics_tpu.utils.data import _cumsum
+
+
+def retrieval_precision_recall_curve(
+    preds: Array, target: Array, max_k: Optional[int] = None, adaptive_k: bool = False
+) -> Tuple[Array, Array, Array]:
+    """Precision/recall at every cutoff k = 1..max_k for a single query.
+
+    Args:
+        preds: document relevance scores.
+        target: binary relevance labels.
+        max_k: largest cutoff (default: number of documents).
+        adaptive_k: clamp per-position denominators at the document count when
+            ``max_k`` exceeds it.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.array([0.2, 0.3, 0.5])
+        >>> target = jnp.array([True, False, True])
+        >>> precisions, recalls, top_k = retrieval_precision_recall_curve(preds, target, max_k=2)
+        >>> precisions
+        Array([1. , 0.5], dtype=float32)
+        >>> recalls
+        Array([0.5, 0.5], dtype=float32)
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    if not isinstance(adaptive_k, bool):
+        raise ValueError("`adaptive_k` has to be a boolean")
+    n_docs = preds.shape[-1]
+    if max_k is None:
+        max_k = n_docs
+    if not (isinstance(max_k, int) and max_k > 0):
+        raise ValueError("`max_k` has to be a positive integer or None")
+
+    if adaptive_k and max_k > n_docs:
+        topk = jnp.concatenate([jnp.arange(1, n_docs + 1), jnp.full((max_k - n_docs,), n_docs)])
+    else:
+        topk = jnp.arange(1, max_k + 1)
+
+    k_eff = min(max_k, n_docs)
+    order = jnp.argsort(-preds)[:k_eff]
+    relevant = target[order].astype(jnp.float32)
+    relevant = jnp.pad(relevant, (0, max(0, max_k - k_eff)))
+    relevant = _cumsum(relevant, axis=0)
+
+    n_pos = target.sum()
+    recall = jnp.where(n_pos > 0, relevant / jnp.maximum(n_pos, 1), 0.0)
+    precision = jnp.where(n_pos > 0, relevant / topk, 0.0)
+    return precision.astype(jnp.float32), recall.astype(jnp.float32), topk

@@ -3,7 +3,9 @@ from metrics_tpu.image.ergas import ErrorRelativeGlobalDimensionlessSynthesis
 from metrics_tpu.image.fid import FrechetInceptionDistance
 from metrics_tpu.image.inception import InceptionScore
 from metrics_tpu.image.kid import KernelInceptionDistance
+from metrics_tpu.image.lpip import LearnedPerceptualImagePatchSimilarity
 from metrics_tpu.image.psnr import PeakSignalNoiseRatio
+from metrics_tpu.image.psnrb import PeakSignalNoiseRatioWithBlockedEffect
 from metrics_tpu.image.rase import RelativeAverageSpectralError
 from metrics_tpu.image.rmse_sw import RootMeanSquaredErrorUsingSlidingWindow
 from metrics_tpu.image.sam import SpectralAngleMapper
@@ -19,8 +21,10 @@ __all__ = [
     "FrechetInceptionDistance",
     "InceptionScore",
     "KernelInceptionDistance",
+    "LearnedPerceptualImagePatchSimilarity",
     "MultiScaleStructuralSimilarityIndexMeasure",
     "PeakSignalNoiseRatio",
+    "PeakSignalNoiseRatioWithBlockedEffect",
     "RelativeAverageSpectralError",
     "RootMeanSquaredErrorUsingSlidingWindow",
     "SpectralAngleMapper",

@@ -1,0 +1,73 @@
+"""LearnedPerceptualImagePatchSimilarity metric (reference: image/lpip.py:42-200)."""
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.image.lpips import _lpips_valid_img
+from metrics_tpu.models.lpips import LPIPS_CHANNELS, load_lpips, lpips_forward
+
+
+class LearnedPerceptualImagePatchSimilarity(Metric):
+    """Running LPIPS perceptual distance (lower = more similar).
+
+    Args:
+        net_type: ``"vgg"`` | ``"alex"`` | ``"squeeze"`` backbone.
+        reduction: ``"mean"`` or ``"sum"`` over all seen samples.
+        normalize: inputs are in [0, 1] instead of [-1, 1].
+        backbone_weights / linear_weights: local weight files (see
+            :mod:`metrics_tpu.models.lpips`; required — no network egress).
+    """
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        net_type: str = "alex",
+        reduction: str = "mean",
+        normalize: bool = False,
+        backbone_weights: Optional[str] = None,
+        linear_weights: Optional[str] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if net_type not in LPIPS_CHANNELS:
+            raise ValueError(f"Argument `net_type` must be one of {tuple(LPIPS_CHANNELS)}, but got {net_type}")
+        if reduction not in ("mean", "sum"):
+            raise ValueError(f"Argument `reduction` must be one of ('mean', 'sum'), but got {reduction}")
+        if not isinstance(normalize, bool):
+            raise ValueError(f"Argument `normalize` should be an bool but got {normalize}")
+        self.net_type = net_type
+        self.reduction = reduction
+        self.normalize = normalize
+        backbone, lins = load_lpips(net_type, backbone_weights, linear_weights)
+        self._forward_fn = jax.jit(
+            partial(lpips_forward, backbone, lins, net_type=net_type, normalize=normalize)
+        )
+
+        self.add_state("sum_scores", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, img1: Array, img2: Array) -> None:
+        if not (_lpips_valid_img(img1, self.normalize) and _lpips_valid_img(img2, self.normalize)):
+            raise ValueError(
+                "Expected both input arguments to be normalized tensors with shape [N, 3, H, W]."
+                f" Got input with shape {img1.shape} and {img2.shape} and values in range"
+                f" {[img1.min(), img1.max()]} and {[img2.min(), img2.max()]} when all values are"
+                f" expected to be in the {[0, 1] if self.normalize else [-1, 1]} range."
+            )
+        loss = self._forward_fn(img1, img2)
+        self.sum_scores = self.sum_scores + loss.sum()
+        self.total = self.total + img1.shape[0]
+
+    def compute(self) -> Array:
+        if self.reduction == "mean":
+            return self.sum_scores / self.total
+        return self.sum_scores

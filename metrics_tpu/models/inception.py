@@ -1,44 +1,278 @@
-"""InceptionV3 feature extractor for FID/KID/IS.
+"""FID-variant InceptionV3 feature extractor in pure JAX (reference: image/fid.py:52-157).
 
-The reference embeds ``NoTrainInceptionV3`` from torch-fidelity with downloaded
-weights (image/fid.py:52-157). This environment has zero network egress, so
-pretrained weights can only come from a local file:
+The reference embeds torch-fidelity's ``FeatureExtractorInceptionV3`` — the
+TF-inception-2015-12-05 architecture with the three FID-specific deltas from the
+published torch-fidelity/pytorch-fid code: (a) average pools exclude padding from
+their divisor, (b) ``Mixed_7c`` (E_2) uses a max pool in its pool branch, and
+(c) the classifier has 1008 outputs. Inputs are uint8 RGB ``(N, 3, H, W)``,
+resized to 299x299 with TF-1x-style bilinear interpolation (no half-pixel
+centers) and normalized to ``(x - 128) / 128``.
 
-- set ``METRICS_TPU_INCEPTION_WEIGHTS`` to a ``.npz`` with the converted parameters
-  (a conversion helper from the torch-fidelity checkpoint is provided below), or
-- pass a callable ``feature`` extractor to FID/KID/IS directly (any jitted model).
-
-``load_inception_feature_extractor`` raises a clear error when neither is available.
+Everything here is jit/vmap-safe pure functions over an explicit parameter
+pytree; :func:`params_from_state_dict` maps the published checkpoint's
+``state_dict`` names onto that pytree (NCHW/OIHW layouts are kept, so conversion
+is transpose-free). Weights must come from a local file
+(``METRICS_TPU_INCEPTION_WEIGHTS`` or an explicit path) — this environment has no
+network egress for the reference's automatic download.
 """
 import os
-from typing import Callable, Tuple, Union
+from functools import partial
+from typing import Any, Callable, Dict, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array, lax
+
+_BN_EPS = 1e-3
+FEATURE_DIMS = {64: 64, 192: 192, 768: 768, 2048: 2048, "logits_unbiased": 1008}
+
+
+# ------------------------------------------------------------------ primitives
+
+def _tf1_bilinear_resize(x: Array, out_h: int, out_w: int) -> Array:
+    """TF-1x bilinear resize (align_corners=False, NO half-pixel centers).
+
+    ``src = dst * (in / out)`` — the legacy mapping the FID reference uses
+    (torch-fidelity's ``interpolate_bilinear_2d_like_tensorflow1x``); modern
+    ``jax.image.resize`` uses half-pixel centers and gives different features.
+    ``x`` is NCHW float.
+    """
+    n, c, in_h, in_w = x.shape
+
+    def axis_weights(in_size: int, out_size: int):
+        scale = in_size / out_size
+        src = jnp.arange(out_size, dtype=jnp.float32) * scale
+        i0 = jnp.clip(jnp.floor(src).astype(jnp.int32), 0, in_size - 1)
+        i1 = jnp.minimum(i0 + 1, in_size - 1)
+        frac = src - i0.astype(jnp.float32)
+        return i0, i1, frac
+
+    y0, y1, fy = axis_weights(in_h, out_h)
+    x0, x1, fx = axis_weights(in_w, out_w)
+
+    top = x[:, :, y0, :] * (1 - fy)[None, None, :, None] + x[:, :, y1, :] * fy[None, None, :, None]
+    out = top[:, :, :, x0] * (1 - fx)[None, None, None, :] + top[:, :, :, x1] * fx[None, None, None, :]
+    return out
+
+
+def _conv_bn(x: Array, p: Dict[str, Array], stride: Union[int, Tuple[int, int]] = 1, padding="VALID") -> Array:
+    """Conv (no bias) + inference batch-norm (eps 1e-3) + relu, NCHW/OIHW."""
+    strides = (stride, stride) if isinstance(stride, int) else stride
+    x = lax.conv_general_dilated(
+        x, p["kernel"], window_strides=strides, padding=padding, dimension_numbers=("NCHW", "OIHW", "NCHW")
+    )
+    scale = p["bn_scale"] / jnp.sqrt(p["bn_var"] + _BN_EPS)
+    shift = p["bn_bias"] - p["bn_mean"] * scale
+    return jax.nn.relu(x * scale[None, :, None, None] + shift[None, :, None, None])
+
+
+def _max_pool(x: Array, window: int = 3, stride: int = 2) -> Array:
+    return lax.reduce_window(
+        x, -jnp.inf, lax.max, (1, 1, window, window), (1, 1, stride, stride), "VALID"
+    )
+
+
+def _avg_pool_exclude_pad(x: Array, window: int = 3) -> Array:
+    """3x3 stride-1 pad-1 average pool with padding excluded from the divisor."""
+    dims, strides = (1, 1, window, window), (1, 1, 1, 1)
+    pad = ((0, 0), (0, 0), (1, 1), (1, 1))
+    sums = lax.reduce_window(x, 0.0, lax.add, dims, strides, pad)
+    ones = jnp.ones((1, 1) + x.shape[2:], x.dtype)
+    counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides, pad)
+    return sums / counts
+
+
+# ------------------------------------------------------------------- blocks
+
+def _inception_a(x, p):
+    b1 = _conv_bn(x, p["branch1x1"])
+    b5 = _conv_bn(_conv_bn(x, p["branch5x5_1"]), p["branch5x5_2"], padding=((2, 2), (2, 2)))
+    b3 = _conv_bn(x, p["branch3x3dbl_1"])
+    b3 = _conv_bn(b3, p["branch3x3dbl_2"], padding=((1, 1), (1, 1)))
+    b3 = _conv_bn(b3, p["branch3x3dbl_3"], padding=((1, 1), (1, 1)))
+    bp = _conv_bn(_avg_pool_exclude_pad(x), p["branch_pool"])
+    return jnp.concatenate([b1, b5, b3, bp], axis=1)
+
+
+def _inception_b(x, p):
+    b3 = _conv_bn(x, p["branch3x3"], stride=2)
+    bd = _conv_bn(x, p["branch3x3dbl_1"])
+    bd = _conv_bn(bd, p["branch3x3dbl_2"], padding=((1, 1), (1, 1)))
+    bd = _conv_bn(bd, p["branch3x3dbl_3"], stride=2)
+    bp = _max_pool(x)
+    return jnp.concatenate([b3, bd, bp], axis=1)
+
+
+def _inception_c(x, p):
+    b1 = _conv_bn(x, p["branch1x1"])
+    b7 = _conv_bn(x, p["branch7x7_1"])
+    b7 = _conv_bn(b7, p["branch7x7_2"], padding=((0, 0), (3, 3)))
+    b7 = _conv_bn(b7, p["branch7x7_3"], padding=((3, 3), (0, 0)))
+    bd = _conv_bn(x, p["branch7x7dbl_1"])
+    bd = _conv_bn(bd, p["branch7x7dbl_2"], padding=((3, 3), (0, 0)))
+    bd = _conv_bn(bd, p["branch7x7dbl_3"], padding=((0, 0), (3, 3)))
+    bd = _conv_bn(bd, p["branch7x7dbl_4"], padding=((3, 3), (0, 0)))
+    bd = _conv_bn(bd, p["branch7x7dbl_5"], padding=((0, 0), (3, 3)))
+    bp = _conv_bn(_avg_pool_exclude_pad(x), p["branch_pool"])
+    return jnp.concatenate([b1, b7, bd, bp], axis=1)
+
+
+def _inception_d(x, p):
+    b3 = _conv_bn(_conv_bn(x, p["branch3x3_1"]), p["branch3x3_2"], stride=2)
+    b7 = _conv_bn(x, p["branch7x7x3_1"])
+    b7 = _conv_bn(b7, p["branch7x7x3_2"], padding=((0, 0), (3, 3)))
+    b7 = _conv_bn(b7, p["branch7x7x3_3"], padding=((3, 3), (0, 0)))
+    b7 = _conv_bn(b7, p["branch7x7x3_4"], stride=2)
+    bp = _max_pool(x)
+    return jnp.concatenate([b3, b7, bp], axis=1)
+
+
+def _inception_e(x, p, pool: str):
+    b1 = _conv_bn(x, p["branch1x1"])
+    b3 = _conv_bn(x, p["branch3x3_1"])
+    b3 = jnp.concatenate(
+        [
+            _conv_bn(b3, p["branch3x3_2a"], padding=((0, 0), (1, 1))),
+            _conv_bn(b3, p["branch3x3_2b"], padding=((1, 1), (0, 0))),
+        ],
+        axis=1,
+    )
+    bd = _conv_bn(x, p["branch3x3dbl_1"])
+    bd = _conv_bn(bd, p["branch3x3dbl_2"], padding=((1, 1), (1, 1)))
+    bd = jnp.concatenate(
+        [
+            _conv_bn(bd, p["branch3x3dbl_3a"], padding=((0, 0), (1, 1))),
+            _conv_bn(bd, p["branch3x3dbl_3b"], padding=((1, 1), (0, 0))),
+        ],
+        axis=1,
+    )
+    if pool == "avg":
+        pooled = _avg_pool_exclude_pad(x)
+    else:  # FID E_2: max pool 3x3 stride 1 pad 1
+        pooled = lax.reduce_window(
+            x, -jnp.inf, lax.max, (1, 1, 3, 3), (1, 1, 1, 1), ((0, 0), (0, 0), (1, 1), (1, 1))
+        )
+    bp = _conv_bn(pooled, p["branch_pool"])
+    return jnp.concatenate([b1, b3, bd, bp], axis=1)
+
+
+# ------------------------------------------------------------------- network
+
+def inception_features(params: Dict[str, Any], x: Array, feature: Union[int, str] = 2048) -> Array:
+    """Forward uint8 RGB NCHW images to the requested feature tap.
+
+    Taps mirror the reference extractor (image/fid.py:96-110): ``64`` after the
+    first max pool, ``192`` after the second, ``768`` after ``Mixed_6e`` — all
+    globally average-pooled to ``(N, dim)`` — ``2048`` after the global average
+    pool, ``"logits_unbiased"`` = fc without bias, ``"logits"`` with bias.
+    """
+    x = x.astype(jnp.float32)
+    x = _tf1_bilinear_resize(x, 299, 299)
+    x = (x - 128.0) / 128.0
+
+    x = _conv_bn(x, params["Conv2d_1a_3x3"], stride=2)
+    x = _conv_bn(x, params["Conv2d_2a_3x3"])
+    x = _conv_bn(x, params["Conv2d_2b_3x3"], padding=((1, 1), (1, 1)))
+    x = _max_pool(x)
+    if feature == 64:
+        return x.mean(axis=(2, 3))
+    x = _conv_bn(x, params["Conv2d_3b_1x1"])
+    x = _conv_bn(x, params["Conv2d_4a_3x3"])
+    x = _max_pool(x)
+    if feature == 192:
+        return x.mean(axis=(2, 3))
+    x = _inception_a(x, params["Mixed_5b"])
+    x = _inception_a(x, params["Mixed_5c"])
+    x = _inception_a(x, params["Mixed_5d"])
+    x = _inception_b(x, params["Mixed_6a"])
+    x = _inception_c(x, params["Mixed_6b"])
+    x = _inception_c(x, params["Mixed_6c"])
+    x = _inception_c(x, params["Mixed_6d"])
+    x = _inception_c(x, params["Mixed_6e"])
+    if feature == 768:
+        return x.mean(axis=(2, 3))
+    x = _inception_d(x, params["Mixed_7a"])
+    x = _inception_e(x, params["Mixed_7b"], pool="avg")
+    x = _inception_e(x, params["Mixed_7c"], pool="max")
+    x = x.mean(axis=(2, 3))  # global average pool -> (N, 2048)
+    if feature == 2048:
+        return x
+    logits = x @ params["fc"]["weight"].T
+    if feature == "logits_unbiased":
+        return logits
+    return logits + params["fc"]["bias"]
+
+
+# ---------------------------------------------------------------- conversion
+
+_BLOCK_NAMES = (
+    ["Conv2d_1a_3x3", "Conv2d_2a_3x3", "Conv2d_2b_3x3", "Conv2d_3b_1x1", "Conv2d_4a_3x3"]
+    + [f"Mixed_5{s}" for s in "bcd"]
+    + [f"Mixed_6{s}" for s in "abcde"]
+    + [f"Mixed_7{s}" for s in "abc"]
+)
+
+
+def params_from_state_dict(state: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    """Build the model parameter pytree from torch-fidelity state_dict arrays."""
+    params: Dict[str, Any] = {}
+
+    def conv_bn(prefix: str) -> Dict[str, jnp.ndarray]:
+        return {
+            "kernel": jnp.asarray(state[f"{prefix}.conv.weight"]),
+            "bn_scale": jnp.asarray(state[f"{prefix}.bn.weight"]),
+            "bn_bias": jnp.asarray(state[f"{prefix}.bn.bias"]),
+            "bn_mean": jnp.asarray(state[f"{prefix}.bn.running_mean"]),
+            "bn_var": jnp.asarray(state[f"{prefix}.bn.running_var"]),
+        }
+
+    for name in _BLOCK_NAMES:
+        if name.startswith("Conv2d"):
+            params[name] = conv_bn(name)
+        else:
+            branches = sorted(
+                {k.split(".")[1] for k in state if k.startswith(f"{name}.") and k.endswith(".conv.weight")}
+            )
+            params[name] = {b: conv_bn(f"{name}.{b}") for b in branches}
+    params["fc"] = {"weight": jnp.asarray(state["fc.weight"]), "bias": jnp.asarray(state["fc.bias"])}
+    return params
+
+
+def load_inception_params(weights_path: str) -> Dict[str, Any]:
+    """Load parameters from an ``.npz`` (converted) or ``.pth`` (torch) file."""
+    from metrics_tpu.models._io import load_checkpoint_state
+
+    return params_from_state_dict(load_checkpoint_state(weights_path))
 
 
 def load_inception_feature_extractor(feature: Union[int, str]) -> Tuple[Callable, int]:
-    """Return (extractor, feature_dim) for the pretrained InceptionV3 layer."""
-    valid_int_input = ("logits_unbiased", 64, 192, 768, 2048)
-    if feature not in valid_int_input:
-        raise ValueError(
-            f"Integer input to argument `feature` must be one of {valid_int_input}, but got {feature}."
-        )
+    """Return ``(extractor, feature_dim)`` for the pretrained InceptionV3 tap.
+
+    The extractor maps uint8 RGB ``(N, 3, H, W)`` images to ``(N, dim)`` features
+    and is jit-compiled. Weights come from ``METRICS_TPU_INCEPTION_WEIGHTS``.
+    """
+    valid = ("logits_unbiased", 64, 192, 768, 2048)
+    if feature not in valid:
+        raise ValueError(f"Integer input to argument `feature` must be one of {valid}, but got {feature}.")
     weights_path = os.environ.get("METRICS_TPU_INCEPTION_WEIGHTS")
     if not weights_path or not os.path.exists(weights_path):
         raise ModuleNotFoundError(
             "Pretrained InceptionV3 weights are required for integer `feature` inputs but no weights file"
             " is available (this environment has no network access for the torch-fidelity download used by"
-            " the reference). Either set METRICS_TPU_INCEPTION_WEIGHTS to a converted .npz checkpoint or"
-            " pass a callable `feature` extractor (any function mapping (N, C, H, W) images to (N, D)"
-            " features, e.g. a jitted flax module)."
+            " the reference). Either set METRICS_TPU_INCEPTION_WEIGHTS to a torch-fidelity .pth checkpoint"
+            " or a converted .npz, or pass a callable `feature` extractor ((N, C, H, W) -> (N, D))."
         )
-    raise NotImplementedError(
-        "Loading converted InceptionV3 weights is not wired up yet; pass a callable `feature` extractor."
-    )
+    params = load_inception_params(weights_path)
+    extractor = jax.jit(partial(inception_features, params, feature=feature))
+    return extractor, FEATURE_DIMS[feature]
 
 
 def convert_torch_fidelity_checkpoint(pth_path: str, out_path: str) -> None:
-    """Convert a torch-fidelity InceptionV3 .pth checkpoint to .npz for this package."""
-    import numpy as np
+    """Convert a torch-fidelity InceptionV3 ``.pth`` checkpoint to ``.npz``."""
     import torch
 
-    state = torch.load(pth_path, map_location="cpu")
+    state = torch.load(pth_path, map_location="cpu", weights_only=False)
+    if hasattr(state, "state_dict"):
+        state = state.state_dict()
     np.savez(out_path, **{k: v.numpy() for k, v in state.items()})

@@ -1,0 +1,249 @@
+"""LPIPS networks in pure JAX (reference: image/lpip.py:42-150 + vendored lpips weights).
+
+The published LPIPS design (Zhang et al., CVPR 2018): a frozen classification
+backbone (VGG16 / AlexNet / SqueezeNet-1.1 feature stacks), channel-unit-normalized
+activations at fixed taps, squared differences, learned 1x1 "lin" heads, spatial
+mean, summed over taps. The reference vendors only the small lin-head ``.pth``
+files (functional/image/lpips_models/*.pth) and pulls backbones from torchvision's
+download cache; offline here both come from local files:
+
+- ``backbone_weights``: torchvision-format ``state_dict`` (``features.N.weight``)
+  for the chosen net, via path or ``METRICS_TPU_LPIPS_<NET>_WEIGHTS`` env var;
+- ``linear_weights``: lpips-format lin heads (``lin0.model.1.weight`` ...), via
+  path or ``METRICS_TPU_LPIPS_LINEAR_WEIGHTS`` (the reference tree's vendored
+  files load directly).
+
+All forwards are jit-safe pure functions over explicit parameter pytrees
+(NCHW/OIHW, conversion transpose-free).
+"""
+import os
+from typing import Any, Dict, List, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array, lax
+
+# ImageNet scaling layer constants from the published lpips implementation
+_SHIFT = np.array([-0.030, -0.088, -0.188], np.float32)
+_SCALE = np.array([0.458, 0.448, 0.450], np.float32)
+
+# channels at each tap
+LPIPS_CHANNELS = {
+    "vgg": (64, 128, 256, 512, 512),
+    "alex": (64, 192, 384, 256, 256),
+    "squeeze": (64, 128, 256, 384, 384, 512, 512),
+}
+
+
+def _conv(x: Array, w: Array, b: Array, stride: int = 1, padding=((0, 0), (0, 0))) -> Array:
+    out = lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride), padding=padding, dimension_numbers=("NCHW", "OIHW", "NCHW")
+    )
+    return out + b[None, :, None, None]
+
+
+def _conv_relu(x, p, stride=1, padding=((0, 0), (0, 0))):
+    return jax.nn.relu(_conv(x, p["weight"], p["bias"], stride, padding))
+
+
+def _max_pool(x: Array, window: int = 3, stride: int = 2, ceil: bool = False) -> Array:
+    pad = ((0, 0), (0, 0), (0, 0), (0, 0))
+    if ceil:
+        h, w = x.shape[2], x.shape[3]
+        eh = (stride - (h - window) % stride) % stride
+        ew = (stride - (w - window) % stride) % stride
+        pad = ((0, 0), (0, 0), (0, eh), (0, ew))
+    return lax.reduce_window(x, -jnp.inf, lax.max, (1, 1, window, window), (1, 1, stride, stride), pad)
+
+
+# ------------------------------------------------------------------ backbones
+
+def _vgg_taps(params: List[Dict[str, Array]], x: Array) -> List[Array]:
+    """VGG16 features; taps after relu1_2, relu2_2, relu3_3, relu4_3, relu5_3."""
+    taps = []
+    plan = [(2, False), (2, True), (3, True), (3, True), (3, True)]  # (convs, pool_before)
+    i = 0
+    for convs, pool_before in plan:
+        if pool_before:
+            x = _max_pool(x, 2, 2)
+        for _ in range(convs):
+            x = _conv_relu(x, params[i], padding=((1, 1), (1, 1)))
+            i += 1
+        taps.append(x)
+    return taps
+
+
+def _alex_taps(params: List[Dict[str, Array]], x: Array) -> List[Array]:
+    """AlexNet features; taps after each of the five relus."""
+    taps = []
+    x = _conv_relu(x, params[0], stride=4, padding=((2, 2), (2, 2)))
+    taps.append(x)
+    x = _max_pool(x, 3, 2)
+    x = _conv_relu(x, params[1], padding=((2, 2), (2, 2)))
+    taps.append(x)
+    x = _max_pool(x, 3, 2)
+    x = _conv_relu(x, params[2], padding=((1, 1), (1, 1)))
+    taps.append(x)
+    x = _conv_relu(x, params[3], padding=((1, 1), (1, 1)))
+    taps.append(x)
+    x = _conv_relu(x, params[4], padding=((1, 1), (1, 1)))
+    taps.append(x)
+    return taps
+
+
+def _fire(x, p):
+    s = _conv_relu(x, p["squeeze"])
+    e1 = _conv_relu(s, p["expand1x1"])
+    e3 = _conv_relu(s, p["expand3x3"], padding=((1, 1), (1, 1)))
+    return jnp.concatenate([e1, e3], axis=1)
+
+
+def _squeeze_taps(params: Dict[str, Any], x: Array) -> List[Array]:
+    """SqueezeNet-1.1 features; seven taps per the published lpips slicing."""
+    taps = []
+    x = _conv_relu(x, params["conv1"], stride=2)
+    taps.append(x)
+    x = _max_pool(x, 3, 2, ceil=True)
+    x = _fire(x, params["fire1"])
+    x = _fire(x, params["fire2"])
+    taps.append(x)
+    x = _max_pool(x, 3, 2, ceil=True)
+    x = _fire(x, params["fire3"])
+    x = _fire(x, params["fire4"])
+    taps.append(x)
+    x = _max_pool(x, 3, 2, ceil=True)
+    x = _fire(x, params["fire5"])
+    taps.append(x)
+    x = _fire(x, params["fire6"])
+    taps.append(x)
+    x = _fire(x, params["fire7"])
+    taps.append(x)
+    x = _fire(x, params["fire8"])
+    taps.append(x)
+    return taps
+
+
+_TAP_FNS = {"vgg": _vgg_taps, "alex": _alex_taps, "squeeze": _squeeze_taps}
+
+
+# -------------------------------------------------------------------- forward
+
+def lpips_forward(
+    backbone_params: Any,
+    linear_weights: Sequence[Array],
+    img1: Array,
+    img2: Array,
+    net_type: str = "vgg",
+    normalize: bool = False,
+) -> Array:
+    """Per-sample LPIPS distance between NCHW RGB batches.
+
+    ``normalize=True`` expects inputs in [0, 1] (rescaled to [-1, 1] like the
+    reference); otherwise inputs must already be in [-1, 1].
+    """
+    if normalize:
+        img1 = 2 * img1 - 1
+        img2 = 2 * img2 - 1
+    shift = jnp.asarray(_SHIFT)[None, :, None, None]
+    scale = jnp.asarray(_SCALE)[None, :, None, None]
+    tap_fn = _TAP_FNS[net_type]
+    taps1 = tap_fn(backbone_params, (img1 - shift) / scale)
+    taps2 = tap_fn(backbone_params, (img2 - shift) / scale)
+
+    total = 0.0
+    for f1, f2, lin_w in zip(taps1, taps2, linear_weights):
+        n1 = f1 / jnp.sqrt(jnp.sum(f1**2, axis=1, keepdims=True) + 1e-10)
+        n2 = f2 / jnp.sqrt(jnp.sum(f2**2, axis=1, keepdims=True) + 1e-10)
+        diff = (n1 - n2) ** 2
+        # lin head: non-negative 1x1 conv, no bias
+        res = jnp.einsum("nchw,oc->nohw", diff, lin_w)
+        total = total + res.mean(axis=(2, 3))[:, 0]
+    return total
+
+
+# ----------------------------------------------------------------- conversion
+
+def vgg_params_from_state_dict(state: Dict[str, np.ndarray]) -> List[Dict[str, Array]]:
+    conv_idx = [0, 2, 5, 7, 10, 12, 14, 17, 19, 21, 24, 26, 28]  # torchvision vgg16.features
+    return [
+        {"weight": jnp.asarray(state[f"features.{i}.weight"]), "bias": jnp.asarray(state[f"features.{i}.bias"])}
+        for i in conv_idx
+    ]
+
+
+def alex_params_from_state_dict(state: Dict[str, np.ndarray]) -> List[Dict[str, Array]]:
+    conv_idx = [0, 3, 6, 8, 10]  # torchvision alexnet.features
+    return [
+        {"weight": jnp.asarray(state[f"features.{i}.weight"]), "bias": jnp.asarray(state[f"features.{i}.bias"])}
+        for i in conv_idx
+    ]
+
+
+def squeeze_params_from_state_dict(state: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    def conv(prefix):
+        return {"weight": jnp.asarray(state[f"{prefix}.weight"]), "bias": jnp.asarray(state[f"{prefix}.bias"])}
+
+    fire_idx = [3, 4, 6, 7, 9, 10, 11, 12]  # torchvision squeezenet1_1.features fire modules
+    params: Dict[str, Any] = {"conv1": conv("features.0")}
+    for n, i in enumerate(fire_idx, start=1):
+        params[f"fire{n}"] = {
+            "squeeze": conv(f"features.{i}.squeeze"),
+            "expand1x1": conv(f"features.{i}.expand1x1"),
+            "expand3x3": conv(f"features.{i}.expand3x3"),
+        }
+    return params
+
+
+_BACKBONE_CONVERTERS = {
+    "vgg": vgg_params_from_state_dict,
+    "alex": alex_params_from_state_dict,
+    "squeeze": squeeze_params_from_state_dict,
+}
+
+
+def linear_weights_from_state_dict(state: Dict[str, np.ndarray], net_type: str) -> List[Array]:
+    """Lin heads from an lpips-format checkpoint (``lin{i}.model.1.weight``)."""
+    n_taps = len(LPIPS_CHANNELS[net_type])
+    out = []
+    for i in range(n_taps):
+        for key in (f"lin{i}.model.1.weight", f"lins.{i}.model.1.weight"):
+            if key in state:
+                w = np.asarray(state[key])  # (1, C, 1, 1)
+                out.append(jnp.asarray(w.reshape(w.shape[0], w.shape[1])))
+                break
+        else:
+            raise KeyError(f"Could not find lin head {i} in linear weights checkpoint")
+    return out
+
+
+def _load_state(path: str) -> Dict[str, np.ndarray]:
+    from metrics_tpu.models._io import load_checkpoint_state
+
+    return load_checkpoint_state(path)
+
+
+def load_lpips(
+    net_type: str = "vgg",
+    backbone_weights: Union[str, None] = None,
+    linear_weights: Union[str, None] = None,
+) -> Tuple[Any, List[Array]]:
+    """Load (backbone_params, linear_weights) for :func:`lpips_forward`."""
+    if net_type not in LPIPS_CHANNELS:
+        raise ValueError(f"Argument `net_type` must be one of {tuple(LPIPS_CHANNELS)}, but got {net_type}")
+    backbone_weights = backbone_weights or os.environ.get(f"METRICS_TPU_LPIPS_{net_type.upper()}_WEIGHTS")
+    linear_weights = linear_weights or os.environ.get("METRICS_TPU_LPIPS_LINEAR_WEIGHTS")
+    if not backbone_weights or not os.path.exists(backbone_weights):
+        raise ModuleNotFoundError(
+            f"LPIPS requires pretrained {net_type} backbone weights (torchvision-format state_dict), but no"
+            f" weights file is available (no network egress for the torchvision download the reference relies"
+            f" on). Set `backbone_weights` or METRICS_TPU_LPIPS_{net_type.upper()}_WEIGHTS."
+        )
+    if not linear_weights or not os.path.exists(linear_weights):
+        raise ModuleNotFoundError(
+            "LPIPS requires the learned lin-head weights (lpips-format .pth, e.g. the reference's vendored"
+            " functional/image/lpips_models/*.pth). Set `linear_weights` or METRICS_TPU_LPIPS_LINEAR_WEIGHTS."
+        )
+    backbone = _BACKBONE_CONVERTERS[net_type](_load_state(backbone_weights))
+    lins = linear_weights_from_state_dict(_load_state(linear_weights), net_type)
+    return backbone, lins

@@ -1,0 +1,4 @@
+"""Multimodal metrics (reference: src/torchmetrics/multimodal/__init__.py)."""
+from metrics_tpu.multimodal.clip_score import CLIPScore
+
+__all__ = ["CLIPScore"]

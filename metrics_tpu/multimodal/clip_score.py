@@ -1,0 +1,62 @@
+"""CLIPScore metric (reference: multimodal/clip_score.py:46-130)."""
+from typing import Any, List, Optional, Sequence, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.multimodal.clip_score import (
+    _DEFAULT_CLIP,
+    ImageEncoder,
+    TextEncoder,
+    _clip_score_update,
+    _default_clip_encoders,
+)
+
+
+class CLIPScore(Metric):
+    """Running-mean CLIPScore: ``max(100 * cos(E_I, E_C), 0)`` over all samples.
+
+    Args:
+        model_name_or_path: HF CLIP checkpoint for the default encoders (requires
+            locally cached weights).
+        image_encoder / text_encoder: custom embedding callables (both required
+            together); see :mod:`metrics_tpu.functional.multimodal.clip_score`.
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 100.0
+
+    def __init__(
+        self,
+        model_name_or_path: str = _DEFAULT_CLIP,
+        image_encoder: Optional[ImageEncoder] = None,
+        text_encoder: Optional[TextEncoder] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if (image_encoder is None) != (text_encoder is None):
+            raise ValueError("`image_encoder` and `text_encoder` must be provided together.")
+        self.model_name_or_path = model_name_or_path
+        self.image_encoder = image_encoder
+        self.text_encoder = text_encoder
+        self.add_state("score", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("n_samples", jnp.asarray(0), dist_reduce_fx="sum")
+
+    def _encoders(self):
+        if self.image_encoder is None:
+            # build (and cache) the default encoders once
+            self.image_encoder, self.text_encoder = _default_clip_encoders(self.model_name_or_path)
+        return self.image_encoder, self.text_encoder
+
+    def update(self, images: Union[Array, List[Array]], text: Union[str, Sequence[str]]) -> None:
+        image_encoder, text_encoder = self._encoders()
+        score, n_samples = _clip_score_update(images, text, image_encoder, text_encoder)
+        self.score = self.score + score.sum(0)
+        self.n_samples = self.n_samples + n_samples
+
+    def compute(self) -> Array:
+        return jnp.maximum(self.score / self.n_samples, 0.0)

@@ -3,6 +3,10 @@ from metrics_tpu.retrieval.fall_out import RetrievalFallOut
 from metrics_tpu.retrieval.hit_rate import RetrievalHitRate
 from metrics_tpu.retrieval.ndcg import RetrievalNormalizedDCG
 from metrics_tpu.retrieval.precision import RetrievalPrecision
+from metrics_tpu.retrieval.precision_recall_curve import (
+    RetrievalPrecisionRecallCurve,
+    RetrievalRecallAtFixedPrecision,
+)
 from metrics_tpu.retrieval.r_precision import RetrievalRPrecision
 from metrics_tpu.retrieval.recall import RetrievalRecall
 from metrics_tpu.retrieval.reciprocal_rank import RetrievalMRR
@@ -14,6 +18,8 @@ __all__ = [
     "RetrievalMRR",
     "RetrievalNormalizedDCG",
     "RetrievalPrecision",
+    "RetrievalPrecisionRecallCurve",
+    "RetrievalRecallAtFixedPrecision",
     "RetrievalRPrecision",
     "RetrievalRecall",
 ]

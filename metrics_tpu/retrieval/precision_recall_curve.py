@@ -1,0 +1,219 @@
+"""RetrievalPrecisionRecallCurve + RetrievalRecallAtFixedPrecision
+(reference: retrieval/precision_recall_curve.py:60-370).
+
+TPU redesign: the reference loops queries on host (``torch.split`` over
+``_flexible_bincount`` sizes, one topk per query); here all queries are handled in
+one vectorized pass — lexsort by (query, -score), within-query ranks, one scatter
+into a ``(num_queries, max_k)`` relevance matrix, one cumsum — so the compute cost
+is independent of the query count.
+"""
+from typing import Any, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.utils.checks import _check_retrieval_inputs
+from metrics_tpu.utils.data import dim_zero_cat
+
+
+def _retrieval_recall_at_fixed_precision(
+    precision: Array, recall: Array, top_k: Array, min_precision: float
+) -> Tuple[Array, Array]:
+    """Maximum recall whose precision >= min_precision, with its best k.
+
+    Ties on recall resolve to the larger k (reference :49 uses ``max((r, k))``);
+    when no point qualifies (or max recall is 0) best_k = len(top_k).
+    """
+    p = np.asarray(precision)
+    r = np.asarray(recall)
+    k = np.asarray(top_k)
+    qualifying = [(rr, kk) for pp, rr, kk in zip(p, r, k) if pp >= min_precision]
+    if not qualifying:
+        return jnp.asarray(0.0, jnp.float32), jnp.asarray(len(k), jnp.int32)
+    max_recall, best_k = max(qualifying)
+    if max_recall == 0.0:
+        best_k = len(k)
+    return jnp.asarray(max_recall, jnp.float32), jnp.asarray(int(best_k), jnp.int32)
+
+
+class RetrievalPrecisionRecallCurve(Metric):
+    """Mean precision/recall over queries at every cutoff k = 1..max_k.
+
+    Args:
+        max_k: largest cutoff (default: size of the largest query).
+        adaptive_k: clamp per-position denominators at each query's document count.
+        empty_target_action: ``neg`` (0s) / ``pos`` (1s) / ``skip`` / ``error`` for
+            queries without positives.
+        ignore_index: drop documents whose target equals this value.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.retrieval import RetrievalPrecisionRecallCurve
+        >>> indexes = jnp.array([0, 0, 0, 0, 1, 1, 1])
+        >>> preds = jnp.array([0.4, 0.01, 0.5, 0.6, 0.2, 0.3, 0.5])
+        >>> target = jnp.array([True, False, False, True, True, False, True])
+        >>> r = RetrievalPrecisionRecallCurve(max_k=4)
+        >>> precisions, recalls, top_k = r(preds, target, indexes=indexes)
+        >>> precisions
+        Array([1.       , 0.5      , 0.6666667, 0.5      ], dtype=float32)
+        >>> recalls
+        Array([0.5, 0.5, 1. , 1. ], dtype=float32)
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    full_state_update: bool = False
+
+    def __init__(
+        self,
+        max_k: Optional[int] = None,
+        adaptive_k: bool = False,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.allow_non_binary_target = False
+
+        if empty_target_action not in ("error", "skip", "neg", "pos"):
+            raise ValueError(f"Argument `empty_target_action` received a wrong value `{empty_target_action}`.")
+        self.empty_target_action = empty_target_action
+        if ignore_index is not None and not isinstance(ignore_index, int):
+            raise ValueError("Argument `ignore_index` must be an integer or None.")
+        self.ignore_index = ignore_index
+        if (max_k is not None) and not (isinstance(max_k, int) and max_k > 0):
+            raise ValueError("`max_k` has to be a positive integer or None")
+        self.max_k = max_k
+        if not isinstance(adaptive_k, bool):
+            raise ValueError("`adaptive_k` has to be a boolean")
+        self.adaptive_k = adaptive_k
+        self.validate_args = validate_args
+
+        self.add_state("indexes", default=[], dist_reduce_fx="cat", cat_dtype=jnp.int32, cat_fill_value=-1)
+        self.add_state("preds", default=[], dist_reduce_fx="cat", cat_dtype=jnp.float32)
+        self.add_state("target", default=[], dist_reduce_fx="cat", cat_dtype=jnp.int32)
+
+    def update(self, preds: Array, target: Array, indexes: Array) -> None:
+        if indexes is None:
+            raise ValueError("Argument `indexes` cannot be None")
+        indexes, preds, target = _check_retrieval_inputs(
+            indexes,
+            preds,
+            target,
+            allow_non_binary_target=self.allow_non_binary_target,
+            ignore_index=self.ignore_index,
+            validate_args=self.validate_args,
+        )
+        self.indexes.append(indexes)
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def compute(self) -> Tuple[Array, Array, Array]:
+        indexes = np.asarray(dim_zero_cat(self.indexes))
+        preds = np.asarray(dim_zero_cat(self.preds))
+        target = np.asarray(dim_zero_cat(self.target))
+
+        # drop cat-buffer padding rows (index sentinel -1)
+        keep = indexes >= 0
+        indexes, preds, target = indexes[keep], preds[keep], target[keep]
+
+        # one lexsort pass: queries contiguous, scores descending within a query
+        order = np.lexsort((-preds, indexes))
+        indexes, preds, target = indexes[order], preds[order], target[order]
+        _, inverse, counts = np.unique(indexes, return_inverse=True, return_counts=True)
+        num_queries = len(counts)
+        starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+        rank = np.arange(len(indexes)) - starts[inverse]
+
+        max_k = self.max_k if self.max_k is not None else (int(counts.max()) if num_queries else 1)
+
+        # scatter ranked relevance into (Q, max_k) and cumsum along k
+        rel = np.zeros((num_queries, max_k), np.float32)
+        in_k = rank < max_k
+        rel[inverse[in_k], rank[in_k]] = target[in_k]
+        rel_cum = np.cumsum(rel, axis=1)
+
+        n_pos = np.zeros(num_queries, np.float32)
+        np.add.at(n_pos, inverse, target.astype(np.float32))
+
+        denom = np.arange(1, max_k + 1, dtype=np.float32)[None, :]
+        if self.adaptive_k:
+            denom = np.minimum(denom, counts[:, None].astype(np.float32))
+        precision = rel_cum / denom
+        recall = rel_cum / np.maximum(n_pos, 1.0)[:, None]
+
+        empty = n_pos == 0
+        if self.empty_target_action == "error":
+            if empty.any():
+                raise ValueError("`compute` method was provided with a query with no positive target.")
+            keep_q = np.ones(num_queries, bool)
+        elif self.empty_target_action == "skip":
+            keep_q = ~empty
+        elif self.empty_target_action == "pos":
+            precision[empty] = 1.0
+            recall[empty] = 1.0
+            keep_q = np.ones(num_queries, bool)
+        else:  # neg
+            precision[empty] = 0.0
+            recall[empty] = 0.0
+            keep_q = np.ones(num_queries, bool)
+
+        if keep_q.any():
+            precision_mean = precision[keep_q].mean(axis=0)
+            recall_mean = recall[keep_q].mean(axis=0)
+        else:
+            precision_mean = np.zeros(max_k, np.float32)
+            recall_mean = np.zeros(max_k, np.float32)
+
+        return (
+            jnp.asarray(precision_mean, jnp.float32),
+            jnp.asarray(recall_mean, jnp.float32),
+            jnp.arange(1, max_k + 1),
+        )
+
+
+class RetrievalRecallAtFixedPrecision(RetrievalPrecisionRecallCurve):
+    """Maximum recall at a minimum precision over the k = 1..max_k curve.
+
+    Args:
+        min_precision: precision floor in [0, 1].
+        max_k / adaptive_k / empty_target_action / ignore_index: see
+            :class:`RetrievalPrecisionRecallCurve`.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.retrieval import RetrievalRecallAtFixedPrecision
+        >>> indexes = jnp.array([0, 0, 0, 0, 1, 1, 1])
+        >>> preds = jnp.array([0.4, 0.01, 0.5, 0.6, 0.2, 0.3, 0.5])
+        >>> target = jnp.array([True, False, False, True, True, False, True])
+        >>> r = RetrievalRecallAtFixedPrecision(min_precision=0.8)
+        >>> r(preds, target, indexes=indexes)
+        (Array(0.5, dtype=float32), Array(1, dtype=int32))
+    """
+
+    def __init__(
+        self,
+        min_precision: float = 0.0,
+        max_k: Optional[int] = None,
+        adaptive_k: bool = False,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            max_k=max_k,
+            adaptive_k=adaptive_k,
+            empty_target_action=empty_target_action,
+            ignore_index=ignore_index,
+            **kwargs,
+        )
+        if not isinstance(min_precision, float) or not 0.0 <= min_precision <= 1.0:
+            raise ValueError("`min_precision` has to be a float value in range [0, 1]")
+        self.min_precision = min_precision
+
+    def compute(self) -> Tuple[Array, Array]:  # type: ignore[override]
+        precision, recall, top_k = super().compute()
+        return _retrieval_recall_at_fixed_precision(precision, recall, top_k, self.min_precision)

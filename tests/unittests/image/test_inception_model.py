@@ -1,0 +1,291 @@
+"""InceptionV3 (FID variant) architecture + converter differential test.
+
+Oracle: a torch replica of the published torch-fidelity/pytorch-fid architecture
+(standard torchvision Inception blocks with the FID deltas: exclude-pad average
+pools, max pool in Mixed_7c's pool branch, 1008-way fc) built here with random
+weights. The same random state_dict drives both the oracle and
+``params_from_state_dict`` + ``inception_features``, so a pass validates every
+conv/pad/stride/BN detail and the checkpoint conversion end-to-end — exactly what
+loading the real torch-fidelity weights exercises.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu.models.inception import (
+    FEATURE_DIMS,
+    _tf1_bilinear_resize,
+    inception_features,
+    params_from_state_dict,
+)
+
+torch = pytest.importorskip("torch")
+import torch.nn as nn  # noqa: E402
+import torch.nn.functional as F  # noqa: E402
+
+
+class BasicConv2d(nn.Module):
+    def __init__(self, i, o, **kw):
+        super().__init__()
+        self.conv = nn.Conv2d(i, o, bias=False, **kw)
+        self.bn = nn.BatchNorm2d(o, eps=0.001)
+
+    def forward(self, x):
+        return F.relu(self.bn(self.conv(x)))
+
+
+def _avg(x):
+    return F.avg_pool2d(x, kernel_size=3, stride=1, padding=1, count_include_pad=False)
+
+
+class IncA(nn.Module):
+    def __init__(self, i, pool_features):
+        super().__init__()
+        self.branch1x1 = BasicConv2d(i, 64, kernel_size=1)
+        self.branch5x5_1 = BasicConv2d(i, 48, kernel_size=1)
+        self.branch5x5_2 = BasicConv2d(48, 64, kernel_size=5, padding=2)
+        self.branch3x3dbl_1 = BasicConv2d(i, 64, kernel_size=1)
+        self.branch3x3dbl_2 = BasicConv2d(64, 96, kernel_size=3, padding=1)
+        self.branch3x3dbl_3 = BasicConv2d(96, 96, kernel_size=3, padding=1)
+        self.branch_pool = BasicConv2d(i, pool_features, kernel_size=1)
+
+    def forward(self, x):
+        return torch.cat(
+            [
+                self.branch1x1(x),
+                self.branch5x5_2(self.branch5x5_1(x)),
+                self.branch3x3dbl_3(self.branch3x3dbl_2(self.branch3x3dbl_1(x))),
+                self.branch_pool(_avg(x)),
+            ],
+            1,
+        )
+
+
+class IncB(nn.Module):
+    def __init__(self, i):
+        super().__init__()
+        self.branch3x3 = BasicConv2d(i, 384, kernel_size=3, stride=2)
+        self.branch3x3dbl_1 = BasicConv2d(i, 64, kernel_size=1)
+        self.branch3x3dbl_2 = BasicConv2d(64, 96, kernel_size=3, padding=1)
+        self.branch3x3dbl_3 = BasicConv2d(96, 96, kernel_size=3, stride=2)
+
+    def forward(self, x):
+        return torch.cat(
+            [
+                self.branch3x3(x),
+                self.branch3x3dbl_3(self.branch3x3dbl_2(self.branch3x3dbl_1(x))),
+                F.max_pool2d(x, kernel_size=3, stride=2),
+            ],
+            1,
+        )
+
+
+class IncC(nn.Module):
+    def __init__(self, i, c7):
+        super().__init__()
+        self.branch1x1 = BasicConv2d(i, 192, kernel_size=1)
+        self.branch7x7_1 = BasicConv2d(i, c7, kernel_size=1)
+        self.branch7x7_2 = BasicConv2d(c7, c7, kernel_size=(1, 7), padding=(0, 3))
+        self.branch7x7_3 = BasicConv2d(c7, 192, kernel_size=(7, 1), padding=(3, 0))
+        self.branch7x7dbl_1 = BasicConv2d(i, c7, kernel_size=1)
+        self.branch7x7dbl_2 = BasicConv2d(c7, c7, kernel_size=(7, 1), padding=(3, 0))
+        self.branch7x7dbl_3 = BasicConv2d(c7, c7, kernel_size=(1, 7), padding=(0, 3))
+        self.branch7x7dbl_4 = BasicConv2d(c7, c7, kernel_size=(7, 1), padding=(3, 0))
+        self.branch7x7dbl_5 = BasicConv2d(c7, 192, kernel_size=(1, 7), padding=(0, 3))
+        self.branch_pool = BasicConv2d(i, 192, kernel_size=1)
+
+    def forward(self, x):
+        b7 = self.branch7x7_3(self.branch7x7_2(self.branch7x7_1(x)))
+        bd = self.branch7x7dbl_5(
+            self.branch7x7dbl_4(self.branch7x7dbl_3(self.branch7x7dbl_2(self.branch7x7dbl_1(x))))
+        )
+        return torch.cat([self.branch1x1(x), b7, bd, self.branch_pool(_avg(x))], 1)
+
+
+class IncD(nn.Module):
+    def __init__(self, i):
+        super().__init__()
+        self.branch3x3_1 = BasicConv2d(i, 192, kernel_size=1)
+        self.branch3x3_2 = BasicConv2d(192, 320, kernel_size=3, stride=2)
+        self.branch7x7x3_1 = BasicConv2d(i, 192, kernel_size=1)
+        self.branch7x7x3_2 = BasicConv2d(192, 192, kernel_size=(1, 7), padding=(0, 3))
+        self.branch7x7x3_3 = BasicConv2d(192, 192, kernel_size=(7, 1), padding=(3, 0))
+        self.branch7x7x3_4 = BasicConv2d(192, 192, kernel_size=3, stride=2)
+
+    def forward(self, x):
+        return torch.cat(
+            [
+                self.branch3x3_2(self.branch3x3_1(x)),
+                self.branch7x7x3_4(self.branch7x7x3_3(self.branch7x7x3_2(self.branch7x7x3_1(x)))),
+                F.max_pool2d(x, kernel_size=3, stride=2),
+            ],
+            1,
+        )
+
+
+class IncE(nn.Module):
+    def __init__(self, i, pool):
+        super().__init__()
+        self.pool = pool
+        self.branch1x1 = BasicConv2d(i, 320, kernel_size=1)
+        self.branch3x3_1 = BasicConv2d(i, 384, kernel_size=1)
+        self.branch3x3_2a = BasicConv2d(384, 384, kernel_size=(1, 3), padding=(0, 1))
+        self.branch3x3_2b = BasicConv2d(384, 384, kernel_size=(3, 1), padding=(1, 0))
+        self.branch3x3dbl_1 = BasicConv2d(i, 448, kernel_size=1)
+        self.branch3x3dbl_2 = BasicConv2d(448, 384, kernel_size=3, padding=1)
+        self.branch3x3dbl_3a = BasicConv2d(384, 384, kernel_size=(1, 3), padding=(0, 1))
+        self.branch3x3dbl_3b = BasicConv2d(384, 384, kernel_size=(3, 1), padding=(1, 0))
+        self.branch_pool = BasicConv2d(i, 192, kernel_size=1)
+
+    def forward(self, x):
+        b3 = self.branch3x3_1(x)
+        b3 = torch.cat([self.branch3x3_2a(b3), self.branch3x3_2b(b3)], 1)
+        bd = self.branch3x3dbl_2(self.branch3x3dbl_1(x))
+        bd = torch.cat([self.branch3x3dbl_3a(bd), self.branch3x3dbl_3b(bd)], 1)
+        pooled = _avg(x) if self.pool == "avg" else F.max_pool2d(x, kernel_size=3, stride=1, padding=1)
+        return torch.cat([self.branch1x1(x), b3, bd, self.branch_pool(pooled)], 1)
+
+
+class TorchFIDInception(nn.Module):
+    """Published FID InceptionV3 architecture, torch oracle for the JAX port."""
+
+    def __init__(self):
+        super().__init__()
+        self.Conv2d_1a_3x3 = BasicConv2d(3, 32, kernel_size=3, stride=2)
+        self.Conv2d_2a_3x3 = BasicConv2d(32, 32, kernel_size=3)
+        self.Conv2d_2b_3x3 = BasicConv2d(32, 64, kernel_size=3, padding=1)
+        self.Conv2d_3b_1x1 = BasicConv2d(64, 80, kernel_size=1)
+        self.Conv2d_4a_3x3 = BasicConv2d(80, 192, kernel_size=3)
+        self.Mixed_5b = IncA(192, 32)
+        self.Mixed_5c = IncA(256, 64)
+        self.Mixed_5d = IncA(288, 64)
+        self.Mixed_6a = IncB(288)
+        self.Mixed_6b = IncC(768, 128)
+        self.Mixed_6c = IncC(768, 160)
+        self.Mixed_6d = IncC(768, 160)
+        self.Mixed_6e = IncC(768, 192)
+        self.Mixed_7a = IncD(768)
+        self.Mixed_7b = IncE(1280, "avg")
+        self.Mixed_7c = IncE(2048, "max")
+        self.fc = nn.Linear(2048, 1008)
+
+    def forward(self, x, feature):
+        x = (x.float() - 128.0) / 128.0
+        x = self.Conv2d_1a_3x3(x)
+        x = self.Conv2d_2a_3x3(x)
+        x = self.Conv2d_2b_3x3(x)
+        x = F.max_pool2d(x, kernel_size=3, stride=2)
+        if feature == 64:
+            return x.mean(dim=(2, 3))
+        x = self.Conv2d_3b_1x1(x)
+        x = self.Conv2d_4a_3x3(x)
+        x = F.max_pool2d(x, kernel_size=3, stride=2)
+        if feature == 192:
+            return x.mean(dim=(2, 3))
+        for name in ["Mixed_5b", "Mixed_5c", "Mixed_5d", "Mixed_6a", "Mixed_6b", "Mixed_6c", "Mixed_6d", "Mixed_6e"]:
+            x = getattr(self, name)(x)
+        if feature == 768:
+            return x.flatten(2).mean(dim=-1)
+        x = self.Mixed_7a(x)
+        x = self.Mixed_7b(x)
+        x = self.Mixed_7c(x)
+        x = x.mean(dim=(2, 3))
+        if feature == 2048:
+            return x
+        logits = x @ self.fc.weight.T
+        if feature == "logits_unbiased":
+            return logits
+        return logits + self.fc.bias
+
+
+@pytest.fixture(scope="module")
+def torch_model():
+    torch.manual_seed(0)
+    model = TorchFIDInception().eval()
+    # non-trivial BN running stats so the BN folding is actually exercised
+    with torch.no_grad():
+        for m in model.modules():
+            if isinstance(m, nn.BatchNorm2d):
+                m.running_mean.normal_(0, 0.1)
+                m.running_var.uniform_(0.5, 1.5)
+    return model
+
+
+@pytest.fixture(scope="module")
+def jax_params(torch_model):
+    state = {k: v.numpy() for k, v in torch_model.state_dict().items()}
+    return params_from_state_dict(state)
+
+
+@pytest.mark.parametrize("feature", [64, 192, 768, 2048, "logits_unbiased", "logits"])
+def test_inception_matches_torch_oracle(torch_model, jax_params, feature):
+    rng = np.random.RandomState(1)
+    imgs = rng.randint(0, 256, (2, 3, 299, 299), dtype=np.uint8)  # 299: resize is identity
+    with torch.no_grad():
+        expected = torch_model(torch.tensor(imgs), feature).numpy()
+    got = np.asarray(inception_features(jax_params, jnp.asarray(imgs), feature))
+    assert got.shape == expected.shape
+    assert np.allclose(got, expected, atol=2e-3), np.abs(got - expected).max()
+
+
+def test_feature_dims(jax_params):
+    rng = np.random.RandomState(2)
+    imgs = jnp.asarray(rng.randint(0, 256, (1, 3, 64, 64), dtype=np.uint8))
+    for feature, dim in FEATURE_DIMS.items():
+        out = inception_features(jax_params, imgs, feature)
+        assert out.shape == (1, dim), feature
+
+
+def test_tf1_bilinear_resize_matches_naive():
+    rng = np.random.RandomState(3)
+    x = rng.rand(1, 2, 7, 5).astype(np.float32)
+    out = np.asarray(_tf1_bilinear_resize(jnp.asarray(x), 11, 9))
+
+    def naive(img, oh, ow):
+        ih, iw = img.shape
+        res = np.zeros((oh, ow), np.float32)
+        for dy in range(oh):
+            for dx in range(ow):
+                sy, sx = dy * ih / oh, dx * iw / ow
+                y0, x0 = min(int(np.floor(sy)), ih - 1), min(int(np.floor(sx)), iw - 1)
+                y1, x1 = min(y0 + 1, ih - 1), min(x0 + 1, iw - 1)
+                fy, fx = sy - y0, sx - x0
+                top = img[y0, x0] * (1 - fx) + img[y0, x1] * fx
+                bot = img[y1, x0] * (1 - fx) + img[y1, x1] * fx
+                res[dy, dx] = top * (1 - fy) + bot * fy
+        return res
+
+    for c in range(2):
+        assert np.allclose(out[0, c], naive(x[0, c], 11, 9), atol=1e-5)
+
+
+def test_fid_with_inception_weights_file(tmp_path, torch_model, monkeypatch):
+    """FrechetInceptionDistance(feature=2048) end-to-end via a weights file."""
+    import torch as _torch
+
+    pth = tmp_path / "weights.pth"
+    _torch.save(torch_model.state_dict(), str(pth))
+    monkeypatch.setenv("METRICS_TPU_INCEPTION_WEIGHTS", str(pth))
+
+    from metrics_tpu.image import FrechetInceptionDistance
+
+    fid = FrechetInceptionDistance(feature=2048)
+    rng = np.random.RandomState(4)
+    real = jnp.asarray(rng.randint(0, 256, (4, 3, 32, 32), dtype=np.uint8))
+    fake = jnp.asarray(rng.randint(0, 256, (4, 3, 32, 32), dtype=np.uint8))
+    fid.update(real, real=True)
+    fid.update(fake, real=False)
+    val = float(fid.compute())
+    assert np.isfinite(val) and val >= -1e-3  # tiny negatives = matrix-sqrt float noise
+
+    # npz conversion round-trip
+    from metrics_tpu.models.inception import convert_torch_fidelity_checkpoint, load_inception_params
+
+    npz = tmp_path / "weights.npz"
+    convert_torch_fidelity_checkpoint(str(pth), str(npz))
+    params_npz = load_inception_params(str(npz))
+    imgs = jnp.asarray(rng.randint(0, 256, (1, 3, 40, 40), dtype=np.uint8))
+    a = inception_features(load_inception_params(str(pth)), imgs, 2048)
+    b = inception_features(params_npz, imgs, 2048)
+    assert np.allclose(np.asarray(a), np.asarray(b))

@@ -1,0 +1,222 @@
+"""PSNR-B (differential vs reference) and LPIPS (differential vs torch replica) tests."""
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu.functional.image.psnrb import peak_signal_noise_ratio_with_blocked_effect
+from metrics_tpu.image import PeakSignalNoiseRatioWithBlockedEffect
+from metrics_tpu.models.lpips import (
+    LPIPS_CHANNELS,
+    alex_params_from_state_dict,
+    linear_weights_from_state_dict,
+    lpips_forward,
+    vgg_params_from_state_dict,
+)
+
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+from helpers.reference import import_reference_text, reference_available  # noqa: E402
+
+import_reference_text()
+needs_ref = pytest.mark.skipif(not reference_available(), reason="reference tree not mounted")
+
+_LPIPS_MODELS_DIR = "/root/reference/src/torchmetrics/functional/image/lpips_models"
+
+
+@needs_ref
+@pytest.mark.parametrize("block_size", [4, 8])
+def test_psnrb_vs_reference(block_size):
+    import torch
+    from torchmetrics.functional.image.psnrb import peak_signal_noise_ratio_with_blocked_effect as ref_fn
+
+    rng = np.random.RandomState(0)
+    preds = rng.rand(2, 1, 28, 28).astype(np.float32)
+    target = rng.rand(2, 1, 28, 28).astype(np.float32)
+    m = float(peak_signal_noise_ratio_with_blocked_effect(jnp.asarray(preds), jnp.asarray(target), block_size))
+    t = float(ref_fn(torch.tensor(preds), torch.tensor(target), block_size))
+    assert abs(m - t) < 1e-3, (m, t)
+
+
+@needs_ref
+def test_psnrb_class_vs_reference():
+    import torch
+    from torchmetrics.image.psnrb import PeakSignalNoiseRatioWithBlockedEffect as RefCls
+
+    rng = np.random.RandomState(1)
+    mine, theirs = PeakSignalNoiseRatioWithBlockedEffect(), RefCls()
+    for _ in range(3):
+        preds = rng.rand(2, 1, 16, 16).astype(np.float32)
+        target = rng.rand(2, 1, 16, 16).astype(np.float32)
+        mine.update(jnp.asarray(preds), jnp.asarray(target))
+        theirs.update(torch.tensor(preds), torch.tensor(target))
+    assert abs(float(mine.compute()) - float(theirs.compute())) < 1e-3
+
+
+def test_psnrb_rejects_multichannel():
+    with pytest.raises(ValueError, match="grayscale"):
+        peak_signal_noise_ratio_with_blocked_effect(jnp.zeros((1, 3, 16, 16)), jnp.zeros((1, 3, 16, 16)))
+
+
+# --------------------------------------------------------------------- LPIPS
+
+def _torch_lpips_oracle(net_type, state, lins_state, img1, img2, normalize):
+    """Published LPIPS pipeline on torch with the same weights (test oracle)."""
+    import torch
+    import torch.nn.functional as F
+
+    def conv(x, w, b, stride=1, padding=0):
+        return F.conv2d(x, torch.tensor(w), torch.tensor(b), stride=stride, padding=padding)
+
+    def alex_taps(x):
+        taps = []
+        x = F.relu(conv(x, state["features.0.weight"], state["features.0.bias"], 4, 2))
+        taps.append(x)
+        x = F.max_pool2d(x, 3, 2)
+        x = F.relu(conv(x, state["features.3.weight"], state["features.3.bias"], 1, 2))
+        taps.append(x)
+        x = F.max_pool2d(x, 3, 2)
+        x = F.relu(conv(x, state["features.6.weight"], state["features.6.bias"], 1, 1))
+        taps.append(x)
+        x = F.relu(conv(x, state["features.8.weight"], state["features.8.bias"], 1, 1))
+        taps.append(x)
+        x = F.relu(conv(x, state["features.10.weight"], state["features.10.bias"], 1, 1))
+        taps.append(x)
+        return taps
+
+    def vgg_taps(x):
+        taps = []
+        conv_idx = [0, 2, 5, 7, 10, 12, 14, 17, 19, 21, 24, 26, 28]
+        i = 0
+        for convs, pool in [(2, False), (2, True), (3, True), (3, True), (3, True)]:
+            if pool:
+                x = F.max_pool2d(x, 2, 2)
+            for _ in range(convs):
+                k = conv_idx[i]
+                x = F.relu(conv(x, state[f"features.{k}.weight"], state[f"features.{k}.bias"], 1, 1))
+                i += 1
+            taps.append(x)
+        return taps
+
+    tap_fn = {"alex": alex_taps, "vgg": vgg_taps}[net_type]
+    x1 = torch.tensor(np.asarray(img1, np.float32))
+    x2 = torch.tensor(np.asarray(img2, np.float32))
+    if normalize:
+        x1, x2 = 2 * x1 - 1, 2 * x2 - 1
+    shift = torch.tensor([-0.030, -0.088, -0.188]).view(1, 3, 1, 1)
+    scale = torch.tensor([0.458, 0.448, 0.450]).view(1, 3, 1, 1)
+    t1, t2 = tap_fn((x1 - shift) / scale), tap_fn((x2 - shift) / scale)
+    total = 0.0
+    for i, (f1, f2) in enumerate(zip(t1, t2)):
+        n1 = f1 / torch.sqrt((f1**2).sum(1, keepdim=True) + 1e-10)
+        n2 = f2 / torch.sqrt((f2**2).sum(1, keepdim=True) + 1e-10)
+        diff = (n1 - n2) ** 2
+        w = torch.tensor(np.asarray(lins_state[i]))  # (1, C)
+        res = torch.einsum("nchw,oc->nohw", diff, w)
+        total = total + res.mean(dim=(2, 3))[:, 0]
+    return total.numpy()
+
+
+def _random_backbone_state(net_type, rng):
+    shapes = {
+        "alex": {
+            "features.0": (64, 3, 11, 11),
+            "features.3": (192, 64, 5, 5),
+            "features.6": (384, 192, 3, 3),
+            "features.8": (256, 384, 3, 3),
+            "features.10": (256, 256, 3, 3),
+        },
+        "vgg": {
+            f"features.{k}": s
+            for k, s in zip(
+                [0, 2, 5, 7, 10, 12, 14, 17, 19, 21, 24, 26, 28],
+                [(64, 3, 3, 3), (64, 64, 3, 3), (128, 64, 3, 3), (128, 128, 3, 3), (256, 128, 3, 3),
+                 (256, 256, 3, 3), (256, 256, 3, 3), (512, 256, 3, 3), (512, 512, 3, 3), (512, 512, 3, 3),
+                 (512, 512, 3, 3), (512, 512, 3, 3), (512, 512, 3, 3)],
+            )
+        },
+    }[net_type]
+    state = {}
+    for prefix, shape in shapes.items():
+        state[f"{prefix}.weight"] = (rng.randn(*shape) * 0.1).astype(np.float32)
+        state[f"{prefix}.bias"] = (rng.randn(shape[0]) * 0.1).astype(np.float32)
+    return state
+
+
+@pytest.mark.parametrize("net_type", ["alex", "vgg"])
+@pytest.mark.parametrize("normalize", [False, True])
+def test_lpips_forward_vs_torch_oracle(net_type, normalize):
+    pytest.importorskip("torch")
+    rng = np.random.RandomState(3)
+    state = _random_backbone_state(net_type, rng)
+    n_taps = len(LPIPS_CHANNELS[net_type])
+    lins = [np.abs(rng.randn(1, c)).astype(np.float32) for c in LPIPS_CHANNELS[net_type][:n_taps]]
+
+    img1 = rng.rand(2, 3, 64, 64).astype(np.float32)
+    img2 = rng.rand(2, 3, 64, 64).astype(np.float32)
+    if not normalize:
+        img1, img2 = 2 * img1 - 1, 2 * img2 - 1
+
+    converter = {"alex": alex_params_from_state_dict, "vgg": vgg_params_from_state_dict}[net_type]
+    got = np.asarray(
+        lpips_forward(converter(state), [jnp.asarray(w) for w in lins], jnp.asarray(img1), jnp.asarray(img2),
+                      net_type, normalize)
+    )
+    want = _torch_lpips_oracle(net_type, state, lins, img1, img2, normalize)
+    assert np.allclose(got, want, atol=1e-4), np.abs(got - want).max()
+
+
+@pytest.mark.skipif(not os.path.isdir(_LPIPS_MODELS_DIR), reason="vendored lin weights not mounted")
+@pytest.mark.parametrize("net_type", ["alex", "vgg", "squeeze"])
+def test_vendored_linear_heads_load(net_type):
+    pytest.importorskip("torch")
+    import torch
+
+    state = torch.load(os.path.join(_LPIPS_MODELS_DIR, f"{net_type}.pth"), map_location="cpu")
+    state = {k: v.numpy() for k, v in state.items()}
+    lins = linear_weights_from_state_dict(state, net_type)
+    assert len(lins) == len(LPIPS_CHANNELS[net_type])
+    for w, c in zip(lins, LPIPS_CHANNELS[net_type]):
+        assert w.shape == (1, c)
+        assert np.all(np.asarray(w) >= 0)  # lpips lin heads are non-negative
+
+
+def test_lpips_class_end_to_end(tmp_path):
+    pytest.importorskip("torch")
+    import torch
+
+    rng = np.random.RandomState(5)
+    state = _random_backbone_state("alex", rng)
+    backbone_path = tmp_path / "alex_backbone.pth"
+    torch.save({k: torch.tensor(v) for k, v in state.items()}, str(backbone_path))
+    lins_path = os.path.join(_LPIPS_MODELS_DIR, "alex.pth")
+    if not os.path.exists(lins_path):
+        pytest.skip("vendored lin weights not mounted")
+
+    from metrics_tpu.image import LearnedPerceptualImagePatchSimilarity
+
+    metric = LearnedPerceptualImagePatchSimilarity(
+        net_type="alex", backbone_weights=str(backbone_path), linear_weights=lins_path
+    )
+    img1 = jnp.asarray(2 * rng.rand(2, 3, 48, 48).astype(np.float32) - 1)
+    img2 = jnp.asarray(2 * rng.rand(2, 3, 48, 48).astype(np.float32) - 1)
+    metric.update(img1, img2)
+    metric.update(img1, img1)  # identical pair contributes ~0
+    val = float(metric.compute())
+    assert np.isfinite(val) and val >= 0
+    # identical images give (near) zero distance
+    metric.reset()
+    metric.update(img1, img1)
+    assert float(metric.compute()) < 1e-5
+
+
+def test_lpips_missing_weights_raise():
+    from metrics_tpu.image import LearnedPerceptualImagePatchSimilarity
+
+    if os.environ.get("METRICS_TPU_LPIPS_ALEX_WEIGHTS"):
+        pytest.skip("weights configured in environment")
+    with pytest.raises(ModuleNotFoundError, match="backbone"):
+        LearnedPerceptualImagePatchSimilarity(net_type="alex")

@@ -1,0 +1,81 @@
+"""CLIPScore tests with deterministic fake encoders (no model downloads).
+
+The score math (normalize, cosine, x100, clamp-at-0, running mean) is checked
+against a numpy oracle; the reference's HF model path requires downloads and is
+identical math on different embeddings.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu.functional.multimodal import clip_score
+from metrics_tpu.multimodal import CLIPScore
+
+_rng = np.random.RandomState(0)
+_D = 12
+_W = _rng.randn(256, _D).astype(np.float32)
+
+
+def image_encoder(images):
+    # deterministic embedding from the mean intensity bucket of each image
+    buckets = np.asarray(images).astype(np.float32).mean(axis=(1, 2, 3)).astype(np.int64) % 256
+    return jnp.asarray(_W[buckets])
+
+
+def text_encoder(captions):
+    return jnp.asarray(_W[[hash(c) % 256 for c in captions]])
+
+
+def _oracle(images, captions):
+    img = np.asarray(image_encoder(images))
+    txt = np.asarray(text_encoder(captions))
+    img = img / np.linalg.norm(img, axis=-1, keepdims=True)
+    txt = txt / np.linalg.norm(txt, axis=-1, keepdims=True)
+    return 100 * (img * txt).sum(-1)
+
+
+IMAGES = _rng.randint(0, 256, (4, 3, 16, 16)).astype(np.uint8)
+CAPTIONS = ["a cat", "a dog", "a house", "a tree"]
+
+
+def test_functional_matches_oracle():
+    got = float(clip_score(jnp.asarray(IMAGES), CAPTIONS, image_encoder=image_encoder, text_encoder=text_encoder))
+    want = max(_oracle(IMAGES, CAPTIONS).mean(), 0.0)
+    assert abs(got - want) < 1e-4
+
+
+def test_single_image_and_caption():
+    got = float(
+        clip_score(jnp.asarray(IMAGES[0]), CAPTIONS[0], image_encoder=image_encoder, text_encoder=text_encoder)
+    )
+    want = max(float(_oracle(IMAGES[:1], CAPTIONS[:1])[0]), 0.0)
+    assert abs(got - want) < 1e-4
+
+
+def test_class_running_mean():
+    metric = CLIPScore(image_encoder=image_encoder, text_encoder=text_encoder)
+    metric.update(jnp.asarray(IMAGES[:2]), CAPTIONS[:2])
+    metric.update(jnp.asarray(IMAGES[2:]), CAPTIONS[2:])
+    got = float(metric.compute())
+    want = max(_oracle(IMAGES, CAPTIONS).mean(), 0.0)
+    assert abs(got - want) < 1e-4
+    metric.reset()
+    assert int(metric.n_samples) == 0
+
+
+def test_mismatched_lengths_raise():
+    with pytest.raises(ValueError, match="same"):
+        clip_score(jnp.asarray(IMAGES), CAPTIONS[:2], image_encoder=image_encoder, text_encoder=text_encoder)
+
+
+def test_encoder_pair_required_together():
+    with pytest.raises(ValueError, match="together"):
+        CLIPScore(image_encoder=image_encoder)
+
+
+def test_list_of_3d_images():
+    imgs = [jnp.asarray(IMAGES[i]) for i in range(4)]
+    got = float(clip_score(imgs, CAPTIONS, image_encoder=image_encoder, text_encoder=text_encoder))
+    want = max(_oracle(IMAGES, CAPTIONS).mean(), 0.0)
+    assert abs(got - want) < 1e-4
