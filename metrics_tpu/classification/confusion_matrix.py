@@ -31,7 +31,18 @@ from metrics_tpu.utils.data import _count_dtype
 from metrics_tpu.utils.enums import ClassificationTask
 
 
-class BinaryConfusionMatrix(Metric):
+class _ConfusionMatrixPlotMixin:
+    """Shared heatmap plot for the three confusion-matrix tasks."""
+
+    def plot(self, val=None, ax=None, add_text=True, labels=None):
+        """Heatmap of the (synced) confusion matrix (reference: confusion_matrix.py plot)."""
+        from metrics_tpu.utils.plot import plot_confusion_matrix
+
+        val = val if val is not None else self.compute()
+        return plot_confusion_matrix(val, ax=ax, add_text=add_text, labels=labels)
+
+
+class BinaryConfusionMatrix(_ConfusionMatrixPlotMixin, Metric):
     """2x2 confusion matrix (reference: classification/confusion_matrix.py:30-118).
 
     Example:
@@ -77,7 +88,7 @@ class BinaryConfusionMatrix(Metric):
         return _binary_confusion_matrix_compute(self.confmat, self.normalize)
 
 
-class MulticlassConfusionMatrix(Metric):
+class MulticlassConfusionMatrix(_ConfusionMatrixPlotMixin, Metric):
     """CxC confusion matrix (reference: classification/confusion_matrix.py:120-218).
 
     Example:
@@ -124,7 +135,7 @@ class MulticlassConfusionMatrix(Metric):
         return _multiclass_confusion_matrix_compute(self.confmat, self.normalize)
 
 
-class MultilabelConfusionMatrix(Metric):
+class MultilabelConfusionMatrix(_ConfusionMatrixPlotMixin, Metric):
     """(L,2,2) confusion matrices (reference: classification/confusion_matrix.py:220-318).
 
     Example:

@@ -34,7 +34,21 @@ from metrics_tpu.utils.data import _count_dtype, dim_zero_cat
 from metrics_tpu.utils.enums import ClassificationTask
 
 
-class BinaryPrecisionRecallCurve(Metric):
+class _PrecisionRecallCurvePlotMixin:
+    """Shared curve plot for the three PR-curve tasks."""
+
+    def plot(self, curve=None, score=None, ax=None):
+        """Plot the precision-recall curve (reference: precision_recall_curve.py plot)."""
+        from metrics_tpu.utils.plot import plot_curve
+
+        curve = curve if curve is not None else self.compute()
+        return plot_curve(
+            (curve[1], curve[0], curve[2]), score=score, ax=ax,
+            label_names=("Recall", "Precision"), name=self.__class__.__name__,
+        )
+
+
+class BinaryPrecisionRecallCurve(_PrecisionRecallCurvePlotMixin, Metric):
     """Binary PR curve (reference: classification/precision_recall_curve.py:35-180).
 
     Example:
@@ -92,8 +106,7 @@ class BinaryPrecisionRecallCurve(Metric):
         state = (dim_zero_cat(self.preds), dim_zero_cat(self.target)) if self.thresholds is None else self.confmat
         return _binary_precision_recall_curve_compute(state, self.thresholds)
 
-
-class MulticlassPrecisionRecallCurve(Metric):
+class MulticlassPrecisionRecallCurve(_PrecisionRecallCurvePlotMixin, Metric):
     """Multiclass PR curve (reference: classification/precision_recall_curve.py:182-340)."""
 
     is_differentiable: bool = False
@@ -143,8 +156,7 @@ class MulticlassPrecisionRecallCurve(Metric):
         state = (dim_zero_cat(self.preds), dim_zero_cat(self.target)) if self.thresholds is None else self.confmat
         return _multiclass_precision_recall_curve_compute(state, self.num_classes, self.thresholds)
 
-
-class MultilabelPrecisionRecallCurve(Metric):
+class MultilabelPrecisionRecallCurve(_PrecisionRecallCurvePlotMixin, Metric):
     """Multilabel PR curve (reference: classification/precision_recall_curve.py:342-500)."""
 
     is_differentiable: bool = False
@@ -193,7 +205,6 @@ class MultilabelPrecisionRecallCurve(Metric):
     def compute(self) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
         state = (dim_zero_cat(self.preds), dim_zero_cat(self.target)) if self.thresholds is None else self.confmat
         return _multilabel_precision_recall_curve_compute(state, self.num_labels, self.thresholds, self.ignore_index)
-
 
 class PrecisionRecallCurve:
     """Task dispatcher (reference: classification/precision_recall_curve.py:502-560)."""

@@ -19,7 +19,22 @@ from metrics_tpu.utils.data import dim_zero_cat
 from metrics_tpu.utils.enums import ClassificationTask
 
 
-class BinaryROC(BinaryPrecisionRecallCurve):
+class _ROCPlotMixin:
+    """Shared curve plot for the three ROC tasks (overrides the PR-curve mixin)."""
+
+    def plot(self, curve=None, score=None, ax=None):
+        """Plot the ROC curve (reference: roc.py plot)."""
+        from metrics_tpu.utils.plot import plot_curve
+
+        curve = curve if curve is not None else self.compute()
+        return plot_curve(
+            curve, score=score, ax=ax,
+            label_names=("False positive rate", "True positive rate"),
+            name=self.__class__.__name__,
+        )
+
+
+class BinaryROC(_ROCPlotMixin, BinaryPrecisionRecallCurve):
     """Binary ROC (reference: classification/roc.py:41-160).
 
     Example:
@@ -41,8 +56,7 @@ class BinaryROC(BinaryPrecisionRecallCurve):
         state = (dim_zero_cat(self.preds), dim_zero_cat(self.target)) if self.thresholds is None else self.confmat
         return _binary_roc_compute(state, self.thresholds)
 
-
-class MulticlassROC(MulticlassPrecisionRecallCurve):
+class MulticlassROC(_ROCPlotMixin, MulticlassPrecisionRecallCurve):
     """Multiclass ROC (reference: classification/roc.py:162-310)."""
 
     is_differentiable: bool = False
@@ -53,8 +67,7 @@ class MulticlassROC(MulticlassPrecisionRecallCurve):
         state = (dim_zero_cat(self.preds), dim_zero_cat(self.target)) if self.thresholds is None else self.confmat
         return _multiclass_roc_compute(state, self.num_classes, self.thresholds)
 
-
-class MultilabelROC(MultilabelPrecisionRecallCurve):
+class MultilabelROC(_ROCPlotMixin, MultilabelPrecisionRecallCurve):
     """Multilabel ROC (reference: classification/roc.py:312-460)."""
 
     is_differentiable: bool = False
@@ -64,7 +77,6 @@ class MultilabelROC(MultilabelPrecisionRecallCurve):
     def compute(self) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
         state = (dim_zero_cat(self.preds), dim_zero_cat(self.target)) if self.thresholds is None else self.confmat
         return _multilabel_roc_compute(state, self.num_labels, self.thresholds, self.ignore_index)
-
 
 class ROC:
     """Task dispatcher (reference: classification/roc.py:420-467)."""

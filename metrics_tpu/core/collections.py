@@ -327,3 +327,44 @@ class MetricCollection:
         for _, m in self.items(keep_base=True, copy_state=False):
             m.to(device)
         return self
+
+    def plot(self, val=None, ax=None, together=False):
+        """Plot all metrics in the collection (reference: collections.py:492-577).
+
+        Args:
+            val: precomputed dict of results (or list of such dicts for a time
+                series); defaults to calling ``compute``.
+            ax: a single axis (``together=True``) or a sequence of axes, one per
+                metric.
+            together: draw all metrics into one axis instead of a grid.
+
+        Returns:
+            List of (figure, axis) tuples (a single tuple when ``together``).
+        """
+        from metrics_tpu.utils.plot import plot_single_or_multi_val
+
+        if not isinstance(together, bool):
+            raise ValueError(f"Expected argument `together` to be a boolean, but got {together}")
+        if ax is not None:
+            if together and not hasattr(ax, "plot"):
+                raise ValueError("Expected argument `ax` to be a matplotlib axis when `together=True`")
+            if not together and hasattr(ax, "flatten"):
+                ax = list(ax.flatten())  # accept the ndarray plt.subplots returns
+            if not together and (not isinstance(ax, (list, tuple)) or len(ax) != len(self)):
+                raise ValueError(
+                    f"Expected argument `ax` to be a sequence of matplotlib axis objects with the same length as the "
+                    f"number of metrics in the collection, but got {type(ax)} with len {len(ax) if isinstance(ax, (list, tuple)) else 'n/a'}"
+                )
+        val = val if val is not None else self.compute()
+        if together:
+            return plot_single_or_multi_val(val, ax=ax)
+        fig_axs = []
+        for i, (k, m) in enumerate(self.items()):
+            if isinstance(val, dict) and k in val:
+                f_a = m.plot(val[k], ax=ax[i] if ax is not None else None)
+            elif isinstance(val, (list, tuple)):
+                f_a = m.plot([v[k] for v in val], ax=ax[i] if ax is not None else None)
+            else:
+                f_a = m.plot(None, ax=ax[i] if ax is not None else None)
+            fig_axs.append(f_a)
+        return fig_axs

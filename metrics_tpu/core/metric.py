@@ -567,6 +567,35 @@ class Metric(ABC):
         yield
         self.unsync(should_unsync=self._is_synced and should_unsync)
 
+    # ------------------------------------------------------------------ plot
+
+    def plot(self, val: Any = None, ax: Any = None) -> Any:
+        """Plot a single or multiple values from the metric (reference: metric.py:580).
+
+        Args:
+            val: a result of ``forward``/``compute``, or a list of them (plotted as a
+                time series). Defaults to calling ``compute``.
+            ax: matplotlib axis to draw into.
+
+        Returns:
+            (figure, axis) tuple.
+        """
+        return self._plot(val, ax)
+
+    def _plot(self, val: Any = None, ax: Any = None) -> Any:
+        from metrics_tpu.utils.plot import plot_single_or_multi_val
+
+        val = val if val is not None else self.compute()
+        return plot_single_or_multi_val(
+            val,
+            ax=ax,
+            higher_is_better=self.higher_is_better,
+            name=self.__class__.__name__,
+            lower_bound=self.plot_lower_bound,
+            upper_bound=self.plot_upper_bound,
+            legend_name=self.plot_legend_name,
+        )
+
     # ----------------------------------------------------------------- reset
 
     def reset(self) -> None:

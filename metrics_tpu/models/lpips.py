@@ -17,6 +17,7 @@ All forwards are jit-safe pure functions over explicit parameter pytrees
 (NCHW/OIHW, conversion transpose-free).
 """
 import os
+from functools import lru_cache
 from typing import Any, Dict, List, Sequence, Tuple, Union
 
 import jax
@@ -223,12 +224,23 @@ def _load_state(path: str) -> Dict[str, np.ndarray]:
     return load_checkpoint_state(path)
 
 
+@lru_cache(maxsize=8)
+def _load_lpips_cached(net_type: str, backbone_weights: str, linear_weights: str) -> Tuple[Any, List[Array]]:
+    backbone = _BACKBONE_CONVERTERS[net_type](_load_state(backbone_weights))
+    lins = linear_weights_from_state_dict(_load_state(linear_weights), net_type)
+    return backbone, lins
+
+
 def load_lpips(
     net_type: str = "vgg",
     backbone_weights: Union[str, None] = None,
     linear_weights: Union[str, None] = None,
 ) -> Tuple[Any, List[Array]]:
-    """Load (backbone_params, linear_weights) for :func:`lpips_forward`."""
+    """Load (backbone_params, linear_weights) for :func:`lpips_forward`.
+
+    Results are cached per (net_type, paths) so per-batch functional calls don't
+    re-read the multi-hundred-MB checkpoints from disk.
+    """
     if net_type not in LPIPS_CHANNELS:
         raise ValueError(f"Argument `net_type` must be one of {tuple(LPIPS_CHANNELS)}, but got {net_type}")
     backbone_weights = backbone_weights or os.environ.get(f"METRICS_TPU_LPIPS_{net_type.upper()}_WEIGHTS")
@@ -244,6 +256,4 @@ def load_lpips(
             "LPIPS requires the learned lin-head weights (lpips-format .pth, e.g. the reference's vendored"
             " functional/image/lpips_models/*.pth). Set `linear_weights` or METRICS_TPU_LPIPS_LINEAR_WEIGHTS."
         )
-    backbone = _BACKBONE_CONVERTERS[net_type](_load_state(backbone_weights))
-    lins = linear_weights_from_state_dict(_load_state(linear_weights), net_type)
-    return backbone, lins
+    return _load_lpips_cached(net_type, backbone_weights, linear_weights)

@@ -1,0 +1,128 @@
+"""`.plot()` observability API tests (reference: tests/unittests/utilities/test_plot.py model).
+
+Matplotlib Agg backend; asserts figures/axes materialize for every plot surface:
+scalar metrics, per-class values, time series, dicts, confusion matrices, curves,
+and MetricCollection grids.
+"""
+import matplotlib
+
+matplotlib.use("Agg")
+
+import matplotlib.pyplot as plt
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu import (
+    BinaryConfusionMatrix,
+    BinaryPrecisionRecallCurve,
+    BinaryROC,
+    MetricCollection,
+    MulticlassAccuracy,
+    MulticlassConfusionMatrix,
+    MultilabelConfusionMatrix,
+    WordErrorRate,
+)
+from metrics_tpu.regression import MeanAbsoluteError, MeanSquaredError
+from metrics_tpu.utils.plot import plot_confusion_matrix, plot_curve, plot_single_or_multi_val
+
+_rng = np.random.RandomState(0)
+
+
+@pytest.fixture(autouse=True)
+def _close_figures():
+    yield
+    plt.close("all")
+
+
+def test_plot_scalar_metric():
+    m = MeanSquaredError()
+    m.update(jnp.asarray([1.0, 2.0]), jnp.asarray([1.0, 3.0]))
+    fig, ax = m.plot()
+    assert fig is not None and ax is not None
+
+
+def test_plot_perclass_metric():
+    m = MulticlassAccuracy(num_classes=3, average=None)
+    m.update(jnp.asarray([0, 1, 2, 2]), jnp.asarray([0, 1, 1, 2]))
+    fig, ax = m.plot()
+    assert ax.get_ylabel() == "MulticlassAccuracy"
+
+
+def test_plot_time_series():
+    m = MeanSquaredError()
+    vals = []
+    for i in range(3):
+        vals.append(m(jnp.asarray([1.0, 2.0]) + i, jnp.asarray([1.0, 3.0])))
+    fig, ax = m.plot(vals)
+    assert ax.get_xlabel() == "Step"
+
+
+def test_plot_into_existing_axis():
+    fig, ax = plt.subplots()
+    m = WordErrorRate()
+    m.update(["a b"], ["a c"])
+    out_fig, out_ax = m.plot(ax=ax)
+    assert out_ax is ax
+
+
+def test_plot_single_or_multi_val_dict():
+    fig, ax = plot_single_or_multi_val({"a": jnp.asarray(0.5), "b": jnp.asarray(0.7)})
+    assert len(ax.get_legend_handles_labels()[0]) == 2
+
+
+def test_plot_confusion_matrix_binary():
+    m = BinaryConfusionMatrix()
+    m.update(jnp.asarray([0.2, 0.8, 0.6]), jnp.asarray([0, 1, 1]))
+    fig, ax = m.plot()
+    assert fig is not None
+
+
+def test_plot_confusion_matrix_multiclass_labels():
+    m = MulticlassConfusionMatrix(num_classes=3)
+    m.update(jnp.asarray([0, 1, 2, 2]), jnp.asarray([0, 1, 1, 2]))
+    fig, ax = m.plot(labels=["cat", "dog", "bird"])
+    assert fig is not None
+    with pytest.raises(ValueError, match="number of elements"):
+        m.plot(labels=["too", "few"])
+
+
+def test_plot_confusion_matrix_multilabel_grid():
+    m = MultilabelConfusionMatrix(num_labels=3)
+    preds = jnp.asarray((_rng.rand(8, 3) > 0.5).astype(np.int32))
+    target = jnp.asarray((_rng.rand(8, 3) > 0.5).astype(np.int32))
+    m.update(preds, target)
+    fig, axs = m.plot()
+    assert len(axs) == 3
+
+
+def test_plot_pr_curve_and_roc():
+    preds = jnp.asarray(_rng.rand(64).astype(np.float32))
+    target = jnp.asarray((_rng.rand(64) > 0.5).astype(np.int32))
+    c = BinaryPrecisionRecallCurve(thresholds=10)
+    c.update(preds, target)
+    fig, ax = c.plot()
+    assert ax.get_xlabel() == "Recall"
+    r = BinaryROC(thresholds=10)
+    r.update(preds, target)
+    fig, ax = r.plot()
+    assert ax.get_xlabel() == "False positive rate"
+
+
+def test_plot_curve_with_score():
+    x = jnp.linspace(0, 1, 10)
+    y = 1 - x
+    fig, ax = plot_curve((x, y, x), score=jnp.asarray(0.5), label_names=("x", "y"))
+    assert "AUC=0.500" in ax.get_legend_handles_labels()[1][0]
+
+
+def test_collection_plot_grid_and_together():
+    col = MetricCollection([MeanSquaredError(), MeanAbsoluteError()])
+    col.update(jnp.asarray([1.0, 2.0]), jnp.asarray([1.0, 3.0]))
+    out = col.plot()
+    assert len(out) == 2
+    fig, ax = col.plot(together=True)
+    assert fig is not None
+    with pytest.raises(ValueError, match="together"):
+        col.plot(together="yes")
