@@ -100,12 +100,12 @@ class BinaryFairness(_AbstractGroupStatScores):
     Example:
         >>> import jax.numpy as jnp
         >>> from metrics_tpu.classification import BinaryFairness
-        >>> target = jnp.array([0, 1, 0, 1, 0, 1])
-        >>> preds = jnp.array([0, 1, 0, 1, 0, 1])
-        >>> groups = jnp.array([0, 1, 0, 1, 0, 1])
+        >>> target = jnp.array([0, 1, 1, 1, 0, 1])
+        >>> preds = jnp.array([0, 1, 1, 0, 0, 1])
+        >>> groups = jnp.array([0, 0, 0, 1, 1, 1])
         >>> metric = BinaryFairness(2)
         >>> metric(preds, target, groups)
-        {'DP_0_1': Array(0., dtype=float32), 'EO_0_1': Array(0., dtype=float32)}
+        {'DP_1_0': Array(0.5, dtype=float32), 'EO_1_0': Array(0.5, dtype=float32)}
     """
 
     is_differentiable = False

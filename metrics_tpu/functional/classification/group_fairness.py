@@ -135,11 +135,12 @@ def _compute_binary_demographic_parity(tp: Array, fp: Array, tn: Array, fn: Arra
     # argmin as phantom rate-0 groups (ADVICE r1)
     min_pos_rate_id = int(jnp.argmin(jnp.where(pop > 0, pos_rates, jnp.inf)))
     max_pos_rate_id = int(jnp.argmax(jnp.where(pop > 0, pos_rates, -jnp.inf)))
-    return {
-        f"DP_{min_pos_rate_id}_{max_pos_rate_id}": _safe_divide(
-            pos_rates[min_pos_rate_id], pos_rates[max_pos_rate_id]
-        )
-    }
+    ratio = _safe_divide(pos_rates[min_pos_rate_id], pos_rates[max_pos_rate_id])
+    if int(jnp.sum(pop > 0)) < 2:
+        # a single measurable group cannot be compared with anything: report NaN
+        # instead of a perfect-fairness self-comparison
+        ratio = jnp.asarray(jnp.nan, ratio.dtype)
+    return {f"DP_{min_pos_rate_id}_{max_pos_rate_id}": ratio}
 
 
 def demographic_parity(
@@ -173,11 +174,11 @@ def _compute_binary_equal_opportunity(tp: Array, fp: Array, tn: Array, fn: Array
     # exclude zero-population groups from selection (ADVICE r1)
     min_pos_rate_id = int(jnp.argmin(jnp.where(pop > 0, true_pos_rates, jnp.inf)))
     max_pos_rate_id = int(jnp.argmax(jnp.where(pop > 0, true_pos_rates, -jnp.inf)))
-    return {
-        f"EO_{min_pos_rate_id}_{max_pos_rate_id}": _safe_divide(
-            true_pos_rates[min_pos_rate_id], true_pos_rates[max_pos_rate_id]
-        )
-    }
+    ratio = _safe_divide(true_pos_rates[min_pos_rate_id], true_pos_rates[max_pos_rate_id])
+    if int(jnp.sum(pop > 0)) < 2:
+        # fewer than two groups have positive targets: the comparison is undefined
+        ratio = jnp.asarray(jnp.nan, ratio.dtype)
+    return {f"EO_{min_pos_rate_id}_{max_pos_rate_id}": ratio}
 
 
 def equal_opportunity(
@@ -193,11 +194,11 @@ def equal_opportunity(
     Example:
         >>> import jax.numpy as jnp
         >>> from metrics_tpu.functional.classification import equal_opportunity
-        >>> target = jnp.array([0, 1, 0, 1, 0, 1])
-        >>> preds = jnp.array([0.11, 0.84, 0.22, 0.73, 0.33, 0.92])
-        >>> groups = jnp.array([0, 1, 0, 1, 0, 1])
+        >>> target = jnp.array([0, 1, 1, 1, 0, 1])
+        >>> preds = jnp.array([0.1, 0.9, 0.8, 0.4, 0.2, 0.7])
+        >>> groups = jnp.array([0, 0, 0, 1, 1, 1])
         >>> equal_opportunity(preds, target, groups)
-        {'EO_0_1': Array(0., dtype=float32)}
+        {'EO_1_0': Array(0.5, dtype=float32)}
     """
     num_groups = int(np.asarray(groups).max()) + 1
     group_stats = _binary_groups_stat_scores(preds, target, groups, num_groups, threshold, ignore_index, validate_args)

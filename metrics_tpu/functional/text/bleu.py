@@ -113,7 +113,7 @@ def bleu_score(
         >>> preds = ['the cat is on the mat']
         >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
         >>> bleu_score(preds, target)
-        Array(0.75983, dtype=float32)
+        Array(0.7598..., dtype=float32)
     """
     preds_ = [preds] if isinstance(preds, str) else preds
     target_ = [[tgt] if isinstance(tgt, str) else tgt for tgt in target]

@@ -204,7 +204,7 @@ def chrf_score(
         >>> preds = ['the cat is on the mat']
         >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
         >>> chrf_score(preds, target)
-        Array(0.86398, dtype=float32)
+        Array(0.8640..., dtype=float32)
     """
     if not isinstance(n_char_order, int) or n_char_order < 1:
         raise ValueError("Expected argument `n_char_order` to be an integer greater than or equal to 1.")

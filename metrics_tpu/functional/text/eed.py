@@ -156,7 +156,7 @@ def extended_edit_distance(
         >>> preds = ["this is the prediction", "here is an other sample"]
         >>> target = ["this is the reference", "here is another one"]
         >>> extended_edit_distance(preds=preds, target=target)
-        Array(0.30778, dtype=float32)
+        Array(0.3077..., dtype=float32)
     """
     for param_name, param in zip(["alpha", "rho", "deletion", "insertion"], [alpha, rho, deletion, insertion]):
         if not isinstance(param, float) or param < 0:

@@ -173,7 +173,7 @@ def sacre_bleu_score(
         >>> preds = ['the cat is on the mat']
         >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
         >>> sacre_bleu_score(preds, target)
-        Array(0.75983, dtype=float32)
+        Array(0.7598..., dtype=float32)
     """
     if tokenize not in AVAILABLE_TOKENIZERS:
         raise ValueError(f"Argument `tokenize` expected to be one of {AVAILABLE_TOKENIZERS} but got {tokenize}.")

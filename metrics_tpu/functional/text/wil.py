@@ -44,7 +44,7 @@ def word_information_lost(preds: Union[str, Sequence[str]], target: Union[str, S
         >>> preds = ["this is the prediction", "there is an other sample"]
         >>> target = ["this is the reference", "there is another one"]
         >>> word_information_lost(preds=preds, target=target)
-        Array(0.65277773, dtype=float32)
+        Array(0.6527..., dtype=float32)
     """
     hits, target_total, preds_total = _wil_update(preds, target)
     return _wil_compute(hits, target_total, preds_total)

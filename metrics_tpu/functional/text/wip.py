@@ -21,7 +21,7 @@ def word_information_preserved(preds: Union[str, Sequence[str]], target: Union[s
         >>> preds = ["this is the prediction", "there is an other sample"]
         >>> target = ["this is the reference", "there is another one"]
         >>> word_information_preserved(preds=preds, target=target)
-        Array(0.3472222, dtype=float32)
+        Array(0.3472..., dtype=float32)
     """
     hits, target_total, preds_total = _wip_update(preds, target)
     return _wip_compute(hits, target_total, preds_total)

@@ -22,7 +22,7 @@ class BLEUScore(Metric):
         >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
         >>> bleu = BLEUScore()
         >>> bleu(preds, target)
-        Array(0.75983, dtype=float32)
+        Array(0.7598..., dtype=float32)
     """
 
     is_differentiable = False

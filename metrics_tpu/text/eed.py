@@ -25,7 +25,7 @@ class ExtendedEditDistance(Metric):
         >>> target = ["this is the reference", "here is another one"]
         >>> eed = ExtendedEditDistance()
         >>> eed(preds=preds, target=target)
-        Array(0.30778, dtype=float32)
+        Array(0.3077..., dtype=float32)
     """
 
     is_differentiable = False

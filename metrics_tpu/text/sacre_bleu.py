@@ -22,7 +22,7 @@ class SacreBLEUScore(BLEUScore):
         >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
         >>> sacre_bleu = SacreBLEUScore()
         >>> sacre_bleu(preds, target)
-        Array(0.75983, dtype=float32)
+        Array(0.7598..., dtype=float32)
     """
 
     def __init__(

@@ -17,7 +17,7 @@ class WordInfoLost(Metric):
         >>> target = ["this is the reference", "there is another one"]
         >>> wil = WordInfoLost()
         >>> wil(preds, target)
-        Array(0.65277773, dtype=float32)
+        Array(0.6527..., dtype=float32)
     """
 
     is_differentiable = False

@@ -17,7 +17,7 @@ class WordInfoPreserved(Metric):
         >>> target = ["this is the reference", "there is another one"]
         >>> wip = WordInfoPreserved()
         >>> wip(preds, target)
-        Array(0.3472222, dtype=float32)
+        Array(0.3472..., dtype=float32)
     """
 
     is_differentiable = False

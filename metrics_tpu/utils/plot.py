@@ -120,7 +120,7 @@ def _get_col_row_split(n: int) -> Tuple[int, int]:
     nsq = np.sqrt(n)
     if int(nsq) ** 2 == n:
         return int(nsq), int(nsq)
-    if int(np.floor(nsq)) * int(np.ceil(nsq)) > n:
+    if int(np.floor(nsq)) * int(np.ceil(nsq)) >= n:
         return int(np.floor(nsq)), int(np.ceil(nsq))
     return int(np.ceil(nsq)), int(np.ceil(nsq))
 
@@ -192,7 +192,8 @@ def plot_confusion_matrix(
         if add_text:
             for ii, jj in product(range(n_classes), range(n_classes)):
                 v = confmat[i, ii, jj] if confmat.ndim == 3 else confmat[ii, jj]
-                ax_i.text(jj, ii, str(v.item()), ha="center", va="center", fontsize=15)
+                txt = f"{v.item():.3g}" if np.issubdtype(confmat.dtype, np.floating) else str(v.item())
+                ax_i.text(jj, ii, txt, ha="center", va="center", fontsize=15)
     return fig, axs
 
 

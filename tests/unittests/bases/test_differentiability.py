@@ -1,0 +1,128 @@
+"""Differentiability tier (reference: testers.py:509-543 run_differentiability_test).
+
+For metrics declaring ``is_differentiable=True``, ``jax.grad`` of the pure
+``compute_from(local_update(init_state, preds, target))`` path w.r.t. ``preds``
+must exist, be finite, and match central finite differences on sampled
+coordinates (the JAX analogue of ``autograd.gradcheck``).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.audio import ScaleInvariantSignalDistortionRatio, SignalNoiseRatio
+from metrics_tpu.classification import MulticlassAccuracy
+from metrics_tpu.image import PeakSignalNoiseRatio, StructuralSimilarityIndexMeasure, TotalVariation
+from metrics_tpu.regression import (
+    CosineSimilarity,
+    ExplainedVariance,
+    MeanAbsoluteError,
+    MeanSquaredError,
+    PearsonCorrCoef,
+    R2Score,
+)
+from metrics_tpu.text import Perplexity
+
+_rng = np.random.RandomState(7)
+
+
+def _finite_difference(fn, preds, indices, eps=1e-3):
+    grads = []
+    flat = np.asarray(preds, np.float64).ravel()
+    for idx in indices:
+        plus, minus = flat.copy(), flat.copy()
+        plus[idx] += eps
+        minus[idx] -= eps
+        f_plus = float(fn(jnp.asarray(plus.reshape(preds.shape), jnp.float32)))
+        f_minus = float(fn(jnp.asarray(minus.reshape(preds.shape), jnp.float32)))
+        grads.append((f_plus - f_minus) / (2 * eps))
+    return np.array(grads)
+
+
+_CASES = [
+    ("mse", lambda: MeanSquaredError(), (16,), lambda r: r.randn(16).astype(np.float32)),
+    ("mae", lambda: MeanAbsoluteError(), (16,), lambda r: r.randn(16).astype(np.float32)),
+    ("r2", lambda: R2Score(), (16,), lambda r: r.randn(16).astype(np.float32)),
+    ("explained_variance", lambda: ExplainedVariance(), (16,), lambda r: r.randn(16).astype(np.float32)),
+    ("cosine", lambda: CosineSimilarity(), (4, 8), lambda r: r.randn(4, 8).astype(np.float32)),
+    ("pearson", lambda: PearsonCorrCoef(), (16,), lambda r: r.randn(16).astype(np.float32)),
+    ("snr", lambda: SignalNoiseRatio(), (2, 64), lambda r: r.randn(2, 64).astype(np.float32)),
+    ("si_sdr", lambda: ScaleInvariantSignalDistortionRatio(), (2, 64), lambda r: r.randn(2, 64).astype(np.float32)),
+    ("psnr", lambda: PeakSignalNoiseRatio(data_range=4.0), (2, 8, 8), lambda r: r.randn(2, 8, 8).astype(np.float32)),
+]
+
+
+_SINGLE_ARG_CASES = [
+    ("tv", lambda: TotalVariation(), (1, 1, 8, 8)),
+]
+
+
+@pytest.mark.parametrize("name, factory, shape, target_gen", _CASES, ids=[c[0] for c in _CASES])
+def test_grad_matches_finite_differences(name, factory, shape, target_gen):
+    metric = factory()
+    assert metric.is_differentiable, f"{name} should declare is_differentiable"
+    preds = jnp.asarray(_rng.randn(*shape).astype(np.float32))
+    target = jnp.asarray(target_gen(_rng))
+
+    def scalar_metric(p):
+        m = factory()
+        state = m.local_update(m.init_state(), p, target)
+        return jnp.sum(jnp.asarray(m.compute_from(state)))
+
+    grad = np.asarray(jax.grad(scalar_metric)(preds))
+    assert np.all(np.isfinite(grad)), name
+
+    indices = _rng.choice(preds.size, size=min(5, preds.size), replace=False)
+    fd = _finite_difference(scalar_metric, np.asarray(preds), indices)
+    got = grad.ravel()[indices]
+    assert np.allclose(got, fd, atol=1e-2, rtol=5e-2), (name, got, fd)
+
+
+@pytest.mark.parametrize("name, factory, shape", _SINGLE_ARG_CASES, ids=[c[0] for c in _SINGLE_ARG_CASES])
+def test_single_arg_grad_matches_finite_differences(name, factory, shape):
+    preds = jnp.asarray(_rng.rand(*shape).astype(np.float32))
+
+    def scalar_metric(p):
+        m = factory()
+        state = m.local_update(m.init_state(), p)
+        return jnp.sum(jnp.asarray(m.compute_from(state)))
+
+    grad = np.asarray(jax.grad(scalar_metric)(preds))
+    assert np.all(np.isfinite(grad)), name
+    indices = _rng.choice(preds.size, size=5, replace=False)
+    fd = _finite_difference(scalar_metric, np.asarray(preds), indices)
+    assert np.allclose(grad.ravel()[indices], fd, atol=1e-2, rtol=5e-2), name
+
+
+def test_ssim_grad_finite():
+    metric = StructuralSimilarityIndexMeasure(data_range=1.0)
+    assert metric.is_differentiable
+    preds = jnp.asarray(_rng.rand(1, 1, 16, 16).astype(np.float32))
+    target = jnp.asarray(_rng.rand(1, 1, 16, 16).astype(np.float32))
+
+    def scalar_metric(p):
+        m = StructuralSimilarityIndexMeasure(data_range=1.0)
+        state = m.local_update(m.init_state(), p, target)
+        return jnp.sum(jnp.asarray(m.compute_from(state)))
+
+    grad = np.asarray(jax.grad(scalar_metric)(preds))
+    assert np.all(np.isfinite(grad)) and np.any(grad != 0)
+
+
+def test_perplexity_grad_finite():
+    logits = jnp.asarray(_rng.randn(2, 6, 5).astype(np.float32))
+    target = jnp.asarray(_rng.randint(0, 5, (2, 6)).astype(np.int32))
+
+    def scalar_metric(lg):
+        m = Perplexity(validate_args=False)
+        state = m.local_update(m.init_state(), lg, target)
+        return m.compute_from(state)
+
+    grad = np.asarray(jax.grad(scalar_metric)(logits))
+    assert np.all(np.isfinite(grad)) and np.any(grad != 0)
+
+
+def test_non_differentiable_declared():
+    # argmax-style metrics must declare is_differentiable=False
+    assert MulticlassAccuracy(num_classes=3).is_differentiable is False

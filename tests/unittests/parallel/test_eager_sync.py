@@ -1,0 +1,140 @@
+"""Eager cross-process sync tests (reference: tests/unittests/bases/test_ddp.py:33-272).
+
+A real multi-process JAX runtime isn't available in CI, so the two seams are
+exercised the way the reference tests its own: ``dist_sync_fn`` injection into
+``Metric._sync_dist`` with a fake world-of-2 gather, and monkeypatched
+``process_count``/``process_allgather`` for ``gather_all_tensors``.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.utils.distributed import gather_all_tensors
+
+
+class _SumMetric(Metric):
+    full_state_update = True
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("x", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, x):
+        self.x = self.x + x
+
+    def compute(self):
+        return self.x
+
+
+class _CatMetric(Metric):
+    full_state_update = True
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("vals", [], dist_reduce_fx="cat")
+
+    def update(self, x):
+        self.vals.append(x)
+
+    def compute(self):
+        from metrics_tpu.utils.data import dim_zero_cat
+
+        return dim_zero_cat(self.vals)
+
+
+class _StackMetric(Metric):
+    full_state_update = True
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("stats", jnp.zeros(3), dist_reduce_fx=None)
+
+    def update(self, x):
+        self.stats = self.stats + x
+
+    def compute(self):
+        return self.stats
+
+
+def _fake_world2_gather(tensor, group=None):
+    """Pretend a second process holds tensor + 10."""
+    return [tensor, tensor + 10]
+
+
+def test_sync_sum_state_with_injected_gather():
+    m = _SumMetric(dist_sync_fn=_fake_world2_gather, distributed_available_fn=lambda: True)
+    m.update(jnp.asarray(3.0))
+    m.sync(dist_sync_fn=_fake_world2_gather, distributed_available=lambda: True)
+    assert float(m.x) == 3.0 + 13.0  # sum over the fake 2-process world
+    m.unsync()
+    assert float(m.x) == 3.0  # local state restored
+
+
+def test_sync_cat_state_with_injected_gather():
+    m = _CatMetric(dist_sync_fn=_fake_world2_gather, distributed_available_fn=lambda: True)
+    m.update(jnp.asarray([1.0, 2.0]))
+    m.update(jnp.asarray([3.0]))
+    m.sync(dist_sync_fn=_fake_world2_gather, distributed_available=lambda: True)
+    from metrics_tpu.utils.data import dim_zero_cat
+
+    synced = np.asarray(dim_zero_cat(m.vals))
+    assert np.allclose(np.sort(synced), np.sort(np.asarray([1.0, 2.0, 3.0, 11.0, 12.0, 13.0])))
+    m.unsync()
+    assert len(m.vals) == 2
+
+
+def test_sync_none_reduction_stacks_ranks():
+    m = _StackMetric(dist_sync_fn=_fake_world2_gather, distributed_available_fn=lambda: True)
+    m.update(jnp.asarray([1.0, 2.0, 3.0]))
+    m.sync(dist_sync_fn=_fake_world2_gather, distributed_available=lambda: True)
+    assert np.asarray(m.stats).shape == (2, 3)  # (world, ...) stack, reference parity
+    m.unsync()
+    assert np.asarray(m.stats).shape == (3,)
+
+
+def test_double_sync_raises():
+    from metrics_tpu.utils.exceptions import MetricsUserError
+
+    m = _SumMetric()
+    m.update(jnp.asarray(1.0))
+    m.sync(dist_sync_fn=_fake_world2_gather, distributed_available=lambda: True)
+    with pytest.raises(MetricsUserError, match="already been synced"):
+        m.sync(dist_sync_fn=_fake_world2_gather, distributed_available=lambda: True)
+    m.unsync()
+    with pytest.raises(MetricsUserError, match="been un-synced"):
+        m.unsync()
+
+
+def test_compute_with_sync_uses_gathered_state():
+    m = _SumMetric(dist_sync_fn=_fake_world2_gather, distributed_available_fn=lambda: True)
+    m.update(jnp.asarray(5.0))
+    assert float(m.compute()) == 5.0 + 15.0
+    # accumulation continues locally after the synced compute
+    m.update(jnp.asarray(1.0))
+    assert float(m.x) == 6.0
+
+
+def test_gather_all_tensors_single_process():
+    x = jnp.asarray([1.0, 2.0])
+    out = gather_all_tensors(x)
+    assert len(out) == 1 and np.allclose(np.asarray(out[0]), [1.0, 2.0])
+
+
+def test_gather_all_tensors_multiprocess_branch(monkeypatch):
+    import jax
+    from jax.experimental import multihost_utils
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(
+        multihost_utils, "process_allgather", lambda x: jnp.stack([x, x + 10])
+    )
+    out = gather_all_tensors(jnp.asarray([1.0, 2.0]))
+    assert len(out) == 2
+    assert np.allclose(np.asarray(out[1]), [11.0, 12.0])
+
+
+def test_gather_all_tensors_rejects_subgroups():
+    with pytest.raises(NotImplementedError, match="sub-group"):
+        gather_all_tensors(jnp.asarray(1.0), group="tp")

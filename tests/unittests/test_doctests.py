@@ -1,0 +1,26 @@
+"""Doctest tier: run every docstring example in the package.
+
+Reference model: the CI "DocTesting" step runs ``pytest --doctest-modules`` over
+the whole source tree (.azure/gpu-unittests.yml:138-143). Here each module is a
+parametrized case so a failing example names its module directly.
+"""
+import doctest
+import importlib
+import pkgutil
+
+import pytest
+
+import metrics_tpu
+
+_MODULES = sorted(
+    m.name
+    for m in pkgutil.walk_packages(metrics_tpu.__path__, prefix="metrics_tpu.")
+    if not m.ispkg
+)
+
+
+@pytest.mark.parametrize("module_name", _MODULES)
+def test_module_doctests(module_name):
+    module = importlib.import_module(module_name)
+    results = doctest.testmod(module, optionflags=doctest.NORMALIZE_WHITESPACE | doctest.ELLIPSIS)
+    assert results.failed == 0, f"{results.failed} doctest failure(s) in {module_name}"
