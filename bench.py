@@ -4,18 +4,21 @@ BASELINE.json config 1 / north star: metric-updates/sec/chip on 1B preds,
 ``MulticlassAccuracy(task="multiclass", num_classes=5)``. The reference publishes no
 numbers (BASELINE.md), so ``vs_baseline`` is measured locally: throughput of this
 framework's jitted TPU path divided by the reference-equivalent torch-CPU kernel
-(torch argmax-free micro accuracy on int labels) on the same machine.
+on the same machine.
 
-Measurement notes (round 2): on the tunneled backend ``jax.block_until_ready``
-returns before device work completes, producing impossible >1 Tpreds/s readings
-(VERDICT r1). The only trustworthy sync point is a device->host value fetch
-(``jax.device_get``) of the final state, which this bench uses. The first timed
-pass after compilation is also discarded (queue warm-up). The resulting number is
-roofline-honest: the trivial fused eq+sum kernel measures the same ~100 GB/s HBM
-bandwidth as this metric's full stat-scores update, i.e. the framework adds zero
-overhead over the hardware limit.
-
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Measurement design (hardened across rounds):
+- **Fresh data every step.** The update is a ``lax.scan`` over a pre-generated
+  ``(steps, chunk)`` device buffer, so each step reads new HBM. Scanning the same
+  buffer repeatedly lets XLA hoist the loop-invariant update out of the scan and
+  produces impossible (>1 Tpreds/s) readings — the round-1 bug, re-verified this
+  round with cost analysis.
+- **One true sync, RTT amortized.** On the tunneled backend only a device->host
+  value fetch is a trustworthy sync, and one round trip costs ~100 ms — more than
+  the on-device compute for a full 1B-pred pass. The timed region queues R
+  independent full passes (the device executes dispatches in order) and fetches
+  the final state once, so the RTT is amortized to ~1/R of the measurement.
+- A sanity assert pins the computed accuracy to the expected ~0.2 for uniform
+  5-class labels, so a silently-wrong kernel cannot post a number.
 """
 import json
 import time
@@ -23,46 +26,58 @@ import time
 import jax
 import jax.numpy as jnp
 
+STEPS = 60
+CHUNK = 1 << 24  # STEPS * CHUNK ≈ 1.007e9 preds, 8 GB for both int32 buffers
+REPEATS = 10
 
-def bench_tpu(total_elems: int = 1_000_000_000, chunk: int = 1 << 27) -> float:
+
+def bench_tpu() -> float:
     from metrics_tpu.classification import MulticlassAccuracy
 
     metric = MulticlassAccuracy(num_classes=5, average="micro", validate_args=False)
 
-    # NOTE: no donate_argnums — buffer donation of the scalar state triggers
-    # INVALID_ARGUMENT on this TPU backend (VERDICT r1); the state is a few
-    # scalars so donation saves nothing anyway.
-    update = jax.jit(metric.local_update)
+    # fill the 8 GB of buffers one chunk at a time so RNG transients stay at
+    # chunk size (a monolithic randint would transiently need ~12 GB of HBM)
+    @jax.jit
+    def _gen_buffers(key):
+        def fill(i, carry):
+            p, t = carry
+            kp = jax.random.fold_in(key, 2 * i)
+            kt = jax.random.fold_in(key, 2 * i + 1)
+            p = jax.lax.dynamic_update_index_in_dim(
+                p, jax.random.randint(kp, (CHUNK,), 0, 5, jnp.int32), i, 0
+            )
+            t = jax.lax.dynamic_update_index_in_dim(
+                t, jax.random.randint(kt, (CHUNK,), 0, 5, jnp.int32), i, 0
+            )
+            return p, t
+        zeros = jnp.zeros((STEPS, CHUNK), jnp.int32)
+        return jax.lax.fori_loop(0, STEPS, fill, (zeros, zeros))
 
-    # pre-generate device-resident batches and cycle through them so the
-    # measurement is the metric update, not RNG
-    key = jax.random.PRNGKey(0)
-    n_bufs = 2
-    bufs = []
-    for _ in range(n_bufs):
-        k1, k2, key = jax.random.split(key, 3)
-        preds = jax.random.randint(k1, (chunk,), 0, 5, dtype=jnp.int32)
-        target = jax.random.randint(k2, (chunk,), 0, 5, dtype=jnp.int32)
-        bufs.append((preds, target))
+    preds, target = _gen_buffers(jax.random.PRNGKey(0))
 
-    steps = max(1, total_elems // chunk)
+    @jax.jit
+    def run_pass(state, p, t):
+        def step(s, batch):
+            return metric.local_update(s, *batch), None
+        state, _ = jax.lax.scan(step, state, (p, t))
+        return state
 
-    def timed_pass() -> float:
-        state = metric.init_state()
+    # compile + warm-up
+    state = run_pass(metric.init_state(), preds, target)
+    jax.device_get(state)
+
+    def timed() -> float:
         t0 = time.perf_counter()
-        for i in range(steps):
-            state = update(state, *bufs[i % n_bufs])
-        host_state = jax.device_get(state)  # true sync: value must cross the wire
+        states = [run_pass(metric.init_state(), preds, target) for _ in range(REPEATS)]
+        host_state = jax.device_get(states[-1])  # in-order queue: forces all passes
         dt = time.perf_counter() - t0
         value = float(metric.compute_from(jax.tree.map(jnp.asarray, host_state)))
         assert 0.15 < value < 0.25, f"sanity: uniform 5-class accuracy ~0.2, got {value}"
-        return steps * chunk / dt
+        return REPEATS * STEPS * CHUNK / dt
 
-    # compile + warm-up, then a discarded pass (first pass after compile reads fast)
-    state = update(metric.init_state(), *bufs[0])
-    jax.device_get(state)
-    timed_pass()
-    return max(timed_pass(), timed_pass())
+    timed()  # discard first timed pass (queue warm-up)
+    return max(timed(), timed())
 
 
 def bench_torch_cpu(total_elems: int = 1 << 26, chunk: int = 1 << 24) -> float:
