@@ -1,0 +1,92 @@
+"""Histogram kernel tiers (ops/histogram.py): compare tier, Pallas tier (interpreted
+on CPU), drop semantics, padding, and dispatch behavior vs a numpy oracle.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.ops import histogram
+from metrics_tpu.utils.data import _bincount, _bincount_weighted
+
+_rng = np.random.RandomState(0)
+
+
+def _oracle(x, w, bins):
+    out = np.zeros(bins, np.float64)
+    for xi, wi in zip(np.asarray(x), np.asarray(w)):
+        if 0 <= xi < bins:
+            out[xi] += wi
+    return out
+
+
+@pytest.mark.parametrize("bins", [5, 25, 64, 300])
+def test_compare_bincount_matches_oracle(bins):
+    x = jnp.asarray(_rng.randint(-2, bins + 3, 5000).astype(np.int32))  # incl. out-of-range
+    w = jnp.asarray(_rng.rand(5000).astype(np.float32))
+    got = histogram._compare_bincount(x, w, bins)
+    assert np.allclose(np.asarray(got), _oracle(x, w, bins), atol=1e-3)
+    got_unweighted = histogram._compare_bincount(x, None, bins)
+    assert np.allclose(np.asarray(got_unweighted), _oracle(x, np.ones(5000), bins))
+
+
+@pytest.mark.parametrize("n", [100, histogram._BLOCK, histogram._BLOCK + 17, 3 * histogram._BLOCK])
+def test_pallas_bincount_interpret_matches_oracle(n):
+    bins = 25
+    x = jnp.asarray(_rng.randint(0, bins, n).astype(np.int32))
+    w = jnp.asarray(_rng.rand(n).astype(np.float32))
+    got = histogram._pallas_bincount(x, w, bins, interpret=True)
+    assert np.allclose(np.asarray(got), _oracle(x, w, bins), atol=1e-2)
+
+
+def test_pallas_bincount_drops_out_of_range():
+    bins = 8
+    x = jnp.asarray(np.array([0, 3, 7, 8, 100, -1] * 100, np.int32))
+    w = jnp.ones((600,), jnp.float32)
+    got = histogram._pallas_bincount(x, w, bins, interpret=True)
+    assert np.allclose(np.asarray(got), _oracle(x, w, bins))
+
+
+def test_bincount_dispatch_small_bins_uses_compare():
+    # on CPU test backend pallas is ineligible; small bins -> compare tier
+    x = jnp.asarray(_rng.randint(0, 10, 1000).astype(np.int32))
+    got = _bincount(x, 10)
+    assert np.allclose(np.asarray(got), _oracle(x, np.ones(1000), 10))
+
+
+def test_bincount_dispatch_large_bins_falls_back_to_scatter():
+    bins = histogram.COMPARE_MAX_BINS + 1
+    x = jnp.asarray(_rng.randint(0, bins, 1000).astype(np.int32))
+    got = _bincount(x, bins)
+    assert np.allclose(np.asarray(got), _oracle(x, np.ones(1000), bins))
+
+
+def test_bincount_weighted_dispatch_matches_oracle():
+    x = jnp.asarray(_rng.randint(0, 25, 4000).astype(np.int32))
+    w = jnp.asarray(_rng.rand(4000).astype(np.float32))
+    got = _bincount_weighted(x, w, 25)
+    assert np.allclose(np.asarray(got), _oracle(x, w, 25), atol=1e-3)
+
+
+def test_bincount_under_jit_and_shard_map():
+    from functools import partial
+
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from metrics_tpu.parallel import make_data_mesh
+
+    x = jnp.asarray(_rng.randint(0, 8, 640).astype(np.int32))
+
+    jit_out = jax.jit(lambda v: _bincount(v, 8))(x)
+    assert np.allclose(np.asarray(jit_out), _oracle(x, np.ones(640), 8))
+
+    mesh = make_data_mesh(8)
+
+    @partial(shard_map, mesh=mesh, in_specs=P("data"), out_specs=P())
+    def sharded(v):
+        return jax.lax.psum(_bincount(v, 8), "data")
+
+    out = jax.jit(sharded)(x)
+    assert np.allclose(np.asarray(out), _oracle(x, np.ones(640), 8))
