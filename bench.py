@@ -7,16 +7,18 @@ framework's jitted TPU path divided by the reference-equivalent torch-CPU kernel
 on the same machine.
 
 Measurement design (hardened across rounds):
-- **Fresh data every step.** The update is a ``lax.scan`` over a pre-generated
-  ``(steps, chunk)`` device buffer, so each step reads new HBM. Scanning the same
-  buffer repeatedly lets XLA hoist the loop-invariant update out of the scan and
-  produces impossible (>1 Tpreds/s) readings — the round-1 bug, re-verified this
-  round with cost analysis.
+- **Real HBM traffic every step.** Each pass chains 4 dependent jitted updates
+  over two alternating device-resident (2^28,) buffer pairs — 1.07B preds/pass,
+  2 GB of fresh reads per update (far beyond VMEM, so nothing can be cached, and
+  separate XLA executions cannot be loop-invariant-hoisted the way a scanned
+  fixed buffer was in round 1's impossible >1 Tpreds/s readings). A dispatch
+  loop rather than ``lax.scan`` also measures ~6x faster here: consecutive
+  executions pipeline reads against compute, which a serialized scan body does
+  not.
 - **One true sync, RTT amortized.** On the tunneled backend only a device->host
-  value fetch is a trustworthy sync, and one round trip costs ~100 ms — more than
-  the on-device compute for a full 1B-pred pass. The timed region queues R
-  independent full passes (the device executes dispatches in order) and fetches
-  the final state once, so the RTT is amortized to ~1/R of the measurement.
+  value fetch is a trustworthy sync, and one round trip costs ~100 ms. The timed
+  region queues R=20 passes (the device executes dispatches in order) and
+  fetches the final state once.
 - A sanity assert pins the computed accuracy to the expected ~0.2 for uniform
   5-class labels, so a silently-wrong kernel cannot post a number.
 """
@@ -26,9 +28,9 @@ import time
 import jax
 import jax.numpy as jnp
 
-STEPS = 60
-CHUNK = 1 << 24  # STEPS * CHUNK ≈ 1.007e9 preds, 8 GB for both int32 buffers
-REPEATS = 10
+CHUNK = 1 << 28  # elements per update; 2 GB of int32 reads per step
+STEPS = 4        # updates per pass -> 1.07e9 preds per pass
+REPEATS = 20
 
 
 def bench_tpu() -> float:
@@ -36,41 +38,27 @@ def bench_tpu() -> float:
 
     metric = MulticlassAccuracy(num_classes=5, average="micro", validate_args=False)
 
-    # fill the 8 GB of buffers one chunk at a time so RNG transients stay at
-    # chunk size (a monolithic randint would transiently need ~12 GB of HBM)
-    @jax.jit
-    def _gen_buffers(key):
-        def fill(i, carry):
-            p, t = carry
-            kp = jax.random.fold_in(key, 2 * i)
-            kt = jax.random.fold_in(key, 2 * i + 1)
-            p = jax.lax.dynamic_update_index_in_dim(
-                p, jax.random.randint(kp, (CHUNK,), 0, 5, jnp.int32), i, 0
-            )
-            t = jax.lax.dynamic_update_index_in_dim(
-                t, jax.random.randint(kt, (CHUNK,), 0, 5, jnp.int32), i, 0
-            )
-            return p, t
-        zeros = jnp.zeros((STEPS, CHUNK), jnp.int32)
-        return jax.lax.fori_loop(0, STEPS, fill, (zeros, zeros))
+    key = jax.random.PRNGKey(0)
+    bufs = []
+    for _ in range(2):
+        k1, k2, key = jax.random.split(key, 3)
+        preds = jax.random.randint(k1, (CHUNK,), 0, 5, dtype=jnp.int32)
+        target = jax.random.randint(k2, (CHUNK,), 0, 5, dtype=jnp.int32)
+        bufs.append((preds, target))
 
-    preds, target = _gen_buffers(jax.random.PRNGKey(0))
-
-    @jax.jit
-    def run_pass(state, p, t):
-        def step(s, batch):
-            return metric.local_update(s, *batch), None
-        state, _ = jax.lax.scan(step, state, (p, t))
-        return state
-
-    # compile + warm-up
-    state = run_pass(metric.init_state(), preds, target)
-    jax.device_get(state)
+    update = jax.jit(metric.local_update)
+    state = update(metric.init_state(), *bufs[0])
+    jax.device_get(state)  # compile + warm-up; also forces buffer generation
 
     def timed() -> float:
         t0 = time.perf_counter()
-        states = [run_pass(metric.init_state(), preds, target) for _ in range(REPEATS)]
-        host_state = jax.device_get(states[-1])  # in-order queue: forces all passes
+        last = None
+        for _ in range(REPEATS):
+            state = metric.init_state()
+            for i in range(STEPS):
+                state = update(state, *bufs[i % 2])
+            last = state
+        host_state = jax.device_get(last)  # in-order queue: forces all passes
         dt = time.perf_counter() - t0
         value = float(metric.compute_from(jax.tree.map(jnp.asarray, host_state)))
         assert 0.15 < value < 0.25, f"sanity: uniform 5-class accuracy ~0.2, got {value}"

@@ -128,19 +128,33 @@ def _provably_unsharded(x: Array) -> bool:
         return False
 
 
+def _on_tpu(x: Array) -> bool:
+    """Platform of the array's committed device, falling back to the default backend.
+
+    The default backend alone is wrong on mixed hosts (e.g. a CPU-committed array
+    on a machine whose default backend is the TPU — the make_data_mesh test setup):
+    a Pallas TPU kernel cannot consume CPU-resident data.
+    """
+    try:
+        devices = x.sharding.device_set
+        return all(d.platform == "tpu" for d in devices)
+    except Exception:
+        return jax.default_backend() == "tpu"
+
+
 def _pallas_eligible(x: Array, num_bins: int) -> bool:
     return (
         num_bins <= PALLAS_MAX_BINS
         and x.size >= PALLAS_MIN_SIZE
-        and jax.default_backend() == "tpu"
+        and _on_tpu(x)
         and _provably_unsharded(x)
     )
 
 
-def bincount_weighted(x: Array, weights: Array, num_bins: int) -> Array:
-    """Weighted static-length histogram with drop semantics; fastest available tier."""
+def _dispatch(x: Array, weights: Optional[Array], num_bins: int) -> Optional[Array]:
     x = jnp.asarray(x).ravel()
-    weights = jnp.asarray(weights).ravel()
+    if weights is not None:
+        weights = jnp.asarray(weights).ravel()
     if _pallas_eligible(x, num_bins):
         return _pallas_bincount(x.astype(jnp.int32), weights, num_bins)
     if num_bins <= COMPARE_MAX_BINS:
@@ -148,11 +162,11 @@ def bincount_weighted(x: Array, weights: Array, num_bins: int) -> Array:
     return None  # caller falls back to scatter
 
 
-def bincount(x: Array, num_bins: int) -> Array:
+def bincount_weighted(x: Array, weights: Array, num_bins: int) -> Optional[Array]:
+    """Weighted static-length histogram with drop semantics; fastest available tier."""
+    return _dispatch(x, weights, num_bins)
+
+
+def bincount(x: Array, num_bins: int) -> Optional[Array]:
     """Unweighted static-length histogram with drop semantics; fastest tier."""
-    x = jnp.asarray(x).ravel()
-    if _pallas_eligible(x, num_bins):
-        return _pallas_bincount(x.astype(jnp.int32), None, num_bins)
-    if num_bins <= COMPARE_MAX_BINS:
-        return _compare_bincount(x, None, num_bins)
-    return None  # caller falls back to scatter
+    return _dispatch(x, None, num_bins)
