@@ -129,17 +129,25 @@ def _provably_unsharded(x: Array) -> bool:
 
 
 def _on_tpu(x: Array) -> bool:
-    """Platform of the array's committed device, falling back to the default backend.
+    """Best-effort platform of the computation, preferring real device info.
 
-    The default backend alone is wrong on mixed hosts (e.g. a CPU-committed array
-    on a machine whose default backend is the TPU — the make_data_mesh test setup):
-    a Pallas TPU kernel cannot consume CPU-resident data.
+    Eager arrays expose their committed devices; under jit the tracer aval only
+    carries an abstract mesh (no platform), so the ``jax_default_device`` config
+    (set by ``with jax.default_device(...)``) and then the default backend decide.
+    Residual limitation: an explicitly CPU-committed operand traced under plain
+    ``jit`` on a TPU-default host is indistinguishable at trace time and fails
+    loudly at lowering ("Only interpret mode is supported on CPU backend") —
+    wrap such computations in ``jax.default_device`` to route them here.
     """
     try:
         devices = x.sharding.device_set
         return all(d.platform == "tpu" for d in devices)
     except Exception:
-        return jax.default_backend() == "tpu"
+        pass
+    default_device = jax.config.jax_default_device
+    if default_device is not None:
+        return getattr(default_device, "platform", None) == "tpu"
+    return jax.default_backend() == "tpu"
 
 
 def _pallas_eligible(x: Array, num_bins: int) -> bool:

@@ -1,0 +1,107 @@
+"""bf16 precision tier (reference: testers.py:443-507 run_precision_test_cpu/gpu).
+
+Every representative metric family must accept bfloat16 inputs (the TPU-native
+half precision) and produce a value close to its float32 result within bf16's
+~3-decimal-digit tolerance.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu.audio import ScaleInvariantSignalDistortionRatio, SignalNoiseRatio
+from metrics_tpu.classification import (
+    BinaryAccuracy,
+    BinaryAUROC,
+    BinaryF1Score,
+    MulticlassAccuracy,
+    MulticlassConfusionMatrix,
+)
+from metrics_tpu.image import PeakSignalNoiseRatio, StructuralSimilarityIndexMeasure
+from metrics_tpu.regression import MeanAbsoluteError, MeanSquaredError, PearsonCorrCoef, R2Score
+from metrics_tpu.retrieval import RetrievalMAP
+from metrics_tpu.text import Perplexity
+
+_rng = np.random.RandomState(11)
+
+
+def _run_both(factory, *arrays, int_args=()):
+    """Run a metric on f32 and bf16 casts of the same float inputs."""
+    results = []
+    for dtype in (jnp.float32, jnp.bfloat16):
+        metric = factory()
+        cast = [jnp.asarray(a).astype(dtype) if np.issubdtype(np.asarray(a).dtype, np.floating) else jnp.asarray(a)
+                for a in arrays]
+        metric.update(*cast, *int_args)
+        results.append(np.asarray(metric.compute(), np.float64))
+    return results
+
+
+@pytest.mark.parametrize(
+    "name, factory, gen",
+    [
+        ("mse", lambda: MeanSquaredError(), lambda: (_rng.rand(64), _rng.rand(64))),
+        ("mae", lambda: MeanAbsoluteError(), lambda: (_rng.rand(64), _rng.rand(64))),
+        ("r2", lambda: R2Score(), lambda: (np.linspace(0, 1, 64) + 0.05 * _rng.rand(64), np.linspace(0, 1, 64))),
+        ("pearson", lambda: PearsonCorrCoef(), lambda: (np.linspace(0, 1, 64) + 0.05 * _rng.rand(64), np.linspace(0, 1, 64))),
+        ("binary_acc", lambda: BinaryAccuracy(), lambda: (_rng.rand(128), (_rng.rand(128) > 0.5).astype(np.int32))),
+        ("binary_f1", lambda: BinaryF1Score(), lambda: (_rng.rand(128), (_rng.rand(128) > 0.5).astype(np.int32))),
+        ("binary_auroc", lambda: BinaryAUROC(thresholds=20), lambda: (_rng.rand(128), (_rng.rand(128) > 0.5).astype(np.int32))),
+        ("snr", lambda: SignalNoiseRatio(), lambda: ((x := _rng.randn(256)), x + 0.3 * _rng.randn(256))),
+        ("si_sdr", lambda: ScaleInvariantSignalDistortionRatio(), lambda: ((x := _rng.randn(256)), x + 0.3 * _rng.randn(256))),
+        ("psnr", lambda: PeakSignalNoiseRatio(data_range=1.0), lambda: (_rng.rand(2, 8, 8), _rng.rand(2, 8, 8))),
+    ],
+)
+def test_bf16_matches_f32(name, factory, gen):
+    arrays = gen()
+    f32, bf16 = _run_both(factory, *arrays)
+    assert np.all(np.isfinite(bf16)), name
+    # bf16 has ~8 mantissa bits: allow ~1% relative + small absolute slack
+    assert np.allclose(bf16, f32, rtol=2e-2, atol=5e-2), (name, f32, bf16)
+
+
+def test_bf16_multiclass_int_inputs_unaffected():
+    preds = _rng.randint(0, 5, 256).astype(np.int32)
+    target = _rng.randint(0, 5, 256).astype(np.int32)
+    m = MulticlassAccuracy(num_classes=5)
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    c = MulticlassConfusionMatrix(num_classes=5)
+    c.update(jnp.asarray(preds), jnp.asarray(target))
+    assert np.isfinite(float(m.compute()))
+    assert int(np.asarray(c.compute()).sum()) == 256
+
+
+def test_bf16_probability_inputs_multiclass():
+    logits = _rng.rand(64, 5).astype(np.float32)
+    target = _rng.randint(0, 5, 64).astype(np.int32)
+    f32, bf16 = _run_both(
+        lambda: MulticlassAccuracy(num_classes=5), logits, int_args=(jnp.asarray(target),)
+    )
+    assert np.allclose(bf16, f32, atol=5e-2)
+
+
+def test_bf16_ssim():
+    img = _rng.rand(1, 1, 16, 16).astype(np.float32)
+    noisy = np.clip(img + 0.05 * _rng.randn(1, 1, 16, 16), 0, 1).astype(np.float32)
+    f32, bf16 = _run_both(lambda: StructuralSimilarityIndexMeasure(data_range=1.0), img, noisy)
+    assert np.allclose(bf16, f32, atol=5e-2)
+
+
+def test_bf16_perplexity():
+    logits = _rng.randn(2, 8, 7).astype(np.float32)
+    target = jnp.asarray(_rng.randint(0, 7, (2, 8)).astype(np.int32))
+    f32, bf16 = _run_both(lambda: Perplexity(validate_args=False), logits, int_args=(target,))
+    assert np.allclose(bf16, f32, rtol=5e-2)
+
+
+def test_bf16_retrieval():
+    idx = jnp.asarray(np.repeat(np.arange(8), 8).astype(np.int32))
+    target = jnp.asarray((_rng.rand(64) > 0.5).astype(np.int32))
+    scores = _rng.rand(64).astype(np.float32)
+    results = []
+    for dtype in (jnp.float32, jnp.bfloat16):
+        m = RetrievalMAP()
+        m.update(jnp.asarray(scores).astype(dtype), target, indexes=idx)
+        results.append(float(m.compute()))
+    # ranking can flip on bf16-rounded near-ties; scores here are well separated
+    assert abs(results[0] - results[1]) < 5e-2

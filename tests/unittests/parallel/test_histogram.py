@@ -90,3 +90,12 @@ def test_bincount_under_jit_and_shard_map():
 
     out = jax.jit(sharded)(x)
     assert np.allclose(np.asarray(out), _oracle(x, np.ones(640), 8))
+
+
+def test_bincount_respects_default_device_context():
+    """jit-traced dispatch under `jax.default_device(cpu)` must not pick the TPU kernel."""
+    x = jnp.asarray(_rng.randint(0, 8, histogram.PALLAS_MIN_SIZE).astype(np.int32))
+    cpu = jax.devices("cpu")[0]
+    with jax.default_device(cpu):
+        out = jax.jit(lambda v: _bincount(v, 8))(x)
+    assert int(np.asarray(out).sum()) == histogram.PALLAS_MIN_SIZE
