@@ -90,16 +90,116 @@ def bench_torch_cpu(total_elems: int = 1 << 26, chunk: int = 1 << 24) -> float:
     return steps * chunk / dt
 
 
-if __name__ == "__main__":
-    tpu_eps = bench_tpu()
-    cpu_eps = bench_torch_cpu()
-    print(
-        json.dumps(
+def bench_map(n_images: int = 64) -> dict:
+    """BASELINE config 3: COCO-style mAP, update + full compute (images/s)."""
+    import numpy as np
+
+    from metrics_tpu.detection import MeanAveragePrecision
+
+    rng = np.random.RandomState(0)
+    preds, target = [], []
+    for _ in range(n_images):
+        nd, ng = 50, 30
+        db = rng.rand(nd, 4) * 100
+        db[:, 2:] += db[:, :2] + 1
+        gb = rng.rand(ng, 4) * 100
+        gb[:, 2:] += gb[:, :2] + 1
+        preds.append(
             {
-                "metric": "multiclass_accuracy_1B_preds_throughput",
-                "value": round(tpu_eps / 1e9, 4),
-                "unit": "Gpreds/s/chip",
-                "vs_baseline": round(tpu_eps / cpu_eps, 2),
+                "boxes": jnp.asarray(db, jnp.float32),
+                "scores": jnp.asarray(rng.rand(nd), jnp.float32),
+                "labels": jnp.asarray(rng.randint(0, 5, nd), jnp.int32),
             }
         )
-    )
+        target.append({"boxes": jnp.asarray(gb, jnp.float32), "labels": jnp.asarray(rng.randint(0, 5, ng), jnp.int32)})
+
+    metric = MeanAveragePrecision()
+    metric.update(preds, target)
+    jax.device_get(metric.compute()["map"])  # compile warm-up
+
+    metric.reset()
+    t0 = time.perf_counter()
+    metric.update(preds, target)
+    out = metric.compute()
+    jax.device_get(out["map"])
+    dt = time.perf_counter() - t0
+    return {"metric": "coco_map_images_per_s", "value": round(n_images / dt, 2), "unit": "images/s/chip",
+            "vs_baseline": None}
+
+
+def bench_ssim(batch: int = 16, hw: int = 256, repeats: int = 20) -> dict:
+    """BASELINE config 4 (SSIM half): streamed SSIM update throughput (pixels/s)."""
+    from metrics_tpu.image import StructuralSimilarityIndexMeasure
+
+    metric = StructuralSimilarityIndexMeasure(data_range=1.0)
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+    imgs1 = jax.random.uniform(k1, (batch, 3, hw, hw), jnp.float32)
+    imgs2 = jax.random.uniform(k2, (batch, 3, hw, hw), jnp.float32)
+    update = jax.jit(metric.local_update)
+    state = update(metric.init_state(), imgs1, imgs2)
+    jax.device_get(state)
+    t0 = time.perf_counter()
+    state = metric.init_state()
+    for _ in range(repeats):
+        state = update(state, imgs1, imgs2)
+    jax.device_get(state)
+    dt = time.perf_counter() - t0
+    px = repeats * batch * 3 * hw * hw
+    return {"metric": "ssim_throughput", "value": round(px / dt / 1e9, 3), "unit": "Gpix/s/chip", "vs_baseline": None}
+
+
+def bench_retrieval(n_docs: int = 1 << 22) -> dict:
+    """BASELINE config 5: RetrievalMAP over fixed-capacity buffers (docs/s)."""
+    import numpy as np
+
+    from metrics_tpu.retrieval import RetrievalMAP
+
+    rng = np.random.RandomState(0)
+    idx = jnp.asarray(np.sort(rng.randint(0, n_docs // 64, n_docs)).astype(np.int32))
+    scores = jnp.asarray(rng.rand(n_docs).astype(np.float32))
+    rel = jnp.asarray((rng.rand(n_docs) > 0.7).astype(np.int32))
+
+    metric = RetrievalMAP(cat_capacity=n_docs, validate_args=False)
+    update = jax.jit(metric.local_update)
+    state = update(metric.init_state(), scores, rel, idx)
+    float(metric.compute_from(state))  # compile + warm
+
+    t0 = time.perf_counter()
+    state = update(metric.init_state(), scores, rel, idx)
+    value = float(metric.compute_from(state))
+    dt = time.perf_counter() - t0
+    assert 0.0 < value < 1.0
+    return {"metric": "retrieval_map_docs_per_s", "value": round(n_docs / dt / 1e6, 2), "unit": "Mdocs/s/chip",
+            "vs_baseline": None}
+
+
+if __name__ == "__main__":
+    import sys
+
+    _CONFIGS = ("accuracy", "map", "ssim", "retrieval", "all")
+    if "--config" in sys.argv:
+        flag_idx = sys.argv.index("--config")
+        if flag_idx + 1 >= len(sys.argv) or sys.argv[flag_idx + 1] not in _CONFIGS:
+            raise SystemExit(f"usage: bench.py [--config {{{'|'.join(_CONFIGS)}}}]")
+        config = sys.argv[flag_idx + 1]
+    else:
+        config = "accuracy"
+    if config in ("accuracy", "all"):
+        tpu_eps = bench_tpu()
+        cpu_eps = bench_torch_cpu()
+        print(
+            json.dumps(
+                {
+                    "metric": "multiclass_accuracy_1B_preds_throughput",
+                    "value": round(tpu_eps / 1e9, 4),
+                    "unit": "Gpreds/s/chip",
+                    "vs_baseline": round(tpu_eps / cpu_eps, 2),
+                }
+            )
+        )
+    if config in ("map", "all"):
+        print(json.dumps(bench_map()))
+    if config in ("ssim", "all"):
+        print(json.dumps(bench_ssim()))
+    if config in ("retrieval", "all"):
+        print(json.dumps(bench_retrieval()))

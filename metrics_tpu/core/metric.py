@@ -182,6 +182,11 @@ class Metric(ABC):
             raise ValueError("Unexpected type of `default` value: list states must start empty")
         if not is_list:
             default = jnp.asarray(default)
+            if getattr(default, "weak_type", False):
+                # strip weak typing: a weak-typed default makes the first
+                # local_update trace differ from steady-state (whose outputs are
+                # strongly typed), costing a second full compilation per metric
+                default = jax.lax.convert_element_type(default, default.dtype)
 
         if dist_reduce_fx is not None and not (dist_reduce_fx in _REDUCE_KIND_TO_FN or callable(dist_reduce_fx)):
             raise ValueError("`dist_reduce_fx` must be callable or one of ['mean', 'sum', 'cat', 'min', 'max', None]")

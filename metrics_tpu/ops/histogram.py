@@ -146,6 +146,8 @@ def _on_tpu(x: Array) -> bool:
         pass
     default_device = jax.config.jax_default_device
     if default_device is not None:
+        if isinstance(default_device, str):  # `with jax.default_device("tpu")`
+            return default_device == "tpu"
         return getattr(default_device, "platform", None) == "tpu"
     return jax.default_backend() == "tpu"
 

@@ -5,6 +5,8 @@ For metrics declaring ``is_differentiable=True``, ``jax.grad`` of the pure
 must exist, be finite, and match central finite differences on sampled
 coordinates (the JAX analogue of ``autograd.gradcheck``).
 """
+import zlib
+
 import numpy as np
 import pytest
 
@@ -62,8 +64,11 @@ _SINGLE_ARG_CASES = [
 def test_grad_matches_finite_differences(name, factory, shape, target_gen):
     metric = factory()
     assert metric.is_differentiable, f"{name} should declare is_differentiable"
-    preds = jnp.asarray(_rng.randn(*shape).astype(np.float32))
-    target = jnp.asarray(target_gen(_rng))
+    # per-test deterministic data: a shared module RNG would make inputs depend
+    # on test execution order and flake near the finite-difference tolerance
+    rng = np.random.RandomState(zlib.crc32(name.encode()) % (2**31))
+    preds = jnp.asarray(rng.randn(*shape).astype(np.float32))
+    target = jnp.asarray(target_gen(rng))
 
     def scalar_metric(p):
         m = factory()
@@ -73,7 +78,7 @@ def test_grad_matches_finite_differences(name, factory, shape, target_gen):
     grad = np.asarray(jax.grad(scalar_metric)(preds))
     assert np.all(np.isfinite(grad)), name
 
-    indices = _rng.choice(preds.size, size=min(5, preds.size), replace=False)
+    indices = rng.choice(preds.size, size=min(5, preds.size), replace=False)
     fd = _finite_difference(scalar_metric, np.asarray(preds), indices)
     got = grad.ravel()[indices]
     assert np.allclose(got, fd, atol=1e-2, rtol=5e-2), (name, got, fd)
@@ -81,7 +86,8 @@ def test_grad_matches_finite_differences(name, factory, shape, target_gen):
 
 @pytest.mark.parametrize("name, factory, shape", _SINGLE_ARG_CASES, ids=[c[0] for c in _SINGLE_ARG_CASES])
 def test_single_arg_grad_matches_finite_differences(name, factory, shape):
-    preds = jnp.asarray(_rng.rand(*shape).astype(np.float32))
+    rng = np.random.RandomState(zlib.crc32(name.encode()) % (2**31))
+    preds = jnp.asarray(rng.rand(*shape).astype(np.float32))
 
     def scalar_metric(p):
         m = factory()
@@ -90,7 +96,7 @@ def test_single_arg_grad_matches_finite_differences(name, factory, shape):
 
     grad = np.asarray(jax.grad(scalar_metric)(preds))
     assert np.all(np.isfinite(grad)), name
-    indices = _rng.choice(preds.size, size=5, replace=False)
+    indices = rng.choice(preds.size, size=5, replace=False)
     fd = _finite_difference(scalar_metric, np.asarray(preds), indices)
     assert np.allclose(grad.ravel()[indices], fd, atol=1e-2, rtol=5e-2), name
 

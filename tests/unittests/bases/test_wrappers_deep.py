@@ -6,6 +6,8 @@ wrappers in collections, and tracker maximize/minimize directions.
 """
 import pickle
 
+import zlib
+
 import numpy as np
 import pytest
 
@@ -22,11 +24,13 @@ from metrics_tpu.wrappers import (
     MultioutputWrapper,
 )
 
-_rng = np.random.RandomState(3)
+def _seeded(name: str) -> np.random.RandomState:
+    return np.random.RandomState(zlib.crc32(name.encode()) % (2**31))
 
 
 class TestBootStrapper:
     def test_quantile_and_raw_outputs(self):
+        _rng = _seeded("test_quantile_and_raw_outputs")
         base = MeanSquaredError()
         bs = BootStrapper(base, num_bootstraps=20, quantile=jnp.asarray([0.05, 0.95]), raw=True)
         for _ in range(4):
@@ -41,6 +45,7 @@ class TestBootStrapper:
         assert float(out["std"]) >= 0
 
     def test_bootstrap_spread_shrinks_with_data(self):
+        _rng = _seeded("test_bootstrap_spread_shrinks_with_data")
         def spread(n_batches):
             bs = BootStrapper(MeanSquaredError(), num_bootstraps=30)
             for _ in range(n_batches):
@@ -52,6 +57,7 @@ class TestBootStrapper:
         assert spread(16) < spread(1) * 1.5  # more data, no larger spread (stochastic slack)
 
     def test_reset_clears_members(self):
+        _rng = _seeded("test_reset_clears_members")
         bs = BootStrapper(MeanSquaredError(), num_bootstraps=5)
         bs.update(jnp.arange(4.0), jnp.arange(4.0) + 1)
         bs.reset()
@@ -59,6 +65,7 @@ class TestBootStrapper:
             assert m._update_count == 0
 
     def test_pickle_roundtrip(self):
+        _rng = _seeded("test_pickle_roundtrip")
         bs = BootStrapper(MeanSquaredError(), num_bootstraps=5)
         bs.update(jnp.arange(4.0), jnp.arange(4.0) + 1)
         clone = pickle.loads(pickle.dumps(bs))
@@ -67,6 +74,7 @@ class TestBootStrapper:
 
 class TestClasswiseWrapper:
     def test_default_integer_labels(self):
+        _rng = _seeded("test_default_integer_labels")
         metric = ClasswiseWrapper(MulticlassAccuracy(num_classes=3, average=None))
         out = metric(jnp.asarray([0, 1, 2, 1]), jnp.asarray([0, 1, 2, 2]))
         assert set(out.keys()) == {
@@ -76,6 +84,7 @@ class TestClasswiseWrapper:
         }
 
     def test_inside_collection(self):
+        _rng = _seeded("test_inside_collection")
         col = MetricCollection(
             {
                 "cw": ClasswiseWrapper(MulticlassAccuracy(num_classes=3, average=None), labels=["x", "y", "z"]),
@@ -88,6 +97,7 @@ class TestClasswiseWrapper:
         assert any(k.endswith("_x") for k in out)
 
     def test_accumulation_matches_base(self):
+        _rng = _seeded("test_accumulation_matches_base")
         wrapped = ClasswiseWrapper(MulticlassAccuracy(num_classes=3, average=None))
         base = MulticlassAccuracy(num_classes=3, average=None)
         for _ in range(3):
@@ -103,6 +113,7 @@ class TestClasswiseWrapper:
 
 class TestMinMaxMetric:
     def test_tracks_extremes_over_steps(self):
+        _rng = _seeded("test_tracks_extremes_over_steps")
         metric = MinMaxMetric(BinaryAccuracy())
         values = []
         for acc_target in (1.0, 0.25, 0.75):
@@ -118,6 +129,7 @@ class TestMinMaxMetric:
         assert float(out["min"]) <= min(values) + 1e-6
 
     def test_reset(self):
+        _rng = _seeded("test_reset")
         metric = MinMaxMetric(BinaryAccuracy())
         metric.update(jnp.asarray([1, 0]), jnp.asarray([1, 1]))
         metric.compute()
@@ -129,6 +141,7 @@ class TestMinMaxMetric:
 
 class TestMultioutputWrapper:
     def test_three_outputs_match_independent_metrics(self):
+        _rng = _seeded("test_three_outputs_match_independent_metrics")
         preds = _rng.rand(16, 3).astype(np.float32)
         target = _rng.rand(16, 3).astype(np.float32)
         wrapped = MultioutputWrapper(MeanAbsoluteError(), num_outputs=3)
@@ -140,6 +153,7 @@ class TestMultioutputWrapper:
             assert abs(got[i] - float(m.compute())) < 1e-6
 
     def test_reset_propagates(self):
+        _rng = _seeded("test_reset_propagates")
         wrapped = MultioutputWrapper(MeanSquaredError(), num_outputs=2)
         wrapped.update(jnp.ones((4, 2)), jnp.zeros((4, 2)))
         wrapped.reset()
@@ -149,6 +163,7 @@ class TestMultioutputWrapper:
 
 class TestTracker:
     def test_maximize_false_picks_minimum(self):
+        _rng = _seeded("test_maximize_false_picks_minimum")
         tracker = MetricTracker(MeanSquaredError(), maximize=False)
         errors = [2.0, 0.5, 1.0]
         for e in errors:
@@ -159,6 +174,7 @@ class TestTracker:
         assert best == pytest.approx(0.25)
 
     def test_n_steps_and_index_access(self):
+        _rng = _seeded("test_n_steps_and_index_access")
         tracker = MetricTracker(BinaryAccuracy())
         for _ in range(2):
             tracker.increment()
