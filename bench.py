@@ -174,16 +174,11 @@ def bench_retrieval(n_docs: int = 1 << 22) -> dict:
 
 
 if __name__ == "__main__":
-    import sys
+    import argparse
 
-    _CONFIGS = ("accuracy", "map", "ssim", "retrieval", "all")
-    if "--config" in sys.argv:
-        flag_idx = sys.argv.index("--config")
-        if flag_idx + 1 >= len(sys.argv) or sys.argv[flag_idx + 1] not in _CONFIGS:
-            raise SystemExit(f"usage: bench.py [--config {{{'|'.join(_CONFIGS)}}}]")
-        config = sys.argv[flag_idx + 1]
-    else:
-        config = "accuracy"
+    parser = argparse.ArgumentParser(description="metrics_tpu benchmarks")
+    parser.add_argument("--config", choices=("accuracy", "map", "ssim", "retrieval", "all"), default="accuracy")
+    config = parser.parse_args().config
     if config in ("accuracy", "all"):
         tpu_eps = bench_tpu()
         cpu_eps = bench_torch_cpu()

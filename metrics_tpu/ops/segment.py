@@ -40,7 +40,10 @@ def _segment_layout(indexes: Array, preds: Array, target: Array):
     rank = pos - seg_start[seg_id] + 1  # 1-based within query
 
     seg_count = jax.ops.segment_sum(jnp.ones(n, jnp.int32), seg_id, num_segments=n)
-    return seg_id, rank, s_preds, s_target, n, seg_count
+    # first (== any) original index of each segment: negative marks padding rows
+    # (cat-buffer fill / pow2 pad), whose segment must not count as a real query
+    seg_index = jax.ops.segment_min(s_idx, seg_id, num_segments=n)
+    return seg_id, rank, s_preds, s_target, n, seg_count, seg_index
 
 
 def _segment_cumsum(values: Array, seg_id: Array, num_segments: int) -> Array:
@@ -68,8 +71,8 @@ def grouped_retrieval_scores(
     for ``empty_target_action`` handling; for ``fall_out`` it counts negatives).
     """
     n = indexes.shape[0]
-    seg_id, rank, s_preds, s_target, n_seg, seg_count = _segment_layout(indexes, preds, target)
-    valid = seg_count > 0
+    seg_id, rank, s_preds, s_target, n_seg, seg_count, seg_index = _segment_layout(indexes, preds, target)
+    valid = (seg_count > 0) & (seg_index >= 0)
     t = s_target.astype(jnp.float32)
     binary_t = (s_target > 0).astype(jnp.float32)
 

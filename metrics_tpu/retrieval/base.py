@@ -103,6 +103,15 @@ class RetrievalMetric(Metric, ABC):
             n_keep = valid.sum()
             total = jnp.where(valid, scores, 0.0).sum()
             return jnp.where(n_keep > 0, total / jnp.maximum(n_keep, 1), 0.0).astype(jnp.float32)
+        # pad to the next power of two so streaming (growing list states) costs
+        # at most log2(N) compilations instead of one per distinct length;
+        # padding rows carry index -1 = invalid query group for the segment kernel
+        n = indexes.shape[0]
+        pad = (1 << max(1, (int(n) - 1).bit_length())) - n
+        if pad:
+            indexes = jnp.concatenate([indexes, jnp.full((pad,), -1, indexes.dtype)])
+            preds = jnp.concatenate([preds, jnp.zeros((pad,), preds.dtype)])
+            target = jnp.concatenate([target, jnp.zeros((pad,), target.dtype)])
         return _dense_retrieval_compute_jit(
             indexes,
             preds,
