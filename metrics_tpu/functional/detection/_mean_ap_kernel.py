@@ -9,7 +9,7 @@ update, ``vmap``-ed over COCO area ranges and again over all (image, class) eval
 groups. Shapes are static (padded to power-of-two buckets by the caller), so XLA
 compiles one fused kernel that runs entirely on device.
 """
-import functools
+from metrics_tpu.utils.data import _next_pow2
 
 import jax
 from jax import Array
@@ -76,10 +76,4 @@ def _match_groups(
     return jax.vmap(per_group)(det_boxes, det_valid, gt_boxes, gt_valid)
 
 
-@functools.lru_cache(maxsize=None)
-def _pow2(n: int) -> int:
-    """Next power of two (>=1) — pads kernel shapes into a small set of buckets."""
-    p = 1
-    while p < n:
-        p *= 2
-    return p
+_pow2 = _next_pow2  # shared bucketing helper (utils/data.py)

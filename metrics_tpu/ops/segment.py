@@ -22,9 +22,11 @@ from jax import Array
 def _segment_layout(indexes: Array, preds: Array, target: Array):
     """Sort rows by (query, -score); return per-row segment ids and rank info.
 
-    Returns: (seg_id, rank, sorted_preds, sorted_target, n_seg_upper, seg_count)
-    where rank is the 1-based position of the row inside its query's score-ordered
-    list and seg_count[s] is the number of docs of segment s (0 for unused slots).
+    Returns: (seg_id, rank, sorted_preds, sorted_target, n_seg_upper, seg_count,
+    seg_index) where rank is the 1-based position of the row inside its query's
+    score-ordered list, seg_count[s] is the number of docs of segment s (0 for unused
+    slots), and seg_index[s] is the original query id of segment s (negative values
+    mark padding rows whose segment must not count as a real query).
     """
     n = indexes.shape[0]
     order = jnp.lexsort((-preds, indexes))

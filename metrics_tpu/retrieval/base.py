@@ -21,7 +21,7 @@ from jax import Array
 from metrics_tpu.core.metric import Metric
 from metrics_tpu.ops.segment import grouped_retrieval_scores
 from metrics_tpu.utils.checks import _check_retrieval_inputs
-from metrics_tpu.utils.data import dim_zero_cat
+from metrics_tpu.utils.data import _next_pow2, dim_zero_cat
 
 
 class RetrievalMetric(Metric, ABC):
@@ -107,7 +107,7 @@ class RetrievalMetric(Metric, ABC):
         # at most log2(N) compilations instead of one per distinct length;
         # padding rows carry index -1 = invalid query group for the segment kernel
         n = indexes.shape[0]
-        pad = (1 << max(1, (int(n) - 1).bit_length())) - n
+        pad = _next_pow2(int(n), floor=2) - n
         if pad:
             indexes = jnp.concatenate([indexes, jnp.full((pad,), -1, indexes.dtype)])
             preds = jnp.concatenate([preds, jnp.zeros((pad,), preds.dtype)])

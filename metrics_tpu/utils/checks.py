@@ -71,6 +71,12 @@ def _check_retrieval_inputs(
         raise ValueError("`indexes`, `preds` and `target` must be non-empty and non-scalar tensors")
     if not jnp.issubdtype(indexes.dtype, jnp.integer):
         raise ValueError("`indexes` must be a tensor of integers")
+    if validate_args and _is_concrete(indexes) and bool(jnp.any(jnp.asarray(indexes) < 0)):
+        # Semantic delta vs reference (utilities/data.py:266 shifts negatives by the
+        # min): negative ids are reserved as the padding sentinel of the
+        # fixed-capacity segment kernel, so they are rejected loudly instead of
+        # being silently dropped.
+        raise ValueError("`indexes` must be non-negative: negative ids are reserved for buffer padding")
     if ignore_index is not None:
         if isinstance(target, jax.core.Tracer):
             raise ValueError(

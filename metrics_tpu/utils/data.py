@@ -22,6 +22,15 @@ from jax import Array
 _ArrayLike = Union[Array, np.ndarray, float, int]
 
 
+def _next_pow2(n: int, floor: int = 1) -> int:
+    """Next power of two >= max(n, floor) — pads data-dependent shapes into a small
+    set of buckets so streaming workloads cost at most log2(N) jit compilations."""
+    p = floor
+    while p < n:
+        p *= 2
+    return p
+
+
 def _count_dtype():
     """dtype for unbounded count accumulators (stat-score states).
 

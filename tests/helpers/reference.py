@@ -15,13 +15,28 @@ def reference_available() -> bool:
     return os.path.isdir(_REFERENCE_SRC)
 
 
-def import_reference_text():
-    """Return the reference ``torchmetrics.functional.text`` module (or None)."""
+def import_reference():
+    """Return the reference ``torchmetrics`` package (or None).
+
+    Gives the suite the strongest oracle available: the actual reference library
+    running on torch CPU, not a re-derivation of its math. Detection requires
+    torchvision (absent in this image) and is excluded at the reference's own
+    import gate; everything else imports.
+    """
     if not reference_available():
         return None
     for p in (_SHIM, _REFERENCE_SRC):
         if p not in sys.path:
             sys.path.insert(0, p)
+    import torchmetrics  # noqa: PLC0415
+
+    return torchmetrics
+
+
+def import_reference_text():
+    """Return the reference ``torchmetrics.functional.text`` module (or None)."""
+    if import_reference() is None:
+        return None
     import torchmetrics.functional.text as ref_text  # noqa: PLC0415
 
     return ref_text

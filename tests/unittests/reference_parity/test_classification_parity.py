@@ -1,0 +1,296 @@
+"""Differential tests: classification functionals vs the actual reference library.
+
+Identical numpy inputs go to ``torchmetrics.functional.classification`` (torch CPU)
+and ``metrics_tpu.functional.classification``; outputs must agree. Sweeps cover the
+argument axes where silent divergence hides: ``average``, ``top_k``,
+``ignore_index``, ``multidim_average``, logits-vs-probs, and binned thresholds.
+"""
+import numpy as np
+import pytest
+
+import metrics_tpu.functional.classification as F
+
+from .conftest import assert_close
+
+N = 128
+NC = 5
+NL = 4
+
+rng = np.random.RandomState(7)
+BIN_PROBS = rng.rand(N).astype(np.float32)
+BIN_LOGITS = rng.randn(N).astype(np.float32) * 3
+BIN_TARGET = rng.randint(0, 2, N)
+MC_LOGITS = rng.randn(N, NC).astype(np.float32)
+MC_PROBS = np.exp(MC_LOGITS) / np.exp(MC_LOGITS).sum(-1, keepdims=True)
+MC_TARGET = rng.randint(0, NC, N)
+MC_PREDS_INT = rng.randint(0, NC, N)
+ML_PROBS = rng.rand(N, NL).astype(np.float32)
+ML_TARGET = rng.randint(0, 2, (N, NL))
+MD_PROBS = rng.rand(32, NC, 6).astype(np.float32)
+MD_PROBS = MD_PROBS / MD_PROBS.sum(1, keepdims=True)
+MD_TARGET = rng.randint(0, NC, (32, 6))
+
+
+def _run(ref, name, args_np, kwargs, atol=1e-6):
+    import jax.numpy as jnp
+    import torch
+
+    ref_fn = getattr(ref.functional.classification, name)
+    our_fn = getattr(F, name)
+    theirs = ref_fn(*[torch.from_numpy(np.asarray(a)) for a in args_np], **kwargs)
+    ours = our_fn(*[jnp.asarray(a) for a in args_np], **kwargs)
+    assert_close(ours, theirs, atol=atol)
+
+
+# ---------------------------------------------------------------- binary family
+
+BINARY_SWEEP = [
+    ("binary_accuracy", {}),
+    ("binary_accuracy", {"threshold": 0.3}),
+    ("binary_accuracy", {"ignore_index": 0}),
+    ("binary_accuracy", {"multidim_average": "global"}),
+    ("binary_precision", {}),
+    ("binary_recall", {}),
+    ("binary_specificity", {}),
+    ("binary_f1_score", {}),
+    ("binary_fbeta_score", {"beta": 0.5}),
+    ("binary_jaccard_index", {}),
+    ("binary_cohen_kappa", {}),
+    ("binary_matthews_corrcoef", {}),
+    ("binary_hamming_distance", {}),
+    ("binary_auroc", {"thresholds": None}),
+    ("binary_auroc", {"thresholds": 50}),
+    ("binary_average_precision", {"thresholds": None}),
+    ("binary_average_precision", {"thresholds": 50}),
+    ("binary_calibration_error", {"n_bins": 10, "norm": "l1"}),
+    ("binary_calibration_error", {"n_bins": 15, "norm": "max"}),
+    ("binary_calibration_error", {"n_bins": 15, "norm": "l2"}),
+    ("binary_hinge_loss", {}),
+    ("binary_hinge_loss", {"squared": False}),
+    ("binary_stat_scores", {}),
+    ("binary_confusion_matrix", {}),
+    ("binary_confusion_matrix", {"normalize": "true"}),
+]
+
+
+@pytest.mark.parametrize(("name", "kwargs"), BINARY_SWEEP)
+@pytest.mark.parametrize("probs", [True, False], ids=["probs", "logits"])
+def test_binary(ref, name, kwargs, probs):
+    preds = BIN_PROBS if probs else BIN_LOGITS
+    if name == "binary_hinge_loss" and probs:
+        pytest.skip("hinge operates on raw scores only")
+    _run(ref, name, (preds, BIN_TARGET), kwargs, atol=1e-5)
+
+
+# ------------------------------------------------------------- multiclass family
+
+MULTICLASS_SWEEP = [
+    ("multiclass_accuracy", {"average": "micro"}),
+    ("multiclass_accuracy", {"average": "macro"}),
+    ("multiclass_accuracy", {"average": "weighted"}),
+    ("multiclass_accuracy", {"average": "none"}),
+    ("multiclass_accuracy", {"average": "macro", "top_k": 2}),
+    ("multiclass_accuracy", {"average": "micro", "ignore_index": 1}),
+    ("multiclass_precision", {"average": "macro"}),
+    ("multiclass_precision", {"average": "weighted", "top_k": 2}),
+    ("multiclass_recall", {"average": "macro"}),
+    ("multiclass_recall", {"average": "none"}),
+    ("multiclass_specificity", {"average": "macro"}),
+    ("multiclass_f1_score", {"average": "macro"}),
+    ("multiclass_f1_score", {"average": "micro", "ignore_index": 2}),
+    ("multiclass_fbeta_score", {"beta": 2.0, "average": "weighted"}),
+    ("multiclass_jaccard_index", {"average": "macro"}),
+    ("multiclass_cohen_kappa", {}),
+    ("multiclass_cohen_kappa", {"weights": "linear"}),
+    ("multiclass_cohen_kappa", {"weights": "quadratic"}),
+    ("multiclass_matthews_corrcoef", {}),
+    ("multiclass_hamming_distance", {"average": "macro"}),
+    ("multiclass_auroc", {"average": "macro", "thresholds": None}),
+    ("multiclass_auroc", {"average": "weighted", "thresholds": 50}),
+    ("multiclass_average_precision", {"average": "macro", "thresholds": None}),
+    ("multiclass_average_precision", {"average": "weighted", "thresholds": 50}),
+    ("multiclass_calibration_error", {"n_bins": 10, "norm": "l1"}),
+    ("multiclass_confusion_matrix", {}),
+    ("multiclass_confusion_matrix", {"normalize": "all"}),
+    ("multiclass_stat_scores", {"average": "macro"}),
+    ("multiclass_stat_scores", {"average": "micro", "top_k": 2}),
+    ("multiclass_exact_match", {"multidim_average": "global"}),
+]
+
+
+@pytest.mark.parametrize(("name", "kwargs"), MULTICLASS_SWEEP)
+def test_multiclass(ref, name, kwargs):
+    args = {"num_classes": NC, **kwargs}
+    if name == "multiclass_exact_match":
+        _run(ref, name, (MD_PROBS, MD_TARGET), args, atol=1e-5)
+        return
+    _run(ref, name, (MC_PROBS, MC_TARGET), args, atol=1e-5)
+
+
+@pytest.mark.parametrize(
+    ("name", "kwargs"),
+    [
+        ("multiclass_accuracy", {"average": "micro"}),
+        ("multiclass_accuracy", {"average": "macro"}),
+        ("multiclass_f1_score", {"average": "macro"}),
+        ("multiclass_jaccard_index", {"average": "macro"}),
+        ("multiclass_confusion_matrix", {}),
+    ],
+)
+def test_multiclass_int_preds(ref, name, kwargs):
+    """Hard label predictions (int) path."""
+    _run(ref, name, (MC_PREDS_INT, MC_TARGET), {"num_classes": NC, **kwargs}, atol=1e-6)
+
+
+@pytest.mark.parametrize(
+    ("name", "kwargs"),
+    [
+        ("multiclass_accuracy", {"average": "macro", "multidim_average": "samplewise"}),
+        ("multiclass_accuracy", {"average": "micro", "multidim_average": "samplewise"}),
+        ("multiclass_stat_scores", {"average": "macro", "multidim_average": "samplewise"}),
+        ("multiclass_exact_match", {"multidim_average": "samplewise"}),
+    ],
+)
+def test_multidim_samplewise(ref, name, kwargs):
+    _run(ref, name, (MD_PROBS, MD_TARGET), {"num_classes": NC, **kwargs}, atol=1e-5)
+
+
+# ------------------------------------------------------------- multilabel family
+
+MULTILABEL_SWEEP = [
+    ("multilabel_accuracy", {"average": "micro"}),
+    ("multilabel_accuracy", {"average": "macro"}),
+    ("multilabel_accuracy", {"average": "none"}),
+    ("multilabel_accuracy", {"average": "macro", "ignore_index": 0}),
+    ("multilabel_precision", {"average": "macro"}),
+    ("multilabel_recall", {"average": "weighted"}),
+    ("multilabel_specificity", {"average": "macro"}),
+    ("multilabel_f1_score", {"average": "macro"}),
+    ("multilabel_fbeta_score", {"beta": 0.5, "average": "micro"}),
+    ("multilabel_jaccard_index", {"average": "macro"}),
+    ("multilabel_matthews_corrcoef", {}),
+    ("multilabel_hamming_distance", {"average": "macro"}),
+    ("multilabel_auroc", {"average": "macro", "thresholds": None}),
+    ("multilabel_auroc", {"average": "micro", "thresholds": 50}),
+    ("multilabel_average_precision", {"average": "macro", "thresholds": None}),
+    ("multilabel_confusion_matrix", {}),
+    ("multilabel_stat_scores", {"average": "macro"}),
+    ("multilabel_exact_match", {}),
+    ("multilabel_ranking_average_precision", {}),
+    ("multilabel_coverage_error", {}),
+    ("multilabel_ranking_loss", {}),
+]
+
+
+@pytest.mark.parametrize(("name", "kwargs"), MULTILABEL_SWEEP)
+def test_multilabel(ref, name, kwargs):
+    args = {"num_labels": NL, **kwargs}
+    _run(ref, name, (ML_PROBS, ML_TARGET), args, atol=1e-5)
+
+
+# ----------------------------------------------------------------- curve outputs
+
+
+@pytest.mark.parametrize("thresholds", [None, 20])
+def test_binary_precision_recall_curve(ref, thresholds):
+    import jax.numpy as jnp
+    import torch
+
+    theirs = ref.functional.classification.binary_precision_recall_curve(
+        torch.from_numpy(BIN_PROBS), torch.from_numpy(BIN_TARGET), thresholds=thresholds
+    )
+    ours = F.binary_precision_recall_curve(jnp.asarray(BIN_PROBS), jnp.asarray(BIN_TARGET), thresholds=thresholds)
+    for o, t in zip(ours, theirs):
+        assert_close(o, t, atol=1e-6)
+
+
+@pytest.mark.parametrize("thresholds", [None, 20])
+def test_binary_roc(ref, thresholds):
+    import jax.numpy as jnp
+    import torch
+
+    theirs = ref.functional.classification.binary_roc(
+        torch.from_numpy(BIN_PROBS), torch.from_numpy(BIN_TARGET), thresholds=thresholds
+    )
+    ours = F.binary_roc(jnp.asarray(BIN_PROBS), jnp.asarray(BIN_TARGET), thresholds=thresholds)
+    for o, t in zip(ours, theirs):
+        assert_close(o, t, atol=1e-6)
+
+
+@pytest.mark.parametrize("thresholds", [None, 20])
+def test_multiclass_roc(ref, thresholds):
+    import jax.numpy as jnp
+    import torch
+
+    theirs = ref.functional.classification.multiclass_roc(
+        torch.from_numpy(MC_PROBS), torch.from_numpy(MC_TARGET), num_classes=NC, thresholds=thresholds
+    )
+    ours = F.multiclass_roc(jnp.asarray(MC_PROBS), jnp.asarray(MC_TARGET), num_classes=NC, thresholds=thresholds)
+    for o, t in zip(ours, theirs):
+        assert_close(o, t, atol=1e-6)
+
+
+# ------------------------------------------------------- fixed-operating-point
+
+
+@pytest.mark.parametrize(
+    ("name", "kwargs"),
+    [
+        ("binary_recall_at_fixed_precision", {"min_precision": 0.5}),
+        ("binary_recall_at_fixed_precision", {"min_precision": 0.5, "thresholds": 100}),
+        ("binary_precision_at_fixed_recall", {"min_recall": 0.5}),
+        ("binary_specificity_at_sensitivity", {"min_sensitivity": 0.5}),
+    ],
+)
+def test_binary_fixed_point(ref, name, kwargs):
+    _run(ref, name, (BIN_PROBS, BIN_TARGET), kwargs, atol=1e-6)
+
+
+# ------------------------------------------------------------------- dispatchers
+
+
+@pytest.mark.parametrize(
+    ("name", "task_kwargs"),
+    [
+        ("accuracy", {"task": "binary"}),
+        ("accuracy", {"task": "multiclass", "num_classes": NC, "average": "macro"}),
+        ("f1_score", {"task": "multilabel", "num_labels": NL, "average": "micro"}),
+        ("auroc", {"task": "binary"}),
+    ],
+)
+def test_dispatchers(ref, name, task_kwargs):
+    import jax.numpy as jnp
+    import torch
+
+    if task_kwargs["task"] == "binary":
+        a = (BIN_PROBS, BIN_TARGET)
+    elif task_kwargs["task"] == "multiclass":
+        a = (MC_PROBS, MC_TARGET)
+    else:
+        a = (ML_PROBS, ML_TARGET)
+    theirs = getattr(ref.functional, name)(*[torch.from_numpy(np.asarray(x)) for x in a], **task_kwargs)
+    ours = getattr(__import__("metrics_tpu.functional", fromlist=[name]), name)(
+        *[jnp.asarray(x) for x in a], **task_kwargs
+    )
+    assert_close(ours, theirs, atol=1e-5)
+
+
+# --------------------------------------------------------------------- fairness
+
+
+def test_group_fairness(ref):
+    import jax.numpy as jnp
+    import torch
+
+    groups = rng.randint(0, 2, N)
+    theirs = ref.functional.classification.demographic_parity(
+        torch.from_numpy(BIN_PROBS), torch.from_numpy(groups)
+    )
+    ours = F.demographic_parity(jnp.asarray(BIN_PROBS), jnp.asarray(groups))
+    assert_close(ours, theirs, atol=1e-6)
+
+    theirs = ref.functional.classification.equal_opportunity(
+        torch.from_numpy(BIN_PROBS), torch.from_numpy(BIN_TARGET), torch.from_numpy(groups)
+    )
+    ours = F.equal_opportunity(jnp.asarray(BIN_PROBS), jnp.asarray(BIN_TARGET), jnp.asarray(groups))
+    assert_close(ours, theirs, atol=1e-6)

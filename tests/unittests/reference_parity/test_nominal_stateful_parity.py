@@ -1,0 +1,234 @@
+"""Differential tests: nominal functionals, stateful class accumulation, and wrappers
+vs the actual reference library."""
+import numpy as np
+import pytest
+
+from .conftest import assert_close
+
+rng = np.random.RandomState(31)
+N = 150
+CAT_A = rng.randint(0, 4, N)
+CAT_B = (CAT_A + rng.randint(0, 2, N)) % 4  # correlated
+MATRIX = rng.randint(0, 3, (N, 5))
+
+
+# --------------------------------------------------------------------- nominal
+
+
+@pytest.mark.parametrize(
+    ("name", "kwargs"),
+    [
+        ("cramers_v", {}),
+        ("cramers_v", {"bias_correction": False}),
+        ("pearsons_contingency_coefficient", {}),
+        ("theils_u", {}),
+        ("tschuprows_t", {}),
+        ("tschuprows_t", {"bias_correction": False}),
+    ],
+)
+def test_nominal(ref, name, kwargs):
+    import jax.numpy as jnp
+    import torch
+
+    import metrics_tpu.functional.nominal as FN
+
+    theirs = getattr(ref.functional.nominal, name)(torch.from_numpy(CAT_A), torch.from_numpy(CAT_B), **kwargs)
+    ours = getattr(FN, name)(jnp.asarray(CAT_A), jnp.asarray(CAT_B), **kwargs)
+    assert_close(ours, theirs, atol=1e-5)
+
+
+@pytest.mark.parametrize(
+    "name",
+    ["cramers_v_matrix", "pearsons_contingency_coefficient_matrix", "theils_u_matrix", "tschuprows_t_matrix"],
+)
+def test_nominal_matrix(ref, name):
+    import jax.numpy as jnp
+    import torch
+
+    import metrics_tpu.functional.nominal as FN
+
+    theirs = getattr(ref.functional.nominal, name)(torch.from_numpy(MATRIX))
+    ours = getattr(FN, name)(jnp.asarray(MATRIX))
+    assert_close(ours, theirs, atol=1e-5)
+
+
+# ------------------------------------------------- stateful class accumulation
+
+NC = 5
+BATCHES = 4
+B = 48
+MC_PROBS = rng.dirichlet(np.ones(NC), (BATCHES, B)).astype(np.float32)
+MC_TARGET = rng.randint(0, NC, (BATCHES, B))
+REG_P = rng.randn(BATCHES, B).astype(np.float32)
+REG_T = (REG_P + 0.4 * rng.randn(BATCHES, B)).astype(np.float32)
+
+
+def _accumulate(ref_cls, our_cls, preds, target, kwargs, atol=1e-5):
+    import jax.numpy as jnp
+    import torch
+
+    theirs_m = ref_cls(**kwargs)
+    ours_m = our_cls(**kwargs)
+    for i in range(len(preds)):
+        theirs_m.update(torch.from_numpy(preds[i]), torch.from_numpy(target[i]))
+        ours_m.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+    assert_close(ours_m.compute(), theirs_m.compute(), atol=atol)
+
+
+@pytest.mark.parametrize(
+    ("name", "kwargs"),
+    [
+        ("MulticlassAccuracy", {"num_classes": NC, "average": "macro"}),
+        ("MulticlassAccuracy", {"num_classes": NC, "average": "weighted"}),
+        ("MulticlassPrecision", {"num_classes": NC, "average": "macro"}),
+        ("MulticlassF1Score", {"num_classes": NC, "average": "none"}),
+        ("MulticlassAUROC", {"num_classes": NC, "average": "macro", "thresholds": None}),
+        ("MulticlassAUROC", {"num_classes": NC, "average": "macro", "thresholds": 50}),
+        ("MulticlassAveragePrecision", {"num_classes": NC, "average": "macro", "thresholds": None}),
+        ("MulticlassCohenKappa", {"num_classes": NC}),
+        ("MulticlassMatthewsCorrCoef", {"num_classes": NC}),
+        ("MulticlassConfusionMatrix", {"num_classes": NC}),
+        ("MulticlassCalibrationError", {"num_classes": NC, "n_bins": 10}),
+    ],
+)
+def test_stateful_classification(ref, name, kwargs):
+    import metrics_tpu.classification as C
+
+    _accumulate(getattr(ref.classification, name), getattr(C, name), MC_PROBS, MC_TARGET, kwargs)
+
+
+@pytest.mark.parametrize(
+    ("name", "kwargs"),
+    [
+        ("MeanSquaredError", {}),
+        ("MeanAbsoluteError", {}),
+        ("PearsonCorrCoef", {}),
+        ("SpearmanCorrCoef", {}),
+        ("KendallRankCorrCoef", {}),
+        ("ConcordanceCorrCoef", {}),
+        ("R2Score", {}),
+        ("ExplainedVariance", {}),
+        ("CosineSimilarity", {}),
+        ("LogCoshError", {}),
+    ],
+)
+def test_stateful_regression(ref, name, kwargs):
+    import metrics_tpu.regression as R
+
+    _accumulate(getattr(ref.regression, name), getattr(R, name), REG_P, REG_T, kwargs)
+
+
+# -------------------------------------------------------------------- wrappers
+
+
+def test_minmax_wrapper(ref, torch):
+    import jax.numpy as jnp
+
+    import metrics_tpu as M
+
+    theirs_m = ref.MinMaxMetric(ref.regression.MeanSquaredError())
+    ours_m = M.MinMaxMetric(M.regression.MeanSquaredError())
+    for i in range(BATCHES):
+        theirs_m.update(torch.from_numpy(REG_P[i]), torch.from_numpy(REG_T[i]))
+        ours_m.update(jnp.asarray(REG_P[i]), jnp.asarray(REG_T[i]))
+    theirs = theirs_m.compute()
+    ours = ours_m.compute()
+    for k in ("raw", "min", "max"):
+        assert_close(ours[k], theirs[k], atol=1e-6)
+
+
+def test_classwise_wrapper(ref, torch):
+    import jax.numpy as jnp
+
+    import metrics_tpu as M
+
+    theirs_m = ref.ClasswiseWrapper(ref.classification.MulticlassAccuracy(num_classes=NC, average=None))
+    ours_m = M.ClasswiseWrapper(M.classification.MulticlassAccuracy(num_classes=NC, average=None))
+    for i in range(BATCHES):
+        theirs_m.update(torch.from_numpy(MC_PROBS[i]), torch.from_numpy(MC_TARGET[i]))
+        ours_m.update(jnp.asarray(MC_PROBS[i]), jnp.asarray(MC_TARGET[i]))
+    theirs = theirs_m.compute()
+    ours = ours_m.compute()
+    assert set(ours) == set(theirs)
+    for k in theirs:
+        assert_close(ours[k], theirs[k], atol=1e-6)
+
+
+def test_multioutput_wrapper(ref, torch):
+    import jax.numpy as jnp
+
+    import metrics_tpu as M
+
+    p = rng.randn(BATCHES, B, 3).astype(np.float32)
+    t = (p + 0.3 * rng.randn(BATCHES, B, 3)).astype(np.float32)
+    theirs_m = ref.MultioutputWrapper(ref.regression.MeanSquaredError(), num_outputs=3)
+    ours_m = M.MultioutputWrapper(M.regression.MeanSquaredError(), num_outputs=3)
+    for i in range(BATCHES):
+        theirs_m.update(torch.from_numpy(p[i]), torch.from_numpy(t[i]))
+        ours_m.update(jnp.asarray(p[i]), jnp.asarray(t[i]))
+    assert_close(ours_m.compute(), theirs_m.compute(), atol=1e-6)
+
+
+def test_tracker(ref, torch):
+    import jax.numpy as jnp
+
+    import metrics_tpu as M
+
+    theirs_m = ref.MetricTracker(ref.regression.MeanSquaredError(), maximize=False)
+    ours_m = M.MetricTracker(M.regression.MeanSquaredError(), maximize=False)
+    for i in range(BATCHES):
+        theirs_m.increment()
+        ours_m.increment()
+        theirs_m.update(torch.from_numpy(REG_P[i]), torch.from_numpy(REG_T[i]))
+        ours_m.update(jnp.asarray(REG_P[i]), jnp.asarray(REG_T[i]))
+    assert_close(ours_m.compute_all(), theirs_m.compute_all(), atol=1e-6)
+    t_best, t_step = theirs_m.best_metric(return_step=True)
+    o_best, o_step = ours_m.best_metric(return_step=True)
+    assert o_step == t_step
+    assert_close(o_best, t_best, atol=1e-6)
+
+
+def test_metric_collection(ref, torch):
+    import jax.numpy as jnp
+
+    import metrics_tpu as M
+
+    theirs_m = ref.MetricCollection(
+        {
+            "acc": ref.classification.MulticlassAccuracy(num_classes=NC, average="micro"),
+            "f1": ref.classification.MulticlassF1Score(num_classes=NC, average="macro"),
+            "kappa": ref.classification.MulticlassCohenKappa(num_classes=NC),
+        }
+    )
+    ours_m = M.MetricCollection(
+        {
+            "acc": M.classification.MulticlassAccuracy(num_classes=NC, average="micro"),
+            "f1": M.classification.MulticlassF1Score(num_classes=NC, average="macro"),
+            "kappa": M.classification.MulticlassCohenKappa(num_classes=NC),
+        }
+    )
+    for i in range(BATCHES):
+        theirs_m.update(torch.from_numpy(MC_PROBS[i]), torch.from_numpy(MC_TARGET[i]))
+        ours_m.update(jnp.asarray(MC_PROBS[i]), jnp.asarray(MC_TARGET[i]))
+    theirs = theirs_m.compute()
+    ours = ours_m.compute()
+    assert set(ours) == set(theirs)
+    for k in theirs:
+        assert_close(ours[k], theirs[k], atol=1e-5)
+
+
+def test_composition_arithmetic(ref, torch):
+    import jax.numpy as jnp
+
+    import metrics_tpu as M
+
+    t_a = ref.regression.MeanSquaredError()
+    t_b = ref.regression.MeanAbsoluteError()
+    t_c = t_a + 2 * t_b
+    o_a = M.regression.MeanSquaredError()
+    o_b = M.regression.MeanAbsoluteError()
+    o_c = o_a + 2 * o_b
+    for i in range(BATCHES):
+        t_c.update(torch.from_numpy(REG_P[i]), torch.from_numpy(REG_T[i]))
+        o_c.update(jnp.asarray(REG_P[i]), jnp.asarray(REG_T[i]))
+    assert_close(o_c.compute(), t_c.compute(), atol=1e-6)

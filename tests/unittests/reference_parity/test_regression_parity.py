@@ -1,0 +1,149 @@
+"""Differential tests: regression + pairwise functionals vs the actual reference."""
+import numpy as np
+import pytest
+
+import metrics_tpu.functional.regression as F
+
+from .conftest import assert_close
+
+N = 200
+NO = 3  # outputs for multioutput sweeps
+
+rng = np.random.RandomState(11)
+P1 = rng.randn(N).astype(np.float32)
+T1 = (P1 + 0.5 * rng.randn(N)).astype(np.float32)
+P2 = rng.randn(N, NO).astype(np.float32)
+T2 = (P2 + 0.5 * rng.randn(N, NO)).astype(np.float32)
+POS_P = np.abs(P1) + 0.1
+POS_T = np.abs(T1) + 0.1
+PROB_P = rng.dirichlet(np.ones(5), N).astype(np.float32)
+PROB_T = rng.dirichlet(np.ones(5), N).astype(np.float32)
+
+
+def _run(ref, name, args_np, kwargs, atol=1e-5):
+    import jax.numpy as jnp
+    import torch
+
+    theirs = getattr(ref.functional.regression, name)(*[torch.from_numpy(np.asarray(a)) for a in args_np], **kwargs)
+    ours = getattr(F, name)(*[jnp.asarray(a) for a in args_np], **kwargs)
+    assert_close(ours, theirs, atol=atol)
+
+
+SWEEP_1D = [
+    ("mean_squared_error", {}),
+    ("mean_squared_error", {"squared": False}),
+    ("mean_absolute_error", {}),
+    ("mean_absolute_percentage_error", {}),
+    ("symmetric_mean_absolute_percentage_error", {}),
+    ("weighted_mean_absolute_percentage_error", {}),
+    ("log_cosh_error", {}),
+    ("minkowski_distance", {"p": 3.0}),
+    ("cosine_similarity", {"reduction": "mean"}),
+    ("explained_variance", {}),
+    ("explained_variance", {"multioutput": "variance_weighted"}),
+    ("r2_score", {}),
+    ("r2_score", {"adjusted": 5}),
+    ("pearson_corrcoef", {}),
+    ("spearman_corrcoef", {}),
+    ("kendall_rank_corrcoef", {}),
+    ("kendall_rank_corrcoef", {"variant": "a"}),
+    ("concordance_corrcoef", {}),
+    ("tweedie_deviance_score", {"power": 0.0}),
+]
+
+
+@pytest.mark.parametrize(("name", "kwargs"), SWEEP_1D)
+def test_regression_1d(ref, name, kwargs):
+    if name == "cosine_similarity":
+        _run(ref, name, (P2, T2), kwargs)
+        return
+    _run(ref, name, (P1, T1), kwargs)
+
+
+@pytest.mark.parametrize(
+    ("name", "kwargs"),
+    [
+        ("mean_squared_error", {}),
+        ("mean_absolute_error", {}),
+        ("r2_score", {"multioutput": "raw_values"}),
+        ("r2_score", {"multioutput": "uniform_average"}),
+        ("explained_variance", {"multioutput": "raw_values"}),
+        ("pearson_corrcoef", {}),
+        ("spearman_corrcoef", {}),
+        ("concordance_corrcoef", {}),
+    ],
+)
+def test_regression_multioutput(ref, name, kwargs):
+    _run(ref, name, (P2, T2), kwargs)
+
+
+def test_msle_tweedie_positive(ref):
+    _run(ref, "mean_squared_log_error", (POS_P, POS_T), {})
+    _run(ref, "tweedie_deviance_score", (POS_P, POS_T), {"power": 1.5})
+    _run(ref, "tweedie_deviance_score", (POS_P, POS_T), {"power": 2.0})
+    _run(ref, "tweedie_deviance_score", (POS_P, POS_T), {"power": 3.0})
+
+
+@pytest.mark.parametrize("log_prob", [True, False])
+def test_kl_divergence(ref, log_prob):
+    import jax.numpy as jnp
+    import torch
+
+    p = np.log(PROB_P) if log_prob else PROB_P
+    q = np.log(PROB_T) if log_prob else PROB_T
+    theirs = ref.functional.regression.kl_divergence(
+        torch.from_numpy(p), torch.from_numpy(q), log_prob=log_prob
+    )
+    ours = F.kl_divergence(jnp.asarray(p), jnp.asarray(q), log_prob=log_prob)
+    assert_close(ours, theirs, atol=1e-5)
+
+
+# ------------------------------------------------------------------- pairwise
+
+
+@pytest.mark.parametrize(
+    ("name", "kwargs"),
+    [
+        ("pairwise_cosine_similarity", {}),
+        ("pairwise_cosine_similarity", {"zero_diagonal": True}),
+        ("pairwise_euclidean_distance", {}),
+        ("pairwise_euclidean_distance", {"reduction": "mean"}),
+        ("pairwise_linear_similarity", {}),
+        ("pairwise_manhattan_distance", {}),
+        ("pairwise_minkowski_distance", {"exponent": 3}),
+    ],
+)
+def test_pairwise(ref, name, kwargs):
+    import jax.numpy as jnp
+    import torch
+
+    import metrics_tpu.functional.pairwise as FP
+
+    x = rng.randn(20, 8).astype(np.float32)
+    y = rng.randn(16, 8).astype(np.float32)
+    theirs = getattr(ref.functional.pairwise, name)(torch.from_numpy(x), torch.from_numpy(y), **kwargs)
+    ours = getattr(FP, name)(jnp.asarray(x), jnp.asarray(y), **kwargs)
+    assert_close(ours, theirs, atol=1e-4)
+
+
+# ----------------------------------------------------------------- aggregation
+
+
+def test_aggregation_classes(ref, torch):
+    import jax.numpy as jnp
+
+    import metrics_tpu as M
+
+    vals = rng.randn(4, 16).astype(np.float32)
+    weights = np.abs(rng.randn(4, 16)).astype(np.float32)
+    for name in ("MeanMetric", "SumMetric", "MaxMetric", "MinMetric"):
+        theirs_m = getattr(ref, name)()
+        ours_m = getattr(M, name)()
+        for i in range(4):
+            if name == "MeanMetric":
+                theirs_m.update(torch.from_numpy(vals[i]), torch.from_numpy(weights[i]))
+                ours_m.update(jnp.asarray(vals[i]), jnp.asarray(weights[i]))
+            else:
+                theirs_m.update(torch.from_numpy(vals[i]))
+                ours_m.update(jnp.asarray(vals[i]))
+        assert_close(ours_m.compute(), theirs_m.compute(), atol=1e-6)

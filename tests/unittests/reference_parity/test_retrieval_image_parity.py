@@ -1,0 +1,179 @@
+"""Differential tests: retrieval + image functionals vs the actual reference."""
+import numpy as np
+import pytest
+
+from .conftest import assert_close
+
+rng = np.random.RandomState(23)
+
+NQ = 12
+NDOC = 180
+IDX = np.sort(rng.randint(0, NQ, NDOC)).astype(np.int64)
+SCORES = rng.rand(NDOC).astype(np.float32)
+REL = (rng.rand(NDOC) > 0.6).astype(np.int64)
+REL_GRADED = rng.randint(0, 4, NDOC).astype(np.int64)
+
+
+# ------------------------------------------------------------------- retrieval
+
+
+@pytest.mark.parametrize(
+    ("name", "kwargs"),
+    [
+        ("retrieval_average_precision", {}),
+        ("retrieval_average_precision", {"top_k": 5}),
+        ("retrieval_reciprocal_rank", {}),
+        ("retrieval_precision", {"top_k": 5}),
+        ("retrieval_precision", {"top_k": 5, "adaptive_k": True}),
+        ("retrieval_recall", {"top_k": 5}),
+        ("retrieval_hit_rate", {"top_k": 5}),
+        ("retrieval_fall_out", {"top_k": 5}),
+        ("retrieval_r_precision", {}),
+        ("retrieval_normalized_dcg", {}),
+        ("retrieval_normalized_dcg", {"top_k": 5}),
+    ],
+)
+def test_retrieval_functional_per_query(ref, name, kwargs):
+    """Functionals operate on a single query's documents."""
+    import jax.numpy as jnp
+    import torch
+
+    import metrics_tpu.functional.retrieval as FR
+
+    rel = REL_GRADED if name == "retrieval_normalized_dcg" else REL
+    for q in range(4):
+        m = IDX == q
+        p, t = SCORES[m], rel[m]
+        if t.sum() == 0 and name != "retrieval_fall_out":
+            continue
+        theirs = getattr(ref.functional.retrieval, name)(torch.from_numpy(p), torch.from_numpy(t), **kwargs)
+        ours = getattr(FR, name)(jnp.asarray(p), jnp.asarray(t), **kwargs)
+        assert_close(ours, theirs, atol=1e-6)
+
+
+@pytest.mark.parametrize(
+    ("cls_name", "kwargs"),
+    [
+        ("RetrievalMAP", {}),
+        ("RetrievalMRR", {}),
+        ("RetrievalPrecision", {"top_k": 5}),
+        ("RetrievalRecall", {"top_k": 5}),
+        ("RetrievalHitRate", {"top_k": 5}),
+        ("RetrievalFallOut", {"top_k": 5}),
+        ("RetrievalRPrecision", {}),
+        ("RetrievalNormalizedDCG", {}),
+        ("RetrievalPrecisionRecallCurve", {"max_k": 10}),
+    ],
+)
+def test_retrieval_class(ref, cls_name, kwargs):
+    """Stateful retrieval metrics: multi-batch accumulate, grouped compute."""
+    import jax.numpy as jnp
+    import torch
+
+    import metrics_tpu.retrieval as R
+
+    rel = REL_GRADED if cls_name == "RetrievalNormalizedDCG" else REL
+    theirs_m = getattr(ref.retrieval, cls_name)(**kwargs)
+    ours_m = getattr(R, cls_name)(**kwargs)
+    for lo in range(0, NDOC, 60):
+        sl = slice(lo, lo + 60)
+        theirs_m.update(torch.from_numpy(SCORES[sl]), torch.from_numpy(rel[sl]), indexes=torch.from_numpy(IDX[sl]))
+        ours_m.update(jnp.asarray(SCORES[sl]), jnp.asarray(rel[sl]), indexes=jnp.asarray(IDX[sl]))
+    theirs = theirs_m.compute()
+    ours = ours_m.compute()
+    if cls_name == "RetrievalPrecisionRecallCurve":
+        for o, t in zip(ours, theirs):
+            assert_close(o, t, atol=1e-6)
+    else:
+        assert_close(ours, theirs, atol=1e-6)
+
+
+@pytest.mark.parametrize("empty_target_action", ["neg", "pos", "skip"])
+def test_retrieval_empty_target_action(ref, empty_target_action):
+    import jax.numpy as jnp
+    import torch
+
+    import metrics_tpu.retrieval as R
+
+    idx = np.array([0, 0, 0, 1, 1, 1, 2, 2], np.int64)
+    scores = rng.rand(8).astype(np.float32)
+    rel = np.array([1, 0, 1, 0, 0, 0, 1, 0], np.int64)  # query 1 has no positives
+    theirs_m = ref.retrieval.RetrievalMAP(empty_target_action=empty_target_action)
+    ours_m = R.RetrievalMAP(empty_target_action=empty_target_action)
+    theirs_m.update(torch.from_numpy(scores), torch.from_numpy(rel), indexes=torch.from_numpy(idx))
+    ours_m.update(jnp.asarray(scores), jnp.asarray(rel), indexes=jnp.asarray(idx))
+    assert_close(ours_m.compute(), theirs_m.compute(), atol=1e-6)
+
+
+# ----------------------------------------------------------------------- image
+
+B, C, H, W = 3, 3, 48, 48
+IMG_P = rng.rand(B, C, H, W).astype(np.float32)
+IMG_T = rng.rand(B, C, H, W).astype(np.float32)
+
+
+def _run_img(ref, name, args_np, kwargs, atol=1e-4):
+    import jax.numpy as jnp
+    import torch
+
+    theirs = getattr(ref.functional.image, name)(*[torch.from_numpy(np.asarray(a)) for a in args_np], **kwargs)
+    import metrics_tpu.functional.image as FI
+
+    ours = getattr(FI, name)(*[jnp.asarray(a) for a in args_np], **kwargs)
+    assert_close(ours, theirs, atol=atol)
+
+
+@pytest.mark.parametrize(
+    ("name", "kwargs"),
+    [
+        ("peak_signal_noise_ratio", {"data_range": 1.0}),
+        ("peak_signal_noise_ratio", {"data_range": 1.0, "dim": (1, 2, 3)}),
+        ("structural_similarity_index_measure", {"data_range": 1.0}),
+        ("structural_similarity_index_measure", {"data_range": 1.0, "gaussian_kernel": False, "kernel_size": 7}),
+        ("structural_similarity_index_measure", {"data_range": 1.0, "sigma": 2.0}),
+        ("universal_image_quality_index", {}),
+        ("spectral_angle_mapper", {}),
+        ("error_relative_global_dimensionless_synthesis", {}),
+        ("relative_average_spectral_error", {}),
+        ("root_mean_squared_error_using_sliding_window", {}),
+        ("spectral_distortion_index", {}),
+    ],
+)
+def test_image_functional(ref, name, kwargs):
+    _run_img(ref, name, (IMG_P, IMG_T), kwargs)
+
+
+@pytest.mark.parametrize("reduction", ["sum", "mean", "none"])
+def test_total_variation(ref, reduction):
+    _run_img(ref, "total_variation", (IMG_P,), {"reduction": reduction})
+
+
+def test_psnrb(ref):
+    gray_p = rng.rand(B, 1, H, W).astype(np.float32)
+    gray_t = rng.rand(B, 1, H, W).astype(np.float32)
+    _run_img(ref, "peak_signal_noise_ratio_with_blocked_effect", (gray_p, gray_t), {})
+    _run_img(ref, "peak_signal_noise_ratio_with_blocked_effect", (gray_p, gray_t), {"block_size": 4})
+
+
+def test_multiscale_ssim(ref):
+    p = rng.rand(2, 3, 192, 192).astype(np.float32)
+    t = rng.rand(2, 3, 192, 192).astype(np.float32)
+    _run_img(ref, "multiscale_structural_similarity_index_measure", (p, t), {"data_range": 1.0}, atol=1e-4)
+
+
+def test_image_gradients(ref):
+    import jax.numpy as jnp
+    import torch
+
+    import metrics_tpu.functional.image as FI
+
+    img = rng.rand(2, 3, 16, 16).astype(np.float32)
+    ty, tx = ref.functional.image.image_gradients(torch.from_numpy(img))
+    oy, ox = FI.image_gradients(jnp.asarray(img))
+    assert_close(oy, ty, atol=1e-6)
+    assert_close(ox, tx, atol=1e-6)
+
+
+@pytest.mark.parametrize("reduction", ["elementwise_mean", "sum", "none"])
+def test_ssim_reductions(ref, reduction):
+    _run_img(ref, "structural_similarity_index_measure", (IMG_P, IMG_T), {"data_range": 1.0, "reduction": reduction})
