@@ -148,6 +148,44 @@ def bench_ssim(batch: int = 16, hw: int = 256, repeats: int = 20) -> dict:
     return {"metric": "ssim_throughput", "value": round(px / dt / 1e9, 3), "unit": "Gpix/s/chip", "vs_baseline": None}
 
 
+def bench_auroc(n: int = 1 << 24) -> dict:
+    """Exact-mode (thresholds=None) binary AUROC: device sort+cumsum kernel vs the
+    reference's host path (torch CPU sort+cumsum, the same math torchmetrics runs)."""
+    import torch
+
+    from metrics_tpu.ops.clf_curve import binary_auroc_exact
+
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+    preds = jax.random.uniform(k1, (n,), jnp.float32)
+    target = (jax.random.uniform(k2, (n,)) < 0.3).astype(jnp.int32)
+    jax.device_get(binary_auroc_exact(preds, target))  # compile + warm
+
+    t0 = time.perf_counter()
+    val = float(binary_auroc_exact(preds, target))
+    dt = time.perf_counter() - t0
+    assert 0.45 < val < 0.55, f"sanity: random scores give AUROC ~0.5, got {val}"
+
+    # reference-equivalent host kernel on a smaller slice, normalized per element
+    n_cpu = min(n, 1 << 22)
+    tp = torch.rand(n_cpu)
+    tt = (torch.rand(n_cpu) < 0.3).long()
+    t0 = time.perf_counter()
+    order = torch.argsort(tp, descending=True)
+    st = tt[order]
+    tps = torch.cumsum(st, 0)
+    fps = torch.arange(1, n_cpu + 1) - tps
+    tpr = tps.float() / tps[-1]
+    fpr = fps.float() / fps[-1]
+    float(torch.trapz(tpr, fpr))
+    cpu_dt = time.perf_counter() - t0
+    return {
+        "metric": "exact_auroc_throughput",
+        "value": round(n / dt / 1e9, 3),
+        "unit": "Gsamples/s/chip",
+        "vs_baseline": round((n / dt) / (n_cpu / cpu_dt), 2),
+    }
+
+
 def bench_retrieval(n_docs: int = 1 << 22) -> dict:
     """BASELINE config 5: RetrievalMAP over fixed-capacity buffers (docs/s)."""
     import numpy as np
@@ -177,7 +215,9 @@ if __name__ == "__main__":
     import argparse
 
     parser = argparse.ArgumentParser(description="metrics_tpu benchmarks")
-    parser.add_argument("--config", choices=("accuracy", "map", "ssim", "retrieval", "all"), default="accuracy")
+    parser.add_argument(
+        "--config", choices=("accuracy", "map", "ssim", "retrieval", "auroc", "all"), default="accuracy"
+    )
     config = parser.parse_args().config
     if config in ("accuracy", "all"):
         tpu_eps = bench_tpu()
@@ -198,3 +238,5 @@ if __name__ == "__main__":
         print(json.dumps(bench_ssim()))
     if config in ("retrieval", "all"):
         print(json.dumps(bench_retrieval()))
+    if config in ("auroc", "all"):
+        print(json.dumps(bench_auroc()))

@@ -30,9 +30,38 @@ from metrics_tpu.functional.classification.roc import (
     _multiclass_roc_compute,
     _multilabel_roc_compute,
 )
+from metrics_tpu.ops.clf_curve import (
+    binary_auroc_exact,
+    multiclass_auroc_exact,
+    multilabel_auroc_exact,
+)
+from metrics_tpu.utils.checks import _is_concrete
 from metrics_tpu.utils.compute import _auc_compute_without_check, _safe_divide
 from metrics_tpu.utils.enums import ClassificationTask
 from metrics_tpu.utils.prints import rank_zero_warn
+
+
+def _reduce_scores(res: Array, average: Optional[str], weights: Optional[Array]) -> Array:
+    """NaN-dropping macro/weighted reduction of per-class scores (reference: auroc.py:56-69).
+
+    jit-safe: the NaN warning is advisory and only emitted eagerly (the reduction
+    math itself is branchless ``where`` masking).
+    """
+    if average is None or average == "none":
+        return res
+    if _is_concrete(res) and bool(jnp.isnan(res).any()):
+        rank_zero_warn(
+            f"Average precision score for one or more classes was `nan`. Ignoring these classes in {average}-average",
+            UserWarning,
+        )
+    idx = ~jnp.isnan(res)
+    if average == "macro":
+        return jnp.where(idx, res, 0.0).sum() / idx.sum()
+    if average == "weighted" and weights is not None:
+        weights = jnp.where(idx, weights.astype(jnp.float32), 0.0)
+        weights = _safe_divide(weights, weights.sum())
+        return jnp.where(idx, res * weights, 0.0).sum()
+    raise ValueError("Received an incompatible combinations of inputs to make reduction.")
 
 
 def _reduce_auroc(
@@ -46,21 +75,7 @@ def _reduce_auroc(
         res = _auc_compute_without_check(fpr, tpr, 1.0, axis=1)
     else:
         res = jnp.stack([_auc_compute_without_check(x, y, 1.0) for x, y in zip(fpr, tpr)])
-    if average is None or average == "none":
-        return res
-    if bool(jnp.isnan(res).any()):
-        rank_zero_warn(
-            f"Average precision score for one or more classes was `nan`. Ignoring these classes in {average}-average",
-            UserWarning,
-        )
-    idx = ~jnp.isnan(res)
-    if average == "macro":
-        return jnp.where(idx, res, 0.0).sum() / idx.sum()
-    if average == "weighted" and weights is not None:
-        weights = jnp.where(idx, weights, 0.0)
-        weights = _safe_divide(weights, weights.sum())
-        return jnp.where(idx, res * weights, 0.0).sum()
-    raise ValueError("Received an incompatible combinations of inputs to make reduction.")
+    return _reduce_scores(res, average, weights)
 
 
 def _binary_auroc_arg_validation(
@@ -79,7 +94,13 @@ def _binary_auroc_compute(
     max_fpr: Optional[float] = None,
     pos_label: int = 1,
 ) -> Array:
-    """Reference: auroc.py:82-106 (incl. McClish-corrected partial AUC)."""
+    """Reference: auroc.py:82-106 (incl. McClish-corrected partial AUC).
+
+    Exact mode (``thresholds=None``) runs fully on device — sort+cumsum with
+    tie-run collapsing (ops/clf_curve.py) instead of the reference's host path.
+    """
+    if not _is_confmat_state(state):
+        return binary_auroc_exact(state[0], state[1], max_fpr=max_fpr)
     fpr, tpr, _ = _binary_roc_compute(state, thresholds, pos_label)
     if max_fpr is None or max_fpr == 1:
         return _auc_compute_without_check(fpr, tpr, 1.0)
@@ -114,13 +135,6 @@ def binary_auroc(
     return _binary_auroc_compute(state, thresholds, max_fpr)
 
 
-def _exact_mode_class_weights(target, num_classes: int) -> Array:
-    """Per-class positive counts from raw exact-mode targets (ignoring -1 masks)."""
-    t = np.asarray(target)
-    t = t[t >= 0]
-    return jnp.asarray(np.bincount(t, minlength=num_classes).astype(np.float32))
-
-
 def _multiclass_auroc_arg_validation(
     num_classes: int,
     average: Optional[str] = "macro",
@@ -140,18 +154,12 @@ def _multiclass_auroc_compute(
     average: Optional[str] = "macro",
     thresholds: Optional[Array] = None,
 ) -> Array:
-    """Reference: auroc.py:191-203."""
+    """Reference: auroc.py:191-203. Exact mode: vmapped one-vs-rest device kernel."""
+    if thresholds is None:
+        res, pos = multiclass_auroc_exact(state[0], state[1])
+        return _reduce_scores(res, average, weights=pos)
     fpr, tpr, _ = _multiclass_roc_compute(state, num_classes, thresholds)
-    return _reduce_auroc(
-        fpr,
-        tpr,
-        average,
-        weights=(
-            _exact_mode_class_weights(state[1], num_classes)
-            if thresholds is None
-            else state[0][:, 1, :].sum(-1).astype(jnp.float32)
-        ),
-    )
+    return _reduce_auroc(fpr, tpr, average, weights=state[0][:, 1, :].sum(-1).astype(jnp.float32))
 
 
 def multiclass_auroc(
@@ -194,25 +202,21 @@ def _multilabel_auroc_compute(
     thresholds: Optional[Array],
     ignore_index: Optional[int] = None,
 ) -> Array:
-    """Reference: auroc.py:305-330."""
+    """Reference: auroc.py:305-330. Exact mode: vmapped per-label device kernel
+    (negative targets are excluded by the kernel's validity mask, so the micro
+    flatten needs no host-side ignore filtering)."""
     if average == "micro":
         if _is_confmat_state(state) and thresholds is not None:
             return _binary_auroc_compute(state.sum(1), thresholds, max_fpr=None)
-        preds = np.asarray(state[0]).ravel()
-        target = np.asarray(state[1]).ravel()
-        if ignore_index is not None:
-            idx = target < 0
-            preds = preds[~idx]
-            target = target[~idx]
+        preds = jnp.asarray(state[0]).ravel()
+        target = jnp.asarray(state[1]).ravel()
         return _binary_auroc_compute((preds, target), thresholds, max_fpr=None)
 
-    fpr, tpr, _ = _multilabel_roc_compute(state, num_labels, thresholds, ignore_index)
     if thresholds is None:
-        t = np.asarray(state[1])
-        weights = jnp.asarray((t == 1).sum(0).astype(np.float32))
-    else:
-        weights = state[0][:, 1, :].sum(-1).astype(jnp.float32)
-    return _reduce_auroc(fpr, tpr, average, weights=weights)
+        res, pos = multilabel_auroc_exact(state[0], state[1])
+        return _reduce_scores(res, average, weights=pos)
+    fpr, tpr, _ = _multilabel_roc_compute(state, num_labels, thresholds, ignore_index)
+    return _reduce_auroc(fpr, tpr, average, weights=state[0][:, 1, :].sum(-1).astype(jnp.float32))
 
 
 def multilabel_auroc(

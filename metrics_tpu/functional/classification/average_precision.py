@@ -10,7 +10,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import Array
 
-from metrics_tpu.functional.classification.auroc import _exact_mode_class_weights
+from metrics_tpu.functional.classification.auroc import _reduce_scores
 from metrics_tpu.functional.classification.precision_recall_curve import (
     _binary_precision_recall_curve_arg_validation,
     _binary_precision_recall_curve_compute,
@@ -29,9 +29,12 @@ from metrics_tpu.functional.classification.precision_recall_curve import (
     _multilabel_precision_recall_curve_update,
 )
 from metrics_tpu.functional.classification.roc import _is_confmat_state
-from metrics_tpu.utils.compute import _safe_divide
+from metrics_tpu.ops.clf_curve import (
+    binary_average_precision_exact,
+    multiclass_average_precision_exact,
+    multilabel_average_precision_exact,
+)
 from metrics_tpu.utils.enums import ClassificationTask
-from metrics_tpu.utils.prints import rank_zero_warn
 
 
 def _reduce_average_precision(
@@ -40,33 +43,22 @@ def _reduce_average_precision(
     average: Optional[str] = "macro",
     weights: Optional[Array] = None,
 ) -> Array:
-    """Reference: average_precision.py:43-67."""
+    """Reference: average_precision.py:43-67 (reduction shared with AUROC)."""
     if isinstance(precision, (jnp.ndarray, np.ndarray)) and not isinstance(precision, (list, tuple)):
         res = -jnp.sum((recall[:, 1:] - recall[:, :-1]) * precision[:, :-1], axis=1)
     else:
         res = jnp.stack([-jnp.sum((r[1:] - r[:-1]) * p[:-1]) for p, r in zip(precision, recall)])
-    if average is None or average == "none":
-        return res
-    if bool(jnp.isnan(res).any()):
-        rank_zero_warn(
-            f"Average precision score for one or more classes was `nan`. Ignoring these classes in {average}-average",
-            UserWarning,
-        )
-    idx = ~jnp.isnan(res)
-    if average == "macro":
-        return jnp.where(idx, res, 0.0).sum() / idx.sum()
-    if average == "weighted" and weights is not None:
-        weights = jnp.where(idx, weights, 0.0)
-        weights = _safe_divide(weights, weights.sum())
-        return jnp.where(idx, res * weights, 0.0).sum()
-    raise ValueError("Received an incompatible combinations of inputs to make reduction.")
+    return _reduce_scores(res, average, weights)
 
 
 def _binary_average_precision_compute(
     state: Union[Array, Tuple[Array, Array]],
     thresholds: Optional[Array],
 ) -> Array:
-    """Reference: average_precision.py:70-75."""
+    """Reference: average_precision.py:70-75. Exact mode runs fully on device
+    (sort+cumsum kernel, ops/clf_curve.py)."""
+    if not _is_confmat_state(state):
+        return binary_average_precision_exact(state[0], state[1])
     precision, recall, _ = _binary_precision_recall_curve_compute(state, thresholds)
     return -jnp.sum((recall[1:] - recall[:-1]) * precision[:-1])
 
@@ -106,17 +98,13 @@ def _multiclass_average_precision_compute(
     average: Optional[str] = "macro",
     thresholds: Optional[Array] = None,
 ) -> Array:
-    """Reference: average_precision.py:163-175."""
+    """Reference: average_precision.py:163-175. Exact mode: vmapped OVR device kernel."""
+    if thresholds is None:
+        res, pos = multiclass_average_precision_exact(state[0], state[1])
+        return _reduce_scores(res, average, weights=pos)
     precision, recall, _ = _multiclass_precision_recall_curve_compute(state, num_classes, thresholds)
     return _reduce_average_precision(
-        precision,
-        recall,
-        average,
-        weights=(
-            _exact_mode_class_weights(state[1], num_classes)
-            if thresholds is None
-            else state[0][:, 1, :].sum(-1).astype(jnp.float32)
-        ),
+        precision, recall, average, weights=state[0][:, 1, :].sum(-1).astype(jnp.float32)
     )
 
 
@@ -160,25 +148,22 @@ def _multilabel_average_precision_compute(
     thresholds: Optional[Array],
     ignore_index: Optional[int] = None,
 ) -> Array:
-    """Reference: average_precision.py:282-310."""
+    """Reference: average_precision.py:282-310. Exact mode: vmapped per-label device
+    kernel (negative targets excluded by the kernel's validity mask)."""
     if average == "micro":
         if _is_confmat_state(state) and thresholds is not None:
             return _binary_average_precision_compute(state.sum(1), thresholds)
-        preds = np.asarray(state[0]).ravel()
-        target = np.asarray(state[1]).ravel()
-        if ignore_index is not None:
-            idx = target < 0
-            preds = preds[~idx]
-            target = target[~idx]
+        preds = jnp.asarray(state[0]).ravel()
+        target = jnp.asarray(state[1]).ravel()
         return _binary_average_precision_compute((preds, target), thresholds)
 
-    precision, recall, _ = _multilabel_precision_recall_curve_compute(state, num_labels, thresholds, ignore_index)
     if thresholds is None:
-        t = np.asarray(state[1])
-        weights = jnp.asarray((t == 1).sum(0).astype(np.float32))
-    else:
-        weights = state[0][:, 1, :].sum(-1).astype(jnp.float32)
-    return _reduce_average_precision(precision, recall, average, weights=weights)
+        res, pos = multilabel_average_precision_exact(state[0], state[1])
+        return _reduce_scores(res, average, weights=pos)
+    precision, recall, _ = _multilabel_precision_recall_curve_compute(state, num_labels, thresholds, ignore_index)
+    return _reduce_average_precision(
+        precision, recall, average, weights=state[0][:, 1, :].sum(-1).astype(jnp.float32)
+    )
 
 
 def multilabel_average_precision(

@@ -1,0 +1,137 @@
+"""Device-side exact-mode curve kernel tests (ops/clf_curve.py).
+
+The exact (``thresholds=None``) AUROC/AP path is a TPU redesign: sort + cumsum +
+tie-run collapsing entirely under jit with static shapes, where the reference (and
+round-1 of this framework) dropped to host NumPy. These tests pin the kernel against
+sklearn on adversarial tie patterns, verify the ignore-mask and padding semantics,
+and verify jit/shard_map compatibility that the host path could never have.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import average_precision_score, roc_auc_score
+
+from metrics_tpu.ops import clf_curve as cc
+
+rng = np.random.RandomState(99)
+
+
+@pytest.mark.parametrize("n", [2, 3, 17, 256, 1000])
+@pytest.mark.parametrize("tie_grid", [None, 2, 10])
+def test_binary_auroc_vs_sklearn(n, tie_grid):
+    for trial in range(3):
+        p = rng.rand(n).astype(np.float32)
+        if tie_grid:
+            p = np.round(p * tie_grid) / tie_grid
+        t = rng.randint(0, 2, n)
+        if t.min() == t.max():
+            t[0] = 1 - t[0]
+        ours = float(cc.binary_auroc_exact(jnp.asarray(p), jnp.asarray(t)))
+        assert abs(ours - roc_auc_score(t, p)) < 1e-6
+
+
+@pytest.mark.parametrize("tie_grid", [None, 4])
+def test_binary_ap_vs_sklearn(tie_grid):
+    for n in (5, 64, 500):
+        p = rng.rand(n).astype(np.float32)
+        if tie_grid:
+            p = np.round(p * tie_grid) / tie_grid
+        t = rng.randint(0, 2, n)
+        if t.sum() == 0:
+            t[0] = 1
+        ours = float(cc.binary_average_precision_exact(jnp.asarray(p), jnp.asarray(t)))
+        assert abs(ours - average_precision_score(t, p)) < 1e-6
+
+
+def test_all_scores_identical():
+    """One giant tie run: AUROC must be exactly 0.5 (the chance diagonal)."""
+    p = np.full(100, 0.7, np.float32)
+    t = rng.randint(0, 2, 100)
+    t[:2] = [0, 1]
+    assert abs(float(cc.binary_auroc_exact(jnp.asarray(p), jnp.asarray(t))) - 0.5) < 1e-7
+
+
+def test_degenerate_single_class_is_nan():
+    p = rng.rand(32).astype(np.float32)
+    assert np.isnan(float(cc.binary_auroc_exact(jnp.asarray(p), jnp.ones(32, np.int32))))
+    assert np.isnan(float(cc.binary_auroc_exact(jnp.asarray(p), jnp.zeros(32, np.int32))))
+    assert np.isnan(float(cc.binary_average_precision_exact(jnp.asarray(p), jnp.zeros(32, np.int32))))
+
+
+def test_negative_targets_are_masked():
+    p = rng.rand(128).astype(np.float32)
+    t = rng.randint(0, 2, 128)
+    t[::5] = -1
+    keep = t >= 0
+    ours = float(cc.binary_auroc_exact(jnp.asarray(p), jnp.asarray(t)))
+    assert abs(ours - roc_auc_score(t[keep], p[keep])) < 1e-6
+
+
+def test_padding_equals_unpadded():
+    """pow2 padding (n=100 -> 128) must not move the result at all."""
+    p = rng.rand(100).astype(np.float32)
+    t = rng.randint(0, 2, 100)
+    a = float(cc.binary_auroc_exact(jnp.asarray(p), jnp.asarray(t)))
+    b = float(cc.binary_auroc_exact(jnp.asarray(p[:64]), jnp.asarray(t[:64])))  # exact pow2, no pad
+    assert abs(a - roc_auc_score(t, p)) < 1e-6
+    assert abs(b - roc_auc_score(t[:64], p[:64])) < 1e-6
+
+
+@pytest.mark.parametrize("max_fpr", [0.1, 0.5, 0.9, 1.0])
+def test_max_fpr_partial_auc(max_fpr):
+    """McClish-corrected partial AUC against a host trapezoid recomputation."""
+    p = np.round(rng.rand(300), 2).astype(np.float32)
+    t = rng.randint(0, 2, 300)
+    ours = float(cc.binary_auroc_exact(jnp.asarray(p), jnp.asarray(t), max_fpr=max_fpr))
+    if max_fpr == 1.0:
+        assert abs(ours - roc_auc_score(t, p)) < 1e-6
+    else:
+        assert abs(ours - roc_auc_score(t, p, max_fpr=max_fpr)) < 1e-6
+
+
+def test_ovr_multiclass_vs_sklearn():
+    probs = rng.dirichlet(np.ones(6), 400).astype(np.float32)
+    probs = np.round(probs, 2)
+    t = rng.randint(0, 6, 400)
+    res, pos = cc.multiclass_auroc_exact(jnp.asarray(probs), jnp.asarray(t))
+    for c in range(6):
+        sk = roc_auc_score((t == c).astype(int), probs[:, c])
+        assert abs(float(res[c]) - sk) < 1e-6
+    np.testing.assert_array_equal(np.asarray(pos), np.bincount(t, minlength=6))
+
+
+def test_exact_mode_is_jittable():
+    """The whole point of the redesign: exact AUROC under jit (host path could not)."""
+    p = jnp.asarray(rng.rand(256).astype(np.float32))
+    t = jnp.asarray(rng.randint(0, 2, 256))
+
+    @jax.jit
+    def f(p, t):
+        return cc.binary_auroc_exact(p, t), cc.binary_average_precision_exact(p, t)
+
+    auroc, ap = f(p, t)
+    assert abs(float(auroc) - roc_auc_score(np.asarray(t), np.asarray(p))) < 1e-6
+    assert abs(float(ap) - average_precision_score(np.asarray(t), np.asarray(p))) < 1e-6
+
+
+def test_exact_auroc_large_n_drift():
+    """1M samples: f32 ratio arithmetic must stay within the 1e-6 drift budget."""
+    n = 1 << 20
+    p = rng.rand(n).astype(np.float32)
+    t = (rng.rand(n) < 0.3).astype(np.int32)
+    ours = float(cc.binary_auroc_exact(jnp.asarray(p), jnp.asarray(t)))
+    assert abs(ours - roc_auc_score(t, p)) < 1e-6
+
+
+def test_functional_entrypoints_use_device_path_under_jit():
+    """binary_auroc / binary_average_precision with thresholds=None now jit."""
+    from metrics_tpu.functional.classification import binary_auroc, binary_average_precision
+
+    p = jnp.asarray(rng.rand(128).astype(np.float32))
+    t = jnp.asarray(rng.randint(0, 2, 128))
+
+    a = jax.jit(lambda p, t: binary_auroc(p, t, validate_args=False))(p, t)
+    b = jax.jit(lambda p, t: binary_average_precision(p, t, validate_args=False))(p, t)
+    assert abs(float(a) - roc_auc_score(np.asarray(t), np.asarray(p))) < 1e-6
+    assert abs(float(b) - average_precision_score(np.asarray(t), np.asarray(p))) < 1e-6
