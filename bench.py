@@ -127,6 +127,29 @@ def bench_map(n_images: int = 64) -> dict:
             "vs_baseline": None}
 
 
+def _reference_torchmetrics():
+    """Import the actual reference library (torch CPU) as the local baseline.
+
+    Looks for the reference checkout at $METRICS_TPU_REFERENCE_PATH (default:
+    /root/reference/src, this container's mount). When absent, benches report
+    vs_baseline=null rather than failing.
+    """
+    import os
+    import sys
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    ref_src = os.environ.get("METRICS_TPU_REFERENCE_PATH", "/root/reference/src")
+    for p in (os.path.join(repo, "tests", "helpers", "refshim"), ref_src):
+        if os.path.isdir(p) and p not in sys.path:
+            sys.path.insert(0, p)
+    try:
+        import torchmetrics  # noqa: PLC0415
+
+        return torchmetrics
+    except Exception:
+        return None
+
+
 def bench_ssim(batch: int = 16, hw: int = 256, repeats: int = 20) -> dict:
     """BASELINE config 4 (SSIM half): streamed SSIM update throughput (pixels/s)."""
     from metrics_tpu.image import StructuralSimilarityIndexMeasure
@@ -145,7 +168,23 @@ def bench_ssim(batch: int = 16, hw: int = 256, repeats: int = 20) -> dict:
     jax.device_get(state)
     dt = time.perf_counter() - t0
     px = repeats * batch * 3 * hw * hw
-    return {"metric": "ssim_throughput", "value": round(px / dt / 1e9, 3), "unit": "Gpix/s/chip", "vs_baseline": None}
+
+    vs = None
+    tm = _reference_torchmetrics()
+    if tm is not None:
+        import torch
+
+        ref = tm.image.StructuralSimilarityIndexMeasure(data_range=1.0)
+        t1 = torch.rand(batch, 3, hw, hw)
+        t2 = torch.rand(batch, 3, hw, hw)
+        ref.update(t1, t2)  # warm
+        ref.reset()
+        t0 = time.perf_counter()
+        for _ in range(3):
+            ref.update(t1, t2)
+        ref_dt = (time.perf_counter() - t0) / 3
+        vs = round((px / dt) / (batch * 3 * hw * hw / ref_dt), 2)
+    return {"metric": "ssim_throughput", "value": round(px / dt / 1e9, 3), "unit": "Gpix/s/chip", "vs_baseline": vs}
 
 
 def bench_auroc(n: int = 1 << 24) -> dict:
@@ -207,8 +246,24 @@ def bench_retrieval(n_docs: int = 1 << 22) -> dict:
     value = float(metric.compute_from(state))
     dt = time.perf_counter() - t0
     assert 0.0 < value < 1.0
+
+    vs = None
+    tm = _reference_torchmetrics()
+    if tm is not None:
+        import torch
+
+        n_cpu = min(n_docs, 1 << 18)  # the reference's per-query python loop is slow
+        ref = tm.retrieval.RetrievalMAP()
+        tidx = torch.from_numpy(np.asarray(idx[:n_cpu]).astype(np.int64))
+        tsc = torch.from_numpy(np.asarray(scores[:n_cpu]))
+        trel = torch.from_numpy(np.asarray(rel[:n_cpu]).astype(np.int64))
+        ref.update(tsc, trel, indexes=tidx)
+        t0 = time.perf_counter()
+        ref.compute()
+        ref_dt = time.perf_counter() - t0
+        vs = round((n_docs / dt) / (n_cpu / ref_dt), 2)
     return {"metric": "retrieval_map_docs_per_s", "value": round(n_docs / dt / 1e6, 2), "unit": "Mdocs/s/chip",
-            "vs_baseline": None}
+            "vs_baseline": vs}
 
 
 if __name__ == "__main__":

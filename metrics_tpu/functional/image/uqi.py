@@ -7,7 +7,7 @@ from typing import Optional, Sequence
 import jax.numpy as jnp
 from jax import Array
 
-from metrics_tpu.functional.image.helper import _depthwise_conv2d, _gaussian_kernel_2d, _reflection_pad_2d
+from metrics_tpu.functional.image.helper import _gaussian, _reflection_pad_2d, _separable_blur_2d
 from metrics_tpu.utils.checks import _check_same_shape
 from metrics_tpu.utils.distributed import reduce
 
@@ -39,8 +39,8 @@ def universal_image_quality_index(
     preds = jnp.asarray(preds, jnp.float32)
     target = jnp.asarray(target, jnp.float32)
 
-    channel = preds.shape[1]
-    kernel = _gaussian_kernel_2d(channel, kernel_size, sigma)
+    g_h = _gaussian(kernel_size[0], sigma[0])[0]
+    g_w = _gaussian(kernel_size[1], sigma[1])[0]
     pad_h = (kernel_size[0] - 1) // 2
     pad_w = (kernel_size[1] - 1) // 2
 
@@ -48,7 +48,7 @@ def universal_image_quality_index(
     target = _reflection_pad_2d(target, pad_h, pad_w)
 
     input_list = jnp.concatenate((preds, target, preds * preds, target * target, preds * target))
-    outputs = _depthwise_conv2d(input_list, kernel)
+    outputs = _separable_blur_2d(input_list, g_h, g_w)
     b = preds.shape[0]
     output_list = [outputs[i * b : (i + 1) * b] for i in range(5)]
 

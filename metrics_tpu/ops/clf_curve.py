@@ -84,7 +84,9 @@ def _trapz(y: Array, x: Array) -> Array:
 
 
 def _binary_auroc_kernel(preds: Array, target: Array, valid: Array, max_fpr: Optional[Array]) -> Array:
-    """Exact binary AUROC; NaN when either class is absent (reference parity)."""
+    """Exact binary AUROC; 0.0 when either class is absent (reference zeroes the
+    degenerate curve via safe division — torch ``_binary_roc_compute`` — and the
+    zero DOES participate in macro averages, unlike AP's NaN)."""
     fpr0, tpr0, pos, neg = _roc_points(preds, target, valid)
     if max_fpr is None:
         area = _trapz(tpr0, fpr0)
@@ -103,7 +105,9 @@ def _binary_auroc_kernel(preds: Array, target: Array, valid: Array, max_fpr: Opt
         partial_auc = _trapz(yc, xc)
         min_area = 0.5 * max_fpr**2
         area = 0.5 * (1 + (partial_auc - min_area) / (max_fpr - min_area))
-    return jnp.where((pos > 0) & (neg > 0), area, jnp.nan)
+    # degenerate single-class data: safe division zeroed the curve, so area == 0
+    # exactly on the max_fpr=None path, matching the reference's 0.0 (not NaN)
+    return area
 
 
 def _binary_ap_kernel(preds: Array, target: Array, valid: Array) -> Tuple[Array, Array]:
@@ -155,10 +159,13 @@ def binary_average_precision_exact(preds: Array, target: Array) -> Array:
 
 
 def _binary_auroc_with_pos(preds: Array, target: Array, valid: Array) -> Tuple[Array, Array]:
-    """(AUROC, positive count) — the per-class body of the vmapped tiers."""
+    """(AUROC, positive count) — the per-class body of the vmapped tiers.
+
+    Absent classes score 0.0 (not NaN) and thus participate in macro averages,
+    exactly like the reference's safe-division-zeroed degenerate curves.
+    """
     fpr0, tpr0, pos, neg = _roc_points(preds, target, valid)
-    area = _trapz(tpr0, fpr0)
-    return jnp.where((pos > 0) & (neg > 0), area, jnp.nan), pos
+    return _trapz(tpr0, fpr0), pos
 
 
 def _make_ovr(kernel):

@@ -52,11 +52,27 @@ def test_all_scores_identical():
     assert abs(float(cc.binary_auroc_exact(jnp.asarray(p), jnp.asarray(t))) - 0.5) < 1e-7
 
 
-def test_degenerate_single_class_is_nan():
+def test_degenerate_single_class():
+    """Reference parity: degenerate AUROC is 0.0 (zeroed curve, participates in
+    macro averages); degenerate AP is NaN (dropped from macro averages)."""
     p = rng.rand(32).astype(np.float32)
-    assert np.isnan(float(cc.binary_auroc_exact(jnp.asarray(p), jnp.ones(32, np.int32))))
-    assert np.isnan(float(cc.binary_auroc_exact(jnp.asarray(p), jnp.zeros(32, np.int32))))
+    assert float(cc.binary_auroc_exact(jnp.asarray(p), jnp.ones(32, np.int32))) == 0.0
+    assert float(cc.binary_auroc_exact(jnp.asarray(p), jnp.zeros(32, np.int32))) == 0.0
     assert np.isnan(float(cc.binary_average_precision_exact(jnp.asarray(p), jnp.zeros(32, np.int32))))
+
+
+def test_absent_class_macro_parity(ref=None):
+    """Multiclass macro AUROC with an absent class averages IN the 0.0 score."""
+    from metrics_tpu.functional.classification import multiclass_auroc
+
+    probs = rng.dirichlet(np.ones(4), 60).astype(np.float32)
+    t = rng.randint(0, 3, 60)  # class 3 absent
+    res = np.asarray(
+        multiclass_auroc(jnp.asarray(probs), jnp.asarray(t), num_classes=4, average="none")
+    )
+    assert res[3] == 0.0
+    macro = float(multiclass_auroc(jnp.asarray(probs), jnp.asarray(t), num_classes=4, average="macro"))
+    assert abs(macro - res.mean()) < 1e-6
 
 
 def test_negative_targets_are_masked():
