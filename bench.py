@@ -187,6 +187,57 @@ def bench_ssim(batch: int = 16, hw: int = 256, repeats: int = 20) -> dict:
     return {"metric": "ssim_throughput", "value": round(px / dt / 1e9, 3), "unit": "Gpix/s/chip", "vs_baseline": vs}
 
 
+def bench_fid(batch: int = 32, n_batches: int = 8, hw: int = 299) -> dict:
+    """BASELINE config 4 (FID half): InceptionV3-2048 feature extraction on TPU plus
+    the covariance accumulation and symmetrized-eigh matrix sqrt (images/s).
+
+    Random (correctly-shaped) weights: throughput is weight-value-independent."""
+    from metrics_tpu.image import FrechetInceptionDistance
+    from metrics_tpu.models.inception import inception_features, random_inception_params
+
+    params = random_inception_params(0)
+    fid = FrechetInceptionDistance(feature=lambda x: inception_features(params, x, 2048), num_features=2048)
+
+    key = jax.random.PRNGKey(0)
+    imgs = jax.random.randint(key, (batch, 3, hw, hw), 0, 256, dtype=jnp.uint8)
+    fid.update(imgs, real=True)  # eager once: sizes the lazy states
+    upd_real = jax.jit(lambda s, x: fid.local_update(s, x, real=True))
+    upd_fake = jax.jit(lambda s, x: fid.local_update(s, x, real=False))
+    state = upd_fake(upd_real(fid.init_state(), imgs), imgs)
+    jax.device_get(state["fake_features_num_samples"])  # compile warm-up both branches
+
+    def timed():
+        t0 = time.perf_counter()
+        state = fid.init_state()
+        for i in range(n_batches):
+            state = (upd_real if i % 2 == 0 else upd_fake)(state, imgs)
+        # fetch a scalar: the in-order queue syncs the whole dispatch chain,
+        # without pulling the 16 MB m2 buffer over the tunnel inside the timed region
+        jax.device_get(state["fake_features_num_samples"])
+        return n_batches * batch / (time.perf_counter() - t0), state
+
+    timed()  # queue warm-up
+    r1, state = timed()
+    r2, state = timed()
+    imgs_per_s = max(r1, r2)
+
+    # device matrix-sqrt compute (Newton-Schulz kernel): jit forces the tracer
+    # branch of compute(); eager compute_from would take the host-f64 parity path
+    compute_j = jax.jit(fid.compute_from)
+    float(compute_j(state))  # compile warm-up
+    t0 = time.perf_counter()
+    val = float(compute_j(state))
+    compute_ms = (time.perf_counter() - t0) * 1000
+    assert jnp.isfinite(val)
+    return {
+        "metric": "fid_inception_images_per_s",
+        "value": round(imgs_per_s, 2),
+        "unit": "images/s/chip",
+        "vs_baseline": None,
+        "compute_ms": round(compute_ms, 1),
+    }
+
+
 def bench_auroc(n: int = 1 << 24) -> dict:
     """Exact-mode (thresholds=None) binary AUROC: device sort+cumsum kernel vs the
     reference's host path (torch CPU sort+cumsum, the same math torchmetrics runs)."""
@@ -271,7 +322,7 @@ if __name__ == "__main__":
 
     parser = argparse.ArgumentParser(description="metrics_tpu benchmarks")
     parser.add_argument(
-        "--config", choices=("accuracy", "map", "ssim", "retrieval", "auroc", "all"), default="accuracy"
+        "--config", choices=("accuracy", "map", "ssim", "retrieval", "auroc", "fid", "all"), default="accuracy"
     )
     config = parser.parse_args().config
     if config in ("accuracy", "all"):
@@ -295,3 +346,5 @@ if __name__ == "__main__":
         print(json.dumps(bench_retrieval()))
     if config in ("auroc", "all"):
         print(json.dumps(bench_auroc()))
+    if config in ("fid", "all"):
+        print(json.dumps(bench_fid()))

@@ -239,6 +239,81 @@ def params_from_state_dict(state: Dict[str, np.ndarray]) -> Dict[str, Any]:
     return params
 
 
+# Complete conv spec (out, in, kh, kw) of the torch-fidelity InceptionV3 — used to
+# synthesize correctly-shaped random parameters for benches/smoke tests without a
+# weights file (extracted from the oracle in tests/unittests/image/test_inception_model.py).
+_CONV_SHAPES: Dict[str, tuple] = {
+    "Conv2d_1a_3x3": (32, 3, 3, 3), "Conv2d_2a_3x3": (32, 32, 3, 3), "Conv2d_2b_3x3": (64, 32, 3, 3),
+    "Conv2d_3b_1x1": (80, 64, 1, 1), "Conv2d_4a_3x3": (192, 80, 3, 3),
+    "Mixed_5b.branch1x1": (64, 192, 1, 1), "Mixed_5b.branch5x5_1": (48, 192, 1, 1),
+    "Mixed_5b.branch5x5_2": (64, 48, 5, 5), "Mixed_5b.branch3x3dbl_1": (64, 192, 1, 1),
+    "Mixed_5b.branch3x3dbl_2": (96, 64, 3, 3), "Mixed_5b.branch3x3dbl_3": (96, 96, 3, 3),
+    "Mixed_5b.branch_pool": (32, 192, 1, 1),
+    "Mixed_5c.branch1x1": (64, 256, 1, 1), "Mixed_5c.branch5x5_1": (48, 256, 1, 1),
+    "Mixed_5c.branch5x5_2": (64, 48, 5, 5), "Mixed_5c.branch3x3dbl_1": (64, 256, 1, 1),
+    "Mixed_5c.branch3x3dbl_2": (96, 64, 3, 3), "Mixed_5c.branch3x3dbl_3": (96, 96, 3, 3),
+    "Mixed_5c.branch_pool": (64, 256, 1, 1),
+    "Mixed_5d.branch1x1": (64, 288, 1, 1), "Mixed_5d.branch5x5_1": (48, 288, 1, 1),
+    "Mixed_5d.branch5x5_2": (64, 48, 5, 5), "Mixed_5d.branch3x3dbl_1": (64, 288, 1, 1),
+    "Mixed_5d.branch3x3dbl_2": (96, 64, 3, 3), "Mixed_5d.branch3x3dbl_3": (96, 96, 3, 3),
+    "Mixed_5d.branch_pool": (64, 288, 1, 1),
+    "Mixed_6a.branch3x3": (384, 288, 3, 3), "Mixed_6a.branch3x3dbl_1": (64, 288, 1, 1),
+    "Mixed_6a.branch3x3dbl_2": (96, 64, 3, 3), "Mixed_6a.branch3x3dbl_3": (96, 96, 3, 3),
+    "Mixed_6b.branch1x1": (192, 768, 1, 1), "Mixed_6b.branch7x7_1": (128, 768, 1, 1),
+    "Mixed_6b.branch7x7_2": (128, 128, 1, 7), "Mixed_6b.branch7x7_3": (192, 128, 7, 1),
+    "Mixed_6b.branch7x7dbl_1": (128, 768, 1, 1), "Mixed_6b.branch7x7dbl_2": (128, 128, 7, 1),
+    "Mixed_6b.branch7x7dbl_3": (128, 128, 1, 7), "Mixed_6b.branch7x7dbl_4": (128, 128, 7, 1),
+    "Mixed_6b.branch7x7dbl_5": (192, 128, 1, 7), "Mixed_6b.branch_pool": (192, 768, 1, 1),
+    "Mixed_6c.branch1x1": (192, 768, 1, 1), "Mixed_6c.branch7x7_1": (160, 768, 1, 1),
+    "Mixed_6c.branch7x7_2": (160, 160, 1, 7), "Mixed_6c.branch7x7_3": (192, 160, 7, 1),
+    "Mixed_6c.branch7x7dbl_1": (160, 768, 1, 1), "Mixed_6c.branch7x7dbl_2": (160, 160, 7, 1),
+    "Mixed_6c.branch7x7dbl_3": (160, 160, 1, 7), "Mixed_6c.branch7x7dbl_4": (160, 160, 7, 1),
+    "Mixed_6c.branch7x7dbl_5": (192, 160, 1, 7), "Mixed_6c.branch_pool": (192, 768, 1, 1),
+    "Mixed_6d.branch1x1": (192, 768, 1, 1), "Mixed_6d.branch7x7_1": (160, 768, 1, 1),
+    "Mixed_6d.branch7x7_2": (160, 160, 1, 7), "Mixed_6d.branch7x7_3": (192, 160, 7, 1),
+    "Mixed_6d.branch7x7dbl_1": (160, 768, 1, 1), "Mixed_6d.branch7x7dbl_2": (160, 160, 7, 1),
+    "Mixed_6d.branch7x7dbl_3": (160, 160, 1, 7), "Mixed_6d.branch7x7dbl_4": (160, 160, 7, 1),
+    "Mixed_6d.branch7x7dbl_5": (192, 160, 1, 7), "Mixed_6d.branch_pool": (192, 768, 1, 1),
+    "Mixed_6e.branch1x1": (192, 768, 1, 1), "Mixed_6e.branch7x7_1": (192, 768, 1, 1),
+    "Mixed_6e.branch7x7_2": (192, 192, 1, 7), "Mixed_6e.branch7x7_3": (192, 192, 7, 1),
+    "Mixed_6e.branch7x7dbl_1": (192, 768, 1, 1), "Mixed_6e.branch7x7dbl_2": (192, 192, 7, 1),
+    "Mixed_6e.branch7x7dbl_3": (192, 192, 1, 7), "Mixed_6e.branch7x7dbl_4": (192, 192, 7, 1),
+    "Mixed_6e.branch7x7dbl_5": (192, 192, 1, 7), "Mixed_6e.branch_pool": (192, 768, 1, 1),
+    "Mixed_7a.branch3x3_1": (192, 768, 1, 1), "Mixed_7a.branch3x3_2": (320, 192, 3, 3),
+    "Mixed_7a.branch7x7x3_1": (192, 768, 1, 1), "Mixed_7a.branch7x7x3_2": (192, 192, 1, 7),
+    "Mixed_7a.branch7x7x3_3": (192, 192, 7, 1), "Mixed_7a.branch7x7x3_4": (192, 192, 3, 3),
+    "Mixed_7b.branch1x1": (320, 1280, 1, 1), "Mixed_7b.branch3x3_1": (384, 1280, 1, 1),
+    "Mixed_7b.branch3x3_2a": (384, 384, 1, 3), "Mixed_7b.branch3x3_2b": (384, 384, 3, 1),
+    "Mixed_7b.branch3x3dbl_1": (448, 1280, 1, 1), "Mixed_7b.branch3x3dbl_2": (384, 448, 3, 3),
+    "Mixed_7b.branch3x3dbl_3a": (384, 384, 1, 3), "Mixed_7b.branch3x3dbl_3b": (384, 384, 3, 1),
+    "Mixed_7b.branch_pool": (192, 1280, 1, 1),
+    "Mixed_7c.branch1x1": (320, 2048, 1, 1), "Mixed_7c.branch3x3_1": (384, 2048, 1, 1),
+    "Mixed_7c.branch3x3_2a": (384, 384, 1, 3), "Mixed_7c.branch3x3_2b": (384, 384, 3, 1),
+    "Mixed_7c.branch3x3dbl_1": (448, 2048, 1, 1), "Mixed_7c.branch3x3dbl_2": (384, 448, 3, 3),
+    "Mixed_7c.branch3x3dbl_3a": (384, 384, 1, 3), "Mixed_7c.branch3x3dbl_3b": (384, 384, 3, 1),
+    "Mixed_7c.branch_pool": (192, 2048, 1, 1),
+}
+
+
+def random_inception_params(seed: int = 0) -> Dict[str, Any]:
+    """Correctly-shaped random parameters (no weights file) for benches/smoke tests.
+
+    BN running stats are non-trivial so the folded BN path is exercised; features
+    from these weights are meaningless but have the production compute graph.
+    """
+    rng = np.random.RandomState(seed)
+    state: Dict[str, np.ndarray] = {}
+    for name, (o, i, kh, kw) in _CONV_SHAPES.items():
+        state[f"{name}.conv.weight"] = rng.randn(o, i, kh, kw).astype(np.float32) * 0.05
+        state[f"{name}.bn.weight"] = rng.uniform(0.5, 1.5, o).astype(np.float32)
+        state[f"{name}.bn.bias"] = rng.randn(o).astype(np.float32) * 0.1
+        state[f"{name}.bn.running_mean"] = rng.randn(o).astype(np.float32) * 0.1
+        state[f"{name}.bn.running_var"] = rng.uniform(0.5, 1.5, o).astype(np.float32)
+    state["fc.weight"] = rng.randn(1008, 2048).astype(np.float32) * 0.01
+    state["fc.bias"] = np.zeros(1008, np.float32)
+    return params_from_state_dict(state)
+
+
 def load_inception_params(weights_path: str) -> Dict[str, Any]:
     """Load parameters from an ``.npz`` (converted) or ``.pth`` (torch) file."""
     from metrics_tpu.models._io import load_checkpoint_state

@@ -105,8 +105,11 @@ def _binary_auroc_kernel(preds: Array, target: Array, valid: Array, max_fpr: Opt
         partial_auc = _trapz(yc, xc)
         min_area = 0.5 * max_fpr**2
         area = 0.5 * (1 + (partial_auc - min_area) / (max_fpr - min_area))
-    # degenerate single-class data: safe division zeroed the curve, so area == 0
-    # exactly on the max_fpr=None path, matching the reference's 0.0 (not NaN)
+        # single-class data has no meaningful partial AUC (the McClish formula on a
+        # zeroed curve fabricates a constant; the reference IndexErrors here) -> NaN
+        return jnp.where((pos > 0) & (neg > 0), area, jnp.nan)
+    # max_fpr=None: safe division zeroed the degenerate curve, so area == 0
+    # exactly, matching the reference's 0.0 (not NaN)
     return area
 
 
