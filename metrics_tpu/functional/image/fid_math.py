@@ -46,16 +46,17 @@ def _sqrtm_trace_newton_schulz(a: Array, iters: int = 25) -> Array:
     return best_tr * jnp.sqrt(scale)
 
 
-def _sqrtm_trace_eigh(sigma1: Array, sigma2: Array) -> Array:
+def _sqrtm_trace_eigh(sigma1: Array, sigma2: Array, xp=jnp) -> Array:
     """tr(sqrtm(S1 S2)) via the symmetrized form tr(sqrtm(sqrtm(S1) S2 sqrtm(S1)))
     — two Hermitian eigendecompositions. More accurate than f32 Newton-Schulz on
     near-singular covariances (~3e-5 vs ~2e-3 relative) but TPU eigh QR loops cost
-    ~88s of XLA compile time at 2048 features."""
-    vals, vecs = jnp.linalg.eigh(sigma1)
-    vals = jnp.clip(vals, 0.0, None)
-    s1_half = (vecs * jnp.sqrt(vals)) @ vecs.T
+    ~88s of XLA compile time at 2048 features. ``xp`` selects the array namespace:
+    the eager FID compute path calls this with numpy on float64 host arrays."""
+    vals, vecs = xp.linalg.eigh(sigma1)
+    vals = xp.clip(vals, 0.0, None)
+    s1_half = (vecs * xp.sqrt(vals)) @ vecs.T
     inner = s1_half @ sigma2 @ s1_half
-    return jnp.sqrt(jnp.clip(jnp.linalg.eigvalsh(inner), 0.0, None)).sum()
+    return xp.sqrt(xp.clip(xp.linalg.eigvalsh(inner), 0.0, None)).sum()
 
 
 def _compute_fid(mu1: Array, sigma1: Array, mu2: Array, sigma2: Array, method: str = "auto") -> Array:

@@ -26,7 +26,7 @@ import jax.numpy as jnp
 from jax import Array
 
 from metrics_tpu.core.metric import Metric
-from metrics_tpu.functional.image.fid_math import _compute_fid
+from metrics_tpu.functional.image.fid_math import _compute_fid, _sqrtm_trace_eigh
 
 
 def _chan_merge(
@@ -173,10 +173,7 @@ class FrechetInceptionDistance(Metric):
         s1 = np.asarray(rm2, np.float64) / (float(rn) - 1)
         mu2 = np.asarray(fm, np.float64)
         s2 = np.asarray(fm2, np.float64) / (float(fn) - 1)
-        vals1, vecs1 = np.linalg.eigh(s1)
-        s1_half = (vecs1 * np.sqrt(np.clip(vals1, 0, None))) @ vecs1.T
-        inner_vals = np.linalg.eigvalsh(s1_half @ s2 @ s1_half)
-        tr_covmean = np.sqrt(np.clip(inner_vals, 0, None)).sum()
+        tr_covmean = _sqrtm_trace_eigh(s1, s2, xp=np)
         diff = mu1 - mu2
         fid = diff @ diff + np.trace(s1) + np.trace(s2) - 2 * tr_covmean
         return jnp.asarray(fid, jnp.float32)

@@ -1,0 +1,152 @@
+"""Differential tests vs the reference: the classification surface not covered by
+the first sweep — fixed-operating-point multiclass/multilabel variants, multilabel
+curves, hinge, dice, fairness rates, and the remaining dispatchers."""
+import numpy as np
+import pytest
+
+import metrics_tpu.functional.classification as F
+
+from .conftest import assert_close
+
+N = 128
+NC = 5
+NL = 4
+
+rng = np.random.RandomState(41)
+BIN_PROBS = rng.rand(N).astype(np.float32)
+BIN_TARGET = rng.randint(0, 2, N)
+MC_LOGITS = rng.randn(N, NC).astype(np.float32)
+MC_PROBS = np.exp(MC_LOGITS) / np.exp(MC_LOGITS).sum(-1, keepdims=True)
+MC_TARGET = rng.randint(0, NC, N)
+ML_PROBS = rng.rand(N, NL).astype(np.float32)
+ML_TARGET = rng.randint(0, 2, (N, NL))
+GROUPS = rng.randint(0, 2, N)
+
+
+def _run(ref, name, args_np, kwargs, atol=1e-5):
+    import jax.numpy as jnp
+    import torch
+
+    theirs = getattr(ref.functional.classification, name)(
+        *[torch.from_numpy(np.asarray(a)) for a in args_np], **kwargs
+    )
+    ours = getattr(F, name)(*[jnp.asarray(a) for a in args_np], **kwargs)
+    assert_close(ours, theirs, atol=atol)
+
+
+@pytest.mark.parametrize(
+    ("name", "kwargs"),
+    [
+        ("multiclass_recall_at_fixed_precision", {"min_precision": 0.4}),
+        ("multiclass_recall_at_fixed_precision", {"min_precision": 0.4, "thresholds": 50}),
+        ("multiclass_precision_at_fixed_recall", {"min_recall": 0.5}),
+        ("multiclass_specificity_at_sensitivity", {"min_sensitivity": 0.5}),
+        ("multiclass_hinge_loss", {}),
+        ("multiclass_hinge_loss", {"multiclass_mode": "one-vs-all"}),
+    ],
+)
+def test_multiclass_extra(ref, name, kwargs):
+    args = (MC_PROBS, MC_TARGET)
+    if "hinge" in name:
+        args = (MC_LOGITS, MC_TARGET)
+    _run(ref, name, args, {"num_classes": NC, **kwargs})
+
+
+@pytest.mark.parametrize(
+    ("name", "kwargs"),
+    [
+        ("multilabel_recall_at_fixed_precision", {"min_precision": 0.4}),
+        ("multilabel_precision_at_fixed_recall", {"min_recall": 0.5}),
+        ("multilabel_specificity_at_sensitivity", {"min_sensitivity": 0.5}),
+    ],
+)
+def test_multilabel_fixed_point(ref, name, kwargs):
+    _run(ref, name, (ML_PROBS, ML_TARGET), {"num_labels": NL, **kwargs})
+
+
+@pytest.mark.parametrize("thresholds", [None, 20])
+def test_multilabel_curves(ref, thresholds):
+    import jax.numpy as jnp
+    import torch
+
+    for name in ("multilabel_precision_recall_curve", "multilabel_roc"):
+        theirs = getattr(ref.functional.classification, name)(
+            torch.from_numpy(ML_PROBS), torch.from_numpy(ML_TARGET), num_labels=NL, thresholds=thresholds
+        )
+        ours = getattr(F, name)(jnp.asarray(ML_PROBS), jnp.asarray(ML_TARGET), num_labels=NL, thresholds=thresholds)
+        for o, t in zip(ours, theirs):
+            assert_close(o, t, atol=1e-6)
+
+
+@pytest.mark.parametrize("thresholds", [None, 20])
+def test_multiclass_precision_recall_curve(ref, thresholds):
+    import jax.numpy as jnp
+    import torch
+
+    theirs = ref.functional.classification.multiclass_precision_recall_curve(
+        torch.from_numpy(MC_PROBS), torch.from_numpy(MC_TARGET), num_classes=NC, thresholds=thresholds
+    )
+    ours = F.multiclass_precision_recall_curve(
+        jnp.asarray(MC_PROBS), jnp.asarray(MC_TARGET), num_classes=NC, thresholds=thresholds
+    )
+    for o, t in zip(ours, theirs):
+        assert_close(o, t, atol=1e-6)
+
+
+def test_dice(ref):
+    import jax.numpy as jnp
+    import torch
+
+    preds = rng.randint(0, 2, N)
+    theirs = ref.functional.classification.dice(torch.from_numpy(preds), torch.from_numpy(BIN_TARGET))
+    ours = F.dice(jnp.asarray(preds), jnp.asarray(BIN_TARGET))
+    assert_close(ours, theirs, atol=1e-6)
+
+
+def test_binary_groups_stat_rates(ref):
+    _run(ref, "binary_groups_stat_rates", (BIN_PROBS, BIN_TARGET, GROUPS), {"num_groups": 2})
+
+
+def test_binary_fairness(ref):
+    import jax.numpy as jnp
+    import torch
+
+    for task in ("demographic_parity", "equal_opportunity", "all"):
+        theirs = ref.functional.classification.binary_fairness(
+            torch.from_numpy(BIN_PROBS), torch.from_numpy(BIN_TARGET), torch.from_numpy(GROUPS), task=task
+        )
+        ours = F.binary_fairness(
+            jnp.asarray(BIN_PROBS), jnp.asarray(BIN_TARGET), jnp.asarray(GROUPS), task=task
+        )
+        assert_close(ours, theirs, atol=1e-6)
+
+
+@pytest.mark.parametrize(
+    ("name", "task_kwargs", "which"),
+    [
+        ("precision", {"task": "multiclass", "num_classes": NC, "average": "macro"}, "mc"),
+        ("recall", {"task": "multilabel", "num_labels": NL, "average": "micro"}, "ml"),
+        ("specificity", {"task": "binary"}, "bin"),
+        ("fbeta_score", {"task": "binary", "beta": 0.5}, "bin"),
+        ("hamming_distance", {"task": "multiclass", "num_classes": NC, "average": "macro"}, "mc"),
+        ("jaccard_index", {"task": "multilabel", "num_labels": NL}, "ml"),
+        ("matthews_corrcoef", {"task": "binary"}, "bin"),
+        ("cohen_kappa", {"task": "multiclass", "num_classes": NC}, "mc"),
+        ("confusion_matrix", {"task": "binary"}, "bin"),
+        ("stat_scores", {"task": "multiclass", "num_classes": NC, "average": "macro"}, "mc"),
+        ("average_precision", {"task": "multiclass", "num_classes": NC, "average": "macro"}, "mc"),
+        ("calibration_error", {"task": "binary", "n_bins": 10}, "bin"),
+        ("exact_match", {"task": "multilabel", "num_labels": NL}, "ml"),
+        ("hinge_loss", {"task": "binary"}, "bin"),
+    ],
+)
+def test_remaining_dispatchers(ref, name, task_kwargs, which):
+    import jax.numpy as jnp
+    import torch
+
+    a = {"bin": (BIN_PROBS, BIN_TARGET), "mc": (MC_PROBS, MC_TARGET), "ml": (ML_PROBS, ML_TARGET)}[which]
+    theirs = getattr(ref.functional, name)(*[torch.from_numpy(np.asarray(x)) for x in a], **task_kwargs)
+    ours = getattr(__import__("metrics_tpu.functional", fromlist=[name]), name)(
+        *[jnp.asarray(x) for x in a], **task_kwargs
+    )
+    assert_close(ours, theirs, atol=1e-5)
