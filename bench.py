@@ -239,6 +239,56 @@ def bench_fid(batch: int = 32, n_batches: int = 8, hw: int = 299) -> dict:
     }
 
 
+def bench_confmat(n: int = 1 << 26, num_classes: int = 64, repeats: int = 10) -> dict:
+    """BASELINE config 2 (single-chip half): MulticlassConfusionMatrix streaming
+    updates through the confusion-count tiers — at C=64 that is the one-hot MXU
+    matmul kernel (ops/confmat.py); C<=45 would route to the Pallas/compare
+    histogram tiers instead. The 8-chip dist_sync half of config 2 is validated
+    functionally by __graft_entry__'s multichip dryrun (psum sync on an 8-device
+    mesh)."""
+    import torch
+
+    from metrics_tpu.classification import MulticlassConfusionMatrix
+
+    metric = MulticlassConfusionMatrix(num_classes=num_classes, validate_args=False)
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+    preds = jax.random.randint(k1, (n,), 0, num_classes, dtype=jnp.int32)
+    target = jax.random.randint(k2, (n,), 0, num_classes, dtype=jnp.int32)
+    update = jax.jit(metric.local_update)
+    state = update(metric.init_state(), preds, target)
+    jax.device_get(state["confmat"][0, 0])
+
+    def timed():
+        t0 = time.perf_counter()
+        st = metric.init_state()
+        for _ in range(repeats):
+            st = update(st, preds, target)
+        jax.device_get(st["confmat"][0, 0])
+        return repeats * n / (time.perf_counter() - t0), st
+
+    timed()
+    r1, st = timed()
+    r2, st = timed()
+    total = float(jnp.sum(st["confmat"]))
+    assert total == repeats * n, f"confmat mass {total} != {repeats * n}"
+
+    # reference-equivalent kernel on torch CPU (bincount of target*C+preds)
+    n_cpu = 1 << 22
+    tp = torch.randint(0, num_classes, (n_cpu,))
+    tt = torch.randint(0, num_classes, (n_cpu,))
+    torch.bincount(tt * num_classes + tp, minlength=num_classes * num_classes)  # warm
+    t0 = time.perf_counter()
+    for _ in range(3):
+        torch.bincount(tt * num_classes + tp, minlength=num_classes * num_classes)
+    cpu_dt = (time.perf_counter() - t0) / 3
+    return {
+        "metric": "confusion_matrix_throughput",
+        "value": round(max(r1, r2) / 1e9, 2),
+        "unit": "Gpreds/s/chip",
+        "vs_baseline": round(max(r1, r2) / (n_cpu / cpu_dt), 2),
+    }
+
+
 def bench_auroc(n: int = 1 << 24) -> dict:
     """Exact-mode (thresholds=None) binary AUROC: device sort+cumsum kernel vs the
     reference's host path (torch CPU sort+cumsum, the same math torchmetrics runs)."""
@@ -323,7 +373,7 @@ if __name__ == "__main__":
 
     parser = argparse.ArgumentParser(description="metrics_tpu benchmarks")
     parser.add_argument(
-        "--config", choices=("accuracy", "map", "ssim", "retrieval", "auroc", "fid", "all"), default="accuracy"
+        "--config", choices=("accuracy", "confmat", "map", "ssim", "retrieval", "auroc", "fid", "all"), default="accuracy"
     )
     config = parser.parse_args().config
     if config in ("accuracy", "all"):
@@ -339,6 +389,8 @@ if __name__ == "__main__":
                 }
             )
         )
+    if config in ("confmat", "all"):
+        print(json.dumps(bench_confmat()))
     if config in ("map", "all"):
         print(json.dumps(bench_map()))
     if config in ("ssim", "all"):

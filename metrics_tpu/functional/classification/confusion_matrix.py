@@ -20,6 +20,7 @@ from jax import Array
 
 from metrics_tpu.functional.classification.stat_scores import _is_floating, _sigmoid_if_logits
 from metrics_tpu.utils.checks import _check_same_shape, _is_concrete
+from metrics_tpu.ops.confmat import confusion_counts
 from metrics_tpu.utils.data import _bincount_weighted
 from metrics_tpu.utils.enums import ClassificationTask
 from metrics_tpu.utils.prints import rank_zero_warn
@@ -230,9 +231,9 @@ def _multiclass_confusion_matrix_format(
 
 
 def _multiclass_confusion_matrix_update(preds: Array, target: Array, num_classes: int) -> Array:
-    """CxC bins via masked bincount (reference: :324-328)."""
-    mapping = target * num_classes + preds
-    return _masked_confmat_bins(mapping, target >= 0, num_classes**2).reshape(num_classes, num_classes)
+    """CxC counts (reference: :324-328) — bincount or one-hot-MXU-matmul tier
+    (ops/confmat.py) depending on class count and platform."""
+    return confusion_counts(preds, target, target >= 0, num_classes)
 
 
 def _multiclass_confusion_matrix_compute(confmat: Array, normalize: Optional[str] = None) -> Array:

