@@ -53,9 +53,13 @@ def _fold_stacked(mean: Array, m2: Array, n: Array) -> Tuple[Array, Array, Array
 
 @jax.jit
 def _fid_from_moments(rm: Array, rm2: Array, rn: Array, fm: Array, fm2: Array, fn: Array) -> Array:
-    cov_real = rm2 / (rn - 1)
-    cov_fake = fm2 / (fn - 1)
-    return _compute_fid(rm, cov_real, fm, cov_fake).astype(jnp.float32)
+    # n < 2 has no unbiased covariance: the eager compute() raises RuntimeError
+    # first; on the jit/compute_from path we clamp the divisor and return an
+    # explicit NaN instead of the Inf/NaN garbage a raw (n-1) division produces.
+    cov_real = rm2 / jnp.maximum(rn - 1, 1.0)
+    cov_fake = fm2 / jnp.maximum(fn - 1, 1.0)
+    fid = _compute_fid(rm, cov_real, fm, cov_fake).astype(jnp.float32)
+    return jnp.where((rn >= 2) & (fn >= 2), fid, jnp.nan)
 
 
 class FrechetInceptionDistance(Metric):

@@ -147,7 +147,10 @@ def binary_auroc_exact(preds: Array, target: Array, max_fpr: Optional[float] = N
     ``target`` entries < 0 (ignore_index masks / buffer padding) are excluded.
     """
     preds, target, valid = _pad_binary(preds, target)
-    if max_fpr is None:
+    # max_fpr == 1 short-circuits to the full-AUC path (reference auroc.py:92:
+    # `max_fpr is None or max_fpr == 1`), which returns 0.0 — not NaN — on
+    # single-class data.
+    if max_fpr is None or max_fpr == 1:
         return _binary_auroc_full_j(preds, target, valid)
     return _binary_auroc_partial_j(preds, target, valid, jnp.float32(max_fpr))
 

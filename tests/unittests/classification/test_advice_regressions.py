@@ -1,8 +1,14 @@
-"""Regression tests for the round-1 advisor findings (ADVICE.md).
+"""Regression tests for the advisor findings (ADVICE.md).
 
+Round 1:
 1. Nominal metrics silently mis-counted non-contiguous / 1-based labels.
 2. `and`-instead-of-`or` validation let num_groups=0/1 and min_precision=1.5 through.
 3. Fairness selection could key a phantom empty group with non-contiguous group ids.
+
+Round 2:
+4. Exact-mode binary AUROC with max_fpr=1.0 on single-class data must match the
+   reference's max_fpr==1 -> full-AUC short-circuit (0.0, not NaN).
+5. `_fid_from_moments` must not emit Inf for n==1 states on the jit path.
 """
 import jax.numpy as jnp
 import numpy as np
@@ -54,6 +60,35 @@ def test_min_precision_validation(bad):
     target = jnp.asarray([0, 1, 1, 0])
     with pytest.raises(ValueError):
         binary_recall_at_fixed_precision(preds, target, min_precision=bad, thresholds=5)
+
+
+def test_exact_auroc_max_fpr_one_single_class():
+    """max_fpr=1.0 takes the full-AUC path: 0.0 on single-class data, not NaN."""
+    from metrics_tpu.functional.classification import binary_auroc
+
+    preds = jnp.asarray([0.1, 0.4, 0.35, 0.8])
+    target = jnp.asarray([1, 1, 1, 1])  # only positives
+    full = float(binary_auroc(preds, target, max_fpr=None))
+    capped = float(binary_auroc(preds, target, max_fpr=1.0))
+    assert full == 0.0
+    assert capped == 0.0
+    # and on well-posed data max_fpr=1.0 still equals the full AUC
+    target2 = jnp.asarray([0, 0, 1, 1])
+    assert float(binary_auroc(preds, target2, max_fpr=1.0)) == pytest.approx(
+        float(binary_auroc(preds, target2)), abs=1e-6
+    )
+
+
+def test_fid_jit_path_single_sample_is_nan_not_inf():
+    """n<2 states produce an explicit NaN through the jit moments path."""
+    from metrics_tpu.image.fid import _fid_from_moments
+
+    d = 4
+    rm = jnp.zeros(d)
+    rm2 = jnp.zeros((d, d))
+    out = _fid_from_moments(rm, rm2, jnp.asarray(1.0), rm, rm2, jnp.asarray(1.0))
+    assert bool(jnp.isnan(out))
+    assert not bool(jnp.isinf(out))
 
 
 def test_fairness_non_contiguous_groups_skip_empty():
