@@ -24,6 +24,7 @@ import numpy as np
 from jax import Array
 
 from metrics_tpu.ops.confmat import confusion_counts
+from metrics_tpu.ops.streaming import eq_count
 from metrics_tpu.utils.checks import _check_same_shape, _is_concrete
 from metrics_tpu.utils.data import _count_dtype, select_topk
 from metrics_tpu.utils.enums import ClassificationTask
@@ -309,15 +310,22 @@ def _multiclass_stat_scores_update(
 
     preds = preds.ravel()
     target = target.ravel()
-    valid = jnp.ones_like(target, dtype=bool) if ignore_index is None else target != ignore_index
 
     if average == "micro":
-        tp = ((preds == target) & valid).sum().astype(jnp.int32)
-        fp = ((preds != target) & valid).sum().astype(jnp.int32)
+        cd = _count_dtype()
+        if ignore_index is None:
+            # hot streaming path: ONE fused compare-reduce (ops/streaming.py);
+            # fp/n_valid derived arithmetically instead of two more reductions
+            tp = eq_count(preds, target)
+            n_valid = jnp.asarray(target.size, cd)
+            fp = jnp.int32(target.size) - tp
+        else:
+            valid = target != ignore_index
+            tp = ((preds == target) & valid).sum().astype(jnp.int32)
+            n_valid = valid.sum().astype(cd)
+            fp = n_valid.astype(jnp.int32) - tp
         fn = fp
         # tn = C*n - ... can exceed int32 for a single huge update; widen first
-        cd = _count_dtype()
-        n_valid = valid.sum().astype(cd)
         tn = (num_classes * n_valid - (fp + fn + tp).astype(cd)).astype(cd)
         return tp, fp, tn, fn
 
@@ -325,6 +333,7 @@ def _multiclass_stat_scores_update(
     # (ops/confmat.py) by class count/platform. NOTE: out-of-range labels are
     # clipped into [0, C-1] rather than erroring — XLA cannot raise on data
     # values; enable validate_args to catch bad labels.
+    valid = jnp.ones_like(target, dtype=bool) if ignore_index is None else target != ignore_index
     confmat = confusion_counts(preds, target, valid, num_classes)
     tp = jnp.diag(confmat)
     fp = confmat.sum(0) - tp

@@ -1,0 +1,53 @@
+"""Streaming compare-accumulate kernels for the hot classification update path.
+
+Replaces the reference's flat eq-sum micro kernels
+(``functional/classification/stat_scores.py:386-396``) with a fusion shape tuned
+for the TPU XLA reduce pipeline.
+
+Measured design notes (TPU v5e, 819 GB/s HBM, int8 label streams, 2x1GB fresh
+buffers per dispatch, one device sync per 24 dispatches):
+
+- XLA's reduce fusion is **issue-rate bound, not HBM bound** for narrow dtypes:
+  a plain ``sum(p == t)`` over two int8 streams sustains ~170 Gpreds/s
+  (~340 GB/s), while pure f32/bf16 reductions cap at ~200 GB/s/stream and an
+  elementwise copy (read+write) runs far slower than reductions. The ceiling for
+  int8-packed reduce fusions measured ~210 Gel/s.
+- Feeding MORE independent streams into ONE reduce fusion raises throughput:
+  slicing each operand into quarters and summing the four int8 eq-masks
+  elementwise before a single reduction ("zip4") measured +12-15% over the
+  plain compare-reduce (median 138 vs 123 Gpreds/s in the same interleaved
+  trial; 194 vs 171 in a faster-tunnel session). Separate fusions do NOT help
+  (TPU executes fusions sequentially); the zip must stay inside one fusion.
+- Pallas/Mosaic is the wrong tool for this op on v5e: int8 vector compares are
+  unsupported, the xor->widen->count compute chain measured ~18 Gel/s (50x below
+  VPU peak), and manual double-buffered DMA topped out at ~150 GB/s vs XLA's
+  ~420 GB/s reduce-fusion reads. SWAR u32 byte-counting dies on the i8->u32
+  tile relayout (materializes the whole array). Kernel-level wins here come
+  from fusion shaping, not hand-written kernels.
+"""
+from jax import Array
+import jax.numpy as jnp
+
+# Below this, slicing overhead outweighs the extra streams.
+_ZIP_MIN = 1 << 22
+_ZIP_WAYS = 4
+
+
+def eq_count(preds: Array, target: Array) -> Array:
+    """``sum(preds == target)`` as one int32 scalar, shaped for max TPU throughput.
+
+    Both inputs must be 1-D and equal length. For large inputs the operands are
+    split into ``_ZIP_WAYS`` slices whose int8 eq-masks are summed elementwise
+    inside the same fusion ("zip4"), lifting XLA's per-stream reduce issue rate.
+    """
+    n = preds.shape[0]
+    if n < _ZIP_MIN:
+        return jnp.sum(preds == target, dtype=jnp.int32)
+    q = n // _ZIP_WAYS
+    eq = (preds[:q] == target[:q]).astype(jnp.int8)
+    for i in range(1, _ZIP_WAYS):
+        eq = eq + (preds[i * q:(i + 1) * q] == target[i * q:(i + 1) * q]).astype(jnp.int8)
+    count = jnp.sum(eq, dtype=jnp.int32)
+    if n % _ZIP_WAYS:
+        count = count + jnp.sum(preds[_ZIP_WAYS * q:] == target[_ZIP_WAYS * q:], dtype=jnp.int32)
+    return count

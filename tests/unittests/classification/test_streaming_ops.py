@@ -1,0 +1,34 @@
+"""eq_count streaming kernel: both fusion shapes agree with the naive reduction."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.ops.streaming import _ZIP_MIN, eq_count
+
+
+@pytest.mark.parametrize(
+    "n",
+    [
+        0,
+        1,
+        257,
+        1 << 10,            # plain branch
+        _ZIP_MIN,           # zip branch, exact multiple of 4
+        _ZIP_MIN + 3,       # zip branch with remainder tail
+    ],
+)
+def test_eq_count_matches_naive(n):
+    rng = np.random.default_rng(n)
+    a = rng.integers(0, 5, n).astype(np.int8)
+    b = rng.integers(0, 5, n).astype(np.int8)
+    got = int(eq_count(jnp.asarray(a), jnp.asarray(b)))
+    assert got == int((a == b).sum())
+
+
+def test_eq_count_negative_labels():
+    n = _ZIP_MIN + 1
+    rng = np.random.default_rng(0)
+    a = rng.integers(-128, 128, n).astype(np.int8)
+    b = rng.integers(-128, 128, n).astype(np.int8)
+    got = int(eq_count(jnp.asarray(a), jnp.asarray(b)))
+    assert got == int((a == b).sum())
