@@ -28,6 +28,7 @@ TPU-first design deltas (see SURVEY.md §7):
 """
 import functools
 import inspect
+import warnings
 from abc import ABC, abstractmethod
 from contextlib import contextmanager
 from copy import deepcopy
@@ -40,6 +41,7 @@ from jax import Array
 
 from metrics_tpu.core.state import CatBuffer, cat_merge
 from metrics_tpu.parallel import collective
+from metrics_tpu.utils.checks import _is_concrete
 from metrics_tpu.utils.data import (
     _flatten,
     _squeeze_if_scalar,
@@ -299,7 +301,29 @@ class Metric(ABC):
                 setattr(self, attr, val)
             self._computed = saved_computed
             self._update_count = saved_count
-        return value
+        return self._poison_if_overflowed(state, value)
+
+    @staticmethod
+    def _poison_if_overflowed(state: Dict[str, Any], value: Any) -> Any:
+        """NaN-poison float outputs when any CatBuffer state overflowed.
+
+        A jitted multi-device eval that overflows a fixed-capacity cat state has
+        silently dropped rows; XLA cannot raise on data, so the overflow bit rides
+        the synced state (core/state.py) and turns the result into NaN rather than
+        a plausible-but-wrong number. Integer outputs are left as-is (documented:
+        check ``CatBuffer.overflowed()``); the eager OO tier warns instead.
+        """
+        flags = [v.overflowed() for v in state.values() if isinstance(v, CatBuffer)]
+        if not flags:
+            return value
+        over = functools.reduce(jnp.logical_or, flags)
+
+        def poison(x):
+            if isinstance(x, (jnp.ndarray, np.ndarray)) and jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating):
+                return jnp.where(over, jnp.nan, x)
+            return x
+
+        return jax.tree_util.tree_map(poison, value)
 
     def _compute_raw(self) -> Any:
         """Subclass compute without wrapping (no cache, no sync)."""
@@ -345,6 +369,19 @@ class Metric(ABC):
                 )
             if self._computed is not None:
                 return self._computed
+
+            for attr in self._defaults:
+                val = getattr(self, attr)
+                if isinstance(val, CatBuffer) and _is_concrete(val.count) and bool(val.overflowed()):
+                    # every process warns (not rank_zero): an overflow on a non-zero
+                    # host is exactly the silent-data-loss this exists to surface
+                    warnings.warn(
+                        f"Metric {self.__class__.__name__}: cat state `{attr}` overflowed its"
+                        f" capacity {val.capacity}; the computed value is missing the overwritten"
+                        " rows. Increase `cat_capacity`.",
+                        RuntimeWarning,
+                        stacklevel=2,
+                    )
 
             with self.sync_context(
                 dist_sync_fn=self.dist_sync_fn,
@@ -680,7 +717,15 @@ class Metric(ABC):
         for attr in self._defaults:
             val = getattr(self, attr)
             if isinstance(val, CatBuffer):
-                setattr(self, attr, CatBuffer(jax.device_put(val.data, device), jax.device_put(val.count, device)))
+                setattr(
+                    self,
+                    attr,
+                    CatBuffer(
+                        jax.device_put(val.data, device),
+                        jax.device_put(val.count, device),
+                        jax.device_put(val.overflow, device),
+                    ),
+                )
             elif isinstance(val, jnp.ndarray):
                 setattr(self, attr, jax.device_put(val, device))
             elif isinstance(val, list):
@@ -698,7 +743,7 @@ class Metric(ABC):
             val = getattr(self, attr)
             if isinstance(val, CatBuffer):
                 if jnp.issubdtype(val.data.dtype, jnp.floating):
-                    setattr(self, attr, CatBuffer(val.data.astype(dst_type), val.count))
+                    setattr(self, attr, CatBuffer(val.data.astype(dst_type), val.count, val.overflow))
             elif isinstance(val, jnp.ndarray) and jnp.issubdtype(val.dtype, jnp.floating):
                 setattr(self, attr, val.astype(dst_type))
             elif isinstance(val, list):
@@ -729,7 +774,11 @@ class Metric(ABC):
             if self._is_synced and self._cache is not None:
                 current_val = self._cache[key]
             if isinstance(current_val, CatBuffer):
-                out[prefix + key] = {"data": np.asarray(current_val.data), "count": np.asarray(current_val.count)}
+                out[prefix + key] = {
+                    "data": np.asarray(current_val.data),
+                    "count": np.asarray(current_val.count),
+                    "overflow": np.asarray(current_val.overflow),
+                }
             elif isinstance(current_val, list):
                 out[prefix + key] = [np.asarray(v) for v in current_val]
             else:
@@ -742,8 +791,16 @@ class Metric(ABC):
             name = prefix + key
             if name in state_dict:
                 value = state_dict[name]
-                if isinstance(value, dict) and set(value) == {"data", "count"}:
-                    setattr(self, key, CatBuffer(jnp.asarray(value["data"]), jnp.asarray(value["count"])))
+                if isinstance(value, dict) and {"data", "count"} <= set(value):
+                    setattr(
+                        self,
+                        key,
+                        CatBuffer(
+                            jnp.asarray(value["data"]),
+                            jnp.asarray(value["count"]),
+                            jnp.asarray(value["overflow"]) if "overflow" in value else None,
+                        ),
+                    )
                 elif isinstance(value, list):
                     setattr(self, key, [jnp.asarray(v) for v in value])
                 else:

@@ -29,11 +29,18 @@ from metrics_tpu.utils.prints import rank_zero_warn
 
 @jax.tree_util.register_pytree_node_class
 class CatBuffer:
-    """Fixed-capacity append buffer: ``data (capacity, *item)`` + ``count`` scalar."""
+    """Fixed-capacity append buffer: ``data (capacity, *item)`` + ``count`` scalar.
 
-    def __init__(self, data: jnp.ndarray, count: jnp.ndarray) -> None:
+    ``overflow`` is a sticky device-side flag: locally an overflow is detectable as
+    ``count > capacity``, but a cross-device ``cat_sync`` clamps per-device counts
+    while gathering, so the flag is the only way the condition survives sync and can
+    poison ``compute`` (see ``Metric.compute_from``).
+    """
+
+    def __init__(self, data: jnp.ndarray, count: jnp.ndarray, overflow: jnp.ndarray = None) -> None:
         self.data = data
         self.count = count
+        self.overflow = jnp.zeros((), jnp.bool_) if overflow is None else overflow
 
     @classmethod
     def create(
@@ -48,7 +55,7 @@ class CatBuffer:
 
     # -------------------------------------------------------------- pytree
     def tree_flatten(self):
-        return (self.data, self.count), None
+        return (self.data, self.count, self.overflow), None
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -62,6 +69,10 @@ class CatBuffer:
     def valid_count(self) -> jnp.ndarray:
         return jnp.minimum(self.count, self.capacity)
 
+    def overflowed(self) -> jnp.ndarray:
+        """Sticky jit-safe overflow indicator (local condition OR synced-in flag)."""
+        return self.overflow | (self.count > self.capacity)
+
     def mask(self) -> jnp.ndarray:
         """Boolean validity mask over the capacity axis (jit-safe)."""
         return jnp.arange(self.capacity) < self.valid_count()
@@ -69,17 +80,18 @@ class CatBuffer:
     def values(self) -> jnp.ndarray:
         """Trim to the concrete count — EAGER ONLY (dynamic output shape)."""
         count = int(self.count)
-        if count > self.capacity:
+        if count > self.capacity or bool(self.overflow):
             rank_zero_warn(
-                f"CatBuffer overflow: {count} elements were appended into capacity {self.capacity}; "
-                "the newest appends overwrote the tail. Increase `cat_capacity`.",
+                f"CatBuffer overflow: {count} elements were appended into capacity {self.capacity}"
+                " (or an overflowed device state was synced in); the newest appends overwrote"
+                " the tail. Increase `cat_capacity`.",
                 RuntimeWarning,
             )
         return self.data[: min(count, self.capacity)]
 
     def copy(self) -> "CatBuffer":
         """New holder over the same (immutable) arrays — append rebinds, never writes."""
-        return CatBuffer(self.data, self.count)
+        return CatBuffer(self.data, self.count, self.overflow)
 
     def __len__(self) -> int:  # eager only
         return int(self.valid_count())
@@ -123,18 +135,23 @@ def cat_sync(buf: CatBuffer, axis_name) -> CatBuffer:
     counts = replicate_gathered(
         jax.lax.all_gather(jnp.atleast_1d(buf.valid_count()), axis_name, axis=0, tiled=True), axis_name
     )  # (W,)
+    # the gather clamps per-device counts; the sticky flag is what survives
+    overflow = replicate_gathered(
+        jax.lax.all_gather(jnp.atleast_1d(buf.overflowed()), axis_name, axis=0, tiled=True), axis_name
+    ).any()
     capacity = buf.capacity
     per_device_mask = jnp.arange(capacity)[None, :] < counts[:, None]
     flat_mask = per_device_mask.reshape(-1)
     # stable sort: valid rows first, preserving per-device order
     order = jnp.argsort(~flat_mask, stable=True)
-    return CatBuffer(jnp.take(data, order, axis=0), counts.sum().astype(jnp.int32))
+    return CatBuffer(jnp.take(data, order, axis=0), counts.sum().astype(jnp.int32), overflow)
 
 
 def cat_merge(global_buf: CatBuffer, local_buf: CatBuffer) -> CatBuffer:
     """Eager merge for forward's reduce-state mode: append local's rows to global."""
     merged = global_buf.copy()
     merged.append(local_buf.values())
+    merged.overflow = merged.overflow | local_buf.overflowed()
     return merged
 
 

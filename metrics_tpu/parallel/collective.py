@@ -109,7 +109,7 @@ def sync_pytree(
         if isinstance(value, CatBuffer):
             # static-shape ragged gather: tiled all_gather + front-pack (core/state.py)
             synced = cat_sync(value, axis_name)
-            out[name] = CatBuffer(fx(synced.data), synced.count) if callable(fx) else synced
+            out[name] = CatBuffer(fx(synced.data), synced.count, synced.overflow) if callable(fx) else synced
         elif isinstance(value, (list, tuple)):
             if len(value) == 0:
                 out[name] = value if fx != "cat" else []

@@ -40,6 +40,40 @@ def shard_batch(batch: Any, mesh: Mesh, axis_name: str = "data") -> Any:
     return jax.tree_util.tree_map(lambda x: jax.device_put(jnp.asarray(x), sharding), batch)
 
 
+def _lists_to_buffers(metric, state0, batches, n_devices: int):
+    """Replace Python-list cat states with auto-sized CatBuffers.
+
+    Metrics built without ``cat_capacity`` keep cat states as unbounded lists, which
+    cannot cross the jit boundary. ``lax.scan`` already forces uniform batch shapes,
+    so one eager probe update on a device-sized shard reveals exactly how many rows
+    each list state appends per batch; capacity = rows/batch * n_batches. Metrics
+    whose append count depends on data values (none in-tree) would overflow instead
+    of crashing — the overflow flag then NaN-poisons compute (core/state.py).
+    """
+    from metrics_tpu.core.state import CatBuffer
+
+    def shardwise(x):
+        x = jnp.asarray(x)
+        shard = max(1, x.shape[0] // n_devices)
+        return x[:shard]
+
+    probe = metric.local_update(state0, *jax.tree_util.tree_map(shardwise, batches[0]))
+    out = {}
+    for name, val in probe.items():
+        if isinstance(state0[name], list):
+            if not val:
+                raise ValueError(
+                    f"cat state `{name}` appended nothing on the probe batch; pass"
+                    " `cat_capacity` explicitly to use evaluate_sharded with this metric"
+                )
+            rows_per_batch = sum(jnp.atleast_1d(v).shape[0] for v in val)
+            item = jnp.atleast_1d(jnp.asarray(val[0]))
+            out[name] = CatBuffer.create(rows_per_batch * len(batches), item.shape[1:], item.dtype)
+        else:
+            out[name] = state0[name]
+    return out
+
+
 def evaluate_sharded(
     metric,
     batches: Sequence[Tuple],
@@ -61,9 +95,9 @@ def evaluate_sharded(
     mesh = mesh or make_data_mesh(axis_name=axis_name)
     state0 = metric.init_state()
     if any(isinstance(v, list) for v in state0.values()):
-        raise NotImplementedError(
-            "evaluate_sharded requires array states (use fixed-capacity buffers for cat states)"
-        )
+        # shard width is the batch axis only — a multi-axis mesh replicates over
+        # the other axes, so capacity must divide by mesh.shape[axis_name]
+        state0 = _lists_to_buffers(metric, state0, batches, n_devices=mesh.shape[axis_name])
 
     stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *batches)
 

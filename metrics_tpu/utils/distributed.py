@@ -4,9 +4,10 @@
 cross-device sync engine lives in ``metrics_tpu.parallel.collective`` and is built on
 ``jax.lax`` collectives over mesh axis names instead of NCCL process groups.
 """
-from typing import List, Optional
+from typing import List, Optional, Sequence
 
 import jax.numpy as jnp
+import numpy as np
 from jax import Array
 
 from metrics_tpu.utils.compute import _safe_divide
@@ -48,25 +49,71 @@ def class_reduce(num: Array, denom: Array, weights: Array, class_reduction: str 
     raise ValueError(f"Reduction parameter {class_reduction} unknown. Choose between one of these: {valid_reduction}")
 
 
-def gather_all_tensors(result: Array, group: Optional[str] = None) -> List[Array]:
+def _pad_to(x: Array, shape: Sequence[int]) -> Array:
+    """Zero-pad ``x`` at the end of each dim up to ``shape``."""
+    pads = [(0, int(s) - int(d)) for d, s in zip(x.shape, shape)]
+    if all(p == (0, 0) for p in pads):
+        return x
+    return jnp.pad(x, pads)
+
+
+def _trim_to(x: Array, shape: Sequence[int]) -> Array:
+    """Slice ``x`` back down to ``shape`` (inverse of :func:`_pad_to`)."""
+    return x[tuple(slice(0, int(s)) for s in shape)]
+
+
+def _process_allgather(x):
+    """Gather ``x`` from every process, stacked on a new leading axis.
+
+    Isolated for test injection: single-process tests monkeypatch this to simulate
+    a multi-host gather (the reference tests inject ``dist_sync_fn`` the same way,
+    tests/unittests/bases/test_ddp.py:33-58).
+    """
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.process_allgather(x)
+
+
+def gather_all_tensors(result: Array, group: Optional[Sequence[int]] = None) -> List[Array]:
     """Eager (outside-jit) cross-process all_gather returning a per-process list.
 
-    Reference: utilities/distributed.py:98-148. On TPU pods this rides DCN via
-    ``jax.experimental.multihost_utils``; in a single-process run it returns ``[result]``.
-    Ragged shapes are handled by the underlying allgather (per-process padding is not
-    required because process_allgather stacks equal-shaped arrays; ragged list states
-    are instead pre-padded by the caller — see parallel.collective.pad_gather).
+    Reference: utilities/distributed.py:98-148 — including the ragged path: when
+    per-process shapes differ, every tensor is zero-padded to the per-dim max,
+    gathered, and trimmed back to each rank's true shape, so variable-length cat
+    states sync across hosts exactly like the reference.
+
+    ``group`` selects a process sub-group as a sequence of process indices (the
+    mesh-axis analogue of a torch process group): the gather still rides the global
+    DCN collective — JAX has no eager sub-communicators — but only the listed
+    ranks' tensors are returned, which is the reference's observable semantics.
+    On TPU pods the transport is ``multihost_utils.process_allgather``; in a
+    single-process run this returns ``[result]``.
     """
     import jax
 
-    if group is not None:
-        raise NotImplementedError(
-            "Process sub-groups are not supported by the eager gather; use a mesh axis"
-            " name with the pure sync tier (Metric.sync_state) for sub-group reductions."
+    if isinstance(group, str):
+        raise ValueError(
+            f"`group` must be a sequence of process indices, got the string {group!r}."
+            " Mesh axis names drive the pure sync tier (Metric.sync_state /"
+            " Metric.sync_axis), not the eager cross-process gather."
         )
+    result = jnp.asarray(result)
     if jax.process_count() == 1:
+        if group is not None and list(group) != [0]:
+            raise ValueError(f"process sub-group {list(group)!r} invalid for a single-process runtime")
         return [result]
-    from jax.experimental import multihost_utils
 
-    stacked = multihost_utils.process_allgather(result)
-    return [stacked[i] for i in range(stacked.shape[0])]
+    # gather per-rank shapes first (reference :119-128)
+    local_shape = np.asarray(result.shape, dtype=np.int64)  # (ndim,); (0,) for scalars
+    all_shapes = np.asarray(_process_allgather(jnp.asarray(local_shape)))  # (world, ndim)
+    ranks = range(all_shapes.shape[0]) if group is None else list(group)
+
+    if (all_shapes == all_shapes[0]).all():
+        stacked = _process_allgather(result)
+        return [jnp.asarray(stacked[i]) for i in ranks]
+
+    # ragged: pad to per-dim max, gather, trim per rank (reference :136-148)
+    max_shape = all_shapes.max(axis=0)
+    padded = _pad_to(result, max_shape)
+    stacked = _process_allgather(padded)
+    return [_trim_to(jnp.asarray(stacked[i]), all_shapes[i]) for i in ranks]

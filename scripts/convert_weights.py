@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""Convert published metric-model checkpoints to the ``.npz`` format this
+framework loads natively (no torch needed at metric runtime).
+
+The reference downloads or vendors these weights directly as torch checkpoints
+(InceptionV3: torch-fidelity, ``image/fid.py:52-157``; LPIPS linear heads:
+vendored ``functional/image/lpips_models/*.pth``; CLIP/BERT: HF hub,
+``multimodal/clip_score.py:46`` / ``text/bert.py:55``). This environment has no
+network egress, so conversion is a user-run step:
+
+    python scripts/convert_weights.py inception pt_inception-2015-12-05.pth inception.npz
+    python scripts/convert_weights.py lpips lpips_models/alex.pth alex_lins.npz
+    python scripts/convert_weights.py state-dict <any .pth or HF pytorch_model.bin> out.npz
+
+Then point the loaders at the outputs:
+
+    METRICS_TPU_INCEPTION_WEIGHTS=inception.npz      # FID / KID / InceptionScore
+    METRICS_TPU_LPIPS_LINEAR_WEIGHTS=alex_lins.npz   # LPIPS lin heads
+    METRICS_TPU_LPIPS_ALEX_WEIGHTS=<backbone.npz>    # LPIPS backbone
+
+Verification story (tests/unittests/image/test_golden_weights.py):
+- a committed golden fixture pins the full LPIPS pipeline against scores
+  generated with the reference's vendored lin heads;
+- when METRICS_TPU_INCEPTION_WEIGHTS points at real torch-fidelity weights and
+  torch is importable, a differential test checks our features against the
+  reference extractor on the same inputs (skip-if-absent).
+"""
+import argparse
+import sys
+
+
+def convert_inception(src: str, dst: str) -> None:
+    from metrics_tpu.models.inception import convert_torch_fidelity_checkpoint
+
+    convert_torch_fidelity_checkpoint(src, dst)
+
+
+def convert_lpips(src: str, dst: str) -> None:
+    """Extract LPIPS linear-head weights (lpips ``.pth`` layout) to npz."""
+    import numpy as np
+
+    from metrics_tpu.models._io import load_checkpoint_state
+
+    state = load_checkpoint_state(src)
+    np.savez(dst, **state)
+
+
+def convert_state_dict(src: str, dst: str) -> None:
+    """Generic torch state-dict (incl. HF ``pytorch_model.bin``) -> flat npz."""
+    import numpy as np
+
+    from metrics_tpu.models._io import load_checkpoint_state
+
+    state = load_checkpoint_state(src)
+    np.savez(dst, **state)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("kind", choices=("inception", "lpips", "state-dict"))
+    parser.add_argument("src", help="source checkpoint (.pth / .bin)")
+    parser.add_argument("dst", help="output .npz path")
+    args = parser.parse_args(argv)
+    {"inception": convert_inception, "lpips": convert_lpips, "state-dict": convert_state_dict}[args.kind](
+        args.src, args.dst
+    )
+    print(f"wrote {args.dst}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Regenerate tests/fixtures/lpips_golden.npz.
+
+The fixture pins the full LPIPS pipeline (JAX backbone forward + unit-normalize +
+lin-head weighting + spatial mean) against scores computed with the REAL vendored
+LPIPS linear-head weights from the reference checkout
+(``src/torchmetrics/functional/image/lpips_models/*.pth``). The backbone and the
+input images are deterministic ``np.random.RandomState`` draws (bit-stable across
+numpy versions), so only the tiny score vectors need committing.
+
+Run from the repo root with the reference mounted:
+
+    python scripts/gen_golden_fixtures.py [reference_lpips_dir] [out_npz]
+"""
+import os
+import sys
+
+import numpy as np
+
+
+def random_backbone_state(net_type, rng):
+    """Deterministic correctly-shaped backbone (same layout as torchvision's)."""
+    shapes = {
+        "alex": {
+            "features.0": (64, 3, 11, 11),
+            "features.3": (192, 64, 5, 5),
+            "features.6": (384, 192, 3, 3),
+            "features.8": (256, 384, 3, 3),
+            "features.10": (256, 256, 3, 3),
+        },
+        "vgg": {
+            f"features.{k}": s
+            for k, s in zip(
+                [0, 2, 5, 7, 10, 12, 14, 17, 19, 21, 24, 26, 28],
+                [(64, 3, 3, 3), (64, 64, 3, 3), (128, 64, 3, 3), (128, 128, 3, 3), (256, 128, 3, 3),
+                 (256, 256, 3, 3), (256, 256, 3, 3), (512, 256, 3, 3), (512, 512, 3, 3), (512, 512, 3, 3),
+                 (512, 512, 3, 3), (512, 512, 3, 3), (512, 512, 3, 3)],
+            )
+        },
+    }[net_type]
+    state = {}
+    for prefix, shape in shapes.items():
+        state[f"{prefix}.weight"] = (rng.randn(*shape) * 0.1).astype(np.float32)
+        state[f"{prefix}.bias"] = (rng.randn(shape[0]) * 0.1).astype(np.float32)
+    return state
+
+
+def compute_scores(lpips_dir: str, net_type: str):
+    import jax.numpy as jnp
+
+    from metrics_tpu.models._io import load_checkpoint_state
+    from metrics_tpu.models.lpips import (
+        alex_params_from_state_dict,
+        linear_weights_from_state_dict,
+        lpips_forward,
+        vgg_params_from_state_dict,
+    )
+
+    rng = np.random.RandomState(1234)
+    state = random_backbone_state(net_type, rng)
+    img1 = (2 * rng.rand(2, 3, 40, 40) - 1).astype(np.float32)
+    img2 = (2 * rng.rand(2, 3, 40, 40) - 1).astype(np.float32)
+    lins_state = load_checkpoint_state(os.path.join(lpips_dir, f"{net_type}.pth"))
+    lins = linear_weights_from_state_dict(lins_state, net_type)
+    converter = {"alex": alex_params_from_state_dict, "vgg": vgg_params_from_state_dict}[net_type]
+    scores = lpips_forward(
+        converter(state), [jnp.asarray(w) for w in lins], jnp.asarray(img1), jnp.asarray(img2), net_type, False
+    )
+    return np.asarray(scores)
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    lpips_dir = sys.argv[1] if len(sys.argv) > 1 else "/root/reference/src/torchmetrics/functional/image/lpips_models"
+    out = sys.argv[2] if len(sys.argv) > 2 else "tests/fixtures/lpips_golden.npz"
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    np.savez(out, alex=compute_scores(lpips_dir, "alex"), vgg=compute_scores(lpips_dir, "vgg"))
+    print(f"wrote {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,62 @@
+"""Golden-weight verification for the model ports (VERDICT r2 item 8).
+
+Two tiers:
+1. A committed fixture (``tests/fixtures/lpips_golden.npz``, regenerate with
+   ``scripts/gen_golden_fixtures.py``) pins the LPIPS pipeline against scores
+   produced with the REAL vendored linear-head weights from the reference
+   (``src/torchmetrics/functional/image/lpips_models/*.pth``) — proving both
+   that the published weights load and that the JAX forward stays bit-stable.
+2. A skip-if-absent differential test for real InceptionV3 weights: when
+   ``METRICS_TPU_INCEPTION_WEIGHTS`` points at a torch-fidelity checkpoint (or
+   its npz conversion via ``scripts/convert_weights.py``) and the reference
+   library is importable, our features must match the reference extractor
+   (reference ``image/fid.py:52-157``) on the same inputs.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+_LPIPS_MODELS_DIR = "/root/reference/src/torchmetrics/functional/image/lpips_models"
+_FIXTURE = os.path.join(os.path.dirname(__file__), "..", "..", "fixtures", "lpips_golden.npz")
+
+
+@pytest.mark.skipif(not os.path.isdir(_LPIPS_MODELS_DIR), reason="vendored lin weights not mounted")
+@pytest.mark.parametrize("net_type", ["alex", "vgg"])
+def test_lpips_golden_scores(net_type):
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..", "..", "scripts"))
+    from gen_golden_fixtures import compute_scores
+
+    golden = np.load(_FIXTURE)[net_type]
+    got = compute_scores(_LPIPS_MODELS_DIR, net_type)
+    assert np.allclose(got, golden, atol=1e-5), np.abs(got - golden).max()
+
+
+@pytest.mark.skipif(
+    not os.environ.get("METRICS_TPU_INCEPTION_WEIGHTS")
+    or not os.path.exists(os.environ.get("METRICS_TPU_INCEPTION_WEIGHTS", "")),
+    reason="set METRICS_TPU_INCEPTION_WEIGHTS to a torch-fidelity checkpoint to run",
+)
+def test_inception_real_weights_match_reference():
+    torch = pytest.importorskip("torch")
+    tf_models = pytest.importorskip("torch_fidelity.feature_extractor_inceptionv3")
+
+    from metrics_tpu.models.inception import inception_features, load_inception_params
+
+    weights_path = os.environ["METRICS_TPU_INCEPTION_WEIGHTS"]
+    params = load_inception_params(weights_path)
+
+    rng = np.random.RandomState(0)
+    imgs = rng.randint(0, 256, (2, 3, 299, 299)).astype(np.uint8)
+    ours = np.asarray(inception_features(params, jnp.asarray(imgs), 2048))
+
+    ref = tf_models.FeatureExtractorInceptionV3("inception", ["2048"])
+    ref.load_state_dict(torch.load(weights_path, map_location="cpu", weights_only=False), strict=False)
+    ref.eval()
+    with torch.no_grad():
+        theirs = ref(torch.from_numpy(imgs.astype(np.int64)).to(torch.uint8))[0].numpy()
+    assert np.allclose(ours, theirs, atol=1e-3), np.abs(ours - theirs).max()

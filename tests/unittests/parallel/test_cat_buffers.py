@@ -76,7 +76,7 @@ def test_copy_isolates_mutation():
 def test_buffer_is_pytree():
     buf = CatBuffer.create(4)
     leaves = jax.tree_util.tree_leaves(buf)
-    assert len(leaves) == 2
+    assert len(leaves) == 3  # data, count, overflow
     mapped = jax.tree_util.tree_map(lambda x: x, buf)
     assert isinstance(mapped, CatBuffer)
 
@@ -237,3 +237,73 @@ def test_cat_sync_front_packs_partial_buffers():
     expected = np.concatenate([vals[d, : counts[d]] for d in range(NUM_DEVICES)])
     assert int(out.count) == counts.sum()
     assert np.allclose(np.asarray(out.values()), expected)
+
+
+# ------------------------------------------------------- overflow surfacing
+
+def test_overflow_flag_survives_cat_sync_and_poisons_compute():
+    """VERDICT r2 item 5: an overflowed sharded RetrievalMAP cannot return a
+    silently wrong value — the flag rides the synced state and compute_from
+    returns NaN."""
+    idx = np.repeat(np.arange(8), 8).astype(np.int32)
+    preds = _rng.rand(64).astype(np.float32)
+    target = (_rng.rand(64) > 0.5).astype(np.int32)
+    # capacity 4 per device but each device receives 8 rows -> overflow everywhere
+    metric = RetrievalMAP(cat_capacity=4, validate_args=False)
+    synced = _sharded_state(metric, (jnp.asarray(preds), jnp.asarray(target), jnp.asarray(idx)), 3)
+    assert bool(synced["indexes"].overflowed())
+    value = metric.compute_from(synced)
+    assert bool(jnp.isnan(value))
+
+
+def test_overflow_poison_applies_to_jit_produced_state():
+    """A jitted update that overflows produces a state whose compute is NaN."""
+    metric = RetrievalMAP(cat_capacity=4, validate_args=False)
+    state = metric.init_state()
+    state = jax.jit(metric.local_update)(
+        state, jnp.asarray(_rng.rand(8), jnp.float32), jnp.ones(8, jnp.int32), jnp.zeros(8, jnp.int32)
+    )
+    assert bool(state["indexes"].overflowed())
+    value = metric.compute_from(state)
+    assert bool(jnp.isnan(value))
+
+
+def test_no_overflow_no_poison():
+    metric = RetrievalMAP(cat_capacity=16, validate_args=False)
+    state = metric.local_update(
+        metric.init_state(), jnp.asarray(_rng.rand(8), jnp.float32), jnp.ones(8, jnp.int32), jnp.zeros(8, jnp.int32)
+    )
+    assert not bool(jnp.isnan(metric.compute_from(state)))
+
+
+def test_overflow_warns_on_eager_compute():
+    import warnings
+
+    metric = SpearmanCorrCoef(cat_capacity=4)
+    p = _rng.randn(10).astype(np.float32)
+    metric.update(jnp.asarray(p), jnp.asarray(p * 2))
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        metric.compute()
+    assert any("overflow" in str(x.message).lower() for x in w)
+
+
+# ------------------------------------------- evaluate_sharded list-state path
+
+def test_evaluate_sharded_auto_buffers_list_states():
+    """Metrics built WITHOUT cat_capacity now run under evaluate_sharded: list
+    states are probed and auto-wrapped in fixed-capacity buffers."""
+    from metrics_tpu.parallel import evaluate_sharded
+
+    mesh = make_data_mesh(NUM_DEVICES)
+    p = _rng.randn(128).astype(np.float32)
+    t = (p + 0.5 * _rng.randn(128)).astype(np.float32)
+    batches = [
+        (jnp.asarray(p[:64]), jnp.asarray(t[:64])),
+        (jnp.asarray(p[64:]), jnp.asarray(t[64:])),
+    ]
+    metric = SpearmanCorrCoef()  # list states, no cat_capacity
+    val = evaluate_sharded(metric, batches, mesh=mesh)
+    single = SpearmanCorrCoef()
+    single.update(jnp.asarray(p), jnp.asarray(t))
+    assert abs(float(val) - float(single.compute())) < 1e-6

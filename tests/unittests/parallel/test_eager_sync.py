@@ -122,19 +122,68 @@ def test_gather_all_tensors_single_process():
     assert len(out) == 1 and np.allclose(np.asarray(out[0]), [1.0, 2.0])
 
 
-def test_gather_all_tensors_multiprocess_branch(monkeypatch):
+def _patch_world2(monkeypatch, rank1_value_of):
+    """Simulate a 2-process world: rank 0 holds the caller's array, rank 1 holds
+    ``rank1_value_of(x)``. Shape gathers (int arrays) see each rank's true shape."""
     import jax
-    from jax.experimental import multihost_utils
+
+    import metrics_tpu.utils.distributed as dist_mod
+
+    def fake_allgather(x):
+        return jnp.stack([x, rank1_value_of(x)])
 
     monkeypatch.setattr(jax, "process_count", lambda: 2)
-    monkeypatch.setattr(
-        multihost_utils, "process_allgather", lambda x: jnp.stack([x, x + 10])
+    monkeypatch.setattr(dist_mod, "_process_allgather", fake_allgather)
+
+
+def test_gather_all_tensors_multiprocess_branch(monkeypatch):
+    _patch_world2(
+        monkeypatch,
+        lambda x: x + 10 if jnp.issubdtype(x.dtype, jnp.floating) else x,
     )
     out = gather_all_tensors(jnp.asarray([1.0, 2.0]))
     assert len(out) == 2
     assert np.allclose(np.asarray(out[1]), [11.0, 12.0])
 
 
-def test_gather_all_tensors_rejects_subgroups():
-    with pytest.raises(NotImplementedError, match="sub-group"):
-        gather_all_tensors(jnp.asarray(1.0), group="tp")
+def test_gather_all_tensors_subgroup_selects_ranks(monkeypatch):
+    _patch_world2(
+        monkeypatch,
+        lambda x: x + 10 if jnp.issubdtype(x.dtype, jnp.floating) else x,
+    )
+    out = gather_all_tensors(jnp.asarray([1.0, 2.0]), group=[1])
+    assert len(out) == 1
+    assert np.allclose(np.asarray(out[0]), [11.0, 12.0])
+
+
+def test_gather_all_tensors_ragged_pads_and_trims(monkeypatch):
+    """Rank 0 holds 3 rows, rank 1 holds 5 rows: pad/gather/trim round-trips both
+    (reference utilities/distributed.py:136-148)."""
+    import jax
+
+    import metrics_tpu.utils.distributed as dist_mod
+
+    rank0 = jnp.asarray([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]])
+    rank1 = jnp.asarray([[7.0, 8.0], [9.0, 10.0], [11.0, 12.0], [13.0, 14.0], [15.0, 16.0]])
+
+    def fake_allgather(x):
+        if not jnp.issubdtype(x.dtype, jnp.floating):  # shape gather
+            return jnp.stack([jnp.asarray(rank0.shape, x.dtype), jnp.asarray(rank1.shape, x.dtype)])
+        # transport requires equal shapes: caller must have padded to the max
+        assert x.shape == (5, 2), f"expected padded shape (5, 2), got {x.shape}"
+        other = dist_mod._pad_to(rank1, (5, 2))
+        return jnp.stack([x, other])
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(dist_mod, "_process_allgather", fake_allgather)
+
+    out = gather_all_tensors(rank0)
+    assert len(out) == 2
+    assert out[0].shape == (3, 2) and np.allclose(np.asarray(out[0]), np.asarray(rank0))
+    assert out[1].shape == (5, 2) and np.allclose(np.asarray(out[1]), np.asarray(rank1))
+
+
+def test_gather_all_tensors_single_process_group():
+    assert len(gather_all_tensors(jnp.asarray(1.0), group=[0])) == 1
+    with pytest.raises(ValueError, match="sub-group"):
+        gather_all_tensors(jnp.asarray(1.0), group=[0, 1])
