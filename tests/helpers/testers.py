@@ -150,19 +150,17 @@ class MetricTester:
         from jax import shard_map
         from jax.sharding import PartitionSpec as P
 
-        import inspect
-
         args = dict(metric_args)
-        # skip validation under jit, but only for metrics that declare the kwarg —
-        # **kwargs-absorbing classes (e.g. PIT) would forward it to their inner fn
-        sig = inspect.signature(metric_class.__init__)
-        if "validate_args" in sig.parameters and "validate_args" not in args:
-            args["validate_args"] = False
-        try:
-            metric = metric_class(**args)
-        except (TypeError, ValueError):
-            args = dict(metric_args)
-            metric = metric_class(**args)
+        metric = metric_class(**args)
+        # skip validation under jit, but only for metrics that actually consume the
+        # kwarg (instance attribute) — checking the leaf __init__ signature would
+        # miss base-class consumption (retrieval), and blind injection would poison
+        # **kwargs-absorbing classes (PIT forwards unknown kwargs to metric_func)
+        if "validate_args" not in args and getattr(metric, "validate_args", False):
+            try:
+                metric = metric_class(**args, validate_args=False)
+            except TypeError:
+                pass
         if any(isinstance(v, list) for v in metric.init_state().values()):
             # cat-state metric: re-build with per-device fixed-capacity buffers
             # (capacity = this device's share of the total sample count)
@@ -219,6 +217,10 @@ def tworank_sync_compute(m0: Metric, m1: Metric) -> Any:
             queue.append(v1.values())
         elif isinstance(v1, list):
             if m0._reductions[attr] == "cat" and len(v0) > 1:
+                assert len(v1) > 0, (
+                    f"tworank_sync_compute: state `{attr}` has updates on rank 0 but none on"
+                    " rank 1 — split updates so both ranks participate"
+                )
                 queue.append(jnp.concatenate([jnp.atleast_1d(x) for x in v1]))
             else:
                 # a real world-2 collective makes one call per rank-0 list item;

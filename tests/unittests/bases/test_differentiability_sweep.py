@@ -1,0 +1,147 @@
+"""Exhaustive differentiability sweep (VERDICT r2 item 6).
+
+One parametrized case for EVERY metric class declaring ``is_differentiable=True``
+(reference analogue: run_differentiability_test + autograd.gradcheck,
+tests/unittests/helpers/testers.py:509-543). Each case checks that
+``jax.grad`` of ``compute_from(local_update(init_state, *inputs))`` w.r.t. preds
+
+1. exists and is finite everywhere, and
+2. matches central finite differences on sampled coordinates.
+
+An exhaustiveness guard enumerates ``is_differentiable`` classes from the root
+export list, so a newly added differentiable metric fails this file until it
+gets a case (or a documented skip).
+"""
+import inspect
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import metrics_tpu
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.audio import scale_invariant_signal_noise_ratio
+
+_rng = np.random.RandomState(99)
+
+
+def _img(shape, positive=False):
+    x = _rng.rand(*shape).astype(np.float32)
+    return x + 0.1 if positive else x
+
+
+def _sig(shape):
+    return _rng.randn(*shape).astype(np.float32)
+
+
+def _probs(shape):
+    x = _rng.rand(*shape).astype(np.float32) + 0.1
+    return x / x.sum(-1, keepdims=True)
+
+
+# name -> (ctor kwargs, preds, target-or-None, grad atol, fd eps)
+IMG = (2, 3, 16, 16)
+CASES = {
+    # image
+    "ErrorRelativeGlobalDimensionlessSynthesis": ({"ratio": 2}, _img(IMG, True), _img(IMG, True), 5e-2, 1e-3),
+    "MultiScaleStructuralSimilarityIndexMeasure": (
+        {"data_range": 1.0, "betas": (0.5, 0.5), "kernel_size": 3},
+        _img((2, 3, 24, 24)),
+        _img((2, 3, 24, 24)),
+        5e-2,
+        1e-3,
+    ),
+    "PeakSignalNoiseRatio": ({"data_range": 1.0}, _img(IMG), _img(IMG), 5e-2, 1e-3),
+    "PeakSignalNoiseRatioWithBlockedEffect": ({"block_size": 4}, _img((2, 1, 16, 16)), _img((2, 1, 16, 16)), 5e-2, 1e-3),
+    "RelativeAverageSpectralError": ({"window_size": 4}, _img(IMG, True), _img(IMG, True), 5e-1, 1e-3),
+    "RootMeanSquaredErrorUsingSlidingWindow": ({"window_size": 4}, _img(IMG), _img(IMG), 5e-2, 1e-3),
+    "SpectralAngleMapper": ({}, _img(IMG, True), _img(IMG, True), 5e-2, 1e-3),
+    "SpectralDistortionIndex": ({}, _img(IMG, True), _img(IMG, True), 5e-2, 1e-3),
+    "StructuralSimilarityIndexMeasure": ({"data_range": 1.0}, _img(IMG), _img(IMG), 5e-2, 1e-3),
+    "TotalVariation": ({}, _img(IMG), None, 5e-2, 1e-3),
+    "UniversalImageQualityIndex": ({}, _img(IMG), _img(IMG), 5e-2, 1e-3),
+    # regression
+    "ConcordanceCorrCoef": ({}, _sig((16,)), _sig((16,)), 5e-2, 1e-3),
+    "CosineSimilarity": ({}, _sig((4, 8)), _sig((4, 8)), 5e-2, 1e-3),
+    "ExplainedVariance": ({}, _sig((16,)), _sig((16,)), 5e-2, 1e-3),
+    "KLDivergence": ({}, _probs((4, 6)), _probs((4, 6)), 5e-2, 1e-4),
+    "LogCoshError": ({}, _sig((16,)), _sig((16,)), 5e-2, 1e-3),
+    "MeanAbsoluteError": ({}, _sig((16,)) + 3, _sig((16,)), 5e-2, 1e-3),
+    "MeanAbsolutePercentageError": ({}, _sig((16,)), np.abs(_sig((16,))) + 0.5, 5e-2, 1e-3),
+    "MeanSquaredError": ({}, _sig((16,)), _sig((16,)), 5e-2, 1e-3),
+    "MeanSquaredLogError": ({}, np.abs(_sig((16,))) + 0.5, np.abs(_sig((16,))) + 0.5, 5e-2, 1e-3),
+    "MinkowskiDistance": ({"p": 3}, _sig((16,)) + 5, _sig((16,)), 5e-2, 1e-3),
+    "PearsonCorrCoef": ({}, _sig((16,)), _sig((16,)), 5e-2, 1e-3),
+    "R2Score": ({}, _sig((16,)), _sig((16,)), 5e-2, 1e-3),
+    "SymmetricMeanAbsolutePercentageError": ({}, np.abs(_sig((16,))) + 0.5, np.abs(_sig((16,))) + 0.5, 5e-2, 1e-3),
+    "TweedieDevianceScore": ({"power": 1.5}, np.abs(_sig((16,))) + 0.5, np.abs(_sig((16,))) + 0.5, 5e-2, 1e-3),
+    "WeightedMeanAbsolutePercentageError": ({}, _sig((16,)), np.abs(_sig((16,))) + 0.5, 5e-2, 1e-3),
+    # audio
+    "PermutationInvariantTraining": (
+        {"metric_func": scale_invariant_signal_noise_ratio, "eval_func": "max"},
+        _sig((2, 2, 32)),
+        _sig((2, 2, 32)),
+        1e-1,
+        1e-3,
+    ),
+    "ScaleInvariantSignalDistortionRatio": ({}, _sig((2, 32)), _sig((2, 32)), 5e-2, 1e-3),
+    "ScaleInvariantSignalNoiseRatio": ({}, _sig((2, 32)), _sig((2, 32)), 5e-2, 1e-3),
+    "SignalDistortionRatio": ({"filter_length": 4, "load_diag": 1e-4}, _sig((2, 64)), _sig((2, 64)), 5e-1, 1e-2),
+    "SignalNoiseRatio": ({}, _sig((2, 32)), _sig((2, 32)), 5e-2, 1e-3),
+    # text
+    "Perplexity": ({}, _sig((2, 4, 8)), _rng.randint(0, 8, (2, 4)).astype(np.int32), 5e-2, 1e-3),
+}
+
+# documented exceptions: differentiable by design but not grad-checkable here
+SKIPS = {
+    "LearnedPerceptualImagePatchSimilarity": "requires backbone weights (no network egress); "
+    "pipeline differentiability is torch-oracle-tested in image/test_psnrb_lpips.py",
+}
+
+
+def _all_differentiable_names():
+    names = []
+    for name in metrics_tpu.__all__:
+        obj = getattr(metrics_tpu, name, None)
+        if inspect.isclass(obj) and issubclass(obj, Metric) and getattr(obj, "is_differentiable", None) is True:
+            names.append(name)
+    return names
+
+
+def test_sweep_is_exhaustive():
+    missing = [n for n in _all_differentiable_names() if n not in CASES and n not in SKIPS]
+    assert not missing, f"differentiable metrics without a gradcheck case: {missing}"
+
+
+@pytest.mark.parametrize("name", sorted(CASES), ids=sorted(CASES))
+def test_gradcheck(name):
+    kwargs, preds, target, atol, eps = CASES[name]
+    cls = getattr(metrics_tpu, name)
+    metric = cls(**kwargs)
+
+    def value(p):
+        args = (p,) if target is None else (p, jnp.asarray(target))
+        state = metric.local_update(metric.init_state(), *args)
+        return jnp.sum(jnp.asarray(metric.compute_from(state)))
+
+    grad = jax.grad(value)(jnp.asarray(preds))
+    assert grad.shape == preds.shape
+    assert bool(jnp.all(jnp.isfinite(grad))), f"{name}: non-finite gradient"
+
+    # finite differences on deterministic sampled coordinates (float32 tolerance)
+    flat = np.asarray(preds, np.float64).ravel()
+    grad_flat = np.asarray(grad, np.float64).ravel()
+    idxs = np.linspace(0, flat.size - 1, num=min(4, flat.size), dtype=np.int64)
+    for idx in idxs:
+        plus, minus = flat.copy(), flat.copy()
+        plus[idx] += eps
+        minus[idx] -= eps
+        f_plus = float(value(jnp.asarray(plus.reshape(preds.shape), jnp.float32)))
+        f_minus = float(value(jnp.asarray(minus.reshape(preds.shape), jnp.float32)))
+        fd = (f_plus - f_minus) / (2 * eps)
+        scale = max(1.0, abs(fd), abs(grad_flat[idx]))
+        assert abs(fd - grad_flat[idx]) <= atol * scale, (
+            f"{name}[{idx}]: analytic {grad_flat[idx]:.6f} vs fd {fd:.6f}"
+        )
