@@ -34,6 +34,24 @@ from metrics_tpu.utils.data import _count_dtype, dim_zero_cat
 from metrics_tpu.utils.enums import ClassificationTask
 
 
+def _exact_cat_state(preds_state: Any, target_state: Any) -> Tuple[Array, Array]:
+    """Dense (preds, target) view of exact-mode cat states, jit-safe for buffers.
+
+    Under a trace, CatBuffer states expose the full static-capacity ``data`` with
+    invalid rows' targets forced to -1 — the device curve kernels treat target<0
+    as masked, so exact mode composes with jit/compute_from (VERDICT r2 item 7).
+    Eagerly this trims like the reference.
+    """
+    from metrics_tpu.core.state import CatBuffer
+    from metrics_tpu.utils.checks import _is_concrete
+
+    if isinstance(preds_state, CatBuffer) and not _is_concrete(preds_state.count):
+        mask = target_state.mask()
+        mask = mask.reshape(mask.shape + (1,) * (target_state.data.ndim - 1))
+        return preds_state.data, jnp.where(mask, target_state.data, -1)
+    return dim_zero_cat(preds_state), dim_zero_cat(target_state)
+
+
 class _PrecisionRecallCurvePlotMixin:
     """Shared curve plot for the three PR-curve tasks."""
 
@@ -103,7 +121,7 @@ class BinaryPrecisionRecallCurve(_PrecisionRecallCurvePlotMixin, Metric):
             self.confmat = self.confmat + state
 
     def compute(self) -> Tuple[Array, Array, Array]:
-        state = (dim_zero_cat(self.preds), dim_zero_cat(self.target)) if self.thresholds is None else self.confmat
+        state = _exact_cat_state(self.preds, self.target) if self.thresholds is None else self.confmat
         return _binary_precision_recall_curve_compute(state, self.thresholds)
 
 class MulticlassPrecisionRecallCurve(_PrecisionRecallCurvePlotMixin, Metric):
@@ -153,7 +171,7 @@ class MulticlassPrecisionRecallCurve(_PrecisionRecallCurvePlotMixin, Metric):
             self.confmat = self.confmat + state
 
     def compute(self) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
-        state = (dim_zero_cat(self.preds), dim_zero_cat(self.target)) if self.thresholds is None else self.confmat
+        state = _exact_cat_state(self.preds, self.target) if self.thresholds is None else self.confmat
         return _multiclass_precision_recall_curve_compute(state, self.num_classes, self.thresholds)
 
 class MultilabelPrecisionRecallCurve(_PrecisionRecallCurvePlotMixin, Metric):
@@ -203,7 +221,7 @@ class MultilabelPrecisionRecallCurve(_PrecisionRecallCurvePlotMixin, Metric):
             self.confmat = self.confmat + state
 
     def compute(self) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
-        state = (dim_zero_cat(self.preds), dim_zero_cat(self.target)) if self.thresholds is None else self.confmat
+        state = _exact_cat_state(self.preds, self.target) if self.thresholds is None else self.confmat
         return _multilabel_precision_recall_curve_compute(state, self.num_labels, self.thresholds, self.ignore_index)
 
 class PrecisionRecallCurve:

@@ -189,6 +189,16 @@ def _binary_precision_recall_curve_compute(
         recall = jnp.concatenate([recall, jnp.zeros(1, dtype=recall.dtype)])
         return precision, recall, thresholds
 
+    if not _is_concrete(state[0]) or not _is_concrete(state[1]):
+        # under jit: static-shape padded device curve (ops/clf_curve.py). The
+        # first K = (~isnan(thresholds)).sum() entries are the reference curve;
+        # precision/recall pads repeat the final (1, 0) point.
+        from metrics_tpu.ops.clf_curve import binary_precision_recall_curve_padded
+
+        target = state[1] if pos_label == 1 else jnp.where(state[1] >= 0, (state[1] == pos_label).astype(jnp.int32), -1)
+        precision, recall, thresholds, _ = binary_precision_recall_curve_padded(state[0], target)
+        return precision, recall, thresholds
+
     # exact mode is host-side; drop positions masked to -1 by ignore_index
     _p, _t = np.asarray(state[0]), np.asarray(state[1])
     keep = _t >= 0
@@ -440,15 +450,21 @@ def _multilabel_precision_recall_curve_compute(
         recall = jnp.concatenate([recall, jnp.zeros((1, num_labels), dtype=recall.dtype)])
         return precision.T, recall.T, thresholds
 
+    tracer_mode = not _is_concrete(state[0]) or not _is_concrete(state[1])
     precision, recall, thresholds_out = [], [], []
     for i in range(num_labels):
-        preds_i = np.asarray(state[0][:, i])
-        target_i = np.asarray(state[1][:, i])
-        if ignore_index is not None:
-            # format already masked ignored positions to -1
-            idx = target_i < 0
-            preds_i = preds_i[~idx]
-            target_i = target_i[~idx]
+        if tracer_mode:
+            # jit path: the binary padded kernel masks target<0 itself (both
+            # ignore_index positions and buffer padding carry -1)
+            preds_i, target_i = state[0][:, i], state[1][:, i]
+        else:
+            preds_i = np.asarray(state[0][:, i])
+            target_i = np.asarray(state[1][:, i])
+            if ignore_index is not None:
+                # format already masked ignored positions to -1
+                idx = target_i < 0
+                preds_i = preds_i[~idx]
+                target_i = target_i[~idx]
         res = _binary_precision_recall_curve_compute((preds_i, target_i), thresholds=None, pos_label=1)
         precision.append(res[0])
         recall.append(res[1])

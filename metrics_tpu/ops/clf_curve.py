@@ -36,10 +36,11 @@ def _suffix_min(x: Array) -> Array:
     return jnp.flip(jax.lax.cummin(jnp.flip(x)))
 
 
-def _run_end_counts(preds: Array, target: Array, valid: Array) -> Tuple[Array, Array, Array]:
+def _run_end_counts(preds: Array, target: Array, valid: Array) -> Tuple[Array, Array, Array, Array]:
     """(fps, tps) at every position of the descending-score sort, tie runs collapsed.
 
-    Returns int32 ``fps``/``tps`` of shape (N,) plus the descending sort keys.
+    Returns int32 ``fps``/``tps`` of shape (N,) plus the descending sort keys and
+    the tie-run-end boundary mask (single source of truth for run collapsing).
     ``tps[-1]``/``fps[-1]`` are the total valid positive/negative counts.
 
     TPU notes: a single multi-operand ``lax.sort`` carries the labels with the keys
@@ -65,12 +66,12 @@ def _run_end_counts(preds: Array, target: Array, valid: Array) -> Tuple[Array, A
     # valid rows sort first, so the valid count up to run_end is min(run_end+1, n_valid)
     n_valid = jnp.sum((st >= 0).astype(jnp.int32))
     fps = jnp.minimum(run_end + 1, n_valid) - tps
-    return fps, tps, sk
+    return fps, tps, sk, boundary
 
 
 def _roc_points(preds: Array, target: Array, valid: Array) -> Tuple[Array, Array, Array, Array]:
     """(fpr0, tpr0) with a prepended origin, plus total positive/negative counts."""
-    fps, tps, _ = _run_end_counts(preds, target, valid)
+    fps, tps, _, _ = _run_end_counts(preds, target, valid)
     pos = tps[-1]
     neg = fps[-1]
     tpr = tps.astype(jnp.float32) / jnp.maximum(pos, 1)
@@ -115,7 +116,7 @@ def _binary_auroc_kernel(preds: Array, target: Array, valid: Array, max_fpr: Opt
 
 def _binary_ap_kernel(preds: Array, target: Array, valid: Array) -> Tuple[Array, Array]:
     """Exact binary average precision and the positive count; NaN when no positives."""
-    fps, tps, _ = _run_end_counts(preds, target, valid)
+    fps, tps, _, _ = _run_end_counts(preds, target, valid)
     pos = tps[-1]
     tot = (tps + fps).astype(jnp.float32)
     precision = jnp.where(tot > 0, tps.astype(jnp.float32) / jnp.where(tot > 0, tot, 1.0), 0.0)
@@ -139,6 +140,58 @@ def _pad_binary(preds: Array, target: Array) -> Tuple[Array, Array, Array]:
         preds = jnp.concatenate([preds, jnp.zeros((m - n,), preds.dtype)])
         target = jnp.concatenate([target, jnp.full((m - n,), -1, target.dtype)])
     return preds, target, target >= 0
+
+
+def _binary_curve_padded_kernel(preds: Array, target: Array, valid: Array) -> Tuple[Array, Array, Array, Array]:
+    """Static-shape exact PR curve: (precision (N+1,), recall (N+1,), thresholds (N,), K).
+
+    The first K entries of each array are EXACTLY the reference's unique-threshold
+    curve (ascending thresholds); precision/recall pads repeat the final point
+    (1, 0) — zero-width segments under integration — and threshold pads are NaN,
+    so ``K = (~isnan(thresholds)).sum()`` is recoverable from the output alone.
+    """
+    n = preds.shape[0]
+    fps, tps, sk, run_boundary = _run_end_counts(preds, target, valid)
+    finite = sk != -jnp.inf  # exclude the invalid-row terminal run
+    boundary = run_boundary & finite
+    pos = tps[-1]
+    precision_all = tps.astype(jnp.float32) / jnp.maximum(tps + fps, 1)
+    # 0 positives yields recall 0 (the host path's 0/0 NaN is unusable anyway)
+    recall_all = tps.astype(jnp.float32) / jnp.maximum(pos, 1)
+
+    # flip to ascending thresholds, then front-pack the run-end points
+    fb = jnp.flip(boundary)
+    order = jnp.argsort(~fb, stable=True)
+    prec = jnp.take(jnp.flip(precision_all), order)
+    rec = jnp.take(jnp.flip(recall_all), order)
+    thr = jnp.take(jnp.flip(sk), order)
+    k = boundary.sum()
+    idx = jnp.arange(n)
+    one = jnp.ones((1,), jnp.float32)
+    zero = jnp.zeros((1,), jnp.float32)
+    precision = jnp.concatenate([jnp.where(idx < k, prec, 1.0), one])
+    recall = jnp.concatenate([jnp.where(idx < k, rec, 0.0), zero])
+    thresholds = jnp.where(idx < k, thr, jnp.nan)
+    return precision, recall, thresholds, k
+
+
+_binary_curve_padded_j = jax.jit(_binary_curve_padded_kernel)
+
+
+def binary_precision_recall_curve_padded(
+    preds: Array, target: Array
+) -> Tuple[Array, Array, Array, Array]:
+    """Exact (``thresholds=None``) PR curve fully on device with static shapes.
+
+    The TPU-first alternative to the reference's host-side exact mode
+    (``functional/classification/precision_recall_curve.py:28-80``): runs under
+    jit/shard_map/compute_from. ``target`` entries < 0 (ignore_index masks /
+    buffer padding) are excluded. Returns ``(precision, recall, thresholds,
+    valid_count)`` — see :func:`_binary_curve_padded_kernel` for the padding
+    contract.
+    """
+    preds, target, valid = _pad_binary(preds, target)
+    return _binary_curve_padded_j(preds, target, valid)
 
 
 def binary_auroc_exact(preds: Array, target: Array, max_fpr: Optional[float] = None) -> Array:

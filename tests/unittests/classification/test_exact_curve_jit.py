@@ -1,0 +1,129 @@
+"""Device-side padded exact-mode curves (VERDICT r2 item 7).
+
+``thresholds=None`` curve outputs are data-dependent on host; under jit the
+padded kernel emits static-shape (N+1,) arrays whose first K entries equal the
+reference curve, K recoverable as ``(~isnan(thresholds)).sum()``.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.classification import BinaryPrecisionRecallCurve
+from metrics_tpu.functional.classification import binary_precision_recall_curve
+from metrics_tpu.ops.clf_curve import binary_precision_recall_curve_padded
+
+_rng = np.random.RandomState(77)
+
+
+def _host_curve(preds, target):
+    return binary_precision_recall_curve(jnp.asarray(preds), jnp.asarray(target), thresholds=None)
+
+
+@pytest.mark.parametrize("n", [16, 100, 257])
+@pytest.mark.parametrize("ties", [False, True])
+def test_padded_kernel_matches_host_curve(n, ties):
+    preds = _rng.rand(n).astype(np.float32)
+    if ties:
+        preds = np.round(preds * 8) / 8  # force duplicate scores
+    target = (_rng.rand(n) > 0.4).astype(np.int32)
+
+    p_host, r_host, t_host = _host_curve(preds, target)
+    prec, rec, thr, k = jax.jit(binary_precision_recall_curve_padded)(jnp.asarray(preds), jnp.asarray(target))
+
+    k = int(k)
+    assert k == np.asarray(t_host).shape[0]
+    assert int(jnp.sum(~jnp.isnan(thr))) == k
+    np.testing.assert_allclose(np.asarray(prec)[:k], np.asarray(p_host)[:k], atol=1e-6)
+    np.testing.assert_allclose(np.asarray(rec)[:k], np.asarray(r_host)[:k], atol=1e-6)
+    np.testing.assert_allclose(np.asarray(thr)[:k], np.asarray(t_host), atol=1e-6)
+    # the K-th entry closes the curve exactly like the reference's appended point
+    assert float(prec[k]) == 1.0 and float(rec[k]) == 0.0
+    # pads are zero-width repeats of the final point
+    assert bool(jnp.all(prec[k:] == 1.0)) and bool(jnp.all(rec[k:] == 0.0))
+
+
+def test_padded_kernel_respects_ignore_mask():
+    preds = _rng.rand(64).astype(np.float32)
+    target = (_rng.rand(64) > 0.5).astype(np.int32)
+    target[::5] = -1  # masked rows
+    keep = target >= 0
+    p_host, r_host, t_host = _host_curve(preds[keep], target[keep])
+    prec, rec, thr, k = binary_precision_recall_curve_padded(jnp.asarray(preds), jnp.asarray(target))
+    k = int(k)
+    assert k == np.asarray(t_host).shape[0]
+    np.testing.assert_allclose(np.asarray(thr)[:k], np.asarray(t_host), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(prec)[:k], np.asarray(p_host)[:k], atol=1e-6)
+
+
+def test_exact_class_compute_from_under_jit():
+    """The VERDICT item's Done criterion: BinaryPrecisionRecallCurve with
+    thresholds=None computable INSIDE jit via fixed-capacity states."""
+    preds = _rng.rand(48).astype(np.float32)
+    target = (_rng.rand(48) > 0.5).astype(np.int32)
+
+    metric = BinaryPrecisionRecallCurve(thresholds=None, validate_args=False, cat_capacity=64)
+    state = jax.jit(metric.local_update)(metric.init_state(), jnp.asarray(preds), jnp.asarray(target))
+    prec, rec, thr = jax.jit(metric.compute_from)(state)
+
+    p_host, r_host, t_host = _host_curve(preds, target)
+    k = int(jnp.sum(~jnp.isnan(thr)))
+    assert k == np.asarray(t_host).shape[0]
+    np.testing.assert_allclose(np.asarray(prec)[:k], np.asarray(p_host)[:k], atol=1e-6)
+    np.testing.assert_allclose(np.asarray(rec)[:k], np.asarray(r_host)[:k], atol=1e-6)
+    np.testing.assert_allclose(np.asarray(thr)[:k], np.asarray(t_host), atol=1e-6)
+
+
+def test_exact_class_eager_path_unchanged():
+    """Eagerly the ragged host API is preserved (no padding in the output)."""
+    preds = _rng.rand(32).astype(np.float32)
+    target = (_rng.rand(32) > 0.5).astype(np.int32)
+    metric = BinaryPrecisionRecallCurve(thresholds=None)
+    metric.update(jnp.asarray(preds), jnp.asarray(target))
+    prec, rec, thr = metric.compute()
+    assert prec.shape[0] == thr.shape[0] + 1
+    assert not bool(jnp.any(jnp.isnan(thr)))
+
+
+def test_multiclass_exact_compute_from_under_jit():
+    from metrics_tpu.classification import MulticlassPrecisionRecallCurve
+    from metrics_tpu.functional.classification import multiclass_precision_recall_curve
+
+    preds = _rng.rand(48, 3).astype(np.float32)
+    preds = preds / preds.sum(-1, keepdims=True)
+    target = _rng.randint(0, 3, 48).astype(np.int32)
+
+    metric = MulticlassPrecisionRecallCurve(num_classes=3, thresholds=None, validate_args=False, cat_capacity=64)
+    state = jax.jit(metric.local_update)(metric.init_state(), jnp.asarray(preds), jnp.asarray(target))
+    prec, rec, thr = jax.jit(metric.compute_from)(state)
+
+    p_host, r_host, t_host = multiclass_precision_recall_curve(
+        jnp.asarray(preds), jnp.asarray(target), num_classes=3, thresholds=None
+    )
+    for c in range(3):
+        k = int(jnp.sum(~jnp.isnan(thr[c])))
+        assert k == np.asarray(t_host[c]).shape[0]
+        np.testing.assert_allclose(np.asarray(thr[c])[:k], np.asarray(t_host[c]), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(prec[c])[:k], np.asarray(p_host[c])[:k], atol=1e-6)
+
+
+def test_multilabel_exact_compute_from_under_jit():
+    from metrics_tpu.classification import MultilabelPrecisionRecallCurve
+    from metrics_tpu.functional.classification import multilabel_precision_recall_curve
+
+    preds = _rng.rand(48, 3).astype(np.float32)
+    target = (_rng.rand(48, 3) > 0.5).astype(np.int32)
+
+    metric = MultilabelPrecisionRecallCurve(num_labels=3, thresholds=None, validate_args=False, cat_capacity=64)
+    state = jax.jit(metric.local_update)(metric.init_state(), jnp.asarray(preds), jnp.asarray(target))
+    prec, rec, thr = jax.jit(metric.compute_from)(state)
+
+    p_host, r_host, t_host = multilabel_precision_recall_curve(
+        jnp.asarray(preds), jnp.asarray(target), num_labels=3, thresholds=None
+    )
+    for c in range(3):
+        k = int(jnp.sum(~jnp.isnan(thr[c])))
+        assert k == np.asarray(t_host[c]).shape[0]
+        np.testing.assert_allclose(np.asarray(thr[c])[:k], np.asarray(t_host[c]), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(prec[c])[:k], np.asarray(p_host[c])[:k], atol=1e-6)
