@@ -1,0 +1,73 @@
+"""Logging metrics inside a jitted flax/optax training step.
+
+The TPU-native replacement for the reference's Lightning integration
+(``docs/source/pages/lightning.rst`` / ``self.log(metric)``): metric state is an
+explicit pytree carried through the train step next to params/opt_state, so the
+whole step — forward, backward, optimizer, metric accumulation — is ONE compiled
+XLA program with no host synchronization per batch. Donate the metric state for
+in-place buffer reuse.
+
+Run: python examples/train_loop.py
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import flax.linen as nn
+import optax
+
+from metrics_tpu import MetricCollection
+from metrics_tpu.classification import MulticlassAccuracy, MulticlassF1Score
+
+NUM_CLASSES, BATCH, FEATURES = 4, 128, 16
+
+
+class MLP(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(NUM_CLASSES)(nn.relu(nn.Dense(32)(x)))
+
+
+def main() -> None:
+    model = MLP()
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, FEATURES)))
+    tx = optax.adam(1e-2)
+    opt_state = tx.init(params)
+
+    metrics = MetricCollection(
+        {
+            "acc": MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False),
+            "f1": MulticlassF1Score(num_classes=NUM_CLASSES, validate_args=False),
+        }
+    )
+
+    def train_step(params, opt_state, metric_state, x, y):
+        def loss_fn(p):
+            logits = model.apply(p, x)
+            return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean(), logits
+
+        (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        metric_state = metrics.local_update(metric_state, jax.nn.softmax(logits), y)
+        return params, opt_state, metric_state, loss
+
+    # donate the metric state: buffers update in place, no realloc
+    train_step_donated = jax.jit(train_step, donate_argnums=(2,))
+
+    rng = np.random.RandomState(0)
+    w = rng.randn(FEATURES, NUM_CLASSES).astype(np.float32)
+    for epoch in range(3):
+        metric_state = metrics.init_state()  # reset between epochs
+        for _ in range(20):
+            x = jnp.asarray(rng.randn(BATCH, FEATURES).astype(np.float32))
+            y = jnp.asarray((np.asarray(x) @ w).argmax(-1).astype(np.int32))
+            params, opt_state, metric_state, loss = train_step_donated(
+                params, opt_state, metric_state, x, y
+            )
+        results = metrics.compute_from(metric_state)
+        print(f"epoch {epoch}: loss={float(loss):.4f} " + " ".join(f"{k}={float(v):.4f}" for k, v in results.items()))
+
+
+if __name__ == "__main__":
+    main()

@@ -17,7 +17,6 @@ from metrics_tpu.functional.classification.auroc import (
     _multilabel_auroc_arg_validation,
     _multilabel_auroc_compute,
 )
-from metrics_tpu.utils.data import dim_zero_cat
 from metrics_tpu.utils.enums import ClassificationTask
 
 
@@ -55,7 +54,7 @@ class BinaryAUROC(BinaryPrecisionRecallCurve):
         self.validate_args = validate_args
 
     def compute(self) -> Array:
-        state = (dim_zero_cat(self.preds), dim_zero_cat(self.target)) if self.thresholds is None else self.confmat
+        state = self._curve_state()
         return _binary_auroc_compute(state, self.thresholds, self.max_fpr)
 
 
@@ -97,7 +96,7 @@ class MulticlassAUROC(MulticlassPrecisionRecallCurve):
         self.validate_args = validate_args
 
     def compute(self) -> Array:
-        state = (dim_zero_cat(self.preds), dim_zero_cat(self.target)) if self.thresholds is None else self.confmat
+        state = self._curve_state()
         return _multiclass_auroc_compute(state, self.num_classes, self.average, self.thresholds)
 
 
@@ -129,7 +128,7 @@ class MultilabelAUROC(MultilabelPrecisionRecallCurve):
         self.validate_args = validate_args
 
     def compute(self) -> Array:
-        state = (dim_zero_cat(self.preds), dim_zero_cat(self.target)) if self.thresholds is None else self.confmat
+        state = self._curve_state()
         return _multilabel_auroc_compute(state, self.num_labels, self.average, self.thresholds, self.ignore_index)
 
 

@@ -16,7 +16,6 @@ from metrics_tpu.functional.classification.average_precision import (
     _multilabel_average_precision_arg_validation,
     _multilabel_average_precision_compute,
 )
-from metrics_tpu.utils.data import dim_zero_cat
 from metrics_tpu.utils.enums import ClassificationTask
 
 
@@ -40,7 +39,7 @@ class BinaryAveragePrecision(BinaryPrecisionRecallCurve):
     plot_upper_bound: float = 1.0
 
     def compute(self) -> Array:
-        state = (dim_zero_cat(self.preds), dim_zero_cat(self.target)) if self.thresholds is None else self.confmat
+        state = self._curve_state()
         return _binary_average_precision_compute(state, self.thresholds)
 
 
@@ -72,7 +71,7 @@ class MulticlassAveragePrecision(MulticlassPrecisionRecallCurve):
         self.validate_args = validate_args
 
     def compute(self) -> Array:
-        state = (dim_zero_cat(self.preds), dim_zero_cat(self.target)) if self.thresholds is None else self.confmat
+        state = self._curve_state()
         return _multiclass_average_precision_compute(state, self.num_classes, self.average, self.thresholds)
 
 
@@ -104,7 +103,7 @@ class MultilabelAveragePrecision(MultilabelPrecisionRecallCurve):
         self.validate_args = validate_args
 
     def compute(self) -> Array:
-        state = (dim_zero_cat(self.preds), dim_zero_cat(self.target)) if self.thresholds is None else self.confmat
+        state = self._curve_state()
         return _multilabel_average_precision_compute(
             state, self.num_labels, self.average, self.thresholds, self.ignore_index
         )

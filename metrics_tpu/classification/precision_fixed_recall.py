@@ -17,7 +17,6 @@ from metrics_tpu.functional.classification.recall_fixed_precision import (
     _multilabel_recall_at_fixed_precision_arg_compute,
     _multilabel_recall_at_fixed_precision_arg_validation,
 )
-from metrics_tpu.utils.data import dim_zero_cat
 from metrics_tpu.utils.enums import ClassificationTask
 
 
@@ -55,7 +54,7 @@ class BinaryPrecisionAtFixedRecall(BinaryPrecisionRecallCurve):
         self.min_recall = min_recall
 
     def compute(self) -> Tuple[Array, Array]:  # type: ignore[override]
-        state = (dim_zero_cat(self.preds), dim_zero_cat(self.target)) if self.thresholds is None else self.confmat
+        state = self._curve_state()
         return _binary_recall_at_fixed_precision_compute(
             state, self.thresholds, self.min_recall, reduce_fn=_precision_at_recall
         )
@@ -89,7 +88,7 @@ class MulticlassPrecisionAtFixedRecall(MulticlassPrecisionRecallCurve):
         self.min_recall = min_recall
 
     def compute(self) -> Tuple[Array, Array]:  # type: ignore[override]
-        state = (dim_zero_cat(self.preds), dim_zero_cat(self.target)) if self.thresholds is None else self.confmat
+        state = self._curve_state()
         return _multiclass_recall_at_fixed_precision_arg_compute(
             state, self.num_classes, self.thresholds, self.min_recall, reduce_fn=_precision_at_recall
         )
@@ -123,7 +122,7 @@ class MultilabelPrecisionAtFixedRecall(MultilabelPrecisionRecallCurve):
         self.min_recall = min_recall
 
     def compute(self) -> Tuple[Array, Array]:  # type: ignore[override]
-        state = (dim_zero_cat(self.preds), dim_zero_cat(self.target)) if self.thresholds is None else self.confmat
+        state = self._curve_state()
         return _multilabel_recall_at_fixed_precision_arg_compute(
             state, self.num_labels, self.thresholds, self.ignore_index, self.min_recall, reduce_fn=_precision_at_recall
         )

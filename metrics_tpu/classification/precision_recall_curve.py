@@ -53,7 +53,15 @@ def _exact_cat_state(preds_state: Any, target_state: Any) -> Tuple[Array, Array]
 
 
 class _PrecisionRecallCurvePlotMixin:
-    """Shared curve plot for the three PR-curve tasks."""
+    """Shared curve plot + state accessor for the three PR-curve tasks."""
+
+    def _curve_state(self):
+        """Confusion tensor (binned) or dense (preds, target) exact state.
+
+        Shared by every curve-state subclass (ROC/AUROC/AP/fixed-point families);
+        jit-safe for fixed-capacity buffer states via :func:`_exact_cat_state`.
+        """
+        return _exact_cat_state(self.preds, self.target) if self.thresholds is None else self.confmat
 
     def plot(self, curve=None, score=None, ax=None):
         """Plot the precision-recall curve (reference: precision_recall_curve.py plot)."""
@@ -121,7 +129,7 @@ class BinaryPrecisionRecallCurve(_PrecisionRecallCurvePlotMixin, Metric):
             self.confmat = self.confmat + state
 
     def compute(self) -> Tuple[Array, Array, Array]:
-        state = _exact_cat_state(self.preds, self.target) if self.thresholds is None else self.confmat
+        state = self._curve_state()
         return _binary_precision_recall_curve_compute(state, self.thresholds)
 
 class MulticlassPrecisionRecallCurve(_PrecisionRecallCurvePlotMixin, Metric):
@@ -171,7 +179,7 @@ class MulticlassPrecisionRecallCurve(_PrecisionRecallCurvePlotMixin, Metric):
             self.confmat = self.confmat + state
 
     def compute(self) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
-        state = _exact_cat_state(self.preds, self.target) if self.thresholds is None else self.confmat
+        state = self._curve_state()
         return _multiclass_precision_recall_curve_compute(state, self.num_classes, self.thresholds)
 
 class MultilabelPrecisionRecallCurve(_PrecisionRecallCurvePlotMixin, Metric):
@@ -221,7 +229,7 @@ class MultilabelPrecisionRecallCurve(_PrecisionRecallCurvePlotMixin, Metric):
             self.confmat = self.confmat + state
 
     def compute(self) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
-        state = _exact_cat_state(self.preds, self.target) if self.thresholds is None else self.confmat
+        state = self._curve_state()
         return _multilabel_precision_recall_curve_compute(state, self.num_labels, self.thresholds, self.ignore_index)
 
 class PrecisionRecallCurve:

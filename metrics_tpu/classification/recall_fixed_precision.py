@@ -16,7 +16,6 @@ from metrics_tpu.functional.classification.recall_fixed_precision import (
     _multilabel_recall_at_fixed_precision_arg_compute,
     _multilabel_recall_at_fixed_precision_arg_validation,
 )
-from metrics_tpu.utils.data import dim_zero_cat
 from metrics_tpu.utils.enums import ClassificationTask
 
 
@@ -54,7 +53,7 @@ class BinaryRecallAtFixedPrecision(BinaryPrecisionRecallCurve):
         self.min_precision = min_precision
 
     def compute(self) -> Tuple[Array, Array]:  # type: ignore[override]
-        state = (dim_zero_cat(self.preds), dim_zero_cat(self.target)) if self.thresholds is None else self.confmat
+        state = self._curve_state()
         return _binary_recall_at_fixed_precision_compute(state, self.thresholds, self.min_precision)
 
 
@@ -86,7 +85,7 @@ class MulticlassRecallAtFixedPrecision(MulticlassPrecisionRecallCurve):
         self.min_precision = min_precision
 
     def compute(self) -> Tuple[Array, Array]:  # type: ignore[override]
-        state = (dim_zero_cat(self.preds), dim_zero_cat(self.target)) if self.thresholds is None else self.confmat
+        state = self._curve_state()
         return _multiclass_recall_at_fixed_precision_arg_compute(
             state, self.num_classes, self.thresholds, self.min_precision
         )
@@ -120,7 +119,7 @@ class MultilabelRecallAtFixedPrecision(MultilabelPrecisionRecallCurve):
         self.min_precision = min_precision
 
     def compute(self) -> Tuple[Array, Array]:  # type: ignore[override]
-        state = (dim_zero_cat(self.preds), dim_zero_cat(self.target)) if self.thresholds is None else self.confmat
+        state = self._curve_state()
         return _multilabel_recall_at_fixed_precision_arg_compute(
             state, self.num_labels, self.thresholds, self.ignore_index, self.min_precision
         )

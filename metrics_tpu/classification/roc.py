@@ -15,7 +15,6 @@ from metrics_tpu.functional.classification.roc import (
     _multiclass_roc_compute,
     _multilabel_roc_compute,
 )
-from metrics_tpu.utils.data import dim_zero_cat
 from metrics_tpu.utils.enums import ClassificationTask
 
 
@@ -53,7 +52,7 @@ class BinaryROC(_ROCPlotMixin, BinaryPrecisionRecallCurve):
     full_state_update: bool = False
 
     def compute(self) -> Tuple[Array, Array, Array]:
-        state = (dim_zero_cat(self.preds), dim_zero_cat(self.target)) if self.thresholds is None else self.confmat
+        state = self._curve_state()
         return _binary_roc_compute(state, self.thresholds)
 
 class MulticlassROC(_ROCPlotMixin, MulticlassPrecisionRecallCurve):
@@ -64,7 +63,7 @@ class MulticlassROC(_ROCPlotMixin, MulticlassPrecisionRecallCurve):
     full_state_update: bool = False
 
     def compute(self) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
-        state = (dim_zero_cat(self.preds), dim_zero_cat(self.target)) if self.thresholds is None else self.confmat
+        state = self._curve_state()
         return _multiclass_roc_compute(state, self.num_classes, self.thresholds)
 
 class MultilabelROC(_ROCPlotMixin, MultilabelPrecisionRecallCurve):
@@ -75,7 +74,7 @@ class MultilabelROC(_ROCPlotMixin, MultilabelPrecisionRecallCurve):
     full_state_update: bool = False
 
     def compute(self) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
-        state = (dim_zero_cat(self.preds), dim_zero_cat(self.target)) if self.thresholds is None else self.confmat
+        state = self._curve_state()
         return _multilabel_roc_compute(state, self.num_labels, self.thresholds, self.ignore_index)
 
 class ROC:

@@ -16,7 +16,6 @@ from metrics_tpu.functional.classification.specificity_sensitivity import (
     _multilabel_specificity_at_sensitivity_arg_validation,
     _multilabel_specificity_at_sensitivity_compute,
 )
-from metrics_tpu.utils.data import dim_zero_cat
 from metrics_tpu.utils.enums import ClassificationTask
 
 
@@ -52,7 +51,7 @@ class BinarySpecificityAtSensitivity(BinaryPrecisionRecallCurve):
         self.min_sensitivity = min_sensitivity
 
     def compute(self) -> Tuple[Array, Array]:  # type: ignore[override]
-        state = (dim_zero_cat(self.preds), dim_zero_cat(self.target)) if self.thresholds is None else self.confmat
+        state = self._curve_state()
         return _binary_specificity_at_sensitivity_compute(state, self.thresholds, self.min_sensitivity)
 
 
@@ -84,7 +83,7 @@ class MulticlassSpecificityAtSensitivity(MulticlassPrecisionRecallCurve):
         self.min_sensitivity = min_sensitivity
 
     def compute(self) -> Tuple[Array, Array]:  # type: ignore[override]
-        state = (dim_zero_cat(self.preds), dim_zero_cat(self.target)) if self.thresholds is None else self.confmat
+        state = self._curve_state()
         return _multiclass_specificity_at_sensitivity_compute(
             state, self.num_classes, self.thresholds, self.min_sensitivity
         )
@@ -116,7 +115,7 @@ class MultilabelSpecificityAtSensitivity(MultilabelPrecisionRecallCurve):
         self.min_sensitivity = min_sensitivity
 
     def compute(self) -> Tuple[Array, Array]:  # type: ignore[override]
-        state = (dim_zero_cat(self.preds), dim_zero_cat(self.target)) if self.thresholds is None else self.confmat
+        state = self._curve_state()
         return _multilabel_specificity_at_sensitivity_compute(
             state, self.num_labels, self.thresholds, self.ignore_index, self.min_sensitivity
         )

@@ -167,6 +167,40 @@ class MetricCollection:
         res = _flatten_dict(res)
         return {self._set_name(k): v for k, v in res.items()}
 
+    # ------------------------------------------------------- pure-functional tier
+
+    def init_state(self) -> Dict[str, Dict[str, Any]]:
+        """Per-metric state pytrees keyed by base name.
+
+        The collection analogue of ``Metric.init_state``: carry the returned dict
+        through a jitted/donated training step via :meth:`local_update` and read
+        results with :meth:`compute_from` (see tests/integrations/test_train_loop.py).
+        Each metric owns its state — the eager tier's compute-group state aliasing
+        is a host-side optimization XLA performs itself via CSE on the traced update.
+        """
+        return {k: m.init_state() for k, m in self.items(keep_base=True, copy_state=False)}
+
+    def local_update(self, state: Dict[str, Dict[str, Any]], *args: Any, **kwargs: Any) -> Dict[str, Dict[str, Any]]:
+        """Pure state transition for every metric (kwargs filtered per metric)."""
+        return {
+            k: m.local_update(state[k], *args, **m._filter_kwargs(**kwargs))
+            for k, m in self.items(keep_base=True, copy_state=False)
+        }
+
+    def sync_state(self, state: Dict[str, Dict[str, Any]], axis_name: Optional[Any] = None) -> Dict[str, Dict[str, Any]]:
+        """Sync every metric's state pytree over a mesh axis (inside shard_map)."""
+        return {k: m.sync_state(state[k], axis_name) for k, m in self.items(keep_base=True, copy_state=False)}
+
+    def compute_from(self, state: Dict[str, Dict[str, Any]], axis_name: Optional[Any] = None) -> Dict[str, Any]:
+        """Pure compute of the renamed result dict from a state produced by
+        :meth:`local_update`."""
+        res = {
+            k: m.compute_from(state[k], axis_name)
+            for k, m in self.items(keep_base=True, copy_state=False)
+        }
+        res = _flatten_dict(res)
+        return {self._set_name(k): v for k, v in res.items()}
+
     def reset(self) -> None:
         for _, m in self.items(keep_base=True, copy_state=False):
             m.reset()

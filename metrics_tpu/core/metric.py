@@ -239,13 +239,18 @@ class Metric(ABC):
     # ------------------------------------------------- pure-functional tier
 
     def init_state(self) -> Dict[str, Any]:
-        """Default state pytree — pure, no mutation of ``self``."""
+        """Default state pytree — pure, no mutation of ``self``.
+
+        Leaves are fresh buffers (not views of ``_defaults``): the returned state
+        is safe to donate to a jitted step (``donate_argnums``) without deleting
+        the metric's default arrays.
+        """
         out: Dict[str, Any] = {}
         for name, default in self._defaults.items():
             if isinstance(default, CatBuffer):
-                out[name] = default.copy()
+                out[name] = default.deep_copy()
             else:
-                out[name] = [] if isinstance(default, list) else jnp.asarray(default)
+                out[name] = [] if isinstance(default, list) else jnp.asarray(default).copy()
         return out
 
     def local_update(self, state: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:

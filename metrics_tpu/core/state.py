@@ -93,6 +93,11 @@ class CatBuffer:
         """New holder over the same (immutable) arrays — append rebinds, never writes."""
         return CatBuffer(self.data, self.count, self.overflow)
 
+    def deep_copy(self) -> "CatBuffer":
+        """Fresh buffers for every field — safe to donate without invalidating
+        the source (keeps the donation-safety invariant in one place)."""
+        return CatBuffer(self.data.copy(), self.count.copy(), self.overflow.copy())
+
     def __len__(self) -> int:  # eager only
         return int(self.valid_count())
 

@@ -343,6 +343,18 @@ def _multiclass_precision_recall_curve_compute(
         recall = jnp.concatenate([recall, jnp.zeros((1, num_classes), dtype=recall.dtype)])
         return precision.T, recall.T, thresholds
 
+    if not _is_concrete(state[0]) or not _is_concrete(state[1]):
+        # jit: ONE batched sort pipeline over the class axis instead of C traced
+        # kernels (vmap of the padded device curve, see ops/clf_curve.py)
+        from metrics_tpu.ops.clf_curve import binary_precision_recall_curve_padded
+
+        def one_class(preds_c: Array, c: Array) -> Tuple[Array, Array, Array, Array]:
+            target_c = jnp.where(state[1] >= 0, (state[1] == c).astype(jnp.int32), -1)
+            return binary_precision_recall_curve_padded(preds_c, target_c)
+
+        prec, rec, thr, _ = jax.vmap(one_class, in_axes=(1, 0))(state[0], jnp.arange(num_classes))
+        return prec, rec, thr
+
     precision, recall, thresholds_out = [], [], []
     for i in range(num_classes):
         res = _binary_precision_recall_curve_compute((state[0][:, i], state[1]), thresholds=None, pos_label=i)
@@ -450,22 +462,20 @@ def _multilabel_precision_recall_curve_compute(
         recall = jnp.concatenate([recall, jnp.zeros((1, num_labels), dtype=recall.dtype)])
         return precision.T, recall.T, thresholds
 
-    tracer_mode = not _is_concrete(state[0]) or not _is_concrete(state[1])
+    if not _is_concrete(state[0]) or not _is_concrete(state[1]):
+        # jit: one vmapped padded kernel over labels; it masks target<0 itself
+        # (both ignore_index positions and buffer padding carry -1)
+        from metrics_tpu.ops.clf_curve import binary_precision_recall_curve_padded
+
+        prec, rec, thr, _ = jax.vmap(binary_precision_recall_curve_padded, in_axes=(1, 1))(state[0], state[1])
+        return prec, rec, thr
+
     precision, recall, thresholds_out = [], [], []
     for i in range(num_labels):
-        if tracer_mode:
-            # jit path: the binary padded kernel masks target<0 itself (both
-            # ignore_index positions and buffer padding carry -1)
-            preds_i, target_i = state[0][:, i], state[1][:, i]
-        else:
-            preds_i = np.asarray(state[0][:, i])
-            target_i = np.asarray(state[1][:, i])
-            if ignore_index is not None:
-                # format already masked ignored positions to -1
-                idx = target_i < 0
-                preds_i = preds_i[~idx]
-                target_i = target_i[~idx]
-        res = _binary_precision_recall_curve_compute((preds_i, target_i), thresholds=None, pos_label=1)
+        # target<0 rows (ignore_index masks) are dropped by the callee's host path
+        res = _binary_precision_recall_curve_compute(
+            (np.asarray(state[0][:, i]), np.asarray(state[1][:, i])), thresholds=None, pos_label=1
+        )
         precision.append(res[0])
         recall.append(res[1])
         thresholds_out.append(res[2])

@@ -1,0 +1,177 @@
+"""Training-loop integration (VERDICT r2 item 10; reference analogue:
+tests/integrations/test_lightning.py:41-344).
+
+A flax/optax training loop logs a MetricCollection INSIDE the jitted train step:
+metric state is an explicit pytree carried (and donated) through the step
+alongside params/opt_state — the TPU-native replacement for Lightning's
+``self.log(metric)`` pattern. Asserts:
+
+- metrics accumulated inside the jitted step equal an eager recomputation over
+  the epoch's predictions,
+- donation works (state buffers reused, no aliasing error),
+- reset-between-epochs == fresh init_state,
+- the loss actually decreases (the loop trains).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+flax = pytest.importorskip("flax")
+optax = pytest.importorskip("optax")
+import flax.linen as nn
+
+from metrics_tpu import MetricCollection
+from metrics_tpu.classification import MulticlassAccuracy, MulticlassF1Score
+from metrics_tpu.regression import MeanSquaredError
+
+NUM_CLASSES = 4
+BATCH = 32
+FEATURES = 8
+STEPS_PER_EPOCH = 5
+
+
+class MLP(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Dense(16)(x)
+        x = nn.relu(x)
+        return nn.Dense(NUM_CLASSES)(x)
+
+
+def _make_data(seed):
+    rng = np.random.RandomState(seed)
+    xs = rng.randn(STEPS_PER_EPOCH, BATCH, FEATURES).astype(np.float32)
+    w = rng.randn(FEATURES, NUM_CLASSES).astype(np.float32)
+    ys = (xs @ w).argmax(-1).astype(np.int32)
+    return jnp.asarray(xs), jnp.asarray(ys)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    model = MLP()
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, FEATURES)))
+    tx = optax.adam(1e-2)
+    opt_state = tx.init(params)
+    metrics = MetricCollection(
+        {
+            "acc": MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False),
+            "f1": MulticlassF1Score(num_classes=NUM_CLASSES, validate_args=False),
+        }
+    )
+    return model, params, tx, opt_state, metrics
+
+
+def test_metrics_inside_jitted_train_step(setup):
+    model, params, tx, opt_state, metrics = setup
+    xs, ys = _make_data(0)
+
+    @jax.jit
+    def train_step(params, opt_state, metric_state, x, y):
+        def loss_fn(p):
+            logits = model.apply(p, x)
+            return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean(), logits
+
+        (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        metric_state = metrics.local_update(metric_state, jax.nn.softmax(logits), y)
+        return params, opt_state, metric_state, loss
+
+    metric_state = metrics.init_state()
+    losses, all_logits = [], []
+    p = params
+    for i in range(STEPS_PER_EPOCH):
+        p_prev = p
+        p, opt_state, metric_state, loss = train_step(p, opt_state, metric_state, xs[i], ys[i])
+        # logits the step actually scored with (pre-update params)
+        all_logits.append(np.asarray(model.apply(p_prev, xs[i])))
+        losses.append(float(loss))
+
+    results = metrics.compute_from(metric_state)
+    assert set(results) == {"acc", "f1"}
+
+    # oracle: eager accumulation over the same per-step predictions
+    eager = MetricCollection(
+        {
+            "acc": MulticlassAccuracy(num_classes=NUM_CLASSES),
+            "f1": MulticlassF1Score(num_classes=NUM_CLASSES),
+        }
+    )
+    for i in range(STEPS_PER_EPOCH):
+        eager.update(jax.nn.softmax(jnp.asarray(all_logits[i])), ys[i])
+    expected = eager.compute()
+    for k in results:
+        assert float(results[k]) == pytest.approx(float(expected[k]), abs=1e-6), k
+
+    assert losses[-1] < losses[0], "training loop failed to reduce the loss"
+
+
+def test_donated_metric_state(setup):
+    """Donating the metric state compiles and runs (buffer reuse, no realloc)."""
+    model, params, tx, opt_state, metrics = setup
+    xs, ys = _make_data(1)
+
+    @jax.jit
+    def step(metric_state, x, y):
+        logits = model.apply(params, x)
+        return metrics.local_update(metric_state, jax.nn.softmax(logits), y)
+
+    donating = jax.jit(
+        lambda ms, x, y: metrics.local_update(ms, jax.nn.softmax(model.apply(params, x)), y),
+        donate_argnums=(0,),
+    )
+    plain_state = metrics.init_state()
+    for i in range(STEPS_PER_EPOCH):
+        plain_state = step(plain_state, xs[i], ys[i])
+
+    donated_state = metrics.init_state()
+    for i in range(STEPS_PER_EPOCH):
+        donated_state = donating(donated_state, xs[i], ys[i])
+
+    r0 = metrics.compute_from(plain_state)
+    r1 = metrics.compute_from(donated_state)
+    for k in r0:
+        assert float(r0[k]) == pytest.approx(float(r1[k]), abs=1e-7)
+
+
+def test_reset_between_epochs_equals_fresh_state(setup):
+    model, params, tx, opt_state, metrics = setup
+    xs, ys = _make_data(2)
+
+    @jax.jit
+    def step(metric_state, x, y):
+        logits = model.apply(params, x)
+        return metrics.local_update(metric_state, jax.nn.softmax(logits), y)
+
+    # epoch 1 accumulates garbage; epoch 2 restarts from init_state
+    state = metrics.init_state()
+    for i in range(STEPS_PER_EPOCH):
+        state = step(state, xs[i], ys[i])
+    state = metrics.init_state()  # "reset"
+    state = step(state, xs[0], ys[0])
+
+    fresh = metrics.init_state()
+    fresh = step(fresh, xs[0], ys[0])
+    r0, r1 = metrics.compute_from(state), metrics.compute_from(fresh)
+    for k in r0:
+        assert float(r0[k]) == float(r1[k])
+
+
+def test_collection_pure_tier_filters_kwargs():
+    """Heterogeneous collections filter kwargs per metric in the pure tier too."""
+    coll = MetricCollection(
+        {
+            "acc": MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False),
+            "mse": MeanSquaredError(),
+        }
+    )
+    rng = np.random.RandomState(0)
+    preds_labels = jnp.asarray(rng.randint(0, NUM_CLASSES, 16))
+    target = jnp.asarray(rng.randint(0, NUM_CLASSES, 16))
+    state = coll.init_state()
+    state = coll.local_update(state, preds_labels, target)
+    res = coll.compute_from(state)
+    assert set(res) == {"acc", "mse"}
+    assert np.isfinite(float(res["acc"])) and np.isfinite(float(res["mse"]))
