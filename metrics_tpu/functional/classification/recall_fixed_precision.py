@@ -127,9 +127,13 @@ def _multiclass_recall_at_fixed_precision_arg_compute(
 ) -> Tuple[Array, Array]:
     """Reference: recall_fixed_precision.py:169-183."""
     precision, recall, thresholds = _multiclass_precision_recall_curve_compute(state, num_classes, thresholds)
-    if not isinstance(precision, list):
+    if not isinstance(precision, list) and getattr(thresholds, "ndim", 1) != 2:
+        # binned: one shared 1-D threshold grid for every class
         res = [reduce_fn(p, r, thresholds, min_precision) for p, r in zip(precision, recall)]
     else:
+        # exact: per-class threshold rows — lists eagerly, stacked 2-D when the
+        # curve came from the jit path (the reduce itself is host-side numpy, so
+        # fixed-point metrics stay eager-only; the guard keeps rows paired right)
         res = [reduce_fn(p, r, t, min_precision) for p, r, t in zip(precision, recall, thresholds)]
     return jnp.stack([r[0] for r in res]), jnp.stack([r[1] for r in res])
 
@@ -179,9 +183,13 @@ def _multilabel_recall_at_fixed_precision_arg_compute(
     precision, recall, thresholds = _multilabel_precision_recall_curve_compute(
         state, num_labels, thresholds, ignore_index
     )
-    if not isinstance(precision, list):
+    if not isinstance(precision, list) and getattr(thresholds, "ndim", 1) != 2:
+        # binned: one shared 1-D threshold grid for every class
         res = [reduce_fn(p, r, thresholds, min_precision) for p, r in zip(precision, recall)]
     else:
+        # exact: per-class threshold rows — lists eagerly, stacked 2-D when the
+        # curve came from the jit path (the reduce itself is host-side numpy, so
+        # fixed-point metrics stay eager-only; the guard keeps rows paired right)
         res = [reduce_fn(p, r, t, min_precision) for p, r, t in zip(precision, recall, thresholds)]
     return jnp.stack([r[0] for r in res]), jnp.stack([r[1] for r in res])
 

@@ -24,7 +24,6 @@ import flax.linen as nn
 
 from metrics_tpu import MetricCollection
 from metrics_tpu.classification import MulticlassAccuracy, MulticlassF1Score
-from metrics_tpu.regression import MeanSquaredError
 
 NUM_CLASSES = 4
 BATCH = 32
@@ -113,15 +112,12 @@ def test_donated_metric_state(setup):
     model, params, tx, opt_state, metrics = setup
     xs, ys = _make_data(1)
 
-    @jax.jit
-    def step(metric_state, x, y):
+    def step_fn(metric_state, x, y):
         logits = model.apply(params, x)
         return metrics.local_update(metric_state, jax.nn.softmax(logits), y)
 
-    donating = jax.jit(
-        lambda ms, x, y: metrics.local_update(ms, jax.nn.softmax(model.apply(params, x)), y),
-        donate_argnums=(0,),
-    )
+    step = jax.jit(step_fn)
+    donating = jax.jit(step_fn, donate_argnums=(0,))
     plain_state = metrics.init_state()
     for i in range(STEPS_PER_EPOCH):
         plain_state = step(plain_state, xs[i], ys[i])
@@ -161,17 +157,22 @@ def test_reset_between_epochs_equals_fresh_state(setup):
 
 def test_collection_pure_tier_filters_kwargs():
     """Heterogeneous collections filter kwargs per metric in the pure tier too."""
+    from metrics_tpu.retrieval import RetrievalMAP
+
     coll = MetricCollection(
         {
             "acc": MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False),
-            "mse": MeanSquaredError(),
+            "rmap": RetrievalMAP(cat_capacity=16, validate_args=False),
         }
     )
     rng = np.random.RandomState(0)
-    preds_labels = jnp.asarray(rng.randint(0, NUM_CLASSES, 16))
-    target = jnp.asarray(rng.randint(0, NUM_CLASSES, 16))
+    preds = jnp.asarray(rng.rand(16).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, 2, 16))
+    indexes = jnp.asarray(rng.randint(0, 4, 16))
     state = coll.init_state()
-    state = coll.local_update(state, preds_labels, target)
+    # `indexes` must reach ONLY RetrievalMAP — MulticlassAccuracy.update would
+    # reject it, so this fails if per-metric kwarg filtering is dropped
+    state = coll.local_update(state, preds, target, indexes=indexes)
     res = coll.compute_from(state)
-    assert set(res) == {"acc", "mse"}
-    assert np.isfinite(float(res["acc"])) and np.isfinite(float(res["mse"]))
+    assert set(res) == {"acc", "rmap"}
+    assert np.isfinite(float(res["acc"])) and np.isfinite(float(res["rmap"]))
