@@ -1,0 +1,38 @@
+"""Sphinx configuration for the metrics_tpu documentation site.
+
+Build: ``pip install sphinx furo && make -C docs html``
+(reference analogue: docs/source/conf.py of the upstream library).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath("../.."))
+
+project = "metrics_tpu"
+author = "metrics_tpu contributors"
+copyright = "2026, metrics_tpu contributors"
+
+extensions = [
+    "sphinx.ext.autodoc",
+    "sphinx.ext.autosummary",
+    "sphinx.ext.napoleon",
+    "sphinx.ext.viewcode",
+    "sphinx.ext.intersphinx",
+]
+
+autosummary_generate = True
+autodoc_member_order = "bysource"
+autodoc_typehints = "description"
+napoleon_google_docstring = True
+
+intersphinx_mapping = {
+    "python": ("https://docs.python.org/3", None),
+    "jax": ("https://docs.jax.dev/en/latest", None),
+    "numpy": ("https://numpy.org/doc/stable", None),
+}
+
+templates_path = ["_templates"]
+exclude_patterns = []
+
+html_theme = os.environ.get("METRICS_TPU_DOCS_THEME", "alabaster")
+html_static_path = []

@@ -43,6 +43,7 @@ from metrics_tpu.core.state import CatBuffer, cat_merge
 from metrics_tpu.parallel import collective
 from metrics_tpu.utils.checks import _is_concrete
 from metrics_tpu.utils.data import (
+    ARRAY_TYPES,
     _flatten,
     _squeeze_if_scalar,
     apply_to_collection,
@@ -50,6 +51,7 @@ from metrics_tpu.utils.data import (
     dim_zero_max,
     dim_zero_mean,
     dim_zero_min,
+    is_array,
     dim_zero_sum,
 )
 from metrics_tpu.utils.exceptions import MetricsUserError, MetricsUserWarning
@@ -324,7 +326,7 @@ class Metric(ABC):
         over = functools.reduce(jnp.logical_or, flags)
 
         def poison(x):
-            if isinstance(x, (jnp.ndarray, np.ndarray)) and jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating):
+            if is_array(x) and jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating):
                 return jnp.where(over, jnp.nan, x)
             return x
 
@@ -493,7 +495,7 @@ class Metric(ABC):
                     reduced = cat_merge(global_state, local_state)
                 else:
                     reduced = list(global_state) + list(local_state)
-            elif reduce_fn is None and isinstance(global_state, (jnp.ndarray, np.ndarray)):
+            elif reduce_fn is None and is_array(global_state):
                 reduced = jnp.stack([jnp.asarray(global_state), jnp.asarray(local_state)])
             elif reduce_fn is None and isinstance(global_state, list):
                 reduced = _flatten([global_state, local_state])
@@ -526,7 +528,7 @@ class Metric(ABC):
 
         output_dict = apply_to_collection(
             input_dict,
-            (jnp.ndarray, np.ndarray),
+            ARRAY_TYPES,
             dist_sync_fn,
             group=process_group or self.process_group,
         )
@@ -536,7 +538,7 @@ class Metric(ABC):
                 setattr(self, attr, [])
                 continue
 
-            if isinstance(output_dict[attr][0], (jnp.ndarray, np.ndarray)):
+            if is_array(output_dict[attr][0]):
                 output_dict[attr] = jnp.stack([jnp.asarray(o) for o in output_dict[attr]])
             elif isinstance(output_dict[attr][0], list):
                 output_dict[attr] = _flatten(output_dict[attr])

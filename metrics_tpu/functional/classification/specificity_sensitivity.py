@@ -121,7 +121,7 @@ def _multiclass_specificity_at_sensitivity_compute(
 ) -> Tuple[Array, Array]:
     """Reference: specificity_sensitivity.py:184-201."""
     fpr, sensitivity, thresholds = _multiclass_roc_compute(state, num_classes, thresholds)
-    if isinstance(fpr, list) or getattr(thresholds, "ndim", 1) == 2:
+    if isinstance(fpr, list):
         specificity = [_convert_fpr_to_specificity(f) for f in fpr]
         res = [
             _specificity_at_sensitivity(sp, sn, t, min_sensitivity)
@@ -178,7 +178,7 @@ def _multilabel_specificity_at_sensitivity_compute(
 ) -> Tuple[Array, Array]:
     """Reference: specificity_sensitivity.py:302-320."""
     fpr, sensitivity, thresholds = _multilabel_roc_compute(state, num_labels, thresholds, ignore_index)
-    if isinstance(fpr, list) or getattr(thresholds, "ndim", 1) == 2:
+    if isinstance(fpr, list):
         specificity = [_convert_fpr_to_specificity(f) for f in fpr]
         res = [
             _specificity_at_sensitivity(sp, sn, t, min_sensitivity)

@@ -231,6 +231,18 @@ def _squeeze_if_scalar(data: Any) -> Any:
     return apply_to_collection(data, (jnp.ndarray, np.ndarray), _squeeze_scalar_element_tensor)
 
 
+# Every array-like a state may legally hold: jax arrays, numpy arrays, and
+# numpy scalars (np.generic covers np.float32(…) etc., which plain
+# ``isinstance(x, np.ndarray)`` misses — a subclass assigning one to a state
+# would silently skip dist-sync otherwise).
+ARRAY_TYPES = (jnp.ndarray, np.ndarray, np.generic)
+
+
+def is_array(x: Any) -> bool:
+    """True for any array-like a metric state may hold (see ``ARRAY_TYPES``)."""
+    return isinstance(x, ARRAY_TYPES)
+
+
 def apply_to_collection(
     data: Any,
     dtype: Union[type, tuple],
