@@ -1,0 +1,195 @@
+"""Pure-JAX BERT/RoBERTa encoder for BERTScore.
+
+The reference runs HF ``AutoModel`` (torch) forwards inside the metric
+(``text/bert.py:55``, ``functional/text/helper_embedding_metric.py``). This port
+re-implements the transformer encoder in jnp so the embedding forward jit-compiles
+onto the TPU: token/position/type embeddings + post-LayerNorm self-attention
+blocks, parameterized directly from a HF ``BertModel``/``RobertaModel``
+state_dict (``.pth``/``.bin``/``.npz`` via ``models/_io.py``, or converted with
+``scripts/convert_weights.py state-dict``).
+
+Tokenization stays on host (HF tokenizers are rust/python, not torch); only the
+dense forward runs on device. Differentially tested against the real HF torch
+module with random weights (tests/unittests/text/test_bert_jax_port.py).
+"""
+from functools import partial
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+# attention bias for masked positions; matches HF's additive mask magnitude
+_NEG = -1e9
+
+
+def params_from_state_dict(state: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    """HF BertModel/RobertaModel state_dict -> nested JAX param pytree.
+
+    Accepts either bare keys (``embeddings.word_embeddings.weight``) or keys
+    prefixed with ``bert.``/``roberta.`` (full checkpoint files).
+    """
+    # strip a model prefix if present
+    for prefix in ("bert.", "roberta.", "model."):
+        if any(k.startswith(prefix + "embeddings.") for k in state):
+            state = {k[len(prefix):]: v for k, v in state.items() if k.startswith(prefix)}
+            break
+
+    def g(name):
+        return jnp.asarray(np.asarray(state[name]))
+
+    p: Dict[str, Any] = {
+        "word_emb": g("embeddings.word_embeddings.weight"),
+        "pos_emb": g("embeddings.position_embeddings.weight"),
+        "type_emb": g("embeddings.token_type_embeddings.weight"),
+        "emb_ln": (g("embeddings.LayerNorm.weight"), g("embeddings.LayerNorm.bias")),
+        "layers": [],
+    }
+    i = 0
+    while f"encoder.layer.{i}.attention.self.query.weight" in state:
+        base = f"encoder.layer.{i}."
+        p["layers"].append(
+            {
+                # torch Linear stores (out, in); transpose once at load
+                "q": (g(base + "attention.self.query.weight").T, g(base + "attention.self.query.bias")),
+                "k": (g(base + "attention.self.key.weight").T, g(base + "attention.self.key.bias")),
+                "v": (g(base + "attention.self.value.weight").T, g(base + "attention.self.value.bias")),
+                "attn_out": (g(base + "attention.output.dense.weight").T, g(base + "attention.output.dense.bias")),
+                "attn_ln": (g(base + "attention.output.LayerNorm.weight"), g(base + "attention.output.LayerNorm.bias")),
+                "ffn_in": (g(base + "intermediate.dense.weight").T, g(base + "intermediate.dense.bias")),
+                "ffn_out": (g(base + "output.dense.weight").T, g(base + "output.dense.bias")),
+                "ffn_ln": (g(base + "output.LayerNorm.weight"), g(base + "output.LayerNorm.bias")),
+            }
+        )
+        i += 1
+    if not p["layers"]:
+        raise ValueError("state_dict contains no `encoder.layer.*` keys — not a BERT-family checkpoint")
+    return p
+
+
+def _layer_norm(x: Array, weight: Array, bias: Array, eps: float) -> Array:
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * weight + bias
+
+
+def _linear(x: Array, wb: Tuple[Array, Array]) -> Array:
+    return x @ wb[0] + wb[1]
+
+
+def _self_attention(x: Array, layer: Dict[str, Any], mask_bias: Array, num_heads: int) -> Array:
+    b, s, d = x.shape
+    dh = d // num_heads
+
+    def heads(t):
+        return t.reshape(b, s, num_heads, dh).transpose(0, 2, 1, 3)  # (B, H, S, dh)
+
+    q, k, v = heads(_linear(x, layer["q"])), heads(_linear(x, layer["k"])), heads(_linear(x, layer["v"]))
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(jnp.float32(dh))
+    probs = jax.nn.softmax(scores + mask_bias, axis=-1)
+    ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, v).transpose(0, 2, 1, 3).reshape(b, s, d)
+    return _linear(ctx, layer["attn_out"])
+
+
+@partial(jax.jit, static_argnames=("num_heads", "eps"))
+def bert_forward(
+    params: Dict[str, Any],
+    input_ids: Array,
+    attention_mask: Array,
+    position_ids: Array,
+    num_heads: int,
+    eps: float = 1e-12,
+) -> Array:
+    """Last hidden state of a BERT-family encoder (post-LN blocks, exact gelu)."""
+    x = (
+        params["word_emb"][input_ids]
+        + params["pos_emb"][position_ids]
+        + params["type_emb"][jnp.zeros_like(input_ids)]
+    )
+    x = _layer_norm(x, *params["emb_ln"], eps=eps)
+
+    # additive key-side padding mask, broadcast over heads and query positions
+    mask_bias = jnp.where(attention_mask[:, None, None, :] > 0, 0.0, _NEG)
+
+    for layer in params["layers"]:
+        attn = _self_attention(x, layer, mask_bias, num_heads)
+        x = _layer_norm(x + attn, *layer["attn_ln"], eps=eps)
+        ffn = _linear(jax.nn.gelu(_linear(x, layer["ffn_in"]), approximate=False), layer["ffn_out"])
+        x = _layer_norm(x + ffn, *layer["ffn_ln"], eps=eps)
+    return x
+
+
+def bert_position_ids(attention_mask: np.ndarray, variant: str, padding_idx: int = 1) -> np.ndarray:
+    """Position ids: sequential for BERT; RoBERTa offsets past its padding index
+    and freezes pad positions at ``padding_idx`` (HF create_position_ids_from_input_ids)."""
+    if variant == "roberta":
+        mask = attention_mask.astype(np.int64)
+        return np.cumsum(mask, axis=1) * mask + padding_idx
+    return np.broadcast_to(np.arange(attention_mask.shape[1]), attention_mask.shape)
+
+
+def infer_num_heads(hidden_size: int) -> int:
+    """Standard BERT head counts by width (64-dim heads)."""
+    if hidden_size % 64 == 0:
+        return hidden_size // 64
+    raise ValueError(f"Cannot infer head count for hidden size {hidden_size}; pass num_heads explicitly")
+
+
+def pad_token_batch(ids: np.ndarray, mask: np.ndarray, pad_id: int, floor: int = 8) -> Tuple[np.ndarray, np.ndarray]:
+    """Pad the sequence axis to the next power of two (bounded jit recompiles).
+
+    Pad-to-longest tokenization gives every batch a distinct (B, S) shape, which
+    would re-trace the jitted forward per batch; pow2 bucketing caps the cache at
+    log2(max_length) entries. Padded positions carry ``mask=0`` so attended
+    outputs are unchanged.
+    """
+    from metrics_tpu.utils.data import _next_pow2
+
+    s = ids.shape[1]
+    m = max(_next_pow2(int(s)), floor)
+    if m == s:
+        return ids, mask
+    pad = ((0, 0), (0, m - s))
+    return np.pad(ids, pad, constant_values=pad_id), np.pad(mask, pad, constant_values=0)
+
+
+def jax_bert_encoder(
+    weights_path: str,
+    tokenizer,
+    variant: str = "bert",
+    num_heads: Optional[int] = None,
+    max_length: int = 512,
+    layer_norm_eps: Optional[float] = None,
+):
+    """Build a BERTScore ``TextEncoder`` running the transformer forward in JAX.
+
+    Args:
+        weights_path: HF state_dict checkpoint (``.bin``/``.pth``/``.npz``).
+        tokenizer: a HF tokenizer instance (host-side; e.g.
+            ``AutoTokenizer.from_pretrained(...)`` from a local cache).
+        variant: ``"bert"`` or ``"roberta"`` (position-id scheme + LN eps).
+        num_heads: attention heads; inferred from hidden size when None.
+        layer_norm_eps: override (default 1e-12 bert / 1e-5 roberta).
+    """
+    from metrics_tpu.models._io import load_checkpoint_state
+
+    params = params_from_state_dict(load_checkpoint_state(weights_path))
+    hidden = params["word_emb"].shape[1]
+    heads = num_heads or infer_num_heads(hidden)
+    eps = layer_norm_eps if layer_norm_eps is not None else (1e-5 if variant == "roberta" else 1e-12)
+
+    pad_id = getattr(tokenizer, "pad_token_id", None) or 0
+
+    def encoder(sentences: Sequence[str]) -> Tuple[Array, np.ndarray, np.ndarray]:
+        batch = tokenizer(
+            list(sentences), padding=True, truncation=True, max_length=max_length, return_tensors="np"
+        )
+        ids = np.asarray(batch["input_ids"])
+        mask = np.asarray(batch["attention_mask"])
+        ids_p, mask_p = pad_token_batch(ids, mask, pad_id)
+        pos = bert_position_ids(mask_p, variant)
+        out = bert_forward(params, jnp.asarray(ids_p), jnp.asarray(mask_p), jnp.asarray(pos), heads, eps)
+        return out, ids_p, mask_p
+
+    return encoder

@@ -1,0 +1,236 @@
+"""Pure-JAX CLIP (ViT image tower + text transformer) for CLIPScore.
+
+The reference wraps HF ``CLIPModel`` torch forwards (``multimodal/clip_score.py:46``).
+This port re-implements both towers in jnp — pre-LN transformer blocks with
+quick-gelu MLPs, causal+padding text attention, ViT patch embedding on the MXU —
+parameterized from a HF ``CLIPModel`` state_dict. Tokenization stays host-side;
+image preprocessing (resize + center crop + normalize) runs in JAX
+(``jax.image.resize`` bicubic — a documented delta vs PIL's resample kernel of
+order ~1e-3 in pixel space; feature parity on pre-sized inputs is exact).
+
+Differentially tested against the real HF torch module with random weights
+(tests/unittests/multimodal/test_clip_jax_port.py).
+"""
+from functools import partial
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+_NEG = -1e9
+
+# openai CLIP preprocessing constants (CLIPProcessor defaults)
+CLIP_IMAGE_MEAN = (0.48145466, 0.4578275, 0.40821073)
+CLIP_IMAGE_STD = (0.26862954, 0.26130258, 0.27577711)
+
+
+def _tower_from_state(state: Dict[str, np.ndarray], prefix: str) -> Dict[str, Any]:
+    def g(name):
+        return jnp.asarray(np.asarray(state[prefix + name]))
+
+    layers = []
+    i = 0
+    while f"{prefix}encoder.layers.{i}.self_attn.q_proj.weight" in state:
+        base = f"encoder.layers.{i}."
+        layers.append(
+            {
+                "q": (g(base + "self_attn.q_proj.weight").T, g(base + "self_attn.q_proj.bias")),
+                "k": (g(base + "self_attn.k_proj.weight").T, g(base + "self_attn.k_proj.bias")),
+                "v": (g(base + "self_attn.v_proj.weight").T, g(base + "self_attn.v_proj.bias")),
+                "out": (g(base + "self_attn.out_proj.weight").T, g(base + "self_attn.out_proj.bias")),
+                "ln1": (g(base + "layer_norm1.weight"), g(base + "layer_norm1.bias")),
+                "ln2": (g(base + "layer_norm2.weight"), g(base + "layer_norm2.bias")),
+                "fc1": (g(base + "mlp.fc1.weight").T, g(base + "mlp.fc1.bias")),
+                "fc2": (g(base + "mlp.fc2.weight").T, g(base + "mlp.fc2.bias")),
+            }
+        )
+        i += 1
+    if not layers:
+        raise ValueError(f"state_dict has no `{prefix}encoder.layers.*` keys — not a CLIP checkpoint")
+    return {"layers": layers}
+
+
+def params_from_state_dict(state: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    """HF ``CLIPModel`` state_dict -> nested JAX param pytree (both towers)."""
+
+    def g(name):
+        return jnp.asarray(np.asarray(state[name]))
+
+    text = _tower_from_state(state, "text_model.")
+    text.update(
+        {
+            "token_emb": g("text_model.embeddings.token_embedding.weight"),
+            "pos_emb": g("text_model.embeddings.position_embedding.weight"),
+            "final_ln": (g("text_model.final_layer_norm.weight"), g("text_model.final_layer_norm.bias")),
+            "proj": g("text_projection.weight").T,
+        }
+    )
+    vision = _tower_from_state(state, "vision_model.")
+    vision.update(
+        {
+            "cls_emb": g("vision_model.embeddings.class_embedding"),
+            "patch_emb": g("vision_model.embeddings.patch_embedding.weight"),  # (D, 3, P, P)
+            "pos_emb": g("vision_model.embeddings.position_embedding.weight"),
+            # sic: HF spells it `pre_layrnorm`
+            "pre_ln": (g("vision_model.pre_layrnorm.weight"), g("vision_model.pre_layrnorm.bias")),
+            "post_ln": (g("vision_model.post_layernorm.weight"), g("vision_model.post_layernorm.bias")),
+            "proj": g("visual_projection.weight").T,
+        }
+    )
+    return {"text": text, "vision": vision}
+
+
+def _layer_norm(x: Array, weight: Array, bias: Array, eps: float = 1e-5) -> Array:
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * weight + bias
+
+
+def _linear(x: Array, wb: Tuple[Array, Array]) -> Array:
+    return x @ wb[0] + wb[1]
+
+
+def _quick_gelu(x: Array) -> Array:
+    return x * jax.nn.sigmoid(1.702 * x)
+
+
+def _attn(x: Array, layer: Dict[str, Any], mask_bias: Optional[Array], num_heads: int) -> Array:
+    b, s, d = x.shape
+    dh = d // num_heads
+
+    def heads(t):
+        return t.reshape(b, s, num_heads, dh).transpose(0, 2, 1, 3)
+
+    q, k, v = heads(_linear(x, layer["q"])), heads(_linear(x, layer["k"])), heads(_linear(x, layer["v"]))
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(jnp.float32(dh))
+    if mask_bias is not None:
+        scores = scores + mask_bias
+    probs = jax.nn.softmax(scores, axis=-1)
+    ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, v).transpose(0, 2, 1, 3).reshape(b, s, d)
+    return _linear(ctx, layer["out"])
+
+
+def _encoder(x: Array, layers, mask_bias: Optional[Array], num_heads: int) -> Array:
+    for layer in layers:
+        x = x + _attn(_layer_norm(x, *layer["ln1"]), layer, mask_bias, num_heads)
+        x = x + _linear(_quick_gelu(_linear(_layer_norm(x, *layer["ln2"]), layer["fc1"])), layer["fc2"])
+    return x
+
+
+@partial(jax.jit, static_argnames=("num_heads", "eos_token_id"))
+def clip_text_features(
+    params: Dict[str, Any], input_ids: Array, attention_mask: Array, num_heads: int, eos_token_id: int
+) -> Array:
+    """Projected text features (HF CLIPTextTransformer + text_projection)."""
+    p = params["text"]
+    b, s = input_ids.shape
+    x = p["token_emb"][input_ids] + p["pos_emb"][jnp.arange(s)]
+    causal = jnp.where(jnp.arange(s)[:, None] >= jnp.arange(s)[None, :], 0.0, _NEG)  # (S, S)
+    pad = jnp.where(attention_mask[:, None, None, :] > 0, 0.0, _NEG)  # (B, 1, 1, S)
+    x = _encoder(x, p["layers"], causal[None, None] + pad, num_heads)
+    x = _layer_norm(x, *p["final_ln"])
+    eos_pos = jnp.argmax((input_ids == eos_token_id).astype(jnp.int32), axis=-1)
+    pooled = x[jnp.arange(b), eos_pos]
+    return pooled @ p["proj"]
+
+
+@partial(jax.jit, static_argnames=("num_heads",))
+def clip_image_features(params: Dict[str, Any], pixel_values: Array, num_heads: int) -> Array:
+    """Projected image features (HF CLIPVisionTransformer + visual_projection).
+
+    ``pixel_values``: (B, 3, H, W) already preprocessed (see :func:`preprocess`).
+    """
+    p = params["vision"]
+    # patch embedding: conv with stride=kernel == unfold + matmul on the MXU
+    patches = jax.lax.conv_general_dilated(
+        pixel_values.astype(jnp.float32),
+        p["patch_emb"],
+        window_strides=(p["patch_emb"].shape[2], p["patch_emb"].shape[3]),
+        padding="VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )  # (B, D, H/P, W/P)
+    b, d = patches.shape[:2]
+    x = patches.reshape(b, d, -1).transpose(0, 2, 1)  # (B, N, D)
+    cls = jnp.broadcast_to(p["cls_emb"], (b, 1, d))
+    x = jnp.concatenate([cls, x], axis=1) + p["pos_emb"][None]
+    x = _layer_norm(x, *p["pre_ln"])
+    x = _encoder(x, p["layers"], None, num_heads)
+    pooled = _layer_norm(x[:, 0], *p["post_ln"])
+    return pooled @ p["proj"]
+
+
+def preprocess(images: Array, size: int = 224) -> Array:
+    """CLIPProcessor-equivalent pipeline in JAX: bicubic resize (shorter side),
+    center crop, rescale to [0,1], channel normalize.
+
+    Accepts (N, 3, H, W) uint8 in [0, 255], float in [0, 255], or float already
+    in [0, 1] (detected eagerly by value range; traced inputs are assumed
+    [0, 255] like the uint8 convention).
+    """
+    from metrics_tpu.utils.checks import _is_concrete
+
+    raw = jnp.asarray(images)
+    x = raw.astype(jnp.float32)
+    if x.ndim == 3:
+        x = x[None]
+    n, c, h, w = x.shape
+    scale = size / min(h, w)
+    nh, nw = max(size, int(round(h * scale))), max(size, int(round(w * scale)))
+    x = jax.image.resize(x, (n, c, nh, nw), method="bicubic")
+    top, left = (nh - size) // 2, (nw - size) // 2
+    x = x[:, :, top:top + size, left:left + size]
+    already_unit = (
+        jnp.issubdtype(raw.dtype, jnp.floating) and _is_concrete(raw) and float(jnp.max(raw)) <= 1.0
+    )
+    if not already_unit:
+        x = x / 255.0
+    mean = jnp.asarray(CLIP_IMAGE_MEAN).reshape(1, 3, 1, 1)
+    std = jnp.asarray(CLIP_IMAGE_STD).reshape(1, 3, 1, 1)
+    return (x - mean) / std
+
+
+def infer_num_heads(width: int) -> int:
+    """CLIP head width is 64 both towers (ViT-B/L and text transformers)."""
+    if width % 64 == 0:
+        return width // 64
+    raise ValueError(f"Cannot infer head count for width {width}; pass explicitly")
+
+
+def jax_clip_encoders(
+    weights_path: str,
+    tokenizer,
+    image_size: int = 224,
+    text_heads: Optional[int] = None,
+    vision_heads: Optional[int] = None,
+    eos_token_id: int = 49407,
+    max_length: int = 77,
+):
+    """Build CLIPScore ``(image_encoder, text_encoder)`` running in JAX.
+
+    Args:
+        weights_path: HF ``CLIPModel`` state_dict (``.bin``/``.pth``/``.npz``).
+        tokenizer: HF CLIP tokenizer instance (host-side).
+        eos_token_id: EOS id used for text pooling (49407 for openai vocab).
+    """
+    from metrics_tpu.models._io import load_checkpoint_state
+
+    params = params_from_state_dict(load_checkpoint_state(weights_path))
+    th = text_heads or infer_num_heads(params["text"]["token_emb"].shape[1])
+    vh = vision_heads or infer_num_heads(params["vision"]["cls_emb"].shape[0])
+
+    def image_encoder(images) -> Array:
+        if isinstance(images, (list, tuple)):
+            images = jnp.stack([jnp.asarray(i) for i in images])
+        return clip_image_features(params, preprocess(images, image_size), vh)
+
+    def text_encoder(captions: Sequence[str]) -> Array:
+        from metrics_tpu.models.bert import pad_token_batch
+
+        batch = tokenizer(list(captions), padding=True, truncation=True, max_length=max_length, return_tensors="np")
+        # pow2 sequence bucketing bounds jit recompiles (see models/bert.py)
+        ids, mask = pad_token_batch(np.asarray(batch["input_ids"]), np.asarray(batch["attention_mask"]), 0)
+        return clip_text_features(params, jnp.asarray(ids), jnp.asarray(mask), th, eos_token_id)
+
+    return image_encoder, text_encoder

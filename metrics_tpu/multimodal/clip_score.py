@@ -18,10 +18,13 @@ class CLIPScore(Metric):
     """Running-mean CLIPScore: ``max(100 * cos(E_I, E_C), 0)`` over all samples.
 
     Args:
-        model_name_or_path: HF CLIP checkpoint for the default encoders (requires
-            locally cached weights).
+        model_name_or_path: HF CLIP checkpoint for the default torch encoders
+            (requires locally cached weights).
         image_encoder / text_encoder: custom embedding callables (both required
             together); see :mod:`metrics_tpu.functional.multimodal.clip_score`.
+            For TPU-native forwards, build both with
+            :func:`metrics_tpu.models.clip.jax_clip_encoders` (pure-JAX ViT +
+            text-transformer port loading HF CLIPModel checkpoints).
     """
 
     is_differentiable = False

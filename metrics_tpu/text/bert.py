@@ -18,9 +18,12 @@ class BERTScore(Metric):
 
     Args:
         encoder: ``(sentences) -> (embeddings, input_ids, attention_mask)``; see
-            :mod:`metrics_tpu.functional.text.bert` for the contract.
-        model_name_or_path: default ``transformers`` encoder to build lazily when
-            no ``encoder`` is given (requires locally cached weights).
+            :mod:`metrics_tpu.functional.text.bert` for the contract. For a
+            TPU-native forward pass, build one with
+            :func:`metrics_tpu.models.bert.jax_bert_encoder` (pure-JAX
+            BERT/RoBERTa port loading HF checkpoints, jit-compiled on device).
+        model_name_or_path: default ``transformers`` torch encoder to build
+            lazily when no ``encoder`` is given (requires locally cached weights).
         idf: weight tokens by inverse document frequency.
         max_length: tokenizer truncation length for the default encoder.
         rescale_with_baseline: linearly rescale with ``baseline``.
