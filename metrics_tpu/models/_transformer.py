@@ -1,0 +1,75 @@
+"""Shared transformer primitives for the JAX model ports (bert.py, clip.py)."""
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+# additive attention bias for masked positions; matches HF's mask magnitude
+NEG_BIAS = -1e9
+
+
+def layer_norm(x: Array, weight: Array, bias: Array, eps: float = 1e-5) -> Array:
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * weight + bias
+
+
+def linear(x: Array, wb: Tuple[Array, Array]) -> Array:
+    return x @ wb[0] + wb[1]
+
+
+def multi_head_attention(
+    x: Array,
+    q_wb: Tuple[Array, Array],
+    k_wb: Tuple[Array, Array],
+    v_wb: Tuple[Array, Array],
+    out_wb: Tuple[Array, Array],
+    mask_bias: Optional[Array],
+    num_heads: int,
+) -> Array:
+    """Standard scaled-dot-product MHA; ``mask_bias`` broadcasts to (B, H, Q, K)."""
+    b, s, d = x.shape
+    dh = d // num_heads
+
+    def heads(t):
+        return t.reshape(b, s, num_heads, dh).transpose(0, 2, 1, 3)
+
+    q, k, v = heads(linear(x, q_wb)), heads(linear(x, k_wb)), heads(linear(x, v_wb))
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(jnp.float32(dh))
+    if mask_bias is not None:
+        scores = scores + mask_bias
+    probs = jax.nn.softmax(scores, axis=-1)
+    ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, v).transpose(0, 2, 1, 3).reshape(b, s, d)
+    return linear(ctx, out_wb)
+
+
+def infer_num_heads(width: int) -> int:
+    """Standard 64-dim attention heads (BERT family and CLIP towers alike)."""
+    if width % 64 == 0:
+        return width // 64
+    raise ValueError(f"Cannot infer head count for width {width}; pass num_heads explicitly")
+
+
+def pad_token_batch(
+    ids: np.ndarray, mask: np.ndarray, pad_id: int, floor: int = 8, cap: Optional[int] = None
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Pad the sequence axis to the next power of two (bounded jit recompiles).
+
+    Pad-to-longest tokenization gives every batch a distinct (B, S) shape, which
+    would re-trace the jitted forward per batch; pow2 bucketing caps the cache at
+    log2(max_length) entries. ``cap`` bounds the bucket (e.g. a model's position
+    table size) so padding never exceeds valid position embeddings. Padded
+    positions carry ``mask=0`` so attended outputs are unchanged.
+    """
+    from metrics_tpu.utils.data import _next_pow2
+
+    s = ids.shape[1]
+    m = max(_next_pow2(int(s)), floor)
+    if cap is not None:
+        m = min(m, max(cap, s))
+    if m == s:
+        return ids, mask
+    pad = ((0, 0), (0, m - s))
+    return np.pad(ids, pad, constant_values=pad_id), np.pad(mask, pad, constant_values=0)

@@ -20,8 +20,14 @@ import jax.numpy as jnp
 import numpy as np
 from jax import Array
 
-# attention bias for masked positions; matches HF's additive mask magnitude
-_NEG = -1e9
+from metrics_tpu.models._transformer import (
+    NEG_BIAS,
+    infer_num_heads,
+    layer_norm as _layer_norm,
+    linear as _linear,
+    multi_head_attention,
+    pad_token_batch,
+)
 
 
 def params_from_state_dict(state: Dict[str, np.ndarray]) -> Dict[str, Any]:
@@ -68,28 +74,8 @@ def params_from_state_dict(state: Dict[str, np.ndarray]) -> Dict[str, Any]:
     return p
 
 
-def _layer_norm(x: Array, weight: Array, bias: Array, eps: float) -> Array:
-    mu = x.mean(-1, keepdims=True)
-    var = ((x - mu) ** 2).mean(-1, keepdims=True)
-    return (x - mu) * jax.lax.rsqrt(var + eps) * weight + bias
-
-
-def _linear(x: Array, wb: Tuple[Array, Array]) -> Array:
-    return x @ wb[0] + wb[1]
-
-
 def _self_attention(x: Array, layer: Dict[str, Any], mask_bias: Array, num_heads: int) -> Array:
-    b, s, d = x.shape
-    dh = d // num_heads
-
-    def heads(t):
-        return t.reshape(b, s, num_heads, dh).transpose(0, 2, 1, 3)  # (B, H, S, dh)
-
-    q, k, v = heads(_linear(x, layer["q"])), heads(_linear(x, layer["k"])), heads(_linear(x, layer["v"]))
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(jnp.float32(dh))
-    probs = jax.nn.softmax(scores + mask_bias, axis=-1)
-    ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, v).transpose(0, 2, 1, 3).reshape(b, s, d)
-    return _linear(ctx, layer["attn_out"])
+    return multi_head_attention(x, layer["q"], layer["k"], layer["v"], layer["attn_out"], mask_bias, num_heads)
 
 
 @partial(jax.jit, static_argnames=("num_heads", "eps"))
@@ -110,7 +96,7 @@ def bert_forward(
     x = _layer_norm(x, *params["emb_ln"], eps=eps)
 
     # additive key-side padding mask, broadcast over heads and query positions
-    mask_bias = jnp.where(attention_mask[:, None, None, :] > 0, 0.0, _NEG)
+    mask_bias = jnp.where(attention_mask[:, None, None, :] > 0, 0.0, NEG_BIAS)
 
     for layer in params["layers"]:
         attn = _self_attention(x, layer, mask_bias, num_heads)
@@ -127,31 +113,6 @@ def bert_position_ids(attention_mask: np.ndarray, variant: str, padding_idx: int
         mask = attention_mask.astype(np.int64)
         return np.cumsum(mask, axis=1) * mask + padding_idx
     return np.broadcast_to(np.arange(attention_mask.shape[1]), attention_mask.shape)
-
-
-def infer_num_heads(hidden_size: int) -> int:
-    """Standard BERT head counts by width (64-dim heads)."""
-    if hidden_size % 64 == 0:
-        return hidden_size // 64
-    raise ValueError(f"Cannot infer head count for hidden size {hidden_size}; pass num_heads explicitly")
-
-
-def pad_token_batch(ids: np.ndarray, mask: np.ndarray, pad_id: int, floor: int = 8) -> Tuple[np.ndarray, np.ndarray]:
-    """Pad the sequence axis to the next power of two (bounded jit recompiles).
-
-    Pad-to-longest tokenization gives every batch a distinct (B, S) shape, which
-    would re-trace the jitted forward per batch; pow2 bucketing caps the cache at
-    log2(max_length) entries. Padded positions carry ``mask=0`` so attended
-    outputs are unchanged.
-    """
-    from metrics_tpu.utils.data import _next_pow2
-
-    s = ids.shape[1]
-    m = max(_next_pow2(int(s)), floor)
-    if m == s:
-        return ids, mask
-    pad = ((0, 0), (0, m - s))
-    return np.pad(ids, pad, constant_values=pad_id), np.pad(mask, pad, constant_values=0)
 
 
 def jax_bert_encoder(
@@ -187,7 +148,7 @@ def jax_bert_encoder(
         )
         ids = np.asarray(batch["input_ids"])
         mask = np.asarray(batch["attention_mask"])
-        ids_p, mask_p = pad_token_batch(ids, mask, pad_id)
+        ids_p, mask_p = pad_token_batch(ids, mask, pad_id, cap=max_length)
         pos = bert_position_ids(mask_p, variant)
         out = bert_forward(params, jnp.asarray(ids_p), jnp.asarray(mask_p), jnp.asarray(pos), heads, eps)
         return out, ids_p, mask_p

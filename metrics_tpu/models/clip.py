@@ -19,7 +19,14 @@ import jax.numpy as jnp
 import numpy as np
 from jax import Array
 
-_NEG = -1e9
+from metrics_tpu.models._transformer import (
+    NEG_BIAS,
+    infer_num_heads,
+    layer_norm as _layer_norm,
+    linear as _linear,
+    multi_head_attention,
+    pad_token_batch,
+)
 
 # openai CLIP preprocessing constants (CLIPProcessor defaults)
 CLIP_IMAGE_MEAN = (0.48145466, 0.4578275, 0.40821073)
@@ -82,34 +89,12 @@ def params_from_state_dict(state: Dict[str, np.ndarray]) -> Dict[str, Any]:
     return {"text": text, "vision": vision}
 
 
-def _layer_norm(x: Array, weight: Array, bias: Array, eps: float = 1e-5) -> Array:
-    mu = x.mean(-1, keepdims=True)
-    var = ((x - mu) ** 2).mean(-1, keepdims=True)
-    return (x - mu) * jax.lax.rsqrt(var + eps) * weight + bias
-
-
-def _linear(x: Array, wb: Tuple[Array, Array]) -> Array:
-    return x @ wb[0] + wb[1]
-
-
 def _quick_gelu(x: Array) -> Array:
     return x * jax.nn.sigmoid(1.702 * x)
 
 
 def _attn(x: Array, layer: Dict[str, Any], mask_bias: Optional[Array], num_heads: int) -> Array:
-    b, s, d = x.shape
-    dh = d // num_heads
-
-    def heads(t):
-        return t.reshape(b, s, num_heads, dh).transpose(0, 2, 1, 3)
-
-    q, k, v = heads(_linear(x, layer["q"])), heads(_linear(x, layer["k"])), heads(_linear(x, layer["v"]))
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(jnp.float32(dh))
-    if mask_bias is not None:
-        scores = scores + mask_bias
-    probs = jax.nn.softmax(scores, axis=-1)
-    ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, v).transpose(0, 2, 1, 3).reshape(b, s, d)
-    return _linear(ctx, layer["out"])
+    return multi_head_attention(x, layer["q"], layer["k"], layer["v"], layer["out"], mask_bias, num_heads)
 
 
 def _encoder(x: Array, layers, mask_bias: Optional[Array], num_heads: int) -> Array:
@@ -127,8 +112,8 @@ def clip_text_features(
     p = params["text"]
     b, s = input_ids.shape
     x = p["token_emb"][input_ids] + p["pos_emb"][jnp.arange(s)]
-    causal = jnp.where(jnp.arange(s)[:, None] >= jnp.arange(s)[None, :], 0.0, _NEG)  # (S, S)
-    pad = jnp.where(attention_mask[:, None, None, :] > 0, 0.0, _NEG)  # (B, 1, 1, S)
+    causal = jnp.where(jnp.arange(s)[:, None] >= jnp.arange(s)[None, :], 0.0, NEG_BIAS)  # (S, S)
+    pad = jnp.where(attention_mask[:, None, None, :] > 0, 0.0, NEG_BIAS)  # (B, 1, 1, S)
     x = _encoder(x, p["layers"], causal[None, None] + pad, num_heads)
     x = _layer_norm(x, *p["final_ln"])
     eos_pos = jnp.argmax((input_ids == eos_token_id).astype(jnp.int32), axis=-1)
@@ -161,17 +146,29 @@ def clip_image_features(params: Dict[str, Any], pixel_values: Array, num_heads: 
     return pooled @ p["proj"]
 
 
-def preprocess(images: Array, size: int = 224) -> Array:
+def preprocess(images: Array, size: int = 224, unit_range: Optional[bool] = None) -> Array:
     """CLIPProcessor-equivalent pipeline in JAX: bicubic resize (shorter side),
-    center crop, rescale to [0,1], channel normalize.
+    center crop, rescale to [0,1], channel normalize. Input: (N, 3, H, W).
 
-    Accepts (N, 3, H, W) uint8 in [0, 255], float in [0, 255], or float already
-    in [0, 1] (detected eagerly by value range; traced inputs are assumed
-    [0, 255] like the uint8 convention).
+    ``unit_range`` declares float inputs' convention: ``True`` = already [0,1],
+    ``False`` = [0,255]. With ``None``, uint8 is [0,255], and concrete (eager)
+    floats are detected by value range; TRACED floats require an explicit value —
+    a silent guess under jit could rescale twice and feed CLIP near-black images.
     """
     from metrics_tpu.utils.checks import _is_concrete
 
     raw = jnp.asarray(images)
+    is_float = jnp.issubdtype(raw.dtype, jnp.floating)
+    if unit_range is None:
+        if not is_float:
+            unit_range = False
+        elif _is_concrete(raw):
+            unit_range = bool(float(jnp.max(raw)) <= 1.0)
+        else:
+            raise ValueError(
+                "preprocess() with traced float images needs an explicit `unit_range`"
+                " (True for [0,1] inputs, False for [0,255])"
+            )
     x = raw.astype(jnp.float32)
     if x.ndim == 3:
         x = x[None]
@@ -181,21 +178,11 @@ def preprocess(images: Array, size: int = 224) -> Array:
     x = jax.image.resize(x, (n, c, nh, nw), method="bicubic")
     top, left = (nh - size) // 2, (nw - size) // 2
     x = x[:, :, top:top + size, left:left + size]
-    already_unit = (
-        jnp.issubdtype(raw.dtype, jnp.floating) and _is_concrete(raw) and float(jnp.max(raw)) <= 1.0
-    )
-    if not already_unit:
+    if not unit_range:
         x = x / 255.0
     mean = jnp.asarray(CLIP_IMAGE_MEAN).reshape(1, 3, 1, 1)
     std = jnp.asarray(CLIP_IMAGE_STD).reshape(1, 3, 1, 1)
     return (x - mean) / std
-
-
-def infer_num_heads(width: int) -> int:
-    """CLIP head width is 64 both towers (ViT-B/L and text transformers)."""
-    if width % 64 == 0:
-        return width // 64
-    raise ValueError(f"Cannot infer head count for width {width}; pass explicitly")
 
 
 def jax_clip_encoders(
@@ -206,6 +193,7 @@ def jax_clip_encoders(
     vision_heads: Optional[int] = None,
     eos_token_id: int = 49407,
     max_length: int = 77,
+    unit_range: Optional[bool] = None,
 ):
     """Build CLIPScore ``(image_encoder, text_encoder)`` running in JAX.
 
@@ -223,14 +211,13 @@ def jax_clip_encoders(
     def image_encoder(images) -> Array:
         if isinstance(images, (list, tuple)):
             images = jnp.stack([jnp.asarray(i) for i in images])
-        return clip_image_features(params, preprocess(images, image_size), vh)
+        return clip_image_features(params, preprocess(images, image_size, unit_range), vh)
 
     def text_encoder(captions: Sequence[str]) -> Array:
-        from metrics_tpu.models.bert import pad_token_batch
-
         batch = tokenizer(list(captions), padding=True, truncation=True, max_length=max_length, return_tensors="np")
-        # pow2 sequence bucketing bounds jit recompiles (see models/bert.py)
-        ids, mask = pad_token_batch(np.asarray(batch["input_ids"]), np.asarray(batch["attention_mask"]), 0)
+        # pow2 bucketing bounds jit recompiles; cap at max_length so padding never
+        # indexes past the position-embedding table
+        ids, mask = pad_token_batch(np.asarray(batch["input_ids"]), np.asarray(batch["attention_mask"]), 0, cap=max_length)
         return clip_text_features(params, jnp.asarray(ids), jnp.asarray(mask), th, eos_token_id)
 
     return image_encoder, text_encoder

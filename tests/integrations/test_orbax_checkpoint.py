@@ -1,0 +1,94 @@
+"""Checkpoint/resume through orbax (SURVEY §5: "states are pytrees -> orbax/flax
+serialization is the natural mapping").
+
+Metric state pytrees (scalar sums, None-reduction stats, CatBuffers with the
+overflow leaf) round-trip through a real orbax checkpoint alongside model
+params, and a resumed evaluation continues to the same result.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+ocp = pytest.importorskip("orbax.checkpoint")
+
+from metrics_tpu import MetricCollection
+from metrics_tpu.classification import MulticlassAccuracy
+from metrics_tpu.regression import PearsonCorrCoef, SpearmanCorrCoef
+
+_rng = np.random.RandomState(9)
+
+
+def _save_restore(tmp_path, tree):
+    # PyTreeCheckpointHandler: handles custom pytree nodes (CatBuffer) that
+    # StandardCheckpointHandler's save_args tree-mapping mispairs
+    path = tmp_path / "ckpt"
+    with ocp.Checkpointer(ocp.PyTreeCheckpointHandler()) as ckptr:
+        ckptr.save(path, args=ocp.args.PyTreeSave(tree))
+        restored = ckptr.restore(path, args=ocp.args.PyTreeRestore(tree))
+    return restored
+
+
+def test_metric_state_roundtrip_and_resume(tmp_path):
+    preds = _rng.rand(64, 4).astype(np.float32)
+    target = _rng.randint(0, 4, 64)
+
+    metrics = MetricCollection(
+        {
+            "acc": MulticlassAccuracy(num_classes=4, validate_args=False),
+            "pearson": PearsonCorrCoef(),
+        }
+    )
+
+    # run half the stream, checkpoint, restore, run the rest
+    state = metrics.init_state()
+    state = {
+        "acc": metrics["acc"].local_update(state["acc"], jnp.asarray(preds[:32]), jnp.asarray(target[:32])),
+        "pearson": metrics["pearson"].local_update(
+            state["pearson"], jnp.asarray(preds[:32, 0]), jnp.asarray(target[:32].astype(np.float32))
+        ),
+    }
+    restored = _save_restore(tmp_path, state)
+    restored = {
+        "acc": metrics["acc"].local_update(restored["acc"], jnp.asarray(preds[32:]), jnp.asarray(target[32:])),
+        "pearson": metrics["pearson"].local_update(
+            restored["pearson"], jnp.asarray(preds[32:, 0]), jnp.asarray(target[32:].astype(np.float32))
+        ),
+    }
+
+    # oracle: uninterrupted run
+    full = {
+        "acc": metrics["acc"].local_update(
+            metrics["acc"].init_state(), jnp.asarray(preds), jnp.asarray(target)
+        ),
+        "pearson": metrics["pearson"].local_update(
+            metrics["pearson"].init_state(), jnp.asarray(preds[:, 0]), jnp.asarray(target.astype(np.float32))
+        ),
+    }
+
+    assert float(metrics["acc"].compute_from(restored["acc"])) == pytest.approx(
+        float(metrics["acc"].compute_from(full["acc"])), abs=1e-7
+    )
+    assert float(metrics["pearson"].compute_from(restored["pearson"])) == pytest.approx(
+        float(metrics["pearson"].compute_from(full["pearson"])), abs=1e-6
+    )
+
+
+def test_cat_buffer_state_roundtrip(tmp_path):
+    """CatBuffer states (3-leaf pytree incl. the overflow flag) survive orbax."""
+    metric = SpearmanCorrCoef(cat_capacity=16)
+    p = _rng.randn(10).astype(np.float32)
+    t = (p + 0.3 * _rng.randn(10)).astype(np.float32)
+    state = metric.local_update(metric.init_state(), jnp.asarray(p), jnp.asarray(t))
+
+    restored = _save_restore(tmp_path, state)
+    assert int(restored["preds"].count) == 10
+    assert not bool(restored["preds"].overflowed())
+    assert float(metric.compute_from(restored)) == pytest.approx(float(metric.compute_from(state)), abs=1e-7)
+
+    # overflowed state keeps its flag through the checkpoint
+    over = metric.local_update(state, jnp.asarray(_rng.randn(12).astype(np.float32)),
+                               jnp.asarray(_rng.randn(12).astype(np.float32)))
+    restored_over = _save_restore(tmp_path / "o", {"s": over})["s"]
+    assert bool(restored_over["preds"].overflowed())

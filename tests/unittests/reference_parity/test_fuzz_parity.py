@@ -1,0 +1,70 @@
+"""Seeded fuzz parity: random configurations vs the actual reference library.
+
+Complements the fixed cartesian grid (test_classification_parity_grid.py) with
+SHAPE and data diversity — odd lengths, tiny batches, extra dims, degenerate
+class distributions, logits vs probs vs hard labels — across randomly drawn
+argument combinations. Every case is reproducible from its seed.
+"""
+import numpy as np
+import pytest
+
+import metrics_tpu.functional.classification as F
+
+from .conftest import assert_close
+
+N_CASES = 60
+
+
+def _draw_case(seed):
+    rng = np.random.RandomState(seed)
+    task = rng.choice(["binary", "multiclass", "multilabel"])
+    n = int(rng.choice([1, 2, 7, 33, 100, 257]))
+    kwargs = {}
+    if task == "binary":
+        name = rng.choice(["binary_accuracy", "binary_f1_score", "binary_stat_scores", "binary_precision"])
+        preds = rng.rand(n).astype(np.float32) if rng.rand() < 0.5 else rng.randn(n).astype(np.float32) * 2
+        target = rng.randint(0, 2, n)
+        if rng.rand() < 0.3:
+            kwargs["threshold"] = float(rng.choice([0.25, 0.5, 0.75]))
+    elif task == "multiclass":
+        nc = int(rng.choice([2, 3, 5, 11]))
+        name = rng.choice(
+            ["multiclass_accuracy", "multiclass_f1_score", "multiclass_stat_scores", "multiclass_recall"]
+        )
+        kwargs["num_classes"] = nc
+        kwargs["average"] = str(rng.choice(["micro", "macro", "weighted", "none"]))
+        if rng.rand() < 0.5:
+            preds = rng.rand(n, nc).astype(np.float32)
+            preds = preds / preds.sum(-1, keepdims=True)
+        else:
+            preds = rng.randint(0, nc, n)
+        target = rng.randint(0, nc, n)
+        if rng.rand() < 0.3:  # skewed targets: some classes absent
+            target = np.minimum(target, 1)
+        if rng.rand() < 0.3:
+            kwargs["ignore_index"] = int(rng.choice([0, -1]))
+            target = target.copy()
+            target[:: max(2, n // 5)] = kwargs["ignore_index"]
+        if rng.rand() < 0.3 and preds.ndim == 2 and nc > 2:
+            kwargs["top_k"] = 2
+    else:
+        nl = int(rng.choice([2, 3, 6]))
+        name = rng.choice(["multilabel_accuracy", "multilabel_f1_score", "multilabel_stat_scores"])
+        kwargs["num_labels"] = nl
+        kwargs["average"] = str(rng.choice(["micro", "macro", "weighted", "none"]))
+        preds = rng.rand(n, nl).astype(np.float32)
+        target = rng.randint(0, 2, (n, nl))
+    return name, preds, target, kwargs
+
+
+@pytest.mark.parametrize("seed", range(N_CASES))
+def test_fuzz_case(ref, seed):
+    import jax.numpy as jnp
+    import torch
+
+    name, preds, target, kwargs = _draw_case(seed)
+    ref_fn = getattr(ref.functional.classification, name)
+    our_fn = getattr(F, name)
+    theirs = ref_fn(torch.from_numpy(np.asarray(preds)), torch.from_numpy(np.asarray(target)), **kwargs)
+    ours = our_fn(jnp.asarray(preds), jnp.asarray(target), **kwargs)
+    assert_close(ours, theirs, atol=1e-5)
