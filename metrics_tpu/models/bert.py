@@ -106,6 +106,95 @@ def bert_forward(
     return x
 
 
+def mlm_params_from_state_dict(state: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    """HF ``BertForMaskedLM``/``RobertaForMaskedLM`` state_dict -> params with MLM head.
+
+    The head is ``dense -> gelu -> LayerNorm -> decoder`` (decoder weight tied to
+    the word embeddings in HF; the checkpoint ships it either way). Handles both
+    key layouts: ``cls.predictions.*`` (BERT) and ``lm_head.*`` (RoBERTa).
+    """
+    params = params_from_state_dict(state)
+
+    def g(name):
+        return jnp.asarray(np.asarray(state[name]))
+
+    def decoder_pair(weight_key, *bias_keys):
+        # save_pretrained strips tied weights: fall back to the word-embedding
+        # matrix (the decoder is tied to it in HF) and to a zero bias
+        weight = g(weight_key).T if weight_key in state else params["word_emb"].T
+        for bk in bias_keys:
+            if bk in state:
+                return weight, g(bk)
+        return weight, jnp.zeros((weight.shape[1],), weight.dtype)
+
+    if "cls.predictions.transform.dense.weight" in state:  # BERT layout
+        head = {
+            "dense": (g("cls.predictions.transform.dense.weight").T, g("cls.predictions.transform.dense.bias")),
+            "ln": (g("cls.predictions.transform.LayerNorm.weight"), g("cls.predictions.transform.LayerNorm.bias")),
+            "decoder": decoder_pair("cls.predictions.decoder.weight", "cls.predictions.decoder.bias", "cls.predictions.bias"),
+        }
+    elif "lm_head.dense.weight" in state:  # RoBERTa layout
+        head = {
+            "dense": (g("lm_head.dense.weight").T, g("lm_head.dense.bias")),
+            "ln": (g("lm_head.layer_norm.weight"), g("lm_head.layer_norm.bias")),
+            "decoder": decoder_pair("lm_head.decoder.weight", "lm_head.decoder.bias", "lm_head.bias"),
+        }
+    else:
+        raise ValueError("state_dict has neither `cls.predictions.*` nor `lm_head.*` keys — not a masked-LM checkpoint")
+    params["mlm_head"] = head
+    return params
+
+
+@partial(jax.jit, static_argnames=("num_heads", "eps"))
+def bert_mlm_logits(
+    params: Dict[str, Any],
+    input_ids: Array,
+    attention_mask: Array,
+    position_ids: Array,
+    num_heads: int,
+    eps: float = 1e-12,
+) -> Array:
+    """(B, S, V) masked-LM logits — the InfoLM ``logits_fn`` surface."""
+    hidden = bert_forward(params, input_ids, attention_mask, position_ids, num_heads, eps)
+    head = params["mlm_head"]
+    x = jax.nn.gelu(_linear(hidden, head["dense"]), approximate=False)
+    x = _layer_norm(x, *head["ln"], eps=eps)
+    return _linear(x, head["decoder"])
+
+
+def jax_mlm_logits_fn(
+    weights_path: str,
+    variant: str = "bert",
+    num_heads: Optional[int] = None,
+    layer_norm_eps: Optional[float] = None,
+):
+    """Build an InfoLM ``logits_fn`` (``(input_ids, attention_mask) -> logits``)
+    running the masked-LM forward in JAX from a HF checkpoint."""
+    from metrics_tpu.models._io import load_checkpoint_state
+
+    params = mlm_params_from_state_dict(load_checkpoint_state(weights_path))
+    heads = num_heads or infer_num_heads(params["word_emb"].shape[1])
+    eps = layer_norm_eps if layer_norm_eps is not None else (1e-5 if variant == "roberta" else 1e-12)
+
+    max_positions = int(params["pos_emb"].shape[0])
+
+    def logits_fn(input_ids: np.ndarray, attention_mask: np.ndarray) -> Array:
+        ids = np.asarray(input_ids)
+        mask = np.asarray(attention_mask)
+        if ids.shape[1] > max_positions:
+            raise ValueError(
+                f"sequence length {ids.shape[1]} exceeds the checkpoint's position table"
+                f" ({max_positions}); truncate in the tokenizer"
+            )
+        # pow2 bucketing bounds jit recompiles; cap keeps positions in-table
+        ids, mask = pad_token_batch(ids, mask, 0, cap=max_positions)
+        pos = bert_position_ids(mask, variant)
+        out = bert_mlm_logits(params, jnp.asarray(ids), jnp.asarray(mask), jnp.asarray(pos), heads, eps)
+        return out[:, : np.asarray(input_ids).shape[1], :]  # trim bucket padding
+
+    return logits_fn
+
+
 def bert_position_ids(attention_mask: np.ndarray, variant: str, padding_idx: int = 1) -> np.ndarray:
     """Position ids: sequential for BERT; RoBERTa offsets past its padding index
     and freezes pad positions at ``padding_idx`` (HF create_position_ids_from_input_ids)."""

@@ -97,3 +97,94 @@ def test_jax_encoder_plugs_into_bert_score(tmp_path):
     f1 = np.asarray(res["f1"])
     assert f1.shape == (2,) and np.all(np.isfinite(f1))
     assert float(f1[1]) == pytest.approx(1.0, abs=1e-4)  # identical sentence
+
+
+@pytest.mark.parametrize("variant", ["bert", "roberta"])
+def test_jax_mlm_head_matches_hf_torch(variant):
+    from metrics_tpu.models.bert import bert_mlm_logits, mlm_params_from_state_dict
+
+    if variant == "bert":
+        config = transformers.BertConfig(
+            vocab_size=VOCAB, hidden_size=HIDDEN, num_hidden_layers=LAYERS, num_attention_heads=HEADS,
+            intermediate_size=4 * HIDDEN, max_position_embeddings=64,
+        )
+        ref = transformers.BertForMaskedLM(config).eval()
+        eps = config.layer_norm_eps
+    else:
+        config = transformers.RobertaConfig(
+            vocab_size=VOCAB, hidden_size=HIDDEN, num_hidden_layers=LAYERS, num_attention_heads=HEADS,
+            intermediate_size=4 * HIDDEN, max_position_embeddings=64, pad_token_id=1,
+        )
+        ref = transformers.RobertaForMaskedLM(config).eval()
+        eps = config.layer_norm_eps
+
+    params = mlm_params_from_state_dict({k: v.numpy() for k, v in ref.state_dict().items()})
+    ids, mask = _rand_inputs(3)
+    pos = bert_position_ids(mask, variant)
+    ours = np.asarray(
+        bert_mlm_logits(params, jnp.asarray(ids), jnp.asarray(mask), jnp.asarray(pos), HEADS, float(eps))
+    )
+    with torch.no_grad():
+        theirs = ref(torch.from_numpy(ids), attention_mask=torch.from_numpy(mask)).logits.numpy()
+    m = mask.astype(bool)
+    np.testing.assert_allclose(ours[m], theirs[m], atol=3e-4)
+
+
+def test_jax_mlm_plugs_into_infolm(tmp_path):
+    from metrics_tpu.functional.text.infolm import infolm
+    from metrics_tpu.models.bert import jax_mlm_logits_fn
+
+    config = transformers.BertConfig(
+        vocab_size=VOCAB, hidden_size=HIDDEN, num_hidden_layers=LAYERS, num_attention_heads=HEADS,
+        intermediate_size=4 * HIDDEN, max_position_embeddings=64,
+    )
+    ref = transformers.BertForMaskedLM(config).eval()
+    ckpt = tmp_path / "mlm.pth"
+    torch.save(ref.state_dict(), str(ckpt))
+
+    logits_fn = jax_mlm_logits_fn(str(ckpt), variant="bert", num_heads=HEADS)
+
+    def tokenize(sentences, max_length=None):
+        ids = [[2] + [(hash(w) % (VOCAB - 5)) + 5 for w in s.split()] + [3] for s in sentences]
+        longest = max(len(i) for i in ids)
+        out = np.zeros((len(ids), longest), np.int64)
+        mask = np.zeros((len(ids), longest), np.int64)
+        for r, row in enumerate(ids):
+            out[r, : len(row)] = row
+            mask[r, : len(row)] = 1
+        return out, mask
+
+    score = infolm(
+        ["the cat sat on the mat"],
+        ["a cat sat on a mat"],
+        logits_fn=logits_fn,
+        tokenizer_fn=tokenize,
+        special_tokens_map={"pad_token_id": 0, "cls_token_id": 2, "sep_token_id": 3, "mask_token_id": 4},
+        information_measure="kl_divergence",
+    )
+    assert np.isfinite(float(np.asarray(score)))
+
+
+def test_mlm_tied_decoder_fallback():
+    """Checkpoints saved via save_pretrained strip tied weights: the loader must
+    tie the decoder to the word embeddings and still match HF exactly."""
+    from metrics_tpu.models.bert import bert_mlm_logits, mlm_params_from_state_dict
+
+    config = transformers.BertConfig(
+        vocab_size=VOCAB, hidden_size=HIDDEN, num_hidden_layers=LAYERS, num_attention_heads=HEADS,
+        intermediate_size=4 * HIDDEN, max_position_embeddings=64,
+    )
+    ref = transformers.BertForMaskedLM(config).eval()
+    state = {k: v.numpy() for k, v in ref.state_dict().items()}
+    state.pop("cls.predictions.decoder.weight")  # simulate tied-weight stripping
+    params = mlm_params_from_state_dict(state)
+
+    ids, mask = _rand_inputs(4)
+    pos = bert_position_ids(mask, "bert")
+    ours = np.asarray(
+        bert_mlm_logits(params, jnp.asarray(ids), jnp.asarray(mask), jnp.asarray(pos), HEADS, float(config.layer_norm_eps))
+    )
+    with torch.no_grad():
+        theirs = ref(torch.from_numpy(ids), attention_mask=torch.from_numpy(mask)).logits.numpy()
+    m = mask.astype(bool)
+    np.testing.assert_allclose(ours[m], theirs[m], atol=3e-4)
