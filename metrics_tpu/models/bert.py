@@ -176,18 +176,21 @@ def jax_mlm_logits_fn(
     heads = num_heads or infer_num_heads(params["word_emb"].shape[1])
     eps = layer_norm_eps if layer_norm_eps is not None else (1e-5 if variant == "roberta" else 1e-12)
 
-    max_positions = int(params["pos_emb"].shape[0])
+    # RoBERTa position ids run cumsum(mask)+padding_idx, so a full row of length S
+    # indexes up to S + padding_idx — bound S accordingly, not by the raw table size
+    table = int(params["pos_emb"].shape[0])
+    max_seq = table - 2 if variant == "roberta" else table
 
     def logits_fn(input_ids: np.ndarray, attention_mask: np.ndarray) -> Array:
         ids = np.asarray(input_ids)
         mask = np.asarray(attention_mask)
-        if ids.shape[1] > max_positions:
+        if ids.shape[1] > max_seq:
             raise ValueError(
-                f"sequence length {ids.shape[1]} exceeds the checkpoint's position table"
-                f" ({max_positions}); truncate in the tokenizer"
+                f"sequence length {ids.shape[1]} exceeds the checkpoint's usable position"
+                f" range ({max_seq}); truncate in the tokenizer"
             )
         # pow2 bucketing bounds jit recompiles; cap keeps positions in-table
-        ids, mask = pad_token_batch(ids, mask, 0, cap=max_positions)
+        ids, mask = pad_token_batch(ids, mask, 0, cap=max_seq)
         pos = bert_position_ids(mask, variant)
         out = bert_mlm_logits(params, jnp.asarray(ids), jnp.asarray(mask), jnp.asarray(pos), heads, eps)
         return out[:, : np.asarray(input_ids).shape[1], :]  # trim bucket padding

@@ -68,3 +68,45 @@ def test_fuzz_case(ref, seed):
     theirs = ref_fn(torch.from_numpy(np.asarray(preds)), torch.from_numpy(np.asarray(target)), **kwargs)
     ours = our_fn(jnp.asarray(preds), jnp.asarray(target), **kwargs)
     assert_close(ours, theirs, atol=1e-5)
+
+
+# ------------------------------------------------------- regression domain
+
+def _draw_regression_case(seed):
+    rng = np.random.RandomState(1000 + seed)
+    name = rng.choice(
+        [
+            "mean_squared_error", "mean_absolute_error", "explained_variance",
+            "r2_score", "cosine_similarity", "pearson_corrcoef", "spearman_corrcoef",
+            "mean_absolute_percentage_error", "symmetric_mean_absolute_percentage_error",
+            "mean_squared_log_error", "log_cosh_error", "kendall_rank_corrcoef",
+        ]
+    )
+    n = int(rng.choice([2, 5, 33, 100]))
+    kwargs = {}
+    if name == "cosine_similarity":
+        preds = rng.randn(n, 8).astype(np.float32)
+        target = rng.randn(n, 8).astype(np.float32)
+    elif name in ("mean_absolute_percentage_error", "symmetric_mean_absolute_percentage_error",
+                  "mean_squared_log_error"):
+        preds = np.abs(rng.randn(n)).astype(np.float32) + 0.5
+        target = np.abs(rng.randn(n)).astype(np.float32) + 0.5
+    else:
+        preds = rng.randn(n).astype(np.float32)
+        target = (preds + rng.randn(n) * rng.choice([0.1, 1.0, 5.0])).astype(np.float32)
+    return name, preds, target, kwargs
+
+
+@pytest.mark.parametrize("seed", range(40))
+def test_fuzz_regression_case(ref, seed):
+    import jax.numpy as jnp
+    import torch
+
+    import metrics_tpu.functional.regression as R
+
+    name, preds, target, kwargs = _draw_regression_case(seed)
+    ref_fn = getattr(ref.functional.regression, name, None) or getattr(ref.functional, name)
+    our_fn = getattr(R, name)
+    theirs = ref_fn(torch.from_numpy(np.asarray(preds)), torch.from_numpy(np.asarray(target)), **kwargs)
+    ours = our_fn(jnp.asarray(preds), jnp.asarray(target), **kwargs)
+    assert_close(ours, theirs, atol=1e-4)
