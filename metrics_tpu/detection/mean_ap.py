@@ -10,8 +10,9 @@ interpolation, reference ``__calculate_recall_precision_scores`` :773-840) runs 
 host NumPy — it is O(total_detections · log) and feeds fixed 101-point tables.
 
 Differences vs pycocotools kept for parity with the reference: ignored ground truths
-are never matched (no crowd fallback), and ``iou_type="segm"`` (RLE masks via
-pycocotools) is not supported on TPU.
+are never matched (no crowd fallback). ``iou_type="segm"`` takes dense binary masks
+(the reference's pre-RLE form) and computes mask IoU as one matmul per image —
+no pycocotools dependency; RLE is a host-memory compaction, not a semantic need.
 """
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -22,7 +23,7 @@ import numpy as np
 
 from metrics_tpu.core.metric import Metric
 from metrics_tpu.detection.helpers import _fix_empty_tensors, _input_validator
-from metrics_tpu.functional.detection._mean_ap_kernel import _match_groups, _pow2
+from metrics_tpu.functional.detection._mean_ap_kernel import _match_groups, _match_groups_from_iou, _pow2
 from metrics_tpu.functional.detection.box_ops import box_convert
 
 
@@ -121,11 +122,12 @@ class MeanAveragePrecision(Metric):
         self.iou_thresholds = iou_thresholds or np.linspace(0.5, 0.95, round((0.95 - 0.5) / 0.05) + 1).tolist()
         self.rec_thresholds = rec_thresholds or np.linspace(0.0, 1.00, round(1.00 / 0.01) + 1).tolist()
         self.max_detection_thresholds = sorted(max_detection_thresholds or [1, 10, 100])
-        if iou_type != "bbox":
-            raise ValueError(
-                f"Expected argument `iou_type` to be 'bbox', got {iou_type!r}"
-                " ('segm' needs pycocotools RLE masks, unsupported in the TPU build)"
-            )
+        if iou_type not in ("bbox", "segm"):
+            raise ValueError(f"Expected argument `iou_type` to be 'bbox' or 'segm', got {iou_type!r}")
+        # segm is a TPU redesign: dense binary masks with IoU as a matmul
+        # (intersection = flat_d @ flat_g^T) — the reference instead requires
+        # pycocotools RLE (detection/mean_ap.py:345); RLE is a host-memory
+        # compaction, not a semantic difference
         self.iou_type = iou_type
         self.bbox_area_ranges = {
             "all": (float(0**2), float(1e5**2)),
@@ -158,6 +160,11 @@ class MeanAveragePrecision(Metric):
             self.groundtruth_labels.append(jnp.asarray(item["labels"]).reshape(-1))
 
     def _get_safe_item_values(self, item: Dict[str, Any]) -> Array:
+        if self.iou_type == "segm":
+            masks = jnp.asarray(item["masks"])
+            if masks.size == 0:
+                return jnp.zeros((0, 1, 1), bool)
+            return masks.astype(bool)
         boxes = _fix_empty_tensors(item["boxes"])
         if boxes.size > 0:
             boxes = box_convert(boxes, in_fmt=self.box_format, out_fmt="xyxy")
@@ -187,23 +194,53 @@ class MeanAveragePrecision(Metric):
                 list(self.groundtruth_labels),
             )
         )
-        det_boxes_np = [np.asarray(b, np.float32).reshape(-1, 4) for b in host[0]]
+        if self.iou_type == "segm":
+            det_items = [np.asarray(b, bool) for b in host[0]]
+            gt_items = [np.asarray(b, bool) for b in host[3]]
+        else:
+            det_items = [np.asarray(b, np.float32).reshape(-1, 4) for b in host[0]]
+            gt_items = [np.asarray(b, np.float32).reshape(-1, 4) for b in host[3]]
         det_scores_np = [np.asarray(s, np.float32).reshape(-1) for s in host[1]]
         det_labels_np = [np.asarray(l).reshape(-1) for l in host[2]]
-        gt_boxes_np = [np.asarray(b, np.float32).reshape(-1, 4) for b in host[3]]
         gt_labels_np = [np.asarray(l).reshape(-1) for l in host[4]]
 
-        groups = []  # (img_idx, class_idx, det_boxes, det_scores, gt_boxes)
-        for img in range(len(gt_boxes_np)):
+        groups = []  # bbox: (img, k_idx, det_boxes, det_scores, gt_boxes)
+        #             segm: (img, k_idx, iou, d_area, det_scores, g_area)
+        for img in range(len(gt_items)):
             for k_idx, cls in enumerate(class_ids):
                 dmask = det_labels_np[img] == cls if img < len(det_labels_np) else np.zeros(0, bool)
                 gmask = gt_labels_np[img] == cls
                 if not dmask.any() and not gmask.any():
                     continue
-                db = det_boxes_np[img][dmask]
                 ds = det_scores_np[img][dmask]
                 order = np.argsort(-ds, kind="stable")[:max_det]
-                groups.append((img, k_idx, db[order], ds[order], gt_boxes_np[img][gmask]))
+                if self.iou_type == "segm":
+                    d_all, g_all = det_items[img], gt_items[img]
+                    # explicit pixel counts: reshape(-1) cannot infer a dim on
+                    # empty selections
+                    d_pix = int(np.prod(d_all.shape[1:]))
+                    g_pix = int(np.prod(g_all.shape[1:]))
+                    dm = d_all[dmask][order].reshape(len(order), d_pix)
+                    gm = g_all[gmask].reshape(int(gmask.sum()), g_pix)
+                    df = dm.astype(np.float32)
+                    gf = gm.astype(np.float32)
+                    d_area = df.sum(1)
+                    g_area = gf.sum(1)
+                    if dm.size and gm.size:
+                        if dm.shape[1] != gm.shape[1]:
+                            raise ValueError(
+                                f"prediction and target masks of image {img} have different"
+                                f" spatial sizes ({dm.shape[1]} vs {gm.shape[1]} pixels)"
+                            )
+                        inter = df @ gf.T
+                        union = d_area[:, None] + g_area[None, :] - inter
+                        iou = np.where(union > 0, inter / np.maximum(union, 1.0), 0.0)
+                    else:
+                        iou = np.zeros((dm.shape[0], gm.shape[0]), np.float32)
+                    groups.append((img, k_idx, iou.astype(np.float32), d_area, ds[order], g_area))
+                else:
+                    db = det_items[img][dmask]
+                    groups.append((img, k_idx, db[order], ds[order], gt_items[img][gmask]))
         return groups
 
     def _calculate(self, class_ids: List[int]) -> Tuple[np.ndarray, np.ndarray]:
@@ -222,35 +259,63 @@ class MeanAveragePrecision(Metric):
 
         ng = len(groups)
         pad_n = _pow2(ng)
-        pad_d = _pow2(max(1, max(g[2].shape[0] for g in groups)))
-        pad_g = _pow2(max(1, max(g[4].shape[0] for g in groups)))
-
-        det_boxes = np.zeros((pad_n, pad_d, 4), np.float32)
-        det_scores = np.full((pad_n, pad_d), -np.inf, np.float32)
-        det_valid = np.zeros((pad_n, pad_d), bool)
-        gt_boxes = np.zeros((pad_n, pad_g, 4), np.float32)
-        gt_valid = np.zeros((pad_n, pad_g), bool)
-        group_img = np.zeros(ng, np.int64)
-        group_cls = np.zeros(ng, np.int64)
-        for i, (img, k_idx, db, ds, gb) in enumerate(groups):
-            group_img[i], group_cls[i] = img, k_idx
-            det_boxes[i, : db.shape[0]] = db
-            det_scores[i, : ds.shape[0]] = ds
-            det_valid[i, : db.shape[0]] = True
-            gt_boxes[i, : gb.shape[0]] = gb
-            gt_valid[i, : gb.shape[0]] = True
-
         area_ranges = np.asarray(list(self.bbox_area_ranges.values()), np.float32)
-        det_matched, det_ignored, npig_ga = jax.device_get(
-            _match_groups(
-                jnp.asarray(det_boxes),
-                jnp.asarray(det_valid),
-                jnp.asarray(gt_boxes),
-                jnp.asarray(gt_valid),
-                jnp.asarray(self.iou_thresholds, jnp.float32),
-                jnp.asarray(area_ranges),
+        group_cls = np.zeros(ng, np.int64)
+
+        def pack(shape_tail, dtype=np.float32, fill=0.0):
+            return np.full((pad_n, *shape_tail), fill, dtype)
+
+        pad_d = _pow2(max(1, max(g[2].shape[0] for g in groups)))
+        n_gt = 5 if self.iou_type == "segm" else 4
+        pad_g = _pow2(max(1, max(g[n_gt].shape[0] for g in groups)))
+        det_scores = pack((pad_d,), fill=-np.inf)
+        det_valid = pack((pad_d,), bool, False)
+        gt_valid = pack((pad_g,), bool, False)
+
+        if self.iou_type == "segm":
+            iou = pack((pad_d, pad_g))
+            d_area = pack((pad_d,))
+            g_area = pack((pad_g,))
+            for i, (img, k_idx, giou, da, ds, ga) in enumerate(groups):
+                group_cls[i] = k_idx
+                iou[i, : giou.shape[0], : giou.shape[1]] = giou
+                d_area[i, : da.shape[0]] = da
+                g_area[i, : ga.shape[0]] = ga
+                det_scores[i, : ds.shape[0]] = ds
+                det_valid[i, : da.shape[0]] = True
+                gt_valid[i, : ga.shape[0]] = True
+            det_matched, det_ignored, npig_ga = jax.device_get(
+                _match_groups_from_iou(
+                    jnp.asarray(iou),
+                    jnp.asarray(d_area),
+                    jnp.asarray(g_area),
+                    jnp.asarray(det_valid),
+                    jnp.asarray(gt_valid),
+                    jnp.asarray(self.iou_thresholds, jnp.float32),
+                    jnp.asarray(area_ranges),
+                )
             )
-        )
+        else:
+            det_boxes = pack((pad_d, 4))
+            gt_boxes = pack((pad_g, 4))
+            for i, (img, k_idx, db, ds, gb) in enumerate(groups):
+                group_cls[i] = k_idx
+                det_boxes[i, : db.shape[0]] = db
+                det_scores[i, : ds.shape[0]] = ds
+                det_valid[i, : db.shape[0]] = True
+                gt_boxes[i, : gb.shape[0]] = gb
+                gt_valid[i, : gb.shape[0]] = True
+
+            det_matched, det_ignored, npig_ga = jax.device_get(
+                _match_groups(
+                    jnp.asarray(det_boxes),
+                    jnp.asarray(det_valid),
+                    jnp.asarray(gt_boxes),
+                    jnp.asarray(gt_valid),
+                    jnp.asarray(self.iou_thresholds, jnp.float32),
+                    jnp.asarray(area_ranges),
+                )
+            )
         det_matched = det_matched[:ng]   # (ng, A, T, D)
         det_ignored = det_ignored[:ng]
         npig_ga = npig_ga[:ng]           # (ng, A)

@@ -8,6 +8,11 @@ with all IoU thresholds evaluated simultaneously as a vectorized ``(T, G)`` mask
 update, ``vmap``-ed over COCO area ranges and again over all (image, class) evaluation
 groups. Shapes are static (padded to power-of-two buckets by the caller), so XLA
 compiles one fused kernel that runs entirely on device.
+
+Two entry points share the matching core: ``_match_groups`` computes box IoU/areas
+itself; ``_match_groups_from_iou`` takes precomputed IoU + areas (the segm path —
+dense mask IoU is a matmul, so no pycocotools RLE is needed; reference
+``detection/mean_ap.py:345`` requires it).
 """
 from metrics_tpu.utils.data import _next_pow2
 
@@ -16,6 +21,45 @@ from jax import Array
 import jax.numpy as jnp
 
 from metrics_tpu.functional.detection.box_ops import box_area, box_iou
+
+
+def _per_group_from_iou(iou, d_area, g_area, dv, gv, iou_thresholds, area_ranges):
+    """Greedy matching for one group given its (D, G) IoU and element areas."""
+    num_t = iou_thresholds.shape[0]
+    num_g = iou.shape[1]
+    iou = jnp.where(dv[:, None] & gv[None, :], iou, 0.0)
+
+    def per_area(rng):
+        lo, hi = rng[0], rng[1]
+        g_ignore_area = (g_area < lo) | (g_area > hi)
+        # parity: reference sorts gts ignored-last before matching (:558-564)
+        sort_key = g_ignore_area.astype(jnp.int32) + 2 * (~gv).astype(jnp.int32)
+        perm = jnp.argsort(sort_key, stable=True)
+        iou_p = iou[:, perm]
+        g_ignore = (g_ignore_area | ~gv)[perm]  # (G,)
+
+        def step(gt_matches, inp):
+            # one detection, all T thresholds at once; ignored gts never match
+            # (parity with reference _find_best_gt_match :628-635)
+            row, valid_d = inp
+            remove = gt_matches | g_ignore[None, :]
+            cand = jnp.where(remove, 0.0, row[None, :])  # (T, G)
+            m = jnp.argmax(cand, axis=1)
+            best = jnp.take_along_axis(cand, m[:, None], axis=1)[:, 0]
+            matched = (best > iou_thresholds) & valid_d
+            hit = (jnp.arange(num_g)[None, :] == m[:, None]) & matched[:, None]
+            return gt_matches | hit, matched
+
+        gt_matches0 = jnp.zeros((num_t, num_g), bool)
+        _, det_matched = jax.lax.scan(step, gt_matches0, (iou_p, dv))
+        det_matched = det_matched.T  # (T, D)
+        d_outside = (d_area < lo) | (d_area > hi)
+        # unmatched out-of-range dets are ignored (:592-598); padding is always ignored
+        det_ignored = (~det_matched & d_outside[None, :]) | ~dv[None, :]
+        npig = jnp.sum(gv & ~g_ignore_area)
+        return det_matched, det_ignored, npig
+
+    return jax.vmap(per_area)(area_ranges)
 
 
 @jax.jit
@@ -27,53 +71,34 @@ def _match_groups(
     iou_thresholds: Array,  # (T,)
     area_ranges: Array,     # (A, 2) [lo, hi] area bounds
 ):
-    """Greedy COCO matching for all groups x area ranges x IoU thresholds at once.
+    """Box matching for all groups x area ranges x IoU thresholds at once.
 
     Returns ``det_matched (N, A, T, D)``, ``det_ignored (N, A, T, D)`` and
     ``npig (N, A)`` — the number of non-ignored ground truths per group/area.
     """
-    num_t = iou_thresholds.shape[0]
 
     def per_group(db, dv, gb, gv):
-        iou = box_iou(db, gb)  # (D, G)
-        iou = jnp.where(dv[:, None] & gv[None, :], iou, 0.0)
-        d_area = box_area(db)
-        g_area = box_area(gb)
-        num_g = gb.shape[0]
-
-        def per_area(rng):
-            lo, hi = rng[0], rng[1]
-            g_ignore_area = (g_area < lo) | (g_area > hi)
-            # parity: reference sorts gts ignored-last before matching (:558-564)
-            sort_key = g_ignore_area.astype(jnp.int32) + 2 * (~gv).astype(jnp.int32)
-            perm = jnp.argsort(sort_key, stable=True)
-            iou_p = iou[:, perm]
-            g_ignore = (g_ignore_area | ~gv)[perm]  # (G,)
-
-            def step(gt_matches, inp):
-                # one detection, all T thresholds at once; ignored gts never match
-                # (parity with reference _find_best_gt_match :628-635)
-                row, valid_d = inp
-                remove = gt_matches | g_ignore[None, :]
-                cand = jnp.where(remove, 0.0, row[None, :])  # (T, G)
-                m = jnp.argmax(cand, axis=1)
-                best = jnp.take_along_axis(cand, m[:, None], axis=1)[:, 0]
-                matched = (best > iou_thresholds) & valid_d
-                hit = (jnp.arange(num_g)[None, :] == m[:, None]) & matched[:, None]
-                return gt_matches | hit, matched
-
-            gt_matches0 = jnp.zeros((num_t, num_g), bool)
-            _, det_matched = jax.lax.scan(step, gt_matches0, (iou_p, dv))
-            det_matched = det_matched.T  # (T, D)
-            d_outside = (d_area < lo) | (d_area > hi)
-            # unmatched out-of-range dets are ignored (:592-598); padding is always ignored
-            det_ignored = (~det_matched & d_outside[None, :]) | ~dv[None, :]
-            npig = jnp.sum(gv & ~g_ignore_area)
-            return det_matched, det_ignored, npig
-
-        return jax.vmap(per_area)(area_ranges)
+        return _per_group_from_iou(box_iou(db, gb), box_area(db), box_area(gb), dv, gv, iou_thresholds, area_ranges)
 
     return jax.vmap(per_group)(det_boxes, det_valid, gt_boxes, gt_valid)
+
+
+@jax.jit
+def _match_groups_from_iou(
+    iou: Array,        # (N, D, G) precomputed per-group IoU, score-sorted rows
+    d_area: Array,     # (N, D)
+    g_area: Array,     # (N, G)
+    det_valid: Array,  # (N, D) bool
+    gt_valid: Array,   # (N, G) bool
+    iou_thresholds: Array,
+    area_ranges: Array,
+):
+    """Same matching from precomputed IoU/areas (mask IoU for ``iou_type="segm"``)."""
+
+    def per_group(i, da, ga, dv, gv):
+        return _per_group_from_iou(i, da, ga, dv, gv, iou_thresholds, area_ranges)
+
+    return jax.vmap(per_group)(iou, d_area, g_area, det_valid, gt_valid)
 
 
 _pow2 = _next_pow2  # shared bucketing helper (utils/data.py)

@@ -233,14 +233,22 @@ def jax_bert_encoder(
     eps = layer_norm_eps if layer_norm_eps is not None else (1e-5 if variant == "roberta" else 1e-12)
 
     pad_id = getattr(tokenizer, "pad_token_id", None) or 0
+    # RoBERTa position ids run cumsum(mask)+padding_idx: bound usable length by
+    # the table minus that offset (same guard as jax_mlm_logits_fn)
+    table = int(params["pos_emb"].shape[0])
+    max_seq = min(max_length, table - 2 if variant == "roberta" else table)
 
     def encoder(sentences: Sequence[str]) -> Tuple[Array, np.ndarray, np.ndarray]:
         batch = tokenizer(
-            list(sentences), padding=True, truncation=True, max_length=max_length, return_tensors="np"
+            list(sentences), padding=True, truncation=True, max_length=max_seq, return_tensors="np"
         )
         ids = np.asarray(batch["input_ids"])
         mask = np.asarray(batch["attention_mask"])
-        ids_p, mask_p = pad_token_batch(ids, mask, pad_id, cap=max_length)
+        if ids.shape[1] > max_seq:
+            raise ValueError(
+                f"tokenizer produced length {ids.shape[1]} > usable position range {max_seq}"
+            )
+        ids_p, mask_p = pad_token_batch(ids, mask, pad_id, cap=max_seq)
         pos = bert_position_ids(mask_p, variant)
         out = bert_forward(params, jnp.asarray(ids_p), jnp.asarray(mask_p), jnp.asarray(pos), heads, eps)
         return out, ids_p, mask_p

@@ -310,7 +310,7 @@ def test_map_errors():
     with pytest.raises(ValueError, match="Expected argument `box_format`"):
         MeanAveragePrecision(box_format="foo")
     with pytest.raises(ValueError, match="iou_type"):
-        MeanAveragePrecision(iou_type="segm")
+        MeanAveragePrecision(iou_type="rle")
 
 
 def test_map_box_format_xywh():

@@ -1,0 +1,99 @@
+"""Mask-IoU mAP (iou_type="segm") — the dense-matmul redesign of the reference's
+pycocotools-RLE path (reference detection/mean_ap.py:345).
+
+Oracle: axis-aligned rectangular masks matching boxes exactly must produce the
+SAME result as iou_type="bbox" on the equivalent boxes (identical IoU matrices
+feed the shared matching kernel), plus hand-checkable degenerate cases.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu.detection import MeanAveragePrecision
+
+H = W = 64
+
+
+def _rect_mask(box):
+    x0, y0, x1, y1 = (int(round(v)) for v in box)
+    m = np.zeros((H, W), bool)
+    m[y0:y1, x0:x1] = True
+    return m
+
+
+def _make_pair(seed, n_images=4):
+    rng = np.random.RandomState(seed)
+    preds_b, target_b, preds_m, target_m = [], [], [], []
+    for _ in range(n_images):
+        nd, ng = rng.randint(1, 5), rng.randint(1, 4)
+        db = np.zeros((nd, 4))
+        gb = np.zeros((ng, 4))
+        for arr, n in ((db, nd), (gb, ng)):
+            for i in range(n):
+                x0, y0 = rng.randint(0, W - 12, 2)
+                w, h = rng.randint(4, 12, 2)
+                arr[i] = [x0, y0, x0 + w, y0 + h]
+        scores = rng.rand(nd).astype(np.float32)
+        dl = rng.randint(0, 2, nd).astype(np.int32)
+        gl = rng.randint(0, 2, ng).astype(np.int32)
+        preds_b.append({"boxes": jnp.asarray(db, jnp.float32), "scores": jnp.asarray(scores), "labels": jnp.asarray(dl)})
+        target_b.append({"boxes": jnp.asarray(gb, jnp.float32), "labels": jnp.asarray(gl)})
+        preds_m.append(
+            {"masks": jnp.asarray(np.stack([_rect_mask(b) for b in db])), "scores": jnp.asarray(scores),
+             "labels": jnp.asarray(dl)}
+        )
+        target_m.append({"masks": jnp.asarray(np.stack([_rect_mask(b) for b in gb])), "labels": jnp.asarray(gl)})
+    return preds_b, target_b, preds_m, target_m
+
+
+@pytest.mark.parametrize("seed", [0, 7])
+def test_rect_masks_equal_bbox_map(seed):
+    preds_b, target_b, preds_m, target_m = _make_pair(seed)
+
+    bbox = MeanAveragePrecision(iou_type="bbox")
+    bbox.update(preds_b, target_b)
+    expected = bbox.compute()
+
+    segm = MeanAveragePrecision(iou_type="segm")
+    segm.update(preds_m, target_m)
+    got = segm.compute()
+
+    for key in ("map", "map_50", "map_75", "mar_100", "map_small"):
+        assert float(got[key]) == pytest.approx(float(expected[key]), abs=1e-6), key
+
+
+def test_perfect_and_disjoint_masks():
+    m1 = _rect_mask([4, 4, 20, 20])
+    m2 = _rect_mask([40, 40, 60, 60])
+    preds = [{"masks": jnp.asarray(m1[None]), "scores": jnp.asarray([0.9]), "labels": jnp.asarray([0])}]
+    target = [{"masks": jnp.asarray(m1[None]), "labels": jnp.asarray([0])}]
+    perfect = MeanAveragePrecision(iou_type="segm")
+    perfect.update(preds, target)
+    assert float(perfect.compute()["map"]) == pytest.approx(1.0, abs=1e-6)
+
+    miss = MeanAveragePrecision(iou_type="segm")
+    miss.update(
+        [{"masks": jnp.asarray(m2[None]), "scores": jnp.asarray([0.9]), "labels": jnp.asarray([0])}], target
+    )
+    assert float(miss.compute()["map"]) == pytest.approx(0.0, abs=1e-6)
+
+
+def test_segm_requires_masks_key():
+    metric = MeanAveragePrecision(iou_type="segm")
+    with pytest.raises(ValueError, match="masks"):
+        metric.update(
+            [{"boxes": jnp.zeros((1, 4)), "scores": jnp.asarray([0.5]), "labels": jnp.asarray([0])}],
+            [{"masks": jnp.zeros((1, 8, 8), bool), "labels": jnp.asarray([0])}],
+        )
+
+
+def test_segm_mismatched_mask_sizes_raise():
+    metric = MeanAveragePrecision(iou_type="segm")
+    metric.update(
+        [{"masks": jnp.zeros((1, 8, 8), bool).at[0, 2:5, 2:5].set(True), "scores": jnp.asarray([0.5]),
+          "labels": jnp.asarray([0])}],
+        [{"masks": jnp.zeros((1, 16, 16), bool).at[0, 2:5, 2:5].set(True), "labels": jnp.asarray([0])}],
+    )
+    with pytest.raises(ValueError, match="spatial sizes"):
+        metric.compute()
