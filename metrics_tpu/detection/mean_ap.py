@@ -204,8 +204,8 @@ class MeanAveragePrecision(Metric):
         det_labels_np = [np.asarray(l).reshape(-1) for l in host[2]]
         gt_labels_np = [np.asarray(l).reshape(-1) for l in host[4]]
 
-        groups = []  # bbox: (img, k_idx, det_boxes, det_scores, gt_boxes)
-        #             segm: (img, k_idx, iou, d_area, det_scores, g_area)
+        groups = []  # bbox: (k_idx, det_boxes, det_scores, gt_boxes)
+        #             segm: (k_idx, iou, d_area, det_scores, g_area)
         for img in range(len(gt_items)):
             for k_idx, cls in enumerate(class_ids):
                 dmask = det_labels_np[img] == cls if img < len(det_labels_np) else np.zeros(0, bool)
@@ -233,14 +233,16 @@ class MeanAveragePrecision(Metric):
                                 f" spatial sizes ({dm.shape[1]} vs {gm.shape[1]} pixels)"
                             )
                         inter = df @ gf.T
+                        # binary masks -> integer-valued union; clamp covers the
+                        # both-empty case (iou 0 there since inter is 0)
                         union = d_area[:, None] + g_area[None, :] - inter
-                        iou = np.where(union > 0, inter / np.maximum(union, 1.0), 0.0)
+                        iou = inter / np.maximum(union, 1.0)
                     else:
                         iou = np.zeros((dm.shape[0], gm.shape[0]), np.float32)
-                    groups.append((img, k_idx, iou.astype(np.float32), d_area, ds[order], g_area))
+                    groups.append((k_idx, iou.astype(np.float32), d_area, ds[order], g_area))
                 else:
                     db = det_items[img][dmask]
-                    groups.append((img, k_idx, db[order], ds[order], gt_items[img][gmask]))
+                    groups.append((k_idx, db[order], ds[order], gt_items[img][gmask]))
         return groups
 
     def _calculate(self, class_ids: List[int]) -> Tuple[np.ndarray, np.ndarray]:
@@ -265,8 +267,8 @@ class MeanAveragePrecision(Metric):
         def pack(shape_tail, dtype=np.float32, fill=0.0):
             return np.full((pad_n, *shape_tail), fill, dtype)
 
-        pad_d = _pow2(max(1, max(g[2].shape[0] for g in groups)))
-        n_gt = 5 if self.iou_type == "segm" else 4
+        pad_d = _pow2(max(1, max(g[1].shape[0] for g in groups)))
+        n_gt = 4 if self.iou_type == "segm" else 3
         pad_g = _pow2(max(1, max(g[n_gt].shape[0] for g in groups)))
         det_scores = pack((pad_d,), fill=-np.inf)
         det_valid = pack((pad_d,), bool, False)
@@ -276,7 +278,7 @@ class MeanAveragePrecision(Metric):
             iou = pack((pad_d, pad_g))
             d_area = pack((pad_d,))
             g_area = pack((pad_g,))
-            for i, (img, k_idx, giou, da, ds, ga) in enumerate(groups):
+            for i, (k_idx, giou, da, ds, ga) in enumerate(groups):
                 group_cls[i] = k_idx
                 iou[i, : giou.shape[0], : giou.shape[1]] = giou
                 d_area[i, : da.shape[0]] = da
@@ -298,7 +300,7 @@ class MeanAveragePrecision(Metric):
         else:
             det_boxes = pack((pad_d, 4))
             gt_boxes = pack((pad_g, 4))
-            for i, (img, k_idx, db, ds, gb) in enumerate(groups):
+            for i, (k_idx, db, ds, gb) in enumerate(groups):
                 group_cls[i] = k_idx
                 det_boxes[i, : db.shape[0]] = db
                 det_scores[i, : ds.shape[0]] = ds

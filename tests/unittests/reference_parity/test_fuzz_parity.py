@@ -110,3 +110,80 @@ def test_fuzz_regression_case(ref, seed):
     theirs = ref_fn(torch.from_numpy(np.asarray(preds)), torch.from_numpy(np.asarray(target)), **kwargs)
     ours = our_fn(jnp.asarray(preds), jnp.asarray(target), **kwargs)
     assert_close(ours, theirs, atol=1e-4)
+
+
+# ----------------------------------------------------------- text domain
+
+_WORDS = [
+    "the", "a", "cat", "dog", "sat", "ran", "on", "under", "mat", "tree",
+    "fast", "slow", "red", "blue", "big", "jumped", "house", "bird", "saw", "ate",
+]
+
+
+def _rand_sentence(rng, lo=1, hi=12):
+    return " ".join(rng.choice(_WORDS, rng.randint(lo, hi)))
+
+
+def _draw_text_case(seed):
+    rng = np.random.RandomState(2000 + seed)
+    name = rng.choice(
+        ["word_error_rate", "char_error_rate", "match_error_rate",
+         "word_information_lost", "word_information_preserved", "bleu_score", "chrf_score"]
+    )
+    n = int(rng.choice([1, 3, 8]))
+    preds = [_rand_sentence(rng) for _ in range(n)]
+    if rng.rand() < 0.3:  # some predictions identical to targets
+        target = list(preds)
+    else:
+        target = [_rand_sentence(rng) for _ in range(n)]
+    if name == "bleu_score":
+        # reference signature: (preds, target) with target as list-of-references
+        return name, preds, [[t] for t in target], {"n_gram": int(rng.choice([1, 2, 3]))}
+    return name, preds, target, {}
+
+
+@pytest.mark.parametrize("seed", range(40))
+def test_fuzz_text_case(ref, seed):
+    import metrics_tpu.functional.text as T
+
+    name, preds, target, kwargs = _draw_text_case(seed)
+    ref_fn = getattr(ref.functional.text, name)
+    our_fn = getattr(T, name)
+    theirs = ref_fn(preds, target, **kwargs)
+    ours = our_fn(preds, target, **kwargs)
+    assert_close(ours, theirs, atol=1e-5)
+
+
+# ------------------------------------------------------ retrieval domain
+
+def _draw_retrieval_case(seed):
+    rng = np.random.RandomState(3000 + seed)
+    name = rng.choice(
+        ["retrieval_average_precision", "retrieval_reciprocal_rank", "retrieval_normalized_dcg",
+         "retrieval_precision", "retrieval_recall", "retrieval_hit_rate", "retrieval_fall_out",
+         "retrieval_r_precision"]
+    )
+    n = int(rng.choice([1, 4, 17, 50]))
+    preds = rng.rand(n).astype(np.float32)
+    target = (rng.rand(n) > rng.choice([0.3, 0.7])).astype(np.int64)
+    if not target.any():
+        target[rng.randint(n)] = 1  # ensure a positive (reference errors otherwise vary)
+    kwargs = {}
+    if name in ("retrieval_precision", "retrieval_recall", "retrieval_hit_rate"):
+        kwargs["top_k"] = int(rng.choice([1, 3, 10]))
+    return name, preds, target, kwargs
+
+
+@pytest.mark.parametrize("seed", range(30))
+def test_fuzz_retrieval_case(ref, seed):
+    import jax.numpy as jnp
+    import torch
+
+    import metrics_tpu.functional.retrieval as RT
+
+    name, preds, target, kwargs = _draw_retrieval_case(seed)
+    ref_fn = getattr(ref.functional.retrieval, name)
+    our_fn = getattr(RT, name)
+    theirs = ref_fn(torch.from_numpy(preds), torch.from_numpy(target), **kwargs)
+    ours = our_fn(jnp.asarray(preds), jnp.asarray(target), **kwargs)
+    assert_close(ours, theirs, atol=1e-5)
