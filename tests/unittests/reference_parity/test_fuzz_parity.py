@@ -57,17 +57,26 @@ def _draw_case(seed):
     return name, preds, target, kwargs
 
 
-@pytest.mark.parametrize("seed", range(N_CASES))
-def test_fuzz_case(ref, seed):
+
+
+def _compare(ref_fn, our_fn, args_np, kwargs, atol, text=False):
+    """Shared comparison protocol for every fuzz driver: run the reference on
+    torch tensors (or raw strings) and ours on jnp arrays, assert closeness."""
     import jax.numpy as jnp
     import torch
 
+    if text:
+        theirs = ref_fn(*args_np, **kwargs)
+        ours = our_fn(*args_np, **kwargs)
+    else:
+        theirs = ref_fn(*[torch.from_numpy(np.asarray(a)) for a in args_np], **kwargs)
+        ours = our_fn(*[jnp.asarray(a) for a in args_np], **kwargs)
+    assert_close(ours, theirs, atol=atol)
+
+@pytest.mark.parametrize("seed", range(N_CASES))
+def test_fuzz_case(ref, seed):
     name, preds, target, kwargs = _draw_case(seed)
-    ref_fn = getattr(ref.functional.classification, name)
-    our_fn = getattr(F, name)
-    theirs = ref_fn(torch.from_numpy(np.asarray(preds)), torch.from_numpy(np.asarray(target)), **kwargs)
-    ours = our_fn(jnp.asarray(preds), jnp.asarray(target), **kwargs)
-    assert_close(ours, theirs, atol=1e-5)
+    _compare(getattr(ref.functional.classification, name), getattr(F, name), (preds, target), kwargs, 1e-5)
 
 
 # ------------------------------------------------------- regression domain
@@ -99,17 +108,11 @@ def _draw_regression_case(seed):
 
 @pytest.mark.parametrize("seed", range(40))
 def test_fuzz_regression_case(ref, seed):
-    import jax.numpy as jnp
-    import torch
-
     import metrics_tpu.functional.regression as R
 
     name, preds, target, kwargs = _draw_regression_case(seed)
     ref_fn = getattr(ref.functional.regression, name, None) or getattr(ref.functional, name)
-    our_fn = getattr(R, name)
-    theirs = ref_fn(torch.from_numpy(np.asarray(preds)), torch.from_numpy(np.asarray(target)), **kwargs)
-    ours = our_fn(jnp.asarray(preds), jnp.asarray(target), **kwargs)
-    assert_close(ours, theirs, atol=1e-4)
+    _compare(ref_fn, getattr(R, name), (preds, target), kwargs, 1e-4)
 
 
 # ----------------------------------------------------------- text domain
@@ -147,11 +150,7 @@ def test_fuzz_text_case(ref, seed):
     import metrics_tpu.functional.text as T
 
     name, preds, target, kwargs = _draw_text_case(seed)
-    ref_fn = getattr(ref.functional.text, name)
-    our_fn = getattr(T, name)
-    theirs = ref_fn(preds, target, **kwargs)
-    ours = our_fn(preds, target, **kwargs)
-    assert_close(ours, theirs, atol=1e-5)
+    _compare(getattr(ref.functional.text, name), getattr(T, name), (preds, target), kwargs, 1e-5, text=True)
 
 
 # ------------------------------------------------------ retrieval domain
@@ -176,14 +175,75 @@ def _draw_retrieval_case(seed):
 
 @pytest.mark.parametrize("seed", range(30))
 def test_fuzz_retrieval_case(ref, seed):
-    import jax.numpy as jnp
-    import torch
-
     import metrics_tpu.functional.retrieval as RT
 
     name, preds, target, kwargs = _draw_retrieval_case(seed)
-    ref_fn = getattr(ref.functional.retrieval, name)
-    our_fn = getattr(RT, name)
-    theirs = ref_fn(torch.from_numpy(preds), torch.from_numpy(target), **kwargs)
-    ours = our_fn(jnp.asarray(preds), jnp.asarray(target), **kwargs)
-    assert_close(ours, theirs, atol=1e-5)
+    _compare(getattr(ref.functional.retrieval, name), getattr(RT, name), (preds, target), kwargs, 1e-5)
+
+
+# --------------------------------------------------------- audio domain
+
+def _draw_audio_case(seed):
+    rng = np.random.RandomState(4000 + seed)
+    name = rng.choice(
+        ["signal_noise_ratio", "scale_invariant_signal_noise_ratio",
+         "scale_invariant_signal_distortion_ratio", "signal_distortion_ratio"]
+    )
+    b = int(rng.choice([1, 2, 4]))
+    t = int(rng.choice([64, 256, 1000]))
+    preds = rng.randn(b, t).astype(np.float32)
+    target = (preds * rng.choice([0.5, 1.0]) + rng.randn(b, t) * rng.choice([0.05, 0.5])).astype(np.float32)
+    kwargs = {}
+    if name == "signal_noise_ratio":
+        kwargs["zero_mean"] = bool(rng.rand() < 0.5)
+    if name == "signal_distortion_ratio":
+        t = 1000  # needs length > default filter taps
+        preds = rng.randn(b, t).astype(np.float32)
+        target = (preds + rng.randn(b, t) * 0.1).astype(np.float32)
+    return name, preds, target, kwargs
+
+
+@pytest.mark.parametrize("seed", range(24))
+def test_fuzz_audio_case(ref, seed):
+    import metrics_tpu.functional.audio as A
+
+    name, preds, target, kwargs = _draw_audio_case(seed)
+    atol = 1e-2 if name == "signal_distortion_ratio" else 1e-4  # toeplitz solve f32
+    _compare(getattr(ref.functional.audio, name), getattr(A, name), (preds, target), kwargs, atol)
+
+
+# --------------------------------------------------------- image domain
+
+def _draw_image_case(seed):
+    rng = np.random.RandomState(5000 + seed)
+    name = rng.choice(
+        ["peak_signal_noise_ratio", "structural_similarity_index_measure",
+         "universal_image_quality_index", "total_variation", "spectral_angle_mapper",
+         "error_relative_global_dimensionless_synthesis"]
+    )
+    b = int(rng.choice([1, 2]))
+    hw = int(rng.choice([16, 33]))
+    preds = rng.rand(b, 3, hw, hw).astype(np.float32)
+    target = np.clip(preds + rng.randn(b, 3, hw, hw) * rng.choice([0.02, 0.2]), 0, 1).astype(np.float32)
+    kwargs = {}
+    if name == "peak_signal_noise_ratio":
+        kwargs["data_range"] = 1.0
+    if name == "structural_similarity_index_measure":
+        kwargs["data_range"] = 1.0
+        if rng.rand() < 0.3:
+            kwargs["gaussian_kernel"] = False
+            kwargs["kernel_size"] = 5
+    if name == "error_relative_global_dimensionless_synthesis":
+        preds = preds + 0.1
+        target = target + 0.1
+    return name, preds, target, kwargs
+
+
+@pytest.mark.parametrize("seed", range(24))
+def test_fuzz_image_case(ref, seed):
+    import metrics_tpu.functional.image as I
+
+    name, preds, target, kwargs = _draw_image_case(seed)
+    ref_fn = getattr(ref.functional.image, name, None) or getattr(ref.functional, name)
+    args = (preds,) if name == "total_variation" else (preds, target)
+    _compare(ref_fn, getattr(I, name), args, kwargs, 1e-4)
