@@ -11,16 +11,17 @@ format+argmax path (the README example users actually run).
 
 Measurement design (hardened across rounds):
 - **Real HBM traffic every step.** Each pass chains 4 dependent jitted updates
-  over two alternating device-resident (2^28,) buffer pairs — 1.07B preds/pass,
-  0.5 GB of fresh reads per update (far beyond VMEM, so nothing can be cached, and
+  over two alternating device-resident (2^30,) buffer pairs — 4.3B preds/pass,
+  2 GB of fresh reads per update (far beyond VMEM, so nothing can be cached, and
   separate XLA executions cannot be loop-invariant-hoisted the way a scanned
   fixed buffer was in round 1's impossible >1 Tpreds/s readings). A dispatch
   loop rather than ``lax.scan`` also measures ~6x faster here: consecutive
   executions pipeline reads against compute, which a serialized scan body does
-  not.
+  not. Big dispatches amortize tunnel latency: in the same slow-tunnel window,
+  2^30 chunks measured 108 Gpreds/s where 2^28 chunks measured 67.
 - **One true sync, RTT amortized.** On the tunneled backend only a device->host
   value fetch is a trustworthy sync, and one round trip costs ~100 ms. The timed
-  region queues R=20 passes (the device executes dispatches in order) and
+  region queues R=5 passes (the device executes dispatches in order) and
   fetches the final state once.
 - A sanity assert pins the computed accuracy to the expected ~0.2 for uniform
   5-class labels, so a silently-wrong kernel cannot post a number.
@@ -44,9 +45,12 @@ import time
 import jax
 import jax.numpy as jnp
 
-CHUNK = 1 << 28  # elements per update; 0.5 GB of int8 reads per step
-STEPS = 4        # updates per pass -> 1.07e9 preds per pass
-REPEATS = 20
+# 1 GB buffers: 2 GB of fresh reads per dispatch amortizes the tunnel's
+# per-dispatch latency (measured 1.3-10 ms depending on session), making the
+# recorded number track the kernel rather than the transport
+CHUNK = 1 << 30  # elements per update
+STEPS = 4        # updates per pass -> 4.3e9 preds per pass
+REPEATS = 5
 
 
 def bench_tpu() -> float:
@@ -58,10 +62,11 @@ def bench_tpu() -> float:
     bufs = []
     for _ in range(2):
         k1, k2, key = jax.random.split(key, 3)
-        # int8 labels: 5 classes fit comfortably and the streaming kernel is
-        # HBM-bound, so narrower label buffers directly raise throughput
-        preds = jax.random.randint(k1, (CHUNK,), 0, 5, dtype=jnp.int32).astype(jnp.int8)
-        target = jax.random.randint(k2, (CHUNK,), 0, 5, dtype=jnp.int32).astype(jnp.int8)
+        # int8 labels generated directly: 5 classes fit, the streaming kernel is
+        # HBM-bound (narrower buffers raise throughput), and an int32 intermediate
+        # would transiently cost 4 GB per buffer at this CHUNK
+        preds = jax.random.randint(k1, (CHUNK,), 0, 5, dtype=jnp.int8)
+        target = jax.random.randint(k2, (CHUNK,), 0, 5, dtype=jnp.int8)
         bufs.append((preds, target))
 
     update = jax.jit(metric.local_update)

@@ -190,16 +190,15 @@ def _draw_audio_case(seed):
          "scale_invariant_signal_distortion_ratio", "signal_distortion_ratio"]
     )
     b = int(rng.choice([1, 2, 4]))
-    t = int(rng.choice([64, 256, 1000]))
+    # SDR needs length > its default filter taps; branch before generating
+    t = 1000 if name == "signal_distortion_ratio" else int(rng.choice([64, 256, 1000]))
+    noise = 0.1 if name == "signal_distortion_ratio" else float(rng.choice([0.05, 0.5]))
+    scale = 1.0 if name == "signal_distortion_ratio" else float(rng.choice([0.5, 1.0]))
     preds = rng.randn(b, t).astype(np.float32)
-    target = (preds * rng.choice([0.5, 1.0]) + rng.randn(b, t) * rng.choice([0.05, 0.5])).astype(np.float32)
+    target = (preds * scale + rng.randn(b, t) * noise).astype(np.float32)
     kwargs = {}
     if name == "signal_noise_ratio":
         kwargs["zero_mean"] = bool(rng.rand() < 0.5)
-    if name == "signal_distortion_ratio":
-        t = 1000  # needs length > default filter taps
-        preds = rng.randn(b, t).astype(np.float32)
-        target = (preds + rng.randn(b, t) * 0.1).astype(np.float32)
     return name, preds, target, kwargs
 
 
