@@ -87,11 +87,12 @@ class RetrievalMetric(Metric, ABC):
         return {}
 
     def compute(self) -> Array:
-        indexes = dim_zero_cat(self.indexes)
-        preds = dim_zero_cat(self.preds)
-        target = dim_zero_cat(self.target)
+        from metrics_tpu.core.state import CatBuffer
 
         if self.empty_target_action == "error":
+            indexes = dim_zero_cat(self.indexes)
+            preds = dim_zero_cat(self.preds)
+            target = dim_zero_cat(self.target)
             # data-dependent raise cannot live under jit; run the kernel eagerly
             # once and reduce those results directly (no second kernel pass)
             scores, n_pos, valid = grouped_retrieval_scores(
@@ -103,6 +104,22 @@ class RetrievalMetric(Metric, ABC):
             n_keep = valid.sum()
             total = jnp.where(valid, scores, 0.0).sum()
             return jnp.where(n_keep > 0, total / jnp.maximum(n_keep, 1), 0.0).astype(jnp.float32)
+
+        if isinstance(self.indexes, CatBuffer) and _next_pow2(
+            max(int(self.indexes.valid_count()), 2)
+        ) >= self.indexes.capacity:
+            # a (near-)full buffer is ALREADY the dense padded form the kernel
+            # wants: unwritten/front-packed tail rows carry index fill -1 (an
+            # invalid query group). Feeding buffer data directly skips the eager
+            # values() trim (device slice) and the re-pad — several tunnel round
+            # trips per compute at large N. Under-filled buffers fall through to
+            # the trim path instead: running the O(N log N) segment sort over a
+            # mostly-empty capacity would cost far more than the trim.
+            indexes, preds, target = self.indexes.data, self.preds.data, self.target.data
+        else:
+            indexes = dim_zero_cat(self.indexes)
+            preds = dim_zero_cat(self.preds)
+            target = dim_zero_cat(self.target)
         # pad to the next power of two so streaming (growing list states) costs
         # at most log2(N) compilations instead of one per distinct length;
         # padding rows carry index -1 = invalid query group for the segment kernel
