@@ -40,6 +40,7 @@ Roofline (measured round 3, TPU v5e: 819 GB/s HBM):
   absolute Gpreds/s comparisons across rounds carry that error bar.
 """
 import json
+import statistics
 import time
 
 import jax
@@ -91,9 +92,23 @@ def bench_tpu() -> float:
     return max(timed(), timed())
 
 
-def bench_tpu_logits(n: int = 1 << 26, num_classes: int = 5, repeats: int = 8) -> dict:
+def bench_tpu_logits(n: int = 1 << 27, num_classes: int = 5, steps: int = 32, trials: int = 5) -> dict:
     """BASELINE config 1, README variant: float probability tensors through the
-    format+argmax path (reads 4*C+1 bytes per pred vs 2 for the labels variant)."""
+    fused format+argmax path (ops/streaming.py:argmax_correct_count).
+
+    Measurement (hardened round 4): 2.7 GB of logical reads per dispatch
+    (n=2^27 rows x 21 B) and a 32-deep dispatch queue. Shallow queues measure
+    the tunnel, not the kernel: the same kernel measured 3.7 Gpreds/s at 8
+    queued 2^26-row dispatches and 10.4 at 32 queued 2^27-row dispatches, while
+    per-dispatch RPC latency was ~7 ms. Recorded value is the p50 of `trials`
+    timed passes after a queue warm-up pass.
+
+    bound: a pure f32 sum over the same buffers (the read-traffic witness) p50s
+    15.0 Gpreds/s (~320 GB/s logical, ~510 GB/s physical with the 5->8 row
+    padding, 58% of HBM roofline — the highest read rate observed on this
+    chip); this kernel p50s 10.4 = 70% of that bound. Faster-but-inexact
+    lowerings rejected for tie semantics; full grid in ops/streaming.py and
+    experiments/logits_exp.py."""
     from metrics_tpu.classification import MulticlassAccuracy
 
     metric = MulticlassAccuracy(num_classes=num_classes, average="micro", validate_args=False)
@@ -112,16 +127,16 @@ def bench_tpu_logits(n: int = 1 << 26, num_classes: int = 5, repeats: int = 8) -
     def timed() -> float:
         t0 = time.perf_counter()
         state = metric.init_state()
-        for i in range(repeats * 4):
+        for i in range(steps):
             state = update(state, *bufs[i % 2])
         jax.device_get(state)
         dt = time.perf_counter() - t0
         value = float(metric.compute_from(jax.tree.map(jnp.asarray, state)))
         assert 0.15 < value < 0.25, f"sanity: uniform 5-class accuracy ~0.2, got {value}"
-        return repeats * 4 * n / dt
+        return steps * n / dt
 
-    timed()
-    tpu_eps = max(timed(), timed())
+    timed()  # queue warm-up
+    tpu_eps = statistics.median(timed() for _ in range(trials))
 
     # reference-equivalent torch-CPU kernel: argmax + eq + sum on float probs
     import torch

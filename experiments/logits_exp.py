@@ -1,0 +1,375 @@
+"""Experiment: where does the float-logits accuracy path lose its throughput?
+
+The README path (probs (N, C=5) f32 -> argmax -> eq -> sum) measured 7.9 Gpreds/s
+vs 126-182 for the int8-label path.  Read traffic is 4*C+1 = 21 B/pred, so the
+HBM roofline (819 GB/s, v5e) is ~39 Gpreds/s.  Hypotheses:
+
+H1 (layout): (N, 5) f32 with minor dim 5 is stored in padded (8,128) tiles ->
+    up to 25.6x read amplification.  Witness: on-device buffer size; a pure
+    sum() over the array vs over a flat (5N,) array.
+H2 (argmax lowering): variadic reduce (value,index) lowers worse than a chain
+    of elementwise max/select.  Witness: argmax vs max-only vs manual unrolled
+    compare chain.
+H3 (stream shape): like the int8 kernel, more independent streams in one
+    fusion raises the issue rate -> zip4 on the sample axis.
+
+Run on the real chip: python experiments/logits_exp.py [--n 26] [--reps 5]
+"""
+import argparse
+import statistics
+import time
+
+import jax
+import jax.numpy as jnp
+
+C = 5
+
+
+def device_size(x):
+    try:
+        return x._arrays[0].on_device_size_in_bytes()
+    except Exception:
+        return -1
+
+
+def make_bufs(n, key, transposed=False, flat=False, int8=False):
+    bufs = []
+    for _ in range(2):
+        k1, k2, key = jax.random.split(key, 3)
+        if int8:
+            probs = jax.random.randint(k1, (n,), 0, C, dtype=jnp.int8)
+        elif transposed:
+            probs = jax.random.uniform(k1, (C, n), jnp.float32)
+        elif flat:
+            probs = jax.random.uniform(k1, (n * C,), jnp.float32)
+        else:
+            probs = jax.random.uniform(k1, (n, C), jnp.float32)
+        target = jax.random.randint(k2, (n,), 0, C, dtype=jnp.int32).astype(jnp.int8)
+        bufs.append((probs, target))
+    return bufs, key
+
+
+def timed_passes(update, init, bufs, steps, n):
+    state = update(init, *bufs[0])
+    jax.device_get(state)  # compile
+    t0 = time.perf_counter()
+    state = init
+    for i in range(steps):
+        state = update(state, *bufs[i % 2])
+    jax.device_get(state)
+    dt = time.perf_counter() - t0
+    return steps * n / dt
+
+
+# ------------------------------------------------------------------ variants
+
+def v_baseline(s, p, t):
+    return s + jnp.sum(p.argmax(axis=1).astype(jnp.int8) == t, dtype=jnp.int32)
+
+
+def v_max_only(s, p, t):
+    # not accuracy; isolates reduce cost without index tracking
+    return s + jnp.sum(p.max(axis=1) > t.astype(jnp.float32), dtype=jnp.int32)
+
+
+def v_sum_only(s, p, t):
+    # pure f32 read-bound witness over the whole (N,C) buffer
+    return s + jnp.sum(p, dtype=jnp.float32).astype(jnp.int32)
+
+
+def v_unrolled(s, p, t):
+    # manual first-occurrence argmax as a compare/select chain over C columns
+    best = p[:, 0]
+    idx = jnp.zeros(p.shape[0], jnp.int8)
+    for c in range(1, C):
+        col = p[:, c]
+        better = col > best
+        best = jnp.where(better, col, best)
+        idx = jnp.where(better, jnp.int8(c), idx)
+    return s + jnp.sum(idx == t, dtype=jnp.int32)
+
+
+def v_rowmax_at_target(s, p, t):
+    # "is target's prob the row max" -- differs from argmax only on exact ties
+    rowmax = p.max(axis=1)
+    tv = jnp.take_along_axis(p, t.astype(jnp.int32)[:, None], axis=1)[:, 0]
+    return s + jnp.sum(tv >= rowmax, dtype=jnp.int32)
+
+
+def v_onehot_dot(s, p, t):
+    # value-at-target via elementwise one-hot multiply + minor-dim sum
+    oh = jax.nn.one_hot(t.astype(jnp.int32), C, dtype=p.dtype)
+    tv = (p * oh).sum(axis=1)
+    rowmax = p.max(axis=1)
+    return s + jnp.sum(tv >= rowmax, dtype=jnp.int32)
+
+
+def v_transposed(s, p, t):
+    # p is (C, N): argmax along axis 0 = chain of elementwise ops on (N,) rows
+    best = p[0]
+    idx = jnp.zeros(p.shape[1], jnp.int8)
+    for c in range(1, C):
+        better = p[c] > best
+        best = jnp.where(better, p[c], best)
+        idx = jnp.where(better, jnp.int8(c), idx)
+    return s + jnp.sum(idx == t, dtype=jnp.int32)
+
+
+def v_transpose_then(s, p, t):
+    # user gives (N, C); pay one explicit transpose then run the fast form
+    return v_transposed(s, p.T, t)
+
+
+def v_transposed_argmax(s, p, t):
+    # p is (C, N): let XLA lower argmax over the MAJOR dim (sublane reduction)
+    return s + jnp.sum(p.argmax(axis=0).astype(jnp.int8) == t, dtype=jnp.int32)
+
+
+def v_flat_strided(s, p, t):
+    # p is flat (N*C,) row-major; column c = p[c::C] strided slice
+    n = t.shape[0]
+    cols = [p[c::C] for c in range(C)]
+    best = cols[0]
+    idx = jnp.zeros(n, jnp.int8)
+    for c in range(1, C):
+        better = cols[c] > best
+        best = jnp.where(better, cols[c], best)
+        idx = jnp.where(better, jnp.int8(c), idx)
+    return s + jnp.sum(idx == t, dtype=jnp.int32)
+
+
+def v_flat_reshaped(s, p, t):
+    # p flat (N*C,) -> reshape to (N, C) inside the kernel, then baseline
+    n = t.shape[0]
+    return v_baseline(s, p.reshape(n, C), t)
+
+
+def v_int8_calib(s, p, t):
+    # harness calibration: the int8-label streaming kernel (bench headline path)
+    return s + jnp.sum(p == t, dtype=jnp.int32)
+
+
+def _zip_argmax(s, p, t, ways):
+    # zip the sample axis into `ways` independent streams whose int8 correct-masks
+    # are summed elementwise inside ONE fusion (the streaming.py zip4 trick)
+    n = t.shape[0]
+    q = n // ways
+    acc = None
+    for i in range(ways):
+        pi = p[i * q:(i + 1) * q]
+        ti = t[i * q:(i + 1) * q]
+        eq = (pi.argmax(axis=1).astype(jnp.int8) == ti).astype(jnp.int8)
+        acc = eq if acc is None else acc + eq
+    return s + jnp.sum(acc, dtype=jnp.int32)
+
+
+def v_zip2_argmax(s, p, t):
+    return _zip_argmax(s, p, t, 2)
+
+
+def v_zip4_argmax(s, p, t):
+    return _zip_argmax(s, p, t, 4)
+
+
+def v_zip8_argmax(s, p, t):
+    return _zip_argmax(s, p, t, 8)
+
+
+def v_argmax_i8idx(s, p, t):
+    # lax.argmax with a narrow index dtype: if the index array is materialized,
+    # i8 cuts its HBM round-trip 4x vs s32
+    idx = jax.lax.argmax(p, 1, jnp.int8)
+    return s + jnp.sum(idx == t, dtype=jnp.int32)
+
+
+def v_reduce_flag(s, p, t):
+    # ONE variadic reduce carrying (value, is_target) -- never produces an index.
+    # Combiner keeps the lexicographically-first max (argmax tie semantics).
+    nloc = t.shape[0]
+    flags = (jax.lax.broadcasted_iota(jnp.int8, (nloc, C), 1) == t[:, None]).astype(jnp.int8)
+
+    def comb(a, b):
+        av, af = a
+        bv, bf = b
+        keep = av >= bv  # left operand is the earlier index: ties keep left
+        return jnp.where(keep, av, bv), jnp.where(keep, af, bf)
+
+    _, win = jax.lax.reduce((p, flags), (jnp.float32(-jnp.inf), jnp.int8(0)), comb, (1,))
+    return s + jnp.sum(win, dtype=jnp.int32)
+
+
+def v_reduce_idx8(s, p, t):
+    # commutation-safe total-order reduce carrying an i8 index lane (the
+    # reduce_flag combiner mis-ties on TPU: lax.reduce may swap operands)
+    nloc = t.shape[0]
+    iota = jax.lax.broadcasted_iota(jnp.int8, (nloc, C), 1)
+
+    def comb(a, b):
+        av, ai = a
+        bv, bi = b
+        a_nan = jnp.isnan(av)
+        b_nan = jnp.isnan(bv)
+        a_gt = (av > bv) | (a_nan & ~b_nan)
+        a_eq = (av == bv) | (a_nan & b_nan)
+        keep = a_gt | (a_eq & (ai < bi))
+        return jnp.where(keep, av, bv), jnp.where(keep, ai, bi)
+
+    _, win = jax.lax.reduce((p, iota), (jnp.float32(-jnp.inf), jnp.int8(127)), comb, (1,))
+    return s + jnp.sum(win == t, dtype=jnp.int32)
+
+
+def v_twopass_minidx(s, p, t):
+    # rowmax (f32 max-reduce) then first index where p == rowmax (i8 min-reduce):
+    # both reduces commutative => exact ties/NaN on any backend; XLA may keep the
+    # row tile in registers across both passes (one HBM read)
+    rowmax = p.max(axis=1)
+    eqn = (p == rowmax[:, None]) | jnp.isnan(p)
+    iota = jax.lax.broadcasted_iota(jnp.int8, p.shape, 1)
+    first = jnp.min(jnp.where(eqn, iota, jnp.int8(127)), axis=1)
+    return s + jnp.sum(first == t, dtype=jnp.int32)
+
+
+def v_packed_u32(s, p, t):
+    # Monotone u32 key with the column index packed in the low 3 bits:
+    # one plain max-reduce replaces the variadic argmax. Exact ties resolve to
+    # the smallest column (= argmax first-occurrence); values differing only in
+    # the low 3 mantissa bits (~2^-21 rel) can mis-rank -- measure-only variant.
+    u = jax.lax.bitcast_convert_type(p, jnp.uint32)
+    u = jnp.where(u >> 31 == 0, u | jnp.uint32(0x80000000), ~u)
+    col = jax.lax.broadcasted_iota(jnp.uint32, p.shape, 1)
+    key = (u & jnp.uint32(0xFFFFFFF8)) | (jnp.uint32(7) - col)
+    best = key.max(axis=1)
+    win = (best & 7) == (jnp.uint32(7) - t.astype(jnp.uint32))
+    return s + jnp.sum(win, dtype=jnp.int32)
+
+
+def v_packed_u64(s, p, t):
+    # EXACT: monotone u32 key from f32 (order-preserving bijection, NaN maximal),
+    # widened to u64 with the reversed column index in the low 3 bits; one
+    # commutative u64 max-reduce == first-occurrence argmax on any backend
+    u = jax.lax.bitcast_convert_type(p, jnp.uint32)
+    u = jnp.where(u >> 31 == 0, u | jnp.uint32(0x80000000), ~u)
+    col = jax.lax.broadcasted_iota(jnp.uint32, p.shape, 1)
+    key = (u.astype(jnp.uint64) << 3) | (jnp.uint32(7) - col).astype(jnp.uint64)
+    best = key.max(axis=1)
+    win = (best & 7).astype(jnp.int8) == (jnp.int8(7) - t)
+    return s + jnp.sum(win, dtype=jnp.int32)
+
+
+def v_masked3_NC(s, p, t):
+    # exact argmax==target via 3 masked commutative max-reduces in one fusion:
+    # argmax(p)==t  <=>  p[t] > max(p[:t])  and  p[t] >= max(p[t+1:])
+    iota = jax.lax.broadcasted_iota(jnp.int8, p.shape, 1)
+    tt = t[:, None]
+    ninf = jnp.float32(-jnp.inf)
+    pv = jnp.max(jnp.where(iota == tt, p, ninf), axis=1)
+    mlt = jnp.max(jnp.where(iota < tt, p, ninf), axis=1)
+    mgt = jnp.max(jnp.where(iota > tt, p, ninf), axis=1)
+    ok = (pv > mlt) & (pv >= mgt)
+    return s + jnp.sum(ok, dtype=jnp.int32)
+
+
+def v_bf16_argmax(s, p, t):
+    # convert-on-load to bf16 before the argmax reduce (precision-lossy witness:
+    # does halving vreg width double the reduce issue rate?)
+    idx = p.astype(jnp.bfloat16).argmax(axis=1).astype(jnp.int8)
+    return s + jnp.sum(idx == t, dtype=jnp.int32)
+
+
+def v_flat_sum(s, p, t):
+    # pure f32 read-bound witness on a FLAT (5N,) array (no 2-D layout in play)
+    return s + jnp.sum(p, dtype=jnp.float32).astype(jnp.int32)
+
+
+def v_flat_zipsum(s, p, t):
+    # 4 independent f32 streams summed elementwise inside one fusion: does the
+    # zip trick raise the f32 issue rate the way it does for int8?
+    n = p.shape[0]
+    q = n // 4
+    acc = p[:q]
+    for i in range(1, 4):
+        acc = acc + p[i * q:(i + 1) * q]
+    return s + jnp.sum(acc, dtype=jnp.float32).astype(jnp.int32)
+
+
+VARIANTS = {
+    "int8_calib": (v_int8_calib, {"int8": True}),
+    "flat_sum_f32": (v_flat_sum, {"flat": True}),
+    "flat_zipsum_f32": (v_flat_zipsum, {"flat": True}),
+    "baseline_argmax_NC": (v_baseline, {}),
+    "max_only_NC": (v_max_only, {}),
+    "sum_only_NC": (v_sum_only, {}),
+    "unrolled_cols_NC": (v_unrolled, {}),
+    "onehot_dot_NC": (v_onehot_dot, {}),
+    "transposed_CN": (v_transposed, {"transposed": True}),
+    "transposed_argmax_CN": (v_transposed_argmax, {"transposed": True}),
+    "transpose_then_CN": (v_transpose_then, {}),
+    "zip2_argmax_NC": (v_zip2_argmax, {}),
+    "zip4_argmax_NC": (v_zip4_argmax, {}),
+    "zip8_argmax_NC": (v_zip8_argmax, {}),
+    "argmax_i8idx_NC": (v_argmax_i8idx, {}),
+    "reduce_flag_NC": (v_reduce_flag, {}),
+    "reduce_idx8_NC": (v_reduce_idx8, {}),
+    "twopass_minidx_NC": (v_twopass_minidx, {}),
+    "packed_u32_NC": (v_packed_u32, {}),
+    "packed_u64_NC": (v_packed_u64, {}),
+    "masked3_NC": (v_masked3_NC, {}),
+    "bf16_argmax_NC": (v_bf16_argmax, {}),
+}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=26, help="log2 samples per dispatch")
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--reps", type=int, default=5, help="interleaved trials per variant")
+    ap.add_argument("--only", type=str, default=None)
+    args = ap.parse_args()
+    n = 1 << args.n
+
+    key = jax.random.PRNGKey(0)
+    cache = {}
+
+    def get_bufs(kw):
+        k = tuple(sorted(kw.items()))
+        nonlocal key
+        if k not in cache:
+            cache[k], key = make_bufs(n, key, **kw)
+        return cache[k]
+
+    only = args.only.split(",") if args.only else None
+    names = [k for k in VARIANTS if only is None or any(o in k for o in only)]
+    # report layouts once
+    b, _ = make_bufs(1 << 20, jax.random.PRNGKey(1))
+    print(f"(2^20,5) f32 logical {b[0][0].nbytes} on-device {device_size(b[0][0])}")
+
+    fns = {}
+    for name in names:
+        fn, kw = VARIANTS[name]
+        fns[name] = (jax.jit(fn), get_bufs(kw))
+
+    results = {name: [] for name in names}
+    dead = set()
+    for _ in range(args.reps):
+        for name in names:  # interleaved: each rep visits every variant
+            if name in dead:
+                continue
+            fn, bufs = fns[name]
+            try:
+                eps = timed_passes(fn, jnp.int32(0), bufs, args.steps, n)
+            except Exception as e:
+                print(f"  {name}: FAILED {type(e).__name__}: {str(e)[:120]}")
+                dead.add(name)
+                continue
+            results[name].append(eps)
+    print(f"n=2^{args.n} steps={args.steps} reps={args.reps}  (p50 / max, Gpreds/s)")
+    for name in names:
+        r = results[name]
+        if not r:
+            continue
+        p50 = statistics.median(r)
+        print(f"  {name:24s} {p50 / 1e9:8.2f} / {max(r) / 1e9:8.2f}   ({21 * p50 / 1e9:.0f} GB/s eff-read)")
+
+
+if __name__ == "__main__":
+    main()

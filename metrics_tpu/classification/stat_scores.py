@@ -19,9 +19,8 @@ from metrics_tpu.functional.classification.stat_scores import (
     _binary_stat_scores_update,
     _multiclass_stat_scores_arg_validation,
     _multiclass_stat_scores_compute,
-    _multiclass_stat_scores_format,
+    _multiclass_stat_scores_format_update,
     _multiclass_stat_scores_tensor_validation,
-    _multiclass_stat_scores_update,
     _multilabel_stat_scores_arg_validation,
     _multilabel_stat_scores_compute,
     _multilabel_stat_scores_format,
@@ -158,8 +157,7 @@ class MulticlassStatScores(_AbstractStatScores):
             _multiclass_stat_scores_tensor_validation(
                 preds, target, self.num_classes, self.multidim_average, self.ignore_index
             )
-        preds, target = _multiclass_stat_scores_format(preds, target, self.top_k)
-        tp, fp, tn, fn = _multiclass_stat_scores_update(
+        tp, fp, tn, fn = _multiclass_stat_scores_format_update(
             preds, target, self.num_classes, self.top_k, self.average, self.multidim_average, self.ignore_index
         )
         self._update_state(tp, fp, tn, fn)

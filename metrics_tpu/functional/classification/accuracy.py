@@ -15,9 +15,8 @@ from metrics_tpu.functional.classification.stat_scores import (
     _binary_stat_scores_tensor_validation,
     _binary_stat_scores_update,
     _multiclass_stat_scores_arg_validation,
-    _multiclass_stat_scores_format,
+    _multiclass_stat_scores_format_update,
     _multiclass_stat_scores_tensor_validation,
-    _multiclass_stat_scores_update,
     _multilabel_stat_scores_arg_validation,
     _multilabel_stat_scores_format,
     _multilabel_stat_scores_tensor_validation,
@@ -88,8 +87,7 @@ def multiclass_accuracy(
     if validate_args:
         _multiclass_stat_scores_arg_validation(num_classes, top_k, average, multidim_average, ignore_index)
         _multiclass_stat_scores_tensor_validation(preds, target, num_classes, multidim_average, ignore_index)
-    preds, target = _multiclass_stat_scores_format(preds, target, top_k)
-    tp, fp, tn, fn = _multiclass_stat_scores_update(
+    tp, fp, tn, fn = _multiclass_stat_scores_format_update(
         preds, target, num_classes, top_k, average, multidim_average, ignore_index
     )
     return _accuracy_reduce(tp, fp, tn, fn, average=average, multidim_average=multidim_average)

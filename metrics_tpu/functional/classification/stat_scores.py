@@ -24,7 +24,7 @@ import numpy as np
 from jax import Array
 
 from metrics_tpu.ops.confmat import confusion_counts
-from metrics_tpu.ops.streaming import eq_count
+from metrics_tpu.ops.streaming import argmax_correct_count, eq_count
 from metrics_tpu.utils.checks import _check_same_shape, _is_concrete
 from metrics_tpu.utils.data import _count_dtype, select_topk
 from metrics_tpu.utils.enums import ClassificationTask
@@ -258,6 +258,22 @@ def _multiclass_stat_scores_format(
     return preds, target
 
 
+def _micro_counts_from_tp(
+    tp: Array, n_valid: Array, num_classes: int, exact_n: Optional[int] = None
+) -> Tuple[Array, Array, Array, Array]:
+    """Derive fp/fn/tn arithmetically from the fused tp count (micro average).
+
+    ``exact_n`` (the static element count, when no ignore_index mask applies)
+    keeps fp exact above 2^24 where the float32 count dtype loses integers;
+    tn = C*n - ... can exceed int32 for a single huge update, so it is widened.
+    """
+    cd = _count_dtype()
+    fp = (jnp.int32(exact_n) if exact_n is not None else n_valid.astype(jnp.int32)) - tp
+    fn = fp
+    tn = (num_classes * n_valid - (fp + fn + tp).astype(cd)).astype(cd)
+    return tp, fp, tn, fn
+
+
 def _multiclass_stat_scores_update(
     preds: Array,
     target: Array,
@@ -312,22 +328,16 @@ def _multiclass_stat_scores_update(
     target = target.ravel()
 
     if average == "micro":
-        cd = _count_dtype()
         if ignore_index is None:
             # hot streaming path: ONE fused compare-reduce (ops/streaming.py);
             # fp/n_valid derived arithmetically instead of two more reductions
             tp = eq_count(preds, target)
-            n_valid = jnp.asarray(target.size, cd)
-            fp = jnp.int32(target.size) - tp
-        else:
-            valid = target != ignore_index
-            tp = ((preds == target) & valid).sum().astype(jnp.int32)
-            n_valid = valid.sum().astype(cd)
-            fp = n_valid.astype(jnp.int32) - tp
-        fn = fp
-        # tn = C*n - ... can exceed int32 for a single huge update; widen first
-        tn = (num_classes * n_valid - (fp + fn + tp).astype(cd)).astype(cd)
-        return tp, fp, tn, fn
+            n_valid = jnp.asarray(target.size, _count_dtype())
+            return _micro_counts_from_tp(tp, n_valid, num_classes, exact_n=target.size)
+        valid = target != ignore_index
+        tp = ((preds == target) & valid).sum().astype(jnp.int32)
+        n_valid = valid.sum().astype(_count_dtype())
+        return _micro_counts_from_tp(tp, n_valid, num_classes)
 
     # confusion counts: weighted bincount or the one-hot MXU matmul tier
     # (ops/confmat.py) by class count/platform. NOTE: out-of-range labels are
@@ -340,6 +350,48 @@ def _multiclass_stat_scores_update(
     fn = confmat.sum(1) - tp
     tn = confmat.sum() - (fp + fn + tp)
     return tp, fp, tn, fn
+
+
+def _multiclass_stat_scores_format_update(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    top_k: int = 1,
+    average: Optional[str] = "macro",
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Array, Array]:
+    """Format + update in one call so the hot micro path can fuse across the stage
+    boundary: for float ``(N, C, ...)`` preds with ``average='micro'``/``top_k=1``/
+    global reduction, argmax+eq+sum run in one dispatch with no int-label
+    round-trip through the generic format contract
+    (ops/streaming.py:argmax_correct_count has the measured lowering grid).
+    All other paths are byte-identical to format -> update.
+    """
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    fused = (
+        preds.ndim == target.ndim + 1
+        and top_k == 1
+        and average == "micro"
+        and multidim_average == "global"
+        and _is_floating(preds)
+    )
+    if fused:
+        probs = jnp.moveaxis(preds, 1, -1).reshape(-1, num_classes)
+        flat_t = target.ravel()
+        if ignore_index is None:
+            tp = argmax_correct_count(probs, flat_t)
+            n_valid = jnp.asarray(flat_t.size, _count_dtype())
+            return _micro_counts_from_tp(tp, n_valid, num_classes, exact_n=flat_t.size)
+        valid = flat_t != ignore_index
+        tp = argmax_correct_count(probs, flat_t, valid)
+        n_valid = valid.sum().astype(_count_dtype())
+        return _micro_counts_from_tp(tp, n_valid, num_classes)
+    preds, target = _multiclass_stat_scores_format(preds, target, top_k)
+    return _multiclass_stat_scores_update(
+        preds, target, num_classes, top_k, average, multidim_average, ignore_index
+    )
 
 
 def _multiclass_stat_scores_compute(
@@ -383,8 +435,7 @@ def multiclass_stat_scores(
     if validate_args:
         _multiclass_stat_scores_arg_validation(num_classes, top_k, average, multidim_average, ignore_index)
         _multiclass_stat_scores_tensor_validation(preds, target, num_classes, multidim_average, ignore_index)
-    preds, target = _multiclass_stat_scores_format(preds, target, top_k)
-    tp, fp, tn, fn = _multiclass_stat_scores_update(
+    tp, fp, tn, fn = _multiclass_stat_scores_format_update(
         preds, target, num_classes, top_k, average, multidim_average, ignore_index
     )
     return _multiclass_stat_scores_compute(tp, fp, tn, fn, average, multidim_average)
@@ -558,8 +609,7 @@ def _multiclass_stat_scores_pipeline(
 ) -> Tuple[Array, Array, Array, Array]:
     if validate_args:
         _multiclass_stat_scores_tensor_validation(preds, target, num_classes, multidim_average, ignore_index)
-    preds, target = _multiclass_stat_scores_format(preds, target, top_k)
-    return _multiclass_stat_scores_update(
+    return _multiclass_stat_scores_format_update(
         preds, target, num_classes, top_k, average, multidim_average, ignore_index
     )
 

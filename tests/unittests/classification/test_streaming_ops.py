@@ -1,9 +1,10 @@
-"""eq_count streaming kernel: both fusion shapes agree with the naive reduction."""
+"""Streaming kernels: fusion shapes agree with the naive reductions."""
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from metrics_tpu.ops.streaming import _ZIP_MIN, eq_count
+from metrics_tpu.ops.streaming import _ZIP_MIN, argmax_correct_count, eq_count
 
 
 @pytest.mark.parametrize(
@@ -32,3 +33,78 @@ def test_eq_count_negative_labels():
     b = rng.integers(-128, 128, n).astype(np.int8)
     got = int(eq_count(jnp.asarray(a), jnp.asarray(b)))
     assert got == int((a == b).sum())
+
+
+@pytest.mark.parametrize("c", [2, 5, 7, 128])
+@pytest.mark.parametrize("jit", [False, True])
+def test_argmax_correct_count_matches_argmax(c, jit):
+    rng = np.random.default_rng(c)
+    n = 1025
+    p = rng.normal(size=(n, c)).astype(np.float32)
+    t = rng.integers(0, c, n).astype(np.int32)
+    fn = jax.jit(argmax_correct_count) if jit else argmax_correct_count
+    got = int(fn(jnp.asarray(p), jnp.asarray(t)))
+    assert got == int((p.argmax(-1) == t).sum())
+
+
+def test_argmax_correct_count_tie_first_occurrence():
+    # exact ties must resolve to the SMALLEST column, like jnp/np argmax
+    p = np.array([[1.0, 3.0, 3.0], [2.0, 2.0, 2.0], [0.0, -1.0, 0.0]], np.float32)
+    t = np.array([1, 0, 0], np.int32)  # argmax picks cols 1, 0, 0
+    assert int(argmax_correct_count(jnp.asarray(p), jnp.asarray(t))) == 3
+    t2 = np.array([2, 1, 2], np.int32)  # the later tied columns must NOT win
+    assert int(argmax_correct_count(jnp.asarray(p), jnp.asarray(t2))) == 0
+
+
+def test_argmax_correct_count_nan_is_maximal():
+    # jnp.argmax treats NaN as the max (first NaN wins); the fused kernel must too
+    p = np.array([[1.0, np.nan, 5.0], [np.nan, np.nan, 1.0], [0.0, 1.0, 2.0]], np.float32)
+    t_nan = np.asarray(jnp.argmax(jnp.asarray(p), axis=1))
+    got = int(argmax_correct_count(jnp.asarray(p), jnp.asarray(t_nan.astype(np.int32))))
+    assert got == 3
+
+
+def test_argmax_correct_count_valid_mask():
+    rng = np.random.default_rng(0)
+    n, c = 513, 4
+    p = rng.normal(size=(n, c)).astype(np.float32)
+    t = rng.integers(0, c, n).astype(np.int32)
+    valid = rng.random(n) > 0.3
+    got = int(argmax_correct_count(jnp.asarray(p), jnp.asarray(t), jnp.asarray(valid)))
+    assert got == int(((p.argmax(-1) == t) & valid).sum())
+
+
+@pytest.mark.parametrize("ignore_index", [None, 1, -1])
+def test_fused_micro_accuracy_matches_label_path(ignore_index):
+    # the fused float-logits micro path must agree exactly with argmax-then-update
+    from metrics_tpu.functional.classification import multiclass_accuracy
+
+    rng = np.random.default_rng(3)
+    n, c = 999, 6
+    p = rng.normal(size=(n, c)).astype(np.float32)
+    t = rng.integers(0, c, n).astype(np.int32)
+    if ignore_index is not None:
+        t[rng.random(n) < 0.2] = ignore_index
+    fused = multiclass_accuracy(
+        jnp.asarray(p), jnp.asarray(t), num_classes=c, average="micro",
+        ignore_index=ignore_index, validate_args=False,
+    )
+    labeled = multiclass_accuracy(
+        jnp.asarray(p.argmax(-1)), jnp.asarray(t), num_classes=c, average="micro",
+        ignore_index=ignore_index, validate_args=False,
+    )
+    assert float(fused) == float(labeled)
+
+
+def test_fused_micro_accuracy_multidim_inputs():
+    # (N, C, d) float preds with (N, d) target: the fused path must flatten the
+    # extra dim exactly like format's reshape
+    from metrics_tpu.functional.classification import multiclass_accuracy
+
+    rng = np.random.default_rng(4)
+    n, c, d = 64, 5, 9
+    p = rng.normal(size=(n, c, d)).astype(np.float32)
+    t = rng.integers(0, c, (n, d)).astype(np.int32)
+    fused = multiclass_accuracy(jnp.asarray(p), jnp.asarray(t), num_classes=c, average="micro")
+    want = (p.argmax(1) == t).mean()
+    np.testing.assert_allclose(float(fused), want, rtol=1e-6)
