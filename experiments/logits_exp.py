@@ -244,9 +244,14 @@ def v_packed_u32(s, p, t):
 
 
 def v_packed_u64(s, p, t):
-    # EXACT: monotone u32 key from f32 (order-preserving bijection, NaN maximal),
-    # widened to u64 with the reversed column index in the low 3 bits; one
-    # commutative u64 max-reduce == first-occurrence argmax on any backend
+    # INVALID under default (x64-disabled) JAX: astype(uint64) silently degrades
+    # to uint32, so `u << 3` drops the key's top 3 bits — the measured 10.5
+    # Gpreds/s row is a truncated-u32 reduce, not a u64 one, and mis-ranks
+    # cross-magnitude values (ties verified wrong in-session). Kept only as a
+    # record of the rejected direction; a real u64 key needs two u32 lanes.
+    # Original intent: monotone u32 key from f32 (order-preserving bijection,
+    # NaN maximal), widened to u64 with the reversed column index in the low 3
+    # bits; one commutative u64 max-reduce == first-occurrence argmax.
     u = jax.lax.bitcast_convert_type(p, jnp.uint32)
     u = jnp.where(u >> 31 == 0, u | jnp.uint32(0x80000000), ~u)
     col = jax.lax.broadcasted_iota(jnp.uint32, p.shape, 1)

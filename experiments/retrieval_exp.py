@@ -1,0 +1,119 @@
+"""Experiment: what bounds RetrievalMAP compute (5.97 Mdocs/s r03)?
+
+Pieces: one 2-key lexsort (indexes, -preds) + ~8 segment reductions + cumsum.
+Run: python experiments/retrieval_exp.py [--n 22]
+"""
+import argparse
+import statistics
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _sync(out):
+    # block_until_ready does not round-trip on the tunneled backend; a scalar
+    # device_get is the only trustworthy sync (in-order queue drains first)
+    leaf = jax.tree.leaves(out)[0]
+    jax.device_get(leaf.ravel()[0] if leaf.ndim else leaf)
+
+
+def timeit(fn, *args, reps=5):
+    out = fn(*args)
+    _sync(out)
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(4):
+            out = fn(*args)
+        _sync(out)
+        ts.append((time.perf_counter() - t0) / 4)
+    return statistics.median(ts)
+
+
+def layout_v2(i, s, t):
+    n = i.shape[0]
+    _, _, s_idx, s_preds, s_target = jax.lax.sort(
+        (i, -s, i, s, t), num_keys=2, is_stable=True
+    )
+    new_seg = jnp.concatenate([jnp.ones(1, dtype=bool), s_idx[1:] != s_idx[:-1]])
+    seg_id = jnp.cumsum(new_seg) - 1
+    pos = jnp.arange(n)
+    seg_start_row = jax.lax.cummax(jnp.where(new_seg, pos, 0))  # no gather
+    rank = pos - seg_start_row + 1
+    seg_count = jax.ops.segment_sum(
+        jnp.ones(n, jnp.int32), seg_id, num_segments=n, indices_are_sorted=True
+    )
+    seg_index = jax.ops.segment_min(s_idx, seg_id, num_segments=n, indices_are_sorted=True)
+    return seg_id, rank, s_preds, s_target, n, seg_count, seg_index
+
+def ap_v2(i, s, t):
+    n = i.shape[0]
+    seg_id, rank, s_preds, s_target, n_seg, seg_count, seg_index = layout_v2(i, s, t)
+    valid = (seg_count > 0) & (seg_index >= 0)
+    binary_t = (s_target > 0).astype(jnp.float32)
+    new_seg = rank == 1
+    # within-segment cumsum of NON-NEGATIVE values: base via cummax, no gather
+    g = jnp.cumsum(binary_t)
+    base = jax.lax.cummax(jnp.where(new_seg, g - binary_t, 0.0))
+    cumrel = g - base
+    contrib = binary_t * cumrel / rank
+    seg_sum = lambda v: jax.ops.segment_sum(v, seg_id, num_segments=n_seg, indices_are_sorted=True)
+    n_pos = seg_sum(binary_t)
+    scores = jnp.where(n_pos > 0, seg_sum(contrib) / jnp.maximum(n_pos, 1.0), 0.0)
+    return scores, n_pos, valid
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=22)
+    args = ap.parse_args()
+    n = 1 << args.n
+    rng = np.random.RandomState(0)
+    idx = jnp.asarray(np.sort(rng.randint(0, n // 64, n)).astype(np.int32))
+    scores = jnp.asarray(rng.rand(n).astype(np.float32))
+    rel = jnp.asarray((rng.rand(n) > 0.7).astype(np.int32))
+
+    f_sort1 = jax.jit(lambda s: jnp.sort(s))
+    f_argsort1 = jax.jit(lambda s: jnp.argsort(s))
+    f_lex2 = jax.jit(lambda i, s: jnp.lexsort((-s, i)))
+    f_lex_gather = jax.jit(lambda i, s, t: tuple(x[jnp.lexsort((-s, i))] for x in (i, s, t)))
+
+    def lex_payload(i, s, t):
+        # single variadic sort carrying payloads instead of argsort+gathers
+        neg = -s
+        _, _, si, ss, st = jax.lax.sort((i, neg, i, s, t), num_keys=2, is_stable=True)
+        return si, ss, st
+
+    f_lex_payload = jax.jit(lex_payload)
+
+    def seg_ops(i, s, t):
+        from metrics_tpu.ops.segment import _segment_layout  # noqa: PLC0415
+        return _segment_layout(i, s, t)
+
+    f_layout = jax.jit(seg_ops)
+
+    from metrics_tpu.ops.segment import grouped_retrieval_scores
+    f_map = jax.jit(lambda i, s, t: grouped_retrieval_scores(i, s, t, "average_precision"))
+
+    f_layout2 = jax.jit(layout_v2)
+    f_ap2 = jax.jit(ap_v2)
+
+    for name, fn, a in (
+        ("sort_f32", f_sort1, (scores,)),
+        ("argsort_f32", f_argsort1, (scores,)),
+        ("lexsort2_idx", f_lex2, (idx, scores)),
+        ("lexsort2+3gathers", f_lex_gather, (idx, scores, rel)),
+        ("sort_payload5", f_lex_payload, (idx, scores, rel)),
+        ("segment_layout", f_layout, (idx, scores, rel)),
+        ("grouped_AP_full", f_map, (idx, scores, rel)),
+        ("layout_v2", f_layout2, (idx, scores, rel)),
+        ("AP_v2", f_ap2, (idx, scores, rel)),
+    ):
+        dt = timeit(fn, *a)
+        print(f"  {name:20s} {dt * 1e3:8.1f} ms   {n / dt / 1e6:8.2f} Mdocs/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -5,11 +5,30 @@ The TPU-native replacement for the reference's host-side group-by loop
 ``torch.split`` + python loop over queries). Here the whole evaluation is one
 device program:
 
-1. one lexsort groups rows by query and ranks docs by score inside each query,
+1. one payload-carrying variadic sort groups rows by query and ranks docs by
+   score inside each query,
 2. segment ids come from boundary detection + cumsum,
 3. every per-query retrieval metric becomes a segment reduction (segment_sum /
    segment_min) over rank-indexed terms — no host round-trips, no ragged splits,
    O(N log N) total and fully jit-compatible with a static row count.
+
+Measured design notes (4.2M docs / 65k queries, v5e, device_get-synced p50 —
+``block_until_ready`` does not round-trip on the tunneled backend):
+
+- **Gathers are the enemy, not the sort.** The original
+  ``order = lexsort(...); x[order]`` layout cost 305 ms, of which the 2-key sort
+  itself was only 38 ms — each 4M-row gather costs ~90 ms on TPU. One
+  ``lax.sort`` carrying all three columns as payloads does the same layout in
+  45 ms (6.8x).
+- **Within-segment positions come from scans, not segment_min+gather**:
+  ``cummax(where(new_seg, pos, 0))`` broadcasts each segment's start row to its
+  members in one associative scan.
+- ``indices_are_sorted=True`` on every segment reduction (segment ids are
+  sorted by construction) lets XLA skip the scatter's sorting pass.
+- Net: RetrievalMAP end-to-end went 8.4 -> 22.0 Mdocs/s (the remaining time is
+  the sort at ~45 ms + ~4 linear scans/scatters at ~15-25 ms each; a fused
+  one-pass segmented scan would need a hand-written kernel for <2x more).
+  Experiment grid: experiments/retrieval_exp.py.
 """
 from functools import partial
 from typing import Optional, Tuple
@@ -29,32 +48,145 @@ def _segment_layout(indexes: Array, preds: Array, target: Array):
     mark padding rows whose segment must not count as a real query).
     """
     n = indexes.shape[0]
-    order = jnp.lexsort((-preds, indexes))
-    s_idx = indexes[order]
-    s_preds = preds[order]
-    s_target = target[order]
+    # one variadic sort carrying the columns as payloads: measured 6.8x faster
+    # than argsort + three 4M-row gathers on TPU (see module docstring)
+    _, _, s_idx, s_preds, s_target = jax.lax.sort(
+        (indexes, -preds, indexes, preds, target), num_keys=2, is_stable=True
+    )
 
     new_seg = jnp.concatenate([jnp.ones(1, dtype=bool), s_idx[1:] != s_idx[:-1]])
     seg_id = jnp.cumsum(new_seg) - 1  # dense 0..n_q-1
 
     pos = jnp.arange(n)
-    seg_start = jax.ops.segment_min(pos, seg_id, num_segments=n)
-    rank = pos - seg_start[seg_id] + 1  # 1-based within query
+    # broadcast each segment's start row to its members via one scan (no gather)
+    seg_start_row = jax.lax.cummax(jnp.where(new_seg, pos, 0))
+    rank = pos - seg_start_row + 1  # 1-based within query
 
-    seg_count = jax.ops.segment_sum(jnp.ones(n, jnp.int32), seg_id, num_segments=n)
+    seg_count = jax.ops.segment_sum(jnp.ones(n, jnp.int32), seg_id, num_segments=n, indices_are_sorted=True)
     # first (== any) original index of each segment: negative marks padding rows
     # (cat-buffer fill / pow2 pad), whose segment must not count as a real query
-    seg_index = jax.ops.segment_min(s_idx, seg_id, num_segments=n)
+    seg_index = jax.ops.segment_min(s_idx, seg_id, num_segments=n, indices_are_sorted=True)
     return seg_id, rank, s_preds, s_target, n, seg_count, seg_index
 
 
+def _segment_cumsum_nonneg(values: Array, new_seg: Array) -> Array:
+    """Within-segment inclusive cumsum for NON-NEGATIVE values.
+
+    The global cumsum is non-decreasing, so each segment's base (global cumsum
+    just before the segment) can be broadcast to its rows with one ``cummax``
+    instead of a per-row gather. Callers must guarantee ``values >= 0``.
+    """
+    g = jnp.cumsum(values)
+    base = jax.lax.cummax(jnp.where(new_seg, g - values, jnp.zeros_like(values)))
+    return g - base
+
+
 def _segment_cumsum(values: Array, seg_id: Array, num_segments: int) -> Array:
-    """Within-segment inclusive cumsum via global cumsum minus per-segment base."""
+    """Within-segment inclusive cumsum via global cumsum minus per-segment base.
+
+    General-sign fallback (uses one gather); prefer ``_segment_cumsum_nonneg``
+    for non-negative inputs on the hot path.
+    """
     g = jnp.cumsum(values)
     pos = jnp.arange(values.shape[0])
-    start = jax.ops.segment_min(pos, seg_id, num_segments=num_segments)
+    start = jax.ops.segment_min(pos, seg_id, num_segments=num_segments, indices_are_sorted=True)
     base = g[start[seg_id]] - values[start[seg_id]]
     return g - base
+
+
+# metrics whose per-query value is a segmented-cumsum read at the segment's
+# last row: they run with ZERO segment scatters (sort + ~5 scans + plain sums)
+_SCAN_METRICS = frozenset(
+    {"average_precision", "reciprocal_rank", "precision", "recall", "hit_rate", "fall_out"}
+)
+
+
+def _scan_retrieval_scores(
+    indexes: Array,
+    preds: Array,
+    target: Array,
+    metric: str,
+    top_k: Optional[int],
+    adaptive_k: bool,
+) -> Tuple[Array, Array, Array]:
+    """Scan-only fast path: per-query score materialized at each segment's LAST
+    row; other rows carry 0 / valid=False. The caller's reduction is elementwise
+    so row-aligned results are interchangeable with segment-aligned ones.
+
+    Why: ``segment_sum`` (a scatter) costs ~174 ms per call at 2^24 rows on v5e
+    even with sorted indices, while ``cumsum``/``cummax`` scans cost ~30 ms; AP
+    needs 4+ per-segment reductions. Expressing each as "segmented cumsum value
+    at the last row" (base broadcast by ``cummax`` — exact for the non-negative
+    summands used here) removes every scatter: 715 -> ~300 ms for the full AP
+    kernel at 2^24. (``lax.associative_scan`` segmented scans were rejected:
+    the recursive decomposition takes minutes to compile at this size.)
+    """
+    n = indexes.shape[0]
+    _, _, s_idx, s_preds, s_target = jax.lax.sort(
+        (indexes, -preds, indexes, preds, target), num_keys=2, is_stable=True
+    )
+    new_seg = jnp.concatenate([jnp.ones(1, dtype=bool), s_idx[1:] != s_idx[:-1]])
+    is_last = jnp.concatenate([new_seg[1:], jnp.ones(1, dtype=bool)])
+    pos = jnp.arange(n)
+    seg_start_row = jax.lax.cummax(jnp.where(new_seg, pos, 0))
+    rank = pos - seg_start_row + 1
+
+    binary_t = (s_target > 0).astype(jnp.float32)
+    in_k = jnp.ones(n, dtype=bool) if top_k is None else rank <= top_k
+
+    def segcumsum(v):  # within-segment cumsum, v >= 0 (see _segment_cumsum_nonneg)
+        return _segment_cumsum_nonneg(v, new_seg)
+
+    cum_rel_k = segcumsum(binary_t * in_k.astype(jnp.float32))
+    cum_rel = cum_rel_k if top_k is None else segcumsum(binary_t)
+    n_pos = jnp.where(is_last, cum_rel, 0.0)
+    valid = is_last & (s_idx >= 0)
+
+    if metric == "fall_out":
+        nonrel = 1.0 - binary_t
+        cum_nonrel_k = segcumsum(nonrel * in_k.astype(jnp.float32))
+        cum_nonrel = cum_nonrel_k if top_k is None else segcumsum(nonrel)
+        n_neg = jnp.where(is_last, cum_nonrel, 0.0)
+        scores = jnp.where(is_last & (n_neg > 0), cum_nonrel_k / jnp.maximum(n_neg, 1.0), 0.0)
+        return scores, n_neg, valid  # n_positive slot carries negatives for empty handling
+
+    if metric == "average_precision":
+        contrib = jnp.where(in_k, binary_t * cum_rel_k / rank, 0.0)
+        cum_contrib = segcumsum(contrib)
+        scores = jnp.where(is_last & (cum_rel_k > 0), cum_contrib / jnp.maximum(cum_rel_k, 1.0), 0.0)
+        return scores, n_pos, valid
+
+    if metric == "reciprocal_rank":
+        # global cummax of "position+1 of each segment's first relevant row":
+        # later segments' markers dominate earlier ones, and the value is only
+        # read at last rows of segments that HAVE a relevant row (n_pos > 0)
+        marker = jnp.where((binary_t > 0) & (cum_rel == 1), pos + 1, 0)
+        first_rel_pos = jax.lax.cummax(marker)
+        first_rel_rank = (first_rel_pos - 1 - seg_start_row + 1).astype(jnp.float32)
+        scores = jnp.where(is_last & (n_pos > 0), 1.0 / jnp.maximum(first_rel_rank, 1.0), 0.0)
+        return scores, n_pos, valid
+
+    count_f = rank.astype(jnp.float32)  # at last row == segment size
+    if top_k is None:
+        k_per_seg = count_f
+    elif adaptive_k:
+        k_per_seg = jnp.minimum(float(top_k), count_f)
+    else:
+        k_per_seg = jnp.full_like(count_f, float(top_k))
+
+    if metric == "precision":
+        scores = jnp.where(is_last & (n_pos > 0), cum_rel_k / jnp.maximum(k_per_seg, 1.0), 0.0)
+        return scores, n_pos, valid
+
+    if metric == "recall":
+        scores = jnp.where(is_last & (n_pos > 0), cum_rel_k / jnp.maximum(n_pos, 1.0), 0.0)
+        return scores, n_pos, valid
+
+    if metric == "hit_rate":
+        scores = jnp.where(is_last & (cum_rel_k > 0), 1.0, 0.0)
+        return scores, n_pos, valid
+
+    raise ValueError(f"Metric {metric} is not scan-friendly")
 
 
 def grouped_retrieval_scores(
@@ -71,10 +203,19 @@ def grouped_retrieval_scores(
     of queries); only entries where ``valid`` is True correspond to real queries.
     ``n_positive`` is the per-query count of positive targets (used by the caller
     for ``empty_target_action`` handling; for ``fall_out`` it counts negatives).
+
+    Scan-friendly metrics take the scatter-free path (``_scan_retrieval_scores``,
+    results row-aligned at segment-last rows); ndcg (summands may be negative
+    for float targets, breaking the cummax base trick) and r_precision (needs a
+    per-row broadcast of the segment total, i.e. future information) keep the
+    segment-reduction layout below.
     """
+    if metric in _SCAN_METRICS:
+        return _scan_retrieval_scores(indexes, preds, target, metric, top_k, adaptive_k)
     n = indexes.shape[0]
     seg_id, rank, s_preds, s_target, n_seg, seg_count, seg_index = _segment_layout(indexes, preds, target)
     valid = (seg_count > 0) & (seg_index >= 0)
+    new_seg = rank == 1
     t = s_target.astype(jnp.float32)
     binary_t = (s_target > 0).astype(jnp.float32)
 
@@ -89,13 +230,13 @@ def grouped_retrieval_scores(
             k_per_seg = jnp.full_like(count_f, float(top_k))
         in_k = rank <= top_k
 
-    seg_sum = partial(jax.ops.segment_sum, segment_ids=seg_id, num_segments=n_seg)
+    seg_sum = partial(jax.ops.segment_sum, segment_ids=seg_id, num_segments=n_seg, indices_are_sorted=True)
     n_pos = seg_sum(binary_t)
     n_neg = seg_sum(1.0 - binary_t)
 
     if metric == "average_precision":
         # AP = mean over relevant-in-topk of (j / rank_j), j = within-query relevant index
-        cumrel = _segment_cumsum(binary_t * in_k, seg_id, n_seg)
+        cumrel = _segment_cumsum_nonneg(binary_t * in_k, new_seg)
         contrib = jnp.where(in_k, binary_t * cumrel / rank, 0.0)
         rel_in_k = seg_sum(binary_t * in_k)
         scores = jnp.where(rel_in_k > 0, seg_sum(contrib) / jnp.maximum(rel_in_k, 1.0), 0.0)
@@ -103,7 +244,10 @@ def grouped_retrieval_scores(
 
     if metric == "reciprocal_rank":
         first_rel = jax.ops.segment_min(
-            jnp.where(binary_t > 0, rank, jnp.iinfo(jnp.int32).max), seg_id, num_segments=n_seg
+            jnp.where(binary_t > 0, rank, jnp.iinfo(jnp.int32).max),
+            seg_id,
+            num_segments=n_seg,
+            indices_are_sorted=True,
         )
         scores = jnp.where(n_pos > 0, 1.0 / jnp.maximum(first_rel, 1).astype(jnp.float32), 0.0)
         return scores, n_pos, valid
@@ -130,7 +274,8 @@ def grouped_retrieval_scores(
         return scores, n_neg, valid  # n_positive slot carries negatives for empty handling
 
     if metric == "r_precision":
-        # relevant among top-(n_pos) ranked docs
+        # relevant among top-(n_pos) ranked docs; the per-row broadcast of the
+        # segment's positive count is the one gather this path keeps
         in_r = rank.astype(jnp.float32) <= n_pos[seg_id]
         rel_in_r = seg_sum(binary_t * in_r)
         scores = jnp.where(n_pos > 0, rel_in_r / jnp.maximum(n_pos, 1.0), 0.0)
@@ -140,10 +285,9 @@ def grouped_retrieval_scores(
         # DCG over score-ranked targets; IDCG over value-sorted targets
         disc = 1.0 / jnp.log2(rank.astype(jnp.float32) + 1.0)
         dcg = seg_sum(jnp.where(in_k, t * disc, 0.0))
-        # ideal ordering: sort by (-target) within query
-        order2 = jnp.lexsort((-target, indexes))
-        s_t2 = target[order2].astype(jnp.float32)
-        idcg = seg_sum(jnp.where(in_k, s_t2 * disc, 0.0))
+        # ideal ordering: payload sort by (query, -target), same no-gather shape
+        _, _, s_t2 = jax.lax.sort((indexes, -target, target), num_keys=2, is_stable=True)
+        idcg = seg_sum(jnp.where(in_k, s_t2.astype(jnp.float32) * disc, 0.0))
         scores = jnp.where(idcg > 0, dcg / jnp.maximum(idcg, 1e-12), 0.0)
         scores = jnp.clip(scores, 0.0, 1.0)
         return scores, n_pos, valid
