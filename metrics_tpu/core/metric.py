@@ -139,6 +139,10 @@ class Metric(ABC):
 
         # state registry
         self._defaults: Dict[str, Union[Array, List]] = {}
+        # declared (item_shape, dtype, fill) per cat state — consumed when a
+        # list state is later converted to a CatBuffer (here with cat_capacity,
+        # or auto-sized by parallel.mesh._lists_to_buffers)
+        self._cat_meta: Dict[str, tuple] = {}
         self._persistent: Dict[str, bool] = {}
         self._reductions: Dict[str, collective.ReduceFx] = {}
 
@@ -200,6 +204,8 @@ class Metric(ABC):
         else:
             reduce_kind = dist_reduce_fx  # None or callable
 
+        if is_list:
+            self._cat_meta[name] = (tuple(cat_item_shape), cat_dtype, cat_fill_value)
         if is_list and self.cat_capacity is not None and reduce_kind == "cat":
             default = CatBuffer.create(
                 self.cat_capacity, tuple(cat_item_shape), cat_dtype or jnp.float32, cat_fill_value

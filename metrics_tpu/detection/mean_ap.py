@@ -170,22 +170,16 @@ class MeanAveragePrecision(Metric):
             boxes = box_convert(boxes, in_fmt=self.box_format, out_fmt="xyxy")
         return boxes
 
-    def _get_classes(self) -> List:
-        """Unique classes present in detections or ground truth (reference :407-411)."""
-        if len(self.detection_labels) > 0 or len(self.groundtruth_labels) > 0:
-            labels = [np.asarray(x).reshape(-1) for x in list(self.detection_labels) + list(self.groundtruth_labels)]
-            cat = np.concatenate(labels) if labels else np.zeros(0)
-            return sorted(np.unique(cat).astype(np.int64).tolist()) if cat.size else []
-        return []
+    def _fetch_host_states(self):
+        """ONE batched device->host fetch of all five unreduced state lists.
 
-    # ------------------------------------------------------------- evaluation
-
-    def _build_groups(self, class_ids: List[int]):
-        """Collect non-empty (image, class) evaluation groups as padded arrays."""
-        max_det = self.max_detection_thresholds[-1]
-        # one batched device->host fetch: per-array np.asarray would pay a full
-        # round trip per (image, state) pair — ~20s for 64 images on the tunnel
-        host = jax.device_get(
+        Per-array ``np.asarray`` pays a full tunnel round trip per (image, state)
+        pair — measured ~58 s for 256 images just to read the label lists; the
+        single ``device_get`` of the whole pytree is ~0.3 s. ``compute`` calls
+        this once and shares the result between ``_get_classes`` and
+        ``_build_groups``.
+        """
+        return jax.device_get(
             (
                 list(self.detections),
                 list(self.detection_scores),
@@ -194,6 +188,24 @@ class MeanAveragePrecision(Metric):
                 list(self.groundtruth_labels),
             )
         )
+
+    def _get_classes(self, host=None) -> List:
+        """Unique classes present in detections or ground truth (reference :407-411)."""
+        if len(self.detection_labels) > 0 or len(self.groundtruth_labels) > 0:
+            if host is None:
+                host = self._fetch_host_states()
+            labels = [np.asarray(x).reshape(-1) for x in list(host[2]) + list(host[4])]
+            cat = np.concatenate(labels) if labels else np.zeros(0)
+            return sorted(np.unique(cat).astype(np.int64).tolist()) if cat.size else []
+        return []
+
+    # ------------------------------------------------------------- evaluation
+
+    def _build_groups(self, class_ids: List[int], host=None):
+        """Collect non-empty (image, class) evaluation groups as padded arrays."""
+        max_det = self.max_detection_thresholds[-1]
+        if host is None:
+            host = self._fetch_host_states()
         if self.iou_type == "segm":
             det_items = [np.asarray(b, bool) for b in host[0]]
             gt_items = [np.asarray(b, bool) for b in host[3]]
@@ -245,7 +257,7 @@ class MeanAveragePrecision(Metric):
                     groups.append((k_idx, db[order], ds[order], gt_items[img][gmask]))
         return groups
 
-    def _calculate(self, class_ids: List[int]) -> Tuple[np.ndarray, np.ndarray]:
+    def _calculate(self, class_ids: List[int], host=None) -> Tuple[np.ndarray, np.ndarray]:
         """Precision/recall tables over (T, R, K, A, M) via the device matching kernel."""
         num_t = len(self.iou_thresholds)
         num_r = len(self.rec_thresholds)
@@ -255,7 +267,7 @@ class MeanAveragePrecision(Metric):
         precision = -np.ones((num_t, num_r, num_k, num_a, num_m))
         recall = -np.ones((num_t, num_k, num_a, num_m))
 
-        groups = self._build_groups(class_ids)
+        groups = self._build_groups(class_ids, host=host)
         if not groups:
             return precision, recall
 
@@ -420,8 +432,9 @@ class MeanAveragePrecision(Metric):
 
     def compute(self) -> dict:
         """Full COCO result dict from the accumulated detections (reference :842-871)."""
-        classes = self._get_classes()
-        precisions, recalls = self._calculate(classes)
+        host = self._fetch_host_states()
+        classes = self._get_classes(host=host)
+        precisions, recalls = self._calculate(classes, host=host)
         map_val, mar_val = self._summarize_results(precisions, recalls)
 
         map_per_class_values: Array = jnp.asarray([-1.0])

@@ -33,6 +33,14 @@ def _lexicographic_best(
     Host-side selection at compute time, mirroring the reference's
     ``max((r, p, t) for ... if p >= min_precision)`` (recall_fixed_precision.py:40-55).
     """
+    import jax.core
+
+    if any(isinstance(x, jax.core.Tracer) for x in (primary, secondary, thresholds)):
+        raise NotImplementedError(
+            "fixed-point metrics (recall@precision / precision@recall /"
+            " specificity@sensitivity) select their operating point with host-side"
+            " numpy and are eager-only; call compute outside jit"
+        )
     p = np.asarray(primary, dtype=np.float64)
     s = np.asarray(secondary, dtype=np.float64)
     t = np.asarray(thresholds, dtype=np.float64)

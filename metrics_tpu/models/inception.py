@@ -48,23 +48,53 @@ def _tf1_bilinear_resize(x: Array, out_h: int, out_w: int) -> Array:
         frac = src - i0.astype(jnp.float32)
         return i0, i1, frac
 
-    y0, y1, fy = axis_weights(in_h, out_h)
-    x0, x1, fx = axis_weights(in_w, out_w)
+    if (in_h, in_w) == (out_h, out_w):
+        # scale 1: src = dst exactly (i0 = dst, frac = 0) — the interpolation is
+        # the identity; skipping it saves ~45% of the whole Inception forward
+        # (the gather form measured 7.5k img/s alone vs 4.2k for the full net)
+        return x
 
-    top = x[:, :, y0, :] * (1 - fy)[None, None, :, None] + x[:, :, y1, :] * fy[None, None, :, None]
-    out = top[:, :, :, x0] * (1 - fx)[None, None, None, :] + top[:, :, :, x1] * fx[None, None, None, :]
-    return out
+    def axis_matrix(in_size: int, out_size: int) -> Array:
+        # interpolation as a dense (out, in) matrix so the resize runs on the
+        # MXU as two matmuls instead of 4 gathers (gathers are the slow path on
+        # TPU; same linear math, bit-identical weights)
+        i0, i1, frac = axis_weights(in_size, out_size)
+        rows = jnp.arange(out_size)
+        w = jnp.zeros((out_size, in_size), jnp.float32)
+        w = w.at[rows, i0].add(1.0 - frac)
+        w = w.at[rows, i1].add(frac)
+        return w
+
+    wy = axis_matrix(in_h, out_h)  # (out_h, in_h)
+    wx = axis_matrix(in_w, out_w)  # (out_w, in_w)
+    out = jnp.einsum("oh,nchw->ncow", wy, x, precision=lax.Precision.HIGHEST)
+    return jnp.einsum("pw,ncow->ncop", wx, out, precision=lax.Precision.HIGHEST)
 
 
-def _conv_bn(x: Array, p: Dict[str, Array], stride: Union[int, Tuple[int, int]] = 1, padding="VALID") -> Array:
-    """Conv (no bias) + inference batch-norm (eps 1e-3) + relu, NCHW/OIHW."""
+def _conv_bn(
+    x: Array, p: Dict[str, Array], stride: Union[int, Tuple[int, int]] = 1, padding="VALID", dtype=None
+) -> Array:
+    """Conv (no bias) + inference batch-norm (eps 1e-3) + relu, NCHW/OIHW.
+
+    ``dtype=bfloat16`` runs the conv with bf16 operands and f32 accumulation
+    (``preferred_element_type``) — the MXU-native mixed precision; batch-norm
+    and relu stay f32, and the activation is cast back to ``dtype`` for the
+    next layer's operand.
+    """
     strides = (stride, stride) if isinstance(stride, int) else stride
+    kernel = p["kernel"]
+    if dtype is not None:
+        x = x.astype(dtype)
+        kernel = kernel.astype(dtype)
     x = lax.conv_general_dilated(
-        x, p["kernel"], window_strides=strides, padding=padding, dimension_numbers=("NCHW", "OIHW", "NCHW")
+        x, kernel, window_strides=strides, padding=padding,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        preferred_element_type=jnp.float32 if dtype is not None else None,
     )
     scale = p["bn_scale"] / jnp.sqrt(p["bn_var"] + _BN_EPS)
     shift = p["bn_bias"] - p["bn_mean"] * scale
-    return jax.nn.relu(x * scale[None, :, None, None] + shift[None, :, None, None])
+    out = jax.nn.relu(x * scale[None, :, None, None] + shift[None, :, None, None])
+    return out.astype(dtype) if dtype is not None else out
 
 
 def _max_pool(x: Array, window: int = 3, stride: int = 2) -> Array:
@@ -85,65 +115,65 @@ def _avg_pool_exclude_pad(x: Array, window: int = 3) -> Array:
 
 # ------------------------------------------------------------------- blocks
 
-def _inception_a(x, p):
-    b1 = _conv_bn(x, p["branch1x1"])
-    b5 = _conv_bn(_conv_bn(x, p["branch5x5_1"]), p["branch5x5_2"], padding=((2, 2), (2, 2)))
-    b3 = _conv_bn(x, p["branch3x3dbl_1"])
-    b3 = _conv_bn(b3, p["branch3x3dbl_2"], padding=((1, 1), (1, 1)))
-    b3 = _conv_bn(b3, p["branch3x3dbl_3"], padding=((1, 1), (1, 1)))
-    bp = _conv_bn(_avg_pool_exclude_pad(x), p["branch_pool"])
+def _inception_a(x, p, dtype=None):
+    b1 = _conv_bn(x, p["branch1x1"], dtype=dtype)
+    b5 = _conv_bn(_conv_bn(x, p["branch5x5_1"], dtype=dtype), p["branch5x5_2"], padding=((2, 2), (2, 2)), dtype=dtype)
+    b3 = _conv_bn(x, p["branch3x3dbl_1"], dtype=dtype)
+    b3 = _conv_bn(b3, p["branch3x3dbl_2"], padding=((1, 1), (1, 1)), dtype=dtype)
+    b3 = _conv_bn(b3, p["branch3x3dbl_3"], padding=((1, 1), (1, 1)), dtype=dtype)
+    bp = _conv_bn(_avg_pool_exclude_pad(x), p["branch_pool"], dtype=dtype)
     return jnp.concatenate([b1, b5, b3, bp], axis=1)
 
 
-def _inception_b(x, p):
-    b3 = _conv_bn(x, p["branch3x3"], stride=2)
-    bd = _conv_bn(x, p["branch3x3dbl_1"])
-    bd = _conv_bn(bd, p["branch3x3dbl_2"], padding=((1, 1), (1, 1)))
-    bd = _conv_bn(bd, p["branch3x3dbl_3"], stride=2)
+def _inception_b(x, p, dtype=None):
+    b3 = _conv_bn(x, p["branch3x3"], stride=2, dtype=dtype)
+    bd = _conv_bn(x, p["branch3x3dbl_1"], dtype=dtype)
+    bd = _conv_bn(bd, p["branch3x3dbl_2"], padding=((1, 1), (1, 1)), dtype=dtype)
+    bd = _conv_bn(bd, p["branch3x3dbl_3"], stride=2, dtype=dtype)
     bp = _max_pool(x)
     return jnp.concatenate([b3, bd, bp], axis=1)
 
 
-def _inception_c(x, p):
-    b1 = _conv_bn(x, p["branch1x1"])
-    b7 = _conv_bn(x, p["branch7x7_1"])
-    b7 = _conv_bn(b7, p["branch7x7_2"], padding=((0, 0), (3, 3)))
-    b7 = _conv_bn(b7, p["branch7x7_3"], padding=((3, 3), (0, 0)))
-    bd = _conv_bn(x, p["branch7x7dbl_1"])
-    bd = _conv_bn(bd, p["branch7x7dbl_2"], padding=((3, 3), (0, 0)))
-    bd = _conv_bn(bd, p["branch7x7dbl_3"], padding=((0, 0), (3, 3)))
-    bd = _conv_bn(bd, p["branch7x7dbl_4"], padding=((3, 3), (0, 0)))
-    bd = _conv_bn(bd, p["branch7x7dbl_5"], padding=((0, 0), (3, 3)))
-    bp = _conv_bn(_avg_pool_exclude_pad(x), p["branch_pool"])
+def _inception_c(x, p, dtype=None):
+    b1 = _conv_bn(x, p["branch1x1"], dtype=dtype)
+    b7 = _conv_bn(x, p["branch7x7_1"], dtype=dtype)
+    b7 = _conv_bn(b7, p["branch7x7_2"], padding=((0, 0), (3, 3)), dtype=dtype)
+    b7 = _conv_bn(b7, p["branch7x7_3"], padding=((3, 3), (0, 0)), dtype=dtype)
+    bd = _conv_bn(x, p["branch7x7dbl_1"], dtype=dtype)
+    bd = _conv_bn(bd, p["branch7x7dbl_2"], padding=((3, 3), (0, 0)), dtype=dtype)
+    bd = _conv_bn(bd, p["branch7x7dbl_3"], padding=((0, 0), (3, 3)), dtype=dtype)
+    bd = _conv_bn(bd, p["branch7x7dbl_4"], padding=((3, 3), (0, 0)), dtype=dtype)
+    bd = _conv_bn(bd, p["branch7x7dbl_5"], padding=((0, 0), (3, 3)), dtype=dtype)
+    bp = _conv_bn(_avg_pool_exclude_pad(x), p["branch_pool"], dtype=dtype)
     return jnp.concatenate([b1, b7, bd, bp], axis=1)
 
 
-def _inception_d(x, p):
-    b3 = _conv_bn(_conv_bn(x, p["branch3x3_1"]), p["branch3x3_2"], stride=2)
-    b7 = _conv_bn(x, p["branch7x7x3_1"])
-    b7 = _conv_bn(b7, p["branch7x7x3_2"], padding=((0, 0), (3, 3)))
-    b7 = _conv_bn(b7, p["branch7x7x3_3"], padding=((3, 3), (0, 0)))
-    b7 = _conv_bn(b7, p["branch7x7x3_4"], stride=2)
+def _inception_d(x, p, dtype=None):
+    b3 = _conv_bn(_conv_bn(x, p["branch3x3_1"], dtype=dtype), p["branch3x3_2"], stride=2, dtype=dtype)
+    b7 = _conv_bn(x, p["branch7x7x3_1"], dtype=dtype)
+    b7 = _conv_bn(b7, p["branch7x7x3_2"], padding=((0, 0), (3, 3)), dtype=dtype)
+    b7 = _conv_bn(b7, p["branch7x7x3_3"], padding=((3, 3), (0, 0)), dtype=dtype)
+    b7 = _conv_bn(b7, p["branch7x7x3_4"], stride=2, dtype=dtype)
     bp = _max_pool(x)
     return jnp.concatenate([b3, b7, bp], axis=1)
 
 
-def _inception_e(x, p, pool: str):
-    b1 = _conv_bn(x, p["branch1x1"])
-    b3 = _conv_bn(x, p["branch3x3_1"])
+def _inception_e(x, p, pool: str, dtype=None):
+    b1 = _conv_bn(x, p["branch1x1"], dtype=dtype)
+    b3 = _conv_bn(x, p["branch3x3_1"], dtype=dtype)
     b3 = jnp.concatenate(
         [
-            _conv_bn(b3, p["branch3x3_2a"], padding=((0, 0), (1, 1))),
-            _conv_bn(b3, p["branch3x3_2b"], padding=((1, 1), (0, 0))),
+            _conv_bn(b3, p["branch3x3_2a"], padding=((0, 0), (1, 1)), dtype=dtype),
+            _conv_bn(b3, p["branch3x3_2b"], padding=((1, 1), (0, 0)), dtype=dtype),
         ],
         axis=1,
     )
-    bd = _conv_bn(x, p["branch3x3dbl_1"])
-    bd = _conv_bn(bd, p["branch3x3dbl_2"], padding=((1, 1), (1, 1)))
+    bd = _conv_bn(x, p["branch3x3dbl_1"], dtype=dtype)
+    bd = _conv_bn(bd, p["branch3x3dbl_2"], padding=((1, 1), (1, 1)), dtype=dtype)
     bd = jnp.concatenate(
         [
-            _conv_bn(bd, p["branch3x3dbl_3a"], padding=((0, 0), (1, 1))),
-            _conv_bn(bd, p["branch3x3dbl_3b"], padding=((1, 1), (0, 0))),
+            _conv_bn(bd, p["branch3x3dbl_3a"], padding=((0, 0), (1, 1)), dtype=dtype),
+            _conv_bn(bd, p["branch3x3dbl_3b"], padding=((1, 1), (0, 0)), dtype=dtype),
         ],
         axis=1,
     )
@@ -153,49 +183,61 @@ def _inception_e(x, p, pool: str):
         pooled = lax.reduce_window(
             x, -jnp.inf, lax.max, (1, 1, 3, 3), (1, 1, 1, 1), ((0, 0), (0, 0), (1, 1), (1, 1))
         )
-    bp = _conv_bn(pooled, p["branch_pool"])
+    bp = _conv_bn(pooled, p["branch_pool"], dtype=dtype)
     return jnp.concatenate([b1, b3, bd, bp], axis=1)
 
 
 # ------------------------------------------------------------------- network
 
-def inception_features(params: Dict[str, Any], x: Array, feature: Union[int, str] = 2048) -> Array:
+def inception_features(
+    params: Dict[str, Any], x: Array, feature: Union[int, str] = 2048, compute_dtype=None
+) -> Array:
     """Forward uint8 RGB NCHW images to the requested feature tap.
 
     Taps mirror the reference extractor (image/fid.py:96-110): ``64`` after the
     first max pool, ``192`` after the second, ``768`` after ``Mixed_6e`` — all
     globally average-pooled to ``(N, dim)`` — ``2048`` after the global average
     pool, ``"logits_unbiased"`` = fc without bias, ``"logits"`` with bias.
+
+    ``compute_dtype=jnp.bfloat16`` runs the conv stack MXU-native (bf16
+    operands, f32 accumulation, f32 batch-norm; resize, pooling taps and the
+    returned features stay f32) — measured ~1.5x the f32 forward on v5e with
+    max feature drift ~3e-3 relative (random weights, 64x64 inputs). NOTE:
+    FID's covariance + matrix-sqrt amplifies feature drift when the sample
+    count is small relative to the 2048 feature dims — use bf16 for throughput
+    at realistic sample counts, f32 for small-sample parity. Default f32
+    matches the torch reference within the parity-test tolerance.
     """
+    dtype = compute_dtype
     x = x.astype(jnp.float32)
     x = _tf1_bilinear_resize(x, 299, 299)
     x = (x - 128.0) / 128.0
 
-    x = _conv_bn(x, params["Conv2d_1a_3x3"], stride=2)
-    x = _conv_bn(x, params["Conv2d_2a_3x3"])
-    x = _conv_bn(x, params["Conv2d_2b_3x3"], padding=((1, 1), (1, 1)))
+    x = _conv_bn(x, params["Conv2d_1a_3x3"], stride=2, dtype=dtype)
+    x = _conv_bn(x, params["Conv2d_2a_3x3"], dtype=dtype)
+    x = _conv_bn(x, params["Conv2d_2b_3x3"], padding=((1, 1), (1, 1)), dtype=dtype)
     x = _max_pool(x)
     if feature == 64:
-        return x.mean(axis=(2, 3))
-    x = _conv_bn(x, params["Conv2d_3b_1x1"])
-    x = _conv_bn(x, params["Conv2d_4a_3x3"])
+        return x.astype(jnp.float32).mean(axis=(2, 3))
+    x = _conv_bn(x, params["Conv2d_3b_1x1"], dtype=dtype)
+    x = _conv_bn(x, params["Conv2d_4a_3x3"], dtype=dtype)
     x = _max_pool(x)
     if feature == 192:
-        return x.mean(axis=(2, 3))
-    x = _inception_a(x, params["Mixed_5b"])
-    x = _inception_a(x, params["Mixed_5c"])
-    x = _inception_a(x, params["Mixed_5d"])
-    x = _inception_b(x, params["Mixed_6a"])
-    x = _inception_c(x, params["Mixed_6b"])
-    x = _inception_c(x, params["Mixed_6c"])
-    x = _inception_c(x, params["Mixed_6d"])
-    x = _inception_c(x, params["Mixed_6e"])
+        return x.astype(jnp.float32).mean(axis=(2, 3))
+    x = _inception_a(x, params["Mixed_5b"], dtype=dtype)
+    x = _inception_a(x, params["Mixed_5c"], dtype=dtype)
+    x = _inception_a(x, params["Mixed_5d"], dtype=dtype)
+    x = _inception_b(x, params["Mixed_6a"], dtype=dtype)
+    x = _inception_c(x, params["Mixed_6b"], dtype=dtype)
+    x = _inception_c(x, params["Mixed_6c"], dtype=dtype)
+    x = _inception_c(x, params["Mixed_6d"], dtype=dtype)
+    x = _inception_c(x, params["Mixed_6e"], dtype=dtype)
     if feature == 768:
-        return x.mean(axis=(2, 3))
-    x = _inception_d(x, params["Mixed_7a"])
-    x = _inception_e(x, params["Mixed_7b"], pool="avg")
-    x = _inception_e(x, params["Mixed_7c"], pool="max")
-    x = x.mean(axis=(2, 3))  # global average pool -> (N, 2048)
+        return x.astype(jnp.float32).mean(axis=(2, 3))
+    x = _inception_d(x, params["Mixed_7a"], dtype=dtype)
+    x = _inception_e(x, params["Mixed_7b"], pool="avg", dtype=dtype)
+    x = _inception_e(x, params["Mixed_7c"], pool="max", dtype=dtype)
+    x = x.astype(jnp.float32).mean(axis=(2, 3))  # global average pool -> (N, 2048)
     if feature == 2048:
         return x
     logits = x @ params["fc"]["weight"].T

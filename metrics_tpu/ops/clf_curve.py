@@ -156,8 +156,10 @@ def _binary_curve_padded_kernel(preds: Array, target: Array, valid: Array) -> Tu
     boundary = run_boundary & finite
     pos = tps[-1]
     precision_all = tps.astype(jnp.float32) / jnp.maximum(tps + fps, 1)
-    # 0 positives yields recall 0 (the host path's 0/0 NaN is unusable anyway)
-    recall_all = tps.astype(jnp.float32) / jnp.maximum(pos, 1)
+    # 0 positives: NaN recall (0/0), matching the eager/host path's tps/tps[-1]
+    # exactly — the same metric instance must not change degenerate values
+    # depending on whether compute runs eagerly or under jit
+    recall_all = jnp.where(pos > 0, tps.astype(jnp.float32) / jnp.maximum(pos, 1), jnp.nan)
 
     # flip to ascending thresholds, then front-pack the run-end points
     fb = jnp.flip(boundary)

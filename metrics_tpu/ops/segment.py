@@ -81,19 +81,6 @@ def _segment_cumsum_nonneg(values: Array, new_seg: Array) -> Array:
     return g - base
 
 
-def _segment_cumsum(values: Array, seg_id: Array, num_segments: int) -> Array:
-    """Within-segment inclusive cumsum via global cumsum minus per-segment base.
-
-    General-sign fallback (uses one gather); prefer ``_segment_cumsum_nonneg``
-    for non-negative inputs on the hot path.
-    """
-    g = jnp.cumsum(values)
-    pos = jnp.arange(values.shape[0])
-    start = jax.ops.segment_min(pos, seg_id, num_segments=num_segments, indices_are_sorted=True)
-    base = g[start[seg_id]] - values[start[seg_id]]
-    return g - base
-
-
 # metrics whose per-query value is a segmented-cumsum read at the segment's
 # last row: they run with ZERO segment scatters (sort + ~5 scans + plain sums)
 _SCAN_METRICS = frozenset(

@@ -68,7 +68,14 @@ def _lists_to_buffers(metric, state0, batches, n_devices: int):
                 )
             rows_per_batch = sum(jnp.atleast_1d(v).shape[0] for v in val)
             item = jnp.atleast_1d(jnp.asarray(val[0]))
-            out[name] = CatBuffer.create(rows_per_batch * len(batches), item.shape[1:], item.dtype)
+            # honor the state's declared cat metadata: e.g. retrieval indexes
+            # declare cat_fill_value=-1 so unwritten tail rows form an invalid
+            # query group instead of silently joining query 0 (the probe only
+            # supplies shape/dtype defaults)
+            _, decl_dtype, decl_fill = getattr(metric, "_cat_meta", {}).get(name, ((), None, 0))
+            out[name] = CatBuffer.create(
+                rows_per_batch * len(batches), item.shape[1:], decl_dtype or item.dtype, decl_fill
+            )
         else:
             out[name] = state0[name]
     return out

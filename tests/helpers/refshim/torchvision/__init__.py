@@ -1,0 +1,10 @@
+"""Minimal torchvision shim so the reference library's detection metrics can run
+as a local baseline (this environment has no torchvision wheel).
+
+Only what `/root/reference/src/torchmetrics/detection/mean_ap.py:31` imports:
+``torchvision.ops.box_area / box_convert / box_iou``, implemented with plain
+torch ops following the documented torchvision semantics.
+"""
+from . import ops  # noqa: F401
+
+__version__ = "0.15.0"

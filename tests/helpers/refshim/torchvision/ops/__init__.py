@@ -1,0 +1,83 @@
+import torch
+
+
+def box_area(boxes: torch.Tensor) -> torch.Tensor:
+    return (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+
+
+def box_iou(boxes1: torch.Tensor, boxes2: torch.Tensor) -> torch.Tensor:
+    area1 = box_area(boxes1)
+    area2 = box_area(boxes2)
+    lt = torch.max(boxes1[:, None, :2], boxes2[None, :, :2])
+    rb = torch.min(boxes1[:, None, 2:], boxes2[None, :, 2:])
+    wh = (rb - lt).clamp(min=0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area1[:, None] + area2[None, :] - inter
+    return inter / union
+
+
+def generalized_box_iou(boxes1: torch.Tensor, boxes2: torch.Tensor) -> torch.Tensor:
+    iou = box_iou(boxes1, boxes2)
+    lt = torch.min(boxes1[:, None, :2], boxes2[None, :, :2])
+    rb = torch.max(boxes1[:, None, 2:], boxes2[None, :, 2:])
+    wh = (rb - lt).clamp(min=0)
+    hull = wh[..., 0] * wh[..., 1]
+    area1 = box_area(boxes1)
+    area2 = box_area(boxes2)
+    inter = iou * (area1[:, None] + area2[None, :]) / (1 + iou)  # recover inter from iou
+    union = area1[:, None] + area2[None, :] - inter
+    return iou - (hull - union) / hull
+
+
+def distance_box_iou(boxes1: torch.Tensor, boxes2: torch.Tensor, eps: float = 1e-7) -> torch.Tensor:
+    iou = box_iou(boxes1, boxes2)
+    lt = torch.min(boxes1[:, None, :2], boxes2[None, :, :2])
+    rb = torch.max(boxes1[:, None, 2:], boxes2[None, :, 2:])
+    wh = (rb - lt).clamp(min=0)
+    diag = wh[..., 0] ** 2 + wh[..., 1] ** 2
+    c1 = (boxes1[:, :2] + boxes1[:, 2:]) / 2
+    c2 = (boxes2[:, :2] + boxes2[:, 2:]) / 2
+    d = ((c1[:, None] - c2[None, :]) ** 2).sum(-1)
+    return iou - d / (diag + eps)
+
+
+def complete_box_iou(boxes1: torch.Tensor, boxes2: torch.Tensor, eps: float = 1e-7) -> torch.Tensor:
+    import math
+
+    diou = distance_box_iou(boxes1, boxes2, eps)
+    iou = box_iou(boxes1, boxes2)
+    w1 = boxes1[:, 2] - boxes1[:, 0]
+    h1 = boxes1[:, 3] - boxes1[:, 1]
+    w2 = boxes2[:, 2] - boxes2[:, 0]
+    h2 = boxes2[:, 3] - boxes2[:, 1]
+    v = (4 / math.pi**2) * (torch.atan(w1 / h1)[:, None] - torch.atan(w2 / h2)[None, :]) ** 2
+    alpha = v / (1 - iou + v + eps)
+    return diou - alpha * v
+
+
+def _xywh_to_xyxy(b):
+    x, y, w, h = b.unbind(-1)
+    return torch.stack([x, y, x + w, y + h], -1)
+
+
+def _cxcywh_to_xyxy(b):
+    cx, cy, w, h = b.unbind(-1)
+    return torch.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], -1)
+
+
+def _xyxy_to_xywh(b):
+    x1, y1, x2, y2 = b.unbind(-1)
+    return torch.stack([x1, y1, x2 - x1, y2 - y1], -1)
+
+
+def _xyxy_to_cxcywh(b):
+    x1, y1, x2, y2 = b.unbind(-1)
+    return torch.stack([(x1 + x2) / 2, (y1 + y2) / 2, x2 - x1, y2 - y1], -1)
+
+
+def box_convert(boxes: torch.Tensor, in_fmt: str, out_fmt: str) -> torch.Tensor:
+    if in_fmt == out_fmt:
+        return boxes.clone()
+    to_xyxy = {"xyxy": lambda b: b, "xywh": _xywh_to_xyxy, "cxcywh": _cxcywh_to_xyxy}
+    from_xyxy = {"xyxy": lambda b: b, "xywh": _xyxy_to_xywh, "cxcywh": _xyxy_to_cxcywh}
+    return from_xyxy[out_fmt](to_xyxy[in_fmt](boxes))

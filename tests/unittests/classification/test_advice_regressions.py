@@ -10,6 +10,7 @@ Round 2:
    reference's max_fpr==1 -> full-AUC short-circuit (0.0, not NaN).
 5. `_fid_from_moments` must not emit Inf for n==1 states on the jit path.
 """
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -102,3 +103,29 @@ def test_fairness_non_contiguous_groups_skip_empty():
     eo = equal_opportunity(preds, target, groups, validate_args=False)
     ((key, _),) = eo.items()
     assert "1" not in key.split("_")[1:]
+
+
+def test_jit_exact_curve_zero_positive_recall_is_nan_like_eager():
+    """ADVICE r3: the jit padded exact curve must return the same degenerate
+    recall (NaN from 0/0) as the eager/host path when a batch has no positives."""
+    import numpy as np
+
+    from metrics_tpu.ops.clf_curve import binary_precision_recall_curve_padded
+
+    preds = jnp.asarray(np.random.default_rng(0).random(17), jnp.float32)
+    target = jnp.zeros(17, jnp.int32)  # zero positives
+    _, recall, _, k = jax.jit(binary_precision_recall_curve_padded)(preds, target)
+    assert bool(jnp.isnan(recall[: int(k)]).all()), "0-positive recall must be NaN (0/0) under jit too"
+
+
+def test_fixed_point_metrics_raise_clearly_under_jit():
+    """ADVICE r3: recall@precision reached via jit must fail with a clear
+    eager-only message, not an opaque TracerArrayConversionError."""
+    import pytest
+
+    from metrics_tpu.classification import BinaryRecallAtFixedPrecision
+
+    m = BinaryRecallAtFixedPrecision(min_precision=0.5)
+    state = m.local_update(m.init_state(), jnp.asarray([0.2, 0.8, 0.6]), jnp.asarray([0, 1, 1]))
+    with pytest.raises(NotImplementedError, match="eager-only"):
+        jax.jit(m.compute_from)(state)

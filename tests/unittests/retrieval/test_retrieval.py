@@ -220,3 +220,60 @@ class TestRetrievalClasses:
         keep = t != -1
         expected = _group_apply(_np_ap, _indexes[keep], _preds[keep], _target[keep])
         np.testing.assert_allclose(np.asarray(metric.compute()), expected, rtol=1e-5)
+
+
+class TestDensePathInvariant:
+    """Pin the zero-copy dense-path contract (retrieval/base.py): a partially
+    filled CatBuffer's unwritten tail rows must carry index fill -1 and form an
+    invalid query group, so feeding buffer data directly (no trim) is exact."""
+
+    def _data(self, n):
+        rng = np.random.default_rng(9)
+        idx = np.sort(rng.integers(0, 7, n)).astype(np.int32)
+        preds = rng.random(n).astype(np.float32)
+        target = (rng.random(n) > 0.5).astype(np.int32)
+        return idx, preds, target
+
+    def test_partially_filled_buffer_matches_oracle(self):
+        # 40 of 64 rows: _next_pow2(40) == 64 >= capacity -> dense path taken
+        # with 24 unwritten tail rows; they must not join any real query group
+        idx, preds, target = self._data(40)
+        metric = RetrievalMAP(cat_capacity=64)
+        metric.update(preds, target, indexes=idx)
+        from metrics_tpu.core.state import CatBuffer
+
+        assert isinstance(metric.indexes, CatBuffer)
+        assert int(metric.indexes.valid_count()) == 40
+        tail = np.asarray(metric.indexes.data)[40:]
+        assert (tail == -1).all(), "unwritten index rows must carry the declared fill -1"
+        expected = _group_apply(_np_ap, idx, preds, target)
+        np.testing.assert_allclose(np.asarray(metric.compute()), expected, rtol=1e-5)
+
+    def test_dense_path_after_reset_refill(self):
+        idx, preds, target = self._data(40)
+        metric = RetrievalMAP(cat_capacity=64)
+        metric.update(preds, target, indexes=idx)
+        metric.compute()
+        metric.reset()
+        # second fill after reset: the fill invariant must be re-established
+        idx2, preds2, target2 = self._data(33)
+        metric.update(preds2, target2, indexes=idx2)
+        tail = np.asarray(metric.indexes.data)[33:]
+        assert (tail == -1).all()
+        expected = _group_apply(_np_ap, idx2, preds2, target2)
+        np.testing.assert_allclose(np.asarray(metric.compute()), expected, rtol=1e-5)
+
+    def test_auto_sized_buffers_inherit_declared_fill(self):
+        # parallel.mesh._lists_to_buffers must plumb add_state's cat_fill_value
+        # (ADVICE r3): an auto-sized indexes buffer with default fill 0 would
+        # silently join query group 0
+        from metrics_tpu.core.state import CatBuffer
+        from metrics_tpu.parallel.mesh import _lists_to_buffers
+
+        idx, preds, target = self._data(16)
+        metric = RetrievalMAP()
+        state0 = metric.init_state()
+        batches = [(preds[:8], target[:8], idx[:8]), (preds[8:], target[8:], idx[8:])]
+        bufs = _lists_to_buffers(metric, state0, batches, n_devices=1)
+        assert isinstance(bufs["indexes"], CatBuffer)
+        assert (np.asarray(bufs["indexes"].data) == -1).all()
