@@ -72,26 +72,26 @@ from metrics_tpu.detection import (
     GeneralizedIntersectionOverUnion,
     IntersectionOverUnion,
     MeanAveragePrecision,
-    ModifiedPanopticQuality,
-    PanopticQuality,
 )
+from metrics_tpu.detection._deprecated import _ModifiedPanopticQuality as ModifiedPanopticQuality  # noqa: E402
+from metrics_tpu.detection._deprecated import _PanopticQuality as PanopticQuality  # noqa: E402
 from metrics_tpu.image import (
-    ErrorRelativeGlobalDimensionlessSynthesis,
     FrechetInceptionDistance,
     InceptionScore,
     KernelInceptionDistance,
     LearnedPerceptualImagePatchSimilarity,
-    MultiScaleStructuralSimilarityIndexMeasure,
-    PeakSignalNoiseRatio,
     PeakSignalNoiseRatioWithBlockedEffect,
-    RelativeAverageSpectralError,
-    RootMeanSquaredErrorUsingSlidingWindow,
-    SpectralAngleMapper,
-    SpectralDistortionIndex,
-    StructuralSimilarityIndexMeasure,
-    TotalVariation,
-    UniversalImageQualityIndex,
 )
+from metrics_tpu.image._deprecated import _ErrorRelativeGlobalDimensionlessSynthesis as ErrorRelativeGlobalDimensionlessSynthesis  # noqa: E402
+from metrics_tpu.image._deprecated import _MultiScaleStructuralSimilarityIndexMeasure as MultiScaleStructuralSimilarityIndexMeasure  # noqa: E402
+from metrics_tpu.image._deprecated import _PeakSignalNoiseRatio as PeakSignalNoiseRatio  # noqa: E402
+from metrics_tpu.image._deprecated import _RelativeAverageSpectralError as RelativeAverageSpectralError  # noqa: E402
+from metrics_tpu.image._deprecated import _RootMeanSquaredErrorUsingSlidingWindow as RootMeanSquaredErrorUsingSlidingWindow  # noqa: E402
+from metrics_tpu.image._deprecated import _SpectralAngleMapper as SpectralAngleMapper  # noqa: E402
+from metrics_tpu.image._deprecated import _SpectralDistortionIndex as SpectralDistortionIndex  # noqa: E402
+from metrics_tpu.image._deprecated import _StructuralSimilarityIndexMeasure as StructuralSimilarityIndexMeasure  # noqa: E402
+from metrics_tpu.image._deprecated import _TotalVariation as TotalVariation  # noqa: E402
+from metrics_tpu.image._deprecated import _UniversalImageQualityIndex as UniversalImageQualityIndex  # noqa: E402
 from metrics_tpu.nominal import CramersV, PearsonsContingencyCoefficient, TheilsU, TschuprowsT
 from metrics_tpu.regression import (
     ConcordanceCorrCoef,
@@ -114,43 +114,41 @@ from metrics_tpu.regression import (
 )
 from metrics_tpu.audio import (
     PerceptualEvaluationSpeechQuality,
-    PermutationInvariantTraining,
-    ScaleInvariantSignalDistortionRatio,
-    ScaleInvariantSignalNoiseRatio,
     ShortTimeObjectiveIntelligibility,
-    SignalDistortionRatio,
-    SignalNoiseRatio,
 )
+from metrics_tpu.audio._deprecated import _PermutationInvariantTraining as PermutationInvariantTraining  # noqa: E402
+from metrics_tpu.audio._deprecated import _ScaleInvariantSignalDistortionRatio as ScaleInvariantSignalDistortionRatio  # noqa: E402
+from metrics_tpu.audio._deprecated import _ScaleInvariantSignalNoiseRatio as ScaleInvariantSignalNoiseRatio  # noqa: E402
+from metrics_tpu.audio._deprecated import _SignalDistortionRatio as SignalDistortionRatio  # noqa: E402
+from metrics_tpu.audio._deprecated import _SignalNoiseRatio as SignalNoiseRatio  # noqa: E402
 from metrics_tpu.multimodal import CLIPScore
 from metrics_tpu.text import (
     BERTScore,
-    BLEUScore,
-    CharErrorRate,
-    CHRFScore,
-    ExtendedEditDistance,
     InfoLM,
-    MatchErrorRate,
-    Perplexity,
     ROUGEScore,
-    SacreBLEUScore,
-    SQuAD,
-    TranslationEditRate,
-    WordErrorRate,
-    WordInfoLost,
-    WordInfoPreserved,
 )
-from metrics_tpu.retrieval import (
-    RetrievalFallOut,
-    RetrievalHitRate,
-    RetrievalMAP,
-    RetrievalMRR,
-    RetrievalNormalizedDCG,
-    RetrievalPrecision,
-    RetrievalPrecisionRecallCurve,
-    RetrievalRecallAtFixedPrecision,
-    RetrievalRPrecision,
-    RetrievalRecall,
-)
+from metrics_tpu.text._deprecated import _BLEUScore as BLEUScore  # noqa: E402
+from metrics_tpu.text._deprecated import _CHRFScore as CHRFScore  # noqa: E402
+from metrics_tpu.text._deprecated import _CharErrorRate as CharErrorRate  # noqa: E402
+from metrics_tpu.text._deprecated import _ExtendedEditDistance as ExtendedEditDistance  # noqa: E402
+from metrics_tpu.text._deprecated import _MatchErrorRate as MatchErrorRate  # noqa: E402
+from metrics_tpu.text._deprecated import _Perplexity as Perplexity  # noqa: E402
+from metrics_tpu.text._deprecated import _SQuAD as SQuAD  # noqa: E402
+from metrics_tpu.text._deprecated import _SacreBLEUScore as SacreBLEUScore  # noqa: E402
+from metrics_tpu.text._deprecated import _TranslationEditRate as TranslationEditRate  # noqa: E402
+from metrics_tpu.text._deprecated import _WordErrorRate as WordErrorRate  # noqa: E402
+from metrics_tpu.text._deprecated import _WordInfoLost as WordInfoLost  # noqa: E402
+from metrics_tpu.text._deprecated import _WordInfoPreserved as WordInfoPreserved  # noqa: E402
+from metrics_tpu.retrieval._deprecated import _RetrievalFallOut as RetrievalFallOut  # noqa: E402
+from metrics_tpu.retrieval._deprecated import _RetrievalHitRate as RetrievalHitRate  # noqa: E402
+from metrics_tpu.retrieval._deprecated import _RetrievalMAP as RetrievalMAP  # noqa: E402
+from metrics_tpu.retrieval._deprecated import _RetrievalMRR as RetrievalMRR  # noqa: E402
+from metrics_tpu.retrieval._deprecated import _RetrievalNormalizedDCG as RetrievalNormalizedDCG  # noqa: E402
+from metrics_tpu.retrieval._deprecated import _RetrievalPrecision as RetrievalPrecision  # noqa: E402
+from metrics_tpu.retrieval._deprecated import _RetrievalPrecisionRecallCurve as RetrievalPrecisionRecallCurve  # noqa: E402
+from metrics_tpu.retrieval._deprecated import _RetrievalRPrecision as RetrievalRPrecision  # noqa: E402
+from metrics_tpu.retrieval._deprecated import _RetrievalRecall as RetrievalRecall  # noqa: E402
+from metrics_tpu.retrieval._deprecated import _RetrievalRecallAtFixedPrecision as RetrievalRecallAtFixedPrecision  # noqa: E402
 from metrics_tpu.wrappers import BootStrapper, ClasswiseWrapper, MetricTracker, MinMaxMetric, MultioutputWrapper
 
 __all__ = [

@@ -1,0 +1,17 @@
+"""Root-import deprecation shims (reference: audio/_deprecated.py).
+
+v1.0 moved the audio metrics into the subpackage; importing them from the
+package root still works through these ``_<Name>`` subclasses but emits the
+reference's FutureWarning (utilities/prints.py:59-65). The subpackage path
+(``metrics_tpu.audio.<Name>``) stays silent.
+"""
+from metrics_tpu.audio import PermutationInvariantTraining, ScaleInvariantSignalDistortionRatio, ScaleInvariantSignalNoiseRatio, SignalDistortionRatio, SignalNoiseRatio
+from metrics_tpu.utils.prints import _root_class_shim
+
+_PermutationInvariantTraining = _root_class_shim(PermutationInvariantTraining, "PermutationInvariantTraining", "audio", __name__)
+_ScaleInvariantSignalDistortionRatio = _root_class_shim(ScaleInvariantSignalDistortionRatio, "ScaleInvariantSignalDistortionRatio", "audio", __name__)
+_ScaleInvariantSignalNoiseRatio = _root_class_shim(ScaleInvariantSignalNoiseRatio, "ScaleInvariantSignalNoiseRatio", "audio", __name__)
+_SignalDistortionRatio = _root_class_shim(SignalDistortionRatio, "SignalDistortionRatio", "audio", __name__)
+_SignalNoiseRatio = _root_class_shim(SignalNoiseRatio, "SignalNoiseRatio", "audio", __name__)
+
+__all__ = ["_PermutationInvariantTraining", "_ScaleInvariantSignalDistortionRatio", "_ScaleInvariantSignalNoiseRatio", "_SignalDistortionRatio", "_SignalNoiseRatio"]

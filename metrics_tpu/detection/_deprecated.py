@@ -1,0 +1,14 @@
+"""Root-import deprecation shims (reference: detection/_deprecated.py).
+
+v1.0 moved the detection metrics into the subpackage; importing them from the
+package root still works through these ``_<Name>`` subclasses but emits the
+reference's FutureWarning (utilities/prints.py:59-65). The subpackage path
+(``metrics_tpu.detection.<Name>``) stays silent.
+"""
+from metrics_tpu.detection import ModifiedPanopticQuality, PanopticQuality
+from metrics_tpu.utils.prints import _root_class_shim
+
+_ModifiedPanopticQuality = _root_class_shim(ModifiedPanopticQuality, "ModifiedPanopticQuality", "detection", __name__)
+_PanopticQuality = _root_class_shim(PanopticQuality, "PanopticQuality", "detection", __name__)
+
+__all__ = ["_ModifiedPanopticQuality", "_PanopticQuality"]

@@ -1,0 +1,12 @@
+"""Root-functional deprecation shims (reference: functional/detection/_deprecated.py).
+
+``metrics_tpu.functional.<name>`` warns; ``metrics_tpu.functional.detection.<name>``
+stays silent (reference utilities/prints.py:67-72).
+"""
+from metrics_tpu.functional.detection import modified_panoptic_quality, panoptic_quality
+from metrics_tpu.utils.prints import _root_func_shim
+
+_modified_panoptic_quality = _root_func_shim(modified_panoptic_quality, "modified_panoptic_quality", "detection")
+_panoptic_quality = _root_func_shim(panoptic_quality, "panoptic_quality", "detection")
+
+__all__ = ["_modified_panoptic_quality", "_panoptic_quality"]

@@ -1,0 +1,24 @@
+"""Root-import deprecation shims (reference: text/_deprecated.py).
+
+v1.0 moved the text metrics into the subpackage; importing them from the
+package root still works through these ``_<Name>`` subclasses but emits the
+reference's FutureWarning (utilities/prints.py:59-65). The subpackage path
+(``metrics_tpu.text.<Name>``) stays silent.
+"""
+from metrics_tpu.text import BLEUScore, CharErrorRate, CHRFScore, ExtendedEditDistance, MatchErrorRate, Perplexity, SacreBLEUScore, SQuAD, TranslationEditRate, WordErrorRate, WordInfoLost, WordInfoPreserved
+from metrics_tpu.utils.prints import _root_class_shim
+
+_BLEUScore = _root_class_shim(BLEUScore, "BLEUScore", "text", __name__)
+_CharErrorRate = _root_class_shim(CharErrorRate, "CharErrorRate", "text", __name__)
+_CHRFScore = _root_class_shim(CHRFScore, "CHRFScore", "text", __name__)
+_ExtendedEditDistance = _root_class_shim(ExtendedEditDistance, "ExtendedEditDistance", "text", __name__)
+_MatchErrorRate = _root_class_shim(MatchErrorRate, "MatchErrorRate", "text", __name__)
+_Perplexity = _root_class_shim(Perplexity, "Perplexity", "text", __name__)
+_SacreBLEUScore = _root_class_shim(SacreBLEUScore, "SacreBLEUScore", "text", __name__)
+_SQuAD = _root_class_shim(SQuAD, "SQuAD", "text", __name__)
+_TranslationEditRate = _root_class_shim(TranslationEditRate, "TranslationEditRate", "text", __name__)
+_WordErrorRate = _root_class_shim(WordErrorRate, "WordErrorRate", "text", __name__)
+_WordInfoLost = _root_class_shim(WordInfoLost, "WordInfoLost", "text", __name__)
+_WordInfoPreserved = _root_class_shim(WordInfoPreserved, "WordInfoPreserved", "text", __name__)
+
+__all__ = ["_BLEUScore", "_CharErrorRate", "_CHRFScore", "_ExtendedEditDistance", "_MatchErrorRate", "_Perplexity", "_SacreBLEUScore", "_SQuAD", "_TranslationEditRate", "_WordErrorRate", "_WordInfoLost", "_WordInfoPreserved"]

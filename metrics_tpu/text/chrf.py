@@ -10,6 +10,7 @@ import jax.numpy as jnp
 from jax import Array
 
 from metrics_tpu.core.metric import Metric
+from metrics_tpu.utils.data import dim_zero_cat
 from metrics_tpu.functional.text.chrf import _chrf_score_compute, _chrf_score_update
 
 
@@ -105,5 +106,5 @@ class CHRFScore(Metric):
             self.beta,
         )
         if self.return_sentence_level_score:
-            return score, jnp.concatenate([jnp.atleast_1d(s) for s in self.sentence_chrf_score])
+            return score, dim_zero_cat(self.sentence_chrf_score)  # list locally, one array post-sync
         return score

@@ -5,6 +5,7 @@ import jax.numpy as jnp
 from jax import Array
 
 from metrics_tpu.core.metric import Metric
+from metrics_tpu.utils.data import dim_zero_cat
 from metrics_tpu.functional.text.eed import _eed_compute, _eed_update
 
 
@@ -64,7 +65,15 @@ class ExtendedEditDistance(Metric):
         self.sentence_eed.append(jnp.asarray(scores, jnp.float32))
 
     def compute(self) -> Union[Array, Tuple[Array, Array]]:
-        all_scores = jnp.concatenate([jnp.atleast_1d(s) for s in self.sentence_eed]) if self.sentence_eed else jnp.zeros(0)
+        # dim_zero_cat: the state is a list locally but arrives as one
+        # concatenated array after dist sync (cat reduction) — truthiness/
+        # iteration over the raw attribute breaks post-sync (caught by the
+        # contract sweep's two-rank parity case)
+        state = self.sentence_eed
+        if isinstance(state, list) and not state:
+            all_scores = jnp.zeros(0)
+        else:
+            all_scores = dim_zero_cat(state)
         average = _eed_compute(list(all_scores.tolist()))
         if self.return_sentence_level_score:
             return average, all_scores

@@ -5,6 +5,7 @@ import jax.numpy as jnp
 from jax import Array
 
 from metrics_tpu.core.metric import Metric
+from metrics_tpu.utils.data import dim_zero_cat
 from metrics_tpu.functional.text.ter import _TercomTokenizer, _ter_compute, _ter_update
 
 
@@ -69,5 +70,5 @@ class TranslationEditRate(Metric):
     def compute(self) -> Union[Array, Tuple[Array, Array]]:
         score = _ter_compute(self.total_num_edits, self.total_tgt_len)
         if self.return_sentence_level_score:
-            return score, jnp.concatenate([jnp.atleast_1d(s) for s in self.sentence_ter])
+            return score, dim_zero_cat(self.sentence_ter)  # list locally, one array post-sync
         return score

@@ -41,3 +41,51 @@ def _deprecated_warn(name: str, replacement: str) -> None:
     rank_zero_warn(
         f"`{name}` is deprecated, use `{replacement}` instead.", DeprecationWarning
     )
+
+
+def _future_warning(message: str) -> None:
+    warnings.warn(message, FutureWarning, stacklevel=3)
+
+
+def _deprecated_root_import_class(name: str, domain: str) -> None:
+    """Reference utilities/prints.py:59-65: v1.0 moved domain metrics to subpackages;
+    the root import keeps working but warns."""
+    _future_warning(
+        f"Importing `{name}` from `metrics_tpu` was deprecated and will be removed in 2.0."
+        f" Import `{name}` from `metrics_tpu.{domain}` instead."
+    )
+
+
+def _deprecated_root_import_func(name: str, domain: str) -> None:
+    """Reference utilities/prints.py:67-72 (functional namespace analogue)."""
+    _future_warning(
+        f"Importing `{name}` from `metrics_tpu.functional` was deprecated and will be removed in 2.0."
+        f" Import `{name}` from `metrics_tpu.functional.{domain}` instead."
+    )
+
+
+def _root_class_shim(cls: type, name: str, domain: str, module: str) -> type:
+    """Subclass ``cls`` so __init__ emits the root-import FutureWarning.
+
+    ``module`` must be the defining ``_deprecated`` module's ``__name__`` and the
+    shim is bound there as ``_<name>`` so pickling instances keeps working.
+    """
+
+    def __init__(self, *args: Any, **kwargs: Any) -> None:
+        _deprecated_root_import_class(name, domain)
+        cls.__init__(self, *args, **kwargs)
+
+    shim = type(f"_{name}", (cls,), {"__init__": __init__, "__module__": module, "__doc__": cls.__doc__})
+    shim.__qualname__ = f"_{name}"
+    return shim
+
+
+def _root_func_shim(fn: Callable, name: str, domain: str) -> Callable:
+    """Wrap ``fn`` so the root-functional call path warns like the reference."""
+
+    @wraps(fn)
+    def wrapped(*args: Any, **kwargs: Any) -> Any:
+        _deprecated_root_import_func(name, domain)
+        return fn(*args, **kwargs)
+
+    return wrapped

@@ -1,0 +1,425 @@
+"""Per-metric contract sweep over the ENTIRE root export list (VERDICT r3 item 8).
+
+The reference drives its ``MetricTester`` (DDP x dtypes x pickling x hashing,
+tests/unittests/helpers/testers.py:319-543) through a dedicated file per metric;
+here one parametrized sweep walks ``metrics_tpu.__all__`` programmatically so a
+newly exported metric class cannot ship without contract coverage: an
+exhaustiveness guard fails until the class lands in exactly one of
+
+- ``INPUT_FAMILY`` (full contract: construct, pickle/deepcopy/clone, metadata,
+  update -> finite compute, determinism after reset, pickle-after-update,
+  two-rank fake-gather sync parity, bf16 input pass), keyed by name or by
+  task-prefix rule (Binary*/Multiclass*/Multilabel*/Retrieval*...),
+- ``CONSTRUCT_ONLY`` (constructor+pickle contract only, reason inline), or
+- ``SKIPS`` (not testable here at all, reason inline).
+
+Dispatcher classes (``__new__``-routing like Accuracy/StatScores) are exercised
+through their task= form.
+"""
+import copy
+import inspect
+import pickle
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import metrics_tpu
+from metrics_tpu.core.metric import Metric
+
+_rng = np.random.RandomState(1234)
+N = 48
+
+
+def _probs01(n=N):
+    return _rng.rand(n).astype(np.float32)
+
+
+def _labels01(n=N):
+    return _rng.randint(0, 2, n).astype(np.int32)
+
+
+def _mc_probs(n=N, c=5):
+    x = _rng.rand(n, c).astype(np.float32) + 0.05
+    return x / x.sum(-1, keepdims=True)
+
+
+def _mc_labels(n=N, c=5):
+    return _rng.randint(0, c, n).astype(np.int32)
+
+
+def _ml_probs(n=N, l=3):
+    return _rng.rand(n, l).astype(np.float32)
+
+
+def _ml_labels(n=N, l=3):
+    return _rng.randint(0, 2, (n, l)).astype(np.int32)
+
+
+def _sig(*shape):
+    return _rng.randn(*shape).astype(np.float32)
+
+
+def _img(b=2, c=3, hw=16, positive=False):
+    x = _rng.rand(b, c, hw, hw).astype(np.float32)
+    return x + 0.1 if positive else x
+
+
+def _texts():
+    return (["the cat sat on the mat", "hello world"], ["the cat sat on a mat", "hello there world"])
+
+
+def _texts_multi_ref():
+    p, t = _texts()
+    return p, [[x] for x in t]
+
+
+def _flat8_feature(x):
+    # picklable stand-in feature extractor for FID/KID/IS (lambdas break the
+    # pickle contract the sweep itself checks)
+    return jnp.asarray(x, jnp.float32).reshape(x.shape[0], -1)[:, :8]
+
+
+def _det_inputs():
+    b = _rng.rand(3, 4).astype(np.float32) * 50
+    b[:, 2:] += b[:, :2] + 1
+    g = b + _rng.randn(3, 4).astype(np.float32)
+    preds = [{"boxes": jnp.asarray(b), "scores": jnp.asarray(_rng.rand(3).astype(np.float32)),
+              "labels": jnp.asarray(np.array([0, 1, 0], np.int32))}]
+    target = [{"boxes": jnp.asarray(g), "labels": jnp.asarray(np.array([0, 1, 1], np.int32))}]
+    return preds, target
+
+
+# ---- input families -------------------------------------------------------
+# name or prefix -> (ctor_kwargs, update_args_fn)
+# update_args_fn returns a tuple fed to metric.update (twice, for accumulation)
+
+FAMILIES = {
+    "Binary": ({}, lambda: (_probs01(), _labels01())),
+    "Multiclass": ({"num_classes": 5}, lambda: (_mc_probs(), _mc_labels())),
+    "Multilabel": ({"num_labels": 3}, lambda: (_ml_probs(), _ml_labels())),
+    "Retrieval": ({}, lambda: (_probs01(24), _labels01(24), np.sort(_rng.randint(0, 4, 24)).astype(np.int32))),
+}
+
+PER_NAME = {
+    # dispatchers: routed through their task= form
+    "Accuracy": ({"task": "binary"}, lambda: (_probs01(), _labels01())),
+    "AUROC": ({"task": "binary"}, lambda: (_probs01(), _labels01())),
+    "AveragePrecision": ({"task": "binary"}, lambda: (_probs01(), _labels01())),
+    "CalibrationError": ({"task": "binary"}, lambda: (_probs01(), _labels01())),
+    "CohenKappa": ({"task": "binary"}, lambda: (_probs01(), _labels01())),
+    "ConfusionMatrix": ({"task": "binary"}, lambda: (_probs01(), _labels01())),
+    "ExactMatch": ({"task": "multiclass", "num_classes": 5}, lambda: (_mc_labels(), _mc_labels())),
+    "F1Score": ({"task": "binary"}, lambda: (_probs01(), _labels01())),
+    "FBetaScore": ({"task": "binary", "beta": 0.5}, lambda: (_probs01(), _labels01())),
+    "HammingDistance": ({"task": "binary"}, lambda: (_probs01(), _labels01())),
+    "HingeLoss": ({"task": "binary"}, lambda: (_sig(N), _labels01())),
+    "JaccardIndex": ({"task": "binary"}, lambda: (_probs01(), _labels01())),
+    "MatthewsCorrCoef": ({"task": "binary"}, lambda: (_probs01(), _labels01())),
+    "Precision": ({"task": "binary"}, lambda: (_probs01(), _labels01())),
+    "PrecisionRecallCurve": ({"task": "binary", "thresholds": 11}, lambda: (_probs01(), _labels01())),
+    "Recall": ({"task": "binary"}, lambda: (_probs01(), _labels01())),
+    "ROC": ({"task": "binary", "thresholds": 11}, lambda: (_probs01(), _labels01())),
+    "Specificity": ({"task": "binary"}, lambda: (_probs01(), _labels01())),
+    "StatScores": ({"task": "binary"}, lambda: (_probs01(), _labels01())),
+    "RecallAtFixedPrecision": (
+        {"task": "binary", "min_precision": 0.5, "thresholds": 11}, lambda: (_probs01(), _labels01())
+    ),
+    "PrecisionAtFixedRecall": (
+        {"task": "binary", "min_recall": 0.5, "thresholds": 11}, lambda: (_probs01(), _labels01())
+    ),
+    "SpecificityAtSensitivity": (
+        {"task": "binary", "min_sensitivity": 0.5, "thresholds": 11}, lambda: (_probs01(), _labels01())
+    ),
+    "Dice": ({}, lambda: (_mc_labels(16, 3), _mc_labels(16, 3))),
+    # classification specials
+    "MulticlassExactMatch": ({"num_classes": 5}, lambda: (_mc_labels(), _mc_labels())),
+    "MultilabelExactMatch": ({"num_labels": 3}, lambda: (_ml_probs(), _ml_labels())),
+    "MultilabelCoverageError": ({"num_labels": 3}, lambda: (_ml_probs(), _ml_labels())),
+    "MultilabelRankingAveragePrecision": ({"num_labels": 3}, lambda: (_ml_probs(), _ml_labels())),
+    "MultilabelRankingLoss": ({"num_labels": 3}, lambda: (_ml_probs(), _ml_labels())),
+    "BinaryFairness": ({"num_groups": 2}, lambda: (_probs01(), _labels01(), _labels01())),
+    "BinaryGroupStatRates": ({"num_groups": 2}, lambda: (_probs01(), _labels01(), _labels01())),
+    # regression & aggregation
+    "CosineSimilarity": ({}, lambda: (_sig(4, 8), _sig(4, 8))),
+    "KLDivergence": ({}, lambda: (_mc_probs(8, 4), _mc_probs(8, 4))),
+    "KendallRankCorrCoef": ({}, lambda: (_sig(N), _sig(N))),
+    "SpearmanCorrCoef": ({}, lambda: (_sig(N), _sig(N))),
+    "PearsonCorrCoef": ({}, lambda: (_sig(N), _sig(N))),
+    "ConcordanceCorrCoef": ({}, lambda: (_sig(N), _sig(N))),
+    "ExplainedVariance": ({}, lambda: (_sig(N), _sig(N))),
+    "LogCoshError": ({}, lambda: (_sig(N), _sig(N))),
+    "MeanAbsoluteError": ({}, lambda: (_sig(N), _sig(N))),
+    "MeanAbsolutePercentageError": ({}, lambda: (_sig(N), np.abs(_sig(N)) + 0.5)),
+    "MeanSquaredError": ({}, lambda: (_sig(N), _sig(N))),
+    "MeanSquaredLogError": ({}, lambda: (np.abs(_sig(N)) + 0.5, np.abs(_sig(N)) + 0.5)),
+    "MinkowskiDistance": ({"p": 3}, lambda: (_sig(N), _sig(N))),
+    "R2Score": ({}, lambda: (_sig(N), _sig(N))),
+    "SymmetricMeanAbsolutePercentageError": ({}, lambda: (np.abs(_sig(N)) + 0.5, np.abs(_sig(N)) + 0.5)),
+    "TweedieDevianceScore": ({"power": 1.5}, lambda: (np.abs(_sig(N)) + 0.5, np.abs(_sig(N)) + 0.5)),
+    "WeightedMeanAbsolutePercentageError": ({}, lambda: (_sig(N), np.abs(_sig(N)) + 0.5)),
+    "MaxMetric": ({}, lambda: (_probs01(),)),
+    "MinMetric": ({}, lambda: (_probs01(),)),
+    "MeanMetric": ({}, lambda: (_probs01(),)),
+    "SumMetric": ({}, lambda: (_probs01(),)),
+    "CatMetric": ({}, lambda: (_probs01(),)),
+    "RunningMean": ({}, lambda: (_probs01(),)),
+    "RunningSum": ({}, lambda: (_probs01(),)),
+    # image (pairs)
+    "ErrorRelativeGlobalDimensionlessSynthesis": ({}, lambda: (_img(positive=True), _img(positive=True))),
+    "MultiScaleStructuralSimilarityIndexMeasure": (
+        {"data_range": 1.0, "betas": (0.5, 0.5), "kernel_size": 3},
+        lambda: (_img(hw=24), _img(hw=24)),
+    ),
+    "PeakSignalNoiseRatio": ({"data_range": 1.0}, lambda: (_img(), _img())),
+    "PeakSignalNoiseRatioWithBlockedEffect": ({"block_size": 4}, lambda: (_img(c=1), _img(c=1))),
+    "RelativeAverageSpectralError": ({"window_size": 4}, lambda: (_img(positive=True), _img(positive=True))),
+    "RootMeanSquaredErrorUsingSlidingWindow": ({"window_size": 4}, lambda: (_img(), _img())),
+    "SpectralAngleMapper": ({}, lambda: (_img(positive=True), _img(positive=True))),
+    "SpectralDistortionIndex": ({}, lambda: (_img(positive=True), _img(positive=True))),
+    "StructuralSimilarityIndexMeasure": ({"data_range": 1.0}, lambda: (_img(), _img())),
+    "TotalVariation": ({}, lambda: (_img(),)),
+    "UniversalImageQualityIndex": ({}, lambda: (_img(), _img())),
+    # audio
+    "ScaleInvariantSignalDistortionRatio": ({}, lambda: (_sig(2, 32), _sig(2, 32))),
+    "ScaleInvariantSignalNoiseRatio": ({}, lambda: (_sig(2, 32), _sig(2, 32))),
+    "SignalDistortionRatio": ({"filter_length": 4, "load_diag": 1e-4}, lambda: (_sig(2, 64), _sig(2, 64))),
+    "SignalNoiseRatio": ({}, lambda: (_sig(2, 32), _sig(2, 32))),
+    "PermutationInvariantTraining": (
+        {"metric_func": metrics_tpu.functional.audio.scale_invariant_signal_noise_ratio, "eval_func": "max"},  # subpackage fn: the root name is a deprecation shim (unpicklable wrapper, same as reference)
+        lambda: (_sig(2, 2, 32), _sig(2, 2, 32)),
+    ),
+    # text (host-side string metrics)
+    "BLEUScore": ({}, _texts_multi_ref),
+    "SacreBLEUScore": ({}, _texts_multi_ref),
+    "CHRFScore": ({}, _texts_multi_ref),
+    "CharErrorRate": ({}, _texts),
+    "ExtendedEditDistance": ({}, _texts),
+    "MatchErrorRate": ({}, _texts),
+    "TranslationEditRate": ({}, _texts_multi_ref),
+    "WordErrorRate": ({}, _texts),
+    "WordInfoLost": ({}, _texts),
+    "WordInfoPreserved": ({}, _texts),
+    "ROUGEScore": ({}, _texts),
+    "SQuAD": (
+        {},
+        lambda: (
+            [{"prediction_text": "paris", "id": "1"}],
+            [{"answers": {"answer_start": [0], "text": ["paris"]}, "id": "1"}],
+        ),
+    ),
+    "Perplexity": ({}, lambda: (_sig(2, 6, 8), _rng.randint(0, 8, (2, 6)).astype(np.int32))),
+    # detection
+    "MeanAveragePrecision": ({}, _det_inputs),
+    "IntersectionOverUnion": ({}, _det_inputs),
+    "GeneralizedIntersectionOverUnion": ({}, _det_inputs),
+    "DistanceIntersectionOverUnion": ({}, _det_inputs),
+    "CompleteIntersectionOverUnion": ({}, _det_inputs),
+    "PanopticQuality": (
+        {"things": {0}, "stuffs": {1}},
+        lambda: (
+            _rng.randint(0, 2, (1, 8, 8, 2)).astype(np.int32),
+            _rng.randint(0, 2, (1, 8, 8, 2)).astype(np.int32),
+        ),
+    ),
+    "ModifiedPanopticQuality": (
+        {"things": {0}, "stuffs": {1}},
+        lambda: (
+            _rng.randint(0, 2, (1, 8, 8, 2)).astype(np.int32),
+            _rng.randint(0, 2, (1, 8, 8, 2)).astype(np.int32),
+        ),
+    ),
+    # nominal
+    "CramersV": ({"num_classes": 4}, lambda: (_mc_labels(c=4), _mc_labels(c=4))),
+    "PearsonsContingencyCoefficient": ({"num_classes": 4}, lambda: (_mc_labels(c=4), _mc_labels(c=4))),
+    "TheilsU": ({"num_classes": 4}, lambda: (_mc_labels(c=4), _mc_labels(c=4))),
+    "TschuprowsT": ({"num_classes": 4}, lambda: (_mc_labels(c=4), _mc_labels(c=4))),
+    # image metrics with injectable feature extractors
+    "FrechetInceptionDistance": (
+        {"feature": _flat8_feature, "num_features": 8},
+        lambda: (_rng.randint(0, 256, (4, 3, 8, 8)).astype(np.uint8),),
+        ({"real": True}, {"real": False}),
+    ),
+    "KernelInceptionDistance": (
+        {"feature": _flat8_feature, "subset_size": 4, "subsets": 2},  # subset==n: degenerate-deterministic sampling
+        lambda: (_rng.randint(0, 256, (4, 3, 8, 8)).astype(np.uint8),),
+        ({"real": True}, {"real": False}),
+    ),
+    "InceptionScore": (
+        {"feature": _flat8_feature},
+        lambda: (_rng.randint(0, 256, (4, 3, 8, 8)).astype(np.uint8),),
+    ),
+}
+
+CONSTRUCT_ONLY = {
+    "Metric": "the ABC itself (runtime contract tested in test_metric.py)",
+    "CompositionalMetric": "built by operator overloads, not directly (test_composition.py)",
+    # wrappers/composition need a base metric instance (their deep behavior is
+    # covered by tests/unittests/bases/test_wrappers_deep.py / test_collections.py)
+    "BootStrapper": "wrapper: takes a base metric (deep-tested in test_wrappers_deep.py)",
+    "ClasswiseWrapper": "wrapper over a classwise metric (test_wrappers_deep.py)",
+    "MinMaxMetric": "wrapper (test_wrappers_deep.py)",
+    "MultioutputWrapper": "wrapper (test_wrappers_deep.py)",
+    "MetricTracker": "wrapper (test_wrappers_deep.py)",
+    "MetricCollection": "composition container (test_collections.py)",
+    "RetrievalPrecisionRecallCurve": "curve-valued compute (test_precision_recall_curve.py)",
+    "RetrievalRecallAtFixedPrecision": "curve-valued compute (test_precision_recall_curve.py)",
+}
+
+SKIPS = {
+    # these need model weights/tokenizers that cannot be fetched here (no
+    # network egress); their pipelines are differentially tested against torch
+    # oracles and pinned by committed goldens in image/test_golden_weights.py
+    "BERTScore": "needs a pretrained encoder; JAX port tested in text/test_bert_jax_port.py",
+    "InfoLM": "needs a pretrained masked-LM; tested in text/test_bert_jax_port.py",
+    "CLIPScore": "needs pretrained CLIP; tested in multimodal/test_clip_jax_port.py",
+    "LearnedPerceptualImagePatchSimilarity": "needs backbone weights; tested in image/test_psnrb_lpips.py",
+    "PerceptualEvaluationSpeechQuality": "delegates to the pesq wheel (same as reference)",
+    "ShortTimeObjectiveIntelligibility": "long DSP pipeline; parity-tested in audio/test_stoi.py",
+}
+
+
+def _case_for(name):
+    if name in PER_NAME:
+        entry = PER_NAME[name]
+        return entry if len(entry) == 3 else (entry[0], entry[1], {})
+    for prefix, (kwargs, gen) in FAMILIES.items():
+        if name.startswith(prefix):
+            return kwargs, gen, {}
+    return None
+
+
+def _metric_class_names():
+    # EVERY exported class counts (Metric subclasses AND plain __new__-routing
+    # dispatchers): a new export with no case must fail the guard, so no
+    # PER_NAME-membership filter here
+    names = []
+    for name in metrics_tpu.__all__:
+        obj = getattr(metrics_tpu, name, None)
+        if inspect.isclass(obj):
+            names.append(name)
+    return sorted(set(names))
+
+
+ALL_NAMES = _metric_class_names()
+
+
+def test_sweep_is_exhaustive():
+    uncovered = [
+        n for n in ALL_NAMES if _case_for(n) is None and n not in CONSTRUCT_ONLY and n not in SKIPS
+    ]
+    assert not uncovered, f"exported metric classes without a contract case: {uncovered}"
+
+
+_FULL = [n for n in ALL_NAMES if _case_for(n) is not None and n not in SKIPS and n not in CONSTRUCT_ONLY]
+
+
+@pytest.mark.parametrize("name", _FULL, ids=_FULL)
+def test_metric_contract(name):
+    kwargs, gen, upd_kwargs = _case_for(name)
+    cls = getattr(metrics_tpu, name)
+    metric = cls(**kwargs)
+
+    # metadata constants exist (reference write-protects them; testers.py:128-131)
+    for attr in ("is_differentiable", "higher_is_better", "full_state_update"):
+        assert hasattr(metric, attr), f"{name} missing metadata constant {attr}"
+
+    # pickle + deepcopy before any update
+    blob = pickle.dumps(metric)
+    clone = pickle.loads(blob)
+    assert type(clone) is type(metric)
+    copy.deepcopy(metric)
+
+    def to_dev(args):
+        return tuple(jnp.asarray(a) if isinstance(a, np.ndarray) else a for a in args)
+
+    kw1, kw2 = (upd_kwargs if isinstance(upd_kwargs, tuple) else (upd_kwargs, upd_kwargs))
+    args1, args2 = to_dev(gen()), to_dev(gen())
+    metric.update(*args1, **kw1)
+    metric.update(*args2, **kw2)
+    val = metric.compute()
+    flat = [np.asarray(x) for x in jax.tree.leaves(val) if not isinstance(x, str)]
+    assert flat, f"{name}: compute returned no array leaves"
+
+    # determinism after reset with identical data (KID samples subsets with a
+    # fresh RNG per compute — random by design, like the reference)
+    if name == "KernelInceptionDistance":
+        return
+    metric.reset()
+    metric.update(*args1, **kw1)
+    metric.update(*args2, **kw2)
+    val2 = metric.compute()
+    for a, b in zip(flat, [np.asarray(x) for x in jax.tree.leaves(val2) if not isinstance(x, str)]):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7, equal_nan=True)
+
+    # pickle after update must carry state (compute after round-trip matches)
+    blob = pickle.dumps(metric)
+    revived = pickle.loads(blob)
+    val3 = revived.compute()
+    for a, b in zip(flat, [np.asarray(x) for x in jax.tree.leaves(val3) if not isinstance(x, str)]):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7, equal_nan=True)
+
+
+_SYNCABLE = [
+    n for n in _FULL
+    if not n.startswith("Retrieval")
+    and n not in (
+        # unreduced (dist_reduce_fx=None) or list-states with host-side compute:
+        # cross-process behavior covered by their own sharded/two-process tests
+        "MeanAveragePrecision", "IntersectionOverUnion", "GeneralizedIntersectionOverUnion",
+        "DistanceIntersectionOverUnion", "CompleteIntersectionOverUnion",
+        "PanopticQuality", "ModifiedPanopticQuality", "SQuAD", "ROUGEScore",
+        "KernelInceptionDistance", "InceptionScore",
+    )
+]
+
+
+@pytest.mark.parametrize("name", _SYNCABLE, ids=_SYNCABLE)
+def test_two_rank_fake_gather_parity(name):
+    """DDP contract: two ranks' fake-gathered compute == one rank on all data."""
+    import sys, os
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+    from helpers.testers import tworank_sync_compute
+
+    kwargs, gen, upd_kwargs = _case_for(name)
+    cls = getattr(metrics_tpu, name)
+    args1, args2 = gen(), gen()
+
+    kw1, kw2 = (upd_kwargs if isinstance(upd_kwargs, tuple) else (upd_kwargs, upd_kwargs))
+    m0, m1 = cls(**kwargs), cls(**kwargs)
+    m0.update(*tuple(jnp.asarray(a) if isinstance(a, np.ndarray) else a for a in args1), **kw1)
+    m1.update(*tuple(jnp.asarray(a) if isinstance(a, np.ndarray) else a for a in args2), **kw2)
+    synced = tworank_sync_compute(m0, m1)
+
+    single = cls(**kwargs)
+    single.update(*tuple(jnp.asarray(a) if isinstance(a, np.ndarray) else a for a in args1), **kw1)
+    single.update(*tuple(jnp.asarray(a) if isinstance(a, np.ndarray) else a for a in args2), **kw2)
+    want = single.compute()
+
+    got_l = [np.asarray(x) for x in jax.tree.leaves(synced) if not isinstance(x, str)]
+    want_l = [np.asarray(x) for x in jax.tree.leaves(want) if not isinstance(x, str)]
+    for a, b in zip(got_l, want_l):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6, equal_nan=True)
+
+
+_BF16 = [
+    n for n in _FULL
+    if all(isinstance(a, np.ndarray) and np.issubdtype(np.asarray(a).dtype, np.floating) for a in _case_for(n)[1]())
+]
+
+
+@pytest.mark.parametrize("name", _BF16, ids=_BF16)
+def test_bf16_inputs_finite(name):
+    """bf16 inputs must flow through update/compute and produce finite values."""
+    kwargs, gen, upd_kwargs = _case_for(name)
+    metric = getattr(metrics_tpu, name)(**kwargs)
+    kw1 = upd_kwargs[0] if isinstance(upd_kwargs, tuple) else upd_kwargs
+    args = tuple(jnp.asarray(a, jnp.bfloat16) for a in gen())
+    metric.update(*args, **kw1)
+    for leaf in jax.tree.leaves(metric.compute()):
+        arr = np.asarray(leaf, np.float32)
+        # NaN is a legitimate degenerate value (0/0 paths); inf means overflow
+        assert not np.isinf(arr).any(), f"{name}: bf16 compute overflowed to inf"

@@ -1,0 +1,136 @@
+"""REAL 2-process eager sync test (VERDICT r3 item 7).
+
+Every other distributed test injects a fake gather (``dist_sync_fn``) the way the
+reference's unit tests do; this one runs the actual transport: two OS processes,
+``jax.distributed.initialize`` on CPU with a local coordinator, and
+``Metric.compute()`` going through ``gather_all_tensors`` ->
+``multihost_utils.process_allgather`` (utils/distributed.py:65-119) — covering
+both the equal-shape path (sum states) and the ragged pad/gather/trim path
+(cat-list states with different per-rank lengths).
+
+Reference analogue: the persistent 2-process gloo pool
+(/root/reference/tests/unittests/conftest.py:25-56).
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_WORKER = r"""
+import json, sys
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+coordinator, rank = sys.argv[1], int(sys.argv[2])
+jax.distributed.initialize(coordinator_address=coordinator, num_processes=2, process_id=rank)
+assert jax.process_count() == 2
+
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.classification import MulticlassAccuracy
+from metrics_tpu.regression import SpearmanCorrCoef
+from metrics_tpu.utils.distributed import gather_all_tensors
+
+rng = np.random.RandomState(42)
+# both ranks draw the same stream; each consumes its own slice
+preds_all = rng.randint(0, 5, (2, 64))
+target_all = rng.randint(0, 5, (2, 64))
+# ragged per-rank lengths for the cat-state metric: 13 vs 29 rows
+sp_preds_all = [rng.rand(13).astype(np.float32), rng.rand(29).astype(np.float32)]
+sp_target_all = [rng.rand(13).astype(np.float32), rng.rand(29).astype(np.float32)]
+
+out = {}
+
+# raw transport: equal shapes
+mine = jnp.asarray(preds_all[rank])
+gathered = gather_all_tensors(mine)
+out["transport_equal"] = [np.asarray(g).tolist() for g in gathered]
+
+# raw transport: ragged shapes (pad/gather/trim)
+gathered_r = gather_all_tensors(jnp.asarray(sp_preds_all[rank]))
+out["transport_ragged_shapes"] = [list(np.asarray(g).shape) for g in gathered_r]
+out["transport_ragged_ok"] = all(
+    np.allclose(np.asarray(g), sp_preds_all[i]) for i, g in enumerate(gathered_r)
+)
+
+# metric sync: sum states
+acc = MulticlassAccuracy(num_classes=5, average="micro")
+acc.update(jnp.asarray(preds_all[rank]), jnp.asarray(target_all[rank]))
+out["accuracy"] = float(acc.compute())
+
+# metric sync: ragged cat states
+sp = SpearmanCorrCoef()
+sp.update(jnp.asarray(sp_preds_all[rank]), jnp.asarray(sp_target_all[rank]))
+out["spearman"] = float(sp.compute())
+
+# sync is reversible: compute's sync_context must restore the rank-LOCAL raw
+# state afterwards (unsync), so accumulation can continue per-rank
+acc2 = MulticlassAccuracy(num_classes=5, average="micro")
+acc2.update(jnp.asarray(preds_all[rank]), jnp.asarray(target_all[rank]))
+global_val = float(acc2.compute())
+out["local_tp_after_unsync"] = float(jnp.sum(jnp.asarray(acc2.tp)))
+out["global_val"] = global_val
+
+print("RESULT" + json.dumps(out))
+"""
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_eager_sync(tmp_path):
+    worker = tmp_path / "worker.py"
+    worker.write_text(_WORKER)
+    coordinator = f"localhost:{_free_port()}"
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # no forced 8-device host platform in the workers
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), coordinator, str(rank)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env, text=True,
+        )
+        for rank in range(2)
+    ]
+    outs = []
+    for p in procs:
+        stdout, stderr = p.communicate(timeout=280)
+        assert p.returncode == 0, f"worker failed:\n{stdout}\n{stderr}"
+        line = [ln for ln in stdout.splitlines() if ln.startswith("RESULT")][-1]
+        outs.append(json.loads(line[len("RESULT"):]))
+
+    # single-process oracle on the concatenated data
+    rng = np.random.RandomState(42)
+    preds_all = rng.randint(0, 5, (2, 64))
+    target_all = rng.randint(0, 5, (2, 64))
+    sp_preds_all = [rng.rand(13).astype(np.float32), rng.rand(29).astype(np.float32)]
+    sp_target_all = [rng.rand(13).astype(np.float32), rng.rand(29).astype(np.float32)]
+
+    want_acc = (preds_all == target_all).mean()
+    from scipy.stats import spearmanr
+
+    want_sp = spearmanr(np.concatenate(sp_preds_all), np.concatenate(sp_target_all)).correlation
+
+    for rank, out in enumerate(outs):
+        # transport returned every rank's tensor, indexed by rank
+        np.testing.assert_array_equal(np.asarray(out["transport_equal"][0]), preds_all[0])
+        np.testing.assert_array_equal(np.asarray(out["transport_equal"][1]), preds_all[1])
+        assert out["transport_ragged_shapes"] == [[13], [29]]
+        assert out["transport_ragged_ok"], "ragged pad/gather/trim returned wrong values"
+        assert abs(out["accuracy"] - want_acc) < 1e-6, (rank, out["accuracy"], want_acc)
+        assert abs(out["spearman"] - want_sp) < 1e-5, (rank, out["spearman"], want_sp)
+        assert out["global_val"] == outs[0]["global_val"]  # both ranks agree
+
+    # unsync restored rank-local state: tp is the rank's own correct-count again
+    for rank, out in enumerate(outs):
+        local_tp = int((preds_all[rank] == target_all[rank]).sum())
+        assert out["local_tp_after_unsync"] == local_tp, (rank, out["local_tp_after_unsync"], local_tp)
