@@ -60,8 +60,15 @@ def _input_validator(preds: Sequence[Dict], targets: Sequence[Dict], iou_type: s
 
 
 def _fix_empty_tensors(boxes) -> jnp.ndarray:
-    """Give empty box arrays the canonical ``(0, 4)`` shape (reference :74-77)."""
-    boxes = jnp.asarray(boxes, jnp.float32)
+    """Give empty box arrays the canonical ``(0, 4)`` shape (reference :74-77).
+
+    Namespace-preserving: numpy stays numpy (host inputs never touch the device
+    in mAP's update), jax stays jax.
+    """
+    if isinstance(boxes, np.ndarray):
+        boxes = boxes.astype(np.float32)
+    else:
+        boxes = jnp.asarray(boxes, jnp.float32)
     if boxes.size == 0:
         return boxes.reshape(0, 4)
     return boxes

@@ -16,18 +16,22 @@ import jax.numpy as jnp
 _EPS = 1e-7  # same stabilizer torchvision uses for the d/c-iou denominators
 
 
-def box_convert(boxes: Array, in_fmt: str, out_fmt: str = "xyxy") -> Array:
-    """Convert ``(N, 4)`` boxes between ``xyxy``/``xywh``/``cxcywh`` formats."""
+def box_convert(boxes: Array, in_fmt: str, out_fmt: str = "xyxy", xp=jnp) -> Array:
+    """Convert ``(N, 4)`` boxes between ``xyxy``/``xywh``/``cxcywh`` formats.
+
+    ``xp`` selects the array namespace (``jnp`` default; pass ``numpy`` to keep
+    host inputs on host — mAP's update does, to avoid a device round trip).
+    """
     if in_fmt == out_fmt:
         return boxes
     if out_fmt != "xyxy":
         raise ValueError(f"Only conversion to 'xyxy' is supported, got {out_fmt}")
-    boxes = jnp.asarray(boxes, jnp.float32)
+    boxes = xp.asarray(boxes, xp.float32)
     a, b, c, d = boxes[..., 0], boxes[..., 1], boxes[..., 2], boxes[..., 3]
     if in_fmt == "xywh":
-        return jnp.stack([a, b, a + c, b + d], axis=-1)
+        return xp.stack([a, b, a + c, b + d], axis=-1)
     if in_fmt == "cxcywh":
-        return jnp.stack([a - c / 2, b - d / 2, a + c / 2, b + d / 2], axis=-1)
+        return xp.stack([a - c / 2, b - d / 2, a + c / 2, b + d / 2], axis=-1)
     raise ValueError(f"Unsupported box format {in_fmt!r}; expected one of ('xyxy', 'xywh', 'cxcywh')")
 
 

@@ -8,10 +8,17 @@ chunks, with bf16 one-hots (0/1 are exact in bf16) and f32 dot accumulation cast
 int32 per chunk (chunk <= 2^19 keeps every per-chunk count f32-exact).
 
 Measured at N=2^26 on the TPU chip: scatter 0.15 Gpreds/s at C=64; matmul
-1.9 Gpreds/s (13x, bit-identical). The matmul costs 2*C^2 MAC/element, so past
+1.9-2.3 Gpreds/s (13x, bit-identical). The matmul costs 2*C^2 MAC/element, so past
 C~700 it loses to the C-independent scatter: the tier is gated to
 COMPARE < C^2 and C <= 512. The ``valid`` mask multiplies the target one-hot
 rows, so masked elements contribute nothing (same semantics as weight-0 bincount).
+
+Alternatives measured and rejected (round 4, same harness): int8 one-hot dot
+2.08 (XLA does not hit the 2x int8 MXU rate for this shape); joint-index
+histogram ``one_hot(t*C+p, C^2)`` summed by VPU reduce 0.34 or by ones-matmul
+0.22 (the (chunk, C^2) one-hot is too wide); K-blocked batched dot (K=128
+native systolic depth) 2.02. The extreme-K skinny outer-product dot at
+~19 TFLOP/s (~10% MXU) is the bound for this op shape.
 """
 from typing import Optional
 

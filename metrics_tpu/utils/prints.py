@@ -44,7 +44,9 @@ def _deprecated_warn(name: str, replacement: str) -> None:
 
 
 def _future_warning(message: str) -> None:
-    warnings.warn(message, FutureWarning, stacklevel=3)
+    # stacklevel 4: warn -> _future_warning -> _deprecated_root_import_* ->
+    # shim __init__/wrapped are all library frames; 4 lands on the user call
+    warnings.warn(message, FutureWarning, stacklevel=4)
 
 
 def _deprecated_root_import_class(name: str, domain: str) -> None:

@@ -1,0 +1,30 @@
+
+
+def test_host_inputs_stay_host_and_match_device_inputs():
+    """numpy inputs must not round-trip through the device (mean_ap update keeps
+    host arrays host; the matching pipeline fetches to host anyway) and must
+    produce identical results to jax-array inputs."""
+    import numpy as np
+    import jax.numpy as jnp
+    from metrics_tpu.detection import MeanAveragePrecision
+
+    rng = np.random.RandomState(3)
+    gt = rng.rand(4, 4).astype(np.float32) * 50
+    gt[:, 2:] += gt[:, :2] + 5
+    det = gt + rng.randn(4, 4).astype(np.float32)
+    scores = rng.rand(4).astype(np.float32)
+    labels = rng.randint(0, 2, 4).astype(np.int32)
+
+    m_np = MeanAveragePrecision()
+    m_np.update([{"boxes": det, "scores": scores, "labels": labels}],
+                [{"boxes": gt, "labels": labels}])
+    assert all(isinstance(b, np.ndarray) for b in m_np.detections)
+    assert all(isinstance(b, np.ndarray) for b in m_np.groundtruths)
+
+    m_dev = MeanAveragePrecision()
+    m_dev.update([{"boxes": jnp.asarray(det), "scores": jnp.asarray(scores), "labels": jnp.asarray(labels)}],
+                 [{"boxes": jnp.asarray(gt), "labels": jnp.asarray(labels)}])
+    a, b = m_np.compute(), m_dev.compute()
+    assert float(a["map"]) > 0.2  # overlapping boxes: a real score
+    for k in ("map", "map_50", "map_75", "mar_100"):
+        assert float(a[k]) == float(b[k]), (k, float(a[k]), float(b[k]))
