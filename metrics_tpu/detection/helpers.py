@@ -66,7 +66,7 @@ def _fix_empty_tensors(boxes) -> jnp.ndarray:
     in mAP's update), jax stays jax.
     """
     if isinstance(boxes, np.ndarray):
-        boxes = boxes.astype(np.float32)
+        boxes = np.asarray(boxes, np.float32)  # no-op for float32 input
     else:
         boxes = jnp.asarray(boxes, jnp.float32)
     if boxes.size == 0:

@@ -178,9 +178,9 @@ class MeanAveragePrecision(Metric):
                 xp = jnp if isinstance(item["masks"], jax.Array) else np
                 return xp.zeros((0, 1, 1), bool)
             return masks.astype(bool)
-        xp = jnp if isinstance(item["boxes"], jax.Array) else np
         boxes = _fix_empty_tensors(self._asarray_like(item["boxes"]))
         if boxes.size > 0:
+            xp = np if isinstance(boxes, np.ndarray) else jnp
             boxes = box_convert(boxes, in_fmt=self.box_format, out_fmt="xyxy", xp=xp)
         return boxes
 

@@ -18,7 +18,7 @@ from metrics_tpu.functional.classification.confusion_matrix import (
     _multiclass_confusion_matrix_format,
     _multiclass_confusion_matrix_tensor_validation,
 )
-from metrics_tpu.functional.classification.stat_scores import _is_floating
+from metrics_tpu.functional.classification.stat_scores import _is_floating, _softmax_if_logits
 from metrics_tpu.utils.enums import ClassificationTaskNoMultilabel
 
 
@@ -158,9 +158,19 @@ def _multiclass_calibration_error_tensor_validation(
 
 
 def _multiclass_calibration_error_update(preds: Array, target: Array) -> Tuple[Array, Array]:
-    """Top-1 confidence + correctness (reference: calibration_error.py:235-244)."""
-    if not bool(jnp.all((preds >= 0) & (preds <= 1))):
-        preds = jax.nn.softmax(preds, axis=1)
+    """Top-1 confidence + correctness (reference: calibration_error.py:235-244).
+
+    Softmax-iff-logits is branchless (both paths traced, jnp.where on an
+    all-reduction) so the update works under jit/shard_map — a host bool on
+    traced data raised TracerBoolConversionError inside evaluate_sharded.
+
+    Decision granularity: per update call eagerly, per SHARD under shard_map —
+    like every probability/logit auto-detect in this package
+    (_sigmoid_if_logits and friends). Identical results under the supported
+    contract that one update's preds are homogeneous (all probabilities or
+    all logits); a batch mixing the two is undefined either way.
+    """
+    preds = _softmax_if_logits(preds)
     confidences = preds.max(axis=1)
     predictions = preds.argmax(axis=1)
     accuracies = (predictions == target).astype(jnp.float32)

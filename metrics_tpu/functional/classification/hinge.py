@@ -15,7 +15,7 @@ from metrics_tpu.functional.classification.confusion_matrix import (
     _multiclass_confusion_matrix_format,
     _multiclass_confusion_matrix_tensor_validation,
 )
-from metrics_tpu.functional.classification.stat_scores import _is_floating
+from metrics_tpu.functional.classification.stat_scores import _is_floating, _softmax_if_logits
 from metrics_tpu.utils.data import to_onehot
 from metrics_tpu.utils.enums import ClassificationTaskNoMultilabel
 
@@ -111,9 +111,12 @@ def _multiclass_hinge_loss_update(
     squared: bool,
     multiclass_mode: str = "crammer-singer",
 ) -> Tuple[Array, Array]:
-    """Margin sums (reference: hinge.py:153-177). Targets < 0 get 0 weight."""
-    if not bool(jnp.all((preds >= 0) & (preds <= 1))):
-        preds = jax.nn.softmax(preds, axis=1)
+    """Margin sums (reference: hinge.py:153-177). Targets < 0 get 0 weight.
+
+    Softmax-iff-logits is branchless (see calibration_error, including the
+    per-shard decision-granularity note) so the update stays jit/shard_map-safe.
+    """
+    preds = _softmax_if_logits(preds)
 
     valid = target >= 0
     target_idx = jnp.maximum(target, 0)

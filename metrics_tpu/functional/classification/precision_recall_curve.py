@@ -23,7 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import Array
 
-from metrics_tpu.functional.classification.stat_scores import _is_floating
+from metrics_tpu.functional.classification.stat_scores import _is_floating, _sigmoid_if_logits, _softmax_if_logits
 from metrics_tpu.utils.checks import _is_concrete
 from metrics_tpu.utils.compute import _safe_divide
 from metrics_tpu.utils.enums import ClassificationTask
@@ -148,8 +148,7 @@ def _binary_precision_recall_curve_format(
     if ignore_index is not None:
         target = jnp.where(target == ignore_index, -1, target)
 
-    is_prob = jnp.all((preds >= 0) & (preds <= 1))
-    preds = jnp.where(is_prob, preds, jax.nn.sigmoid(preds))
+    preds = _sigmoid_if_logits(preds)
 
     thresholds = _adjust_threshold_arg(thresholds)
     return preds, target, thresholds
@@ -299,8 +298,7 @@ def _multiclass_precision_recall_curve_format(
     if ignore_index is not None:
         target = jnp.where(target == ignore_index, -1, target)
 
-    is_prob = jnp.all((preds >= 0) & (preds <= 1))
-    preds = jnp.where(is_prob, preds, jax.nn.softmax(preds, axis=1))
+    preds = _softmax_if_logits(preds)
 
     thresholds = _adjust_threshold_arg(thresholds)
     return preds, target, thresholds
@@ -415,8 +413,7 @@ def _multilabel_precision_recall_curve_format(
     """(N, C, ...) -> (N', L); ignored positions -> target=-1 (masked in update)."""
     preds = jnp.moveaxis(jnp.asarray(preds), 0, 1).reshape(num_labels, -1).T
     target = jnp.moveaxis(jnp.asarray(target), 0, 1).reshape(num_labels, -1).T
-    is_prob = jnp.all((preds >= 0) & (preds <= 1))
-    preds = jnp.where(is_prob, preds, jax.nn.sigmoid(preds))
+    preds = _sigmoid_if_logits(preds)
 
     thresholds = _adjust_threshold_arg(thresholds)
     if ignore_index is not None:

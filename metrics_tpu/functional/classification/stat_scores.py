@@ -42,6 +42,18 @@ def _sigmoid_if_logits(preds: Array) -> Array:
     return jnp.where(is_prob, preds, jax.nn.sigmoid(preds))
 
 
+def _softmax_if_logits(preds: Array, axis: int = 1) -> Array:
+    """Softmax iff any value is outside [0, 1] — the multiclass analogue.
+
+    Branchless, so jit/shard_map-safe. Decision granularity is per call
+    (eagerly) / per shard (under shard_map); results are identical under the
+    supported contract that one update's preds are homogeneous (all
+    probabilities or all logits).
+    """
+    is_prob = jnp.all((preds >= 0) & (preds <= 1))
+    return jnp.where(is_prob, preds, jax.nn.softmax(preds, axis=axis))
+
+
 # ----------------------------------------------------------------------- binary
 
 

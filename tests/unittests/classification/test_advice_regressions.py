@@ -129,3 +129,27 @@ def test_fixed_point_metrics_raise_clearly_under_jit():
     state = m.local_update(m.init_state(), jnp.asarray([0.2, 0.8, 0.6]), jnp.asarray([0, 1, 1]))
     with pytest.raises(NotImplementedError, match="eager-only"):
         jax.jit(m.compute_from)(state)
+
+
+@pytest.mark.parametrize("as_logits", [False, True])
+def test_calibration_and_hinge_updates_are_jit_safe(as_logits):
+    """Softmax-iff-logits must be branchless: a host bool on traced preds raised
+    TracerBoolConversionError under jit/shard_map (found via evaluate_sharded)."""
+    import numpy as np
+
+    from metrics_tpu.classification import MulticlassCalibrationError, MulticlassHingeLoss
+
+    rng = np.random.default_rng(0)
+    p = rng.normal(size=(32, 4)).astype(np.float32)
+    if not as_logits:
+        p = np.exp(p) / np.exp(p).sum(-1, keepdims=True)
+    t = rng.integers(0, 4, 32).astype(np.int32)
+
+    for cls in (MulticlassCalibrationError, MulticlassHingeLoss):
+        m = cls(num_classes=4, validate_args=False)
+        state = jax.jit(m.local_update)(m.init_state(), jnp.asarray(p), jnp.asarray(t))
+        jit_val = float(m.compute_from(jax.tree.map(jnp.asarray, jax.device_get(state))))
+        m2 = cls(num_classes=4, validate_args=False)
+        m2.update(jnp.asarray(p), jnp.asarray(t))
+        eager_val = float(m2.compute())
+        assert abs(jit_val - eager_val) < 1e-6, (cls.__name__, jit_val, eager_val)

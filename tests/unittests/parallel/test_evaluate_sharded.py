@@ -1,0 +1,35 @@
+"""evaluate_sharded composition cases (beyond the per-domain sharded tiers)."""
+def test_collection_with_cat_state_member_sharded():
+    """A MetricCollection containing a cat-list-state metric must evaluate in ONE
+    shard_map pass: evaluate_sharded converts nested list states to CatBuffers
+    per member (found by examples/eval_harness.py — the scan carry mismatched)."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from metrics_tpu import MetricCollection
+    from metrics_tpu.classification import MulticlassAccuracy, MulticlassCalibrationError
+    from metrics_tpu.parallel import evaluate_sharded, make_data_mesh
+
+    rng = np.random.default_rng(0)
+    logits = rng.normal(size=(4, 64, 4)).astype(np.float32)
+    labels = rng.integers(0, 4, (4, 64)).astype(np.int32)
+    coll = MetricCollection(
+        {
+            "acc": MulticlassAccuracy(num_classes=4, validate_args=False),
+            "ece": MulticlassCalibrationError(num_classes=4, n_bins=9, validate_args=False),
+        }
+    )
+    batches = [(jnp.asarray(p), jnp.asarray(t)) for p, t in zip(logits, labels)]
+    out = evaluate_sharded(coll, batches, mesh=make_data_mesh(8))
+
+    eager = MetricCollection(
+        {
+            "acc": MulticlassAccuracy(num_classes=4, validate_args=False),
+            "ece": MulticlassCalibrationError(num_classes=4, n_bins=9, validate_args=False),
+        }
+    )
+    for p, t in batches:
+        eager.update(p, t)
+    want = eager.compute()
+    for k in want:
+        assert abs(float(out[k]) - float(want[k])) < 1e-6, (k, float(out[k]), float(want[k]))
