@@ -66,7 +66,9 @@ def _fix_empty_tensors(boxes) -> jnp.ndarray:
     in mAP's update), jax stays jax.
     """
     if isinstance(boxes, np.ndarray):
-        boxes = np.asarray(boxes, np.float32)  # no-op for float32 input
+        # copy even when already float32: the stored state must not alias the
+        # caller's buffer (in-place reuse between updates would corrupt it)
+        boxes = np.array(boxes, np.float32)
     else:
         boxes = jnp.asarray(boxes, jnp.float32)
     if boxes.size == 0:

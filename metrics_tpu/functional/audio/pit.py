@@ -19,15 +19,17 @@ from metrics_tpu.utils.imports import _SCIPY_AVAILABLE
 
 _EXHAUSTIVE_SPK_LIMIT = 8
 
-# permutation tables keyed by speaker count
+# permutation tables keyed by speaker count — cached as HOST numpy: a jnp array
+# built during a jit trace is a tracer, and caching it leaks the tracer into
+# later traces (UnexpectedTracerError; caught by the jit-safety contract sweep)
 _ps_cache: dict = {}
 
 
 def _perm_table(spk_num: int) -> jnp.ndarray:
-    """All permutations as an ``(spk!, spk)`` int array (cached)."""
+    """All permutations as an ``(spk!, spk)`` int array (host-cached)."""
     if spk_num not in _ps_cache:
-        _ps_cache[spk_num] = jnp.asarray(list(permutations(range(spk_num))), jnp.int32)
-    return _ps_cache[spk_num]
+        _ps_cache[spk_num] = np.asarray(list(permutations(range(spk_num))), np.int32)
+    return jnp.asarray(_ps_cache[spk_num])
 
 
 def _find_best_perm_by_exhaustive_method(metric_mtx: Array, larger_is_better: bool) -> Tuple[Array, Array]:

@@ -190,6 +190,14 @@ def _input_format_classification(
     ignore_index: Optional[int] = None,
 ) -> Tuple[Array, Array, DataType]:
     """Convert preds/target into common one-hot format (reference: checks.py:313-452)."""
+    import jax.core
+
+    if any(isinstance(x, jax.core.Tracer) for x in (preds, target)):
+        raise NotImplementedError(
+            "legacy-input metrics (Dice / old-style HingeLoss) classify their input"
+            " mode from data VALUES (reference utilities/checks.py:206-452) and are"
+            " eager-only; call update/compute outside jit"
+        )
     preds, target = _input_squeeze(preds, target)
     if preds.dtype == jnp.float16:
         preds = preds.astype(jnp.float32)

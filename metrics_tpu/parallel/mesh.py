@@ -105,18 +105,18 @@ def evaluate_sharded(
         # shard width is the batch axis only — a multi-axis mesh replicates over
         # the other axes, so capacity must divide by mesh.shape[axis_name]
         state0 = _lists_to_buffers(metric, state0, batches, n_devices=mesh.shape[axis_name])
-    elif any(
-        isinstance(sub, dict) and any(isinstance(v, list) for v in sub.values())
-        for sub in state0.values()
-    ):
-        # MetricCollection: states are nested one level ({name: {state: ...}});
-        # convert each member's list states with its own probe update (batches
-        # are positional tuples here; no kwargs filtering happens on this path)
-        for name, member in metric.items(keep_base=True, copy_state=False):
-            if any(isinstance(v, list) for v in state0[name].values()):
-                state0[name] = _lists_to_buffers(
-                    member, state0[name], batches, n_devices=mesh.shape[axis_name]
-                )
+    else:
+        from metrics_tpu.core.collections import MetricCollection
+
+        if isinstance(metric, MetricCollection):
+            # states are nested one level ({name: {state: ...}}); convert each
+            # member's list states with its own probe update (batches are
+            # positional tuples here; no kwargs filtering happens on this path)
+            for name, member in metric.items(keep_base=True, copy_state=False):
+                if any(isinstance(v, list) for v in state0[name].values()):
+                    state0[name] = _lists_to_buffers(
+                        member, state0[name], batches, n_devices=mesh.shape[axis_name]
+                    )
 
     stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *batches)
 
