@@ -8,6 +8,7 @@ host-side (NumPy checks); the produced one-hot stat-score reductions are jnp ops
 """
 from typing import List, Optional, Tuple, Union
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import Array
@@ -190,8 +191,6 @@ def _input_format_classification(
     ignore_index: Optional[int] = None,
 ) -> Tuple[Array, Array, DataType]:
     """Convert preds/target into common one-hot format (reference: checks.py:313-452)."""
-    import jax.core
-
     if any(isinstance(x, jax.core.Tracer) for x in (preds, target)):
         raise NotImplementedError(
             "legacy-input metrics (Dice / old-style HingeLoss) classify their input"

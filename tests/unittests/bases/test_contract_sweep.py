@@ -438,6 +438,10 @@ _HOST_SIDE = frozenset(
 
 _JIT_SAFE = [n for n in _FULL if n not in _HOST_SIDE]
 
+# metrics whose local_update raises a DOCUMENTED NotImplementedError under
+# tracing; anything else raising it is a regression the sweep must catch
+_EAGER_ONLY = frozenset({"Dice", "RecallAtFixedPrecision", "PrecisionAtFixedRecall", "SpecificityAtSensitivity"})
+
 
 @pytest.mark.parametrize("name", _JIT_SAFE, ids=_JIT_SAFE)
 def test_local_update_is_jit_safe(name):
@@ -451,10 +455,16 @@ def test_local_update_is_jit_safe(name):
     argsets = [tuple(jnp.asarray(a) if isinstance(a, np.ndarray) else a for a in gen()) for _ in kws]
     try:
         state = metric.init_state()
+        fns = {}
         for args, kw in zip(argsets, kws):
-            state = jax.jit(partial_update(metric, kw))(state, *args)
-    except NotImplementedError:
-        return  # documented eager-only metric (fixed-point operating points, legacy-input Dice)
+            key = tuple(sorted(kw.items()))
+            if key not in fns:
+                fns[key] = jax.jit(partial_update(metric, kw))
+            state = fns[key](state, *args)
+    except NotImplementedError as e:
+        if name in _EAGER_ONLY:
+            pytest.skip(f"documented eager-only: {e}")
+        raise  # a previously jit-safe metric regressing to eager-only must FAIL
     if name == "KernelInceptionDistance":
         return  # traces fine; compute subsamples with a fresh RNG (random by design)
     # value from the jitted state must equal the eager update's value
