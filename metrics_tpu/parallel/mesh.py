@@ -96,6 +96,11 @@ def evaluate_sharded(
       through a ``lax.scan`` over batches (no host round-trips between batches),
     - ``sync_state`` reduces over the mesh axis with psum/all_gather,
     - ``compute_from`` evaluates the final value from the replicated synced state.
+
+    ``metric`` may be a single metric or a whole :class:`MetricCollection` — the
+    collection evaluates in the same ONE shard_map program, with any member's
+    cat-list states auto-converted to capacity buffers (see
+    ``examples/eval_harness.py`` for the full recipe).
     """
     from jax import shard_map
 
