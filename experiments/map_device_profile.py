@@ -1,0 +1,41 @@
+"""End-to-end timing of the fully-device consolidated mAP path on the real TPU."""
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import bench
+from experiments.map_profile2 import consolidate
+from metrics_tpu.detection import MeanAveragePrecision
+
+
+def main(n_images=1000):
+    datasets = [bench._coco_like_dataset(n_images, seed) for seed in range(4)]
+    device_data = [consolidate(p, t) for p, t in datasets]
+    jax.device_get(device_data[-1][0]["boxes"])
+
+    metric = MeanAveragePrecision()
+    t0 = time.perf_counter()
+    metric.update(*device_data[0])
+    out = metric.compute()
+    print(f"warm-up (compile): {time.perf_counter()-t0:6.1f} s, map={float(out['map']):.4f}")
+
+    for preds, target in device_data[1:]:
+        metric.reset()
+        t0 = time.perf_counter()
+        metric.update(preds, target)
+        out = metric.compute()
+        mv = float(jax.device_get(out["map"]))
+        dt = time.perf_counter() - t0
+        print(f"cycle {dt*1e3:7.1f} ms -> {n_images/dt:7.1f} img/s   map={mv:.4f}")
+
+    from metrics_tpu.functional.detection import _mean_ap_device as D
+    print("consolidated_tables compiles:", D.consolidated_tables._cache_size())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,72 @@
+"""Shape-stability check: pow2-pad the consolidated staging, print bucket dims,
+and time repeated evaluation of distinct datasets with IDENTICAL shapes."""
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import bench
+from metrics_tpu.detection import MeanAveragePrecision
+from metrics_tpu.functional.detection import _mean_ap_device as D
+
+
+def _pow2(n):
+    return 1 << max(0, (n - 1).bit_length())
+
+
+def consolidate_pow2(preds, target):
+    B = len(preds)
+    md = _pow2(max(max(p[0].shape[0] for p in preds), 1))
+    mg = _pow2(max(max(t[0].shape[0] for t in target), 1))
+    pb = np.zeros((B, md, 4), np.float32)
+    ps = np.full((B, md), -np.inf, np.float32)
+    pl = np.full((B, md), -1, np.int32)
+    tb = np.zeros((B, mg, 4), np.float32)
+    tl = np.full((B, mg), -1, np.int32)
+    for i, ((db, dsc, dl), (gb, gl)) in enumerate(zip(preds, target)):
+        n = db.shape[0]
+        pb[i, :n], ps[i, :n], pl[i, :n] = db, dsc, dl
+        n = gb.shape[0]
+        tb[i, :n], tl[i, :n] = gb, gl
+    return ({"boxes": jnp.asarray(pb), "scores": jnp.asarray(ps), "labels": jnp.asarray(pl)},
+            {"boxes": jnp.asarray(tb), "labels": jnp.asarray(tl)})
+
+
+def main(n_images=1000):
+    datasets = [bench._coco_like_dataset(n_images, seed) for seed in range(4)]
+    for p, t in datasets:
+        dl = np.concatenate([x[2] for x in p])
+        counts = [np.bincount(x[2], minlength=5).max() if len(x[2]) else 0 for x in p]
+        gcounts = [np.bincount(x[1], minlength=5).max() if len(x[1]) else 0 for x in t]
+        print("max per-(img,cls) det count:", max(counts), " gt:", max(gcounts),
+              " n big det>16:", sum(1 for c in counts if c > 16),
+              " n big gt>16:", sum(1 for c in gcounts if c > 16))
+    device_data = [consolidate_pow2(p, t) for p, t in datasets]
+    jax.device_get(device_data[-1][0]["boxes"])
+    for p, t in device_data:
+        print("shapes:", p["boxes"].shape, t["boxes"].shape)
+
+    metric = MeanAveragePrecision()
+    t0 = time.perf_counter()
+    metric.update(*device_data[0])
+    out = metric.compute()
+    print(f"warm-up (compile): {time.perf_counter()-t0:6.1f} s, map={float(out['map']):.4f}")
+
+    for preds, target in device_data[1:] + device_data[1:2]:
+        metric.reset()
+        t0 = time.perf_counter()
+        metric.update(preds, target)
+        out = metric.compute()
+        mv = float(jax.device_get(out["map"]))
+        dt = time.perf_counter() - t0
+        print(f"cycle {dt*1e3:7.1f} ms -> {n_images/dt:7.1f} img/s   map={mv:.4f}")
+
+    print("consolidated_tables compiles:", D.consolidated_tables._cache_size())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,55 @@
+"""Scaling probe for the mAP matching kernel: how does runtime scale with the
+scan length (pad_d), group count, and gt width? Decides whether group-size
+bucketing (short scans for the common case) is worth the routing complexity."""
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from metrics_tpu.functional.detection._mean_ap_kernel import _match_groups
+
+A = np.asarray([[0.0, 1e10], [0, 1024], [1024, 9216], [9216, 1e10]], np.float32)
+T = np.linspace(0.5, 0.95, 10).astype(np.float32)
+
+
+def timed_match(pad_n, pad_d, pad_g, reps=3):
+    rng = np.random.RandomState(0)
+    db = rng.rand(pad_n, pad_d, 4).astype(np.float32) * 100
+    db[..., 2:] += db[..., :2]
+    gb = rng.rand(pad_n, pad_g, 4).astype(np.float32) * 100
+    gb[..., 2:] += gb[..., :2]
+    dv = rng.rand(pad_n, pad_d) < 0.5
+    gv = rng.rand(pad_n, pad_g) < 0.5
+    args = [jnp.asarray(x) for x in (db, dv, gb, gv, T, A)]
+    jax.device_get(_match_groups(*args)[2][0, 0])  # compile + settle
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = _match_groups(*args)
+        jax.device_get(out[2][0, 0])
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+def main():
+    jax.device_get(jnp.zeros(8) + 1)
+    for pad_n, pad_d, pad_g in (
+        (8192, 128, 64),
+        (8192, 64, 64),
+        (8192, 32, 64),
+        (8192, 16, 64),
+        (8192, 16, 16),
+        (8192, 128, 16),
+        (2048, 128, 64),
+        (512, 128, 64),
+    ):
+        dt = timed_match(pad_n, pad_d, pad_g)
+        print(f"N={pad_n:5d} D={pad_d:4d} G={pad_g:3d}: {dt*1e3:8.1f} ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,192 @@
+"""Measure D2H packing strategies for mAP's per-image ragged state lists.
+
+The round-4 bench showed the mAP cycle is transfer-bound: ~3 s of the ~1.8 s/1000-img
+cycle is the batched device_get of ~5000 per-image buffers (~0.6 ms/buffer floor on
+the tunneled backend). Candidate fixes move the packing onto the device so compute
+fetches a handful of large buffers instead:
+
+A. status quo: device_get of all per-image arrays in one pytree call
+B. one eager jnp.concatenate over all arrays per state (compile keyed on the FULL
+   ragged shape tuple -> recompiles every dataset)
+C. chunked concat: eager concat in fixed-size operand chunks, then concat of chunks
+D. exact-shape grouping: group arrays by identical shape, jnp.stack each group
+   (compile keyed on (group_size, shape); pad group count to pow2 with a dummy)
+E. host roundtrip per array (np.asarray) — known-bad floor check at small n
+
+Run on the real TPU (axon tunnel) — the numbers only mean anything there.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def make_dataset(n_images: int, seed: int):
+    rng = np.random.RandomState(seed)
+    arrays = []
+    for _ in range(n_images):
+        n = int(np.clip(rng.poisson(12), 1, 90))
+        arrays.append(jnp.asarray(rng.rand(n, 4).astype(np.float32)))
+    return arrays
+
+
+def sync(x):
+    jax.device_get(x)
+
+
+def timeit(fn, *args):
+    t0 = time.perf_counter()
+    out = fn(*args)
+    dt = time.perf_counter() - t0
+    return out, dt
+
+
+def strat_a(arrays):
+    return jax.device_get(arrays)
+
+
+def strat_b(arrays):
+    big = jnp.concatenate(arrays, axis=0)
+    return jax.device_get(big)
+
+
+def strat_c(arrays, chunk=64):
+    chunks = [jnp.concatenate(arrays[i : i + chunk], axis=0) for i in range(0, len(arrays), chunk)]
+    big = jnp.concatenate(chunks, axis=0) if len(chunks) > 1 else chunks[0]
+    return jax.device_get(big)
+
+
+def strat_d(arrays):
+    groups = {}
+    for i, a in enumerate(arrays):
+        groups.setdefault(a.shape, []).append((i, a))
+    out = {}
+    for shape, items in groups.items():
+        stack = jnp.stack([a for _, a in items])
+        out[shape] = (jax.device_get(stack), [i for i, _ in items])
+    return out
+
+
+def main():
+    n = 1000
+    # warm the backend
+    sync(jnp.zeros(8) + 1)
+
+    for trial_seed in (0, 1, 2):
+        arrays = make_dataset(n, trial_seed)
+        sync(arrays[-1])  # settle H2D queue
+        print(f"--- dataset seed={trial_seed}, {n} ragged arrays ---")
+        _, dt = timeit(strat_a, arrays)
+        print(f"A pytree device_get of {n} buffers:   {dt*1e3:8.1f} ms  ({dt/n*1e3:.3f} ms/buf)")
+        _, dt = timeit(strat_b, arrays)
+        print(f"B single {n}-operand eager concat:    {dt*1e3:8.1f} ms")
+        _, dt = timeit(strat_b, arrays)
+        print(f"B   (second call, compile cached):    {dt*1e3:8.1f} ms")
+        _, dt = timeit(strat_c, arrays)
+        print(f"C chunked concat (64-op chunks):      {dt*1e3:8.1f} ms")
+        _, dt = timeit(strat_c, arrays)
+        print(f"C   (second call):                    {dt*1e3:8.1f} ms")
+        _, dt = timeit(strat_d, arrays)
+        print(f"D exact-shape group + stack:          {dt*1e3:8.1f} ms")
+        _, dt = timeit(strat_d, arrays)
+        print(f"D   (second call):                    {dt*1e3:8.1f} ms")
+
+    small = make_dataset(64, 9)
+    _, dt = timeit(lambda: [np.asarray(a) for a in small])
+    print(f"E per-array np.asarray (64 arrays):   {dt*1e3:8.1f} ms  ({dt/64*1e3:.3f} ms/buf)")
+
+
+if __name__ == "__main__":
+    main()
+
+
+# ---------------------------------------------------------------- experiment 2
+# Compile-stable packing: pad each image to pow2-bucketed rows (jitted per
+# (in_rows, bucket) pair -> cached forever), then a fixed-chunk concat tree over
+# uniform shapes with operand-count padded by a dummy (cache keys independent of
+# the dataset's raggedness). Measures steady-state dispatch + transfer cost.
+
+def _pow2(n: int) -> int:
+    return 1 << max(0, (n - 1).bit_length())
+
+
+def make_image_triples(n_images, seed):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n_images):
+        nd = int(np.clip(rng.poisson(12), 1, 90))
+        out.append((
+            jnp.asarray(rng.rand(nd, 4).astype(np.float32)),
+            jnp.asarray(rng.rand(nd).astype(np.float32)),
+            jnp.asarray(rng.randint(0, 5, nd), jnp.int32),
+        ))
+    return out
+
+
+from functools import partial
+
+
+@partial(jax.jit, static_argnames=("bucket",))
+def pad_triple(b, s, l, bucket):
+    pad = bucket - b.shape[0]
+    return (
+        jnp.pad(b, ((0, pad), (0, 0))),
+        jnp.pad(s, ((0, pad),), constant_values=-np.inf),
+        jnp.pad(l, ((0, pad),), constant_values=-1),
+    )
+
+
+CHUNK_OPS = 64
+
+
+def chunk_tree_concat(stacks):
+    """Concat a list of uniformly-shaped arrays with dataset-independent compile keys."""
+    while len(stacks) > 1:
+        if len(stacks) % CHUNK_OPS:
+            dummy = jnp.zeros_like(stacks[0])
+            stacks = stacks + [dummy] * (CHUNK_OPS - len(stacks) % CHUNK_OPS) if len(stacks) > CHUNK_OPS else stacks + [dummy] * (_pow2(len(stacks)) - len(stacks))
+        step = min(CHUNK_OPS, len(stacks))
+        stacks = [jnp.concatenate(stacks[i:i + step], axis=0) for i in range(0, len(stacks), step)]
+    return stacks[0]
+
+
+def strat_pad_bucket(triples):
+    buckets = {}
+    for b, s, l in triples:
+        bucket = _pow2(b.shape[0])
+        pb, ps, pl = pad_triple(b, s, l, bucket)
+        buckets.setdefault(bucket, []).append((pb, ps, pl))
+    outs = {}
+    for bucket, items in buckets.items():
+        bb = chunk_tree_concat([x[0] for x in items])
+        ss = chunk_tree_concat([x[1] for x in items])
+        ll = chunk_tree_concat([x[2] for x in items])
+        outs[bucket] = jax.device_get((bb, ss, ll))
+    return outs
+
+
+def exp2():
+    n = 1000
+    sync(jnp.zeros(8) + 1)
+    # dispatch-overhead probe: tiny jitted op, queued without sync
+    x = jnp.ones((64, 4))
+    f = jax.jit(lambda a: a + 1)
+    sync(f(x))
+    t0 = time.perf_counter()
+    ys = [f(x) for _ in range(2000)]
+    sync(ys[-1])
+    print(f"2000 tiny jitted dispatches (queued): {(time.perf_counter()-t0)*1e3:8.1f} ms")
+
+    for seed in (0, 1, 2):
+        triples = make_image_triples(n, seed)
+        sync(triples[-1][0])
+        t0 = time.perf_counter()
+        out = strat_pad_bucket(triples)
+        dt = time.perf_counter() - t0
+        nb = sum(3 for _ in out)
+        print(f"seed={seed} pad+bucket+chunktree ({len(out)} buckets, {nb} fetches): {dt*1e3:8.1f} ms")
+
+
+if __name__ == "__main__" and __import__("sys").argv[-1] == "exp2":
+    exp2()

@@ -1,0 +1,66 @@
+"""Break down the mAP bench cycle: update dispatches, state fetch, group build,
+matching kernel, host PR accumulation. Run on the real TPU tunnel."""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import sys, os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import bench
+from metrics_tpu.detection import MeanAveragePrecision
+
+
+def main(n_images=1000):
+    datasets = [bench._coco_like_dataset(n_images, seed) for seed in range(2)]
+
+    def to_jnp(preds, target):
+        ps = [
+            {"boxes": jnp.asarray(b), "scores": jnp.asarray(s), "labels": jnp.asarray(l.astype(np.int32))}
+            for b, s, l in preds
+        ]
+        ts = [{"boxes": jnp.asarray(b), "labels": jnp.asarray(l.astype(np.int32))} for b, l in target]
+        return ps, ts
+
+    device_data = [to_jnp(p, t) for p, t in datasets]
+    jax.device_get(device_data[-1][0][-1]["boxes"])
+
+    metric = MeanAveragePrecision()
+    metric.update(*device_data[0])
+    jax.device_get(metric.compute()["map"])  # warm-up
+
+    for preds, target in device_data[1:]:
+        metric.reset()
+        t0 = time.perf_counter()
+        metric.update(preds, target)
+        t_update = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        host = metric._fetch_host_states()
+        t_fetch = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        classes = metric._get_classes(host=host)
+        groups = metric._build_groups(classes, host=host)
+        t_groups = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        precisions, recalls = metric._calculate(classes, host=host)
+        t_calc = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        metric._summarize_results(precisions, recalls)
+        t_sum = time.perf_counter() - t0
+
+        total = t_update + t_fetch + t_calc + t_sum
+        print(
+            f"update {t_update*1e3:7.1f} ms | fetch {t_fetch*1e3:7.1f} ms | "
+            f"build_groups {t_groups*1e3:7.1f} ms (x2 inside calc) | "
+            f"calculate(groups+kernel+PR) {t_calc*1e3:7.1f} ms | summarize {t_sum*1e3:6.1f} ms | "
+            f"total-ish {total*1e3:7.1f} ms -> {n_images/total:6.1f} img/s"
+        )
+
+
+if __name__ == "__main__":
+    main()

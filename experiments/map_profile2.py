@@ -1,0 +1,195 @@
+"""Profile the mAP cycle with CONSOLIDATED inputs on the real TPU: where does the
+time go once per-image buffers are gone? Splits _calculate into group-build,
+group-pack, kernel+fetch, and PR accumulation."""
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import bench
+from metrics_tpu.detection import MeanAveragePrecision
+from metrics_tpu.functional.detection import _mean_ap_kernel as _K
+
+
+def consolidate(preds, target):
+    B = len(preds)
+    md = max(p[0].shape[0] for p in preds) or 1
+    mg = max(t[0].shape[0] for t in target) or 1
+    pb = np.zeros((B, md, 4), np.float32)
+    ps = np.full((B, md), -np.inf, np.float32)
+    pl = np.full((B, md), -1, np.int32)
+    tb = np.zeros((B, mg, 4), np.float32)
+    tl = np.full((B, mg), -1, np.int32)
+    for i, ((db, dsc, dl), (gb, gl)) in enumerate(zip(preds, target)):
+        n = db.shape[0]
+        pb[i, :n], ps[i, :n], pl[i, :n] = db, dsc, dl
+        n = gb.shape[0]
+        tb[i, :n], tl[i, :n] = gb, gl
+    return ({"boxes": jnp.asarray(pb), "scores": jnp.asarray(ps), "labels": jnp.asarray(pl)},
+            {"boxes": jnp.asarray(tb), "labels": jnp.asarray(tl)})
+
+
+def main(n_images=1000):
+    datasets = [bench._coco_like_dataset(n_images, seed) for seed in range(3)]
+    device_data = [consolidate(p, t) for p, t in datasets]
+    jax.device_get(device_data[-1][0]["boxes"])
+
+    metric = MeanAveragePrecision()
+    metric.update(*device_data[0])
+    jax.device_get(metric.compute()["map"])  # warm-up
+
+    for preds, target in device_data[1:]:
+        metric.reset()
+        t0 = time.perf_counter()
+        metric.update(preds, target)
+        t_update = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        host = metric._fetch_host_states()
+        t_fetch = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        classes = metric._get_classes(host=host)
+        t_classes = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        groups = metric._build_groups(classes, host=host)
+        t_groups = time.perf_counter() - t0
+
+        # pack + kernel + fetch (reproduce _calculate's middle)
+        t0 = time.perf_counter()
+        precisions, recalls = metric._calculate(classes, host=host)
+        t_calc = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        metric._summarize_results(precisions, recalls)
+        t_sum = time.perf_counter() - t0
+
+        total = t_update + t_fetch + t_classes + t_calc + t_sum
+        print(
+            f"update {t_update*1e3:6.1f} | fetch {t_fetch*1e3:6.1f} | classes {t_classes*1e3:6.1f} | "
+            f"build_groups {t_groups*1e3:6.1f} (n={len(groups)}, inside calc) | "
+            f"calculate {t_calc*1e3:7.1f} | summarize {t_sum*1e3:5.1f} | "
+            f"total {total*1e3:7.1f} ms -> {n_images/total:6.1f} img/s"
+        )
+    print("match_groups compile count:", _K._match_groups._cache_size())
+
+
+if __name__ == "__main__" and "--breakdown" not in sys.argv:
+    main()
+
+
+def breakdown(n_images=1000):
+    """Copy of _calculate's body with timers around each stage."""
+    datasets = [bench._coco_like_dataset(n_images, seed) for seed in range(3)]
+    device_data = [consolidate(p, t) for p, t in datasets]
+    jax.device_get(device_data[-1][0]["boxes"])
+
+    metric = MeanAveragePrecision()
+    metric.update(*device_data[0])
+    jax.device_get(metric.compute()["map"])  # warm-up
+
+    for preds, target in device_data[1:]:
+        metric.reset()
+        metric.update(preds, target)
+        host = metric._fetch_host_states()
+        classes = metric._get_classes(host=host)
+
+        num_t = len(metric.iou_thresholds)
+        t0 = time.perf_counter()
+        groups = metric._build_groups(classes, host=host)
+        t_groups = time.perf_counter() - t0
+
+        ng = len(groups)
+        pad_n = _K._pow2(ng)
+        area_ranges = np.asarray(list(metric.bbox_area_ranges.values()), np.float32)
+        group_cls = np.zeros(ng, np.int64)
+
+        t0 = time.perf_counter()
+        pad_d = _K._pow2(max(1, max(g[1].shape[0] for g in groups)))
+        pad_g = _K._pow2(max(1, max(g[3].shape[0] for g in groups)))
+        det_scores = np.full((pad_n, pad_d), -np.inf, np.float32)
+        det_valid = np.zeros((pad_n, pad_d), bool)
+        gt_valid = np.zeros((pad_n, pad_g), bool)
+        det_boxes = np.zeros((pad_n, pad_d, 4), np.float32)
+        gt_boxes = np.zeros((pad_n, pad_g, 4), np.float32)
+        for i, (k_idx, db, ds, gb) in enumerate(groups):
+            group_cls[i] = k_idx
+            det_boxes[i, : db.shape[0]] = db
+            det_scores[i, : ds.shape[0]] = ds
+            det_valid[i, : db.shape[0]] = True
+            gt_boxes[i, : gb.shape[0]] = gb
+            gt_valid[i, : gb.shape[0]] = True
+        t_pack = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        dev_args = [jnp.asarray(x) for x in (det_boxes, det_valid, gt_boxes, gt_valid)]
+        jax.device_get(dev_args[0][0, 0])  # force upload
+        t_h2d = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        out = _K._match_groups(*dev_args, jnp.asarray(metric.iou_thresholds, jnp.float32), jnp.asarray(area_ranges))
+        out[0].block_until_ready() if hasattr(out[0], "block_until_ready") else None
+        jax.device_get(out[2][0, 0])
+        t_kernel = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        det_matched, det_ignored, npig_ga = jax.device_get(out)
+        t_d2h = time.perf_counter() - t0
+        nbytes = det_matched.nbytes + det_ignored.nbytes + npig_ga.nbytes
+
+        det_matched = det_matched[:ng]
+        det_ignored = det_ignored[:ng]
+        npig_ga = npig_ga[:ng]
+
+        t0 = time.perf_counter()
+        num_r = len(metric.rec_thresholds)
+        num_k = len(classes)
+        num_a = len(metric.bbox_area_ranges)
+        num_m = len(metric.max_detection_thresholds)
+        precision = -np.ones((num_t, num_r, num_k, num_a, num_m))
+        recall = -np.ones((num_t, num_k, num_a, num_m))
+        rec_thresholds = np.asarray(metric.rec_thresholds)
+        _EPS = float(np.finfo(np.float64).eps)
+        for k_idx in range(num_k):
+            sel = np.nonzero(group_cls == k_idx)[0]
+            if sel.size == 0:
+                continue
+            for a_idx in range(num_a):
+                npig = int(npig_ga[sel, a_idx].sum())
+                if npig == 0:
+                    continue
+                for m_idx, max_det in enumerate(metric.max_detection_thresholds):
+                    cap = min(max_det, det_scores.shape[1])
+                    scores_flat = det_scores[sel, :cap].reshape(-1)
+                    matched = det_matched[sel, a_idx, :, :cap].transpose(1, 0, 2).reshape(num_t, -1)
+                    ignored = det_ignored[sel, a_idx, :, :cap].transpose(1, 0, 2).reshape(num_t, -1)
+                    order = np.argsort(-scores_flat, kind="stable")
+                    matched = matched[:, order]
+                    ignored = ignored[:, order]
+                    tps = np.cumsum(matched & ~ignored, axis=1, dtype=np.float64)
+                    fps = np.cumsum(~matched & ~ignored, axis=1, dtype=np.float64)
+                    nd = tps.shape[1]
+                    rc = tps / npig
+                    pr = tps / (fps + tps + _EPS)
+                    recall[:, k_idx, a_idx, m_idx] = rc[:, -1] if nd else 0.0
+                    pr = np.maximum.accumulate(pr[:, ::-1], axis=1)[:, ::-1]
+                    for t_idx in range(num_t):
+                        inds = np.searchsorted(rc[t_idx], rec_thresholds, side="left")
+                        num_inds = int(inds.argmax()) if inds.max() >= nd else num_r
+                        prec = np.zeros(num_r)
+                        prec[:num_inds] = pr[t_idx][inds[:num_inds]]
+                        precision[t_idx, :, k_idx, a_idx, m_idx] = prec
+        t_pr = time.perf_counter() - t0
+        print(
+            f"groups {t_groups*1e3:6.1f} | pack {t_pack*1e3:6.1f} | h2d {t_h2d*1e3:6.1f} | "
+            f"kernel {t_kernel*1e3:7.1f} | d2h {t_d2h*1e3:6.1f} ({nbytes/1e6:.0f} MB) | hostPR {t_pr*1e3:7.1f}"
+        )
+
+
+if __name__ == "__main__" and "--breakdown" in sys.argv:
+    breakdown()

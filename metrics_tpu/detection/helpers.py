@@ -7,6 +7,13 @@ import numpy as np
 _ARRAY_TYPES = (jnp.ndarray, np.ndarray)
 
 
+def _is_rle_list(value) -> bool:
+    """True for a per-image ``masks`` given as a (possibly empty) list of COCO RLE dicts."""
+    return isinstance(value, (list, tuple)) and all(
+        isinstance(r, dict) and "size" in r and "counts" in r for r in value
+    )
+
+
 def _input_validator(preds: Sequence[Dict], targets: Sequence[Dict], iou_type: str = "bbox") -> None:
     """Ensure the correct input format of ``preds`` and ``targets``."""
     if iou_type == "bbox":
@@ -33,30 +40,81 @@ def _input_validator(preds: Sequence[Dict], targets: Sequence[Dict], iou_type: s
         if any(k not in p for p in targets):
             raise ValueError(f"Expected all dicts in `target` to contain the `{k}` key")
 
-    if any(not isinstance(pred[item_val_name], _ARRAY_TYPES) for pred in preds):
+    # masks may also arrive as per-image lists of COCO RLE dicts (decoded host-side
+    # by detection/rle.py); the reference instead requires dense tensors and
+    # pycocotools (mean_ap.py:345,402)
+    def _item_ok(value):
+        return isinstance(value, _ARRAY_TYPES) or (item_val_name == "masks" and _is_rle_list(value))
+
+    if any(not _item_ok(pred[item_val_name]) for pred in preds):
         raise ValueError(f"Expected all {item_val_name} in `preds` to be of type Array")
     if any(not isinstance(pred["scores"], _ARRAY_TYPES) for pred in preds):
         raise ValueError("Expected all scores in `preds` to be of type Array")
     if any(not isinstance(pred["labels"], _ARRAY_TYPES) for pred in preds):
         raise ValueError("Expected all labels in `preds` to be of type Array")
-    if any(not isinstance(target[item_val_name], _ARRAY_TYPES) for target in targets):
+    if any(not _item_ok(target[item_val_name]) for target in targets):
         raise ValueError(f"Expected all {item_val_name} in `target` to be of type Array")
     if any(not isinstance(target["labels"], _ARRAY_TYPES) for target in targets):
         raise ValueError("Expected all labels in `target` to be of type Array")
 
+    def _n_items(value):
+        return len(value) if _is_rle_list(value) else value.shape[0]
+
     for i, item in enumerate(targets):
-        if item[item_val_name].shape[0] != item["labels"].shape[0]:
+        if _n_items(item[item_val_name]) != item["labels"].shape[0]:
             raise ValueError(
                 f"Input {item_val_name} and labels of sample {i} in targets have a"
-                f" different length (expected {item[item_val_name].shape[0]} labels, got {item['labels'].shape[0]})"
+                f" different length (expected {_n_items(item[item_val_name])} labels, got {item['labels'].shape[0]})"
             )
     for i, item in enumerate(preds):
-        if not (item[item_val_name].shape[0] == item["labels"].shape[0] == item["scores"].shape[0]):
+        if not (_n_items(item[item_val_name]) == item["labels"].shape[0] == item["scores"].shape[0]):
             raise ValueError(
                 f"Input {item_val_name}, labels and scores of sample {i} in predictions have a"
-                f" different length (expected {item[item_val_name].shape[0]} labels and scores,"
+                f" different length (expected {_n_items(item[item_val_name])} labels and scores,"
                 f" got {item['labels'].shape[0]} labels and {item['scores'].shape[0]} scores)"
             )
+
+
+def _validate_consolidated(preds: Dict, target: Dict, iou_type: str = "bbox") -> None:
+    """Validate the TPU-first consolidated input layout.
+
+    ``preds``/``target`` are single dicts of batched padded arrays — the shape a
+    TPU detection model naturally emits (fixed max detections per image):
+    ``preds[boxes|masks] (B, M, 4)`` / ``(B, M, H, W)``, ``scores (B, M)``,
+    ``labels (B, M)``; rows with ``labels < 0`` are padding. No per-image buffers
+    exist, so update/compute never pay the tunnel's ~0.6 ms per-buffer floor
+    (experiments/map_pack_exp.py measures why per-image layouts cannot win).
+    """
+    item_val_name = "masks" if iou_type == "segm" else "boxes"
+    for name, item, keys in (("preds", preds, (item_val_name, "scores", "labels")),
+                             ("target", target, (item_val_name, "labels"))):
+        for k in keys:
+            if k not in item:
+                raise ValueError(f"Expected consolidated `{name}` dict to contain the `{k}` key")
+            if not isinstance(item[k], _ARRAY_TYPES):
+                raise ValueError(f"Expected consolidated `{name}[{k!r}]` to be an Array")
+        main_ndim = 4 if item_val_name == "masks" else 3
+        main = item[item_val_name]
+        if main.ndim != main_ndim or (item_val_name == "boxes" and main.shape[-1] != 4):
+            raise ValueError(
+                f"Expected consolidated `{name}[{item_val_name!r}]` to have shape"
+                f" {'(B, M, H, W)' if item_val_name == 'masks' else '(B, M, 4)'}, got {main.shape}"
+            )
+        if item["labels"].shape != main.shape[:2]:
+            raise ValueError(
+                f"Expected consolidated `{name}['labels']` shape {main.shape[:2]},"
+                f" got {item['labels'].shape}"
+            )
+    if preds["scores"].shape != preds["labels"].shape:
+        raise ValueError(
+            f"Expected consolidated `preds['scores']` shape {preds['labels'].shape},"
+            f" got {preds['scores'].shape}"
+        )
+    if preds[item_val_name].shape[0] != target[item_val_name].shape[0]:
+        raise ValueError(
+            f"Expected consolidated `preds` and `target` to cover the same images, got"
+            f" batch {preds[item_val_name].shape[0]} vs {target[item_val_name].shape[0]}"
+        )
 
 
 def _fix_empty_tensors(boxes) -> jnp.ndarray:

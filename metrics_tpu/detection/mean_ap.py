@@ -22,7 +22,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu.core.metric import Metric
-from metrics_tpu.detection.helpers import _fix_empty_tensors, _input_validator
+from metrics_tpu.detection.helpers import _fix_empty_tensors, _input_validator, _is_rle_list, _validate_consolidated
+from metrics_tpu.detection.rle import masks_from_rle
 from metrics_tpu.functional.detection._mean_ap_kernel import _match_groups, _match_groups_from_iou, _pow2
 from metrics_tpu.functional.detection.box_ops import box_convert
 
@@ -146,15 +147,44 @@ class MeanAveragePrecision(Metric):
         self.add_state("groundtruths", default=[], dist_reduce_fx=None)
         self.add_state("groundtruth_labels", default=[], dist_reduce_fx=None)
 
-    def update(self, preds: List[Dict[str, Array]], target: List[Dict[str, Array]]) -> None:
-        """Append per-image detections and ground truths to the unreduced states.
+    def update(self, preds, target) -> None:
+        """Append detections and ground truths to the unreduced states.
 
-        Host (numpy/list) inputs STAY on host: the matching pipeline fetches all
-        per-image state to host anyway (``_fetch_host_states``), so moving host
-        inputs through the device would pay a pointless H2D upload now plus a
-        ~0.6 ms/buffer D2H round trip per (image, state) pair at compute.
-        Device (jax.Array) inputs are kept as-is, as before.
+        Two input layouts are accepted:
+
+        - **Reference-parity list layout** (reference mean_ap.py:366-377): lists of
+          per-image dicts. ``masks`` may additionally be a per-image list of COCO
+          RLE dicts (``{"size": [h, w], "counts": ...}``, compressed or not) —
+          decoded host-side by :mod:`metrics_tpu.detection.rle`; the reference
+          instead requires dense tensors plus pycocotools. Host (numpy/list)
+          inputs STAY on host: the matching pipeline fetches all per-image state
+          to host anyway (``_fetch_host_states``), so moving host inputs through
+          the device would pay a pointless H2D upload now plus a ~0.6 ms/buffer
+          D2H round trip per (image, state) pair at compute.
+        - **Consolidated TPU layout**: single dicts of batched padded arrays —
+          ``preds = {"boxes": (B, M, 4), "scores": (B, M), "labels": (B, M)}``
+          (``"masks": (B, M, H, W)`` for segm), ``target`` likewise without
+          scores; rows with ``labels < 0`` are padding. This is the layout a TPU
+          detection model emits (static max detections per image) and the fast
+          path on a tunneled backend: per-image device buffers each pay a
+          ~0.6 ms dispatch/transfer floor in BOTH directions, so no device-side
+          repacking of a ragged per-image list can win (measured grid in
+          experiments/map_pack_exp.py); consolidated inputs never create
+          per-image buffers at all and compute does ONE batched D2H per buffer.
         """
+        if isinstance(preds, dict) and isinstance(target, dict):
+            _validate_consolidated(preds, target, iou_type=self.iou_type)
+            key = "masks" if self.iou_type == "segm" else "boxes"
+            # batched entries are appended whole (zero per-image work); ndim
+            # distinguishes them from per-image entries at host expansion, where
+            # box-format conversion and padding-row removal happen vectorized
+            self.detections.append(self._asarray_like(preds[key]))
+            self.detection_scores.append(self._asarray_like(preds["scores"]))
+            self.detection_labels.append(self._asarray_like(preds["labels"]))
+            self.groundtruths.append(self._asarray_like(target[key]))
+            self.groundtruth_labels.append(self._asarray_like(target["labels"]))
+            return
+
         _input_validator(preds, target, iou_type=self.iou_type)
 
         for item in preds:
@@ -173,6 +203,10 @@ class MeanAveragePrecision(Metric):
 
     def _get_safe_item_values(self, item: Dict[str, Any]) -> Array:
         if self.iou_type == "segm":
+            if _is_rle_list(item["masks"]):
+                # COCO-annotation ingestion: decode host-side to the dense form
+                # the matmul-IoU kernel consumes (rle.py; stays numpy/host)
+                return masks_from_rle(item["masks"])
             masks = self._asarray_like(item["masks"])
             if masks.size == 0:
                 xp = jnp if isinstance(item["masks"], jax.Array) else np
@@ -185,15 +219,19 @@ class MeanAveragePrecision(Metric):
         return boxes
 
     def _fetch_host_states(self):
-        """ONE batched device->host fetch of all five unreduced state lists.
+        """ONE batched device->host fetch of all five unreduced state lists,
+        then host-side expansion of consolidated entries into per-image arrays.
 
         Per-array ``np.asarray`` pays a full tunnel round trip per (image, state)
         pair — measured ~58 s for 256 images just to read the label lists; the
-        single ``device_get`` of the whole pytree is ~0.3 s. ``compute`` calls
-        this once and shares the result between ``_get_classes`` and
-        ``_build_groups``.
+        single ``device_get`` of the whole pytree is ~0.3 s. Consolidated entries
+        (batched padded arrays from the dict update layout) are each ONE buffer
+        regardless of image count, so the fetch cost drops from O(images) to
+        O(update calls); padding rows (labels < 0) are stripped and box-format
+        conversion applied here in vectorized numpy. ``compute`` calls this once
+        and shares the result between ``_get_classes`` and ``_build_groups``.
         """
-        return jax.device_get(
+        host = jax.device_get(
             (
                 list(self.detections),
                 list(self.detection_scores),
@@ -202,6 +240,43 @@ class MeanAveragePrecision(Metric):
                 list(self.groundtruth_labels),
             )
         )
+        return self._expand_consolidated(host)
+
+    def _expand_consolidated(self, host):
+        """Split batched (B, M, ...) state entries into per-image numpy arrays.
+
+        Per-image entries pass through untouched; batched entries (one extra
+        leading dim, appended by the consolidated update path) expand to B
+        per-image arrays with padding rows (labels < 0) dropped. Legacy entries
+        had their box format converted at update time; consolidated boxes are
+        converted here instead, once per batch.
+        """
+        det, ds, dl, gt, gl = (list(x) for x in host)
+        item_ndim = 3 if self.iou_type == "segm" else 2  # per-image (n,H,W) / (n,4)
+
+        def expand(items, labels, *extra_streams):
+            """One rule for preds and gts: gts are just preds minus the scores stream."""
+            outs = [[] for _ in range(2 + len(extra_streams))]
+            for entry in zip(items, labels, *extra_streams):
+                item, l = entry[0], entry[1]
+                if np.asarray(item).ndim == item_ndim:
+                    for out, v in zip(outs, entry):
+                        out.append(v)
+                    continue
+                for b in range(len(l)):
+                    keep = l[b] >= 0
+                    rows = item[b][keep]
+                    if self.iou_type != "segm" and self.box_format != "xyxy" and rows.size:
+                        rows = box_convert(rows, in_fmt=self.box_format, out_fmt="xyxy", xp=np)
+                    outs[0].append(rows)
+                    outs[1].append(l[b][keep])
+                    for out, stream in zip(outs[2:], entry[2:]):
+                        out.append(stream[b][keep])
+            return outs
+
+        det, dl, ds = expand(det, dl, ds)
+        gt, gl = expand(gt, gl)
+        return det, ds, dl, gt, gl
 
     def _get_classes(self, host=None) -> List:
         """Unique classes present in detections or ground truth (reference :407-411)."""
@@ -270,6 +345,109 @@ class MeanAveragePrecision(Metric):
                     db = det_items[img][dmask]
                     groups.append((k_idx, db[order], ds[order], gt_items[img][gmask]))
         return groups
+
+    def _device_path_ok(self) -> bool:
+        """True when every state entry came from the consolidated bbox layout.
+
+        The fully-device pipeline (functional/detection/_mean_ap_device.py) then
+        evaluates grouping, matching and the PR tables in one jitted program and
+        only the ~0.25 MB result tables leave the device — the host path would
+        instead round-trip all boxes twice over the tunnel. segm and per-image
+        entries keep the host-orchestrated path.
+        """
+        if self.iou_type != "bbox" or not len(self.detections):
+            return False
+        return all(np.ndim(x) == 3 for x in self.detections) and all(
+            np.ndim(x) == 3 for x in self.groundtruths
+        )
+
+    def _calculate_device(self):
+        """Classes + device-resident tables for consolidated states (bbox only).
+
+        Returns ``(classes, precision, recall)``; one small label-only fetch
+        decides the class list and bucket routing, everything else stays in HBM.
+        """
+        from metrics_tpu.functional.detection._mean_ap_device import consolidated_tables, plan_buckets
+
+        def merge(entries, ncols_to, fill):
+            entries = [jnp.asarray(e) for e in entries]
+            width = max(int(e.shape[1]) for e in entries)
+            width = max(width, ncols_to)
+            padded = []
+            for e in entries:
+                pad = width - int(e.shape[1])
+                cfg = [(0, 0)] * e.ndim
+                cfg[1] = (0, pad)
+                padded.append(jnp.pad(e, cfg, constant_values=fill) if pad else e)
+            return padded[0] if len(padded) == 1 else jnp.concatenate(padded, axis=0)
+
+        max_det = self.max_detection_thresholds[-1]
+        d_small = g_small = 16
+        det_labels = merge(self.detection_labels, d_small, -1)
+        gt_labels = merge(self.groundtruth_labels, g_small, -1)
+        # ONE small host fetch (labels only) decides classes + bucket routing
+        dl_np, gl_np = jax.device_get((det_labels, gt_labels))
+        cat = np.concatenate([dl_np.reshape(-1), gl_np.reshape(-1)])
+        cat = cat[cat >= 0]
+        class_ids = sorted(np.unique(cat).astype(np.int64).tolist()) if cat.size else []
+        class_ids_np = np.asarray(class_ids, np.int64)
+        K = len(class_ids_np)
+        if K == 0:
+            num_t, num_r = len(self.iou_thresholds), len(self.rec_thresholds)
+            num_a, num_m = len(self.bbox_area_ranges), len(self.max_detection_thresholds)
+            return [], -np.ones((num_t, num_r, 0, num_a, num_m)), -np.ones((num_t, 0, num_a, num_m))
+        det_counts = (dl_np[:, :, None] == class_ids_np[None, None, :]).sum(1)  # (B, K)
+        gt_counts = (gl_np[:, :, None] == class_ids_np[None, None, :]).sum(1)
+        is_small, big_pairs, d_big, g_big = plan_buckets(det_counts, gt_counts, max_det)
+
+        nb = _pow2(max(1, len(big_pairs)))
+        big_b = np.zeros(nb, np.int32)
+        big_kidx = np.full(nb, -1, np.int32)
+        for i, (b, kidx) in enumerate(big_pairs):
+            big_b[i] = b
+            big_kidx[i] = kidx
+        big_k = np.where(big_kidx >= 0, class_ids_np[np.maximum(big_kidx, 0)], -1).astype(np.int32)
+
+        det_boxes = merge(self.detections, max(d_small, d_big), 0.0).astype(jnp.float32)
+        det_scores = merge(self.detection_scores, max(d_small, d_big), -np.inf).astype(jnp.float32)
+        gt_boxes = merge(self.groundtruths, max(g_small, g_big), 0.0).astype(jnp.float32)
+        # labels were merged before the bucket widths were known; re-pad so every
+        # buffer shares one (B, width) — _group_rows broadcasts them together
+        if det_labels.shape[1] < det_boxes.shape[1]:
+            det_labels = jnp.pad(det_labels, ((0, 0), (0, det_boxes.shape[1] - det_labels.shape[1])), constant_values=-1)
+        if gt_labels.shape[1] < gt_boxes.shape[1]:
+            gt_labels = jnp.pad(gt_labels, ((0, 0), (0, gt_boxes.shape[1] - gt_labels.shape[1])), constant_values=-1)
+        if self.box_format != "xyxy":
+            B, M = det_boxes.shape[:2]
+            det_boxes = box_convert(det_boxes.reshape(-1, 4), in_fmt=self.box_format, out_fmt="xyxy", xp=jnp).reshape(B, M, 4)
+            Bg, Mg = gt_boxes.shape[:2]
+            gt_boxes = box_convert(gt_boxes.reshape(-1, 4), in_fmt=self.box_format, out_fmt="xyxy", xp=jnp).reshape(Bg, Mg, 4)
+
+        precision, recall = consolidated_tables(
+            det_boxes,
+            det_scores,
+            det_labels.astype(jnp.int32),
+            gt_boxes,
+            gt_labels.astype(jnp.int32),
+            jnp.asarray(class_ids_np, jnp.int32),
+            jnp.asarray(is_small),
+            jnp.asarray(big_b),
+            jnp.asarray(big_k),
+            jnp.asarray(big_kidx),
+            jnp.asarray(self.iou_thresholds, jnp.float32),
+            jnp.asarray(self.rec_thresholds, jnp.float32),
+            jnp.asarray(list(self.bbox_area_ranges.values()), jnp.float32),
+            d_small=d_small,
+            g_small=g_small,
+            d_big=d_big,
+            g_big=g_big,
+            max_det=max_det,
+            # the cap only truncates REAL rows (padding slots are ignored either
+            # way), so rank < m is the host path's min(m, width) semantics
+            caps=tuple(self.max_detection_thresholds),
+        )
+        precision, recall = jax.device_get((precision, recall))
+        return class_ids, np.asarray(precision, np.float64), np.asarray(recall, np.float64)
 
     def _calculate(self, class_ids: List[int], host=None) -> Tuple[np.ndarray, np.ndarray]:
         """Precision/recall tables over (T, R, K, A, M) via the device matching kernel."""
@@ -446,9 +624,12 @@ class MeanAveragePrecision(Metric):
 
     def compute(self) -> dict:
         """Full COCO result dict from the accumulated detections (reference :842-871)."""
-        host = self._fetch_host_states()
-        classes = self._get_classes(host=host)
-        precisions, recalls = self._calculate(classes, host=host)
+        if self._device_path_ok():
+            classes, precisions, recalls = self._calculate_device()
+        else:
+            host = self._fetch_host_states()
+            classes = self._get_classes(host=host)
+            precisions, recalls = self._calculate(classes, host=host)
         map_val, mar_val = self._summarize_results(precisions, recalls)
 
         map_per_class_values: Array = jnp.asarray([-1.0])

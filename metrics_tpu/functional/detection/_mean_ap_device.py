@@ -1,0 +1,240 @@
+"""Fully device-resident mAP evaluation for consolidated inputs.
+
+The reference's evaluation is host-orchestrated end to end: python loops build
+per-(image, class) tensors, the matching loop runs on CPU, and the PR tables come
+from numpy (``/root/reference/src/torchmetrics/detection/mean_ap.py:509-606,773-840``).
+Round 4's port moved the matching loop onto the device but still round-tripped all
+per-image data host->group-tensors->device and the (N, A, T, D) match masks back —
+on a ~25-50 MB/s tunneled backend those two transfers plus the padded-shape kernel
+were ~3 s of a ~4 s cycle for 1000 images (measured: experiments/map_profile2.py).
+
+This module removes the data movement entirely for the consolidated input layout
+(update appends ``(B, M, ...)`` padded batches — the natural output shape of a TPU
+detection model). Everything from grouping to the 101-point PR tables runs in ONE
+jitted program over the buffers already in HBM:
+
+1. **Grouping is a batched stable sort**, not a python loop: for each class, each
+   image's rows are ordered by ``(label != k, -score)`` so the class's detections
+   land score-sorted in the leading slots (parity with the reference's
+   ``argsort(-scores, stable)[:max_det]``).
+2. **Two-bucket matching**: the greedy-match scan costs O(D) sequential steps and
+   O(G) per-step width, and measured time is ~linear in both (D=128,G=64 ->
+   D=16,G=16 is 7.4x: experiments/map_kernel_exp.py). Nearly every (image, class)
+   group is small, so groups with <= 16 dets and <= 16 gts run in a (K*B)-wide
+   D=16/G=16 kernel and only the rare big groups pay the wide shapes. The split
+   is decided on host from a ~0.5 MB label fetch; bucket shapes are pow2 so
+   compile keys stay log-bounded.
+3. **PR accumulation on device** (``lax.map`` over classes to bound memory): per
+   class, all row slots (small grid + masked big-bucket rows) are score-sorted
+   once, and tps/fps cumsums, precision envelope (reverse cummax) and the
+   101-recall-threshold lookup (vectorized searchsorted) produce the final
+   ``(T, R, K, A, M)`` table. Cumsums are f32 but exact: summands are 0/1 counts
+   and every partial sum is an integer < 2^24 for < 16.7M detections per class.
+   Only the ~0.25 MB tables cross the tunnel.
+
+Parity with the host path is exact up to f32-vs-f64 division rounding in rc/pr
+(<= ~1e-7 relative; the bench asserts <= 1e-6 vs the live reference) and score-tie
+ordering between rows of different buckets (pycocotools itself is permutation-
+dependent under ties).
+"""
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.functional.detection._mean_ap_kernel import _match_groups_core
+from metrics_tpu.utils.data import _next_pow2
+
+_EPS = float(np.finfo(np.float64).eps)
+
+
+def _group_rows(boxes, scores, labels, class_vec, width, max_det):
+    """Score-sorted class rows for each (group, class) pair.
+
+    ``boxes/scores/labels`` are ``(N, M, ...)`` image rows; ``class_vec`` is the
+    ``(N,)`` class id each output group selects. Returns ``(N, width)`` slots:
+    the class's detections score-sorted first (stable ties keep input order, as
+    the reference's ``argsort(-scores, kind="stable")``), padding after;
+    ``valid`` marks real class rows within the top ``max_det``.
+    """
+    is_class = labels == class_vec[:, None]
+    key = jnp.where(is_class, -scores, jnp.inf)
+    perm = jnp.argsort(key, axis=1, stable=True)[:, :width]
+    b = jnp.take_along_axis(boxes, perm[..., None], axis=1)
+    s = jnp.take_along_axis(scores, perm, axis=1)
+    valid = jnp.take_along_axis(is_class, perm, axis=1)
+    valid = valid & (jnp.arange(width)[None, :] < max_det)
+    return b, s, valid
+
+
+def _group_gt_rows(boxes, labels, class_vec, width):
+    """Class ground-truth rows packed first (original order preserved)."""
+    is_class = labels == class_vec[:, None]
+    perm = jnp.argsort(~is_class, axis=1, stable=True)[:, :width]
+    b = jnp.take_along_axis(boxes, perm[..., None], axis=1)
+    valid = jnp.take_along_axis(is_class, perm, axis=1)
+    return b, valid
+
+
+@partial(
+    jax.jit,
+    static_argnames=("d_small", "g_small", "d_big", "g_big", "max_det", "caps"),
+)
+def consolidated_tables(
+    det_boxes: Array,   # (B, M, 4) xyxy
+    det_scores: Array,  # (B, M); padding rows score -inf
+    det_labels: Array,  # (B, M) int32; padding rows < 0
+    gt_boxes: Array,    # (B, Mg, 4)
+    gt_labels: Array,   # (B, Mg) int32; padding rows < 0
+    class_arr: Array,   # (K,) int32 sorted unique class ids
+    is_small: Array,    # (B, K) bool: group (b, k) routed to the small bucket
+    big_b: Array,       # (Nb,) int32 image index of each big group (0 for dummies)
+    big_k: Array,       # (Nb,) int32 class id of each big group (-1 for dummies)
+    big_kidx: Array,    # (Nb,) int32 index into class_arr (-1 for dummies)
+    iou_thresholds: Array,  # (T,)
+    rec_thresholds: Array,  # (R,)
+    area_ranges: Array,     # (A, 2)
+    *,
+    d_small: int,
+    g_small: int,
+    d_big: int,
+    g_big: int,
+    max_det: int,
+    caps: Tuple[int, ...],
+) -> Tuple[Array, Array]:
+    """Precision ``(T, R, K, A, M)`` and recall ``(T, K, A, M)`` tables on device."""
+    B, K = is_small.shape
+    num_t = iou_thresholds.shape[0]
+    num_a = area_ranges.shape[0]
+    num_m = len(caps)
+
+    # ---- small bucket: dense (K, B) grid of groups at narrow widths ----------
+    def small_class(k, small_k):
+        db, ds, dv = _group_rows(det_boxes, det_scores, det_labels, jnp.full((B,), k), d_small, max_det)
+        gb, gv = _group_gt_rows(gt_boxes, gt_labels, jnp.full((B,), k), g_small)
+        dv = dv & small_k[:, None]
+        gv = gv & small_k[:, None]
+        return db, ds, dv, gb, gv
+
+    s_db, s_ds, s_dv, s_gb, s_gv = jax.vmap(small_class)(class_arr, is_small.T)  # (K, B, ...)
+    flat = lambda x: x.reshape((K * B,) + x.shape[2:])
+    s_matched, s_ignored, s_npig = _match_groups_core(
+        flat(s_db), flat(s_dv), flat(s_gb), flat(s_gv), iou_thresholds, area_ranges
+    )  # (K*B, A, T, d_small), ..., (K*B, A)
+    s_matched = s_matched.reshape(K, B, num_a, num_t, d_small)
+    s_ignored = s_ignored.reshape(K, B, num_a, num_t, d_small)
+    s_npig = s_npig.reshape(K, B, num_a)
+    s_scores = s_ds  # (K, B, d_small)
+
+    # ---- big bucket: host-listed (b, k) groups at wide static widths ---------
+    nb = big_b.shape[0]
+    b_db, b_ds, b_dv = _group_rows(
+        det_boxes[big_b], det_scores[big_b], det_labels[big_b], big_k, d_big, max_det
+    )
+    b_gb, b_gv = _group_gt_rows(gt_boxes[big_b], gt_labels[big_b], big_k, g_big)
+    # dummy groups carry class -1, which matches padding label rows: mask them out
+    real = (big_k >= 0)[:, None]
+    b_dv = b_dv & real
+    b_gv = b_gv & real
+    b_matched, b_ignored, b_npig = _match_groups_core(
+        b_db, b_dv, b_gb, b_gv, iou_thresholds, area_ranges
+    )  # (Nb, A, T, d_big), ..., (Nb, A)
+
+    # per-class npig: small grid sum + big groups folded in by class index
+    npig = s_npig.sum(axis=1)  # (K, A)
+    npig = npig + jax.ops.segment_sum(
+        b_npig * (big_kidx >= 0)[:, None], jnp.maximum(big_kidx, 0), num_segments=K
+    )
+
+    caps_arr = jnp.asarray(caps, jnp.int32)  # (M,)
+    num_r = rec_thresholds.shape[0]
+
+    # ---- PR accumulation: one class at a time (lax.map bounds peak memory) ---
+    def per_class(kidx):
+        # rows = the class's small grid slots + every big-bucket slot masked to it
+        sc = jnp.concatenate([s_scores[kidx].reshape(-1), b_ds.reshape(-1)])
+        rank = jnp.concatenate(
+            [
+                jnp.broadcast_to(jnp.arange(d_small), (B, d_small)).reshape(-1),
+                jnp.broadcast_to(jnp.arange(d_big), (nb, d_big)).reshape(-1),
+            ]
+        )
+        mine = big_kidx == kidx  # (Nb,)
+        m_rows = jnp.concatenate(
+            [
+                s_matched[kidx].transpose(0, 3, 1, 2).reshape(B * d_small, num_a, num_t),
+                b_matched.transpose(0, 3, 1, 2).reshape(nb * d_big, num_a, num_t),
+            ]
+        )  # (R, A, T)
+        i_rows = jnp.concatenate(
+            [
+                s_ignored[kidx].transpose(0, 3, 1, 2).reshape(B * d_small, num_a, num_t),
+                (b_ignored | ~mine[:, None, None, None]).transpose(0, 3, 1, 2).reshape(nb * d_big, num_a, num_t),
+            ]
+        )
+        other = jnp.concatenate([jnp.zeros(B * d_small, bool), ~mine.repeat(d_big)])
+        sc = jnp.where(other, -jnp.inf, sc)
+
+        order = jnp.argsort(-sc, stable=True)
+        rank = rank[order]
+        m_rows = m_rows[order]
+        i_rows = i_rows[order]
+
+        incap = rank[:, None] < caps_arr[None, :]  # (R, M)
+        counted = ~i_rows  # (R, A, T)
+        # (A, T, M, R) streams; 0/1 summands keep f32 cumsums exact below 2^24 rows
+        tp = (m_rows & counted)[:, :, :, None] & incap[:, None, None, :]
+        fp = (~m_rows & counted)[:, :, :, None] & incap[:, None, None, :]
+        tps = jnp.cumsum(tp.transpose(1, 2, 3, 0).astype(jnp.float32), axis=-1)
+        fps = jnp.cumsum(fp.transpose(1, 2, 3, 0).astype(jnp.float32), axis=-1)
+
+        npig_k = npig[kidx]  # (A,)
+        rc = tps / jnp.maximum(npig_k[:, None, None, None], 1.0)
+        pr = tps / (tps + fps + _EPS)
+        rec_last = rc[..., -1]  # (A, T, M)
+        pr_env = jax.lax.cummax(pr[..., ::-1], axis=pr.ndim - 1)[..., ::-1]
+
+        flat_rc = rc.reshape(-1, rc.shape[-1])
+        inds = jax.vmap(lambda row: jnp.searchsorted(row, rec_thresholds, side="left"))(flat_rc)
+        flat_env = pr_env.reshape(-1, pr_env.shape[-1])
+        n_rows = flat_rc.shape[-1]
+        prec = jnp.where(
+            inds < n_rows,
+            jnp.take_along_axis(flat_env, jnp.minimum(inds, n_rows - 1), axis=-1),
+            0.0,
+        )  # (A*T*M, R_thr)
+        prec = prec.reshape(num_a, num_t, num_m, num_r)
+
+        # npig == 0 keeps the reference's -1 sentinel for "no gts in this slice"
+        valid = npig_k > 0  # (A,)
+        prec = jnp.where(valid[:, None, None, None], prec, -1.0)
+        rec_last = jnp.where(valid[:, None, None], rec_last, -1.0)
+        return prec, rec_last
+
+    prec_k, rec_k = jax.lax.map(per_class, jnp.arange(K))  # (K, A, T, M, R), (K, A, T, M)
+    precision = prec_k.transpose(2, 4, 0, 1, 3)  # (T, R, K, A, M)
+    recall = rec_k.transpose(2, 0, 1, 3)         # (T, K, A, M)
+    return precision, recall
+
+
+def plan_buckets(det_counts: np.ndarray, gt_counts: np.ndarray, max_det: int):
+    """Host-side bucket routing from per-(image, class) row counts.
+
+    Returns ``(is_small (B, K) bool, big_pairs list[(b, kidx)], d_big, g_big)``
+    with pow2 widths so compile keys stay log-bounded. ``d_small``/``g_small``
+    are fixed at 16 (the measured sweet spot: experiments/map_kernel_exp.py).
+    """
+    small_cap = 16
+    is_small = (det_counts <= small_cap) & (gt_counts <= small_cap)
+    big_idx = np.nonzero(~is_small)
+    big_pairs = list(zip(big_idx[0].tolist(), big_idx[1].tolist()))
+    if big_pairs:
+        d_big = _next_pow2(int(min(max(det_counts[~is_small].max(), 1), max_det)))
+        g_big = _next_pow2(int(max(gt_counts[~is_small].max(), 1)))
+        d_big = max(d_big, 1)
+    else:
+        d_big, g_big = 1, 1
+    return is_small, big_pairs, d_big, g_big

@@ -62,8 +62,7 @@ def _per_group_from_iou(iou, d_area, g_area, dv, gv, iou_thresholds, area_ranges
     return jax.vmap(per_area)(area_ranges)
 
 
-@jax.jit
-def _match_groups(
+def _match_groups_core(
     det_boxes: Array,   # (N, D, 4) score-sorted per group, zero-padded
     det_valid: Array,   # (N, D) bool
     gt_boxes: Array,    # (N, G, 4) zero-padded
@@ -75,12 +74,18 @@ def _match_groups(
 
     Returns ``det_matched (N, A, T, D)``, ``det_ignored (N, A, T, D)`` and
     ``npig (N, A)`` — the number of non-ignored ground truths per group/area.
+    Unjitted so the fully-device consolidated pipeline (_mean_ap_device.py) can
+    inline it inside its own program; the legacy host-orchestrated path uses the
+    jitted ``_match_groups`` wrapper below.
     """
 
     def per_group(db, dv, gb, gv):
         return _per_group_from_iou(box_iou(db, gb), box_area(db), box_area(gb), dv, gv, iou_thresholds, area_ranges)
 
     return jax.vmap(per_group)(det_boxes, det_valid, gt_boxes, gt_valid)
+
+
+_match_groups = jax.jit(_match_groups_core)
 
 
 @jax.jit

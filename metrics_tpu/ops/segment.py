@@ -75,9 +75,15 @@ def _segment_cumsum_nonneg(values: Array, new_seg: Array) -> Array:
     The global cumsum is non-decreasing, so each segment's base (global cumsum
     just before the segment) can be broadcast to its rows with one ``cummax``
     instead of a per-row gather. Callers must guarantee ``values >= 0``.
+
+    Dtype-preserving: count-like streams are passed as int32 so the GLOBAL
+    running sum stays exact to 2^31 rows (an f32 global sum would lose integer
+    exactness past 2^24 positive rows — the scale this module's own 2^24-row
+    benchmarks run at); fractional streams (the AP contribution sum) stay f32,
+    where the base-difference is subject to ordinary float rounding only.
     """
     g = jnp.cumsum(values)
-    base = jax.lax.cummax(jnp.where(new_seg, g - values, jnp.zeros_like(values)))
+    base = jax.lax.cummax(jnp.where(new_seg, g - values, jnp.zeros_like(g)))
     return g - base
 
 
@@ -118,21 +124,25 @@ def _scan_retrieval_scores(
     seg_start_row = jax.lax.cummax(jnp.where(new_seg, pos, 0))
     rank = pos - seg_start_row + 1
 
-    binary_t = (s_target > 0).astype(jnp.float32)
+    # counts run in int32 through the cumsum-base trick: exact to 2^31 rows
+    # (f32 would drift past 2^24 positive rows); cast at the read points
+    binary_i = (s_target > 0).astype(jnp.int32)
+    binary_t = binary_i.astype(jnp.float32)
     in_k = jnp.ones(n, dtype=bool) if top_k is None else rank <= top_k
+    in_k_i = in_k.astype(jnp.int32)
 
     def segcumsum(v):  # within-segment cumsum, v >= 0 (see _segment_cumsum_nonneg)
         return _segment_cumsum_nonneg(v, new_seg)
 
-    cum_rel_k = segcumsum(binary_t * in_k.astype(jnp.float32))
-    cum_rel = cum_rel_k if top_k is None else segcumsum(binary_t)
+    cum_rel_k = segcumsum(binary_i * in_k_i).astype(jnp.float32)
+    cum_rel = cum_rel_k if top_k is None else segcumsum(binary_i).astype(jnp.float32)
     n_pos = jnp.where(is_last, cum_rel, 0.0)
     valid = is_last & (s_idx >= 0)
 
     if metric == "fall_out":
-        nonrel = 1.0 - binary_t
-        cum_nonrel_k = segcumsum(nonrel * in_k.astype(jnp.float32))
-        cum_nonrel = cum_nonrel_k if top_k is None else segcumsum(nonrel)
+        nonrel = 1 - binary_i
+        cum_nonrel_k = segcumsum(nonrel * in_k_i).astype(jnp.float32)
+        cum_nonrel = cum_nonrel_k if top_k is None else segcumsum(nonrel).astype(jnp.float32)
         n_neg = jnp.where(is_last, cum_nonrel, 0.0)
         scores = jnp.where(is_last & (n_neg > 0), cum_nonrel_k / jnp.maximum(n_neg, 1.0), 0.0)
         return scores, n_neg, valid  # n_positive slot carries negatives for empty handling
@@ -186,16 +196,28 @@ def grouped_retrieval_scores(
 ) -> Tuple[Array, Array, Array]:
     """Per-query scores for every query in one fused device pass.
 
-    Returns ``(scores, n_positive, valid)`` each of length N (upper bound on number
-    of queries); only entries where ``valid`` is True correspond to real queries.
-    ``n_positive`` is the per-query count of positive targets (used by the caller
-    for ``empty_target_action`` handling; for ``fall_out`` it counts negatives).
+    Returns ``(scores, n_positive, valid)`` each of length N (the padded row
+    count, an upper bound on the number of queries); only entries where
+    ``valid`` is True are real queries. ``n_positive`` is the per-query count of
+    positive targets (used by the caller for ``empty_target_action`` handling;
+    for ``fall_out`` it counts negatives).
 
-    Scan-friendly metrics take the scatter-free path (``_scan_retrieval_scores``,
-    results row-aligned at segment-last rows); ndcg (summands may be negative
-    for float targets, breaking the cummax base trick) and r_precision (needs a
-    per-row broadcast of the segment total, i.e. future information) keep the
-    segment-reduction layout below.
+    ALIGNMENT CONTRACT — the three arrays are mutually aligned, but WHERE a
+    query's entry sits depends on the metric's path:
+
+    - scan metrics (``_SCAN_METRICS``) return ROW-aligned results: a query's
+      score/n_positive/valid live at its LAST row in (query, -score) sort order,
+      every other row holds 0 / False;
+    - ``ndcg`` and ``r_precision`` return SEGMENT-aligned results: entry ``s``
+      is the ``s``-th distinct query in sorted order, trailing slots are 0/False.
+
+    Both shapes are length N and support only position-agnostic consumption
+    (masked reductions over ``valid``, e.g. ``scores.sum() / valid.sum()``).
+    Do NOT slice a prefix (``scores[:n_queries]``) or otherwise assume one of
+    the two layouts. Scan metrics avoid every scatter this way; ndcg (summands
+    may be negative for float targets, breaking the cummax base trick) and
+    r_precision (needs a per-row broadcast of the segment total, i.e. future
+    information) keep the segment-reduction layout below.
     """
     if metric in _SCAN_METRICS:
         return _scan_retrieval_scores(indexes, preds, target, metric, top_k, adaptive_k)

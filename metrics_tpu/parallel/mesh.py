@@ -73,6 +73,19 @@ def _lists_to_buffers(metric, state0, batches, n_devices: int):
             # query group instead of silently joining query 0 (the probe only
             # supplies shape/dtype defaults)
             _, decl_dtype, decl_fill = getattr(metric, "_cat_meta", {}).get(name, ((), None, 0))
+            if decl_dtype is not None and item.dtype != jnp.dtype(decl_dtype):
+                # CatBuffer.append casts appended values to the declared dtype
+                # (core/state.py), so a WIDENING mismatch (e.g. NDCG's integer
+                # relevance grades into its declared float32 target state) is
+                # fine; only a lossy cast (float values into an int state) is a
+                # bug worth failing fast on, with the state named rather than an
+                # opaque error later
+                if jnp.result_type(item.dtype, decl_dtype) != jnp.dtype(decl_dtype):
+                    raise ValueError(
+                        f"cat state `{name}` declares dtype {jnp.dtype(decl_dtype).name} but the"
+                        f" probe update appended {item.dtype.name}, which the buffer would cast"
+                        " lossily; fix the metric's add_state declaration or the update's cast"
+                    )
             out[name] = CatBuffer.create(
                 rows_per_batch * len(batches), item.shape[1:], decl_dtype or item.dtype, decl_fill
             )
