@@ -60,6 +60,9 @@ class BinaryConfusionMatrix(_ConfusionMatrixPlotMixin, Metric):
     higher_is_better: Optional[bool] = None
     full_state_update: bool = False
 
+    # update-relevant ctor args (static compute-group signature; see core/metric.py)
+    _update_signature_attrs = ("threshold", "ignore_index")
+
     def __init__(
         self,
         threshold: float = 0.5,
@@ -106,6 +109,9 @@ class MulticlassConfusionMatrix(_ConfusionMatrixPlotMixin, Metric):
     is_differentiable: bool = False
     higher_is_better: Optional[bool] = None
     full_state_update: bool = False
+
+    # update-relevant ctor args (static compute-group signature; see core/metric.py)
+    _update_signature_attrs = ("num_classes", "ignore_index")
 
     def __init__(
         self,
@@ -158,6 +164,9 @@ class MultilabelConfusionMatrix(_ConfusionMatrixPlotMixin, Metric):
     is_differentiable: bool = False
     higher_is_better: Optional[bool] = None
     full_state_update: bool = False
+
+    # update-relevant ctor args (static compute-group signature; see core/metric.py)
+    _update_signature_attrs = ("num_labels", "threshold", "ignore_index")
 
     def __init__(
         self,

@@ -92,6 +92,9 @@ class BinaryPrecisionRecallCurve(_PrecisionRecallCurvePlotMixin, Metric):
     higher_is_better: Optional[bool] = None
     full_state_update: bool = False
 
+    # update-relevant ctor args (static compute-group signature; see core/metric.py)
+    _update_signature_attrs = ("thresholds", "ignore_index")
+
     def __init__(
         self,
         thresholds: Optional[Union[int, List[float], Array]] = None,
@@ -138,6 +141,9 @@ class MulticlassPrecisionRecallCurve(_PrecisionRecallCurvePlotMixin, Metric):
     is_differentiable: bool = False
     higher_is_better: Optional[bool] = None
     full_state_update: bool = False
+
+    # update-relevant ctor args (static compute-group signature; see core/metric.py)
+    _update_signature_attrs = ("num_classes", "thresholds", "ignore_index")
 
     def __init__(
         self,
@@ -188,6 +194,9 @@ class MultilabelPrecisionRecallCurve(_PrecisionRecallCurvePlotMixin, Metric):
     is_differentiable: bool = False
     higher_is_better: Optional[bool] = None
     full_state_update: bool = False
+
+    # update-relevant ctor args (static compute-group signature; see core/metric.py)
+    _update_signature_attrs = ("num_labels", "thresholds", "ignore_index")
 
     def __init__(
         self,

@@ -81,6 +81,9 @@ class BinaryStatScores(_AbstractStatScores):
     higher_is_better: Optional[bool] = None
     full_state_update: bool = False
 
+    # update-relevant ctor args (static compute-group signature; see core/metric.py)
+    _update_signature_attrs = ("threshold", "multidim_average", "ignore_index")
+
     def __init__(
         self,
         threshold: float = 0.5,
@@ -128,6 +131,9 @@ class MulticlassStatScores(_AbstractStatScores):
     is_differentiable: bool = False
     higher_is_better: Optional[bool] = None
     full_state_update: bool = False
+
+    # update-relevant ctor args (static compute-group signature; see core/metric.py)
+    _update_signature_attrs = ("num_classes", "top_k", "average", "multidim_average", "ignore_index")
 
     def __init__(
         self,
@@ -185,6 +191,9 @@ class MultilabelStatScores(_AbstractStatScores):
     is_differentiable: bool = False
     higher_is_better: Optional[bool] = None
     full_state_update: bool = False
+
+    # update-relevant ctor args (static compute-group signature; see core/metric.py)
+    _update_signature_attrs = ("num_labels", "threshold", "multidim_average", "ignore_index")
 
     def __init__(
         self,

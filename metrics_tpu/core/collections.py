@@ -10,6 +10,7 @@ updates rebind attributes, so member states are re-pointed at the group leader's
 current state after every update — same observable semantics, same single-update
 saving.
 """
+import os
 from collections import OrderedDict
 from copy import deepcopy
 from typing import Any, Dict, Hashable, Iterable, List, Optional, Sequence, Tuple, Union
@@ -56,6 +57,10 @@ class MetricCollection:
         self._enable_compute_groups = compute_groups
         self._groups_checked: bool = False
         self._state_is_copy: bool = False
+        self._validate_groups_runtime: bool = os.environ.get(
+            "METRICS_TPU_VALIDATE_COMPUTE_GROUPS", ""
+        ) not in ("", "0")
+        self._groups_validated: bool = False
 
         self.add_metrics(metrics, *additional_metrics)
 
@@ -66,6 +71,17 @@ class MetricCollection:
 
     def __setitem__(self, key: str, value: Metric) -> None:
         self._modules[key] = value
+        # keep groups in sync with direct assignment: with static groups the
+        # leader-only update fast path applies from the first update, so a
+        # metric outside every group would silently never be updated.
+        # add_metrics assigns in a loop and re-derives ONCE at the end
+        # (_in_add_metrics guard), so bulk adds stay one O(n^2) pass.
+        if (
+            getattr(self, "_groups_checked", False)
+            and not getattr(self, "_in_add_metrics", False)
+            and not isinstance(self._enable_compute_groups, list)
+        ):
+            self._init_compute_groups()
 
     def __len__(self) -> int:
         return len(self._modules)
@@ -85,8 +101,20 @@ class MetricCollection:
         return self.forward(*args, **kwargs)
 
     def update(self, *args: Any, **kwargs: Any) -> None:
-        """Update each metric (only group leaders after groups form; reference :185-210)."""
+        """Update each metric (only group leaders once groups exist; reference :185-210).
+
+        With static groups (derived at ``add_metrics`` time) the leader-only fast
+        path applies from the FIRST update — the reference instead updates every
+        member once and runs its O(n^2) device data-compare before grouping kicks
+        in (collections.py:185-243). Set ``METRICS_TPU_VALIDATE_COMPUTE_GROUPS=1``
+        to re-enable that data-compare as a first-update validation pass that
+        warns when it disagrees with the static derivation.
+        """
         if self._groups_checked:
+            if self._validate_groups_runtime and not self._groups_validated:
+                self._validate_groups_against_runtime(*args, **kwargs)
+                return
+            self._split_diverged_members()
             for cg in self._groups.values():
                 m0 = self._modules[cg[0]]
                 m0.update(*args, **m0._filter_kwargs(**kwargs))
@@ -96,13 +124,191 @@ class MetricCollection:
         else:
             for _, m in self.items(keep_base=True, copy_state=False):
                 m.update(*args, **m._filter_kwargs(**kwargs))
-            if self._enable_compute_groups:
-                self._merge_compute_groups()
-                self._compute_groups_create_state_ref()
-                self._groups_checked = True
 
-    def _merge_compute_groups(self) -> None:
-        """O(n^2) state-equality merge (reference: collections.py:210-243)."""
+    def _split_diverged_members(self) -> None:
+        """Give a member its own group when its state no longer aliases the leader's.
+
+        A direct ``mc['name'].update(...)`` between collection updates rebinds that
+        member's state attrs (jax arrays are immutable), so a cheap identity check
+        detects it; re-pointing such a member at the leader would silently drop its
+        extra batches. Skipped while states are access copies (``_state_is_copy``),
+        where the reference shares the same lose-the-copy semantics.
+        """
+        if self._state_is_copy:
+            return
+        new_groups: List[List[str]] = []
+        for cg in self._groups.values():
+            kept = [cg[0]]
+            m0 = self._modules[cg[0]]
+            for name in cg[1:]:
+                mi = self._modules[name]
+                diverged = mi._update_count != m0._update_count or any(
+                    getattr(mi, s) is not getattr(m0, s) for s in m0._defaults
+                )
+                if diverged:
+                    new_groups.append([name])
+                else:
+                    kept.append(name)
+            new_groups.append(kept)
+        if len(new_groups) != len(self._groups):
+            self._groups = dict(enumerate(new_groups))
+
+    # ------------------------------------------------- static compute groups
+
+    _GROUP_IRRELEVANT_ATTRS = frozenset(
+        {
+            # runtime/sync knobs: they never change the update state transition
+            "compute_on_cpu", "dist_sync_on_step", "process_group", "dist_sync_fn",
+            "distributed_available_fn", "sync_on_compute", "validate_args",
+        }
+    )
+
+    def _static_merge_groups(self) -> None:
+        """Derive compute groups from static metric signatures (SURVEY §7(2)).
+
+        Replaces the reference's first-update O(n^2) ``allclose`` over device
+        states (collections.py:210-268) — a host-only derivation with no device
+        syncs: two metrics share a group iff they run the SAME update function
+        (class-function identity), over the SAME state schema (names, kinds,
+        shapes, dtypes, reductions), with the SAME update-relevant constructor
+        args. Families declare those args via ``Metric._update_signature_attrs``;
+        undeclared metrics fall back to comparing every non-runtime constructor
+        attribute (callables by identity), which can only produce false SPLITS
+        (lost sharing), never false merges.
+        """
+        keys = list(self._groups)
+        for i, k1 in enumerate(keys):
+            if k1 not in self._groups:
+                continue
+            for k2 in keys[i + 1 :]:
+                if k2 not in self._groups:
+                    continue
+                m1 = self._modules[self._groups[k1][0]]
+                m2 = self._modules[self._groups[k2][0]]
+                if self._same_update_signature(m1, m2):
+                    self._groups[k1].extend(self._groups.pop(k2))
+        self._groups = dict(enumerate(self._groups.values()))
+
+    @classmethod
+    def _same_update_signature(cls, m1: Metric, m2: Metric) -> bool:
+        # only FRESH metrics may merge: group members share state by reference,
+        # so merging a metric that already accumulated updates (pre-updated at
+        # construction, or added via __setitem__ after updates) would overwrite
+        # one side's history with the other's. The reference's data-compare
+        # could never merge unequal states; unequal update counts are the
+        # static-side conservative equivalent.
+        if m1._update_count != 0 or m2._update_count != 0:
+            return False
+        upd1 = cls._update_owner(type(m1))
+        upd2 = cls._update_owner(type(m2))
+        if upd1 is None or upd1[1] is not upd2[1]:  # same update code object required
+            return False
+        if not cls._same_state_schema(m1, m2):
+            return False
+        declared = cls._declared_signature_attrs(type(m1), upd1[0])
+        if declared is not None and declared == cls._declared_signature_attrs(type(m2), upd2[0]):
+            names1 = names2 = declared
+        else:
+            # conservative fallback: every constructor attribute that is not a
+            # runtime knob or a state. Key sets must match exactly.
+            names1 = cls._fallback_signature_attrs(m1)
+            names2 = cls._fallback_signature_attrs(m2)
+            if names1 != names2:
+                return False
+        for name in names1:
+            if not cls._attr_equal(getattr(m1, name, None), getattr(m2, name, None)):
+                return False
+        return True
+
+    @staticmethod
+    def _update_owner(klass):
+        """(defining class, function) for ``update``, walking the MRO."""
+        for c in klass.__mro__:
+            if "update" in c.__dict__:
+                return c, c.__dict__["update"]
+        return None
+
+    @staticmethod
+    def _declared_signature_attrs(klass, update_owner):
+        """A ``_update_signature_attrs`` declaration, valid only if it comes from
+        the update-defining class or one of its subclasses (a subclass that
+        overrides ``update`` without re-declaring falls back to conservative)."""
+        for c in klass.__mro__:
+            if "_update_signature_attrs" in c.__dict__:
+                decl = c.__dict__["_update_signature_attrs"]
+                if decl is None:
+                    return None
+                return decl if issubclass(c, update_owner) or c is update_owner else None
+        return None
+
+    @classmethod
+    def _fallback_signature_attrs(cls, m: Metric):
+        return tuple(
+            sorted(
+                k
+                for k in vars(m)
+                if not k.startswith("_") and k not in m._defaults and k not in cls._GROUP_IRRELEVANT_ATTRS
+            )
+        )
+
+    @staticmethod
+    def _same_state_schema(m1: Metric, m2: Metric) -> bool:
+        if len(m1._defaults) == 0 or m1._defaults.keys() != m2._defaults.keys():
+            return False
+        for key in m1._defaults:
+            d1, d2 = m1._defaults[key], m2._defaults[key]
+            if type(d1) != type(d2):
+                return False
+            if isinstance(d1, (jnp.ndarray, np.ndarray)) and (d1.shape != d2.shape or d1.dtype != d2.dtype):
+                return False
+            r1, r2 = m1._reductions.get(key), m2._reductions.get(key)
+            if r1 is not r2 and r1 != r2:
+                return False
+            if m1._cat_meta.get(key) != m2._cat_meta.get(key):
+                return False
+        return True
+
+    @staticmethod
+    def _attr_equal(a, b) -> bool:
+        if a is b:
+            return True
+        if type(a) != type(b):
+            return False
+        if isinstance(a, (jnp.ndarray, np.ndarray)):
+            return a.shape == b.shape and bool(np.array_equal(np.asarray(a), np.asarray(b)))
+        if callable(a):
+            return False  # identity already failed; unequal objects stay split
+        try:
+            return bool(a == b)
+        except Exception:  # noqa: BLE001 — incomparable values must split, not crash
+            return False
+
+    def _validate_groups_against_runtime(self, *args: Any, **kwargs: Any) -> None:
+        """Debug path: run the reference's data-compare merge once and diff it
+        against the static groups (enabled by METRICS_TPU_VALIDATE_COMPUTE_GROUPS)."""
+        for _, m in self.items(keep_base=True, copy_state=False):
+            m.update(*args, **m._filter_kwargs(**kwargs))
+        static_groups = {i: list(v) for i, v in self._groups.items()}
+        self._groups = {i: [str(k)] for i, k in enumerate(self._modules.keys())}
+        self._runtime_merge_compute_groups()
+        runtime_partition = {frozenset(v) for v in self._groups.values()}
+        static_partition = {frozenset(v) for v in static_groups.values()}
+        if runtime_partition != static_partition:
+            rank_zero_warn(
+                "Static compute groups disagree with the runtime state comparison:"
+                f" static={sorted(map(sorted, static_partition))} vs"
+                f" runtime={sorted(map(sorted, runtime_partition))}. The static"
+                " derivation is conservative-correct; report this if the runtime"
+                " partition is coarser than expected."
+            )
+        self._groups = static_groups
+        self._groups_validated = True
+        self._state_is_copy = False
+        self._compute_groups_create_state_ref()
+
+    def _runtime_merge_compute_groups(self) -> None:
+        """The reference's O(n^2) state-equality merge (collections.py:210-243);
+        kept as the validation oracle for the static derivation."""
         n_groups = len(self._groups)
         while True:
             for cg_idx1, cg_members1 in deepcopy(self._groups).items():
@@ -235,6 +441,15 @@ class MetricCollection:
         self, metrics: Union[Metric, Sequence[Metric], Dict[str, Metric]], *additional_metrics: Metric
     ) -> None:
         """Reference: collections.py:323-383 (incl. nesting flattening)."""
+        self._in_add_metrics = True
+        try:
+            self._add_metrics_impl(metrics, *additional_metrics)
+        finally:
+            self._in_add_metrics = False
+
+    def _add_metrics_impl(
+        self, metrics: Union[Metric, Sequence[Metric], Dict[str, Metric]], *additional_metrics: Metric
+    ) -> None:
         if isinstance(metrics, Metric):
             metrics = [metrics]
         if isinstance(metrics, Sequence):
@@ -289,7 +504,14 @@ class MetricCollection:
             self._groups = {}
 
     def _init_compute_groups(self) -> None:
-        """Reference: collections.py:385-409."""
+        """Reference: collections.py:385-409 — but groups form HERE, statically.
+
+        The reference postpones grouping to the first update so it can compare
+        state values; the static signature (update function + state schema +
+        update-relevant ctor args) needs no data, so the leader-only update fast
+        path applies from the very first batch and the first hot-loop step runs
+        no device ``allclose`` compares.
+        """
         if isinstance(self._enable_compute_groups, list):
             self._groups = dict(enumerate(self._enable_compute_groups))
             for v in self._groups.values():
@@ -302,6 +524,10 @@ class MetricCollection:
             self._groups_checked = True
         else:
             self._groups = {i: [str(k)] for i, k in enumerate(self._modules.keys())}
+            self._static_merge_groups()
+            self._groups_checked = True
+            self._groups_validated = False
+            self._compute_groups_create_state_ref()
 
     @property
     def compute_groups(self) -> Dict[int, List[str]]:

@@ -32,7 +32,7 @@ import warnings
 from abc import ABC, abstractmethod
 from contextlib import contextmanager
 from copy import deepcopy
-from typing import Any, Callable, Dict, Generator, List, Optional, Sequence, Union
+from typing import Any, Callable, Dict, Generator, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -95,6 +95,13 @@ class Metric(ABC):
     plot_lower_bound: Optional[float] = None
     plot_upper_bound: Optional[float] = None
     plot_legend_name: Optional[str] = None
+    # names of the constructor attributes that determine the UPDATE state
+    # transition (not compute-only knobs). Declared on the class that defines
+    # ``update`` so MetricCollection can derive compute groups statically at
+    # add_metrics time instead of the reference's first-update device data
+    # compare (collections.py:210-268; SURVEY §7(2)). None -> the collection
+    # falls back to a conservative full-attribute comparison.
+    _update_signature_attrs: Optional[Tuple[str, ...]] = None
 
     def __init__(self, **kwargs: Any) -> None:
         self._device = None  # lazy: jax default device

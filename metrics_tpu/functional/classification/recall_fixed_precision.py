@@ -22,6 +22,8 @@ from metrics_tpu.functional.classification.precision_recall_curve import (
     _multilabel_precision_recall_curve_tensor_validation,
     _multilabel_precision_recall_curve_update,
 )
+from metrics_tpu.utils.checks import _is_concrete
+from metrics_tpu.utils.compute import _smallest_f32_at_least
 from metrics_tpu.utils.enums import ClassificationTask
 
 
@@ -30,17 +32,34 @@ def _lexicographic_best(
 ) -> Tuple[Array, Array]:
     """max over (primary, secondary, threshold) tuples where secondary >= min_secondary.
 
-    Host-side selection at compute time, mirroring the reference's
+    Eager path: host-side selection mirroring the reference's
     ``max((r, p, t) for ... if p >= min_precision)`` (recall_fixed_precision.py:40-55).
+    Traced path: the same lexicographic max expressed branchlessly — a cascade of
+    masked maxes (best primary, then best secondary among primary-ties, then best
+    threshold among (primary, secondary)-ties) — so the fixed-point metrics
+    (recall@precision / precision@recall / specificity@sensitivity) compute inside
+    jit/shard_map. Values on both paths live on the f32 grid, so the comparisons
+    (including ``>= min_secondary`` against the f64 constant) decide identically.
     """
-    import jax.core
+    if not _is_concrete(primary, secondary, thresholds):
+        n = min(primary.shape[0], secondary.shape[0], thresholds.shape[0])
+        p, s, t = primary[:n], secondary[:n], thresholds[:n]
+        cutoff = _smallest_f32_at_least(min_secondary)  # f64-equivalent compare on the f32 grid
+        # padded exact-mode curves mark their pad rows with NaN thresholds; the
+        # host path never sees pad rows, so they must not qualify here either
+        ok = (s >= cutoff) & ~jnp.isnan(t)
+        neg = -jnp.inf
+        best_p = jnp.max(jnp.where(ok, p, neg), initial=neg)
+        tie_p = ok & (p == best_p)
+        best_s = jnp.max(jnp.where(tie_p, s, neg), initial=neg)
+        best_t = jnp.max(jnp.where(tie_p & (s == best_s), t, neg), initial=neg)
+        any_ok = jnp.any(ok)
+        best_primary = jnp.where(any_ok, best_p, 0.0).astype(jnp.float32)
+        best_threshold = jnp.where(any_ok, best_t, 0.0).astype(jnp.float32)
+        # the reference pins the threshold to 1e6 whenever the best value is 0
+        best_threshold = jnp.where(best_primary == 0.0, jnp.float32(1e6), best_threshold)
+        return best_primary, best_threshold
 
-    if any(isinstance(x, jax.core.Tracer) for x in (primary, secondary, thresholds)):
-        raise NotImplementedError(
-            "fixed-point metrics (recall@precision / precision@recall /"
-            " specificity@sensitivity) select their operating point with host-side"
-            " numpy and are eager-only; call compute outside jit"
-        )
     p = np.asarray(primary, dtype=np.float64)
     s = np.asarray(secondary, dtype=np.float64)
     t = np.asarray(thresholds, dtype=np.float64)
@@ -139,9 +158,9 @@ def _multiclass_recall_at_fixed_precision_arg_compute(
         # binned: one shared 1-D threshold grid for every class
         res = [reduce_fn(p, r, thresholds, min_precision) for p, r in zip(precision, recall)]
     else:
-        # exact: per-class threshold rows — lists eagerly, stacked 2-D when the
-        # curve came from the jit path (the reduce itself is host-side numpy, so
-        # fixed-point metrics stay eager-only; the guard keeps rows paired right)
+        # exact: per-class threshold rows — lists eagerly, stacked 2-D from the
+        # jit path (the guard keeps rows paired with their class's thresholds;
+        # the reduce runs branchlessly on device when traced, host numpy when not)
         res = [reduce_fn(p, r, t, min_precision) for p, r, t in zip(precision, recall, thresholds)]
     return jnp.stack([r[0] for r in res]), jnp.stack([r[1] for r in res])
 
@@ -195,9 +214,9 @@ def _multilabel_recall_at_fixed_precision_arg_compute(
         # binned: one shared 1-D threshold grid for every class
         res = [reduce_fn(p, r, thresholds, min_precision) for p, r in zip(precision, recall)]
     else:
-        # exact: per-class threshold rows — lists eagerly, stacked 2-D when the
-        # curve came from the jit path (the reduce itself is host-side numpy, so
-        # fixed-point metrics stay eager-only; the guard keeps rows paired right)
+        # exact: per-class threshold rows — lists eagerly, stacked 2-D from the
+        # jit path (the guard keeps rows paired with their class's thresholds;
+        # the reduce runs branchlessly on device when traced, host numpy when not)
         res = [reduce_fn(p, r, t, min_precision) for p, r, t in zip(precision, recall, thresholds)]
     return jnp.stack([r[0] for r in res]), jnp.stack([r[1] for r in res])
 

@@ -6,6 +6,7 @@ Shares the PR-curve state (binned (T,2,2) confusion tensor or raw scores).
 """
 from typing import List, Optional, Tuple, Union
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import Array
@@ -25,6 +26,7 @@ from metrics_tpu.functional.classification.precision_recall_curve import (
     _multilabel_precision_recall_curve_tensor_validation,
     _multilabel_precision_recall_curve_update,
 )
+from metrics_tpu.utils.checks import _is_concrete
 from metrics_tpu.utils.compute import _safe_divide
 from metrics_tpu.utils.enums import ClassificationTask
 from metrics_tpu.utils.prints import rank_zero_warn
@@ -48,6 +50,15 @@ def _binary_roc_compute(
         tpr = jnp.flip(_safe_divide(tps, tps + fns), 0)
         fpr = jnp.flip(_safe_divide(fps, fps + tns), 0)
         thresholds = jnp.flip(thresholds, 0)
+        return fpr, tpr, thresholds
+
+    if not _is_concrete(state[0]) or not _is_concrete(state[1]):
+        # under jit: static-shape padded device ROC (ops/clf_curve.py); the
+        # first K rows are the reference curve, pads carry NaN thresholds
+        from metrics_tpu.ops.clf_curve import binary_roc_curve_padded
+
+        target = state[1] if pos_label == 1 else jnp.where(state[1] >= 0, (state[1] == pos_label).astype(jnp.int32), -1)
+        fpr, tpr, thresholds, _ = binary_roc_curve_padded(state[0], target)
         return fpr, tpr, thresholds
 
     _p, _t = np.asarray(state[0]), np.asarray(state[1])
@@ -111,6 +122,18 @@ def _multiclass_roc_compute(
         fpr = jnp.flip(_safe_divide(fps, fps + tns), 0).T
         thresholds = jnp.flip(thresholds, 0)
         return fpr, tpr, thresholds
+    if not _is_concrete(state[0]) or not _is_concrete(state[1]):
+        # jit: one vmapped padded ROC kernel over the class axis (same shape
+        # contract as the PR-curve traced branch)
+        from metrics_tpu.ops.clf_curve import binary_roc_curve_padded
+
+        def one_class(preds_c: Array, c: Array):
+            target_c = jnp.where(state[1] >= 0, (state[1] == c).astype(jnp.int32), -1)
+            return binary_roc_curve_padded(preds_c, target_c)
+
+        fpr, tpr, thr, _ = jax.vmap(one_class, in_axes=(1, 0))(state[0], jnp.arange(num_classes))
+        return fpr, tpr, thr
+
     fpr, tpr, thresholds_out = [], [], []
     for i in range(num_classes):
         res = _binary_roc_compute((state[0][:, i], state[1]), thresholds=None, pos_label=i)
@@ -155,6 +178,14 @@ def _multilabel_roc_compute(
         fpr = jnp.flip(_safe_divide(fps, fps + tns), 0).T
         thresholds = jnp.flip(thresholds, 0)
         return fpr, tpr, thresholds
+    if not _is_concrete(state[0]) or not _is_concrete(state[1]):
+        # jit: one vmapped padded ROC kernel over labels; target<0 rows
+        # (ignore_index masks and buffer padding) are excluded by the kernel
+        from metrics_tpu.ops.clf_curve import binary_roc_curve_padded
+
+        fpr, tpr, thr, _ = jax.vmap(binary_roc_curve_padded, in_axes=(1, 1))(state[0], state[1])
+        return fpr, tpr, thr
+
     fpr, tpr, thresholds_out = [], [], []
     for i in range(num_labels):
         preds_i = np.asarray(state[0][:, i])

@@ -24,6 +24,8 @@ from metrics_tpu.functional.classification.roc import (
     _multiclass_roc_compute,
     _multilabel_roc_compute,
 )
+from metrics_tpu.utils.checks import _is_concrete
+from metrics_tpu.utils.compute import _smallest_f32_at_least
 from metrics_tpu.utils.enums import ClassificationTask
 
 
@@ -38,7 +40,26 @@ def _specificity_at_sensitivity(
     thresholds: Array,
     min_sensitivity: float,
 ) -> Tuple[Array, Array]:
-    """Max specificity with sensitivity >= min (reference: specificity_sensitivity.py:47-70)."""
+    """Max specificity with sensitivity >= min (reference: specificity_sensitivity.py:47-70).
+
+    Unlike the recall/precision fixed-point reduce, the reference picks the FIRST
+    argmax row (no lexicographic threshold tie-break) and applies the 1e6 sentinel
+    only when no row qualifies. The traced branch reproduces exactly that with a
+    masked argmax (jnp.argmax also returns the first maximum), so the metric
+    computes inside jit; eager keeps the host numpy selection.
+    """
+    if not _is_concrete(specificity, sensitivity, thresholds):
+        cutoff = _smallest_f32_at_least(min_sensitivity)  # f64-equivalent compare on the f32 grid
+        # NaN thresholds mark pad rows of the padded exact-mode curves; the host
+        # path never sees pad rows, so they must not qualify here either
+        ok = (sensitivity >= cutoff) & ~jnp.isnan(thresholds)
+        masked = jnp.where(ok, specificity, -jnp.inf)
+        idx = jnp.argmax(masked)  # first max among qualifying rows, original order
+        any_ok = jnp.any(ok)
+        best_spec = jnp.where(any_ok, specificity[idx], 0.0).astype(jnp.float32)
+        best_thr = jnp.where(any_ok, thresholds[idx], jnp.float32(1e6)).astype(jnp.float32)
+        return best_spec, best_thr
+
     spec = np.asarray(specificity, dtype=np.float64)
     sens = np.asarray(sensitivity, dtype=np.float64)
     thr = np.asarray(thresholds, dtype=np.float64)
@@ -121,7 +142,9 @@ def _multiclass_specificity_at_sensitivity_compute(
 ) -> Tuple[Array, Array]:
     """Reference: specificity_sensitivity.py:184-201."""
     fpr, sensitivity, thresholds = _multiclass_roc_compute(state, num_classes, thresholds)
-    if isinstance(fpr, list):
+    if isinstance(fpr, list) or getattr(thresholds, "ndim", 1) == 2:
+        # per-class threshold rows: lists eagerly, stacked 2-D from the exact-mode
+        # jit path (same pairing guard as recall_fixed_precision.py)
         specificity = [_convert_fpr_to_specificity(f) for f in fpr]
         res = [
             _specificity_at_sensitivity(sp, sn, t, min_sensitivity)
@@ -178,7 +201,9 @@ def _multilabel_specificity_at_sensitivity_compute(
 ) -> Tuple[Array, Array]:
     """Reference: specificity_sensitivity.py:302-320."""
     fpr, sensitivity, thresholds = _multilabel_roc_compute(state, num_labels, thresholds, ignore_index)
-    if isinstance(fpr, list):
+    if isinstance(fpr, list) or getattr(thresholds, "ndim", 1) == 2:
+        # per-label threshold rows: lists eagerly, stacked 2-D from the exact-mode
+        # jit path (same pairing guard as recall_fixed_precision.py)
         specificity = [_convert_fpr_to_specificity(f) for f in fpr]
         res = [
             _specificity_at_sensitivity(sp, sn, t, min_sensitivity)

@@ -196,6 +196,52 @@ def binary_precision_recall_curve_padded(
     return _binary_curve_padded_j(preds, target, valid)
 
 
+def _binary_roc_padded_kernel(preds: Array, target: Array, valid: Array) -> Tuple[Array, Array, Array, Array]:
+    """Static-shape exact ROC: (fpr (N+1,), tpr (N+1,), thresholds (N+1,), K).
+
+    Matches the eager host layout: descending thresholds with a prepended
+    (0, 0, 1.0) origin row; the first K entries are exact, pads repeat the
+    terminal point and carry NaN thresholds (consumers exclude NaN-threshold
+    rows, mirroring how the host path never sees pad rows). Degenerate
+    single-class data zeroes the missing rate, as the host path does.
+    """
+    n = preds.shape[0]
+    fps, tps, sk, run_boundary = _run_end_counts(preds, target, valid)
+    finite = sk != -jnp.inf  # exclude the invalid-row terminal run
+    boundary = run_boundary & finite
+    pos = tps[-1]
+    neg = fps[-1]
+    tpr_all = jnp.where(pos > 0, tps.astype(jnp.float32) / jnp.maximum(pos, 1), 0.0)
+    fpr_all = jnp.where(neg > 0, fps.astype(jnp.float32) / jnp.maximum(neg, 1), 0.0)
+    # front-pack run-end points, keeping the descending-threshold order
+    order = jnp.argsort(~boundary, stable=True)
+    tprp = jnp.take(tpr_all, order)
+    fprp = jnp.take(fpr_all, order)
+    thrp = jnp.take(sk, order)
+    k = boundary.sum()
+    idx = jnp.arange(n)
+    zero = jnp.zeros((1,), jnp.float32)
+    one = jnp.ones((1,), jnp.float32)
+    fpr = jnp.concatenate([zero, jnp.where(idx < k, fprp, jnp.where(neg > 0, 1.0, 0.0))])
+    tpr = jnp.concatenate([zero, jnp.where(idx < k, tprp, jnp.where(pos > 0, 1.0, 0.0))])
+    thresholds = jnp.concatenate([one, jnp.where(idx < k, thrp, jnp.nan)])
+    return fpr, tpr, thresholds, k + 1
+
+
+_binary_roc_padded_j = jax.jit(_binary_roc_padded_kernel)
+
+
+def binary_roc_curve_padded(preds: Array, target: Array) -> Tuple[Array, Array, Array, Array]:
+    """Exact (``thresholds=None``) ROC curve fully on device with static shapes.
+
+    The jit-path sibling of :func:`binary_precision_recall_curve_padded`;
+    ``target`` entries < 0 (ignore_index masks / buffer padding) are excluded.
+    Returns ``(fpr, tpr, thresholds, valid_count)``.
+    """
+    preds, target, valid = _pad_binary(preds, target)
+    return _binary_roc_padded_j(preds, target, valid)
+
+
 def binary_auroc_exact(preds: Array, target: Array, max_fpr: Optional[float] = None) -> Array:
     """Exact (``thresholds=None``) binary AUROC fully on device.
 

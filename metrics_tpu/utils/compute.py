@@ -8,6 +8,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import Array
 
 
@@ -85,3 +86,18 @@ def auc(x: Array, y: Array, reorder: bool = False) -> Array:
     if x.shape[0] != y.shape[0]:
         raise ValueError("x and y must have the same length")
     return _auc_compute(x, y, reorder=reorder)
+
+
+def _smallest_f32_at_least(value: float) -> np.float32:
+    """The smallest float32 >= ``value`` (a float64 constant).
+
+    Used by the traced fixed-point reduces: the eager tier compares f32 curve
+    values against the f64 cutoff, and since every curve value lives on the f32
+    grid, ``v_f64 >= cutoff`` is equivalent to the f32 compare against this
+    rounded-UP cutoff (a plain ``np.float32(0.7)`` rounds DOWN and would admit
+    rows the eager path excludes).
+    """
+    cutoff = np.float32(value)
+    if float(cutoff) < value:
+        cutoff = np.nextafter(cutoff, np.float32(np.inf), dtype=np.float32)
+    return cutoff

@@ -3,10 +3,18 @@
 Capability parity with reference ``wrappers/bootstrapping.py`` (_bootstrap_sampler
 :30-50, BootStrapper :53-200): N copies of a base metric, each update resamples the
 batch with replacement; compute returns mean/std/quantile/raw.
+
+TPU-first pure tier (round 5): instead of the reference's N eager deepcopies fed
+in a Python loop, ``init_state``/``local_update``/``compute_from`` carry ONE
+stacked ``(num_bootstraps, ...)`` state pytree, resample on device with the jax
+PRNG (key carried in the state) and run the base metric's ``local_update`` vmapped
+over the bootstrap axis — all N bootstrap replicas cost one fused device program
+under jit/shard_map, making bootstrap confidence intervals nearly free on device.
 """
 from copy import deepcopy
 from typing import Any, Dict, Optional, Sequence, Union
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import Array
@@ -59,9 +67,13 @@ class BootStrapper(Metric):
         quantile: Optional[Union[float, Array]] = None,
         raw: bool = False,
         sampling_strategy: str = "poisson",
+        seed: Optional[int] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
+        # `seed` is additive over the reference API: it makes BOTH tiers
+        # reproducible (numpy rng for eager update, PRNG key for the pure tier)
+        self._seed = seed
         if not isinstance(base_metric, Metric):
             raise ValueError(
                 f"Expected base metric to be an instance of metrics_tpu.Metric but received {base_metric}"
@@ -74,7 +86,7 @@ class BootStrapper(Metric):
         self.std = std
         self.quantile = quantile
         self.raw = raw
-        self._rng = np.random.default_rng()
+        self._rng = np.random.default_rng(seed)
 
         allowed_sampling = ("poisson", "multinomial")
         if sampling_strategy not in allowed_sampling:
@@ -121,3 +133,100 @@ class BootStrapper(Metric):
         for m in self.metrics:
             m.reset()
         super().reset()
+
+    # --------------------------------------------------- pure-functional tier
+
+    def init_state(self) -> Dict[str, Any]:
+        """One stacked ``(num_bootstraps, ...)`` base-state pytree + the PRNG key."""
+        base = self.metrics[0].init_state()
+        if any(isinstance(v, list) for v in base.values()):
+            raise ValueError(
+                "BootStrapper's pure tier needs static-shape base states; construct the"
+                " base metric with `cat_capacity` so its cat states become CatBuffers"
+            )
+        n = self.num_bootstraps
+        stacked = jax.tree_util.tree_map(lambda x: jnp.broadcast_to(jnp.asarray(x), (n,) + jnp.shape(x)), base)
+        # seed=None draws fresh entropy per init_state (mirroring the eager
+        # tier's default_rng()): a fixed fallback key would make "unseeded"
+        # wrappers byte-identical across instances and runs, silently
+        # correlating their bootstrap CIs
+        seed = self._seed if self._seed is not None else int(self._rng.integers(0, 2**63 - 1))
+        return {"key": jax.random.PRNGKey(seed), "metrics": stacked}
+
+    def _device_sample(self, key: Array, size: int) -> Array:
+        """Resample indices on device with a static output length.
+
+        multinomial == the classic bootstrap (uniform draw with replacement).
+        poisson mirrors the reference's variable-length Poisson(1) resampling as
+        closely as static shapes allow: per-row counts are realized by
+        ``repeat(..., total_repeat_length=size)`` — a draw whose total exceeds
+        ``size`` is truncated and one that falls short repeats the final row,
+        a boundary effect of O(sqrt(size))/size on the sample distribution.
+        """
+        if self.sampling_strategy == "multinomial":
+            return jax.random.randint(key, (size,), 0, size)
+        # Poisson(1) by inverse CDF over a truncated support (P(K > 16) < 1e-14):
+        # jax.random.poisson's rejection while_loop trips shard_map's varying-axis
+        # type check, and a branchless searchsorted is also faster for fixed lam=1
+        ks = jnp.arange(17)
+        log_pmf = -1.0 - jax.scipy.special.gammaln(ks + 1.0)
+        cdf = jnp.cumsum(jnp.exp(log_pmf))
+        u = jax.random.uniform(key, (size,))
+        counts = jnp.sum(u[:, None] > cdf[None, :], axis=1)
+        return jnp.repeat(jnp.arange(size), counts, total_repeat_length=size)
+
+    def local_update(self, state: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        """All bootstrap replicas in one vmapped program (device-side resampling)."""
+        array_types = (jnp.ndarray, np.ndarray)
+        sizes = apply_to_collection(args, array_types, len) or tuple(
+            apply_to_collection(kwargs, array_types, len).values()
+        )
+        sizes = jax.tree_util.tree_leaves(sizes)
+        if not sizes:
+            raise ValueError("None of the input contained tensors, so could not determine the sampling size")
+        size = int(sizes[0])
+        base = self.metrics[0]
+        key, sub = jax.random.split(state["key"])
+        keys = jax.random.split(sub, self.num_bootstraps)
+
+        def one(bstate, k):
+            idx = self._device_sample(k, size)
+            new_args = apply_to_collection(args, array_types, lambda x: jnp.take(jnp.asarray(x), idx, axis=0))
+            new_kwargs = apply_to_collection(kwargs, array_types, lambda x: jnp.take(jnp.asarray(x), idx, axis=0))
+            return base.local_update(bstate, *new_args, **new_kwargs)
+
+        return {"key": key, "metrics": jax.vmap(one)(state["metrics"], keys)}
+
+    def sync_state(self, state: Dict[str, Any], axis_name: Optional[Any] = None) -> Dict[str, Any]:
+        """Per-replica sync: the base reductions apply elementwise over the stack."""
+        base = self.metrics[0]
+        if any(kind == "cat" for kind in base._reductions.values()):
+            # an all_gather along axis 0 would interleave the bootstrap stack
+            # dimension with the mesh axis; no in-tree sum-state metric needs it
+            raise NotImplementedError(
+                "BootStrapper's pure tier cannot sync cat-reduction base states over a"
+                " mesh axis; evaluate per shard and combine computes instead"
+            )
+        key = state["key"]
+        if axis_name is not None:
+            # every device ran the same split sequence, so the keys are equal; a
+            # pmax no-op gives them the device-invariant type shard_map's
+            # out_specs=P() requires (see collective.replicate_gathered)
+            from metrics_tpu.parallel import collective
+
+            key = collective.replicate_gathered(key, axis_name)
+        return {"key": key, "metrics": base.sync_state(state["metrics"], axis_name)}
+
+    def compute_from(self, state: Dict[str, Any], axis_name: Optional[Any] = None) -> Dict[str, Array]:
+        base = self.metrics[0]
+        vals = jax.vmap(lambda s: jnp.asarray(base.compute_from(s, axis_name)))(state["metrics"])
+        output_dict: Dict[str, Array] = {}
+        if self.mean:
+            output_dict["mean"] = vals.mean(axis=0)
+        if self.std:
+            output_dict["std"] = vals.std(axis=0, ddof=1)
+        if self.quantile is not None:
+            output_dict["quantile"] = jnp.quantile(vals, self.quantile, axis=0)
+        if self.raw:
+            output_dict["raw"] = vals
+        return output_dict

@@ -1,8 +1,16 @@
 """MultioutputWrapper (reference: wrappers/multioutput.py:29-192): K copies of a base
-metric, one per output dimension, with optional NaN-row removal per output."""
-from copy import deepcopy
-from typing import Any, List, Optional, Tuple
+metric, one per output dimension, with optional NaN-row removal per output.
 
+TPU-first pure tier (round 5): ``init_state``/``local_update``/``compute_from``
+carry one stacked ``(num_outputs, ...)`` base-state pytree and run the base
+metric's ``local_update`` vmapped over the output axis — every output column
+evaluates in one fused device program under jit/shard_map. ``remove_nans`` is a
+data-dependent row filter and stays eager-only (construct with
+``remove_nans=False`` for the pure tier)."""
+from copy import deepcopy
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import Array
@@ -103,3 +111,53 @@ class MultioutputWrapper(Metric):
         for metric in self.metrics:
             metric.reset()
         super().reset()
+
+    # --------------------------------------------------- pure-functional tier
+
+    def init_state(self) -> Dict[str, Any]:
+        """One stacked ``(num_outputs, ...)`` base-state pytree."""
+        base = self.metrics[0].init_state()
+        if any(isinstance(v, list) for v in base.values()):
+            raise ValueError(
+                "MultioutputWrapper's pure tier needs static-shape base states; construct"
+                " the base metric with `cat_capacity` so its cat states become CatBuffers"
+            )
+        k = len(self.metrics)
+        return jax.tree_util.tree_map(lambda x: jnp.broadcast_to(jnp.asarray(x), (k,) + jnp.shape(x)), base)
+
+    def local_update(self, state: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        """All output columns in one vmapped program."""
+        if self.remove_nans:
+            raise NotImplementedError(
+                "remove_nans drops a data-dependent number of rows and cannot run under"
+                " jit; construct MultioutputWrapper(remove_nans=False) for the pure tier"
+            )
+        array_types = (jnp.ndarray, np.ndarray)
+        base = self.metrics[0]
+
+        def one(bstate, i):
+            def select(x):
+                picked = jnp.take(jnp.asarray(x), i, axis=self.output_dim)  # scalar take drops the axis
+                if not self.squeeze_outputs:
+                    picked = jnp.expand_dims(picked, self.output_dim)
+                return picked
+
+            new_args = apply_to_collection(args, array_types, select)
+            new_kwargs = apply_to_collection(kwargs, array_types, select)
+            return base.local_update(bstate, *new_args, **new_kwargs)
+
+        return jax.vmap(one)(state, jnp.arange(len(self.metrics)))
+
+    def sync_state(self, state: Dict[str, Any], axis_name: Optional[Any] = None) -> Dict[str, Any]:
+        """Per-output sync: the base reductions apply elementwise over the stack."""
+        base = self.metrics[0]
+        if any(kind == "cat" for kind in base._reductions.values()):
+            raise NotImplementedError(
+                "MultioutputWrapper's pure tier cannot sync cat-reduction base states"
+                " over a mesh axis; evaluate per shard and combine computes instead"
+            )
+        return base.sync_state(state, axis_name)
+
+    def compute_from(self, state: Dict[str, Any], axis_name: Optional[Any] = None) -> Array:
+        base = self.metrics[0]
+        return jax.vmap(lambda s: jnp.asarray(base.compute_from(s, axis_name)))(state)

@@ -1,0 +1,275 @@
+"""Compute-group matrix ported from the reference
+(/root/reference/tests/unittests/bases/test_collections.py:309-480).
+
+Adaptation: groups here form STATICALLY at construction (update-function identity
++ state schema + declared update-relevant ctor args, core/collections.py) instead
+of after the first update's O(n^2) device data-compare — so the group assertions
+hold immediately and the reference's "groups only after first update" assertions
+become "groups from construction". Values with and without compute groups must
+stay identical across epochs/batches, reset included.
+"""
+import os
+from copy import deepcopy
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu.classification import (
+    MulticlassAccuracy,
+    MulticlassAUROC,
+    MulticlassAveragePrecision,
+    MulticlassCohenKappa,
+    MulticlassConfusionMatrix,
+    MulticlassF1Score,
+    MulticlassPrecision,
+    MulticlassRecall,
+    MultilabelAUROC,
+    MultilabelAveragePrecision,
+)
+from metrics_tpu.core.collections import MetricCollection
+
+_rng = np.random.RandomState(42)
+_logits = _rng.randn(10, 3, 2).astype(np.float32)
+_mc_preds = jnp.asarray(np.exp(_logits) / np.exp(_logits).sum(1, keepdims=True))
+_mc_target = jnp.asarray(_rng.randint(0, 3, (10, 2)))
+_ml_preds = jnp.asarray(_rng.rand(10, 3).astype(np.float32))
+_ml_target = jnp.asarray(_rng.randint(0, 2, (10, 3)))
+
+
+CASES = [
+    # single metric forms its own compute group
+    (MulticlassAccuracy(num_classes=3), {0: ["MulticlassAccuracy"]}, _mc_preds, _mc_target),
+    # two metrics of same class form a compute group
+    (
+        {"acc0": MulticlassAccuracy(num_classes=3), "acc1": MulticlassAccuracy(num_classes=3)},
+        {0: ["acc0", "acc1"]},
+        _mc_preds,
+        _mc_target,
+    ),
+    # two metrics sharing an update function form a compute group
+    (
+        [MulticlassPrecision(num_classes=3), MulticlassRecall(num_classes=3)],
+        {0: ["MulticlassPrecision", "MulticlassRecall"]},
+        _mc_preds,
+        _mc_target,
+    ),
+    # two metrics from different families give two compute groups
+    (
+        [MulticlassConfusionMatrix(num_classes=3), MulticlassRecall(num_classes=3)],
+        {0: ["MulticlassConfusionMatrix"], 1: ["MulticlassRecall"]},
+        _mc_preds,
+        _mc_target,
+    ),
+    # multi group multi metric (CohenKappa inherits the confmat update)
+    (
+        [
+            MulticlassConfusionMatrix(num_classes=3),
+            MulticlassCohenKappa(num_classes=3),
+            MulticlassRecall(num_classes=3),
+            MulticlassPrecision(num_classes=3),
+        ],
+        {0: ["MulticlassConfusionMatrix", "MulticlassCohenKappa"], 1: ["MulticlassRecall", "MulticlassPrecision"]},
+        _mc_preds,
+        _mc_target,
+    ),
+    # complex example: samplewise accuracy splits off, confmat splits off
+    (
+        {
+            "acc": MulticlassAccuracy(num_classes=3),
+            "acc2": MulticlassAccuracy(num_classes=3),
+            "acc3": MulticlassAccuracy(num_classes=3, multidim_average="samplewise"),
+            "f1": MulticlassF1Score(num_classes=3),
+            "recall": MulticlassRecall(num_classes=3),
+            "confmat": MulticlassConfusionMatrix(num_classes=3),
+        },
+        {0: ["acc", "acc2", "f1", "recall"], 1: ["acc3"], 2: ["confmat"]},
+        _mc_preds,
+        _mc_target,
+    ),
+    # with list states (exact-mode curves)
+    (
+        [
+            MulticlassAUROC(num_classes=3, average="macro"),
+            MulticlassAveragePrecision(num_classes=3, average="macro"),
+        ],
+        {0: ["MulticlassAUROC", "MulticlassAveragePrecision"]},
+        _mc_preds,
+        _mc_target,
+    ),
+    # nested collections: average only affects compute, so ALL merge
+    (
+        [
+            MetricCollection(
+                MultilabelAUROC(num_labels=3, average="micro"),
+                MultilabelAveragePrecision(num_labels=3, average="micro"),
+                postfix="_micro",
+            ),
+            MetricCollection(
+                MultilabelAUROC(num_labels=3, average="macro"),
+                MultilabelAveragePrecision(num_labels=3, average="macro"),
+                postfix="_macro",
+            ),
+        ],
+        {
+            0: [
+                "MultilabelAUROC_micro",
+                "MultilabelAveragePrecision_micro",
+                "MultilabelAUROC_macro",
+                "MultilabelAveragePrecision_macro",
+            ]
+        },
+        _ml_preds,
+        _ml_target,
+    ),
+]
+
+IDS = [
+    "single", "same_class", "same_update_fn", "different_families", "multi_group",
+    "complex", "list_states", "nested_average_merge",
+]
+
+
+def _partition(groups):
+    return {frozenset(v) for v in groups.values()}
+
+
+@pytest.mark.parametrize(("prefix", "postfix"), [(None, None), ("prefix_", None), (None, "_postfix"), ("prefix_", "_postfix")])
+@pytest.mark.parametrize(("metrics", "expected", "preds", "target"), CASES, ids=IDS)
+def test_compute_groups_correctness(metrics, expected, preds, target, prefix, postfix):
+    m = MetricCollection(deepcopy(metrics), prefix=prefix, postfix=postfix, compute_groups=True)
+    m2 = MetricCollection(deepcopy(metrics), prefix=prefix, postfix=postfix, compute_groups=False)
+
+    # static derivation: groups exist from construction (adaptation of the
+    # reference's post-first-update assertion)
+    assert _partition(m.compute_groups) == _partition(expected)
+    assert m2.compute_groups == {}
+
+    for _ in range(2):  # epochs
+        for _ in range(2):  # batches
+            m.update(preds, target)
+            m2.update(preds, target)
+            assert _partition(m.compute_groups) == _partition(expected)
+            for _, member in m.items():
+                assert member._update_count > 0
+
+        res_cg = m.compute()
+        res_no_cg = m2.compute()
+        assert res_cg.keys() == res_no_cg.keys()
+        for key in res_cg:
+            np.testing.assert_allclose(np.asarray(res_cg[key]), np.asarray(res_no_cg[key]), rtol=1e-6, atol=1e-6)
+        m.reset()
+        m2.reset()
+
+
+@pytest.mark.parametrize("method", ["items", "values", "getitem"])
+@pytest.mark.parametrize(("metrics", "expected", "preds", "target"), CASES[:6], ids=IDS[:6])
+def test_compute_group_state_copies_on_access(metrics, expected, preds, target, method):
+    """Accessing members must copy states so resetting one metric cannot corrupt
+    its group partners (reference test_check_compute_groups_items_and_values)."""
+    m = MetricCollection(deepcopy(metrics), compute_groups=True)
+    m2 = MetricCollection(deepcopy(metrics), compute_groups=False)
+    for _ in range(2):
+        m.update(preds, target)
+        m2.update(preds, target)
+
+    def compare_then_reset(m1, m2_):
+        for state in m1._defaults:
+            s1, s2 = getattr(m1, state), getattr(m2_, state)
+            if isinstance(s1, list):
+                for a, b in zip(s1, s2):
+                    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+            else:
+                np.testing.assert_allclose(np.asarray(s1), np.asarray(s2), rtol=1e-6)
+        m1.reset()
+        m2_.reset()
+
+    if method == "items":
+        for (n1, mm1), (n2, mm2) in zip(m.items(), m2.items()):
+            assert n1 == n2
+            compare_then_reset(mm1, mm2)
+    elif method == "values":
+        for mm1, mm2 in zip(m.values(), m2.values()):
+            compare_then_reset(mm1, mm2)
+    else:
+        for key in list(m.keys()):
+            compare_then_reset(m[key], m2[key])
+
+
+@pytest.mark.parametrize(("metrics", "expected", "preds", "target"), CASES, ids=IDS)
+def test_runtime_validation_agrees_with_static(metrics, expected, preds, target, monkeypatch):
+    """With METRICS_TPU_VALIDATE_COMPUTE_GROUPS=1 the reference's data-compare
+    merge runs once on the first update; it must agree with the static partition
+    (no warning) and produce identical results."""
+    import warnings
+
+    monkeypatch.setenv("METRICS_TPU_VALIDATE_COMPUTE_GROUPS", "1")
+    m = MetricCollection(deepcopy(metrics), compute_groups=True)
+    assert m._validate_groups_runtime
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # any disagreement warning -> failure
+        m.update(preds, target)
+    assert _partition(m.compute_groups) == _partition(expected)
+    m.update(preds, target)
+
+    m2 = MetricCollection(deepcopy(metrics), compute_groups=False)
+    m2.update(preds, target)
+    m2.update(preds, target)
+    res, res2 = m.compute(), m2.compute()
+    for key in res:
+        np.testing.assert_allclose(np.asarray(res[key]), np.asarray(res2[key]), rtol=1e-6, atol=1e-6)
+
+
+def test_no_device_compare_on_first_update(monkeypatch):
+    """The static path must not run any state allclose during updates."""
+    import metrics_tpu.core.collections as C
+
+    calls = []
+    orig = C.MetricCollection._equal_metric_states
+
+    def spy(m1, m2):
+        calls.append(1)
+        return orig(m1, m2)
+
+    monkeypatch.setattr(C.MetricCollection, "_equal_metric_states", staticmethod(spy))
+    m = MetricCollection([MulticlassPrecision(num_classes=3), MulticlassRecall(num_classes=3)])
+    m.update(_mc_preds, _mc_target)
+    m.update(_mc_preds, _mc_target)
+    assert calls == []
+    assert _partition(m.compute_groups) == {frozenset({"MulticlassPrecision", "MulticlassRecall"})}
+
+
+def test_pre_updated_metric_never_merges():
+    """Merging shares state by reference, so a metric that already accumulated
+    updates must stay in its own group — both at construction and when added
+    via __setitem__ after the collection has been updated (r5 review finding:
+    a signature-only merge would clobber one side's history)."""
+    updated = MulticlassAccuracy(num_classes=3)
+    updated.update(_mc_preds, _mc_target)
+    before = float(updated.compute())
+    fresh = MulticlassAccuracy(num_classes=3)
+    mc = MetricCollection({"old": deepcopy(updated), "new": fresh})
+    assert _partition(mc.compute_groups) == {frozenset({"old"}), frozenset({"new"})}
+    assert float(mc["old"].compute()) == before
+    assert float(np.asarray(mc["new"].tp).sum()) == 0.0  # fresh state untouched
+
+    mc2 = MetricCollection([MulticlassAccuracy(num_classes=3)])
+    mc2.update(_mc_preds, _mc_target)
+    acc_after_one = {k: float(v) for k, v in mc2.compute().items()}
+    mc2["late"] = MulticlassAccuracy(num_classes=3)
+    assert _partition(mc2.compute_groups) == {frozenset({"MulticlassAccuracy"}), frozenset({"late"})}
+    assert float(np.asarray(mc2["late"].tp).sum()) == 0.0
+    mc2.update(_mc_preds, _mc_target)
+    res = mc2.compute()
+    # the original metric has 2 updates, the late one only 1 of the same batch
+    assert float(res["MulticlassAccuracy"]) == acc_after_one["MulticlassAccuracy"]
+    assert float(res["late"]) == acc_after_one["MulticlassAccuracy"]
+
+
+def test_custom_group_list_still_respected():
+    m = MetricCollection(
+        [MulticlassPrecision(num_classes=3), MulticlassRecall(num_classes=3), MulticlassConfusionMatrix(num_classes=3)],
+        compute_groups=[["MulticlassPrecision"], ["MulticlassRecall", "MulticlassConfusionMatrix"]],
+    )
+    assert m.compute_groups == {0: ["MulticlassPrecision"], 1: ["MulticlassRecall", "MulticlassConfusionMatrix"]}

@@ -1,0 +1,186 @@
+"""Round-5 vmapped pure tiers for BootStrapper and MultioutputWrapper.
+
+The reference implements both wrappers as N eager deepcopies fed in a Python
+loop (wrappers/bootstrapping.py:53, multioutput.py:95); here the pure tier
+carries one stacked (N, ...) base-state pytree and vmaps the base metric's
+local_update, so every replica/output runs in one fused device program and the
+wrappers compose with jit / lax.scan / shard_map like any other metric.
+"""
+from functools import partial
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.classification import MulticlassAccuracy
+from metrics_tpu.parallel import evaluate_sharded, make_data_mesh
+from metrics_tpu.regression import MeanSquaredError
+from metrics_tpu.wrappers import BootStrapper, MultioutputWrapper
+
+_rng = np.random.RandomState(0)
+
+
+# ------------------------------------------------------------ MultioutputWrapper
+
+def _mo_batches(n_batches=3, n=16, k=2):
+    return [
+        (jnp.asarray(_rng.rand(n, k).astype(np.float32)), jnp.asarray(_rng.rand(n, k).astype(np.float32)))
+        for _ in range(n_batches)
+    ]
+
+
+def test_multioutput_pure_matches_eager():
+    batches = _mo_batches()
+    wrapper = MultioutputWrapper(MeanSquaredError(), num_outputs=2, remove_nans=False)
+
+    state = wrapper.init_state()
+    update = jax.jit(wrapper.local_update)
+    for p, t in batches:
+        state = update(state, p, t)
+    got = wrapper.compute_from(state)
+
+    eager = MultioutputWrapper(MeanSquaredError(), num_outputs=2, remove_nans=False)
+    for p, t in batches:
+        eager.update(p, t)
+    want = eager.compute()
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)
+    assert got.shape == (2,)
+
+
+def test_multioutput_pure_in_scan():
+    batches = _mo_batches(4)
+    wrapper = MultioutputWrapper(MeanSquaredError(), num_outputs=2, remove_nans=False)
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *batches)
+
+    @jax.jit
+    def run(state, data):
+        def step(s, batch):
+            return wrapper.local_update(s, *batch), None
+
+        s, _ = jax.lax.scan(step, state, data)
+        return wrapper.compute_from(s)
+
+    got = run(wrapper.init_state(), stacked)
+    eager = MultioutputWrapper(MeanSquaredError(), num_outputs=2, remove_nans=False)
+    for p, t in batches:
+        eager.update(p, t)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(eager.compute()), rtol=1e-6)
+
+
+def test_multioutput_pure_sharded():
+    mesh = make_data_mesh(8)
+    batches = _mo_batches(2, n=64)
+    wrapper = MultioutputWrapper(MeanSquaredError(), num_outputs=2, remove_nans=False)
+    got = evaluate_sharded(wrapper, batches, mesh=mesh)
+
+    eager = MultioutputWrapper(MeanSquaredError(), num_outputs=2, remove_nans=False)
+    for p, t in batches:
+        eager.update(p, t)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(eager.compute()), rtol=1e-5)
+
+
+def test_multioutput_pure_remove_nans_raises():
+    wrapper = MultioutputWrapper(MeanSquaredError(), num_outputs=2)  # remove_nans default True
+    state = wrapper.init_state()
+    p, t = _mo_batches(1)[0]
+    with pytest.raises(NotImplementedError, match="remove_nans"):
+        wrapper.local_update(state, p, t)
+
+
+def test_multioutput_pure_no_squeeze():
+    batches = _mo_batches()
+    wrapper = MultioutputWrapper(MeanSquaredError(), num_outputs=2, remove_nans=False, squeeze_outputs=False)
+    state = wrapper.init_state()
+    for p, t in batches:
+        state = jax.jit(wrapper.local_update)(state, p, t)
+    eager = MultioutputWrapper(MeanSquaredError(), num_outputs=2, remove_nans=False, squeeze_outputs=False)
+    for p, t in batches:
+        eager.update(p, t)
+    np.testing.assert_allclose(np.asarray(wrapper.compute_from(state)), np.asarray(eager.compute()), rtol=1e-6)
+
+
+# ---------------------------------------------------------------- BootStrapper
+
+def _clf_batches(n_batches=3, n=256):
+    return [
+        (jnp.asarray(_rng.randint(0, 5, n)), jnp.asarray(_rng.randint(0, 5, n)))
+        for _ in range(n_batches)
+    ]
+
+
+@pytest.mark.parametrize("strategy", ["poisson", "multinomial"])
+def test_bootstrap_pure_statistics(strategy):
+    """The vmapped tier's mean must track the base metric's value and the draws
+    must actually differ across replicas (std > 0)."""
+    batches = _clf_batches()
+    base = MulticlassAccuracy(num_classes=5, average="micro")
+    boot = BootStrapper(base, num_bootstraps=20, raw=True, sampling_strategy=strategy, seed=0)
+
+    state = boot.init_state()
+    update = jax.jit(boot.local_update)
+    for p, t in batches:
+        state = update(state, p, t)
+    out = boot.compute_from(state)
+
+    plain = MulticlassAccuracy(num_classes=5, average="micro")
+    for p, t in batches:
+        plain.update(p, t)
+    true_val = float(plain.compute())
+
+    assert out["raw"].shape == (20,)
+    assert float(out["std"]) > 0.0
+    # accuracy ~0.2 over 768 rows: bootstrap SE ~ sqrt(0.2*0.8/768) ~ 0.014
+    assert abs(float(out["mean"]) - true_val) < 5 * 0.014
+    # the key advanced, so a second update draws differently
+    state2 = update(state, *batches[0])
+    assert not np.array_equal(np.asarray(state2["metrics"]["tp"]), np.asarray(state["metrics"]["tp"]))
+
+
+def test_bootstrap_pure_deterministic_given_seed():
+    batches = _clf_batches(2)
+    outs = []
+    for _ in range(2):
+        boot = BootStrapper(MulticlassAccuracy(num_classes=5, average="micro"), num_bootstraps=8, seed=7, raw=True)
+        state = boot.init_state()
+        for p, t in batches:
+            state = jax.jit(boot.local_update)(state, p, t)
+        outs.append(np.asarray(boot.compute_from(state)["raw"]))
+    np.testing.assert_array_equal(outs[0], outs[1])
+
+
+def test_bootstrap_pure_sharded():
+    mesh = make_data_mesh(8)
+    batches = _clf_batches(2, n=128)
+    boot = BootStrapper(MulticlassAccuracy(num_classes=5, average="micro"), num_bootstraps=8, seed=3)
+    out = evaluate_sharded(boot, batches, mesh=mesh)
+
+    plain = MulticlassAccuracy(num_classes=5, average="micro")
+    for p, t in batches:
+        plain.update(p, t)
+    assert abs(float(out["mean"]) - float(plain.compute())) < 0.15
+    assert float(out["std"]) > 0.0
+
+
+def test_bootstrap_pure_quantile():
+    boot = BootStrapper(
+        MulticlassAccuracy(num_classes=5, average="micro"),
+        num_bootstraps=16,
+        quantile=jnp.asarray([0.05, 0.95]),
+        seed=1,
+    )
+    state = boot.init_state()
+    p, t = _clf_batches(1)[0]
+    state = jax.jit(boot.local_update)(state, p, t)
+    q = boot.compute_from(state)["quantile"]
+    assert q.shape == (2,)
+    assert float(q[0]) <= float(q[1])
+
+
+def test_bootstrap_pure_list_state_guard():
+    from metrics_tpu.classification import BinaryAUROC
+
+    boot = BootStrapper(BinaryAUROC(), num_bootstraps=4)  # exact mode -> list states
+    with pytest.raises(ValueError, match="cat_capacity"):
+        boot.init_state()

@@ -1,0 +1,102 @@
+"""Fixed-point curve metrics (recall@precision, precision@recall,
+specificity@sensitivity) must compute INSIDE jit (round-5 lift: branchless
+constrained-max reduce), matching the eager host-side selection exactly —
+both paths operate on the same f32 curve values, so every comparison decides
+identically and results must be bit-equal."""
+from functools import partial
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.classification import (
+    BinaryPrecisionAtFixedRecall,
+    BinaryRecallAtFixedPrecision,
+    BinarySpecificityAtSensitivity,
+    MulticlassRecallAtFixedPrecision,
+    MulticlassSpecificityAtSensitivity,
+    MultilabelPrecisionAtFixedRecall,
+)
+
+_rng = np.random.RandomState(7)
+
+
+def _binary_batch(n=64):
+    return jnp.asarray(_rng.rand(n).astype(np.float32)), jnp.asarray((_rng.rand(n) > 0.6).astype(np.int32))
+
+
+def _mc_batch(n=64, c=4):
+    p = _rng.rand(n, c).astype(np.float32)
+    return jnp.asarray(p / p.sum(1, keepdims=True)), jnp.asarray(_rng.randint(0, c, n))
+
+
+def _ml_batch(n=64, l=3):
+    return jnp.asarray(_rng.rand(n, l).astype(np.float32)), jnp.asarray((_rng.rand(n, l) > 0.5).astype(np.int32))
+
+
+CASES = [
+    (BinaryRecallAtFixedPrecision, {"min_precision": 0.5}, _binary_batch),
+    # 0.7 is not f32-representable: the traced compare must use the smallest
+    # f32 >= 0.7 to match the eager float64 boundary decision exactly
+    (BinaryRecallAtFixedPrecision, {"min_precision": 0.7}, _binary_batch),
+    (BinarySpecificityAtSensitivity, {"min_sensitivity": 0.7}, _binary_batch),
+    (BinaryRecallAtFixedPrecision, {"min_precision": 1.0}, _binary_batch),  # nothing qualifies -> (0, 1e6)
+    (BinaryPrecisionAtFixedRecall, {"min_recall": 0.5}, _binary_batch),
+    (BinarySpecificityAtSensitivity, {"min_sensitivity": 0.5}, _binary_batch),
+    (MulticlassRecallAtFixedPrecision, {"num_classes": 4, "min_precision": 0.5}, _mc_batch),
+    (MulticlassSpecificityAtSensitivity, {"num_classes": 4, "min_sensitivity": 0.5}, _mc_batch),
+    (MultilabelPrecisionAtFixedRecall, {"num_labels": 3, "min_recall": 0.5}, _ml_batch),
+]
+
+
+@pytest.mark.parametrize("thresholds", [11, None], ids=["binned", "exact"])
+@pytest.mark.parametrize("cls,kwargs,gen", CASES, ids=lambda c: getattr(c, "__name__", None))
+def test_jit_compute_matches_eager(cls, kwargs, gen, thresholds):
+    kw = dict(kwargs, thresholds=thresholds)
+    if thresholds is None:
+        kw["cat_capacity"] = 256  # exact mode under jit needs a static curve buffer
+    metric = cls(**kw)
+    batches = [gen() for _ in range(3)]
+
+    state = metric.init_state()
+    update = jax.jit(partial(metric.local_update))
+    for p, t in batches:
+        state = update(state, p, t)
+    val_jit = jax.jit(metric.compute_from)(state)
+
+    eager = cls(**dict(kwargs, thresholds=thresholds))
+    for p, t in batches:
+        eager.update(p, t)
+    val_eager = eager.compute()
+
+    for a, b in zip(jax.tree.leaves(val_jit), jax.tree.leaves(val_eager)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_boundary_cutoff_matches_eager_exactly():
+    """A curve point landing exactly on the f32 grid value of a non-representable
+    cutoff (7/10 vs min=0.7): jit and eager must make the same include/exclude
+    decision (eager compares in f64, where f32(0.7) < 0.7)."""
+    # 10 predictions above threshold 0.5, 7 of them positive -> precision exactly 0.7
+    preds = jnp.asarray([0.9] * 10 + [0.1] * 4)
+    target = jnp.asarray([1] * 7 + [0] * 3 + [1] * 2 + [0] * 2)
+    for mp in (0.7, 0.7000000000000001, float(np.float32(0.7))):
+        metric = BinaryRecallAtFixedPrecision(min_precision=mp, thresholds=[0.5])
+        state = jax.jit(metric.local_update)(metric.init_state(), preds, target)
+        jit_out = [float(x) for x in jax.jit(metric.compute_from)(state)]
+        eager = BinaryRecallAtFixedPrecision(min_precision=mp, thresholds=[0.5])
+        eager.update(preds, target)
+        eager_out = [float(x) for x in eager.compute()]
+        assert jit_out == eager_out, (mp, jit_out, eager_out)
+
+
+def test_nothing_qualifies_sentinel_under_jit():
+    metric = BinaryRecallAtFixedPrecision(min_precision=1.0, thresholds=5)
+    p = jnp.asarray([0.9, 0.8, 0.7, 0.2])
+    t = jnp.asarray([0, 0, 1, 1])  # high scores are all negatives: precision < 1 everywhere
+    state = jax.jit(metric.local_update)(metric.init_state(), p, t)
+    best, thr = jax.jit(metric.compute_from)(state)
+    assert float(best) == 0.0
+    assert float(thr) == 1e6
