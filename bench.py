@@ -701,6 +701,22 @@ def bench_retrieval(n_docs: int = 1 << 24, trials: int = 5) -> dict:
         rates.append(n_docs / (time.perf_counter() - t0))
     assert 0.0 < value < 1.0
 
+    # NDCG on the unified scan path (round 5: sign-split segmented cumsum; the
+    # old segment-reduction path paid ~174 ms/scatter at this size)
+    from metrics_tpu.retrieval import RetrievalNormalizedDCG
+
+    ndcg = RetrievalNormalizedDCG(cat_capacity=n_docs, validate_args=False)
+    upd_n = jax.jit(ndcg.local_update)
+    state_n = upd_n(ndcg.init_state(), scores, rel, idx)
+    ndcg_val = float(ndcg.compute_from(state_n))  # compile + warm
+    ndcg_rates = []
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        state_n = upd_n(ndcg.init_state(), scores, rel, idx)
+        ndcg_val = float(ndcg.compute_from(state_n))
+        ndcg_rates.append(n_docs / (time.perf_counter() - t0))
+    assert 0.0 < ndcg_val < 1.0
+
     vs = None
     tm = _reference_torchmetrics()
     if tm is not None:
@@ -720,9 +736,11 @@ def bench_retrieval(n_docs: int = 1 << 24, trials: int = 5) -> dict:
         vs = round(statistics.median(rates) / ref_rate, 2)
     return {"metric": "retrieval_map_docs_per_s", "value": round(statistics.median(rates) / 1e6, 2),
             "unit": "Mdocs/s/chip", "vs_baseline": vs,
+            "ndcg_mdocs_per_s": round(statistics.median(ndcg_rates) / 1e6, 2),
             "bound": "sort+scan kernel bound: payload sort ~125 ms at 2^24 rows plus"
                      " ~5 cumsum/cummax scans ~30 ms each, zero scatters/gathers"
-                     " (ops/segment.py scan path)"}
+                     " (ops/segment.py scan path; since r5 ndcg/r_precision ride it"
+                     " too via the sign-split segmented cumsum)"}
 
 
 if __name__ == "__main__":

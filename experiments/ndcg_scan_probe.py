@@ -1,0 +1,25 @@
+"""Measure ndcg/r_precision on the unified scan path at bench scale (2^24 rows)."""
+import sys, os, time, statistics
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax, jax.numpy as jnp
+import numpy as np
+from metrics_tpu.retrieval import RetrievalNormalizedDCG, RetrievalRPrecision, RetrievalMAP
+
+n_docs = 1 << 24
+rng = np.random.RandomState(0)
+idx = jnp.asarray(np.sort(rng.randint(0, n_docs // 64, n_docs)).astype(np.int32))
+scores = jnp.asarray(rng.rand(n_docs).astype(np.float32))
+rel = jnp.asarray((rng.rand(n_docs) > 0.7).astype(np.int32))
+
+for cls in (RetrievalNormalizedDCG, RetrievalRPrecision, RetrievalMAP):
+    m = cls(cat_capacity=n_docs, validate_args=False)
+    update = jax.jit(m.local_update)
+    state = update(m.init_state(), scores, rel, idx)
+    v = float(m.compute_from(state))
+    rates = []
+    for _ in range(4):
+        t0 = time.perf_counter()
+        state = update(m.init_state(), scores, rel, idx)
+        v = float(m.compute_from(state))
+        rates.append(n_docs / (time.perf_counter() - t0))
+    print(f"{cls.__name__}: {statistics.median(rates)/1e6:.1f} Mdocs/s  value={v:.4f}")

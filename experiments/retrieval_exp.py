@@ -12,6 +12,40 @@ import jax.numpy as jnp
 import numpy as np
 
 
+# moved here from ops/segment.py when the library dropped its last scatter path
+# (r5): only this experiment grid still exercises the segment-reduction layout
+def _segment_layout(indexes: Array, preds: Array, target: Array):
+    """Sort rows by (query, -score); return per-row segment ids and rank info.
+
+    Returns: (seg_id, rank, sorted_preds, sorted_target, n_seg_upper, seg_count,
+    seg_index) where rank is the 1-based position of the row inside its query's
+    score-ordered list, seg_count[s] is the number of docs of segment s (0 for unused
+    slots), and seg_index[s] is the original query id of segment s (negative values
+    mark padding rows whose segment must not count as a real query).
+    """
+    n = indexes.shape[0]
+    # one variadic sort carrying the columns as payloads: measured 6.8x faster
+    # than argsort + three 4M-row gathers on TPU (see module docstring)
+    _, _, s_idx, s_preds, s_target = jax.lax.sort(
+        (indexes, -preds, indexes, preds, target), num_keys=2, is_stable=True
+    )
+
+    new_seg = jnp.concatenate([jnp.ones(1, dtype=bool), s_idx[1:] != s_idx[:-1]])
+    seg_id = jnp.cumsum(new_seg) - 1  # dense 0..n_q-1
+
+    pos = jnp.arange(n)
+    # broadcast each segment's start row to its members via one scan (no gather)
+    seg_start_row = jax.lax.cummax(jnp.where(new_seg, pos, 0))
+    rank = pos - seg_start_row + 1  # 1-based within query
+
+    seg_count = jax.ops.segment_sum(jnp.ones(n, jnp.int32), seg_id, num_segments=n, indices_are_sorted=True)
+    # first (== any) original index of each segment: negative marks padding rows
+    # (cat-buffer fill / pow2 pad), whose segment must not count as a real query
+    seg_index = jax.ops.segment_min(s_idx, seg_id, num_segments=n, indices_are_sorted=True)
+    return seg_id, rank, s_preds, s_target, n, seg_count, seg_index
+
+
+
 def _sync(out):
     # block_until_ready does not round-trip on the tunneled backend; a scalar
     # device_get is the only trustworthy sync (in-order queue drains first)
@@ -89,7 +123,6 @@ def main():
     f_lex_payload = jax.jit(lex_payload)
 
     def seg_ops(i, s, t):
-        from metrics_tpu.ops.segment import _segment_layout  # noqa: PLC0415
         return _segment_layout(i, s, t)
 
     f_layout = jax.jit(seg_ops)

@@ -80,6 +80,28 @@ class BinaryCalibrationError(Metric):
         accuracies = dim_zero_cat(self.accuracies)
         return _ce_compute(confidences, accuracies, self.n_bins, norm=self.norm)
 
+    def plot_reliability_diagram(self, ax: Optional[Any] = None):
+        """Reliability diagram of the accumulated state: per-bin accuracy vs
+        confidence with the |acc - conf| gap markers the ECE sums — the
+        curve-shaped view the reference's scalar ``plot`` cannot draw.
+
+        Example:
+            >>> import jax.numpy as jnp
+            >>> from metrics_tpu.classification import BinaryCalibrationError
+            >>> metric = BinaryCalibrationError(n_bins=5)
+            >>> metric.update(jnp.array([0.25, 0.55, 0.75]), jnp.array([0, 1, 1]))
+            >>> fig, ax = metric.plot_reliability_diagram()
+        """
+        from metrics_tpu.utils.plot import plot_reliability_diagram
+
+        return plot_reliability_diagram(
+            dim_zero_cat(self.confidences),
+            dim_zero_cat(self.accuracies),
+            n_bins=self.n_bins,
+            ax=ax,
+            name=self.__class__.__name__,
+        )
+
 
 class MulticlassCalibrationError(Metric):
     """Multiclass expected calibration error (reference: classification/calibration_error.py:135-229).
@@ -142,6 +164,19 @@ class MulticlassCalibrationError(Metric):
         confidences = dim_zero_cat(self.confidences)
         accuracies = dim_zero_cat(self.accuracies)
         return _ce_compute(confidences, accuracies, self.n_bins, norm=self.norm)
+
+    def plot_reliability_diagram(self, ax: Optional[Any] = None):
+        """Reliability diagram of the accumulated top-1 confidences (see
+        :meth:`BinaryCalibrationError.plot_reliability_diagram`)."""
+        from metrics_tpu.utils.plot import plot_reliability_diagram
+
+        return plot_reliability_diagram(
+            dim_zero_cat(self.confidences),
+            dim_zero_cat(self.accuracies),
+            n_bins=self.n_bins,
+            ax=ax,
+            name=self.__class__.__name__,
+        )
 
 
 class CalibrationError:

@@ -4,8 +4,19 @@ PESQ (ITU-T P.862) is a ~1500-line standardized C reference covering level/time
 alignment, an auditory transform, and a cognitive model; like the reference
 library, this function delegates to the ``pesq`` wheel (the reference raises the
 same ``ModuleNotFoundError`` when the wheel is absent — functional/audio/pesq.py:30).
-A from-scratch port is intentionally out of scope: any deviation from the ITU
-reference implementation produces non-comparable MOS-LQO numbers.
+
+Round-5 assessment of an in-repo port (the STOI treatment, functional/audio/stoi.py):
+evaluated and deliberately declined. Unlike STOI — whose published paper specifies
+the complete algorithm — P.862 conformance hinges on large numeric tables (Bark band
+edges and widths, absolute-hearing-threshold and loudness-scaling curves per band,
+IRS filter coefficients) that exist only in the ITU's source distribution, not in
+the paper; and this environment carries neither that source nor the ``pesq`` wheel,
+so a port could not be validated against ANY oracle (the stated acceptance bar,
+MOS-LQO within ~1e-4 of the wheel, is unmeasurable here). A "P.862-shaped" pipeline
+with reinvented constants would return plausible-looking but non-comparable MOS
+values — strictly worse than failing fast with parity-identical behavior to the
+reference. Revisit if the ITU reference tables or the wheel become available for
+conformance testing.
 """
 from typing import Union
 

@@ -18,7 +18,11 @@ def _check_data_shape_to_num_outputs(preds: Array, target: Array, num_outputs: i
             f"Expected both predictions and target to be either 1- or 2-dimensional tensors,"
             f" but got {target.ndim} and {preds.ndim}."
         )
-    if (num_outputs == 1 and preds.ndim != 1) or (num_outputs > 1 and num_outputs != preds.shape[-1]):
+    # (N, 1) inputs count as single-output, matching the reference's condition
+    # (functional/regression/utils.py:24: `preds.ndim == 1 or preds.shape[1] == 1`)
+    cond1 = num_outputs == 1 and not (preds.ndim == 1 or preds.shape[1] == 1)
+    cond2 = num_outputs > 1 and num_outputs != preds.shape[-1]
+    if cond1 or cond2:
         raise ValueError(
             f"Expected argument `num_outputs` to match the second dimension of input, but got {num_outputs}"
             f" and {preds.shape[-1] if preds.ndim > 1 else 1}."

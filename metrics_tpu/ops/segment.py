@@ -7,10 +7,12 @@ device program:
 
 1. one payload-carrying variadic sort groups rows by query and ranks docs by
    score inside each query,
-2. segment ids come from boundary detection + cumsum,
-3. every per-query retrieval metric becomes a segment reduction (segment_sum /
-   segment_min) over rank-indexed terms — no host round-trips, no ragged splits,
-   O(N log N) total and fully jit-compatible with a static row count.
+2. segment boundaries come from neighbor comparison; within-segment positions
+   and segmented sums come from cumsum/cummax scans (the cummax-base trick,
+   sign-split for general values),
+3. every per-query retrieval metric is a segmented-scan value read at its
+   query's last row — no host round-trips, no ragged splits, no scatters or
+   gathers, O(N log N) total and fully jit-compatible with a static row count.
 
 Measured design notes (4.2M docs / 65k queries, v5e, device_get-synced p50 —
 ``block_until_ready`` does not round-trip on the tunneled backend):
@@ -23,50 +25,17 @@ Measured design notes (4.2M docs / 65k queries, v5e, device_get-synced p50 —
 - **Within-segment positions come from scans, not segment_min+gather**:
   ``cummax(where(new_seg, pos, 0))`` broadcasts each segment's start row to its
   members in one associative scan.
-- ``indices_are_sorted=True`` on every segment reduction (segment ids are
-  sorted by construction) lets XLA skip the scatter's sorting pass.
 - Net: RetrievalMAP end-to-end went 8.4 -> 22.0 Mdocs/s (the remaining time is
   the sort at ~45 ms + ~4 linear scans/scatters at ~15-25 ms each; a fused
   one-pass segmented scan would need a hand-written kernel for <2x more).
   Experiment grid: experiments/retrieval_exp.py.
 """
-from functools import partial
+
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import Array
-
-
-def _segment_layout(indexes: Array, preds: Array, target: Array):
-    """Sort rows by (query, -score); return per-row segment ids and rank info.
-
-    Returns: (seg_id, rank, sorted_preds, sorted_target, n_seg_upper, seg_count,
-    seg_index) where rank is the 1-based position of the row inside its query's
-    score-ordered list, seg_count[s] is the number of docs of segment s (0 for unused
-    slots), and seg_index[s] is the original query id of segment s (negative values
-    mark padding rows whose segment must not count as a real query).
-    """
-    n = indexes.shape[0]
-    # one variadic sort carrying the columns as payloads: measured 6.8x faster
-    # than argsort + three 4M-row gathers on TPU (see module docstring)
-    _, _, s_idx, s_preds, s_target = jax.lax.sort(
-        (indexes, -preds, indexes, preds, target), num_keys=2, is_stable=True
-    )
-
-    new_seg = jnp.concatenate([jnp.ones(1, dtype=bool), s_idx[1:] != s_idx[:-1]])
-    seg_id = jnp.cumsum(new_seg) - 1  # dense 0..n_q-1
-
-    pos = jnp.arange(n)
-    # broadcast each segment's start row to its members via one scan (no gather)
-    seg_start_row = jax.lax.cummax(jnp.where(new_seg, pos, 0))
-    rank = pos - seg_start_row + 1  # 1-based within query
-
-    seg_count = jax.ops.segment_sum(jnp.ones(n, jnp.int32), seg_id, num_segments=n, indices_are_sorted=True)
-    # first (== any) original index of each segment: negative marks padding rows
-    # (cat-buffer fill / pow2 pad), whose segment must not count as a real query
-    seg_index = jax.ops.segment_min(s_idx, seg_id, num_segments=n, indices_are_sorted=True)
-    return seg_id, rank, s_preds, s_target, n, seg_count, seg_index
 
 
 def _segment_cumsum_nonneg(values: Array, new_seg: Array) -> Array:
@@ -79,18 +48,96 @@ def _segment_cumsum_nonneg(values: Array, new_seg: Array) -> Array:
     Dtype-preserving: count-like streams are passed as int32 so the GLOBAL
     running sum stays exact to 2^31 rows (an f32 global sum would lose integer
     exactness past 2^24 positive rows — the scale this module's own 2^24-row
-    benchmarks run at); fractional streams (the AP contribution sum) stay f32,
-    where the base-difference is subject to ordinary float rounding only.
+    benchmarks run at). Float streams must NOT use this one-pass form — the
+    base-difference loses ulp(global running sum) per segment; they go through
+    :func:`_segment_cumsum_float` instead.
     """
     g = jnp.cumsum(values)
     base = jax.lax.cummax(jnp.where(new_seg, g - values, jnp.zeros_like(g)))
     return g - base
 
 
-# metrics whose per-query value is a segmented-cumsum read at the segment's
-# last row: they run with ZERO segment scatters (sort + ~5 scans + plain sums)
+def _segment_cumsum_float(values: Array, new_seg: Array, block: int = 2048) -> Array:
+    """Within-segment inclusive cumsum for float values with BLOCK-LOCAL precision.
+
+    The cummax-base trick differences two GLOBAL cumsums; for float streams the
+    global running sum reaches ``N * mean|v|`` and the difference loses
+    ``ulp(global)`` per segment — measured up to 4e-3 per-query error at 2^22
+    rows (r5 review finding). Here the array splits into fixed-size blocks:
+    in-block segmented cumsums run fully parallel, and the open segment's carry
+    across block boundaries — an affine reset-composition — comes from ONE
+    ``associative_scan`` over the block summaries (thousands of elements, so
+    the recursive decomposition that makes element-level associative_scan
+    uncompilable at 2^24 stays trivial). Every intermediate magnitude is
+    bounded by ``block * mean|v|`` (or the true segment sum), so the error
+    matches plain per-segment summation — measured ~1000x tighter at 2^22 at
+    parity throughput (experiments/ndcg_scan_probe.py). Handles ARBITRARY sign
+    via an in-block sign-split (block-local magnitudes keep the split benign).
+    Integer count streams don't need this — int addition is exact — and stay
+    on the global one-pass :func:`_segment_cumsum_nonneg`.
+    """
+    n = values.shape[0]
+    pad = (-n) % block
+    if pad:
+        values = jnp.concatenate([values, jnp.zeros((pad,), values.dtype)])
+        # padding rows start their own segment so they can never extend a carry
+        new_seg = jnp.concatenate([new_seg, jnp.ones((pad,), bool)])
+    nb = values.shape[0] // block
+    v_blocks = values.reshape(nb, block)
+    s_blocks = new_seg.reshape(nb, block)
+
+    def inblock(vb, sb):
+        g = jnp.cumsum(vb, axis=1)
+        base = jax.lax.cummax(jnp.where(sb, g - vb, jnp.zeros_like(g)), axis=1)
+        return g - base
+
+    # pass 1, fully parallel over blocks: in-block segmented cumsum
+    within = inblock(jnp.maximum(v_blocks, 0.0), s_blocks) - inblock(jnp.maximum(-v_blocks, 0.0), s_blocks)
+
+    # pass 2: the carry into each block. The recurrence
+    #   c_{i+1} = has_boundary_i ? within_last_i : c_i + within_last_i
+    # is the affine reset-composition f_i(c) = m_i*c + a_i with m_i = !has_b_i,
+    # a_i = within_last_i — associative, so one associative_scan over the nb
+    # BLOCK SUMMARIES (thousands, not 2^24 rows: compile stays trivial) replaces
+    # a sequential lax.scan that measured ~45% of the kernel at 2^24.
+    has_b = s_blocks.any(axis=1)
+    m = (~has_b).astype(values.dtype)
+    a = within[:, -1]
+
+    def compose(f, g):  # g after f: (m, a) pairs
+        return (g[0] * f[0], g[0] * f[1] + g[1])
+
+    _, cum_a = jax.lax.associative_scan(compose, (m, a))
+    carry = jnp.concatenate([jnp.zeros((1,), values.dtype), cum_a[:-1]])
+
+    # pass 3, fully parallel: rows before a block's first boundary extend the
+    # carried-in segment
+    before_first = jnp.cumsum(s_blocks, axis=1) == 0
+    out = within + jnp.where(before_first, carry[:, None], 0.0)
+    return out.reshape(-1)[:n]
+
+
+def _segment_suffix_sum_nonneg(values: Array, is_last: Array) -> Array:
+    """Within-segment inclusive SUFFIX sum for non-negative values.
+
+    The reversed array's segment boundaries are the reversed ``is_last`` mask,
+    so one reversed prefix scan gives each row the sum of the rows at-or-after
+    it inside its segment — this broadcasts a segment total to every row
+    (``prefix + suffix - value``) without the per-row gather the old
+    r_precision path needed.
+    """
+    rev = lambda x: x[::-1]
+    return rev(_segment_cumsum_nonneg(rev(values), rev(is_last)))
+
+
+# every retrieval metric's per-query value is a segmented-scan read at the
+# segment's last row: the whole family runs with ZERO segment scatters
+# (one or two payload sorts + a handful of cumsum/cummax scans + plain sums)
 _SCAN_METRICS = frozenset(
-    {"average_precision", "reciprocal_rank", "precision", "recall", "hit_rate", "fall_out"}
+    {
+        "average_precision", "reciprocal_rank", "precision", "recall", "hit_rate",
+        "fall_out", "ndcg", "r_precision",
+    }
 )
 
 
@@ -149,7 +196,8 @@ def _scan_retrieval_scores(
 
     if metric == "average_precision":
         contrib = jnp.where(in_k, binary_t * cum_rel_k / rank, 0.0)
-        cum_contrib = segcumsum(contrib)
+        # fractional stream: blocked scan keeps the precision segment-local
+        cum_contrib = _segment_cumsum_float(contrib, new_seg)
         scores = jnp.where(is_last & (cum_rel_k > 0), cum_contrib / jnp.maximum(cum_rel_k, 1.0), 0.0)
         return scores, n_pos, valid
 
@@ -161,6 +209,32 @@ def _scan_retrieval_scores(
         first_rel_pos = jax.lax.cummax(marker)
         first_rel_rank = (first_rel_pos - 1 - seg_start_row + 1).astype(jnp.float32)
         scores = jnp.where(is_last & (n_pos > 0), 1.0 / jnp.maximum(first_rel_rank, 1.0), 0.0)
+        return scores, n_pos, valid
+
+    if metric == "ndcg":
+        # DCG over score-ranked targets; IDCG over value-sorted targets. Both
+        # sorts are query-major with identical segment spans, so the positional
+        # rank/in_k/discount arrays apply unchanged to the ideal layout.
+        t_float = s_target.astype(jnp.float32)
+        disc = 1.0 / jnp.log2(rank.astype(jnp.float32) + 1.0)
+        cum_dcg = _segment_cumsum_float(jnp.where(in_k, t_float * disc, 0.0), new_seg)
+        _, _, s_t2 = jax.lax.sort((indexes, -target, target), num_keys=2, is_stable=True)
+        cum_idcg = _segment_cumsum_float(jnp.where(in_k, s_t2.astype(jnp.float32) * disc, 0.0), new_seg)
+        idcg = jnp.where(is_last, cum_idcg, 0.0)
+        scores = jnp.where(
+            is_last & (idcg > 0), jnp.clip(cum_dcg / jnp.maximum(idcg, 1e-12), 0.0, 1.0), 0.0
+        )
+        return scores, n_pos, valid
+
+    if metric == "r_precision":
+        # relevant among the top-(n_pos) ranked docs; the segment's positive
+        # total reaches every row as prefix + suffix - value (two scans), not
+        # the per-row gather the old segment-reduction path needed
+        suffix = _segment_suffix_sum_nonneg(binary_i, is_last)
+        total = (segcumsum(binary_i) + suffix - binary_i).astype(jnp.float32)
+        in_r = rank.astype(jnp.float32) <= total
+        rel_in_r = segcumsum(binary_i * in_r.astype(jnp.int32)).astype(jnp.float32)
+        scores = jnp.where(is_last & (n_pos > 0), rel_in_r / jnp.maximum(n_pos, 1.0), 0.0)
         return scores, n_pos, valid
 
     count_f = rank.astype(jnp.float32)  # at last row == segment size
@@ -202,103 +276,18 @@ def grouped_retrieval_scores(
     positive targets (used by the caller for ``empty_target_action`` handling;
     for ``fall_out`` it counts negatives).
 
-    ALIGNMENT CONTRACT — the three arrays are mutually aligned, but WHERE a
-    query's entry sits depends on the metric's path:
+    ALIGNMENT CONTRACT: results are ROW-aligned — a query's
+    score/n_positive/valid live at its LAST row in (query, -score) sort order,
+    every other row holds 0 / False. Consumption must be position-agnostic
+    (masked reductions over ``valid``, e.g. ``scores.sum() / valid.sum()``);
+    do NOT slice a prefix (``scores[:n_queries]``).
 
-    - scan metrics (``_SCAN_METRICS``) return ROW-aligned results: a query's
-      score/n_positive/valid live at its LAST row in (query, -score) sort order,
-      every other row holds 0 / False;
-    - ``ndcg`` and ``r_precision`` return SEGMENT-aligned results: entry ``s``
-      is the ``s``-th distinct query in sorted order, trailing slots are 0/False.
-
-    Both shapes are length N and support only position-agnostic consumption
-    (masked reductions over ``valid``, e.g. ``scores.sum() / valid.sum()``).
-    Do NOT slice a prefix (``scores[:n_queries]``) or otherwise assume one of
-    the two layouts. Scan metrics avoid every scatter this way; ndcg (summands
-    may be negative for float targets, breaking the cummax base trick) and
-    r_precision (needs a per-row broadcast of the segment total, i.e. future
-    information) keep the segment-reduction layout below.
+    Every metric takes the scatter-free scan path since round 5: ndcg's
+    possibly-negative float gains run through the blocked segmented cumsum
+    (:func:`_segment_cumsum_float`) and r_precision's segment-total broadcast is a
+    prefix+suffix scan pair, so the 174 ms-per-call ``segment_sum`` scatters at
+    2^24 rows (and the one per-row gather r_precision kept) are gone entirely.
     """
-    if metric in _SCAN_METRICS:
-        return _scan_retrieval_scores(indexes, preds, target, metric, top_k, adaptive_k)
-    n = indexes.shape[0]
-    seg_id, rank, s_preds, s_target, n_seg, seg_count, seg_index = _segment_layout(indexes, preds, target)
-    valid = (seg_count > 0) & (seg_index >= 0)
-    new_seg = rank == 1
-    t = s_target.astype(jnp.float32)
-    binary_t = (s_target > 0).astype(jnp.float32)
-
-    count_f = seg_count.astype(jnp.float32)
-    if top_k is None:
-        k_per_seg = count_f
-        in_k = jnp.ones(n, dtype=bool)
-    else:
-        if adaptive_k:
-            k_per_seg = jnp.minimum(float(top_k), count_f)
-        else:
-            k_per_seg = jnp.full_like(count_f, float(top_k))
-        in_k = rank <= top_k
-
-    seg_sum = partial(jax.ops.segment_sum, segment_ids=seg_id, num_segments=n_seg, indices_are_sorted=True)
-    n_pos = seg_sum(binary_t)
-    n_neg = seg_sum(1.0 - binary_t)
-
-    if metric == "average_precision":
-        # AP = mean over relevant-in-topk of (j / rank_j), j = within-query relevant index
-        cumrel = _segment_cumsum_nonneg(binary_t * in_k, new_seg)
-        contrib = jnp.where(in_k, binary_t * cumrel / rank, 0.0)
-        rel_in_k = seg_sum(binary_t * in_k)
-        scores = jnp.where(rel_in_k > 0, seg_sum(contrib) / jnp.maximum(rel_in_k, 1.0), 0.0)
-        return scores, n_pos, valid
-
-    if metric == "reciprocal_rank":
-        first_rel = jax.ops.segment_min(
-            jnp.where(binary_t > 0, rank, jnp.iinfo(jnp.int32).max),
-            seg_id,
-            num_segments=n_seg,
-            indices_are_sorted=True,
-        )
-        scores = jnp.where(n_pos > 0, 1.0 / jnp.maximum(first_rel, 1).astype(jnp.float32), 0.0)
-        return scores, n_pos, valid
-
-    if metric == "precision":
-        rel_in_k = seg_sum(binary_t * in_k)
-        scores = jnp.where(n_pos > 0, rel_in_k / jnp.maximum(k_per_seg, 1.0), 0.0)
-        return scores, n_pos, valid
-
-    if metric == "recall":
-        rel_in_k = seg_sum(binary_t * in_k)
-        scores = jnp.where(n_pos > 0, rel_in_k / jnp.maximum(n_pos, 1.0), 0.0)
-        return scores, n_pos, valid
-
-    if metric == "hit_rate":
-        rel_in_k = seg_sum(binary_t * in_k)
-        scores = (rel_in_k > 0).astype(jnp.float32)
-        return scores, n_pos, valid
-
-    if metric == "fall_out":
-        # fraction of non-relevant docs retrieved in top-k among all non-relevant
-        nonrel_in_k = seg_sum((1.0 - binary_t) * in_k)
-        scores = jnp.where(n_neg > 0, nonrel_in_k / jnp.maximum(n_neg, 1.0), 0.0)
-        return scores, n_neg, valid  # n_positive slot carries negatives for empty handling
-
-    if metric == "r_precision":
-        # relevant among top-(n_pos) ranked docs; the per-row broadcast of the
-        # segment's positive count is the one gather this path keeps
-        in_r = rank.astype(jnp.float32) <= n_pos[seg_id]
-        rel_in_r = seg_sum(binary_t * in_r)
-        scores = jnp.where(n_pos > 0, rel_in_r / jnp.maximum(n_pos, 1.0), 0.0)
-        return scores, n_pos, valid
-
-    if metric == "ndcg":
-        # DCG over score-ranked targets; IDCG over value-sorted targets
-        disc = 1.0 / jnp.log2(rank.astype(jnp.float32) + 1.0)
-        dcg = seg_sum(jnp.where(in_k, t * disc, 0.0))
-        # ideal ordering: payload sort by (query, -target), same no-gather shape
-        _, _, s_t2 = jax.lax.sort((indexes, -target, target), num_keys=2, is_stable=True)
-        idcg = seg_sum(jnp.where(in_k, s_t2.astype(jnp.float32) * disc, 0.0))
-        scores = jnp.where(idcg > 0, dcg / jnp.maximum(idcg, 1e-12), 0.0)
-        scores = jnp.clip(scores, 0.0, 1.0)
-        return scores, n_pos, valid
-
-    raise ValueError(f"Unknown grouped retrieval metric: {metric}")
+    if metric not in _SCAN_METRICS:
+        raise ValueError(f"Unknown grouped retrieval metric: {metric}")
+    return _scan_retrieval_scores(indexes, preds, target, metric, top_k, adaptive_k)

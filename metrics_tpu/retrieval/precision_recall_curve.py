@@ -39,7 +39,7 @@ def _retrieval_recall_at_fixed_precision(
 
 
 class RetrievalPrecisionRecallCurve(Metric):
-    """Mean precision/recall over queries at every cutoff k = 1..max_k.
+    r"""Mean precision/recall over queries at every cutoff k = 1..max_k.
 
     Args:
         max_k: largest cutoff (default: size of the largest query).
@@ -174,6 +174,28 @@ class RetrievalPrecisionRecallCurve(Metric):
             jnp.arange(1, max_k + 1),
         )
 
+    def plot(self, curve: Optional[Tuple[Array, Array, Array]] = None, ax: Optional[Any] = None):
+        """Draw the mean precision-vs-recall curve over cutoffs k = 1..max_k
+        (reference: retrieval/precision_recall_curve.py ``plot``).
+
+        Example:
+            >>> import jax.numpy as jnp
+            >>> from metrics_tpu.retrieval import RetrievalPrecisionRecallCurve
+            >>> r = RetrievalPrecisionRecallCurve(max_k=4)
+            >>> r.update(jnp.array([0.4, 0.6, 0.3]), jnp.array([1, 0, 1]), indexes=jnp.array([0, 0, 0]))
+            >>> fig, ax = r.plot()
+        """
+        from metrics_tpu.utils.plot import plot_curve
+
+        curve = curve if curve is not None else self.compute()
+        precisions, recalls = curve[0], curve[1]
+        return plot_curve(
+            (recalls, precisions, curve[2]),
+            ax=ax,
+            label_names=("Recall", "Precision"),
+            name=self.__class__.__name__,
+        )
+
 
 class RetrievalRecallAtFixedPrecision(RetrievalPrecisionRecallCurve):
     """Maximum recall at a minimum precision over the k = 1..max_k curve.
@@ -217,3 +239,17 @@ class RetrievalRecallAtFixedPrecision(RetrievalPrecisionRecallCurve):
     def compute(self) -> Tuple[Array, Array]:  # type: ignore[override]
         precision, recall, top_k = super().compute()
         return _retrieval_recall_at_fixed_precision(precision, recall, top_k, self.min_precision)
+
+    def plot(self, val: Optional[Any] = None, ax: Optional[Any] = None):
+        """Scalar plot of the best recall (compute's first element); the parent's
+        curve plot does not apply to this metric's (recall, k) output.
+
+        Example:
+            >>> import jax.numpy as jnp
+            >>> from metrics_tpu.retrieval import RetrievalRecallAtFixedPrecision
+            >>> r = RetrievalRecallAtFixedPrecision(min_precision=0.5)
+            >>> r.update(jnp.array([0.4, 0.6, 0.3]), jnp.array([1, 0, 1]), indexes=jnp.array([0, 0, 0]))
+            >>> fig, ax = r.plot()
+        """
+        val = val if val is not None else self.compute()[0]
+        return Metric.plot(self, val, ax)

@@ -237,3 +237,62 @@ def plot_curve(
     if name is not None:
         ax.set_title(name)
     return fig, ax
+
+
+def plot_reliability_diagram(
+    confidences: Any,
+    accuracies: Any,
+    n_bins: int = 15,
+    ax: Optional[Any] = None,
+    name: Optional[str] = None,
+) -> _PLOT_OUT_TYPE:
+    """Reliability diagram for calibration metrics: per-bin mean accuracy vs
+    confidence bars against the identity diagonal, with a sample-density strip.
+
+    The curve-shaped view of the calibration state the reference never draws
+    (its ``CalibrationError.plot`` is scalar-only); the binning mirrors
+    ``_ce_compute``'s uniform [0, 1] bins so the bars visualize exactly the
+    terms the ECE sums.
+    """
+    _error_on_missing_matplotlib()
+    import matplotlib.pyplot as plt
+
+    conf = _to_np(confidences).reshape(-1)
+    acc = _to_np(accuracies).reshape(-1).astype(np.float64)
+    edges = np.linspace(0.0, 1.0, n_bins + 1)
+    # IDENTICAL binning to _binning_bucketize (searchsorted right - 1): samples
+    # with confidence exactly 1.0 land in a final phantom bucket, drawn as its
+    # own sliver at x = 1.0 so every bar maps 1:1 onto an ECE term
+    ids = np.clip(np.searchsorted(edges, conf, side="right") - 1, 0, n_bins)
+    n_buckets = n_bins + 1
+    bin_acc = np.zeros(n_buckets)
+    bin_conf = np.zeros(n_buckets)
+    bin_count = np.bincount(ids, minlength=n_buckets).astype(np.float64)
+    np.add.at(bin_acc, ids, acc)
+    np.add.at(bin_conf, ids, conf)
+    nonzero = bin_count > 0
+    bin_acc[nonzero] /= bin_count[nonzero]
+    bin_conf[nonzero] /= bin_count[nonzero]
+
+    fig, ax = plt.subplots() if ax is None else (None, ax)
+    width = 1.0 / n_bins
+    centers = np.concatenate([(edges[:-1] + edges[1:]) / 2, [1.0 + width / 4]])
+    widths = np.concatenate([np.full(n_bins, width * 0.9), [width * 0.45]])
+    ax.bar(centers, np.where(nonzero, bin_acc, 0.0), width=widths, label="accuracy", alpha=0.8)
+    ax.plot([0, 1], [0, 1], linestyle="--", linewidth=1, color="gray", label="perfect calibration")
+    # gap markers from bin accuracy to bin confidence (the |acc - conf| ECE terms)
+    for c, a, cf, nz in zip(centers, bin_acc, bin_conf, nonzero):
+        if nz:
+            ax.plot([c, c], [a, cf], color="tab:red", linewidth=2, alpha=0.7)
+    frac = bin_count / max(bin_count.sum(), 1.0)
+    ax.bar(centers, frac * 0.1, width=widths, bottom=-0.12, color="tab:gray", alpha=0.6)
+    # the phantom bucket (confidence exactly 1.0) extends slightly past x=1
+    ax.set_xlim(0.0, 1.0 + (width / 2 if nonzero[-1] else 0.0))
+    ax.set_ylim(-0.13, 1.0)
+    ax.set_xlabel("Confidence")
+    ax.set_ylabel("Accuracy")
+    ax.grid(True, alpha=0.3)
+    ax.legend(loc="upper left")
+    if name is not None:
+        ax.set_title(name)
+    return fig, ax

@@ -126,3 +126,43 @@ def test_collection_plot_grid_and_together():
     assert fig is not None
     with pytest.raises(ValueError, match="together"):
         col.plot(together="yes")
+
+
+def test_plot_retrieval_pr_curve():
+    from metrics_tpu.retrieval import RetrievalPrecisionRecallCurve, RetrievalRecallAtFixedPrecision
+
+    idx = jnp.asarray(np.sort(_rng.randint(0, 8, 64)))
+    preds = jnp.asarray(_rng.rand(64).astype(np.float32))
+    target = jnp.asarray((_rng.rand(64) > 0.5).astype(np.int32))
+    c = RetrievalPrecisionRecallCurve(max_k=6)
+    c.update(preds, target, indexes=idx)
+    fig, ax = c.plot()
+    assert ax.get_xlabel() == "Recall"
+    assert ax.get_ylabel() == "Precision"
+    assert ax.get_title() == "RetrievalPrecisionRecallCurve"
+
+    # the fixed-precision subclass returns (recall, k): scalar plot, not a curve
+    r = RetrievalRecallAtFixedPrecision(min_precision=0.5, max_k=6)
+    r.update(preds, target, indexes=idx)
+    fig, ax = r.plot()
+    assert fig is not None
+
+
+def test_plot_calibration_reliability_diagram():
+    from metrics_tpu.classification import BinaryCalibrationError, MulticlassCalibrationError
+
+    preds = jnp.asarray(_rng.rand(128).astype(np.float32))
+    target = jnp.asarray((_rng.rand(128) > 0.4).astype(np.int32))
+    m = BinaryCalibrationError(n_bins=10)
+    m.update(preds, target)
+    fig, ax = m.plot_reliability_diagram()
+    assert ax.get_xlabel() == "Confidence"
+    assert ax.get_ylabel() == "Accuracy"
+    assert ax.get_title() == "BinaryCalibrationError"
+
+    logits = _rng.rand(64, 3).astype(np.float32)
+    probs = jnp.asarray(logits / logits.sum(1, keepdims=True))
+    mc = MulticlassCalibrationError(num_classes=3, n_bins=8)
+    mc.update(probs, jnp.asarray(_rng.randint(0, 3, 64)))
+    fig, ax = mc.plot_reliability_diagram()
+    assert ax.get_title() == "MulticlassCalibrationError"

@@ -1,0 +1,344 @@
+"""Enumerated parameter grids vs the live reference — the MetricTester-style
+cartesian coverage for the domains whose parity tiers were sampled, not
+enumerated (VERDICT r4 weak #7): regression (multioutput x shapes x kwargs),
+aggregation (nan_strategy x inputs), audio (SDR/SNR config grid x lengths), and
+text (sacrebleu tokenizer x lowercase, ROUGE variants x accumulate, TER/EED/CHRF
+flag grids). Mirrors what the reference's MetricTester enumerates
+(/root/reference/tests/unittests/helpers/testers.py:319-443) as oracle-parity
+parametrizations over identical inputs.
+"""
+import itertools
+import zlib
+
+import numpy as np
+import pytest
+
+from .conftest import assert_close
+
+rng = np.random.RandomState(1234)
+
+
+# ------------------------------------------------------------------ regression
+
+N = 64
+SHAPES = {
+    "1d": (N,),
+    "multioutput": (N, 3),
+    "single_col": (N, 1),
+    "tiny": (4,),
+}
+REG_FNS = [
+    ("mean_squared_error", {}),
+    ("mean_squared_error", {"squared": False}),
+    ("mean_absolute_error", {}),
+    ("mean_absolute_percentage_error", {}),
+    ("symmetric_mean_absolute_percentage_error", {}),
+    ("weighted_mean_absolute_percentage_error", {}),
+    ("log_cosh_error", {}),
+    ("explained_variance", {"multioutput": "raw_values"}),
+    ("explained_variance", {"multioutput": "uniform_average"}),
+    ("explained_variance", {"multioutput": "variance_weighted"}),
+    ("r2_score", {"multioutput": "raw_values"}),
+    ("r2_score", {"multioutput": "uniform_average"}),
+    ("r2_score", {"multioutput": "variance_weighted"}),
+    ("pearson_corrcoef", {}),
+    ("spearman_corrcoef", {}),
+    ("concordance_corrcoef", {}),
+    ("kendall_rank_corrcoef", {"variant": "b"}),
+    ("kendall_rank_corrcoef", {"variant": "a"}),
+    ("kendall_rank_corrcoef", {"variant": "c"}),
+    ("cosine_similarity", {"reduction": "mean"}),
+    ("cosine_similarity", {"reduction": "sum"}),
+    ("cosine_similarity", {"reduction": "none"}),
+    ("minkowski_distance", {"p": 1.0}),
+    ("minkowski_distance", {"p": 2.0}),
+    ("minkowski_distance", {"p": 4.5}),
+]
+REG_GRID = [
+    (name, kwargs, shape_key)
+    for (name, kwargs), shape_key in itertools.product(REG_FNS, SHAPES)
+    # cosine/minkowski need >= 2 feature dims or vector rows; kendall on (N, 1)
+    # IndexErrors in the reference itself (kendall.py:54 deprecated .T path), so
+    # there is no behavior to be parity with; keep the valid cartesian subset
+    if not (name in ("cosine_similarity", "minkowski_distance") and shape_key in ("1d", "tiny"))
+    and not (name == "kendall_rank_corrcoef" and shape_key == "single_col")
+]
+
+
+@pytest.mark.parametrize(("name", "kwargs", "shape_key"), REG_GRID,
+                         ids=[f"{n}-{'-'.join(f'{k}={v}' for k, v in kw.items()) or 'default'}-{s}" for n, kw, s in REG_GRID])
+def test_regression_grid(ref, name, kwargs, shape_key):
+    import jax.numpy as jnp
+    import torch
+
+    import metrics_tpu.functional.regression as F
+
+    shape = SHAPES[shape_key]
+    r = np.random.RandomState(zlib.crc32(str(((name, shape_key))).encode()))
+    preds = r.randn(*shape).astype(np.float32)
+    target = (preds + 0.5 * r.randn(*shape)).astype(np.float32)
+
+    theirs = getattr(ref.functional.regression, name)(torch.from_numpy(preds), torch.from_numpy(target), **kwargs)
+    ours = getattr(F, name)(jnp.asarray(preds), jnp.asarray(target), **kwargs)
+    assert_close(ours, theirs, atol=2e-5, rtol=1e-4)
+
+
+# ----------------------------------------------------------------- aggregation
+
+AGG_GRID = list(itertools.product(
+    ("MeanMetric", "SumMetric", "MaxMetric", "MinMetric", "CatMetric"),
+    ("warn", "ignore", 0.0, 5.5),
+    ("clean", "some_nan", "all_nan_batch"),
+))
+
+
+@pytest.mark.parametrize(("cls_name", "nan_strategy", "data_kind"), AGG_GRID,
+                         ids=[f"{c}-{s}-{d}" for c, s, d in AGG_GRID])
+def test_aggregation_nan_grid(ref, cls_name, nan_strategy, data_kind):
+    import warnings
+
+    import jax.numpy as jnp
+    import torch
+
+    import metrics_tpu as M
+
+    r = np.random.RandomState(zlib.crc32(str(((cls_name, str(nan_strategy), data_kind))).encode()))
+    batches = [r.randn(16).astype(np.float32) for _ in range(3)]
+    if data_kind == "some_nan":
+        for b in batches:
+            b[r.randint(0, 16, 3)] = np.nan
+    elif data_kind == "all_nan_batch":
+        batches[1][:] = np.nan
+
+    theirs_m = getattr(ref, cls_name)(nan_strategy=nan_strategy)
+    ours_m = getattr(M, cls_name)(nan_strategy=nan_strategy)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for b in batches:
+            theirs_m.update(torch.from_numpy(b))
+            ours_m.update(jnp.asarray(b))
+    assert_close(ours_m.compute(), theirs_m.compute(), atol=1e-5, rtol=1e-5, )
+
+
+def test_aggregation_error_strategy_raises(ref):
+    import jax.numpy as jnp
+    import torch
+
+    import metrics_tpu as M
+
+    bad = np.asarray([1.0, np.nan], np.float32)
+    theirs = ref.MeanMetric(nan_strategy="error")
+    ours = M.MeanMetric(nan_strategy="error")
+    with pytest.raises(RuntimeError):
+        theirs.update(torch.from_numpy(bad))
+    with pytest.raises(RuntimeError):
+        ours.update(jnp.asarray(bad))
+
+
+# ----------------------------------------------------------------------- audio
+
+SDR_GRID = list(itertools.product(
+    (None, 10),            # use_cg_iter
+    (False, True),         # zero_mean
+    (512, 128),            # filter_length
+    (False, True),         # load_diag
+    ("short", "long"),     # input length
+))
+
+
+@pytest.mark.parametrize(("use_cg_iter", "zero_mean", "filter_length", "load_diag", "length"), SDR_GRID,
+                         ids=[f"cg={c}-zm={z}-fl={f}-ld={d}-{l}" for c, z, f, d, l in SDR_GRID])
+def test_sdr_grid(ref, use_cg_iter, zero_mean, filter_length, load_diag, length):
+    import jax.numpy as jnp
+    import torch
+
+    from metrics_tpu.functional.audio import signal_distortion_ratio
+
+    n = 3000 if length == "short" else 16000
+    r = np.random.RandomState(zlib.crc32(str(((use_cg_iter, zero_mean, filter_length, load_diag, length))).encode()))
+    target = r.randn(2, n).astype(np.float32)
+    preds = (target + 0.1 * r.randn(2, n)).astype(np.float32)
+    kwargs = dict(
+        use_cg_iter=use_cg_iter,
+        zero_mean=zero_mean,
+        filter_length=filter_length,
+        load_diag=1e-4 if load_diag else None,
+    )
+    theirs = ref.functional.audio.signal_distortion_ratio(torch.from_numpy(preds), torch.from_numpy(target), **kwargs)
+    ours = signal_distortion_ratio(jnp.asarray(preds), jnp.asarray(target), **kwargs)
+    # the toeplitz solve chain is long; both sides are f32 so allow small drift
+    assert_close(ours, theirs, atol=2e-2, rtol=1e-3)
+
+
+SNR_GRID = list(itertools.product(("snr", "si_sdr", "si_snr"), (False, True)))
+
+
+@pytest.mark.parametrize(("which", "zero_mean"), SNR_GRID, ids=[f"{w}-zm={z}" for w, z in SNR_GRID])
+def test_snr_family_grid(ref, which, zero_mean):
+    import jax.numpy as jnp
+    import torch
+
+    import metrics_tpu.functional.audio as FA
+
+    r = np.random.RandomState(zlib.crc32(str(((which, zero_mean))).encode()))
+    target = r.randn(4, 2000).astype(np.float32)
+    preds = (target + 0.3 * r.randn(4, 2000)).astype(np.float32)
+    names = {
+        "snr": "signal_noise_ratio",
+        "si_sdr": "scale_invariant_signal_distortion_ratio",
+        "si_snr": "scale_invariant_signal_noise_ratio",
+    }
+    name = names[which]
+    kwargs = {"zero_mean": zero_mean} if which != "si_snr" else {}
+    if which == "si_snr" and zero_mean:
+        pytest.skip("si_snr has no zero_mean argument")
+    theirs = getattr(ref.functional.audio, name)(torch.from_numpy(preds), torch.from_numpy(target), **kwargs)
+    ours = getattr(FA, name)(jnp.asarray(preds), jnp.asarray(target), **kwargs)
+    assert_close(ours, theirs, atol=1e-4, rtol=1e-4)
+
+
+# ------------------------------------------------------------------------ text
+
+_PREDS = [
+    "the cat is on the mat",
+    "a quick brown fox jumps over the lazy dog",
+    "hello there General Kenobi, you are a bold one",
+    "numbers like 1,234.5 and punct-uation; are hard!",
+]
+_REFS = [
+    ["there is a cat on the mat", "a cat is on the mat"],
+    ["the quick brown fox jumped over the lazy dog"],
+    ["hello there general kenobi you are bold"],
+    ["numbers like 1,234.5 and punctuation are hard"],
+]
+
+SACRE_GRID = list(itertools.product(("13a", "char", "none", "intl"), (False, True), (2, 4)))
+
+
+@pytest.mark.parametrize(("tokenize", "lowercase", "n_gram"), SACRE_GRID,
+                         ids=[f"{t}-lc={l}-n={n}" for t, l, n in SACRE_GRID])
+def test_sacrebleu_grid(ref, tokenize, lowercase, n_gram):
+    import jax.numpy as jnp  # noqa: F401
+
+    import metrics_tpu.functional.text as FT
+
+    try:
+        theirs = ref.functional.text.sacre_bleu_score(
+            _PREDS, _REFS, tokenize=tokenize, lowercase=lowercase, n_gram=n_gram
+        )
+    except (ModuleNotFoundError, ValueError) as e:
+        pytest.skip(f"reference cannot run this tokenizer here: {e}")
+    ours = FT.sacre_bleu_score(_PREDS, _REFS, tokenize=tokenize, lowercase=lowercase, n_gram=n_gram)
+    assert_close(ours, theirs, atol=1e-5)
+
+
+BLEU_GRID = list(itertools.product((1, 2, 3, 4), (False, True)))
+
+
+@pytest.mark.parametrize(("n_gram", "smooth"), BLEU_GRID, ids=[f"n={n}-smooth={s}" for n, s in BLEU_GRID])
+def test_bleu_grid(ref, n_gram, smooth):
+    import metrics_tpu.functional.text as FT
+
+    theirs = ref.functional.text.bleu_score(_PREDS, _REFS, n_gram=n_gram, smooth=smooth)
+    ours = FT.bleu_score(_PREDS, _REFS, n_gram=n_gram, smooth=smooth)
+    assert_close(ours, theirs, atol=1e-5)
+
+
+ROUGE_GRID = list(itertools.product(
+    (("rouge1",), ("rouge2",), ("rougeL",), ("rougeLsum",), ("rouge1", "rouge2", "rougeL")),
+    ("best", "avg"),
+    (False, True),  # use_stemmer
+))
+
+
+@pytest.mark.parametrize(("keys", "accumulate", "use_stemmer"), ROUGE_GRID,
+                         ids=[f"{'-'.join(k)}-{a}-stem={s}" for k, a, s in ROUGE_GRID])
+def test_rouge_grid(ref, keys, accumulate, use_stemmer):
+    import metrics_tpu.functional.text as FT
+
+    try:
+        theirs = ref.functional.text.rouge_score(
+            _PREDS, _REFS, rouge_keys=keys, accumulate=accumulate, use_stemmer=use_stemmer
+        )
+    except (ModuleNotFoundError, ValueError, LookupError, OSError) as e:
+        # rougeLsum needs nltk punkt data, unavailable without egress; the
+        # in-repo rougeLsum is pinned by tests/unittests/text/test_text.py
+        pytest.skip(f"reference rouge unavailable in this config: {e}")
+    ours = FT.rouge_score(_PREDS, _REFS, rouge_keys=keys, accumulate=accumulate, use_stemmer=use_stemmer)
+    assert_close(ours, theirs, atol=1e-5)
+
+
+TER_GRID = list(itertools.product((False, True), (False, True), (False, True)))
+
+
+@pytest.mark.parametrize(("normalize", "no_punctuation", "lowercase"), TER_GRID,
+                         ids=[f"norm={n}-nopunct={p}-lc={l}" for n, p, l in TER_GRID])
+def test_ter_grid(ref, normalize, no_punctuation, lowercase):
+    import metrics_tpu.functional.text as FT
+
+    kwargs = dict(normalize=normalize, no_punctuation=no_punctuation, lowercase=lowercase)
+    theirs = ref.functional.text.translation_edit_rate(_PREDS, _REFS, **kwargs)
+    ours = FT.translation_edit_rate(_PREDS, _REFS, **kwargs)
+    assert_close(ours, theirs, atol=1e-5)
+
+
+CHRF_GRID = [
+    (6, 0, 2.0, False),
+    (6, 2, 2.0, False),
+    (4, 0, 1.0, False),
+    (6, 0, 2.0, True),   # lowercase
+    (6, 2, 3.0, True),
+]
+
+
+@pytest.mark.parametrize(("n_char_order", "n_word_order", "beta", "lowercase"), CHRF_GRID,
+                         ids=[f"c={c}-w={w}-b={b}-lc={l}" for c, w, b, l in CHRF_GRID])
+def test_chrf_grid(ref, n_char_order, n_word_order, beta, lowercase):
+    import metrics_tpu.functional.text as FT
+
+    kwargs = dict(n_char_order=n_char_order, n_word_order=n_word_order, beta=beta, lowercase=lowercase)
+    theirs = ref.functional.text.chrf_score(_PREDS, _REFS, **kwargs)
+    ours = FT.chrf_score(_PREDS, _REFS, **kwargs)
+    assert_close(ours, theirs, atol=1e-5)
+
+
+EED_GRID = [
+    {},
+    {"alpha": 1.0},
+    {"rho": 0.5},
+    {"deletion": 1.0, "insertion": 0.5},
+    {"language": "en"},
+]
+
+
+@pytest.mark.parametrize("kwargs", EED_GRID, ids=[str(sorted(k)) or "default" for k in EED_GRID])
+def test_eed_grid(ref, kwargs):
+    import metrics_tpu.functional.text as FT
+
+    theirs = ref.functional.text.extended_edit_distance(_PREDS, [r[0] for r in _REFS], **kwargs)
+    ours = FT.extended_edit_distance(_PREDS, [r[0] for r in _REFS], **kwargs)
+    assert_close(ours, theirs, atol=1e-5)
+
+
+EDIT_FNS = ("char_error_rate", "word_error_rate", "match_error_rate", "word_information_lost",
+            "word_information_preserved")
+
+
+@pytest.mark.parametrize("name", EDIT_FNS, ids=EDIT_FNS)
+@pytest.mark.parametrize("case", ["plain", "empty_pred", "unicode"])
+def test_edit_distance_grid(ref, name, case):
+    import metrics_tpu.functional.text as FT
+
+    preds = {
+        "plain": ["this is the prediction", "there is an other sample"],
+        "empty_pred": ["", "there is an other sample"],
+        "unicode": ["café naïve résumé", "日本語 テスト"],
+    }[case]
+    target = {
+        "plain": ["this is the reference", "there is another one"],
+        "empty_pred": ["this is the reference", "there is another one"],
+        "unicode": ["cafe naive resume", "日本語 テスト です"],
+    }[case]
+    theirs = getattr(ref.functional.text, name)(preds, target)
+    ours = getattr(FT, name)(preds, target)
+    assert_close(ours, theirs, atol=1e-6)
