@@ -54,6 +54,18 @@ STEPS = 4        # updates per pass -> 4.3e9 preds per pass
 REPEATS = 5
 
 
+def _obs():
+    """Lazy obs import: keeps `bench.py --help` from importing the full package.
+
+    All timed regions run through ``obs.stopwatch`` — one timing code path
+    whether observability is on or off (the headline configs keep it OFF, the
+    bench-parity criterion; ``--obs`` flips it on and the recorded JSON lines
+    then carry the per-metric counter snapshot)."""
+    from metrics_tpu import obs
+
+    return obs
+
+
 def bench_tpu() -> float:
     from metrics_tpu.classification import MulticlassAccuracy
 
@@ -75,18 +87,17 @@ def bench_tpu() -> float:
     jax.device_get(state)  # compile + warm-up; also forces buffer generation
 
     def timed() -> float:
-        t0 = time.perf_counter()
-        last = None
-        for _ in range(REPEATS):
-            state = metric.init_state()
-            for i in range(STEPS):
-                state = update(state, *bufs[i % 2])
-            last = state
-        host_state = jax.device_get(last)  # in-order queue: forces all passes
-        dt = time.perf_counter() - t0
+        with _obs().stopwatch("bench", "accuracy_pass") as sw:
+            last = None
+            for _ in range(REPEATS):
+                state = metric.init_state()
+                for i in range(STEPS):
+                    state = update(state, *bufs[i % 2])
+                last = state
+            host_state = jax.device_get(last)  # in-order queue: forces all passes
         value = float(metric.compute_from(jax.tree.map(jnp.asarray, host_state)))
         assert 0.15 < value < 0.25, f"sanity: uniform 5-class accuracy ~0.2, got {value}"
-        return REPEATS * STEPS * CHUNK / dt
+        return REPEATS * STEPS * CHUNK / sw.elapsed
 
     timed()  # discard first timed pass (queue warm-up)
     return statistics.median(timed() for _ in range(3))
@@ -125,15 +136,14 @@ def bench_tpu_logits(n: int = 1 << 27, num_classes: int = 5, steps: int = 32, tr
     jax.device_get(state)
 
     def timed() -> float:
-        t0 = time.perf_counter()
-        state = metric.init_state()
-        for i in range(steps):
-            state = update(state, *bufs[i % 2])
-        jax.device_get(state)
-        dt = time.perf_counter() - t0
+        with _obs().stopwatch("bench", "logits_pass") as sw:
+            state = metric.init_state()
+            for i in range(steps):
+                state = update(state, *bufs[i % 2])
+            jax.device_get(state)
         value = float(metric.compute_from(jax.tree.map(jnp.asarray, state)))
         assert 0.15 < value < 0.25, f"sanity: uniform 5-class accuracy ~0.2, got {value}"
-        return steps * n / dt
+        return steps * n / sw.elapsed
 
     timed()  # queue warm-up
     tpu_eps = statistics.median(timed() for _ in range(trials))
@@ -403,12 +413,12 @@ def bench_ssim(batch: int = 128, hw: int = 256, repeats: int = 16, trials: int =
     jax.device_get(state)
 
     def timed() -> float:
-        t0 = time.perf_counter()
-        state = metric.init_state()
-        for _ in range(repeats):
-            state = update(state, imgs1, imgs2)
-        jax.device_get(state)
-        return repeats * batch * 3 * hw * hw / (time.perf_counter() - t0)
+        with _obs().stopwatch("bench", "ssim_pass") as sw:
+            state = metric.init_state()
+            for _ in range(repeats):
+                state = update(state, imgs1, imgs2)
+            jax.device_get(state)
+        return repeats * batch * 3 * hw * hw / sw.elapsed
 
     timed()  # queue warm-up
     px_per_s = statistics.median(timed() for _ in range(trials))
@@ -474,14 +484,14 @@ def bench_fid(batch: int = 256, n_batches: int = 12, hw: int = 299, trials: int 
         jax.device_get(state["fake_features_num_samples"])  # compile warm-up both branches
 
         def timed():
-            t0 = time.perf_counter()
-            state = fid.init_state()
-            for i in range(n_batches):
-                state = (upd_real if i % 2 == 0 else upd_fake)(state, imgs)
-            # fetch a scalar: the in-order queue syncs the whole dispatch chain,
-            # without pulling the 16 MB m2 buffer over the tunnel inside the timed region
-            jax.device_get(state["fake_features_num_samples"])
-            return n_batches * batch / (time.perf_counter() - t0), state
+            with _obs().stopwatch("bench", "fid_pass") as sw:
+                state = fid.init_state()
+                for i in range(n_batches):
+                    state = (upd_real if i % 2 == 0 else upd_fake)(state, imgs)
+                # fetch a scalar: the in-order queue syncs the whole dispatch chain,
+                # without pulling the 16 MB m2 buffer over the tunnel inside the timed region
+                jax.device_get(state["fake_features_num_samples"])
+            return n_batches * batch / sw.elapsed, state
 
         timed()  # queue warm-up
         rates = []
@@ -576,12 +586,12 @@ def bench_confmat(n: int = 1 << 26, num_classes: int = 64, repeats: int = 10) ->
     jax.device_get(state["confmat"][0, 0])
 
     def timed():
-        t0 = time.perf_counter()
-        st = metric.init_state()
-        for _ in range(repeats):
-            st = update(st, preds, target)
-        jax.device_get(st["confmat"][0, 0])
-        return repeats * n / (time.perf_counter() - t0), st
+        with _obs().stopwatch("bench", "confmat_pass") as sw:
+            st = metric.init_state()
+            for _ in range(repeats):
+                st = update(st, preds, target)
+            jax.device_get(st["confmat"][0, 0])
+        return repeats * n / sw.elapsed, st
 
     timed()
     samples = [timed() for _ in range(3)]
@@ -630,11 +640,11 @@ def bench_auroc(n: int = 1 << 24, queue_depth: int = 4) -> dict:
     jax.device_get(binary_auroc_exact(preds, target))  # compile + warm
 
     def timed() -> float:
-        t0 = time.perf_counter()
-        vals = [binary_auroc_exact(preds, target) for _ in range(queue_depth)]
-        val = float(vals[-1])  # in-order queue: one fetch syncs the whole chain
+        with _obs().stopwatch("bench", "auroc_pass") as sw:
+            vals = [binary_auroc_exact(preds, target) for _ in range(queue_depth)]
+            val = float(vals[-1])  # in-order queue: one fetch syncs the whole chain
         assert 0.45 < val < 0.55, f"sanity: random scores give AUROC ~0.5, got {val}"
-        return queue_depth * n / (time.perf_counter() - t0)
+        return queue_depth * n / sw.elapsed
 
     timed()  # queue warm-up
     rate = statistics.median(timed() for _ in range(3))
@@ -695,10 +705,10 @@ def bench_retrieval(n_docs: int = 1 << 24, trials: int = 5) -> dict:
 
     rates = []
     for _ in range(trials):
-        t0 = time.perf_counter()
-        state = update(metric.init_state(), scores, rel, idx)
-        value = float(metric.compute_from(state))
-        rates.append(n_docs / (time.perf_counter() - t0))
+        with _obs().stopwatch("bench", "retrieval_pass") as sw:
+            state = update(metric.init_state(), scores, rel, idx)
+            value = float(metric.compute_from(state))
+        rates.append(n_docs / sw.elapsed)
     assert 0.0 < value < 1.0
 
     # NDCG on the unified scan path (round 5: sign-split segmented cumsum; the
@@ -752,7 +762,17 @@ if __name__ == "__main__":
         choices=("accuracy", "logits", "confmat", "map", "ssim", "retrieval", "auroc", "fid", "all"),
         default="all",
     )
-    config = parser.parse_args().config
+    parser.add_argument(
+        "--obs",
+        action="store_true",
+        help="enable metrics_tpu.obs for the run: timed regions record into the"
+        " registry and every JSON line carries the counter snapshot (headline"
+        " numbers are recorded with obs OFF — the zero-overhead default)",
+    )
+    cli = parser.parse_args()
+    config = cli.config
+    if cli.obs:
+        _obs().enable(clear=True)
 
     def bench_headline() -> dict:
         tpu_eps = bench_tpu()
@@ -787,6 +807,8 @@ if __name__ == "__main__":
                 summary[result["metric"]] = {
                     "value": result["value"], "unit": result["unit"], "vs_baseline": result["vs_baseline"]
                 }
+                if cli.obs:
+                    result["obs"] = _obs().snapshot()
                 print(json.dumps(result), flush=True)
             except Exception as e:  # noqa: BLE001 — one failed config must not hide the rest
                 summary[name] = {"error": f"{type(e).__name__}: {e}"}
@@ -795,4 +817,5 @@ if __name__ == "__main__":
     # truncated round 4's artifact and lost the headline number — every metric
     # must survive in the LAST line (VERDICT r4 weak #2)
     print(json.dumps({"metric": "summary_all_configs", "value": len(summary), "unit": "configs",
-                      "vs_baseline": None, "summary": summary}), flush=True)
+                      "vs_baseline": None, "summary": summary,
+                      "obs": _obs().export_snapshot()}), flush=True)

@@ -19,7 +19,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu.core.metric import Metric
-from metrics_tpu.utils.data import _flatten_dict, allclose
+from metrics_tpu.obs import registry as _obs
+from metrics_tpu.obs import scopes as _obs_scopes
+from metrics_tpu.utils.data import _flatten_dict, _squeeze_if_scalar, allclose
 from metrics_tpu.utils.prints import rank_zero_warn
 
 
@@ -73,14 +75,12 @@ class MetricCollection:
         self._modules[key] = value
         # keep groups in sync with direct assignment: with static groups the
         # leader-only update fast path applies from the first update, so a
-        # metric outside every group would silently never be updated.
+        # metric outside every group would silently never be updated. This
+        # includes explicit `compute_groups` lists: _init_compute_groups gives
+        # any uncovered member its own singleton group.
         # add_metrics assigns in a loop and re-derives ONCE at the end
         # (_in_add_metrics guard), so bulk adds stay one O(n^2) pass.
-        if (
-            getattr(self, "_groups_checked", False)
-            and not getattr(self, "_in_add_metrics", False)
-            and not isinstance(self._enable_compute_groups, list)
-        ):
+        if getattr(self, "_groups_checked", False) and not getattr(self, "_in_add_metrics", False):
             self._init_compute_groups()
 
     def __len__(self) -> int:
@@ -92,10 +92,65 @@ class MetricCollection:
     # ------------------------------------------------------------------- flow
 
     def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
-        """Forward every metric; returns renamed result dict (reference: :173-183)."""
-        res = {k: m(*args, **m._filter_kwargs(**kwargs)) for k, m in self.items(keep_base=True, copy_state=False)}
+        """Forward every metric; returns renamed result dict (reference: :173-183).
+
+        With static compute groups, only group leaders run the accumulation
+        update (members are re-pointed at the leader's state, exactly like
+        :meth:`update`'s fast path); per-member batch values are evaluated from
+        one shared batch-local state. Forwarding every member individually would
+        rebind each member's state attrs and permanently split every group on
+        the first ``forward`` call.
+        """
+        if _obs._ENABLED:
+            with _obs_scopes.annotate("tm.collection.forward"):
+                return self._forward_impl(*args, **kwargs)
+        return self._forward_impl(*args, **kwargs)
+
+    def _forward_impl(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        if self._groups_checked and not (self._validate_groups_runtime and not self._groups_validated):
+            res = self._forward_grouped(*args, **kwargs)
+        else:
+            res = {
+                k: m(*args, **m._filter_kwargs(**kwargs))
+                for k, m in self.items(keep_base=True, copy_state=False)
+            }
         res = _flatten_dict(res)
         return {self._set_name(k): v for k, v in res.items()}
+
+    def _forward_grouped(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        """Leader-only forward: one update per group, member batch values from a
+        shared batch-local state.
+
+        In both of ``Metric.forward``'s strategies the returned batch value is
+        the metric's compute over the batch-only state (metric.py:434-487), so a
+        member's batch value is ``member.compute_from(batch_state)`` for the
+        batch state its leader produced — members never touch their own state
+        attrs and keep aliasing the leader. Groups containing a
+        ``dist_sync_on_step`` metric keep the per-member path (their batch value
+        syncs eagerly inside ``forward``), at the cost of splitting that group.
+        """
+        self._split_diverged_members()
+        res: Dict[str, Any] = {}
+        for cg in self._groups.values():
+            m0 = self._modules[cg[0]]
+            if len(cg) == 1 or any(self._modules[n].dist_sync_on_step for n in cg):
+                for name in cg:
+                    m = self._modules[name]
+                    res[name] = m(*args, **m._filter_kwargs(**kwargs))
+                continue
+            filtered = m0._filter_kwargs(**kwargs)
+            batch_state = m0.local_update(m0.init_state(), *args, **filtered)
+            m0.update(*args, **filtered)
+            for name in cg:
+                mi = self._modules[name]
+                val = _squeeze_if_scalar(mi.compute_from(batch_state))
+                mi._forward_cache = val
+                mi._computed = None
+                res[name] = val
+        # re-point members at the leader's freshly-updated state
+        self._state_is_copy = False
+        self._compute_groups_create_state_ref()
+        return res
 
     def __call__(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
         return self.forward(*args, **kwargs)
@@ -110,6 +165,13 @@ class MetricCollection:
         to re-enable that data-compare as a first-update validation pass that
         warns when it disagrees with the static derivation.
         """
+        if _obs._ENABLED:
+            with _obs_scopes.annotate("tm.collection.update"):
+                self._update_impl(*args, **kwargs)
+            return
+        self._update_impl(*args, **kwargs)
+
+    def _update_impl(self, *args: Any, **kwargs: Any) -> None:
         if self._groups_checked:
             if self._validate_groups_runtime and not self._groups_validated:
                 self._validate_groups_against_runtime(*args, **kwargs)
@@ -243,11 +305,18 @@ class MetricCollection:
 
     @classmethod
     def _fallback_signature_attrs(cls, m: Metric):
+        # "update"/"compute" are the per-instance wrapped bound closures
+        # Metric.__init__ shadows onto every instance — always unique objects,
+        # so including them made the identity comparison below fail for EVERY
+        # pair and the conservative fallback could never merge anything
         return tuple(
             sorted(
                 k
                 for k in vars(m)
-                if not k.startswith("_") and k not in m._defaults and k not in cls._GROUP_IRRELEVANT_ATTRS
+                if not k.startswith("_")
+                and k not in ("update", "compute")
+                and k not in m._defaults
+                and k not in cls._GROUP_IRRELEVANT_ATTRS
             )
         )
 
@@ -268,8 +337,8 @@ class MetricCollection:
                 return False
         return True
 
-    @staticmethod
-    def _attr_equal(a, b) -> bool:
+    @classmethod
+    def _attr_equal(cls, a, b) -> bool:
         if a is b:
             return True
         if type(a) != type(b):
@@ -278,6 +347,11 @@ class MetricCollection:
             return a.shape == b.shape and bool(np.array_equal(np.asarray(a), np.asarray(b)))
         if callable(a):
             return False  # identity already failed; unequal objects stay split
+        if isinstance(a, (list, tuple)):
+            # recurse per element: plain `a == b` would route Metric elements
+            # through Metric.__eq__, whose CompositionalMetric result is always
+            # truthy — two lists of DIFFERENT metrics would compare "equal"
+            return len(a) == len(b) and all(cls._attr_equal(x, y) for x, y in zip(a, b))
         try:
             return bool(a == b)
         except Exception:  # noqa: BLE001 — incomparable values must split, not crash
@@ -369,9 +443,22 @@ class MetricCollection:
         self._state_is_copy = copy
 
     def compute(self) -> Dict[str, Any]:
-        res = {k: m.compute() for k, m in self.items(keep_base=True, copy_state=False)}
+        if _obs._ENABLED:
+            with _obs_scopes.annotate("tm.collection.compute"):
+                res = {k: m.compute() for k, m in self.items(keep_base=True, copy_state=False)}
+        else:
+            res = {k: m.compute() for k, m in self.items(keep_base=True, copy_state=False)}
         res = _flatten_dict(res)
         return {self._set_name(k): v for k, v in res.items()}
+
+    def summary(self) -> Dict[str, Any]:
+        """Structured HBM/sharding/topology report for the whole collection:
+        per-metric :meth:`Metric.state_report` rows, the compute-group topology,
+        and the bytes the static grouping deduplicates. Render with
+        ``metrics_tpu.utils.prints.render_collection_summary``."""
+        from metrics_tpu.obs.report import collection_summary
+
+        return collection_summary(self)
 
     # ------------------------------------------------------- pure-functional tier
 
@@ -513,7 +600,8 @@ class MetricCollection:
         no device ``allclose`` compares.
         """
         if isinstance(self._enable_compute_groups, list):
-            self._groups = dict(enumerate(self._enable_compute_groups))
+            self._groups = dict(enumerate(list(v) for v in self._enable_compute_groups))
+            covered = set()
             for v in self._groups.values():
                 for metric in v:
                     if metric not in self:
@@ -521,6 +609,14 @@ class MetricCollection:
                             f"Input {metric} in `compute_groups` argument does not match a metric in the collection."
                             f" Please make sure that {self._enable_compute_groups} matches {self.keys(keep_base=True)}"
                         )
+                    covered.add(metric)
+            # a member no explicit group mentions would otherwise never be
+            # updated by the leader-only fast path — it becomes its own
+            # singleton group (covers add_metrics and __setitem__ after an
+            # explicit compute_groups list)
+            for key in self._modules:
+                if key not in covered:
+                    self._groups[len(self._groups)] = [str(key)]
             self._groups_checked = True
         else:
             self._groups = {i: [str(k)] for i, k in enumerate(self._modules.keys())}

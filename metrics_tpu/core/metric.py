@@ -40,6 +40,9 @@ import numpy as np
 from jax import Array
 
 from metrics_tpu.core.state import CatBuffer, cat_merge
+from metrics_tpu.obs import recompile as _obs_recompile
+from metrics_tpu.obs import registry as _obs
+from metrics_tpu.obs import scopes as _obs_scopes
 from metrics_tpu.parallel import collective
 from metrics_tpu.utils.checks import _is_concrete
 from metrics_tpu.utils.data import (
@@ -364,7 +367,16 @@ class Metric(ABC):
         def wrapped_func(*args: Any, **kwargs: Any) -> None:
             self._computed = None
             self._update_count += 1
-            update(*args, **kwargs)
+            # single-boolean gate: the disabled path must stay a no-op
+            # (bench-parity criterion; tests/unittests/obs/test_obs.py)
+            if _obs._ENABLED:
+                name = type(self).__name__
+                _obs.REGISTRY.inc(name, "updates")
+                _obs_recompile.check_update(self, args, kwargs)
+                with _obs_scopes.update_scope(name):
+                    update(*args, **kwargs)
+            else:
+                update(*args, **kwargs)
             if self.compute_on_cpu:
                 self._move_list_states_to_cpu()
 
@@ -388,6 +400,8 @@ class Metric(ABC):
                     MetricsUserWarning,
                 )
             if self._computed is not None:
+                if _obs._ENABLED:
+                    _obs.REGISTRY.inc(type(self).__name__, "compute_cache_hits")
                 return self._computed
 
             for attr in self._defaults:
@@ -408,7 +422,13 @@ class Metric(ABC):
                 should_sync=self._to_sync,
                 should_unsync=self._should_unsync,
             ):
-                value = compute(*args, **kwargs)
+                if _obs._ENABLED:
+                    name = type(self).__name__
+                    _obs.REGISTRY.inc(name, "computes")
+                    with _obs_scopes.compute_scope(name):
+                        value = compute(*args, **kwargs)
+                else:
+                    value = compute(*args, **kwargs)
                 self._computed = _squeeze_if_scalar(value)
 
             return self._computed
@@ -425,6 +445,8 @@ class Metric(ABC):
                 "The Metric shouldn't be synced when performing ``forward``. "
                 "HINT: Did you forget to call ``unsync``?"
             )
+        if _obs._ENABLED:
+            _obs.REGISTRY.inc(type(self).__name__, "forwards")
         if self.full_state_update or self.full_state_update is None or self.dist_sync_on_step:
             self._forward_cache = self._forward_full_state_update(*args, **kwargs)
         else:
@@ -594,6 +616,8 @@ class Metric(ABC):
             dist_sync_fn = gather_all_tensors
 
         self._cache = {attr: getattr(self, attr) for attr in self._defaults}
+        if _obs._ENABLED:
+            _obs.REGISTRY.inc(type(self).__name__, "syncs")
         self._sync_dist(dist_sync_fn, process_group=process_group or self.process_group)
         self._is_synced = True
 
@@ -658,10 +682,23 @@ class Metric(ABC):
             legend_name=self.plot_legend_name,
         )
 
+    # ------------------------------------------------------------------- obs
+
+    def state_report(self) -> Dict[str, Any]:
+        """Structured HBM/sharding report: one row per registered state with
+        dtype, shape, nbytes, sharding spec and (for CatBuffer states) fill vs
+        capacity. Render with ``metrics_tpu.utils.prints.render_state_report``.
+        """
+        from metrics_tpu.obs.report import metric_state_report
+
+        return metric_state_report(self)
+
     # ----------------------------------------------------------------- reset
 
     def reset(self) -> None:
         """Restore default states (reference: metric.py:615-630)."""
+        if _obs._ENABLED:
+            _obs.REGISTRY.inc(type(self).__name__, "resets")
         self._update_count = 0
         self._forward_cache = None
         self._computed = None

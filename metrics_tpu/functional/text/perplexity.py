@@ -73,7 +73,7 @@ def perplexity(preds: Array, target: Array, ignore_index: Optional[int] = None) 
         >>> target = jax.random.randint(jax.random.PRNGKey(89), (2, 8), 0, 5)
         >>> target = target.at[0, 6:].set(-100)
         >>> perplexity(preds, target, ignore_index=-100)
-        Array(4.988..., dtype=float32)
+        Array(5.20..., dtype=float32)
     """
     total, count = _perplexity_update(preds, target, ignore_index)
     return _perplexity_compute(total, count)

@@ -1,0 +1,74 @@
+"""metrics_tpu.obs — zero-overhead instrumentation layer.
+
+Quickstart::
+
+    import metrics_tpu.obs as obs
+
+    obs.enable()                      # counters + scopes + retrace detection
+    metric.update(preds, target)      # counted, annotated, fingerprinted
+    obs.snapshot()                    # {"MulticlassAccuracy": {"updates": 1}, ...}
+    metric.state_report()             # per-state dtype/shape/nbytes/sharding/fill
+
+    with obs.trace("/tmp/profile"):   # one-call XProf capture; the trace shows
+        eval_step()                   # tm.update/<Metric> and tm.sync/<fx> scopes
+
+Off by default: with obs disabled every instrumented hot path reduces to a
+single boolean check (see ``registry.py``), keeping the library's measured
+throughput identical to the uninstrumented build.
+"""
+from metrics_tpu.obs.registry import (
+    REGISTRY,
+    ObsRegistry,
+    disable,
+    enable,
+    enabled,
+    observe,
+    snapshot,
+    snapshot_json,
+)
+from metrics_tpu.obs import recompile, registry
+from metrics_tpu.obs.export import dump_jsonl
+from metrics_tpu.obs.export import snapshot as export_snapshot
+from metrics_tpu.obs.recompile import RETRACE_WARN_THRESHOLD, fingerprint, reset_detector
+from metrics_tpu.obs.report import collection_summary, metric_state_report
+from metrics_tpu.obs.scopes import (
+    annotate,
+    compute_scope,
+    forward_scope,
+    sync_scope,
+    trace,
+    update_scope,
+)
+
+
+def stopwatch(scope: str, name: str = "elapsed"):
+    """Module-level shortcut for ``REGISTRY.stopwatch`` (used by bench.py)."""
+    return REGISTRY.stopwatch(scope, name)
+
+
+__all__ = [
+    "REGISTRY",
+    "RETRACE_WARN_THRESHOLD",
+    "ObsRegistry",
+    "annotate",
+    "collection_summary",
+    "compute_scope",
+    "disable",
+    "dump_jsonl",
+    "enable",
+    "enabled",
+    "export_snapshot",
+    "fingerprint",
+    "forward_scope",
+    "metric_state_report",
+    "observe",
+    "recompile",
+    "registry",
+    "reset_detector",
+    "snapshot",
+    "snapshot_json",
+    "stopwatch",
+    "sync_scope",
+    "trace",
+    "update_scope",
+]

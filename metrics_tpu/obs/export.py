@@ -1,0 +1,28 @@
+"""Registry snapshot export: JSONL dumps for offline analysis / dashboards.
+
+One registry snapshot == one JSON line, so a long-running eval can append a line
+per epoch and the file stays grep/pandas-friendly. ``bench.py`` embeds the same
+snapshot dict in its recorded JSON lines.
+"""
+import json
+import time
+from typing import Any, Dict, Optional
+
+from metrics_tpu.obs import registry as _reg
+
+
+def snapshot(extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Registry contents as one JSON-serializable dict (plus caller extras)."""
+    out: Dict[str, Any] = {"enabled": _reg.enabled(), "registry": _reg.snapshot()}
+    if extra:
+        out.update(extra)
+    return out
+
+
+def dump_jsonl(path: str, extra: Optional[Dict[str, Any]] = None, clock: Any = time.time) -> Dict[str, Any]:
+    """Append one snapshot line to ``path``; returns the dict that was written."""
+    record = snapshot(extra)
+    record["time_unix"] = float(clock())
+    with open(path, "a") as fh:
+        fh.write(json.dumps(record, sort_keys=True, default=str) + "\n")
+    return record

@@ -1,0 +1,109 @@
+"""Retrace / compile-storm detection.
+
+The classic silent TPU perf bug: a metric fed slightly different abstract shapes
+(ragged last batch, a dtype flip, a Python-scalar-vs-array argument) retraces and
+recompiles on every step. XLA gives no warning; the job just runs 100x slower.
+
+Detection is host-side and cheap: every instrumented ``update`` fingerprints the
+**abstract** structure of its inputs (pytree paths + shapes + dtypes — never
+values, never device syncs). A metric instance that accumulates more than one
+distinct fingerprint is retracing its jitted update; past
+``RETRACE_WARN_THRESHOLD`` distinct fingerprints it is in a compile storm and a
+rate-limited warning (once per instance) names the offending metric and the
+fingerprints seen. ``jax.monitoring`` compile events, when available, are
+counted alongside (``registry._register_compile_listener``) as corroboration.
+"""
+import warnings
+from typing import Any, Tuple
+
+import numpy as np
+
+from metrics_tpu.obs import registry as _reg
+
+#: Distinct input fingerprints at which a metric is declared "storming".
+RETRACE_WARN_THRESHOLD = 2
+
+
+def _fingerprint_leaf(x: Any) -> Tuple:
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        return ("arr", tuple(shape), str(dtype))
+    if isinstance(x, (list, tuple)):
+        return (type(x).__name__,) + tuple(_fingerprint_leaf(v) for v in x)
+    if isinstance(x, dict):
+        return ("dict",) + tuple((k, _fingerprint_leaf(x[k])) for k in sorted(map(str, x)))
+    if isinstance(x, (bool, int, float, str, bytes, type(None))):
+        # static values participate in the jit cache key, so a varying Python
+        # scalar is itself a retrace source — fingerprint the value
+        return ("py", type(x).__name__, x)
+    return ("obj", type(x).__name__)
+
+
+def fingerprint(args: Tuple, kwargs: dict) -> Tuple:
+    """Abstract (shape/dtype/structure) fingerprint of an update's inputs."""
+    return (
+        tuple(_fingerprint_leaf(a) for a in args),
+        tuple((k, _fingerprint_leaf(kwargs[k])) for k in sorted(kwargs)),
+    )
+
+
+def check_update(metric: Any, args: Tuple, kwargs: dict) -> None:
+    """Record one update's input fingerprint on ``metric``; warn on a storm.
+
+    Called from ``Metric._wrap_update`` only when obs is enabled. State lives on
+    the instance (``_obs_fingerprints`` / ``_obs_retrace_warned``) so detector
+    lifetime matches metric lifetime with no global id() maps.
+    """
+    fp = fingerprint(args, kwargs)
+    seen = metric.__dict__.get("_obs_fingerprints")
+    if seen is None:
+        seen = set()
+        object.__setattr__(metric, "_obs_fingerprints", seen)
+    if fp in seen:
+        return
+    first = not seen
+    seen.add(fp)
+    name = type(metric).__name__
+    if not first:
+        _reg.REGISTRY.inc(name, "retraces")
+    if len(seen) > RETRACE_WARN_THRESHOLD and not metric.__dict__.get("_obs_retrace_warned", False):
+        object.__setattr__(metric, "_obs_retrace_warned", True)
+        _reg.REGISTRY.inc(name, "retrace_warnings")
+        shapes = _summarize(seen)
+        warnings.warn(
+            f"metrics_tpu.obs: compile storm suspected — `{name}.update` has now seen"
+            f" {len(seen)} distinct input shape/dtype signatures ({shapes}). Every new"
+            " signature retraces and recompiles the jitted update. Pad inputs to a"
+            " fixed shape (or bucket them) to stop the recompilation.",
+            RuntimeWarning,
+            stacklevel=3,
+        )
+
+
+def _summarize(seen: set, limit: int = 4) -> str:
+    def leaf_shapes(fp: Tuple) -> str:
+        arrs = [t for t in fp[0] if isinstance(t, tuple) and t and t[0] == "arr"]
+        return "/".join("x".join(map(str, t[1])) + f":{t[2]}" for t in arrs) or "<no-arrays>"
+
+    items = sorted(leaf_shapes(fp) for fp in seen)
+    head = ", ".join(items[:limit])
+    return head + (f", ... +{len(items) - limit} more" if len(items) > limit else "")
+
+
+def reset_detector(metric: Any) -> None:
+    """Forget a metric's fingerprint history (used by tests)."""
+    metric.__dict__.pop("_obs_fingerprints", None)
+    metric.__dict__.pop("_obs_retrace_warned", None)
+
+
+def nbytes_of(x: Any) -> int:
+    """Static (trace-safe) byte size of an array-like; 0 when unknowable."""
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    try:
+        return int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+    except Exception:  # noqa: BLE001 — exotic dtypes must not break accounting
+        return 0

@@ -1,0 +1,168 @@
+"""Process-global observability registry: per-metric counters and timers.
+
+Off by default. The hot paths in ``core/metric.py`` and ``parallel/collective.py``
+gate every registry touch behind a single module-attribute boolean check
+(``if registry._ENABLED:``), so the disabled path costs one dict-free attribute
+load and nothing else — no locks, no allocations, no device syncs (verified by
+``tests/unittests/obs/test_obs.py::test_disabled_mode_writes_nothing`` and the
+bench-parity criterion in ISSUE 1).
+
+Counting semantics: counters count **host-level events**. A metric update that
+runs eagerly counts once per call; the same update traced into a ``jit``/
+``shard_map`` program counts once per *trace* (XLA executions are invisible to
+host code). Retrace detection (``recompile.py``) exists precisely because the
+trace-time view is the one that matters for compile storms.
+"""
+import json
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, Iterator, Optional
+
+# Single boolean the instrumented hot paths check. Module attribute (not a
+# function) so the disabled cost is one LOAD_ATTR.
+_ENABLED: bool = False
+
+
+class _Stopwatch:
+    """Result object of :func:`ObsRegistry.stopwatch` — ``elapsed`` in seconds."""
+
+    __slots__ = ("elapsed", "_t0")
+
+    def __init__(self) -> None:
+        self.elapsed: float = 0.0
+        self._t0: float = 0.0
+
+
+class ObsRegistry:
+    """Thread-safe counter/timer store keyed by ``(scope, name)``.
+
+    ``scope`` is typically a metric class name (``"MulticlassAccuracy"``) or a
+    subsystem (``"sync"``, ``"jax"``); ``name`` is the event (``"updates"``,
+    ``"retraces"``, ``"bytes_gathered"``...). Timers accumulate
+    ``{count, total_s, max_s}`` per key.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[tuple, float] = {}
+        self._timers: Dict[tuple, Dict[str, float]] = {}
+
+    # ----------------------------------------------------------- counters
+
+    def inc(self, scope: str, name: str, value: float = 1) -> None:
+        key = (scope, name)
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0) + value
+
+    def get(self, scope: str, name: str, default: float = 0) -> float:
+        return self._counters.get((scope, name), default)
+
+    # ------------------------------------------------------------- timers
+
+    def observe_duration(self, scope: str, name: str, seconds: float) -> None:
+        key = (scope, name)
+        with self._lock:
+            t = self._timers.setdefault(key, {"count": 0, "total_s": 0.0, "max_s": 0.0})
+            t["count"] += 1
+            t["total_s"] += seconds
+            t["max_s"] = max(t["max_s"], seconds)
+
+    @contextmanager
+    def stopwatch(self, scope: str, name: str) -> Iterator[_Stopwatch]:
+        """Always measures wall time (``sw.elapsed``); records into the registry
+        only when obs is enabled, so callers (e.g. ``bench.py``) can time through
+        one code path whether or not observability is on."""
+        sw = _Stopwatch()
+        sw._t0 = time.perf_counter()
+        try:
+            yield sw
+        finally:
+            sw.elapsed = time.perf_counter() - sw._t0
+            if _ENABLED:
+                self.observe_duration(scope, name, sw.elapsed)
+
+    # ------------------------------------------------------------ export
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """Nested ``{scope: {name: value}}`` view; timers appear under
+        ``{scope: {name: {count, total_s, max_s}}}``."""
+        out: Dict[str, Dict[str, Any]] = {}
+        with self._lock:
+            for (scope, name), value in self._counters.items():
+                out.setdefault(scope, {})[name] = value
+            for (scope, name), t in self._timers.items():
+                out.setdefault(scope, {})[name] = dict(t)
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._timers.clear()
+
+
+#: The process-global registry instance the instrumented runtime writes into.
+REGISTRY = ObsRegistry()
+
+_compile_listener_registered = False
+
+
+def _register_compile_listener() -> None:
+    """Best-effort hook on jax.monitoring compile events (idempotent).
+
+    The listener itself is gated on ``_ENABLED`` so a later ``disable()`` stops
+    the accounting without touching other libraries' listeners."""
+    global _compile_listener_registered
+    if _compile_listener_registered:
+        return
+    try:
+        from jax import monitoring
+
+        def _on_duration(event: str, duration: float, **kw: Any) -> None:
+            if _ENABLED and "compile" in event:
+                REGISTRY.inc("jax", "compile_events")
+                REGISTRY.observe_duration("jax", "compile_time", duration)
+
+        monitoring.register_event_duration_secs_listener(_on_duration)
+        _compile_listener_registered = True
+    except Exception:  # noqa: BLE001 — observability must never break the runtime
+        pass
+
+
+def enable(clear: bool = False) -> None:
+    """Turn the instrumentation layer on (counters, scopes, retrace detection)."""
+    global _ENABLED
+    if clear:
+        REGISTRY.clear()
+    _register_compile_listener()
+    _ENABLED = True
+
+
+def disable() -> None:
+    """Return to the zero-overhead default."""
+    global _ENABLED
+    _ENABLED = False
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+@contextmanager
+def observe(clear: bool = False) -> Iterator[ObsRegistry]:
+    """Scoped ``enable()``: restores the previous on/off state on exit."""
+    global _ENABLED
+    prev = _ENABLED
+    enable(clear=clear)
+    try:
+        yield REGISTRY
+    finally:
+        _ENABLED = prev
+
+
+def snapshot() -> Dict[str, Dict[str, Any]]:
+    return REGISTRY.snapshot()
+
+
+def snapshot_json() -> str:
+    return json.dumps(REGISTRY.snapshot(), sort_keys=True)

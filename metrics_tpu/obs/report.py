@@ -1,0 +1,125 @@
+"""Structured HBM/sharding state reports for metrics and collections.
+
+``Metric.state_report()`` answers "what is this metric holding on device right
+now": one row per registered state with dtype, shape, nbytes, the sharding spec
+(where the bytes physically live on the mesh), and — for fixed-capacity
+``CatBuffer`` states — fill vs capacity and the overflow flag, the signal that
+catches unbounded cat-state growth before it OOMs HBM.
+
+``MetricCollection.summary()`` adds the compute-group topology: which metrics
+share state (updated once per group) and the per-group HBM total, i.e. the bytes
+the static grouping actually deduplicates.
+
+Everything here is read-only and host-side; values that would require a device
+sync on non-concrete (traced) arrays are reported as ``None``.
+"""
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+def _sharding_of(x: Any) -> Optional[str]:
+    sharding = getattr(x, "sharding", None)
+    if sharding is None:
+        return None
+    spec = getattr(sharding, "spec", None)
+    try:
+        return str(spec) if spec is not None else str(sharding)
+    except Exception:  # noqa: BLE001 — repr of exotic shardings must not break the report
+        return None
+
+
+def _nbytes(shape, dtype) -> int:
+    return int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+
+
+def _is_concrete_scalar(x: Any) -> bool:
+    from metrics_tpu.utils.checks import _is_concrete
+
+    return _is_concrete(x)
+
+
+def _state_entry(name: str, value: Any) -> Dict[str, Any]:
+    from metrics_tpu.core.state import CatBuffer
+
+    if isinstance(value, CatBuffer):
+        entry: Dict[str, Any] = {
+            "name": name,
+            "kind": "cat_buffer",
+            "dtype": str(value.data.dtype),
+            "shape": tuple(value.data.shape),
+            "nbytes": _nbytes(value.data.shape, value.data.dtype),
+            "sharding": _sharding_of(value.data),
+            "capacity": value.capacity,
+        }
+        if _is_concrete_scalar(value.count):
+            entry["fill"] = int(value.valid_count())
+            entry["overflowed"] = bool(value.overflowed())
+        else:
+            entry["fill"] = None
+            entry["overflowed"] = None
+        return entry
+    if isinstance(value, (list, tuple)):
+        shapes = [tuple(np.shape(v)) for v in value]
+        nbytes = sum(
+            _nbytes(np.shape(v), getattr(v, "dtype", np.asarray(v).dtype)) for v in value
+        )
+        return {
+            "name": name,
+            "kind": "list",
+            "dtype": str(getattr(value[0], "dtype", "?")) if value else None,
+            "shape": shapes,
+            "nbytes": nbytes,
+            "sharding": _sharding_of(value[0]) if value else None,
+            "length": len(value),
+        }
+    shape = tuple(getattr(value, "shape", np.shape(value)))
+    dtype = getattr(value, "dtype", np.asarray(value).dtype)
+    return {
+        "name": name,
+        "kind": "array",
+        "dtype": str(dtype),
+        "shape": shape,
+        "nbytes": _nbytes(shape, dtype),
+        "sharding": _sharding_of(value),
+    }
+
+
+def metric_state_report(metric: Any) -> Dict[str, Any]:
+    """Structured report for one metric: per-state rows + totals."""
+    states: List[Dict[str, Any]] = [
+        _state_entry(name, getattr(metric, name)) for name in metric._defaults
+    ]
+    return {
+        "metric": type(metric).__name__,
+        "update_count": metric._update_count,
+        "states": states,
+        "total_nbytes": int(sum(s["nbytes"] for s in states)),
+    }
+
+
+def collection_summary(collection: Any) -> Dict[str, Any]:
+    """Structured report for a MetricCollection: per-metric reports + group topology."""
+    reports = {
+        name: metric_state_report(m)
+        for name, m in collection.items(keep_base=True, copy_state=False)
+    }
+    groups = []
+    for members in getattr(collection, "_groups", {}).values():
+        leader = members[0]
+        groups.append(
+            {
+                "leader": leader,
+                "members": list(members),
+                # members alias the leader's arrays, so one group costs one leader
+                "shared_nbytes": reports[leader]["total_nbytes"],
+            }
+        )
+    naive = sum(r["total_nbytes"] for r in reports.values())
+    shared = sum(g["shared_nbytes"] for g in groups) if groups else naive
+    return {
+        "metrics": reports,
+        "compute_groups": groups,
+        "total_nbytes": shared,
+        "nbytes_saved_by_groups": int(naive - shared),
+    }

@@ -1,0 +1,75 @@
+"""Named trace scopes: make XProf/Perfetto traces attribute HLO to metrics.
+
+Every scope is the pair ``jax.named_scope`` (names the ops in the jaxpr/HLO, so
+the XLA op-profile groups by metric) + ``jax.profiler.TraceAnnotation`` (marks
+the host thread's dispatch window, so the trace timeline shows which metric
+issued which device work). Naming convention:
+
+    tm.update/<MetricClassName>     one metric update
+    tm.compute/<MetricClassName>    one metric compute
+    tm.forward/<MetricClassName>    dual-purpose forward
+    tm.collection.update            MetricCollection fan-out
+    tm.sync/<reduce_fx>             one collective state sync
+
+Callers in the hot path gate on ``registry._ENABLED`` *before* building the
+context manager, so the disabled path never allocates one. ``trace(path)`` is
+the one-call capture driver around ``jax.profiler``.
+"""
+from contextlib import contextmanager
+from typing import Iterator, Optional
+
+import jax
+
+from metrics_tpu.obs import registry as _reg
+
+
+@contextmanager
+def annotate(label: str) -> Iterator[None]:
+    """Enter ``jax.named_scope(label)`` + ``jax.profiler.TraceAnnotation(label)``.
+
+    Also counts the entry under ``("scopes", label)`` so tests (and exported
+    snapshots) can assert which annotations a run emitted without parsing a
+    binary trace.
+    """
+    _reg.REGISTRY.inc("scopes", label)
+    with jax.named_scope(label), jax.profiler.TraceAnnotation(label):
+        yield
+
+
+def update_scope(metric_name: str):
+    return annotate(f"tm.update/{metric_name}")
+
+
+def compute_scope(metric_name: str):
+    return annotate(f"tm.compute/{metric_name}")
+
+
+def forward_scope(metric_name: str):
+    return annotate(f"tm.forward/{metric_name}")
+
+
+def sync_scope(reduce_fx) -> "annotate":
+    kind = reduce_fx if isinstance(reduce_fx, str) else (
+        "stack" if reduce_fx is None else getattr(reduce_fx, "__name__", "custom")
+    )
+    return annotate(f"tm.sync/{kind}")
+
+
+@contextmanager
+def trace(path: str, create_perfetto_link: bool = False, enable_obs: bool = True) -> Iterator[str]:
+    """One-call profile capture: ``with obs.trace("/tmp/prof"): eval_step()``.
+
+    Drives ``jax.profiler.start_trace``/``stop_trace`` and (by default) enables
+    the instrumentation layer for the duration so the captured trace carries the
+    ``tm.*`` annotations.
+    """
+    prev = _reg.enabled()
+    if enable_obs:
+        _reg.enable()
+    jax.profiler.start_trace(path, create_perfetto_link=create_perfetto_link)
+    try:
+        yield path
+    finally:
+        jax.profiler.stop_trace()
+        if enable_obs and not prev:
+            _reg.disable()

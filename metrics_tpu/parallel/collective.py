@@ -30,6 +30,15 @@ from typing import Any, Callable, Dict, Optional, Sequence, Union
 import jax
 import jax.numpy as jnp
 
+from metrics_tpu.obs import recompile as _obs_recompile
+from metrics_tpu.obs import registry as _obs
+from metrics_tpu.obs import scopes as _obs_scopes
+
+try:  # newer jax re-exports shard_map at the top level
+    from jax import shard_map  # type: ignore[attr-defined]  # noqa: F401
+except ImportError:  # jax 0.4.x keeps it in experimental
+    from jax.experimental.shard_map import shard_map  # noqa: F401
+
 AxisName = Union[str, Sequence[str]]
 # A reduction spec: one of the string kinds, None (stack ranks), or a callable applied
 # to the (world, ...) stacked gather. Mirrors `dist_reduce_fx` of reference add_state
@@ -45,11 +54,13 @@ def mark_varying(x: Any, axis_name: AxisName) -> Any:
     Needed for shard_map's varying-manual-axes type check when a replicated initial
     state is carried through a per-device ``lax.scan``.
     """
-    fn = getattr(jax.lax, "pcast", None)
-    if fn is not None:
-        mark = lambda v: jax.lax.pcast(v, (axis_name,) if isinstance(axis_name, str) else tuple(axis_name), to="varying")
-    else:  # older jax
-        mark = lambda v: jax.lax.pvary(v, (axis_name,) if isinstance(axis_name, str) else tuple(axis_name))
+    axes = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+    if getattr(jax.lax, "pcast", None) is not None:
+        mark = lambda v: jax.lax.pcast(v, axes, to="varying")
+    elif getattr(jax.lax, "pvary", None) is not None:
+        mark = lambda v: jax.lax.pvary(v, axes)
+    else:  # jax <= 0.4.x: no varying-manual-axes type system, nothing to mark
+        return x
     return jax.tree_util.tree_map(mark, x)
 
 
@@ -69,7 +80,24 @@ def sync_array(x: jnp.ndarray, reduce_fx: ReduceFx, axis_name: AxisName) -> jnp.
     """Sync a single array state across ``axis_name`` according to its reduction kind.
 
     Must be called inside a mapped context (shard_map/pmap) binding ``axis_name``.
+    With obs enabled the collective is wrapped in a ``tm.sync/<reduce_fx>``
+    named scope + trace annotation and its gathered bytes are counted (sizes are
+    static, so the accounting is trace-safe: no device sync).
     """
+    if _obs._ENABLED:
+        kind = reduce_fx if isinstance(reduce_fx, str) else "stack"
+        _obs.REGISTRY.inc("sync", f"collectives/{kind}")
+        _obs.REGISTRY.inc(
+            "sync",
+            "bytes_reduced" if kind in ("sum", "mean", "max", "min") else "bytes_gathered",
+            _obs_recompile.nbytes_of(x),
+        )
+        with _obs_scopes.sync_scope(reduce_fx):
+            return _sync_array_impl(x, reduce_fx, axis_name)
+    return _sync_array_impl(x, reduce_fx, axis_name)
+
+
+def _sync_array_impl(x: jnp.ndarray, reduce_fx: ReduceFx, axis_name: AxisName) -> jnp.ndarray:
     if reduce_fx == "sum":
         return jax.lax.psum(x, axis_name)
     if reduce_fx == "mean":
@@ -101,6 +129,18 @@ def sync_pytree(
     """
     if axis_name is None:
         return state
+    if _obs._ENABLED:
+        _obs.REGISTRY.inc("sync", "pytree_syncs")
+        with _obs_scopes.annotate("tm.sync/pytree"):
+            return _sync_pytree_impl(state, reductions, axis_name)
+    return _sync_pytree_impl(state, reductions, axis_name)
+
+
+def _sync_pytree_impl(
+    state: Dict[str, Any],
+    reductions: Dict[str, ReduceFx],
+    axis_name: AxisName,
+) -> Dict[str, Any]:
     from metrics_tpu.core.state import CatBuffer, cat_sync
 
     out = {}
@@ -133,6 +173,13 @@ def pad_gather(x: jnp.ndarray, valid: jnp.ndarray, axis_name: AxisName) -> tuple
     so ragged states live in fixed-capacity buffers with a ``valid`` count; gathering
     moves the buffers tiled and the counts summed. Downstream computes mask on counts.
     """
+    if _obs._ENABLED:
+        _obs.REGISTRY.inc("sync", "collectives/pad_gather")
+        _obs.REGISTRY.inc("sync", "bytes_gathered", _obs_recompile.nbytes_of(x))
+        with _obs_scopes.sync_scope("pad_gather"):
+            gathered = jax.lax.all_gather(x, axis_name, axis=0, tiled=True)
+            counts = jax.lax.all_gather(jnp.atleast_1d(valid), axis_name, axis=0, tiled=True)
+            return gathered, counts
     gathered = jax.lax.all_gather(x, axis_name, axis=0, tiled=True)
     counts = jax.lax.all_gather(jnp.atleast_1d(valid), axis_name, axis=0, tiled=True)
     return gathered, counts

@@ -115,7 +115,7 @@ def evaluate_sharded(
     cat-list states auto-converted to capacity buffers (see
     ``examples/eval_harness.py`` for the full recipe).
     """
-    from jax import shard_map
+    from metrics_tpu.parallel.collective import shard_map
 
     mesh = mesh or make_data_mesh(axis_name=axis_name)
     state0 = metric.init_state()

@@ -156,6 +156,19 @@ def _scatter_sharding_args(x: Array):
     return contextlib.nullcontext(), {}
 
 
+def _scatter_add_drop(zeros: Array, x: Array, updates, minlength: int, **kwargs) -> Array:
+    """Scatter-add with out-of-range (including negative) indices dropped.
+
+    Newer jax takes ``wrap_negative_indices=False``; on older jax negatives
+    would wrap NumPy-style into the tail, so they are shifted out of bounds
+    first and ``mode="drop"`` discards them.
+    """
+    try:
+        return zeros.at[x].add(updates, mode="drop", wrap_negative_indices=False, **kwargs)
+    except TypeError:  # jax <= 0.4.x
+        return zeros.at[jnp.where(x < 0, minlength, x)].add(updates, mode="drop", **kwargs)
+
+
 def _bincount(x: Array, minlength: int) -> Array:
     """Count occurrences of each value in ``[0, minlength)``.
 
@@ -174,9 +187,7 @@ def _bincount(x: Array, minlength: int) -> Array:
         return fast
     ctx, kwargs = _scatter_sharding_args(x)
     with ctx:
-        return jnp.zeros((minlength,), jnp.int32).at[x].add(
-            1, mode="drop", wrap_negative_indices=False, **kwargs
-        )
+        return _scatter_add_drop(jnp.zeros((minlength,), jnp.int32), x, 1, minlength, **kwargs)
 
 
 def _bincount_weighted(x: Array, weights: Array, minlength: int) -> Array:
@@ -193,9 +204,7 @@ def _bincount_weighted(x: Array, weights: Array, minlength: int) -> Array:
         return fast
     ctx, kwargs = _scatter_sharding_args(x)
     with ctx:
-        return jnp.zeros((minlength,), weights.dtype).at[x].add(
-            weights, mode="drop", wrap_negative_indices=False, **kwargs
-        )
+        return _scatter_add_drop(jnp.zeros((minlength,), weights.dtype), x, weights, minlength, **kwargs)
 
 
 def _cumsum(x: Array, axis: int = 0) -> Array:

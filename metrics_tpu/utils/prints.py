@@ -37,6 +37,63 @@ def rank_zero_info(*args: Any, **kwargs: Any) -> None:
     print(*args, **kwargs)
 
 
+def _human_bytes(n: int) -> str:
+    size = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if size < 1024 or unit == "GiB":
+            return f"{size:.1f} {unit}" if unit != "B" else f"{int(size)} B"
+        size /= 1024
+    return f"{int(n)} B"
+
+
+def render_state_report(report: dict) -> str:
+    """Pretty table for ``Metric.state_report()`` (metrics_tpu.obs.report).
+
+    One row per registered state: name, dtype, shape, nbytes, sharding, and —
+    for CatBuffer states — fill/capacity (+ overflow marker).
+    """
+    rows = [("state", "dtype", "shape", "nbytes", "sharding", "fill")]
+    for s in report["states"]:
+        if s["kind"] == "cat_buffer":
+            fill = "?" if s["fill"] is None else f"{s['fill']}/{s['capacity']}"
+            if s.get("overflowed"):
+                fill += " OVERFLOWED"
+        elif s["kind"] == "list":
+            fill = f"len={s['length']}"
+        else:
+            fill = "-"
+        rows.append(
+            (s["name"], str(s["dtype"]), str(s["shape"]), _human_bytes(s["nbytes"]),
+             str(s["sharding"] or "-"), fill)
+        )
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    lines = [f"{report['metric']} (updates={report['update_count']},"
+             f" total={_human_bytes(report['total_nbytes'])})"]
+    for i, r in enumerate(rows):
+        lines.append("  " + "  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip())
+        if i == 0:
+            lines.append("  " + "-" * (sum(widths) + 2 * (len(widths) - 1)))
+    return "\n".join(lines)
+
+
+def render_collection_summary(summary: dict) -> str:
+    """Pretty renderer for ``MetricCollection.summary()``: per-metric state
+    tables plus the compute-group topology and the HBM the grouping saves."""
+    lines = []
+    for report in summary["metrics"].values():
+        lines.append(render_state_report(report))
+    if summary["compute_groups"]:
+        lines.append("compute groups:")
+        for g in summary["compute_groups"]:
+            members = ", ".join(g["members"])
+            lines.append(f"  [{members}] <- leader {g['leader']} ({_human_bytes(g['shared_nbytes'])} shared)")
+    lines.append(
+        f"total HBM: {_human_bytes(summary['total_nbytes'])}"
+        f" (groups save {_human_bytes(summary['nbytes_saved_by_groups'])})"
+    )
+    return "\n".join(lines)
+
+
 def _deprecated_warn(name: str, replacement: str) -> None:
     rank_zero_warn(
         f"`{name}` is deprecated, use `{replacement}` instead.", DeprecationWarning

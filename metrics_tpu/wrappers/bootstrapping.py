@@ -160,20 +160,25 @@ class BootStrapper(Metric):
         poisson mirrors the reference's variable-length Poisson(1) resampling as
         closely as static shapes allow: per-row counts are realized by
         ``repeat(..., total_repeat_length=size)`` — a draw whose total exceeds
-        ``size`` is truncated and one that falls short repeats the final row,
-        a boundary effect of O(sqrt(size))/size on the sample distribution.
+        ``size`` is truncated, and one that falls short is padded with
+        uniformly drawn indices (NOT the repeat's default final-row padding,
+        which would overweight the last row and make the O(sqrt(size))/size
+        boundary correction position-dependent).
         """
         if self.sampling_strategy == "multinomial":
             return jax.random.randint(key, (size,), 0, size)
         # Poisson(1) by inverse CDF over a truncated support (P(K > 16) < 1e-14):
         # jax.random.poisson's rejection while_loop trips shard_map's varying-axis
         # type check, and a branchless searchsorted is also faster for fixed lam=1
+        k_cnt, k_pad = jax.random.split(key)
         ks = jnp.arange(17)
         log_pmf = -1.0 - jax.scipy.special.gammaln(ks + 1.0)
         cdf = jnp.cumsum(jnp.exp(log_pmf))
-        u = jax.random.uniform(key, (size,))
+        u = jax.random.uniform(k_cnt, (size,))
         counts = jnp.sum(u[:, None] > cdf[None, :], axis=1)
-        return jnp.repeat(jnp.arange(size), counts, total_repeat_length=size)
+        idx = jnp.repeat(jnp.arange(size), counts, total_repeat_length=size)
+        pad = jax.random.randint(k_pad, (size,), 0, size)
+        return jnp.where(jnp.arange(size) < counts.sum(), idx, pad)
 
     def local_update(self, state: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:
         """All bootstrap replicas in one vmapped program (device-side resampling)."""

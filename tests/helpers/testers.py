@@ -147,7 +147,7 @@ class MetricTester:
 
     def _sharded_class_test(self, preds, target, metric_class, expected, metric_args, atol) -> None:
         """Mesh-sharded accumulate + single sync == reference on all data."""
-        from jax import shard_map
+        from metrics_tpu.parallel.collective import shard_map
         from jax.sharding import PartitionSpec as P
 
         args = dict(metric_args)

@@ -251,7 +251,7 @@ def test_sharded_snr_matches_single_device():
     from functools import partial
 
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from metrics_tpu.parallel.collective import shard_map
 
     from metrics_tpu.parallel import collective, make_data_mesh
 

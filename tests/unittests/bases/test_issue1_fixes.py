@@ -1,0 +1,157 @@
+"""Regression tests for the ISSUE 1 satellite fixes.
+
+1. ``_fallback_signature_attrs`` no longer compares the per-instance wrapped
+   ``update``/``compute`` closures, so undeclared identical metrics merge.
+2. ``MetricCollection.forward`` updates only group leaders (it used to split
+   every static compute group permanently on the first forward).
+3. ``__setitem__`` under an explicit ``compute_groups`` list appends the new
+   metric as its own singleton group instead of silently never updating it.
+4. ``BootStrapper``'s device-side Poisson resampling pads shortfalls with
+   uniform indices instead of repeating the final row.
+"""
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.core.collections import MetricCollection
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.wrappers.bootstrapping import BootStrapper
+
+
+class UndeclaredMean(Metric):
+    """No ``_update_signature_attrs`` declaration -> conservative fallback path."""
+
+    full_state_update = False
+
+    def __init__(self, scale=1.0, **kw):
+        super().__init__(**kw)
+        self.scale = scale
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("count", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, x):
+        self.total = self.total + jnp.sum(x)
+        self.count = self.count + x.size
+
+    def compute(self):
+        return self.scale * self.total / self.count
+
+
+class UndeclaredDoubledMean(UndeclaredMean):
+    """Same update/state schema, different compute -> must share a group."""
+
+    def compute(self):
+        return 2.0 * self.scale * self.total / self.count
+
+
+def test_fallback_signature_merges_identical_undeclared_metrics():
+    mc = MetricCollection({"a": UndeclaredMean(), "b": UndeclaredDoubledMean()})
+    assert len(mc.compute_groups) == 1, mc.compute_groups
+
+
+def test_fallback_signature_still_splits_on_differing_ctor_args():
+    mc = MetricCollection({"a": UndeclaredMean(scale=1.0), "b": UndeclaredDoubledMean(scale=3.0)})
+    assert len(mc.compute_groups) == 2, mc.compute_groups
+
+
+def test_forward_keeps_compute_groups_and_accumulates_once():
+    mc = MetricCollection({"a": UndeclaredMean(), "b": UndeclaredDoubledMean()})
+    assert len(mc.compute_groups) == 1
+
+    out1 = mc(jnp.array([1.0, 2.0, 3.0]))  # batch values from batch-only state
+    assert float(out1["a"]) == pytest.approx(2.0)
+    assert float(out1["b"]) == pytest.approx(4.0)
+    assert len(mc.compute_groups) == 1, "forward split the static compute group"
+
+    out2 = mc(jnp.array([5.0]))
+    assert float(out2["a"]) == pytest.approx(5.0)
+    assert len(mc.compute_groups) == 1
+
+    res = mc.compute()  # accumulated over both batches: mean([1,2,3,5]) = 2.75
+    assert float(res["a"]) == pytest.approx(2.75)
+    assert float(res["b"]) == pytest.approx(5.5)
+    a, b = mc._modules["a"], mc._modules["b"]
+    assert a.total is b.total and a.count is b.count, "members must alias the leader state"
+    assert a._update_count == b._update_count == 2
+
+
+def test_forward_matches_individually_updated_metrics():
+    mc = MetricCollection({"a": UndeclaredMean(), "b": UndeclaredDoubledMean()})
+    solo = UndeclaredMean()
+    for batch in (jnp.array([1.0, 4.0]), jnp.array([2.0]), jnp.array([0.5, 1.5, 7.0])):
+        mc(batch)
+        solo(batch)
+    assert float(mc.compute()["a"]) == pytest.approx(float(solo.compute()))
+
+
+def test_forward_mixed_groups_and_dist_sync_on_step():
+    # a dist_sync_on_step member keeps the per-member forward path (group splits)
+    mc = MetricCollection(
+        {"a": UndeclaredMean(dist_sync_on_step=True), "b": UndeclaredDoubledMean()}
+    )
+    out = mc(jnp.array([2.0, 4.0]))
+    assert float(out["a"]) == pytest.approx(3.0)
+    assert float(out["b"]) == pytest.approx(6.0)
+    assert float(mc.compute()["a"]) == pytest.approx(3.0)
+
+
+def test_setitem_under_explicit_groups_becomes_singleton_group():
+    mc = MetricCollection(
+        {"a": UndeclaredMean(), "b": UndeclaredDoubledMean()}, compute_groups=[["a", "b"]]
+    )
+    mc["c"] = UndeclaredMean(scale=10.0)
+    assert any(group == ["c"] for group in mc.compute_groups.values()), mc.compute_groups
+
+    mc.update(jnp.array([1.0, 3.0]))
+    res = mc.compute()
+    assert float(res["c"]) == pytest.approx(20.0), "the added metric was never updated"
+    assert float(res["a"]) == pytest.approx(2.0)
+
+
+def test_add_metrics_under_explicit_groups_covers_new_member():
+    mc = MetricCollection({"a": UndeclaredMean(), "b": UndeclaredDoubledMean()},
+                          compute_groups=[["a", "b"]])
+    mc.add_metrics({"d": UndeclaredMean(scale=5.0)})
+    mc.update(jnp.array([2.0, 2.0]))
+    assert float(mc.compute()["d"]) == pytest.approx(10.0)
+
+
+def test_explicit_groups_still_validate_unknown_names():
+    with pytest.raises(ValueError, match="does not match a metric"):
+        MetricCollection({"a": UndeclaredMean()}, compute_groups=[["a", "nope"]])
+
+
+def test_poisson_pad_is_position_independent():
+    """The shortfall pad must be uniform over rows, not a repeat of the last row."""
+    size = 32
+    bs = BootStrapper(UndeclaredMean(), num_bootstraps=2, sampling_strategy="poisson", seed=0)
+    counts = np.zeros(size, dtype=np.int64)
+    short_draws = 0
+    for s in range(300):
+        key = jax.random.PRNGKey(s)
+        idx = np.asarray(bs._device_sample(key, size))
+        assert idx.shape == (size,)
+        assert idx.min() >= 0 and idx.max() < size
+        # identify a shortfall draw: the Poisson counts sum below `size`
+        k_cnt, _ = jax.random.split(key)
+        u = np.asarray(jax.random.uniform(k_cnt, (size,)))
+        cdf = np.cumsum(np.exp(-1.0 - np.array([math.lgamma(k + 1) for k in range(17)])))
+        total = int(np.sum(np.sum(u[:, None] > cdf[None, :], axis=1)))
+        if total < size:
+            short_draws += 1
+            counts += np.bincount(idx[total:], minlength=size)
+    assert short_draws > 50  # Poisson(1) undershoots ~half the time
+    # old behavior put 100% of the pad mass on index size-1; uniform padding
+    # spreads it — the last row must not dominate
+    assert counts[-1] < 0.25 * counts.sum(), (counts[-1], counts.sum())
+    # and the pad must cover many distinct rows
+    assert (counts > 0).sum() > size // 2
+
+
+def test_poisson_sample_still_static_shape_under_jit():
+    bs = BootStrapper(UndeclaredMean(), num_bootstraps=2, sampling_strategy="poisson", seed=1)
+    out = jax.jit(lambda k: bs._device_sample(k, 16))(jax.random.PRNGKey(3))
+    assert out.shape == (16,)

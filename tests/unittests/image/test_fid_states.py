@@ -73,7 +73,7 @@ def test_fid_vs_numpy_f64_oracle():
 
 def test_sharded_fid_matches_single_device():
     """Per-device local updates + gather-sync + Chan fold == single-device run."""
-    from jax import shard_map
+    from metrics_tpu.parallel.collective import shard_map
     from jax.sharding import PartitionSpec as P
 
     from metrics_tpu.parallel import collective

@@ -1,0 +1,261 @@
+"""metrics_tpu.obs: counters, retrace detection, state reports, zero-overhead off path."""
+import json
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu.obs as obs
+from metrics_tpu.classification import MulticlassAccuracy, MulticlassPrecision
+from metrics_tpu.core.aggregation import CatMetric
+from metrics_tpu.core.collections import MetricCollection
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.obs import registry as obs_registry
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    obs.disable()
+    obs.REGISTRY.clear()
+    yield
+    obs.disable()
+    obs.REGISTRY.clear()
+
+
+class StreamMean(Metric):
+    full_state_update = False
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("count", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, x):
+        self.total = self.total + jnp.sum(x)
+        self.count = self.count + x.size
+
+    def compute(self):
+        return self.total / self.count
+
+
+def test_counters_update_forward_reset_compute():
+    obs.enable(clear=True)
+    m = StreamMean()
+    x = jnp.array([1.0, 2.0])
+    m.update(x)
+    m.update(x)
+    m(x)  # forward: reduce-state strategy -> exactly one more update
+    m.compute()
+    m.compute()  # cached
+    m.reset()
+    snap = obs.snapshot()["StreamMean"]
+    assert snap["updates"] == 3
+    assert snap["forwards"] == 1
+    # one explicit reset + the internal reset of forward's reduce-state merge:
+    # counters record actual invocations, including the runtime's own
+    assert snap["resets"] == 2
+    assert snap["compute_cache_hits"] == 1
+    # forward runs a compute internally for the batch value
+    assert snap["computes"] >= 2
+
+
+def test_scope_counters_name_the_metric():
+    obs.enable(clear=True)
+    m = StreamMean()
+    m.update(jnp.ones(3))
+    m.compute()
+    scopes = obs.snapshot()["scopes"]
+    assert scopes["tm.update/StreamMean"] == 1
+    assert scopes["tm.compute/StreamMean"] == 1
+
+
+def test_disabled_mode_writes_nothing(monkeypatch):
+    """The acceptance criterion: with obs off, the wrapped update/compute/reset
+    paths must not touch the registry at all."""
+    assert not obs.enabled()
+
+    def _boom(*a, **k):
+        raise AssertionError("registry written while obs disabled")
+
+    monkeypatch.setattr(obs_registry.ObsRegistry, "inc", _boom)
+    monkeypatch.setattr(obs_registry.ObsRegistry, "observe_duration", _boom)
+    m = StreamMean()
+    x = jnp.arange(4.0)
+    m.update(x)
+    m(x)
+    m.compute()
+    m.reset()
+    mc = MetricCollection({"a": StreamMean()})
+    mc.update(x)
+    mc(x)
+    mc.compute()
+    mc.reset()
+    monkeypatch.undo()
+    assert obs.snapshot() == {}
+
+
+def test_retrace_detector_fires_once_on_shape_unstable_metric():
+    obs.enable(clear=True)
+    m = StreamMean()
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        for n in range(1, 8):  # 7 distinct shapes: a deliberate compile storm
+            m.update(jnp.zeros(n))
+    storm = [w for w in caught if "compile storm" in str(w.message)]
+    assert len(storm) == 1  # rate-limited: exactly once per instance
+    assert "StreamMean" in str(storm[0].message)
+    snap = obs.snapshot()["StreamMean"]
+    assert snap["retraces"] == 6  # every fingerprint beyond the first
+    assert snap["retrace_warnings"] == 1
+
+
+def test_retrace_detector_quiet_on_stable_shapes():
+    obs.enable(clear=True)
+    m = StreamMean()
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        for _ in range(20):
+            m.update(jnp.zeros(5))
+    assert not [w for w in caught if "compile storm" in str(w.message)]
+    assert obs.REGISTRY.get("StreamMean", "retraces") == 0
+
+
+def test_retrace_fingerprint_sees_dtype_and_python_scalars():
+    fp_f32 = obs.fingerprint((jnp.zeros(3, jnp.float32),), {})
+    fp_i32 = obs.fingerprint((jnp.zeros(3, jnp.int32),), {})
+    assert fp_f32 != fp_i32
+    assert obs.fingerprint((1,), {}) != obs.fingerprint((2,), {})
+    assert obs.fingerprint((jnp.zeros(3),), {"w": 1}) == obs.fingerprint((jnp.zeros(3),), {"w": 1})
+
+
+def test_state_report_nbytes_and_catbuffer_fill():
+    m = CatMetric(cat_capacity=8)
+    m.update(jnp.array([1.0, 2.0, 3.0]))
+    report = m.state_report()
+    (entry,) = report["states"]
+    assert entry["kind"] == "cat_buffer"
+    assert entry["capacity"] == 8
+    assert entry["fill"] == 3
+    assert entry["overflowed"] is False
+    assert entry["nbytes"] == 8 * 4  # (capacity,) f32 buffer
+    assert entry["dtype"] == "float32"
+    assert report["total_nbytes"] == 32
+
+    dense = StreamMean()
+    dense.update(jnp.ones(5))
+    rep = dense.state_report()
+    assert {s["name"] for s in rep["states"]} == {"total", "count"}
+    assert all(s["nbytes"] == 4 and s["shape"] == () for s in rep["states"])
+    assert rep["total_nbytes"] == 8
+    assert all(s["sharding"] for s in rep["states"])
+
+
+def test_state_report_flags_overflow():
+    m = CatMetric(cat_capacity=2)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m.update(jnp.array([1.0, 2.0, 3.0]))
+        (entry,) = m.state_report()["states"]
+    assert entry["overflowed"] is True
+    assert entry["fill"] == 2
+
+
+def test_collection_summary_topology_and_savings():
+    mc = MetricCollection(
+        {
+            "acc1": MulticlassAccuracy(num_classes=3, average="micro"),
+            "acc2": MulticlassAccuracy(num_classes=3, average="micro"),
+            "prec": MulticlassPrecision(num_classes=3, average="macro"),
+        }
+    )
+    summary = mc.summary()
+    assert set(summary["metrics"]) == {"acc1", "acc2", "prec"}
+    partitions = {frozenset(g["members"]) for g in summary["compute_groups"]}
+    assert frozenset({"acc1", "acc2"}) in partitions
+    # the acc1/acc2 group shares one 16-byte state block
+    assert summary["nbytes_saved_by_groups"] == summary["metrics"]["acc2"]["total_nbytes"]
+    from metrics_tpu.utils.prints import render_collection_summary, render_state_report
+
+    text = render_collection_summary(summary)
+    assert "compute groups:" in text and "groups save" in text
+    assert "MulticlassAccuracy" in render_state_report(summary["metrics"]["acc1"])
+
+
+def test_named_scopes_reach_compiled_hlo():
+    obs.enable(clear=True)
+    m = MulticlassAccuracy(num_classes=5, average="micro", validate_args=False)
+    p = jnp.zeros(8, jnp.int32)
+    hlo = jax.jit(m.local_update).lower(m.init_state(), p, p).compile().as_text()
+    assert "tm.update/MulticlassAccuracy" in hlo
+
+
+def test_sync_scope_and_byte_accounting_in_shard_map():
+    from jax.sharding import Mesh
+    from jax.sharding import PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    from metrics_tpu.parallel import collective
+
+    obs.enable(clear=True)
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ("d",))
+    fn = shard_map(
+        lambda x: collective.sync_array(x, "sum", "d"), mesh=mesh, in_specs=P("d"), out_specs=P()
+    )
+    hlo = jax.jit(fn).lower(jnp.zeros(8, jnp.float32)).compile().as_text()
+    assert "tm.sync/sum" in hlo
+    sync = obs.snapshot()["sync"]
+    assert sync["collectives/sum"] >= 1
+    assert sync["bytes_reduced"] >= 4  # per-device f32 scalar, statically accounted
+
+    gather_fn = shard_map(
+        lambda x: collective.sync_array(x, "cat", "d"), mesh=mesh, in_specs=P("d"), out_specs=P()
+    )
+    jax.jit(gather_fn).lower(jnp.zeros(8, jnp.float32)).compile()
+    assert obs.snapshot()["sync"]["bytes_gathered"] >= 4
+
+
+def test_stopwatch_records_only_when_enabled():
+    with obs.stopwatch("bench", "off_pass") as sw:
+        pass
+    assert sw.elapsed >= 0
+    assert obs.snapshot() == {}
+    obs.enable()
+    with obs.stopwatch("bench", "on_pass"):
+        pass
+    timers = obs.snapshot()["bench"]
+    assert timers["on_pass"]["count"] == 1
+
+
+def test_observe_context_restores_state():
+    assert not obs.enabled()
+    with obs.observe(clear=True) as reg:
+        assert obs.enabled()
+        reg.inc("x", "y")
+    assert not obs.enabled()
+    assert obs.REGISTRY.get("x", "y") == 1
+
+
+def test_export_jsonl_roundtrip(tmp_path):
+    obs.enable(clear=True)
+    StreamMean().update(jnp.ones(2))
+    path = tmp_path / "obs.jsonl"
+    obs.dump_jsonl(str(path), extra={"step": 1}, clock=lambda: 123.0)
+    obs.dump_jsonl(str(path), extra={"step": 2}, clock=lambda: 124.0)
+    lines = [json.loads(line) for line in path.read_text().splitlines()]
+    assert len(lines) == 2
+    assert lines[0]["step"] == 1 and lines[0]["time_unix"] == 123.0
+    assert lines[1]["registry"]["StreamMean"]["updates"] == 1
+    assert lines[0]["enabled"] is True
+
+
+def test_trace_capture_writes_profile(tmp_path):
+    prof_dir = tmp_path / "prof"
+    m = StreamMean()
+    with obs.trace(str(prof_dir)):
+        assert obs.enabled()  # trace() turns the annotations on for the capture
+        jax.jit(m.local_update)(m.init_state(), jnp.ones(4)).get("total", None)
+    assert not obs.enabled()  # restored
+    captured = list(prof_dir.rglob("*"))
+    assert captured, "jax.profiler.trace produced no artifacts"

@@ -1,0 +1,45 @@
+"""CI/tooling guards: bench entry smoke test + host-sync lint.
+
+The lint enforces the obs contract at the source level: ``block_until_ready``
+is a host sync, and the library's hot paths must never force one — only the
+observability layer (and the benchmark driver, whose whole job is timing) may.
+"""
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+import metrics_tpu
+
+REPO_ROOT = pathlib.Path(metrics_tpu.__file__).resolve().parent.parent
+
+
+@pytest.mark.smoke
+def test_bench_entry_smoke():
+    """`bench.py --help` must parse and exit cleanly on the CPU backend."""
+    result = subprocess.run(
+        [sys.executable, str(REPO_ROOT / "bench.py"), "--help"],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        env={"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu", "PYTHONPATH": str(REPO_ROOT)},
+    )
+    assert result.returncode == 0, result.stderr
+    assert "--config" in result.stdout
+    assert "--obs" in result.stdout
+
+
+def test_no_block_until_ready_outside_obs():
+    """Grep-lint: no module under metrics_tpu/ may force a host sync via
+    ``block_until_ready(`` except the obs subsystem itself (bench.py, outside
+    the package, is also exempt by construction)."""
+    pkg_root = pathlib.Path(metrics_tpu.__file__).resolve().parent
+    offenders = []
+    for path in sorted(pkg_root.rglob("*.py")):
+        rel = path.relative_to(pkg_root)
+        if rel.parts[0] == "obs":
+            continue
+        if "block_until_ready(" in path.read_text():
+            offenders.append(str(rel))
+    assert not offenders, f"host syncs outside obs/: {offenders}"

@@ -72,7 +72,7 @@ def test_bincount_weighted_dispatch_matches_oracle():
 def test_bincount_under_jit_and_shard_map():
     from functools import partial
 
-    from jax import shard_map
+    from metrics_tpu.parallel.collective import shard_map
     from jax.sharding import PartitionSpec as P
 
     from metrics_tpu.parallel import make_data_mesh

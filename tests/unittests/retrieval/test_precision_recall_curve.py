@@ -10,7 +10,7 @@ import pytest
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from metrics_tpu.parallel.collective import shard_map
 from jax.sharding import PartitionSpec as P
 
 from metrics_tpu.functional.retrieval import retrieval_precision_recall_curve

@@ -11,7 +11,7 @@ import pytest
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from metrics_tpu.parallel.collective import shard_map
 from jax.sharding import PartitionSpec as P
 
 from tests.helpers.reference import import_reference
