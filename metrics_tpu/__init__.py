@@ -6,7 +6,7 @@ kernels, and jax.lax collectives over device meshes instead of NCCL process grou
 """
 __version__ = "0.1.0"
 
-from metrics_tpu import functional, obs
+from metrics_tpu import ckpt, functional, obs
 
 from metrics_tpu.classification import (
     AUROC,
@@ -269,6 +269,7 @@ __all__ = [
     "Specificity",
     "StatScores",
     "functional",
+    "ckpt",
     "obs",
 
     "PerceptualEvaluationSpeechQuality",

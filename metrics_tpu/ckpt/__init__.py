@@ -1,0 +1,76 @@
+"""metrics_tpu.ckpt — preemption-safe checkpoint/restore for metric state.
+
+Long streaming evaluations on preemptible TPU pods lose every accumulated
+state on a kill; this subsystem makes metric state durable:
+
+    from metrics_tpu import ckpt
+
+    metric.update(preds, target)
+    ckpt.save_checkpoint(metric, "gs-mount/eval-ckpts", retain=3)   # atomic
+
+    # ... pod preempted, job restarts ...
+    fresh = MulticlassAccuracy(num_classes=5, average="micro")
+    step = ckpt.restore_checkpoint(fresh, "gs-mount/eval-ckpts")    # latest
+    fresh.compute()   # identical to the uninterrupted run
+
+Properties:
+
+- **Atomic + versioned**: checkpoints live in monotonically numbered
+  ``step_*`` directories, committed by a single rename; a kill mid-save never
+  leaves a readable-but-partial checkpoint. ``retain=N`` prunes old steps.
+- **Async**: ``blocking=False`` snapshots immutable array references and
+  writes on a background thread — the eval loop keeps the device busy while
+  bytes drain to disk. ``wait_for_all_saves()`` joins everything in flight.
+- **Validated**: restore checks the manifest against the live metric tree
+  first and raises typed errors (:class:`SchemaDriftError`,
+  :class:`CorruptCheckpointError`...) before touching any state.
+- **Mesh/topology aware**: host 0 writes replicated states once, every host
+  writes its own cat-state shards, commit is a barrier-free "all manifests
+  present" check; states saved on N hosts restore onto M hosts by
+  re-reducing sum/max/min states and re-packing cat buffers.
+- **Group aware**: ``MetricCollection`` checkpoints save each compute group's
+  state once (the leader's) and restore re-establishes member aliasing.
+
+``Metric.save_checkpoint`` / ``Metric.restore_checkpoint`` (and the
+``MetricCollection`` equivalents) are thin wrappers over this module.
+"""
+from metrics_tpu.ckpt.errors import (
+    CapacityError,
+    CheckpointError,
+    CheckpointNotFoundError,
+    CorruptCheckpointError,
+    DtypeDriftError,
+    IncompleteCheckpointError,
+    SchemaDriftError,
+    ShapeDriftError,
+    TopologyError,
+)
+from metrics_tpu.ckpt.manager import (
+    CheckpointWrite,
+    all_steps,
+    latest_step,
+    restore_checkpoint,
+    save_checkpoint,
+    wait_for_all_saves,
+)
+from metrics_tpu.ckpt.manifest import metric_schema, validate_schema
+
+__all__ = [
+    "CapacityError",
+    "CheckpointError",
+    "CheckpointNotFoundError",
+    "CheckpointWrite",
+    "CorruptCheckpointError",
+    "DtypeDriftError",
+    "IncompleteCheckpointError",
+    "SchemaDriftError",
+    "ShapeDriftError",
+    "TopologyError",
+    "all_steps",
+    "latest_step",
+    "metric_schema",
+    "restore_checkpoint",
+    "save_checkpoint",
+    "validate_schema",
+    "wait_for_all_saves",
+]

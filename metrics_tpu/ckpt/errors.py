@@ -1,0 +1,49 @@
+"""Typed error hierarchy for the checkpoint subsystem.
+
+Every failure mode a restore can hit maps to a distinct exception type so
+callers can branch programmatically (retry an older step on corruption, rebuild
+the metric on schema drift, fail loudly on misuse) instead of parsing strings.
+All types derive from :class:`CheckpointError`.
+"""
+
+
+class CheckpointError(Exception):
+    """Base class for every checkpoint/restore failure."""
+
+
+class CheckpointNotFoundError(CheckpointError):
+    """No committed checkpoint exists at the requested directory/step."""
+
+
+class IncompleteCheckpointError(CheckpointError):
+    """A step directory exists but was never committed (killed mid-save), or a
+    committed directory is missing per-host files the commit record promises."""
+
+
+class CorruptCheckpointError(CheckpointError):
+    """A manifest or payload exists but fails integrity checks (unparseable
+    JSON, truncated payload blob, CRC mismatch)."""
+
+
+class SchemaDriftError(CheckpointError):
+    """The saved state tree does not match the live metric tree (different
+    metric classes, state names, state kinds, or reduction specs)."""
+
+
+class ShapeDriftError(SchemaDriftError):
+    """A saved array state's shape differs from the live metric's."""
+
+
+class DtypeDriftError(SchemaDriftError):
+    """A saved state's dtype differs from the live metric's."""
+
+
+class CapacityError(CheckpointError):
+    """Restored cat rows do not fit the live metric's ``CatBuffer`` capacity
+    (raised instead of silently dropping accumulated samples)."""
+
+
+class TopologyError(CheckpointError):
+    """The saved host topology cannot be re-mapped onto the restoring one
+    (e.g. per-host states with a ``None``/callable reduction saved on N hosts
+    and restored onto M != N hosts — there is no way to re-reduce them)."""

@@ -1,0 +1,507 @@
+"""Checkpoint manager: versioned step dirs, atomic commits, async writes,
+multi-host coordination, retention.
+
+On-disk layout (one directory per checkpoint *series*)::
+
+    ckpts/
+      step_0000000042/            # committed checkpoint (atomically renamed)
+        manifest-h0000.json       # per-host manifest (schema + payload index)
+        arrays-h0000.bin          # per-host payload blob
+        COMMIT                    # commit record: {step, world, ...}
+      .tmp-step_0000000043/       # in-flight write (ignored by readers)
+
+Atomicity: payloads are written and fsynced before their manifest, manifests
+before the ``COMMIT`` record, and the whole step directory stays under a
+``.tmp-`` name until the commit record exists — then one ``os.rename`` makes it
+visible. A kill at ANY point leaves either a committed step or an ignorable
+tmp dir; readers never observe a partial checkpoint.
+
+Multi-host protocol (barrier-free, shared filesystem): every host writes its
+own payload + manifest into the same tmp dir, then runs the commit check —
+"are all ``world`` manifests present?". Whichever host observes completeness
+last writes ``COMMIT`` and renames; rename races are benign (first rename
+wins, the loser verifies the committed dir exists). No collective, no barrier:
+a straggler host simply finds the work already done.
+
+Async: ``blocking=False`` snapshots array *references* (jax arrays are
+immutable) and runs transfer+write+commit on a daemon thread; the returned
+:class:`CheckpointWrite` handle exposes ``result()``/``done()`` and in-flight
+writes are tracked so ``wait_for_all_saves()`` can drain them before exit.
+"""
+import json
+import os
+import re
+import shutil
+import threading
+import time
+from contextlib import nullcontext
+from typing import Any, Dict, List, Optional, Tuple
+
+from metrics_tpu.ckpt import manifest as _manifest
+from metrics_tpu.ckpt import restore as _restore
+from metrics_tpu.ckpt import serializer as _serializer
+from metrics_tpu.ckpt.errors import (
+    CheckpointError,
+    CheckpointNotFoundError,
+    CorruptCheckpointError,
+    IncompleteCheckpointError,
+)
+from metrics_tpu.obs import registry as _obs
+from metrics_tpu.obs import scopes as _obs_scopes
+
+_STEP_RE = re.compile(r"^step_(\d{10})$")
+_TMP_PREFIX = ".tmp-"
+
+
+def _scope(label: str):
+    """`tm.ckpt/*` trace scope, gated like every other obs hot path: disabled
+    obs costs one boolean check, no context manager, no registry write."""
+    return _obs_scopes.annotate(label) if _obs._ENABLED else nullcontext()
+
+
+def _step_name(step: int) -> str:
+    return f"step_{int(step):010d}"
+
+
+def _manifest_name(host: int) -> str:
+    return f"manifest-h{host:04d}.json"
+
+
+def _payload_name(host: int) -> str:
+    return f"arrays-h{host:04d}.bin"
+
+
+def _is_committed(step_dir: str) -> bool:
+    return os.path.isfile(os.path.join(step_dir, "COMMIT"))
+
+
+def all_steps(directory: str) -> List[int]:
+    """Committed step numbers in ``directory``, ascending. Tmp/partial dirs are
+    invisible here by design — they are not checkpoints yet."""
+    if not os.path.isdir(directory):
+        return []
+    steps = []
+    for entry in os.listdir(directory):
+        m = _STEP_RE.match(entry)
+        if m and _is_committed(os.path.join(directory, entry)):
+            steps.append(int(m.group(1)))
+    return sorted(steps)
+
+
+def latest_step(directory: str) -> Optional[int]:
+    steps = all_steps(directory)
+    return steps[-1] if steps else None
+
+
+def _atomic_write_json(path: str, payload: Dict[str, Any]) -> None:
+    tmp = path + ".part"
+    with open(tmp, "w") as fh:
+        json.dump(payload, fh, sort_keys=True)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+
+
+def _read_json(path: str, what: str) -> Dict[str, Any]:
+    try:
+        with open(path) as fh:
+            return json.load(fh)
+    except FileNotFoundError:
+        raise
+    except (OSError, ValueError) as err:
+        raise CorruptCheckpointError(f"unreadable checkpoint {what} at {path}: {err}") from err
+
+
+# ------------------------------------------------------------------ handles
+
+
+class CheckpointWrite:
+    """Handle for one (possibly async) checkpoint save."""
+
+    def __init__(self, directory: str, step: int) -> None:
+        self.directory = directory
+        self.step = step
+        self._done = threading.Event()
+        self._error: Optional[BaseException] = None
+        self._path: Optional[str] = None
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> str:
+        """Block until the write committed; returns the committed step dir.
+        Re-raises any writer-thread exception."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(f"checkpoint write for step {self.step} still in flight")
+        if self._error is not None:
+            raise self._error
+        return self._path  # type: ignore[return-value]
+
+    def _finish(self, path: Optional[str], error: Optional[BaseException]) -> None:
+        self._path, self._error = path, error
+        self._done.set()
+
+
+_INFLIGHT: List[CheckpointWrite] = []
+_INFLIGHT_LOCK = threading.Lock()
+# highest step this process has assigned per series directory: auto-stepping
+# must not reuse a step whose async write has not committed yet (two writers
+# would race on the same tmp dir)
+_LAST_ASSIGNED: Dict[str, int] = {}
+
+
+def wait_for_all_saves() -> None:
+    """Drain every in-flight async save (re-raising the first failure)."""
+    with _INFLIGHT_LOCK:
+        pending = list(_INFLIGHT)
+    for handle in pending:
+        handle.result()
+
+
+# -------------------------------------------------------------------- save
+
+
+def _snapshot(obj: Any, persistent_only: bool) -> Tuple[Dict[str, Any], List[Tuple[str, Any, bool]]]:
+    """Host-side snapshot: schema tree + (key, array-ref, is_cat) entries.
+
+    Cheap and sync-free: jax arrays are captured by reference, device->host
+    transfer happens at write time (possibly on the background thread).
+    """
+    from metrics_tpu.core.collections import MetricCollection
+
+    if isinstance(obj, MetricCollection):
+        groups = _manifest.collection_groups(obj)
+        tree: Dict[str, Any] = {
+            "kind": "collection",
+            "metrics": {
+                name: _manifest.metric_schema(m, persistent_only)
+                for name, m in obj._modules.items()
+            },
+            "groups": groups,
+            "update_counts": {name: int(m._update_count) for name, m in obj._modules.items()},
+        }
+        entries: List[Tuple[str, Any, bool]] = []
+        for group in groups:
+            # group members alias the leader's arrays: save each group once
+            entries.extend(
+                _serializer.snapshot_state(obj._modules[group[0]], f"{group[0]}/", persistent_only)
+            )
+        return tree, entries
+    return (
+        {"kind": "metric", "schema": _manifest.metric_schema(obj, persistent_only)},
+        _serializer.snapshot_state(obj, persistent_only=persistent_only),
+    )
+
+
+def _prune(directory: str, retain: int) -> None:
+    steps = all_steps(directory)
+    for step in steps[:-retain] if retain > 0 else []:
+        shutil.rmtree(os.path.join(directory, _step_name(step)), ignore_errors=True)
+
+
+def _try_commit(directory: str, tmp_dir: str, step: int, world: int) -> bool:
+    """Barrier-free commit: if all ``world`` manifests are present, write the
+    COMMIT record and rename the tmp dir into place. Returns True when the
+    step is committed (by us or a racing host) on return."""
+    final_dir = os.path.join(directory, _step_name(step))
+    if _is_committed(final_dir):
+        return True
+    if not os.path.isdir(tmp_dir):
+        return _is_committed(final_dir)
+    present = [h for h in range(world) if os.path.isfile(os.path.join(tmp_dir, _manifest_name(h)))]
+    if len(present) < world:
+        return False
+    _atomic_write_json(
+        os.path.join(tmp_dir, "COMMIT"),
+        {
+            "format": _manifest.FORMAT,
+            "version": _manifest.FORMAT_VERSION,
+            "step": step,
+            "world": world,
+            "time_unix": time.time(),
+        },
+    )
+    try:
+        os.rename(tmp_dir, final_dir)
+    except OSError:
+        # a racing host renamed first; losing the race is success
+        if not _is_committed(final_dir):
+            raise
+    return True
+
+
+def _stamp(obj: Any, **stats: Any) -> None:
+    """Record last-checkpoint stats on the object for ``state_report``."""
+    try:
+        ckpt_stats = getattr(obj, "_ckpt_stats", None)
+        if not isinstance(ckpt_stats, dict):
+            ckpt_stats = {}
+        ckpt_stats.update(stats)
+        object.__setattr__(obj, "_ckpt_stats", ckpt_stats)
+    except Exception:  # noqa: BLE001 — stats are best-effort observability
+        pass
+
+
+def save_checkpoint(
+    obj: Any,
+    directory: str,
+    step: Optional[int] = None,
+    *,
+    blocking: bool = True,
+    retain: Optional[int] = None,
+    replicated: bool = True,
+    persistent_only: bool = False,
+    process_index: Optional[int] = None,
+    process_count: Optional[int] = None,
+) -> CheckpointWrite:
+    """Save a :class:`Metric` or :class:`MetricCollection` state checkpoint.
+
+    Args:
+        obj: the live metric or collection (update may continue immediately —
+            the snapshot captures immutable array references).
+        directory: checkpoint series directory (created if missing).
+        step: monotonically increasing version; defaults to ``latest + 1``.
+        blocking: ``False`` returns immediately and writes on a background
+            thread; call ``.result()`` on the returned handle to join.
+        retain: keep only the newest ``retain`` committed steps (pruned by the
+            committing host after a successful commit).
+        replicated: declare array states host-replicated (the jit/GSPMD
+            pattern): host 0 writes them once, other hosts write only their
+            cat shards. Pass ``False`` for per-host local accumulation
+            (pattern B) — every host then writes all states and restore
+            re-reduces across shards on topology change.
+        persistent_only: save only states registered with ``persistent=True``
+            (``state_dict`` semantics); default saves everything, which is
+            what preemption recovery needs.
+        process_index / process_count: override the host topology (defaults
+            to the jax runtime's; explicit values support external launchers
+            and testing).
+
+    Returns:
+        A :class:`CheckpointWrite` handle (already finished when blocking).
+    """
+    from metrics_tpu.parallel.collective import process_topology
+
+    rank, world = process_topology(process_index, process_count)
+    os.makedirs(directory, exist_ok=True)
+    dir_key = os.path.abspath(directory)
+    with _INFLIGHT_LOCK:
+        if step is None:
+            last = latest_step(directory)
+            # floor on in-flight assignments too: back-to-back async saves must
+            # each get a fresh step even though none has committed yet
+            step = max(-1 if last is None else last, _LAST_ASSIGNED.get(dir_key, -1)) + 1
+        _LAST_ASSIGNED[dir_key] = max(_LAST_ASSIGNED.get(dir_key, -1), step)
+    final_dir = os.path.join(directory, _step_name(step))
+    if _is_committed(final_dir):
+        raise CheckpointError(f"checkpoint step {step} already exists in {directory}")
+
+    tree, entries = _snapshot(obj, persistent_only)
+    handle = CheckpointWrite(directory, step)
+
+    def write() -> None:
+        t0 = time.perf_counter()
+        try:
+            with _scope("tm.ckpt/save"):
+                tmp_dir = os.path.join(directory, _TMP_PREFIX + _step_name(step))
+                os.makedirs(tmp_dir, exist_ok=True)
+                mine = entries if (rank == 0 or not replicated) else [e for e in entries if e[2]]
+                payload_meta = _serializer.write_payload(
+                    os.path.join(tmp_dir, _payload_name(rank)), mine
+                )
+                _atomic_write_json(
+                    os.path.join(tmp_dir, _manifest_name(rank)),
+                    {
+                        "format": _manifest.FORMAT,
+                        "version": _manifest.FORMAT_VERSION,
+                        "step": step,
+                        "host": rank,
+                        "world": world,
+                        "replicated": replicated,
+                        "persistent_only": persistent_only,
+                        "tree": tree,
+                        "payload": payload_meta,
+                    },
+                )
+                committed = _try_commit(directory, tmp_dir, step, world)
+                if committed and retain is not None:
+                    _prune(directory, retain)
+            elapsed_ms = (time.perf_counter() - t0) * 1000
+            if _obs._ENABLED:
+                _obs.REGISTRY.inc("ckpt", "saves")
+                _obs.REGISTRY.inc("ckpt", "bytes", payload_meta["nbytes"])
+                _obs.REGISTRY.inc("ckpt", "save_ms", elapsed_ms)
+            _stamp(obj, last_save_ms=round(elapsed_ms, 3), last_save_step=step,
+                   last_save_bytes=payload_meta["nbytes"])
+            handle._finish(final_dir, None)
+        except BaseException as err:  # noqa: BLE001 — surfaced via handle.result()
+            handle._finish(None, err)
+        finally:
+            with _INFLIGHT_LOCK:
+                if handle in _INFLIGHT:
+                    _INFLIGHT.remove(handle)
+
+    if blocking:
+        write()
+        handle.result()
+    else:
+        with _INFLIGHT_LOCK:
+            _INFLIGHT.append(handle)
+        threading.Thread(target=write, name=f"metrics-tpu-ckpt-{step}", daemon=True).start()
+    return handle
+
+
+# ------------------------------------------------------------------ restore
+
+
+def _resolve_step_dir(directory: str, step: Optional[int]) -> Tuple[int, str]:
+    if step is None:
+        found = latest_step(directory)
+        if found is None:
+            raise CheckpointNotFoundError(f"no committed checkpoint found in {directory!r}")
+        return found, os.path.join(directory, _step_name(found))
+    step_dir = os.path.join(directory, _step_name(step))
+    if not os.path.isdir(step_dir):
+        if os.path.isdir(os.path.join(directory, _TMP_PREFIX + _step_name(step))):
+            raise IncompleteCheckpointError(
+                f"checkpoint step {step} in {directory!r} was started but never committed"
+            )
+        raise CheckpointNotFoundError(f"no checkpoint for step {step} in {directory!r}")
+    if not _is_committed(step_dir):
+        raise IncompleteCheckpointError(
+            f"checkpoint step {step} in {directory!r} has no commit record (partial write)"
+        )
+    return step, step_dir
+
+
+def restore_checkpoint(
+    obj: Any,
+    directory: str,
+    step: Optional[int] = None,
+    *,
+    process_index: Optional[int] = None,
+    process_count: Optional[int] = None,
+) -> int:
+    """Restore ``obj`` (Metric or MetricCollection) from a committed checkpoint.
+
+    Validates the saved manifest against the live tree first (typed errors,
+    no partial loads), then assigns states — including compute-group
+    re-aliasing for collections and topology re-mapping when the restoring
+    host count differs from the saved one. Returns the restored step.
+    """
+    from metrics_tpu.core.collections import MetricCollection
+    from metrics_tpu.parallel.collective import process_topology
+
+    rank, world = process_topology(process_index, process_count)
+    step, step_dir = _resolve_step_dir(directory, step)
+    t0 = time.perf_counter()
+    with _scope("tm.ckpt/restore"):
+        commit = _read_json(os.path.join(step_dir, "COMMIT"), "commit record")
+        saved_world = int(commit.get("world", 1))
+        manifests = []
+        for host in range(saved_world):
+            path = os.path.join(step_dir, _manifest_name(host))
+            try:
+                manifests.append(_read_json(path, "manifest"))
+            except FileNotFoundError:
+                raise IncompleteCheckpointError(
+                    f"committed checkpoint {step_dir} is missing {_manifest_name(host)}"
+                    f" (commit record promises {saved_world} hosts)"
+                ) from None
+        replicated = bool(manifests[0].get("replicated", True))
+        persistent_only = bool(manifests[0].get("persistent_only", False))
+        payloads = [
+            _serializer.load_payload(
+                os.path.join(step_dir, m["payload"]["file"]), m["payload"]
+            )
+            for m in manifests
+        ]
+        bytes_read = sum(int(m["payload"]["nbytes"]) for m in manifests)
+
+        own = manifests[rank]["tree"] if world == saved_world else None
+        tree = (own or manifests[0]["tree"])
+
+        if isinstance(obj, MetricCollection):
+            _restore_collection(
+                obj, tree, manifests, payloads,
+                rank=rank, world=world, saved_world=saved_world,
+                replicated=replicated, persistent_only=persistent_only,
+            )
+        else:
+            if tree.get("kind") != "metric":
+                raise CheckpointError(
+                    "checkpoint was saved from a MetricCollection; restore into a collection"
+                )
+            # live schema stays FULL even for persistent_only checkpoints:
+            # allow_subset loads the saved subset, untouched states keep defaults
+            live = _manifest.metric_schema(obj)
+            _manifest.validate_schema(live, tree["schema"], allow_subset=persistent_only)
+            count = _restore.merged_update_count(
+                [m["tree"]["schema"] for m in manifests],
+                own["schema"] if own is not None else None,
+            )
+            _restore.assign_metric_state(
+                obj, tree["schema"], payloads,
+                rank=rank, world=world, saved_world=saved_world,
+                replicated=replicated, update_count=count,
+            )
+    elapsed_ms = (time.perf_counter() - t0) * 1000
+    if _obs._ENABLED:
+        _obs.REGISTRY.inc("ckpt", "restores")
+        _obs.REGISTRY.inc("ckpt", "bytes", bytes_read)
+        _obs.REGISTRY.inc("ckpt", "restore_ms", elapsed_ms)
+    _stamp(obj, last_restore_ms=round(elapsed_ms, 3), last_restore_step=step,
+           last_restore_bytes=bytes_read)
+    return step
+
+
+def _restore_collection(
+    collection: Any,
+    tree: Dict[str, Any],
+    manifests: List[Dict[str, Any]],
+    payloads: List[Dict[str, Any]],
+    *,
+    rank: int,
+    world: int,
+    saved_world: int,
+    replicated: bool,
+    persistent_only: bool,
+) -> None:
+    from metrics_tpu.ckpt.errors import SchemaDriftError
+
+    if tree.get("kind") != "collection":
+        raise CheckpointError("checkpoint was saved from a single Metric; restore into a Metric")
+    saved_names = set(tree["metrics"])
+    live_names = set(collection._modules)
+    if saved_names != live_names:
+        raise SchemaDriftError(
+            "checkpoint metric names do not match the live collection:"
+            f" missing live={sorted(saved_names - live_names)},"
+            f" extra live={sorted(live_names - saved_names)}"
+        )
+    # validate the WHOLE tree first: restore is all-or-nothing. Each member
+    # validates against its OWN saved schema (group members share state layout
+    # but not class names); the leader's payload is what gets loaded.
+    for name in tree["metrics"]:
+        live = _manifest.metric_schema(collection._modules[name])
+        _manifest.validate_schema(live, tree["metrics"][name], path=name, allow_subset=persistent_only)
+    update_counts = tree.get("update_counts", {})
+    for group in tree["groups"]:
+        leader_name = group[0]
+        leader_schema = tree["metrics"][leader_name]
+        leader = collection._modules[leader_name]
+        for name in group:
+            member = collection._modules[name]
+            _restore.assign_metric_state(
+                member, leader_schema, payloads, f"{leader_name}/",
+                rank=rank, world=world, saved_world=saved_world, replicated=replicated,
+                update_count=int(update_counts.get(name, leader_schema["update_count"])),
+            )
+            if member is not leader:
+                # re-establish compute-group aliasing: members point at the
+                # leader's array objects, exactly like
+                # _compute_groups_create_state_ref after an update
+                for state in leader._defaults:
+                    if state in leader_schema["states"]:
+                        setattr(member, state, getattr(leader, state))
+    collection._state_is_copy = False

@@ -1,0 +1,224 @@
+"""Manifest schema: the host-side description of a metric state tree.
+
+A manifest is the JSON half of a checkpoint: it records *what* was saved
+(per-state kinds, dtypes, shapes, reduction specs, capacities, compute-group
+topology, update counts) while the payload blob records the bytes. Restore
+validates the manifest against the live metric tree **before** touching any
+state, raising the typed errors in :mod:`metrics_tpu.ckpt.errors` on drift, so
+a failed restore never leaves a metric half-loaded.
+
+Schema walking is recursive: wrapper metrics (``BootStrapper``,
+``MultioutputWrapper``, ``MinMaxMetric``, ``CompositionalMetric``...) hold
+child ``Metric`` instances in plain attributes; those children are discovered
+by value type and serialized as a nested tree, so any wrapper composition
+checkpoints without per-class code.
+
+Nothing in this module touches device values: shapes/dtypes/capacities are
+static metadata, and cat counts live in the payload (reading them at snapshot
+time would force a device sync on the save critical path).
+"""
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+from metrics_tpu.ckpt.errors import DtypeDriftError, SchemaDriftError, ShapeDriftError
+
+FORMAT = "metrics_tpu.ckpt"
+FORMAT_VERSION = 1
+
+#: state-kind tags used in manifests
+KIND_ARRAY = "array"
+KIND_CAT_BUFFER = "cat_buffer"
+KIND_LIST = "list"
+
+
+def reduce_spec(fx: Union[str, Callable, None]) -> Optional[str]:
+    """JSON-stable name for a ``dist_reduce_fx``: the string kinds verbatim,
+    ``None`` as null, callables by qualified name (compared by name on restore —
+    the function object itself cannot round-trip through JSON)."""
+    if fx is None or isinstance(fx, str):
+        return fx
+    return f"callable:{getattr(fx, '__module__', '?')}.{getattr(fx, '__qualname__', repr(fx))}"
+
+
+def child_metrics(metric: Any) -> Dict[str, Union[Any, List[Any]]]:
+    """Discover child ``Metric`` instances held in plain attributes.
+
+    Returns ``{attr: Metric}`` and ``{attr: [Metric, ...]}`` entries in sorted
+    attribute order. Registered states are excluded (they are arrays); bound
+    callables (the wrapped ``update``/``compute`` closures) never match.
+    """
+    from metrics_tpu.core.metric import Metric
+
+    out: Dict[str, Union[Any, List[Any]]] = {}
+    for attr in sorted(vars(metric)):
+        if attr in getattr(metric, "_defaults", {}):
+            continue
+        value = getattr(metric, attr)
+        if isinstance(value, Metric):
+            out[attr] = value
+        elif (
+            isinstance(value, (list, tuple))
+            and len(value) > 0
+            and all(isinstance(v, Metric) for v in value)
+        ):
+            out[attr] = list(value)
+    return out
+
+
+def _value_kind(value: Any) -> str:
+    from metrics_tpu.core.state import CatBuffer
+
+    if isinstance(value, CatBuffer):
+        return KIND_CAT_BUFFER
+    if isinstance(value, (list, tuple)):
+        return KIND_LIST
+    return KIND_ARRAY
+
+
+def _default_spec(default: Any) -> Dict[str, Any]:
+    """Validation descriptor of a state's REGISTERED DEFAULT (reset value).
+
+    Validation compares defaults, not live values: defaults encode the metric's
+    configuration (``num_classes`` shapes, cat dtypes...), while live values are
+    data — several metrics lazily reshape or retype a state on first update
+    (e.g. a scalar placeholder becoming the first batch's image shape), which a
+    current-value compare would misread as drift on a fresh restore target.
+    """
+    from metrics_tpu.core.state import CatBuffer
+
+    if isinstance(default, CatBuffer):
+        return {
+            "kind": KIND_CAT_BUFFER,
+            "dtype": str(default.data.dtype),
+            "item_shape": list(default.data.shape[1:]),
+        }
+    if isinstance(default, (list, tuple)):
+        return {"kind": KIND_LIST}
+    return {
+        "kind": KIND_ARRAY,
+        "dtype": str(getattr(default, "dtype", None)),
+        "shape": list(getattr(default, "shape", ())),
+    }
+
+
+def state_spec(metric: Any, name: str) -> Dict[str, Any]:
+    """Manifest entry for one registered state of ``metric``.
+
+    ``kind`` describes the CURRENT value (it decides how the payload entries
+    for this state are keyed and decoded); ``default`` carries the
+    configuration descriptor that restore validates.
+    """
+    return {
+        "reduce": reduce_spec(metric._reductions.get(name)),
+        "kind": _value_kind(getattr(metric, name)),
+        "default": _default_spec(metric._defaults[name]),
+    }
+
+
+def metric_schema(metric: Any, persistent_only: bool = False) -> Dict[str, Any]:
+    """Recursive schema of a metric: its states plus any child metric trees."""
+    states = {
+        name: state_spec(metric, name)
+        for name in metric._defaults
+        if not persistent_only or metric._persistent.get(name, False)
+    }
+    children: Dict[str, Any] = {}
+    for attr, child in child_metrics(metric).items():
+        if isinstance(child, list):
+            children[attr] = [metric_schema(c, persistent_only) for c in child]
+        else:
+            children[attr] = metric_schema(child, persistent_only)
+    return {
+        "class": type(metric).__name__,
+        "update_count": int(metric._update_count),
+        "states": states,
+        "children": children,
+    }
+
+
+def _drift(path: str, what: str) -> str:
+    return f"checkpoint schema drift at `{path or '<root>'}`: {what}"
+
+
+def validate_schema(
+    live: Dict[str, Any],
+    saved: Dict[str, Any],
+    path: str = "",
+    allow_subset: bool = False,
+) -> None:
+    """Raise a typed error where ``saved`` cannot be loaded into ``live``.
+
+    ``allow_subset`` permits saved state/child sets to be a subset of the live
+    ones (the ``persistent_only`` save mode); extra *saved* entries always
+    fail. Cat-buffer capacities are intentionally NOT compared — restore
+    re-packs rows into the live capacity (topology change support).
+    """
+    if live["class"] != saved["class"]:
+        raise SchemaDriftError(
+            _drift(path, f"saved metric class {saved['class']!r} != live {live['class']!r}")
+        )
+    live_states, saved_states = live["states"], saved["states"]
+    missing = sorted(set(saved_states) - set(live_states))
+    if missing:
+        raise SchemaDriftError(_drift(path, f"saved states {missing} do not exist on the live metric"))
+    if not allow_subset:
+        extra = sorted(set(live_states) - set(saved_states))
+        if extra:
+            raise SchemaDriftError(_drift(path, f"live states {extra} are missing from the checkpoint"))
+    for name in saved_states:
+        ls, ss = live_states[name], saved_states[name]
+        spath = f"{path}.{name}" if path else name
+        if ls["reduce"] != ss["reduce"]:
+            raise SchemaDriftError(
+                _drift(spath, f"saved reduce {ss['reduce']!r} != live reduce {ls['reduce']!r}")
+            )
+        ld, sd = ls["default"], ss["default"]
+        if ld["kind"] != sd["kind"]:
+            raise SchemaDriftError(
+                _drift(spath, f"saved kind {sd['kind']!r} != live kind {ld['kind']!r}")
+            )
+        if sd["kind"] in (KIND_ARRAY, KIND_CAT_BUFFER) and ld["dtype"] != sd["dtype"]:
+            raise DtypeDriftError(
+                _drift(spath, f"saved dtype {sd['dtype']} != live dtype {ld['dtype']}")
+            )
+        if sd["kind"] == KIND_ARRAY and list(ld["shape"]) != list(sd["shape"]):
+            raise ShapeDriftError(
+                _drift(spath, f"saved shape {sd['shape']} != live shape {ld['shape']}")
+            )
+        if sd["kind"] == KIND_CAT_BUFFER and list(ld["item_shape"]) != list(sd["item_shape"]):
+            raise ShapeDriftError(
+                _drift(
+                    spath,
+                    f"saved item shape {sd['item_shape']} != live item shape {ld['item_shape']}",
+                )
+            )
+    live_children, saved_children = live["children"], saved["children"]
+    missing_c = sorted(set(saved_children) - set(live_children))
+    if missing_c:
+        raise SchemaDriftError(_drift(path, f"saved child metrics {missing_c} do not exist live"))
+    if not allow_subset:
+        extra_c = sorted(set(live_children) - set(saved_children))
+        if extra_c:
+            raise SchemaDriftError(_drift(path, f"live child metrics {extra_c} missing from checkpoint"))
+    for attr in saved_children:
+        lc, sc = live_children[attr], saved_children[attr]
+        cpath = f"{path}.{attr}" if path else attr
+        if isinstance(sc, list) != isinstance(lc, list):
+            raise SchemaDriftError(_drift(cpath, "child metric list/single mismatch"))
+        if isinstance(sc, list):
+            if len(sc) != len(lc):
+                raise SchemaDriftError(
+                    _drift(cpath, f"saved {len(sc)} child metrics != live {len(lc)}")
+                )
+            for i, (l_i, s_i) in enumerate(zip(lc, sc)):
+                validate_schema(l_i, s_i, f"{cpath}[{i}]", allow_subset)
+        else:
+            validate_schema(lc, sc, cpath, allow_subset)
+
+
+def collection_groups(collection: Any) -> List[List[str]]:
+    """Compute-group partition of a collection as name lists (leader first);
+    collections built with ``compute_groups=False`` get singleton groups."""
+    groups = [list(v) for v in getattr(collection, "_groups", {}).values()]
+    if not groups:
+        groups = [[str(k)] for k in collection._modules]
+    return groups

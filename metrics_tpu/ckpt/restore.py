@@ -1,0 +1,189 @@
+"""Restore: load validated payloads back into a live metric tree.
+
+Topology-change matrix (saved on N hosts, restored onto M hosts):
+
+====================  =======================  ==================================
+state kind / reduce    N == M                   N != M
+====================  =======================  ==================================
+array, replicated      host 0's copy            host 0's copy (all hosts)
+array sum (per-host)   own shard, verbatim      re-reduced total on host 0,
+                                                reset default on hosts > 0
+array max/min          own shard, verbatim      element-wise merge, all hosts
+array mean             own shard, verbatim      mean-of-means, all hosts
+array None/callable    own shard, verbatim      TopologyError (not re-reducible)
+cat (CatBuffer/list)   own shard, verbatim*     rows re-packed: concatenated in
+                                                host order, split contiguously
+                                                over the M hosts
+====================  =======================  ==================================
+
+``*`` verbatim when the live capacity equals the saved capacity — including the
+true over-capacity count and the sticky overflow flag, so NaN-poisoning of an
+overflowed eval survives preemption. When capacities differ (or N != M) the
+valid rows are re-packed; the overflow *flag* still survives (ORed across
+hosts), the unrecoverable true count degrades to the packed row count.
+
+Assignment is all-or-nothing per restore call: validation runs against the full
+manifest before the first ``setattr``, so typed failures leave the metric
+untouched.
+"""
+from typing import Any, Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.ckpt.errors import CapacityError, CorruptCheckpointError, TopologyError
+from metrics_tpu.ckpt.manifest import KIND_ARRAY, KIND_CAT_BUFFER, KIND_LIST, child_metrics
+from metrics_tpu.ckpt.serializer import iter_list_items
+
+
+def _require(payload: Dict[str, np.ndarray], key: str) -> np.ndarray:
+    try:
+        return payload[key]
+    except KeyError:
+        raise CorruptCheckpointError(f"checkpoint payload is missing entry `{key}`") from None
+
+
+def split_items(items: List[Any], world: int, rank: int) -> List[Any]:
+    """Contiguous split of ``items`` into ``world`` near-equal parts; part ``rank``.
+
+    Matches ``np.array_split`` semantics (first ``len % world`` parts get one
+    extra item) so re-packing is deterministic and order-preserving.
+    """
+    n = len(items)
+    base, rem = divmod(n, world)
+    start = rank * base + min(rank, rem)
+    stop = start + base + (1 if rank < rem else 0)
+    return items[start:stop]
+
+
+def _merge_arrays(key: str, reduce_name: Optional[str], payloads: List[Dict[str, np.ndarray]],
+                  default: Any, rank: int) -> np.ndarray:
+    """Re-reduce one per-host array state across the saved shards (N != M path)."""
+    shards = [_require(p, key) for p in payloads]
+    if reduce_name == "sum":
+        return np.sum(shards, axis=0) if rank == 0 else np.asarray(default)
+    if reduce_name == "mean":
+        return np.mean(shards, axis=0)
+    if reduce_name == "max":
+        return np.maximum.reduce(shards)
+    if reduce_name == "min":
+        return np.minimum.reduce(shards)
+    raise TopologyError(
+        f"state `{key}` has reduction {reduce_name!r}, which cannot be re-reduced"
+        " across a host-count change; restore with the same number of hosts"
+    )
+
+
+def _restore_cat_buffer(metric: Any, name: str, prefix: str, payloads: List[Dict[str, np.ndarray]],
+                        rank: int, world: int, saved_world: int) -> Any:
+    from metrics_tpu.core.state import CatBuffer
+
+    live: CatBuffer = getattr(metric, name)
+    key = f"{prefix}{name}"
+    datas = [_require(p, f"{key}@data") for p in payloads]
+    counts = [int(_require(p, f"{key}@count")) for p in payloads]
+    flags = [
+        bool(_require(p, f"{key}@overflow")) or counts[h] > datas[h].shape[0]
+        for h, p in enumerate(payloads)
+    ]
+    if world == saved_world and datas[rank].shape[0] == live.capacity:
+        # exact resume: same topology and capacity — keep the true (possibly
+        # over-capacity) count and the saved flag bit-for-bit
+        return CatBuffer(
+            jnp.asarray(datas[rank]),
+            jnp.asarray(counts[rank], jnp.int32),
+            jnp.asarray(bool(_require(payloads[rank], f"{key}@overflow")), jnp.bool_),
+        )
+    rows = np.concatenate(
+        [d[: min(c, d.shape[0])] for d, c in zip(datas, counts)], axis=0
+    )
+    mine = split_items(list(range(rows.shape[0])), world, rank)
+    mine_rows = rows[mine[0] : mine[-1] + 1] if mine else rows[:0]
+    if mine_rows.shape[0] > live.capacity:
+        raise CapacityError(
+            f"cat state `{key}`: {mine_rows.shape[0]} restored rows exceed the live"
+            f" CatBuffer capacity {live.capacity}; rebuild the metric with"
+            f" `cat_capacity>={mine_rows.shape[0]}` before restoring"
+        )
+    fill = metric._cat_meta.get(name, ((), None, 0))[2]
+    return CatBuffer.from_rows(
+        mine_rows, live.capacity, fill_value=fill, dtype=live.data.dtype, overflow=any(flags)
+    )
+
+
+def _restore_list(name: str, prefix: str, payloads: List[Dict[str, np.ndarray]],
+                  rank: int, world: int, saved_world: int) -> List[Any]:
+    if world == saved_world:
+        return [jnp.asarray(v) for v in iter_list_items(payloads[rank], prefix, name)]
+    items: List[np.ndarray] = []
+    for p in payloads:
+        items.extend(iter_list_items(p, prefix, name))
+    return [jnp.asarray(v) for v in split_items(items, world, rank)]
+
+
+def assign_metric_state(
+    metric: Any,
+    saved_schema: Dict[str, Any],
+    payloads: List[Dict[str, np.ndarray]],
+    prefix: str = "",
+    *,
+    rank: int = 0,
+    world: int = 1,
+    saved_world: int = 1,
+    replicated: bool = True,
+    update_count: Optional[int] = None,
+) -> None:
+    """Load the saved state under ``prefix`` into ``metric`` (recursively).
+
+    ``payloads[h]`` is saved host ``h``'s decoded payload. Call only after
+    :func:`metrics_tpu.ckpt.manifest.validate_schema` has accepted the tree.
+    """
+    for name, spec in saved_schema["states"].items():
+        key = f"{prefix}{name}"
+        if spec["kind"] == KIND_CAT_BUFFER:
+            setattr(metric, name, _restore_cat_buffer(metric, name, prefix, payloads, rank, world, saved_world))
+        elif spec["kind"] == KIND_LIST:
+            setattr(metric, name, _restore_list(name, prefix, payloads, rank, world, saved_world))
+        elif replicated:
+            # replicated arrays: one copy exists (host 0 wrote it), all hosts load it
+            setattr(metric, name, jnp.asarray(_require(payloads[0], key)))
+        elif world == saved_world:
+            setattr(metric, name, jnp.asarray(_require(payloads[rank], key)))
+        else:
+            merged = _merge_arrays(key, spec["reduce"], payloads, metric._defaults[name], rank)
+            setattr(metric, name, jnp.asarray(merged))
+    for attr, child_schema in saved_schema["children"].items():
+        live_child = child_metrics(metric)[attr]
+        if isinstance(child_schema, list):
+            for i, (c_metric, c_schema) in enumerate(zip(live_child, child_schema)):
+                assign_metric_state(
+                    c_metric, c_schema, payloads, f"{prefix}{attr}[{i}]/",
+                    rank=rank, world=world, saved_world=saved_world, replicated=replicated,
+                    update_count=c_schema.get("update_count"),
+                )
+        else:
+            assign_metric_state(
+                live_child, child_schema, payloads, f"{prefix}{attr}/",
+                rank=rank, world=world, saved_world=saved_world, replicated=replicated,
+                update_count=child_schema.get("update_count"),
+            )
+    finalize_metric(metric, saved_schema["update_count"] if update_count is None else update_count)
+
+
+def finalize_metric(metric: Any, update_count: int) -> None:
+    """Reset runtime bookkeeping after a state load so the metric behaves as if
+    it had accumulated the restored state itself."""
+    metric._update_count = int(update_count)
+    metric._computed = None
+    metric._forward_cache = None
+    metric._cache = None
+    metric._is_synced = False
+
+
+def merged_update_count(schemas: List[Dict[str, Any]], own: Optional[Dict[str, Any]]) -> int:
+    """Update count to restore: the restoring host's own on exact topology,
+    otherwise the max across saved hosts (counts gate warnings and the mean
+    forward path; max is the conservative choice)."""
+    if own is not None:
+        return int(own["update_count"])
+    return max(int(s["update_count"]) for s in schemas)

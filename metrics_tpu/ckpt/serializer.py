@@ -1,0 +1,141 @@
+"""Payload (de)serialization: raw-bytes blobs with a manifest-side index.
+
+Design constraints this format answers:
+
+- **bf16 and friends.** ``np.savez`` cannot express ``bfloat16`` without
+  pickling; a raw ``tobytes()`` blob + a ``{dtype, shape}`` index entry can
+  express every dtype jax produces (``ml_dtypes`` registers them with numpy).
+- **Async snapshot.** jax arrays are immutable, so the save critical path only
+  captures *references* (:func:`snapshot_state`); the device->host transfer
+  (``np.asarray``) happens when the background writer thread serializes.
+- **Integrity.** Every entry records length + CRC32; a truncated or bit-rotted
+  payload fails restore with :class:`CorruptCheckpointError` instead of loading
+  garbage into metric state.
+
+Key syntax inside one payload (all segments are python identifiers):
+
+- ``tp`` — array state of the root metric
+- ``x@data`` / ``x@count`` / ``x@overflow`` — the three fields of a CatBuffer
+- ``y#3`` — item 3 of a list ("cat") state
+- ``metrics[2]/tp`` — state of a child metric held in a list attribute
+- ``AccName/tp`` — state of a named collection member (prefix added by manager)
+"""
+import os
+import zlib
+from typing import Any, Dict, Iterator, List, Tuple
+
+import numpy as np
+
+from metrics_tpu.ckpt.errors import CorruptCheckpointError
+from metrics_tpu.ckpt.manifest import child_metrics
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """Resolve a dtype name, including the ml_dtypes families (bfloat16...)."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+# --------------------------------------------------------------- flattening
+
+
+def snapshot_state(metric: Any, prefix: str = "", persistent_only: bool = False) -> List[Tuple[str, Any, bool]]:
+    """Flatten a metric tree's live state into ``(key, value, is_cat)`` entries.
+
+    Values are *references* (jax arrays are immutable): safe to serialize later
+    on a background thread while the live metric keeps updating. ``is_cat``
+    marks cat-type entries (CatBuffer fields / list items) — the per-host
+    shards of a multi-host save; array states are the replicated part.
+    """
+    from metrics_tpu.core.state import CatBuffer
+
+    out: List[Tuple[str, Any, bool]] = []
+    for name in metric._defaults:
+        if persistent_only and not metric._persistent.get(name, False):
+            continue
+        value = getattr(metric, name)
+        if isinstance(value, CatBuffer):
+            out.append((f"{prefix}{name}@data", value.data, True))
+            out.append((f"{prefix}{name}@count", value.count, True))
+            out.append((f"{prefix}{name}@overflow", value.overflow, True))
+        elif isinstance(value, (list, tuple)):
+            for i, item in enumerate(value):
+                out.append((f"{prefix}{name}#{i}", item, True))
+        else:
+            out.append((f"{prefix}{name}", value, False))
+    for attr, child in child_metrics(metric).items():
+        if isinstance(child, list):
+            for i, c in enumerate(child):
+                out.extend(snapshot_state(c, f"{prefix}{attr}[{i}]/", persistent_only))
+        else:
+            out.extend(snapshot_state(child, f"{prefix}{attr}/", persistent_only))
+    return out
+
+
+# ------------------------------------------------------------------ writing
+
+
+def write_payload(path: str, entries: List[Tuple[str, Any, bool]]) -> Dict[str, Any]:
+    """Serialize entries to a raw blob at ``path``; returns the payload index.
+
+    The device->host transfer happens here (off the critical path when called
+    from the background writer). The file is fsynced before returning so a
+    manifest that references it is never newer than its bytes.
+    """
+    index: Dict[str, Dict[str, Any]] = {}
+    offset = 0
+    with open(path, "wb") as fh:
+        for key, value, _ in entries:
+            arr = np.asarray(value)
+            buf = arr.tobytes()
+            index[key] = {
+                "offset": offset,
+                "nbytes": len(buf),
+                "dtype": str(arr.dtype),
+                "shape": list(arr.shape),
+                "crc32": zlib.crc32(buf),
+            }
+            fh.write(buf)
+            offset += len(buf)
+        fh.flush()
+        os.fsync(fh.fileno())
+    return {"file": os.path.basename(path), "nbytes": offset, "index": index}
+
+
+def load_payload(path: str, payload_meta: Dict[str, Any]) -> Dict[str, np.ndarray]:
+    """Read a payload blob back into ``{key: np.ndarray}``, verifying integrity."""
+    try:
+        with open(path, "rb") as fh:
+            blob = fh.read()
+    except OSError as err:
+        raise CorruptCheckpointError(f"cannot read checkpoint payload {path}: {err}") from err
+    if len(blob) < int(payload_meta.get("nbytes", 0)):
+        raise CorruptCheckpointError(
+            f"truncated checkpoint payload {path}: {len(blob)} bytes on disk,"
+            f" manifest promises {payload_meta['nbytes']}"
+        )
+    out: Dict[str, np.ndarray] = {}
+    for key, meta in payload_meta["index"].items():
+        start, n = int(meta["offset"]), int(meta["nbytes"])
+        if start + n > len(blob):
+            raise CorruptCheckpointError(
+                f"truncated checkpoint payload {path}: entry `{key}` ends at {start + n},"
+                f" file has {len(blob)} bytes"
+            )
+        buf = blob[start : start + n]
+        if zlib.crc32(buf) != int(meta["crc32"]):
+            raise CorruptCheckpointError(f"checksum mismatch for entry `{key}` in {path}")
+        out[key] = np.frombuffer(buf, dtype=_np_dtype(meta["dtype"])).reshape(meta["shape"])
+    return out
+
+
+def iter_list_items(payload: Dict[str, np.ndarray], prefix: str, name: str) -> Iterator[np.ndarray]:
+    """Yield the ``{prefix}{name}#i`` items of one list state in index order."""
+    i = 0
+    while f"{prefix}{name}#{i}" in payload:
+        yield payload[f"{prefix}{name}#{i}"]
+        i += 1

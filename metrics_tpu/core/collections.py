@@ -522,6 +522,22 @@ class MetricCollection:
         for k, m in self.items(keep_base=True, copy_state=False):
             m.load_state_dict(state_dict, prefix=f"{k}.")
 
+    def save_checkpoint(self, directory: str, step: Optional[int] = None, **kwargs: Any):
+        """Atomic full-state checkpoint of the collection (group-aware: each
+        compute group's shared state is written once, under its leader's name).
+        See :func:`metrics_tpu.ckpt.save_checkpoint` for options."""
+        from metrics_tpu.ckpt import save_checkpoint
+
+        return save_checkpoint(self, directory, step=step, **kwargs)
+
+    def restore_checkpoint(self, directory: str, step: Optional[int] = None, **kwargs: Any) -> int:
+        """Restore a checkpoint written by :meth:`save_checkpoint`, re-pointing
+        compute-group members at their leader's loaded arrays (aliasing is
+        re-established exactly as after an update). Returns the restored step."""
+        from metrics_tpu.ckpt import restore_checkpoint
+
+        return restore_checkpoint(self, directory, step=step, **kwargs)
+
     # ------------------------------------------------------------------ admin
 
     def add_metrics(

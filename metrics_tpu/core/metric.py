@@ -863,6 +863,34 @@ class Metric(ABC):
                 else:
                     setattr(self, key, jnp.asarray(value))
 
+    def save_checkpoint(self, directory: str, step: Optional[int] = None, **kwargs: Any):
+        """Write a durable, atomic checkpoint of this metric's full state.
+
+        Unlike :meth:`state_dict` (persistent states only, torch-checkpoint
+        parity) this captures EVERYTHING a preempted evaluation needs to
+        resume: every registered state (pass ``persistent_only=True`` for
+        state_dict semantics), ``CatBuffer`` counts/overflow flags, nested
+        child metrics, and the update count. See
+        :func:`metrics_tpu.ckpt.save_checkpoint` for ``blocking``/``retain``/
+        multi-host options; returns its :class:`~metrics_tpu.ckpt.CheckpointWrite`.
+        """
+        from metrics_tpu.ckpt import save_checkpoint
+
+        return save_checkpoint(self, directory, step=step, **kwargs)
+
+    def restore_checkpoint(self, directory: str, step: Optional[int] = None, **kwargs: Any) -> int:
+        """Load a checkpoint written by :meth:`save_checkpoint` into this metric.
+
+        Validates the saved manifest against this metric first (typed
+        ``metrics_tpu.ckpt`` errors on schema/shape/dtype drift, corruption,
+        or partial writes) and never leaves the metric half-loaded. Restoring
+        onto a different host count re-reduces/re-packs states (see
+        :mod:`metrics_tpu.ckpt.restore`). Returns the restored step number.
+        """
+        from metrics_tpu.ckpt import restore_checkpoint
+
+        return restore_checkpoint(self, directory, step=step, **kwargs)
+
     def _filter_kwargs(self, **kwargs: Any) -> Dict[str, Any]:
         """Filter kwargs to those accepted by ``update`` (reference: metric.py:802-821)."""
         _params = (inspect.Parameter.VAR_POSITIONAL, inspect.Parameter.VAR_KEYWORD)

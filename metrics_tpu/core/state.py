@@ -98,6 +98,45 @@ class CatBuffer:
         the source (keeps the donation-safety invariant in one place)."""
         return CatBuffer(self.data.copy(), self.count.copy(), self.overflow.copy())
 
+    # --------------------------------------------------- ckpt (de)hydration
+    def to_host(self) -> dict:
+        """Host snapshot of all three fields (eager only): the payload format of
+        ``metrics_tpu.ckpt``. ``count`` is the TRUE append count (possibly over
+        capacity) so overflow remains detectable after a round trip."""
+        return {
+            "data": np.asarray(self.data),
+            "count": int(self.count),
+            "overflow": bool(self.overflow),
+        }
+
+    @classmethod
+    def from_rows(
+        cls,
+        rows: Any,
+        capacity: int,
+        fill_value: Union[int, float] = 0,
+        dtype: Any = None,
+        overflow: bool = False,
+    ) -> "CatBuffer":
+        """Re-pack dense valid rows into a fresh buffer of ``capacity``.
+
+        The checkpoint-restore repack path (topology/capacity change): rows
+        beyond ``capacity`` are a caller error — restore validates and raises
+        a typed ``CapacityError`` rather than silently dropping samples.
+        """
+        rows = np.asarray(rows)
+        if dtype is not None:
+            rows = rows.astype(dtype)
+        if rows.shape[0] > capacity:
+            raise ValueError(f"{rows.shape[0]} rows do not fit capacity {capacity}")
+        data = np.full((capacity, *rows.shape[1:]), fill_value, dtype=rows.dtype)
+        data[: rows.shape[0]] = rows
+        return cls(
+            jnp.asarray(data),
+            jnp.asarray(rows.shape[0], jnp.int32),
+            jnp.asarray(bool(overflow), jnp.bool_),
+        )
+
     def __len__(self) -> int:  # eager only
         return int(self.valid_count())
 

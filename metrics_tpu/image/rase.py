@@ -19,13 +19,20 @@ class RelativeAverageSpectralError(Metric):
         if not isinstance(window_size, int) or window_size < 1:
             raise ValueError(f"Argument `window_size` is expected to be a positive integer, but got {window_size}")
         self.window_size = window_size
-        # map-shaped states are lazily initialized on the first update
-        self._initialized = False
         import jax.numpy as jnp
 
+        # map-shaped states are lazily initialized on the first update; the
+        # scalar placeholder itself marks "uninitialized" (ndim == 0), so
+        # restoring a checkpointed map-shaped state resumes accumulation
+        # correctly (a separate boolean flag would reset on restore and
+        # silently discard the restored maps)
         self.add_state("rmse_map", default=jnp.asarray(0.0), dist_reduce_fx="sum")
         self.add_state("target_sum", default=jnp.asarray(0.0), dist_reduce_fx="sum")
         self.add_state("total_images", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    @property
+    def _initialized(self) -> bool:
+        return self.rmse_map.ndim != 0
 
     def update(self, preds: Array, target: Array) -> None:
         rmse_map = None if not self._initialized else self.rmse_map
@@ -35,11 +42,6 @@ class RelativeAverageSpectralError(Metric):
             preds, target, self.window_size, rmse_map, target_sum, total
         )
         self.rmse_map, self.target_sum, self.total_images = rmse_map, target_sum, total_images
-        self._initialized = True
 
     def compute(self) -> Array:
         return _rase_compute(self.rmse_map, self.target_sum, self.total_images, self.window_size)
-
-    def reset(self) -> None:
-        super().reset()
-        self._initialized = False

@@ -19,12 +19,17 @@ class RootMeanSquaredErrorUsingSlidingWindow(Metric):
         if not isinstance(window_size, int) or window_size < 1:
             raise ValueError("Argument `window_size` is expected to be a positive integer.")
         self.window_size = window_size
-        self._initialized = False
         import jax.numpy as jnp
 
+        # lazily-shaped map state: the scalar placeholder marks "uninitialized"
+        # (see rase.py — a separate boolean would not survive checkpoint restore)
         self.add_state("rmse_val_sum", default=jnp.asarray(0.0), dist_reduce_fx="sum")
         self.add_state("rmse_map", default=jnp.asarray(0.0), dist_reduce_fx="sum")
         self.add_state("total_images", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    @property
+    def _initialized(self) -> bool:
+        return self.rmse_map.ndim != 0
 
     def update(self, preds: Array, target: Array) -> None:
         if not self._initialized:
@@ -35,12 +40,7 @@ class RootMeanSquaredErrorUsingSlidingWindow(Metric):
             preds, target, self.window_size, rmse_val_sum, rmse_map, total
         )
         self.rmse_val_sum, self.rmse_map, self.total_images = rmse_val_sum, rmse_map, total_images
-        self._initialized = True
 
     def compute(self) -> Optional[Array]:
         rmse, _ = _rmse_sw_compute(self.rmse_val_sum, self.rmse_map, self.total_images)
         return rmse
-
-    def reset(self) -> None:
-        super().reset()
-        self._initialized = False

@@ -90,12 +90,17 @@ def metric_state_report(metric: Any) -> Dict[str, Any]:
     states: List[Dict[str, Any]] = [
         _state_entry(name, getattr(metric, name)) for name in metric._defaults
     ]
-    return {
+    report = {
         "metric": type(metric).__name__,
         "update_count": metric._update_count,
         "states": states,
         "total_nbytes": int(sum(s["nbytes"] for s in states)),
     }
+    # last checkpoint save/restore latency + step, stamped by metrics_tpu.ckpt
+    ckpt_stats = getattr(metric, "_ckpt_stats", None)
+    if isinstance(ckpt_stats, dict) and ckpt_stats:
+        report["ckpt"] = dict(ckpt_stats)
+    return report
 
 
 def collection_summary(collection: Any) -> Dict[str, Any]:
@@ -117,9 +122,13 @@ def collection_summary(collection: Any) -> Dict[str, Any]:
         )
     naive = sum(r["total_nbytes"] for r in reports.values())
     shared = sum(g["shared_nbytes"] for g in groups) if groups else naive
-    return {
+    out = {
         "metrics": reports,
         "compute_groups": groups,
         "total_nbytes": shared,
         "nbytes_saved_by_groups": int(naive - shared),
     }
+    ckpt_stats = getattr(collection, "_ckpt_stats", None)
+    if isinstance(ckpt_stats, dict) and ckpt_stats:
+        out["ckpt"] = dict(ckpt_stats)
+    return out

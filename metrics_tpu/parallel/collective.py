@@ -185,6 +185,26 @@ def pad_gather(x: jnp.ndarray, valid: jnp.ndarray, axis_name: AxisName) -> tuple
     return gathered, counts
 
 
+def process_topology(
+    process_index: Optional[int] = None, process_count: Optional[int] = None
+) -> tuple:
+    """``(rank, world)`` host topology for eager-side coordination.
+
+    The single source the checkpoint subsystem uses to decide who writes
+    replicated states (rank 0) and how many per-host shards a commit must
+    collect. Defaults to the jax runtime's view; explicit overrides support
+    external launchers and single-process tests of the multi-host protocol.
+    """
+    if process_count is None:
+        process_count = jax.process_count()
+    if process_index is None:
+        process_index = jax.process_index()
+    rank, world = int(process_index), int(process_count)
+    if not 0 <= rank < world:
+        raise ValueError(f"process_index {rank} out of range for process_count {world}")
+    return rank, world
+
+
 def distributed_available() -> bool:
     """Default ``distributed_available_fn``: multi-process JAX runtime present.
 

@@ -1,0 +1,571 @@
+"""Core semantics of metrics_tpu.ckpt: atomicity, versioning/retention, typed
+errors, async writes, multi-host commit protocol, topology change, compute-group
+re-aliasing, CatBuffer overflow survival, obs counters.
+
+The round-trip property over every public metric class lives in
+``test_roundtrip_sweep.py``; this file covers the engine itself.
+"""
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu
+from metrics_tpu import ckpt, obs
+from metrics_tpu.ckpt import (
+    CapacityError,
+    CheckpointError,
+    CheckpointNotFoundError,
+    CorruptCheckpointError,
+    DtypeDriftError,
+    IncompleteCheckpointError,
+    SchemaDriftError,
+    ShapeDriftError,
+    TopologyError,
+)
+from metrics_tpu.classification import MulticlassAccuracy, MulticlassPrecision, MulticlassRecall
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.core.state import CatBuffer, cat_values
+
+pytestmark = pytest.mark.ckpt
+
+_rng = np.random.RandomState(7)
+
+
+def _acc(preds_n=64):
+    m = MulticlassAccuracy(num_classes=5, average="micro")
+    m.update(jnp.asarray(_rng.randint(0, 5, preds_n)), jnp.asarray(_rng.randint(0, 5, preds_n)))
+    return m
+
+
+class _CatSum(Metric):
+    """Tiny metric with a cat state + a sum state, for buffer-level tests."""
+
+    full_state_update = True
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("vals", [], dist_reduce_fx="cat", cat_item_shape=(), cat_dtype=jnp.float32)
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, x):
+        x = jnp.atleast_1d(jnp.asarray(x, jnp.float32))
+        self.vals.append(x)
+        self.total = self.total + x.sum()
+
+    def compute(self):
+        return cat_values(self.vals).sum()
+
+
+class _Unreduced(Metric):
+    """A dist_reduce_fx=None state: not re-reducible across topology change."""
+
+    full_state_update = True
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("raw", jnp.zeros(3), dist_reduce_fx=None)
+
+    def update(self, x):
+        self.raw = self.raw + jnp.asarray(x)
+
+    def compute(self):
+        return self.raw.sum()
+
+
+# ------------------------------------------------------------------ basics
+
+
+def test_versioned_steps_and_retention(tmp_path):
+    d = str(tmp_path)
+    m = _acc()
+    for expect in range(4):
+        handle = m.save_checkpoint(d, retain=2)
+        assert handle.step == expect
+        assert handle.result().endswith(f"step_{expect:010d}")
+    assert ckpt.all_steps(d) == [2, 3]  # retention pruned 0 and 1
+    assert ckpt.latest_step(d) == 3
+
+
+def test_explicit_step_collision_raises(tmp_path):
+    m = _acc()
+    m.save_checkpoint(str(tmp_path), step=5)
+    with pytest.raises(CheckpointError):
+        m.save_checkpoint(str(tmp_path), step=5)
+
+
+def test_restore_missing_raises_not_found(tmp_path):
+    with pytest.raises(CheckpointNotFoundError):
+        _acc().restore_checkpoint(str(tmp_path))
+    with pytest.raises(CheckpointNotFoundError):
+        _acc().restore_checkpoint(str(tmp_path), step=3)
+
+
+def test_async_save_roundtrip(tmp_path):
+    m = _acc()
+    want = float(m.compute())
+    handle = m.save_checkpoint(str(tmp_path), blocking=False)
+    # the snapshot captured immutable references: mutating the live metric
+    # after the call must not corrupt the in-flight write
+    m.update(jnp.asarray(_rng.randint(0, 5, 64)), jnp.asarray(_rng.randint(0, 5, 64)))
+    handle.result(timeout=60)
+    ckpt.wait_for_all_saves()
+    fresh = MulticlassAccuracy(num_classes=5, average="micro")
+    fresh.restore_checkpoint(str(tmp_path))
+    assert float(fresh.compute()) == want
+
+
+def test_async_auto_step_saves_never_collide(tmp_path):
+    """Back-to-back non-blocking saves with auto-stepping must each get a fresh
+    step even though none has committed yet — two writers assigned the same
+    step would race on one tmp dir (regression: ``latest + 1`` alone reused
+    in-flight steps when dispatch outpaced commit)."""
+    d = str(tmp_path)
+    m = _acc()
+    handles = [m.save_checkpoint(d, blocking=False) for _ in range(10)]
+    ckpt.wait_for_all_saves()
+    assert [h.step for h in handles] == list(range(10))
+    assert ckpt.all_steps(d) == list(range(10))
+    for step in range(10):  # every one must be complete and uncorrupted
+        fresh = MulticlassAccuracy(num_classes=5, average="micro")
+        assert fresh.restore_checkpoint(d, step=step) == step
+
+
+# --------------------------------------------------------------- atomicity
+
+
+def test_kill_before_commit_leaves_no_readable_checkpoint(tmp_path, monkeypatch):
+    """A death anywhere between save start and commit must leave nothing a
+    reader would accept: the step dir only becomes visible via the final
+    rename, which happens after the COMMIT record exists."""
+    from metrics_tpu.ckpt import manager
+
+    d = str(tmp_path)
+    m = _acc()
+
+    # kill point 1: before any bytes are written
+    monkeypatch.setattr(
+        manager._serializer, "write_payload",
+        lambda *a, **k: (_ for _ in ()).throw(KeyboardInterrupt("preempted")),
+    )
+    with pytest.raises(KeyboardInterrupt):
+        m.save_checkpoint(d)
+    monkeypatch.undo()
+
+    # kill point 2: payload + manifest written, rename never happens
+    monkeypatch.setattr(manager.os, "rename", lambda *a: (_ for _ in ()).throw(OSError("preempted")))
+    with pytest.raises(OSError):
+        m.save_checkpoint(d, step=9)
+    monkeypatch.undo()
+
+    assert ckpt.all_steps(d) == []
+    with pytest.raises(CheckpointNotFoundError):
+        _acc().restore_checkpoint(d)
+    # the explicitly-requested half-written step is typed as incomplete
+    with pytest.raises(IncompleteCheckpointError):
+        _acc().restore_checkpoint(d, step=9)
+
+    # a later, uninterrupted save of the same series works and restores
+    m.save_checkpoint(d, step=10)
+    fresh = MulticlassAccuracy(num_classes=5, average="micro")
+    assert fresh.restore_checkpoint(d) == 10
+    assert float(fresh.compute()) == float(m.compute())
+
+
+def test_committed_dir_without_commit_record_is_incomplete(tmp_path):
+    d = str(tmp_path)
+    m = _acc()
+    m.save_checkpoint(d, step=0)
+    os.remove(os.path.join(d, "step_0000000000", "COMMIT"))
+    assert ckpt.all_steps(d) == []
+    with pytest.raises(IncompleteCheckpointError):
+        _acc().restore_checkpoint(d, step=0)
+
+
+# ------------------------------------------------------------ typed errors
+
+
+def test_truncated_payload_raises_corrupt(tmp_path):
+    d = str(tmp_path)
+    _acc().save_checkpoint(d)
+    payload = os.path.join(d, "step_0000000000", "arrays-h0000.bin")
+    with open(payload, "r+b") as fh:
+        fh.truncate(os.path.getsize(payload) // 2)
+    with pytest.raises(CorruptCheckpointError, match="truncated"):
+        _acc().restore_checkpoint(d)
+
+
+def test_bitrot_payload_raises_corrupt(tmp_path):
+    d = str(tmp_path)
+    _acc().save_checkpoint(d)
+    payload = os.path.join(d, "step_0000000000", "arrays-h0000.bin")
+    with open(payload, "r+b") as fh:
+        fh.seek(0)
+        first = fh.read(1)
+        fh.seek(0)
+        fh.write(bytes([first[0] ^ 0xFF]))
+    with pytest.raises(CorruptCheckpointError, match="checksum"):
+        _acc().restore_checkpoint(d)
+
+
+def test_corrupt_manifest_raises_corrupt(tmp_path):
+    d = str(tmp_path)
+    _acc().save_checkpoint(d)
+    manifest = os.path.join(d, "step_0000000000", "manifest-h0000.json")
+    with open(manifest, "w") as fh:
+        fh.write('{"format": "metrics_tpu.ck')  # truncated JSON
+    with pytest.raises(CorruptCheckpointError, match="manifest"):
+        _acc().restore_checkpoint(d)
+
+
+class _Vec(Metric):
+    """Configurable state schema, for drift tests: shape/dtype/reduce knobs."""
+
+    full_state_update = True
+
+    def __init__(self, n=3, dtype=jnp.float32, reduce="sum", **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("v", jnp.zeros(n, dtype), dist_reduce_fx=reduce)
+
+    def update(self, x):
+        self.v = self.v + jnp.asarray(x, self.v.dtype)
+
+    def compute(self):
+        return self.v.sum()
+
+
+def test_schema_drift_typed_errors(tmp_path):
+    d = str(tmp_path)
+    m = _Vec(n=3)
+    m.update(jnp.ones(3))
+    m.save_checkpoint(d)
+
+    with pytest.raises(ShapeDriftError):
+        _Vec(n=4).restore_checkpoint(d)
+    with pytest.raises(DtypeDriftError):
+        _Vec(n=3, dtype=jnp.int32).restore_checkpoint(d)
+    with pytest.raises(SchemaDriftError):
+        _Vec(n=3, reduce="max").restore_checkpoint(d)
+    with pytest.raises(SchemaDriftError):
+        # different metric class entirely
+        MulticlassPrecision(num_classes=5, average="micro").restore_checkpoint(d)
+
+    # drift raises BEFORE any assignment: the live metric stays untouched
+    clean = _Vec(n=4)
+    clean.update(jnp.ones(4))
+    before = float(clean.compute())
+    with pytest.raises(ShapeDriftError):
+        clean.restore_checkpoint(d)
+    assert float(clean.compute()) == before
+
+
+def test_lazy_reshaped_state_is_not_drift(tmp_path):
+    """Metrics that reshape a placeholder state on first update (image metrics
+    with data-dependent map shapes) must restore into a FRESH instance: the
+    validation compares registered defaults, not live values."""
+    from metrics_tpu.image import RelativeAverageSpectralError
+
+    d = str(tmp_path)
+    img = jnp.asarray(_rng.rand(2, 3, 16, 16).astype(np.float32)) + 0.1
+    m = RelativeAverageSpectralError(window_size=4)
+    m.update(img, img + 0.01)
+    want = float(m.compute())
+    m.save_checkpoint(d)
+    fresh = RelativeAverageSpectralError(window_size=4)
+    fresh.restore_checkpoint(d)
+    assert float(fresh.compute()) == want
+
+
+# ------------------------------------------------------------- cat buffers
+
+
+def test_catbuffer_count_and_overflow_survive_roundtrip(tmp_path):
+    d = str(tmp_path)
+    m = _CatSum(cat_capacity=4)
+    m.update(jnp.arange(3.0))
+    m.update(jnp.arange(3.0))  # true count 6 > capacity 4: overflow
+    assert bool(m.vals.overflowed())
+    m.save_checkpoint(d)
+
+    same = _CatSum(cat_capacity=4)
+    same.restore_checkpoint(d)
+    # exact resume: the TRUE over-capacity count and flag survive bit-for-bit
+    assert int(same.vals.count) == 6
+    assert bool(same.vals.overflowed())
+    np.testing.assert_array_equal(np.asarray(same.vals.data), np.asarray(m.vals.data))
+
+    bigger = _CatSum(cat_capacity=16)
+    bigger.restore_checkpoint(d)
+    # re-packed: only the valid rows transfer, the sticky flag still survives
+    assert int(bigger.vals.count) == 4
+    assert bool(bigger.vals.overflowed())
+
+
+def test_catbuffer_capacity_too_small_raises(tmp_path):
+    d = str(tmp_path)
+    m = _CatSum(cat_capacity=8)
+    m.update(jnp.arange(6.0))
+    m.save_checkpoint(d)
+    with pytest.raises(CapacityError):
+        _CatSum(cat_capacity=2).restore_checkpoint(d)
+
+
+def test_list_cat_state_roundtrip_ragged(tmp_path):
+    d = str(tmp_path)
+    m = _CatSum()  # no cat_capacity: plain list state, ragged items
+    m.update(jnp.arange(3.0))
+    m.update(jnp.arange(5.0))
+    want = float(m.compute())
+    m.save_checkpoint(d)
+    fresh = _CatSum()
+    fresh.restore_checkpoint(d)
+    assert [tuple(v.shape) for v in fresh.vals] == [(3,), (5,)]
+    assert float(fresh.compute()) == want
+
+
+# ------------------------------------------------------- collections/groups
+
+
+def _make_collection():
+    return metrics_tpu.MetricCollection(
+        [
+            MulticlassAccuracy(num_classes=5),
+            MulticlassPrecision(num_classes=5),
+            MulticlassRecall(num_classes=5),
+        ]
+    )
+
+
+def test_collection_roundtrip_and_group_realiasing(tmp_path):
+    d = str(tmp_path)
+    mc = _make_collection()
+    assert any(len(g) > 1 for g in mc.compute_groups.values())  # premise: grouped
+    mc.update(jnp.asarray(_rng.randint(0, 5, 64)), jnp.asarray(_rng.randint(0, 5, 64)))
+    want = {k: float(v) for k, v in mc.compute().items()}
+    mc.save_checkpoint(d)
+
+    # the payload contains ONE copy of the shared group state (leader only)
+    manifest = json.load(open(os.path.join(d, "step_0000000000", "manifest-h0000.json")))
+    prefixes = {k.split("/")[0] for k in manifest["payload"]["index"]}
+    leaders = {g[0] for g in manifest["tree"]["groups"]}
+    assert prefixes == leaders
+
+    mc2 = _make_collection()
+    mc2.restore_checkpoint(d)
+    assert {k: float(v) for k, v in mc2.compute().items()} == want
+    for group in mc2.compute_groups.values():
+        leader = mc2._modules[group[0]]
+        for name in group[1:]:
+            member = mc2._modules[name]
+            assert all(getattr(member, s) is getattr(leader, s) for s in leader._defaults)
+            assert member._update_count == leader._update_count
+
+    # accumulation continues correctly after restore (aliasing is live)
+    extra_p, extra_t = _rng.randint(0, 5, 32), _rng.randint(0, 5, 32)
+    mc.update(jnp.asarray(extra_p), jnp.asarray(extra_t))
+    mc2.update(jnp.asarray(extra_p), jnp.asarray(extra_t))
+    got = {k: float(v) for k, v in mc2.compute().items()}
+    assert got == {k: float(v) for k, v in mc.compute().items()}
+
+
+def test_collection_name_drift_raises(tmp_path):
+    d = str(tmp_path)
+    mc = _make_collection()
+    mc.update(jnp.asarray(_rng.randint(0, 5, 16)), jnp.asarray(_rng.randint(0, 5, 16)))
+    mc.save_checkpoint(d)
+    other = metrics_tpu.MetricCollection([MulticlassAccuracy(num_classes=5)])
+    with pytest.raises(SchemaDriftError, match="names"):
+        other.restore_checkpoint(d)
+
+
+# ------------------------------------------------------- wrappers / nesting
+
+
+def test_nested_wrapper_children_roundtrip(tmp_path):
+    from metrics_tpu.wrappers import MinMaxMetric
+
+    d = str(tmp_path)
+    m = MinMaxMetric(MulticlassAccuracy(num_classes=5, average="micro"))
+    for _ in range(3):
+        m.update(jnp.asarray(_rng.randint(0, 5, 32)), jnp.asarray(_rng.randint(0, 5, 32)))
+    want = {k: float(v) for k, v in m.compute().items()}
+    m.save_checkpoint(d)
+    fresh = MinMaxMetric(MulticlassAccuracy(num_classes=5, average="micro"))
+    fresh.restore_checkpoint(d)
+    # the child metric's states rode along under the `_base_metric/` prefix
+    assert {k: float(v) for k, v in fresh.compute().items()} == want
+    assert fresh._base_metric._update_count == m._base_metric._update_count
+
+
+# ------------------------------------------------- multi-host coordination
+
+
+def test_multihost_commit_requires_all_manifests(tmp_path):
+    d = str(tmp_path)
+    m0, m1 = _acc(), _acc()
+    # host 1 saves first: no commit yet (host 0's manifest missing)
+    m1.save_checkpoint(d, step=3, process_index=1, process_count=2)
+    assert ckpt.all_steps(d) == []
+    with pytest.raises(CheckpointNotFoundError):
+        _acc().restore_checkpoint(d)
+    # host 0 arrives: its commit check sees both manifests and commits
+    m0.save_checkpoint(d, step=3, process_index=0, process_count=2)
+    assert ckpt.all_steps(d) == [3]
+    step_dir = os.path.join(d, "step_0000000003")
+    assert json.load(open(os.path.join(step_dir, "COMMIT")))["world"] == 2
+
+
+def test_multihost_replicated_rank0_writes_arrays_once(tmp_path):
+    d = str(tmp_path)
+    m0, m1 = _acc(), _acc()
+    m1.save_checkpoint(d, step=0, process_index=1, process_count=2)
+    m0.save_checkpoint(d, step=0, process_index=0, process_count=2)
+    step_dir = os.path.join(d, "step_0000000000")
+    m_h0 = json.load(open(os.path.join(step_dir, "manifest-h0000.json")))
+    m_h1 = json.load(open(os.path.join(step_dir, "manifest-h0001.json")))
+    # replicated array states appear only in host 0's payload
+    assert "tp" in m_h0["payload"]["index"]
+    assert "tp" not in m_h1["payload"]["index"]
+
+
+# --------------------------------------------------------- topology change
+
+
+def test_topology_change_sum_states_rereduce(tmp_path):
+    d = str(tmp_path)
+    data = [(_rng.randint(0, 5, 40), _rng.randint(0, 5, 40)) for _ in range(2)]
+    for rank, (p, t) in enumerate(data):
+        m = MulticlassAccuracy(num_classes=5, average="micro")
+        m.update(jnp.asarray(p), jnp.asarray(t))
+        m.save_checkpoint(d, step=0, process_index=rank, process_count=2, replicated=False)
+
+    oracle = MulticlassAccuracy(num_classes=5, average="micro")
+    for p, t in data:
+        oracle.update(jnp.asarray(p), jnp.asarray(t))
+
+    # 2 hosts -> 1 host: the single host owns the re-reduced total
+    single = MulticlassAccuracy(num_classes=5, average="micro")
+    single.restore_checkpoint(d, process_index=0, process_count=1)
+    assert float(single.compute()) == float(oracle.compute())
+
+    # 2 hosts -> 3 hosts: rank 0 owns the total, others hold reset defaults,
+    # so a cross-host sum still yields the global state
+    shards = []
+    for rank in range(3):
+        h = MulticlassAccuracy(num_classes=5, average="micro")
+        h.restore_checkpoint(d, process_index=rank, process_count=3)
+        shards.append(np.asarray(h.tp))
+    np.testing.assert_array_equal(sum(shards), np.asarray(oracle.tp))
+
+
+def test_topology_change_cat_rows_repack(tmp_path):
+    d = str(tmp_path)
+    chunks = [np.arange(5.0), np.arange(5.0, 8.0)]
+    for rank, chunk in enumerate(chunks):
+        m = _CatSum(cat_capacity=8)
+        m.update(jnp.asarray(chunk))
+        m.save_checkpoint(d, step=0, process_index=rank, process_count=2, replicated=False)
+
+    # 2 hosts -> 3 hosts: every row lands on exactly one host, in order
+    rows = []
+    for rank in range(3):
+        h = _CatSum(cat_capacity=8)
+        h.restore_checkpoint(d, process_index=rank, process_count=3)
+        rows.extend(np.asarray(h.vals.values()).tolist())
+    assert rows == np.concatenate(chunks).tolist()
+
+
+def test_topology_change_same_world_exact(tmp_path):
+    d = str(tmp_path)
+    states = []
+    for rank in range(2):
+        m = _CatSum(cat_capacity=8)
+        m.update(jnp.arange(float(rank + 2)))
+        states.append(np.asarray(m.vals.values()))
+        m.save_checkpoint(d, step=0, process_index=rank, process_count=2, replicated=False)
+    for rank in range(2):
+        h = _CatSum(cat_capacity=8)
+        h.restore_checkpoint(d, process_index=rank, process_count=2)
+        np.testing.assert_array_equal(np.asarray(h.vals.values()), states[rank])
+
+
+def test_topology_change_unreduced_state_raises(tmp_path):
+    d = str(tmp_path)
+    for rank in range(2):
+        m = _Unreduced()
+        m.update(jnp.ones(3) * (rank + 1))
+        m.save_checkpoint(d, step=0, process_index=rank, process_count=2, replicated=False)
+    # same world: exact per-rank restore is fine
+    ok = _Unreduced()
+    ok.restore_checkpoint(d, process_index=1, process_count=2)
+    np.testing.assert_array_equal(np.asarray(ok.raw), 2 * np.ones(3))
+    # changed world: no way to re-reduce a None-reduction state
+    with pytest.raises(TopologyError):
+        _Unreduced().restore_checkpoint(d, process_index=0, process_count=1)
+
+
+# ------------------------------------------------------------- persistence
+
+
+def test_persistent_only_saves_subset(tmp_path):
+    d = str(tmp_path)
+    m = _CatSum(cat_capacity=8)
+    m.persistent(True)
+    m._persistent["vals"] = False  # only `total` is persistent
+    m.update(jnp.arange(4.0))
+    m.save_checkpoint(d, persistent_only=True)
+
+    manifest = json.load(open(os.path.join(d, "step_0000000000", "manifest-h0000.json")))
+    assert set(manifest["tree"]["schema"]["states"]) == {"total"}
+
+    fresh = _CatSum(cat_capacity=8)
+    fresh.restore_checkpoint(d)
+    assert float(fresh.total) == 6.0
+    assert int(fresh.vals.count) == 0  # non-persistent state kept its default
+
+
+# -------------------------------------------------------------------- obs
+
+
+def test_obs_counters_and_jsonl_export(tmp_path):
+    d = str(tmp_path)
+    m = _acc()
+    with obs.observe(clear=True):
+        m.save_checkpoint(d)
+        fresh = MulticlassAccuracy(num_classes=5, average="micro")
+        fresh.restore_checkpoint(d)
+        snap = obs.snapshot()
+        assert snap["ckpt"]["saves"] == 1
+        assert snap["ckpt"]["restores"] == 1
+        assert snap["ckpt"]["bytes"] > 0
+        assert snap["ckpt"]["save_ms"] > 0
+        assert snap["ckpt"]["restore_ms"] > 0
+        # the JSONL export carries the same counters
+        record = obs.dump_jsonl(str(tmp_path / "obs.jsonl"))
+        assert record["registry"]["ckpt"]["saves"] == 1
+    line = json.loads(open(tmp_path / "obs.jsonl").read().splitlines()[-1])
+    assert line["registry"]["ckpt"]["restores"] == 1
+
+
+def test_obs_disabled_writes_nothing(tmp_path):
+    obs.disable()
+    obs.REGISTRY.clear()
+    m = _acc()
+    m.save_checkpoint(str(tmp_path))
+    fresh = MulticlassAccuracy(num_classes=5, average="micro")
+    fresh.restore_checkpoint(str(tmp_path))
+    assert obs.snapshot() == {}
+
+
+def test_state_report_carries_ckpt_latency(tmp_path):
+    m = _acc()
+    m.save_checkpoint(str(tmp_path))
+    report = m.state_report()
+    assert report["ckpt"]["last_save_step"] == 0
+    assert report["ckpt"]["last_save_ms"] > 0
+    assert report["ckpt"]["last_save_bytes"] > 0
+    fresh = MulticlassAccuracy(num_classes=5, average="micro")
+    fresh.restore_checkpoint(str(tmp_path))
+    assert fresh.state_report()["ckpt"]["last_restore_step"] == 0

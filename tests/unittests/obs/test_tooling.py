@@ -28,6 +28,7 @@ def test_bench_entry_smoke():
     assert result.returncode == 0, result.stderr
     assert "--config" in result.stdout
     assert "--obs" in result.stdout
+    assert "--ckpt" in result.stdout
 
 
 def test_no_block_until_ready_outside_obs():
