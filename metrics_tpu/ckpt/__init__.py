@@ -26,8 +26,10 @@ Properties:
   :class:`CorruptCheckpointError`...) before touching any state.
 - **Mesh/topology aware**: host 0 writes replicated states once, every host
   writes its own cat-state shards, commit is a barrier-free "all manifests
-  present" check; states saved on N hosts restore onto M hosts by
-  re-reducing sum/max/min states and re-packing cat buffers.
+  of this save generation present" check (manifests a preempted incarnation
+  left behind never mix into a fresh commit); states saved on N hosts
+  restore onto M hosts by re-reducing sum/max/min states and re-packing cat
+  buffers.
 - **Group aware**: ``MetricCollection`` checkpoints save each compute group's
   state once (the leader's) and restore re-establishes member aliasing.
 

@@ -13,15 +13,25 @@ On-disk layout (one directory per checkpoint *series*)::
 Atomicity: payloads are written and fsynced before their manifest, manifests
 before the ``COMMIT`` record, and the whole step directory stays under a
 ``.tmp-`` name until the commit record exists — then one ``os.rename`` makes it
-visible. A kill at ANY point leaves either a committed step or an ignorable
+visible (followed by a directory fsync so the rename itself survives power
+loss). A kill at ANY point leaves either a committed step or an ignorable
 tmp dir; readers never observe a partial checkpoint.
 
 Multi-host protocol (barrier-free, shared filesystem): every host writes its
 own payload + manifest into the same tmp dir, then runs the commit check —
-"are all ``world`` manifests present?". Whichever host observes completeness
-last writes ``COMMIT`` and renames; rename races are benign (first rename
-wins, the loser verifies the committed dir exists). No collective, no barrier:
-a straggler host simply finds the work already done.
+"are all ``world`` manifests present, stamped with THIS save generation?".
+Whichever host observes completeness last writes ``COMMIT`` and renames;
+rename races are benign (first rename wins, the loser verifies the committed
+dir exists). No collective, no barrier: a straggler host simply finds the
+work already done.
+
+The generation stamp closes the preemption hole step reuse would otherwise
+open: a save killed after some hosts wrote their manifests leaves those
+manifests in the tmp dir, and the restarted job — which auto-assigns
+``latest committed + 1`` again — must not count them toward its own commit,
+or the committed step would silently mix shards from two save generations.
+See :func:`_save_generation` for how hosts of one incarnation agree on the
+nonce without a barrier.
 
 Async: ``blocking=False`` snapshots array *references* (jax arrays are
 immutable) and runs transfer+write+commit on a daemon thread; the returned
@@ -34,6 +44,7 @@ import re
 import shutil
 import threading
 import time
+import warnings
 from contextlib import nullcontext
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -93,6 +104,25 @@ def latest_step(directory: str) -> Optional[int]:
     return steps[-1] if steps else None
 
 
+def _fsync_dir(path: str) -> None:
+    """fsync a directory so a just-renamed entry in it survives power loss.
+
+    Without this the rename that publishes a manifest or a committed step is
+    only durable once the filesystem happens to flush its metadata. Best
+    effort: not every OS/filesystem supports opening or fsyncing directories.
+    """
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
 def _atomic_write_json(path: str, payload: Dict[str, Any]) -> None:
     tmp = path + ".part"
     with open(tmp, "w") as fh:
@@ -100,6 +130,7 @@ def _atomic_write_json(path: str, payload: Dict[str, Any]) -> None:
         fh.flush()
         os.fsync(fh.fileno())
     os.replace(tmp, path)
+    _fsync_dir(os.path.dirname(path))
 
 
 def _read_json(path: str, what: str) -> Dict[str, Any]:
@@ -124,21 +155,41 @@ class CheckpointWrite:
         self._done = threading.Event()
         self._error: Optional[BaseException] = None
         self._path: Optional[str] = None
+        self._committed = False
 
     def done(self) -> bool:
         return self._done.is_set()
 
+    @property
+    def committed(self) -> bool:
+        """True once the step's ``COMMIT`` record exists on disk.
+
+        A barrier-free multi-host save can finish this host's write while the
+        commit is still pending peer manifests; the property re-checks the
+        filesystem, so a peer committing later is observed on the same handle.
+        """
+        if not self._committed and self._path is not None and _is_committed(self._path):
+            self._committed = True
+        return self._committed
+
     def result(self, timeout: Optional[float] = None) -> str:
-        """Block until the write committed; returns the committed step dir.
-        Re-raises any writer-thread exception."""
+        """Block until this host's write finished; returns the step directory
+        the save commits into. Re-raises any writer-thread exception.
+
+        On a multi-host save the commit may still be pending peer manifests
+        when this host's write completes (the returned directory then does not
+        exist yet) — check :attr:`committed` to distinguish.
+        """
         if not self._done.wait(timeout):
             raise TimeoutError(f"checkpoint write for step {self.step} still in flight")
         if self._error is not None:
             raise self._error
         return self._path  # type: ignore[return-value]
 
-    def _finish(self, path: Optional[str], error: Optional[BaseException]) -> None:
-        self._path, self._error = path, error
+    def _finish(
+        self, path: Optional[str], error: Optional[BaseException], committed: bool = False
+    ) -> None:
+        self._path, self._error, self._committed = path, error, committed
         self._done.set()
 
 
@@ -150,15 +201,80 @@ _INFLIGHT_LOCK = threading.Lock()
 _LAST_ASSIGNED: Dict[str, int] = {}
 
 
-def wait_for_all_saves() -> None:
-    """Drain every in-flight async save (re-raising the first failure)."""
+def wait_for_all_saves(require_committed: bool = False) -> None:
+    """Drain every in-flight async save (re-raising the first failure).
+
+    A drained save can still be commit-pending on a multi-host run: this
+    host's shard is written but a peer's manifest has not arrived (e.g. the
+    peer was preempted mid-save). By default that is surfaced as a
+    ``RuntimeWarning`` — the peer can still commit without us once it catches
+    up; with ``require_committed=True`` it raises
+    :class:`IncompleteCheckpointError` instead, for callers that must know
+    the checkpoint is readable before moving on.
+    """
     with _INFLIGHT_LOCK:
         pending = list(_INFLIGHT)
     for handle in pending:
         handle.result()
+    uncommitted = sorted(h.step for h in pending if not h.committed)
+    if uncommitted:
+        msg = (
+            f"checkpoint step(s) {uncommitted} are fully written by this host but"
+            " not committed: not every peer host's manifest has arrived"
+        )
+        if require_committed:
+            raise IncompleteCheckpointError(msg)
+        warnings.warn(msg, RuntimeWarning, stacklevel=2)
 
 
 # -------------------------------------------------------------------- save
+
+# save-generation nonces, one per process (see _save_generation)
+_GENERATION_LOCK = threading.Lock()
+_GENERATION: Dict[str, str] = {}
+
+
+def _save_generation(world: int) -> str:
+    """Generation nonce stamped into every manifest of a save invocation.
+
+    :func:`_try_commit` only counts manifests carrying the committing host's
+    own generation, so manifests a preempted incarnation left in a tmp dir can
+    never be mixed into a fresh save of the same step. Hosts of ONE
+    incarnation must therefore agree on the nonce:
+
+    - ``world == 1``: a random per-process nonce — trivially agreed.
+    - real multi-host (``jax.process_count() == world``): host 0's random
+      nonce, shared once per process via ``broadcast_one_to_all`` (one
+      collective per process lifetime, not per save — the commit protocol
+      itself stays barrier-free).
+    - overridden topology (``jax.process_count() != world``: single-process
+      simulation in tests, or external launchers running one jax process per
+      host): separate processes cannot agree on a nonce without
+      communication, so the stamp degrades to a constant and commit falls
+      back to the plain all-manifests-present rule. External launchers that
+      need staleness protection should pass ``generation=`` explicitly (any
+      string shared by the incarnation, e.g. the launcher's attempt id).
+    """
+    import jax
+
+    if world == 1:
+        key = "local"
+    elif jax.process_count() == world:
+        key = "shared"
+    else:
+        return "-"
+    with _GENERATION_LOCK:
+        nonce = _GENERATION.get(key)
+        if nonce is None:
+            raw = int.from_bytes(os.urandom(8), "big") >> 1  # fits a non-negative int64
+            if key == "shared":
+                import numpy as np
+                from jax.experimental import multihost_utils
+
+                raw = int(multihost_utils.broadcast_one_to_all(np.asarray(raw, np.int64)))
+            nonce = f"{raw:016x}"
+            _GENERATION[key] = nonce
+    return nonce
 
 
 def _snapshot(obj: Any, persistent_only: bool) -> Tuple[Dict[str, Any], List[Tuple[str, Any, bool]]]:
@@ -199,34 +315,79 @@ def _prune(directory: str, retain: int) -> None:
         shutil.rmtree(os.path.join(directory, _step_name(step)), ignore_errors=True)
 
 
-def _try_commit(directory: str, tmp_dir: str, step: int, world: int) -> bool:
-    """Barrier-free commit: if all ``world`` manifests are present, write the
-    COMMIT record and rename the tmp dir into place. Returns True when the
-    step is committed (by us or a racing host) on return."""
+def _sweep_stale_shards(tmp_dir: str, world: int) -> None:
+    """Best-effort removal of shard files a preempted bigger-world incarnation
+    left in the tmp dir (hosts ``>= world``, including orphaned ``.part``
+    temporaries) so they do not ride into the committed step dir. Shards for
+    hosts ``< world`` were all freshly (over)written by this generation —
+    _try_commit verified their manifests before calling here."""
+    try:
+        entries = os.listdir(tmp_dir)
+    except OSError:
+        return
+    for entry in entries:
+        m = re.match(r"^(?:manifest|arrays)-h(\d{4})\.", entry)
+        if m and int(m.group(1)) >= world:
+            try:
+                os.remove(os.path.join(tmp_dir, entry))
+            except OSError:
+                pass
+
+
+def _try_commit(directory: str, tmp_dir: str, step: int, world: int, generation: str) -> bool:
+    """Barrier-free commit: if all ``world`` manifests of THIS save generation
+    are present, write the COMMIT record and rename the tmp dir into place.
+    Returns True when the step is committed (by us or a racing host) on
+    return; False while peer manifests are still missing — or stale.
+
+    A manifest left behind by a preempted incarnation carries a different
+    ``generation`` stamp and counts as absent, so a fresh save reusing the
+    same step number can never commit a mix of shards from two generations.
+    """
     final_dir = os.path.join(directory, _step_name(step))
     if _is_committed(final_dir):
         return True
     if not os.path.isdir(tmp_dir):
         return _is_committed(final_dir)
-    present = [h for h in range(world) if os.path.isfile(os.path.join(tmp_dir, _manifest_name(h)))]
-    if len(present) < world:
-        return False
-    _atomic_write_json(
-        os.path.join(tmp_dir, "COMMIT"),
-        {
-            "format": _manifest.FORMAT,
-            "version": _manifest.FORMAT_VERSION,
-            "step": step,
-            "world": world,
-            "time_unix": time.time(),
-        },
-    )
+    for host in range(world):
+        try:
+            peer = _read_json(os.path.join(tmp_dir, _manifest_name(host)), "manifest")
+        except FileNotFoundError:
+            # not written yet — or the whole tmp dir just vanished under a
+            # racing host's rename; _is_committed distinguishes the two
+            return _is_committed(final_dir)
+        except CorruptCheckpointError:
+            return False  # torn write from a dead incarnation: not committable
+        # missing stamp = manifest from a pre-generation writer: let it count
+        if peer.get("generation", generation) != generation:
+            return False
+    _sweep_stale_shards(tmp_dir, world)
+    try:
+        _atomic_write_json(
+            os.path.join(tmp_dir, "COMMIT"),
+            {
+                "format": _manifest.FORMAT,
+                "version": _manifest.FORMAT_VERSION,
+                "step": step,
+                "world": world,
+                "generation": generation,
+                "time_unix": time.time(),
+            },
+        )
+    except FileNotFoundError:
+        # tmp dir vanished between the completeness check and the COMMIT
+        # write: a racing host committed first, which is success
+        if _is_committed(final_dir):
+            return True
+        raise
     try:
         os.rename(tmp_dir, final_dir)
     except OSError:
         # a racing host renamed first; losing the race is success
         if not _is_committed(final_dir):
             raise
+        return True
+    _fsync_dir(directory)  # make the publishing rename itself durable
     return True
 
 
@@ -253,6 +414,7 @@ def save_checkpoint(
     persistent_only: bool = False,
     process_index: Optional[int] = None,
     process_count: Optional[int] = None,
+    generation: Optional[str] = None,
 ) -> CheckpointWrite:
     """Save a :class:`Metric` or :class:`MetricCollection` state checkpoint.
 
@@ -276,13 +438,22 @@ def save_checkpoint(
         process_index / process_count: override the host topology (defaults
             to the jax runtime's; explicit values support external launchers
             and testing).
+        generation: save-generation stamp shared by all hosts of this
+            invocation; manifests from other generations (a preempted save of
+            the same step) never count toward the commit. Defaults to
+            :func:`_save_generation`'s per-incarnation nonce — pass an
+            explicit value (e.g. a launcher attempt id) when overriding the
+            topology across separate processes.
 
     Returns:
-        A :class:`CheckpointWrite` handle (already finished when blocking).
+        A :class:`CheckpointWrite` handle (already finished when blocking;
+        its ``committed`` flag reports whether the step is readable yet).
     """
     from metrics_tpu.parallel.collective import process_topology
 
     rank, world = process_topology(process_index, process_count)
+    if generation is None:
+        generation = _save_generation(world)
     os.makedirs(directory, exist_ok=True)
     dir_key = os.path.abspath(directory)
     with _INFLIGHT_LOCK:
@@ -304,26 +475,35 @@ def save_checkpoint(
         try:
             with _scope("tm.ckpt/save"):
                 tmp_dir = os.path.join(directory, _TMP_PREFIX + _step_name(step))
-                os.makedirs(tmp_dir, exist_ok=True)
-                mine = entries if (rank == 0 or not replicated) else [e for e in entries if e[2]]
-                payload_meta = _serializer.write_payload(
-                    os.path.join(tmp_dir, _payload_name(rank)), mine
-                )
-                _atomic_write_json(
-                    os.path.join(tmp_dir, _manifest_name(rank)),
-                    {
-                        "format": _manifest.FORMAT,
-                        "version": _manifest.FORMAT_VERSION,
-                        "step": step,
-                        "host": rank,
-                        "world": world,
-                        "replicated": replicated,
-                        "persistent_only": persistent_only,
-                        "tree": tree,
-                        "payload": payload_meta,
-                    },
-                )
-                committed = _try_commit(directory, tmp_dir, step, world)
+                try:
+                    os.makedirs(tmp_dir, exist_ok=True)
+                    mine = entries if (rank == 0 or not replicated) else [e for e in entries if e[2]]
+                    payload_meta = _serializer.write_payload(
+                        os.path.join(tmp_dir, _payload_name(rank)), mine
+                    )
+                    _atomic_write_json(
+                        os.path.join(tmp_dir, _manifest_name(rank)),
+                        {
+                            "format": _manifest.FORMAT,
+                            "version": _manifest.FORMAT_VERSION,
+                            "step": step,
+                            "host": rank,
+                            "world": world,
+                            "generation": generation,
+                            "replicated": replicated,
+                            "persistent_only": persistent_only,
+                            "tree": tree,
+                            "payload": payload_meta,
+                        },
+                    )
+                except FileNotFoundError:
+                    # the tmp dir vanished mid-write: a racing host observed
+                    # completeness and renamed it into place — if the step is
+                    # committed the save's goal is met, anything else is real
+                    if not _is_committed(final_dir):
+                        raise
+                    payload_meta = {"nbytes": 0}
+                committed = _try_commit(directory, tmp_dir, step, world, generation)
                 if committed and retain is not None:
                     _prune(directory, retain)
             elapsed_ms = (time.perf_counter() - t0) * 1000
@@ -333,7 +513,7 @@ def save_checkpoint(
                 _obs.REGISTRY.inc("ckpt", "save_ms", elapsed_ms)
             _stamp(obj, last_save_ms=round(elapsed_ms, 3), last_save_step=step,
                    last_save_bytes=payload_meta["nbytes"])
-            handle._finish(final_dir, None)
+            handle._finish(final_dir, None, committed=committed)
         except BaseException as err:  # noqa: BLE001 — surfaced via handle.result()
             handle._finish(None, err)
         finally:
@@ -455,6 +635,30 @@ def restore_checkpoint(
     return step
 
 
+def _member_update_counts(
+    tree: Dict[str, Any], manifests: List[Dict[str, Any]], *, topo_changed: bool
+) -> Dict[str, int]:
+    """Per-member update counts to restore into a collection.
+
+    Exact topology: the restoring host's own saved counts (``tree`` is its own
+    manifest's). Host-count change: the max of each member's count across the
+    saved hosts — per-host counts differ under non-replicated accumulation,
+    and this mirrors :func:`metrics_tpu.ckpt.restore.merged_update_count`'s
+    conservative-max policy for single metrics.
+    """
+    counts = {name: int(c) for name, c in (tree.get("update_counts") or {}).items()}
+    if not topo_changed:
+        return counts
+    for man in manifests:
+        host_tree = man["tree"]
+        host_counts = host_tree.get("update_counts") or {}
+        for name, schema in host_tree.get("metrics", {}).items():
+            c = int(host_counts.get(name, schema["update_count"]))
+            if c > counts.get(name, -1):
+                counts[name] = c
+    return counts
+
+
 def _restore_collection(
     collection: Any,
     tree: Dict[str, Any],
@@ -485,7 +689,7 @@ def _restore_collection(
     for name in tree["metrics"]:
         live = _manifest.metric_schema(collection._modules[name])
         _manifest.validate_schema(live, tree["metrics"][name], path=name, allow_subset=persistent_only)
-    update_counts = tree.get("update_counts", {})
+    update_counts = _member_update_counts(tree, manifests, topo_changed=world != saved_world)
     for group in tree["groups"]:
         leader_name = group[0]
         leader_schema = tree["metrics"][leader_name]

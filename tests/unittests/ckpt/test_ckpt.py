@@ -417,6 +417,101 @@ def test_multihost_commit_requires_all_manifests(tmp_path):
     assert json.load(open(os.path.join(step_dir, "COMMIT")))["world"] == 2
 
 
+def test_stale_manifest_from_dead_incarnation_never_commits(tmp_path):
+    """Preemption mid-save leaves some hosts' manifests in the tmp dir; the
+    restarted job reuses the step number, and those stale manifests must NOT
+    count toward the new generation's commit — a commit mixing shards from
+    two save generations would read as a valid checkpoint with wrong state."""
+    d = str(tmp_path)
+    # incarnation 1: host 1 wrote its manifest, host 0 was preempted before
+    # writing — step 0 never commits
+    _acc().save_checkpoint(d, step=0, process_index=1, process_count=2, generation="gen-dead")
+    assert ckpt.all_steps(d) == []
+    # incarnation 2 reuses step 0: host 0 writes and runs the commit check;
+    # the stale manifest-h0001 is present but from the dead generation
+    h0 = _acc().save_checkpoint(d, step=0, process_index=0, process_count=2, generation="gen-live")
+    assert ckpt.all_steps(d) == []
+    assert not h0.committed
+    # host 1 of the live generation overwrites its stale shard: now commit
+    h1 = _acc().save_checkpoint(d, step=0, process_index=1, process_count=2, generation="gen-live")
+    assert ckpt.all_steps(d) == [0]
+    assert h1.committed
+    assert h0.committed  # the earlier handle observes the later commit live
+    step_dir = os.path.join(d, "step_0000000000")
+    for host in range(2):
+        man = json.load(open(os.path.join(step_dir, f"manifest-h{host:04d}.json")))
+        assert man["generation"] == "gen-live"
+    assert json.load(open(os.path.join(step_dir, "COMMIT")))["generation"] == "gen-live"
+
+
+def test_commit_sweeps_stale_bigger_world_shards(tmp_path):
+    """A preempted 2-host incarnation leaves host-1 shards in the tmp dir; the
+    restarted job runs on 1 host and reuses the step. Its commit must both
+    ignore the stale shards and remove them, so the committed dir holds one
+    generation only."""
+    d = str(tmp_path)
+    _acc().save_checkpoint(d, step=0, process_index=1, process_count=2, generation="gen-dead")
+    m = _acc()
+    want = float(m.compute())
+    m.save_checkpoint(d, step=0)  # world 1: its own fresh manifest suffices
+    assert ckpt.all_steps(d) == [0]
+    step_dir = os.path.join(d, "step_0000000000")
+    assert not os.path.exists(os.path.join(step_dir, "manifest-h0001.json"))
+    assert not os.path.exists(os.path.join(step_dir, "arrays-h0001.bin"))
+    fresh = MulticlassAccuracy(num_classes=5, average="micro")
+    fresh.restore_checkpoint(d)
+    assert float(fresh.compute()) == want
+
+
+def test_wait_for_all_saves_surfaces_uncommitted_steps(tmp_path):
+    """A drained multi-host save whose peers never arrived must not read as
+    plain success: warn by default, raise with require_committed=True."""
+    from metrics_tpu.ckpt import manager
+
+    d = str(tmp_path)
+    h = _acc().save_checkpoint(d, step=0, process_index=1, process_count=2)
+    assert h.done() and not h.committed  # write finished, commit pending peers
+    with manager._INFLIGHT_LOCK:
+        manager._INFLIGHT.append(h)  # as if the async writer had not drained yet
+    try:
+        with pytest.warns(RuntimeWarning, match="not committed"):
+            ckpt.wait_for_all_saves()
+        with pytest.raises(IncompleteCheckpointError, match="not committed"):
+            ckpt.wait_for_all_saves(require_committed=True)
+    finally:
+        with manager._INFLIGHT_LOCK:
+            manager._INFLIGHT.remove(h)
+    # the peer arrives later: the commit is observed, nothing pending anymore
+    _acc().save_checkpoint(d, step=0, process_index=0, process_count=2)
+    assert h.committed
+    ckpt.wait_for_all_saves()
+
+
+def test_commit_write_losing_rename_race_is_success(tmp_path, monkeypatch):
+    """Between a host's completeness check and its COMMIT write, a racing host
+    can rename the tmp dir away; the resulting FileNotFoundError must read as
+    success (the step IS committed), not as a failed save."""
+    from metrics_tpu.ckpt import manager
+
+    d = str(tmp_path)
+    _acc().save_checkpoint(d, step=0, process_index=1, process_count=2)
+    real = manager._atomic_write_json
+    tmp_dir = os.path.join(d, ".tmp-step_0000000000")
+    final_dir = os.path.join(d, "step_0000000000")
+
+    def racing(path, payload):
+        if os.path.basename(path) == "COMMIT" and os.path.isdir(tmp_dir):
+            real(path, payload)  # the racing peer completes the commit...
+            os.rename(tmp_dir, final_dir)  # ...and wins the rename,
+            raise FileNotFoundError(path + ".part")  # so our write finds no dir
+        return real(path, payload)
+
+    monkeypatch.setattr(manager, "_atomic_write_json", racing)
+    h = _acc().save_checkpoint(d, step=0, process_index=0, process_count=2)
+    assert h.committed
+    assert ckpt.all_steps(d) == [0]
+
+
 def test_multihost_replicated_rank0_writes_arrays_once(tmp_path):
     d = str(tmp_path)
     m0, m1 = _acc(), _acc()
@@ -489,6 +584,27 @@ def test_topology_change_same_world_exact(tmp_path):
         h = _CatSum(cat_capacity=8)
         h.restore_checkpoint(d, process_index=rank, process_count=2)
         np.testing.assert_array_equal(np.asarray(h.vals.values()), states[rank])
+
+
+def test_topology_change_collection_member_counts_take_max(tmp_path):
+    """Per-member update counts restored across a host-count change follow the
+    conservative-max policy (counts differ per host under non-replicated
+    accumulation), mirroring the single-metric merged_update_count path —
+    not host 0's counts verbatim."""
+    d = str(tmp_path)
+    for rank, n_updates in enumerate((1, 3)):
+        mc = metrics_tpu.MetricCollection(
+            [MulticlassAccuracy(num_classes=5, average="micro")]
+        )
+        for _ in range(n_updates):
+            mc.update(jnp.asarray(_rng.randint(0, 5, 8)), jnp.asarray(_rng.randint(0, 5, 8)))
+        mc.save_checkpoint(d, step=0, process_index=rank, process_count=2, replicated=False)
+    single = metrics_tpu.MetricCollection(
+        [MulticlassAccuracy(num_classes=5, average="micro")]
+    )
+    single.restore_checkpoint(d, process_index=0, process_count=1)
+    [member] = list(single._modules.values())
+    assert member._update_count == 3  # max across hosts, not host 0's count of 1
 
 
 def test_topology_change_unreduced_state_raises(tmp_path):
