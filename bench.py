@@ -623,6 +623,15 @@ def bench_auroc(n: int = 1 << 24, queue_depth: int = 4) -> dict:
     """Exact-mode (thresholds=None) binary AUROC: device sort+cumsum kernel vs the
     reference's host path (torch CPU sort+cumsum, the same math torchmetrics runs).
 
+    Since round 6 the kernel dispatches through the rank engine (ops/rank.py):
+    on TPU at this size the (f32 key, i32 label) oracle sort is replaced by the
+    bit-identical (u32 key, u8 label) reduced-payload sort — 5 B/element
+    through the ~300-pass bitonic network instead of 8, the op BENCH_r05 put at
+    ~125 ms of the ~160 ms cycle. The timed region is now SPLIT: a sort-only
+    probe (the dispatched tier's exact sort, synced the same way) runs beside
+    the full kernel so the recorded line attributes sort vs post-sort-scan
+    time instead of inferring the ~78% share from r5's cost notes.
+
     Measurement note (r4 -> r5): rounds 3/4 timed a SINGLE evaluation per fetch,
     so each ~170 ms measurement carried one full tunnel round trip — the r3->r4
     "regression" (0.108 -> 0.094 Gsamples/s) was session RTT drift, not a kernel
@@ -632,7 +641,8 @@ def bench_auroc(n: int = 1 << 24, queue_depth: int = 4) -> dict:
     RTT the same way the other configs do."""
     import torch
 
-    from metrics_tpu.ops.clf_curve import binary_auroc_exact
+    from metrics_tpu.ops import rank as _rank
+    from metrics_tpu.ops.clf_curve import _pad_binary, binary_auroc_exact
 
     k1, k2 = jax.random.split(jax.random.PRNGKey(0))
     preds = jax.random.uniform(k1, (n,), jnp.float32)
@@ -649,6 +659,36 @@ def bench_auroc(n: int = 1 << 24, queue_depth: int = 4) -> dict:
     timed()  # queue warm-up
     rate = statistics.median(timed() for _ in range(3))
     dt = n / rate
+
+    # ---- sort-vs-scan attribution: time the dispatched tier's sort ALONE with
+    # the identical queue/sync protocol; the difference is the scan tail
+    pp, tt, vv = _pad_binary(preds, target)
+    tier = _rank.select_tier(pp)
+    if tier == "rank":
+
+        @jax.jit
+        def sort_probe(p, t, v):
+            key = _rank.monotone_key_descending(p, v)
+            lab = jnp.where(v, (t == 1).astype(jnp.uint8), jnp.uint8(2))
+            return jax.lax.sort((key, lab), num_keys=1)[0][-1]
+
+    else:
+
+        @jax.jit
+        def sort_probe(p, t, v):
+            key = jnp.where(v, p, -jnp.inf)
+            return jax.lax.sort((-key, jnp.where(v, t, -1)), num_keys=1)[0][-1]
+
+    float(sort_probe(pp, tt, vv))  # compile + warm
+
+    def timed_sort() -> float:
+        t0 = time.perf_counter()
+        vals = [sort_probe(pp, tt, vv) for _ in range(queue_depth)]
+        float(vals[-1])
+        return (time.perf_counter() - t0) / queue_depth
+
+    timed_sort()  # queue warm-up
+    sort_s = statistics.median(timed_sort() for _ in range(3))
 
     # reference-equivalent host kernel on a smaller slice, normalized per element
     n_cpu = min(n, 1 << 22)
@@ -668,10 +708,15 @@ def bench_auroc(n: int = 1 << 24, queue_depth: int = 4) -> dict:
         "value": round(n / dt / 1e9, 3),
         "unit": "Gsamples/s/chip",
         "vs_baseline": round((n / dt) / (n_cpu / cpu_dt), 2),
-        "bound": "device sort-bound: the payload-carrying lax.sort of 2^24 f32 keys is"
-                 " ~125 ms alone (clf_curve.py:46 carries labels with keys; no gathers);"
-                 " cumsum+trapezoid add <25%. r3->r4 delta was tunnel RTT drift in a"
-                 " single-dispatch timed region; now amortized over a 4-deep queue",
+        "tier": tier,
+        "sort_ms": round(sort_s * 1000, 1),
+        "post_sort_ms": round(max(dt - sort_s, 0.0) * 1000, 1),
+        "bound": "device sort-bound: the bitonic lax.sort costs ~passes x operand"
+                 " bytes; the rank tier (ops/rank.py) sorts (u32 key, u8 label) —"
+                 " 5 B/elem vs the f32 oracle's 8 — and the sort_ms/post_sort_ms"
+                 " split above is measured per round, not inferred. r3->r4 delta"
+                 " was tunnel RTT drift in a single-dispatch timed region; still"
+                 " amortized over a 4-deep queue",
     }
 
 
@@ -727,6 +772,25 @@ def bench_retrieval(n_docs: int = 1 << 24, trials: int = 5) -> dict:
         ndcg_rates.append(n_docs / (time.perf_counter() - t0))
     assert 0.0 < ndcg_val < 1.0
 
+    # ---- sort-vs-scan attribution: the layout sort (since r6 slimmed to the
+    # 3-operand (indexes, -preds, target) form, 12 B/row vs 20) timed alone
+    # with the same sync protocol; the rest of the cycle is scans + reduction
+    @jax.jit
+    def layout_probe(i, s, t):
+        return jax.lax.sort((i, -s, t), num_keys=2, is_stable=True)[0][-1]
+
+    float(layout_probe(idx, scores, rel))  # compile + warm
+
+    def timed_layout() -> float:
+        t0 = time.perf_counter()
+        vals = [layout_probe(idx, scores, rel) for _ in range(4)]
+        float(vals[-1])
+        return (time.perf_counter() - t0) / 4
+
+    timed_layout()  # queue warm-up
+    layout_s = statistics.median(timed_layout() for _ in range(3))
+    cycle_s = n_docs / statistics.median(rates)
+
     vs = None
     tm = _reference_torchmetrics()
     if tm is not None:
@@ -747,10 +811,14 @@ def bench_retrieval(n_docs: int = 1 << 24, trials: int = 5) -> dict:
     return {"metric": "retrieval_map_docs_per_s", "value": round(statistics.median(rates) / 1e6, 2),
             "unit": "Mdocs/s/chip", "vs_baseline": vs,
             "ndcg_mdocs_per_s": round(statistics.median(ndcg_rates) / 1e6, 2),
-            "bound": "sort+scan kernel bound: payload sort ~125 ms at 2^24 rows plus"
-                     " ~5 cumsum/cummax scans ~30 ms each, zero scatters/gathers"
-                     " (ops/segment.py scan path; since r5 ndcg/r_precision ride it"
-                     " too via the sign-split segmented cumsum)"}
+            "layout_sort_ms": round(layout_s * 1000, 1),
+            "scan_ms": round(max(cycle_s - layout_s, 0.0) * 1000, 1),
+            "bound": "sort+scan kernel bound: the layout sort (since r6 the slimmed"
+                     " 3-operand (indexes, -preds, target) form, 12 B/row vs 20 —"
+                     " ops/segment.py) plus ~5 cumsum/cummax scans, zero"
+                     " scatters/gathers; the layout_sort_ms/scan_ms split is"
+                     " measured per round. Radix partition-by-query rejected:"
+                     " experiments/rank_exp.py verdict"}
 
 
 def bench_ckpt(cat_docs: int = 1 << 22, trials: int = 5) -> dict:

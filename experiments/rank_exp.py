@@ -1,0 +1,203 @@
+"""Experiment grid for the bucketed rank engine (ops/rank.py) — sort widths,
+histogram tiers, and the retrieval radix-partition evaluation.
+
+Run: python experiments/rank_exp.py [--n 24] [--section all|sort|hist|partition]
+
+== RECORDED VERDICT: radix partition-by-query for the retrieval layout ==
+
+REJECTED; the adopted change is sort-operand slimming (ops/segment.py r6).
+
+The layout pass needs rows grouped by query and ranked by score inside each
+query. The partition alternative (compute per-row destinations from a query
+histogram + prefix sum, then materialize the permutation) was evaluated
+against the measured cost model and the ``partition`` section below, which
+times its mandatory ingredients:
+
+- A materializing partition IS a permutation apply: one computed-destination
+  gather (or scatter) per pass. Measured on the v5e (round 5, ops/segment.py
+  notes): ~90 ms per 16M-row gather — MORE than the entire 4.2M-row 3-payload
+  sort (45 ms) and ~70% of the full 2^24-row sort (~125 ms). Multi-pass radix
+  (needed because query ids span up to 2^24 values) multiplies that cost.
+- The gather-free alternative (per-row destination via scans, then positional
+  relabeling) still has to MOVE the payload columns — which is exactly the
+  data reorganization ``lax.sort`` already performs in its bitonic network,
+  with no computed-index traffic at all.
+- What partitioning would save is the sort's ranking work WITHIN queries — but
+  scores must be ranked within queries anyway; the sort does both in one op.
+
+The measurable lever was operand bytes, not the network: the r3 layout carried
+(indexes, -preds, indexes, preds, target) = 20 B/row where the sorted key
+columns come out of ``lax.sort`` anyway; the r6 form carries (indexes, -preds,
+target) = 12 B/row and recovers ndcg's ideal layout by negating its own sort
+key (8 vs 12 B/row). ADOPTED — bit-identical outputs, 40% fewer bytes through
+the ~300-pass network. bench.py's retrieval line now records the measured
+layout_sort_ms/scan_ms split each round so the win is visible in BENCH_r06+.
+
+== Sort-width grid (``--section sort``) ==
+
+Times the exact-AUROC sort candidates at equal N: the (f32, i32) oracle, the
+(u32, i32) integer-comparator variant, the shipped (u32, u8) reduced-payload
+tier, a key-only u32 sort (the no-label floor), and the curve path's
+(u8 flag + 3 f32) front-pack vs argsort + 3 gathers. On the tunneled TPU the
+bitonic cost model predicts ~bytes-proportional scaling (5/8 for the shipped
+tier); this grid is the ground truth for that prediction.
+
+== Histogram tier grid (``--section hist``) ==
+
+bincount tiers (compare / tiled-Pallas / MXU pair-split / scatter) across
+num_bins in {64..16384}: records the compare-vs-Pallas crossover that decides
+whether PALLAS_MAX_BINS (raised 64 -> 256 in r6 via output-block bin tiling)
+should rise further, and the pair-split-vs-scatter margin at 2^12-2^14 bins
+that the rank engine's bucket histograms (ops/rank.py:bucket_counts) ride.
+"""
+import argparse
+import statistics
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _sync(out):
+    # block_until_ready does not round-trip on the tunneled backend; a scalar
+    # device_get is the only trustworthy sync (in-order queue drains first)
+    leaf = jax.tree.leaves(out)[0]
+    jax.device_get(leaf.ravel()[0] if leaf.ndim else leaf)
+
+
+def timeit(fn, *args, reps=5):
+    out = fn(*args)
+    _sync(out)
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(4):
+            out = fn(*args)
+        _sync(out)
+        ts.append((time.perf_counter() - t0) / 4)
+    return statistics.median(ts)
+
+
+def _report(name, dt, n):
+    print(f"  {name:28s} {dt * 1e3:8.1f} ms   {n / dt / 1e6:8.2f} Melem/s")
+
+
+def section_sort(n):
+    from metrics_tpu.ops import rank
+
+    rng = np.random.RandomState(0)
+    preds = jnp.asarray(rng.rand(n).astype(np.float32))
+    target = jnp.asarray((rng.rand(n) > 0.7).astype(np.int32))
+    valid = jnp.ones((n,), bool)
+
+    f_oracle = jax.jit(lambda p, t: jax.lax.sort((-p, t), num_keys=1))
+    f_u32_i32 = jax.jit(lambda p, t: jax.lax.sort((rank.monotone_key_descending(p), t), num_keys=1))
+    f_u32_u8 = jax.jit(
+        lambda p, t: jax.lax.sort((rank.monotone_key_descending(p), t.astype(jnp.uint8)), num_keys=1)
+    )
+    f_keyonly = jax.jit(lambda p: jax.lax.sort((rank.monotone_key_descending(p),), num_keys=1))
+    f_full_oracle = jax.jit(lambda p, t, v: rank_counts_oracle(p, t, v))
+    f_full_rank = jax.jit(lambda p, t, v: rank.rank_run_end_counts(p, t, v))
+
+    def rank_counts_oracle(p, t, v):
+        from metrics_tpu.ops.clf_curve import _run_end_counts
+
+        return _run_end_counts(p, t, v, tier="sort")
+
+    for name, fn, a in (
+        ("sort_f32key_i32lab (oracle)", f_oracle, (preds, target)),
+        ("sort_u32key_i32lab", f_u32_i32, (preds, target)),
+        ("sort_u32key_u8lab (shipped)", f_u32_u8, (preds, target)),
+        ("sort_u32key_only (floor)", f_keyonly, (preds,)),
+        ("run_end_counts oracle", f_full_oracle, (preds, target, valid)),
+        ("run_end_counts rank tier", f_full_rank, (preds, target, valid)),
+    ):
+        _report(name, timeit(fn, *a), n)
+
+    # curve compaction: argsort + 3 gathers vs one stable payload sort
+    mask = jnp.asarray(rng.rand(n) > 0.5)
+    cols = tuple(jnp.asarray(rng.rand(n).astype(np.float32)) for _ in range(3))
+
+    def compact_gather(m, a, b, c):
+        order = jnp.argsort(~m, stable=True)
+        return jnp.take(a, order), jnp.take(b, order), jnp.take(c, order)
+
+    _report("front_pack argsort+3gather", timeit(jax.jit(compact_gather), mask, *cols), n)
+    _report("front_pack payload sort", timeit(jax.jit(rank.stable_front_pack), mask, *cols), n)
+
+
+def section_hist(n):
+    from metrics_tpu.ops import histogram as H
+    from metrics_tpu.ops import rank
+
+    rng = np.random.RandomState(0)
+    preds = jnp.asarray(rng.rand(n).astype(np.float32))
+    keys = rank.monotone_key_descending(preds)
+    on_tpu = jax.default_backend() == "tpu"
+
+    for bins in (64, 128, 256, 512, 1024, 2048, 4096, 8192, 16384):
+        bits = bins.bit_length() - 1
+        x = (keys >> jnp.uint32(32 - bits)).astype(jnp.int32)
+        if bins <= H.COMPARE_MAX_BINS:
+            _report(f"compare       bins={bins}", timeit(jax.jit(
+                lambda v, b=bins: H._compare_bincount(v, None, b)), x), n)
+        if on_tpu and bins <= 2048:  # tiled kernel: VMEM-unbounded, work O(bins*N)
+            _report(f"pallas_tiled  bins={bins}", timeit(jax.jit(
+                lambda v, b=bins: H._pallas_bincount(v, None, b)), x), n)
+        if bins > 2048:
+            _report(f"pairsplit_mxu bins={bins}", timeit(jax.jit(
+                lambda v, b=bins: H._pairsplit_bincount(v, None, b)), x), n)
+            _report(f"scatter       bins={bins}", timeit(jax.jit(
+                lambda v, b=bins: jnp.zeros((b,), jnp.int32).at[v].add(1, mode="drop")), x), n)
+
+    # the histogram-only AUROC bounds pass vs the exact sort kernel
+    target = jnp.asarray((rng.rand(n) > 0.7).astype(np.int32))
+    _report("bucketed_auroc_bounds b=12", timeit(jax.jit(
+        lambda p, t: rank.bucketed_auroc_bounds(p, t, bits=12)), preds, target), n)
+
+
+def section_partition(n):
+    """Radix partition ingredients vs the one-sort layout (verdict: rejected)."""
+    rng = np.random.RandomState(0)
+    idx = jnp.asarray(np.sort(rng.randint(0, n // 64, n)).astype(np.int32))
+    scores = jnp.asarray(rng.rand(n).astype(np.float32))
+    rel = jnp.asarray((rng.rand(n) > 0.7).astype(np.int32))
+
+    f_sort3 = jax.jit(lambda i, s, t: jax.lax.sort((i, -s, t), num_keys=2, is_stable=True))
+    f_sort5 = jax.jit(lambda i, s, t: jax.lax.sort((i, -s, i, s, t), num_keys=2, is_stable=True))
+    # the partition's mandatory permutation-apply: 3 computed-index gathers
+    perm = jnp.asarray(rng.permutation(n).astype(np.int32))
+    f_gather3 = jax.jit(lambda p, i, s, t: (jnp.take(i, p), jnp.take(s, p), jnp.take(t, p)))
+    # destination computation alone (histogram + prefix + rank-in-bucket scans)
+    def dests(i):
+        new_seg = jnp.concatenate([jnp.ones(1, bool), i[1:] != i[:-1]])
+        pos = jnp.arange(i.shape[0])
+        start = jax.lax.cummax(jnp.where(new_seg, pos, 0))
+        return pos - start
+
+    f_dests = jax.jit(dests)
+
+    _report("layout sort 3-op (adopted)", timeit(f_sort3, idx, scores, rel), n)
+    _report("layout sort 5-op (r3 form)", timeit(f_sort5, idx, scores, rel), n)
+    _report("partition: 3 perm-gathers", timeit(f_gather3, perm, idx, scores, rel), n)
+    _report("partition: dest scans only", timeit(f_dests, idx), n)
+    print("  -> verdict (module docstring): partition REJECTED — the permutation")
+    print("     apply alone costs more than the whole slimmed sort; adopted the")
+    print("     20->12 B/row operand slimming instead.")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=22)
+    ap.add_argument("--section", choices=("all", "sort", "hist", "partition"), default="all")
+    args = ap.parse_args()
+    n = 1 << args.n
+    for name, fn in (("sort", section_sort), ("hist", section_hist), ("partition", section_partition)):
+        if args.section in ("all", name):
+            print(f"== {name} (n=2^{args.n}, backend={jax.default_backend()}) ==")
+            fn(n)
+
+
+if __name__ == "__main__":
+    main()

@@ -186,9 +186,19 @@ def cat_sync(buf: CatBuffer, axis_name) -> CatBuffer:
     capacity = buf.capacity
     per_device_mask = jnp.arange(capacity)[None, :] < counts[:, None]
     flat_mask = per_device_mask.reshape(-1)
-    # stable sort: valid rows first, preserving per-device order
-    order = jnp.argsort(~flat_mask, stable=True)
-    return CatBuffer(jnp.take(data, order, axis=0), counts.sum().astype(jnp.int32), overflow)
+    # stable front-pack: valid rows first, preserving per-device order
+    if data.ndim == 1:
+        # one payload sort instead of argsort + a per-row gather (the ~90 ms/16M
+        # gather trap, ops/segment.py notes) — the common CatBuffer shape
+        from metrics_tpu.ops.rank import stable_front_pack
+
+        (packed,) = stable_front_pack(flat_mask, data)
+    else:
+        # multi-column rows: lax.sort cannot mix a (N,) key with (N, F) payloads;
+        # the row gather amortizes over F columns, so argsort+take stays
+        order = jnp.argsort(~flat_mask, stable=True)
+        packed = jnp.take(data, order, axis=0)
+    return CatBuffer(packed, counts.sum().astype(jnp.int32), overflow)
 
 
 def cat_merge(global_buf: CatBuffer, local_buf: CatBuffer) -> CatBuffer:

@@ -4,6 +4,7 @@ from typing import Optional
 import jax.numpy as jnp
 from jax import Array
 
+from metrics_tpu.ops.rank import ranked_targets
 from metrics_tpu.utils.checks import _check_retrieval_functional_inputs
 
 
@@ -23,8 +24,8 @@ def retrieval_average_precision(preds: Array, target: Array, top_k: Optional[int
     if not (isinstance(top_k, int) and top_k > 0):
         raise ValueError(f"Argument ``top_k`` has to be a positive integer or None, but got {top_k}.")
     k = min(top_k, preds.shape[-1])
-    order = jnp.argsort(-preds)
-    t = (target[order][:k] > 0).astype(jnp.float32)
+    # payload sort, not argsort+gather (ops/segment.py gather-trap notes)
+    t = (ranked_targets(preds, target)[:k] > 0).astype(jnp.float32)
     n_rel = t.sum()
     pos = jnp.arange(1, k + 1, dtype=jnp.float32)
     cumrel = jnp.cumsum(t)

@@ -4,6 +4,7 @@ from typing import Optional
 import jax.numpy as jnp
 from jax import Array
 
+from metrics_tpu.ops.rank import ranked_targets
 from metrics_tpu.utils.checks import _check_retrieval_functional_inputs
 
 
@@ -15,7 +16,7 @@ def retrieval_fall_out(preds: Array, target: Array, top_k: Optional[int] = None)
     if not (isinstance(top_k, int) and top_k > 0):
         raise ValueError("`top_k` has to be a positive integer or None")
     neg = 1 - (target > 0).astype(jnp.int32)
-    order = jnp.argsort(-preds)
-    nonrel_in_k = neg[order][:top_k].sum().astype(jnp.float32)
+    # payload sort, not argsort+gather (ops/segment.py gather-trap notes)
+    nonrel_in_k = (1 - (ranked_targets(preds, target)[:top_k] > 0).astype(jnp.int32)).sum().astype(jnp.float32)
     total_neg = neg.sum().astype(jnp.float32)
     return jnp.where(total_neg > 0, nonrel_in_k / jnp.maximum(total_neg, 1.0), 0.0)

@@ -4,6 +4,7 @@ from typing import Optional
 import jax.numpy as jnp
 from jax import Array
 
+from metrics_tpu.ops.rank import ranked_targets
 from metrics_tpu.utils.checks import _check_retrieval_functional_inputs
 
 
@@ -14,6 +15,6 @@ def retrieval_hit_rate(preds: Array, target: Array, top_k: Optional[int] = None)
         top_k = preds.shape[-1]
     if not (isinstance(top_k, int) and top_k > 0):
         raise ValueError("`top_k` has to be a positive integer or None")
-    order = jnp.argsort(-preds)
-    relevant = (target[order][:top_k] > 0).sum()
+    # payload sort, not argsort+gather (ops/segment.py gather-trap notes)
+    relevant = (ranked_targets(preds, target)[:top_k] > 0).sum()
     return (relevant > 0).astype(jnp.float32)

@@ -4,6 +4,7 @@ from typing import Optional
 import jax.numpy as jnp
 from jax import Array
 
+from metrics_tpu.ops.rank import ranked_targets
 from metrics_tpu.utils.checks import _check_retrieval_functional_inputs
 
 
@@ -28,7 +29,8 @@ def retrieval_normalized_dcg(preds: Array, target: Array, top_k: Optional[int] =
     if not (isinstance(top_k, int) and top_k > 0):
         raise ValueError("`top_k` has to be a positive integer or None")
     target = target.astype(jnp.float32)
-    sorted_target = target[jnp.argsort(-preds)][:top_k]
+    # payload sort, not argsort+gather (ops/segment.py gather-trap notes)
+    sorted_target = ranked_targets(preds, target)[:top_k]
     ideal_target = -jnp.sort(-target)[:top_k]
     ideal_dcg = _dcg(ideal_target)
     target_dcg = _dcg(sorted_target)

@@ -4,6 +4,7 @@ from typing import Optional
 import jax.numpy as jnp
 from jax import Array
 
+from metrics_tpu.ops.rank import ranked_targets
 from metrics_tpu.utils.checks import _check_retrieval_functional_inputs
 
 
@@ -16,6 +17,6 @@ def retrieval_precision(preds: Array, target: Array, top_k: Optional[int] = None
         top_k = preds.shape[-1]
     if not (isinstance(top_k, int) and top_k > 0):
         raise ValueError("`top_k` has to be a positive integer or None")
-    order = jnp.argsort(-preds)
-    relevant = (target[order][: min(top_k, preds.shape[-1])] > 0).sum().astype(jnp.float32)
+    # payload sort, not argsort+gather (ops/segment.py gather-trap notes)
+    relevant = (ranked_targets(preds, target)[: min(top_k, preds.shape[-1])] > 0).sum().astype(jnp.float32)
     return jnp.where(target.sum() > 0, relevant / top_k, 0.0)

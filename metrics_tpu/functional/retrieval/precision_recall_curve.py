@@ -4,6 +4,7 @@ from typing import Optional, Tuple
 import jax.numpy as jnp
 from jax import Array
 
+from metrics_tpu.ops.rank import ranked_targets
 from metrics_tpu.utils.checks import _check_retrieval_functional_inputs
 from metrics_tpu.utils.data import _cumsum
 
@@ -45,8 +46,8 @@ def retrieval_precision_recall_curve(
         topk = jnp.arange(1, max_k + 1)
 
     k_eff = min(max_k, n_docs)
-    order = jnp.argsort(-preds)[:k_eff]
-    relevant = target[order].astype(jnp.float32)
+    # payload sort, not argsort+gather (ops/segment.py gather-trap notes)
+    relevant = ranked_targets(preds, target)[:k_eff].astype(jnp.float32)
     relevant = jnp.pad(relevant, (0, max(0, max_k - k_eff)))
     relevant = _cumsum(relevant, axis=0)
 

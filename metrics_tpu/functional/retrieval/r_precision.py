@@ -6,6 +6,7 @@ as a rank mask instead of a slice so the kernel stays static-shape.
 import jax.numpy as jnp
 from jax import Array
 
+from metrics_tpu.ops.rank import ranked_targets
 from metrics_tpu.utils.checks import _check_retrieval_functional_inputs
 
 
@@ -13,8 +14,8 @@ def retrieval_r_precision(preds: Array, target: Array) -> Array:
     """R-precision for a single query."""
     preds, target = _check_retrieval_functional_inputs(preds, target)
     n_rel = (target > 0).sum()
-    order = jnp.argsort(-preds)
-    t = (target[order] > 0).astype(jnp.float32)
+    # payload sort, not argsort+gather (ops/segment.py gather-trap notes)
+    t = (ranked_targets(preds, target) > 0).astype(jnp.float32)
     rank = jnp.arange(1, preds.shape[-1] + 1)
     rel_in_r = jnp.where(rank <= n_rel, t, 0.0).sum()
     return jnp.where(n_rel > 0, rel_in_r / jnp.maximum(n_rel.astype(jnp.float32), 1.0), 0.0)

@@ -2,6 +2,7 @@
 import jax.numpy as jnp
 from jax import Array
 
+from metrics_tpu.ops.rank import ranked_targets
 from metrics_tpu.utils.checks import _check_retrieval_functional_inputs
 
 
@@ -17,8 +18,8 @@ def retrieval_reciprocal_rank(preds: Array, target: Array) -> Array:
         Array(0.5, dtype=float32)
     """
     preds, target = _check_retrieval_functional_inputs(preds, target)
-    order = jnp.argsort(-preds)
-    t = target[order] > 0
+    # payload sort, not argsort+gather (ops/segment.py gather-trap notes)
+    t = ranked_targets(preds, target) > 0
     rank = jnp.arange(1, preds.shape[-1] + 1)
     first = jnp.min(jnp.where(t, rank, preds.shape[-1] + 1))
     return jnp.where(t.any(), 1.0 / first.astype(jnp.float32), 0.0)

@@ -10,6 +10,7 @@ issued which device work). Naming convention:
     tm.forward/<MetricClassName>    dual-purpose forward
     tm.collection.update            MetricCollection fan-out
     tm.sync/<reduce_fx>             one collective state sync
+    tm.rank/<tier>                  one rank-engine dispatch (ops/rank.py)
 
 Callers in the hot path gate on ``registry._ENABLED`` *before* building the
 context manager, so the disabled path never allocates one. ``trace(path)`` is

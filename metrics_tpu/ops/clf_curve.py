@@ -19,6 +19,18 @@ Invalid rows (``ignore_index`` masks, fixed-capacity buffer padding) carry
 adds only duplicated end points. This also makes exact mode jit/compute_from-safe —
 the reference's exact mode cannot run under torch.compile/jit at all.
 
+Since round 6 the scalar kernels (AUROC, AP, and their one-vs-rest/per-label
+variants) run behind a two-tier dispatch (ops/rank.py): TPU + unsharded +
+large-N routes to the bucketed rank engine's reduced-payload (u32 key, u8
+label) sort — 5 B/element against this module's (f32, i32) 8 B/element, the
+dominant cost of the ~125 ms bitonic network at 2^24 rows — and everything
+else keeps the f32 sort below, which remains the correctness oracle (the rank
+tier must match it bit-for-bit; property suite in
+tests/unittests/classification/test_rank_engine.py). The curve-shaped outputs
+(PR/ROC padded) stay on the oracle tier: their thresholds are user-visible f32
+values and the rank tier's -0.0 canonicalization would swap -0.0 thresholds
+for +0.0 (numerically equal, bitwise not).
+
 One-vs-rest variants vmap the binary kernel over classes/labels.
 """
 from functools import partial
@@ -28,6 +40,7 @@ import jax
 import jax.numpy as jnp
 from jax import Array
 
+from metrics_tpu.ops import rank as _rank
 from metrics_tpu.utils.data import _next_pow2
 
 
@@ -36,12 +49,19 @@ def _suffix_min(x: Array) -> Array:
     return jnp.flip(jax.lax.cummin(jnp.flip(x)))
 
 
-def _run_end_counts(preds: Array, target: Array, valid: Array) -> Tuple[Array, Array, Array, Array]:
+def _run_end_counts(
+    preds: Array, target: Array, valid: Array, tier: str = "sort"
+) -> Tuple[Array, Array, Array, Array]:
     """(fps, tps) at every position of the descending-score sort, tie runs collapsed.
 
     Returns int32 ``fps``/``tps`` of shape (N,) plus the descending sort keys and
     the tie-run-end boundary mask (single source of truth for run collapsing).
     ``tps[-1]``/``fps[-1]`` are the total valid positive/negative counts.
+
+    ``tier="rank"`` swaps the (f32 key, i32 label) sort below for the rank
+    engine's bit-identical (u32 key, u8 label) construction (ops/rank.py) —
+    5 B/element through the bitonic network instead of 8, and no 64 MB key
+    negations. This f32 path stays the oracle the rank tier is tested against.
 
     TPU notes: a single multi-operand ``lax.sort`` carries the labels with the keys
     (argsort + gathers cost ~90 ms per 16M-element gather on TPU), and tie-run ends
@@ -49,6 +69,8 @@ def _run_end_counts(preds: Array, target: Array, valid: Array) -> Tuple[Array, A
     ``searchsorted`` is a serialized gather loop under XLA (~3.7 s at 16M vs ~35 ms
     for the scan).
     """
+    if tier == "rank":
+        return _rank.rank_run_end_counts(preds, target, valid)
     n = preds.shape[0]
     key = jnp.where(valid, preds.astype(jnp.float32), -jnp.inf)
     # ascending sort of -key == descending by key; invalid rows (-inf key) sort last
@@ -69,9 +91,11 @@ def _run_end_counts(preds: Array, target: Array, valid: Array) -> Tuple[Array, A
     return fps, tps, sk, boundary
 
 
-def _roc_points(preds: Array, target: Array, valid: Array) -> Tuple[Array, Array, Array, Array]:
+def _roc_points(
+    preds: Array, target: Array, valid: Array, tier: str = "sort"
+) -> Tuple[Array, Array, Array, Array]:
     """(fpr0, tpr0) with a prepended origin, plus total positive/negative counts."""
-    fps, tps, _, _ = _run_end_counts(preds, target, valid)
+    fps, tps, _, _ = _run_end_counts(preds, target, valid, tier)
     pos = tps[-1]
     neg = fps[-1]
     tpr = tps.astype(jnp.float32) / jnp.maximum(pos, 1)
@@ -84,11 +108,13 @@ def _trapz(y: Array, x: Array) -> Array:
     return jnp.sum(jnp.diff(x) * (y[1:] + y[:-1]) * 0.5)
 
 
-def _binary_auroc_kernel(preds: Array, target: Array, valid: Array, max_fpr: Optional[Array]) -> Array:
+def _binary_auroc_kernel(
+    preds: Array, target: Array, valid: Array, max_fpr: Optional[Array], tier: str = "sort"
+) -> Array:
     """Exact binary AUROC; 0.0 when either class is absent (reference zeroes the
     degenerate curve via safe division — torch ``_binary_roc_compute`` — and the
     zero DOES participate in macro averages, unlike AP's NaN)."""
-    fpr0, tpr0, pos, neg = _roc_points(preds, target, valid)
+    fpr0, tpr0, pos, neg = _roc_points(preds, target, valid, tier)
     if max_fpr is None:
         area = _trapz(tpr0, fpr0)
     else:
@@ -114,9 +140,11 @@ def _binary_auroc_kernel(preds: Array, target: Array, valid: Array, max_fpr: Opt
     return area
 
 
-def _binary_ap_kernel(preds: Array, target: Array, valid: Array) -> Tuple[Array, Array]:
+def _binary_ap_kernel(
+    preds: Array, target: Array, valid: Array, tier: str = "sort"
+) -> Tuple[Array, Array]:
     """Exact binary average precision and the positive count; NaN when no positives."""
-    fps, tps, _, _ = _run_end_counts(preds, target, valid)
+    fps, tps, _, _ = _run_end_counts(preds, target, valid, tier)
     pos = tps[-1]
     tot = (tps + fps).astype(jnp.float32)
     precision = jnp.where(tot > 0, tps.astype(jnp.float32) / jnp.where(tot > 0, tot, 1.0), 0.0)
@@ -125,9 +153,13 @@ def _binary_ap_kernel(preds: Array, target: Array, valid: Array) -> Tuple[Array,
     return jnp.where(pos > 0, ap, jnp.nan), pos
 
 
-_binary_auroc_full_j = jax.jit(partial(_binary_auroc_kernel, max_fpr=None))
-_binary_auroc_partial_j = jax.jit(_binary_auroc_kernel)
-_binary_ap_j = jax.jit(lambda p, t, v: _binary_ap_kernel(p, t, v)[0])
+# tier is a static argument: each dispatch target compiles (and caches) its own
+# program, so a force_tier override can never hit a stale trace
+_binary_auroc_full_j = jax.jit(partial(_binary_auroc_kernel, max_fpr=None), static_argnames=("tier",))
+_binary_auroc_partial_j = jax.jit(_binary_auroc_kernel, static_argnames=("tier",))
+_binary_ap_j = jax.jit(
+    lambda p, t, v, tier: _binary_ap_kernel(p, t, v, tier)[0], static_argnames=("tier",)
+)
 
 
 def _pad_binary(preds: Array, target: Array) -> Tuple[Array, Array, Array]:
@@ -161,12 +193,13 @@ def _binary_curve_padded_kernel(preds: Array, target: Array, valid: Array) -> Tu
     # depending on whether compute runs eagerly or under jit
     recall_all = jnp.where(pos > 0, tps.astype(jnp.float32) / jnp.maximum(pos, 1), jnp.nan)
 
-    # flip to ascending thresholds, then front-pack the run-end points
+    # flip to ascending thresholds, then front-pack the run-end points — one
+    # stable payload sort instead of argsort + 3 gathers (the ~90 ms/16M-row
+    # gather trap, ops/segment.py notes)
     fb = jnp.flip(boundary)
-    order = jnp.argsort(~fb, stable=True)
-    prec = jnp.take(jnp.flip(precision_all), order)
-    rec = jnp.take(jnp.flip(recall_all), order)
-    thr = jnp.take(jnp.flip(sk), order)
+    prec, rec, thr = _rank.stable_front_pack(
+        fb, jnp.flip(precision_all), jnp.flip(recall_all), jnp.flip(sk)
+    )
     k = boundary.sum()
     idx = jnp.arange(n)
     one = jnp.ones((1,), jnp.float32)
@@ -213,11 +246,9 @@ def _binary_roc_padded_kernel(preds: Array, target: Array, valid: Array) -> Tupl
     neg = fps[-1]
     tpr_all = jnp.where(pos > 0, tps.astype(jnp.float32) / jnp.maximum(pos, 1), 0.0)
     fpr_all = jnp.where(neg > 0, fps.astype(jnp.float32) / jnp.maximum(neg, 1), 0.0)
-    # front-pack run-end points, keeping the descending-threshold order
-    order = jnp.argsort(~boundary, stable=True)
-    tprp = jnp.take(tpr_all, order)
-    fprp = jnp.take(fpr_all, order)
-    thrp = jnp.take(sk, order)
+    # front-pack run-end points, keeping the descending-threshold order — one
+    # stable payload sort instead of argsort + 3 gathers
+    tprp, fprp, thrp = _rank.stable_front_pack(boundary, tpr_all, fpr_all, sk)
     k = boundary.sum()
     idx = jnp.arange(n)
     zero = jnp.zeros((1,), jnp.float32)
@@ -246,44 +277,54 @@ def binary_auroc_exact(preds: Array, target: Array, max_fpr: Optional[float] = N
     """Exact (``thresholds=None``) binary AUROC fully on device.
 
     ``target`` entries < 0 (ignore_index masks / buffer padding) are excluded.
+    Dispatches between the f32 oracle sort and the rank engine's reduced-payload
+    tier (ops/rank.py); the choice is visible under obs as ``rank.dispatch/*``.
     """
     preds, target, valid = _pad_binary(preds, target)
-    # max_fpr == 1 short-circuits to the full-AUC path (reference auroc.py:92:
-    # `max_fpr is None or max_fpr == 1`), which returns 0.0 — not NaN — on
-    # single-class data.
-    if max_fpr is None or max_fpr == 1:
-        return _binary_auroc_full_j(preds, target, valid)
-    return _binary_auroc_partial_j(preds, target, valid, jnp.float32(max_fpr))
+    tier = _rank.select_tier(preds)
+    _rank.record_dispatch(tier, "binary_auroc")
+    with _rank.rank_scope(tier):
+        # max_fpr == 1 short-circuits to the full-AUC path (reference auroc.py:92:
+        # `max_fpr is None or max_fpr == 1`), which returns 0.0 — not NaN — on
+        # single-class data.
+        if max_fpr is None or max_fpr == 1:
+            return _binary_auroc_full_j(preds, target, valid, tier=tier)
+        return _binary_auroc_partial_j(preds, target, valid, jnp.float32(max_fpr), tier=tier)
 
 
 def binary_average_precision_exact(preds: Array, target: Array) -> Array:
-    """Exact binary average precision fully on device."""
+    """Exact binary average precision fully on device (tiered like AUROC)."""
     preds, target, valid = _pad_binary(preds, target)
-    return _binary_ap_j(preds, target, valid)
+    tier = _rank.select_tier(preds)
+    _rank.record_dispatch(tier, "binary_ap")
+    with _rank.rank_scope(tier):
+        return _binary_ap_j(preds, target, valid, tier=tier)
 
 
 # ------------------------------------------------------------- one-vs-rest tiers
 
 
-def _binary_auroc_with_pos(preds: Array, target: Array, valid: Array) -> Tuple[Array, Array]:
+def _binary_auroc_with_pos(
+    preds: Array, target: Array, valid: Array, tier: str = "sort"
+) -> Tuple[Array, Array]:
     """(AUROC, positive count) — the per-class body of the vmapped tiers.
 
     Absent classes score 0.0 (not NaN) and thus participate in macro averages,
     exactly like the reference's safe-division-zeroed degenerate curves.
     """
-    fpr0, tpr0, pos, neg = _roc_points(preds, target, valid)
+    fpr0, tpr0, pos, neg = _roc_points(preds, target, valid, tier)
     return _trapz(tpr0, fpr0), pos
 
 
 def _make_ovr(kernel):
     """Multiclass tier: binarize a shared label vector one-vs-rest per class."""
 
-    @jax.jit
-    def run(preds2d: Array, target: Array) -> Tuple[Array, Array]:
+    @partial(jax.jit, static_argnames=("tier",))
+    def run(preds2d: Array, target: Array, tier: str = "sort") -> Tuple[Array, Array]:
         valid = target >= 0
 
         def per_class(p_col, c):
-            return kernel(p_col, (target == c).astype(jnp.int32), valid)
+            return kernel(p_col, (target == c).astype(jnp.int32), valid, tier)
 
         return jax.vmap(per_class)(preds2d.T, jnp.arange(preds2d.shape[1]))
 
@@ -293,10 +334,10 @@ def _make_ovr(kernel):
 def _make_perlabel(kernel):
     """Multilabel tier: independent target column (and ignore mask) per label."""
 
-    @jax.jit
-    def run(preds2d: Array, target2d: Array) -> Tuple[Array, Array]:
+    @partial(jax.jit, static_argnames=("tier",))
+    def run(preds2d: Array, target2d: Array, tier: str = "sort") -> Tuple[Array, Array]:
         def per_label(p_col, t_col):
-            return kernel(p_col, t_col, t_col >= 0)
+            return kernel(p_col, t_col, t_col >= 0, tier)
 
         return jax.vmap(per_label)(preds2d.T, target2d.T)
 
@@ -320,22 +361,39 @@ def _pad_rows(preds2d: Array, target: Array) -> Tuple[Array, Array]:
     return preds2d, target
 
 
+def _ovr_tier(preds2d: Array, op: str) -> str:
+    """Tier for the vmapped variants: size gate on the per-class column length
+    (each lane sorts its own column; the batched bitonic network's depth is set
+    by the column, not the matrix)."""
+    tier = _rank.select_tier(preds2d[:, 0] if preds2d.ndim == 2 else preds2d)
+    _rank.record_dispatch(tier, op)
+    return tier
+
+
 def multiclass_auroc_exact(preds2d: Array, target: Array) -> Tuple[Array, Array]:
     """Per-class exact AUROC + positive-count weights; rows with target<0 excluded."""
     preds2d, target = _pad_rows(preds2d, target)
-    return _ovr_auroc_j(preds2d, target)
+    tier = _ovr_tier(preds2d, "multiclass_auroc")
+    with _rank.rank_scope(tier):
+        return _ovr_auroc_j(preds2d, target, tier=tier)
 
 
 def multiclass_average_precision_exact(preds2d: Array, target: Array) -> Tuple[Array, Array]:
     preds2d, target = _pad_rows(preds2d, target)
-    return _ovr_ap_j(preds2d, target)
+    tier = _ovr_tier(preds2d, "multiclass_ap")
+    with _rank.rank_scope(tier):
+        return _ovr_ap_j(preds2d, target, tier=tier)
 
 
 def multilabel_auroc_exact(preds2d: Array, target2d: Array) -> Tuple[Array, Array]:
     preds2d, target2d = _pad_rows(preds2d, target2d)
-    return _perlabel_auroc_j(preds2d, target2d)
+    tier = _ovr_tier(preds2d, "multilabel_auroc")
+    with _rank.rank_scope(tier):
+        return _perlabel_auroc_j(preds2d, target2d, tier=tier)
 
 
 def multilabel_average_precision_exact(preds2d: Array, target2d: Array) -> Tuple[Array, Array]:
     preds2d, target2d = _pad_rows(preds2d, target2d)
-    return _perlabel_ap_j(preds2d, target2d)
+    tier = _ovr_tier(preds2d, "multilabel_ap")
+    with _rank.rank_scope(tier):
+        return _perlabel_ap_j(preds2d, target2d, tier=tier)

@@ -14,10 +14,24 @@ magnitude under the memory roofline. This module provides the TPU-native tiers:
    inputs stream HBM->VMEM in ``(8, 4096)`` blocks, each grid step accumulates a
    ``(num_bins, 1)`` partial histogram in a revisited output block. Saturates the
    measured element-compare bandwidth (~8.8 Gelem/s at 25 bins, +6% over the
-   fused XLA form) and keeps VMEM bounded, used for ``num_bins <= 64`` on large
-   unsharded inputs.
+   fused XLA form) and keeps VMEM bounded. Since round 6 the output block is
+   additionally TILED over bins (``_BIN_TILE`` = 64 bins per grid column), so
+   the kernel's ceiling is no longer the 64 bins one output block could hold:
+   ``PALLAS_MAX_BINS`` now sits at 256. The compare work is O(num_bins * N) in
+   BOTH this tier and the fused-XLA tier, so the only measured anchor for the
+   crossover is the +6% at 25 bins; the 256..2048 range keeps the XLA form
+   until experiments/rank_exp.py's tier grid is run on the TPU chip.
 
-Both tiers drop out-of-range and negative indices exactly like the scatter path
+3. **One-hot MXU pair-split** (TPU only): for ``2048 < num_bins <= 2^14`` the
+   bin index splits as ``hi*64 + lo`` and the histogram is the flattened
+   ``onehot(hi)^T @ onehot(lo)`` — the exact kernel shape ops/confmat.py
+   measured at 13x the scatter fallback (1.9-2.3 Gpreds/s at 4096 bins, C=64).
+   This is the tier that makes the rank engine's 2^12-bucket key histograms
+   (ops/rank.py) an O(N) MXU pass instead of a serialized scatter. Weighted
+   form is exact for boolean/small-int weights only (one-hots are bf16; counts
+   accumulate f32 per <=2^19 chunk) — float weights stay on the lower tiers.
+
+All tiers drop out-of-range and negative indices exactly like the scatter path
 (``mode="drop"``): a padded/ignored position simply matches no bin.
 """
 import functools
@@ -28,10 +42,14 @@ import jax.numpy as jnp
 from jax import Array
 
 COMPARE_MAX_BINS = 2048
-PALLAS_MAX_BINS = 64
+PALLAS_MAX_BINS = 256
+PAIRSPLIT_MAX_BINS = 1 << 14
+PAIRSPLIT_MIN_SIZE = 1 << 18
 PALLAS_MIN_SIZE = 1 << 18
 _BLOCK = 1 << 15
 _ROWS = 8
+_BIN_TILE = 64
+_PAIRSPLIT_CHUNK = 1 << 19  # per-chunk f32 count accumulation stays exact
 
 
 _EAGER_COMPARE_BUDGET = 1 << 28  # max bins*N elements materialized per eager chunk
@@ -60,7 +78,7 @@ def _compare_bincount(x: Array, weights: Optional[Array], num_bins: int) -> Arra
     return jnp.concatenate(parts)
 
 
-def _histogram_kernel(num_bins, x_ref, w_ref, o_ref):
+def _histogram_kernel(bin_tile, x_ref, w_ref, o_ref):
     from jax.experimental import pallas as pl
 
     @pl.when(pl.program_id(0) == 0)
@@ -68,8 +86,9 @@ def _histogram_kernel(num_bins, x_ref, w_ref, o_ref):
         o_ref[...] = jnp.zeros_like(o_ref)
 
     mapping = x_ref[0].reshape(1, _BLOCK)
-    bins = jax.lax.broadcasted_iota(jnp.int32, (num_bins, 1), 0)
-    eq = mapping == bins  # (num_bins, BLOCK)
+    # this grid column owns bins [j*bin_tile, (j+1)*bin_tile)
+    bins = jax.lax.broadcasted_iota(jnp.int32, (bin_tile, 1), 0) + pl.program_id(1) * bin_tile
+    eq = mapping == bins  # (bin_tile, BLOCK)
     if w_ref is None:
         hits = eq.astype(o_ref.dtype)
     else:
@@ -80,6 +99,14 @@ def _histogram_kernel(num_bins, x_ref, w_ref, o_ref):
 
 def _pallas_bincount(x: Array, weights: Optional[Array], num_bins: int, interpret: bool = False) -> Array:
     """Tiled compare-reduce histogram on TPU; inputs padded to a block multiple.
+
+    The grid is (input blocks, bin tiles): each column of the grid owns a
+    ``_BIN_TILE``-bin slice of the output (revisited across input blocks), so
+    the bin count is VMEM-unbounded — the 64-bin ceiling of the untiled round-5
+    kernel came from the single output block, not the algorithm. Compare work
+    stays O(num_bins * N) regardless. The innermost grid axis is the bin tile,
+    so consecutive steps revisit the SAME input block against new bins before
+    streaming the next block in.
 
     ``interpret=True`` runs the kernel in the Pallas interpreter (CPU tests).
     """
@@ -94,24 +121,77 @@ def _pallas_bincount(x: Array, weights: Optional[Array], num_bins: int, interpre
             weights = jnp.concatenate([weights, jnp.zeros((pad,), weights.dtype)])
     x2 = x.reshape(-1, _ROWS, _BLOCK // _ROWS)
     grid = x2.shape[0]
-    block_spec = pl.BlockSpec((1, _ROWS, _BLOCK // _ROWS), lambda i: (i, 0, 0))
+    bin_tile = min(num_bins, _BIN_TILE)
+    bins_pad = (-num_bins) % bin_tile
+    n_tiles = (num_bins + bins_pad) // bin_tile
+    block_spec = pl.BlockSpec((1, _ROWS, _BLOCK // _ROWS), lambda i, j: (i, 0, 0))
     out_dtype = jnp.int32 if weights is None else weights.dtype
     if weights is None:
         # weights-free kernel: no ones array, half the streamed bytes
-        kernel = lambda x_ref, o_ref: _histogram_kernel(num_bins, x_ref, None, o_ref)
+        kernel = lambda x_ref, o_ref: _histogram_kernel(bin_tile, x_ref, None, o_ref)
         operands, in_specs = (x2,), [block_spec]
     else:
-        kernel = functools.partial(_histogram_kernel, num_bins)
+        kernel = functools.partial(_histogram_kernel, bin_tile)
         operands, in_specs = (x2, weights.reshape(-1, _ROWS, _BLOCK // _ROWS)), [block_spec, block_spec]
     out = pl.pallas_call(
         kernel,
-        grid=(grid,),
+        grid=(grid, n_tiles),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((num_bins, 1), lambda i: (0, 0)),
-        out_shape=jax.ShapeDtypeStruct((num_bins, 1), out_dtype),
+        out_specs=pl.BlockSpec((bin_tile, 1), lambda i, j: (j, 0)),
+        out_shape=jax.ShapeDtypeStruct((num_bins + bins_pad, 1), out_dtype),
         interpret=interpret,
     )(*operands)
-    return out[:, 0]
+    return out[:num_bins, 0]
+
+
+def _pairsplit_bincount(x: Array, weights: Optional[Array], num_bins: int) -> Array:
+    """One-hot MXU histogram for large bin counts: ``hist[hi*64+lo]`` as the
+    flattened ``onehot(hi)^T @ onehot(lo)`` over <=2^19-element chunks.
+
+    The kernel shape ops/confmat.py measured at 1.9-2.3 Gpreds/s (13x scatter)
+    at 4096 bins: both one-hot factors are >=64 wide so the dot runs on the
+    systolic array, and per-chunk f32 accumulation of 0/1 products stays exact.
+    Out-of-range/negative ids drop via a weight mask (same semantics as the
+    other tiers). Weights must be boolean/small-int (bf16 one-hot carries
+    them exactly only to 256); the dispatch gates float weights away.
+    """
+    c_hi = -(-num_bins // 64)
+    in_range = (x >= 0) & (x < num_bins)
+    w = in_range.astype(jnp.bfloat16) if weights is None else (
+        jnp.where(in_range, weights, 0).astype(jnp.bfloat16)
+    )
+    xc = jnp.where(in_range, x, 0).astype(jnp.int32)
+    n = xc.shape[0]
+    pad = (-n) % _PAIRSPLIT_CHUNK
+    if pad:
+        xc = jnp.concatenate([xc, jnp.zeros((pad,), xc.dtype)])
+        w = jnp.concatenate([w, jnp.zeros((pad,), w.dtype)])
+
+    def chunk_counts(ids, wc):
+        hi_oh = jax.nn.one_hot(ids >> 6, c_hi, dtype=jnp.bfloat16) * wc[:, None]
+        lo_oh = jax.nn.one_hot(ids & 63, 64, dtype=jnp.bfloat16)
+        return jax.lax.dot(hi_oh.T, lo_oh, preferred_element_type=jnp.float32)
+
+    if xc.shape[0] == _PAIRSPLIT_CHUNK:
+        acc = chunk_counts(xc, w)
+    else:
+        acc, _ = jax.lax.scan(
+            lambda a, cw: (a + chunk_counts(*cw), None),
+            jnp.zeros((c_hi, 64), jnp.float32),
+            (xc.reshape(-1, _PAIRSPLIT_CHUNK), w.reshape(-1, _PAIRSPLIT_CHUNK)),
+        )
+    flat = acc.reshape(-1)[:num_bins]
+    return flat.astype(jnp.int32) if weights is None else flat.astype(weights.dtype)
+
+
+def _pairsplit_eligible(x: Array, weights: Optional[Array], num_bins: int) -> bool:
+    int_weights = weights is None or jnp.issubdtype(weights.dtype, jnp.integer) or weights.dtype == jnp.bool_
+    return (
+        COMPARE_MAX_BINS < num_bins <= PAIRSPLIT_MAX_BINS
+        and int_weights
+        and x.size >= PAIRSPLIT_MIN_SIZE
+        and _on_tpu(x)
+    )
 
 
 def _provably_unsharded(x: Array) -> bool:
@@ -169,6 +249,8 @@ def _dispatch(x: Array, weights: Optional[Array], num_bins: int) -> Optional[Arr
         return _pallas_bincount(x.astype(jnp.int32), weights, num_bins)
     if num_bins <= COMPARE_MAX_BINS:
         return _compare_bincount(x, weights, num_bins)
+    if _pairsplit_eligible(x, weights, num_bins):
+        return _pairsplit_bincount(x.astype(jnp.int32), weights, num_bins)
     return None  # caller falls back to scatter
 
 

@@ -1,0 +1,318 @@
+"""Bucketed rank engine: exact rank statistics with the minimum-width sort.
+
+BENCH_r05 pinned the two weakest configs on the same op: the payload-carrying
+``lax.sort`` (exact AUROC 0.172 Gsamples/s with ~125 ms of the ~160 ms cycle in
+the sort at 2^24 rows; retrieval 57.6 Mdocs/s, sort + scans). XLA lowers
+``lax.sort`` on TPU to a bitonic network — ~log2(N)*(log2(N)+1)/2 = 300
+compare-exchange passes at 2^24 — so its cost is ~(passes x operand bytes), and
+the lever is BYTES PER ELEMENT, not the comparison count.
+
+What this module does about it:
+
+1. **Order-preserving key bijection** (:func:`monotone_key_descending`): f32
+   scores map to u32 keys whose UNSIGNED ascending order is exactly descending
+   score order — a total order covering ±inf and denormals, with -0.0
+   canonicalized to +0.0 (IEEE equality makes them one tie run in the f32
+   oracle; one shared key reproduces that) and invalid rows pinned to the -inf
+   key (bit-for-bit the run structure the oracle gets by forcing -inf keys).
+   Integer keys replace XLA's float total-order comparator and open the radix/
+   bucket machinery below.
+
+2. **Reduced-payload sort tier** (:func:`rank_run_end_counts`): the exact
+   AUROC/AP pipeline needs only (key, label∈{neg,pos,invalid}) per row — a
+   (u32, u8) sort, 5 B/element against the oracle's (f32, i32) 8 B/element —
+   and every consumed quantity downstream (run-end cumulative counts, run
+   positions, valid totals) is an INTEGER that depends only on the key multiset
+   and per-run label counts, both invariant to within-run order. The tier
+   therefore reproduces the oracle's ``(fps, tps, sk, boundary)`` bit-for-bit
+   (property-tested in tests/unittests/classification/test_rank_engine.py) and
+   the float tail (trapezoid / AP sums) is SHARED code on identical inputs.
+
+3. **Bucket histograms + exact cross-bucket pair counts**
+   (:func:`class_bucket_counts`, :func:`cross_bucket_pair_stats`): per-bucket
+   positive/negative counts on the top key bits, whose suffix-cumsums give
+   exact cross-bucket pair counts. Why this cannot replace the sort outright:
+   resolving WITHIN-bucket pairs at full f32 resolution needs per-(bucket,
+   sub-digit) joint counts, and the channel count doubles per resolved bit —
+   past ~2^12-2^14 bins every joint-histogram formulation (compare, Pallas,
+   one-hot MXU; see ops/histogram.py tiers) scales past the sort's own cost.
+   Exactness below the bucket floor requires reorganizing the data, i.e. the
+   sort. The histograms therefore serve (a) exact cross-bucket statistics and
+   AUROC bounds for the experiment grid (experiments/rank_exp.py), (b)
+   quantized-score workloads where the key domain genuinely fits the bins.
+
+4. **Sort-slimming helpers** for the other payload-sort users:
+   :func:`ranked_targets` (replaces the ``argsort(-preds)`` + gather pattern in
+   functional/retrieval/* — the documented ~90 ms/16M-element gather trap in
+   ops/segment.py) and :func:`stable_front_pack` (replaces the
+   ``argsort(~mask, stable=True)`` + 3-gather compactions in ops/clf_curve.py).
+
+Dispatch mirrors ops/histogram.py: TPU + provably-unsharded + large-N routes to
+the rank tier; everything else keeps the f32 oracle sort, which stays the
+correctness reference. ``force_tier`` pins a tier for tests/debugging; the
+selection is recorded under obs counters ``rank.dispatch/<tier>`` and wrapped in
+``tm.rank/<tier>`` trace scopes when observability is on (zero-overhead gate).
+
+Cost model (v5e, 2^24 rows, from the measured notes in bench.py/segment.py —
+this round's kernels are laid out against it, bench.py now attributes
+sort-vs-scan time per cycle so BENCH_r06 records the real split):
+oracle sort (f32+i32, 8 B/elem) ~125 ms -> (u32+u8, 5 B/elem) ~ 5/8 of that if
+bandwidth-proportional; cumsum/cummax scans ~15-30 ms each (the tier also drops
+the oracle's two 64 MB key negations); bucket histograms 2-8 ms per pass
+(Pallas/MXU tiers).
+"""
+from contextlib import contextmanager
+from typing import Iterator, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.ops.histogram import _on_tpu, _provably_unsharded, bincount_weighted
+
+#: Below this row count the oracle path wins (dispatch + key-conversion
+#: overheads dominate; the bitonic network is shallow anyway).
+RANK_MIN_SIZE = 1 << 20
+
+_EXP_MASK = jnp.uint32(0x7FFFFFFF)
+_SIGN_BIT = jnp.uint32(0x80000000)
+#: Descending-order key of -inf — also the pinned key for invalid rows, so they
+#: merge into the same terminal run the oracle builds by forcing -inf f32 keys.
+NEG_INF_KEY = jnp.uint32(0xFF800000)
+
+_FORCED_TIER: Optional[str] = None
+
+
+# --------------------------------------------------------------- key bijection
+
+
+def monotone_key_descending(preds: Array, valid: Optional[Array] = None) -> Array:
+    """u32 keys whose unsigned ascending order is descending score order.
+
+    Total order on non-NaN f32: +inf -> 0x007FFFFF, ..., +0 -> 0x7FFFFFFF,
+    ..., -inf -> 0xFF800000. The zero-exponent class — ±0.0 AND ±denormals —
+    collapses to the +0.0 key: XLA's sort comparator flushes denormals to zero
+    on both CPU and TPU (measured here: ``lax.sort`` leaves ``[1e-40, 0.0,
+    1e-40, -0.0]`` interleaved and the f32 boundary check calls them one run),
+    so the f32 oracle treats the whole class as a single tie run and the
+    bijection must reproduce exactly that. The canonicalization runs in INTEGER
+    space (exponent-field test on the raw bits) so it cannot itself be
+    disturbed by flush-to-zero. Rows with ``valid`` False are pinned to
+    ``NEG_INF_KEY`` (the oracle forces their keys to -inf). Inputs are NaN-free
+    by the same contract the reference imposes.
+    """
+    x = preds.astype(jnp.float32)
+    bits = jax.lax.bitcast_convert_type(x, jnp.uint32)
+    # zero exponent field == zero or denormal: one tie class, keyed as +0.0
+    bits = jnp.where((bits & jnp.uint32(0x7F800000)) == 0, jnp.uint32(0), bits)
+    # sign set: key = bits (more-negative floats have bigger magnitudes -> bigger
+    # unsigned bits); sign clear: flip the 31 value bits so bigger floats sort first
+    key = jnp.where(bits >= _SIGN_BIT, bits, bits ^ _EXP_MASK)
+    if valid is not None:
+        key = jnp.where(valid, key, NEG_INF_KEY)
+    return key
+
+
+def key_to_f32_descending(keys: Array) -> Array:
+    """Exact inverse of :func:`monotone_key_descending` (modulo -0 canonicalization)."""
+    bits = jnp.where(keys >= _SIGN_BIT, keys, keys ^ _EXP_MASK)
+    return jax.lax.bitcast_convert_type(bits.astype(jnp.uint32), jnp.float32)
+
+
+# ------------------------------------------------------------------- dispatch
+
+
+@contextmanager
+def force_tier(tier: Optional[str]) -> Iterator[None]:
+    """Pin rank-engine dispatch to ``"rank"``/``"sort"`` (None restores auto).
+
+    Trace-time effect only: callers thread the selected tier into their jitted
+    kernels as a static argument, so a pinned tier forms its own compile key
+    and cannot leak through a stale cache entry.
+    """
+    global _FORCED_TIER
+    if tier not in (None, "rank", "sort"):
+        raise ValueError(f"unknown rank tier: {tier!r}")
+    prev = _FORCED_TIER
+    _FORCED_TIER = tier
+    try:
+        yield
+    finally:
+        _FORCED_TIER = prev
+
+
+def select_tier(x: Array) -> str:
+    """histogram.py-style tier choice: TPU + unsharded + large-N -> "rank".
+
+    Everything else keeps the f32 oracle sort — including sharded inputs (the
+    reduced-payload sort is still a global op) and small batches where the
+    key-conversion passes outweigh the byte savings.
+    """
+    if _FORCED_TIER is not None:
+        return _FORCED_TIER
+    if x.size >= RANK_MIN_SIZE and _on_tpu(x) and _provably_unsharded(x):
+        return "rank"
+    return "sort"
+
+
+def record_dispatch(tier: str, op: str) -> None:
+    """obs counters for which tier served a call; free when obs is disabled."""
+    from metrics_tpu.obs import registry as _reg
+
+    if _reg._ENABLED:
+        _reg.REGISTRY.inc("rank", f"dispatch/{tier}")
+        _reg.REGISTRY.inc("rank", f"op/{op}")
+
+
+def rank_scope(tier: str):
+    """``tm.rank/<tier>`` trace scope (built only when obs is enabled)."""
+    from contextlib import nullcontext
+
+    from metrics_tpu.obs import registry as _reg
+
+    if not _reg._ENABLED:
+        return nullcontext()
+    from metrics_tpu.obs import scopes as _scopes
+
+    return _scopes.annotate(f"tm.rank/{tier}")
+
+
+# ------------------------------------------------------- reduced-payload tier
+
+
+def _suffix_min(x: Array) -> Array:
+    """Minimum over the suffix x[i:] for every i (reverse cumulative min)."""
+    return jnp.flip(jax.lax.cummin(jnp.flip(x)))
+
+
+def rank_run_end_counts(preds: Array, target: Array, valid: Array) -> Tuple[Array, Array, Array, Array]:
+    """Rank-tier construction of ``(fps, tps, sk, boundary)`` — bit-identical to
+    the f32 oracle (ops/clf_curve.py:_run_end_counts).
+
+    Sorts (u32 key, u8 label) — 5 B/element vs the oracle's 8 — with labels
+    encoding {0: negative, 1: positive, 2: invalid}. Every consumed quantity is
+    within-run-order invariant: run boundaries depend on the key multiset alone
+    (identical under the bijection), and ``tps``/``fps`` read only run-END
+    cumulative counts (per-run label totals are multiset properties). The f32
+    ``sk`` is reconstructed through the exact inverse bijection, so downstream
+    float code sees bit-identical inputs.
+    """
+    n = preds.shape[0]
+    key = monotone_key_descending(preds, valid)
+    lab = jnp.where(valid, (target == 1).astype(jnp.uint8), jnp.uint8(2))
+    skey, slab = jax.lax.sort((key, lab), num_keys=1)
+    tps_all = jnp.cumsum((slab == 1).astype(jnp.int32))
+    boundary = jnp.concatenate([skey[1:] != skey[:-1], jnp.ones((1,), bool)])
+    big = jnp.int32(2**31 - 1)
+    pos = jnp.arange(n, dtype=jnp.int32)
+    tps = _suffix_min(jnp.where(boundary, tps_all, big))
+    run_end = _suffix_min(jnp.where(boundary, pos, n - 1))
+    n_valid = jnp.sum((slab != 2).astype(jnp.int32))
+    fps = jnp.minimum(run_end + 1, n_valid) - tps
+    return fps, tps, key_to_f32_descending(skey), boundary
+
+
+# ------------------------------------------------- bucket histogram machinery
+
+
+def bucket_counts(keys: Array, bits: int, weights: Optional[Array] = None) -> Array:
+    """Histogram of the top ``bits`` key bits through the tiered bincount engine.
+
+    2^bits bins; the fastest available tier serves (Pallas <= the tiled
+    ceiling, compare <= 2048, one-hot-MXU pair-split <= 2^14 on TPU, scatter
+    fallback above — ops/histogram.py). Returns int32 (or the weight dtype).
+    """
+    if not 1 <= bits <= 32:
+        raise ValueError(f"bits must be in [1, 32], got {bits}")
+    buckets = (keys >> jnp.uint32(32 - bits)).astype(jnp.int32)
+    num_bins = 1 << bits
+    if weights is not None:
+        out = bincount_weighted(buckets, weights, num_bins)
+    else:
+        from metrics_tpu.ops.histogram import bincount
+
+        out = bincount(buckets, num_bins)
+    if out is None:  # past every tier: scatter fallback, drop semantics
+        w = weights if weights is not None else jnp.ones(buckets.shape, jnp.int32)
+        out = jnp.zeros((num_bins,), w.dtype).at[buckets].add(w, mode="drop")
+    return out
+
+
+def class_bucket_counts(keys: Array, pos_mask: Array, valid: Array, bits: int) -> Tuple[Array, Array]:
+    """(pos_hist, neg_hist) over the top ``bits`` key bits; invalid rows drop out."""
+    pos_w = (pos_mask & valid).astype(jnp.int32)
+    val_w = valid.astype(jnp.int32)
+    pos_hist = bucket_counts(keys, bits, pos_w)
+    all_hist = bucket_counts(keys, bits, val_w)
+    return pos_hist, all_hist - pos_hist
+
+
+def cross_bucket_pair_stats(pos_hist: Array, neg_hist: Array) -> Tuple[Array, Array]:
+    """Exact (cross_gt_pairs, same_bucket_pairs) from per-bucket class counts.
+
+    Keys are DESCENDING-order buckets (lower bucket == higher score), so a
+    positive outscores every negative in a strictly higher bucket:
+    ``cross_gt = sum_b pos[b] * sum_{b' > b} neg[b']``. Accumulated in f32 —
+    pair counts reach N^2 and there is no int64 without x64 mode; the relative
+    error (~1e-7) is documented where these feed bounds, and the EXACT metric
+    path never consumes them (it runs the reduced-payload sort tier).
+    """
+    neg_f = neg_hist.astype(jnp.float32)
+    neg_above = jnp.flip(jnp.cumsum(jnp.flip(neg_f))) - neg_f  # strictly higher buckets
+    pos_f = pos_hist.astype(jnp.float32)
+    return jnp.sum(pos_f * neg_above), jnp.sum(pos_f * neg_f)
+
+
+def bucketed_auroc_bounds(
+    preds: Array, target: Array, valid: Optional[Array] = None, bits: int = 12
+) -> Tuple[Array, Array]:
+    """[lower, upper] AUROC bounds from one histogram pass (no sort).
+
+    The bracket width is the same-bucket opposite-class pair mass over P*N —
+    the pairs the top-``bits`` histogram cannot order. Two useful exactness
+    facts: the bracket collapses only when every bucket is CLASS-pure, while
+    the MIDPOINT ``(lo+hi)/2`` is already the exact AUROC whenever no bucket
+    mixes *distinct* scores (e.g. any <= 2^bits-value quantized domain: the
+    residual same-bucket mass is then true ties, which score exactly 1/2).
+    The exact dispatch path does NOT use this: it exists for the experiment
+    grid (experiments/rank_exp.py) and cheap progress/QA probes on streaming
+    evals.
+    """
+    if valid is None:
+        valid = jnp.ones(preds.shape, bool)
+    keys = monotone_key_descending(preds, valid)
+    pos_hist, neg_hist = class_bucket_counts(keys, target == 1, valid, bits)
+    cross, same = cross_bucket_pair_stats(pos_hist, neg_hist)
+    p = jnp.sum(pos_hist).astype(jnp.float32)
+    q = jnp.sum(neg_hist).astype(jnp.float32)
+    denom = jnp.maximum(p * q, 1.0)
+    both = (p > 0) & (q > 0)
+    lo = jnp.where(both, cross / denom, 0.0)
+    hi = jnp.where(both, (cross + same) / denom, 0.0)
+    return lo, hi
+
+
+# --------------------------------------------------------- sort-slim helpers
+
+
+def ranked_targets(preds: Array, target: Array) -> Array:
+    """``target`` reordered by descending ``preds`` via one payload sort.
+
+    Replaces ``target[jnp.argsort(-preds)]`` — on TPU the argsort+gather form
+    pays ~90 ms per 16M-element gather (ops/segment.py notes) where a
+    payload-carrying sort does the same layout in one op. Stable, matching
+    ``jnp.argsort``'s tie behavior (original order within equal scores).
+    """
+    _, out = jax.lax.sort((-preds, target), num_keys=1, is_stable=True)
+    return out
+
+
+def stable_front_pack(mask: Array, *cols: Array) -> Tuple[Array, ...]:
+    """Rows where ``mask`` is True packed first, order preserved, via one sort.
+
+    Replaces the ``order = argsort(~mask, stable=True)`` + per-column ``take``
+    compaction (one sort + K gathers) with a single (u8 key, K payloads)
+    stable sort.
+    """
+    out = jax.lax.sort(((~mask).astype(jnp.uint8),) + tuple(cols), num_keys=1, is_stable=True)
+    return out[1:]

@@ -29,6 +29,17 @@ Measured design notes (4.2M docs / 65k queries, v5e, device_get-synced p50 —
   the sort at ~45 ms + ~4 linear scans/scatters at ~15-25 ms each; a fused
   one-pass segmented scan would need a hand-written kernel for <2x more).
   Experiment grid: experiments/retrieval_exp.py.
+- **Round 6, the sort's operand bytes** (the bitonic network costs ~passes x
+  bytes, see ops/rank.py): the layout sort now carries (indexes, -preds,
+  target) only — 12 B/row vs the old 20 (sorted keys come out of ``lax.sort``
+  too, so re-carrying indexes/preds as payloads was pure overhead), and ndcg's
+  ideal-layout sort recovers targets by negating its own key (8 vs 12 B/row).
+  A radix PARTITION-by-query replacement for this sort was evaluated and
+  rejected: a materializing partition needs one computed-destination
+  gather/scatter per pass (~90 ms per 16M rows measured, vs ~45 ms for the
+  whole 4M-row payload sort), and a gather-free partition needs exactly the
+  data reorganization the sort already does — grid and verdict in
+  experiments/rank_exp.py.
 """
 
 from typing import Optional, Tuple
@@ -162,9 +173,11 @@ def _scan_retrieval_scores(
     the recursive decomposition takes minutes to compile at this size.)
     """
     n = indexes.shape[0]
-    _, _, s_idx, s_preds, s_target = jax.lax.sort(
-        (indexes, -preds, indexes, preds, target), num_keys=2, is_stable=True
-    )
+    # the sorted KEYS come out of lax.sort too: carrying (indexes, preds) again
+    # as payloads (the round-3 layout) moved 20 B/row through the ~300-pass
+    # bitonic network where 12 B/row suffice — s_idx IS the sorted key column,
+    # and the pred VALUES are never consumed post-ranking (only their order)
+    s_idx, _, s_target = jax.lax.sort((indexes, -preds, target), num_keys=2, is_stable=True)
     new_seg = jnp.concatenate([jnp.ones(1, dtype=bool), s_idx[1:] != s_idx[:-1]])
     is_last = jnp.concatenate([new_seg[1:], jnp.ones(1, dtype=bool)])
     pos = jnp.arange(n)
@@ -218,7 +231,10 @@ def _scan_retrieval_scores(
         t_float = s_target.astype(jnp.float32)
         disc = 1.0 / jnp.log2(rank.astype(jnp.float32) + 1.0)
         cum_dcg = _segment_cumsum_float(jnp.where(in_k, t_float * disc, 0.0), new_seg)
-        _, _, s_t2 = jax.lax.sort((indexes, -target, target), num_keys=2, is_stable=True)
+        # ideal layout: recover the sorted targets by negating the sorted KEY
+        # (sign-flip is an exact involution) instead of carrying them again
+        _, neg_t2 = jax.lax.sort((indexes, -target), num_keys=2, is_stable=True)
+        s_t2 = -neg_t2
         cum_idcg = _segment_cumsum_float(jnp.where(in_k, s_t2.astype(jnp.float32) * disc, 0.0), new_seg)
         idcg = jnp.where(is_last, cum_idcg, 0.0)
         scores = jnp.where(

@@ -338,6 +338,34 @@ _FULL = [n for n in ALL_NAMES if _case_for(n) is not None and n not in SKIPS and
 _EAGER_CONTRACT = [n for n in _FULL if n != "BootStrapper"]
 _GATHERABLE = [n for n in _FULL if n not in ("BootStrapper", "MultioutputWrapper")]
 
+# every exported AUROC/AP class rides the rank-engine dispatch (ops/rank.py)
+# in exact mode; the sweep pins each one to BOTH tiers and demands bit-equality
+_RANK_TIERED = [
+    n for n in _FULL
+    if ("AUROC" in n or "AveragePrecision" in n)
+    and not n.startswith("Retrieval")  # retrieval AP rides ops/segment.py, not clf_curve
+    and n != "MeanAveragePrecision"  # detection mAP: own device kernel, dict output
+]
+
+
+@pytest.mark.parametrize("name", _RANK_TIERED, ids=_RANK_TIERED)
+def test_exact_kernels_agree_across_rank_dispatch_tiers(name):
+    """ISSUE 3 wiring: AUROC/AP metric classes exercise both rank-engine
+    dispatch tiers through the registry-derived class list, so a newly
+    exported AUROC/AP variant is tier-swept automatically."""
+    from metrics_tpu.ops import rank as rank_engine
+
+    kwargs, gen, _ = _case_for(name)
+    cls = getattr(metrics_tpu, name)
+    args = gen()
+    out = {}
+    for tier in ("sort", "rank"):
+        metric = cls(**kwargs)
+        with rank_engine.force_tier(tier):
+            metric.update(*(jnp.asarray(a) if isinstance(a, np.ndarray) else a for a in args))
+            out[tier] = np.asarray(metric.compute())
+    assert np.array_equal(out["sort"], out["rank"], equal_nan=True), name
+
 
 @pytest.mark.parametrize("name", _EAGER_CONTRACT, ids=_EAGER_CONTRACT)
 def test_metric_contract(name):

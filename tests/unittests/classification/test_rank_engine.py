@@ -1,0 +1,296 @@
+"""Bucketed rank engine (ops/rank.py): adversarial bit-parity vs the lax.sort
+oracle, the key bijection's total-order contract, bucket pair-count machinery,
+sort-slimming helpers, and dispatch/obs behavior.
+
+The load-bearing property: for EVERY adversarial input class, the rank tier's
+AUROC/AP must equal the f32 oracle tier BIT-FOR-BIT (``==`` on the f32 result,
+NaN matching NaN) — the tiers share the float tail, so this reduces to the
+integer (fps, tps) construction and the reconstructed sort keys being
+identical, which is asserted directly too.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.ops import clf_curve as cc
+from metrics_tpu.ops import rank
+
+_rng = np.random.RandomState(1234)
+_TINY = np.finfo(np.float32).tiny
+
+
+def _labels(n, p=0.4, seed=None):
+    r = _rng if seed is None else np.random.RandomState(seed)
+    return (r.rand(n) < p).astype(np.int32)
+
+
+# every entry: name -> (preds, target); the suite demands bit-parity on each
+ADVERSARIAL = {
+    "random": (_rng.rand(777).astype(np.float32), _labels(777)),
+    "tie_heavy": ((_rng.randint(0, 5, 1500) / 4.0).astype(np.float32), _labels(1500)),
+    "all_equal": (np.full(300, 0.25, np.float32), _labels(300)),
+    "two_values": (np.where(_rng.rand(512) < 0.5, 0.1, 0.9).astype(np.float32), _labels(512)),
+    "pm_inf": (
+        np.where(_rng.rand(600) < 0.2, np.inf, np.where(_rng.rand(600) < 0.2, -np.inf, _rng.randn(600))).astype(np.float32),
+        _labels(600),
+    ),
+    "denormal": ((_rng.randn(500) * 1e-38).astype(np.float32), _labels(500)),
+    "negative_zero": (
+        np.where(_rng.rand(400) < 0.3, -0.0, np.where(_rng.rand(400) < 0.3, 0.0, _rng.randn(400))).astype(np.float32),
+        _labels(400),
+    ),
+    "all_positive_labels": (_rng.rand(200).astype(np.float32), np.ones(200, np.int32)),
+    "all_negative_labels": (_rng.rand(200).astype(np.float32), np.zeros(200, np.int32)),
+    "extreme_magnitudes": (
+        np.concatenate([[np.finfo(np.float32).max, -np.finfo(np.float32).max, _TINY, -_TINY, 0.0, -0.0],
+                        _rng.randn(250).astype(np.float32) * 1e30]).astype(np.float32),
+        _labels(256),
+    ),
+}
+# ignore_index padding: negative targets are excluded rows
+_pads = _labels(800)
+_pads[_rng.rand(800) < 0.25] = -1
+ADVERSARIAL["ignore_index"] = (_rng.randn(800).astype(np.float32), _pads)
+
+
+def _bitwise_equal(a, b):
+    a, b = np.asarray(a), np.asarray(b)
+    return np.array_equal(a, b, equal_nan=True) and np.array_equal(np.signbit(a), np.signbit(b))
+
+
+# ------------------------------------------------------------- key bijection
+
+
+def test_bijection_is_order_preserving_and_invertible():
+    vals = np.unique(np.concatenate([
+        _rng.randn(2000).astype(np.float32) * np.exp(_rng.randn(2000) * 20).astype(np.float32),
+        np.array([0.0, 1.0, -1.0, np.inf, -np.inf, _TINY, -_TINY,
+                  np.finfo(np.float32).max, -np.finfo(np.float32).max], np.float32),
+    ]))
+    keys = np.asarray(rank.monotone_key_descending(jnp.asarray(vals)))
+    # descending floats -> strictly ascending u32 keys
+    assert (np.diff(keys.astype(np.int64)[np.argsort(-vals)]) > 0).all()
+    inv = np.asarray(rank.key_to_f32_descending(jnp.asarray(keys)))
+    assert _bitwise_equal(inv, vals)
+
+
+def test_bijection_collapses_the_flushed_zero_class():
+    # XLA's sort comparator flushes denormals on CPU and TPU: the oracle treats
+    # {±0, ±denormal} as ONE tie run, so they must share one key (+0.0's)
+    z = np.array([0.0, -0.0, 1e-40, -1e-40, _TINY / 2], np.float32)
+    keys = np.asarray(rank.monotone_key_descending(jnp.asarray(z)))
+    assert (keys == keys[0]).all()
+    inv = np.asarray(rank.key_to_f32_descending(jnp.asarray(keys)))
+    assert (inv == 0.0).all() and not np.signbit(inv).any()
+    # smallest NORMAL stays distinct from the zero class
+    kt = np.asarray(rank.monotone_key_descending(jnp.asarray(np.array([_TINY], np.float32))))
+    assert kt[0] != keys[0]
+
+
+def test_invalid_rows_share_the_neg_inf_run():
+    p = np.array([0.5, -np.inf, 0.1], np.float32)
+    keys = np.asarray(rank.monotone_key_descending(jnp.asarray(p), jnp.asarray([True, True, False])))
+    assert keys[1] == keys[2] == np.uint32(rank.NEG_INF_KEY)
+
+
+# ------------------------------------------------- adversarial tier bit-parity
+
+
+@pytest.mark.parametrize("case", sorted(ADVERSARIAL))
+def test_run_end_counts_bit_identical(case):
+    preds, target = ADVERSARIAL[case]
+    p, t = jnp.asarray(preds), jnp.asarray(target)
+    valid = t >= 0
+    oracle = cc._run_end_counts(p, t, valid, tier="sort")
+    ranked = cc._run_end_counts(p, t, valid, tier="rank")
+    for name, a, b in zip(("fps", "tps", "boundary"), oracle[:2] + oracle[3:], ranked[:2] + ranked[3:]):
+        assert _bitwise_equal(a, b), f"{case}: {name} diverged"
+    # sk: numerically equal everywhere; bitwise equal OUTSIDE the flushed-zero
+    # class, where the rank tier canonicalizes {-0, ±denormal} to +0.0 (this is
+    # exactly why the curve-shaped outputs keep the oracle tier — their
+    # thresholds surface sk to users)
+    sk_o, sk_r = np.asarray(oracle[2]), np.asarray(ranked[2])
+    flushed = np.abs(sk_o) < np.finfo(np.float32).tiny  # ±0 and ±denormals
+    assert _bitwise_equal(sk_o[~flushed], sk_r[~flushed]), f"{case}: sk diverged outside zero class"
+    assert (sk_r[flushed] == 0.0).all() and not np.signbit(sk_r[flushed]).any()
+
+
+@pytest.mark.parametrize("case", sorted(ADVERSARIAL))
+def test_auroc_and_ap_bit_identical(case):
+    preds, target = ADVERSARIAL[case]
+    p, t = jnp.asarray(preds), jnp.asarray(target)
+    with rank.force_tier("sort"):
+        s = (cc.binary_auroc_exact(p, t), cc.binary_average_precision_exact(p, t),
+             cc.binary_auroc_exact(p, t, max_fpr=0.5))
+    with rank.force_tier("rank"):
+        r = (cc.binary_auroc_exact(p, t), cc.binary_average_precision_exact(p, t),
+             cc.binary_auroc_exact(p, t, max_fpr=0.5))
+    for name, a, b in zip(("auroc", "ap", "partial_auroc"), s, r):
+        assert _bitwise_equal(a, b), f"{case}: {name} diverged"
+
+
+def test_multiclass_and_multilabel_tiers_bit_identical():
+    probs = _rng.rand(300, 5).astype(np.float32)
+    tmc = _rng.randint(0, 5, 300).astype(np.int32)
+    tml = _rng.randint(0, 2, (300, 5)).astype(np.int32)
+    for fn, tgt in (
+        (cc.multiclass_auroc_exact, tmc),
+        (cc.multiclass_average_precision_exact, tmc),
+        (cc.multilabel_auroc_exact, tml),
+        (cc.multilabel_average_precision_exact, tml),
+    ):
+        with rank.force_tier("sort"):
+            rs, ws = fn(jnp.asarray(probs), jnp.asarray(tgt))
+        with rank.force_tier("rank"):
+            rr, wr = fn(jnp.asarray(probs), jnp.asarray(tgt))
+        assert _bitwise_equal(rs, rr) and _bitwise_equal(ws, wr), fn.__name__
+
+
+def test_jit_and_vmap_compose_with_the_rank_tier():
+    p = jnp.asarray(_rng.rand(256).astype(np.float32))
+    t = jnp.asarray(_labels(256))
+    f = jax.jit(lambda p, t: cc._binary_auroc_kernel(p, t, t >= 0, None, tier="rank"))
+    g = jax.jit(lambda p, t: cc._binary_auroc_kernel(p, t, t >= 0, None, tier="sort"))
+    assert _bitwise_equal(f(p, t), g(p, t))
+
+
+# ------------------------------------------------------- bucket histogram side
+
+
+def test_bucket_counts_totals_and_reference():
+    preds = _rng.rand(4096).astype(np.float32)
+    keys = rank.monotone_key_descending(jnp.asarray(preds))
+    for bits in (4, 8, 12):
+        h = np.asarray(rank.bucket_counts(keys, bits))
+        assert h.shape == (1 << bits,) and h.sum() == 4096
+        ref = np.bincount(np.asarray(keys) >> (32 - bits), minlength=1 << bits)
+        assert np.array_equal(h, ref)
+
+
+def test_cross_bucket_pair_stats_vs_bruteforce():
+    preds = _rng.rand(200).astype(np.float32)
+    target = _labels(200)
+    keys = rank.monotone_key_descending(jnp.asarray(preds))
+    bits = 6
+    pos_h, neg_h = rank.class_bucket_counts(keys, jnp.asarray(target) == 1, jnp.ones(200, bool), bits)
+    cross, same = rank.cross_bucket_pair_stats(pos_h, neg_h)
+    b = np.asarray(keys) >> (32 - bits)
+    pos_b, neg_b = b[target == 1], b[target == 0]
+    brute_cross = sum(int((neg_b > pb).sum()) for pb in pos_b)  # lower bucket == higher score
+    brute_same = sum(int((neg_b == pb).sum()) for pb in pos_b)
+    assert int(cross) == brute_cross and int(same) == brute_same
+
+
+def test_bucketed_auroc_bounds_bracket_the_exact_value():
+    preds = _rng.rand(8192).astype(np.float32)
+    target = _labels(8192, 0.3)
+    exact = float(cc.binary_auroc_exact(jnp.asarray(preds), jnp.asarray(target)))
+    lo, hi = rank.bucketed_auroc_bounds(jnp.asarray(preds), jnp.asarray(target), bits=12)
+    assert float(lo) - 1e-6 <= exact <= float(hi) + 1e-6
+    # quantized domain: <= 2^bits distinct scores -> the residual same-bucket
+    # mass is pure ties, so the bracket MIDPOINT is the exact AUROC
+    q = (_rng.randint(0, 16, 2048) / 16.0).astype(np.float32)
+    tq = _labels(2048)
+    lo_q, hi_q = rank.bucketed_auroc_bounds(jnp.asarray(q), jnp.asarray(tq), bits=12)
+    exact_q = float(cc.binary_auroc_exact(jnp.asarray(q), jnp.asarray(tq)))
+    assert float(lo_q) - 1e-6 <= exact_q <= float(hi_q) + 1e-6
+    assert abs((float(lo_q) + float(hi_q)) / 2 - exact_q) < 1e-5
+
+
+# ------------------------------------------------------- sort-slim helpers
+
+
+def test_ranked_targets_matches_argsort_gather():
+    for seed in range(3):
+        r = np.random.RandomState(seed)
+        preds = (r.randint(0, 7, 400) / 7.0).astype(np.float32)  # heavy ties
+        target = r.randint(0, 5, 400).astype(np.int32)
+        ref = target[np.argsort(-preds, kind="stable")]
+        got = np.asarray(rank.ranked_targets(jnp.asarray(preds), jnp.asarray(target)))
+        assert np.array_equal(got, ref)
+
+
+def test_stable_front_pack_matches_argsort_take():
+    mask = _rng.rand(500) < 0.4
+    cols = [_rng.rand(500).astype(np.float32) for _ in range(3)]
+    order = np.argsort(~mask, kind="stable")
+    got = rank.stable_front_pack(jnp.asarray(mask), *(jnp.asarray(c) for c in cols))
+    for g, c in zip(got, cols):
+        assert np.array_equal(np.asarray(g), c[order])
+
+
+# ----------------------------------------------------------- dispatch + obs
+
+
+def test_dispatch_defaults_to_oracle_on_cpu_and_force_overrides():
+    x = jnp.zeros((1 << 10,), jnp.float32)
+    assert rank.select_tier(x) == "sort"  # CPU backend: oracle regardless of size
+    with rank.force_tier("rank"):
+        assert rank.select_tier(x) == "rank"
+        with rank.force_tier("sort"):
+            assert rank.select_tier(x) == "sort"
+        assert rank.select_tier(x) == "rank"
+    assert rank.select_tier(x) == "sort"
+    with pytest.raises(ValueError):
+        with rank.force_tier("bogus"):
+            pass
+
+
+def test_dispatch_counters_and_scopes_visible_in_obs():
+    from metrics_tpu import obs
+    from metrics_tpu.obs import export
+
+    p = jnp.asarray(_rng.rand(128).astype(np.float32))
+    t = jnp.asarray(_labels(128))
+    with obs.observe(clear=True) as reg:
+        with rank.force_tier("rank"):
+            cc.binary_auroc_exact(p, t)
+        cc.binary_average_precision_exact(p, t)  # auto -> sort on CPU
+        snap = export.snapshot()
+    assert reg.get("rank", "dispatch/rank") == 1
+    assert reg.get("rank", "dispatch/sort") == 1
+    assert reg.get("rank", "op/binary_auroc") == 1
+    assert snap["registry"]["rank"]["dispatch/rank"] == 1
+    assert snap["registry"]["scopes"]["tm.rank/rank"] == 1
+
+
+def test_disabled_obs_records_nothing():
+    from metrics_tpu.obs import registry as reg
+
+    reg.REGISTRY.clear()
+    with rank.force_tier("rank"):
+        cc.binary_auroc_exact(jnp.asarray(_rng.rand(64).astype(np.float32)), jnp.asarray(_labels(64)))
+    assert reg.REGISTRY.get("rank", "dispatch/rank") == 0
+
+
+# ------------------------------------------------- metric classes x both tiers
+
+
+@pytest.mark.parametrize("cls_name,ctor,args_fn", [
+    ("BinaryAUROC", {}, lambda: (_rng.rand(96).astype(np.float32), _labels(96))),
+    ("BinaryAveragePrecision", {}, lambda: (_rng.rand(96).astype(np.float32), _labels(96))),
+    ("MulticlassAUROC", {"num_classes": 4},
+     lambda: (_rng.rand(96, 4).astype(np.float32), _rng.randint(0, 4, 96).astype(np.int32))),
+    ("MulticlassAveragePrecision", {"num_classes": 4},
+     lambda: (_rng.rand(96, 4).astype(np.float32), _rng.randint(0, 4, 96).astype(np.int32))),
+    ("MultilabelAUROC", {"num_labels": 3},
+     lambda: (_rng.rand(96, 3).astype(np.float32), _rng.randint(0, 2, (96, 3)).astype(np.int32))),
+    ("MultilabelAveragePrecision", {"num_labels": 3},
+     lambda: (_rng.rand(96, 3).astype(np.float32), _rng.randint(0, 2, (96, 3)).astype(np.int32))),
+])
+def test_metric_classes_agree_across_dispatch_tiers(cls_name, ctor, args_fn):
+    """The contract-sweep hook: every AUROC/AP metric class must compute the
+    same value whichever rank-engine tier serves its exact-mode kernel."""
+    import metrics_tpu
+
+    cls = getattr(metrics_tpu, cls_name)
+    args = args_fn()
+    vals = {}
+    for tier in ("sort", "rank"):
+        m = cls(**ctor, validate_args=False)
+        with rank.force_tier(tier):
+            m.update(*(jnp.asarray(a) for a in args))
+            vals[tier] = np.asarray(m.compute())
+    assert _bitwise_equal(vals["sort"], vals["rank"]), cls_name

@@ -99,3 +99,48 @@ def test_bincount_respects_default_device_context():
     with jax.default_device(cpu):
         out = jax.jit(lambda v: _bincount(v, 8))(x)
     assert int(np.asarray(out).sum()) == histogram.PALLAS_MIN_SIZE
+
+
+# ---------------------------------------------- round-6 tier extensions
+
+
+@pytest.mark.parametrize("bins", [64, 100, 128, 256, 1000, 2048])
+def test_pallas_bincount_bin_tiling_matches_oracle(bins):
+    """The output block now tiles over bins (_BIN_TILE columns), so the kernel
+    is no longer capped at the 64 bins one block could hold."""
+    n = histogram._BLOCK + 33
+    x = jnp.asarray(_rng.randint(-3, bins + 5, n).astype(np.int32))
+    got = histogram._pallas_bincount(x, None, bins, interpret=True)
+    assert np.array_equal(np.asarray(got), _oracle(x, np.ones(n), bins))
+    w = jnp.asarray(_rng.rand(n).astype(np.float32))
+    got_w = histogram._pallas_bincount(x, w, bins, interpret=True)
+    assert np.allclose(np.asarray(got_w), _oracle(x, w, bins), atol=1e-2)
+
+
+@pytest.mark.parametrize("bins", [2049, 4096, 10000, histogram.PAIRSPLIT_MAX_BINS])
+def test_pairsplit_bincount_matches_oracle(bins):
+    """One-hot MXU pair-split tier (hi*64+lo split): exact counts incl. drop
+    semantics for out-of-range ids, unweighted and 0/1-weighted."""
+    n = 50_000
+    x = jnp.asarray(_rng.randint(-10, bins + 10, n).astype(np.int32))
+    got = histogram._pairsplit_bincount(x, None, bins)
+    assert np.array_equal(np.asarray(got), _oracle(x, np.ones(n), bins))
+    w = jnp.asarray(_rng.randint(0, 2, n).astype(np.int32))
+    got_w = histogram._pairsplit_bincount(x, w, bins)
+    assert np.array_equal(np.asarray(got_w), _oracle(x, np.asarray(w), bins).astype(np.int64))
+
+
+def test_pairsplit_eligibility_gates():
+    big = histogram.PAIRSPLIT_MIN_SIZE
+    x = jnp.zeros((big,), jnp.int32)
+    fw = jnp.ones((big,), jnp.float32)
+    # float weights are never pair-split eligible (bf16 one-hots carry them inexactly)
+    assert not histogram._pairsplit_eligible(x, fw, 4096)
+    # bin range gates: only past the compare ceiling, up to PAIRSPLIT_MAX_BINS
+    assert not histogram._pairsplit_eligible(x, None, histogram.COMPARE_MAX_BINS)
+    assert not histogram._pairsplit_eligible(x, None, histogram.PAIRSPLIT_MAX_BINS * 2)
+
+
+def test_pallas_max_bins_constant_consistent_with_tiling():
+    # the dispatch ceiling must be a multiple of the bin tile the kernel uses
+    assert histogram.PALLAS_MAX_BINS % histogram._BIN_TILE == 0
