@@ -899,13 +899,58 @@ def bench_ckpt(cat_docs: int = 1 << 22, trials: int = 5) -> dict:
     }
 
 
+def bench_lint(runs: int = 3) -> dict:
+    """``--lint-overhead``: cold tmlint wall time over the full package.
+
+    Each run is a fresh interpreter (``python -m metrics_tpu.analysis
+    metrics_tpu/``) so the number is the true cold cost a CI lint tier or a
+    pre-commit hook pays: interpreter + jax import + metric-registry
+    introspection + AST pass over every module. ``analyze_s`` is the
+    analyzer-internal time (the summary line's own stopwatch) — the gap to the
+    cold number is import cost, which CI pays once regardless. Recorded so the
+    lint tier's cost stays visible as the package (and the jit-reachable
+    function count) grows.
+    """
+    import os
+    import re
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    wall_s, analyze_s, summary = [], [], ""
+    for _ in range(runs):
+        t0 = time.perf_counter()
+        proc = subprocess.run(
+            [sys.executable, "-m", "metrics_tpu.analysis", "metrics_tpu/"],
+            cwd=repo, capture_output=True, text=True, timeout=900,
+        )
+        wall_s.append(time.perf_counter() - t0)
+        if proc.returncode != 0:
+            raise RuntimeError(f"tmlint reported new findings during bench:\n{proc.stdout[-2000:]}")
+        summary = proc.stdout.strip().rsplit("\n", 1)[-1]
+        m = re.search(r"in ([0-9.]+)s", summary)
+        if m:
+            analyze_s.append(float(m.group(1)))
+    return {
+        "metric": "tmlint_cold_wall_s",
+        "value": round(statistics.median(wall_s), 2),
+        "unit": "s",
+        "vs_baseline": None,
+        "analyze_s": round(statistics.median(analyze_s), 2) if analyze_s else None,
+        "summary_line": summary,
+        "bound": "host-only: interpreter+jax import dominates the cold number;"
+                 " the analyzer itself is one AST pass per module plus importing"
+                 " every registered Metric class for the state-contract rules",
+    }
+
+
 if __name__ == "__main__":
     import argparse
 
     parser = argparse.ArgumentParser(description="metrics_tpu benchmarks")
     parser.add_argument(
         "--config",
-        choices=("accuracy", "logits", "confmat", "map", "ssim", "retrieval", "auroc", "fid", "all"),
+        choices=("accuracy", "logits", "confmat", "map", "ssim", "retrieval", "auroc", "fid", "lint", "all"),
         default="all",
     )
     parser.add_argument(
@@ -914,6 +959,14 @@ if __name__ == "__main__":
         help="also run the metrics_tpu.ckpt save/restore bench: p50 save/restore"
         " latency and payload bytes for a scalar-state and a ~48 MB cat-state"
         " metric, reported as a JSON line (not part of the BASELINE configs)",
+    )
+    parser.add_argument(
+        "--lint-overhead",
+        action="store_true",
+        help="also time the tmlint static analyzer cold over the full package"
+        " (metrics_tpu/analysis/): p50 of fresh-interpreter runs, reported as a"
+        " JSON line so analyzer cost stays visible as the package grows (also"
+        " runs under --config all)",
     )
     parser.add_argument(
         "--obs",
@@ -954,10 +1007,13 @@ if __name__ == "__main__":
         ("retrieval", bench_retrieval),
         ("auroc", bench_auroc),
         ("ckpt", bench_ckpt),
+        ("lint", bench_lint),
     ):
         if name == "ckpt" and not cli.ckpt:
             continue
-        if config in (name, "all") or name == "ckpt":
+        if name == "lint" and not (cli.lint_overhead or config in ("lint", "all")):
+            continue
+        if config in (name, "all") or name in ("ckpt", "lint"):
             try:
                 result = fn()
                 summary[result["metric"]] = {

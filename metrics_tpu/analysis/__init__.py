@@ -1,0 +1,56 @@
+"""metrics_tpu.analysis — **tmlint**, a JAX/TPU-aware static analyzer.
+
+The paper's stateful ``Metric`` contract (``add_state``/``update``/``compute``)
+has invariants no Python type checker sees: update/compute bodies must stay
+traceable (no host syncs, no Python branching on traced values, no
+data-dependent shapes), and state may only flow through the registry that
+``ckpt/`` serializes and ``parallel/`` reduces. tmlint checks them statically:
+
+==================  =========================================================
+rule                what it catches
+==================  =========================================================
+TM-HOSTSYNC         ``.item()``/``float()``/numpy calls in jit-reachable code
+TM-PYBRANCH         ``if``/``while``/``assert`` on traced values
+TM-DYNSHAPE         ``jnp.unique``/``nonzero``/bool-mask without ``size=``
+TM-RETRACE          per-call constants into jit (compile-storm hazard)
+TM-STATE-UNREG      ``update`` mutates attrs never passed to ``add_state``
+TM-REDUCE-MISMATCH  ``dist_reduce_fx`` the sync/re-reduce cannot honor
+TM-PERSIST          array state the ckpt serializer silently drops
+==================  =========================================================
+
+Each rule is cross-linked to the ``metrics_tpu.obs`` counter that would fire
+at runtime (``--explain RULE``); trace rules know the jit boundary — decorator,
+``jax.jit`` call sites, the ``Metric._wrap_update`` entry — and the repo's
+``_is_concrete`` guard idiom, so host-side code is not flagged.
+
+CLI::
+
+    python -m metrics_tpu.analysis metrics_tpu/
+    python -m metrics_tpu.analysis --explain TM-RETRACE
+
+CI fails only on findings not waived in ``tmlint_baseline.json``.
+"""
+from metrics_tpu.analysis.baseline import (
+    BASELINE_FILENAME,
+    apply_baseline,
+    default_baseline_path,
+    load_baseline,
+    write_baseline,
+)
+from metrics_tpu.analysis.findings import INTROSPECTION_RULES, RULES, Finding, Rule, explain
+from metrics_tpu.analysis.runner import Report, analyze
+
+__all__ = [
+    "BASELINE_FILENAME",
+    "Finding",
+    "INTROSPECTION_RULES",
+    "RULES",
+    "Report",
+    "Rule",
+    "analyze",
+    "apply_baseline",
+    "default_baseline_path",
+    "explain",
+    "load_baseline",
+    "write_baseline",
+]

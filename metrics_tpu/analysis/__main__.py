@@ -1,0 +1,132 @@
+"""``python -m metrics_tpu.analysis`` — the tmlint CLI.
+
+Usage:
+    python -m metrics_tpu.analysis metrics_tpu/            # lint, baseline-aware
+    python -m metrics_tpu.analysis --explain TM-HOSTSYNC   # rule rationale
+    python -m metrics_tpu.analysis metrics_tpu/ --write-baseline  # bootstrap waivers
+    python -m metrics_tpu.analysis metrics_tpu/ --json     # machine-readable
+
+Exit codes: 0 = clean (or fully baselined), 1 = new findings, 2 = usage error.
+"""
+import argparse
+import json
+import sys
+
+from metrics_tpu.analysis import baseline as baseline_mod
+from metrics_tpu.analysis.findings import RULES, explain
+from metrics_tpu.analysis.runner import analyze
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m metrics_tpu.analysis",
+        description=(
+            "tmlint: JAX/TPU-aware static analysis for trace safety (TM-HOSTSYNC, "
+            "TM-PYBRANCH, TM-DYNSHAPE), the Metric state contract (TM-STATE-UNREG, "
+            "TM-REDUCE-MISMATCH, TM-PERSIST), and retrace hazards (TM-RETRACE). "
+            "Findings are cross-linked to metrics_tpu.obs counter names."
+        ),
+    )
+    parser.add_argument("paths", nargs="*", help="package dirs or files to lint (default: metrics_tpu/)")
+    parser.add_argument("--explain", metavar="RULE", help="print a rule's rationale and obs cross-link, then exit")
+    parser.add_argument("--baseline", metavar="FILE", help="waiver file (default: tmlint_baseline.json at the repo root)")
+    parser.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="write/overwrite the baseline waiving every current finding (bootstrap; edit reasons in afterwards)",
+    )
+    parser.add_argument("--select", metavar="RULES", help="comma-separated rule ids to report (default: all)")
+    parser.add_argument("--json", action="store_true", help="machine-readable report on stdout")
+    parser.add_argument("--no-introspect", action="store_true", help="AST rules only (skip importing the metric registry)")
+    parser.add_argument("-v", "--verbose", action="store_true", help="also list waived findings and skipped classes")
+    args = parser.parse_args(argv)
+
+    if args.explain:
+        rule = args.explain.upper()
+        if rule not in RULES:
+            print(f"unknown rule {args.explain!r}; known: {', '.join(sorted(RULES))}", file=sys.stderr)
+            return 2
+        print(explain(rule))
+        return 0
+
+    paths = args.paths or ["metrics_tpu"]
+    if len(paths) != 1:
+        # one tree per run keeps repo-relative baseline keys unambiguous
+        print("lint exactly one root per run (got: %s)" % ", ".join(paths), file=sys.stderr)
+        return 2
+
+    try:
+        report = analyze(
+            paths[0],
+            baseline_path=args.baseline,
+            introspect=not args.no_introspect,
+        )
+    except FileNotFoundError as err:
+        print(f"tmlint: {err}", file=sys.stderr)
+        return 2
+
+    selected = None
+    if args.select:
+        selected = {r.strip().upper() for r in args.select.split(",")}
+        unknown = selected - set(RULES)
+        if unknown:
+            print(f"unknown rule(s): {', '.join(sorted(unknown))}", file=sys.stderr)
+            return 2
+
+    def keep(f):
+        return selected is None or f.rule in selected
+
+    if args.write_baseline:
+        import os
+
+        from metrics_tpu.analysis.runner import _find_repo_root
+
+        out = args.baseline or os.path.join(_find_repo_root(paths[0]), baseline_mod.BASELINE_FILENAME)
+        n = baseline_mod.write_baseline(
+            out,
+            [f for f in report.findings if keep(f)],
+            reason="bootstrap waiver: pre-existing finding, triage pending",
+        )
+        print(f"tmlint: wrote {n} waivers to {out}")
+        return 0
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "stats": report.stats,
+                    "new": [vars(f) for f in report.new_findings if keep(f)],
+                    "waived": [vars(f) for f in report.waived if keep(f)],
+                    "unused_waivers": [list(k) for k in report.unused_waivers],
+                    "skipped_classes": report.skipped_classes,
+                    "parse_errors": report.parse_errors,
+                },
+                indent=2,
+            )
+        )
+        return 1 if [f for f in report.new_findings if keep(f)] else 0
+
+    new = [f for f in report.new_findings if keep(f)]
+    for f in new:
+        print(f.format())
+    if args.verbose:
+        for f in report.waived:
+            if keep(f):
+                print(f.format() + f"  # reason: {f.waive_reason}")
+        for name, reason in sorted(report.skipped_classes.items()):
+            print(f"# not introspected: {name}: {reason}")
+    for key in report.unused_waivers:
+        print(f"# stale waiver (no matching finding): {':'.join(key)}")
+    for path, err in sorted(report.parse_errors.items()):
+        print(f"# parse error: {path}: {err}")
+    s = report.stats
+    print(
+        f"tmlint: {s['files']} files, {s['functions']} functions "
+        f"({s['jit_reachable']} jit-reachable), {s['findings']} findings "
+        f"({s['waived']} waived, {len(new)} new) in {s['seconds']}s"
+    )
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,213 @@
+"""tmlint rule metadata and the Finding record.
+
+Every rule carries its cross-link to the *runtime* observability layer
+(``metrics_tpu.obs``): a static finding tells you which obs counter (or
+trace-time error) would fire if the flagged line actually executed on the hot
+path. This is the contract the ISSUE calls "each static rule ID cross-linked to
+the runtime counter name" — lint findings and fleet JSONL exports speak the
+same vocabulary, so a ``TM-RETRACE`` finding on ``Foo.update`` and a nonzero
+``Foo.retrace_signatures`` counter in production point at the same bug.
+"""
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class Rule:
+    """One tmlint rule: identity, family, and its runtime cross-link."""
+
+    id: str
+    family: str  # "trace-safety" | "state-contract" | "retrace-hazard"
+    summary: str
+    #: obs counter(s) that fire at runtime for this failure class, with
+    #: ``<M>`` standing for the metric class name; None when the failure
+    #: manifests as a trace-time error instead of a counter.
+    counter: Optional[str]
+    #: what you would see at runtime if the finding is real (error type,
+    #: counter increment, or silent behavior) — printed by ``--explain``.
+    runtime_signal: str
+    rationale: str
+
+
+RULES: Dict[str, Rule] = {
+    r.id: r
+    for r in (
+        Rule(
+            id="TM-HOSTSYNC",
+            family="trace-safety",
+            summary="host synchronization inside a jit-reachable region",
+            counter=None,
+            runtime_signal=(
+                "TracerArrayConversionError / ConcretizationTypeError at trace time, or a "
+                "silent device->host transfer that serializes the TPU pipeline (visible as "
+                "gaps between tm.update/<M> XProf scopes, obs/scopes.py)"
+            ),
+            rationale=(
+                "`.item()`, `.tolist()`, `float()/int()/bool()` on array values, and numpy\n"
+                "calls all force the device to finish and copy data to the host. Inside a\n"
+                "jitted region they either fail at trace time (tracers cannot be\n"
+                "concretized) or — worse, on the eager-but-hot path — silently stall the\n"
+                "accelerator. The paper's Metric contract requires update/compute bodies\n"
+                "to stay traceable; host work belongs behind an `_is_concrete` guard\n"
+                "(metrics_tpu/utils/checks.py), which tmlint recognizes and exempts."
+            ),
+        ),
+        Rule(
+            id="TM-PYBRANCH",
+            family="trace-safety",
+            summary="Python control flow branching on a traced value",
+            counter=None,
+            runtime_signal=(
+                "TracerBoolConversionError at trace time (the runtime check is the "
+                "contract sweep's test_local_update_is_jit_safe)"
+            ),
+            rationale=(
+                "`if`/`while`/`assert` on an expression derived from array values calls\n"
+                "`bool()` on a tracer: under jit this raises, and eagerly it host-syncs\n"
+                "per step. Data-dependent control flow must use `jnp.where`/`lax.cond`,\n"
+                "or sit behind an `_is_concrete` guard so tracing skips it."
+            ),
+        ),
+        Rule(
+            id="TM-DYNSHAPE",
+            family="trace-safety",
+            summary="data-dependent output shape inside a jit-reachable region",
+            counter=None,
+            runtime_signal=(
+                "ConcretizationTypeError / NonConcreteBooleanIndexError at trace time; "
+                "with a concrete fallback, a retrace per distinct data shape "
+                "(jax.compile_events)"
+            ),
+            rationale=(
+                "`jnp.unique`/`nonzero`/`argwhere`/`where(cond)` (single-argument) and\n"
+                "boolean-mask indexing produce shapes that depend on data, which XLA\n"
+                "cannot compile. JAX's escape hatch is the `size=` argument (static\n"
+                "upper bound + fill); the repo-wide alternative is the padded static-\n"
+                "shape kernels in ops/ (e.g. ops/clf_curve.py curve padding)."
+            ),
+        ),
+        Rule(
+            id="TM-RETRACE",
+            family="retrace-hazard",
+            summary="per-call constants flowing into jit (compile storm hazard)",
+            counter="<M>.retraces / <M>.retrace_signatures / jax.compile_events",
+            runtime_signal=(
+                "obs/recompile.py increments `<MetricClass>.retraces` (per instance) and "
+                "`<MetricClass>.retrace_signatures` (per class, fleet JSONL) and warns "
+                "past RETRACE_WARN_THRESHOLD distinct signatures"
+            ),
+            rationale=(
+                "A Python scalar passed to a jitted function participates in the trace as\n"
+                "a fresh constant: every new value compiles a new program (the classic\n"
+                "silent 100x slowdown obs/recompile.py exists to catch at runtime).\n"
+                "Convert per-call scalars with `jnp.asarray`/`jnp.float32` so they become\n"
+                "traced operands, or declare them in `static_argnames` when they are\n"
+                "genuinely few-valued. Building `jax.jit(...)` inside a function body is\n"
+                "the same hazard: each call constructs a fresh wrapper and misses the\n"
+                "C++ dispatch fast path."
+            ),
+        ),
+        Rule(
+            id="TM-STATE-UNREG",
+            family="state-contract",
+            summary="update() mutates an attribute never registered via add_state",
+            counter=None,
+            runtime_signal=(
+                "silent state loss: ckpt round-trip (tests/unittests/ckpt round-trip "
+                "sweep) restores a metric that recomputes from defaults; parallel sync "
+                "never reduces the attr"
+            ),
+            rationale=(
+                "The Metric contract (core/metric.py add_state) is the single registry\n"
+                "that ckpt/ serializes, parallel/ reduces, and reset() restores. An\n"
+                "attribute assigned in update() but never registered rides along eagerly\n"
+                "and then silently disappears on checkpoint restore, never syncs across\n"
+                "hosts, and survives reset() — the RASE/RMSE-SW lazy-init bug class\n"
+                "fixed in PR 2. Register it with add_state, or derive it from registered\n"
+                "state."
+            ),
+        ),
+        Rule(
+            id="TM-REDUCE-MISMATCH",
+            family="state-contract",
+            summary="dist_reduce_fx inconsistent with the state's default/shape",
+            counter="<M>.syncs",
+            runtime_signal=(
+                "wrong values after cross-host sync (parallel/collective.py) or a "
+                "checkpoint topology change (ckpt/restore.py re-reduce refuses or "
+                "mis-reduces the state)"
+            ),
+            rationale=(
+                "The reduction declared at add_state time is what parallel/collective.py\n"
+                "applies on sync and what ckpt/restore.py re-applies when restoring onto\n"
+                "a different host count. A `cat` reduction on a dense array default, a\n"
+                "sum/mean/max/min on a list default, a `mean` over an integer-dtype\n"
+                "state, or a custom callable (which the topology re-reduce cannot\n"
+                "invert) all produce states the rest of the system cannot honor."
+            ),
+        ),
+        Rule(
+            id="TM-PERSIST",
+            family="state-contract",
+            summary="array state the ckpt serializer would silently drop",
+            counter="ckpt.bytes",
+            runtime_signal=(
+                "ckpt.saves succeeds but ckpt.bytes is missing the attr's payload; "
+                "restore_checkpoint validates only registered states, so the drop is "
+                "silent"
+            ),
+            rationale=(
+                "ckpt/serializer.py snapshots exactly the add_state registry. An array-\n"
+                "valued instance attribute outside the registry (and not a constructor\n"
+                "knob named in `_update_signature_attrs`, which is re-derived at\n"
+                "construction, nor a declared `_ckpt_exempt_attrs` entry) holds real\n"
+                "accumulated data that a preemption would lose. Register it, derive it\n"
+                "from registered state, or declare the exemption explicitly."
+            ),
+        ),
+    )
+}
+
+#: Rules that need the import-time introspection pass (vs pure AST).
+INTROSPECTION_RULES: Tuple[str, ...] = ("TM-STATE-UNREG", "TM-REDUCE-MISMATCH", "TM-PERSIST")
+
+
+@dataclass
+class Finding:
+    """One lint finding, anchored to a repo-relative path and symbol.
+
+    Baseline waivers match on ``(rule, path, symbol)`` — deliberately not the
+    line number, so waived findings do not churn when unrelated edits shift
+    lines in the file.
+    """
+
+    rule: str
+    path: str  # repo-relative, posix separators
+    line: int
+    col: int
+    symbol: str  # dotted context: "func", "Class.update", "Class.state_name"
+    message: str
+    waived: bool = False
+    waive_reason: str = ""
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.symbol)
+
+    def format(self) -> str:
+        mark = " (waived)" if self.waived else ""
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} [{self.symbol}] {self.message}{mark}"
+
+
+def explain(rule_id: str) -> str:
+    """Human ``--explain`` text for one rule (raises KeyError on unknown ids)."""
+    r = RULES[rule_id]
+    counter = r.counter if r.counter else "none — fails at trace time instead of counting"
+    return (
+        f"{r.id} ({r.family}): {r.summary}\n"
+        f"\nobs counter: {counter}"
+        f"\nruntime signal: {r.runtime_signal}\n"
+        f"\n{r.rationale}\n"
+        "\nWaiving: add {\"rule\": \"" + r.id + "\", \"path\": \"<repo-relative file>\","
+        " \"symbol\": \"<symbol>\", \"reason\": \"<why this is safe>\"} to"
+        " tmlint_baseline.json (see `python -m metrics_tpu.analysis --help`)."
+    )

@@ -1,0 +1,617 @@
+"""The jit-boundary model: which code is reachable from a traced region.
+
+Host-sync-shaped calls are only bugs when they can execute *under tracing*.
+This module builds, per package, the set of (module, function) pairs reachable
+from a jit entry, where entries are:
+
+1. **decorators** — ``@jax.jit``, ``@partial(jax.jit, ...)``, ``@jax.vmap``,
+   ``@jax.pmap``, ``@shard_map`` (anything that traces its target),
+2. **call sites** — ``jax.jit(f)``, ``jax.vmap(f)``, ``jax.lax.scan(f, ...)``,
+   ``lax.cond/while_loop/fori_loop/switch/associative_scan/map``,
+   ``pl.pallas_call(kernel, ...)``, including targets wrapped in
+   ``functools.partial`` and lambdas,
+3. **the ``Metric._wrap_update`` entry** — every registered ``Metric``
+   subclass's ``update``/``compute`` body (injected by the runner from
+   import-time introspection; classes that declare ``_host_side_update = True``
+   are host code by contract and are not entries),
+4. **jit factories** — a local function whose *parameter* is called inside a
+   jitted inner function (the ``_make_ovr(kernel)`` pattern in
+   ops/clf_curve.py) marks the argument at each call site as an entry.
+
+Reachability then propagates through the call graph — across modules of the
+analyzed package via import resolution — but **only through trace-reachable
+statements**: the repo's concreteness-guard idiom
+(``if not _is_concrete(x): ...`` / ``isinstance(x, jax.core.Tracer)``,
+metrics_tpu/utils/checks.py) partitions a function body into traced and
+host-only regions, and calls made from host-only regions do not propagate.
+This is what lets the exact-mode curve metrics keep their numpy compute path
+(guarded, eager-only) without drowning the lint in false positives.
+"""
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+#: callables that trace their function argument(s)
+_TRACING_WRAPPERS = {"jit", "pjit", "pmap", "vmap", "shard_map", "named_call", "checkpoint", "remat", "grad", "value_and_grad", "custom_jvp", "custom_vjp"}
+#: jax.lax combinators: {name: positions of traced function args (None = all)}
+_TRACING_COMBINATORS = {
+    "scan": (0,),
+    "cond": (1, 2),
+    "while_loop": (0, 1),
+    "fori_loop": (2,),
+    "associative_scan": (0,),
+    "map": (0,),
+    "switch": None,
+    "pallas_call": (0,),
+    "custom_root": None,
+    "custom_linear_solve": None,
+}
+#: functions recognized as concreteness guards (utils/checks.py idiom)
+_CONCRETE_GUARDS = {"_is_concrete", "is_concrete"}
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'jax.lax.scan' for an Attribute/Name chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _terminates(body: Sequence[ast.stmt]) -> bool:
+    """Whether falling out of ``body`` is impossible (ends in return/raise/...)."""
+    if not body:
+        return False
+    last = body[-1]
+    if isinstance(last, (ast.Return, ast.Raise, ast.Break, ast.Continue)):
+        return True
+    if isinstance(last, ast.If):
+        return bool(last.orelse) and _terminates(last.body) and _terminates(last.orelse)
+    return False
+
+
+def tracing_truth(test: ast.expr) -> Optional[bool]:
+    """Value of a guard expression *under tracing*: True/False when decidable.
+
+    ``_is_concrete(...)`` is False under tracing; ``isinstance(x, ...Tracer)``
+    is True. Boolean combinations fold through and/or/not; anything else is
+    None (unknown).
+    """
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        inner = tracing_truth(test.operand)
+        return None if inner is None else (not inner)
+    if isinstance(test, ast.BoolOp):
+        vals = [tracing_truth(v) for v in test.values]
+        if isinstance(test.op, ast.And):
+            if any(v is False for v in vals):
+                return False
+            if all(v is True for v in vals):
+                return True
+        else:  # Or
+            if any(v is True for v in vals):
+                return True
+            if all(v is False for v in vals):
+                return False
+        return None
+    if isinstance(test, ast.Call):
+        name = dotted_name(test.func)
+        if name and name.split(".")[-1] in _CONCRETE_GUARDS:
+            return False
+        if name and name.split(".")[-1] == "isinstance" or (
+            isinstance(test.func, ast.Name) and test.func.id == "isinstance"
+        ):
+            # isinstance(x, jax.core.Tracer) -> True under tracing
+            if len(test.args) == 2:
+                cls = dotted_name(test.args[1])
+                if cls and cls.split(".")[-1] == "Tracer":
+                    return True
+        return None
+    return None
+
+
+def _has_guard(test: ast.expr) -> bool:
+    """Whether the test mentions a concreteness guard at all (then the test
+    expression itself must not be linted: its data-dependent sub-expressions
+    only evaluate on the concrete side of a short-circuit)."""
+    for node in ast.walk(test):
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            if name and name.split(".")[-1] in _CONCRETE_GUARDS:
+                return True
+            if name and name.split(".")[-1] == "isinstance" and len(node.args) == 2:
+                cls = dotted_name(node.args[1])
+                if cls and cls.split(".")[-1] == "Tracer":
+                    return True
+    return False
+
+
+def iter_trace_regions(body: Sequence[ast.stmt], traced: bool = True) -> Iterable[Tuple[ast.stmt, bool, bool]]:
+    """Yield ``(stmt, traced, lint_test)`` for every statement, guard-aware.
+
+    ``traced`` is False for statements only reachable on the concrete (eager)
+    side of a guard. ``lint_test`` is False for If/While statements whose test
+    contains a guard call (the test short-circuits on concreteness and must
+    not be linted). Nested function/class defs are NOT entered — they are
+    separate symbols with their own reachability.
+    """
+    traced_now = traced
+    for stmt in body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            yield stmt, traced_now, True  # callers may want the def site itself
+            continue
+        if isinstance(stmt, ast.If):
+            truth = tracing_truth(stmt.test)
+            yield stmt, traced_now, not _has_guard(stmt.test)
+            if truth is True:
+                # tracing enters the body; orelse is eager-only
+                yield from iter_trace_regions(stmt.body, traced_now)
+                yield from iter_trace_regions(stmt.orelse, False)
+                if _terminates(stmt.body):
+                    traced_now = False  # the rest only runs eagerly
+            elif truth is False:
+                # tracing skips the body
+                yield from iter_trace_regions(stmt.body, False)
+                yield from iter_trace_regions(stmt.orelse, traced_now)
+                if stmt.orelse and _terminates(stmt.orelse):
+                    traced_now = False
+            else:
+                yield from iter_trace_regions(stmt.body, traced_now)
+                yield from iter_trace_regions(stmt.orelse, traced_now)
+            continue
+        yield stmt, traced_now, True
+        for sub in (
+            getattr(stmt, "body", None),
+            getattr(stmt, "orelse", None),
+            getattr(stmt, "finalbody", None),
+        ):
+            if sub:
+                yield from iter_trace_regions(sub, traced_now)
+        for handler in getattr(stmt, "handlers", ()) or ():
+            yield from iter_trace_regions(handler.body, traced_now)
+
+
+@dataclass
+class FuncInfo:
+    qualname: str
+    node: ast.AST  # FunctionDef | Lambda
+    lineno: int
+    cls: Optional[str] = None  # enclosing class name, if a method
+    #: symbols called from trace-reachable statements (resolved in phase B)
+    edges: Set[str] = field(default_factory=set)
+    #: params that escape into a jitted inner region (jit-factory pattern)
+    escaping_params: Set[str] = field(default_factory=set)
+
+
+@dataclass
+class JitAlias:
+    """Module-level ``X = jax.jit(f, static_argnames=...)`` binding."""
+
+    name: str
+    target: Optional[str]  # qualname of the wrapped local function, if known
+    static_argnames: Tuple[str, ...] = ()
+    static_argnums: Tuple[int, ...] = ()
+    lineno: int = 0
+
+
+class ModuleModel:
+    """Per-file AST model: functions, imports, jit entries, call edges."""
+
+    def __init__(self, path: str, modname: str, source: str) -> None:
+        self.path = path
+        self.modname = modname
+        self.tree = ast.parse(source)
+        self.functions: Dict[str, FuncInfo] = {}
+        self.imports: Dict[str, str] = {}  # local name -> "module" | "module:symbol"
+        self.np_aliases: Set[str] = set()
+        self.jnp_aliases: Set[str] = set()
+        self.jit_aliases: Dict[str, JitAlias] = {}
+        self.roots: Dict[str, str] = {}  # qualname -> reason
+        #: local functions whose only call sites are module-level statements
+        #: (setup/factory helpers run once at import; jit-in-body is fine there)
+        self.module_level_only: Set[str] = set()
+        self._collect()
+
+    # ------------------------------------------------------------ phase A
+
+    def _collect(self) -> None:
+        self._walk_scope(self.tree.body, prefix="", cls=None, at_module_level=True)
+        self._detect_factories()
+
+    def _add_function(self, node: ast.AST, qualname: str, cls: Optional[str]) -> FuncInfo:
+        info = FuncInfo(qualname=qualname, node=node, lineno=node.lineno, cls=cls)
+        self.functions[qualname] = info
+        return info
+
+    def _walk_scope(self, body: Sequence[ast.stmt], prefix: str, cls: Optional[str], at_module_level: bool) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.Import, ast.ImportFrom)):
+                self._record_import(stmt)
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = prefix + stmt.name
+                self._add_function(stmt, qual, cls)
+                self._scan_decorators(stmt, qual)
+                self._walk_scope(stmt.body, prefix=qual + ".", cls=cls, at_module_level=False)
+            elif isinstance(stmt, ast.ClassDef):
+                self._walk_scope(stmt.body, prefix=prefix + stmt.name + ".", cls=stmt.name, at_module_level=False)
+            elif at_module_level and isinstance(stmt, ast.Assign):
+                self._scan_module_assign(stmt)
+        if at_module_level:
+            # jit entries referenced from arbitrary module-level expressions
+            for stmt in body:
+                if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                    self._scan_calls_for_entries(stmt)
+
+    def _record_import(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, ast.Import):
+            for alias in stmt.names:
+                local = alias.asname or alias.name.split(".")[0]
+                self.imports[local] = alias.name
+                if alias.name == "numpy" or alias.name.startswith("numpy."):
+                    self.np_aliases.add(local)
+                if alias.name == "jax.numpy":
+                    self.jnp_aliases.add(local)
+        elif isinstance(stmt, ast.ImportFrom) and stmt.module:
+            for alias in stmt.names:
+                local = alias.asname or alias.name
+                if stmt.module == "jax" and alias.name == "numpy":
+                    self.jnp_aliases.add(local)
+                    self.imports[local] = "jax.numpy"
+                    continue
+                if stmt.module == "numpy":
+                    self.np_aliases.add(local)
+                self.imports[local] = f"{stmt.module}:{alias.name}"
+
+    # -- jit entry detection -------------------------------------------------
+
+    def _is_tracing_wrapper(self, func: ast.expr) -> bool:
+        name = dotted_name(func)
+        if not name:
+            return False
+        last = name.split(".")[-1]
+        if last not in _TRACING_WRAPPERS:
+            return False
+        # avoid false-positive on unrelated local symbols named e.g. `map`
+        if "." in name:
+            return True
+        target = self.imports.get(name, "")
+        return target.startswith("jax") or last in {"jit", "pjit", "pmap", "vmap", "shard_map"}
+
+    def _combinator_positions(self, func: ast.expr) -> Optional[Tuple[Optional[Tuple[int, ...]], str]]:
+        name = dotted_name(func)
+        if not name:
+            return None
+        last = name.split(".")[-1]
+        if last in _TRACING_COMBINATORS:
+            return _TRACING_COMBINATORS[last], last
+        return None
+
+    def _mark_entry_expr(self, node: ast.expr, reason: str) -> None:
+        """Mark the function referenced by an expression as a jit entry."""
+        if isinstance(node, ast.Name):
+            for qual, info in self.functions.items():
+                if qual == node.id or qual.endswith("." + node.id):
+                    self.roots.setdefault(qual, reason)
+            return
+        if isinstance(node, ast.Lambda):
+            qual = f"<lambda@{node.lineno}>"
+            if qual not in self.functions:
+                self._add_function(node, qual, None)
+            self.roots.setdefault(qual, reason)
+            return
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            if name and name.split(".")[-1] == "partial":
+                if node.args:
+                    self._mark_entry_expr(node.args[0], reason)
+                return
+            # nested wrapper: jax.jit(jax.vmap(f))
+            if self._is_tracing_wrapper(node.func) and node.args:
+                self._mark_entry_expr(node.args[0], reason)
+        if isinstance(node, ast.Attribute):
+            name = dotted_name(node)
+            if name and name.startswith("self."):
+                # method references: self._kernel passed to vmap
+                for qual in self.functions:
+                    if qual.endswith("." + node.attr):
+                        self.roots.setdefault(qual, "method passed to a tracing wrapper")
+
+    def _scan_decorators(self, node: ast.AST, qual: str) -> None:
+        for dec in getattr(node, "decorator_list", ()):
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            if self._is_tracing_wrapper(target):
+                self.roots.setdefault(qual, f"decorated by a tracing wrapper at line {dec.lineno}")
+            elif isinstance(dec, ast.Call):
+                name = dotted_name(dec.func)
+                if name and name.split(".")[-1] == "partial" and dec.args:
+                    if self._is_tracing_wrapper(dec.args[0]):
+                        self.roots.setdefault(qual, f"decorated @partial(jit) at line {dec.lineno}")
+
+    def _scan_calls_for_entries(self, root: ast.AST) -> None:
+        for node in ast.walk(root):
+            if not isinstance(node, ast.Call):
+                continue
+            if self._is_tracing_wrapper(node.func) and node.args:
+                self._mark_entry_expr(node.args[0], f"passed to a tracing wrapper at line {node.lineno}")
+                continue
+            comb = self._combinator_positions(node.func)
+            if comb is not None:
+                positions, cname = comb
+                args = node.args
+                idxs = range(len(args)) if positions is None else [p for p in positions if p < len(args)]
+                for i in idxs:
+                    self._mark_entry_expr(args[i], f"traced by lax.{cname} at line {node.lineno}")
+
+    def _scan_module_assign(self, stmt: ast.Assign) -> None:
+        """Record ``X = jax.jit(f, static_argnames=(...))`` aliases."""
+        if not (isinstance(stmt.value, ast.Call) and len(stmt.targets) == 1):
+            return
+        target = stmt.targets[0]
+        if not isinstance(target, ast.Name):
+            return
+        call = stmt.value
+        if not self._is_tracing_wrapper(call.func):
+            return
+        static_names: Tuple[str, ...] = ()
+        static_nums: Tuple[int, ...] = ()
+        for kw in call.keywords:
+            if kw.arg in ("static_argnames", "static_argnums"):
+                vals: List = []
+                for el in ast.walk(kw.value):
+                    if isinstance(el, ast.Constant):
+                        vals.append(el.value)
+                if kw.arg == "static_argnames":
+                    static_names = tuple(str(v) for v in vals)
+                else:
+                    static_nums = tuple(v for v in vals if isinstance(v, int))
+        wrapped: Optional[str] = None
+        if call.args:
+            inner = call.args[0]
+            if isinstance(inner, ast.Name) and inner.id in self.functions:
+                wrapped = inner.id
+            elif isinstance(inner, ast.Call):
+                name = dotted_name(inner.func)
+                if name and name.split(".")[-1] == "partial" and inner.args:
+                    first = inner.args[0]
+                    if isinstance(first, ast.Name) and first.id in self.functions:
+                        wrapped = first.id
+        self.jit_aliases[target.id] = JitAlias(
+            name=target.id,
+            target=wrapped,
+            static_argnames=static_names,
+            static_argnums=static_nums,
+            lineno=stmt.lineno,
+        )
+
+    # -- jit factories -------------------------------------------------------
+
+    def _detect_factories(self) -> None:
+        """The ``_make_ovr(kernel)`` pattern: a param called inside a rooted
+        inner function escapes into jit; call-site args at that position become
+        entries. Also classifies which local functions are only ever called
+        from module level (setup helpers — exempt from jit-in-body linting)."""
+        for qual, info in self.functions.items():
+            node = info.node
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            params = [a.arg for a in node.args.args]
+            if not params:
+                continue
+            inner_rooted = [
+                self.functions[q].node
+                for q in self.roots
+                if q.startswith(qual + ".") and q in self.functions
+            ]
+            called: Set[str] = set()
+            for inner in inner_rooted:
+                for sub in ast.walk(inner):
+                    if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name):
+                        called.add(sub.func.id)
+            info.escaping_params = {p for p in params if p in called}
+
+        # classify call sites: a function is a "setup helper" (jit-in-body is
+        # fine — it runs once at import) only when it IS called at module level
+        # and NOT from any function body. Never-called functions are runtime
+        # API surface and stay lintable.
+        called_from_funcs: Set[str] = set()
+        for qual, info in self.functions.items():
+            node = info.node
+            body = node.body if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) else [ast.Expr(node.body)]
+            for stmt in body:
+                for sub in ast.walk(stmt):
+                    if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name):
+                        called_from_funcs.add(sub.func.id)
+        called_at_module: Set[str] = set()
+        for stmt in self.tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name):
+                    called_at_module.add(sub.func.id)
+        for qual in self.functions:
+            base = qual.split(".")[-1]
+            if base in called_at_module and base not in called_from_funcs:
+                self.module_level_only.add(qual)
+
+        # factory call sites at module level
+        for stmt in self.tree.body:
+            for node in ast.walk(stmt):
+                if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)):
+                    continue
+                factory = self.functions.get(node.func.id)
+                if factory is None or not factory.escaping_params:
+                    continue
+                fnode = factory.node
+                if not isinstance(fnode, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                params = [a.arg for a in fnode.args.args]
+                for i, arg in enumerate(node.args):
+                    if i < len(params) and params[i] in factory.escaping_params:
+                        self._mark_entry_expr(arg, f"escapes into jit via factory {factory.qualname} (line {node.lineno})")
+                for kw in node.keywords:
+                    if kw.arg in factory.escaping_params:
+                        self._mark_entry_expr(kw.value, f"escapes into jit via factory {factory.qualname} (line {node.lineno})")
+
+    # ------------------------------------------------------------ edges
+
+    def collect_edges(self) -> None:
+        """Record, per function, the symbols called from trace-reachable code."""
+        for qual, info in self.functions.items():
+            node = info.node
+            if isinstance(node, ast.Lambda):
+                stmts_flags: List[Tuple[ast.AST, bool]] = [(node.body, True)]
+            else:
+                stmts_flags = [
+                    (stmt, traced)
+                    for stmt, traced, _ in iter_trace_regions(node.body)
+                    if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef))
+                ]
+            for stmt, traced in stmts_flags:
+                if not traced:
+                    continue
+                for sub in ast.walk(stmt):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    if isinstance(sub.func, ast.Name):
+                        info.edges.add(sub.func.id)
+                    else:
+                        name = dotted_name(sub.func)
+                        if name:
+                            info.edges.add(name)
+            # entries passed onward as bare references (e.g. vmapped helpers)
+            # are handled by the entry scan; method calls via self:
+            info.edges = {e[5:] if e.startswith("self.") else e for e in info.edges}
+
+
+# ---------------------------------------------------------------- package level
+
+
+class PackageModel:
+    """All ModuleModels of one analyzed tree + cross-module reachability."""
+
+    def __init__(self, files: Dict[str, Tuple[str, str]]) -> None:
+        """``files``: {repo_relative_path: (modname, source)}."""
+        self.modules: Dict[str, ModuleModel] = {}
+        self.errors: Dict[str, str] = {}
+        for path, (modname, source) in files.items():
+            try:
+                self.modules[path] = ModuleModel(path, modname, source)
+            except SyntaxError as err:  # lint must not die on one bad file
+                self.errors[path] = f"SyntaxError: {err}"
+        self.by_modname = {m.modname: m for m in self.modules.values()}
+        for m in self.modules.values():
+            m.collect_edges()
+        #: (path, qualname) -> reason, filled by propagate()
+        self.reachable: Dict[Tuple[str, str], str] = {}
+
+    def inject_roots(self, extra: Dict[str, Dict[str, str]]) -> None:
+        """``{repo_relative_path: {qualname: reason}}`` — introspection entries."""
+        for path, quals in extra.items():
+            module = self.modules.get(path)
+            if module is None:
+                continue
+            for qual, reason in quals.items():
+                if qual in module.functions:
+                    module.roots.setdefault(qual, reason)
+                else:
+                    # tolerate minor qualname drift (nested class etc.): suffix match
+                    for cand in module.functions:
+                        if cand.endswith("." + qual) or cand.split(".", 1)[-1] == qual:
+                            module.roots.setdefault(cand, reason)
+                            break
+
+    def _resolve(self, module: ModuleModel, symbol: str, cls: Optional[str]) -> Optional[Tuple[ModuleModel, str]]:
+        """Resolve a called symbol to (module, qualname) within the package."""
+        # method on the same class
+        if cls is not None and f"{cls}.{symbol}" in module.functions:
+            return module, f"{cls}.{symbol}"
+        if symbol in module.functions:
+            return module, symbol
+        if symbol in module.jit_aliases:
+            target = module.jit_aliases[symbol].target
+            if target and target in module.functions:
+                return module, target
+            return None
+        if "." in symbol:
+            base, _, attr = symbol.partition(".")
+            target_mod = module.imports.get(base)
+            if target_mod:
+                if ":" in target_mod:
+                    # `from metrics_tpu.ops import rank as _rank` records
+                    # "metrics_tpu.ops:rank" — the imported symbol may itself
+                    # be a module of the analyzed package
+                    m, _, nm = target_mod.partition(":")
+                    sub = self.by_modname.get(f"{m}.{nm}")
+                    if sub:
+                        return self._resolve(sub, attr, None)
+                    return None
+                other = self.by_modname.get(target_mod)
+                if other:
+                    return self._resolve(other, attr, None)
+            return None
+        imported = module.imports.get(symbol)
+        if imported and ":" in imported:
+            modname, _, name = imported.partition(":")
+            other = self.by_modname.get(modname)
+            if other:
+                return self._resolve(other, name, None)
+            # `from metrics_tpu.ops import rank` style: symbol is a module
+            sub = self.by_modname.get(f"{modname}.{name}")
+            if sub is not None:
+                return None
+        return None
+
+    def propagate(self) -> None:
+        """BFS the call graph from all entries, trace-reachable edges only."""
+        queue: List[Tuple[ModuleModel, str, str]] = []
+        for module in self.modules.values():
+            for qual, reason in module.roots.items():
+                queue.append((module, qual, reason))
+        while queue:
+            module, qual, reason = queue.pop()
+            key = (module.path, qual)
+            if key in self.reachable:
+                continue
+            self.reachable[key] = reason
+            info = module.functions.get(qual)
+            if info is None:
+                continue
+            for edge in info.edges:
+                resolved = self._resolve(module, edge, info.cls)
+                if resolved is None:
+                    continue
+                tmod, tqual = resolved
+                queue.append((tmod, tqual, f"called from {module.modname}:{qual}"))
+
+    def reachable_functions(self) -> Iterable[Tuple[ModuleModel, FuncInfo, str]]:
+        for (path, qual), reason in sorted(self.reachable.items()):
+            module = self.modules[path]
+            info = module.functions.get(qual)
+            if info is not None:
+                yield module, info, reason
+
+
+def load_package(root: str, repo_root: str) -> Dict[str, Tuple[str, str]]:
+    """Collect ``{repo_relative_path: (modname, source)}`` for a tree or file."""
+    out: Dict[str, Tuple[str, str]] = {}
+    paths: List[str] = []
+    if os.path.isfile(root):
+        paths = [root]
+    else:
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [d for d in dirnames if d not in ("__pycache__",)]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    paths.append(os.path.join(dirpath, fn))
+    for path in paths:
+        rel = os.path.relpath(os.path.abspath(path), repo_root).replace(os.sep, "/")
+        mod = rel[:-3].replace("/", ".")
+        if mod.endswith(".__init__"):
+            mod = mod[: -len(".__init__")]
+        with open(path, "r", encoding="utf-8") as fh:
+            out[rel] = (mod, fh.read())
+    return out

@@ -1,0 +1,175 @@
+"""Metric-class registry for the import-time introspection rules.
+
+Mirrors the contract-sweep discovery (tests/unittests/bases/test_contract_sweep.py):
+every class exported from ``metrics_tpu.__all__`` counts, constructed either by
+a task-family prefix rule or a per-name constructor spec. The sweep's
+exhaustiveness guard and tests/unittests/analysis keep the two tables in sync,
+so a newly exported metric class reaches both the runtime contract tests and
+tmlint's state-contract rules automatically.
+
+Instances are built once per analyzer run; construction failures are recorded
+(not raised) so an optional-dependency metric (pesq wheel, pretrained weights)
+degrades to "not introspected" instead of killing the lint.
+"""
+import inspect
+import warnings
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterator, Optional, Tuple
+
+
+def _flat8_feature(x):
+    """Weight-free stand-in feature extractor for FID/KID/IS construction."""
+    import jax.numpy as jnp
+
+    return jnp.asarray(x, jnp.float32).reshape(x.shape[0], -1)[:, :8]
+
+
+def _ctor_specs() -> Dict[str, Callable[[], Dict[str, Any]]]:
+    """Per-name constructor kwargs (lazy thunks: some need live sub-metrics)."""
+    import metrics_tpu
+
+    def kw(**kwargs):
+        return lambda: kwargs
+
+    specs: Dict[str, Callable[[], Dict[str, Any]]] = {
+        # __new__-routing dispatchers
+        "Accuracy": kw(task="binary"),
+        "AUROC": kw(task="binary"),
+        "AveragePrecision": kw(task="binary"),
+        "CalibrationError": kw(task="binary"),
+        "CohenKappa": kw(task="binary"),
+        "ConfusionMatrix": kw(task="binary"),
+        "ExactMatch": kw(task="multiclass", num_classes=5),
+        "F1Score": kw(task="binary"),
+        "FBetaScore": kw(task="binary", beta=0.5),
+        "HammingDistance": kw(task="binary"),
+        "HingeLoss": kw(task="binary"),
+        "JaccardIndex": kw(task="binary"),
+        "MatthewsCorrCoef": kw(task="binary"),
+        "Precision": kw(task="binary"),
+        "PrecisionRecallCurve": kw(task="binary", thresholds=11),
+        "Recall": kw(task="binary"),
+        "ROC": kw(task="binary", thresholds=11),
+        "Specificity": kw(task="binary"),
+        "StatScores": kw(task="binary"),
+        "RecallAtFixedPrecision": kw(task="binary", min_precision=0.5, thresholds=11),
+        "PrecisionAtFixedRecall": kw(task="binary", min_recall=0.5, thresholds=11),
+        "SpecificityAtSensitivity": kw(task="binary", min_sensitivity=0.5, thresholds=11),
+        # classes whose family prefix is not enough
+        "MinkowskiDistance": kw(p=3),
+        "TweedieDevianceScore": kw(power=1.5),
+        "MultiScaleStructuralSimilarityIndexMeasure": kw(data_range=1.0, betas=(0.5, 0.5), kernel_size=3),
+        "PeakSignalNoiseRatio": kw(data_range=1.0),
+        "PeakSignalNoiseRatioWithBlockedEffect": kw(block_size=4),
+        "RelativeAverageSpectralError": kw(window_size=4),
+        "RootMeanSquaredErrorUsingSlidingWindow": kw(window_size=4),
+        "StructuralSimilarityIndexMeasure": kw(data_range=1.0),
+        "SignalDistortionRatio": kw(filter_length=4, load_diag=1e-4),
+        "PanopticQuality": kw(things={0}, stuffs={1}),
+        "ModifiedPanopticQuality": kw(things={0}, stuffs={1}),
+        "CramersV": kw(num_classes=4),
+        "PearsonsContingencyCoefficient": kw(num_classes=4),
+        "TheilsU": kw(num_classes=4),
+        "TschuprowsT": kw(num_classes=4),
+        "FrechetInceptionDistance": kw(feature=_flat8_feature, num_features=8),
+        "KernelInceptionDistance": kw(feature=_flat8_feature, subset_size=4, subsets=2),
+        "InceptionScore": kw(feature=_flat8_feature),
+        "PermutationInvariantTraining": lambda: {
+            "metric_func": metrics_tpu.functional.audio.scale_invariant_signal_noise_ratio,
+            "eval_func": "max",
+        },
+        # wrappers: need live base metrics
+        "BootStrapper": lambda: {
+            "base_metric": metrics_tpu.MulticlassAccuracy(num_classes=5, average="micro", validate_args=False),
+            "num_bootstraps": 4,
+            "seed": 0,
+        },
+        "MultioutputWrapper": lambda: {
+            "base_metric": metrics_tpu.MeanSquaredError(),
+            "num_outputs": 2,
+            "remove_nans": False,
+        },
+        "ClasswiseWrapper": lambda: {"metric": metrics_tpu.MulticlassAccuracy(num_classes=5, average=None)},
+        "MinMaxMetric": lambda: {"base_metric": metrics_tpu.BinaryAccuracy()},
+        "MetricTracker": lambda: {"metric": metrics_tpu.BinaryAccuracy()},
+    }
+    return specs
+
+
+#: family prefix -> ctor kwargs (matches the contract sweep's FAMILIES)
+FAMILY_KWARGS: Tuple[Tuple[str, Dict[str, Any]], ...] = (
+    ("Binary", {}),
+    ("Multiclass", {"num_classes": 5}),
+    ("Multilabel", {"num_labels": 3}),
+    ("Retrieval", {}),
+)
+
+#: not introspectable here, with reasons (mirrors the sweep's CONSTRUCT_ONLY/SKIPS)
+NOT_INTROSPECTED: Dict[str, str] = {
+    "Metric": "the ABC itself",
+    "CompositionalMetric": "built by operator overloads, not directly",
+    "MetricCollection": "container, not a Metric (its members are introspected individually)",
+    "BERTScore": "needs a pretrained encoder (no network egress)",
+    "InfoLM": "needs a pretrained masked-LM (no network egress)",
+    "CLIPScore": "needs pretrained CLIP (no network egress)",
+    "LearnedPerceptualImagePatchSimilarity": "needs backbone weights (no network egress)",
+    "PerceptualEvaluationSpeechQuality": "delegates to the optional pesq wheel",
+    "ShortTimeObjectiveIntelligibility": "optional DSP dependency pipeline",
+}
+
+
+@dataclass
+class IntrospectedClass:
+    name: str
+    cls: type
+    instance: Optional[Any]  # None when construction failed/skipped
+    skip_reason: str = ""
+
+    @property
+    def host_side(self) -> bool:
+        """Whether the class declares its update/compute bodies host-side by
+        contract (``_host_side_update``, the core/metric.py introspection hook)."""
+        return bool(getattr(self.cls, "_host_side_update", False))
+
+
+def ctor_kwargs_for(name: str) -> Optional[Callable[[], Dict[str, Any]]]:
+    specs = _ctor_specs()
+    if name in specs:
+        return specs[name]
+    for prefix, kwargs in FAMILY_KWARGS:
+        if name.startswith(prefix):
+            return lambda kwargs=kwargs: dict(kwargs)
+    return lambda: {}
+
+
+def iter_metric_classes() -> Iterator[Tuple[str, type]]:
+    """Every class exported at the package root, same walk as the sweep."""
+    import metrics_tpu
+
+    for name in sorted(set(metrics_tpu.__all__)):
+        obj = getattr(metrics_tpu, name, None)
+        if inspect.isclass(obj):
+            yield name, obj
+
+
+def introspect_classes() -> Iterator[IntrospectedClass]:
+    """Construct one instance per exported metric class (best effort)."""
+    from metrics_tpu.core.metric import Metric
+
+    for name, cls in iter_metric_classes():
+        if name in NOT_INTROSPECTED:
+            yield IntrospectedClass(name, cls, None, NOT_INTROSPECTED[name])
+            continue
+        thunk = ctor_kwargs_for(name)
+        try:
+            with warnings.catch_warnings():
+                # root-import deprecation shims etc. are not the lint's business
+                warnings.simplefilter("ignore")
+                instance = cls(**thunk())
+        except Exception as err:  # noqa: BLE001 — lint degrades, never dies, on ctor failure
+            yield IntrospectedClass(name, cls, None, f"construction failed: {type(err).__name__}: {err}")
+            continue
+        if not isinstance(instance, Metric):
+            yield IntrospectedClass(name, cls, None, "dispatcher returned a non-Metric")
+            continue
+        yield IntrospectedClass(name, type(instance), instance)

@@ -1,0 +1,452 @@
+"""AST rules over trace-reachable code: TM-HOSTSYNC, TM-PYBRANCH, TM-DYNSHAPE,
+TM-RETRACE.
+
+These run only on functions the jit-boundary model (jitmap.py) marked
+reachable from a traced region, and only on statements on the traced side of
+the repo's concreteness guards. Precision heuristics:
+
+- a small per-function *static-name* dataflow pass marks locals derived from
+  shapes/lengths/literals (``n = preds.shape[0]``; ``m = _next_pow2(int(n))``)
+  so ``int(n)`` padding arithmetic is not a host sync;
+- parameters annotated with Python scalar types (``int``, ``float``, ``bool``,
+  ``str``, ``Optional[int]`` …) are static;
+- numpy calls are exempt when the callee is a dtype/const helper or every
+  argument is static (``np.prod(shape)``).
+"""
+import ast
+from typing import List, Optional, Set
+
+from metrics_tpu.analysis.findings import Finding
+from metrics_tpu.analysis.jitmap import (
+    FuncInfo,
+    ModuleModel,
+    dotted_name,
+    iter_trace_regions,
+)
+
+#: numpy attributes that produce static/python values (or are type objects)
+_NP_STATIC = {
+    "dtype", "finfo", "iinfo", "result_type", "promote_types", "issubdtype",
+    "ndarray", "generic", "number", "integer", "floating", "complexfloating",
+    "bool_", "int8", "int16", "int32", "int64", "uint8", "uint16", "uint32",
+    "uint64", "float16", "float32", "float64", "longdouble", "complex64",
+    "complex128", "isscalar", "ndim", "shape", "size", "newaxis", "errstate",
+    "RandomState", "random",
+}
+#: jnp attributes whose results are static python values (safe in branch tests)
+_JNP_STATIC = {"issubdtype", "ndim", "isscalar", "result_type", "promote_types", "dtype", "finfo", "iinfo"}
+#: dynamic-output-shape jnp functions needing size=
+_DYNSHAPE_FNS = {
+    "unique", "nonzero", "flatnonzero", "argwhere", "unique_values",
+    "unique_counts", "union1d", "intersect1d", "setdiff1d",
+}
+_SCALAR_ANNOTATIONS = {"int", "float", "bool", "str", "bytes"}
+_HOST_CASTS = {"float", "int", "bool", "complex"}
+_DTYPE_NAMES = {
+    "bool_", "int8", "int16", "int32", "int64", "uint8", "uint16", "uint32",
+    "uint64", "float16", "bfloat16", "float32", "float64", "complex64",
+    "complex128",
+}
+
+
+def _annotation_is_scalar(node: Optional[ast.expr]) -> bool:
+    if node is None:
+        return False
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id in _SCALAR_ANNOTATIONS:
+            return True
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str) and sub.value in _SCALAR_ANNOTATIONS:
+            return True
+    return False
+
+
+class _StaticNames:
+    """Per-function set of names known to hold static (non-traced) values."""
+
+    def __init__(self, func: ast.AST, module: ModuleModel) -> None:
+        self.module = module
+        self.names: Set[str] = set()
+        args = getattr(func, "args", None)
+        if args is not None:
+            for a in list(args.args) + list(args.kwonlyargs) + list(getattr(args, "posonlyargs", [])):
+                if _annotation_is_scalar(a.annotation):
+                    self.names.add(a.arg)
+        # one forward pass: assignments of static expressions create static names
+        for node in ast.walk(func):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target = node.targets[0]
+                if isinstance(target, ast.Name) and self.is_static(node.value):
+                    self.names.add(target.id)
+                elif isinstance(target, ast.Tuple) and self.is_static(node.value):
+                    # e.g. `_, c, h, w = x.shape` — every unpacked name is static
+                    for el in target.elts:
+                        if isinstance(el, ast.Name):
+                            self.names.add(el.id)
+            elif isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Name):
+                if node.value is not None and self.is_static(node.value):
+                    self.names.add(node.target.id)
+            elif isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp, ast.DictComp)):
+                # comprehension vars over a static iterable are static
+                for gen in node.generators:
+                    if self.is_static(gen.iter) and isinstance(gen.target, ast.Name):
+                        self.names.add(gen.target.id)
+
+    def is_static(self, node: ast.expr) -> bool:
+        if isinstance(node, ast.Constant):
+            return True
+        if isinstance(node, ast.Name):
+            return node.id in self.names
+        if isinstance(node, ast.Attribute):
+            # x.shape / x.ndim / x.size / x.dtype are static under jit
+            if node.attr in ("shape", "ndim", "size", "dtype", "itemsize"):
+                return True
+            # np.int32 / jnp.float32 used as dtype arguments are type objects
+            if isinstance(node.value, ast.Name):
+                if node.value.id in self.module.np_aliases:
+                    return node.attr in _NP_STATIC
+                if node.value.id in self.module.jnp_aliases:
+                    return node.attr in _JNP_STATIC or node.attr in _DTYPE_NAMES
+            return False
+        if isinstance(node, ast.Subscript):
+            return self.is_static(node.value)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return all(self.is_static(e) for e in node.elts)
+        if isinstance(node, ast.BinOp):
+            return self.is_static(node.left) and self.is_static(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.is_static(node.operand)
+        if isinstance(node, ast.IfExp):
+            return self.is_static(node.body) and self.is_static(node.orelse)
+        if isinstance(node, ast.Compare):
+            return self.is_static(node.left) and all(self.is_static(c) for c in node.comparators)
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            if name is None:
+                return False
+            last = name.split(".")[-1]
+            if last == "len":
+                return True
+            base = name.split(".")[0]
+            if base in self.module.np_aliases:
+                return last in _NP_STATIC or all(self.is_static(a) for a in node.args)
+            if base in self.module.jnp_aliases:
+                return last in _JNP_STATIC
+            # local helper over static args (e.g. _next_pow2(int(n)))
+            return bool(node.args or node.keywords) and all(
+                self.is_static(a) for a in node.args
+            ) and all(self.is_static(k.value) for k in node.keywords if k.value is not None)
+        return False
+
+
+def _call_kwarg_names(call: ast.Call) -> Set[str]:
+    return {k.arg for k in call.keywords if k.arg}
+
+
+class _RuleVisitor(ast.NodeVisitor):
+    """Expression-level rules for one trace-reachable statement."""
+
+    def __init__(
+        self,
+        module: ModuleModel,
+        symbol: str,
+        statics: _StaticNames,
+        findings: List[Finding],
+        skip_tests: Set[int],
+    ) -> None:
+        self.module = module
+        self.symbol = symbol
+        self.statics = statics
+        self.findings = findings
+        self.skip_tests = skip_tests  # node ids of guard-bearing branch tests
+
+    def _emit(self, rule: str, node: ast.AST, message: str) -> None:
+        self.findings.append(
+            Finding(
+                rule=rule,
+                path=self.module.path,
+                line=getattr(node, "lineno", 0),
+                col=getattr(node, "col_offset", 0),
+                symbol=self.symbol,
+                message=message,
+            )
+        )
+
+    # ------------------------------------------------------- TM-PYBRANCH
+
+    def _test_is_traced(self, test: ast.expr) -> Optional[ast.AST]:
+        """First sub-expression proving the branch test depends on traced data.
+
+        Recursive rather than ``ast.walk``: sub-expressions whose *consumed*
+        value is static — ``jnp.asarray(x).dtype``, ``jnp.issubdtype(...)``,
+        shape attributes — must not count as traced evidence.
+        """
+
+        def probe(node: ast.AST) -> Optional[ast.AST]:
+            if isinstance(node, ast.Attribute) and node.attr in (
+                "shape", "ndim", "size", "dtype", "itemsize",
+            ):
+                return None  # static attribute of whatever it hangs off
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                parts = name.split(".") if name else []
+                if parts and parts[0] in self.module.jnp_aliases:
+                    if parts[-1] in _JNP_STATIC:
+                        return None  # jnp.issubdtype(...) etc. produce host bools
+                    return node
+                if (
+                    parts
+                    and parts[-1] in ("any", "all", "item")
+                    and isinstance(node.func, ast.Attribute)
+                    and not self.statics.is_static(node.func.value)
+                ):
+                    return node
+            for child in ast.iter_child_nodes(node):
+                found = probe(child)
+                if found is not None:
+                    return found
+            return None
+
+        return probe(test)
+
+    def check_branch(self, stmt: ast.stmt) -> bool:
+        """Returns True when the statement's test needs no further linting."""
+        test = getattr(stmt, "test", None)
+        if test is None or id(test) in self.skip_tests:
+            return True  # guard test: exempt, and don't lint its sub-expressions
+        kind = {ast.If: "if", ast.While: "while", ast.Assert: "assert"}[type(stmt)]
+        evidence = self._test_is_traced(test)
+        if evidence is not None:
+            what = dotted_name(getattr(evidence, "func", evidence)) or "array expression"
+            self._emit(
+                "TM-PYBRANCH",
+                stmt,
+                f"`{kind}` branches on a traced value ({what}(...)): bool() on a tracer "
+                "raises under jit; use jnp.where/lax.cond or an `_is_concrete` guard",
+            )
+            return True  # one finding per branch; skip HOSTSYNC echoes in the test
+        return False
+
+    # -------------------------------------------------------------- calls
+
+    def visit_Call(self, node: ast.Call) -> None:
+        self.generic_visit(node)
+        func = node.func
+        name = dotted_name(func)
+
+        # .item() / .tolist() on anything non-static
+        if isinstance(func, ast.Attribute) and func.attr in ("item", "tolist") and not node.args:
+            if not self.statics.is_static(func.value):
+                self._emit(
+                    "TM-HOSTSYNC",
+                    node,
+                    f"`.{func.attr}()` forces a device->host sync inside a jit-reachable region",
+                )
+            return
+
+        # float()/int()/bool() on non-static values
+        if isinstance(func, ast.Name) and func.id in _HOST_CASTS and len(node.args) == 1:
+            if not self.statics.is_static(node.args[0]):
+                self._emit(
+                    "TM-HOSTSYNC",
+                    node,
+                    f"`{func.id}()` on an array value concretizes a tracer (host sync); "
+                    "use jnp casts or mark the operand static",
+                )
+            return
+
+        if name is None:
+            return
+        parts = name.split(".")
+        base, last = parts[0], parts[-1]
+
+        # numpy compute calls
+        if base in self.module.np_aliases and len(parts) >= 2:
+            if last not in _NP_STATIC and not (
+                node.args and all(self.statics.is_static(a) for a in node.args)
+            ):
+                self._emit(
+                    "TM-HOSTSYNC",
+                    node,
+                    f"numpy call `{name}(...)` materializes on host inside a jit-reachable "
+                    "region; use jnp, or guard the host path with `_is_concrete`",
+                )
+            return
+
+        # jax.device_get
+        if last == "device_get":
+            self._emit("TM-HOSTSYNC", node, "`jax.device_get` is an explicit host sync")
+            return
+
+        # dynamic shapes
+        if base in self.module.jnp_aliases and last in _DYNSHAPE_FNS:
+            if "size" not in _call_kwarg_names(node):
+                self._emit(
+                    "TM-DYNSHAPE",
+                    node,
+                    f"`{name}` without `size=` has a data-dependent output shape; pass "
+                    "`size=` (static bound + fill_value) or use a padded ops/ kernel",
+                )
+            return
+        if base in self.module.jnp_aliases and last == "where":
+            if len(node.args) == 1 and not node.keywords:
+                self._emit(
+                    "TM-DYNSHAPE",
+                    node,
+                    "single-argument `jnp.where(cond)` is `nonzero` (data-dependent shape); "
+                    "pass `size=` or use the three-argument select form",
+                )
+            return
+
+    # ------------------------------------------------- boolean-mask indexing
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        self.generic_visit(node)
+        sl = node.slice
+        if isinstance(sl, ast.Compare) and not self.statics.is_static(sl):
+            self._emit(
+                "TM-DYNSHAPE",
+                node,
+                "boolean-mask indexing `x[cond]` has a data-dependent shape under jit; "
+                "use `jnp.where(cond, x, fill)` or a padded kernel",
+            )
+
+
+def run_retrace_rules(module: ModuleModel, info: FuncInfo) -> List[Finding]:
+    """TM-RETRACE: jit wrappers built per call + python scalars into jit aliases.
+
+    Unlike the trace-safety rules, these scan EVERY function: the hazard lives
+    at the host-side call site feeding a jitted callable, which is usually not
+    itself jit-reachable."""
+    findings: List[Finding] = []
+    _check_retrace(module, info, findings)
+    return findings
+
+
+def _check_retrace(
+    module: ModuleModel,
+    info: FuncInfo,
+    findings: List[Finding],
+) -> None:
+    node = info.node
+    is_setup = info.qualname in module.module_level_only
+    fargs = getattr(node, "args", None)
+    scalar_params = {
+        a.arg for a in (fargs.args if fargs is not None else []) if _annotation_is_scalar(a.annotation)
+    }
+
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Call):
+            continue
+
+        # (a) jax.jit(...) constructed inside a function body
+        if module._is_tracing_wrapper(sub.func):
+            name = dotted_name(sub.func) or "jit"
+            if name.split(".")[-1] in ("jit", "pjit") and not is_setup:
+                findings.append(
+                    Finding(
+                        rule="TM-RETRACE",
+                        path=module.path,
+                        line=sub.lineno,
+                        col=sub.col_offset,
+                        symbol=info.qualname,
+                        message=(
+                            f"`{name}(...)` constructed inside `{info.qualname}`: a fresh "
+                            "wrapper per call misses the jit dispatch cache — build it at "
+                            "module scope (obs counter: jax.compile_events)"
+                        ),
+                    )
+                )
+            continue
+
+        # (b) python-scalar params flowing into a known jit alias
+        if not isinstance(sub.func, ast.Name):
+            continue
+        alias = module.jit_aliases.get(sub.func.id)
+        if alias is None:
+            continue
+        target_params: List[str] = []
+        if alias.target and alias.target in module.functions:
+            tnode = module.functions[alias.target].node
+            targs = getattr(tnode, "args", None)
+            if targs is not None:
+                target_params = [a.arg for a in targs.args]
+
+        def _flag(arg_node: ast.expr, param: Optional[str]) -> None:
+            if not isinstance(arg_node, ast.Name) or arg_node.id not in scalar_params:
+                return
+            if param is not None and param in alias.static_argnames:
+                return
+            findings.append(
+                Finding(
+                    rule="TM-RETRACE",
+                    path=module.path,
+                    line=arg_node.lineno,
+                    col=arg_node.col_offset,
+                    symbol=info.qualname,
+                    message=(
+                        f"python scalar `{arg_node.id}` flows into jitted `{alias.name}` as a "
+                        "fresh constant per call: every new value retraces (obs counters: "
+                        "<MetricClass>.retraces / .retrace_signatures, jax.compile_events). "
+                        "Wrap with jnp.asarray or add to static_argnames"
+                    ),
+                )
+            )
+
+        for i, arg in enumerate(sub.args):
+            param = target_params[i] if i < len(target_params) else None
+            if param is None and i in alias.static_argnums:
+                continue
+            _flag(arg, param)
+        for kw in sub.keywords:
+            if kw.arg and kw.arg in alias.static_argnames:
+                continue
+            _flag(kw.value, kw.arg)
+
+
+def run_trace_rules(module: ModuleModel, info: FuncInfo) -> List[Finding]:
+    """All trace-safety + retrace findings for one jit-reachable function."""
+    findings: List[Finding] = []
+    node = info.node
+    statics = _StaticNames(node, module)
+
+    if isinstance(node, ast.Lambda):
+        visitor = _RuleVisitor(module, info.qualname, statics, findings, set())
+        visitor.visit(node.body)
+        return findings
+
+    regions = list(iter_trace_regions(node.body))
+    skip_tests: Set[int] = set()
+    for stmt, _traced, lint_test in regions:
+        if not lint_test:
+            test = getattr(stmt, "test", None)
+            if test is not None:
+                skip_tests.add(id(test))
+
+    visitor = _RuleVisitor(module, info.qualname, statics, findings, skip_tests)
+    for stmt, traced, _lint_test in regions:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            continue  # nested defs are separate symbols (rooted independently)
+        if not traced:
+            continue
+        if isinstance(stmt, (ast.If, ast.While, ast.Assert)):
+            handled = visitor.check_branch(stmt)
+            test = getattr(stmt, "test", None)
+            if not handled and test is not None:
+                visitor.visit(test)
+            if isinstance(stmt, ast.Assert) and stmt.msg is not None:
+                visitor.visit(stmt.msg)
+            continue
+        # visit only this statement's own expressions, not nested blocks
+        # (nested block statements appear as their own region entries)
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                visitor.visit(child)
+
+    seen = set()
+    unique: List[Finding] = []
+    for f in findings:
+        k = (f.rule, f.path, f.line, f.col, f.symbol)
+        if k not in seen:
+            seen.add(k)
+            unique.append(f)
+    return unique

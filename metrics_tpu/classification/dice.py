@@ -27,6 +27,10 @@ class Dice(Metric):
     is_differentiable: bool = False
     higher_is_better: bool = True
     full_state_update: bool = False
+    # documented eager-only: rides the legacy input-format pipeline whose
+    # validations/compaction are data-dependent (NotImplementedError under jit,
+    # see the contract sweep's _EAGER_ONLY); tmlint treats it as host code
+    _host_side_update = True
     plot_lower_bound: float = 0.0
     plot_upper_bound: float = 1.0
 

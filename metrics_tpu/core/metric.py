@@ -103,8 +103,26 @@ class Metric(ABC):
     # ``update`` so MetricCollection can derive compute groups statically at
     # add_metrics time instead of the reference's first-update device data
     # compare (collections.py:210-268; SURVEY §7(2)). None -> the collection
-    # falls back to a conservative full-attribute comparison.
+    # falls back to a conservative full-attribute comparison. tmlint
+    # (metrics_tpu/analysis/) also reads this: attrs named here are ctor knobs
+    # re-derived at construction, so the ckpt serializer not saving them is
+    # correct rather than a TM-PERSIST finding.
     _update_signature_attrs: Optional[Tuple[str, ...]] = None
+    # introspection hooks for tmlint (metrics_tpu/analysis/):
+    # - _host_side_update: this class's update/compute bodies are host code by
+    #   contract (string/dict inputs — text, detection): the trace-safety rules
+    #   do not treat them as jit entries. The state-contract rules still apply.
+    # - _host_side_compute: only the COMPUTE body is host code by contract
+    #   (ragged/data-dependent output — nominal's empty-row dropping, curve-
+    #   valued retrieval): update stays a traced entry, compute does not.
+    # - _ckpt_exempt_attrs: array-valued instance attributes deliberately
+    #   outside the add_state registry (derived caches, ctor-derived constants
+    #   not named in _update_signature_attrs) — suppresses TM-PERSIST /
+    #   TM-STATE-UNREG for the named attrs, with the declaration itself acting
+    #   as the in-code waiver.
+    _host_side_update: bool = False
+    _host_side_compute: bool = False
+    _ckpt_exempt_attrs: Tuple[str, ...] = ()
 
     def __init__(self, **kwargs: Any) -> None:
         self._device = None  # lazy: jax default device

@@ -45,6 +45,10 @@ class IntersectionOverUnion(Metric):
     is_differentiable: bool = False
     higher_is_better: Optional[bool] = True
     full_state_update: bool = True
+    # host-side by contract: update/compute work on python strings/dicts (same
+    # as the reference); tmlint (metrics_tpu/analysis/) treats the bodies as
+    # host code, not jit entries
+    _host_side_update = True
 
     _iou_type: str = "iou"
     _invalid_val: float = 0.0

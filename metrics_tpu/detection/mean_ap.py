@@ -101,6 +101,10 @@ class MeanAveragePrecision(Metric):
     is_differentiable: bool = False
     higher_is_better: Optional[bool] = True
     full_state_update: bool = True
+    # host-side by contract: update/compute work on python strings/dicts (same
+    # as the reference); tmlint (metrics_tpu/analysis/) treats the bodies as
+    # host code, not jit entries
+    _host_side_update = True
     plot_lower_bound: float = 0.0
     plot_upper_bound: float = 1.0
 

@@ -45,6 +45,10 @@ class PanopticQuality(Metric):
     is_differentiable: bool = False
     higher_is_better: bool = True
     full_state_update: bool = False
+    # host-side by contract: update/compute work on python strings/dicts (same
+    # as the reference); tmlint (metrics_tpu/analysis/) treats the bodies as
+    # host code, not jit entries
+    _host_side_update = True
     plot_lower_bound: float = 0.0
     plot_upper_bound: float = 1.0
 

@@ -32,6 +32,7 @@ from metrics_tpu.functional.classification.roc import (
 )
 from metrics_tpu.ops.clf_curve import (
     binary_auroc_exact,
+    mcclish_partial_auc,
     multiclass_auroc_exact,
     multilabel_auroc_exact,
 )
@@ -105,17 +106,11 @@ def _binary_auroc_compute(
     if max_fpr is None or max_fpr == 1:
         return _auc_compute_without_check(fpr, tpr, 1.0)
 
+    # pure-jnp clip+interpolate (shared with the exact device kernel): the old
+    # np.searchsorted path concretized the traced confusion state under jit —
+    # the first true positive tmlint's TM-HOSTSYNC surfaced in this hot path
     max_area = jnp.asarray(max_fpr, dtype=jnp.float32)
-    fpr_np, tpr_np = np.asarray(fpr), np.asarray(tpr)
-    stop = int(np.searchsorted(fpr_np, max_fpr, side="right"))
-    weight = (max_fpr - fpr_np[stop - 1]) / (fpr_np[stop] - fpr_np[stop - 1])
-    interp_tpr = tpr_np[stop - 1] + weight * (tpr_np[stop] - tpr_np[stop - 1])
-    tpr_c = jnp.concatenate([jnp.asarray(tpr_np[:stop]), jnp.asarray([interp_tpr], dtype=jnp.float32)])
-    fpr_c = jnp.concatenate([jnp.asarray(fpr_np[:stop]), max_area.reshape(1)])
-
-    partial_auc = _auc_compute_without_check(fpr_c, tpr_c, 1.0)
-    min_area = 0.5 * max_area**2
-    return 0.5 * (1 + (partial_auc - min_area) / (max_area - min_area))
+    return mcclish_partial_auc(jnp.asarray(fpr), jnp.asarray(tpr), max_area)
 
 
 def binary_auroc(

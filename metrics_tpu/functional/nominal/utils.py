@@ -6,6 +6,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import Array
 
+from metrics_tpu.utils.checks import _is_concrete
 from metrics_tpu.utils.prints import rank_zero_warn
 
 
@@ -83,6 +84,15 @@ def _handle_nan_in_data(
     target = jnp.asarray(target)
     if nan_strategy == "replace":
         return jnp.nan_to_num(preds, nan=nan_replace_value), jnp.nan_to_num(target, nan=nan_replace_value)
+    if not _is_concrete(preds, target):
+        # data-dependent row count: fail at trace time with a usable message
+        # instead of a TracerArrayConversionError from np.isnan (tmlint
+        # TM-HOSTSYNC finding, round 7)
+        raise ValueError(
+            "`nan_strategy='drop'` removes rows by data content and cannot run under"
+            " jit/shard_map; use nan_strategy='replace' or drop NaN rows on host"
+            " before updating."
+        )
     rows_contain_nan = np.logical_or(np.isnan(np.asarray(preds)), np.isnan(np.asarray(target)))
     return preds[~rows_contain_nan], target[~rows_contain_nan]
 

@@ -63,6 +63,9 @@ class KernelInceptionDistance(Metric):
     higher_is_better: bool = False
     is_differentiable: bool = False
     full_state_update: bool = False
+    # compute subsamples with host RNG (torch.randperm reproducibility parity);
+    # tmlint treats compute as host code, update stays traced
+    _host_side_compute = True
 
     def __init__(
         self,

@@ -23,6 +23,9 @@ class TheilsU(Metric):
     """
 
     full_state_update: bool = False
+    # compute drops all-zero confmat rows/cols (ragged, host-side by design,
+    # reference parity); tmlint treats compute as host code, update stays traced
+    _host_side_compute = True
     is_differentiable: bool = False
     higher_is_better: bool = True
     plot_lower_bound: float = 0.0

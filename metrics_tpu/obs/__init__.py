@@ -29,7 +29,12 @@ from metrics_tpu.obs.registry import (
 from metrics_tpu.obs import recompile, registry
 from metrics_tpu.obs.export import dump_jsonl
 from metrics_tpu.obs.export import snapshot as export_snapshot
-from metrics_tpu.obs.recompile import RETRACE_WARN_THRESHOLD, fingerprint, reset_detector
+from metrics_tpu.obs.recompile import (
+    RETRACE_WARN_THRESHOLD,
+    fingerprint,
+    reset_class_detector,
+    reset_detector,
+)
 from metrics_tpu.obs.report import collection_summary, metric_state_report
 from metrics_tpu.obs.scopes import (
     annotate,
@@ -64,6 +69,7 @@ __all__ = [
     "observe",
     "recompile",
     "registry",
+    "reset_class_detector",
     "reset_detector",
     "snapshot",
     "snapshot_json",

@@ -23,6 +23,15 @@ from metrics_tpu.obs import registry as _reg
 #: Distinct input fingerprints at which a metric is declared "storming".
 RETRACE_WARN_THRESHOLD = 2
 
+#: Distinct fingerprints seen per metric CLASS across all instances. The
+#: per-instance dedup below means a fleet of N instances each seeing the same
+#: two signatures records N `retraces` but tells you nothing about signature
+#: churn at the class level; the `retrace_signatures` counter (one increment
+#: per signature beyond the first, class-wide) is what the JSONL export
+#: attributes to a class — matching the class-level rule IDs tmlint emits
+#: (metrics_tpu/analysis/, TM-RETRACE).
+_CLASS_FINGERPRINTS: dict = {}
+
 
 def _fingerprint_leaf(x: Any) -> Tuple:
     shape = getattr(x, "shape", None)
@@ -65,6 +74,14 @@ def check_update(metric: Any, args: Tuple, kwargs: dict) -> None:
     first = not seen
     seen.add(fp)
     name = type(metric).__name__
+    # class-level aggregation rides every instance-level miss (set-union cost
+    # only on new-signature events, never on the steady-state early return)
+    class_seen = _CLASS_FINGERPRINTS.setdefault(name, set())
+    class_first = not class_seen
+    if fp not in class_seen:
+        class_seen.add(fp)
+        if not class_first:
+            _reg.REGISTRY.inc(name, "retrace_signatures")
     if not first:
         _reg.REGISTRY.inc(name, "retraces")
     if len(seen) > RETRACE_WARN_THRESHOLD and not metric.__dict__.get("_obs_retrace_warned", False):
@@ -95,6 +112,18 @@ def reset_detector(metric: Any) -> None:
     """Forget a metric's fingerprint history (used by tests)."""
     metric.__dict__.pop("_obs_fingerprints", None)
     metric.__dict__.pop("_obs_retrace_warned", None)
+
+
+def reset_class_detector(name: Any = None) -> None:
+    """Forget class-level fingerprint history — all classes, or one class /
+    metric class object (used by tests and long-lived eval loops that rotate
+    workloads)."""
+    if name is None:
+        _CLASS_FINGERPRINTS.clear()
+        return
+    if isinstance(name, type):
+        name = name.__name__
+    _CLASS_FINGERPRINTS.pop(name, None)
 
 
 def nbytes_of(x: Any) -> int:

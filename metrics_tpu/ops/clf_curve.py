@@ -108,6 +108,31 @@ def _trapz(y: Array, x: Array) -> Array:
     return jnp.sum(jnp.diff(x) * (y[1:] + y[:-1]) * 0.5)
 
 
+def mcclish_partial_auc(fpr: Array, tpr: Array, max_fpr: Array) -> Array:
+    """McClish-standardized partial AUC of an ascending-``fpr`` ROC curve, pure jnp.
+
+    Clips the curve at ``fpr == max_fpr``, interpolating ``tpr`` on the crossing
+    segment (points past the clip collapse to zero-width segments, which add
+    exactly 0.0 under trapezoidal integration), then applies the McClish
+    correction (identity at ``max_fpr == 1``). Shared by the exact device
+    kernel below and the binned path in ``functional/classification/auroc.py``
+    — the binned path used host ``np.searchsorted`` before round 7, which
+    concretized traced confusion state (tmlint TM-HOSTSYNC).
+    """
+    m = fpr.shape[0] - 1
+    stop = jnp.searchsorted(fpr, max_fpr, side="right")
+    lo = jnp.clip(stop - 1, 0, m)
+    hi = jnp.clip(stop, 0, m)
+    denom = fpr[hi] - fpr[lo]
+    w = jnp.where(denom > 0, (max_fpr - fpr[lo]) / jnp.where(denom > 0, denom, 1.0), 0.0)
+    interp = tpr[lo] + w * (tpr[hi] - tpr[lo])
+    xc = jnp.minimum(fpr, max_fpr)
+    yc = jnp.where(fpr > max_fpr, interp, tpr)
+    partial_auc = _trapz(yc, xc)
+    min_area = 0.5 * max_fpr**2
+    return 0.5 * (1 + (partial_auc - min_area) / (max_fpr - min_area))
+
+
 def _binary_auroc_kernel(
     preds: Array, target: Array, valid: Array, max_fpr: Optional[Array], tier: str = "sort"
 ) -> Array:
@@ -118,20 +143,7 @@ def _binary_auroc_kernel(
     if max_fpr is None:
         area = _trapz(tpr0, fpr0)
     else:
-        # clip the curve at fpr == max_fpr, interpolating tpr on the crossing
-        # segment, then apply the McClish correction (identity at max_fpr == 1)
-        m = fpr0.shape[0] - 1
-        stop = jnp.searchsorted(fpr0, max_fpr, side="right")
-        lo = jnp.clip(stop - 1, 0, m)
-        hi = jnp.clip(stop, 0, m)
-        denom = fpr0[hi] - fpr0[lo]
-        w = jnp.where(denom > 0, (max_fpr - fpr0[lo]) / jnp.where(denom > 0, denom, 1.0), 0.0)
-        interp = tpr0[lo] + w * (tpr0[hi] - tpr0[lo])
-        xc = jnp.minimum(fpr0, max_fpr)
-        yc = jnp.where(fpr0 > max_fpr, interp, tpr0)
-        partial_auc = _trapz(yc, xc)
-        min_area = 0.5 * max_fpr**2
-        area = 0.5 * (1 + (partial_auc - min_area) / (max_fpr - min_area))
+        area = mcclish_partial_auc(fpr0, tpr0, max_fpr)
         # single-class data has no meaningful partial AUC (the McClish formula on a
         # zeroed curve fabricates a constant; the reference IndexErrors here) -> NaN
         return jnp.where((pos > 0) & (neg > 0), area, jnp.nan)

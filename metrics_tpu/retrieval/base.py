@@ -20,7 +20,7 @@ from jax import Array
 
 from metrics_tpu.core.metric import Metric
 from metrics_tpu.ops.segment import grouped_retrieval_scores
-from metrics_tpu.utils.checks import _check_retrieval_inputs
+from metrics_tpu.utils.checks import _check_retrieval_inputs, _is_concrete
 from metrics_tpu.utils.data import _next_pow2, dim_zero_cat
 
 
@@ -105,9 +105,13 @@ class RetrievalMetric(Metric, ABC):
             total = jnp.where(valid, scores, 0.0).sum()
             return jnp.where(n_keep > 0, total / jnp.maximum(n_keep, 1), 0.0).astype(jnp.float32)
 
-        if isinstance(self.indexes, CatBuffer) and _next_pow2(
-            max(int(self.indexes.valid_count()), 2)
-        ) >= self.indexes.capacity:
+        if isinstance(self.indexes, CatBuffer) and (
+            # under a trace the count is a tracer and trimming is data-dependent
+            # anyway, so the dense buffer path is the only static-shape option
+            # (int(tracer) here was a tmlint TM-HOSTSYNC true positive, round 7)
+            not _is_concrete(self.indexes.count)
+            or _next_pow2(max(int(self.indexes.valid_count()), 2)) >= self.indexes.capacity
+        ):
             # a (near-)full buffer is ALREADY the dense padded form the kernel
             # wants: unwritten/front-packed tail rows carry index fill -1 (an
             # invalid query group). Feeding buffer data directly skips the eager

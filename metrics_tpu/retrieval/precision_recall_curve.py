@@ -65,6 +65,9 @@ class RetrievalPrecisionRecallCurve(Metric):
     is_differentiable: bool = False
     higher_is_better: bool = True
     full_state_update: bool = False
+    # curve-valued compute: per-query top-k curves are ragged and assembled on
+    # host (reference parity); tmlint treats compute as host code
+    _host_side_compute = True
 
     def __init__(
         self,

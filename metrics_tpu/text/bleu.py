@@ -28,6 +28,10 @@ class BLEUScore(Metric):
     is_differentiable = False
     higher_is_better = True
     full_state_update = True
+    # host-side by contract: update/compute work on python strings/dicts (same
+    # as the reference); tmlint (metrics_tpu/analysis/) treats the bodies as
+    # host code, not jit entries
+    _host_side_update = True
     plot_lower_bound = 0.0
     plot_upper_bound = 1.0
 

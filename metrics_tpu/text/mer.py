@@ -23,6 +23,10 @@ class MatchErrorRate(Metric):
     is_differentiable = False
     higher_is_better = False
     full_state_update = False
+    # host-side by contract: update/compute work on python strings/dicts (same
+    # as the reference); tmlint (metrics_tpu/analysis/) treats the bodies as
+    # host code, not jit entries
+    _host_side_update = True
     plot_lower_bound = 0.0
     plot_upper_bound = 1.0
 

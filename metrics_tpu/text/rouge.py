@@ -36,6 +36,10 @@ class ROUGEScore(Metric):
     is_differentiable = False
     higher_is_better = True
     full_state_update = True
+    # host-side by contract: update/compute work on python strings/dicts (same
+    # as the reference); tmlint (metrics_tpu/analysis/) treats the bodies as
+    # host code, not jit entries
+    _host_side_update = True
 
     def __init__(
         self,

@@ -16,6 +16,7 @@ import numpy as np
 from jax import Array
 
 from metrics_tpu.core.metric import Metric
+from metrics_tpu.utils.checks import _is_concrete
 from metrics_tpu.utils.data import apply_to_collection
 
 
@@ -82,6 +83,14 @@ class MultioutputWrapper(Metric):
                     v for v in selected_kwargs.values() if isinstance(v, array_types)
                 ]
                 if tensors:
+                    if not _is_concrete(*tensors):
+                        # row filtering is data-dependent-shape: fail with a
+                        # usable message instead of a tracer conversion error
+                        raise ValueError(
+                            "MultioutputWrapper(remove_nans=True) filters rows by NaN"
+                            " content and cannot run under jit/shard_map; use"
+                            " remove_nans=False or filter rows on host first."
+                        )
                     nan_idxs = np.asarray(_get_nan_indices(*tensors))
                     if nan_idxs.any():
                         selected_args = tuple(np.asarray(a)[~nan_idxs] for a in selected_args)

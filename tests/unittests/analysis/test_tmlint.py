@@ -1,0 +1,488 @@
+"""tmlint (metrics_tpu/analysis/): per-rule fixtures and the repo-wide guard.
+
+Every shipped rule has one known-bad snippet (asserting the exact rule ID and
+line) and one known-clean snippet (asserting silence — the clean twin encodes
+the jit-boundary/guard model the rule must respect). The repo-wide test runs
+the analyzer over the whole package against the checked-in baseline: a new
+finding anywhere in metrics_tpu/ fails CI here.
+"""
+import importlib.util
+import json
+import pathlib
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+import metrics_tpu
+from metrics_tpu.analysis import BASELINE_FILENAME, RULES, analyze, explain
+from metrics_tpu.analysis.contract import class_findings
+from metrics_tpu.analysis.registry import IntrospectedClass
+
+pytestmark = pytest.mark.lint
+
+REPO_ROOT = pathlib.Path(metrics_tpu.__file__).resolve().parent.parent
+
+
+def _lint_snippet(tmp_path, source, introspect=False):
+    path = tmp_path / "mod.py"
+    path.write_text(textwrap.dedent(source))
+    report = analyze(str(path), introspect=introspect, repo_root=str(tmp_path))
+    return report.new_findings
+
+
+def _rules_and_lines(findings):
+    return sorted((f.rule, f.line) for f in findings)
+
+
+# --------------------------------------------------------------- TM-HOSTSYNC
+
+
+def test_hostsync_bad(tmp_path):
+    findings = _lint_snippet(
+        tmp_path,
+        """
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        @jax.jit
+        def kernel(x):
+            total = x.sum().item()
+            arr = np.asarray(x)
+            return jnp.asarray(total) + arr.sum()
+        """,
+    )
+    assert ("TM-HOSTSYNC", 8) in _rules_and_lines(findings)  # .item()
+    assert ("TM-HOSTSYNC", 9) in _rules_and_lines(findings)  # np.asarray
+    assert all(f.rule == "TM-HOSTSYNC" for f in findings)
+
+
+def test_hostsync_clean_guarded_and_static(tmp_path):
+    """Concreteness guards and shape-derived statics must not be flagged."""
+    findings = _lint_snippet(
+        tmp_path,
+        """
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from metrics_tpu.utils.checks import _is_concrete
+
+        @jax.jit
+        def kernel(x):
+            n = x.shape[0]
+            m = int(n) * 2                      # static shape arithmetic
+            pad = np.zeros(3, np.float32)       # static-arg numpy constant
+            if _is_concrete(x):
+                host = float(x.sum())           # eager-only side of the guard
+                return jnp.asarray(host + m)
+            return x.sum() + m + pad.sum()
+        """,
+    )
+    assert findings == []
+
+
+# --------------------------------------------------------------- TM-PYBRANCH
+
+
+def test_pybranch_bad(tmp_path):
+    findings = _lint_snippet(
+        tmp_path,
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def kernel(x):
+            if jnp.any(x > 0):
+                return x.sum()
+            return -x.sum()
+        """,
+    )
+    assert _rules_and_lines(findings) == [("TM-PYBRANCH", 7)]
+
+
+def test_pybranch_clean_static_tests(tmp_path):
+    """Dtype checks and guarded data branches are not python branching bugs."""
+    findings = _lint_snippet(
+        tmp_path,
+        """
+        import jax
+        import jax.numpy as jnp
+        from metrics_tpu.utils.checks import _is_concrete
+
+        @jax.jit
+        def kernel(x, flag: bool):
+            if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating):
+                x = x * 2
+            if flag:
+                x = x + 1
+            if _is_concrete(x) and bool(jnp.any(x > 100)):
+                raise ValueError("overflow")
+            return x.sum()
+        """,
+    )
+    assert findings == []
+
+
+# --------------------------------------------------------------- TM-DYNSHAPE
+
+
+def test_dynshape_bad(tmp_path):
+    findings = _lint_snippet(
+        tmp_path,
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def kernel(x):
+            u = jnp.unique(x)
+            pos = x[x > 0]
+            return u.sum() + pos.sum()
+        """,
+    )
+    assert ("TM-DYNSHAPE", 7) in _rules_and_lines(findings)  # unique without size=
+    assert ("TM-DYNSHAPE", 8) in _rules_and_lines(findings)  # boolean mask
+    assert all(f.rule == "TM-DYNSHAPE" for f in findings)
+
+
+def test_dynshape_clean_with_size(tmp_path):
+    findings = _lint_snippet(
+        tmp_path,
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def kernel(x):
+            u = jnp.unique(x, size=16, fill_value=0)
+            pos = jnp.where(x > 0, x, 0.0)
+            return u.sum() + pos.sum()
+        """,
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------------- TM-RETRACE
+
+
+def test_retrace_bad(tmp_path):
+    findings = _lint_snippet(
+        tmp_path,
+        """
+        import jax
+        import jax.numpy as jnp
+
+        def _kernel(x, scale):
+            return x * scale
+
+        _kernel_j = jax.jit(_kernel)
+
+        def apply(x, scale: float):
+            return _kernel_j(x, scale)
+
+        def rebuild_every_call(x):
+            return jax.jit(lambda v: v * 2)(x)
+        """,
+    )
+    assert ("TM-RETRACE", 11) in _rules_and_lines(findings)  # scalar into jit
+    assert ("TM-RETRACE", 14) in _rules_and_lines(findings)  # jit built per call
+    assert all(f.rule == "TM-RETRACE" for f in findings)
+
+
+def test_retrace_clean_static_argnames_and_asarray(tmp_path):
+    findings = _lint_snippet(
+        tmp_path,
+        """
+        import jax
+        import jax.numpy as jnp
+
+        def _kernel(x, scale, mode):
+            return x * scale if mode == "a" else x + scale
+
+        _kernel_j = jax.jit(_kernel, static_argnames=("mode",))
+
+        def apply(x, scale: float, mode: str):
+            return _kernel_j(x, jnp.asarray(scale), mode=mode)
+        """,
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------- state-contract fixtures
+
+
+def _load_module(tmp_path, name, source):
+    path = tmp_path / f"{name}.py"
+    path.write_text("import jax.numpy as jnp\nfrom metrics_tpu.core.metric import Metric\n" + textwrap.dedent(source))
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _contract(tmp_path, mod, cls_name, ctor_kwargs=None):
+    cls = getattr(mod, cls_name)
+    instance = cls(**(ctor_kwargs or {}))
+    item = IntrospectedClass(cls_name, cls, instance)
+    return class_findings(item, repo_root=str(tmp_path))
+
+
+
+
+def test_state_unreg_bad(tmp_path):
+    mod = _load_module(
+        tmp_path,
+        "unreg_bad",
+        """
+        class BadUnreg(Metric):
+            full_state_update = False
+            def __init__(self, **kw):
+                super().__init__(**kw)
+                self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+            def update(self, x) -> None:
+                self.total = self.total + x.sum()
+                self.last_batch_mean = x.mean()
+            def compute(self):
+                return self.total
+        """,
+    )
+    findings = _contract(tmp_path, mod, "BadUnreg")
+    (f,) = [f for f in findings if f.rule == "TM-STATE-UNREG"]
+    assert f.symbol.endswith(".last_batch_mean")
+    # anchored to the offending assignment line in the source file
+    line = pathlib.Path(tmp_path / "unreg_bad.py").read_text().split("\n")[f.line - 1]
+    assert "last_batch_mean" in line
+
+
+def test_state_unreg_clean_conditional_registration(tmp_path):
+    """Attrs registered in ANY branch (curve-metric pattern) are not findings."""
+    mod = _load_module(
+        tmp_path,
+        "unreg_clean",
+        """
+        class CleanConditional(Metric):
+            full_state_update = False
+            def __init__(self, binned=False, **kw):
+                super().__init__(**kw)
+                if binned:
+                    self.add_state("confmat", jnp.zeros((2, 2)), dist_reduce_fx="sum")
+                else:
+                    self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+                self.binned = binned
+            def update(self, x) -> None:
+                if self.binned:
+                    self.confmat = self.confmat + 1
+                else:
+                    self.total = self.total + x.sum()
+            def compute(self):
+                return self.total if not self.binned else self.confmat
+        """,
+    )
+    assert [f for f in _contract(tmp_path, mod, "CleanConditional") if f.rule == "TM-STATE-UNREG"] == []
+
+
+def test_reduce_mismatch_bad(tmp_path):
+    mod = _load_module(
+        tmp_path,
+        "reduce_bad",
+        """
+        def _weird(stack):
+            return stack[0]
+
+        class BadReduce(Metric):
+            full_state_update = False
+            def __init__(self, **kw):
+                super().__init__(**kw)
+                self.add_state("dense_cat", jnp.zeros(3), dist_reduce_fx="cat")
+                self.add_state("int_mean", jnp.asarray(0), dist_reduce_fx="mean")
+                self.add_state("custom", jnp.asarray(0.0), dist_reduce_fx=_weird)
+            def update(self, x) -> None:
+                self.int_mean = self.int_mean + 1
+            def compute(self):
+                return self.int_mean
+        """,
+    )
+    findings = [f for f in _contract(tmp_path, mod, "BadReduce") if f.rule == "TM-REDUCE-MISMATCH"]
+    symbols = {f.symbol for f in findings}
+    assert symbols == {"BadReduce.dense_cat", "BadReduce.int_mean", "BadReduce.custom"}
+    cls_line = [
+        i + 1
+        for i, l in enumerate(pathlib.Path(tmp_path / "reduce_bad.py").read_text().split("\n"))
+        if l.startswith("class BadReduce")
+    ][0]
+    assert all(f.line == cls_line for f in findings)
+
+
+def test_reduce_mismatch_clean(tmp_path):
+    mod = _load_module(
+        tmp_path,
+        "reduce_clean",
+        """
+        class CleanReduce(Metric):
+            full_state_update = False
+            def __init__(self, **kw):
+                super().__init__(**kw)
+                self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+                self.add_state("avg", jnp.asarray(0.0), dist_reduce_fx="mean")
+                self.add_state("rows", [], dist_reduce_fx="cat")
+                self.add_state("stacked", jnp.asarray(0.0), dist_reduce_fx=None)
+            def update(self, x) -> None:
+                self.total = self.total + x.sum()
+            def compute(self):
+                return self.total
+        """,
+    )
+    assert [f for f in _contract(tmp_path, mod, "CleanReduce") if f.rule == "TM-REDUCE-MISMATCH"] == []
+
+
+def test_persist_bad(tmp_path):
+    mod = _load_module(
+        tmp_path,
+        "persist_bad",
+        """
+        class BadPersist(Metric):
+            full_state_update = False
+            def __init__(self, **kw):
+                super().__init__(**kw)
+                self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+                self.running_window = jnp.zeros(8)
+            def update(self, x) -> None:
+                self.total = self.total + x.sum()
+            def compute(self):
+                return self.total
+        """,
+    )
+    findings = [f for f in _contract(tmp_path, mod, "BadPersist") if f.rule == "TM-PERSIST"]
+    assert [f.symbol for f in findings] == ["BadPersist.running_window"]
+
+
+def test_persist_clean_declared_exemptions(tmp_path):
+    """Ctor knobs (_update_signature_attrs) and declared exemptions are fine."""
+    mod = _load_module(
+        tmp_path,
+        "persist_clean",
+        """
+        class CleanPersist(Metric):
+            full_state_update = False
+            _update_signature_attrs = ("thresholds",)
+            _ckpt_exempt_attrs = ("scratch",)
+            def __init__(self, **kw):
+                super().__init__(**kw)
+                self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+                self.thresholds = jnp.linspace(0, 1, 5)
+                self.scratch = jnp.zeros(4)
+            def update(self, x) -> None:
+                self.total = self.total + x.sum()
+            def compute(self):
+                return self.total
+        """,
+    )
+    assert [f for f in _contract(tmp_path, mod, "CleanPersist") if f.rule == "TM-PERSIST"] == []
+
+
+# ------------------------------------------------------------ repo-wide guard
+
+
+def test_tmlint_no_new_findings():
+    """The whole package must be clean against the checked-in baseline."""
+    report = analyze(str(REPO_ROOT / "metrics_tpu"), baseline_path=str(REPO_ROOT / BASELINE_FILENAME))
+    assert report.parse_errors == {}
+    msgs = "\n".join(f.format() for f in report.new_findings)
+    assert not report.new_findings, f"new tmlint findings:\n{msgs}"
+    # stale waivers rot silently; fail so the baseline shrinks as fixes land
+    assert not report.unused_waivers, f"stale baseline waivers: {report.unused_waivers}"
+
+
+def test_every_rule_documented_and_cross_linked():
+    assert set(RULES) == {
+        "TM-HOSTSYNC", "TM-PYBRANCH", "TM-DYNSHAPE", "TM-RETRACE",
+        "TM-STATE-UNREG", "TM-REDUCE-MISMATCH", "TM-PERSIST",
+    }
+    for rule_id, rule in RULES.items():
+        text = explain(rule_id)
+        assert rule_id in text and rule.runtime_signal in text
+    # the retrace rule must name the obs counters it mirrors (obs/recompile.py)
+    assert "retrace_signatures" in RULES["TM-RETRACE"].counter
+
+
+def test_registry_covers_contract_sweep_classes():
+    """The analyzer's ctor registry must construct what the sweep tests: every
+    exported metric class is introspected or carries an explicit skip reason."""
+    from metrics_tpu.analysis.registry import introspect_classes
+
+    results = {item.name: item for item in introspect_classes()}
+    unexplained = [
+        name for name, item in results.items() if item.instance is None and not item.skip_reason
+    ]
+    assert not unexplained
+    constructed = [n for n, item in results.items() if item.instance is not None]
+    assert len(constructed) > 100, f"only {len(constructed)} classes constructible"
+    failures = {
+        n: item.skip_reason
+        for n, item in results.items()
+        if item.instance is None and item.skip_reason.startswith("construction failed")
+    }
+    assert not failures, f"registry ctor specs out of sync with exports: {failures}"
+
+
+# --------------------------------------------------------------------- CLI
+
+
+@pytest.mark.smoke
+def test_cli_seeded_violation_and_clean_exit(tmp_path):
+    """Acceptance: clean tree exits 0; a seeded `.item()` in a jitted kernel
+    exits non-zero and names the rule."""
+    pkg = tmp_path / "toypkg"
+    pkg.mkdir()
+    clean = textwrap.dedent(
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def kernel(x):
+            return x.sum()
+        """
+    )
+    (pkg / "mod.py").write_text(clean)
+    env = {"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu", "PYTHONPATH": str(REPO_ROOT)}
+
+    def run():
+        return subprocess.run(
+            [sys.executable, "-m", "metrics_tpu.analysis", str(pkg), "--no-introspect"],
+            capture_output=True, text=True, timeout=120, env=env, cwd=str(tmp_path),
+        )
+
+    result = run()
+    assert result.returncode == 0, result.stdout + result.stderr
+
+    (pkg / "mod.py").write_text(clean.replace("return x.sum()", "return x.sum().item()"))
+    result = run()
+    assert result.returncode == 1, result.stdout + result.stderr
+    assert "TM-HOSTSYNC" in result.stdout
+
+
+@pytest.mark.smoke
+def test_cli_explain_and_json(tmp_path):
+    env = {"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu", "PYTHONPATH": str(REPO_ROOT)}
+    result = subprocess.run(
+        [sys.executable, "-m", "metrics_tpu.analysis", "--explain", "TM-HOSTSYNC"],
+        capture_output=True, text=True, timeout=120, env=env,
+    )
+    assert result.returncode == 0
+    assert "TM-HOSTSYNC" in result.stdout and "obs" in result.stdout
+
+    pkg = tmp_path / "p"
+    pkg.mkdir()
+    (pkg / "m.py").write_text("import jax\n@jax.jit\ndef k(x):\n    return float(x)\n")
+    result = subprocess.run(
+        [sys.executable, "-m", "metrics_tpu.analysis", str(pkg), "--no-introspect", "--json"],
+        capture_output=True, text=True, timeout=120, env=env,
+    )
+    assert result.returncode == 1
+    payload = json.loads(result.stdout)
+    assert payload["new"] and payload["new"][0]["rule"] == "TM-HOSTSYNC"

@@ -19,9 +19,11 @@ from metrics_tpu.obs import registry as obs_registry
 def _clean_obs():
     obs.disable()
     obs.REGISTRY.clear()
+    obs.reset_class_detector()
     yield
     obs.disable()
     obs.REGISTRY.clear()
+    obs.reset_class_detector()
 
 
 class StreamMean(Metric):
@@ -120,6 +122,39 @@ def test_retrace_detector_quiet_on_stable_shapes():
             m.update(jnp.zeros(5))
     assert not [w for w in caught if "compile storm" in str(w.message)]
     assert obs.REGISTRY.get("StreamMean", "retraces") == 0
+
+
+def test_retrace_class_level_aggregation_across_instances():
+    """A fleet of instances each under the per-instance threshold still shows
+    class-level signature churn: `retrace_signatures` aggregates per CLASS so
+    the JSONL export can attribute retraces to a metric class — the same
+    granularity as tmlint's TM-RETRACE rule IDs (metrics_tpu/analysis/)."""
+    obs.enable(clear=True)
+    obs.reset_class_detector(StreamMean)
+    # 4 instances, each sees ONE distinct shape -> zero per-instance retraces
+    for n in range(1, 5):
+        StreamMean().update(jnp.zeros(n))
+    assert obs.REGISTRY.get("StreamMean", "retraces") == 0
+    # but the class saw 4 distinct signatures -> 3 beyond the first
+    assert obs.REGISTRY.get("StreamMean", "retrace_signatures") == 3
+    # repeats of known signatures stay silent at both levels
+    StreamMean().update(jnp.zeros(2))
+    assert obs.REGISTRY.get("StreamMean", "retrace_signatures") == 3
+    assert obs.REGISTRY.get("StreamMean", "retraces") == 0
+    # and the counter rides the JSONL export snapshot
+    assert obs.export_snapshot()["registry"]["StreamMean"]["retrace_signatures"] == 3
+
+
+def test_retrace_class_detector_reset():
+    obs.enable(clear=True)
+    obs.reset_class_detector()  # full clear
+    StreamMean().update(jnp.zeros(3))
+    StreamMean().update(jnp.zeros(4))
+    assert obs.REGISTRY.get("StreamMean", "retrace_signatures") == 1
+    obs.reset_class_detector("StreamMean")
+    obs.REGISTRY.clear()
+    StreamMean().update(jnp.zeros(3))
+    assert obs.REGISTRY.get("StreamMean", "retrace_signatures") == 0
 
 
 def test_retrace_fingerprint_sees_dtype_and_python_scalars():
