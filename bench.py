@@ -944,6 +944,53 @@ def bench_lint(runs: int = 3) -> dict:
     }
 
 
+def bench_san(runs: int = 3) -> dict:
+    """``--san-overhead``: cold tmsan wall time (trace + analyze + cost tier).
+
+    Each run is a fresh interpreter (``python -m metrics_tpu.analysis --san``)
+    so the number is the true cold cost the CI lint tier pays: interpreter +
+    jax import + registry construction + ~400 abstract traces + ~110
+    lower/compile cost measurements + the jaxpr rule walks. ``analyze_s`` is
+    the analyzer-internal total and ``trace_s`` the trace+rules portion (both
+    parsed from the summary line); the gap to the cold number is import cost.
+    Recorded so the jaxpr tier's cost stays visible as the registry grows —
+    the acceptance budget is 120 s cold on CPU.
+    """
+    import os
+    import re
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    wall_s, analyze_s, trace_s, summary = [], [], [], ""
+    for _ in range(runs):
+        t0 = time.perf_counter()
+        proc = subprocess.run(
+            [sys.executable, "-m", "metrics_tpu.analysis", "--san"],
+            cwd=repo, capture_output=True, text=True, timeout=900,
+        )
+        wall_s.append(time.perf_counter() - t0)
+        if proc.returncode != 0:
+            raise RuntimeError(f"tmsan reported new findings during bench:\n{proc.stdout[-2000:]}")
+        summary = proc.stdout.strip().rsplit("\n", 1)[-1]
+        m = re.search(r"in ([0-9.]+)s \(trace\+analyze ([0-9.]+)s\)", summary)
+        if m:
+            analyze_s.append(float(m.group(1)))
+            trace_s.append(float(m.group(2)))
+    return {
+        "metric": "tmsan_cold_wall_s",
+        "value": round(statistics.median(wall_s), 2),
+        "unit": "s",
+        "vs_baseline": None,
+        "analyze_s": round(statistics.median(analyze_s), 2) if analyze_s else None,
+        "trace_s": round(statistics.median(trace_s), 2) if trace_s else None,
+        "summary_line": summary,
+        "bound": "host-only: ~400 make_jaxpr traces under abstract inputs plus"
+                 " ~110 XLA lower+compile cost measurements; nothing executes —"
+                 " compile of the small canonical shapes dominates",
+    }
+
+
 if __name__ == "__main__":
     import argparse
 
@@ -967,6 +1014,14 @@ if __name__ == "__main__":
         " (metrics_tpu/analysis/): p50 of fresh-interpreter runs, reported as a"
         " JSON line so analyzer cost stays visible as the package grows (also"
         " runs under --config all)",
+    )
+    parser.add_argument(
+        "--san-overhead",
+        action="store_true",
+        help="also time tmsan (the jaxpr/HLO analyzer tier) cold: fresh-"
+        " interpreter p50 of `python -m metrics_tpu.analysis --san`, reported"
+        " as a JSON line so the static perf-gate's own cost stays visible"
+        " (also runs under --config all)",
     )
     parser.add_argument(
         "--obs",
@@ -1008,12 +1063,15 @@ if __name__ == "__main__":
         ("auroc", bench_auroc),
         ("ckpt", bench_ckpt),
         ("lint", bench_lint),
+        ("san", bench_san),
     ):
         if name == "ckpt" and not cli.ckpt:
             continue
         if name == "lint" and not (cli.lint_overhead or config in ("lint", "all")):
             continue
-        if config in (name, "all") or name in ("ckpt", "lint"):
+        if name == "san" and not (cli.san_overhead or config == "all"):
+            continue
+        if config in (name, "all") or name in ("ckpt", "lint", "san"):
             try:
                 result = fn()
                 summary[result["metric"]] = {

@@ -23,12 +23,20 @@ at runtime (``--explain RULE``); trace rules know the jit boundary — decorator
 ``jax.jit`` call sites, the ``Metric._wrap_update`` entry — and the repo's
 ``_is_concrete`` guard idiom, so host-side code is not flagged.
 
+tmlint is the AST tier; ``metrics_tpu.analysis.san`` (**tmsan**) is the
+jaxpr/HLO tier that verifies its predictions against the tracer and the
+compiler: abstract traces of every registered metric (TMS-* rules), the
+``tmsan_costs.json`` compile-cost budget, and the waiver crosscheck. Run it
+with ``--san`` (it is not imported here to keep the AST tier import-light).
+
 CLI::
 
     python -m metrics_tpu.analysis metrics_tpu/
+    python -m metrics_tpu.analysis --san
     python -m metrics_tpu.analysis --explain TM-RETRACE
 
-CI fails only on findings not waived in ``tmlint_baseline.json``.
+CI fails only on findings not waived in ``tmlint_baseline.json`` (plus cost
+budget breaches in ``--san`` runs).
 """
 from metrics_tpu.analysis.baseline import (
     BASELINE_FILENAME,

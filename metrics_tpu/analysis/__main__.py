@@ -1,12 +1,15 @@
-"""``python -m metrics_tpu.analysis`` — the tmlint CLI.
+"""``python -m metrics_tpu.analysis`` — the tmlint/tmsan CLI.
 
 Usage:
     python -m metrics_tpu.analysis metrics_tpu/            # lint, baseline-aware
+    python -m metrics_tpu.analysis --san                   # + jaxpr/HLO tier (tmsan)
+    python -m metrics_tpu.analysis --san --write-costs     # refresh tmsan_costs.json
     python -m metrics_tpu.analysis --explain TM-HOSTSYNC   # rule rationale
     python -m metrics_tpu.analysis metrics_tpu/ --write-baseline  # bootstrap waivers
     python -m metrics_tpu.analysis metrics_tpu/ --json     # machine-readable
 
-Exit codes: 0 = clean (or fully baselined), 1 = new findings, 2 = usage error.
+Exit codes: 0 = clean (or fully baselined), 1 = new findings or budget breach,
+2 = usage error.
 """
 import argparse
 import json
@@ -38,6 +41,22 @@ def main(argv=None) -> int:
     parser.add_argument("--select", metavar="RULES", help="comma-separated rule ids to report (default: all)")
     parser.add_argument("--json", action="store_true", help="machine-readable report on stdout")
     parser.add_argument("--no-introspect", action="store_true", help="AST rules only (skip importing the metric registry)")
+    parser.add_argument(
+        "--san",
+        action="store_true",
+        help="also run tmsan, the jaxpr/HLO tier: trace every registered metric "
+        "under abstract inputs, walk the jaxprs (TMS-* rules), check the "
+        "compile-cost budget (tmsan_costs.json), and crosscheck tmlint's "
+        "TM-HOSTSYNC waivers against jaxpr evidence",
+    )
+    parser.add_argument(
+        "--write-costs",
+        action="store_true",
+        help="with --san: write/refresh tmsan_costs.json from the measured "
+        "compile costs (commit the diff with its explanation)",
+    )
+    parser.add_argument("--costs", metavar="FILE", help="cost-budget file (default: tmsan_costs.json at the repo root)")
+    parser.add_argument("--no-costs", action="store_true", help="with --san: skip the compile/cost tier (trace rules only)")
     parser.add_argument("-v", "--verbose", action="store_true", help="also list waived findings and skipped classes")
     args = parser.parse_args(argv)
 
@@ -54,6 +73,9 @@ def main(argv=None) -> int:
         # one tree per run keeps repo-relative baseline keys unambiguous
         print("lint exactly one root per run (got: %s)" % ", ".join(paths), file=sys.stderr)
         return 2
+
+    if args.san:
+        return _main_san(args, paths[0])
 
     try:
         report = analyze(
@@ -126,6 +148,99 @@ def main(argv=None) -> int:
         f"({s['waived']} waived, {len(new)} new) in {s['seconds']}s"
     )
     return 1 if new else 0
+
+
+def _main_san(args, target: str) -> int:
+    """The --san path: full two-tier run (tmlint + tmsan)."""
+    import os
+
+    from metrics_tpu.analysis.runner import _find_repo_root
+    from metrics_tpu.analysis.san import costs as costs_mod
+    from metrics_tpu.analysis.san.runner import run_san
+
+    selected = None
+    if args.select:
+        selected = {r.strip().upper() for r in args.select.split(",")}
+        unknown = selected - set(RULES)
+        if unknown:
+            print(f"unknown rule(s): {', '.join(sorted(unknown))}", file=sys.stderr)
+            return 2
+
+    def keep(f):
+        return selected is None or f.rule in selected
+
+    report = run_san(
+        target,
+        baseline_path=args.baseline,
+        costs_path=args.costs,
+        with_costs=not args.no_costs,
+    )
+
+    if args.write_costs:
+        repo_root = _find_repo_root(target)
+        out = args.costs or os.path.join(repo_root, costs_mod.COSTS_FILENAME)
+        n = costs_mod.write_costs(out, report.costs)
+        print(f"tmsan: wrote {n} cost-budget entries to {out}")
+
+    if args.write_baseline:
+        from metrics_tpu.analysis import baseline as baseline_mod
+        from metrics_tpu.analysis.runner import _find_repo_root as _frr
+
+        out = args.baseline or os.path.join(_frr(target), baseline_mod.BASELINE_FILENAME)
+        lint_findings = report.lint.findings if report.lint is not None else []
+        n = baseline_mod.write_baseline(
+            out,
+            [f for f in lint_findings + report.findings if keep(f) and f.rule != "TMS-BUDGET"],
+            reason="bootstrap waiver: pre-existing finding, triage pending",
+        )
+        print(f"tmsan: wrote {n} waivers to {out}")
+        return 0
+
+    lint_new = [f for f in (report.lint.new_findings if report.lint else []) if keep(f)]
+    san_new = [f for f in report.new_findings if keep(f)]
+    unused = sorted(set(report.lint.unused_waivers if report.lint else []) | set(report.unused_waivers))
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "stats": {**(report.lint.stats if report.lint else {}), **{f"san_{k}": v for k, v in report.stats.items()}},
+                    "new": [vars(f) for f in lint_new + san_new],
+                    "waived": [vars(f) for f in (report.lint.waived if report.lint else []) + report.waived if keep(f)],
+                    "unused_waivers": [list(k) for k in unused],
+                    "skipped": report.skipped,
+                    "costs": report.costs,
+                    "budget_notes": report.budget_notes,
+                    "waiver_status": report.waiver_status,
+                },
+                indent=2,
+            )
+        )
+        return 1 if (lint_new or san_new) else 0
+
+    for f in lint_new + san_new:
+        print(f.format())
+    if args.verbose:
+        for f in (report.lint.waived if report.lint else []) + report.waived:
+            if keep(f):
+                print(f.format() + f"  # reason: {f.waive_reason}")
+        for name, reason in sorted(report.skipped.items()):
+            print(f"# not traced: {name}: {reason}")
+    for key_str, status in sorted(report.waiver_status.items()):
+        print(f"# waiver {key_str}: {status}")
+    for note in report.budget_notes:
+        print(f"# budget: {note}")
+    for key in unused:
+        print(f"# stale waiver (no matching finding): {':'.join(key)}")
+    s, ls = report.stats, (report.lint.stats if report.lint else {})
+    print(
+        f"tmsan: {s['classes_traced']} classes traced ({s['entries_traced']} abstract "
+        f"traces, {s['skipped']} skipped), {s['cost_entries']} cost entries, "
+        f"{s['findings']} san findings ({s['waived']} waived, {len(san_new)} new) "
+        f"+ {ls.get('new', 0):.0f} lint new, in {s['seconds']}s "
+        f"(trace+analyze {s['trace_seconds']}s)"
+    )
+    return 1 if (lint_new or san_new) else 0
 
 
 if __name__ == "__main__":

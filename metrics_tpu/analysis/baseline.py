@@ -34,6 +34,18 @@ def load_baseline(path: str) -> Dict[Tuple[str, str, str], str]:
     return out
 
 
+def scope_waivers(
+    waivers: Dict[Tuple[str, str, str], str], rules: Iterable[str]
+) -> Dict[Tuple[str, str, str], str]:
+    """Restrict a waiver table to the given rule ids.
+
+    The baseline file is shared between tmlint (TM-*) and tmsan (TMS-*); each
+    tier applies — and reports staleness for — only the waivers in its scope.
+    """
+    allowed = set(rules)
+    return {k: v for k, v in waivers.items() if k[0] in allowed}
+
+
 def apply_baseline(
     findings: List[Finding], waivers: Dict[Tuple[str, str, str], str]
 ) -> Tuple[List[Finding], List[Tuple[str, str, str]]]:

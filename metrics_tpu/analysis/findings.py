@@ -14,10 +14,12 @@ from typing import Dict, Optional, Tuple
 
 @dataclass(frozen=True)
 class Rule:
-    """One tmlint rule: identity, family, and its runtime cross-link."""
+    """One tmlint/tmsan rule: identity, family, and its runtime cross-link."""
 
     id: str
-    family: str  # "trace-safety" | "state-contract" | "retrace-hazard"
+    # tmlint: "trace-safety" | "state-contract" | "retrace-hazard"
+    # tmsan:  "jaxpr-trace" | "hlo-cost" | "crosscheck"
+    family: str
     summary: str
     #: obs counter(s) that fire at runtime for this failure class, with
     #: ``<M>`` standing for the metric class name; None when the failure
@@ -165,11 +167,197 @@ RULES: Dict[str, Rule] = {
                 "from registered state, or declare the exemption explicitly."
             ),
         ),
+        Rule(
+            id="TMS-CALLBACK",
+            family="jaxpr-trace",
+            summary="host callback primitive in a supposedly device-pure graph",
+            counter="san.callbacks",
+            runtime_signal=(
+                "every execution of the compiled program round-trips to the host "
+                "(pure_callback/io_callback/debug_callback): the TPU pipeline stalls "
+                "per call — visible as gaps between tm.update/<M> XProf scopes"
+            ),
+            rationale=(
+                "tmlint's TM-HOSTSYNC works on source text; a callback can still reach\n"
+                "the traced graph through a waiver, a modeling gap, or a third-party\n"
+                "helper. tmsan looks at the ground truth: the closed jaxpr of every\n"
+                "registered metric's update/compute traced under abstract inputs. A\n"
+                "`pure_callback`/`io_callback`/`debug_callback` equation there means\n"
+                "host code runs on EVERY step of the hot path, not just at trace time.\n"
+                "Move the work onto the device, or declare the class `_host_side_update`."
+            ),
+        ),
+        Rule(
+            id="TMS-F64",
+            family="jaxpr-trace",
+            summary="float64 value or constant in the traced graph",
+            counter="san.f64",
+            runtime_signal=(
+                "on TPU: 2x HBM for the affected buffers and software-emulated f64 "
+                "arithmetic (or an XLA error on platforms without f64 support)"
+            ),
+            rationale=(
+                "With jax's default x64-disabled config a float64 aval cannot appear\n"
+                "unless code opts in (`jax.experimental.enable_x64`, explicit f64\n"
+                "dtypes). A silent promotion — typically an np.float64 scalar or a\n"
+                "strongly-typed f64 constant leaking into arithmetic — doubles state\n"
+                "bytes and falls off the TPU fast path. Use weak python scalars or\n"
+                "explicit f32/bf16 dtypes."
+            ),
+        ),
+        Rule(
+            id="TMS-UPCAST",
+            family="jaxpr-trace",
+            summary="bf16/f16 state silently promoted to a wider dtype by update",
+            counter="san.upcasts",
+            runtime_signal=(
+                "state_report() shows f32 buffers where bf16 was declared (2x HBM); a "
+                "checkpoint saved after the first update fails restore validation "
+                "against the declared default dtype (ckpt DtypeDrift)"
+            ),
+            rationale=(
+                "A metric cast to bf16 (`set_dtype(jnp.bfloat16)`) must keep its state\n"
+                "bf16 through update: the state transition's output dtype is part of\n"
+                "the Metric contract (ckpt manifests validate it; parallel sync\n"
+                "reduces it). A strongly-typed f32 scalar (np.float32(x),\n"
+                "jnp.float32(x), jnp.asarray(x, jnp.float32)) in the accumulation\n"
+                "promotes the whole state. Use weak python scalars or\n"
+                "`.astype(state.dtype)` so the declared dtype survives. (Deliberate\n"
+                "f32 accumulation is fine — declare the STATE f32 then.)"
+            ),
+        ),
+        Rule(
+            id="TMS-BIGCONST",
+            family="jaxpr-trace",
+            summary="large constant baked into the traced graph",
+            counter="san.bigconsts",
+            runtime_signal=(
+                "per-executable HBM for the baked constant (jax.live_arrays shows a "
+                "copy per compiled program) and re-materialization on every retrace "
+                "(<M>.retraces / jax.compile_events)"
+            ),
+            rationale=(
+                "A constant above the byte threshold captured by the trace (a numpy\n"
+                "table, a materialized iota/linspace grid, a dense helper matrix) is\n"
+                "embedded in the XLA executable: it costs HBM per program, transfer\n"
+                "per compile, and is rebuilt on every retrace. Pass it as a traced\n"
+                "operand (donated state or argument), or compute it on device from\n"
+                "cheap primitives (iota) inside the graph."
+            ),
+        ),
+        Rule(
+            id="TMS-COLLECTIVE",
+            family="jaxpr-trace",
+            summary="collective over an axis not bound in the traced context",
+            counter="san.collectives",
+            runtime_signal=(
+                "NameError: unbound axis name at trace time inside shard_map/pmap, or "
+                "a deadlock when a single-host path reaches a collective only some "
+                "hosts execute"
+            ),
+            rationale=(
+                "psum/all_gather/ppermute equations in a graph traced WITHOUT a mesh\n"
+                "context mean a collective is reachable from a single-host code path:\n"
+                "under real sharding some hosts would enter it and others not —\n"
+                "the classic SPMD deadlock. Collectives belong in sync_state/\n"
+                "compute_from(axis_name=...) where the axis is explicitly bound\n"
+                "(parallel/collective.py), never in local_update."
+            ),
+        ),
+        Rule(
+            id="TMS-DYNSHAPE",
+            family="jaxpr-trace",
+            summary="metric body failed to trace (dynamic shape / concretization)",
+            counter="san.trace_failures",
+            runtime_signal=(
+                "TracerBoolConversionError / ConcretizationTypeError / "
+                "NonConcreteBooleanIndexError the first time the metric meets "
+                "jit/shard_map in production"
+            ),
+            rationale=(
+                "tmsan actually traces every registered metric's update/compute under\n"
+                "abstract ShapeDtypeStruct inputs — the same thing jit does. A trace\n"
+                "failure here is ground truth that the body is not trace-safe, and a\n"
+                "finding tmlint's AST tier should have predicted (TM-PYBRANCH/\n"
+                "TM-DYNSHAPE): this rule is the should-be-empty verification that the\n"
+                "two tiers agree. Fix the metric (size= bounds, lax.cond, padded ops/\n"
+                "kernels) or declare it `_host_side_update` if host-side by contract."
+            ),
+        ),
+        Rule(
+            id="TMS-LINTGAP",
+            family="crosscheck",
+            summary="jaxpr-level host callback in a tmlint-clean function",
+            counter="san.lintgaps",
+            runtime_signal=(
+                "same as TMS-CALLBACK — but additionally means tmlint's TM-HOSTSYNC "
+                "model has a blind spot worth closing"
+            ),
+            rationale=(
+                "The two analysis tiers keep each other honest: every callback tmsan\n"
+                "finds in a traced graph must correspond to a TM-HOSTSYNC finding (or\n"
+                "waiver) at the same source location. A callback in a function tmlint\n"
+                "considered clean is a LINTGAP — fix the metric AND extend the AST\n"
+                "rule (trace_rules.py) so the cheap tier catches the pattern next time."
+            ),
+        ),
+        Rule(
+            id="TMS-STALE-WAIVER",
+            family="crosscheck",
+            summary="TM-HOSTSYNC waiver contradicted by jaxpr evidence",
+            counter="san.stale_waivers",
+            runtime_signal=(
+                "the waived 'host-only' line participates in traced graphs — the "
+                "waiver's safety claim no longer holds and the original TM-HOSTSYNC "
+                "runtime signal applies"
+            ),
+            rationale=(
+                "A TM-HOSTSYNC waiver asserts the flagged host work stays off traced\n"
+                "paths (eager-only tier, concreteness guard). tmsan corroborates each\n"
+                "waiver against the traced footprint: the waived lines must be absent\n"
+                "from every traced jaxpr (corroborated-by-absence) or appear as an\n"
+                "explicit callback (corroborated-by-presence). A waived line showing\n"
+                "up as ordinary traced computation means the code moved under the\n"
+                "waiver — re-triage it."
+            ),
+        ),
+        Rule(
+            id="TMS-BUDGET",
+            family="hlo-cost",
+            summary="compiled cost grew >15% over the checked-in budget",
+            counter="san.budget_breaches",
+            runtime_signal=(
+                "the next benchmark run regresses (BENCH flops/bytes-bound configs); "
+                "tmsan catches it statically from .compile().cost_analysis() before "
+                "any benchmark executes"
+            ),
+            rationale=(
+                "tmsan_costs.json records flops / bytes-accessed / peak transient\n"
+                "bytes per (metric, canonical shape) from XLA's own cost model. A\n"
+                ">15% unexplained growth is a static perf regression — an accidental\n"
+                "broadcast, a lost fusion, a dtype widening — caught before a\n"
+                "benchmark ever runs. If the growth is intended (new feature, better\n"
+                "accuracy), refresh the budget: `python -m metrics_tpu.analysis --san\n"
+                "--write-costs` and commit the diff with the explanation."
+            ),
+        ),
     )
 }
 
 #: Rules that need the import-time introspection pass (vs pure AST).
 INTROSPECTION_RULES: Tuple[str, ...] = ("TM-STATE-UNREG", "TM-REDUCE-MISMATCH", "TM-PERSIST")
+
+#: tmsan (jaxpr/HLO tier) rules — produced by ``metrics_tpu.analysis.san``, not
+#: by the AST pass. Baseline waivers are shared but scoped: a pure tmlint run
+#: ignores TMS-* waivers and a san run ignores unused TM-* ones.
+SAN_RULES: Tuple[str, ...] = (
+    "TMS-CALLBACK", "TMS-F64", "TMS-UPCAST", "TMS-BIGCONST",
+    "TMS-COLLECTIVE", "TMS-DYNSHAPE", "TMS-LINTGAP", "TMS-STALE-WAIVER",
+    "TMS-BUDGET",
+)
+
+#: AST/introspection (tmlint) rules — everything that is not a san rule.
+LINT_RULES: Tuple[str, ...] = tuple(r for r in RULES if r not in SAN_RULES)
 
 
 @dataclass

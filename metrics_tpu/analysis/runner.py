@@ -128,7 +128,11 @@ def analyze(
     if baseline_path is None:
         baseline_path = baseline_mod.default_baseline_path(repo_root)
     if baseline_path is not None:
-        waivers = baseline_mod.load_baseline(baseline_path)
+        from metrics_tpu.analysis.findings import LINT_RULES
+
+        # the waiver file is shared with tmsan (the jaxpr tier): an AST-only run
+        # must not report TMS-* waivers as stale
+        waivers = baseline_mod.scope_waivers(baseline_mod.load_baseline(baseline_path), LINT_RULES)
         report.new_findings, report.unused_waivers = baseline_mod.apply_baseline(
             report.findings, waivers
         )

@@ -1,0 +1,39 @@
+"""metrics_tpu.analysis.san — **tmsan**, the jaxpr/HLO tier of the analyzer.
+
+tmlint (the AST tier, ``metrics_tpu.analysis``) predicts trace hazards from
+source text; its jit-boundary model is an approximation. tmsan gets ground
+truth from the tracer and the compiler: every constructible registered Metric's
+``update``/``compute`` (and the exact-kernel functional entrypoints in
+``ops/``) is traced under abstract ``jax.ShapeDtypeStruct`` inputs at canonical
+shapes, the closed jaxprs are walked for rule families the AST cannot decide,
+and ``.lower().compile().cost_analysis()`` maintains a checked-in per-metric
+compile-cost budget (``tmsan_costs.json``) that fails CI on unexplained >15%
+growth — a static perf-regression gate that runs before any benchmark.
+
+==================  =========================================================
+rule                what it catches (in the TRACED GRAPH, not the source)
+==================  =========================================================
+TMS-CALLBACK        pure_callback/io_callback/debug_callback equations
+TMS-F64             float64 avals/constants without explicit x64 intent
+TMS-UPCAST          bf16/f16 state promoted to a wider dtype by update
+TMS-BIGCONST        constants above a byte threshold baked into the jaxpr
+TMS-COLLECTIVE      psum/all_gather reachable from a single-host path
+TMS-DYNSHAPE        trace failures tmlint should have predicted (verification)
+TMS-LINTGAP         callback in a tmlint-clean function (crosscheck)
+TMS-STALE-WAIVER    TM-HOSTSYNC waiver contradicted by jaxpr evidence
+TMS-BUDGET          compile cost grew >15% over tmsan_costs.json
+==================  =========================================================
+
+CLI::
+
+    python -m metrics_tpu.analysis --san               # full two-tier run
+    python -m metrics_tpu.analysis --san --write-costs # refresh the budget
+    python -m metrics_tpu.analysis --explain TMS-BUDGET
+
+Waivers share ``tmlint_baseline.json`` (same (rule, path, symbol) schema);
+obs counters live in the ``san.*`` namespace.
+"""
+from metrics_tpu.analysis.san.costs import COSTS_FILENAME, load_costs, write_costs
+from metrics_tpu.analysis.san.runner import SanReport, run_san
+
+__all__ = ["COSTS_FILENAME", "SanReport", "load_costs", "run_san", "write_costs"]

@@ -1,0 +1,316 @@
+"""Abstract input model: ShapeDtypeStruct update arguments per metric class.
+
+tmsan traces metric state transitions without ever materializing data: each
+registered class gets a small set of :class:`TraceCase`\\ s — tuples of
+``jax.ShapeDtypeStruct`` update arguments (plus static python kwargs) at the
+canonical batch sizes in :data:`SIZES`. Two sizes are traced so shape-
+specialized constants and size-dependent dispatch both show up; the cost
+budget (costs.py) is recorded at the ``canon`` size only.
+
+Resolution order for a class's specs:
+
+1. the ``Metric._san_input_specs(n)`` instance hook (core/metric.py) — for
+   metrics whose update signature is not inferable from tables (wrappers whose
+   shapes depend on the wrapped metric);
+2. the per-name table below (mirrors the contract sweep's PER_NAME);
+3. the task-family prefix rule (Binary*/Multiclass*/Multilabel*/Retrieval*).
+
+A class with no spec is recorded as a skip (never a crash): tmsan degrades the
+same way the tmlint registry does on a ctor failure.
+"""
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+#: canonical batch sizes; "canon" is also the cost-budget shape
+SIZES: Dict[str, int] = {"small": 8, "canon": 64}
+
+
+def f32(*shape: int) -> jax.ShapeDtypeStruct:
+    return jax.ShapeDtypeStruct(shape, jnp.float32)
+
+
+def bf16(*shape: int) -> jax.ShapeDtypeStruct:
+    return jax.ShapeDtypeStruct(shape, jnp.bfloat16)
+
+
+def i32(*shape: int) -> jax.ShapeDtypeStruct:
+    return jax.ShapeDtypeStruct(shape, jnp.int32)
+
+
+def u8(*shape: int) -> jax.ShapeDtypeStruct:
+    return jax.ShapeDtypeStruct(shape, jnp.uint8)
+
+
+def b8(*shape: int) -> jax.ShapeDtypeStruct:
+    return jax.ShapeDtypeStruct(shape, jnp.bool_)
+
+
+@dataclass(frozen=True)
+class TraceCase:
+    """One (args, kwargs) update invocation to trace at one canonical size."""
+
+    tag: str  # "canon" / "small" (+ ":variant" for kwarg variants)
+    args: Tuple[jax.ShapeDtypeStruct, ...]
+    kwargs: Dict[str, Any] = field(default_factory=dict)
+
+
+def _one(*args: jax.ShapeDtypeStruct, **kwargs: Any):
+    return [(args, kwargs)]
+
+
+# ---------------------------------------------------------------------------
+# shape builders: name -> fn(n) -> list of (args, kwargs)
+# (mirrors tests/unittests/bases/test_contract_sweep.py PER_NAME, shapes only)
+# ---------------------------------------------------------------------------
+
+def _binary(n):
+    return _one(f32(n), i32(n))
+
+
+def _multiclass(n):
+    return _one(f32(n, 5), i32(n))
+
+
+def _multilabel(n):
+    return _one(f32(n, 3), i32(n, 3))
+
+
+def _retrieval(n):
+    return _one(f32(n), i32(n), i32(n))
+
+
+def _pairs(n):
+    return _one(f32(n), f32(n))
+
+
+def _single(n):
+    return _one(f32(n))
+
+
+def _img(n, c=3, hw=16):
+    b = max(1, n // 32)  # canonical batch: 2 at canon, 1 at small
+    return b, c, hw, hw
+
+
+def _img_pair(n, c=3, hw=16):
+    shape = _img(n, c, hw)
+    return _one(f32(*shape), f32(*shape))
+
+
+def _sig_pair(n, t=32):
+    b = max(1, n // 32)
+    return _one(f32(b, t), f32(b, t))
+
+
+#: family prefix -> builder (matches registry.FAMILY_KWARGS order)
+FAMILY_BUILDERS: Tuple[Tuple[str, Callable[[int], list]], ...] = (
+    ("Binary", _binary),
+    ("Multiclass", _multiclass),
+    ("Multilabel", _multilabel),
+    ("Retrieval", _retrieval),
+)
+
+#: per-name builders (checked before the family prefix)
+PER_NAME: Dict[str, Callable[[int], list]] = {
+    # __new__-routing dispatchers (registry constructs their task= form)
+    "Accuracy": _binary,
+    "AUROC": _binary,
+    "AveragePrecision": _binary,
+    "CalibrationError": _binary,
+    "CohenKappa": _binary,
+    "ConfusionMatrix": _binary,
+    "F1Score": _binary,
+    "FBetaScore": _binary,
+    "HammingDistance": _binary,
+    "JaccardIndex": _binary,
+    "MatthewsCorrCoef": _binary,
+    "Precision": _binary,
+    "PrecisionRecallCurve": _binary,
+    "Recall": _binary,
+    "ROC": _binary,
+    "Specificity": _binary,
+    "StatScores": _binary,
+    "RecallAtFixedPrecision": _binary,
+    "PrecisionAtFixedRecall": _binary,
+    "SpecificityAtSensitivity": _binary,
+    "HingeLoss": _binary,
+    "ExactMatch": lambda n: _one(i32(n), i32(n)),
+    "MulticlassExactMatch": lambda n: _one(i32(n), i32(n)),
+    "MultilabelExactMatch": _multilabel,
+    # regression & aggregation
+    "CosineSimilarity": lambda n: _one(f32(max(2, n // 16), 8), f32(max(2, n // 16), 8)),
+    "KLDivergence": lambda n: _one(f32(max(2, n // 8), 4), f32(max(2, n // 8), 4)),
+    "KendallRankCorrCoef": _pairs,
+    "SpearmanCorrCoef": _pairs,
+    "PearsonCorrCoef": _pairs,
+    "ConcordanceCorrCoef": _pairs,
+    "ExplainedVariance": _pairs,
+    "LogCoshError": _pairs,
+    "MeanAbsoluteError": _pairs,
+    "MeanAbsolutePercentageError": _pairs,
+    "MeanSquaredError": _pairs,
+    "MeanSquaredLogError": _pairs,
+    "MinkowskiDistance": _pairs,
+    "R2Score": _pairs,
+    "SymmetricMeanAbsolutePercentageError": _pairs,
+    "TweedieDevianceScore": _pairs,
+    "WeightedMeanAbsolutePercentageError": _pairs,
+    "MaxMetric": _single,
+    "MinMetric": _single,
+    "MeanMetric": _single,
+    "SumMetric": _single,
+    "CatMetric": _single,
+    "RunningMean": _single,
+    "RunningSum": _single,
+    # image (pairs)
+    "ErrorRelativeGlobalDimensionlessSynthesis": _img_pair,
+    "MultiScaleStructuralSimilarityIndexMeasure": lambda n: _img_pair(n, hw=24),
+    "PeakSignalNoiseRatio": _img_pair,
+    "PeakSignalNoiseRatioWithBlockedEffect": lambda n: _img_pair(n, c=1),
+    "RelativeAverageSpectralError": _img_pair,
+    "RootMeanSquaredErrorUsingSlidingWindow": _img_pair,
+    "SpectralAngleMapper": _img_pair,
+    "SpectralDistortionIndex": _img_pair,
+    "StructuralSimilarityIndexMeasure": _img_pair,
+    "TotalVariation": lambda n: _one(f32(*_img(n))),
+    "UniversalImageQualityIndex": _img_pair,
+    # audio
+    "ScaleInvariantSignalDistortionRatio": _sig_pair,
+    "ScaleInvariantSignalNoiseRatio": _sig_pair,
+    "SignalNoiseRatio": _sig_pair,
+    "SignalDistortionRatio": lambda n: _sig_pair(n, t=64),
+    "PermutationInvariantTraining": lambda n: _one(
+        f32(max(1, n // 32), 2, 32), f32(max(1, n // 32), 2, 32)
+    ),
+    # text-adjacent device metric
+    "Perplexity": lambda n: _one(f32(max(1, n // 32), 6, 8), i32(max(1, n // 32), 6)),
+    # nominal (update is device-side; compute is declared host-side)
+    "CramersV": lambda n: _one(i32(n), i32(n)),
+    "PearsonsContingencyCoefficient": lambda n: _one(i32(n), i32(n)),
+    "TheilsU": lambda n: _one(i32(n), i32(n)),
+    "TschuprowsT": lambda n: _one(i32(n), i32(n)),
+    # image-gen metrics with injected feature extractors (registry supplies a
+    # weight-free 8-feature stand-in): real/fake branches are distinct traces
+    "FrechetInceptionDistance": lambda n: [
+        ((u8(max(2, n // 16), 3, 8, 8),), {"real": True}),
+        ((u8(max(2, n // 16), 3, 8, 8),), {"real": False}),
+    ],
+    "KernelInceptionDistance": lambda n: [
+        ((u8(max(2, n // 16), 3, 8, 8),), {"real": True}),
+        ((u8(max(2, n // 16), 3, 8, 8),), {"real": False}),
+    ],
+    "InceptionScore": lambda n: _one(u8(max(2, n // 16), 3, 8, 8)),
+}
+
+
+def _normalize(raw: Any, tag: str) -> List[TraceCase]:
+    """Accept builder/hook output shapes: list of (args, kwargs) pairs, a bare
+    args tuple, or a list of (tag, args, kwargs) triples."""
+    cases: List[TraceCase] = []
+    if raw is None:
+        return cases
+    if isinstance(raw, tuple) and all(isinstance(a, jax.ShapeDtypeStruct) for a in raw):
+        raw = [(raw, {})]
+    for i, entry in enumerate(raw):
+        if len(entry) == 3 and isinstance(entry[0], str):
+            sub, args, kwargs = entry
+            cases.append(TraceCase(f"{tag}:{sub}", tuple(args), dict(kwargs)))
+            continue
+        args, kwargs = entry
+        sub = ""
+        if kwargs:
+            sub = ":" + ",".join(f"{k}={v}" for k, v in sorted(kwargs.items()))
+        cases.append(TraceCase(tag + sub, tuple(args), dict(kwargs)))
+    return cases
+
+
+def inner_spec(metric: Any, n: int) -> Optional[list]:
+    """Raw spec list for a WRAPPED metric instance, resolved by class name.
+
+    Wrapper classes implement their ``_san_input_specs`` hook with this: the
+    wrapped metric's own hook wins, then the tables above (class names match
+    the family prefixes — ``MulticlassAccuracy`` hits the ``Multiclass`` rule).
+    """
+    hook = getattr(metric, "_san_input_specs", None)
+    raw = hook(n) if hook is not None else None
+    if raw is not None:
+        return raw
+    name = type(metric).__name__.lstrip("_")
+    builder = PER_NAME.get(name)
+    if builder is None:
+        for prefix, fam in FAMILY_BUILDERS:
+            if name.startswith(prefix):
+                builder = fam
+                break
+    return builder(n) if builder is not None else None
+
+
+def cases_for(name: str, instance: Any) -> Optional[Dict[str, List[TraceCase]]]:
+    """``{size_tag: [TraceCase, ...]}`` for one registered metric, or None when
+    no spec exists (hook, table, and family all miss)."""
+    out: Dict[str, List[TraceCase]] = {}
+    hook = getattr(instance, "_san_input_specs", None)
+    for tag, n in SIZES.items():
+        raw = hook(n) if hook is not None else None
+        if raw is None:
+            builder = PER_NAME.get(name)
+            if builder is None:
+                for prefix, fam in FAMILY_BUILDERS:
+                    if name.startswith(prefix):
+                        builder = fam
+                        break
+            if builder is None:
+                return None
+            raw = builder(n)
+        out[tag] = _normalize(raw, tag)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# ops/ exact-kernel functional entrypoints (traced + budgeted like metrics)
+# ---------------------------------------------------------------------------
+
+def _ops_entrypoints() -> Dict[str, Tuple[Callable, Callable[[int], list]]]:
+    from metrics_tpu.ops import clf_curve, confmat, rank, segment
+
+    return {
+        "ops.binary_auroc_exact": (clf_curve.binary_auroc_exact, _pairs_it),
+        "ops.binary_average_precision_exact": (clf_curve.binary_average_precision_exact, _pairs_it),
+        "ops.multiclass_auroc_exact": (clf_curve.multiclass_auroc_exact, lambda n: _one(f32(n, 5), i32(n))),
+        "ops.multiclass_average_precision_exact": (
+            clf_curve.multiclass_average_precision_exact, lambda n: _one(f32(n, 5), i32(n))
+        ),
+        "ops.multilabel_auroc_exact": (clf_curve.multilabel_auroc_exact, lambda n: _one(f32(n, 3), i32(n, 3))),
+        "ops.multilabel_average_precision_exact": (
+            clf_curve.multilabel_average_precision_exact, lambda n: _one(f32(n, 3), i32(n, 3))
+        ),
+        "ops.binary_precision_recall_curve_padded": (
+            clf_curve.binary_precision_recall_curve_padded, _pairs_it
+        ),
+        "ops.binary_roc_curve_padded": (clf_curve.binary_roc_curve_padded, _pairs_it),
+        "ops.grouped_retrieval_scores": (
+            segment.grouped_retrieval_scores,
+            lambda n: _one(i32(n), f32(n), i32(n), metric="precision", top_k=2),
+        ),
+        "ops.confusion_counts": (
+            confmat.confusion_counts,
+            lambda n: _one(i32(n), i32(n), b8(n), num_classes=5),
+        ),
+        "ops.ranked_targets": (rank.ranked_targets, lambda n: _one(f32(n), i32(n))),
+        "ops.monotone_key_descending": (rank.monotone_key_descending, lambda n: _one(f32(n))),
+    }
+
+
+def _pairs_it(n):
+    return _one(f32(n), i32(n))
+
+
+def ops_cases() -> Dict[str, Tuple[Callable, Dict[str, List[TraceCase]]]]:
+    """``{entry_key: (fn, {size_tag: cases})}`` for the ops/ kernels."""
+    out = {}
+    for key, (fn, builder) in _ops_entrypoints().items():
+        out[key] = (fn, {tag: _normalize(builder(n), tag) for tag, n in SIZES.items()})
+    return out

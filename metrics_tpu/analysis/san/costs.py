@@ -1,0 +1,174 @@
+"""Compile-cost budget: XLA's own cost model as a static perf-regression gate.
+
+For every traced entry at the canonical shape, ``jax.jit(fn).lower(*abstract)
+.compile().cost_analysis()`` yields flops and bytes-accessed, and
+``memory_analysis()`` the transient footprint — all WITHOUT executing anything
+or materializing data. ``tmsan_costs.json`` at the repo root records them;
+:func:`compare_costs` fails CI (TMS-BUDGET findings) on unexplained growth
+above :data:`BUDGET_TOLERANCE`.
+
+The recorded numbers come from one XLA version's cost model, so the file
+stamps ``jax``/``jaxlib``: on a version mismatch the comparison still runs but
+degrades to warnings (notes) instead of findings — cross-version cost drift is
+XLA's business, same-version drift is a regression in THIS repo. Refresh after
+an intended change with ``python -m metrics_tpu.analysis --san --write-costs``
+and commit the diff alongside its explanation.
+"""
+import json
+import math
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from metrics_tpu.analysis.findings import Finding
+
+COSTS_FILENAME = "tmsan_costs.json"
+#: growth beyond this fraction of the recorded budget is a TMS-BUDGET finding
+BUDGET_TOLERANCE = 0.15
+#: the cost dimensions the budget tracks, in report order
+COST_KEYS = ("flops", "bytes_accessed", "peak_bytes")
+
+
+def measure_entry(fn, args, kwargs) -> Optional[Dict[str, float]]:
+    """Lower+compile one entry under abstract inputs; never executes it.
+
+    ``peak_bytes`` is the executable's transient footprint beyond its inputs:
+    XLA temp allocations plus outputs (CompiledMemoryStats).
+    """
+    import warnings
+
+    import jax
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        lowered = jax.jit(lambda *a: fn(*a, **kwargs)).lower(*args)
+        compiled = lowered.compile()
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    flops = float(ca.get("flops", 0.0) or 0.0)
+    nbytes = float(ca.get("bytes accessed", 0.0) or 0.0)
+    peak = 0.0
+    try:
+        mem = compiled.memory_analysis()
+        peak = float(
+            (getattr(mem, "temp_size_in_bytes", 0) or 0)
+            + (getattr(mem, "output_size_in_bytes", 0) or 0)
+        )
+    except Exception:  # noqa: BLE001 — peak is best-effort on exotic backends
+        pass
+    return {"flops": flops, "bytes_accessed": nbytes, "peak_bytes": peak}
+
+
+def load_costs(path: str) -> Dict[str, Any]:
+    with open(path, "r", encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def write_costs(path: str, entries: Dict[str, Dict[str, float]]) -> int:
+    import jax
+
+    payload = {
+        "version": 1,
+        "comment": (
+            "tmsan compile-cost budget: flops / bytes-accessed / peak transient"
+            " bytes per (entry, canonical shape) from XLA cost analysis."
+            " CI fails on >15% unexplained growth (same jax version); refresh"
+            " with `python -m metrics_tpu.analysis --san --write-costs` and"
+            " commit the diff with its explanation."
+        ),
+        "jax": jax.__version__,
+        "backend": jax.default_backend(),
+        "entries": {k: entries[k] for k in sorted(entries)},
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=2)
+        fh.write("\n")
+    return len(payload["entries"])
+
+
+def default_costs_path(repo_root: str) -> Optional[str]:
+    cand = os.path.join(repo_root, COSTS_FILENAME)
+    return cand if os.path.exists(cand) else None
+
+
+def _breaches(current: Dict[str, float], budget: Dict[str, float]) -> List[str]:
+    out = []
+    for key in COST_KEYS:
+        cur, ref = float(current.get(key, 0.0)), float(budget.get(key, 0.0))
+        if ref <= 0.0:
+            continue  # zero-cost reference: nothing meaningful to gate on
+        growth = cur / ref - 1.0
+        if growth > BUDGET_TOLERANCE and not math.isclose(cur, ref):
+            out.append(f"{key} {ref:.0f} -> {cur:.0f} (+{growth * 100:.0f}%)")
+    return out
+
+
+def compare_costs(
+    current: Dict[str, Dict[str, float]],
+    budget_payload: Dict[str, Any],
+    anchors: Dict[str, Tuple[str, int]],
+) -> Tuple[List[Finding], List[str]]:
+    """(findings, notes) comparing measured costs against the checked-in budget.
+
+    ``anchors``: entry key -> (repo_relative_path, line) for finding placement.
+    """
+    import jax
+
+    findings: List[Finding] = []
+    notes: List[str] = []
+    budget: Dict[str, Dict[str, float]] = budget_payload.get("entries", {})
+    version_ok = budget_payload.get("jax") == jax.__version__ and (
+        budget_payload.get("backend") == jax.default_backend()
+    )
+    if not version_ok:
+        notes.append(
+            f"budget recorded on jax={budget_payload.get('jax')}/"
+            f"{budget_payload.get('backend')} but running jax={jax.__version__}/"
+            f"{jax.default_backend()}: cost drift reported as warnings, not failures"
+        )
+
+    def emit(entry: str, message: str) -> None:
+        path, line = anchors.get(entry, ("", 0))
+        f = Finding(
+            rule="TMS-BUDGET", path=path or COSTS_FILENAME, line=line, col=0,
+            symbol=entry, message=message,
+        )
+        if version_ok:
+            findings.append(f)
+        else:
+            notes.append(f"(version-skew warning) {f.format()}")
+
+    for entry in sorted(current):
+        if entry not in budget:
+            emit(
+                entry,
+                f"no budget recorded for `{entry}`: run `python -m metrics_tpu.analysis"
+                " --san --write-costs` and commit tmsan_costs.json",
+            )
+            continue
+        over = _breaches(current[entry], budget[entry])
+        if over:
+            emit(
+                entry,
+                f"compile cost of `{entry}` grew past the +{BUDGET_TOLERANCE * 100:.0f}% "
+                f"budget: {'; '.join(over)} — fix the regression or refresh the budget "
+                "(--write-costs) with an explanation",
+            )
+            continue
+        shrunk = [
+            f"{k} {budget[entry].get(k, 0):.0f} -> {current[entry].get(k, 0):.0f}"
+            for k in COST_KEYS
+            if float(budget[entry].get(k, 0.0)) > 0.0
+            and float(current[entry].get(k, 0.0)) < float(budget[entry].get(k, 0.0)) * (1 - BUDGET_TOLERANCE)
+        ]
+        if shrunk:
+            notes.append(
+                f"`{entry}` improved >{BUDGET_TOLERANCE * 100:.0f}% below budget"
+                f" ({'; '.join(shrunk)}): refresh with --write-costs to lock in the gain"
+            )
+    for entry in sorted(set(budget) - set(current)):
+        notes.append(
+            f"budget entry `{entry}` no longer traced (metric removed or renamed):"
+            " refresh with --write-costs"
+        )
+    return findings, notes

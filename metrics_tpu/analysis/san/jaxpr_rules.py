@@ -1,0 +1,317 @@
+"""Jaxpr-walking rules: TMS-CALLBACK, TMS-F64, TMS-UPCAST, TMS-BIGCONST,
+TMS-COLLECTIVE.
+
+These operate on the ground truth the AST tier approximates: the closed jaxpr
+of a metric's ``local_update``/``compute_from`` traced under abstract inputs.
+Every equation of every nested sub-jaxpr (pjit bodies, cond branches, scan
+bodies, custom_jvp calls) is visited; findings are attributed to repo source
+via jax's per-equation ``source_info`` when a user frame inside the repo
+exists, else to the metric entry that was traced.
+"""
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+import numpy as np
+
+from metrics_tpu.analysis.findings import Finding
+
+#: host-callback primitives — device-pure graphs must not contain these
+CALLBACK_PRIMS = frozenset({"pure_callback", "io_callback", "debug_callback"})
+#: named-axis collectives — unreachable from a correct single-host trace
+COLLECTIVE_PRIMS = frozenset(
+    {
+        "psum", "pmax", "pmin", "pmean", "ppermute", "pbroadcast", "pgather",
+        "all_gather", "all_to_all", "reduce_scatter", "psum_scatter", "axis_index",
+    }
+)
+#: constants at or above this size are "baked in" findings (per-executable HBM)
+BIGCONST_BYTES = 1 << 16  # 64 KiB
+
+_WIDE_FLOATS = ("float64", "complex128")
+_NARROW_FLOATS = ("bfloat16", "float16")
+
+
+@dataclass
+class TraceAnchor:
+    """Where findings for one traced entry are pinned (waiver-stable symbol)."""
+
+    path: str  # repo-relative defining file of the traced entry
+    line: int
+    symbol: str  # "ClassName.update" / "ops.binary_auroc_exact"
+
+
+@dataclass
+class GraphFacts:
+    """Everything one walk of a closed jaxpr extracts (rules + crosscheck)."""
+
+    #: (primitive_name, repo_path, line, function_name) for callback eqns;
+    #: path may be "" when no repo frame exists
+    callbacks: List[Tuple[str, str, int, str]] = field(default_factory=list)
+    #: (primitive_name, axis_names, repo_path, line) for collective eqns
+    collectives: List[Tuple[str, str, str, int]] = field(default_factory=list)
+    #: dtype-offending avals: (dtype_str, repo_path, line, prim)
+    f64s: List[Tuple[str, str, int, str]] = field(default_factory=list)
+    #: (nbytes, shape, dtype_str) for big consts/literals
+    bigconsts: List[Tuple[int, Tuple[int, ...], str]] = field(default_factory=list)
+    #: every repo (path, line) any equation's user stack touches — the traced
+    #: source footprint crosscheck.py corroborates TM-HOSTSYNC waivers against
+    footprint: Set[Tuple[str, int]] = field(default_factory=set)
+
+
+def _iter_jaxprs(jaxpr) -> Iterator[Any]:
+    """The jaxpr plus every nested sub-jaxpr reachable through eqn params."""
+    try:
+        from jax._src import core as jcore
+    except ImportError:  # pragma: no cover — fallback for jax layout changes
+        import jax.core as jcore
+
+    seen: Set[int] = set()
+    stack = [jaxpr]
+    while stack:
+        j = stack.pop()
+        if id(j) in seen:
+            continue
+        seen.add(id(j))
+        yield j
+        for eqn in j.eqns:
+            for val in eqn.params.values():
+                for sub in _as_jaxprs(val, jcore):
+                    stack.append(sub)
+
+
+def _as_jaxprs(val: Any, jcore) -> Iterable[Any]:
+    if isinstance(val, jcore.Jaxpr):
+        yield val
+    elif isinstance(val, jcore.ClosedJaxpr):
+        yield val.jaxpr
+    elif isinstance(val, (tuple, list)):
+        for v in val:
+            yield from _as_jaxprs(v, jcore)
+
+
+def _iter_consts(closed_jaxpr) -> Iterator[Any]:
+    """Consts of the closed jaxpr and of every nested closed sub-jaxpr."""
+    try:
+        from jax._src import core as jcore
+    except ImportError:  # pragma: no cover
+        import jax.core as jcore
+
+    seen: Set[int] = set()
+    stack = [closed_jaxpr]
+    while stack:
+        j = stack.pop()
+        if id(j) in seen:
+            continue
+        seen.add(id(j))
+        yield from getattr(j, "consts", ())
+        core_j = getattr(j, "jaxpr", j)
+        for eqn in getattr(core_j, "eqns", ()):
+            for val in eqn.params.values():
+                vals = val if isinstance(val, (tuple, list)) else [val]
+                for v in vals:
+                    if isinstance(v, jcore.ClosedJaxpr):
+                        stack.append(v)
+
+
+def _repo_frames(eqn, repo_root: str) -> List[Tuple[str, int, str]]:
+    """(repo_relative_path, line, function_name) user frames for one equation."""
+    from jax._src import source_info_util
+
+    out: List[Tuple[str, int, str]] = []
+    try:
+        frames = source_info_util.user_frames(eqn.source_info)
+    except Exception:  # noqa: BLE001 — attribution is best-effort
+        return out
+    for fr in frames:
+        fname = getattr(fr, "file_name", "") or ""
+        if not fname.startswith(repo_root):
+            continue
+        rel = os.path.relpath(fname, repo_root).replace(os.sep, "/")
+        out.append((rel, int(getattr(fr, "start_line", 0) or 0), getattr(fr, "function_name", "") or ""))
+    return out
+
+
+def _axis_names(params: Dict[str, Any]) -> str:
+    for key in ("axes", "axis_name", "named_axes"):
+        if key in params and params[key]:
+            val = params[key]
+            if isinstance(val, (tuple, list)):
+                names = [str(v) for v in val if isinstance(v, (str,)) or v is not None]
+                if names:
+                    return ",".join(names)
+            else:
+                return str(val)
+    return ""
+
+
+def collect_graph_facts(closed_jaxpr, repo_root: str, *, footprint: bool = True) -> GraphFacts:
+    """One walk over every (nested) equation of a traced entry."""
+    facts = GraphFacts()
+    core_jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+
+    # big consts captured by the closure (the canonical BIGCONST source) —
+    # including consts of nested closed jaxprs (pjit usually hoists them to
+    # the top level, but custom primitives may not)
+    for const in _iter_consts(closed_jaxpr):
+        nbytes = getattr(const, "nbytes", None)
+        if nbytes is not None and nbytes >= BIGCONST_BYTES:
+            arr = np.asarray(const) if not hasattr(const, "dtype") else const
+            facts.bigconsts.append((int(nbytes), tuple(arr.shape), str(arr.dtype)))
+
+    for j in _iter_jaxprs(core_jaxpr):
+        for var in getattr(j, "constvars", ()):
+            dt = str(getattr(getattr(var, "aval", None), "dtype", ""))
+            if dt in _WIDE_FLOATS:
+                facts.f64s.append((dt, "", 0, "constvar"))
+        for eqn in j.eqns:
+            prim = eqn.primitive.name
+            frames = _repo_frames(eqn, repo_root) if footprint else []
+            facts.footprint.update((p, ln) for p, ln, _ in frames)
+            top = frames[0] if frames else ("", 0, "")
+
+            if prim in CALLBACK_PRIMS:
+                facts.callbacks.append((prim, top[0], top[1], top[2]))
+            if prim in COLLECTIVE_PRIMS:
+                facts.collectives.append((prim, _axis_names(eqn.params), top[0], top[1]))
+
+            for var in eqn.outvars:
+                dt = str(getattr(getattr(var, "aval", None), "dtype", ""))
+                if dt in _WIDE_FLOATS:
+                    facts.f64s.append((dt, top[0], top[1], prim))
+                    break  # one report per equation is enough
+
+            for invar in eqn.invars:
+                val = getattr(invar, "val", None)  # Literal operands
+                if val is None:
+                    continue
+                dt = str(getattr(val, "dtype", ""))
+                if dt in _WIDE_FLOATS:
+                    facts.f64s.append((dt, top[0], top[1], prim))
+                nbytes = getattr(val, "nbytes", 0)
+                if nbytes and nbytes >= BIGCONST_BYTES:
+                    facts.bigconsts.append((int(nbytes), tuple(np.shape(val)), dt or "?"))
+    return facts
+
+
+# ---------------------------------------------------------------------------
+# findings from facts
+# ---------------------------------------------------------------------------
+
+def _mk(rule: str, anchor: TraceAnchor, path: str, line: int, message: str) -> Finding:
+    return Finding(
+        rule=rule,
+        path=path or anchor.path,
+        line=line or anchor.line,
+        col=0,
+        symbol=anchor.symbol,
+        message=message,
+    )
+
+
+def findings_from_facts(facts: GraphFacts, anchor: TraceAnchor, case: str) -> List[Finding]:
+    out: List[Finding] = []
+    for prim, path, line, func in facts.callbacks:
+        where = f" (host code: {func})" if func else ""
+        out.append(
+            _mk(
+                "TMS-CALLBACK",
+                anchor,
+                path,
+                line,
+                f"`{prim}` equation in the traced graph of {anchor.symbol} [{case}]{where}: "
+                "the compiled program round-trips to the host on EVERY execution",
+            )
+        )
+    for prim, axes, path, line in facts.collectives:
+        ax = f" over axis `{axes}`" if axes else ""
+        out.append(
+            _mk(
+                "TMS-COLLECTIVE",
+                anchor,
+                path,
+                line,
+                f"collective `{prim}`{ax} reachable from the single-host trace of "
+                f"{anchor.symbol} [{case}]: unbound axes deadlock under real sharding — "
+                "collectives belong in sync_state/compute_from(axis_name=...)",
+            )
+        )
+    for dt, path, line, prim in facts.f64s:
+        out.append(
+            _mk(
+                "TMS-F64",
+                anchor,
+                path,
+                line,
+                f"{dt} value (primitive `{prim}`) in the traced graph of {anchor.symbol} "
+                f"[{case}] without explicit x64 intent: 2x HBM and emulated arithmetic on TPU",
+            )
+        )
+    for nbytes, shape, dt in facts.bigconsts:
+        out.append(
+            _mk(
+                "TMS-BIGCONST",
+                anchor,
+                "",
+                0,
+                f"constant {dt}{list(shape)} ({nbytes} B >= {BIGCONST_BYTES} B) baked into the "
+                f"jaxpr of {anchor.symbol} [{case}]: costs HBM per compiled executable and is "
+                "re-materialized on every retrace — pass it as a traced operand or build it on device",
+            )
+        )
+    # one finding per (rule, message) — the same hazard at two shapes is one triage
+    seen: Set[Tuple[str, str]] = set()
+    unique: List[Finding] = []
+    for f in out:
+        k = (f.rule, f.message)
+        if k not in seen:
+            seen.add(k)
+            unique.append(f)
+    return unique
+
+
+def upcast_findings(
+    in_state: Dict[str, Any],
+    out_state: Dict[str, Any],
+    anchor: TraceAnchor,
+    case: str,
+) -> List[Finding]:
+    """TMS-UPCAST: compare declared (input) vs produced (output) state dtypes.
+
+    Consumes the ``jax.eval_shape`` result of the bf16 trace variant: a state
+    leaf that enters update as bf16/f16 and leaves as f32/f64 breaks the
+    dtype half of the state contract (ckpt manifests validate it).
+    """
+    import jax
+
+    out: List[Finding] = []
+    in_leaves = dict(_leaves_by_path(in_state, jax))
+    for key, leaf_out in _leaves_by_path(out_state, jax):
+        leaf_in = in_leaves.get(key)
+        if leaf_in is None:
+            continue
+        din = str(getattr(leaf_in, "dtype", ""))
+        dout = str(getattr(leaf_out, "dtype", ""))
+        if din in _NARROW_FLOATS and dout not in _NARROW_FLOATS and dout.startswith(("float", "complex")):
+            out.append(
+                _mk(
+                    "TMS-UPCAST",
+                    anchor,
+                    "",
+                    0,
+                    f"state `{key}` enters update as {din} but leaves as {dout} [{case}]: "
+                    "a strongly-typed wide constant in the accumulation promotes the "
+                    "declared state dtype (2x HBM; ckpt DtypeDrift on restore). Use weak "
+                    "python scalars or cast back with .astype(<state>.dtype)",
+                )
+            )
+    return out
+
+
+def _leaves_by_path(tree: Any, jax) -> List[Tuple[str, Any]]:
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    out = []
+    for path, leaf in flat:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+        out.append((key, leaf))
+    return out

@@ -1,0 +1,444 @@
+"""tmsan orchestration: registry -> abstract traces -> jaxpr rules -> costs ->
+crosscheck -> baseline -> report.
+
+The sweep is pure host work: ``jax.make_jaxpr`` under ``ShapeDtypeStruct``
+inputs never materializes data, and the cost tier stops at
+``.lower().compile()`` — nothing executes. Everything degrades per-entry: a
+ctor failure, a missing input spec, or an unexpected trace error becomes a
+recorded skip, while *classified* trace failures (concretization / dynamic
+shape) become TMS-DYNSHAPE findings — those are exactly what the AST tier
+claims cannot happen.
+"""
+import os
+import time
+import warnings
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from metrics_tpu.analysis import baseline as baseline_mod
+from metrics_tpu.analysis.findings import SAN_RULES, Finding
+from metrics_tpu.analysis.runner import Report, _find_repo_root, analyze
+from metrics_tpu.analysis.san import costs as costs_mod
+from metrics_tpu.analysis.san.abstract_inputs import SIZES, cases_for, ops_cases
+from metrics_tpu.analysis.san.jaxpr_rules import (
+    GraphFacts,
+    TraceAnchor,
+    collect_graph_facts,
+    findings_from_facts,
+    upcast_findings,
+)
+
+#: trace-failure types that are findings (tmlint should have predicted them),
+#: matched by exception class NAME so jax version drift cannot break the gate
+_DYNSHAPE_ERRORS = (
+    "TracerBoolConversionError",
+    "TracerArrayConversionError",
+    "TracerIntegerConversionError",
+    "ConcretizationTypeError",
+    "NonConcreteBooleanIndexError",
+)
+
+
+@dataclass
+class SanReport:
+    """Combined two-tier report: tmlint's AST run + the jaxpr/cost sweep."""
+
+    lint: Optional[Report] = None
+    findings: List[Finding] = field(default_factory=list)  # san tier, waived included
+    new_findings: List[Finding] = field(default_factory=list)
+    unused_waivers: List[Tuple[str, str, str]] = field(default_factory=list)
+    #: export name -> traced entry count (update/compute x sizes x cases)
+    traced: Dict[str, int] = field(default_factory=dict)
+    skipped: Dict[str, str] = field(default_factory=dict)
+    costs: Dict[str, Dict[str, float]] = field(default_factory=dict)
+    budget_notes: List[str] = field(default_factory=list)
+    waiver_status: Dict[str, str] = field(default_factory=dict)
+    stats: Dict[str, float] = field(default_factory=dict)
+
+    @property
+    def waived(self) -> List[Finding]:
+        return [f for f in self.findings if f.waived]
+
+    @property
+    def exit_code(self) -> int:
+        lint_new = self.lint.new_findings if self.lint is not None else []
+        return 1 if (self.new_findings or lint_new) else 0
+
+
+def _fresh(inst: Any) -> Any:
+    """Per-trace instance isolation: wrapper metrics mutate their (unregistered)
+    child metrics during update, so a trace would leak tracers into the shared
+    registry instance and poison the next trace. Falls back to the original
+    when a metric cannot be deep-copied (the trace then owns the instance)."""
+    import copy
+
+    try:
+        return copy.deepcopy(inst)
+    except Exception:  # noqa: BLE001
+        return inst
+
+
+def _obs_inc(name: str, value: float = 1) -> None:
+    from metrics_tpu.obs import registry as _obs
+
+    if _obs._ENABLED:
+        _obs.REGISTRY.inc("san", name, value)
+
+
+def _to_sds(tree: Any):
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x)), tree
+    )
+
+
+def _bf16_tree(tree: Any):
+    import jax
+    import jax.numpy as jnp
+
+    def cast(x):
+        if jnp.issubdtype(x.dtype, jnp.floating) and x.dtype in (jnp.float32, jnp.float64):
+            return jax.ShapeDtypeStruct(x.shape, jnp.bfloat16)
+        return x
+
+    return jax.tree_util.tree_map(cast, tree)
+
+
+def _has_narrow_or_float_state(state_sds: Any) -> bool:
+    import jax
+    import jax.numpy as jnp
+
+    return any(
+        jnp.issubdtype(leaf.dtype, jnp.floating)
+        for leaf in jax.tree_util.tree_leaves(state_sds)
+    )
+
+
+def _method_anchor(cls: type, method: str, repo_root: str) -> Optional[TraceAnchor]:
+    import inspect
+
+    from metrics_tpu.core.metric import Metric
+
+    for base in cls.__mro__:
+        if base is Metric or method not in base.__dict__:
+            continue
+        fn = base.__dict__[method]
+        try:
+            path = inspect.getsourcefile(fn)
+            _, line = inspect.getsourcelines(fn)
+        except (OSError, TypeError):
+            return None
+        if path is None:
+            return None
+        rel = os.path.relpath(os.path.abspath(path), repo_root).replace(os.sep, "/")
+        if rel.startswith(".."):
+            return None
+        return TraceAnchor(path=rel, line=line, symbol=f"{cls.__name__}.{method}")
+    return None
+
+
+def _fn_anchor(fn: Callable, key: str, repo_root: str) -> TraceAnchor:
+    import inspect
+
+    try:
+        path = inspect.getsourcefile(fn)
+        _, line = inspect.getsourcelines(fn)
+        rel = os.path.relpath(os.path.abspath(path), repo_root).replace(os.sep, "/")
+    except (OSError, TypeError):
+        rel, line = "", 0
+    return TraceAnchor(path=rel, line=line, symbol=key)
+
+
+@dataclass
+class _TraceOutcome:
+    facts: Optional[GraphFacts] = None
+    out_shape: Any = None
+    error: Optional[BaseException] = None
+    skip: str = ""
+
+
+def _trace(fn: Callable, args: tuple, repo_root: str) -> _TraceOutcome:
+    """make_jaxpr under abstract inputs; classified errors become findings."""
+    import jax
+
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            closed, out_shape = jax.make_jaxpr(fn, return_shape=True)(*args)
+    except Exception as err:  # noqa: BLE001 — every failure is data, not a crash
+        if type(err).__name__ in _DYNSHAPE_ERRORS:
+            return _TraceOutcome(error=err)
+        return _TraceOutcome(skip=f"trace failed: {type(err).__name__}: {err}")
+    return _TraceOutcome(facts=collect_graph_facts(closed, repo_root), out_shape=out_shape)
+
+
+def _dynshape_finding(anchor: TraceAnchor, case: str, err: BaseException) -> Finding:
+    msg = str(err).split("\n", 1)[0][:300]
+    return Finding(
+        rule="TMS-DYNSHAPE",
+        path=anchor.path,
+        line=anchor.line,
+        col=0,
+        symbol=anchor.symbol,
+        message=(
+            f"abstract trace of {anchor.symbol} [{case}] failed with "
+            f"{type(err).__name__}: {msg} — ground truth that the body is not "
+            "trace-safe; tmlint's AST tier should have predicted this"
+        ),
+    )
+
+
+def run_san(
+    target: str = "metrics_tpu",
+    baseline_path: Optional[str] = None,
+    costs_path: Optional[str] = None,
+    repo_root: Optional[str] = None,
+    with_costs: bool = True,
+    with_lint: bool = True,
+) -> SanReport:
+    """Full two-tier run over the live registry (see module docstring)."""
+    import jax
+
+    from metrics_tpu.analysis.registry import introspect_classes
+    from metrics_tpu.core.metric import Metric
+
+    t0 = time.perf_counter()
+    report = SanReport()
+    repo_root = repo_root or _find_repo_root(target)
+
+    if with_lint:
+        report.lint = analyze(target, baseline_path=baseline_path, repo_root=repo_root)
+
+    footprint: set = set()
+    all_callbacks: List[Tuple[str, str, int, str]] = []
+    cost_current: Dict[str, Dict[str, float]] = {}
+    cost_anchors: Dict[str, Tuple[str, int]] = {}
+    n_traces = 0
+    t_trace = time.perf_counter()
+
+    # ---------------------------------------------------------- metric classes
+    traced_cls: Dict[type, int] = {}
+    cls_findings: Dict[type, List[Finding]] = {}
+    for item in introspect_classes():
+        if item.instance is None:
+            report.skipped[item.name] = item.skip_reason
+            continue
+        if item.host_side:
+            report.skipped[item.name] = "declared _host_side_update (host code by contract)"
+            continue
+        if item.cls in traced_cls:  # dispatcher alias: reuse the class's traces
+            if traced_cls[item.cls] > 0:
+                report.traced[item.name] = traced_cls[item.cls]
+            else:
+                report.skipped[item.name] = report.skipped.get(item.cls.__name__, "trace failed")
+            continue
+
+        inst = item.instance
+        sizes = cases_for(item.name, inst)
+        if sizes is None:
+            traced_cls[item.cls] = 0
+            report.skipped[item.name] = "no abstract input spec (add _san_input_specs or a table entry)"
+            continue
+
+        up_anchor = _method_anchor(item.cls, "update", repo_root) or TraceAnchor(
+            "", 0, f"{item.cls.__name__}.update"
+        )
+        cp_anchor = _method_anchor(item.cls, "compute", repo_root) or TraceAnchor(
+            "", 0, f"{item.cls.__name__}.compute"
+        )
+        try:
+            state_sds = _to_sds(inst.init_state())
+        except Exception as err:  # noqa: BLE001
+            traced_cls[item.cls] = 0
+            report.skipped[item.name] = f"init_state failed: {type(err).__name__}: {err}"
+            continue
+
+        found: List[Finding] = []
+        entry_count = 0
+        for size_tag, cases in sizes.items():
+            for case in cases:
+                inst_u = _fresh(inst)
+
+                def upd(s, *a, _kw=case.kwargs, _m=inst_u):
+                    return _m.local_update(s, *a, **_kw)
+
+                outcome = _trace(upd, (state_sds, *case.args), repo_root)
+                if outcome.error is not None:
+                    found.append(_dynshape_finding(up_anchor, case.tag, outcome.error))
+                    _obs_inc("trace_failures")
+                    continue
+                if outcome.skip:
+                    report.skipped.setdefault(item.name, f"update[{case.tag}]: {outcome.skip}")
+                    continue
+                entry_count += 1
+                n_traces += 1
+                footprint |= outcome.facts.footprint
+                all_callbacks.extend(outcome.facts.callbacks)
+                found.extend(findings_from_facts(outcome.facts, up_anchor, case.tag))
+
+                out_state = outcome.out_shape
+                # compute on the POST-update state shapes (cat states have rows now)
+                if not getattr(item.cls, "_host_side_compute", False):
+                    inst_c = _fresh(inst)
+                    c_outcome = _trace(lambda s, _m=inst_c: _m.compute_from(s), (out_state,), repo_root)
+                    if c_outcome.error is not None:
+                        found.append(_dynshape_finding(cp_anchor, case.tag, c_outcome.error))
+                        _obs_inc("trace_failures")
+                    elif c_outcome.skip:
+                        report.skipped.setdefault(item.name, f"compute[{case.tag}]: {c_outcome.skip}")
+                    else:
+                        entry_count += 1
+                        n_traces += 1
+                        footprint |= c_outcome.facts.footprint
+                        all_callbacks.extend(c_outcome.facts.callbacks)
+                        found.extend(findings_from_facts(c_outcome.facts, cp_anchor, case.tag))
+
+                # bf16 variant: does update preserve a narrow state dtype?
+                if size_tag == "canon" and _has_narrow_or_float_state(state_sds):
+                    bf_state, bf_args = _bf16_tree(state_sds), _bf16_tree(case.args)
+                    inst_b = _fresh(inst)
+                    try:
+                        with warnings.catch_warnings():
+                            warnings.simplefilter("ignore")
+                            bf_out = jax.eval_shape(
+                                lambda s, *a, _m=inst_b, _kw=case.kwargs: _m.local_update(s, *a, **_kw),
+                                bf_state,
+                                *bf_args,
+                            )
+                        found.extend(
+                            upcast_findings(bf_state, bf_out, up_anchor, f"{case.tag}:bf16")
+                        )
+                    except Exception:  # noqa: BLE001 — bf16 support is opportunistic
+                        pass
+
+                # cost budget at the canonical shape
+                if with_costs and size_tag == "canon":
+                    key = f"{item.cls.__name__}.update[{case.tag}]"
+                    inst_k = _fresh(inst)
+                    try:
+                        measured = costs_mod.measure_entry(
+                            lambda s, *a, _m=inst_k, _kw=case.kwargs: _m.local_update(s, *a, **_kw),
+                            (state_sds, *case.args),
+                            {},
+                        )
+                    except Exception as err:  # noqa: BLE001
+                        report.budget_notes.append(
+                            f"cost measurement failed for {key}: {type(err).__name__}: {err}"
+                        )
+                        measured = None
+                    if measured is not None:
+                        cost_current[key] = measured
+                        cost_anchors[key] = (up_anchor.path, up_anchor.line)
+
+        traced_cls[item.cls] = entry_count
+        cls_findings[item.cls] = found
+        if entry_count > 0:
+            report.traced[item.name] = entry_count
+            _obs_inc("traced")
+        elif item.name not in report.skipped:
+            report.skipped[item.name] = "no entry traced"
+        report.findings.extend(found)
+
+    # ------------------------------------------------------- ops/ entrypoints
+    for key, (fn, sizes) in sorted(ops_cases().items()):
+        anchor = _fn_anchor(fn, key, repo_root)
+        entry_count = 0
+        for size_tag, cases in sizes.items():
+            for case in cases:
+                outcome = _trace(
+                    lambda *a, _kw=case.kwargs: fn(*a, **_kw), case.args, repo_root
+                )
+                if outcome.error is not None:
+                    report.findings.append(_dynshape_finding(anchor, case.tag, outcome.error))
+                    _obs_inc("trace_failures")
+                    continue
+                if outcome.skip:
+                    report.skipped.setdefault(key, f"[{case.tag}]: {outcome.skip}")
+                    continue
+                entry_count += 1
+                n_traces += 1
+                footprint |= outcome.facts.footprint
+                all_callbacks.extend(outcome.facts.callbacks)
+                report.findings.extend(findings_from_facts(outcome.facts, anchor, case.tag))
+                if with_costs and size_tag == "canon":
+                    ckey = f"{key}[{case.tag}]"
+                    try:
+                        measured = costs_mod.measure_entry(fn, case.args, case.kwargs)
+                    except Exception as err:  # noqa: BLE001
+                        report.budget_notes.append(
+                            f"cost measurement failed for {ckey}: {type(err).__name__}: {err}"
+                        )
+                        measured = None
+                    if measured is not None:
+                        cost_current[ckey] = measured
+                        cost_anchors[ckey] = (anchor.path, anchor.line)
+        if entry_count:
+            report.traced[key] = entry_count
+            _obs_inc("traced")
+    t_trace = time.perf_counter() - t_trace
+
+    # ------------------------------------------------------------- crosscheck
+    from metrics_tpu.analysis.san.crosscheck import corroborate_waivers, lintgap_findings
+
+    lint_findings = report.lint.findings if report.lint is not None else []
+    report.findings.extend(lintgap_findings(all_callbacks, lint_findings))
+
+    if baseline_path is None:
+        baseline_path = baseline_mod.default_baseline_path(repo_root)
+    waivers = baseline_mod.load_baseline(baseline_path) if baseline_path else {}
+    stale, status = corroborate_waivers(waivers, lint_findings, footprint, all_callbacks)
+    report.findings.extend(stale)
+    report.waiver_status = status
+
+    # ------------------------------------------------------------ cost budget
+    report.costs = cost_current
+    if with_costs:
+        budget_path = costs_path or costs_mod.default_costs_path(repo_root)
+        if budget_path is not None:
+            budget = costs_mod.load_costs(budget_path)
+            budget_findings, notes = costs_mod.compare_costs(cost_current, budget, cost_anchors)
+            report.findings.extend(budget_findings)
+            report.budget_notes.extend(notes)
+            _obs_inc("budget_breaches", len(budget_findings))
+        else:
+            report.budget_notes.append(
+                f"no {costs_mod.COSTS_FILENAME} at the repo root: bootstrap with --write-costs"
+            )
+
+    # ---------------------------------------------------------------- baseline
+    report.findings.sort(key=lambda f: (f.path, f.line, f.rule, f.symbol))
+    san_waivers = baseline_mod.scope_waivers(waivers, SAN_RULES)
+    report.new_findings, report.unused_waivers = baseline_mod.apply_baseline(
+        report.findings, san_waivers
+    )
+    _obs_inc("findings", len(report.findings))
+    for f in report.findings:
+        if f.rule == "TMS-CALLBACK":
+            _obs_inc("callbacks")
+        elif f.rule == "TMS-F64":
+            _obs_inc("f64")
+        elif f.rule == "TMS-UPCAST":
+            _obs_inc("upcasts")
+        elif f.rule == "TMS-BIGCONST":
+            _obs_inc("bigconsts")
+        elif f.rule == "TMS-COLLECTIVE":
+            _obs_inc("collectives")
+        elif f.rule == "TMS-LINTGAP":
+            _obs_inc("lintgaps")
+        elif f.rule == "TMS-STALE-WAIVER":
+            _obs_inc("stale_waivers")
+
+    report.stats = {
+        "classes_traced": len(report.traced),
+        "entries_traced": n_traces,
+        "skipped": len(report.skipped),
+        "findings": len(report.findings),
+        "waived": len(report.waived),
+        "new": len(report.new_findings),
+        "cost_entries": len(cost_current),
+        "trace_seconds": round(t_trace, 3),
+        "seconds": round(time.perf_counter() - t0, 3),
+    }
+    return report

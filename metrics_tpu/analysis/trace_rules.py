@@ -95,7 +95,17 @@ class _StaticNames:
         if isinstance(node, ast.Constant):
             return True
         if isinstance(node, ast.Name):
-            return node.id in self.names
+            if node.id in self.names:
+                return True
+            # bare from-imports of dtype/type objects: `from numpy import float32`
+            imported = self.module.imports.get(node.id, "")
+            if ":" in imported:
+                srcmod, _, orig = imported.partition(":")
+                if srcmod == "numpy" and orig in _NP_STATIC:
+                    return True
+                if srcmod == "jax.numpy" and (orig in _JNP_STATIC or orig in _DTYPE_NAMES):
+                    return True
+            return False
         if isinstance(node, ast.Attribute):
             # x.shape / x.ndim / x.size / x.dtype are static under jit
             if node.attr in ("shape", "ndim", "size", "dtype", "itemsize"):
@@ -258,6 +268,26 @@ class _RuleVisitor(ast.NodeVisitor):
             return
         parts = name.split(".")
         base, last = parts[0], parts[-1]
+
+        # bare-name from-imports: `from numpy import asarray` / `from jax
+        # import device_get as dget` hide the module prefix the dotted checks
+        # key on — resolve through the import table (tmsan crosscheck found
+        # this gap: TMS-LINTGAP fixtures in tests/unittests/analysis)
+        if len(parts) == 1:
+            imported = self.module.imports.get(base, "")
+            if ":" in imported:
+                srcmod, _, orig = imported.partition(":")
+                if srcmod == "jax" and orig == "device_get":
+                    self._emit(
+                        "TM-HOSTSYNC", node,
+                        f"`{base}` resolves to jax.device_get: an explicit host sync",
+                    )
+                    return
+                if srcmod == "numpy":
+                    # route through the numpy branch below under the ORIGINAL
+                    # name, so _NP_STATIC and the static-args exemption apply
+                    parts = [base, orig]
+                    last = orig
 
         # numpy compute calls
         if base in self.module.np_aliases and len(parts) >= 2:

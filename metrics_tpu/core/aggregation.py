@@ -50,8 +50,19 @@ class BaseAggregator(Metric):
         self.add_state("value", default=default_value, dist_reduce_fx=fn)
 
     def _cast_and_nan_check_input(self, x: Union[float, Array], nan_identity: float = 0.0) -> Array:
-        """Cast to float array; apply the NaN strategy (reference: aggregation.py:71-89)."""
-        x = jnp.asarray(x, dtype=jnp.float32)
+        """Cast to float array; apply the NaN strategy (reference: aggregation.py:71-89).
+
+        Dtype-preserving: the declared ``value`` state dtype wins (tmsan
+        TMS-UPCAST) — a hard f32 cast here silently promoted bf16 aggregator
+        states back to f32 on the first update, breaking set_dtype and the
+        ckpt manifest's dtype validation. Non-float inputs still become f32.
+        """
+        state = getattr(self, "value", None)
+        if isinstance(state, jnp.ndarray) and jnp.issubdtype(state.dtype, jnp.floating):
+            dtype = state.dtype
+        else:
+            dtype = jnp.float32
+        x = jnp.asarray(x, dtype=dtype)
         if self.nan_strategy == "error" or self.nan_strategy == "warn":
             if _is_concrete(x):
                 has_nan = bool(np.isnan(np.asarray(x)).any())
@@ -71,7 +82,7 @@ class BaseAggregator(Metric):
                 x = jnp.where(jnp.isnan(x), nan_identity, x)
         else:  # float imputation
             x = jnp.where(jnp.isnan(x), self.nan_strategy, x)
-        return x.astype(jnp.float32)
+        return x.astype(dtype)
 
     def update(self, value: Union[float, Array]) -> None:
         pass

@@ -120,9 +120,24 @@ class Metric(ABC):
     #   not named in _update_signature_attrs) — suppresses TM-PERSIST /
     #   TM-STATE-UNREG for the named attrs, with the declaration itself acting
     #   as the in-code waiver.
+    # - _san_input_specs: hook for tmsan (metrics_tpu/analysis/san/), the
+    #   jaxpr/HLO tier that traces every registered metric's update under
+    #   abstract inputs. Metrics whose update signature is not inferable from
+    #   the family tables in analysis/san/abstract_inputs.py (wrappers whose
+    #   shapes depend on the wrapped metric, multi-argument specials) override
+    #   this INSTANCE method: given a canonical batch size ``n`` return a list
+    #   of ``(tag, args, kwargs)`` cases, where ``args`` is a tuple of
+    #   ``jax.ShapeDtypeStruct`` update arguments and ``kwargs`` static python
+    #   update keywords. Return an empty list to opt the instance out of
+    #   abstract tracing (recorded as a skip, not a failure).
     _host_side_update: bool = False
     _host_side_compute: bool = False
     _ckpt_exempt_attrs: Tuple[str, ...] = ()
+
+    def _san_input_specs(self, n: int):
+        """Abstract update-argument specs for tmsan; None -> use the shape
+        tables in ``analysis/san/abstract_inputs.py`` (see hook note above)."""
+        return None
 
     def __init__(self, **kwargs: Any) -> None:
         self._device = None  # lazy: jax default device

@@ -14,6 +14,7 @@ typical (non-degenerate) signals; enable x64 for bit-level parity on CPU.
 import math
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 from jax import Array
 
@@ -58,7 +59,12 @@ def signal_distortion_ratio(
         load_diag: diagonal loading to stabilize the solve for degenerate targets.
     """
     _check_same_shape(preds, target)
-    compute_dtype = jnp.promote_types(preds.dtype, jnp.float64)  # f64 if x64 enabled, else f32
+    # f64 if x64 enabled, else f32 — gate on the config (same idiom as
+    # functional/pairwise/euclidean.py) instead of promote_types(.., float64),
+    # which under the default config requests f64 and is silently truncated to
+    # f32 with a per-trace UserWarning (tmsan TMS-F64 hygiene)
+    wide = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    compute_dtype = jnp.promote_types(preds.dtype, wide)
     out_dtype = preds.dtype
     preds = preds.astype(compute_dtype)
     target = target.astype(compute_dtype)

@@ -4,7 +4,7 @@ from typing import Tuple
 import jax.numpy as jnp
 from jax import Array
 
-from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.checks import _as_float, _check_same_shape
 
 
 def _unsqueeze_tensors(preds: Array, target: Array) -> Tuple[Array, Array]:
@@ -15,8 +15,8 @@ def _unsqueeze_tensors(preds: Array, target: Array) -> Tuple[Array, Array]:
 
 def _log_cosh_error_update(preds: Array, target: Array, num_outputs: int) -> Tuple[Array, Array]:
     _check_same_shape(preds, target)
-    preds = jnp.asarray(preds, jnp.float32)
-    target = jnp.asarray(target, jnp.float32)
+    preds = _as_float(preds)  # dtype-preserving (tmsan TMS-UPCAST)
+    target = _as_float(target)
     preds, target = _unsqueeze_tensors(preds, target)
     diff = preds - target
     # numerically-stable log cosh: |d| + log1p(exp(-2|d|)) - log 2

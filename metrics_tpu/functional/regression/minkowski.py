@@ -2,7 +2,7 @@
 import jax.numpy as jnp
 from jax import Array
 
-from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.checks import _as_float, _check_same_shape
 from metrics_tpu.utils.exceptions import MetricsUserError
 
 
@@ -10,8 +10,8 @@ def _minkowski_distance_update(preds: Array, targets: Array, p: float) -> Array:
     _check_same_shape(preds, targets)
     if not (isinstance(p, (float, int)) and p >= 1):
         raise MetricsUserError(f"Argument ``p`` must be a float or int greater than 1, but got {p}")
-    preds = jnp.asarray(preds, jnp.float32)
-    targets = jnp.asarray(targets, jnp.float32)
+    preds = _as_float(preds)  # dtype-preserving (tmsan TMS-UPCAST)
+    targets = _as_float(targets)
     return jnp.sum(jnp.abs(preds - targets) ** p)
 
 

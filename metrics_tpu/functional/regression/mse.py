@@ -4,13 +4,15 @@ from typing import Tuple, Union
 import jax.numpy as jnp
 from jax import Array
 
-from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.checks import _as_float, _check_same_shape
 
 
 def _mean_squared_error_update(preds: Array, target: Array) -> Tuple[Array, int]:
     _check_same_shape(preds, target)
-    preds = jnp.asarray(preds, jnp.float32)
-    target = jnp.asarray(target, jnp.float32)
+    # dtype-preserving (tmsan TMS-UPCAST): bf16 inputs accumulate in bf16 so a
+    # bf16-declared sum state is not silently promoted to f32
+    preds = _as_float(preds)
+    target = _as_float(target)
     diff = preds - target
     return jnp.sum(diff * diff), target.size
 

@@ -4,15 +4,15 @@ from typing import Tuple, Union
 import jax.numpy as jnp
 from jax import Array
 
-from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.checks import _as_float, _check_same_shape
 
 
 def _symmetric_mean_absolute_percentage_error_update(
     preds: Array, target: Array, epsilon: float = 1.17e-06
 ) -> Tuple[Array, int]:
     _check_same_shape(preds, target)
-    preds = jnp.asarray(preds, jnp.float32)
-    target = jnp.asarray(target, jnp.float32)
+    preds = _as_float(preds)  # dtype-preserving (tmsan TMS-UPCAST)
+    target = _as_float(target)
     abs_per_error = jnp.abs(preds - target) / jnp.maximum(jnp.abs(target) + jnp.abs(preds), epsilon)
     return 2 * jnp.sum(abs_per_error), target.size
 

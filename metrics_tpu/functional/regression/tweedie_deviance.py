@@ -9,7 +9,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import Array
 
-from metrics_tpu.utils.checks import _check_same_shape, _is_concrete
+from metrics_tpu.utils.checks import _as_float, _check_same_shape, _is_concrete
 from metrics_tpu.utils.compute import _safe_xlogy
 
 
@@ -35,8 +35,8 @@ def _tweedie_deviance_score_update(preds: Array, targets: Array, power: float = 
     _check_same_shape(preds, targets)
     if 0 < power < 1:
         raise ValueError(f"Deviance Score is not defined for power={power}.")
-    preds = jnp.asarray(preds, jnp.float32)
-    targets = jnp.asarray(targets, jnp.float32)
+    preds = _as_float(preds)  # dtype-preserving (tmsan TMS-UPCAST)
+    targets = _as_float(targets)
 
     if power == 0:
         deviance_score = (targets - preds) ** 2

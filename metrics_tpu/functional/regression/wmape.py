@@ -4,13 +4,13 @@ from typing import Tuple
 import jax.numpy as jnp
 from jax import Array
 
-from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.checks import _as_float, _check_same_shape
 
 
 def _weighted_mean_absolute_percentage_error_update(preds: Array, target: Array) -> Tuple[Array, Array]:
     _check_same_shape(preds, target)
-    preds = jnp.asarray(preds, jnp.float32)
-    target = jnp.asarray(target, jnp.float32)
+    preds = _as_float(preds)  # dtype-preserving (tmsan TMS-UPCAST)
+    target = _as_float(target)
     return jnp.sum(jnp.abs(preds - target)), jnp.sum(jnp.abs(target))
 
 

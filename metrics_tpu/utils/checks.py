@@ -25,6 +25,21 @@ def _is_concrete(*arrays) -> bool:
     return not any(isinstance(a, jax.core.Tracer) for a in arrays)
 
 
+def _as_float(x) -> Array:
+    """Float array preserving narrow float dtypes (bf16/f16).
+
+    The dtype-preserving replacement for the `jnp.asarray(x, jnp.float32)`
+    idiom (tmsan TMS-UPCAST): a hard f32 cast inside an update kernel silently
+    promotes bf16-declared metric state back to f32 on the first update —
+    2x HBM and a ckpt DtypeDrift against the declared default. Floating inputs
+    keep their dtype; everything else becomes f32.
+    """
+    x = jnp.asarray(x)
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        return x
+    return x.astype(jnp.float32)
+
+
 def _check_same_shape(preds: Array, target: Array) -> None:
     """Raise if shapes differ (reference: utilities/checks.py:39)."""
     if preds.shape != target.shape:

@@ -96,6 +96,12 @@ class BootStrapper(Metric):
             )
         self.sampling_strategy = sampling_strategy
 
+    def _san_input_specs(self, n: int):
+        # tmsan hook (core/metric.py): shapes come from the wrapped metric
+        from metrics_tpu.analysis.san.abstract_inputs import inner_spec
+
+        return inner_spec(self.metrics[0], n) if self.metrics else None
+
     def update(self, *args: Any, **kwargs: Any) -> None:
         """Resample inputs along dim 0 per bootstrap copy (reference: :115-135)."""
         array_types = (jnp.ndarray, np.ndarray)

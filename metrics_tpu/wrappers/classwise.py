@@ -38,6 +38,12 @@ class ClasswiseWrapper(Metric):
             return {f"{name}_{i}": val for i, val in enumerate(x)}
         return {f"{name}_{lab}": val for lab, val in zip(self.labels, x)}
 
+    def _san_input_specs(self, n: int):
+        # tmsan hook (core/metric.py): shapes come from the wrapped metric
+        from metrics_tpu.analysis.san.abstract_inputs import inner_spec
+
+        return inner_spec(self.metric, n)
+
     def update(self, *args: Any, **kwargs: Any) -> None:
         self.metric.update(*args, **kwargs)
 

@@ -34,6 +34,12 @@ class MinMaxMetric(Metric):
         self.add_state("min_val", jnp.asarray(jnp.inf), dist_reduce_fx="min")
         self.add_state("max_val", jnp.asarray(-jnp.inf), dist_reduce_fx="max")
 
+    def _san_input_specs(self, n: int):
+        # tmsan hook (core/metric.py): shapes come from the wrapped metric
+        from metrics_tpu.analysis.san.abstract_inputs import inner_spec
+
+        return inner_spec(self._base_metric, n)
+
     def update(self, *args: Any, **kwargs: Any) -> None:
         self._base_metric.update(*args, **kwargs)
 

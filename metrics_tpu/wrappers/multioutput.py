@@ -98,6 +98,31 @@ class MultioutputWrapper(Metric):
             args_kwargs_by_output.append((selected_args, selected_kwargs))
         return args_kwargs_by_output
 
+    def _san_input_specs(self, n: int):
+        # tmsan hook (core/metric.py): the wrapped metric's shapes gain an
+        # output axis at output_dim (only the trailing-dim layout is modeled)
+        import jax
+
+        from metrics_tpu.analysis.san.abstract_inputs import inner_spec
+
+        if self.output_dim != -1 or not self.metrics:
+            return []  # opt out: non-trailing output dims are not modeled
+        raw = inner_spec(self.metrics[0], n)
+        if raw is None:
+            return None
+        expanded = []
+        for args, kw in raw:
+            expanded.append(
+                (
+                    tuple(
+                        jax.ShapeDtypeStruct(tuple(a.shape) + (len(self.metrics),), a.dtype)
+                        for a in args
+                    ),
+                    kw,
+                )
+            )
+        return expanded
+
     def update(self, *args: Any, **kwargs: Any) -> None:
         reshaped_args_kwargs = self._get_args_kwargs_by_output(*args, **kwargs)
         for metric, (selected_args, selected_kwargs) in zip(self.metrics, reshaped_args_kwargs):

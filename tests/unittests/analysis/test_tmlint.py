@@ -83,6 +83,48 @@ def test_hostsync_clean_guarded_and_static(tmp_path):
     assert findings == []
 
 
+def test_hostsync_bad_bare_imports(tmp_path):
+    """Rule-gap regression (found by tmsan's crosscheck tier): bare-name
+    from-imports of numpy compute calls and aliased jax.device_get."""
+    findings = _lint_snippet(
+        tmp_path,
+        """
+        import jax
+        from numpy import asarray, array
+        from jax import device_get as dget
+
+        @jax.jit
+        def kernel(x):
+            a = asarray(x)
+            b = array(x)
+            c = dget(x)
+            return a.sum() + b.sum() + c.sum()
+        """,
+    )
+    assert ("TM-HOSTSYNC", 8) in _rules_and_lines(findings)  # bare asarray
+    assert ("TM-HOSTSYNC", 9) in _rules_and_lines(findings)  # bare array
+    assert ("TM-HOSTSYNC", 10) in _rules_and_lines(findings)  # aliased device_get
+    assert all(f.rule == "TM-HOSTSYNC" for f in findings)
+
+
+def test_hostsync_clean_bare_imports_static(tmp_path):
+    """Bare numpy imports on static values (shape math, dtype objects) stay clean."""
+    findings = _lint_snippet(
+        tmp_path,
+        """
+        import jax
+        from numpy import asarray, prod, float32
+
+        @jax.jit
+        def kernel(x):
+            n = prod(x.shape)
+            pad = asarray([0.0, 1.0], float32)
+            return x.sum() + int(n) + pad.sum()
+        """,
+    )
+    assert findings == []
+
+
 # --------------------------------------------------------------- TM-PYBRANCH
 
 
@@ -398,10 +440,18 @@ def test_tmlint_no_new_findings():
 
 
 def test_every_rule_documented_and_cross_linked():
-    assert set(RULES) == {
+    from metrics_tpu.analysis.findings import LINT_RULES, SAN_RULES
+
+    assert set(LINT_RULES) == {
         "TM-HOSTSYNC", "TM-PYBRANCH", "TM-DYNSHAPE", "TM-RETRACE",
         "TM-STATE-UNREG", "TM-REDUCE-MISMATCH", "TM-PERSIST",
     }
+    assert set(SAN_RULES) == {
+        "TMS-CALLBACK", "TMS-F64", "TMS-UPCAST", "TMS-BIGCONST",
+        "TMS-COLLECTIVE", "TMS-DYNSHAPE", "TMS-LINTGAP", "TMS-STALE-WAIVER",
+        "TMS-BUDGET",
+    }
+    assert set(RULES) == set(LINT_RULES) | set(SAN_RULES)
     for rule_id, rule in RULES.items():
         text = explain(rule_id)
         assert rule_id in text and rule.runtime_signal in text

@@ -1,0 +1,311 @@
+"""tmsan (metrics_tpu/analysis/san/): per-rule fixtures and the repo-wide gate.
+
+Every TMS rule has a seeded-violation fixture asserting the exact rule ID,
+driven through the same machinery the analyzer uses (abstract trace ->
+collect_graph_facts -> findings). The repo-wide tier runs the full two-tier
+analyzer once (shared module fixture) and asserts: no new findings against the
+checked-in baseline, >100 registered metric classes traced, every TM-HOSTSYNC
+waiver corroborated by jaxpr evidence, and a perturbed cost budget fails the
+gate CI-style.
+"""
+import json
+import pathlib
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import metrics_tpu
+from metrics_tpu.analysis.findings import SAN_RULES
+from metrics_tpu.analysis.san import costs as costs_mod
+from metrics_tpu.analysis.san.crosscheck import corroborate_waivers, lintgap_findings
+from metrics_tpu.analysis.san.jaxpr_rules import (
+    TraceAnchor,
+    collect_graph_facts,
+    findings_from_facts,
+    upcast_findings,
+)
+from metrics_tpu.analysis.san.runner import _trace, run_san
+
+pytestmark = [pytest.mark.lint, pytest.mark.san]
+
+REPO_ROOT = pathlib.Path(metrics_tpu.__file__).resolve().parent.parent
+_ANCHOR = TraceAnchor(path="metrics_tpu/fake.py", line=1, symbol="Fake.update")
+
+
+def _sds(*shape, dtype=jnp.float32):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _facts(fn, *args):
+    closed = jax.make_jaxpr(fn)(*args)
+    return collect_graph_facts(closed, str(REPO_ROOT))
+
+
+def _rules(fn, *args, case="canon"):
+    return sorted({f.rule for f in findings_from_facts(_facts(fn, *args), _ANCHOR, case)})
+
+
+# ------------------------------------------------------------- per-rule seeds
+
+
+def test_callback_rule_fires_on_pure_callback():
+    def bad(x):
+        return jax.pure_callback(lambda a: np.asarray(a) * 2, jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+
+    assert _rules(bad, _sds(8)) == ["TMS-CALLBACK"]
+
+
+def test_callback_rule_fires_on_debug_callback():
+    def bad(x):
+        jax.debug.callback(lambda a: None, x)
+        return x * 2
+
+    assert "TMS-CALLBACK" in _rules(bad, _sds(8))
+
+
+def test_callback_rule_silent_on_pure_graph():
+    assert _rules(lambda x: jnp.sum(x * 2), _sds(8)) == []
+
+
+def test_f64_rule_fires_under_x64():
+    from jax.experimental import enable_x64
+
+    def bad(x):
+        return x.astype(jnp.float64).sum()
+
+    with enable_x64():
+        rules = _rules(bad, _sds(8))
+    assert "TMS-F64" in rules
+
+
+@pytest.mark.filterwarnings("ignore:Explicitly requested dtype")
+def test_f64_rule_silent_on_default_config():
+    # with x64 disabled a f64 request is truncated at the boundary: the traced
+    # graph itself is f32-pure and must not be flagged
+    assert _rules(lambda x: x.astype("float64").sum(), _sds(8)) == []
+
+
+def test_upcast_rule_compares_state_dtypes():
+    ins = {"total": _sds(dtype=jnp.bfloat16), "count": _sds(dtype=jnp.int32)}
+    outs = {"total": _sds(dtype=jnp.float32), "count": _sds(dtype=jnp.int32)}
+    found = upcast_findings(ins, outs, _ANCHOR, "canon:bf16")
+    assert [f.rule for f in found] == ["TMS-UPCAST"]
+    assert "total" in found[0].message
+    # dtype-preserving update: no finding
+    assert upcast_findings(ins, dict(ins), _ANCHOR, "canon:bf16") == []
+
+
+def test_bigconst_rule_fires_on_baked_table():
+    table = jnp.asarray(np.arange(64 * 1024, dtype=np.float32))  # 256 KiB
+
+    def bad(x):
+        return x.sum() + table[:8].sum()
+
+    assert "TMS-BIGCONST" in _rules(bad, _sds(8))
+
+
+def test_bigconst_rule_silent_on_small_consts():
+    small = jnp.asarray(np.arange(16, dtype=np.float32))
+    assert _rules(lambda x: x.sum() + small.sum(), _sds(8)) == []
+
+
+def test_collective_rule_fires_on_named_axis_psum():
+    def bad(x):
+        return jax.vmap(lambda v: jax.lax.psum(v, "b"), axis_name="b")(x)
+
+    assert "TMS-COLLECTIVE" in _rules(bad, _sds(8))
+
+
+def test_dynshape_classified_trace_failure():
+    def bad(x):
+        if (x > 0).any():  # TracerBoolConversionError under tracing
+            return x.sum()
+        return -x.sum()
+
+    outcome = _trace(bad, (_sds(8),), str(REPO_ROOT))
+    assert outcome.error is not None and outcome.facts is None
+    assert type(outcome.error).__name__ == "TracerBoolConversionError"
+
+
+def test_unclassified_trace_failure_is_a_skip_not_a_finding():
+    def weird(x):
+        raise RuntimeError("unrelated breakage")
+
+    outcome = _trace(weird, (_sds(8),), str(REPO_ROOT))
+    assert outcome.error is None and outcome.skip.startswith("trace failed: RuntimeError")
+
+
+# -------------------------------------------------------------- crosscheck
+
+
+def test_lintgap_fires_without_covering_hostsync_finding():
+    callbacks = [("pure_callback", "metrics_tpu/some/mod.py", 42, "helper")]
+    found = lintgap_findings(callbacks, lint_findings=[])
+    assert [f.rule for f in found] == ["TMS-LINTGAP"]
+
+
+def test_lintgap_silent_when_hostsync_covers_it():
+    from metrics_tpu.analysis.findings import Finding
+
+    covering = Finding(
+        rule="TM-HOSTSYNC", path="metrics_tpu/some/mod.py", line=41, col=0,
+        symbol="helper", message="", waived=True,
+    )
+    callbacks = [("pure_callback", "metrics_tpu/some/mod.py", 42, "helper")]
+    assert lintgap_findings(callbacks, [covering]) == []
+
+
+def test_stale_waiver_vs_corroborated():
+    from metrics_tpu.analysis.findings import Finding
+
+    key = ("TM-HOSTSYNC", "metrics_tpu/some/mod.py", "helper")
+    waivers = {key: "claims host-only"}
+    finding = Finding(rule="TM-HOSTSYNC", path=key[1], line=10, col=0, symbol="helper", message="")
+    # waived line absent from every traced graph -> corroborated
+    stale, status = corroborate_waivers(waivers, [finding], footprint=set(), callbacks=[])
+    assert stale == [] and "corroborated-by-absence" in status[":".join(key)]
+    # waived line participates in a traced graph -> stale
+    stale, status = corroborate_waivers(waivers, [finding], footprint={(key[1], 10)}, callbacks=[])
+    assert [f.rule for f in stale] == ["TMS-STALE-WAIVER"]
+    assert "STALE" in status[":".join(key)]
+
+
+# ------------------------------------------------------------- cost budget
+
+
+def test_budget_breach_and_missing_entry():
+    current = {"M.update[canon]": {"flops": 200.0, "bytes_accessed": 100.0, "peak_bytes": 10.0}}
+    budget = {
+        "jax": jax.__version__,
+        "backend": jax.default_backend(),
+        "entries": {"M.update[canon]": {"flops": 100.0, "bytes_accessed": 100.0, "peak_bytes": 10.0}},
+    }
+    findings, _ = costs_mod.compare_costs(current, budget, anchors={})
+    assert [f.rule for f in findings] == ["TMS-BUDGET"] and "flops" in findings[0].message
+
+    findings, _ = costs_mod.compare_costs({"New.update[canon]": current["M.update[canon]"]}, budget, anchors={})
+    assert [f.rule for f in findings] == ["TMS-BUDGET"] and "no budget recorded" in findings[0].message
+
+
+def test_budget_within_tolerance_is_clean():
+    current = {"M.update[canon]": {"flops": 110.0, "bytes_accessed": 100.0, "peak_bytes": 10.0}}
+    budget = {
+        "jax": jax.__version__,
+        "backend": jax.default_backend(),
+        "entries": {"M.update[canon]": {"flops": 100.0, "bytes_accessed": 100.0, "peak_bytes": 10.0}},
+    }
+    findings, notes = costs_mod.compare_costs(current, budget, anchors={})
+    assert findings == []
+
+
+def test_budget_version_skew_degrades_to_warning():
+    current = {"M.update[canon]": {"flops": 200.0, "bytes_accessed": 100.0, "peak_bytes": 10.0}}
+    budget = {"jax": "0.0.1", "backend": "tpu", "entries": {"M.update[canon]": {"flops": 100.0}}}
+    findings, notes = costs_mod.compare_costs(current, budget, anchors={})
+    assert findings == [] and any("version-skew" in n for n in notes)
+
+
+# --------------------------------------------------------- repo-wide gate
+
+
+@pytest.fixture(scope="module")
+def repo_report():
+    """One full two-tier run with the cost tier, obs enabled (san.* counters)."""
+    from metrics_tpu import obs
+
+    obs.enable(clear=True)
+    try:
+        report = run_san(str(REPO_ROOT / "metrics_tpu"))
+    finally:
+        snap = obs.snapshot()
+        obs.disable()
+    return report, snap
+
+
+def test_repo_wide_no_new_findings(repo_report):
+    report, _ = repo_report
+    msgs = "\n".join(f.format() for f in report.new_findings + (report.lint.new_findings if report.lint else []))
+    assert not report.new_findings and not (report.lint and report.lint.new_findings), f"new findings:\n{msgs}"
+    # stale waivers rot silently, in either tier's scope
+    unused = set(report.unused_waivers) | set(report.lint.unused_waivers if report.lint else [])
+    assert not unused, f"stale baseline waivers: {sorted(unused)}"
+
+
+def test_registry_coverage_over_100_traced_classes(repo_report):
+    report, _ = repo_report
+    metric_classes = [k for k in report.traced if not k.startswith("ops.")]
+    assert len(metric_classes) > 100, f"only {len(metric_classes)} metric classes traced"
+    assert any(k.startswith("ops.") for k in report.traced), "ops/ entrypoints missing from the sweep"
+    # every skip must carry an explicit reason
+    assert all(reason for reason in report.skipped.values())
+
+
+def test_all_hostsync_waivers_corroborated(repo_report):
+    """Acceptance: every TM-HOSTSYNC waiver is corroborated by jaxpr evidence."""
+    report, _ = repo_report
+    assert report.waiver_status, "no TM-HOSTSYNC waivers were checked"
+    bad = {k: v for k, v in report.waiver_status.items() if "corroborated" not in v}
+    assert not bad, f"uncorroborated TM-HOSTSYNC waivers: {bad}"
+
+
+def test_obs_san_namespace_counters(repo_report):
+    _, snap = repo_report
+    san = snap.get("san", {})
+    assert san.get("traced", 0) > 100, f"san.* counters missing: {sorted(snap)}"
+    assert san.get("findings", 0) >= 1  # the waived TMS-UPCAST triage is counted
+
+
+def test_budget_regression_fails_ci_style(repo_report, tmp_path):
+    """Perturb tmsan_costs.json (halve one recorded flops budget) and assert
+    the gate produces an unwaived TMS-BUDGET finding — the CI failure mode."""
+    report, _ = repo_report
+    assert report.costs, "cost tier produced no entries"
+    payload = costs_mod.load_costs(str(REPO_ROOT / costs_mod.COSTS_FILENAME))
+    entry = next(k for k in sorted(payload["entries"]) if payload["entries"][k]["flops"] > 0)
+    payload["entries"][entry]["flops"] /= 2.0
+    perturbed = tmp_path / "tmsan_costs.json"
+    perturbed.write_text(json.dumps(payload))
+
+    findings, _ = costs_mod.compare_costs(report.costs, json.loads(perturbed.read_text()), anchors={})
+    breached = [f for f in findings if f.rule == "TMS-BUDGET" and f.symbol == entry]
+    assert breached, f"halving {entry}'s flops budget did not breach the gate"
+    # CI-style: the breach must not be absorbed by the checked-in baseline
+    from metrics_tpu.analysis import baseline as baseline_mod
+    from metrics_tpu.analysis.findings import SAN_RULES as _SAN
+
+    waivers = baseline_mod.scope_waivers(
+        baseline_mod.load_baseline(str(REPO_ROOT / baseline_mod.BASELINE_FILENAME)), _SAN
+    )
+    new, _ = baseline_mod.apply_baseline(list(breached), waivers)
+    assert new, "TMS-BUDGET breach was unexpectedly waived by the baseline"
+
+
+def test_seeded_callback_fails_end_to_end(monkeypatch):
+    """Acceptance: a pure_callback smuggled into a registered metric's update
+    turns into TMS-CALLBACK (+ TMS-LINTGAP via crosscheck) and exit code 1."""
+    import metrics_tpu.regression.mse as mse_mod
+
+    orig = mse_mod._mean_squared_error_update
+
+    def smuggled(preds, target):
+        s, n = orig(preds, target)
+        s = jax.pure_callback(lambda v: np.asarray(v), jax.ShapeDtypeStruct(jnp.shape(s), jnp.result_type(s)), s)
+        return s, n
+
+    monkeypatch.setattr(mse_mod, "_mean_squared_error_update", smuggled)
+    report = run_san(str(REPO_ROOT / "metrics_tpu"), with_costs=False, with_lint=False)
+    rules = {f.rule for f in report.new_findings}
+    assert "TMS-CALLBACK" in rules, sorted(rules)
+    assert any(f.symbol == "MeanSquaredError.update" for f in report.new_findings if f.rule == "TMS-CALLBACK")
+    assert report.exit_code == 1
+
+
+def test_san_rules_explainable():
+    from metrics_tpu.analysis import explain
+
+    for rule in SAN_RULES:
+        text = explain(rule)
+        assert rule in text and "Waiving" in text
