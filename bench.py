@@ -899,6 +899,73 @@ def bench_ckpt(cat_docs: int = 1 << 22, trials: int = 5) -> dict:
     }
 
 
+def bench_fused(n: int = 1 << 20, steps: int = 8, trials: int = 5) -> dict:
+    """``--fused``: eager-vs-fused collection step over the canonical
+    five-group collection (core/fused.py) — the ROADMAP item 4 N->1 claim.
+
+    Reports the fused step p50 ms with vs_baseline = eager_p50/fused_p50, plus
+    the directly measured launches/step for both tiers (sum of the obs
+    ``dispatches`` counter across scopes, off one instrumented pass) and the
+    executable-cache hit rate. The timed passes run with obs OFF (bench-parity
+    criterion); only the launch-count pass flips it on.
+    """
+    from metrics_tpu.core.fused import canonical_collection, engine_for
+
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+    preds = jax.random.uniform(k1, (n,), jnp.float32)
+    target = jax.random.randint(k2, (n,), 0, 2, dtype=jnp.int32)
+
+    def leaders_ready(coll):
+        for cg in coll._groups.values():
+            m = coll._modules[cg[0]]
+            jax.block_until_ready(jax.tree_util.tree_leaves(m.state_pytree()))
+
+    def timed_pass(coll, label):
+        coll.reset()
+        with _obs().stopwatch("bench", label) as sw:
+            for _ in range(steps):
+                coll.update(preds, target)
+            leaders_ready(coll)
+        return sw.elapsed / steps * 1000  # ms/step
+
+    results = {}
+    for label, fused_flag in (("eager", False), ("fused", True)):
+        coll = canonical_collection(fused=fused_flag)
+        coll.update(preds, target)  # compile/warm
+        leaders_ready(coll)
+        results[label] = statistics.median(timed_pass(coll, f"fused_bench_{label}") for _ in range(trials))
+        if fused_flag:
+            fused_coll = coll
+
+    # launch count per step, measured off the counters (not inferred)
+    launches = {}
+    for label, fused_flag in (("eager", False), ("fused", True)):
+        coll = canonical_collection(fused=fused_flag)
+        coll.update(preds, target)  # warm outside the counted window
+        with _obs().observe(clear=True):
+            for _ in range(3):
+                coll.update(preds, target)
+            snap = _obs().snapshot()
+        launches[label] = (
+            sum(v.get("dispatches", 0) for v in snap.values()) / 3
+        )
+    stats = engine_for(fused_coll).stats
+    hit_rate = stats["cache_hits"] / max(1, stats["cache_hits"] + stats["cache_misses"])
+    return {
+        "metric": "fused_collection_step",
+        "value": round(results["fused"], 3),
+        "unit": "ms/step",
+        "vs_baseline": round(results["eager"] / results["fused"], 2),
+        "eager_ms_per_step": round(results["eager"], 3),
+        "launches_per_step_fused": launches["fused"],
+        "launches_per_step_eager": launches["eager"],
+        "cache_hit_rate": round(hit_rate, 3),
+        "bound": "five compute groups over one (preds, target) pair: eager pays"
+                 " five dispatches + five state round-trips per step, fused one"
+                 " donated launch (in-place HBM accumulation)",
+    }
+
+
 def bench_lint(runs: int = 3) -> dict:
     """``--lint-overhead``: cold tmlint wall time over the full package.
 
@@ -997,8 +1064,16 @@ if __name__ == "__main__":
     parser = argparse.ArgumentParser(description="metrics_tpu benchmarks")
     parser.add_argument(
         "--config",
-        choices=("accuracy", "logits", "confmat", "map", "ssim", "retrieval", "auroc", "fid", "lint", "all"),
+        choices=("accuracy", "logits", "confmat", "map", "ssim", "retrieval", "auroc", "fid", "fused", "lint", "all"),
         default="all",
+    )
+    parser.add_argument(
+        "--fused",
+        action="store_true",
+        help="also run the fused-collection bench: eager vs fused (one donated"
+        " XLA launch, core/fused.py) step time over the canonical five-group"
+        " collection, launches/step from the obs `dispatches` counter, and the"
+        " executable-cache hit rate (also runs under --config all)",
     )
     parser.add_argument(
         "--ckpt",
@@ -1061,17 +1136,20 @@ if __name__ == "__main__":
         ("fid", bench_fid),
         ("retrieval", bench_retrieval),
         ("auroc", bench_auroc),
+        ("fused", bench_fused),
         ("ckpt", bench_ckpt),
         ("lint", bench_lint),
         ("san", bench_san),
     ):
         if name == "ckpt" and not cli.ckpt:
             continue
+        if name == "fused" and not (cli.fused or config in ("fused", "all")):
+            continue
         if name == "lint" and not (cli.lint_overhead or config in ("lint", "all")):
             continue
         if name == "san" and not (cli.san_overhead or config == "all"):
             continue
-        if config in (name, "all") or name in ("ckpt", "lint", "san"):
+        if config in (name, "all") or name in ("ckpt", "fused", "lint", "san"):
             try:
                 result = fn()
                 summary[result["metric"]] = {

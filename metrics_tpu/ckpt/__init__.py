@@ -53,6 +53,7 @@ from metrics_tpu.ckpt.manager import (
     latest_step,
     restore_checkpoint,
     save_checkpoint,
+    secure_pending_snapshots,
     wait_for_all_saves,
 )
 from metrics_tpu.ckpt.manifest import metric_schema, validate_schema
@@ -73,6 +74,7 @@ __all__ = [
     "metric_schema",
     "restore_checkpoint",
     "save_checkpoint",
+    "secure_pending_snapshots",
     "validate_schema",
     "wait_for_all_saves",
 ]

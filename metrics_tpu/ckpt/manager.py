@@ -193,6 +193,61 @@ class CheckpointWrite:
         self._done.set()
 
 
+class _PendingSnapshot:
+    """In-flight async-save snapshot holding device-array *references*.
+
+    The zero-copy async contract (snapshot refs on the critical path, device->
+    host transfer on the writer thread) breaks the moment a referenced buffer
+    is DONATED: the fused update engine (core/fused.py) donates the live state
+    tree to XLA, which deletes the input arrays a pending snapshot still
+    points at. Registered here until materialized, a snapshot can be "secured"
+    from the donating thread: :func:`secure_pending_snapshots` converts the
+    intersecting entries device->host under the snapshot's lock *before* the
+    donation happens (snapshot-before-donate). The writer thread takes the
+    same lock and materializes everything as its first step, so whichever
+    side runs first, the bytes that reach disk are always pre-donation.
+    """
+
+    def __init__(self, entries: List[Tuple[str, Any, bool]]) -> None:
+        self.entries = entries
+        self.lock = threading.Lock()
+
+    def materialize(self, ids: Optional[set] = None) -> int:
+        """Device->host convert entries (all, or only those whose array id is
+        in ``ids``); returns the number converted."""
+        import numpy as np
+
+        n = 0
+        with self.lock:
+            for i, (key, value, is_cat) in enumerate(self.entries):
+                if isinstance(value, np.ndarray):
+                    continue
+                if ids is not None and id(value) not in ids:
+                    continue
+                self.entries[i] = (key, np.asarray(value), is_cat)
+                n += 1
+        return n
+
+
+_PENDING_SNAPSHOTS: List[_PendingSnapshot] = []
+_PENDING_LOCK = threading.Lock()
+
+
+def secure_pending_snapshots(arrays: Any) -> int:
+    """Materialize in-flight async-save entries referencing ``arrays``.
+
+    Call with the device arrays about to be invalidated (donated); returns the
+    number of snapshot entries transferred to host. Cheap no-op when no async
+    save is in flight.
+    """
+    if not _PENDING_SNAPSHOTS:
+        return 0
+    ids = {id(a) for a in arrays}
+    with _PENDING_LOCK:
+        pending = list(_PENDING_SNAPSHOTS)
+    return sum(snap.materialize(ids) for snap in pending)
+
+
 _INFLIGHT: List[CheckpointWrite] = []
 _INFLIGHT_LOCK = threading.Lock()
 # highest step this process has assigned per series directory: auto-stepping
@@ -469,10 +524,26 @@ def save_checkpoint(
 
     tree, entries = _snapshot(obj, persistent_only)
     handle = CheckpointWrite(directory, step)
+    snap: Optional[_PendingSnapshot] = None
+    if not blocking:
+        # register the reference snapshot so a donation-backed fused update
+        # racing this save secures (materializes) it before invalidating the
+        # arrays (see _PendingSnapshot)
+        snap = _PendingSnapshot(entries)
+        with _PENDING_LOCK:
+            _PENDING_SNAPSHOTS.append(snap)
 
     def write() -> None:
         t0 = time.perf_counter()
         try:
+            if snap is not None:
+                # device->host first, under the snapshot lock: after this the
+                # payload is immune to buffer donation/deletion (the disk IO
+                # below works off host arrays)
+                snap.materialize()
+                with _PENDING_LOCK:
+                    if snap in _PENDING_SNAPSHOTS:
+                        _PENDING_SNAPSHOTS.remove(snap)
             with _scope("tm.ckpt/save"):
                 tmp_dir = os.path.join(directory, _TMP_PREFIX + _step_name(step))
                 try:
@@ -517,6 +588,10 @@ def save_checkpoint(
         except BaseException as err:  # noqa: BLE001 — surfaced via handle.result()
             handle._finish(None, err)
         finally:
+            if snap is not None:
+                with _PENDING_LOCK:
+                    if snap in _PENDING_SNAPSHOTS:
+                        _PENDING_SNAPSHOTS.remove(snap)
             with _INFLIGHT_LOCK:
                 if handle in _INFLIGHT:
                     _INFLIGHT.remove(handle)

@@ -44,6 +44,9 @@ class MetricCollection:
     """
 
     _groups: Dict[int, List[str]]
+    # class-level default so instances materialized without __init__ (old
+    # pickles, test doubles) read as eager rather than AttributeError-ing
+    fused: bool = False
 
     def __init__(
         self,
@@ -52,11 +55,19 @@ class MetricCollection:
         prefix: Optional[str] = None,
         postfix: Optional[str] = None,
         compute_groups: Union[bool, List[List[str]]] = True,
+        fused: bool = False,
     ) -> None:
         self._modules: "OrderedDict[str, Metric]" = OrderedDict()
         self.prefix = self._check_arg(prefix, "prefix")
         self.postfix = self._check_arg(postfix, "postfix")
         self._enable_compute_groups = compute_groups
+        if not isinstance(fused, bool):
+            raise ValueError(f"Expected keyword argument `fused` to be a `bool` but got {fused}")
+        # route update/forward through the fused one-launch engine
+        # (core/fused.py): compute-group leaders chained into ONE donated jitted
+        # step; ineligible groups (host-side update, list states,
+        # compute_on_cpu, mid-sync, wrappers) stay on the eager path per group
+        self.fused = fused
         self._groups_checked: bool = False
         self._state_is_copy: bool = False
         self._validate_groups_runtime: bool = os.environ.get(
@@ -130,6 +141,10 @@ class MetricCollection:
         syncs eagerly inside ``forward``), at the cost of splitting that group.
         """
         self._split_diverged_members()
+        if self.fused:
+            from metrics_tpu.core.fused import engine_for
+
+            return engine_for(self).forward(self, *args, **kwargs)
         res: Dict[str, Any] = {}
         for cg in self._groups.values():
             m0 = self._modules[cg[0]]
@@ -177,6 +192,11 @@ class MetricCollection:
                 self._validate_groups_against_runtime(*args, **kwargs)
                 return
             self._split_diverged_members()
+            if self.fused:
+                from metrics_tpu.core.fused import engine_for
+
+                engine_for(self).update(self, *args, **kwargs)
+                return
             for cg in self._groups.values():
                 m0 = self._modules[cg[0]]
                 m0.update(*args, **m0._filter_kwargs(**kwargs))
@@ -474,7 +494,17 @@ class MetricCollection:
         return {k: m.init_state() for k, m in self.items(keep_base=True, copy_state=False)}
 
     def local_update(self, state: Dict[str, Dict[str, Any]], *args: Any, **kwargs: Any) -> Dict[str, Dict[str, Any]]:
-        """Pure state transition for every metric (kwargs filtered per metric)."""
+        """Pure state transition for every metric.
+
+        Kwargs are filtered per metric; positional args are forwarded verbatim
+        to every member, so an arity mismatch is checked eagerly here
+        (a typed :class:`~metrics_tpu.utils.exceptions.MetricsUserError` naming
+        the offending metric) instead of surfacing as a deep trace error.
+        """
+        from metrics_tpu.core.fused import _check_update_arity
+
+        for k, m in self.items(keep_base=True, copy_state=False):
+            _check_update_arity(k, m, args)
         return {
             k: m.local_update(state[k], *args, **m._filter_kwargs(**kwargs))
             for k, m in self.items(keep_base=True, copy_state=False)

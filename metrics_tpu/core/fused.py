@@ -1,0 +1,683 @@
+"""Fused, donation-backed ``MetricCollection.update``: one XLA launch per step.
+
+Under serving-shaped traffic the per-step cost of a collection is dominated by
+N separate eager ``update`` dispatches plus N host-side state round-trips
+(ROADMAP item 4). The pure-functional tier (``Metric.init_state`` /
+``local_update``) and the donation-safe state buffers (``core/state.py``)
+already provide everything a compile-once/execute-many step needs — this
+module wires them together *inside* the library:
+
+- **One launch.** ``MetricCollection(..., fused=True)`` routes ``update`` (and
+  ``forward``) through :class:`FusedCollectionUpdate`: the compute-group
+  leaders' state pytrees are gathered into one dict, a single pure function
+  ``new_states = f(states, *inputs)`` chains every leader's ``local_update``,
+  and the whole step executes as one jitted XLA program.
+- **Zero-copy accumulation.** The state tree is donated
+  (``donate_argnums``): XLA accumulates in-place in HBM and the returned
+  buffers *are* the old ones — no defensive copies, no N per-metric
+  host round-trips. Live metric (and compute-group alias) state is re-pointed
+  at the returned arrays after every launch.
+- **Executable cache.** Executables are AOT-compiled (``.lower().compile()``)
+  once per (input avals, group topology, per-metric static signature) and
+  reused; the obs retrace detector is the storm alarm — a collection fed
+  churning shapes warns exactly like a single metric would.
+- **Partial fusion.** Groups that cannot fuse — ``_host_side_update`` classes,
+  list-state / ``compute_on_cpu`` metrics, metrics mid-``sync_context``,
+  metrics holding child metrics (wrappers), or groups whose trace fails at
+  runtime — fall back to the eager per-group path, so a mixed collection stays
+  correct and fuses whatever it can.
+
+Donation safety is centralized here: leaves that alias a metric's registered
+default (the state right after ``reset``/construction) are copied before the
+launch so defaults survive; duplicate buffers across groups are deduplicated
+(XLA rejects donating one buffer twice); and in-flight async checkpoint
+snapshots are materialized device->host *before* the donation invalidates the
+arrays they reference (``metrics_tpu.ckpt.manager.secure_pending_snapshots``).
+
+Observability (all behind the usual zero-overhead gate): ``fused.launches`` /
+``fused.cache_hits`` / ``fused.fallbacks`` / ``fused.dispatches`` counters,
+``tm.fused/step`` trace annotation at dispatch, and — independent of the obs
+gate — every leader's ops are wrapped in ``jax.named_scope("tm.fused/<Class>")``
+inside the traced program so XProf attributes HLO per metric even in the fused
+launch. The ``dispatches`` counter family (one per actual XLA dispatch: an
+eager ``update`` call or one fused launch) is what makes the N->1 claim
+measurable in the JSONL export; sum the ``dispatches`` counter across scopes
+for the per-step launch count.
+"""
+import functools
+import warnings
+import weakref
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.core.state import CatBuffer
+from metrics_tpu.obs import recompile as _obs_recompile
+from metrics_tpu.obs import registry as _obs
+from metrics_tpu.obs import scopes as _obs_scopes
+from metrics_tpu.utils.data import _squeeze_if_scalar, is_array
+
+__all__ = [
+    "FusedCollectionUpdate",
+    "engine_for",
+    "fusion_fallback_reason",
+    "canonical_fused_update",
+    "canonical_fused_case",
+]
+
+#: placeholder marking a dynamic (array) leaf position in a flattened input
+_DYN = object()
+
+
+# ------------------------------------------------------------- eligibility
+
+
+def fusion_fallback_reason(
+    leader: Metric, members: Sequence[Metric] = (), forward: bool = False
+) -> Optional[str]:
+    """Why this compute group cannot fuse (None = fusable).
+
+    Static contract checks only — runtime trace failures are detected (and
+    cached) by the engine itself. The checks mirror the eligibility table in
+    ``docs/source/pages/fused_update.rst``.
+    """
+    from metrics_tpu.ckpt.manifest import child_metrics
+
+    if type(leader)._host_side_update:
+        return "update is host-side by contract (_host_side_update)"
+    if not leader._defaults:
+        return "no registered state (nothing to donate or chain)"
+    if leader.compute_on_cpu:
+        return "compute_on_cpu moves state off-device after every update"
+    if any(isinstance(v, list) for v in (getattr(leader, n) for n in leader._defaults)):
+        return "list ('cat') state without cat_capacity is host-ragged"
+    if child_metrics(leader):
+        return "holds child metrics (wrapper updates are not pure over registered state)"
+    if forward:
+        if any(m.dist_sync_on_step for m in members or (leader,)):
+            return "dist_sync_on_step forwards sync eagerly inside the step"
+        if any(type(m)._host_side_compute for m in members or (leader,)):
+            return "a member's compute is host-side by contract (_host_side_compute)"
+    return None
+
+
+def _check_update_arity(name: str, metric: Metric, args: Tuple[Any, ...]) -> None:
+    """Raise a typed, actionable error when positional inputs cannot bind.
+
+    ``MetricCollection.local_update`` (and the fused engine) filter *kwargs*
+    per metric but forward positional args verbatim to every member; a member
+    whose ``update`` takes fewer positional parameters used to surface this as
+    a deep trace-time ``TypeError``. Checked here, eagerly, with the metric
+    named.
+    """
+    import inspect
+
+    from metrics_tpu.utils.exceptions import MetricsUserError
+
+    params = [
+        p
+        for p in metric._update_signature.parameters.values()
+        if p.name != "self"
+    ]
+    if any(p.kind == inspect.Parameter.VAR_POSITIONAL for p in params):
+        return
+    positional = [
+        p
+        for p in params
+        if p.kind in (inspect.Parameter.POSITIONAL_ONLY, inspect.Parameter.POSITIONAL_OR_KEYWORD)
+    ]
+    if len(args) > len(positional):
+        names = ", ".join(p.name for p in positional) or "<none>"
+        raise MetricsUserError(
+            f"Metric `{name}` ({type(metric).__name__}) accepts at most"
+            f" {len(positional)} positional update argument(s) ({names}) but the"
+            f" collection update was called with {len(args)}. Positional args are"
+            " forwarded verbatim to every metric — pass per-metric inputs as"
+            " keyword arguments (they are filtered against each metric's update"
+            " signature), or drop the metric into its own collection."
+        )
+
+
+# --------------------------------------------------------- input splitting
+
+
+def _split_inputs(args: Tuple, kwargs: Dict) -> Tuple[List[Any], Tuple[Any, tuple]]:
+    """Partition ``(args, kwargs)`` leaves into dynamic arrays and static spec.
+
+    Arrays (jax/np) are traced inputs; everything else (python scalars,
+    strings, None...) is closed over statically — exactly the split ``jit``'s
+    cache key semantics imply, and the same split the obs retrace fingerprint
+    models (``recompile._fingerprint_leaf``).
+    """
+    leaves, treedef = jax.tree_util.tree_flatten((args, dict(kwargs)))
+    dyn: List[Any] = []
+    spec: List[Any] = []
+    for leaf in leaves:
+        if is_array(leaf):
+            dyn.append(jnp.asarray(leaf))
+            spec.append(_DYN)
+        else:
+            spec.append(leaf)
+    return dyn, (treedef, tuple(spec))
+
+
+def _merge_inputs(dyn: Sequence[Any], split_spec: Tuple[Any, tuple]) -> Tuple[Tuple, Dict]:
+    treedef, spec = split_spec
+    it = iter(dyn)
+    leaves = [next(it) if s is _DYN else s for s in spec]
+    args, kwargs = jax.tree_util.tree_unflatten(treedef, leaves)
+    return args, kwargs
+
+
+def _static_key(spec: Tuple[Any, tuple]) -> Tuple:
+    """Hashable cache-key component for the static leaves (value-sensitive)."""
+    treedef, leaves = spec
+    parts = []
+    for leaf in leaves:
+        if leaf is _DYN:
+            parts.append(_DYN)
+        elif isinstance(leaf, (bool, int, float, str, bytes, type(None))):
+            parts.append((type(leaf).__name__, leaf))
+        else:
+            # exotic static object: keyed by identity — a replaced object
+            # retraces rather than silently reusing a stale closure
+            parts.append(("id", id(leaf)))
+    return (treedef, tuple(parts))
+
+
+def _aval_key(tree: Any) -> Tuple:
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    return (treedef, tuple((tuple(l.shape), str(l.dtype)) for l in leaves))
+
+
+# ------------------------------------------------------------------ engine
+
+
+class FusedCollectionUpdate:
+    """Per-collection fused-update engine (see module docstring).
+
+    Held in a module-level :class:`weakref.WeakKeyDictionary` keyed by the
+    collection (:func:`engine_for`) so collections stay picklable/deep-copyable
+    and the executable cache dies with its collection.
+    """
+
+    def __init__(self) -> None:
+        # (mode, topology, state avals, input avals+statics) -> compiled step
+        self._cache: Dict[Tuple, Any] = {}
+        # cache keys whose chained compile failed: permanent eager for that key
+        self._broken_keys: set = set()
+        # leader collection-names whose individual trace failed: permanent
+        # eager for that group (re-probed only if the key changes shape)
+        self._trace_fallbacks: Dict[str, str] = {}
+        self.stats: Dict[str, int] = {
+            "launches": 0,
+            "cache_hits": 0,
+            "cache_misses": 0,
+            "fallback_groups": 0,
+        }
+
+    # ---------------------------------------------------------- partition
+
+    def _partition(
+        self, collection: Any, forward: bool
+    ) -> Tuple[List[Tuple[str, Tuple[str, ...]]], List[List[str]], Dict[str, str]]:
+        """Split the collection's compute groups into fused vs eager."""
+        fused: List[Tuple[str, Tuple[str, ...]]] = []
+        eager: List[List[str]] = []
+        reasons: Dict[str, str] = {}
+        for cg in collection._groups.values():
+            leader = collection._modules[cg[0]]
+            reason = self._trace_fallbacks.get(cg[0]) or fusion_fallback_reason(
+                leader, [collection._modules[n] for n in cg], forward=forward
+            )
+            if reason is None and leader._is_synced:
+                # dynamic condition: a metric inside sync_context views synced
+                # state; donating/re-pointing it would corrupt the unsync cache
+                reason = "mid-sync_context (synced state is a temporary view)"
+            if reason is None:
+                fused.append((cg[0], tuple(cg)))
+            else:
+                eager.append(list(cg))
+                reasons[cg[0]] = reason
+        return fused, eager, reasons
+
+    # ------------------------------------------------------------ tracing
+
+    def _probe(
+        self,
+        collection: Any,
+        fused: List[Tuple[str, Tuple[str, ...]]],
+        states: Dict[str, Any],
+        dyn: List[Any],
+        split_spec: Tuple[Any, tuple],
+        forward: bool,
+    ) -> Tuple[List[Tuple[str, Tuple[str, ...]]], List[List[str]]]:
+        """Abstractly trace each candidate group alone; failures fall back.
+
+        Per-group ``eval_shape`` probes attribute a trace failure to the group
+        that caused it (a chained trace error names no one), and the failure is
+        cached so steady-state steps never re-probe.
+        """
+        survivors: List[Tuple[str, Tuple[str, ...]]] = []
+        demoted: List[List[str]] = []
+        for name, members in fused:
+            m = collection._modules[name]
+
+            def one_group(state, dyn_leaves, _m=m):
+                args, kwargs = _merge_inputs(dyn_leaves, split_spec)
+                new = _m.local_update(state, *args, **_m._filter_kwargs(**kwargs))
+                if forward:
+                    batch = _m.local_update(_m.init_state(), *args, **_m._filter_kwargs(**kwargs))
+                    vals = tuple(
+                        collection._modules[n].compute_from(batch) for n in members
+                    )
+                    return new, vals
+                return new
+
+            try:
+                jax.eval_shape(one_group, states[name], dyn)
+            except Exception as err:  # noqa: BLE001 — fallback, never crash the step
+                reason = f"trace failed: {type(err).__name__}: {str(err).splitlines()[0][:200]}"
+                self._trace_fallbacks[name] = reason
+                demoted.append(list(members))
+                warnings.warn(
+                    f"metrics_tpu fused update: group led by `{name}`"
+                    f" ({type(m).__name__}) cannot fuse and stays eager — {reason}",
+                    RuntimeWarning,
+                    stacklevel=4,
+                )
+            else:
+                survivors.append((name, members))
+        return survivors, demoted
+
+    def _build(
+        self,
+        collection: Any,
+        fused: List[Tuple[str, Tuple[str, ...]]],
+        split_spec: Tuple[Any, tuple],
+        forward: bool,
+    ) -> Callable:
+        """The pure chained step function over all fused groups."""
+        bound = [
+            (name, members, collection._modules[name],
+             tuple(collection._modules[n] for n in members))
+            for name, members in fused
+        ]
+
+        def step(states, fresh, dyn_leaves):
+            args, kwargs = _merge_inputs(dyn_leaves, split_spec)
+            new_states: Dict[str, Any] = {}
+            results: Dict[str, Any] = {}
+            for name, members, leader, member_metrics in bound:
+                filtered = leader._filter_kwargs(**kwargs)
+                # named per metric so XProf attributes HLO inside the single
+                # launch exactly like the eager tm.update/<M> scopes would
+                with jax.named_scope(f"tm.fused/{type(leader).__name__}"):
+                    new_states[name] = leader.local_update(states[name], *args, **filtered)
+                    if forward:
+                        batch = leader.local_update(fresh[name], *args, **filtered)
+                        for member_name, member in zip(members, member_metrics):
+                            results[member_name] = member.compute_from(batch)
+            return new_states, results
+
+        if forward:
+            return step
+        return lambda states, dyn_leaves: step(states, None, dyn_leaves)
+
+    def _compile(
+        self,
+        collection: Any,
+        fused: List[Tuple[str, Tuple[str, ...]]],
+        states: Dict[str, Any],
+        fresh: Optional[Dict[str, Any]],
+        dyn: List[Any],
+        split_spec: Tuple[Any, tuple],
+        forward: bool,
+    ) -> Any:
+        """AOT-compile the chained step (donating the state tree(s)).
+
+        ``.lower().compile()`` keeps the one-time trace separate from
+        execution, so the trace-time side effects of the wrapped ``update``
+        closures (obs counters firing once per *trace*) are suppressed here
+        and steady-state launches stay side-effect-free.
+        """
+        step = self._build(collection, fused, split_spec, forward)
+        # donate only the accumulated state tree: batch-local `fresh` states
+        # never appear in the outputs, so XLA could not alias them anyway
+        # (donating them just trips the unusable-donation warning)
+        jitted = jax.jit(step, donate_argnums=(0,))
+        prev = _obs._ENABLED
+        _obs._ENABLED = False
+        try:
+            if forward:
+                lowered = jitted.lower(states, fresh, dyn)
+            else:
+                lowered = jitted.lower(states, dyn)
+            return lowered.compile()
+        finally:
+            _obs._ENABLED = prev
+
+    # --------------------------------------------------- donation plumbing
+
+    @staticmethod
+    def _donation_guard(trees: List[Any]) -> None:
+        """Make the about-to-be-donated trees safe to donate, in place.
+
+        Two hazards, one pass: (1) a state leaf that *is* a registered default
+        array (the live state right after construction/``reset`` is the default
+        object itself) must be copied or the donation deletes the default and
+        every later ``reset`` dies; the trees passed here are pre-filtered by
+        the caller, which swaps default-aliased leaves for copies. (2) the same
+        buffer appearing twice anywhere across the donated trees (cross-group
+        aliasing after manual state surgery) — XLA rejects donating one buffer
+        twice, so the second occurrence is copied.
+        """
+        seen: set = set()
+
+        def dedup(tree):
+            def visit(leaf):
+                key = id(leaf)
+                if key in seen:
+                    return leaf.copy()
+                seen.add(key)
+                return leaf
+
+            return jax.tree_util.tree_map(visit, tree)
+
+        for i, tree in enumerate(trees):
+            trees[i] = dedup(tree)
+
+    @staticmethod
+    def _protected_ids(metric: Metric) -> set:
+        """ids of arrays donation must never delete: the registered defaults."""
+        out: set = set()
+        for default in metric._defaults.values():
+            for leaf in jax.tree_util.tree_leaves(default):
+                out.add(id(leaf))
+        return out
+
+    def _gather_states(
+        self, collection: Any, fused: List[Tuple[str, Tuple[str, ...]]]
+    ) -> Dict[str, Any]:
+        """Leaders' live state pytrees, with default-aliased leaves copied."""
+        states: Dict[str, Any] = {}
+        for name, _ in fused:
+            m = collection._modules[name]
+            protected = self._protected_ids(m)
+
+            def shield(leaf, _protected=protected):
+                return leaf.copy() if id(leaf) in _protected else leaf
+
+            states[name] = jax.tree_util.tree_map(shield, m.state_pytree())
+        return states
+
+    @staticmethod
+    def _secure_ckpt_snapshots(trees: List[Any]) -> None:
+        """Materialize in-flight async-checkpoint snapshot entries that
+        reference arrays about to be donated (snapshot-before-donate)."""
+        from metrics_tpu.ckpt import manager as _ckpt_manager
+
+        if not _ckpt_manager._PENDING_SNAPSHOTS:
+            return
+        leaves: List[Any] = []
+        for tree in trees:
+            leaves.extend(jax.tree_util.tree_leaves(tree))
+        _ckpt_manager.secure_pending_snapshots(leaves)
+
+    # ------------------------------------------------------------ stepping
+
+    def _launch(
+        self,
+        collection: Any,
+        fused: List[Tuple[str, Tuple[str, ...]]],
+        args: Tuple,
+        kwargs: Dict,
+        forward: bool,
+    ) -> Tuple[List[Tuple[str, Tuple[str, ...]]], List[List[str]], Dict[str, Any]]:
+        """Compile-or-reuse, donate, execute, re-point. Returns
+        (fused groups actually launched, demoted groups, member results)."""
+        dyn, split_spec = _split_inputs(args, kwargs)
+        topo = tuple((name, members, id(collection._modules[name])) for name, members in fused)
+        states = self._gather_states(collection, fused)
+        key = (
+            "forward" if forward else "update",
+            topo,
+            _aval_key(states),
+            _aval_key(dyn),
+            _static_key(split_spec),
+        )
+        if key in self._broken_keys:
+            return [], [list(m) for _, m in fused], {}
+
+        compiled = self._cache.get(key)
+        demoted: List[List[str]] = []
+        fresh: Optional[Dict[str, Any]] = None
+        if compiled is None:
+            if _obs._ENABLED:
+                # storm alarm: the engine retracing per step is the collection-
+                # level compile storm; reuses the metric retrace detector
+                _obs_recompile.check_update(self, args, kwargs)
+                _obs.REGISTRY.inc("fused", "cache_misses")
+            self.stats["cache_misses"] += 1
+            fused, demoted = self._probe(collection, fused, states, dyn, split_spec, forward)
+            if not fused:
+                return [], demoted, {}
+            for name in list(states):
+                if name not in {n for n, _ in fused}:
+                    del states[name]
+            fresh = (
+                {name: collection._modules[name].init_state() for name, _ in fused}
+                if forward
+                else None
+            )
+            topo = tuple((name, members, id(collection._modules[name])) for name, members in fused)
+            key = (
+                "forward" if forward else "update",
+                topo,
+                _aval_key(states),
+                _aval_key(dyn),
+                _static_key(split_spec),
+            )
+            try:
+                compiled = self._compile(
+                    collection, fused, states, fresh, dyn, split_spec, forward
+                )
+            except Exception as err:  # noqa: BLE001 — eager is always correct
+                self._broken_keys.add(key)
+                warnings.warn(
+                    "metrics_tpu fused update: compiling the chained step failed"
+                    f" ({type(err).__name__}: {str(err).splitlines()[0][:200]});"
+                    " this input signature stays on the eager path.",
+                    RuntimeWarning,
+                    stacklevel=3,
+                )
+                return [], demoted + [list(m) for _, m in fused], {}
+            self._cache[key] = compiled
+        else:
+            self.stats["cache_hits"] += 1
+            if _obs._ENABLED:
+                _obs.REGISTRY.inc("fused", "cache_hits")
+
+        if forward and fresh is None:
+            fresh = {name: collection._modules[name].init_state() for name, _ in fused}
+
+        donate_trees = [states]
+        self._secure_ckpt_snapshots(donate_trees)
+        self._donation_guard(donate_trees)
+        (states,) = donate_trees
+
+        self.stats["launches"] += 1
+        if _obs._ENABLED:
+            _obs.REGISTRY.inc("fused", "launches")
+            _obs.REGISTRY.inc("fused", "dispatches")
+            with _obs_scopes.annotate("tm.fused/step"):
+                if forward:
+                    new_states, results = compiled(states, fresh, dyn)
+                else:
+                    new_states, results = compiled(states, dyn)
+        else:
+            if forward:
+                new_states, results = compiled(states, fresh, dyn)
+            else:
+                new_states, results = compiled(states, dyn)
+
+        # re-point live leader state at the donated-in-place output buffers
+        for name, _ in fused:
+            m = collection._modules[name]
+            m._load_state(new_states[name])
+            m._update_count += 1
+            m._computed = None
+            if _obs._ENABLED:
+                _obs.REGISTRY.inc(type(m).__name__, "updates")
+        return fused, demoted, results
+
+    def update(self, collection: Any, *args: Any, **kwargs: Any) -> None:
+        """One fused accumulation step (plus eager fallback groups)."""
+        fused, eager, _ = self._partition(collection, forward=False)
+        for name, _members in fused:
+            _check_update_arity(name, collection._modules[name], args)
+        if fused:
+            _launched, demoted, _ = self._launch(collection, fused, args, kwargs, forward=False)
+            eager = eager + demoted
+        if eager:
+            self.stats["fallback_groups"] += len(eager)
+            if _obs._ENABLED:
+                _obs.REGISTRY.inc("fused", "fallbacks", len(eager))
+            for cg in eager:
+                m0 = collection._modules[cg[0]]
+                m0.update(*args, **m0._filter_kwargs(**kwargs))
+        collection._state_is_copy = False
+        collection._compute_groups_create_state_ref()
+
+    def forward(self, collection: Any, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        """One fused dual-purpose step: accumulate AND return batch values."""
+        res: Dict[str, Any] = {}
+        fused, eager, _ = self._partition(collection, forward=True)
+        for name, _members in fused:
+            _check_update_arity(name, collection._modules[name], args)
+        if fused:
+            launched, demoted, results = self._launch(collection, fused, args, kwargs, forward=True)
+            eager = eager + demoted
+            for name, members in launched:
+                for member_name in members:
+                    mi = collection._modules[member_name]
+                    val = _squeeze_if_scalar(results[member_name])
+                    mi._forward_cache = val
+                    mi._computed = None
+                    res[member_name] = val
+        if eager:
+            self.stats["fallback_groups"] += len(eager)
+            if _obs._ENABLED:
+                _obs.REGISTRY.inc("fused", "fallbacks", len(eager))
+            for cg in eager:
+                for name in cg:
+                    m = collection._modules[name]
+                    res[name] = m(*args, **m._filter_kwargs(**kwargs))
+        collection._state_is_copy = False
+        collection._compute_groups_create_state_ref()
+        return res
+
+
+#: engines keyed weakly by collection: the collection itself stays free of
+#: unpicklable jitted executables (clone/deepcopy/pickle are untouched) and
+#: the cache is garbage-collected with its collection
+_ENGINES: "weakref.WeakKeyDictionary[Any, FusedCollectionUpdate]" = weakref.WeakKeyDictionary()
+
+
+def engine_for(collection: Any) -> FusedCollectionUpdate:
+    engine = _ENGINES.get(collection)
+    if engine is None:
+        engine = FusedCollectionUpdate()
+        _ENGINES[collection] = engine
+    return engine
+
+
+# ------------------------------------------------- canonical fused entry
+#
+# A fixed five-group collection over shared (preds, target) binary inputs.
+# This is the analyzable face of the engine: tmsan traces/compiles
+# ``canonical_fused_update`` as ONE executable (registered as
+# ``fused.collection_update`` in analysis/san/abstract_inputs.py, budget-gated
+# in tmsan_costs.json against the five per-metric eager entries), and bench.py
+# ``--fused`` times the same collection eager-vs-fused.
+
+
+def _canonical_metrics() -> List[Metric]:
+    from metrics_tpu.classification import BinaryAccuracy, BinaryAUROC, BinaryConfusionMatrix
+    from metrics_tpu.regression import MeanAbsoluteError, MeanSquaredError
+
+    # five DISTINCT update functions -> five compute groups -> five eager
+    # dispatches per step, all consuming the same (preds, target) pair
+    return [
+        BinaryAccuracy(),
+        BinaryConfusionMatrix(),
+        BinaryAUROC(thresholds=11),
+        MeanSquaredError(),
+        MeanAbsoluteError(),
+    ]
+
+
+def canonical_collection(fused: bool = True) -> Any:
+    """The canonical five-group fusable collection (see comment above)."""
+    from metrics_tpu.core.collections import MetricCollection
+
+    return MetricCollection(_canonical_metrics(), fused=fused)
+
+
+@functools.lru_cache(maxsize=1)
+def _canonical_leaders() -> Tuple[Tuple[str, Metric], ...]:
+    coll = canonical_collection(fused=False)
+    return tuple((cg[0], coll._modules[cg[0]]) for cg in coll._groups.values())
+
+
+def canonical_fused_update(states: Dict[str, Any], preds: Any, target: Any) -> Dict[str, Any]:
+    """Pure chained update of the canonical collection — the fused entrypoint
+    tmsan registers in its trace registry (one executable, vs five eager
+    ``<Class>.update[canon]`` entries)."""
+    out: Dict[str, Any] = {}
+    for name, m in _canonical_leaders():
+        with jax.named_scope(f"tm.fused/{type(m).__name__}"):
+            out[name] = m.local_update(states[name], preds, target)
+    return out
+
+
+def _sds(x) -> jax.ShapeDtypeStruct:
+    return jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x))
+
+
+def canonical_fused_case(n: int):
+    """tmsan abstract-input builder: ``[(args, kwargs)]`` at batch size n."""
+    states = {
+        name: jax.tree_util.tree_map(_sds, m.init_state()) for name, m in _canonical_leaders()
+    }
+    preds = jax.ShapeDtypeStruct((n,), jnp.float32)
+    target = jax.ShapeDtypeStruct((n,), jnp.int32)
+    return [((states, preds, target), {})]
+
+
+def canonical_eager_entries() -> Dict[str, Tuple[Callable, Callable]]:
+    """Per-leader stand-alone update entries, SAME constructors as the fused
+    chain — the apples-to-apples half of the budget comparison. The registry's
+    own ``<Class>.update[canon]`` entries use the registry ctor specs (e.g.
+    exact-mode AUROC), so the fewer-executables / lower-bytes claim is gated
+    against these instead: ``fused.collection_update[canon]`` must cost less
+    than the sum of the ``fused.eager/<Class>[canon]`` entries."""
+    out: Dict[str, Tuple[Callable, Callable]] = {}
+    for name, m in _canonical_leaders():
+
+        def fn(state, preds, target, _m=m):
+            return _m.local_update(state, preds, target)
+
+        def builder(n, _m=m):
+            state = jax.tree_util.tree_map(_sds, _m.init_state())
+            return [
+                (
+                    (state, jax.ShapeDtypeStruct((n,), jnp.float32), jax.ShapeDtypeStruct((n,), jnp.int32)),
+                    {},
+                )
+            ]
+
+        out[f"fused.eager/{type(m).__name__}"] = (fn, builder)
+    return out

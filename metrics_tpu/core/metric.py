@@ -405,6 +405,13 @@ class Metric(ABC):
             if _obs._ENABLED:
                 name = type(self).__name__
                 _obs.REGISTRY.inc(name, "updates")
+                # one eager update call == one XLA dispatch of the update
+                # program. The fused engine (core/fused.py) increments the
+                # same-named counter ONCE per fused launch under the "fused"
+                # scope instead of once per leader, so summing `dispatches`
+                # across scopes measures launches/step (the N->1 claim of
+                # ROADMAP item 4).
+                _obs.REGISTRY.inc(name, "dispatches")
                 _obs_recompile.check_update(self, args, kwargs)
                 with _obs_scopes.update_scope(name):
                     update(*args, **kwargs)
@@ -938,7 +945,9 @@ class Metric(ABC):
 
     @property
     def _update_signature(self) -> inspect.Signature:
-        return inspect.signature(type(self).update)
+        # per-class cache: `_filter_kwargs` and the collection arity check hit
+        # this on every hot-loop step, and `inspect.signature` is not cheap
+        return _class_update_signature(type(self))
 
     def __hash__(self) -> int:
         hash_vals = [self.__class__.__name__]
@@ -1077,6 +1086,11 @@ class Metric(ABC):
 
     def __iter__(self):
         raise NotImplementedError("Metrics does not support iteration.")
+
+
+@functools.lru_cache(maxsize=None)
+def _class_update_signature(cls: type) -> inspect.Signature:
+    return inspect.signature(cls.update)
 
 
 def _neg(x: Array) -> Array:

@@ -131,4 +131,9 @@ def collection_summary(collection: Any) -> Dict[str, Any]:
     ckpt_stats = getattr(collection, "_ckpt_stats", None)
     if isinstance(ckpt_stats, dict) and ckpt_stats:
         out["ckpt"] = dict(ckpt_stats)
+    if getattr(collection, "fused", False):
+        from metrics_tpu.core.fused import _ENGINES
+
+        engine = _ENGINES.get(collection)
+        out["fused"] = dict(engine.stats) if engine is not None else {"launches": 0}
     return out

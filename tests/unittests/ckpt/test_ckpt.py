@@ -685,3 +685,88 @@ def test_state_report_carries_ckpt_latency(tmp_path):
     fresh = MulticlassAccuracy(num_classes=5, average="micro")
     fresh.restore_checkpoint(str(tmp_path))
     assert fresh.state_report()["ckpt"]["last_restore_step"] == 0
+
+
+# --------------------------------------------- fused donation vs async saves
+
+
+def test_async_save_racing_fused_donation_serializes_pre_donation_state(
+    tmp_path, monkeypatch
+):
+    """Regression (ISSUE 6 satellite): ``save_checkpoint(blocking=False)``
+    snapshots array *references*; a donation-backed fused update racing the
+    writer thread invalidates exactly those arrays. The engine must secure the
+    pending snapshot (device->host) BEFORE donating, so the checkpoint that
+    lands on disk is the pre-donation state — not a crash on deleted buffers.
+
+    The race is made deterministic by capturing the writer thread instead of
+    starting it: the fused update runs while the snapshot still holds device
+    references, then the writer runs.
+    """
+    import threading
+
+    from metrics_tpu.ckpt import manager
+    from metrics_tpu.core.fused import canonical_collection
+
+    rng = np.random.RandomState(0)
+    p = rng.rand(64).astype(np.float32)
+    t = rng.randint(0, 2, 64).astype(np.int32)
+    coll = canonical_collection(fused=True)
+    coll.update(p, t)
+    coll.update(p, t)  # warmed: the next update donates via the cached executable
+    pre = {k: np.asarray(v) for k, v in coll.compute().items()}
+
+    captured = []
+
+    class _DeferredThread:
+        def __init__(self, target=None, **kwargs):
+            captured.append(target)
+
+        def start(self):
+            pass
+
+    monkeypatch.setattr(manager.threading, "Thread", _DeferredThread)
+    handle = coll.save_checkpoint(str(tmp_path), blocking=False)
+    monkeypatch.undo()
+    assert len(manager._PENDING_SNAPSHOTS) == 1
+    snap = manager._PENDING_SNAPSHOTS[0]
+    assert any(not isinstance(v, np.ndarray) for _, v, _ in snap.entries)
+
+    coll.update(p, t)  # donates the snapshotted arrays -> engine secures first
+    # every entry the donation touched is now a host array; nothing deleted
+    for _, value, _ in snap.entries:
+        assert isinstance(value, np.ndarray) or not value.is_deleted()
+
+    captured[0]()  # run the deferred writer
+    handle.result()
+    assert handle.committed
+
+    fresh = canonical_collection(fused=False)
+    fresh.restore_checkpoint(str(tmp_path))
+    post = {k: np.asarray(v) for k, v in fresh.compute().items()}
+    assert pre.keys() == post.keys()
+    for k in pre:
+        assert pre[k].tobytes() == post[k].tobytes()
+
+
+def test_async_save_without_race_still_materializes_on_writer(tmp_path):
+    """The writer thread itself materializes the snapshot first, so an async
+    save with no racing donation behaves exactly as before (and the pending
+    registry drains)."""
+    from metrics_tpu.ckpt import manager
+    from metrics_tpu.core.fused import canonical_collection
+
+    rng = np.random.RandomState(1)
+    p = rng.rand(64).astype(np.float32)
+    t = rng.randint(0, 2, 64).astype(np.int32)
+    coll = canonical_collection(fused=True)
+    coll.update(p, t)
+    handle = coll.save_checkpoint(str(tmp_path), blocking=False)
+    handle.result()
+    ckpt.wait_for_all_saves()
+    assert not manager._PENDING_SNAPSHOTS
+    fresh = canonical_collection(fused=False)
+    fresh.restore_checkpoint(str(tmp_path))
+    assert {k: np.asarray(v).tobytes() for k, v in coll.compute().items()} == {
+        k: np.asarray(v).tobytes() for k, v in fresh.compute().items()
+    }
