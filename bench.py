@@ -597,7 +597,11 @@ def bench_confmat(n: int = 1 << 26, num_classes: int = 64, repeats: int = 10) ->
     samples = [timed() for _ in range(3)]
     st = samples[-1][1]
     p50 = statistics.median(r for r, _ in samples)
-    total = float(jnp.sum(st["confmat"]))
+    # mass check in int32: the f32 state cells are exact integers (<2^24 each)
+    # but their 6.7e8 TOTAL is past f32's exact-integer range — an f32 sum is
+    # reduction-order-dependent there (TPU's tree happened to land exact, the
+    # CPU backend's order does not)
+    total = int(jnp.sum(st["confmat"].astype(jnp.int32)))
     assert total == repeats * n, f"confmat mass {total} != {repeats * n}"
 
     # reference-equivalent kernel on torch CPU (bincount of target*C+preds)
@@ -966,6 +970,121 @@ def bench_fused(n: int = 1 << 20, steps: int = 8, trials: int = 5) -> dict:
     }
 
 
+def bench_sketch(sizes=(1 << 20, 1 << 24), trials: int = 3) -> dict:
+    """``--sketch``: the mergeable sketch family (metrics_tpu/sketches/) —
+    update throughput, compute latency, and merge cost at 2^20 and 2^24 elems.
+
+    Per class and size: p50 update throughput through the jitted pure tier
+    with the state donated (the serving-shaped path: in-place accumulation,
+    exactly what ``MetricCollection(fused=True)`` compiles), p50 ``compute``
+    latency off a jitted ``compute_from``, and p50 pairwise state-merge cost
+    (the psum-equivalent, O(state) not O(stream)). Headline value is
+    QuantileSketch update throughput at the largest size; vs_baseline is the
+    exact-path alternative measured locally — ``np.quantile`` over the same
+    materialized 2^24 buffer (sort-bound), which is what the sketch replaces.
+    """
+    from metrics_tpu.sketches import (
+        DistinctCount,
+        HistogramDrift,
+        QuantileSketch,
+        StreamingAUROCBound,
+    )
+
+    import numpy as np
+
+    key = jax.random.PRNGKey(7)
+    k1, k2, k3 = jax.random.split(key, 3)
+    per_class = {}
+    headline = None
+    for n in sizes:
+        scores = jax.random.uniform(k1, (n,), jnp.float32)
+        lat = jnp.exp(4.0 * jax.random.normal(k2, (n,)))  # lognormal latencies
+        ids = jax.random.randint(k3, (n,), 0, n // 2, dtype=jnp.int32)
+        labels = (jax.random.uniform(k2, (n,)) < scores).astype(jnp.int32)
+        cases = (
+            ("QuantileSketch", QuantileSketch(), (lat,)),
+            ("DistinctCount", DistinctCount(), (ids,)),
+            ("HistogramDrift", HistogramDrift(), (scores,)),
+            ("StreamingAUROCBound", StreamingAUROCBound(), (scores, labels)),
+        )
+        steps = max(1, (1 << 24) // n // 4)  # same work per timed pass
+        for name, metric, args in cases:
+            update_j = jax.jit(
+                lambda s, *a, _m=metric: _m.local_update(s, *a), donate_argnums=0
+            )
+            state = update_j(metric.init_state(), *args)  # compile/warm
+            jax.block_until_ready(jax.tree_util.tree_leaves(state))
+
+            def timed_updates():
+                s = metric.init_state()
+                with _obs().stopwatch("bench", f"sketch_update_{name}") as sw:
+                    for _ in range(steps):
+                        s = update_j(s, *args)
+                    jax.block_until_ready(jax.tree_util.tree_leaves(s))
+                return n * steps / sw.elapsed
+
+            update_eps = statistics.median(timed_updates() for _ in range(trials))
+
+            compute_j = jax.jit(metric.compute_from)
+            jax.block_until_ready(jax.tree_util.tree_leaves(compute_j(state)))
+
+            def timed_compute():
+                with _obs().stopwatch("bench", f"sketch_compute_{name}") as sw:
+                    jax.block_until_ready(jax.tree_util.tree_leaves(compute_j(state)))
+                return sw.elapsed * 1000
+
+            compute_ms = statistics.median(timed_compute() for _ in range(trials))
+
+            reductions = dict(metric._reductions)
+            merge_j = jax.jit(
+                lambda sa, sb: {
+                    k: jnp.maximum(sa[k], sb[k]) if reductions[k] == "max" else sa[k] + sb[k]
+                    for k in sa
+                }
+            )
+            other = jax.tree_util.tree_map(jnp.copy, state)
+            jax.block_until_ready(jax.tree_util.tree_leaves(merge_j(state, other)))
+
+            def timed_merge():
+                with _obs().stopwatch("bench", f"sketch_merge_{name}") as sw:
+                    for _ in range(100):
+                        jax.block_until_ready(
+                            jax.tree_util.tree_leaves(merge_j(state, other))
+                        )
+                return sw.elapsed / 100 * 1e6
+
+            merge_us = statistics.median(timed_merge() for _ in range(trials))
+            per_class[f"{name}@2^{n.bit_length() - 1}"] = {
+                "update_gelems_per_s": round(update_eps / 1e9, 4),
+                "compute_ms": round(compute_ms, 3),
+                "merge_us": round(merge_us, 1),
+                "state_bytes": metric.state_bytes(),
+            }
+            if name == "QuantileSketch" and n == max(sizes):
+                headline = update_eps
+
+    # local exact-path baseline: np.quantile over the same materialized buffer
+    n = max(sizes)
+    host_lat = np.asarray(jnp.exp(4.0 * jax.random.normal(k2, (n,))))
+    t0 = time.perf_counter()
+    np.quantile(host_lat, (0.5, 0.9, 0.99))
+    exact_eps = n / (time.perf_counter() - t0)
+
+    return {
+        "metric": "sketch_quantile_update_throughput",
+        "value": round(headline / 1e9, 4),
+        "unit": "Gelems/s/chip",
+        "vs_baseline": round(headline / exact_eps, 2),
+        "per_class": per_class,
+        "bound": "bucket/hash bound: one log+floor (quantile), one integer mix"
+                 " (HLL), or one key-bijection pass (AUROC bound) per element"
+                 " plus a tiered bincount — O(1) state, so no sort, no growing"
+                 " cat buffer; merge is O(state) elementwise sum/max"
+                 " (vs_baseline = np.quantile on the same materialized buffer,"
+                 " the exact-path alternative the sketch replaces)",
+    }
+
+
 def bench_lint(runs: int = 3) -> dict:
     """``--lint-overhead``: cold tmlint wall time over the full package.
 
@@ -1064,8 +1183,16 @@ if __name__ == "__main__":
     parser = argparse.ArgumentParser(description="metrics_tpu benchmarks")
     parser.add_argument(
         "--config",
-        choices=("accuracy", "logits", "confmat", "map", "ssim", "retrieval", "auroc", "fid", "fused", "lint", "all"),
+        choices=("accuracy", "logits", "confmat", "map", "ssim", "retrieval", "auroc", "fid", "fused", "sketch", "lint", "all"),
         default="all",
+    )
+    parser.add_argument(
+        "--sketch",
+        action="store_true",
+        help="also run the sketch-family bench (metrics_tpu/sketches/): p50"
+        " update throughput through the donated jitted pure tier, compute"
+        " latency, and pairwise merge cost for all four sketch classes at"
+        " 2^20 and 2^24 elements (also runs under --config all)",
     )
     parser.add_argument(
         "--fused",
@@ -1137,6 +1264,7 @@ if __name__ == "__main__":
         ("retrieval", bench_retrieval),
         ("auroc", bench_auroc),
         ("fused", bench_fused),
+        ("sketch", bench_sketch),
         ("ckpt", bench_ckpt),
         ("lint", bench_lint),
         ("san", bench_san),
@@ -1145,11 +1273,13 @@ if __name__ == "__main__":
             continue
         if name == "fused" and not (cli.fused or config in ("fused", "all")):
             continue
+        if name == "sketch" and not (cli.sketch or config in ("sketch", "all")):
+            continue
         if name == "lint" and not (cli.lint_overhead or config in ("lint", "all")):
             continue
         if name == "san" and not (cli.san_overhead or config == "all"):
             continue
-        if config in (name, "all") or name in ("ckpt", "fused", "lint", "san"):
+        if config in (name, "all") or name in ("ckpt", "fused", "sketch", "lint", "san"):
             try:
                 result = fn()
                 summary[result["metric"]] = {

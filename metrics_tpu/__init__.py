@@ -149,6 +149,7 @@ from metrics_tpu.retrieval._deprecated import _RetrievalPrecisionRecallCurve as 
 from metrics_tpu.retrieval._deprecated import _RetrievalRPrecision as RetrievalRPrecision  # noqa: E402
 from metrics_tpu.retrieval._deprecated import _RetrievalRecall as RetrievalRecall  # noqa: E402
 from metrics_tpu.retrieval._deprecated import _RetrievalRecallAtFixedPrecision as RetrievalRecallAtFixedPrecision  # noqa: E402
+from metrics_tpu.sketches import DistinctCount, HistogramDrift, QuantileSketch, StreamingAUROCBound
 from metrics_tpu.wrappers import BootStrapper, ClasswiseWrapper, MetricTracker, MinMaxMetric, MultioutputWrapper
 
 __all__ = [
@@ -212,6 +213,11 @@ __all__ = [
     "SymmetricMeanAbsolutePercentageError",
     "TweedieDevianceScore",
     "WeightedMeanAbsolutePercentageError",
+
+    "DistinctCount",
+    "HistogramDrift",
+    "QuantileSketch",
+    "StreamingAUROCBound",
 
     "AUROC",
     "AveragePrecision",

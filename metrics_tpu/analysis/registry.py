@@ -67,6 +67,13 @@ def _ctor_specs() -> Dict[str, Callable[[], Dict[str, Any]]]:
         "SignalDistortionRatio": kw(filter_length=4, load_diag=1e-4),
         "PanopticQuality": kw(things={0}, stuffs={1}),
         "ModifiedPanopticQuality": kw(things={0}, stuffs={1}),
+        # sketches/: constructed at their telemetry defaults so tmlint's
+        # state-contract rules and tmsan's trace/cost sweep see the shipping
+        # bucket/register shapes
+        "QuantileSketch": kw(),
+        "DistinctCount": kw(),
+        "HistogramDrift": kw(),
+        "StreamingAUROCBound": kw(),
         "CramersV": kw(num_classes=4),
         "PearsonsContingencyCoefficient": kw(num_classes=4),
         "TheilsU": kw(num_classes=4),

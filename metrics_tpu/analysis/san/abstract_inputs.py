@@ -187,6 +187,15 @@ PER_NAME: Dict[str, Callable[[int], list]] = {
     ),
     # text-adjacent device metric
     "Perplexity": lambda n: _one(f32(max(1, n // 32), 6, 8), i32(max(1, n // 32), 6)),
+    # sketches (mergeable streaming telemetry; sketches/) — HistogramDrift's
+    # reference/live branches are distinct traces like FID's real/fake
+    "QuantileSketch": _single,
+    "DistinctCount": lambda n: _one(i32(n)),
+    "HistogramDrift": lambda n: [
+        ((f32(n),), {"reference": True}),
+        ((f32(n),), {"reference": False}),
+    ],
+    "StreamingAUROCBound": _binary,
     # nominal (update is device-side; compute is declared host-side)
     "CramersV": lambda n: _one(i32(n), i32(n)),
     "PearsonsContingencyCoefficient": lambda n: _one(i32(n), i32(n)),
@@ -276,6 +285,7 @@ def cases_for(name: str, instance: Any) -> Optional[Dict[str, List[TraceCase]]]:
 def _ops_entrypoints() -> Dict[str, Tuple[Callable, Callable[[int], list]]]:
     from metrics_tpu.core import fused
     from metrics_tpu.ops import clf_curve, confmat, rank, segment
+    from metrics_tpu.ops import sketch as sketch_ops
 
     return {
         # the fused-collection entrypoint (core/fused.py): the canonical
@@ -309,6 +319,14 @@ def _ops_entrypoints() -> Dict[str, Tuple[Callable, Callable[[int], list]]]:
         ),
         "ops.ranked_targets": (rank.ranked_targets, lambda n: _one(f32(n), i32(n))),
         "ops.monotone_key_descending": (rank.monotone_key_descending, lambda n: _one(f32(n))),
+        # sketch kernels (ops/sketch.py + the histogram-form rank bounds):
+        # the hash mixer scales with n; the bounds run at the shipping
+        # 2^12-bucket resolution (state-shaped, n-independent)
+        "ops.sketch_hash_u32": (sketch_ops.hash_u32, lambda n: _one(f32(n))),
+        "ops.average_precision_bounds_from_hists": (
+            rank.average_precision_bounds_from_hists,
+            lambda n: _one(i32(1 << 12), i32(1 << 12)),
+        ),
     }
 
 

@@ -335,6 +335,66 @@ class Metric(ABC):
         axis = axis_name if axis_name is not None else None
         return collective.sync_pytree(state, self._reductions, axis)
 
+    def merge_state(self, other: Union["Metric", Dict[str, Any]]) -> None:
+        """Merge another instance's state into the live state, in place, using
+        each state's registered ``dist_reduce_fx`` algebra — the same merge
+        ``psum``/``pmax`` apply over a mesh axis and ``ckpt`` applies when
+        re-reducing across an N→M topology change.
+
+        This is the mesh-free merge path the sketch family
+        (``metrics_tpu/sketches/``) is designed around: for fixed-shape
+        ``sum``/``max``/``min`` states, merge-then-compute equals
+        compute-on-concatenated-input (exactly for HLL registers and bucket
+        histograms; within the declared certificate for quantile sketches).
+
+        Only the reductions with a well-defined pairwise merge are accepted:
+        ``sum``/``max``/``min`` array states and ``cat`` list states. ``mean``
+        (needs the weight stream), ``None``, custom callables, and CatBuffer
+        states (merge via ``ckpt`` re-pack or the mesh ``all_gather``) raise
+        :class:`MetricsUserError`.
+        """
+        if isinstance(other, Metric):
+            if set(other._defaults) != set(self._defaults):
+                raise MetricsUserError(
+                    f"Cannot merge state of {type(other).__name__} into {type(self).__name__}:"
+                    f" state registries differ ({sorted(other._defaults)} vs {sorted(self._defaults)})"
+                )
+            incoming: Dict[str, Any] = {name: getattr(other, name) for name in other._defaults}
+            incoming_count = other._update_count
+        else:
+            incoming = other
+            incoming_count = 0
+
+        merged: Dict[str, Any] = {}
+        for name, reduce_kind in self._reductions.items():
+            if name not in incoming:
+                raise MetricsUserError(f"merge_state: incoming state is missing `{name}`")
+            mine, theirs = getattr(self, name), incoming[name]
+            if isinstance(mine, CatBuffer) or isinstance(theirs, CatBuffer):
+                raise MetricsUserError(
+                    f"merge_state: `{name}` is a CatBuffer state; merge fixed-capacity cat"
+                    " states through the mesh all_gather sync or the ckpt re-pack path"
+                )
+            if reduce_kind == "cat" and isinstance(mine, list):
+                merged[name] = list(mine) + list(theirs)
+            elif reduce_kind == "sum":
+                merged[name] = mine + theirs
+            elif reduce_kind == "max":
+                merged[name] = jnp.maximum(mine, theirs)
+            elif reduce_kind == "min":
+                merged[name] = jnp.minimum(mine, theirs)
+            else:
+                raise MetricsUserError(
+                    f"merge_state: state `{name}` has reduction {reduce_kind!r}, which has no"
+                    " well-defined pairwise merge (supported: sum, max, min, cat lists)"
+                )
+        for name, value in merged.items():
+            setattr(self, name, value)
+        self._update_count += incoming_count
+        self._computed = None
+        if _obs._ENABLED:
+            _obs.REGISTRY.inc(type(self).__name__, "merges")
+
     def compute_from(
         self, state: Dict[str, Any], axis_name: Optional[collective.AxisName] = None
     ) -> Any:

@@ -263,6 +263,24 @@ def cross_bucket_pair_stats(pos_hist: Array, neg_hist: Array) -> Tuple[Array, Ar
     return jnp.sum(pos_f * neg_above), jnp.sum(pos_f * neg_f)
 
 
+def auroc_bounds_from_hists(pos_hist: Array, neg_hist: Array) -> Tuple[Array, Array]:
+    """[lower, upper] AUROC bounds from accumulated per-class bucket histograms.
+
+    Same math as :func:`bucketed_auroc_bounds` but starting from the counts —
+    the accumulating form the streaming sketch tier
+    (``sketches/auroc_bound.py``) computes over many updates' worth of merged
+    (psum'd) histograms without ever materializing the row stream.
+    """
+    cross, same = cross_bucket_pair_stats(pos_hist, neg_hist)
+    p = jnp.sum(pos_hist).astype(jnp.float32)
+    q = jnp.sum(neg_hist).astype(jnp.float32)
+    denom = jnp.maximum(p * q, 1.0)
+    both = (p > 0) & (q > 0)
+    lo = jnp.where(both, cross / denom, 0.0)
+    hi = jnp.where(both, (cross + same) / denom, 0.0)
+    return lo, hi
+
+
 def bucketed_auroc_bounds(
     preds: Array, target: Array, valid: Optional[Array] = None, bits: int = 12
 ) -> Tuple[Array, Array]:
@@ -275,20 +293,66 @@ def bucketed_auroc_bounds(
     mixes *distinct* scores (e.g. any <= 2^bits-value quantized domain: the
     residual same-bucket mass is then true ties, which score exactly 1/2).
     The exact dispatch path does NOT use this: it exists for the experiment
-    grid (experiments/rank_exp.py) and cheap progress/QA probes on streaming
-    evals.
+    grid (experiments/rank_exp.py), cheap progress/QA probes on streaming
+    evals, and — through the histogram-input form above — the accumulating
+    ``StreamingAUROCBound`` sketch metric.
     """
     if valid is None:
         valid = jnp.ones(preds.shape, bool)
     keys = monotone_key_descending(preds, valid)
     pos_hist, neg_hist = class_bucket_counts(keys, target == 1, valid, bits)
-    cross, same = cross_bucket_pair_stats(pos_hist, neg_hist)
-    p = jnp.sum(pos_hist).astype(jnp.float32)
-    q = jnp.sum(neg_hist).astype(jnp.float32)
-    denom = jnp.maximum(p * q, 1.0)
-    both = (p > 0) & (q > 0)
-    lo = jnp.where(both, cross / denom, 0.0)
-    hi = jnp.where(both, (cross + same) / denom, 0.0)
+    return auroc_bounds_from_hists(pos_hist, neg_hist)
+
+
+def _psi_diff(a: Array, p: Array) -> Array:
+    """``ψ(a+p) − ψ(a)`` (= the harmonic sum ``Σ_{i=0..p-1} 1/(a+i)``) without
+    catastrophic cancellation.
+
+    A direct digamma difference is useless here: at stream scale ``a`` reaches
+    1e7+ where ψ(a) ≈ 16 and the true difference ≈ p/a ≈ 1e-7 — below f32
+    resolution of the operands. The asymptotic expansion of ψ turns every term
+    into a stable small-difference form (``log1p(p/a)``, ``p/(2ab)``,
+    ``p(a+b)/(12a²b²)``); its truncation error is < 1/(120 a⁴), negligible for
+    a ≥ 8, and small ``a`` falls back to the exact digamma difference (where
+    cancellation is harmless because the difference is O(1)).
+    """
+    b = a + p
+    stable = jnp.log1p(p / a) + p / (2.0 * a * b) - p * (a + b) / (12.0 * a * a * b * b)
+    exact = jax.scipy.special.digamma(b) - jax.scipy.special.digamma(a)
+    return jnp.where(a < 8.0, exact, stable)
+
+
+def average_precision_bounds_from_hists(pos_hist: Array, neg_hist: Array) -> Tuple[Array, Array]:
+    """[lower, upper] average-precision bounds from per-class bucket histograms.
+
+    Buckets are in DESCENDING score order. Within a bucket the histogram has
+    lost the ordering, so AP is bracketed by the two extreme arrangements:
+    every positive before every negative (upper) and after (lower). Both have
+    closed forms — with ``P`` positives and ``N`` negatives already emitted
+    above the bucket, placing the bucket's ``p`` positives starting after
+    ``k`` of its negatives contributes ``Σ_{i=1..p} (P+i)/(P+N+k+i) =
+    p − (N+k)·(ψ(P+N+k+p+1) − ψ(P+N+k+1))`` — so the whole bound is two
+    vectorized ψ-difference passes, O(buckets) work, no sort. The exact
+    tie-collapsed AP (what ``binary_average_precision_exact`` computes) lies
+    inside the bracket for every dataset: each tied run's collapsed precision
+    is between its best- and worst-arrangement sums term by term.
+
+    Pair-count caveat shared with :func:`cross_bucket_pair_stats`: counts ride
+    f32 (no int64 without x64), exact to 2^24 per bucket; beyond that the
+    ~1e-7 relative error is far inside the bucket-width certificate.
+    """
+    pos_f = pos_hist.astype(jnp.float32)
+    neg_f = neg_hist.astype(jnp.float32)
+    p_prev = jnp.cumsum(pos_f) - pos_f
+    n_prev = jnp.cumsum(neg_f) - neg_f
+    t_prev = p_prev + n_prev
+    best = pos_f - n_prev * _psi_diff(t_prev + 1.0, pos_f)
+    worst = pos_f - (n_prev + neg_f) * _psi_diff(t_prev + neg_f + 1.0, pos_f)
+    p_total = jnp.sum(pos_f)
+    denom = jnp.maximum(p_total, 1.0)
+    any_pos = p_total > 0
+    lo = jnp.where(any_pos, jnp.sum(worst) / denom, 0.0)
+    hi = jnp.where(any_pos, jnp.sum(best) / denom, 0.0)
     return lo, hi
 
 

@@ -4,6 +4,16 @@ Replaces the reference's flat eq-sum micro kernels
 (``functional/classification/stat_scores.py:386-396``) with a fusion shape tuned
 for the TPU XLA reduce pipeline.
 
+Scope note (vs ``metrics_tpu/sketches/``): "streaming" here means the EXACT
+compare-accumulate hot path — ``functional/classification/stat_scores.py``
+routes int-label micro accuracy through :func:`eq_count` and float-logit
+micro accuracy through :func:`argmax_correct_count` on every update. These
+are not sketches (nothing is approximated, state is the caller's scalar
+counters) and deliberately stay with the exact tier; the approximate
+O(1)-state telemetry family lives in ``sketches/`` on the hashing/bucketing
+kernels in ``ops/sketch.py``. Docs: the "Related streaming kernels" section
+of ``docs/source/pages/sketches.rst``.
+
 Measured design notes (TPU v5e, 819 GB/s HBM, int8 label streams, 2x1GB fresh
 buffers per dispatch, one device sync per 24 dispatches):
 

@@ -1,0 +1,22 @@
+"""Mergeable streaming sketch metrics for service telemetry.
+
+Latency percentiles, approximate distinct counts, distribution drift, and
+streaming rank-metric bounds as first-class :class:`~metrics_tpu.core.metric.Metric`
+subclasses — fixed-shape integer state whose distributed reduction (psum/pmax)
+IS the sketch merge. See ``docs/source/pages/sketches.rst`` for the
+accuracy/merge/state-size table and when to prefer a sketch over the exact
+tier.
+"""
+from metrics_tpu.sketches.auroc_bound import StreamingAUROCBound
+from metrics_tpu.sketches.base import SketchMetric
+from metrics_tpu.sketches.distinct import DistinctCount
+from metrics_tpu.sketches.drift import HistogramDrift
+from metrics_tpu.sketches.quantile import QuantileSketch
+
+__all__ = [
+    "DistinctCount",
+    "HistogramDrift",
+    "QuantileSketch",
+    "SketchMetric",
+    "StreamingAUROCBound",
+]

@@ -1,0 +1,93 @@
+"""Shared base for mergeable streaming sketch metrics.
+
+A sketch here is a :class:`~metrics_tpu.core.metric.Metric` whose entire
+registered state is a small set of FIXED-SHAPE INTEGER arrays under a
+``sum``/``max`` reduction. That single structural invariant buys every
+property the rest of the stack contracts on, for free:
+
+- **mesh merge is the collective itself**: ``psum`` (sum states) / ``pmax``
+  (max states) over an axis IS the sketch merge — no gather, no host round
+  trip, O(state) bytes on the ICI;
+- **ckpt-safe**: fixed shapes round-trip bit-identically through the raw-bytes
+  serializer, and the N→M topology re-reduce (ckpt/restore.py's sum/max merge
+  matrix) is exactly the sketch merge, so host-count changes preserve the
+  estimate;
+- **fusable**: static-shape integer pytrees chain into the donation-backed
+  ``MetricCollection(fused=True)`` engine like any other dense state;
+- **bf16/f32-safe** under tmsan's TMS-UPCAST rule trivially — integer state
+  cannot be silently promoted by a float cast, and float INPUTS may arrive in
+  any width that widens exactly to f32;
+- **fleet-ready** (ROADMAP item 1): a leading fleet axis over a fixed-shape
+  integer state vmaps without reshaping or re-bucketing.
+
+:meth:`SketchMetric.add_sketch_state` enforces the invariant at registration
+time; :meth:`SketchMetric.merge` is the eager pairwise merge (delegating to
+``Metric.merge_state``, the core hook that applies each state's registered
+reduction algebra) used by multi-stream aggregation and the property tests'
+merge-associativity sweeps.
+"""
+from typing import Any, Dict, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.utils.exceptions import MetricsUserError
+
+#: the reductions whose pairwise merge is the distributed collective
+_MERGEABLE_REDUCTIONS = ("sum", "max", "min")
+
+
+class SketchMetric(Metric):
+    """Base class for mergeable streaming sketches (quantiles, distinct
+    counts, drift, streaming rank bounds).
+
+    Subclasses register state exclusively through :meth:`add_sketch_state` and
+    implement ``update``/``compute`` with pure jnp ops; everything else
+    (pure-functional tier, sync, ckpt, fusion) is inherited.
+    """
+
+    is_differentiable: bool = False
+    higher_is_better = None
+    full_state_update: bool = False
+
+    def add_sketch_state(self, name: str, default: Array, dist_reduce_fx: str) -> None:
+        """Register a sketch state, enforcing the family invariant: a
+        fixed-shape integer array under a mergeable reduction."""
+        if dist_reduce_fx not in _MERGEABLE_REDUCTIONS:
+            raise MetricsUserError(
+                f"Sketch state `{name}` must use a mergeable reduction"
+                f" {_MERGEABLE_REDUCTIONS}, got {dist_reduce_fx!r}"
+            )
+        default = jnp.asarray(default)
+        if not jnp.issubdtype(default.dtype, jnp.integer):
+            raise MetricsUserError(
+                f"Sketch state `{name}` must be an integer array (got {default.dtype}):"
+                " integer state is what makes the merge exact and TMS-UPCAST-safe"
+            )
+        self.add_state(name, default, dist_reduce_fx=dist_reduce_fx)
+
+    def merge(self, other: Union["SketchMetric", Dict[str, Any]]) -> None:
+        """Merge another sketch of the same type into this one, in place.
+
+        ``a.merge(b); a.compute()`` equals computing over the concatenated
+        input streams — bit-identically for pure count/register states (HLL,
+        histograms), within the declared certificate for quantile sketches.
+        Associative and commutative, so any merge tree over any shard order
+        yields the same state.
+        """
+        if isinstance(other, Metric) and type(other) is not type(self):
+            raise MetricsUserError(
+                f"Cannot merge {type(other).__name__} into {type(self).__name__}:"
+                " sketch merges are only defined between instances of the same class"
+            )
+        self.merge_state(other)
+
+    def state_bytes(self) -> int:
+        """Total bytes of registered sketch state — the per-stream memory cost
+        quoted in the docs table (and the per-save ckpt payload floor)."""
+        total = 0
+        for name in self._defaults:
+            value = getattr(self, name)
+            total += int(jnp.asarray(value).size * jnp.asarray(value).dtype.itemsize)
+        return total

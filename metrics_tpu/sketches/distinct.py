@@ -1,0 +1,62 @@
+"""HyperLogLog approximate distinct counting (Flajolet et al., 2007)."""
+from typing import Any, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.ops.sketch import hash_u32, hll_estimate, hll_index_rank
+from metrics_tpu.sketches.base import SketchMetric
+
+
+class DistinctCount(SketchMetric):
+    """Approximate number of distinct values seen, in ``2^p`` bytes of state.
+
+    HyperLogLog: each value hashes to a u32 (ops/sketch.py's murmur3-finalizer
+    bijection); the top ``p`` bits pick one of ``m = 2^p`` u8 registers, which
+    keeps a running max of the rank (leading-zero count + 1) of the remaining
+    bits. The estimate's standard error is ``1.04/sqrt(m)`` (~1.6% at the
+    default ``p=12``), with the standard linear-counting (small-range) and
+    32-bit-saturation (large-range) corrections applied in ``compute``.
+
+    ``dist_reduce_fx="max"`` — the elementwise register max IS the HLL merge,
+    so ``pmax`` over a mesh axis, :meth:`merge`, and the ckpt N→M ``max``
+    re-reduce all commute bit-identically with single-stream ingestion:
+    merge-then-compute equals compute-on-concat exactly, in any order.
+
+    Values may be any integer, bool, or float array; floats are hashed by
+    their f32 bit pattern (−0.0 folded into +0.0), so bf16/f16 inputs — which
+    widen exactly — count the same distinct set as their f32 ingestion.
+
+    Args:
+        p: register-count exponent (``m = 2^p`` u8 registers, ``4 <= p <= 16``).
+        seed: hash seed; two sketches must share it to be mergeable.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.sketches import DistinctCount
+        >>> dc = DistinctCount(p=12)
+        >>> dc.update(jnp.arange(5000) % 1000)
+        >>> bool(jnp.abs(dc.compute() - 1000.0) / 1000.0 < 0.05)
+        True
+    """
+
+    higher_is_better = None
+    _update_signature_attrs = ("p", "seed")
+
+    def __init__(self, p: int = 12, seed: int = 0, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(p, int) or not 4 <= p <= 16:
+            raise ValueError(f"Argument `p` must be an int in [4, 16], got {p}")
+        self.p = p
+        self.seed = int(seed)
+        self.add_sketch_state("registers", jnp.zeros((1 << p,), jnp.uint8), "max")
+
+    def update(self, values: Union[int, float, Array]) -> None:
+        """Hash a batch of values (any shape; flattened) into the registers."""
+        h = hash_u32(jnp.ravel(jnp.asarray(values)), self.seed)
+        idx, rank = hll_index_rank(h, self.p)
+        self.registers = self.registers.at[idx].max(rank)
+
+    def compute(self) -> Array:
+        """Bias-corrected cardinality estimate (f32 scalar; 0 when empty)."""
+        return hll_estimate(self.registers)

@@ -1,0 +1,188 @@
+"""DDSketch-style relative-error quantile sketch (Masson et al., VLDB 2019)."""
+import math
+from typing import Any, Sequence, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.ops.sketch import (
+    bucket_midpoints,
+    counts_into_bins,
+    log_bucket_index,
+    quantile_gamma,
+)
+from metrics_tpu.sketches.base import SketchMetric
+
+#: edge_counts slot layout (see :meth:`QuantileSketch.update`)
+_NEG_OVER, _NEG_UNDER, _ZERO, _POS_UNDER, _POS_OVER = range(5)
+
+
+class QuantileSketch(SketchMetric):
+    """Streaming quantiles with a per-value relative-error certificate.
+
+    Log-γ bucketed counts à la DDSketch: magnitudes fall into ``2^bits``
+    geometric buckets per sign (bucket ``i`` covers
+    ``[min_value·γ^i, min_value·γ^(i+1))`` with ``γ = (1+α)/(1-α)``), plus
+    five edge bins (±overflow, ±underflow, exact zeros). Any quantile whose
+    rank lands in a regular bucket — or on an exact zero — is certified to
+    within relative error ``α = relative_error``; ranks landing in an edge
+    bin are still estimated but flagged uncertified.
+
+    State is ``2·2^bits + 5`` int32 counters (16.4 KB at the default
+    ``bits=11``), ``dist_reduce_fx="sum"`` throughout — so ``psum`` over a
+    mesh axis, :meth:`merge`, and the ckpt N→M re-reduce are all the same
+    exact histogram addition; merge-then-compute equals compute-on-concat
+    bit-identically at the state level when the shards ran the same update
+    program. (Bucket *assignment* is deterministic per compiled executable:
+    two different compilations of ``log`` — eager vs jit, or different batch
+    shapes — can place a value within 1 ulp of a bucket boundary in the
+    adjacent bucket. Both placements satisfy the certificate; the psum/merge
+    itself is always exact. Verified: mesh-psum state is bit-identical to
+    per-shard same-program ingestion.)
+
+    NaN inputs are excluded from the ranks (``nanquantile`` semantics) and
+    tallied in the ``nan_count`` state.
+
+    Args:
+        relative_error: certified relative accuracy α of returned quantile
+            values (default 1%).
+        bits: log2 bucket count per sign; with ``relative_error`` fixes the
+            trackable magnitude range ``[min_value, min_value·γ^(2^bits))``.
+        min_value: smallest certifiable nonzero magnitude; smaller values
+            count as (uncertified) underflow.
+        quantiles: the quantile levels ``compute`` reports.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.sketches import QuantileSketch
+        >>> sk = QuantileSketch(relative_error=0.01)
+        >>> sk.update(jnp.arange(1.0, 1001.0))
+        >>> out = sk.compute()
+        >>> bool(jnp.abs(out["quantiles"][0] - 500.0) / 500.0 <= 0.01)
+        True
+        >>> bool(out["certified"].all())
+        True
+    """
+
+    higher_is_better = None
+    _update_signature_attrs = ("relative_error", "bits", "min_value")
+
+    def __init__(
+        self,
+        relative_error: float = 0.01,
+        bits: int = 11,
+        min_value: float = 1e-9,
+        quantiles: Sequence[float] = (0.5, 0.9, 0.99),
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(bits, int) or not 4 <= bits <= 16:
+            raise ValueError(f"Argument `bits` must be an int in [4, 16], got {bits}")
+        if not min_value > 0.0:
+            raise ValueError(f"Argument `min_value` must be positive, got {min_value}")
+        qs = tuple(float(q) for q in quantiles)
+        if not qs or not all(0.0 <= q <= 1.0 for q in qs):
+            raise ValueError(f"Argument `quantiles` must be levels in [0, 1], got {quantiles}")
+        self.relative_error = float(relative_error)
+        self.bits = bits
+        self.min_value = float(min_value)
+        self.quantiles = qs
+        self._gamma = quantile_gamma(self.relative_error)
+        self._log_gamma = math.log(self._gamma)
+        nb = 1 << bits
+        self.add_sketch_state("pos_buckets", jnp.zeros((nb,), jnp.int32), "sum")
+        self.add_sketch_state("neg_buckets", jnp.zeros((nb,), jnp.int32), "sum")
+        self.add_sketch_state("edge_counts", jnp.zeros((5,), jnp.int32), "sum")
+        self.add_sketch_state("nan_count", jnp.zeros((), jnp.int32), "sum")
+
+    @property
+    def max_value(self) -> float:
+        """Largest certifiable magnitude, ``min_value · γ^(2^bits)``."""
+        return self.min_value * math.exp(self._log_gamma * (1 << self.bits))
+
+    def update(self, values: Union[float, Array]) -> None:
+        """Bucket a batch of values (any shape; flattened)."""
+        x = jnp.ravel(jnp.asarray(values)).astype(jnp.float32)
+        nb = 1 << self.bits
+        mag = jnp.abs(x)
+        nan = jnp.isnan(x)
+        idx = log_bucket_index(mag, self._log_gamma, self.min_value, nb)
+        pos = (x > 0) & ~nan
+        neg = (x < 0) & ~nan
+        in_range = (idx >= 0) & (idx < nb)
+        self.pos_buckets = self.pos_buckets + counts_into_bins(
+            idx, (pos & in_range).astype(jnp.int32), nb
+        )
+        self.neg_buckets = self.neg_buckets + counts_into_bins(
+            idx, (neg & in_range).astype(jnp.int32), nb
+        )
+        over, under = idx >= nb, idx < 0
+        edges = jnp.stack(
+            [
+                jnp.sum(neg & over, dtype=jnp.int32),
+                jnp.sum(neg & under, dtype=jnp.int32),
+                jnp.sum(x == 0, dtype=jnp.int32),
+                jnp.sum(pos & under, dtype=jnp.int32),
+                jnp.sum(pos & over, dtype=jnp.int32),
+            ]
+        )
+        self.edge_counts = self.edge_counts + edges
+        self.nan_count = self.nan_count + jnp.sum(nan, dtype=jnp.int32)
+
+    def compute(self) -> dict:
+        """Quantile estimates with their certificate.
+
+        Returns a dict: ``quantiles`` (f32, one per requested level, NaN when
+        no values were seen), ``certified`` (bool per level: the rank landed
+        in a regular bucket or on an exact zero, so the value is within
+        ``relative_error``), ``relative_error`` (the declared α).
+        """
+        nb = 1 << self.bits
+        est = bucket_midpoints(nb, self._log_gamma, self.min_value)
+        edge = self.edge_counts
+        # merged ascending-value ordering: most-negative first
+        counts = jnp.concatenate(
+            [
+                edge[_NEG_OVER][None],
+                jnp.flip(self.neg_buckets),
+                edge[_NEG_UNDER][None],
+                edge[_ZERO][None],
+                edge[_POS_UNDER][None],
+                self.pos_buckets,
+                edge[_POS_OVER][None],
+            ]
+        )
+        half_min = jnp.float32(0.5 * self.min_value)
+        values = jnp.concatenate(
+            [
+                jnp.float32(-self.max_value)[None],
+                -jnp.flip(est),
+                -half_min[None],
+                jnp.zeros((1,), jnp.float32),
+                half_min[None],
+                est,
+                jnp.float32(self.max_value)[None],
+            ]
+        )
+        certified = jnp.concatenate(
+            [
+                jnp.zeros((1,), bool),
+                jnp.ones((nb,), bool),
+                jnp.zeros((1,), bool),
+                jnp.ones((1,), bool),  # exact zeros: relative error 0
+                jnp.zeros((1,), bool),
+                jnp.ones((nb,), bool),
+                jnp.zeros((1,), bool),
+            ]
+        )
+        total = jnp.sum(counts)
+        q = jnp.asarray(self.quantiles, jnp.float32)
+        ranks = jnp.floor(q * jnp.maximum(total.astype(jnp.float32) - 1.0, 0.0))
+        slot = jnp.searchsorted(jnp.cumsum(counts).astype(jnp.float32), ranks, side="right")
+        slot = jnp.clip(slot, 0, counts.shape[0] - 1)
+        nonempty = total > 0
+        return {
+            "quantiles": jnp.where(nonempty, values[slot], jnp.nan),
+            "certified": certified[slot] & nonempty,
+            "relative_error": jnp.float32(self.relative_error),
+        }

@@ -239,6 +239,15 @@ PER_NAME = {
             _rng.randint(0, 2, (1, 8, 8, 2)).astype(np.int32),
         ),
     ),
+    # sketches (mergeable streaming telemetry metrics; sketches/)
+    "QuantileSketch": ({}, lambda: (_probs01(),)),
+    "DistinctCount": ({}, lambda: (_rng.randint(0, 1000, N).astype(np.int32),)),
+    "HistogramDrift": (
+        {},
+        lambda: (_probs01(),),
+        ({"reference": True}, {"reference": False}),
+    ),
+    "StreamingAUROCBound": ({}, lambda: (_probs01(), _labels01())),
     # nominal
     "CramersV": ({"num_classes": 4}, lambda: (_mc_labels(c=4), _mc_labels(c=4))),
     "PearsonsContingencyCoefficient": ({"num_classes": 4}, lambda: (_mc_labels(c=4), _mc_labels(c=4))),
@@ -345,6 +354,7 @@ _RANK_TIERED = [
     if ("AUROC" in n or "AveragePrecision" in n)
     and not n.startswith("Retrieval")  # retrieval AP rides ops/segment.py, not clf_curve
     and n != "MeanAveragePrecision"  # detection mAP: own device kernel, dict output
+    and n != "StreamingAUROCBound"  # sketch tier: histogram bounds, no sort dispatch
 ]
 
 

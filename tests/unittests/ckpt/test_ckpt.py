@@ -607,6 +607,75 @@ def test_topology_change_collection_member_counts_take_max(tmp_path):
     assert member._update_count == 3  # max across hosts, not host 0's count of 1
 
 
+def test_topology_change_hll_max_states_rereduce(tmp_path):
+    """The sketch family's `max` re-reduce in the N→M matrix: HLL registers
+    saved from 2 hosts restore onto 1 host as the elementwise max — which IS
+    the HLL merge, so the restored estimate equals the single-stream oracle
+    bit-identically (restore.py's max rule merges on every host, not rank 0)."""
+    from metrics_tpu.sketches import DistinctCount
+
+    d = str(tmp_path)
+    chunks = [_rng.randint(0, 3000, 4000), _rng.randint(2000, 8000, 4000)]
+    for rank, chunk in enumerate(chunks):
+        m = DistinctCount(p=10)
+        m.update(jnp.asarray(chunk))
+        m.save_checkpoint(d, step=0, process_index=rank, process_count=2, replicated=False)
+
+    oracle = DistinctCount(p=10)
+    oracle.update(jnp.asarray(np.concatenate(chunks)))
+
+    # 2 hosts -> 1 host
+    single = DistinctCount(p=10)
+    single.restore_checkpoint(d, process_index=0, process_count=1)
+    np.testing.assert_array_equal(np.asarray(single.registers), np.asarray(oracle.registers))
+    assert float(single.compute()) == float(oracle.compute())
+
+    # 2 hosts -> 3 hosts: max states merge on EVERY host (unlike sum, the
+    # merged registers are safe to hold replicated — pmax is idempotent)
+    for rank in range(3):
+        h = DistinctCount(p=10)
+        h.restore_checkpoint(d, process_index=rank, process_count=3)
+        np.testing.assert_array_equal(np.asarray(h.registers), np.asarray(oracle.registers))
+
+
+def test_topology_change_quantile_sketch_sum_states_rereduce(tmp_path):
+    """QuantileSketch's `sum` re-reduce across N→M: bucket histograms saved
+    from 2 hosts re-reduce so that a cross-host sum still equals the oracle's
+    single-stream histogram, and the restored quantiles match exactly."""
+    from metrics_tpu.sketches import QuantileSketch
+
+    d = str(tmp_path)
+    chunks = [
+        _rng.lognormal(0.0, 1.5, 3000).astype(np.float32),
+        _rng.lognormal(1.0, 1.0, 3000).astype(np.float32),
+    ]
+    for rank, chunk in enumerate(chunks):
+        m = QuantileSketch()
+        m.update(jnp.asarray(chunk))
+        m.save_checkpoint(d, step=0, process_index=rank, process_count=2, replicated=False)
+
+    oracle = QuantileSketch()
+    oracle.update(jnp.asarray(np.concatenate(chunks)))
+
+    # 2 hosts -> 1 host: the single host owns the re-reduced totals
+    single = QuantileSketch()
+    single.restore_checkpoint(d, process_index=0, process_count=1)
+    for state in ("pos_buckets", "neg_buckets", "edge_counts", "nan_count"):
+        np.testing.assert_array_equal(np.asarray(getattr(single, state)), np.asarray(getattr(oracle, state)))
+    np.testing.assert_array_equal(
+        np.asarray(single.compute()["quantiles"]), np.asarray(oracle.compute()["quantiles"])
+    )
+
+    # 2 hosts -> 3 hosts: rank 0 owns the total, others reset defaults, so the
+    # cross-host sum reproduces the global histogram
+    shards = []
+    for rank in range(3):
+        h = QuantileSketch()
+        h.restore_checkpoint(d, process_index=rank, process_count=3)
+        shards.append(np.asarray(h.pos_buckets))
+    np.testing.assert_array_equal(sum(shards), np.asarray(oracle.pos_buckets))
+
+
 def test_topology_change_unreduced_state_raises(tmp_path):
     d = str(tmp_path)
     for rank in range(2):

@@ -1,0 +1,479 @@
+"""Property tests for the mergeable streaming sketch family (sketches/).
+
+Every sketch is checked against a numpy/scipy oracle on the full input stream:
+
+- QuantileSketch: every returned certified quantile is within the DECLARED
+  relative error of the exact ``np.quantile`` of the same data (the γ-bound),
+  across dtypes, distributions, and adversarial values;
+- DistinctCount: estimate within 3σ of the HLL standard error 1.04/sqrt(m)
+  of the true cardinality (and exactly order/merge-invariant);
+- HistogramDrift: KL/PSI/TV equal to scipy/numpy recomputation from the same
+  histograms;
+- StreamingAUROCBound: the exact-tier AUROC/AP (ops/clf_curve.py) lies inside
+  the certified bracket, and the bracket collapses to the exact value on
+  quantized score domains.
+
+Merge laws hold for all four: merge-then-compute equals compute-on-concat
+(bit-identically at the state level), under arbitrary split/merge orderings.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.sketches import (
+    DistinctCount,
+    HistogramDrift,
+    QuantileSketch,
+    SketchMetric,
+    StreamingAUROCBound,
+)
+from metrics_tpu.utils.exceptions import MetricsUserError
+
+pytestmark = pytest.mark.sketch
+
+_rng = np.random.RandomState(202)
+
+#: fp slack on top of the declared certificate: bucket-boundary assignment can
+#: shift one bucket on the ~1-ulp log rounding, costing at most ~α extra on the
+#: two affected values; everything observed is far inside this
+_CERT_SLACK = 1.10
+
+
+def _leaves(value):
+    return [np.asarray(x) for x in jax.tree.leaves(value)]
+
+
+# ------------------------------------------------------------- QuantileSketch
+
+
+@pytest.mark.parametrize("alpha", [0.05, 0.01])
+@pytest.mark.parametrize(
+    "sampler",
+    [
+        lambda n: _rng.lognormal(0.0, 2.0, n),
+        lambda n: _rng.exponential(37.0, n) + 1e-3,
+        lambda n: np.concatenate([_rng.lognormal(0, 1, n // 2), -_rng.lognormal(2, 1, n - n // 2)]),
+    ],
+    ids=["lognormal", "latency-like", "two-sided"],
+)
+def test_quantile_certified_relative_error(alpha, sampler):
+    x = sampler(60_000).astype(np.float32)
+    qs = (0.01, 0.25, 0.5, 0.9, 0.99, 0.999)
+    sk = QuantileSketch(relative_error=alpha, quantiles=qs)
+    sk.update(jnp.asarray(x))
+    out = sk.compute()
+    est, cert = np.asarray(out["quantiles"]), np.asarray(out["certified"])
+    true = np.quantile(x, qs, method="lower")
+    assert cert.all(), "in-range data must produce certified quantiles"
+    rel = np.abs(est - true) / np.abs(true)
+    assert (rel <= alpha * _CERT_SLACK).all(), f"relative errors {rel} exceed the α={alpha} certificate"
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float16, "bfloat16"])
+def test_quantile_dtypes(dtype):
+    x = _rng.lognormal(0.0, 1.0, 20_000).astype(np.float32)
+    xj = jnp.asarray(x).astype(jnp.bfloat16 if dtype == "bfloat16" else dtype)
+    sk = QuantileSketch(relative_error=0.02, quantiles=(0.5, 0.99))
+    sk.update(xj)
+    out = sk.compute()
+    # oracle over the values the sketch actually saw (narrow dtypes round)
+    true = np.quantile(np.asarray(xj, np.float32), (0.5, 0.99), method="lower")
+    rel = np.abs(np.asarray(out["quantiles"]) - true) / true
+    assert (rel <= 0.02 * _CERT_SLACK).all()
+    assert np.asarray(out["certified"]).all()
+
+
+def test_quantile_adversarial_values():
+    sk = QuantileSketch(quantiles=(0.0, 0.5, 1.0))
+    sk.update(jnp.asarray([np.inf, -np.inf, 0.0, -0.0, 1e-40, -1e-40, 1e38, -1e38, np.nan, 2.0]))
+    out = sk.compute()
+    est, cert = np.asarray(out["quantiles"]), np.asarray(out["certified"])
+    assert int(sk.nan_count) == 1  # NaN tallied, excluded from ranks
+    assert est[0] == -float(sk.max_value) and not cert[0]  # -inf: overflow bin, uncertified
+    assert est[2] == float(sk.max_value) and not cert[2]  # +inf
+    assert np.isfinite(est).all()
+    # exact zeros are certified with zero error (denormals flush into the zero
+    # class on this backend's float pipeline, like the rank engine documents)
+    mid_ok = cert[1] and abs(est[1]) <= float(sk.min_value)
+    assert mid_ok
+
+
+def test_quantile_empty_and_single():
+    sk = QuantileSketch()
+    out = sk.compute()
+    assert np.isnan(np.asarray(out["quantiles"])).all()
+    assert not np.asarray(out["certified"]).any()
+    sk.update(jnp.asarray([42.0]))
+    out = sk.compute()
+    assert (np.abs(np.asarray(out["quantiles"]) - 42.0) / 42.0 <= 0.01 * _CERT_SLACK).all()
+
+
+def test_quantile_merge_orderings_match_concat():
+    chunks = [
+        _rng.lognormal(0, 1, 5000).astype(np.float32),
+        _rng.lognormal(2, 1, 3000).astype(np.float32),
+        -_rng.lognormal(1, 1, 4000).astype(np.float32),
+        _rng.exponential(5.0, 2000).astype(np.float32),
+    ]
+    whole = QuantileSketch()
+    whole.update(jnp.asarray(np.concatenate(chunks)))
+
+    def sketch_of(c):
+        s = QuantileSketch()
+        s.update(jnp.asarray(c))
+        return s
+
+    # left fold, right fold, and pairwise tree must all equal the single stream
+    for order in ([0, 1, 2, 3], [3, 2, 1, 0], [2, 0, 3, 1]):
+        acc = sketch_of(chunks[order[0]])
+        for i in order[1:]:
+            acc.merge(sketch_of(chunks[i]))
+        for state in ("pos_buckets", "neg_buckets", "edge_counts", "nan_count"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(acc, state)), np.asarray(getattr(whole, state)),
+                err_msg=f"merge order {order}, state {state}",
+            )
+        np.testing.assert_array_equal(
+            np.asarray(acc.compute()["quantiles"]), np.asarray(whole.compute()["quantiles"])
+        )
+
+
+# -------------------------------------------------------------- DistinctCount
+
+
+@pytest.mark.parametrize("p", [10, 12])
+@pytest.mark.parametrize("true_n", [500, 20_000, 300_000])
+def test_hll_within_three_sigma(p, true_n):
+    vals = np.arange(true_n, dtype=np.int64) * 2654435761 % (1 << 31)  # distinct, scattered
+    stream = np.concatenate([vals, vals[: true_n // 2]]).astype(np.int32)  # duplicates too
+    dc = DistinctCount(p=p)
+    dc.update(jnp.asarray(stream))
+    est = float(dc.compute())
+    sigma = 1.04 / np.sqrt(1 << p)
+    assert abs(est - true_n) / true_n <= 3 * sigma, (
+        f"p={p} n={true_n}: estimate {est:.0f} off by {abs(est - true_n) / true_n:.4f}"
+        f" > 3σ={3 * sigma:.4f}"
+    )
+
+
+def test_hll_float_inputs_and_dtype_consistency():
+    vals = _rng.rand(10_000).astype(np.float32)
+    a, b = DistinctCount(), DistinctCount()
+    a.update(jnp.asarray(vals))
+    # bf16 widens exactly into f32: counting the bf16-rounded values directly
+    # or their f32 widening must hash identically
+    bf = jnp.asarray(vals).astype(jnp.bfloat16)
+    b.update(bf)
+    c = DistinctCount()
+    c.update(bf.astype(jnp.float32))
+    np.testing.assert_array_equal(np.asarray(b.registers), np.asarray(c.registers))
+    true_bf = len(np.unique(np.asarray(bf.astype(jnp.float32))))
+    assert abs(float(b.compute()) - true_bf) / true_bf <= 3 * 1.04 / np.sqrt(1 << 12)
+
+
+def test_hll_zero_negzero_collapse_and_empty():
+    a = DistinctCount()
+    a.update(jnp.asarray([0.0, -0.0]))
+    assert int(np.sum(np.asarray(a.registers) > 0)) == 1  # one distinct value
+    assert float(DistinctCount().compute()) == 0.0
+
+
+def test_hll_merge_bit_identical_any_order():
+    chunks = [_rng.randint(0, 40_000, 30_000).astype(np.int32) for _ in range(3)]
+    whole = DistinctCount()
+    whole.update(jnp.asarray(np.concatenate(chunks)))
+    for order in ([0, 1, 2], [2, 0, 1], [1, 2, 0]):
+        acc = DistinctCount()
+        for i in order:
+            part = DistinctCount()
+            part.update(jnp.asarray(chunks[i]))
+            acc.merge(part)
+        np.testing.assert_array_equal(np.asarray(acc.registers), np.asarray(whole.registers))
+        assert float(acc.compute()) == float(whole.compute())
+
+
+def test_hll_seed_mismatch_is_callers_contract():
+    # same data, different seeds -> different registers (the docs' "share the
+    # seed to merge" rule has observable teeth)
+    a, b = DistinctCount(seed=0), DistinctCount(seed=1)
+    data = jnp.arange(1000)
+    a.update(data)
+    b.update(data)
+    assert not np.array_equal(np.asarray(a.registers), np.asarray(b.registers))
+
+
+# ------------------------------------------------------------- HistogramDrift
+
+
+def test_drift_divergences_match_scipy():
+    scipy_stats = pytest.importorskip("scipy.stats")
+    ref = _rng.beta(2, 2, 30_000).astype(np.float32)
+    live = _rng.beta(2, 5, 30_000).astype(np.float32)
+    hd = HistogramDrift(num_bins=32)
+    hd.update(jnp.asarray(ref), reference=True)
+    hd.update(jnp.asarray(live))
+    out = {k: float(v) for k, v in hd.compute().items()}
+
+    # oracle: same binning, Jeffreys smoothing, scipy entropy for the KL
+    bins = np.concatenate([[-np.inf], np.linspace(0, 1, 33), [np.inf]])
+    href = np.histogram(ref, bins)[0].astype(np.float64)
+    hlive = np.histogram(live, bins)[0].astype(np.float64)
+    p = (hlive + 0.5) / (hlive.sum() + 0.5 * len(hlive))
+    q = (href + 0.5) / (href.sum() + 0.5 * len(href))
+    np.testing.assert_allclose(out["kl"], scipy_stats.entropy(p, q), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(out["psi"], np.sum((p - q) * np.log(p / q)), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        out["tv"], 0.5 * np.abs(hlive / hlive.sum() - href / href.sum()).sum(), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_drift_identical_distributions_near_zero():
+    x = _rng.rand(20_000).astype(np.float32)
+    hd = HistogramDrift()
+    hd.update(jnp.asarray(x), reference=True)
+    hd.update(jnp.asarray(x))
+    out = {k: float(v) for k, v in hd.compute().items()}
+    assert out["tv"] == 0.0 and out["kl"] < 1e-6 and abs(out["psi"]) < 1e-6
+
+
+def test_drift_out_of_range_and_window_reset():
+    hd = HistogramDrift(num_bins=8, low=0.0, high=1.0)
+    hd.update(jnp.asarray([-5.0, -np.inf, 0.5, np.inf, 7.0, np.nan]), reference=True)
+    ref = np.asarray(hd.ref_hist)
+    assert ref[0] == 2 and ref[-1] == 2 and ref.sum() == 5  # NaN dropped, ±inf in edge bins
+    hd.update(jnp.asarray([0.9, 0.9]))
+    assert np.asarray(hd.live_hist).sum() == 2
+    hd.reset_live()
+    assert np.asarray(hd.live_hist).sum() == 0
+    assert np.asarray(hd.ref_hist).sum() == 5  # reference survives the window slide
+
+
+def test_drift_merge_matches_concat():
+    r1, r2 = _rng.rand(4000).astype(np.float32), _rng.rand(4000).astype(np.float32)
+    l1, l2 = (_rng.rand(4000) ** 2).astype(np.float32), (_rng.rand(4000) ** 2).astype(np.float32)
+    a, b = HistogramDrift(), HistogramDrift()
+    a.update(jnp.asarray(r1), reference=True)
+    a.update(jnp.asarray(l1))
+    b.update(jnp.asarray(r2), reference=True)
+    b.update(jnp.asarray(l2))
+    whole = HistogramDrift()
+    whole.update(jnp.asarray(np.concatenate([r1, r2])), reference=True)
+    whole.update(jnp.asarray(np.concatenate([l1, l2])))
+    a.merge(b)
+    np.testing.assert_array_equal(np.asarray(a.ref_hist), np.asarray(whole.ref_hist))
+    np.testing.assert_array_equal(np.asarray(a.live_hist), np.asarray(whole.live_hist))
+    for k in ("kl", "psi", "tv"):
+        assert float(a.compute()[k]) == float(whole.compute()[k])
+
+
+# -------------------------------------------------------- StreamingAUROCBound
+
+
+def _exact_auroc_ap(preds, target):
+    from metrics_tpu.ops.clf_curve import binary_auroc_exact, binary_average_precision_exact
+
+    return (
+        float(binary_auroc_exact(jnp.asarray(preds), jnp.asarray(target))),
+        float(binary_average_precision_exact(jnp.asarray(preds), jnp.asarray(target))),
+    )
+
+
+@pytest.mark.parametrize(
+    ("skew", "max_auroc_width", "max_ap_width"),
+    # AP's bracket widens when positives are rare: the top-rank precisions
+    # that dominate AP are exactly the within-bucket orderings the histogram
+    # lost. AUROC's bracket only carries pair mass, so it stays tight.
+    [(0.5, 0.06, 0.09), (0.05, 0.06, 0.25)],
+    ids=["balanced", "rare-positives"],
+)
+def test_streaming_auroc_bracket_contains_exact(skew, max_auroc_width, max_ap_width):
+    n = 60_000
+    preds = _rng.rand(n).astype(np.float32)
+    target = (_rng.rand(n) < preds * skew * 2).astype(np.int32)
+    m = StreamingAUROCBound(bits=12)
+    # stream in batches — the accumulating path, not one-shot
+    for lo in range(0, n, 7_000):
+        m.update(jnp.asarray(preds[lo : lo + 7_000]), jnp.asarray(target[lo : lo + 7_000]))
+    out = {k: float(v) for k, v in m.compute().items()}
+    ex_auroc, ex_ap = _exact_auroc_ap(preds, target)
+    eps = 1e-5
+    assert out["auroc_lower"] - eps <= ex_auroc <= out["auroc_upper"] + eps
+    assert out["ap_lower"] - eps <= ex_ap <= out["ap_upper"] + eps
+    # continuous uniform scores: bucketing is per-BINADE (2^(bits-9) buckets
+    # per binade), and half of U[0,1) mass sits in [0.5, 1) — one binade, 8
+    # sub-buckets at bits=12 — so the predicted same-bucket pair fraction is
+    # ~0.03, not the 1/2^bits a uniform-key intuition suggests (the class
+    # docstring carries this caveat).
+    assert out["auroc_upper"] - out["auroc_lower"] < max_auroc_width
+    assert out["ap_upper"] - out["ap_lower"] < max_ap_width
+
+
+def test_streaming_auroc_quantized_domain_collapses_to_exact():
+    # a score domain whose distinct values never share a bucket (here: 64
+    # powers of two — one exponent each, and the top 12 key bits contain the
+    # full exponent) -> residual same-bucket mass is true ties, which score
+    # exactly 1/2, so the midpoint IS the exact AUROC (rank_engine docs)
+    n = 50_000
+    preds = (2.0 ** -_rng.randint(0, 64, n)).astype(np.float32)
+    target = (_rng.rand(n) < preds ** 0.05).astype(np.int32)
+    m = StreamingAUROCBound(bits=12)
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    out = {k: float(v) for k, v in m.compute().items()}
+    ex_auroc, _ = _exact_auroc_ap(preds, target)
+    np.testing.assert_allclose(out["auroc_mid"], ex_auroc, rtol=2e-5, atol=2e-6)
+
+
+def test_streaming_auroc_degenerate_single_class():
+    m = StreamingAUROCBound()
+    m.update(jnp.asarray([0.1, 0.9, 0.5]), jnp.asarray([1, 1, 1]))
+    out = {k: float(v) for k, v in m.compute().items()}
+    assert out["auroc_lower"] == out["auroc_upper"] == 0.0  # documented degenerate
+    empty = {k: float(v) for k, v in StreamingAUROCBound().compute().items()}
+    assert all(v == 0.0 for v in empty.values())
+
+
+def test_streaming_auroc_merge_bit_identical():
+    n = 30_000
+    preds = _rng.rand(n).astype(np.float32)
+    target = _rng.randint(0, 2, n).astype(np.int32)
+    a, b = StreamingAUROCBound(), StreamingAUROCBound()
+    a.update(jnp.asarray(preds[: n // 2]), jnp.asarray(target[: n // 2]))
+    b.update(jnp.asarray(preds[n // 2 :]), jnp.asarray(target[n // 2 :]))
+    whole = StreamingAUROCBound()
+    whole.update(jnp.asarray(preds), jnp.asarray(target))
+    a.merge(b)
+    np.testing.assert_array_equal(np.asarray(a.pos_hist), np.asarray(whole.pos_hist))
+    np.testing.assert_array_equal(np.asarray(a.neg_hist), np.asarray(whole.neg_hist))
+    for k, v in a.compute().items():
+        assert float(v) == float(whole.compute()[k])
+
+
+def test_ap_bound_psi_diff_stability_at_stream_scale():
+    """The ψ-difference AP form must stay accurate where a naive digamma
+    difference catastrophically cancels (prefix counts ~1e7)."""
+    from metrics_tpu.ops.rank import average_precision_bounds_from_hists
+
+    pos = np.zeros(4096, np.int32)
+    neg = np.zeros(4096, np.int32)
+    # 10M negatives ranked first, then interleaved tail — prefix counts hit 1e7
+    neg[:100] = 100_000
+    pos[100:200] = 5_000
+    neg[100:200] = 5_000
+    lo, hi = average_precision_bounds_from_hists(jnp.asarray(pos), jnp.asarray(neg))
+    lo, hi = float(lo), float(hi)
+    # brute-force oracle on the worst/best arrangements (f64)
+    def arrangement_ap(pos_first):
+        total_p = pos.sum()
+        ap = 0.0
+        p_prev = n_prev = 0
+        for b in range(4096):
+            pb, nb = int(pos[b]), int(neg[b])
+            if pb:
+                k = n_prev + (0 if pos_first else nb)
+                i = np.arange(1, pb + 1, dtype=np.float64)
+                ap += np.sum((p_prev + i) / (p_prev + k + i))
+            p_prev += pb
+            n_prev += nb
+        return ap / total_p
+
+    np.testing.assert_allclose(lo, arrangement_ap(False), rtol=1e-4)
+    np.testing.assert_allclose(hi, arrangement_ap(True), rtol=1e-4)
+    assert lo <= hi
+
+
+# --------------------------------------------------------- mesh merge = psum
+
+
+def test_mesh_collective_merge_is_the_sketch_merge():
+    """The headline claim: psum/pmax over a mesh axis IS the sketch merge.
+
+    HLL registers are cross-program stable (integer hashing), so the mesh-pmax
+    state must equal single-stream ingestion bit-identically. QuantileSketch's
+    bucket assignment is float (deterministic per executable), so its mesh-psum
+    state is compared against SAME-PROGRAM per-shard ingestion merged on host —
+    also bit-identical (the docs' precise form of the claim)."""
+    from functools import partial
+
+    from metrics_tpu.parallel.collective import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    world = len(jax.devices())
+    assert world >= 2, "conftest forces 8 virtual host devices"
+    mesh = Mesh(np.array(jax.devices()), ("hosts",))
+
+    ids = jnp.asarray(_rng.randint(0, 30_000, (world, 8_000)).astype(np.int32))
+    lat = jnp.asarray(_rng.lognormal(0, 1, (world, 8_000)).astype(np.float32))
+
+    for metric, data in ((DistinctCount(), ids), (QuantileSketch(), lat)):
+        @jax.jit
+        @partial(shard_map, mesh=mesh, in_specs=P("hosts"), out_specs=P())
+        def synced_state(x, _m=metric):
+            return _m.sync_state(_m.local_update(_m.init_state(), x[0]), axis_name="hosts")
+
+        synced = synced_state(data)
+        if isinstance(metric, DistinctCount):
+            oracle = DistinctCount()
+            oracle.update(data.reshape(-1))
+            want = {"registers": np.asarray(oracle.registers)}
+        else:
+            upd = jax.jit(lambda s, x, _m=metric: _m.local_update(s, x))
+            shard_states = [upd(metric.init_state(), data[i]) for i in range(world)]
+            want = {k: sum(np.asarray(s[k]) for s in shard_states) for k in shard_states[0]}
+        for k, v in want.items():
+            np.testing.assert_array_equal(np.asarray(synced[k]), v, err_msg=f"{type(metric).__name__}.{k}")
+
+
+# ------------------------------------------------------------- family contract
+
+
+def test_sketch_base_rejects_float_state_and_bad_reduce():
+    class _BadDtype(SketchMetric):
+        def __init__(self):
+            super().__init__()
+            self.add_sketch_state("x", jnp.zeros((4,), jnp.float32), "sum")
+
+        def update(self):  # pragma: no cover - never reached
+            pass
+
+        def compute(self):  # pragma: no cover
+            pass
+
+    with pytest.raises(MetricsUserError, match="integer"):
+        _BadDtype()
+
+    class _BadReduce(SketchMetric):
+        def __init__(self):
+            super().__init__()
+            self.add_sketch_state("x", jnp.zeros((4,), jnp.int32), "cat")
+
+        def update(self):  # pragma: no cover
+            pass
+
+        def compute(self):  # pragma: no cover
+            pass
+
+    with pytest.raises(MetricsUserError, match="mergeable"):
+        _BadReduce()
+
+
+def test_merge_rejects_cross_class_and_counts_updates():
+    a, b = DistinctCount(), QuantileSketch()
+    with pytest.raises(MetricsUserError, match="same class"):
+        a.merge(b)
+    c, d = DistinctCount(), DistinctCount()
+    c.update(jnp.arange(10))
+    d.update(jnp.arange(10))
+    d.update(jnp.arange(5))
+    c.merge(d)
+    assert c._update_count == 3  # merge carries the peer's update count
+
+
+def test_state_bytes_reports_fixed_cost():
+    assert DistinctCount(p=12).state_bytes() == 4096
+    qs = QuantileSketch(bits=11)
+    assert qs.state_bytes() == 2 * 2048 * 4 + 5 * 4 + 4
+    # and it never grows with data — the whole point of a sketch
+    qs.update(jnp.asarray(_rng.rand(100_000).astype(np.float32)))
+    assert qs.state_bytes() == 2 * 2048 * 4 + 5 * 4 + 4
