@@ -970,6 +970,120 @@ def bench_fused(n: int = 1 << 20, steps: int = 8, trials: int = 5) -> dict:
     }
 
 
+def bench_fleet(fleet_sizes=(16, 256, 4096), rows_per_stream: int = 8,
+                steps: int = 8, trials: int = 3) -> dict:
+    """``--fleet``: eager-N instances vs ONE fleet metric (core/fleet.py) —
+    the ISSUE 9 N->1 dispatch claim for concurrent serving streams.
+
+    Per fleet size N in ``fleet_sizes``: p50 update ms for N independent
+    ``MulticlassAccuracy`` instances each fed its own ``rows_per_stream`` rows
+    (one dispatch per instance per step) vs one ``fleet_size=N`` instance fed
+    the concatenated batch with repeat ``stream_ids`` (one routed launch).
+    Batch shapes are fixed per tier so neither side pays retraces in the timed
+    window. Launches/step are measured off the obs ``dispatches`` counter (one
+    instrumented step, not inferred) and state HBM comes from
+    ``state_report()``. Headline value is the fleet update p50 at the largest
+    N; vs_baseline is aggregate eager/fleet throughput there (acceptance
+    floor: >=10x on CPU). Timed passes run with obs OFF (bench-parity
+    criterion); only the launch-count pass flips it on.
+    """
+    from metrics_tpu.classification import MulticlassAccuracy
+
+    def batch_for(n_streams: int) -> tuple:
+        k1, k2 = jax.random.split(jax.random.PRNGKey(n_streams))
+        rows = n_streams * rows_per_stream
+        preds = jax.random.randint(k1, (rows,), 0, 5, dtype=jnp.int32)
+        target = jax.random.randint(k2, (rows,), 0, 5, dtype=jnp.int32)
+        ids = jnp.repeat(jnp.arange(n_streams, dtype=jnp.int32), rows_per_stream)
+        return preds, target, ids
+
+    per_n = {}
+    headline_ms = None
+    headline_ratio = None
+    for n_streams in fleet_sizes:
+        preds, target, ids = batch_for(n_streams)
+        subs = [
+            (preds[i * rows_per_stream:(i + 1) * rows_per_stream],
+             target[i * rows_per_stream:(i + 1) * rows_per_stream])
+            for i in range(n_streams)
+        ]
+        # eager steps shrink with N so the largest size stays bounded on CPU
+        # (4096 dispatches/step); the fleet tier always runs the full window
+        eager_steps = max(1, min(steps, 2048 // n_streams))
+        eager_trials = trials if n_streams <= 256 else 1
+
+        fleet = MulticlassAccuracy(
+            num_classes=5, average="micro", validate_args=False, fleet_size=n_streams
+        )
+        fleet.update(preds, target, stream_ids=ids)  # compile/warm
+        jax.block_until_ready(fleet.tp)
+
+        def fleet_pass():
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                fleet.update(preds, target, stream_ids=ids)
+            jax.block_until_ready(fleet.tp)
+            return (time.perf_counter() - t0) / steps * 1000
+
+        fleet_ms = statistics.median(fleet_pass() for _ in range(trials))
+
+        eager = [
+            MulticlassAccuracy(num_classes=5, average="micro", validate_args=False)
+            for _ in range(n_streams)
+        ]
+        for m, (p, t) in zip(eager, subs):
+            m.update(p, t)  # warm
+        jax.block_until_ready(eager[-1].tp)
+
+        def eager_pass():
+            t0 = time.perf_counter()
+            for _ in range(eager_steps):
+                for m, (p, t) in zip(eager, subs):
+                    m.update(p, t)
+            jax.block_until_ready(eager[-1].tp)
+            return (time.perf_counter() - t0) / eager_steps * 1000
+
+        eager_ms = statistics.median(eager_pass() for _ in range(eager_trials))
+
+        # launch count per step off the counters (one instrumented step)
+        launches = {}
+        with _obs().observe(clear=True):
+            fleet.update(preds, target, stream_ids=ids)
+            snap = _obs().snapshot()
+        launches["fleet"] = sum(v.get("dispatches", 0) for v in snap.values())
+        with _obs().observe(clear=True):
+            for m, (p, t) in zip(eager, subs):
+                m.update(p, t)
+            snap = _obs().snapshot()
+        launches["eager"] = sum(v.get("dispatches", 0) for v in snap.values())
+
+        one = MulticlassAccuracy(num_classes=5, average="micro", validate_args=False)
+        per_n[str(n_streams)] = {
+            "fleet_update_ms": round(fleet_ms, 3),
+            "eager_update_ms": round(eager_ms, 3),
+            "throughput_x": round(eager_ms / fleet_ms, 2),
+            "launches_per_step_fleet": launches["fleet"],
+            "launches_per_step_eager": launches["eager"],
+            "fleet_state_bytes": fleet.state_report()["total_nbytes"],
+            "eager_state_bytes": one.state_report()["total_nbytes"] * n_streams,
+        }
+        headline_ms, headline_ratio = fleet_ms, eager_ms / fleet_ms
+    return {
+        "metric": "fleet_update_step",
+        "value": round(headline_ms, 3),
+        "unit": "ms/step",
+        "vs_baseline": round(headline_ratio, 2),
+        "fleet_size": fleet_sizes[-1],
+        "rows_per_stream": rows_per_stream,
+        "per_fleet_size": per_n,
+        "bound": "eager pays one python dispatch + one jit cache lookup + one"
+                 " tiny launch PER STREAM per step (host-bound at ~0.5 ms each"
+                 " on CPU); the fleet tier routes the whole concatenated batch"
+                 " through one cached donated executable, so its cost is one"
+                 " dispatch plus an O(rows) segment reduction",
+    }
+
+
 def bench_sketch(sizes=(1 << 20, 1 << 24), trials: int = 3) -> dict:
     """``--sketch``: the mergeable sketch family (metrics_tpu/sketches/) —
     update throughput, compute latency, and merge cost at 2^20 and 2^24 elems.
@@ -1183,7 +1297,7 @@ if __name__ == "__main__":
     parser = argparse.ArgumentParser(description="metrics_tpu benchmarks")
     parser.add_argument(
         "--config",
-        choices=("accuracy", "logits", "confmat", "map", "ssim", "retrieval", "auroc", "fid", "fused", "sketch", "lint", "all"),
+        choices=("accuracy", "logits", "confmat", "map", "ssim", "retrieval", "auroc", "fid", "fused", "fleet", "sketch", "lint", "all"),
         default="all",
     )
     parser.add_argument(
@@ -1201,6 +1315,15 @@ if __name__ == "__main__":
         " XLA launch, core/fused.py) step time over the canonical five-group"
         " collection, launches/step from the obs `dispatches` counter, and the"
         " executable-cache hit rate (also runs under --config all)",
+    )
+    parser.add_argument(
+        "--fleet",
+        action="store_true",
+        help="also run the fleet-axis bench: N eager per-stream instances vs"
+        " one Metric(fleet_size=N) routed launch (core/fleet.py) at N in"
+        " {16, 256, 4096} — update p50, launches/step from the obs"
+        " `dispatches` counter, and state HBM bytes (also runs under"
+        " --config all)",
     )
     parser.add_argument(
         "--ckpt",
@@ -1264,6 +1387,7 @@ if __name__ == "__main__":
         ("retrieval", bench_retrieval),
         ("auroc", bench_auroc),
         ("fused", bench_fused),
+        ("fleet", bench_fleet),
         ("sketch", bench_sketch),
         ("ckpt", bench_ckpt),
         ("lint", bench_lint),
@@ -1273,13 +1397,15 @@ if __name__ == "__main__":
             continue
         if name == "fused" and not (cli.fused or config in ("fused", "all")):
             continue
+        if name == "fleet" and not (cli.fleet or config in ("fleet", "all")):
+            continue
         if name == "sketch" and not (cli.sketch or config in ("sketch", "all")):
             continue
         if name == "lint" and not (cli.lint_overhead or config in ("lint", "all")):
             continue
         if name == "san" and not (cli.san_overhead or config == "all"):
             continue
-        if config in (name, "all") or name in ("ckpt", "fused", "sketch", "lint", "san"):
+        if config in (name, "all") or name in ("ckpt", "fused", "fleet", "sketch", "lint", "san"):
             try:
                 result = fn()
                 summary[result["metric"]] = {

@@ -278,7 +278,7 @@ def class_findings(item: IntrospectedClass, repo_root: str) -> List[Finding]:
 
 def run_contract_rules(repo_root: str) -> Tuple[List[Finding], Dict[str, str]]:
     """(findings, {class_name: skip_reason}) over every introspectable class."""
-    from metrics_tpu.analysis.registry import introspect_classes
+    from metrics_tpu.analysis.registry import introspect_classes, introspect_fleet_variants
 
     findings: List[Finding] = []
     skipped: Dict[str, str] = {}
@@ -290,6 +290,14 @@ def run_contract_rules(repo_root: str) -> Tuple[List[Finding], Dict[str, str]]:
         if item.cls in seen_classes:
             continue  # dispatcher duplicates (Accuracy -> BinaryAccuracy)
         seen_classes.add(item.cls)
+        findings.extend(class_findings(item, repo_root))
+    # fleet-axis variants re-run the contract rules over a live (N, *base)
+    # state registry — same classes, so any repeat finding collapses in the
+    # key+line dedup below and only fleet-specific drift would surface
+    for item in introspect_fleet_variants():
+        if item.instance is None:
+            skipped[item.name] = item.skip_reason
+            continue
         findings.extend(class_findings(item, repo_root))
     # several exported classes share one defining update (AUROC inherits the
     # curve update): identical (key, line) findings collapse to one

@@ -103,6 +103,19 @@ def _ctor_specs() -> Dict[str, Callable[[], Dict[str, Any]]]:
     return specs
 
 
+#: fleet-axis ctor specs (core/fleet.py): representative classes — one per
+#: state flavor (scalar counts, per-class vectors, float accumulators, a
+#: max-reduction state) — re-constructed with a fleet dim so the
+#: state-contract rules also sweep a live (fleet_size, *base) registry,
+#: including the `_fleet_rows` bookkeeping state it injects
+FLEET_VARIANT_SPECS: Tuple[Tuple[str, Dict[str, Any]], ...] = (
+    ("BinaryAccuracy", {"fleet_size": 4}),
+    ("MulticlassAccuracy", {"num_classes": 5, "average": None, "fleet_size": 4}),
+    ("MeanSquaredError", {"fleet_size": 4}),
+    ("MinMetric", {"fleet_size": 4}),
+)
+
+
 #: family prefix -> ctor kwargs (matches the contract sweep's FAMILIES)
 FAMILY_KWARGS: Tuple[Tuple[str, Dict[str, Any]], ...] = (
     ("Binary", {}),
@@ -180,3 +193,20 @@ def introspect_classes() -> Iterator[IntrospectedClass]:
             yield IntrospectedClass(name, cls, None, "dispatcher returned a non-Metric")
             continue
         yield IntrospectedClass(name, type(instance), instance)
+
+
+def introspect_fleet_variants() -> Iterator[IntrospectedClass]:
+    """Fleet-constructed instances of the ``FLEET_VARIANT_SPECS`` classes,
+    named ``Class@fleet`` so reports distinguish them from the plain sweep."""
+    import metrics_tpu
+
+    for name, kwargs in FLEET_VARIANT_SPECS:
+        cls = getattr(metrics_tpu, name)
+        try:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                instance = cls(**kwargs)
+        except Exception as err:  # noqa: BLE001 — lint degrades, never dies, on ctor failure
+            yield IntrospectedClass(f"{name}@fleet", cls, None, f"construction failed: {type(err).__name__}: {err}")
+            continue
+        yield IntrospectedClass(f"{name}@fleet", type(instance), instance)

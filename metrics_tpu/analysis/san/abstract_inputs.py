@@ -283,7 +283,7 @@ def cases_for(name: str, instance: Any) -> Optional[Dict[str, List[TraceCase]]]:
 # ---------------------------------------------------------------------------
 
 def _ops_entrypoints() -> Dict[str, Tuple[Callable, Callable[[int], list]]]:
-    from metrics_tpu.core import fused
+    from metrics_tpu.core import fleet, fused
     from metrics_tpu.ops import clf_curve, confmat, rank, segment
     from metrics_tpu.ops import sketch as sketch_ops
 
@@ -295,6 +295,11 @@ def _ops_entrypoints() -> Dict[str, Tuple[Callable, Callable[[int], list]]]:
         # total bytes-accessed than five eager launches
         "fused.collection_update": (fused.canonical_fused_update, fused.canonical_fused_case),
         **fused.canonical_eager_entries(),
+        # the fleet-axis entrypoints (core/fleet.py): one routed update over a
+        # 16-stream fleet and one vmapped per-stream compute — the budget-gated
+        # proof that N concurrent streams cost one executable, not N
+        "fleet.update": (fleet.canonical_fleet_update, fleet.canonical_fleet_update_case),
+        "fleet.compute": (fleet.canonical_fleet_compute, fleet.canonical_fleet_compute_case),
         "ops.binary_auroc_exact": (clf_curve.binary_auroc_exact, _pairs_it),
         "ops.binary_average_precision_exact": (clf_curve.binary_average_precision_exact, _pairs_it),
         "ops.multiclass_auroc_exact": (clf_curve.multiclass_auroc_exact, lambda n: _one(f32(n, 5), i32(n))),

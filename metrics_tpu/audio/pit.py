@@ -44,6 +44,7 @@ class PermutationInvariantTraining(Metric):
                 "distributed_available_fn",
                 "sync_on_compute",
                 "cat_capacity",
+                "fleet_size",
             )
             if k in kwargs
         }

@@ -636,6 +636,7 @@ def restore_checkpoint(
     *,
     process_index: Optional[int] = None,
     process_count: Optional[int] = None,
+    stream: Optional[int] = None,
 ) -> int:
     """Restore ``obj`` (Metric or MetricCollection) from a committed checkpoint.
 
@@ -643,6 +644,12 @@ def restore_checkpoint(
     no partial loads), then assigns states — including compute-group
     re-aliasing for collections and topology re-mapping when the restoring
     host count differs from the saved one. Returns the restored step.
+
+    ``stream`` slices ONE stream out of a fleet-metric checkpoint
+    (``Metric(fleet_size=N)``, see :mod:`metrics_tpu.core.fleet`): the saved
+    ``(N, *base)`` states are indexed at ``stream`` and loaded into a plain
+    (non-fleet) instance of the same class — per-tenant extraction without
+    materializing the whole fleet.
     """
     from metrics_tpu.core.collections import MetricCollection
     from metrics_tpu.parallel.collective import process_topology
@@ -677,6 +684,10 @@ def restore_checkpoint(
         tree = (own or manifests[0]["tree"])
 
         if isinstance(obj, MetricCollection):
+            if stream is not None:
+                raise CheckpointError(
+                    "stream= slicing applies to single fleet-metric restores, not collections"
+                )
             _restore_collection(
                 obj, tree, manifests, payloads,
                 rank=rank, world=world, saved_world=saved_world,
@@ -687,16 +698,30 @@ def restore_checkpoint(
                 raise CheckpointError(
                     "checkpoint was saved from a MetricCollection; restore into a collection"
                 )
+            saved_schema = tree["schema"]
+            if stream is not None:
+                saved_n = saved_schema.get("fleet_size")
+                if saved_n is None:
+                    raise CheckpointError(
+                        "stream= slicing requires a fleet checkpoint; this one was saved"
+                        " from a metric without a fleet axis"
+                    )
+                if not 0 <= stream < saved_n:
+                    raise CheckpointError(
+                        f"stream={stream} out of range for the saved fleet_size={saved_n}"
+                    )
+                saved_schema = _restore.slice_fleet_schema(saved_schema)
+                payloads = _restore.slice_fleet_payloads(payloads, tree["schema"], stream)
             # live schema stays FULL even for persistent_only checkpoints:
             # allow_subset loads the saved subset, untouched states keep defaults
             live = _manifest.metric_schema(obj)
-            _manifest.validate_schema(live, tree["schema"], allow_subset=persistent_only)
+            _manifest.validate_schema(live, saved_schema, allow_subset=persistent_only)
             count = _restore.merged_update_count(
                 [m["tree"]["schema"] for m in manifests],
                 own["schema"] if own is not None else None,
             )
             _restore.assign_metric_state(
-                obj, tree["schema"], payloads,
+                obj, saved_schema, payloads,
                 rank=rank, world=world, saved_world=saved_world,
                 replicated=replicated, update_count=count,
             )

@@ -127,12 +127,19 @@ def metric_schema(metric: Any, persistent_only: bool = False) -> Dict[str, Any]:
             children[attr] = [metric_schema(c, persistent_only) for c in child]
         else:
             children[attr] = metric_schema(child, persistent_only)
-    return {
+    out = {
         "class": type(metric).__name__,
         "update_count": int(metric._update_count),
         "states": states,
         "children": children,
     }
+    fleet_size = getattr(metric, "fleet_size", None)
+    if fleet_size is not None:
+        # fleet-axis metrics (core/fleet.py): state shapes are (fleet_size,
+        # *base); recorded so restore can diagnose fleet drift and slice one
+        # stream out (restore_checkpoint(..., stream=i))
+        out["fleet_size"] = int(fleet_size)
+    return out
 
 
 def _drift(path: str, what: str) -> str:
@@ -155,6 +162,19 @@ def validate_schema(
     if live["class"] != saved["class"]:
         raise SchemaDriftError(
             _drift(path, f"saved metric class {saved['class']!r} != live {live['class']!r}")
+        )
+    live_fleet, saved_fleet = live.get("fleet_size"), saved.get("fleet_size")
+    if live_fleet != saved_fleet:
+        # checked before the per-state loop so the error names the fleet dim
+        # instead of a bare (N, *base) vs (M, *base) shape mismatch
+        raise ShapeDriftError(
+            _drift(
+                path,
+                f"saved fleet axis fleet_size={saved_fleet} != live fleet_size={live_fleet}:"
+                " every fleet state is shaped (fleet_size, *base). Restore into a metric of"
+                " the saved fleet_size, or slice one stream with"
+                " restore_checkpoint(..., stream=i)",
+            )
         )
     live_states, saved_states = live["states"], saved["states"]
     missing = sorted(set(saved_states) - set(live_states))

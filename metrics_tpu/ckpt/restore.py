@@ -180,6 +180,48 @@ def finalize_metric(metric: Any, update_count: int) -> None:
     metric._is_synced = False
 
 
+def slice_fleet_schema(saved: Dict[str, Any]) -> Dict[str, Any]:
+    """Project a saved fleet-metric schema onto ONE stream: drop the
+    ``fleet_size`` key and the ``_fleet_rows`` bookkeeping state, and strip the
+    leading fleet dim from every array default shape. The result validates
+    against a plain (non-fleet) live instance of the same class."""
+    from metrics_tpu.core.fleet import ROWS_STATE
+
+    out = {k: v for k, v in saved.items() if k != "fleet_size"}
+    states: Dict[str, Any] = {}
+    for name, spec in saved["states"].items():
+        if name == ROWS_STATE:
+            continue
+        spec = dict(spec, default=dict(spec["default"]))
+        shape = spec["default"].get("shape")
+        if shape:
+            spec["default"]["shape"] = list(shape[1:])
+        states[name] = spec
+    out["states"] = states
+    return out
+
+
+def slice_fleet_payloads(
+    payloads: List[Dict[str, np.ndarray]], saved: Dict[str, Any], stream: int, prefix: str = ""
+) -> List[Dict[str, np.ndarray]]:
+    """Per-host payloads with every fleet state sliced at ``stream`` along the
+    fleet axis (``_fleet_rows`` dropped). Hosts that wrote no states (rank > 0
+    under ``replicated=True``) pass through unchanged."""
+    from metrics_tpu.core.fleet import ROWS_STATE
+
+    out: List[Dict[str, np.ndarray]] = []
+    for payload in payloads:
+        sliced = dict(payload)
+        for name in saved["states"]:
+            key = f"{prefix}{name}"
+            if name == ROWS_STATE:
+                sliced.pop(key, None)
+            elif key in sliced:
+                sliced[key] = np.asarray(sliced[key])[stream]
+        out.append(sliced)
+    return out
+
+
 def merged_update_count(schemas: List[Dict[str, Any]], own: Optional[Dict[str, Any]]) -> int:
     """Update count to restore: the restoring host's own on exact topology,
     otherwise the max across saved hosts (counts gate warnings and the mean

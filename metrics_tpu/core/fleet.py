@@ -1,0 +1,470 @@
+"""Fleet-axis metric runtime: one state tree and ONE launch for N streams.
+
+Serving-scale evaluation means thousands of concurrent per-tenant / per-slice
+metric streams. One ``Metric`` instance per stream costs N jitted dispatches
+per step plus N separate state trees — exactly the class-level churn the obs
+``retrace_signatures`` detector flags. A *fleet* metric instead carries every
+registered state with an optional leading fleet axis ``(N, *base)`` and routes
+a mixed batch to its streams in one XLA launch:
+
+- ``Metric(fleet_size=N)`` (or ``metric.as_fleet(N)``) broadcasts every
+  ``add_state`` default to ``(N, *base)`` and registers a ``_fleet_rows``
+  bookkeeping state counting rows routed per stream.
+- ``update(batch, stream_ids=ids)`` runs the subclass update per ROW via
+  ``vmap`` over unit states, then folds the unit results into the fleet state
+  with ``segment_sum`` / ``segment_max`` / ``segment_min`` keyed on the
+  registered reduction — the same pairwise algebra ``merge_state`` and the
+  ckpt N→M re-reduce use. ``update(batch)`` without ids broadcasts the batch
+  to every stream (vmap over state rows).
+- ``compute()`` returns the per-stream tree from one vmapped call;
+  ``compute(stream=i)`` indexes it; ``reduce_fleet()`` collapses the fleet
+  axis through the reduction registry and computes the aggregate.
+
+Eligibility: fleet states must be fixed-shape arrays with a ``sum``/``max``/
+``min`` reduction (list/cat/CatBuffer states and ``mean``/``None``/callable
+reductions raise :class:`MetricsUserError` at ``add_state`` time). The routing
+decomposition is exact for integer count states and associative-only (order
+may differ at the ulp level) for float accumulators.
+
+This module is imported lazily from ``core.metric`` (no import cycle); it
+reuses the fused engine's input split / donation helpers (``core.fused``).
+"""
+import weakref
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.obs import registry as _obs
+from metrics_tpu.utils.exceptions import MetricsUserError
+
+# bookkeeping state: rows routed per stream, shape (fleet_size,), int32, "sum"
+ROWS_STATE = "_fleet_rows"
+
+# reductions with an exact/associative per-row fold (matches merge_state)
+FLEET_REDUCTIONS = ("sum", "max", "min")
+
+
+# ------------------------------------------------------------- registration
+
+
+def validate_fleet_size(fleet_size: Any) -> Optional[int]:
+    if fleet_size is None:
+        return None
+    if isinstance(fleet_size, bool) or not isinstance(fleet_size, int) or fleet_size < 1:
+        raise ValueError(
+            f"Expected keyword argument `fleet_size` to be a positive int or None but got {fleet_size!r}"
+        )
+    return fleet_size
+
+
+def register_state(metric: Any, name: str, default: Any, reduce_kind: Any, is_list: bool) -> Any:
+    """Fleet hook for ``Metric.add_state``: validate eligibility, remember the
+    base default, ensure the rows state exists, return the broadcast default."""
+    if is_list or type(default).__name__ == "CatBuffer":
+        raise MetricsUserError(
+            f"Fleet metrics cannot register list/cat state `{name}`: cat states are"
+            " host-ragged or fixed-capacity buffers with no per-stream segment fold."
+            " Use per-stream instances (or a sketch state) for cat-style metrics."
+        )
+    if reduce_kind not in FLEET_REDUCTIONS:
+        raise MetricsUserError(
+            f"Fleet metrics require a sum/max/min reduction for state `{name}`, got"
+            f" {reduce_kind!r}: only those have the exact per-row fold stream routing"
+            " relies on (the same pairwise algebra as merge_state)."
+        )
+    ensure_rows_state(metric)
+    base = jnp.asarray(default)
+    metric._fleet_base_defaults[name] = base
+    return _replicate(base, metric.fleet_size)
+
+
+def _replicate(value: Any, n: int) -> Any:
+    """Materialized ``(n, *value.shape)`` tiling of ``value``."""
+    value = jnp.asarray(value)
+    return jnp.tile(value[None], (n,) + (1,) * value.ndim)
+
+
+def ensure_rows_state(metric: Any) -> None:
+    """Register the ``_fleet_rows`` bookkeeping state directly (bypassing
+    ``add_state`` to avoid re-entering the fleet hook)."""
+    if ROWS_STATE in metric._defaults:
+        return
+    rows = jnp.zeros((metric.fleet_size,), jnp.int32)
+    setattr(metric, ROWS_STATE, rows)
+    metric._defaults[ROWS_STATE] = rows
+    metric._persistent[ROWS_STATE] = False
+    metric._reductions[ROWS_STATE] = "sum"
+
+
+def convert_to_fleet(metric: Any, fleet_size: int) -> None:
+    """In-place conversion of a (deep-copied) base metric into a fleet: the
+    live value of every state is replicated to all ``fleet_size`` streams."""
+    n = validate_fleet_size(fleet_size)
+    for name in metric._defaults:
+        default = metric._defaults[name]
+        if isinstance(default, list) or type(default).__name__ == "CatBuffer":
+            raise MetricsUserError(
+                f"{type(metric).__name__} cannot become a fleet: state `{name}` is a"
+                " list/cat state (no per-stream segment fold)."
+            )
+        if metric._reductions[name] not in FLEET_REDUCTIONS:
+            raise MetricsUserError(
+                f"{type(metric).__name__} cannot become a fleet: state `{name}` has"
+                f" reduction {metric._reductions[name]!r} (fleet states need sum/max/min)."
+            )
+    metric.fleet_size = n
+    metric._fleet_base_defaults = {}
+    for name in list(metric._defaults):
+        base_default = jnp.asarray(metric._defaults[name])
+        metric._fleet_base_defaults[name] = base_default
+        metric._defaults[name] = _replicate(base_default, n)
+        setattr(metric, name, _replicate(getattr(metric, name), n))
+    ensure_rows_state(metric)
+    metric._computed = None
+
+
+def base_state_names(metric: Any) -> List[str]:
+    return [n for n in metric._defaults if n != ROWS_STATE]
+
+
+# --------------------------------------------------------------- pure paths
+
+
+def _base_apply(metric: Any, raw_update: Callable, base_state: Dict[str, Any], args: Tuple, kwargs: Dict) -> Dict[str, Any]:
+    """Run the RAW subclass update on a base-shaped state dict, purely w.r.t.
+    the live state of ``metric`` (same save/load/restore dance as local_update,
+    but on the un-wrapped update so no counters/fleet re-entry fire)."""
+    saved = {attr: getattr(metric, attr) for attr in metric._defaults}
+    saved_count, saved_computed = metric._update_count, metric._computed
+    try:
+        for name, value in base_state.items():
+            setattr(metric, name, value)
+        raw_update(*args, **kwargs)
+        return {name: getattr(metric, name) for name in base_state}
+    finally:
+        for attr, val in saved.items():
+            setattr(metric, attr, val)
+        metric._update_count, metric._computed = saved_count, saved_computed
+
+
+def _batch_rows(dyn: List[Any]) -> int:
+    """Leading dim shared by the dynamic update inputs (0 when none)."""
+    dims = {int(d.shape[0]) for d in dyn if getattr(d, "ndim", 0) >= 1}
+    if len(dims) > 1:
+        raise MetricsUserError(
+            f"Fleet routing requires every array input to share the batch axis 0; got leading dims {sorted(dims)}"
+        )
+    return dims.pop() if dims else 0
+
+
+def routed_new_state(
+    metric: Any,
+    raw_update: Callable,
+    state: Dict[str, Any],
+    args: Tuple,
+    kwargs: Dict,
+    stream_ids: Any,
+) -> Dict[str, Any]:
+    """Pure fleet transition for a routed batch: vmap the base update over
+    per-row unit states, then segment-fold the units into the fleet state."""
+    from metrics_tpu.core import fused as _fused
+
+    n = metric.fleet_size
+    ids = jnp.asarray(stream_ids)
+    if ids.ndim != 1:
+        raise MetricsUserError(f"stream_ids must be 1-D (one id per batch row), got shape {ids.shape}")
+    if not jnp.issubdtype(ids.dtype, jnp.integer):
+        raise MetricsUserError(f"stream_ids must be integer, got dtype {ids.dtype}")
+
+    dyn, spec = _fused._split_inputs(args, kwargs)
+    rows = _batch_rows(dyn)
+    if rows != int(ids.shape[0]):
+        raise MetricsUserError(
+            f"stream_ids has {int(ids.shape[0])} entries but the batch has {rows} rows"
+        )
+    base_defaults = metric._fleet_base_defaults
+
+    def unit(row_dyn):
+        # each row is a batch of one: re-add the batch axis the update expects
+        a, k = _fused._merge_inputs([d[None] for d in row_dyn], spec)
+        return _base_apply(metric, raw_update, dict(base_defaults), a, k)
+
+    units = jax.vmap(unit)(dyn)  # {name: (rows, *base)}
+
+    new: Dict[str, Any] = {}
+    for name, reduce_kind in metric._reductions.items():
+        if name == ROWS_STATE:
+            new[name] = state[name] + jax.ops.segment_sum(
+                jnp.ones(ids.shape, jnp.int32), ids, num_segments=n
+            )
+        elif reduce_kind == "sum":
+            delta = units[name] - base_defaults[name]
+            new[name] = state[name] + jax.ops.segment_sum(delta, ids, num_segments=n)
+        elif reduce_kind == "max":
+            # segment identity (-inf / iinfo.min) keeps empty segments inert
+            new[name] = jnp.maximum(state[name], jax.ops.segment_max(units[name], ids, num_segments=n))
+        else:  # "min" — add_state admitted nothing else
+            new[name] = jnp.minimum(state[name], jax.ops.segment_min(units[name], ids, num_segments=n))
+    return new
+
+
+def broadcast_new_state(
+    metric: Any, raw_update: Callable, state: Dict[str, Any], args: Tuple, kwargs: Dict
+) -> Dict[str, Any]:
+    """Pure fleet transition without stream_ids: every stream sees the batch."""
+    from metrics_tpu.core import fused as _fused
+
+    dyn, spec = _fused._split_inputs(args, kwargs)
+    rows = _batch_rows(dyn)
+    names = base_state_names(metric)
+
+    def one(row_state):
+        a, k = _fused._merge_inputs(dyn, spec)
+        return _base_apply(metric, raw_update, row_state, a, k)
+
+    new = dict(jax.vmap(one)({name: state[name] for name in names}))
+    new[ROWS_STATE] = state[ROWS_STATE] + jnp.int32(rows)
+    return new
+
+
+def fleet_compute_value(metric: Any) -> Any:
+    """Per-stream compute tree in one vmapped call over the state rows.
+
+    Metrics whose ``compute`` is host-side (e.g. the nominal-association
+    family drops empty confmat rows/cols through numpy) cannot be vmapped;
+    they fall back to an eager per-stream loop. Update routing — the hot
+    path — is unaffected: only compute pays the N-iteration cost.
+    """
+    names = base_state_names(metric)
+    state = {name: getattr(metric, name) for name in names}
+
+    def one(row_state):
+        return _base_apply_compute(metric, row_state)
+
+    try:
+        return jax.vmap(one)(state)
+    except (jax.errors.TracerArrayConversionError, jax.errors.ConcretizationTypeError):
+        rows = [
+            one({name: state[name][i] for name in names})
+            for i in range(metric.fleet_size)
+        ]
+        return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *rows)
+
+
+def _base_apply_compute(metric: Any, base_state: Dict[str, Any]) -> Any:
+    from metrics_tpu.utils.data import _squeeze_if_scalar
+
+    saved = {attr: getattr(metric, attr) for attr in metric._defaults}
+    saved_count, saved_computed = metric._update_count, metric._computed
+    try:
+        for name, value in base_state.items():
+            setattr(metric, name, value)
+        metric._computed = None
+        metric._update_count = max(saved_count, 1)
+        # squeeze per-row scalars exactly like the classic wrapped compute, so
+        # a stream's slice is shaped identically to an independent instance
+        return _squeeze_if_scalar(type(metric).compute(metric))
+    finally:
+        for attr, val in saved.items():
+            setattr(metric, attr, val)
+        metric._update_count, metric._computed = saved_count, saved_computed
+
+
+def reduce_fleet_value(metric: Any) -> Any:
+    """Collapse the fleet axis through the registered reductions (the same
+    pairwise algebra as ``merge_state``) and compute the aggregate value."""
+    collapsed: Dict[str, Any] = {}
+    for name in base_state_names(metric):
+        value = getattr(metric, name)
+        reduce_kind = metric._reductions[name]
+        if reduce_kind == "sum":
+            # off-default streams contribute (value - default); re-add ONE default
+            base = metric._fleet_base_defaults[name]
+            collapsed[name] = base + jnp.sum(value - base[None], axis=0)
+        elif reduce_kind == "max":
+            collapsed[name] = jnp.max(value, axis=0)
+        else:
+            collapsed[name] = jnp.min(value, axis=0)
+    return _base_apply_compute(metric, collapsed)
+
+
+def index_stream(value: Any, stream: Optional[int]) -> Any:
+    if stream is None:
+        return value
+    return jax.tree_util.tree_map(lambda x: x[stream], value)
+
+
+# ----------------------------------------------------- eager dispatch cache
+
+# Compiled steps keyed by id(metric): Metric.__hash__/__eq__ are value-based
+# (a WeakKeyDictionary would alias distinct metrics), and compiled executables
+# must never land on the instance (__getstate__ copies __dict__). weakref
+# finalizers evict the entry when the metric is collected.
+_EXEC_CACHE: Dict[int, Dict[Tuple, Any]] = {}
+
+
+def _cache_for(metric: Any) -> Dict[Tuple, Any]:
+    key = id(metric)
+    cache = _EXEC_CACHE.get(key)
+    if cache is None:
+        cache = _EXEC_CACHE[key] = {}
+        weakref.finalize(metric, _EXEC_CACHE.pop, key, None)
+    return cache
+
+
+def _is_traced(*trees: Any) -> bool:
+    return any(isinstance(leaf, jax.core.Tracer) for leaf in jax.tree_util.tree_leaves(trees))
+
+
+def _shield_donation(metric: Any, state: Dict[str, Any]) -> Dict[str, Any]:
+    """Copy default-aliased leaves, dedup duplicate buffers, and materialize
+    pending async-ckpt snapshots before the state is donated."""
+    from metrics_tpu.core.fused import FusedCollectionUpdate as _F
+
+    protected = _F._protected_ids(metric)
+    state = jax.tree_util.tree_map(lambda leaf: leaf.copy() if id(leaf) in protected else leaf, state)
+    trees = [state]
+    _F._secure_ckpt_snapshots(trees)
+    _F._donation_guard(trees)
+    return trees[0]
+
+
+def run_step(
+    metric: Any,
+    tag: str,
+    step: Callable,
+    state: Dict[str, Any],
+    *extras: Any,
+    static_key: Tuple = (),
+) -> Dict[str, Any]:
+    """Run a pure ``step(state, *extras) -> new_state``: inline when any input
+    is a tracer (we're already inside someone else's jit/vmap program), else
+    through a cached AOT-compiled executable that donates the state buffers
+    (skipped inside ``local_update`` — the pure contract forbids deleting the
+    caller's arrays)."""
+    from metrics_tpu.core import fused as _fused
+
+    if _is_traced(state, extras):
+        return step(state, *extras)
+    donate = getattr(metric, "_pure_call_depth", 0) == 0
+    key = (tag, donate, _fused._aval_key(state), _fused._aval_key(extras), static_key)
+    cache = _cache_for(metric)
+    compiled = cache.get(key)
+    if compiled is None:
+        jitted = jax.jit(step, donate_argnums=(0,) if donate else ())
+        compiled = jitted.lower(state, *extras).compile()
+        cache[key] = compiled
+    if donate:
+        state = _shield_donation(metric, state)
+    return compiled(state, *extras)
+
+
+# --------------------------------------------------------- update interface
+
+
+def apply_update(metric: Any, raw_update: Callable, args: Tuple, kwargs: Dict) -> None:
+    """The fleet body of ``Metric._wrap_update``: pop ``stream_ids``, route or
+    broadcast in one launch, and re-point the live state at the result."""
+    from metrics_tpu.core import fused as _fused
+
+    kwargs = dict(kwargs)
+    stream_ids = kwargs.pop("stream_ids", None)
+    state = {name: getattr(metric, name) for name in metric._defaults}
+
+    if stream_ids is None:
+        dyn, spec = _fused._split_inputs(args, kwargs)
+
+        def step(st, dl):
+            a, k = _fused._merge_inputs(dl, spec)
+            return broadcast_new_state(metric, raw_update, st, a, k)
+
+        new = run_step(metric, "fleet.bcast", step, state, dyn, static_key=_fused._static_key(spec))
+        if _obs._ENABLED:
+            _obs.REGISTRY.inc("fleet", "routed", _batch_rows(dyn))
+            _obs.REGISTRY.inc("fleet", "streams", metric.fleet_size)
+    else:
+        ids = jnp.asarray(stream_ids)
+        if not isinstance(ids, jax.core.Tracer):
+            from metrics_tpu.utils.checks import _is_concrete
+
+            if ids.size and _is_concrete(ids) and jnp.issubdtype(ids.dtype, jnp.integer):
+                host_ids = np.asarray(ids)
+                if host_ids.min() < 0 or host_ids.max() >= metric.fleet_size:
+                    raise MetricsUserError(
+                        f"stream_ids must lie in [0, {metric.fleet_size}), got range"
+                        f" [{int(host_ids.min())}, {int(host_ids.max())}]"
+                    )
+        dyn, spec = _fused._split_inputs(args, kwargs)
+
+        def step(st, dl, i_):
+            a, k = _fused._merge_inputs(dl, spec)
+            return routed_new_state(metric, raw_update, st, a, k, i_)
+
+        new = run_step(metric, "fleet.route", step, state, dyn, ids, static_key=_fused._static_key(spec))
+        if _obs._ENABLED:
+            from metrics_tpu.utils.checks import _is_concrete
+
+            _obs.REGISTRY.inc("fleet", "routed", int(ids.shape[0]))
+            if _is_concrete(ids):
+                _obs.REGISTRY.inc("fleet", "streams", int(np.unique(np.asarray(ids)).size))
+    metric._load_state(new)
+
+
+# ------------------------------------------------------------ tmsan entries
+# Canonical abstract traces for the analyzers (mirrors fused.canonical_*):
+# one routed fleet update and one vmapped fleet compute, registered in
+# analysis/san/abstract_inputs._ops_entrypoints under "fleet.update" /
+# "fleet.compute".
+
+_CANONICAL_FLEET_SIZE = 16
+
+
+def _canonical_fleet():
+    from metrics_tpu.classification import MulticlassAccuracy
+
+    return MulticlassAccuracy(num_classes=5, average="micro", fleet_size=_CANONICAL_FLEET_SIZE)
+
+
+_CANONICAL_CACHE: Dict[str, Any] = {}
+
+
+def _canonical(name: str, build: Callable) -> Any:
+    if name not in _CANONICAL_CACHE:
+        _CANONICAL_CACHE[name] = build()
+    return _CANONICAL_CACHE[name]
+
+
+def _sds(x: Any) -> jax.ShapeDtypeStruct:
+    return jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x))
+
+
+def canonical_fleet_update(state, preds, target, stream_ids):
+    m = _canonical("metric", _canonical_fleet)
+    raw = type(m).update.__get__(m)
+    return routed_new_state(m, raw, state, (preds, target), {}, stream_ids)
+
+
+def canonical_fleet_update_case(n: int):
+    m = _canonical("metric", _canonical_fleet)
+    state_sds = {name: _sds(d) for name, d in m._defaults.items()}
+    preds = jax.ShapeDtypeStruct((n,), jnp.int32)
+    target = jax.ShapeDtypeStruct((n,), jnp.int32)
+    ids = jax.ShapeDtypeStruct((n,), jnp.int32)
+    return [((state_sds, preds, target, ids), {})]
+
+
+def canonical_fleet_compute(state):
+    m = _canonical("metric", _canonical_fleet)
+
+    def one(row_state):
+        return _base_apply_compute(m, row_state)
+
+    return jax.vmap(one)({k: v for k, v in state.items() if k != ROWS_STATE})
+
+
+def canonical_fleet_compute_case(n: int):
+    m = _canonical("metric", _canonical_fleet)
+    state_sds = {name: _sds(d) for name, d in m._defaults.items()}
+    return [((state_sds,), {})]

@@ -74,6 +74,14 @@ def jit_distributed_available() -> bool:
     return collective.distributed_available()
 
 
+def _index_fleet_stream(value: Any, stream: Optional[int]) -> Any:
+    """Select one stream's slice from a per-stream compute tree (identity when
+    ``stream`` is None — the classic full-value path)."""
+    if stream is None:
+        return value
+    return jax.tree_util.tree_map(lambda x: x[stream], value)
+
+
 class Metric(ABC):
     """Base class for all metrics.
 
@@ -133,6 +141,18 @@ class Metric(ABC):
     _host_side_update: bool = False
     _host_side_compute: bool = False
     _ckpt_exempt_attrs: Tuple[str, ...] = ()
+    # fleet axis (core/fleet.py): None = classic single-stream metric; an int N
+    # means every registered state carries a leading (N, ...) stream axis and
+    # update/compute route through the vmapped one-launch fleet paths. Class
+    # attr so metrics pickled/constructed before the fleet tier stay valid.
+    fleet_size: Optional[int] = None
+    # classes whose state shapes depend on the first batch (scalar placeholder
+    # swapped for a map-shaped array in update) set this: the fleet segment
+    # fold requires the registered shape to be final, so fleet_size is rejected
+    _lazy_state_shapes: bool = False
+    # depth of in-flight pure-tier calls (local_update): the fleet eager
+    # dispatch must not donate state buffers while a pure caller still owns them
+    _pure_call_depth: int = 0
 
     def _san_input_specs(self, n: int):
         """Abstract update-argument specs for tmsan; None -> use the shape
@@ -174,6 +194,25 @@ class Metric(ABC):
         if self.cat_capacity is not None and (not isinstance(self.cat_capacity, int) or self.cat_capacity < 1):
             raise ValueError(
                 f"Expected keyword argument `cat_capacity` to be a positive int or None but got {self.cat_capacity}"
+            )
+
+        # fleet axis (SURVEY.md §7 / ROADMAP item 1): N concurrent streams share
+        # one state tree with a leading (N, ...) axis and ONE launch per update
+        from metrics_tpu.core import fleet as _fleet
+
+        self.fleet_size = _fleet.validate_fleet_size(kwargs.pop("fleet_size", None))
+        self._fleet_base_defaults: Dict[str, Array] = {}
+        if self.fleet_size is not None and self.cat_capacity is not None:
+            raise MetricsUserError(
+                "fleet_size and cat_capacity are mutually exclusive: CatBuffer"
+                " states have no per-stream segment fold (see docs/pages/fleet.rst)"
+            )
+        if self.fleet_size is not None and type(self)._lazy_state_shapes:
+            raise MetricsUserError(
+                f"{type(self).__name__} initializes data-shaped state lazily on the"
+                " first update (scalar placeholder -> map-shaped array), but the fleet"
+                " axis requires every stream's state to keep the registered shape so"
+                " rows can fold through one segment reduction (docs/pages/fleet.rst)"
             )
 
         if kwargs:
@@ -254,6 +293,13 @@ class Metric(ABC):
                 self.cat_capacity, tuple(cat_item_shape), cat_dtype or jnp.float32, cat_fill_value
             )
 
+        if self.fleet_size is not None:
+            from metrics_tpu.core import fleet as _fleet
+
+            # validates eligibility (fixed-shape, sum/max/min), registers the
+            # _fleet_rows bookkeeping state, returns the (N, *base) default
+            default = _fleet.register_state(self, name, default, reduce_kind, is_list)
+
         if isinstance(default, CatBuffer):
             setattr(self, name, default.copy())
         else:
@@ -313,11 +359,15 @@ class Metric(ABC):
         """
         saved = {attr: getattr(self, attr) for attr in self._defaults}
         saved_count, saved_computed = self._update_count, self._computed
+        # mark the pure scope: the fleet eager dispatch keys donation off this
+        # (donating here would delete the caller's state arrays)
+        self._pure_call_depth = self._pure_call_depth + 1
         try:
             self._load_state(state)
             self.update(*args, **kwargs)
             new_state = self.state_pytree()
         finally:
+            self._pure_call_depth = self._pure_call_depth - 1
             for attr, val in saved.items():
                 setattr(self, attr, val)
             self._update_count, self._computed = saved_count, saved_computed
@@ -354,6 +404,16 @@ class Metric(ABC):
         :class:`MetricsUserError`.
         """
         if isinstance(other, Metric):
+            if other.fleet_size != self.fleet_size:
+                # checked BEFORE the per-state merge: the registries of two
+                # fleets of different size share the same names, so without
+                # this the sum merge would silently broadcast (N,)+(M,) shapes
+                raise MetricsUserError(
+                    f"Cannot merge state of {type(other).__name__} into {type(self).__name__}:"
+                    f" fleet sizes differ (fleet_size={other.fleet_size} vs"
+                    f" fleet_size={self.fleet_size}); reduce_fleet() one side or restore"
+                    " per-stream (restore_checkpoint(..., stream=i)) first"
+                )
             if set(other._defaults) != set(self._defaults):
                 raise MetricsUserError(
                     f"Cannot merge state of {type(other).__name__} into {type(self).__name__}:"
@@ -442,7 +502,12 @@ class Metric(ABC):
         return jax.tree_util.tree_map(poison, value)
 
     def _compute_raw(self) -> Any:
-        """Subclass compute without wrapping (no cache, no sync)."""
+        """Subclass compute without wrapping (no cache, no sync). Fleet metrics
+        return the per-stream tree from one vmapped call (core/fleet.py)."""
+        if self.fleet_size is not None:
+            from metrics_tpu.core import fleet as _fleet
+
+            return _fleet.fleet_compute_value(self)
         return type(self).compute(self)
 
     # ------------------------------------------------------------- OO shell
@@ -460,6 +525,15 @@ class Metric(ABC):
         def wrapped_func(*args: Any, **kwargs: Any) -> None:
             self._computed = None
             self._update_count += 1
+            if self.fleet_size is not None:
+                # fleet tier: route/broadcast the batch to the stream axis in
+                # one launch via the RAW bound update (`update` here is the
+                # pre-wrap closure — calling self.update would recurse)
+                from metrics_tpu.core import fleet as _fleet
+
+                run = functools.partial(_fleet.apply_update, self, update, args, kwargs)
+            else:
+                run = functools.partial(update, *args, **kwargs)
             # single-boolean gate: the disabled path must stay a no-op
             # (bench-parity criterion; tests/unittests/obs/test_obs.py)
             if _obs._ENABLED:
@@ -474,9 +548,9 @@ class Metric(ABC):
                 _obs.REGISTRY.inc(name, "dispatches")
                 _obs_recompile.check_update(self, args, kwargs)
                 with _obs_scopes.update_scope(name):
-                    update(*args, **kwargs)
+                    run()
             else:
-                update(*args, **kwargs)
+                run()
             if self.compute_on_cpu:
                 self._move_list_states_to_cpu()
 
@@ -492,6 +566,16 @@ class Metric(ABC):
     def _wrap_compute(self, compute: Callable) -> Callable:
         @functools.wraps(compute)
         def wrapped_func(*args: Any, **kwargs: Any) -> Any:
+            stream = kwargs.pop("stream", None)
+            if stream is not None and self.fleet_size is None:
+                raise MetricsUserError(
+                    f"compute(stream={stream}) requires a fleet metric; construct with"
+                    " Metric(fleet_size=N) or convert via .as_fleet(N)"
+                )
+            if stream is not None and not (0 <= stream < self.fleet_size):
+                raise MetricsUserError(
+                    f"compute(stream={stream}) out of range for fleet_size={self.fleet_size}"
+                )
             if self._update_count == 0:
                 rank_zero_warn(
                     f"The ``compute`` method of metric {self.__class__.__name__}"
@@ -502,7 +586,7 @@ class Metric(ABC):
             if self._computed is not None:
                 if _obs._ENABLED:
                     _obs.REGISTRY.inc(type(self).__name__, "compute_cache_hits")
-                return self._computed
+                return _index_fleet_stream(self._computed, stream)
 
             for attr in self._defaults:
                 val = getattr(self, attr)
@@ -522,16 +606,24 @@ class Metric(ABC):
                 should_sync=self._to_sync,
                 should_unsync=self._should_unsync,
             ):
+                if self.fleet_size is not None:
+                    # the raw subclass compute sees one stream; the per-stream
+                    # tree comes from one vmapped call over the state rows
+                    compute_fn = self._compute_raw
+                else:
+                    compute_fn = functools.partial(compute, *args, **kwargs)
                 if _obs._ENABLED:
                     name = type(self).__name__
                     _obs.REGISTRY.inc(name, "computes")
                     with _obs_scopes.compute_scope(name):
-                        value = compute(*args, **kwargs)
+                        value = compute_fn()
                 else:
-                    value = compute(*args, **kwargs)
-                self._computed = _squeeze_if_scalar(value)
+                    value = compute_fn()
+                # fleet values keep their (N, ...) leaves: squeezing a
+                # fleet_size=1 result would break compute(stream=0) indexing
+                self._computed = value if self.fleet_size is not None else _squeeze_if_scalar(value)
 
-            return self._computed
+            return _index_fleet_stream(self._computed, stream)
 
         return wrapped_func
 
@@ -821,6 +913,38 @@ class Metric(ABC):
         """Deep copy of the metric (reference: metric.py:632-634)."""
         return deepcopy(self)
 
+    # ----------------------------------------------------------------- fleet
+
+    def as_fleet(self, fleet_size: int) -> "Metric":
+        """Return a fleet-axis copy of this metric: every registered state gains
+        a leading ``(fleet_size, ...)`` stream axis and ``update`` accepts
+        ``stream_ids`` routing (see :mod:`metrics_tpu.core.fleet`). The live
+        state values are replicated to every stream, so convert fresh metrics
+        (the usual case) or deliberately seed all streams with the accumulated
+        value. Raises :class:`MetricsUserError` when any state is ineligible
+        (list/cat state, or a reduction outside sum/max/min)."""
+        from metrics_tpu.core import fleet as _fleet
+
+        if self.fleet_size is not None:
+            raise MetricsUserError(
+                f"{type(self).__name__} is already a fleet (fleet_size={self.fleet_size})"
+            )
+        out = deepcopy(self)
+        _fleet.convert_to_fleet(out, fleet_size)
+        return out
+
+    def reduce_fleet(self) -> Any:
+        """Collapse the fleet axis through each state's registered reduction
+        (the ``merge_state`` pairwise algebra applied across streams) and
+        return the aggregate compute value — the answer "over all tenants"."""
+        from metrics_tpu.core import fleet as _fleet
+
+        if self.fleet_size is None:
+            raise MetricsUserError(
+                f"reduce_fleet() requires a fleet metric; {type(self).__name__} has no fleet axis"
+            )
+        return _fleet.reduce_fleet_value(self)
+
     def __getstate__(self) -> Dict[str, Any]:
         # drop wrapped bound closures for pickling (reference: metric.py:636-640)
         state = self.__dict__.copy()
@@ -1001,6 +1125,10 @@ class Metric(ABC):
         exists_var_keyword = any(v.kind == inspect.Parameter.VAR_KEYWORD for v in _sign_params.values())
         if exists_var_keyword:
             filtered_kwargs = kwargs
+        elif self.fleet_size is not None and "stream_ids" in kwargs:
+            # routing kwarg of the fleet tier, consumed by _wrap_update before
+            # the subclass update sees it — never filter it out
+            filtered_kwargs = dict(filtered_kwargs, stream_ids=kwargs["stream_ids"])
         return filtered_kwargs
 
     @property

@@ -13,6 +13,9 @@ class RelativeAverageSpectralError(Metric):
     is_differentiable: bool = True
     higher_is_better: bool = False
     full_state_update: bool = False
+    # scalar placeholders become image-shaped maps on the first update, so the
+    # fleet axis (which needs final state shapes at registration) is rejected
+    _lazy_state_shapes: bool = True
 
     def __init__(self, window_size: int = 8, **kwargs: Any) -> None:
         super().__init__(**kwargs)

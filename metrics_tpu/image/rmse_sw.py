@@ -13,6 +13,9 @@ class RootMeanSquaredErrorUsingSlidingWindow(Metric):
     is_differentiable: bool = True
     higher_is_better: bool = False
     full_state_update: bool = False
+    # scalar placeholders become map-shaped state on the first update (see
+    # rase.py), so the fleet axis rejects this class at construction
+    _lazy_state_shapes: bool = True
 
     def __init__(self, window_size: int = 8, **kwargs: Any) -> None:
         super().__init__(**kwargs)

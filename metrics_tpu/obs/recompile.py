@@ -32,6 +32,9 @@ RETRACE_WARN_THRESHOLD = 2
 #: (metrics_tpu/analysis/, TM-RETRACE).
 _CLASS_FINGERPRINTS: dict = {}
 
+#: Classes already warned about class-level signature churn (once per class).
+_CLASS_RETRACE_WARNED: set = set()
+
 
 def _fingerprint_leaf(x: Any) -> Tuple:
     shape = getattr(x, "shape", None)
@@ -82,6 +85,26 @@ def check_update(metric: Any, args: Tuple, kwargs: dict) -> None:
         class_seen.add(fp)
         if not class_first:
             _reg.REGISTRY.inc(name, "retrace_signatures")
+        if (
+            len(class_seen) > RETRACE_WARN_THRESHOLD
+            and name not in _CLASS_RETRACE_WARNED
+            and getattr(metric, "fleet_size", None) is None
+        ):
+            # class-level churn with per-instance dedup intact means MANY
+            # instances of the same class each compile their own update — the
+            # eager-fleet anti-pattern. A single fleet instance shares one
+            # compiled executable across every stream.
+            _CLASS_RETRACE_WARNED.add(name)
+            warnings.warn(
+                f"metrics_tpu.obs: `{name}` has seen {len(class_seen)} distinct"
+                " update signatures across its instances (class-wide). If these"
+                " are per-stream/per-tenant copies of the same metric, replace"
+                f" them with one fleet instance — `{name}(..., fleet_size=N)`"
+                " updated via `update(..., stream_ids=...)` — which compiles one"
+                " executable and runs one launch for all streams.",
+                RuntimeWarning,
+                stacklevel=3,
+            )
     if not first:
         _reg.REGISTRY.inc(name, "retraces")
     if len(seen) > RETRACE_WARN_THRESHOLD and not metric.__dict__.get("_obs_retrace_warned", False):
@@ -120,10 +143,12 @@ def reset_class_detector(name: Any = None) -> None:
     workloads)."""
     if name is None:
         _CLASS_FINGERPRINTS.clear()
+        _CLASS_RETRACE_WARNED.clear()
         return
     if isinstance(name, type):
         name = name.__name__
     _CLASS_FINGERPRINTS.pop(name, None)
+    _CLASS_RETRACE_WARNED.discard(name)
 
 
 def nbytes_of(x: Any) -> int:

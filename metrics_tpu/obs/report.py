@@ -96,6 +96,11 @@ def metric_state_report(metric: Any) -> Dict[str, Any]:
         "states": states,
         "total_nbytes": int(sum(s["nbytes"] for s in states)),
     }
+    fleet_size = getattr(metric, "fleet_size", None)
+    if fleet_size is not None:
+        # fleet-axis metric (core/fleet.py): every state row above is shaped
+        # (fleet_size, *base), so per-stream HBM is total_nbytes / fleet_size
+        report["fleet_size"] = int(fleet_size)
     # last checkpoint save/restore latency + step, stamped by metrics_tpu.ckpt
     ckpt_stats = getattr(metric, "_ckpt_stats", None)
     if isinstance(ckpt_stats, dict) and ckpt_stats:

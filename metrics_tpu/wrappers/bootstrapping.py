@@ -10,6 +10,15 @@ stacked ``(num_bootstraps, ...)`` state pytree, resample on device with the jax
 PRNG (key carried in the state) and run the base metric's ``local_update`` vmapped
 over the bootstrap axis — all N bootstrap replicas cost one fused device program
 under jit/shard_map, making bootstrap confidence intervals nearly free on device.
+
+Fleet rebase (round 9): the EAGER tier now rides the same degenerate-fleet shape.
+When the base metric is eligible (fixed-shape array states, traceable update, no
+child metrics of its own) the wrapper keeps ONE template copy plus registered
+``boot_<name>`` states stacked ``(num_bootstraps, *base)``, and each ``update``
+is one cached donated launch (``core.fleet.run_step``) vmapping the base
+``local_update`` over device-resampled replicas — N dispatches and N state trees
+collapse to 1. Ineligible bases (list/cat states, host-side updates, wrapper
+bases) keep the reference's N-deepcopy loop.
 """
 from copy import deepcopy
 from typing import Any, Dict, Optional, Sequence, Union
@@ -79,7 +88,6 @@ class BootStrapper(Metric):
                 f"Expected base metric to be an instance of metrics_tpu.Metric but received {base_metric}"
             )
 
-        self.metrics = [deepcopy(base_metric) for _ in range(num_bootstraps)]
         self.num_bootstraps = num_bootstraps
 
         self.mean = mean
@@ -96,24 +104,94 @@ class BootStrapper(Metric):
             )
         self.sampling_strategy = sampling_strategy
 
+        self._eager_stacked = self._stackable(base_metric)
+        if self._eager_stacked:
+            # degenerate fleet: one template + registered (N, *base) states,
+            # every eager update is ONE vmapped launch (see module docstring)
+            self.metrics = [deepcopy(base_metric)]
+            n = num_bootstraps
+            for name, default in base_metric._defaults.items():
+                stacked = jnp.tile(jnp.asarray(default)[None], (n,) + (1,) * jnp.ndim(default))
+                self.add_state(
+                    f"boot_{name}",
+                    stacked,
+                    dist_reduce_fx=base_metric._reductions[name],
+                    persistent=base_metric._persistent[name],
+                )
+        else:
+            self.metrics = [deepcopy(base_metric) for _ in range(num_bootstraps)]
+
+    @staticmethod
+    def _stackable(base: Metric) -> bool:
+        """Can the eager tier carry one stacked state instead of N copies?
+        Mirrors the fused-engine eligibility: fixed-shape array states and a
+        traceable update on a leaf metric."""
+        from metrics_tpu.ckpt.manifest import child_metrics
+        from metrics_tpu.core.state import CatBuffer
+
+        if type(base)._host_side_update or not base._defaults:
+            return False
+        if any(isinstance(v, (list, CatBuffer)) for v in base._defaults.values()):
+            return False
+        return not child_metrics(base)
+
     def _san_input_specs(self, n: int):
         # tmsan hook (core/metric.py): shapes come from the wrapped metric
         from metrics_tpu.analysis.san.abstract_inputs import inner_spec
 
         return inner_spec(self.metrics[0], n) if self.metrics else None
 
-    def update(self, *args: Any, **kwargs: Any) -> None:
-        """Resample inputs along dim 0 per bootstrap copy (reference: :115-135)."""
+    @staticmethod
+    def _batch_size(args: Any, kwargs: Any) -> int:
         array_types = (jnp.ndarray, np.ndarray)
+        args_sizes = apply_to_collection(args, array_types, len)
+        kwargs_sizes = list(apply_to_collection(kwargs, array_types, len).values()) if kwargs else []
+        sizes = list(jax.tree_util.tree_leaves(args_sizes)) + kwargs_sizes
+        if not sizes:
+            raise ValueError("None of the input contained tensors, so could not determine the sampling size")
+        # sizes come from len() over concrete arrays — already host ints
+        return sizes[0]
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Resample inputs along dim 0 per bootstrap replica (reference: :115-135).
+
+        Stacked (degenerate-fleet) path: one cached donated launch vmapping the
+        base ``local_update`` over device-resampled replicas. The per-call seed
+        still comes from the host ``self._rng`` stream, so seeded wrappers stay
+        reproducible and unseeded ones draw fresh subsamples per call.
+        """
+        array_types = (jnp.ndarray, np.ndarray)
+        if self._eager_stacked:
+            from metrics_tpu.core import fleet as _fleet
+            from metrics_tpu.core import fused as _fused
+
+            base = self.metrics[0]
+            size = self._batch_size(args, kwargs)
+            seed = int(self._rng.integers(0, 2**63 - 1))
+            keys = jax.random.split(jax.random.PRNGKey(seed), self.num_bootstraps)
+            state = {name: getattr(self, f"boot_{name}") for name in base._defaults}
+            dyn, spec = _fused._split_inputs(args, kwargs)
+
+            def step(st, ks, dl):
+                a, kw = _fused._merge_inputs(dl, spec)
+
+                def one(bstate, k):
+                    idx = self._device_sample(k, size)
+                    new_a = apply_to_collection(a, array_types, lambda x: jnp.take(jnp.asarray(x), idx, axis=0))
+                    new_kw = apply_to_collection(kw, array_types, lambda x: jnp.take(jnp.asarray(x), idx, axis=0))
+                    return base.local_update(bstate, *new_a, **new_kw)
+
+                return jax.vmap(one)(st, ks)
+
+            new = _fleet.run_step(
+                self, "boot.update", step, state, keys, dyn, static_key=_fused._static_key(spec)
+            )
+            for name, value in new.items():
+                setattr(self, f"boot_{name}", value)
+            return
+
         for idx in range(self.num_bootstraps):
-            args_sizes = apply_to_collection(args, array_types, len)
-            kwargs_sizes = list(apply_to_collection(kwargs, array_types, len).values()) if kwargs else []
-            if len(args_sizes) > 0:
-                size = args_sizes[0]
-            elif len(kwargs_sizes) > 0:
-                size = kwargs_sizes[0]
-            else:
-                raise ValueError("None of the input contained tensors, so could not determine the sampling size")
+            size = self._batch_size(args, kwargs)
             sample_idx = _bootstrap_sampler(size, self.sampling_strategy, self._rng)
             new_args = apply_to_collection(args, array_types, lambda x: jnp.take(jnp.asarray(x), sample_idx, axis=0))
             new_kwargs = apply_to_collection(
@@ -123,7 +201,12 @@ class BootStrapper(Metric):
 
     def compute(self) -> Dict[str, Array]:
         """mean/std/quantile/raw over bootstrap computes (reference: :141-157)."""
-        computed_vals = jnp.stack([jnp.asarray(m.compute()) for m in self.metrics], axis=0)
+        if self._eager_stacked:
+            base = self.metrics[0]
+            state = {name: getattr(self, f"boot_{name}") for name in base._defaults}
+            computed_vals = jax.vmap(lambda s: jnp.asarray(base.compute_from(s)))(state)
+        else:
+            computed_vals = jnp.stack([jnp.asarray(m.compute()) for m in self.metrics], axis=0)
         output_dict = {}
         if self.mean:
             output_dict["mean"] = computed_vals.mean(axis=0)

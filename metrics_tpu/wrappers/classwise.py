@@ -34,6 +34,13 @@ class ClasswiseWrapper(Metric):
 
     def _convert(self, x: Array) -> Dict[str, Array]:
         name = self.metric.__class__.__name__.lower()
+        if self.metric.fleet_size is not None:
+            # fleet inner metric: the compute tree is (fleet_size, num_classes)
+            # — enumerate the trailing CLASS axis so each dict value keeps its
+            # per-stream leading axis (per-class × per-stream results)
+            if self.labels is None:
+                return {f"{name}_{i}": x[..., i] for i in range(x.shape[-1])}
+            return {f"{name}_{lab}": x[..., i] for i, lab in enumerate(self.labels)}
         if self.labels is None:
             return {f"{name}_{i}": val for i, val in enumerate(x)}
         return {f"{name}_{lab}": val for lab, val in zip(self.labels, x)}

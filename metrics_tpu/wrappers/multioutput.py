@@ -59,6 +59,15 @@ class MultioutputWrapper(Metric):
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
+        if self.fleet_size is not None:
+            from metrics_tpu.utils.exceptions import MetricsUserError
+
+            raise MetricsUserError(
+                "MultioutputWrapper holds its state in per-output child metrics,"
+                " so fleet_size on the wrapper registers nothing to route; make"
+                " the underlying metric the fleet instead (base_metric with"
+                " fleet_size=N, updated with stream_ids)"
+            )
         self.metrics = [deepcopy(base_metric) for _ in range(num_outputs)]
         self.output_dim = output_dim
         self.remove_nans = remove_nans

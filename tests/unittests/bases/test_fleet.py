@@ -1,0 +1,440 @@
+"""Fleet-axis tier (core/fleet.py): stream routing parity, one-launch
+dispatch, reductions, typed errors, and wrapper composition.
+
+The load-bearing property everywhere below is BIT-IDENTITY against N
+independent instances: stat-score metrics accumulate integer counts, and the
+segment-sum routing decomposition is exact over integers, so every comparison
+uses ``array_equal`` — not ``allclose``.
+"""
+import pickle
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu.obs as obs
+from metrics_tpu import MetricCollection
+from metrics_tpu.classification import (
+    BinaryAccuracy,
+    MulticlassAccuracy,
+    MulticlassPrecision,
+)
+from metrics_tpu.core.fleet import ROWS_STATE
+from metrics_tpu.regression import MeanSquaredError
+from metrics_tpu.utils.exceptions import MetricsUserError
+from metrics_tpu.wrappers import BootStrapper, ClasswiseWrapper
+
+pytestmark = pytest.mark.fleet
+
+
+def _batches(num, rows, num_classes=3, fleet=4, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        (
+            jnp.asarray(rng.integers(0, num_classes, rows)),
+            jnp.asarray(rng.integers(0, num_classes, rows)),
+            jnp.asarray(rng.integers(0, fleet, rows), dtype=jnp.int32),
+        )
+        for _ in range(num)
+    ]
+
+
+def _route_to_refs(refs, preds, target, ids):
+    for s, ref in enumerate(refs):
+        m = np.asarray(ids) == s
+        if m.any():
+            ref.update(preds[m], target[m])
+
+
+class TestConstruction:
+    def test_fleet_state_shapes(self):
+        m = MulticlassAccuracy(num_classes=5, average=None, fleet_size=3)
+        assert m.fleet_size == 3
+        assert m.tp.shape == (3, 5)
+        assert getattr(m, ROWS_STATE).shape == (3,)
+        assert ROWS_STATE in m._defaults and m._reductions[ROWS_STATE] == "sum"
+
+    def test_as_fleet_replicates_live_state(self):
+        base = BinaryAccuracy()
+        base.update(jnp.array([1, 0, 1]), jnp.array([1, 1, 1]))
+        fleet = base.as_fleet(2)
+        assert fleet.fleet_size == 2
+        # live accumulators are replicated to every stream, base untouched
+        assert np.array_equal(np.asarray(fleet.tp), np.tile(np.asarray(base.tp)[None], (2, 1)))
+        assert base.fleet_size is None
+
+    def test_as_fleet_on_fleet_raises(self):
+        with pytest.raises(MetricsUserError, match="already"):
+            BinaryAccuracy(fleet_size=2).as_fleet(3)
+
+    @pytest.mark.parametrize("bad", [0, -1, True, 2.5, "4"])
+    def test_bad_fleet_size(self, bad):
+        with pytest.raises(ValueError, match="fleet_size"):
+            BinaryAccuracy(fleet_size=bad)
+
+    def test_cat_state_metric_rejected(self):
+        from metrics_tpu.retrieval import RetrievalMAP
+
+        with pytest.raises(MetricsUserError, match="list/cat state"):
+            RetrievalMAP(fleet_size=2)
+
+    def test_non_foldable_reduction_rejected(self):
+        # PearsonCorrCoef is the canonical dist_reduce_fx=None metric: its
+        # moment states have no per-row segment fold
+        from metrics_tpu import PearsonCorrCoef
+
+        with pytest.raises(MetricsUserError, match="sum/max/min"):
+            PearsonCorrCoef(fleet_size=2)
+
+
+class TestRoutingParity:
+    def test_routed_bit_identical_to_independent_instances(self):
+        fleet = MulticlassAccuracy(num_classes=3, average=None, fleet_size=4)
+        refs = [MulticlassAccuracy(num_classes=3, average=None) for _ in range(4)]
+        for preds, target, ids in _batches(5, 64):
+            fleet.update(preds, target, stream_ids=ids)
+            _route_to_refs(refs, preds, target, ids)
+        out = fleet.compute()
+        for s, ref in enumerate(refs):
+            assert np.array_equal(np.asarray(out[s]), np.asarray(ref.compute()))
+            assert np.array_equal(
+                np.asarray(fleet.compute(stream=s)), np.asarray(ref.compute())
+            )
+
+    def test_broadcast_update_hits_every_stream(self):
+        fleet = BinaryAccuracy(fleet_size=3)
+        ref = BinaryAccuracy()
+        preds, target = jnp.array([1, 0, 1, 1]), jnp.array([1, 1, 0, 1])
+        fleet.update(preds, target)  # no stream_ids -> broadcast
+        ref.update(preds, target)
+        out = fleet.compute()
+        assert out.shape == (3,)
+        for s in range(3):
+            assert np.array_equal(np.asarray(out[s]), np.asarray(ref.compute()))
+        assert np.array_equal(np.asarray(getattr(fleet, ROWS_STATE)), np.full(3, 4))
+
+    def test_rows_state_counts_routed_rows(self):
+        fleet = BinaryAccuracy(fleet_size=3)
+        ids = jnp.array([0, 0, 2, 2, 2], dtype=jnp.int32)
+        ones = jnp.ones(5, jnp.int32)
+        fleet.update(ones, ones, stream_ids=ids)
+        assert np.array_equal(np.asarray(getattr(fleet, ROWS_STATE)), [2, 0, 3])
+
+    def test_empty_stream_keeps_default_state(self):
+        fleet = MulticlassAccuracy(num_classes=3, average="micro", fleet_size=3)
+        ids = jnp.zeros(8, jnp.int32)  # everything to stream 0
+        preds, target, _ = _batches(1, 8)[0]
+        fleet.update(preds, target, stream_ids=ids)
+        ref = MulticlassAccuracy(num_classes=3, average="micro")
+        assert np.array_equal(
+            np.asarray(fleet.compute(stream=0)),
+            np.asarray((lambda: (ref.update(preds, target), ref.compute())[1])()),
+        )
+        # untouched streams carry untouched default accumulators
+        assert np.asarray(fleet.tp)[1:].sum() == 0
+
+    def test_float_accumulators_route(self):
+        fleet = MeanSquaredError(fleet_size=2)
+        refs = [MeanSquaredError() for _ in range(2)]
+        rng = np.random.default_rng(3)
+        preds = jnp.asarray(rng.normal(size=32))
+        target = jnp.asarray(rng.normal(size=32))
+        ids = jnp.asarray(rng.integers(0, 2, 32), dtype=jnp.int32)
+        fleet.update(preds, target, stream_ids=ids)
+        _route_to_refs(refs, preds, target, ids)
+        out = fleet.compute()
+        for s in range(2):
+            # float path: associative-only, so allclose (ulp-level reorder)
+            np.testing.assert_allclose(
+                np.asarray(out[s]), np.asarray(refs[s].compute()), rtol=1e-6
+            )
+
+    def test_max_reduction_routes(self):
+        from metrics_tpu import MaxMetric
+
+        fleet = MaxMetric(fleet_size=3)
+        vals = jnp.array([1.0, 9.0, 4.0, 7.0])
+        ids = jnp.array([0, 1, 1, 2], dtype=jnp.int32)
+        fleet.update(vals, stream_ids=ids)
+        out = fleet.compute()
+        assert np.array_equal(np.asarray(out), [1.0, 9.0, 7.0])
+
+
+class TestComputeAndReduce:
+    def test_compute_stream_out_of_range(self):
+        m = BinaryAccuracy(fleet_size=2)
+        m.update(jnp.ones(2, jnp.int32), jnp.ones(2, jnp.int32))
+        with pytest.raises(MetricsUserError, match="stream"):
+            m.compute(stream=2)
+
+    def test_compute_stream_on_non_fleet(self):
+        m = BinaryAccuracy()
+        m.update(jnp.ones(2, jnp.int32), jnp.ones(2, jnp.int32))
+        with pytest.raises(MetricsUserError, match="fleet"):
+            m.compute(stream=0)
+
+    def test_compute_cache_indexing(self):
+        fleet = BinaryAccuracy(fleet_size=2)
+        fleet.update(jnp.array([1, 0]), jnp.array([1, 1]), stream_ids=jnp.array([0, 1]))
+        full = fleet.compute()  # caches the per-stream tree
+        assert np.array_equal(np.asarray(fleet.compute(stream=1)), np.asarray(full[1]))
+
+    def test_reduce_fleet_matches_single_instance(self):
+        fleet = MulticlassAccuracy(num_classes=3, average="micro", fleet_size=4)
+        ref = MulticlassAccuracy(num_classes=3, average="micro")
+        for preds, target, ids in _batches(3, 48):
+            fleet.update(preds, target, stream_ids=ids)
+            ref.update(preds, target)
+        assert np.array_equal(np.asarray(fleet.reduce_fleet()), np.asarray(ref.compute()))
+
+    def test_reduce_fleet_on_non_fleet_raises(self):
+        with pytest.raises(MetricsUserError, match="fleet"):
+            BinaryAccuracy().reduce_fleet()
+
+    def test_reset_restores_fleet_defaults(self):
+        fleet = BinaryAccuracy(fleet_size=3)
+        fleet.update(jnp.ones(4, jnp.int32), jnp.ones(4, jnp.int32))
+        fleet.reset()
+        assert fleet.tp.shape == (3, 1)
+        assert np.asarray(fleet.tp).sum() == 0
+        assert np.asarray(getattr(fleet, ROWS_STATE)).sum() == 0
+
+
+class TestTypedErrors:
+    def test_stream_ids_out_of_bounds(self):
+        fleet = BinaryAccuracy(fleet_size=2)
+        ones = jnp.ones(3, jnp.int32)
+        with pytest.raises(MetricsUserError, match=r"\[0, 2\)"):
+            fleet.update(ones, ones, stream_ids=jnp.array([0, 1, 2], dtype=jnp.int32))
+
+    def test_stream_ids_rank_mismatch(self):
+        fleet = BinaryAccuracy(fleet_size=2)
+        ones = jnp.ones(3, jnp.int32)
+        with pytest.raises(MetricsUserError):
+            fleet.update(ones, ones, stream_ids=jnp.zeros((3, 1), jnp.int32))
+
+    def test_stream_ids_on_non_fleet_ignored_by_filter(self):
+        # MetricCollection._filter_kwargs drops stream_ids for non-fleet
+        # members; a DIRECT non-fleet update with stream_ids is a TypeError
+        # from the subclass signature, which is fine — here we pin the
+        # collection path
+        col = MetricCollection(
+            {
+                "fleet": BinaryAccuracy(fleet_size=2),
+                "plain": BinaryAccuracy(),
+            }
+        )
+        ones = jnp.ones(4, jnp.int32)
+        col.update(ones, ones, stream_ids=jnp.array([0, 1, 0, 1], dtype=jnp.int32))
+        out = col.compute()
+        assert out["fleet"].shape == (2,)
+        assert np.asarray(out["plain"]).shape == ()
+
+    def test_merge_unequal_fleet_sizes(self):
+        a, b = BinaryAccuracy(fleet_size=2), BinaryAccuracy(fleet_size=3)
+        with pytest.raises(MetricsUserError, match="fleet sizes differ"):
+            a.merge_state(b)
+
+    def test_merge_fleet_with_non_fleet(self):
+        a, b = BinaryAccuracy(fleet_size=2), BinaryAccuracy()
+        with pytest.raises(MetricsUserError, match="fleet sizes differ"):
+            a.merge_state(b)
+
+    def test_merge_equal_fleets_elementwise(self):
+        ids = jnp.array([0, 1], dtype=jnp.int32)
+        a, b = BinaryAccuracy(fleet_size=2), BinaryAccuracy(fleet_size=2)
+        a.update(jnp.array([1, 0]), jnp.array([1, 1]), stream_ids=ids)
+        b.update(jnp.array([1, 1]), jnp.array([1, 0]), stream_ids=ids)
+        ref = BinaryAccuracy(fleet_size=2)
+        ref.update(jnp.array([1, 0]), jnp.array([1, 1]), stream_ids=ids)
+        ref.update(jnp.array([1, 1]), jnp.array([1, 0]), stream_ids=ids)
+        a.merge_state(b)
+        assert np.array_equal(np.asarray(a.compute()), np.asarray(ref.compute()))
+
+    def test_fleet_and_cat_capacity_exclusive(self):
+        from metrics_tpu.retrieval import RetrievalMAP
+
+        with pytest.raises(MetricsUserError, match="mutually exclusive"):
+            RetrievalMAP(fleet_size=2, cat_capacity=16)
+
+
+class TestPureTier:
+    def test_local_update_under_jit_parity(self):
+        fleet = MulticlassAccuracy(num_classes=3, average="micro", fleet_size=4)
+        refs = [MulticlassAccuracy(num_classes=3, average="micro") for _ in range(4)]
+
+        @jax.jit
+        def step(state, preds, target, ids):
+            return fleet.local_update(state, preds, target, stream_ids=ids)
+
+        state = fleet.init_state()
+        for preds, target, ids in _batches(4, 32):
+            state = step(state, preds, target, ids)
+            _route_to_refs(refs, preds, target, ids)
+        vals = fleet.compute_from(state)
+        for s, ref in enumerate(refs):
+            assert np.array_equal(np.asarray(vals[s]), np.asarray(ref.compute()))
+
+    def test_local_update_does_not_donate_callers_state(self):
+        fleet = BinaryAccuracy(fleet_size=2)
+        state = fleet.init_state()
+        ones = jnp.ones(2, jnp.int32)
+        new = fleet.local_update(state, ones, ones, stream_ids=jnp.array([0, 1], dtype=jnp.int32))
+        # the caller's arrays must still be alive (pure contract: no donation)
+        assert np.asarray(state["tp"]).sum() == 0
+        assert np.asarray(new["tp"]).sum() == 2
+
+
+class TestOneLaunch:
+    def test_single_dispatch_per_update(self):
+        fleet = MulticlassAccuracy(num_classes=3, average="micro", fleet_size=8)
+        preds, target, _ = _batches(1, 32, fleet=8)[0]
+        ids = jnp.asarray(np.random.default_rng(0).integers(0, 8, 32), dtype=jnp.int32)
+        fleet.update(preds, target, stream_ids=ids)  # warm/compile
+        with obs.observe(clear=True):
+            fleet.update(preds, target, stream_ids=ids)
+            snap = obs.snapshot()
+        dispatches = sum(
+            v.get("dispatches", 0) for v in snap.values() if isinstance(v, dict)
+        )
+        assert dispatches == 1
+        scope = snap["fleet"]
+        assert scope.get("routed", 0) == 32
+        assert scope.get("streams", 0) == len(set(np.asarray(ids).tolist()))
+
+    def test_executable_cache_reused_across_steps(self):
+        from metrics_tpu.core import fleet as fleet_mod
+
+        m = BinaryAccuracy(fleet_size=4)
+        ones = jnp.ones(8, jnp.int32)
+        ids = jnp.tile(jnp.arange(4, dtype=jnp.int32), 2)
+        m.update(ones, ones, stream_ids=ids)
+        cache = fleet_mod._EXEC_CACHE[id(m)]
+        n_entries = len(cache)
+        for _ in range(3):
+            m.update(ones, ones, stream_ids=ids)
+        assert len(cache) == n_entries  # same avals -> same executable
+
+
+class TestWrapperComposition:
+    def test_classwise_fleet_per_class_per_stream(self):
+        inner = MulticlassAccuracy(num_classes=3, average=None, fleet_size=2)
+        cw = ClasswiseWrapper(inner)
+        refs = [
+            ClasswiseWrapper(MulticlassAccuracy(num_classes=3, average=None))
+            for _ in range(2)
+        ]
+        for preds, target, ids in _batches(3, 24, fleet=2, seed=5):
+            cw.update(preds, target, stream_ids=ids)
+            for s, ref in enumerate(refs):
+                m = np.asarray(ids) == s
+                if m.any():
+                    ref.update(preds[m], target[m])
+        out = cw.compute()
+        assert sorted(out) == [f"multiclassaccuracy_{i}" for i in range(3)]
+        for key, val in out.items():
+            assert val.shape == (2,)
+            for s in range(2):
+                assert np.array_equal(np.asarray(val[s]), np.asarray(refs[s].compute()[key]))
+
+    def test_classwise_fleet_labels(self):
+        cw = ClasswiseWrapper(
+            MulticlassAccuracy(num_classes=2, average=None, fleet_size=2),
+            labels=["cat", "dog"],
+        )
+        cw.update(
+            jnp.array([0, 1]), jnp.array([0, 0]), stream_ids=jnp.array([0, 1], dtype=jnp.int32)
+        )
+        assert sorted(cw.compute()) == ["multiclassaccuracy_cat", "multiclassaccuracy_dog"]
+
+    def test_fused_collection_with_fleet_member(self):
+        fleet = MulticlassAccuracy(num_classes=3, average="micro", fleet_size=4)
+        plain = MulticlassPrecision(num_classes=3, average="macro")
+        col = MetricCollection({"fleet_acc": fleet, "prec": plain}, fused=True)
+        refs = [MulticlassAccuracy(num_classes=3, average="micro") for _ in range(4)]
+        ref_prec = MulticlassPrecision(num_classes=3, average="macro")
+        for preds, target, ids in _batches(4, 32, seed=7):
+            col.update(preds, target, stream_ids=ids)
+            ref_prec.update(preds, target)
+            _route_to_refs(refs, preds, target, ids)
+        out = col.compute()
+        for s, ref in enumerate(refs):
+            assert np.array_equal(np.asarray(out["fleet_acc"][s]), np.asarray(ref.compute()))
+        assert np.array_equal(np.asarray(out["prec"]), np.asarray(ref_prec.compute()))
+
+
+class TestBootStrapperStacked:
+    def test_stacked_states_registered(self):
+        bs = BootStrapper(BinaryAccuracy(), num_bootstraps=4, seed=0)
+        assert bs._eager_stacked
+        assert len(bs.metrics) == 1  # template only, not num_bootstraps copies
+        assert bs.boot_tp.shape == (4, 1)
+
+    def test_stacked_update_one_dispatch(self):
+        bs = BootStrapper(BinaryAccuracy(), num_bootstraps=8, seed=1)
+        ones = jnp.ones(16, jnp.int32)
+        bs.update(ones, ones)  # warm
+        with obs.observe(clear=True):
+            bs.update(ones, ones)
+            snap = obs.snapshot()
+        # one stacked launch, not num_bootstraps eager child updates
+        assert sum(v.get("dispatches", 0) for v in snap.values()) == 1
+        out = bs.compute()
+        assert np.asarray(out["mean"]).shape == ()
+
+
+class TestObsIntegration:
+    def test_class_churn_warning_names_fleet_api(self):
+        from metrics_tpu.obs import recompile
+
+        obs.enable(clear=True)
+        recompile.reset_class_detector("MulticlassPrecision")
+        try:
+            with warnings.catch_warnings(record=True) as w:
+                warnings.simplefilter("always")
+                for rows in (4, 5, 6):
+                    m = MulticlassPrecision(num_classes=3)
+                    m.update(jnp.zeros(rows, jnp.int32), jnp.zeros(rows, jnp.int32))
+            msgs = [str(x.message) for x in w if "fleet_size=N" in str(x.message)]
+            assert len(msgs) == 1 and "stream_ids" in msgs[0]
+        finally:
+            obs.disable()
+            recompile.reset_class_detector("MulticlassPrecision")
+
+    def test_fleet_instances_exempt_from_churn_warning(self):
+        from metrics_tpu.obs import recompile
+
+        obs.enable(clear=True)
+        recompile.reset_class_detector("MulticlassAccuracy")
+        try:
+            with warnings.catch_warnings(record=True) as w:
+                warnings.simplefilter("always")
+                for rows in (4, 5, 6):
+                    m = MulticlassAccuracy(num_classes=3, fleet_size=2)
+                    m.update(
+                        jnp.zeros(rows, jnp.int32),
+                        jnp.zeros(rows, jnp.int32),
+                        stream_ids=jnp.zeros(rows, jnp.int32),
+                    )
+            assert not any("fleet_size=N" in str(x.message) for x in w)
+        finally:
+            obs.disable()
+            recompile.reset_class_detector("MulticlassAccuracy")
+
+    def test_state_report_carries_fleet_size(self):
+        report = BinaryAccuracy(fleet_size=8).state_report()
+        assert report["fleet_size"] == 8
+
+
+class TestPickle:
+    def test_fleet_pickle_roundtrip(self):
+        fleet = MulticlassAccuracy(num_classes=3, average=None, fleet_size=3)
+        preds, target, ids = _batches(1, 24, fleet=3, seed=9)[0]
+        fleet.update(preds, target, stream_ids=ids)
+        clone = pickle.loads(pickle.dumps(fleet))
+        assert clone.fleet_size == 3
+        assert np.array_equal(np.asarray(clone.compute()), np.asarray(fleet.compute()))
+        # the restored instance keeps working (no stale compiled executables)
+        clone.update(preds, target, stream_ids=ids)

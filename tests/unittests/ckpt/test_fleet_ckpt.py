@@ -1,0 +1,166 @@
+"""Fleet-axis checkpointing (core/fleet.py x metrics_tpu.ckpt): full-fleet
+roundtrip, per-stream slicing (``restore_checkpoint(..., stream=i)``), host
+topology N->M re-reduce along the fleet axis, and the fleet-dim drift error.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import MaxMetric, MinMetric, ckpt
+from metrics_tpu.ckpt import CheckpointError, ShapeDriftError
+from metrics_tpu.classification import BinaryAccuracy, MulticlassAccuracy
+from metrics_tpu.core.fleet import ROWS_STATE
+
+pytestmark = [pytest.mark.ckpt, pytest.mark.fleet]
+
+FLEET = 4
+
+
+def _fed_fleet(seed=0, steps=3, rows=32):
+    rng = np.random.default_rng(seed)
+    m = MulticlassAccuracy(num_classes=3, average=None, fleet_size=FLEET)
+    refs = [MulticlassAccuracy(num_classes=3, average=None) for _ in range(FLEET)]
+    for _ in range(steps):
+        preds = jnp.asarray(rng.integers(0, 3, rows))
+        target = jnp.asarray(rng.integers(0, 3, rows))
+        ids = jnp.asarray(rng.integers(0, FLEET, rows), dtype=jnp.int32)
+        m.update(preds, target, stream_ids=ids)
+        for s, ref in enumerate(refs):
+            mask = np.asarray(ids) == s
+            if mask.any():
+                ref.update(preds[mask], target[mask])
+    return m, refs
+
+
+def test_fleet_roundtrip_bit_identical(tmp_path):
+    m, _ = _fed_fleet()
+    m.save_checkpoint(str(tmp_path), step=0)
+    fresh = MulticlassAccuracy(num_classes=3, average=None, fleet_size=FLEET)
+    assert fresh.restore_checkpoint(str(tmp_path)) == 0
+    assert np.array_equal(np.asarray(fresh.compute()), np.asarray(m.compute()))
+    assert np.array_equal(
+        np.asarray(getattr(fresh, ROWS_STATE)), np.asarray(getattr(m, ROWS_STATE))
+    )
+    assert fresh._update_count == m._update_count
+
+
+def test_stream_slice_restores_one_tenant(tmp_path):
+    m, refs = _fed_fleet(seed=1)
+    m.save_checkpoint(str(tmp_path), step=0)
+    for s, ref in enumerate(refs):
+        single = MulticlassAccuracy(num_classes=3, average=None)
+        single.restore_checkpoint(str(tmp_path), stream=s)
+        assert np.array_equal(np.asarray(single.tp), np.asarray(ref.tp))
+        assert np.array_equal(np.asarray(single.compute()), np.asarray(ref.compute()))
+
+
+def test_stream_slice_out_of_range(tmp_path):
+    m, _ = _fed_fleet()
+    m.save_checkpoint(str(tmp_path), step=0)
+    with pytest.raises(CheckpointError, match="out of range"):
+        MulticlassAccuracy(num_classes=3, average=None).restore_checkpoint(
+            str(tmp_path), stream=FLEET
+        )
+
+
+def test_stream_slice_requires_fleet_checkpoint(tmp_path):
+    plain = BinaryAccuracy()
+    plain.update(jnp.ones(4, jnp.int32), jnp.ones(4, jnp.int32))
+    plain.save_checkpoint(str(tmp_path), step=0)
+    with pytest.raises(CheckpointError, match="fleet"):
+        BinaryAccuracy().restore_checkpoint(str(tmp_path), stream=0)
+
+
+def test_fleet_size_drift_names_fleet_dim(tmp_path):
+    m, _ = _fed_fleet()
+    m.save_checkpoint(str(tmp_path), step=0)
+    wrong = MulticlassAccuracy(num_classes=3, average=None, fleet_size=FLEET + 1)
+    with pytest.raises(ShapeDriftError, match=r"fleet_size=4 != live fleet_size=5"):
+        wrong.restore_checkpoint(str(tmp_path))
+    plain = MulticlassAccuracy(num_classes=3, average=None)
+    with pytest.raises(ShapeDriftError, match=r"fleet_size=4 != live fleet_size=None"):
+        plain.restore_checkpoint(str(tmp_path))
+
+
+def test_collection_restore_rejects_stream(tmp_path):
+    from metrics_tpu import MetricCollection
+
+    col = MetricCollection({"acc": BinaryAccuracy(fleet_size=2)})
+    col.update(
+        jnp.ones(4, jnp.int32), jnp.ones(4, jnp.int32),
+        stream_ids=jnp.array([0, 1, 0, 1], dtype=jnp.int32),
+    )
+    ckpt.save_checkpoint(col, str(tmp_path), step=0)
+    fresh = MetricCollection({"acc": BinaryAccuracy(fleet_size=2)})
+    with pytest.raises(CheckpointError, match="not collections"):
+        ckpt.restore_checkpoint(fresh, str(tmp_path), stream=0)
+    # without stream= the collection restores normally
+    assert ckpt.restore_checkpoint(fresh, str(tmp_path)) == 0
+
+
+# ------------------------------------------ topology change along the fleet axis
+
+
+def _save_two_hosts(metric_builder, feed, tmp_path):
+    """Two per-host (replicated=False) instances of the same fleet metric, fed
+    different data, saved as hosts 0/1 of one step."""
+    hosts = [metric_builder() for _ in range(2)]
+    for h, m in enumerate(hosts):
+        feed(m, h)
+        m.save_checkpoint(
+            str(tmp_path), step=0, replicated=False,
+            process_index=h, process_count=2, generation="gen-t",
+        )
+    return hosts
+
+
+def test_topology_change_sum_rereduces_fleet_axis(tmp_path):
+    ids = jnp.array([0, 0, 1, 1], dtype=jnp.int32)
+
+    def feed(m, h):
+        preds = jnp.asarray([1, 0, 1, 1]) if h == 0 else jnp.asarray([0, 0, 1, 0])
+        target = jnp.ones(4, jnp.int32)
+        m.update(preds, target, stream_ids=ids)
+
+    hosts = _save_two_hosts(lambda: BinaryAccuracy(fleet_size=2), feed, tmp_path)
+    merged = BinaryAccuracy(fleet_size=2)
+    merged.restore_checkpoint(str(tmp_path), process_index=0, process_count=1)
+    # sum states re-reduce elementwise, which along the fleet axis is exactly
+    # per-stream summation — identical to merge_state of the two host fleets
+    ref = hosts[0]
+    ref.merge_state(hosts[1])
+    assert np.array_equal(np.asarray(merged.tp), np.asarray(ref.tp))
+    assert np.array_equal(np.asarray(merged.compute()), np.asarray(ref.compute()))
+
+
+@pytest.mark.parametrize("cls,vals0,vals1,want", [
+    (MaxMetric, [1.0, 5.0], [3.0, 2.0], [3.0, 5.0]),
+    (MinMetric, [1.0, 5.0], [3.0, 2.0], [1.0, 2.0]),
+])
+def test_topology_change_minmax_rereduces_fleet_axis(tmp_path, cls, vals0, vals1, want):
+    ids = jnp.array([0, 1], dtype=jnp.int32)
+
+    def feed(m, h):
+        m.update(jnp.asarray(vals0 if h == 0 else vals1), stream_ids=ids)
+
+    _save_two_hosts(lambda: cls(fleet_size=2), feed, tmp_path)
+    merged = cls(fleet_size=2)
+    merged.restore_checkpoint(str(tmp_path), process_index=0, process_count=1)
+    assert np.array_equal(np.asarray(merged.compute()), np.asarray(want))
+
+
+def test_stream_slice_after_topology_change(tmp_path):
+    """stream= slicing composes with N->M: slice host 0's stream out of a
+    2-host fleet checkpoint restored onto 1 host."""
+    ids = jnp.array([0, 0, 1, 1], dtype=jnp.int32)
+
+    def feed(m, h):
+        preds = jnp.asarray([1, 0, 1, 1]) if h == 0 else jnp.asarray([0, 0, 1, 0])
+        m.update(preds, jnp.ones(4, jnp.int32), stream_ids=ids)
+
+    hosts = _save_two_hosts(lambda: BinaryAccuracy(fleet_size=2), feed, tmp_path)
+    single = BinaryAccuracy()
+    single.restore_checkpoint(str(tmp_path), stream=1, process_index=0, process_count=1)
+    ref = hosts[0]
+    ref.merge_state(hosts[1])
+    assert np.array_equal(np.asarray(single.compute()), np.asarray(ref.compute()[1]))
