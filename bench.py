@@ -1291,13 +1291,93 @@ def bench_san(runs: int = 3) -> dict:
     }
 
 
+def bench_obs_trace(out_path=None, steps: int = 3) -> dict:
+    """``--obs-trace``: one instrumented fused+fleet window exported as a
+    Perfetto/Chrome ``trace_event`` JSON, plus the runtime<->static cost
+    crosscheck (obs/costcheck.py) against ``tmsan_costs.json``.
+
+    Runs the canonical fused collection and a routed fleet metric for a few
+    steps with the full tmprof stack on (flight recorder + health sketches),
+    writes the timeline with ``obs.export_chrome_trace``, validates it against
+    the ``trace_event`` structural schema, and reports launch-count drift. The
+    trace is the only bench mode that times WITH obs on — its purpose is the
+    telemetry itself, not the headline numbers.
+    """
+    import os
+    import tempfile
+
+    from metrics_tpu import obs
+    from metrics_tpu.classification import MulticlassAccuracy
+    from metrics_tpu.core.fused import canonical_collection
+
+    out_path = out_path or os.path.join(tempfile.gettempdir(), "tm-obs-trace.json")
+    prev_enabled = obs.enabled()
+    obs.flight.enable(capacity=4096)
+    obs.health.enable(flush_every=16)
+    obs.REGISTRY.clear()
+    try:
+        n = 1 << 14
+        k1, k2 = jax.random.split(jax.random.PRNGKey(7))
+        preds = jax.random.uniform(k1, (n,), jnp.float32)
+        target = jax.random.randint(k2, (n,), 0, 2, dtype=jnp.int32)
+        coll = canonical_collection(fused=True)
+
+        n_streams, rows = 64, 8
+        k3, k4 = jax.random.split(jax.random.PRNGKey(11))
+        fp = jax.random.randint(k3, (n_streams * rows,), 0, 5, dtype=jnp.int32)
+        ft = jax.random.randint(k4, (n_streams * rows,), 0, 5, dtype=jnp.int32)
+        ids = jnp.repeat(jnp.arange(n_streams, dtype=jnp.int32), rows)
+        fleet = MulticlassAccuracy(
+            num_classes=5, average="micro", validate_args=False, fleet_size=n_streams
+        )
+
+        for _ in range(steps):
+            coll.update(preds, target)
+            fleet.update(fp, ft, stream_ids=ids)
+        jax.block_until_ready(fleet.tp)
+
+        trace_obj = obs.export_chrome_trace(out_path)
+        n_events = obs.validate_chrome_trace(trace_obj)
+        costcheck = obs.costcheck.crosscheck(warn=False)
+        health = obs.health.report()
+    finally:
+        obs.health.disable()
+        obs.flight.disable()
+        if not prev_enabled:
+            obs.disable()
+    tracks = sorted(
+        ev["args"]["name"]
+        for ev in trace_obj["traceEvents"]
+        if ev["ph"] == "M" and ev["name"] == "thread_name"
+    )
+    return {
+        "metric": "obs_trace",
+        "value": n_events,
+        "unit": "trace_events",
+        "vs_baseline": None,
+        "trace_path": out_path,
+        "tracks": tracks,
+        "costcheck": {
+            "version_ok": costcheck["version_ok"],
+            "checked": len(costcheck["checked"]),
+            "drifts": costcheck["drifts"],
+            "amortized": [r["scope"] for r in costcheck["amortized"]],
+            "unbudgeted": costcheck["unbudgeted"],
+            "notes": costcheck["notes"],
+        },
+        "hbm_watermark_bytes": health.get("hbm_watermark_bytes"),
+        "bound": "telemetry config: fused+fleet steps with flight recorder and"
+                 " health sketches on; load trace_path in ui.perfetto.dev",
+    }
+
+
 if __name__ == "__main__":
     import argparse
 
     parser = argparse.ArgumentParser(description="metrics_tpu benchmarks")
     parser.add_argument(
         "--config",
-        choices=("accuracy", "logits", "confmat", "map", "ssim", "retrieval", "auroc", "fid", "fused", "fleet", "sketch", "lint", "all"),
+        choices=("accuracy", "logits", "confmat", "map", "ssim", "retrieval", "auroc", "fid", "fused", "fleet", "sketch", "lint", "obs_trace", "all"),
         default="all",
     )
     parser.add_argument(
@@ -1349,6 +1429,15 @@ if __name__ == "__main__":
         " (also runs under --config all)",
     )
     parser.add_argument(
+        "--obs-trace",
+        action="store_true",
+        help="run one instrumented fused+fleet window with the tmprof stack on"
+        " (flight recorder + health sketches), export it as Perfetto/Chrome"
+        " trace_event JSON (path in the `trace_path` field), and report the"
+        " runtime<->static cost crosscheck against tmsan_costs.json in the"
+        " `costcheck` field",
+    )
+    parser.add_argument(
         "--obs",
         action="store_true",
         help="enable metrics_tpu.obs for the run: timed regions record into the"
@@ -1392,8 +1481,11 @@ if __name__ == "__main__":
         ("ckpt", bench_ckpt),
         ("lint", bench_lint),
         ("san", bench_san),
+        ("obs_trace", bench_obs_trace),
     ):
         if name == "ckpt" and not cli.ckpt:
+            continue
+        if name == "obs_trace" and not (cli.obs_trace or config == "obs_trace"):
             continue
         if name == "fused" and not (cli.fused or config in ("fused", "all")):
             continue
@@ -1405,7 +1497,7 @@ if __name__ == "__main__":
             continue
         if name == "san" and not (cli.san_overhead or config == "all"):
             continue
-        if config in (name, "all") or name in ("ckpt", "fused", "fleet", "sketch", "lint", "san"):
+        if config in (name, "all") or name in ("ckpt", "fused", "fleet", "sketch", "lint", "san", "obs_trace"):
             try:
                 result = fn()
                 summary[result["metric"]] = {

@@ -57,6 +57,7 @@ from metrics_tpu.ckpt.errors import (
     CorruptCheckpointError,
     IncompleteCheckpointError,
 )
+from metrics_tpu.obs import flight as _obs_flight
 from metrics_tpu.obs import registry as _obs
 from metrics_tpu.obs import scopes as _obs_scopes
 
@@ -523,6 +524,10 @@ def save_checkpoint(
         raise CheckpointError(f"checkpoint step {step} already exists in {directory}")
 
     tree, entries = _snapshot(obj, persistent_only)
+    if _obs._ENABLED and _obs_flight._RING is not None:
+        # the post-mortem wants the state layout of whatever was being saved
+        _obs_flight.note_state_source(obj)
+        _obs_flight.record("ckpt_save_begin", step=step, host=rank, blocking=blocking)
     handle = CheckpointWrite(directory, step)
     snap: Optional[_PendingSnapshot] = None
     if not blocking:
@@ -574,6 +579,15 @@ def save_checkpoint(
                     if not _is_committed(final_dir):
                         raise
                     payload_meta = {"nbytes": 0}
+                if _obs_flight.ckpt_integration_active():
+                    # the flight window rides the step dir through the atomic
+                    # commit (dump() is best-effort: a vanished tmp_dir — the
+                    # racing-host rename above — degrades to no dump, not an
+                    # aborted save)
+                    _obs_flight.dump(
+                        os.path.join(tmp_dir, f"flight-h{rank:04d}.json"),
+                        state_objs=[obj],
+                    )
                 committed = _try_commit(directory, tmp_dir, step, world, generation)
                 if committed and retain is not None:
                     _prune(directory, retain)
@@ -582,6 +596,12 @@ def save_checkpoint(
                 _obs.REGISTRY.inc("ckpt", "saves")
                 _obs.REGISTRY.inc("ckpt", "bytes", payload_meta["nbytes"])
                 _obs.REGISTRY.inc("ckpt", "save_ms", elapsed_ms)
+                if _obs_flight._RING is not None:
+                    _obs_flight.record(
+                        "ckpt_save_commit", step=step, host=rank,
+                        committed=committed, nbytes=payload_meta["nbytes"],
+                        elapsed_ms=round(elapsed_ms, 3),
+                    )
             _stamp(obj, last_save_ms=round(elapsed_ms, 3), last_save_step=step,
                    last_save_bytes=payload_meta["nbytes"])
             handle._finish(final_dir, None, committed=committed)
@@ -730,6 +750,11 @@ def restore_checkpoint(
         _obs.REGISTRY.inc("ckpt", "restores")
         _obs.REGISTRY.inc("ckpt", "bytes", bytes_read)
         _obs.REGISTRY.inc("ckpt", "restore_ms", elapsed_ms)
+        if _obs_flight._RING is not None:
+            _obs_flight.record(
+                "ckpt_restore", step=step, nbytes=bytes_read,
+                elapsed_ms=round(elapsed_ms, 3),
+            )
     _stamp(obj, last_restore_ms=round(elapsed_ms, 3), last_restore_step=step,
            last_restore_bytes=bytes_read)
     return step

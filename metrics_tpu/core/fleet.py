@@ -36,6 +36,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from metrics_tpu.obs import flight as _obs_flight
 from metrics_tpu.obs import registry as _obs
 from metrics_tpu.utils.exceptions import MetricsUserError
 
@@ -384,6 +385,14 @@ def apply_update(metric: Any, raw_update: Callable, args: Tuple, kwargs: Dict) -
         if _obs._ENABLED:
             _obs.REGISTRY.inc("fleet", "routed", _batch_rows(dyn))
             _obs.REGISTRY.inc("fleet", "streams", metric.fleet_size)
+            if _obs_flight._RING is not None:
+                _obs_flight.record(
+                    "fleet_route",
+                    metric=type(metric).__name__,
+                    mode="broadcast",
+                    rows=_batch_rows(dyn),
+                    streams=metric.fleet_size,
+                )
     else:
         ids = jnp.asarray(stream_ids)
         if not isinstance(ids, jax.core.Tracer):
@@ -409,6 +418,14 @@ def apply_update(metric: Any, raw_update: Callable, args: Tuple, kwargs: Dict) -
             _obs.REGISTRY.inc("fleet", "routed", int(ids.shape[0]))
             if _is_concrete(ids):
                 _obs.REGISTRY.inc("fleet", "streams", int(np.unique(np.asarray(ids)).size))
+            if _obs_flight._RING is not None:
+                _obs_flight.record(
+                    "fleet_route",
+                    metric=type(metric).__name__,
+                    mode="routed",
+                    rows=int(ids.shape[0]),
+                    streams=metric.fleet_size,
+                )
     metric._load_state(new)
 
 

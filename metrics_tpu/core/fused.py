@@ -54,6 +54,7 @@ import jax.numpy as jnp
 
 from metrics_tpu.core.metric import Metric
 from metrics_tpu.core.state import CatBuffer
+from metrics_tpu.obs import flight as _obs_flight
 from metrics_tpu.obs import recompile as _obs_recompile
 from metrics_tpu.obs import registry as _obs
 from metrics_tpu.obs import scopes as _obs_scopes
@@ -460,6 +461,12 @@ class FusedCollectionUpdate:
                 # level compile storm; reuses the metric retrace detector
                 _obs_recompile.check_update(self, args, kwargs)
                 _obs.REGISTRY.inc("fused", "cache_misses")
+                if _obs_flight._RING is not None:
+                    _obs_flight.record(
+                        "fused_cache_miss",
+                        groups=[name for name, _ in fused],
+                        mode="forward" if forward else "update",
+                    )
             self.stats["cache_misses"] += 1
             fused, demoted = self._probe(collection, fused, states, dyn, split_spec, forward)
             if not fused:
@@ -512,6 +519,13 @@ class FusedCollectionUpdate:
         if _obs._ENABLED:
             _obs.REGISTRY.inc("fused", "launches")
             _obs.REGISTRY.inc("fused", "dispatches")
+            if _obs_flight._RING is not None:
+                _obs_flight.record(
+                    "fused_launch",
+                    groups=[name for name, _ in fused],
+                    mode="forward" if forward else "update",
+                    cache_key=f"{key[0]}:{hash(key) & 0xFFFFFFFF:08x}",
+                )
             with _obs_scopes.annotate("tm.fused/step"):
                 if forward:
                     new_states, results = compiled(states, fresh, dyn)

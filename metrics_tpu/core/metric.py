@@ -40,6 +40,7 @@ import numpy as np
 from jax import Array
 
 from metrics_tpu.core.state import CatBuffer, cat_merge
+from metrics_tpu.obs import flight as _obs_flight
 from metrics_tpu.obs import recompile as _obs_recompile
 from metrics_tpu.obs import registry as _obs
 from metrics_tpu.obs import scopes as _obs_scopes
@@ -454,6 +455,10 @@ class Metric(ABC):
         self._computed = None
         if _obs._ENABLED:
             _obs.REGISTRY.inc(type(self).__name__, "merges")
+            if _obs_flight._RING is not None:
+                _obs_flight.record(
+                    "merge", metric=type(self).__name__, incoming_updates=incoming_count
+                )
 
     def compute_from(
         self, state: Dict[str, Any], axis_name: Optional[collective.AxisName] = None
@@ -546,6 +551,8 @@ class Metric(ABC):
                 # across scopes measures launches/step (the N->1 claim of
                 # ROADMAP item 4).
                 _obs.REGISTRY.inc(name, "dispatches")
+                if _obs_flight._RING is not None:
+                    _obs_flight.record_dispatch(name, args, kwargs)
                 _obs_recompile.check_update(self, args, kwargs)
                 with _obs_scopes.update_scope(name):
                     run()

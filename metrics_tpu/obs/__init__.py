@@ -12,9 +12,23 @@ Quickstart::
     with obs.trace("/tmp/profile"):   # one-call XProf capture; the trace shows
         eval_step()                   # tm.update/<Metric> and tm.sync/<fx> scopes
 
+tmprof — the production telemetry tier on the same gate::
+
+    obs.flight.enable(dump_path="/tmp/flight.json", install_handlers=True)
+    train()                              # ring of dispatches/launches/retraces/...
+    obs.export_chrome_trace("/tmp/tm-trace.json")   # load in ui.perfetto.dev
+
+    obs.health.enable()                  # latency sketches + HBM watermark
+    obs.health.set_slo(p99_update_latency_ms=5.0)
+    obs.health.check_slos()
+
+    obs.costcheck.crosscheck()           # measured launches vs tmsan_costs.json
+
 Off by default: with obs disabled every instrumented hot path reduces to a
 single boolean check (see ``registry.py``), keeping the library's measured
-throughput identical to the uninstrumented build.
+throughput identical to the uninstrumented build — and none of the tmprof
+surfaces (flight ring, sketches) allocate anything until their own
+``enable()``.
 """
 from metrics_tpu.obs.registry import (
     REGISTRY,
@@ -26,9 +40,21 @@ from metrics_tpu.obs.registry import (
     snapshot,
     snapshot_json,
 )
-from metrics_tpu.obs import recompile, registry
-from metrics_tpu.obs.export import dump_jsonl
+# NOTE import order: the `trace` submodule must bind into the package BEFORE
+# the `from ...scopes import trace` below rebinds the package attribute
+# `obs.trace` to the XProf capture contextmanager (the documented public name).
+# The exporter stays reachable as `obs.export_chrome_trace` / via
+# `metrics_tpu.obs import trace as trace_export`.
+from metrics_tpu.obs import costcheck, flight, health, recompile, registry
+from metrics_tpu.obs import trace as _trace_export
+from metrics_tpu.obs.costcheck import CostDriftWarning, crosscheck
+from metrics_tpu.obs.export import SCHEMA_VERSION, dump_jsonl, validate_snapshot
 from metrics_tpu.obs.export import snapshot as export_snapshot
+from metrics_tpu.obs.health import (
+    SLOBudget,
+    SLOBudgetExceeded,
+    SLOViolationWarning,
+)
 from metrics_tpu.obs.recompile import (
     RETRACE_WARN_THRESHOLD,
     fingerprint,
@@ -44,6 +70,11 @@ from metrics_tpu.obs.scopes import (
     trace,
     update_scope,
 )
+from metrics_tpu.obs.trace import (
+    chrome_trace_events,
+    export_chrome_trace,
+    validate_chrome_trace,
+)
 
 
 def stopwatch(scope: str, name: str = "elapsed"):
@@ -54,17 +85,28 @@ def stopwatch(scope: str, name: str = "elapsed"):
 __all__ = [
     "REGISTRY",
     "RETRACE_WARN_THRESHOLD",
+    "SCHEMA_VERSION",
+    "CostDriftWarning",
     "ObsRegistry",
+    "SLOBudget",
+    "SLOBudgetExceeded",
+    "SLOViolationWarning",
     "annotate",
+    "chrome_trace_events",
     "collection_summary",
     "compute_scope",
+    "costcheck",
+    "crosscheck",
     "disable",
     "dump_jsonl",
     "enable",
     "enabled",
+    "export_chrome_trace",
     "export_snapshot",
     "fingerprint",
+    "flight",
     "forward_scope",
+    "health",
     "metric_state_report",
     "observe",
     "recompile",
@@ -77,4 +119,6 @@ __all__ = [
     "sync_scope",
     "trace",
     "update_scope",
+    "validate_chrome_trace",
+    "validate_snapshot",
 ]

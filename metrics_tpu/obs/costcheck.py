@@ -1,0 +1,196 @@
+"""Runtime↔static cost crosscheck: do tmsan's predictions hold on the hot path?
+
+The analysis tier (``metrics_tpu.analysis.san``) checks a compile-cost budget
+into ``tmsan_costs.json``: for every metric entry at its canonical shape, ONE
+executable per ``update`` call, with XLA-modelled flops/bytes. That is a
+*promise about the runtime* made without running anything — this module closes
+the loop by comparing it against what the obs registry actually measured.
+
+The observable the two tiers share is the **launch count per update**: the
+static model says a budgeted ``<Class>.update`` costs one dispatch per call
+(``dispatches / updates == 1``). A measured ratio above ``1 + tolerance``
+means the runtime is launching more executables per update than the analysis
+tier certified — un-jitted glue, a shape-polymorphic path fanning out, or an
+instrumentation bug — and surfaces as a :class:`CostDriftWarning` plus a
+structured report entry (also embedded in ``bench.py --obs-trace`` output).
+A ratio *below* ``1 - tolerance`` is the good kind of drift (fused/batched
+updates amortizing launches) and is reported as a note, never a warning.
+
+Version skew follows the same policy as ``analysis/san/costs.py``: the budget
+file stamps the jax version/backend it was recorded on; on a mismatch the
+comparison still runs but drift degrades to notes — cross-version behaviour is
+XLA's business, same-version drift is this repo's regression.
+
+Zero-overhead contract: this module measures nothing itself — it only *reads*
+the registry snapshot (and per-scope wall timers when the scope timing added
+by flight/health was active), so with the gate off there is nothing to check
+and :func:`crosscheck` returns an empty report.
+"""
+import os
+import warnings
+from typing import Any, Dict, List, Optional
+
+from metrics_tpu.obs import registry as _reg
+
+#: launch-count drift beyond this fraction of the static model is a warning
+#: (mirrors ``analysis.san.costs.BUDGET_TOLERANCE``)
+DRIFT_TOLERANCE = 0.15
+
+#: registry scopes that are infrastructure, not budgeted metric classes
+_INFRA_SCOPES = frozenset(
+    {"fused", "fleet", "scopes", "bench", "jax", "sync", "ckpt", "collection", "health"}
+)
+
+#: the static model's launches-per-update for a budgeted entry
+_STATIC_LAUNCHES_PER_UPDATE = 1.0
+
+
+class CostDriftWarning(RuntimeWarning):
+    """Measured launch counts drifted past tolerance from tmsan's static model."""
+
+
+def default_costs_path() -> Optional[str]:
+    """``tmsan_costs.json`` at the repo root (the package's parent dir)."""
+    import metrics_tpu
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(metrics_tpu.__file__)))
+    cand = os.path.join(root, "tmsan_costs.json")
+    return cand if os.path.exists(cand) else None
+
+
+def budgeted_classes(payload: Dict[str, Any]) -> Dict[str, int]:
+    """Metric class names with at least one ``<Class>.update[...]`` budget entry,
+    mapped to how many shape variants the budget records for them."""
+    out: Dict[str, int] = {}
+    for key in payload.get("entries", {}):
+        head, _, _ = key.partition("[")
+        cls, dot, op = head.partition(".")
+        if dot and op == "update" and cls and cls[0].isupper():
+            out[cls] = out.get(cls, 0) + 1
+    return out
+
+
+def _scope_wall_s(counters: Dict[str, Any]) -> Optional[float]:
+    """Sum the wall-time timers recorded for a scope (None when none exist)."""
+    total = None
+    for value in counters.values():
+        if isinstance(value, dict) and "total_s" in value:
+            total = (total or 0.0) + float(value["total_s"])
+    return total
+
+
+def crosscheck(
+    costs_path: Optional[str] = None,
+    tolerance: float = DRIFT_TOLERANCE,
+    snapshot: Optional[Dict[str, Dict[str, Any]]] = None,
+    warn: bool = True,
+) -> Dict[str, Any]:
+    """Compare measured launch counts against the static budget; return a report.
+
+    Report layout::
+
+        {"costs_path", "tolerance", "static_jax", "version_ok",
+         "checked":   [{scope, updates, dispatches, launches_per_update,
+                        wall_s?}, ...],
+         "drifts":    [same rows, measured > 1 + tolerance],
+         "amortized": [same rows, measured < 1 - tolerance],
+         "unbudgeted": [scopes measured but absent from the budget],
+         "notes": [...]}
+
+    ``warn=True`` raises one :class:`CostDriftWarning` naming every drifted
+    scope (suppressed to a note on jax version/backend skew).
+    """
+    report: Dict[str, Any] = {
+        "costs_path": None,
+        "tolerance": tolerance,
+        "static_jax": None,
+        "version_ok": None,
+        "checked": [],
+        "drifts": [],
+        "amortized": [],
+        "unbudgeted": [],
+        "notes": [],
+    }
+    path = costs_path or default_costs_path()
+    if path is None or not os.path.exists(path):
+        report["notes"].append(
+            "tmsan_costs.json not found: run `python -m metrics_tpu.analysis --san"
+            " --write-costs` to record the static budget"
+        )
+        return report
+    from metrics_tpu.analysis.san.costs import load_costs
+
+    try:
+        payload = load_costs(path)
+    except Exception as exc:  # noqa: BLE001 — a broken budget file is a note, not a crash
+        report["notes"].append(f"failed to load {path}: {exc}")
+        return report
+    report["costs_path"] = path
+    report["static_jax"] = f"{payload.get('jax')}/{payload.get('backend')}"
+
+    import jax
+
+    version_ok = payload.get("jax") == jax.__version__ and (
+        payload.get("backend") == jax.default_backend()
+    )
+    report["version_ok"] = bool(version_ok)
+    if not version_ok:
+        report["notes"].append(
+            f"budget recorded on jax={payload.get('jax')}/{payload.get('backend')}"
+            f" but running jax={jax.__version__}/{jax.default_backend()}:"
+            " drift reported as notes, not warnings"
+        )
+
+    budget = budgeted_classes(payload)
+    snap = snapshot if snapshot is not None else _reg.snapshot()
+
+    for scope in sorted(snap):
+        if scope in _INFRA_SCOPES:
+            continue
+        counters = snap[scope]
+        updates = counters.get("updates")
+        if not isinstance(updates, (int, float)) or updates <= 0:
+            continue
+        dispatches = counters.get("dispatches", 0)
+        if not isinstance(dispatches, (int, float)):
+            continue
+        if scope not in budget:
+            report["unbudgeted"].append(scope)
+            continue
+        row: Dict[str, Any] = {
+            "scope": scope,
+            "updates": int(updates),
+            "dispatches": int(dispatches),
+            "launches_per_update": round(dispatches / updates, 4),
+            "static_launches_per_update": _STATIC_LAUNCHES_PER_UPDATE,
+            "budget_variants": budget[scope],
+        }
+        wall = _scope_wall_s(counters)
+        if wall is not None:
+            row["wall_s"] = round(wall, 6)
+        ratio = dispatches / updates
+        if ratio > _STATIC_LAUNCHES_PER_UPDATE * (1.0 + tolerance):
+            report["drifts"].append(row)
+        elif ratio < _STATIC_LAUNCHES_PER_UPDATE * (1.0 - tolerance):
+            report["amortized"].append(row)
+        else:
+            report["checked"].append(row)
+
+    if report["drifts"]:
+        msg = "; ".join(
+            f"{r['scope']}: {r['launches_per_update']:.2f} launches/update"
+            f" vs static {_STATIC_LAUNCHES_PER_UPDATE:.2f}"
+            f" (+{(r['launches_per_update'] / _STATIC_LAUNCHES_PER_UPDATE - 1) * 100:.0f}%)"
+            for r in report["drifts"]
+        )
+        text = (
+            f"runtime launch counts drifted past the +{tolerance * 100:.0f}% static"
+            f" budget from {os.path.basename(path)} — {msg}. The serving path is"
+            " launching more executables per update than tmsan certified; fix the"
+            " dispatch regression or refresh the budget with an explanation."
+        )
+        if warn and version_ok:
+            warnings.warn(text, CostDriftWarning, stacklevel=2)
+        else:
+            report["notes"].append(text)
+    return report

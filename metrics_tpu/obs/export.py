@@ -3,6 +3,21 @@
 One registry snapshot == one JSON line, so a long-running eval can append a line
 per epoch and the file stays grep/pandas-friendly. ``bench.py`` embeds the same
 snapshot dict in its recorded JSON lines.
+
+Line contract (``export_schema.json`` next to this module is the normative
+JSON-schema copy; :func:`validate_snapshot` is the dependency-free validator
+tests and CI run against it):
+
+- ``schema_version``: integer stamp, bumped on breaking layout changes so
+  downstream dashboards can evolve safely. Version history: 1 = the original
+  ``{enabled, registry}`` pair; 2 added ``schema_version`` + ``enabled_now``
+  and fixed ``enabled`` to describe the *recorded* counters.
+- ``enabled``: the gate state in effect for the counters in this line. A
+  scoped ``observe()`` window that recorded counters and then exited leaves
+  the instantaneous gate off while the snapshot is full of enabled-mode data —
+  ``enabled`` reports True for that line (BENCH_r07 reported False there).
+- ``enabled_now``: the instantaneous gate at export time.
+- ``registry``: ``{scope: {name: number | {count, total_s, max_s}}}``.
 """
 import json
 import time
@@ -10,10 +25,19 @@ from typing import Any, Dict, Optional
 
 from metrics_tpu.obs import registry as _reg
 
+#: current layout stamp of exported lines (see module docstring for history)
+SCHEMA_VERSION = 2
+
 
 def snapshot(extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
     """Registry contents as one JSON-serializable dict (plus caller extras)."""
-    out: Dict[str, Any] = {"enabled": _reg.enabled(), "registry": _reg.snapshot()}
+    enabled_now = _reg.enabled()
+    out: Dict[str, Any] = {
+        "schema_version": SCHEMA_VERSION,
+        "enabled": enabled_now or _reg.REGISTRY.recorded(),
+        "enabled_now": enabled_now,
+        "registry": _reg.snapshot(),
+    }
     if extra:
         out.update(extra)
     return out
@@ -26,3 +50,47 @@ def dump_jsonl(path: str, extra: Optional[Dict[str, Any]] = None, clock: Any = t
     with open(path, "a") as fh:
         fh.write(json.dumps(record, sort_keys=True, default=str) + "\n")
     return record
+
+
+def validate_snapshot(record: Dict[str, Any]) -> None:
+    """Validate one exported line against the schema; raises ``ValueError``.
+
+    Dependency-free mirror of ``export_schema.json`` so the check runs in CI
+    without ``jsonschema`` installed.
+    """
+    if not isinstance(record, dict):
+        raise ValueError("snapshot line must be a JSON object")
+    sv = record.get("schema_version")
+    if not isinstance(sv, int) or isinstance(sv, bool) or sv < 1:
+        raise ValueError(f"schema_version must be a positive integer, got {sv!r}")
+    for field in ("enabled", "enabled_now"):
+        if not isinstance(record.get(field), bool):
+            raise ValueError(f"`{field}` must be a boolean, got {record.get(field)!r}")
+    reg = record.get("registry")
+    if not isinstance(reg, dict):
+        raise ValueError("`registry` must be an object")
+    for scope, counters in reg.items():
+        if not isinstance(counters, dict):
+            raise ValueError(f"registry[{scope!r}] must be an object")
+        for name, value in counters.items():
+            if isinstance(value, bool):
+                raise ValueError(f"registry[{scope!r}][{name!r}] must be numeric")
+            if isinstance(value, (int, float)):
+                continue
+            if isinstance(value, dict):
+                missing = {"count", "total_s", "max_s"} - set(value)
+                if missing or not all(
+                    isinstance(value[k], (int, float)) and not isinstance(value[k], bool)
+                    for k in ("count", "total_s", "max_s")
+                ):
+                    raise ValueError(
+                        f"registry[{scope!r}][{name!r}] timer must carry numeric"
+                        f" count/total_s/max_s, got {value!r}"
+                    )
+                continue
+            raise ValueError(
+                f"registry[{scope!r}][{name!r}] must be a number or timer object,"
+                f" got {type(value).__name__}"
+            )
+    if "time_unix" in record and not isinstance(record["time_unix"], (int, float)):
+        raise ValueError("`time_unix` must be numeric when present")

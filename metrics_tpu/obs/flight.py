@@ -1,0 +1,328 @@
+"""Flight recorder: a bounded ring of structured step events, dumped on failure.
+
+A metrics stack that dies on a preempted TPU slice leaves nothing behind but an
+exit code; the questions that matter — *what was the last fused launch? did the
+checkpoint commit? was the job mid-retrace-storm?* — need the last few hundred
+runtime events, not a profiler session that was never started. The flight
+recorder keeps exactly that: a fixed-capacity ring (``collections.deque``) of
+small structured events appended by the instrumented hot paths, and a
+``dump()`` that writes the surviving window (plus ``state_report()`` snapshots
+of recently-checkpointed metrics) as one JSON file.
+
+Event kinds emitted by the runtime (all behind the obs gate):
+
+    ``dispatch``          one eager metric update dispatch (metric, input avals)
+    ``scope``             one timed ``tm.*`` scope (name, ts_us, dur_us)
+    ``retrace``           a metric accumulated a new update signature
+    ``fused_launch``      one fused-collection XLA launch (groups, cache key)
+    ``fused_cache_miss``  the fused engine compiled a new executable
+    ``fleet_route``       one routed fleet batch (rows, streams)
+    ``merge``             one ``merge_state`` (sketch merges ride this hook)
+    ``ckpt_save_begin`` / ``ckpt_save_commit`` / ``ckpt_restore``
+
+Gating contract (the single-boolean rule of ``registry.py``): every call site
+lives inside an existing ``if registry._ENABLED:`` block and additionally
+checks ``flight._RING is not None`` before touching this module — with obs off
+the recorder costs nothing, and **no ring storage exists until**
+:func:`enable` allocates it (disabled-mode no-allocation guarantee, verified
+by ``tests/unittests/obs/test_tmprof.py``).
+
+Dump-on-failure: :func:`enable` can install an ``atexit`` hook and chain-
+preserving ``signal`` handlers (SIGTERM by default — the preemption notice) so
+the last-K events survive a kill at any point, including between an update and
+its checkpoint commit. The opt-in ``ckpt_integration`` additionally writes the
+dump *into* each checkpoint's tmp dir before the atomic commit, so every
+committed step carries the flight window that produced it.
+"""
+import atexit
+import itertools
+import json
+import os
+import signal as _signal
+import threading
+import time
+import weakref
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+#: schema stamp of the dump file (bump on breaking layout changes)
+DUMP_SCHEMA_VERSION = 1
+
+#: the ring itself. ``None`` == recorder off == nothing allocated; hot paths
+#: gate on ``_RING is not None`` (one module-attribute load + identity check).
+_RING: Optional[deque] = None
+
+_SEQ = itertools.count()
+_LOCK = threading.Lock()
+
+#: configuration captured by :func:`enable`
+_DUMP_PATH: Optional[str] = None
+_CKPT_INTEGRATION: bool = False
+_CAPACITY: int = 0
+
+#: weakrefs to objects whose ``state_report()``/``summary()`` rides every dump
+#: (registered by ``ckpt.save_checkpoint`` — the post-mortem wants the state
+#: layout of whatever was being checkpointed)
+_STATE_SOURCES: "List[weakref.ref]" = []
+
+#: previously-installed signal handlers, for chaining + uninstall
+_PREV_HANDLERS: Dict[int, Any] = {}
+_ATEXIT_REGISTERED = False
+
+
+def _now_us() -> float:
+    """Monotonic microsecond timebase shared with the trace exporter."""
+    return time.perf_counter() * 1e6
+
+
+def enable(
+    capacity: int = 512,
+    dump_path: Optional[str] = None,
+    install_handlers: bool = False,
+    signals: Tuple[int, ...] = (_signal.SIGTERM,),
+    ckpt_integration: bool = False,
+    enable_obs: bool = True,
+) -> None:
+    """Allocate the ring and start recording.
+
+    Args:
+        capacity: events retained (the "last K" of every dump).
+        dump_path: where crash dumps go; required for ``install_handlers``.
+        install_handlers: register an ``atexit`` hook plus chaining handlers on
+            ``signals`` that write ``dump_path`` before the process dies — the
+            preemption post-mortem. Handlers forward to whatever was installed
+            before them (or re-deliver the signal with the default action, so
+            the exit status stays honest).
+        signals: which signals to hook (default SIGTERM, the preemption
+            notice; add SIGINT for interactive runs).
+        ckpt_integration: opt-in — every ``ckpt.save_checkpoint`` also writes
+            the current window as ``flight-h<rank>.json`` inside the step dir,
+            committed atomically with the checkpoint itself.
+        enable_obs: flight events are only emitted from obs-gated call sites,
+            so by default this flips the obs gate on too.
+    """
+    global _RING, _DUMP_PATH, _CKPT_INTEGRATION, _CAPACITY
+    if capacity < 1:
+        raise ValueError(f"flight recorder capacity must be >= 1, got {capacity}")
+    with _LOCK:
+        _RING = deque(maxlen=capacity)
+        _CAPACITY = capacity
+        _DUMP_PATH = dump_path
+        _CKPT_INTEGRATION = bool(ckpt_integration)
+    if enable_obs:
+        from metrics_tpu.obs import registry as _reg
+
+        _reg.enable()
+    if install_handlers:
+        if dump_path is None:
+            raise ValueError("install_handlers=True requires dump_path")
+        _install_handlers(signals)
+
+
+def disable() -> None:
+    """Stop recording and free the ring; uninstalls any crash handlers."""
+    global _RING, _DUMP_PATH, _CKPT_INTEGRATION
+    _uninstall_handlers()
+    with _LOCK:
+        _RING = None
+        _DUMP_PATH = None
+        _CKPT_INTEGRATION = False
+        _STATE_SOURCES.clear()
+
+
+def active() -> bool:
+    return _RING is not None
+
+
+def ckpt_integration_active() -> bool:
+    return _RING is not None and _CKPT_INTEGRATION
+
+
+def capacity() -> int:
+    return _CAPACITY if _RING is not None else 0
+
+
+# -------------------------------------------------------------- recording
+
+
+def record(kind: str, ts_us: Optional[float] = None, **fields: Any) -> None:
+    """Append one event; no-op when the recorder is off.
+
+    Events are plain dicts ``{seq, ts_us, kind, **fields}`` — ``seq`` is a
+    process-global monotone counter so dumps are orderable even if two threads
+    land the same microsecond; ``ts_us`` is the ``perf_counter`` microsecond
+    timebase the Perfetto exporter (``obs/trace.py``) uses directly.
+    """
+    ring = _RING
+    if ring is None:
+        return
+    event = {"seq": next(_SEQ), "ts_us": _now_us() if ts_us is None else ts_us, "kind": kind}
+    event.update(fields)
+    ring.append(event)  # deque.append with maxlen is atomic under the GIL
+
+
+def _aval_str(x: Any) -> str:
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        return "x".join(map(str, shape)) + f":{dtype}"
+    if isinstance(x, (bool, int, float)):
+        return f"py:{type(x).__name__}={x}"
+    return type(x).__name__
+
+
+def record_dispatch(metric_name: str, args: Tuple, kwargs: Dict) -> None:
+    """One eager update dispatch, args summarized as avals (never values)."""
+    if _RING is None:
+        return
+    avals = [_aval_str(a) for a in args]
+    avals += [f"{k}={_aval_str(v)}" for k, v in kwargs.items()]
+    record("dispatch", metric=metric_name, avals=avals)
+
+
+def events() -> List[Dict[str, Any]]:
+    """Snapshot of the current window, oldest first.
+
+    ``deque.append`` is atomic under the GIL but iterating a deque while
+    another thread appends can raise ``RuntimeError`` — retry rather than
+    locking the hot-path append.
+    """
+    ring = _RING
+    if ring is None:
+        return []
+    for _ in range(8):
+        try:
+            return list(ring)
+        except RuntimeError:
+            continue
+    return list(ring)
+
+
+def last(k: int) -> List[Dict[str, Any]]:
+    return events()[-k:]
+
+
+def clear() -> None:
+    ring = _RING
+    if ring is not None:
+        ring.clear()
+
+
+# ------------------------------------------------------- state-report riders
+
+
+def note_state_source(obj: Any) -> None:
+    """Remember ``obj`` (weakly) so its state report rides future dumps."""
+    if _RING is None:
+        return
+    with _LOCK:
+        refs = [r for r in _STATE_SOURCES if r() is not None and r() is not obj]
+        refs.append(weakref.ref(obj))
+        del _STATE_SOURCES[:]
+        _STATE_SOURCES.extend(refs[-8:])  # the post-mortem needs recent, not all
+
+
+def _state_reports() -> List[Dict[str, Any]]:
+    out = []
+    with _LOCK:
+        objs = [r() for r in _STATE_SOURCES]
+    for obj in objs:
+        if obj is None:
+            continue
+        try:
+            if hasattr(obj, "state_report"):
+                out.append(obj.state_report())
+            elif hasattr(obj, "summary"):
+                out.append(obj.summary())
+        except Exception:  # noqa: BLE001 — a post-mortem must never throw
+            continue
+    return out
+
+
+# --------------------------------------------------------------------- dump
+
+
+def dump(path: Optional[str] = None, state_objs: Optional[List[Any]] = None) -> Optional[str]:
+    """Write the surviving window as one JSON file; returns the path.
+
+    The dump is self-contained: schema stamp, wall-clock anchor (so ``ts_us``
+    offsets translate to absolute time), capacity, the events oldest-first,
+    and ``state_report()`` snapshots of the registered state sources (plus any
+    ``state_objs`` passed explicitly — the ckpt integration passes the object
+    being saved). Best-effort by design: returns ``None`` instead of raising
+    when the recorder is off or the write fails mid-crash.
+    """
+    path = path or _DUMP_PATH
+    ring = _RING
+    if ring is None or path is None:
+        return None
+    reports = _state_reports()
+    for obj in state_objs or ():
+        try:
+            if hasattr(obj, "state_report"):
+                reports.append(obj.state_report())
+            elif hasattr(obj, "summary"):
+                reports.append(obj.summary())
+        except Exception:  # noqa: BLE001
+            pass
+    payload = {
+        "schema_version": DUMP_SCHEMA_VERSION,
+        "dumped_unix": time.time(),
+        "anchor_us": _now_us(),
+        "capacity": _CAPACITY,
+        "events": events(),
+        "state_reports": reports,
+    }
+    try:
+        tmp = f"{path}.tmp-{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(payload, fh, default=str)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+        return path
+    except Exception:  # noqa: BLE001 — a failing dump must not mask the crash
+        return None
+
+
+# -------------------------------------------------------- failure handlers
+
+
+def _on_exit() -> None:
+    if _RING is not None and _DUMP_PATH is not None:
+        dump()
+
+
+def _on_signal(signum: int, frame: Any) -> None:
+    dump()
+    prev = _PREV_HANDLERS.get(signum)
+    if callable(prev):
+        prev(signum, frame)
+        return
+    # previous handler was the default (or SIG_IGN): restore it and re-deliver
+    # so the process dies with the honest signal exit status
+    _signal.signal(signum, prev if prev is not None else _signal.SIG_DFL)
+    os.kill(os.getpid(), signum)
+
+
+def _install_handlers(signals: Tuple[int, ...]) -> None:
+    global _ATEXIT_REGISTERED
+    if not _ATEXIT_REGISTERED:
+        atexit.register(_on_exit)
+        _ATEXIT_REGISTERED = True
+    for signum in signals:
+        if signum in _PREV_HANDLERS:
+            continue
+        try:
+            _PREV_HANDLERS[signum] = _signal.signal(signum, _on_signal)
+        except (ValueError, OSError):  # non-main thread / unsupported signal
+            continue
+
+
+def _uninstall_handlers() -> None:
+    for signum, prev in list(_PREV_HANDLERS.items()):
+        try:
+            _signal.signal(signum, prev if prev is not None else _signal.SIG_DFL)
+        except (ValueError, OSError):
+            pass
+        _PREV_HANDLERS.pop(signum, None)

@@ -1,0 +1,395 @@
+"""Self-telemetry: latency percentiles, HBM watermark, declarative SLO budgets.
+
+The obs registry answers "how many"; this module answers "how slow, at what
+tail". It dogfoods the repo's own :class:`~metrics_tpu.sketches.QuantileSketch`
+— per ``(op, metric)`` update/compute latency lands in a mergeable DDSketch
+with O(1) state (~16 KB per tracked key) instead of an unbounded list, so a
+week-long serving job holds the same memory as a minute-long one and the
+percentiles carry the sketch's relative-error certificate.
+
+Recording path: ``obs/scopes.py`` times every ``tm.*`` window when a monitor
+is active and feeds :meth:`HealthMonitor.observe_scope`. Observations buffer
+in plain Python lists and flush into the sketch in **fixed-size batches**
+(``flush_every``), for two reasons: one vectorized sketch update per batch
+instead of one XLA dispatch per metric update, and a *constant* batch shape so
+the self-telemetry never triggers the retrace detector it lives next to
+(residual flushes pad with NaN — the sketch counts NaNs outside the ranks by
+construction). While flushing, the obs gate is suppressed so self-telemetry
+never pollutes the counters, scopes, flight ring, or its own latency stream.
+
+The HBM watermark samples ``device.memory_stats()['bytes_in_use']`` (TPU
+backends; CPU reports nothing) every ``hbm_sample_every`` observations, plus
+any explicit :func:`observe_state_bytes` calls, and keeps the max.
+
+SLO budgets are declarative: :func:`set_slo` names the budget, \
+:func:`check_slos` evaluates it against the registry/sketches and reacts per
+the configured action (``"warn"`` → :class:`SLOViolationWarning`, ``"raise"``
+→ :class:`SLOBudgetExceeded`, or any callable receiving the violation list).
+
+Zero-overhead contract: module global ``_MONITOR`` stays ``None`` until
+:func:`enable` — no sketches, no buffers, no budgets are allocated before
+then, and the instrumented paths check ``_MONITOR is not None`` from inside
+existing ``registry._ENABLED`` blocks only.
+"""
+import threading
+import time
+import warnings
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+from metrics_tpu.obs import registry as _reg
+
+_MONITOR: Optional["HealthMonitor"] = None
+
+#: scope ops whose latency is sketched per metric
+_TRACKED_OPS = ("update", "compute", "forward", "fused")
+
+
+class SLOViolationWarning(RuntimeWarning):
+    """Named warning for a breached SLO budget (action="warn")."""
+
+
+class SLOBudgetExceeded(RuntimeError):
+    """Raised for a breached SLO budget when action="raise"."""
+
+
+class SLOBudget:
+    """One declarative service-level budget.
+
+    Args:
+        max_launches_per_step: ceiling on XLA launches per step, measured off
+            the summed ``dispatches`` counters (requires ``steps`` at check
+            time).
+        max_retraces_per_window: ceiling on retrace events (instance retraces
+            + class-level signature churn) accumulated since the last check —
+            each ``check_slos`` call closes one window.
+        p99_update_latency_ms: ceiling on any single metric's p99 update
+            latency, from the health sketches.
+        action: ``"warn"`` | ``"raise"`` | callable(list_of_violations).
+    """
+
+    def __init__(
+        self,
+        max_launches_per_step: Optional[float] = None,
+        max_retraces_per_window: Optional[int] = None,
+        p99_update_latency_ms: Optional[float] = None,
+        action: Union[str, Callable[[List[Dict[str, Any]]], None]] = "warn",
+    ) -> None:
+        if isinstance(action, str) and action not in ("warn", "raise"):
+            raise ValueError(f"SLO action must be 'warn', 'raise' or a callable, got {action!r}")
+        self.max_launches_per_step = max_launches_per_step
+        self.max_retraces_per_window = max_retraces_per_window
+        self.p99_update_latency_ms = p99_update_latency_ms
+        self.action = action
+
+
+class HealthMonitor:
+    """Latency sketches + HBM watermark + SLO state (see module docstring)."""
+
+    def __init__(
+        self,
+        flush_every: int = 256,
+        relative_error: float = 0.01,
+        quantiles: Tuple[float, ...] = (0.5, 0.9, 0.99),
+        hbm_sample_every: int = 64,
+    ) -> None:
+        if flush_every < 1:
+            raise ValueError(f"flush_every must be >= 1, got {flush_every}")
+        self.flush_every = int(flush_every)
+        self.relative_error = float(relative_error)
+        self.quantiles = tuple(float(q) for q in quantiles)
+        if 0.99 not in self.quantiles:
+            self.quantiles = self.quantiles + (0.99,)
+        self.hbm_sample_every = int(hbm_sample_every)
+        self._lock = threading.RLock()
+        self._in_self = False  # reentrancy guard: sketch updates re-enter scopes
+        # key -> (sketch instance, state pytree, observation count)
+        self._sketches: Dict[Tuple[str, str], List[Any]] = {}
+        self._buffers: Dict[Tuple[str, str], List[float]] = {}
+        self._obs_count = 0
+        self.hbm_watermark_bytes: Optional[int] = None
+        self.budget: Optional[SLOBudget] = None
+        self._window_base: Dict[str, float] = {}
+        self._mark_window()
+
+    # ------------------------------------------------------------ recording
+
+    def observe_scope(self, label: str, seconds: float) -> None:
+        """One timed ``tm.*`` window; called from ``obs/scopes.py``."""
+        if self._in_self or not label.startswith("tm."):
+            return
+        body = label[3:]
+        op, _, owner = body.partition("/")
+        if op not in _TRACKED_OPS:
+            return
+        self.observe_latency(op, owner or op, seconds)
+
+    def observe_latency(self, op: str, name: str, seconds: float) -> None:
+        if self._in_self:
+            return
+        key = (op, name)
+        with self._lock:
+            if self._in_self:
+                return
+            buf = self._buffers.setdefault(key, [])
+            buf.append(seconds * 1e6)  # sketch in microseconds
+            self._obs_count += 1
+            sample_hbm = self._obs_count % self.hbm_sample_every == 0
+            flush = len(buf) >= self.flush_every
+            if flush:
+                self._flush_locked(key)
+        if sample_hbm:
+            self._sample_hbm()
+
+    def _sketch_for(self, key: Tuple[str, str]) -> List[Any]:
+        entry = self._sketches.get(key)
+        if entry is None:
+            from metrics_tpu.sketches import QuantileSketch
+
+            sk = QuantileSketch(
+                relative_error=self.relative_error,
+                quantiles=self.quantiles,
+                min_value=1e-3,  # 1 nanosecond, in µs units
+            )
+            entry = self._sketches[key] = [sk, sk.init_state(), 0]
+        return entry
+
+    def _flush_locked(self, key: Tuple[str, str]) -> None:
+        """Fold the buffered batch into the sketch — fixed shape, obs-gated off.
+
+        Pads the residual with NaN so every flush compiles against ONE batch
+        shape (NaNs are tallied outside the quantile ranks by the sketch).
+        """
+        buf = self._buffers.get(key)
+        if not buf:
+            return
+        import jax.numpy as jnp
+
+        batch = buf[: self.flush_every]
+        n = len(batch)
+        if n < self.flush_every:
+            batch = batch + [float("nan")] * (self.flush_every - n)
+        del buf[:n]
+        entry = self._sketch_for(key)
+        sk, state, count = entry
+        prev = _reg._ENABLED
+        _reg._ENABLED = False  # self-telemetry must not observe itself
+        self._in_self = True
+        try:
+            entry[1] = sk.local_update(state, jnp.asarray(batch, jnp.float32))
+            entry[2] = count + n
+        finally:
+            self._in_self = False
+            _reg._ENABLED = prev
+
+    def _sample_hbm(self) -> None:
+        try:
+            import jax
+
+            stats = jax.local_devices()[0].memory_stats()
+            bytes_in_use = (stats or {}).get("bytes_in_use")
+        except Exception:  # noqa: BLE001 — backends without memory stats
+            bytes_in_use = None
+        if bytes_in_use is not None:
+            self.note_hbm(int(bytes_in_use))
+
+    def note_hbm(self, nbytes: int) -> None:
+        with self._lock:
+            if self.hbm_watermark_bytes is None or nbytes > self.hbm_watermark_bytes:
+                self.hbm_watermark_bytes = int(nbytes)
+
+    # ------------------------------------------------------------ reporting
+
+    def report(self) -> Dict[str, Any]:
+        """Flush residuals and return percentiles + watermark as one dict."""
+        with self._lock:
+            for key in list(self._buffers):
+                self._flush_locked(key)
+            latency: Dict[str, Any] = {}
+            prev = _reg._ENABLED
+            _reg._ENABLED = False
+            self._in_self = True
+            try:
+                for (op, name), (sk, state, count) in sorted(self._sketches.items()):
+                    if count == 0:
+                        continue
+                    out = sk.compute_from(state)
+                    row = {"count": int(count)}
+                    for q, v, c in zip(
+                        sk.quantiles, out["quantiles"].tolist(), out["certified"].tolist()
+                    ):
+                        row[f"p{round(q * 100):d}_us"] = round(float(v), 3)
+                        row[f"p{round(q * 100):d}_certified"] = bool(c)
+                    latency[f"{op}/{name}"] = row
+            finally:
+                self._in_self = False
+                _reg._ENABLED = prev
+            return {
+                "latency_us": latency,
+                "hbm_watermark_bytes": self.hbm_watermark_bytes,
+                "relative_error": self.relative_error,
+                "flush_every": self.flush_every,
+            }
+
+    # ------------------------------------------------------------------ SLO
+
+    def _mark_window(self) -> None:
+        snap = _reg.snapshot()
+        total = 0.0
+        for counters in snap.values():
+            for name in ("retraces", "retrace_signatures"):
+                v = counters.get(name)
+                if isinstance(v, (int, float)):
+                    total += v
+        self._window_base = {"retraces": total, "t": time.time()}
+
+    def check_slos(self, steps: Optional[int] = None) -> List[Dict[str, Any]]:
+        """Evaluate the configured budget; returns (and reacts to) violations.
+
+        Each call closes the retrace window — the next check counts retraces
+        accumulated from now.
+        """
+        budget = self.budget
+        if budget is None:
+            return []
+        violations: List[Dict[str, Any]] = []
+        snap = _reg.snapshot()
+
+        if budget.max_launches_per_step is not None and steps:
+            launches = sum(
+                counters.get("dispatches", 0)
+                for counters in snap.values()
+                if isinstance(counters.get("dispatches", 0), (int, float))
+            )
+            per_step = launches / steps
+            if per_step > budget.max_launches_per_step:
+                violations.append(
+                    {
+                        "slo": "max_launches_per_step",
+                        "budget": budget.max_launches_per_step,
+                        "measured": per_step,
+                        "detail": f"{launches:.0f} launches over {steps} steps",
+                    }
+                )
+
+        if budget.max_retraces_per_window is not None:
+            total = 0.0
+            for counters in snap.values():
+                for name in ("retraces", "retrace_signatures"):
+                    v = counters.get(name)
+                    if isinstance(v, (int, float)):
+                        total += v
+            window = total - self._window_base.get("retraces", 0.0)
+            if window > budget.max_retraces_per_window:
+                violations.append(
+                    {
+                        "slo": "max_retraces_per_window",
+                        "budget": budget.max_retraces_per_window,
+                        "measured": window,
+                        "detail": f"window opened {time.time() - self._window_base['t']:.1f}s ago",
+                    }
+                )
+            self._mark_window()
+
+        if budget.p99_update_latency_ms is not None:
+            latency = self.report()["latency_us"]
+            for key, row in latency.items():
+                if not key.startswith("update/"):
+                    continue
+                p99_ms = row.get("p99_us", float("nan")) / 1000.0
+                if p99_ms > budget.p99_update_latency_ms:
+                    violations.append(
+                        {
+                            "slo": "p99_update_latency_ms",
+                            "budget": budget.p99_update_latency_ms,
+                            "measured": round(p99_ms, 4),
+                            "detail": f"metric {key.split('/', 1)[1]}"
+                            + ("" if row.get("p99_certified") else " (uncertified edge-bin rank)"),
+                        }
+                    )
+
+        if violations:
+            self._react(budget, violations)
+        return violations
+
+    @staticmethod
+    def _react(budget: SLOBudget, violations: List[Dict[str, Any]]) -> None:
+        if callable(budget.action):
+            budget.action(violations)
+            return
+        msg = "; ".join(
+            f"{v['slo']}: measured {v['measured']} > budget {v['budget']} ({v['detail']})"
+            for v in violations
+        )
+        if budget.action == "raise":
+            raise SLOBudgetExceeded(f"metrics_tpu.obs.health SLO breached — {msg}")
+        warnings.warn(
+            f"metrics_tpu.obs.health SLO breached — {msg}",
+            SLOViolationWarning,
+            stacklevel=3,
+        )
+
+
+# ----------------------------------------------------------- module facade
+
+
+def enable(
+    flush_every: int = 256,
+    relative_error: float = 0.01,
+    hbm_sample_every: int = 64,
+    enable_obs: bool = True,
+) -> "HealthMonitor":
+    """Allocate and activate the monitor (idempotent: replaces any previous)."""
+    global _MONITOR
+    _MONITOR = HealthMonitor(
+        flush_every=flush_every,
+        relative_error=relative_error,
+        hbm_sample_every=hbm_sample_every,
+    )
+    if enable_obs:
+        _reg.enable()
+    return _MONITOR
+
+
+def disable() -> None:
+    global _MONITOR
+    _MONITOR = None
+
+
+def active() -> bool:
+    return _MONITOR is not None
+
+
+def monitor() -> Optional["HealthMonitor"]:
+    return _MONITOR
+
+
+def set_slo(**kwargs: Any) -> SLOBudget:
+    """Declare the SLO budget on the active monitor (see :class:`SLOBudget`)."""
+    if _MONITOR is None:
+        raise RuntimeError("obs.health.set_slo requires an enabled monitor (health.enable())")
+    budget = kwargs.pop("budget", None)
+    if budget is None:
+        budget = SLOBudget(**kwargs)
+    _MONITOR.budget = budget
+    _MONITOR._mark_window()
+    return budget
+
+
+def check_slos(steps: Optional[int] = None) -> List[Dict[str, Any]]:
+    return _MONITOR.check_slos(steps=steps) if _MONITOR is not None else []
+
+
+def report() -> Dict[str, Any]:
+    return _MONITOR.report() if _MONITOR is not None else {}
+
+
+def observe_state_bytes(metric: Any) -> None:
+    """Explicitly fold a metric's registered-state bytes into the watermark —
+    the deterministic fallback for backends without ``memory_stats`` (CPU)."""
+    if _MONITOR is None:
+        return
+    try:
+        nbytes = metric.state_report()["total_nbytes"]
+    except Exception:  # noqa: BLE001
+        return
+    _MONITOR.note_hbm(int(nbytes))

@@ -13,11 +13,13 @@ rate-limited warning (once per instance) names the offending metric and the
 fingerprints seen. ``jax.monitoring`` compile events, when available, are
 counted alongside (``registry._register_compile_listener``) as corroboration.
 """
+import threading
 import warnings
 from typing import Any, Tuple
 
 import numpy as np
 
+from metrics_tpu.obs import flight as _flight
 from metrics_tpu.obs import registry as _reg
 
 #: Distinct input fingerprints at which a metric is declared "storming".
@@ -34,6 +36,11 @@ _CLASS_FINGERPRINTS: dict = {}
 
 #: Classes already warned about class-level signature churn (once per class).
 _CLASS_RETRACE_WARNED: set = set()
+
+#: Guards the class-level maps above: the async ckpt writer thread can drive
+#: instrumented updates concurrently with the training thread, and dict
+#: setdefault + set mutation is not atomic as a sequence.
+_CLASS_LOCK = threading.Lock()
 
 
 def _fingerprint_leaf(x: Any) -> Tuple:
@@ -78,35 +85,44 @@ def check_update(metric: Any, args: Tuple, kwargs: dict) -> None:
     seen.add(fp)
     name = type(metric).__name__
     # class-level aggregation rides every instance-level miss (set-union cost
-    # only on new-signature events, never on the steady-state early return)
-    class_seen = _CLASS_FINGERPRINTS.setdefault(name, set())
-    class_first = not class_seen
-    if fp not in class_seen:
-        class_seen.add(fp)
-        if not class_first:
-            _reg.REGISTRY.inc(name, "retrace_signatures")
-        if (
-            len(class_seen) > RETRACE_WARN_THRESHOLD
+    # only on new-signature events, never on the steady-state early return);
+    # the map mutation happens under a lock, the warning outside it
+    with _CLASS_LOCK:
+        class_seen = _CLASS_FINGERPRINTS.setdefault(name, set())
+        class_first = not class_seen
+        new_signature = fp not in class_seen
+        if new_signature:
+            class_seen.add(fp)
+        n_class = len(class_seen)
+        warn_class = (
+            new_signature
+            and n_class > RETRACE_WARN_THRESHOLD
             and name not in _CLASS_RETRACE_WARNED
             and getattr(metric, "fleet_size", None) is None
-        ):
-            # class-level churn with per-instance dedup intact means MANY
-            # instances of the same class each compile their own update — the
-            # eager-fleet anti-pattern. A single fleet instance shares one
-            # compiled executable across every stream.
+        )
+        if warn_class:
             _CLASS_RETRACE_WARNED.add(name)
-            warnings.warn(
-                f"metrics_tpu.obs: `{name}` has seen {len(class_seen)} distinct"
-                " update signatures across its instances (class-wide). If these"
-                " are per-stream/per-tenant copies of the same metric, replace"
-                f" them with one fleet instance — `{name}(..., fleet_size=N)`"
-                " updated via `update(..., stream_ids=...)` — which compiles one"
-                " executable and runs one launch for all streams.",
-                RuntimeWarning,
-                stacklevel=3,
-            )
+    if new_signature and not class_first:
+        _reg.REGISTRY.inc(name, "retrace_signatures")
+    if warn_class:
+        # class-level churn with per-instance dedup intact means MANY
+        # instances of the same class each compile their own update — the
+        # eager-fleet anti-pattern. A single fleet instance shares one
+        # compiled executable across every stream.
+        warnings.warn(
+            f"metrics_tpu.obs: `{name}` has seen {n_class} distinct"
+            " update signatures across its instances (class-wide). If these"
+            " are per-stream/per-tenant copies of the same metric, replace"
+            f" them with one fleet instance — `{name}(..., fleet_size=N)`"
+            " updated via `update(..., stream_ids=...)` — which compiles one"
+            " executable and runs one launch for all streams.",
+            RuntimeWarning,
+            stacklevel=3,
+        )
     if not first:
         _reg.REGISTRY.inc(name, "retraces")
+        if _flight._RING is not None:
+            _flight.record("retrace", metric=name, signatures=len(seen))
     if len(seen) > RETRACE_WARN_THRESHOLD and not metric.__dict__.get("_obs_retrace_warned", False):
         object.__setattr__(metric, "_obs_retrace_warned", True)
         _reg.REGISTRY.inc(name, "retrace_warnings")
@@ -141,14 +157,15 @@ def reset_class_detector(name: Any = None) -> None:
     """Forget class-level fingerprint history — all classes, or one class /
     metric class object (used by tests and long-lived eval loops that rotate
     workloads)."""
-    if name is None:
-        _CLASS_FINGERPRINTS.clear()
-        _CLASS_RETRACE_WARNED.clear()
-        return
-    if isinstance(name, type):
-        name = name.__name__
-    _CLASS_FINGERPRINTS.pop(name, None)
-    _CLASS_RETRACE_WARNED.discard(name)
+    with _CLASS_LOCK:
+        if name is None:
+            _CLASS_FINGERPRINTS.clear()
+            _CLASS_RETRACE_WARNED.clear()
+            return
+        if isinstance(name, type):
+            name = name.__name__
+        _CLASS_FINGERPRINTS.pop(name, None)
+        _CLASS_RETRACE_WARNED.discard(name)
 
 
 def nbytes_of(x: Any) -> int:

@@ -47,6 +47,11 @@ class ObsRegistry:
         self._lock = threading.Lock()
         self._counters: Dict[tuple, float] = {}
         self._timers: Dict[tuple, Dict[str, float]] = {}
+        # dirty flag: True once anything was recorded since the last clear().
+        # The JSONL export uses it to report the gate state that was in effect
+        # FOR the recorded counters, which may differ from the instantaneous
+        # gate (a scoped `observe()` window that already exited).
+        self._recorded = False
 
     # ----------------------------------------------------------- counters
 
@@ -54,6 +59,7 @@ class ObsRegistry:
         key = (scope, name)
         with self._lock:
             self._counters[key] = self._counters.get(key, 0) + value
+            self._recorded = True
 
     def get(self, scope: str, name: str, default: float = 0) -> float:
         return self._counters.get((scope, name), default)
@@ -67,6 +73,11 @@ class ObsRegistry:
             t["count"] += 1
             t["total_s"] += seconds
             t["max_s"] = max(t["max_s"], seconds)
+            self._recorded = True
+
+    def recorded(self) -> bool:
+        """True once any counter/timer write landed since the last clear()."""
+        return self._recorded
 
     @contextmanager
     def stopwatch(self, scope: str, name: str) -> Iterator[_Stopwatch]:
@@ -99,6 +110,7 @@ class ObsRegistry:
         with self._lock:
             self._counters.clear()
             self._timers.clear()
+            self._recorded = False
 
 
 #: The process-global registry instance the instrumented runtime writes into.

@@ -16,11 +16,14 @@ Callers in the hot path gate on ``registry._ENABLED`` *before* building the
 context manager, so the disabled path never allocates one. ``trace(path)`` is
 the one-call capture driver around ``jax.profiler``.
 """
+import time
 from contextlib import contextmanager
 from typing import Iterator, Optional
 
 import jax
 
+from metrics_tpu.obs import flight as _flight
+from metrics_tpu.obs import health as _health
 from metrics_tpu.obs import registry as _reg
 
 
@@ -30,11 +33,24 @@ def annotate(label: str) -> Iterator[None]:
 
     Also counts the entry under ``("scopes", label)`` so tests (and exported
     snapshots) can assert which annotations a run emitted without parsing a
-    binary trace.
+    binary trace. When the flight recorder or the health monitor is active the
+    window is additionally *timed* (two ``perf_counter`` reads) — the flight
+    ring gets a ``scope`` event the Perfetto exporter renders as a slice, and
+    the health sketches get the latency sample. Counting-only mode stays
+    timer-free.
     """
     _reg.REGISTRY.inc("scopes", label)
+    timed = _flight._RING is not None or _health._MONITOR is not None
+    t0 = time.perf_counter() if timed else 0.0
     with jax.named_scope(label), jax.profiler.TraceAnnotation(label):
         yield
+    if timed:
+        dt = time.perf_counter() - t0
+        if _flight._RING is not None:
+            _flight.record("scope", ts_us=t0 * 1e6, name=label, dur_us=dt * 1e6)
+        monitor = _health._MONITOR
+        if monitor is not None:
+            monitor.observe_scope(label, dt)
 
 
 def update_scope(metric_name: str):

@@ -1,0 +1,182 @@
+"""Perfetto / Chrome ``trace_event`` export of the ``tm.*`` runtime timeline.
+
+Turns the flight-recorder window (``obs/flight.py``) into a JSON object-format
+trace — ``{"traceEvents": [...], "displayTimeUnit": "ms", "otherData": ...}``
+— loadable in ``chrome://tracing`` and https://ui.perfetto.dev, and
+correlatable with a ``jax.profiler`` XProf capture of the same run: the host
+slices here carry the same ``tm.update/<Metric>`` / ``tm.fused/step`` names as
+the ``jax.named_scope`` annotations baked into the HLO.
+
+Track model — one track per metric/engine:
+
+- every ``scope`` flight event (a timed ``tm.*`` window from
+  ``obs/scopes.py``) becomes a complete slice (``"ph": "X"``) on the track of
+  the metric or engine that owns it (``tm.update/BinaryAccuracy`` → track
+  ``BinaryAccuracy``, ``tm.fused/step`` → track ``fused``);
+- point events (``dispatch``, ``retrace``, ``fused_cache_miss``,
+  ``fleet_route``, ``merge``, ``ckpt_*``) become instants (``"ph": "i"``) on
+  the owning track, with their structured fields — input avals, cache keys,
+  routed rows, commit steps — in ``args`` where the Perfetto UI shows them on
+  click;
+- tracks are named via ``thread_name`` metadata events, so the timeline reads
+  as one row per metric/engine rather than anonymous tids.
+
+Naming note: the *module* ``metrics_tpu.obs.trace`` (this file) is the
+exporter; the *attribute* ``metrics_tpu.obs.trace`` remains the XProf capture
+context manager from ``obs/scopes.py`` for backward compatibility — use
+``obs.export_chrome_trace(...)`` / ``obs.chrome_trace_events()`` (re-exported
+at the package root) rather than ``obs.trace.export_chrome_trace``.
+"""
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+from metrics_tpu.obs import flight as _flight
+from metrics_tpu.obs import registry as _reg
+
+#: event kinds rendered as instants, with the track they land on. ``None``
+#: means "take the track from the event's ``metric`` field".
+_INSTANT_TRACKS = {
+    "dispatch": None,
+    "retrace": None,
+    "merge": None,
+    "fused_launch": "fused",
+    "fused_cache_miss": "fused",
+    "fleet_route": None,
+    "ckpt_save_begin": "ckpt",
+    "ckpt_save_commit": "ckpt",
+    "ckpt_restore": "ckpt",
+}
+
+
+def _scope_track(label: str) -> str:
+    """``tm.update/BinaryAccuracy`` -> ``BinaryAccuracy``; ``tm.fused/step`` ->
+    ``fused``; ``tm.collection.update`` -> ``collection``."""
+    if label.startswith("tm."):
+        label = label[3:]
+    if "/" in label:
+        op, owner = label.split("/", 1)
+        return "fused" if op == "fused" else owner
+    return label.split(".", 1)[0]
+
+
+def chrome_trace_events(events: Optional[List[Dict[str, Any]]] = None) -> List[Dict[str, Any]]:
+    """Flight events -> ``trace_event`` dicts (µs timestamps, one tid/track)."""
+    if events is None:
+        events = _flight.events()
+    pid = os.getpid()
+    tids: Dict[str, int] = {}
+    out: List[Dict[str, Any]] = [
+        {
+            "ph": "M",
+            "name": "process_name",
+            "pid": pid,
+            "tid": 0,
+            "args": {"name": "metrics_tpu"},
+        }
+    ]
+
+    def tid_for(track: str) -> int:
+        tid = tids.get(track)
+        if tid is None:
+            tid = tids[track] = len(tids) + 1
+            out.append(
+                {
+                    "ph": "M",
+                    "name": "thread_name",
+                    "pid": pid,
+                    "tid": tid,
+                    "args": {"name": track},
+                }
+            )
+        return tid
+
+    for ev in events:
+        kind = ev.get("kind")
+        args = {
+            k: v for k, v in ev.items() if k not in ("kind", "ts_us", "seq", "dur_us")
+        }
+        args["seq"] = ev.get("seq")
+        if kind == "scope":
+            label = ev.get("name", "tm.scope")
+            out.append(
+                {
+                    "ph": "X",
+                    "name": label,
+                    "cat": "tm",
+                    "ts": ev["ts_us"],
+                    "dur": max(float(ev.get("dur_us", 0.0)), 0.001),
+                    "pid": pid,
+                    "tid": tid_for(_scope_track(label)),
+                    "args": args,
+                }
+            )
+            continue
+        track = _INSTANT_TRACKS.get(kind)
+        if track is None:
+            track = str(ev.get("metric", kind))
+        out.append(
+            {
+                "ph": "i",
+                "name": str(kind),
+                "cat": "tm",
+                "s": "t",  # thread-scoped instant
+                "ts": ev["ts_us"],
+                "pid": pid,
+                "tid": tid_for(track),
+                "args": args,
+            }
+        )
+    return out
+
+
+def export_chrome_trace(
+    path: str,
+    events: Optional[List[Dict[str, Any]]] = None,
+    include_registry: bool = True,
+) -> Dict[str, Any]:
+    """Write the trace JSON to ``path``; returns the written object.
+
+    ``otherData`` carries the registry counter snapshot (when obs holds one)
+    so a single file answers both "what happened when" and "how often".
+    """
+    trace_events = chrome_trace_events(events)
+    obj: Dict[str, Any] = {
+        "traceEvents": trace_events,
+        "displayTimeUnit": "ms",
+        "otherData": {"exporter": "metrics_tpu.obs.trace"},
+    }
+    if include_registry:
+        obj["otherData"]["registry"] = _reg.snapshot()
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(obj, fh, default=str)
+    return obj
+
+
+def validate_chrome_trace(obj: Dict[str, Any]) -> int:
+    """Structural check against the ``trace_event`` format; returns the event
+    count. Raises ``ValueError`` naming the first offending event — used by the
+    CI obs tier and ``bench.py --obs-trace`` to guarantee the exported file is
+    Perfetto-loadable without eyeballing a UI.
+    """
+    if not isinstance(obj, dict) or not isinstance(obj.get("traceEvents"), list):
+        raise ValueError("trace must be an object with a `traceEvents` list")
+    for i, ev in enumerate(obj["traceEvents"]):
+        if not isinstance(ev, dict):
+            raise ValueError(f"traceEvents[{i}] is not an object")
+        ph = ev.get("ph")
+        if ph not in ("X", "i", "I", "M", "B", "E", "C"):
+            raise ValueError(f"traceEvents[{i}] has unsupported ph={ph!r}")
+        if not isinstance(ev.get("name"), str):
+            raise ValueError(f"traceEvents[{i}] missing string `name`")
+        if not isinstance(ev.get("pid"), int) or not isinstance(ev.get("tid"), int):
+            raise ValueError(f"traceEvents[{i}] missing integer pid/tid")
+        if ph in ("X", "i", "I", "B", "E", "C") and not isinstance(
+            ev.get("ts"), (int, float)
+        ):
+            raise ValueError(f"traceEvents[{i}] ({ph}) missing numeric `ts`")
+        if ph == "X" and not isinstance(ev.get("dur"), (int, float)):
+            raise ValueError(f"traceEvents[{i}] (X) missing numeric `dur`")
+        if ph == "M" and "args" not in ev:
+            raise ValueError(f"traceEvents[{i}] (M) missing `args`")
+    return len(obj["traceEvents"])

@@ -1,0 +1,685 @@
+"""tmprof: flight recorder, Perfetto trace export, health sketches, costcheck.
+
+Covers the ISSUE 10 acceptance criteria: disabled-mode no-allocation for every
+new surface, the preemption kill test (dump survives a SIGTERM between an
+update and its ckpt commit), the ckpt-integration dump riding the committed
+step dir, Perfetto structural validity, SLO budget reactions, the seeded
+>=15% launch-count drift against tmsan_costs.json (clean on the real repo),
+the registry/recompile two-thread stress, the JSONL schema_version contract,
+and the bench summary enabled-state regression.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import warnings
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+import metrics_tpu.obs as obs
+from metrics_tpu.classification import MulticlassAccuracy
+from metrics_tpu.core.metric import Metric
+import importlib
+
+from metrics_tpu.obs import costcheck as obs_costcheck
+from metrics_tpu.obs import export as obs_export
+from metrics_tpu.obs import flight as obs_flight
+from metrics_tpu.obs import health as obs_health
+
+# `from metrics_tpu.obs import trace` resolves to the XProf capture FUNCTION
+# (the documented package attribute); the exporter module needs an explicit
+# module-path import
+obs_trace = importlib.import_module("metrics_tpu.obs.trace")
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture(autouse=True)
+def _clean_tmprof():
+    obs.disable()
+    obs.flight.disable()
+    obs.health.disable()
+    obs.REGISTRY.clear()
+    obs.reset_class_detector()
+    yield
+    obs.disable()
+    obs.flight.disable()
+    obs.health.disable()
+    obs.REGISTRY.clear()
+    obs.reset_class_detector()
+
+
+class StreamMean(Metric):
+    full_state_update = False
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("count", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, x):
+        self.total = self.total + jnp.sum(x)
+        self.count = self.count + x.size
+
+    def compute(self):
+        return self.total / self.count
+
+
+# ------------------------------------------------------------ flight recorder
+
+
+def test_flight_ring_bounded_and_ordered():
+    obs.flight.enable(capacity=4)
+    for i in range(10):
+        obs.flight.record("probe", i=i)
+    evs = obs.flight.events()
+    assert len(evs) == 4
+    assert [e["i"] for e in evs] == [6, 7, 8, 9]
+    seqs = [e["seq"] for e in evs]
+    assert seqs == sorted(seqs)
+    assert obs.flight.last(2)[-1]["i"] == 9
+    obs.flight.clear()
+    assert obs.flight.events() == []
+    assert obs.flight.capacity() == 4
+
+
+def test_flight_records_runtime_events():
+    obs.flight.enable(capacity=128)
+    m = StreamMean()
+    m.update(jnp.ones(3))
+    m.update(jnp.ones(3))
+    other = StreamMean()
+    other.update(jnp.ones(3))
+    m.merge_state(other)
+    kinds = {e["kind"] for e in obs.flight.events()}
+    assert {"dispatch", "scope", "merge"} <= kinds
+    dispatch = next(e for e in obs.flight.events() if e["kind"] == "dispatch")
+    assert dispatch["metric"] == "StreamMean"
+    assert dispatch["avals"] == ["3:float32"]
+    scope = next(e for e in obs.flight.events() if e["kind"] == "scope")
+    assert scope["name"].startswith("tm.")
+    assert scope["dur_us"] >= 0
+
+
+def test_flight_records_retraces():
+    obs.flight.enable(capacity=64)
+    m = StreamMean()
+    m.update(jnp.ones(2))
+    m.update(jnp.ones(3))  # new signature -> retrace event
+    retraces = [e for e in obs.flight.events() if e["kind"] == "retrace"]
+    assert retraces and retraces[0]["metric"] == "StreamMean"
+
+
+def test_flight_records_fused_and_fleet():
+    from metrics_tpu.core.fused import canonical_collection
+
+    obs.flight.enable(capacity=512)
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+    preds = jax.random.uniform(k1, (64,), jnp.float32)
+    target = jax.random.randint(k2, (64,), 0, 2, dtype=jnp.int32)
+    coll = canonical_collection(fused=True)
+    coll.update(preds, target)
+    coll.update(preds, target)
+    fleet = MulticlassAccuracy(
+        num_classes=5, average="micro", validate_args=False, fleet_size=4
+    )
+    ids = jnp.repeat(jnp.arange(4, dtype=jnp.int32), 2)
+    lbl = jax.random.randint(k1, (8,), 0, 5, dtype=jnp.int32)
+    fleet.update(lbl, lbl, stream_ids=ids)
+    kinds = {e["kind"] for e in obs.flight.events()}
+    assert {"fused_cache_miss", "fused_launch", "fleet_route"} <= kinds
+    launch = next(e for e in obs.flight.events() if e["kind"] == "fused_launch")
+    assert launch["groups"] and "cache_key" in launch
+    route = next(e for e in obs.flight.events() if e["kind"] == "fleet_route")
+    assert route["streams"] == 4 and route["rows"] == 8
+
+
+def test_flight_dump_roundtrip(tmp_path):
+    obs.flight.enable(capacity=8)
+    m = StreamMean()
+    m.update(jnp.ones(3))
+    obs.flight.note_state_source(m)
+    path = str(tmp_path / "flight.json")
+    assert obs.flight.dump(path) == path
+    payload = json.loads(open(path).read())
+    assert payload["schema_version"] == obs_flight.DUMP_SCHEMA_VERSION
+    assert payload["capacity"] == 8
+    assert [e["kind"] for e in payload["events"]].count("dispatch") == 1
+    assert payload["state_reports"], "note_state_source report must ride the dump"
+    assert payload["state_reports"][0]["metric"] == "StreamMean"
+
+
+def test_flight_dump_never_raises(tmp_path):
+    obs.flight.enable(capacity=4)
+    assert obs.flight.dump(str(tmp_path / "no-such-dir" / "x.json")) is None
+    obs.flight.disable()
+    assert obs.flight.dump(str(tmp_path / "y.json")) is None
+
+
+# ------------------------------------------------- disabled-mode zero overhead
+
+
+def test_disabled_mode_allocates_nothing(monkeypatch):
+    """Gate off: no ring, no monitor, and the hot paths never call into the
+    new surfaces (boom-monkeypatch proof, not timing)."""
+    assert not obs.enabled()
+    assert obs_flight._RING is None and obs_flight.capacity() == 0
+    assert obs_health._MONITOR is None
+
+    def boom(*a, **k):  # noqa: ANN001
+        raise AssertionError("tmprof surface touched with obs disabled")
+
+    monkeypatch.setattr(obs_flight, "record", boom)
+    monkeypatch.setattr(obs_flight, "record_dispatch", boom)
+    monkeypatch.setattr(obs_health.HealthMonitor, "observe_scope", boom)
+    m = StreamMean()
+    m.update(jnp.ones(3))
+    assert float(m.compute()) == 1.0
+    assert obs.flight.events() == []
+    assert obs.health.report() == {}
+    assert obs.health.check_slos() == []
+
+
+def test_record_is_noop_without_ring():
+    obs.flight.record("probe", x=1)  # must not raise, must not allocate
+    assert obs_flight._RING is None
+    assert obs.flight.events() == []
+
+
+def test_enabled_counting_mode_does_not_time_scopes(monkeypatch):
+    """obs.enable() alone (no flight/health) keeps the counting-only scope
+    path: no perf_counter pairs, no flight events."""
+    obs.enable(clear=True)
+
+    def boom(*a, **k):  # noqa: ANN001
+        raise AssertionError("flight.record called with no ring")
+
+    monkeypatch.setattr(obs_flight, "record", boom)
+    m = StreamMean()
+    m.update(jnp.ones(3))
+    assert obs.snapshot()["StreamMean"]["updates"] == 1
+
+
+def test_costcheck_empty_when_nothing_recorded():
+    report = obs.crosscheck(warn=False)
+    assert report["checked"] == [] and report["drifts"] == []
+
+
+# ------------------------------------------------------------- perfetto trace
+
+
+def test_chrome_trace_structure_and_tracks():
+    obs.flight.enable(capacity=128)
+    m = StreamMean()
+    m.update(jnp.ones(3))
+    m.compute()
+    events = obs.chrome_trace_events()
+    phases = {e["ph"] for e in events}
+    assert "M" in phases and "X" in phases
+    names = {e["args"]["name"] for e in events if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert "StreamMean" in names
+    slices = [e for e in events if e["ph"] == "X"]
+    assert all(e["dur"] > 0 and e["cat"] == "tm" for e in slices)
+    instants = [e for e in events if e["ph"] == "i"]
+    assert all(e["s"] == "t" for e in instants)
+    dispatch = next(e for e in instants if e["name"] == "dispatch")
+    assert dispatch["args"]["avals"] == ["3:float32"]
+
+
+def test_export_chrome_trace_validates(tmp_path):
+    obs.flight.enable(capacity=64)
+    m = StreamMean()
+    m.update(jnp.ones(3))
+    path = str(tmp_path / "trace.json")
+    written = obs.export_chrome_trace(path)
+    loaded = json.loads(open(path).read())
+    assert obs.validate_chrome_trace(loaded) == len(written["traceEvents"]) > 0
+    assert loaded["displayTimeUnit"] == "ms"
+    assert loaded["otherData"]["registry"]["StreamMean"]["updates"] == 1
+
+
+def test_validate_chrome_trace_rejects_malformed():
+    with pytest.raises(ValueError, match="traceEvents"):
+        obs.validate_chrome_trace({"not": "a trace"})
+    bad = {"traceEvents": [{"ph": "X", "name": "x", "pid": 1, "tid": 1, "ts": 0.0}]}
+    with pytest.raises(ValueError, match="dur"):
+        obs.validate_chrome_trace(bad)
+    bad = {"traceEvents": [{"ph": "Z", "name": "x", "pid": 1, "tid": 1}]}
+    with pytest.raises(ValueError, match="ph"):
+        obs.validate_chrome_trace(bad)
+
+
+def test_trace_name_collision_contract():
+    """obs.trace stays the XProf capture fn; the exporter lives at the package
+    root and as the obs.trace *submodule*."""
+    import metrics_tpu.obs.scopes as scopes_mod
+
+    assert obs.trace is scopes_mod.trace
+    assert obs_trace.export_chrome_trace is obs.export_chrome_trace
+
+
+# ------------------------------------------------------------ health sketches
+
+
+def test_health_latency_percentiles():
+    mon = obs.health.enable(flush_every=8)
+    for us in range(1, 101):  # 1..100 ms
+        mon.observe_latency("update", "StreamMean", us * 1e-3)
+    rep = obs.health.report()
+    row = rep["latency_us"]["update/StreamMean"]
+    assert row["count"] == 100
+    # DDSketch certificate: relative error within the declared alpha
+    assert row["p50_us"] == pytest.approx(50_000, rel=0.05)
+    assert row["p99_us"] == pytest.approx(99_000, rel=0.05)
+    assert row["p50_certified"] and row["p99_certified"]
+
+
+def test_health_residual_flush_pads_with_nan():
+    """A residual (non-full) buffer flushes NaN-padded: the count must reflect
+    only the real observations."""
+    mon = obs.health.enable(flush_every=64)
+    for _ in range(5):
+        mon.observe_latency("update", "X", 1e-3)
+    row = obs.health.report()["latency_us"]["update/X"]
+    assert row["count"] == 5
+    assert row["p50_us"] == pytest.approx(1_000, rel=0.05)
+
+
+def test_health_scopes_feed_sketches():
+    obs.health.enable(flush_every=2)
+    m = StreamMean()
+    for _ in range(4):
+        m.update(jnp.ones(3))
+    rep = obs.health.report()
+    assert rep["latency_us"]["update/StreamMean"]["count"] == 4
+
+
+def test_health_self_telemetry_does_not_pollute_counters():
+    """The sketch flush itself must not appear in the registry (gate suppressed
+    during flush) — QuantileSketch scopes would otherwise recurse."""
+    obs.health.enable(flush_every=1)  # flush on every observation
+    m = StreamMean()
+    m.update(jnp.ones(3))
+    snap = obs.snapshot()
+    assert "QuantileSketch" not in snap
+    assert snap["StreamMean"]["updates"] == 1
+
+
+def test_health_hbm_watermark():
+    mon = obs.health.enable()
+    mon.note_hbm(100)
+    mon.note_hbm(50)
+    assert mon.hbm_watermark_bytes == 100
+    m = StreamMean()
+    m.update(jnp.ones(3))
+    obs.health.observe_state_bytes(m)
+    assert mon.hbm_watermark_bytes >= m.state_report()["total_nbytes"]
+    assert obs.health.report()["hbm_watermark_bytes"] == mon.hbm_watermark_bytes
+
+
+def test_slo_warn_raise_and_callable():
+    mon = obs.health.enable(flush_every=2)
+    m = StreamMean()
+    for _ in range(4):
+        m.update(jnp.ones(3))
+    obs.health.set_slo(p99_update_latency_ms=1e-9, action="warn")
+    with pytest.warns(obs.SLOViolationWarning, match="p99_update_latency_ms"):
+        violations = obs.health.check_slos()
+    assert violations and violations[0]["slo"] == "p99_update_latency_ms"
+
+    obs.health.set_slo(p99_update_latency_ms=1e-9, action="raise")
+    with pytest.raises(obs.SLOBudgetExceeded):
+        obs.health.check_slos()
+
+    seen = []
+    obs.health.set_slo(p99_update_latency_ms=1e-9, action=seen.append)
+    obs.health.check_slos()
+    assert seen and seen[0][0]["slo"] == "p99_update_latency_ms"
+
+    # generous budget: clean
+    obs.health.set_slo(p99_update_latency_ms=1e9, action="raise")
+    assert obs.health.check_slos() == []
+
+
+def test_slo_launches_and_retrace_window():
+    obs.health.enable()
+    m = StreamMean()
+    for _ in range(3):
+        m.update(jnp.ones(3))
+    obs.health.set_slo(max_launches_per_step=1.0, action="warn")
+    assert obs.health.check_slos(steps=3) == []  # 1 dispatch/step: on budget
+    with pytest.warns(obs.SLOViolationWarning, match="max_launches_per_step"):
+        assert obs.health.check_slos(steps=1)  # 3 dispatches in "1 step"
+
+    obs.health.set_slo(max_retraces_per_window=0, action="warn")
+    assert obs.health.check_slos() == []  # window opens clean
+    m.update(jnp.ones(5))  # new signature -> retrace
+    with pytest.warns(obs.SLOViolationWarning, match="max_retraces_per_window"):
+        obs.health.check_slos()
+    assert obs.health.check_slos() == []  # window closed by the last check
+
+
+def test_slo_requires_monitor():
+    with pytest.raises(RuntimeError, match="health.enable"):
+        obs.health.set_slo(p99_update_latency_ms=1.0)
+
+
+# --------------------------------------------------------------- costcheck
+
+
+def test_costcheck_clean_on_real_repo():
+    """Real metric updates must NOT drift: the static one-launch-per-update
+    model holds on the eager OO path."""
+    obs.enable(clear=True)
+    m = MulticlassAccuracy(num_classes=5, average="micro", validate_args=False)
+    lbl = jnp.arange(10, dtype=jnp.int32) % 5
+    for _ in range(4):
+        m.update(lbl, lbl)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", obs.CostDriftWarning)
+        report = obs.crosscheck()
+    assert report["drifts"] == []
+    assert [r["scope"] for r in report["checked"]] == ["MulticlassAccuracy"]
+    assert report["checked"][0]["launches_per_update"] == 1.0
+
+
+def test_costcheck_flags_seeded_drift():
+    """The acceptance criterion: a seeded >=15% launch-count drift must warn."""
+    obs.enable(clear=True)
+    obs.REGISTRY.inc("MulticlassAccuracy", "updates", 100)
+    obs.REGISTRY.inc("MulticlassAccuracy", "dispatches", 120)  # +20%
+    with pytest.warns(obs.CostDriftWarning, match="MulticlassAccuracy"):
+        report = obs.crosscheck()
+    assert len(report["drifts"]) == 1
+    assert report["drifts"][0]["launches_per_update"] == pytest.approx(1.2)
+
+
+def test_costcheck_amortized_and_unbudgeted():
+    obs.enable(clear=True)
+    obs.REGISTRY.inc("MulticlassAccuracy", "updates", 100)
+    obs.REGISTRY.inc("MulticlassAccuracy", "dispatches", 10)  # fused-style
+    obs.REGISTRY.inc("NoSuchMetricClass", "updates", 5)
+    obs.REGISTRY.inc("NoSuchMetricClass", "dispatches", 5)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", obs.CostDriftWarning)
+        report = obs.crosscheck()
+    assert [r["scope"] for r in report["amortized"]] == ["MulticlassAccuracy"]
+    assert report["unbudgeted"] == ["NoSuchMetricClass"]
+
+
+def test_costcheck_missing_budget_file(tmp_path):
+    report = obs.crosscheck(costs_path=str(tmp_path / "nope.json"), warn=False)
+    assert report["costs_path"] is None
+    assert any("not found" in n for n in report["notes"])
+
+
+def test_costcheck_version_skew_degrades_to_note(tmp_path):
+    payload = json.loads(open(obs_costcheck.default_costs_path()).read())
+    payload["jax"] = "0.0.0-other"
+    skewed = tmp_path / "costs.json"
+    skewed.write_text(json.dumps(payload))
+    obs.REGISTRY.inc("MulticlassAccuracy", "updates", 100)
+    obs.REGISTRY.inc("MulticlassAccuracy", "dispatches", 200)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", obs.CostDriftWarning)
+        report = obs.crosscheck(costs_path=str(skewed))
+    assert not report["version_ok"]
+    assert report["drifts"], "drift rows still reported"
+    assert any("drifted" in n for n in report["notes"]), "warning degraded to note"
+
+
+# ------------------------------------------------------ registry thread-safety
+
+
+def test_registry_two_thread_stress():
+    """The async-ckpt-writer scenario: two threads hammer counters, timers and
+    the retrace detector concurrently; totals must be exact (no lost updates)."""
+    obs.enable(clear=True)
+    n, rounds = 4, 2000
+    errs = []
+
+    def worker(tid):
+        try:
+            m = StreamMean()
+            for i in range(rounds):
+                obs.REGISTRY.inc("stress", "hits")
+                obs.REGISTRY.observe_duration("stress", "lat", 1e-6)
+                from metrics_tpu.obs import recompile as _rc
+
+                _rc.check_update(m, (jnp.ones(1 + (i % 3)),), {})
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        threads = [threading.Thread(target=worker, args=(t,)) for t in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    assert not errs
+    snap = obs.snapshot()["stress"]
+    assert snap["hits"] == n * rounds
+    assert snap["lat"]["count"] == n * rounds
+
+
+def test_flight_ring_concurrent_append_and_snapshot():
+    obs.flight.enable(capacity=256)
+    stop = threading.Event()
+    errs = []
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            obs.flight.record("probe", i=i)
+            i += 1
+
+    def reader():
+        try:
+            for _ in range(300):
+                evs = obs.flight.events()
+                assert len(evs) <= 256
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+        finally:
+            stop.set()
+
+    tw = threading.Thread(target=writer)
+    tr = threading.Thread(target=reader)
+    tw.start(); tr.start()
+    tr.join(); tw.join()
+    assert not errs
+
+
+# -------------------------------------------------------- JSONL export schema
+
+
+def test_export_schema_version_and_validation(tmp_path):
+    obs.enable(clear=True)
+    m = StreamMean()
+    m.update(jnp.ones(3))
+    path = str(tmp_path / "obs.jsonl")
+    obs.dump_jsonl(path)
+    obs.dump_jsonl(path, extra={"epoch": 1})
+    lines = [json.loads(l) for l in open(path)]
+    assert len(lines) == 2
+    for line in lines:
+        assert line["schema_version"] == obs.SCHEMA_VERSION
+        obs.validate_snapshot(line)
+    schema_path = os.path.join(os.path.dirname(obs_export.__file__), "export_schema.json")
+    schema = json.loads(open(schema_path).read())
+    assert schema["properties"]["schema_version"]["type"] == "integer"
+    try:
+        import jsonschema
+    except ImportError:
+        jsonschema = None
+    if jsonschema is not None:
+        for line in lines:
+            jsonschema.validate(line, schema)
+
+
+def test_validate_snapshot_rejects_malformed():
+    good = {"schema_version": 2, "enabled": True, "enabled_now": True, "registry": {}}
+    obs.validate_snapshot(good)
+    for mutant, match in (
+        ({**good, "schema_version": "2"}, "schema_version"),
+        ({**good, "enabled": 1}, "enabled"),
+        ({**good, "registry": []}, "registry"),
+        ({**good, "registry": {"a": {"b": "x"}}}, "number or timer"),
+        ({**good, "registry": {"a": {"b": {"count": 1}}}}, "timer"),
+    ):
+        with pytest.raises(ValueError, match=match):
+            obs.validate_snapshot(mutant)
+
+
+def test_bench_summary_reports_recorded_gate_state():
+    """BENCH_r07 regression: a scoped observe() window that recorded counters
+    and exited must export enabled=True for those counters (the gate state in
+    effect when they were recorded), with enabled_now carrying the instant."""
+    m = StreamMean()
+    with obs.observe(clear=True):
+        m.update(jnp.ones(3))
+    assert not obs.enabled()
+    snap = obs.export_snapshot()
+    assert snap["registry"]["StreamMean"]["updates"] == 1
+    assert snap["enabled"] is True, "counters were recorded under an enabled gate"
+    assert snap["enabled_now"] is False
+    obs.REGISTRY.clear()
+    empty = obs.export_snapshot()
+    assert empty["enabled"] is False and empty["enabled_now"] is False
+
+
+# --------------------------------------------------------- ckpt integration
+
+
+def test_ckpt_integration_dump_rides_committed_step(tmp_path):
+    from metrics_tpu.ckpt import save_checkpoint
+
+    obs.flight.enable(capacity=64, ckpt_integration=True)
+    m = StreamMean()
+    m.update(jnp.ones(3))
+    handle = save_checkpoint(m, str(tmp_path / "series"))
+    step_dir = handle.result()
+    assert handle.committed
+    dump_path = os.path.join(step_dir, "flight-h0000.json")
+    assert os.path.exists(dump_path)
+    payload = json.loads(open(dump_path).read())
+    kinds = [e["kind"] for e in payload["events"]]
+    assert "dispatch" in kinds and "ckpt_save_begin" in kinds
+    assert "ckpt_save_commit" not in kinds, "dump happens before the commit"
+    assert payload["state_reports"], "the saved object's state report rides the dump"
+    # the live ring meanwhile saw the commit
+    assert any(e["kind"] == "ckpt_save_commit" and e["committed"] for e in obs.flight.events())
+
+
+def test_ckpt_without_integration_writes_no_dump(tmp_path):
+    from metrics_tpu.ckpt import save_checkpoint
+
+    obs.flight.enable(capacity=64)  # ckpt_integration defaults off
+    m = StreamMean()
+    m.update(jnp.ones(3))
+    step_dir = save_checkpoint(m, str(tmp_path / "series")).result()
+    assert not [f for f in os.listdir(step_dir) if f.startswith("flight")]
+
+
+# ------------------------------------------------------- preemption kill test
+
+
+_PREEMPT_CHILD = r"""
+import os, signal, sys
+import jax.numpy as jnp
+import metrics_tpu.obs as obs
+from metrics_tpu.ckpt import manager
+from metrics_tpu.classification import MulticlassAccuracy
+
+dump_path, series = sys.argv[1], sys.argv[2]
+obs.flight.enable(capacity=32, dump_path=dump_path, install_handlers=True)
+
+def killing_commit(*a, **k):
+    # the preemption lands BETWEEN the update and the ckpt commit
+    os.kill(os.getpid(), signal.SIGTERM)
+    raise AssertionError("unreachable: SIGTERM must terminate the process")
+
+manager._try_commit = killing_commit
+m = MulticlassAccuracy(num_classes=5, average="micro", validate_args=False)
+lbl = jnp.arange(10, dtype=jnp.int32) % 5
+for _ in range(3):
+    m.update(lbl, lbl)
+manager.save_checkpoint(m, series)
+print("SHOULD-NOT-REACH", flush=True)
+"""
+
+
+@pytest.mark.smoke
+def test_flight_dump_survives_preemption_kill(tmp_path):
+    """Acceptance criterion: SIGTERM between the last update and the ckpt
+    commit still leaves a dump with the last-K events, and no step commits."""
+    dump_path = str(tmp_path / "flight-dump.json")
+    series = str(tmp_path / "series")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-c", _PREEMPT_CHILD, dump_path, series],
+        capture_output=True, text=True, timeout=240, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))),
+    )
+    assert proc.returncode == -signal.SIGTERM, (proc.returncode, proc.stdout, proc.stderr)
+    assert "SHOULD-NOT-REACH" not in proc.stdout
+    assert os.path.exists(dump_path), proc.stderr
+    payload = json.loads(open(dump_path).read())
+    kinds = [e["kind"] for e in payload["events"]]
+    assert kinds.count("dispatch") == 3, "all three updates survive in the window"
+    assert "ckpt_save_begin" in kinds
+    assert "ckpt_save_commit" not in kinds, "killed before the commit"
+    # nothing committed on disk
+    committed = [d for d in os.listdir(series) if d.startswith("step_")] if os.path.isdir(series) else []
+    assert committed == []
+
+
+def test_signal_handler_chains_and_uninstalls(tmp_path):
+    calls = []
+    prev = signal.signal(signal.SIGUSR1, lambda s, f: calls.append("prev"))
+    try:
+        dump_path = str(tmp_path / "sig.json")
+        obs.flight.enable(
+            capacity=8, dump_path=dump_path, install_handlers=True,
+            signals=(signal.SIGUSR1,),
+        )
+        obs.flight.record("probe")
+        os.kill(os.getpid(), signal.SIGUSR1)
+        assert calls == ["prev"], "previous handler must be chained"
+        assert os.path.exists(dump_path)
+        obs.flight.disable()
+        calls.clear()
+        os.kill(os.getpid(), signal.SIGUSR1)
+        assert calls == ["prev"], "disable() restores the previous handler"
+    finally:
+        signal.signal(signal.SIGUSR1, prev)
+
+
+# --------------------------------------------------------------- bench driver
+
+
+def test_bench_obs_trace_config(tmp_path):
+    """`bench.py --obs-trace` in-process: Perfetto-loadable fused+fleet trace
+    plus a clean costcheck field (the acceptance criterion, minus the CLI)."""
+    import importlib.util
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+    spec = importlib.util.spec_from_file_location("bench_mod", os.path.join(repo_root, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    out = str(tmp_path / "trace.json")
+    result = bench.bench_obs_trace(out_path=out, steps=2)
+    assert result["metric"] == "obs_trace"
+    assert result["value"] > 0
+    loaded = json.loads(open(out).read())
+    assert obs.validate_chrome_trace(loaded) == result["value"]
+    assert "fused" in result["tracks"]
+    assert result["costcheck"]["drifts"] == []
+    # tmprof teardown left the session gate where it was
+    assert not obs.enabled()
+    assert obs_flight._RING is None and obs_health._MONITOR is None
