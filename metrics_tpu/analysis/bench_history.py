@@ -1,0 +1,287 @@
+"""Parse the BENCH_r*.json trajectory into backend-normalized series + a gate.
+
+The checked-in ``BENCH_r<NN>.json`` rounds are raw driver captures — a stdout
+``tail`` whose last JSON lines carry per-config measurements, later rounds a
+machine-readable ``parsed.summary`` block. This module turns that history into
+per-``(backend, config, field)`` series and answers the question the perf
+trajectory could not answer by machine: *did the newest round regress?*
+
+Backend normalization is the load-bearing rule: r06/r07 were recorded on the
+CPU backend while r01–r05 ran on TPU, and absolute throughputs across backends
+differ by orders of magnitude — a series only ever compares measurements with
+the same backend stamp (legacy rounds without one are ``tpu``, per the
+recorded history; ``bench.py`` now stamps every new round itself).
+
+Gate semantics (:func:`find_regressions`): only the round under test is
+gated — each of its measurements is compared against the **best** earlier
+same-backend value of the same ``(config, field)`` series, and a change
+worse than ``threshold`` (default 15%) in the unit's known direction
+(``…/s…`` throughputs: higher is better; ``ms``/``s`` latencies: lower is
+better) is a regression. Earlier-round dips are history that already shipped;
+they surface as non-gating notes in the report so the trajectory stays
+readable, but a gate that re-flagged them forever would just be permanently
+red. Fields with no inferable direction (counts, parities) are not gated;
+the sort-split fields the ROADMAP asks future TPU rounds to record
+(``sort_ms``/``post_sort_ms``/``layout_sort_ms``/``scan_ms``) are gated as
+latencies alongside each config's primary ``value``.
+"""
+import json
+import os
+import re
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+
+#: >15% worse than the best same-backend round fails the gate (ISSUE 11)
+DEFAULT_THRESHOLD = 0.15
+
+#: unstamped legacy rounds (r01–r05) predate the backend stamp and ran on TPU
+LEGACY_BACKEND = "tpu"
+
+#: per-config sub-fields gated as ms latencies when a round records them
+GATED_SPLIT_FIELDS = ("sort_ms", "post_sort_ms", "layout_sort_ms", "scan_ms")
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+class Measurement(NamedTuple):
+    round_num: int
+    value: float
+    unit: Optional[str]
+
+
+class Round(NamedTuple):
+    num: int
+    backend: str
+    ok: bool
+    path: str
+    #: {config: {field: (value, unit)}}
+    measurements: Dict[str, Dict[str, Tuple[float, Optional[str]]]]
+
+
+class Regression(NamedTuple):
+    backend: str
+    config: str
+    field: str
+    unit: Optional[str]
+    value: float
+    best: float
+    best_round: int
+    round_num: int
+    change_pct: float
+
+
+def direction_of(unit: Optional[str]) -> int:
+    """+1 higher-is-better, -1 lower-is-better, 0 unknown (not gated)."""
+    if not isinstance(unit, str):
+        return 0
+    u = unit.strip()
+    # latency first: "ms/step" must not match the "/s" throughput test below
+    if u in ("ms", "s", "us") or u.startswith(("ms/", "s/", "us/")):
+        return -1
+    # "/s" as a whole path segment: Gpreds/s/chip, images/s, Mdocs/s/chip, ...
+    if re.search(r"/s(/|$)", u):
+        return 1
+    return 0
+
+
+def _rows_from_round(data: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
+    """Per-config measurement rows: the summary block when present, else the
+    JSON measurement lines recoverable from the stdout tail."""
+    parsed = data.get("parsed")
+    if isinstance(parsed, dict) and isinstance(parsed.get("summary"), dict):
+        return {
+            cfg: row
+            for cfg, row in parsed["summary"].items()
+            if isinstance(row, dict)
+        }
+    rows: Dict[str, Dict[str, Any]] = {}
+    for line in (data.get("tail") or "").splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if not isinstance(obj, dict) or "metric" not in obj:
+            continue
+        if obj["metric"] == "summary_all_configs":
+            if isinstance(obj.get("summary"), dict):
+                rows.update(
+                    {c: r for c, r in obj["summary"].items() if isinstance(r, dict)}
+                )
+        else:
+            rows[obj["metric"]] = obj
+    return rows
+
+
+def parse_round(path: str) -> Round:
+    """One BENCH_r*.json file -> a :class:`Round` of gateable measurements.
+
+    Errored rounds (``rc != 0``, e.g. r01) parse to an empty measurement set
+    — present in the trajectory, excluded from every series. Rows that record
+    an ``error`` instead of a value (r06's CPU fid timeout) are skipped the
+    same way.
+    """
+    m = _ROUND_RE.search(os.path.basename(path))
+    if m is None:
+        raise ValueError(f"not a bench round filename: {path!r}")
+    num = int(m.group(1))
+    with open(path) as f:
+        data = json.load(f)
+    backend = data.get("backend")
+    parsed = data.get("parsed")
+    if backend is None and isinstance(parsed, dict):
+        # bench.py now stamps its own env into the summary line (r08+)
+        env = parsed.get("env")
+        if isinstance(env, dict):
+            backend = env.get("backend")
+    backend = backend or LEGACY_BACKEND
+    ok = data.get("rc", 1) == 0
+    measurements: Dict[str, Dict[str, Tuple[float, Optional[str]]]] = {}
+    if ok:
+        for cfg, row in _rows_from_round(data).items():
+            if "error" in row:
+                continue
+            fields: Dict[str, Tuple[float, Optional[str]]] = {}
+            value, unit = row.get("value"), row.get("unit")
+            if isinstance(value, (int, float)) and not isinstance(value, bool):
+                fields["value"] = (float(value), unit)
+            for split in GATED_SPLIT_FIELDS:
+                sv = row.get(split)
+                if isinstance(sv, (int, float)) and not isinstance(sv, bool):
+                    fields[split] = (float(sv), "ms")
+            if fields:
+                measurements[cfg] = fields
+    return Round(num=num, backend=str(backend), ok=ok, path=path, measurements=measurements)
+
+
+def load_rounds(paths: List[str]) -> List[Round]:
+    """Parse and sort a set of round files (duplicate round numbers rejected)."""
+    rounds = sorted((parse_round(p) for p in paths), key=lambda r: r.num)
+    nums = [r.num for r in rounds]
+    if len(set(nums)) != len(nums):
+        dupes = sorted({n for n in nums if nums.count(n) > 1})
+        raise ValueError(f"duplicate bench round numbers: {dupes}")
+    return rounds
+
+
+def discover(dirpath: str) -> List[str]:
+    """All BENCH_r*.json files directly under ``dirpath``, sorted."""
+    return sorted(
+        os.path.join(dirpath, name)
+        for name in os.listdir(dirpath)
+        if _ROUND_RE.search(name)
+    )
+
+
+def build_series(
+    rounds: List[Round],
+) -> Dict[Tuple[str, str, str], List[Measurement]]:
+    """``{(backend, config, field): [Measurement, ...]}``, round-ordered."""
+    series: Dict[Tuple[str, str, str], List[Measurement]] = {}
+    for rnd in rounds:
+        for cfg, fields in rnd.measurements.items():
+            for field, (value, unit) in fields.items():
+                series.setdefault((rnd.backend, cfg, field), []).append(
+                    Measurement(round_num=rnd.num, value=value, unit=unit)
+                )
+    return series
+
+
+def _relative_loss(value: float, best: float, direction: int) -> float:
+    """How much worse ``value`` is than ``best``, as a fraction of ``best``
+    (0.0 when equal or better)."""
+    if best == 0:
+        return 0.0
+    if direction > 0:
+        return max(0.0, (best - value) / abs(best))
+    return max(0.0, (value - best) / abs(best))
+
+
+def find_regressions(
+    series: Dict[Tuple[str, str, str], List[Measurement]],
+    round_num: int,
+    threshold: float = DEFAULT_THRESHOLD,
+) -> List[Regression]:
+    """Gate one round: its measurements vs the best earlier same-backend value.
+
+    A series the round under test doesn't appear in, or appears in first
+    (a new config, or the first round on a new backend), has nothing to
+    compare against and cannot regress.
+    """
+    out: List[Regression] = []
+    for (backend, cfg, field), points in sorted(series.items()):
+        current = next((p for p in points if p.round_num == round_num), None)
+        if current is None:
+            continue
+        direction = direction_of(current.unit)
+        if direction == 0:
+            continue
+        earlier = [p.value for p in points if p.round_num < round_num]
+        if not earlier:
+            continue
+        best = max(earlier) if direction > 0 else min(earlier)
+        best_round = next(
+            p.round_num
+            for p in points
+            if p.round_num < round_num and p.value == best
+        )
+        loss = _relative_loss(current.value, best, direction)
+        if loss > threshold:
+            out.append(
+                Regression(
+                    backend=backend,
+                    config=cfg,
+                    field=field,
+                    unit=current.unit,
+                    value=current.value,
+                    best=best,
+                    best_round=best_round,
+                    round_num=round_num,
+                    change_pct=round(loss * 100.0, 2),
+                )
+            )
+    return out
+
+
+def trajectory_report(
+    rounds: List[Round], threshold: float = DEFAULT_THRESHOLD
+) -> Dict[str, Any]:
+    """Full history view: every series, plus which round (if any) is gated.
+
+    ``historical_dips`` lists >threshold drops at earlier rounds — context
+    for a reader, never a gate failure (see module docstring).
+    """
+    series = build_series(rounds)
+    latest = max((r.num for r in rounds), default=None)
+    regressions = (
+        find_regressions(series, latest, threshold) if latest is not None else []
+    )
+    dips: List[Dict[str, Any]] = []
+    for num in sorted({p.round_num for pts in series.values() for p in pts}):
+        if num == latest:
+            continue
+        for reg in find_regressions(series, num, threshold):
+            dips.append(reg._asdict())
+    return {
+        "rounds": [
+            {
+                "round": r.num,
+                "backend": r.backend,
+                "ok": r.ok,
+                "configs": sorted(r.measurements),
+            }
+            for r in rounds
+        ],
+        "series": {
+            f"{backend}/{cfg}/{field}": [
+                {"round": p.round_num, "value": p.value, "unit": p.unit}
+                for p in points
+            ]
+            for (backend, cfg, field), points in sorted(series.items())
+        },
+        "gated_round": latest,
+        "threshold": threshold,
+        "regressions": [reg._asdict() for reg in regressions],
+        "historical_dips": dips,
+    }

@@ -24,6 +24,12 @@ tmprof — the production telemetry tier on the same gate::
 
     obs.costcheck.crosscheck()           # measured launches vs tmsan_costs.json
 
+tmscope — continuous monitoring on the same gate::
+
+    obs.series.enable(interval_s=1.0)    # 1 Hz counter-delta + percentile ring
+    obs.prom.start_server(port=9464)     # GET /metrics, Prometheus text format
+    obs.aggregate.fleet_snapshot()       # cross-host merge (sketch-exact p99s)
+
 Off by default: with obs disabled every instrumented hot path reduces to a
 single boolean check (see ``registry.py``), keeping the library's measured
 throughput identical to the uninstrumented build — and none of the tmprof
@@ -45,7 +51,7 @@ from metrics_tpu.obs.registry import (
 # `obs.trace` to the XProf capture contextmanager (the documented public name).
 # The exporter stays reachable as `obs.export_chrome_trace` / via
 # `metrics_tpu.obs import trace as trace_export`.
-from metrics_tpu.obs import costcheck, flight, health, recompile, registry
+from metrics_tpu.obs import aggregate, costcheck, flight, health, prom, recompile, registry, series
 from metrics_tpu.obs import trace as _trace_export
 from metrics_tpu.obs.costcheck import CostDriftWarning, crosscheck
 from metrics_tpu.obs.export import SCHEMA_VERSION, dump_jsonl, validate_snapshot
@@ -91,6 +97,7 @@ __all__ = [
     "SLOBudget",
     "SLOBudgetExceeded",
     "SLOViolationWarning",
+    "aggregate",
     "annotate",
     "chrome_trace_events",
     "collection_summary",
@@ -109,10 +116,12 @@ __all__ = [
     "health",
     "metric_state_report",
     "observe",
+    "prom",
     "recompile",
     "registry",
     "reset_class_detector",
     "reset_detector",
+    "series",
     "snapshot",
     "snapshot_json",
     "stopwatch",

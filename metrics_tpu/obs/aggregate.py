@@ -1,0 +1,242 @@
+"""Cross-host obs aggregation: one fleet-wide snapshot from per-host telemetry.
+
+A pod-scale serving job runs one obs registry + health monitor *per process*;
+answering "what is the fleet's p99 update latency" requires merging them. This
+module does that with the same algebra the metric states themselves use:
+
+- **counters sum** — events on host A plus events on host B are fleet events;
+- **timers** sum ``count``/``total_s`` and take the elementwise **max** of
+  ``max_s`` (the fleet's worst single observation is the worst any host saw);
+- **HBM watermarks max** — the fleet watermark is the hottest device;
+- **latency QuantileSketch states merge exactly** — every sketch leaf is a
+  sum-reduced int32 histogram (``sketches/base.py`` invariant), so the
+  cross-host merge is elementwise integer addition, bit-identical to having
+  bucketed all hosts' observations into one sketch, and the merged quantiles
+  carry the same relative-error certificate.
+
+The unit of exchange is :func:`host_snapshot` — a JSON-serializable dict
+stamped with this process's ``(rank, world)`` from
+:func:`metrics_tpu.parallel.collective.process_topology` (the same source the
+ckpt multi-host protocol coordinates on). Transport is the caller's choice:
+:func:`aggregate` merges an explicit list (tests, sidecar collectors, scrape
+federation), while :func:`publish` + :func:`aggregate_dir` implement the
+ckpt-style shared-directory exchange (each host atomically writes
+``obs-h<rank>.json``; any host merges the directory).
+
+Zero-overhead contract: nothing here is called from instrumented hot paths —
+aggregation *pulls* registry/health state on demand, allocates only when
+called, and works (degenerately) with the obs gate off.
+"""
+import json
+import os
+import tempfile
+from typing import Any, Dict, List, Optional
+
+from metrics_tpu.obs import health as _health
+from metrics_tpu.obs import registry as _reg
+
+SCHEMA_VERSION = 1
+
+
+def host_snapshot() -> Dict[str, Any]:
+    """This process's obs state as one JSON-serializable, mergeable dict."""
+    from metrics_tpu.parallel.collective import process_topology
+
+    rank, world = process_topology()
+    monitor = _health._MONITOR
+    return {
+        "schema": SCHEMA_VERSION,
+        "host": rank,
+        "world": world,
+        "counters": _reg.snapshot(),
+        "hbm_watermark_bytes": (
+            monitor.hbm_watermark_bytes if monitor is not None else None
+        ),
+        "latency_sketches": monitor.export_sketches() if monitor is not None else {},
+    }
+
+
+def _merge_counters(
+    into: Dict[str, Dict[str, Any]], snap: Dict[str, Dict[str, Any]]
+) -> None:
+    for scope, names in snap.items():
+        dst = into.setdefault(scope, {})
+        for name, value in names.items():
+            if isinstance(value, dict):
+                cur = dst.setdefault(name, {"count": 0, "total_s": 0.0, "max_s": 0.0})
+                cur["count"] += value.get("count", 0)
+                cur["total_s"] += value.get("total_s", 0.0)
+                cur["max_s"] = max(cur["max_s"], value.get("max_s", 0.0))
+            else:
+                dst[name] = dst.get(name, 0) + value
+
+
+def _add_leaves(a: Any, b: Any) -> Any:
+    """Elementwise integer addition over tolist()-shaped sketch leaves."""
+    if isinstance(a, list):
+        if len(a) != len(b):
+            raise ValueError(
+                f"sketch state leaves have mismatched lengths ({len(a)} vs {len(b)})"
+            )
+        return [_add_leaves(x, y) for x, y in zip(a, b)]
+    return a + b
+
+
+def _merge_sketches(
+    into: Dict[str, Dict[str, Any]], sketches: Dict[str, Dict[str, Any]]
+) -> None:
+    for key, entry in sketches.items():
+        cur = into.get(key)
+        if cur is None:
+            into[key] = {
+                "params": dict(entry["params"]),
+                "state": {k: json.loads(json.dumps(v)) for k, v in entry["state"].items()},
+                "count": int(entry["count"]),
+            }
+            continue
+        if cur["params"] != entry["params"]:
+            raise ValueError(
+                f"cannot merge latency sketch {key!r}: hosts disagree on sketch"
+                f" params ({cur['params']} vs {entry['params']}) — merged quantiles"
+                " would silently lose their certificate"
+            )
+        if set(cur["state"]) != set(entry["state"]):
+            raise ValueError(
+                f"cannot merge latency sketch {key!r}: state leaves differ"
+                f" ({sorted(cur['state'])} vs {sorted(entry['state'])})"
+            )
+        cur["state"] = {
+            k: _add_leaves(cur["state"][k], entry["state"][k]) for k in cur["state"]
+        }
+        cur["count"] += int(entry["count"])
+
+
+def _quantiles_of(entry: Dict[str, Any]) -> Dict[str, Any]:
+    """Compute the percentile row for one merged sketch entry."""
+    import jax.numpy as jnp
+
+    from metrics_tpu.sketches import QuantileSketch
+
+    sk = QuantileSketch(**entry["params"])
+    state = {k: jnp.asarray(v, jnp.int32) for k, v in entry["state"].items()}
+    out = sk.compute_from(state)
+    row: Dict[str, Any] = {"count": int(entry["count"])}
+    for q, v, c in zip(
+        sk.quantiles, out["quantiles"].tolist(), out["certified"].tolist()
+    ):
+        row[f"p{round(q * 100):d}_us"] = round(float(v), 3)
+        row[f"p{round(q * 100):d}_certified"] = bool(c)
+    return row
+
+
+def aggregate(snapshots: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Merge per-host snapshots into one fleet-wide view with host breakdown.
+
+    Returns ``{"hosts": <count>, "world": ..., "counters": <summed registry
+    shape>, "hbm_watermark_bytes": <fleet max>, "latency_us": {key:
+    percentile row computed from the merged sketch state},
+    "latency_sketches": <merged, still-mergeable states>, "per_host": [...]}``
+    — the merged output is itself a valid input to a higher aggregation level
+    (rack → pod → fleet composes, because every reduction is associative).
+    """
+    if not snapshots:
+        raise ValueError("aggregate() needs at least one host snapshot")
+    counters: Dict[str, Dict[str, Any]] = {}
+    sketches: Dict[str, Dict[str, Any]] = {}
+    hbm: Optional[int] = None
+    per_host: List[Dict[str, Any]] = []
+    world = 0
+    for snap in snapshots:
+        if snap.get("schema") != SCHEMA_VERSION:
+            raise ValueError(
+                f"host snapshot schema {snap.get('schema')!r} != {SCHEMA_VERSION}"
+            )
+        _merge_counters(counters, snap.get("counters", {}))
+        _merge_sketches(sketches, snap.get("latency_sketches", {}))
+        host_hbm = snap.get("hbm_watermark_bytes")
+        if host_hbm is not None:
+            hbm = host_hbm if hbm is None else max(hbm, host_hbm)
+        world = max(world, snap.get("world", 0))
+        per_host.append(
+            {
+                "host": snap.get("host"),
+                "hbm_watermark_bytes": host_hbm,
+                "events_total": sum(
+                    value
+                    for names in snap.get("counters", {}).values()
+                    for value in names.values()
+                    if not isinstance(value, dict)
+                ),
+                "latency_keys": sorted(snap.get("latency_sketches", {})),
+            }
+        )
+    per_host.sort(key=lambda h: (h["host"] is None, h["host"]))
+    return {
+        "schema": SCHEMA_VERSION,
+        "hosts": len(snapshots),
+        "world": world,
+        "counters": counters,
+        "hbm_watermark_bytes": hbm,
+        "latency_us": {key: _quantiles_of(entry) for key, entry in sketches.items()},
+        "latency_sketches": sketches,
+        "per_host": per_host,
+    }
+
+
+# ------------------------------------------------- shared-directory exchange
+
+
+def _host_path(dirpath: str, rank: int) -> str:
+    return os.path.join(dirpath, f"obs-h{rank:04d}.json")
+
+
+def publish(dirpath: str, snapshot: Optional[Dict[str, Any]] = None) -> str:
+    """Write this host's snapshot to ``dirpath/obs-h<rank>.json``, atomically.
+
+    The ckpt-style exchange for launchers without a shared network plane:
+    every process publishes into one shared directory (tmp + fsync + rename,
+    so readers never see a torn file), then any process calls
+    :func:`aggregate_dir`. Returns the path written.
+    """
+    snap = host_snapshot() if snapshot is None else snapshot
+    path = _host_path(dirpath, int(snap["host"]))
+    os.makedirs(dirpath, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=dirpath, prefix=".obs-", suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(snap, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return path
+
+
+def aggregate_dir(dirpath: str, expect_world: Optional[int] = None) -> Dict[str, Any]:
+    """Merge every ``obs-h*.json`` under ``dirpath`` (see :func:`aggregate`).
+
+    ``expect_world`` makes a partial exchange loud: fewer published hosts than
+    the expected world raises instead of silently reporting a partial fleet.
+    """
+    snapshots = []
+    for entry in sorted(os.listdir(dirpath)):
+        if entry.startswith("obs-h") and entry.endswith(".json"):
+            with open(os.path.join(dirpath, entry)) as f:
+                snapshots.append(json.load(f))
+    if expect_world is not None and len(snapshots) < expect_world:
+        raise ValueError(
+            f"aggregate_dir: found {len(snapshots)} host snapshots under"
+            f" {dirpath!r}, expected {expect_world}"
+        )
+    return aggregate(snapshots)
+
+
+def fleet_snapshot() -> Dict[str, Any]:
+    """This process's view of the fleet — in a single-process runtime, the
+    aggregate of its own snapshot (the world==1 degenerate case)."""
+    return aggregate([host_snapshot()])

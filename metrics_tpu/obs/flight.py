@@ -288,13 +288,35 @@ def dump(path: Optional[str] = None, state_objs: Optional[List[Any]] = None) -> 
 # -------------------------------------------------------- failure handlers
 
 
+def failure_dump_path() -> Optional[str]:
+    """Where the atexit/signal handlers will write: ``dump_path`` suffixed
+    with process rank + pid (``…-h0000-p12345.json``).
+
+    Concurrent multi-process dumps into one shared directory must not
+    overwrite each other; the rank matches the ckpt-embedded
+    ``flight-h<rank>.json`` naming, and the pid disambiguates external
+    launchers that map several processes to one rank. Explicit
+    :func:`dump` calls keep the caller's path verbatim.
+    """
+    if _DUMP_PATH is None:
+        return None
+    try:
+        from metrics_tpu.parallel.collective import process_topology
+
+        rank, _ = process_topology()
+    except Exception:  # noqa: BLE001 — mid-crash, a best-effort name beats none
+        rank = 0
+    root, ext = os.path.splitext(_DUMP_PATH)
+    return f"{root}-h{rank:04d}-p{os.getpid()}{ext or '.json'}"
+
+
 def _on_exit() -> None:
     if _RING is not None and _DUMP_PATH is not None:
-        dump()
+        dump(failure_dump_path())
 
 
 def _on_signal(signum: int, frame: Any) -> None:
-    dump()
+    dump(failure_dump_path())
     prev = _PREV_HANDLERS.get(signum)
     if callable(prev):
         prev(signum, frame)

@@ -230,6 +230,41 @@ class HealthMonitor:
                 "flush_every": self.flush_every,
             }
 
+    def export_sketches(self) -> Dict[str, Any]:
+        """Flush residuals and export each latency sketch as mergeable state.
+
+        The cross-host currency of ``obs/aggregate``: per ``op/metric`` key,
+        the sketch construction params plus its int32 state leaves as plain
+        Python lists — JSON-serializable, and (every leaf being sum-reduced)
+        mergeable *exactly* by elementwise addition on whichever host
+        reconstructs the sketch. Same gate suppression as :meth:`report`.
+        """
+        with self._lock:
+            for key in list(self._buffers):
+                self._flush_locked(key)
+            out: Dict[str, Any] = {}
+            prev = _reg._ENABLED
+            _reg._ENABLED = False
+            self._in_self = True
+            try:
+                for (op, name), (sk, state, count) in sorted(self._sketches.items()):
+                    if count == 0:
+                        continue
+                    out[f"{op}/{name}"] = {
+                        "params": {
+                            "relative_error": sk.relative_error,
+                            "bits": sk.bits,
+                            "min_value": sk.min_value,
+                            "quantiles": list(sk.quantiles),
+                        },
+                        "state": {k: v.tolist() for k, v in state.items()},
+                        "count": int(count),
+                    }
+            finally:
+                self._in_self = False
+                _reg._ENABLED = prev
+            return out
+
     # ------------------------------------------------------------------ SLO
 
     def _mark_window(self) -> None:
@@ -381,6 +416,10 @@ def check_slos(steps: Optional[int] = None) -> List[Dict[str, Any]]:
 
 def report() -> Dict[str, Any]:
     return _MONITOR.report() if _MONITOR is not None else {}
+
+
+def export_sketches() -> Dict[str, Any]:
+    return _MONITOR.export_sketches() if _MONITOR is not None else {}
 
 
 def observe_state_bytes(metric: Any) -> None:

@@ -1,0 +1,340 @@
+"""Prometheus text-format exposition of the obs registry, health, and series.
+
+:func:`render` turns the current state of the instrumentation layer into the
+Prometheus exposition format (text/plain; version=0.0.4) so any external
+scraper can collect it without this library growing a client dependency:
+
+- registry counters as ``tm_events_total{scope=...,name=...}``;
+- registry wall timers as a ``tm_scope_seconds`` summary-style family
+  (``_count``/``_sum`` per ``{scope, name}``) plus a ``tm_scope_seconds_max``
+  gauge;
+- health latency percentiles as a ``tm_latency_microseconds`` summary — one
+  ``{op, metric, quantile}`` sample per dogfooded QuantileSketch level, with
+  the per-key observation ``_count``;
+- the HBM watermark and gate state as gauges;
+- the sampler's latest tick (when ``obs.series`` is enabled) as
+  ``tm_series_rate_per_second`` gauges plus cumulative tick/violation
+  counters — the "series tails" an alerting rule wants without rescraping
+  history.
+
+Metric names follow the Prometheus conventions this module also *validates*:
+``[a-zA-Z_:][a-zA-Z0-9_:]*`` names, counters ending ``_total``, label values
+escaped (``\\`` ``"`` and newline). :func:`validate_exposition` is the
+dependency-free structural validator (the analogue of
+``obs.validate_chrome_trace`` for the scrape path); CI round-trips every
+rendered page through it.
+
+:func:`start_server` serves ``GET /metrics`` from a stdlib ``http.server``
+on a daemon thread — zero new dependencies, one call to make a process
+scrapeable. Nothing in this module is reachable from the instrumented hot
+paths: exposition *pulls* registry/health/series state on demand, and no
+server or buffer exists until :func:`start_server`.
+"""
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+
+from metrics_tpu.obs import health as _health
+from metrics_tpu.obs import registry as _reg
+from metrics_tpu.obs import series as _series
+
+#: the Content-Type Prometheus scrapers expect from a text-format endpoint
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+# one sample line: name, optional {labels}, value, optional timestamp
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})?"
+    r"\s+(?P<value>[^\s]+)"
+    r"(?:\s+(?P<ts>-?[0-9]+))?$"
+)
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+_SERVER: Optional[ThreadingHTTPServer] = None
+_SERVER_THREAD: Optional[threading.Thread] = None
+
+
+def _escape_label(value: str) -> str:
+    return value.replace("\\", "\\\\").replace("\n", "\\n").replace('"', '\\"')
+
+
+def _labels(**kv: str) -> str:
+    inner = ",".join(f'{k}="{_escape_label(str(v))}"' for k, v in sorted(kv.items()))
+    return "{" + inner + "}" if inner else ""
+
+
+def _fmt(value: Any) -> str:
+    v = float(value)
+    if v != v:
+        return "NaN"
+    if v in (float("inf"), float("-inf")):
+        return "+Inf" if v > 0 else "-Inf"
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v)
+
+
+class _Family:
+    """One metric family: HELP/TYPE header + its sample lines, in order."""
+
+    def __init__(self, name: str, kind: str, help_text: str) -> None:
+        self.name = name
+        self.kind = kind
+        self.help = help_text
+        self.samples: List[str] = []
+
+    def add(self, suffix: str, labels: str, value: Any) -> None:
+        self.samples.append(f"{self.name}{suffix}{labels} {_fmt(value)}")
+
+    def render(self) -> str:
+        head = f"# HELP {self.name} {self.help}\n# TYPE {self.name} {self.kind}\n"
+        return head + "".join(s + "\n" for s in self.samples)
+
+
+def render() -> str:
+    """The current obs state as one Prometheus text-format page.
+
+    Always renderable: with everything disabled the page carries only the
+    ``tm_obs_enabled 0`` gauge, so a scrape endpoint stays healthy across
+    ``obs.disable()`` windows instead of 500ing.
+    """
+    families: List[_Family] = []
+
+    gate = _Family("tm_obs_enabled", "gauge", "1 while the obs gate is on.")
+    gate.add("", "", 1 if _reg.enabled() else 0)
+    families.append(gate)
+
+    counters = _Family(
+        "tm_events", "counter",
+        "Obs registry counters, labelled by scope (metric class or subsystem) and event name.",
+    )
+    timers = _Family(
+        "tm_scope_seconds", "summary",
+        "Wall time of timed obs scopes (count/sum per scope and timer name).",
+    )
+    timer_max = _Family(
+        "tm_scope_seconds_max", "gauge", "Largest single observation per timed scope.",
+    )
+    for scope, names in sorted(_reg.snapshot().items()):
+        for name, value in sorted(names.items()):
+            if isinstance(value, dict):
+                labels = _labels(scope=scope, name=name)
+                timers.add("_count", labels, value.get("count", 0))
+                timers.add("_sum", labels, value.get("total_s", 0.0))
+                timer_max.add("", labels, value.get("max_s", 0.0))
+            else:
+                counters.add("_total", _labels(scope=scope, name=name), value)
+    if counters.samples:
+        families.append(counters)
+    if timers.samples:
+        families.extend([timers, timer_max])
+
+    monitor = _health._MONITOR
+    if monitor is not None:
+        report = monitor.report()
+        latency = _Family(
+            "tm_latency_microseconds", "summary",
+            "Per-(op, metric) latency quantiles from the health QuantileSketches"
+            " (certified to the sketch relative_error unless the rank hit an edge bin).",
+        )
+        for key, row in sorted(report["latency_us"].items()):
+            op, _, metric = key.partition("/")
+            for field, value in sorted(row.items()):
+                if field == "count":
+                    latency.add("_count", _labels(op=op, metric=metric), value)
+                elif field.endswith("_us"):
+                    q = int(field[1:-3]) / 100.0
+                    latency.add(
+                        "", _labels(op=op, metric=metric, quantile=f"{q:g}"), value
+                    )
+        if latency.samples:
+            families.append(latency)
+        if report["hbm_watermark_bytes"] is not None:
+            hbm = _Family(
+                "tm_hbm_watermark_bytes", "gauge",
+                "High-water mark of device bytes_in_use observed by the health monitor.",
+            )
+            hbm.add("", "", report["hbm_watermark_bytes"])
+            families.append(hbm)
+
+    smp = _series._SAMPLER
+    if smp is not None:
+        ticks = _Family(
+            "tm_series_ticks", "counter", "Sampler ticks taken since series.enable().",
+        )
+        ticks.add("_total", "", smp.ticks_taken)
+        families.append(ticks)
+        slo = _Family(
+            "tm_slo_violations", "counter",
+            "SLO violations observed across all sampler ticks.",
+        )
+        slo.add("_total", "", smp.slo_violations_total)
+        families.append(slo)
+        rates = _Family(
+            "tm_series_rate_per_second", "gauge",
+            "Per-second counter rates off the sampler's most recent tick.",
+        )
+        for scope, names in sorted(smp.rates().items()):
+            for name, rate in sorted(names.items()):
+                rates.add("", _labels(scope=scope, name=name), rate)
+        if rates.samples:
+            families.append(rates)
+
+    return "".join(f.render() for f in families)
+
+
+# ------------------------------------------------------------------ validator
+
+
+def validate_exposition(text: str) -> int:
+    """Structurally validate a text-format page; returns the sample count.
+
+    Dependency-free mirror of the exposition-format rules this module relies
+    on (what a strict scraper would reject): metric/label name charsets,
+    HELP/TYPE placement (TYPE precedes its samples, at most one per family),
+    known TYPE values, float-parseable sample values, counter samples ending
+    in ``_total``, summary samples restricted to the base name (with an
+    optional ``quantile`` label) plus ``_count``/``_sum``. Raises
+    ``ValueError`` on the first violation.
+    """
+    if not isinstance(text, str):
+        raise ValueError("exposition must be a string")
+    types: Dict[str, str] = {}
+    helped: set = set()
+    seen_samples = 0
+    sampled_families: set = set()
+    for lineno, line in enumerate(text.splitlines(), start=1):
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) < 3 or parts[1] not in ("HELP", "TYPE"):
+                raise ValueError(f"line {lineno}: malformed comment {line!r}")
+            kind, fam = parts[1], parts[2]
+            if not _NAME_RE.match(fam):
+                raise ValueError(f"line {lineno}: invalid family name {fam!r}")
+            if kind == "TYPE":
+                if len(parts) != 4 or parts[3] not in (
+                    "counter", "gauge", "summary", "histogram", "untyped"
+                ):
+                    raise ValueError(f"line {lineno}: invalid TYPE line {line!r}")
+                if fam in types:
+                    raise ValueError(f"line {lineno}: duplicate TYPE for {fam}")
+                if fam in sampled_families:
+                    raise ValueError(f"line {lineno}: TYPE for {fam} after its samples")
+                types[fam] = parts[3]
+            else:
+                if fam in helped:
+                    raise ValueError(f"line {lineno}: duplicate HELP for {fam}")
+                helped.add(fam)
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            raise ValueError(f"line {lineno}: malformed sample {line!r}")
+        name, labels, value = m.group("name"), m.group("labels"), m.group("value")
+        try:
+            float(value.replace("+Inf", "inf").replace("-Inf", "-inf"))
+        except ValueError:
+            raise ValueError(f"line {lineno}: non-numeric value {value!r}") from None
+        label_names = []
+        if labels:
+            consumed = _LABEL_RE.sub("", labels).replace(",", "").strip()
+            if consumed:
+                raise ValueError(f"line {lineno}: malformed labels {{{labels}}}")
+            label_names = [lm.group(1) for lm in _LABEL_RE.finditer(labels)]
+            for ln in label_names:
+                if not _LABEL_NAME_RE.match(ln):
+                    raise ValueError(f"line {lineno}: invalid label name {ln!r}")
+            if len(set(label_names)) != len(label_names):
+                raise ValueError(f"line {lineno}: duplicate label names in {line!r}")
+        family = _family_of(name, types)
+        if family is None:
+            raise ValueError(f"line {lineno}: sample {name!r} has no TYPE header")
+        kind = types[family]
+        suffix = name[len(family):]
+        if kind == "counter" and suffix != "_total":
+            raise ValueError(f"line {lineno}: counter sample {name!r} must end in _total")
+        if kind == "summary" and suffix not in ("", "_count", "_sum"):
+            raise ValueError(f"line {lineno}: invalid summary sample {name!r}")
+        if kind == "summary" and suffix == "" and "quantile" not in label_names:
+            raise ValueError(f"line {lineno}: summary sample {name!r} missing quantile label")
+        if kind == "gauge" and suffix != "":
+            raise ValueError(f"line {lineno}: gauge sample {name!r} must match its family")
+        sampled_families.add(family)
+        seen_samples += 1
+    return seen_samples
+
+
+def _family_of(sample_name: str, types: Dict[str, str]) -> Optional[str]:
+    """Longest declared family whose name prefixes this sample name."""
+    best = None
+    for fam in types:
+        if sample_name == fam or (
+            sample_name.startswith(fam)
+            and sample_name[len(fam):] in ("_total", "_count", "_sum", "_bucket")
+        ):
+            if best is None or len(fam) > len(best):
+                best = fam
+    return best
+
+
+# ---------------------------------------------------------------- http server
+
+
+class _MetricsHandler(BaseHTTPRequestHandler):
+    def do_GET(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler contract
+        if self.path.split("?", 1)[0] not in ("/metrics", "/"):
+            self.send_response(404)
+            self.end_headers()
+            return
+        try:
+            body = render().encode("utf-8")
+        except Exception as exc:  # noqa: BLE001 — a scrape must answer, not hang
+            self.send_response(500)
+            self.end_headers()
+            self.wfile.write(str(exc).encode("utf-8"))
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", CONTENT_TYPE)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args: Any) -> None:  # scrapes must not spam stderr
+        pass
+
+
+def start_server(port: int = 9464, host: str = "127.0.0.1") -> Tuple[str, int]:
+    """Serve ``GET /metrics`` on a daemon thread; returns ``(host, port)``.
+
+    ``port=0`` binds an ephemeral port (tests); the returned port is the one
+    actually bound. Idempotent: a second call replaces the first server.
+    """
+    global _SERVER, _SERVER_THREAD
+    stop_server()
+    server = ThreadingHTTPServer((host, port), _MetricsHandler)
+    server.daemon_threads = True
+    thread = threading.Thread(
+        target=server.serve_forever, name="tmscope-prom", daemon=True
+    )
+    thread.start()
+    _SERVER, _SERVER_THREAD = server, thread
+    return server.server_address[0], server.server_address[1]
+
+
+def stop_server() -> None:
+    global _SERVER, _SERVER_THREAD
+    server, thread = _SERVER, _SERVER_THREAD
+    _SERVER = _SERVER_THREAD = None
+    if server is not None:
+        server.shutdown()
+        server.server_close()
+    if thread is not None:
+        thread.join(timeout=5.0)
+
+
+def server_active() -> bool:
+    return _SERVER is not None

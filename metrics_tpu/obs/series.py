@@ -1,0 +1,238 @@
+"""Continuous telemetry series: a background sampler over the obs registry.
+
+``obs/health`` answers "how slow, at what tail" at the moment someone asks;
+a serving deployment needs the same signals as *time series* an external
+scraper can collect — launches/step over the last minute, p99 drift across a
+deploy, the HBM watermark climbing toward an OOM. This module runs a daemon
+thread that, every ``interval_s``, snapshots registry counter **deltas**
+(what happened since the previous tick, not since process start) plus the
+health monitor's percentile flushes into a fixed-capacity ring of ticks, and
+evaluates :func:`metrics_tpu.obs.health.check_slos` per tick — SLOs become
+continuous instead of call-site-driven.
+
+Tick layout (one dict per tick, oldest first in :func:`ticks`)::
+
+    {"t_unix": ..., "dt_s": ...,            # wall anchor + actual tick width
+     "counters": {scope: {name: delta}},    # numeric counter deltas
+     "timers":   {scope: {name: {count, total_s}}},  # timer deltas
+     "latency_us": {...},                   # health.report()["latency_us"]
+     "hbm_watermark_bytes": ...,            # health watermark (None w/o monitor)
+     "slo_violations": [...]}               # check_slos() result this tick
+
+Zero-overhead contract (the ``health.py`` discipline): module global
+``_SAMPLER`` stays ``None`` until :func:`enable` — no ring, no thread, no
+state before then — and the instrumented hot paths never call into this
+module at all (the sampler *pulls* from the registry; nothing pushes). The
+sampler's own work runs entirely off the hot path: ``registry.snapshot()``
+takes the registry lock briefly, and the health flush/percentile computes
+already suppress the obs gate during self-observation, so sampling never
+pollutes the counters it samples (same trick as ``health._flush_locked``).
+"""
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from metrics_tpu.obs import health as _health
+from metrics_tpu.obs import registry as _reg
+
+_SAMPLER: Optional["TelemetrySampler"] = None
+
+
+def _flatten(snap: Dict[str, Dict[str, Any]]) -> Dict[Tuple[str, str], Any]:
+    """``{scope: {name: value}}`` -> ``{(scope, name): value}`` for delta math."""
+    flat: Dict[Tuple[str, str], Any] = {}
+    for scope, counters in snap.items():
+        for name, value in counters.items():
+            flat[(scope, name)] = value
+    return flat
+
+
+class TelemetrySampler:
+    """Fixed-capacity ring of periodic registry/health ticks (module docstring).
+
+    Args:
+        interval_s: target seconds between ticks (the scrape cadence).
+        capacity: ticks retained — at the default 1 Hz, 600 ticks = 10 minutes
+            of history in a few hundred KB of plain dicts.
+        check_slos: evaluate the configured health SLO budget every tick
+            (violations land in the tick AND react per the budget's action).
+    """
+
+    def __init__(
+        self,
+        interval_s: float = 1.0,
+        capacity: int = 600,
+        check_slos: bool = True,
+    ) -> None:
+        if interval_s <= 0:
+            raise ValueError(f"interval_s must be positive, got {interval_s}")
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.interval_s = float(interval_s)
+        self.capacity = int(capacity)
+        self.check_slos = bool(check_slos)
+        self._ring: deque = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._prev_flat = _flatten(_reg.snapshot())
+        self._prev_t = time.time()
+        self.ticks_taken = 0
+        self.slo_violations_total = 0
+
+    # ------------------------------------------------------------- sampling
+
+    def tick(self) -> Dict[str, Any]:
+        """Take one sample now (the thread calls this; tests call it directly
+        for determinism). Returns the tick dict it appended."""
+        now = time.time()
+        snap_flat = _flatten(_reg.snapshot())
+        counters: Dict[str, Dict[str, float]] = {}
+        timers: Dict[str, Dict[str, Dict[str, float]]] = {}
+        for key, value in snap_flat.items():
+            scope, name = key
+            prev = self._prev_flat.get(key)
+            if isinstance(value, dict):
+                prev = prev if isinstance(prev, dict) else {}
+                d_count = value.get("count", 0) - prev.get("count", 0)
+                if d_count:
+                    timers.setdefault(scope, {})[name] = {
+                        "count": d_count,
+                        "total_s": value.get("total_s", 0.0) - prev.get("total_s", 0.0),
+                    }
+            else:
+                delta = value - (prev if isinstance(prev, (int, float)) else 0)
+                if delta:
+                    counters.setdefault(scope, {})[name] = delta
+
+        monitor = _health._MONITOR
+        latency: Dict[str, Any] = {}
+        hbm: Optional[int] = None
+        violations: List[Dict[str, Any]] = []
+        if monitor is not None:
+            # report() flushes residual buffers with the obs gate suppressed,
+            # so the sampler's own sketch computes never count themselves
+            health_report = monitor.report()
+            latency = health_report["latency_us"]
+            hbm = health_report["hbm_watermark_bytes"]
+            if self.check_slos:
+                violations = monitor.check_slos()
+
+        tick = {
+            "t_unix": now,
+            "dt_s": now - self._prev_t,
+            "counters": counters,
+            "timers": timers,
+            "latency_us": latency,
+            "hbm_watermark_bytes": hbm,
+            "slo_violations": violations,
+        }
+        with self._lock:
+            self._ring.append(tick)
+            self._prev_flat = snap_flat
+            self._prev_t = now
+            self.ticks_taken += 1
+            self.slo_violations_total += len(violations)
+        return tick
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 — telemetry must never kill the host
+                continue
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="tmscope-sampler", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self, join_timeout_s: float = 5.0) -> None:
+        self._stop.set()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=join_timeout_s)
+            self._thread = None
+
+    # ------------------------------------------------------------ reporting
+
+    def ticks(self, last: Optional[int] = None) -> List[Dict[str, Any]]:
+        """Snapshot of the tick ring, oldest first (optionally only the last N)."""
+        with self._lock:
+            out = list(self._ring)
+        return out if last is None else out[-last:]
+
+    def series(self, scope: str, name: str) -> List[Tuple[float, float]]:
+        """One counter's delta series as ``[(t_unix, delta), ...]`` — zeros
+        included so the series is dense over the retained window."""
+        out = []
+        for tick in self.ticks():
+            out.append((tick["t_unix"], float(tick["counters"].get(scope, {}).get(name, 0))))
+        return out
+
+    def rates(self) -> Dict[str, Dict[str, float]]:
+        """Per-second counter rates off the most recent tick (empty before the
+        first tick; a zero-width tick reports raw deltas)."""
+        last = self.ticks(last=1)
+        if not last:
+            return {}
+        tick = last[0]
+        dt = tick["dt_s"] if tick["dt_s"] > 0 else 1.0
+        return {
+            scope: {name: delta / dt for name, delta in counters.items()}
+            for scope, counters in tick["counters"].items()
+        }
+
+
+# ----------------------------------------------------------- module facade
+
+
+def enable(
+    interval_s: float = 1.0,
+    capacity: int = 600,
+    check_slos: bool = True,
+    start_thread: bool = True,
+    enable_obs: bool = True,
+) -> TelemetrySampler:
+    """Allocate and start the sampler (idempotent: replaces any previous).
+
+    ``start_thread=False`` allocates the ring but leaves ticking to explicit
+    :meth:`TelemetrySampler.tick` calls — the deterministic mode tests (and
+    callers with their own scheduler) use.
+    """
+    global _SAMPLER
+    disable()
+    sampler = TelemetrySampler(
+        interval_s=interval_s, capacity=capacity, check_slos=check_slos
+    )
+    _SAMPLER = sampler
+    if enable_obs:
+        _reg.enable()
+    if start_thread:
+        sampler.start()
+    return sampler
+
+
+def disable() -> None:
+    """Stop the thread and free the ring (back to the zero-overhead default)."""
+    global _SAMPLER
+    sampler = _SAMPLER
+    _SAMPLER = None
+    if sampler is not None:
+        sampler.stop()
+
+
+def active() -> bool:
+    return _SAMPLER is not None
+
+
+def sampler() -> Optional[TelemetrySampler]:
+    return _SAMPLER
+
+
+def ticks(last: Optional[int] = None) -> List[Dict[str, Any]]:
+    return _SAMPLER.ticks(last=last) if _SAMPLER is not None else []

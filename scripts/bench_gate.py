@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""bench_gate: fail CI when the newest bench round regresses the trajectory.
+
+Parses the checked-in ``BENCH_r*.json`` rounds into backend-normalized
+per-(config, field) series (``metrics_tpu.analysis.bench_history``) and gates
+the newest round against the best earlier same-backend measurement of each
+series. Exit 1 on any >threshold regression, 0 otherwise.
+
+Usage::
+
+    python scripts/bench_gate.py                  # gate ./BENCH_r*.json
+    python scripts/bench_gate.py --dir path/      # gate another trajectory
+    python scripts/bench_gate.py --round 7        # gate a specific round
+    python scripts/bench_gate.py --threshold 0.2  # loosen the bar
+    python scripts/bench_gate.py --json           # machine-readable report
+
+Stdlib-only on the CLI side so the gate runs before (and regardless of) any
+accelerator runtime coming up.
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from metrics_tpu.analysis import bench_history as bh  # noqa: E402
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="bench_gate.py",
+        description="Gate the newest BENCH_r*.json round against the best"
+        " earlier same-backend measurement of every (config, field) series.",
+    )
+    parser.add_argument(
+        "--dir",
+        default=".",
+        help="directory holding BENCH_r*.json rounds (default: cwd)",
+    )
+    parser.add_argument(
+        "--round",
+        type=int,
+        default=None,
+        help="round number to gate (default: the newest round present)",
+    )
+    parser.add_argument(
+        "--threshold",
+        type=float,
+        default=bh.DEFAULT_THRESHOLD,
+        help="relative regression bar (default: %(default)s = 15%%)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="emit the full trajectory report as JSON"
+    )
+    args = parser.parse_args(argv)
+
+    paths = bh.discover(args.dir)
+    if not paths:
+        print(f"bench_gate: no BENCH_r*.json rounds under {args.dir!r}", file=sys.stderr)
+        return 2
+    rounds = bh.load_rounds(paths)
+    series = bh.build_series(rounds)
+    gated = args.round if args.round is not None else max(r.num for r in rounds)
+    if gated not in {r.num for r in rounds}:
+        print(f"bench_gate: round {gated} not found in trajectory", file=sys.stderr)
+        return 2
+    regressions = bh.find_regressions(series, gated, threshold=args.threshold)
+
+    if args.json:
+        report = bh.trajectory_report(rounds, threshold=args.threshold)
+        report["gated_round"] = gated
+        report["regressions"] = [r._asdict() for r in regressions]
+        print(json.dumps(report, indent=2))
+    else:
+        print(
+            f"bench_gate: {len(rounds)} rounds, {len(series)} series,"
+            f" gating r{gated:02d} at {args.threshold:.0%}"
+        )
+        for (backend, cfg, field), points in sorted(series.items()):
+            vals = " -> ".join(f"r{p.round_num:02d}:{p.value:g}" for p in points)
+            unit = points[-1].unit or "?"
+            print(f"  [{backend}] {cfg}/{field} ({unit}): {vals}")
+        for reg in regressions:
+            print(
+                f"REGRESSION [{reg.backend}] {reg.config}/{reg.field}:"
+                f" r{reg.round_num:02d}={reg.value:g} is {reg.change_pct:.1f}% worse"
+                f" than best r{reg.best_round:02d}={reg.best:g} ({reg.unit})"
+            )
+        if not regressions:
+            print(f"OK: r{gated:02d} does not regress any same-backend series")
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

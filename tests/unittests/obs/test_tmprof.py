@@ -8,6 +8,7 @@ step dir, Perfetto structural validity, SLO budget reactions, the seeded
 the registry/recompile two-thread stress, the JSONL schema_version contract,
 and the bench summary enabled-state regression.
 """
+import glob
 import json
 import os
 import signal
@@ -628,8 +629,10 @@ def test_flight_dump_survives_preemption_kill(tmp_path):
     )
     assert proc.returncode == -signal.SIGTERM, (proc.returncode, proc.stdout, proc.stderr)
     assert "SHOULD-NOT-REACH" not in proc.stdout
-    assert os.path.exists(dump_path), proc.stderr
-    payload = json.loads(open(dump_path).read())
+    # handler dumps carry the rank+pid disambiguation suffix (-h0000-p<pid>)
+    dumps = glob.glob(str(tmp_path / "flight-dump-h0000-p*.json"))
+    assert dumps, proc.stderr
+    payload = json.loads(open(dumps[0]).read())
     kinds = [e["kind"] for e in payload["events"]]
     assert kinds.count("dispatch") == 3, "all three updates survive in the window"
     assert "ckpt_save_begin" in kinds
@@ -651,7 +654,7 @@ def test_signal_handler_chains_and_uninstalls(tmp_path):
         obs.flight.record("probe")
         os.kill(os.getpid(), signal.SIGUSR1)
         assert calls == ["prev"], "previous handler must be chained"
-        assert os.path.exists(dump_path)
+        assert os.path.exists(obs.flight.failure_dump_path())
         obs.flight.disable()
         calls.clear()
         os.kill(os.getpid(), signal.SIGUSR1)

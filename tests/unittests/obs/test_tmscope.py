@@ -1,0 +1,510 @@
+"""tmscope tests: series sampler, Prometheus exposition, cross-host
+aggregation, and the bench-trajectory regression gate (ISSUE 11).
+
+Covers the acceptance criteria directly: zero-overhead boom proofs for every
+new surface while disabled, exposition-format validator round-trips, exact
+two-"host" sketch merges, and the gate fixtures (seeded 20% regression -> 1,
+clean trajectory and the real checked-in history -> 0).
+"""
+import json
+import os
+import shutil
+import subprocess
+import sys
+import urllib.request
+
+import jax.numpy as jnp
+import pytest
+
+import metrics_tpu.obs as obs
+from metrics_tpu.analysis import bench_history as bh
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.obs import aggregate as obs_aggregate
+from metrics_tpu.obs import health as obs_health
+from metrics_tpu.obs import prom as obs_prom
+from metrics_tpu.obs import series as obs_series
+
+pytestmark = pytest.mark.obs
+
+_REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_tmscope():
+    obs.disable()
+    obs.series.disable()
+    obs.prom.stop_server()
+    obs.health.disable()
+    obs.flight.disable()
+    obs.REGISTRY.clear()
+    obs.reset_class_detector()
+    yield
+    obs.disable()
+    obs.series.disable()
+    obs.prom.stop_server()
+    obs.health.disable()
+    obs.flight.disable()
+    obs.REGISTRY.clear()
+    obs.reset_class_detector()
+
+
+class StreamMean(Metric):
+    full_state_update = False
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("count", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, x):
+        self.total = self.total + jnp.sum(x)
+        self.count = self.count + x.size
+
+    def compute(self):
+        return self.total / jnp.maximum(self.count, 1)
+
+
+# ------------------------------------------------------ zero-overhead proofs
+
+
+def test_disabled_mode_allocates_nothing(monkeypatch):
+    """Gate off: no sampler, no server, and the hot paths never call into any
+    tmscope surface (boom-monkeypatch proof, not timing)."""
+    assert obs_series._SAMPLER is None
+    assert obs_prom._SERVER is None
+    assert not obs.series.active()
+    assert not obs.prom.server_active()
+
+    def boom(*a, **k):  # noqa: ANN001
+        raise AssertionError("tmscope surface touched with obs disabled")
+
+    monkeypatch.setattr(obs_series.TelemetrySampler, "tick", boom)
+    monkeypatch.setattr(obs_prom, "render", boom)
+    monkeypatch.setattr(obs_aggregate, "host_snapshot", boom)
+    m = StreamMean()
+    m.update(jnp.ones(3))
+    assert float(m.compute()) == 1.0
+    assert obs.series.ticks() == []
+
+
+def test_series_disable_is_idempotent_and_frees_state():
+    obs.series.enable(start_thread=False)
+    assert obs.series.active()
+    obs.series.disable()
+    obs.series.disable()
+    assert obs_series._SAMPLER is None
+    assert obs.series.ticks() == []
+
+
+# ---------------------------------------------------------------- series.py
+
+
+def test_sampler_records_counter_deltas_not_totals():
+    obs.series.enable(start_thread=False)
+    smp = obs.series.sampler()
+    obs.REGISTRY.inc("fused", "launches", 5)
+    t1 = smp.tick()
+    assert t1["counters"]["fused"]["launches"] == 5
+    obs.REGISTRY.inc("fused", "launches", 2)
+    t2 = smp.tick()
+    assert t2["counters"]["fused"]["launches"] == 2, "deltas, not running totals"
+    t3 = smp.tick()
+    assert "fused" not in t3["counters"], "quiet tick carries no zero spam"
+    series = smp.series("fused", "launches")
+    assert [v for _, v in series] == [5.0, 2.0, 0.0], "dense over the window"
+
+
+def test_sampler_ring_capacity_bounds_history():
+    obs.series.enable(capacity=3, start_thread=False)
+    smp = obs.series.sampler()
+    for i in range(7):
+        obs.REGISTRY.inc("s", "n", i + 1)
+        smp.tick()
+    ticks = obs.series.ticks()
+    assert len(ticks) == 3
+    assert smp.ticks_taken == 7
+    assert [t["counters"]["s"]["n"] for t in ticks] == [5, 6, 7], "oldest evicted"
+
+
+def test_sampler_timer_deltas_and_rates():
+    obs.series.enable(start_thread=False)
+    smp = obs.series.sampler()
+    with obs.stopwatch("bench", "step"):
+        pass
+    tick = smp.tick()
+    assert tick["timers"]["bench"]["step"]["count"] == 1
+    assert tick["timers"]["bench"]["step"]["total_s"] >= 0
+    obs.REGISTRY.inc("fused", "launches", 10)
+    smp.tick()
+    rates = smp.rates()
+    assert rates["fused"]["launches"] > 0
+
+
+def test_sampler_evaluates_slos_per_tick():
+    obs.health.enable()
+    obs.health.set_slo(max_retraces_per_window=0, action=lambda v: None)
+    obs.series.enable(start_thread=False)
+    smp = obs.series.sampler()
+    obs.REGISTRY.inc("StreamMean", "retraces", 3)
+    tick = smp.tick()
+    assert [v["slo"] for v in tick["slo_violations"]] == ["max_retraces_per_window"]
+    assert smp.slo_violations_total == 1
+    tick2 = smp.tick()  # window closed by the check: next tick is clean
+    assert tick2["slo_violations"] == []
+
+
+def test_sampler_thread_ticks_and_stops():
+    obs.series.enable(interval_s=0.02, start_thread=True)
+    smp = obs.series.sampler()
+    deadline = 200
+    while smp.ticks_taken < 2 and deadline:
+        deadline -= 1
+        smp._stop.wait(0.02)
+    assert smp.ticks_taken >= 2, "background thread must tick on its own"
+    obs.series.disable()
+    assert smp._thread is None
+
+
+def test_sampler_validates_args():
+    with pytest.raises(ValueError):
+        obs_series.TelemetrySampler(interval_s=0)
+    with pytest.raises(ValueError):
+        obs_series.TelemetrySampler(capacity=0)
+
+
+# ------------------------------------------------------------------ prom.py
+
+
+def test_render_disabled_is_minimal_and_valid():
+    page = obs.prom.render()
+    assert "tm_obs_enabled 0" in page
+    assert obs.prom.validate_exposition(page) == 1
+
+
+def test_render_roundtrips_through_validator_with_health():
+    obs.health.enable(flush_every=4)
+    obs.series.enable(start_thread=False)
+    obs.REGISTRY.inc("fused", "launches", 7)
+    with obs.stopwatch("bench", "step"):
+        pass
+    mon = obs.health.monitor()
+    for i in range(16):
+        mon.observe_latency("update", "StreamMean", 0.001 * (i + 1))
+    obs.series.sampler().tick()
+    page = obs.prom.render()
+    assert obs.prom.validate_exposition(page) > 5
+    assert 'tm_events_total{name="launches",scope="fused"} 7' in page
+    assert 'tm_latency_microseconds{metric="StreamMean",op="update",quantile="0.5"}' in page
+    assert 'quantile="0.99"' in page
+    assert "tm_latency_microseconds_count" in page
+    assert "tm_scope_seconds_count" in page
+    assert "tm_series_ticks_total 1" in page
+
+
+def test_validator_rejects_malformed_pages():
+    cases = [
+        "tm_x 1\n",  # sample without TYPE header
+        "# TYPE tm_x counter\ntm_x 1\n",  # counter not ending _total
+        "# TYPE tm_x gauge\ntm_x{bad-label=\"v\"} 1\n",
+        "# TYPE tm_x gauge\ntm_x abc\n",
+        "# TYPE tm_x summary\ntm_x 1\n",  # summary sample missing quantile
+        "# TYPE tm_x gauge\n# TYPE tm_x gauge\ntm_x 1\n",  # duplicate TYPE
+        "tm_y 1\n# TYPE tm_y gauge\ntm_y 1\n",  # TYPE after samples
+        "# TYPE tm_x wat\n",
+    ]
+    for page in cases:
+        with pytest.raises(ValueError):
+            obs.prom.validate_exposition(page)
+
+
+def test_label_escaping_survives_validation():
+    obs.enable()
+    obs.REGISTRY.inc('we"ird\\scope', "n")
+    page = obs.prom.render()
+    assert obs.prom.validate_exposition(page) >= 2
+
+
+def test_scrape_endpoint_serves_valid_exposition():
+    obs.series.enable(start_thread=False)
+    obs.REGISTRY.inc("fleet", "routed_launches", 3)
+    obs.series.sampler().tick()
+    host, port = obs.prom.start_server(port=0)
+    try:
+        with urllib.request.urlopen(f"http://{host}:{port}/metrics", timeout=10) as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"] == obs_prom.CONTENT_TYPE
+            body = r.read().decode("utf-8")
+        assert obs.prom.validate_exposition(body) > 0
+        assert "routed_launches" in body
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"http://{host}:{port}/nope", timeout=10)
+    finally:
+        obs.prom.stop_server()
+    assert not obs.prom.server_active()
+
+
+def test_instrumented_fused_fleet_scrape_has_per_op_quantiles():
+    """Acceptance: a scrape of an instrumented fused+fleet run passes the
+    validator and carries per-(op, metric) p50/p99."""
+    from metrics_tpu.core.collections import MetricCollection
+
+    obs.enable()
+    obs.health.enable(flush_every=4)
+    obs.series.enable(start_thread=False)
+    coll = MetricCollection({"mean": StreamMean()}, fused=True)
+    fleet = StreamMean(fleet_size=4)
+    for i in range(6):
+        coll.update(jnp.ones(8) * i)
+        fleet.update(jnp.ones(4), stream_ids=jnp.arange(4) % 4)
+    coll.compute()
+    obs.series.sampler().tick()
+    page = obs.prom.render()
+    assert obs.prom.validate_exposition(page) > 0
+    assert 'op="update"' in page
+    assert 'quantile="0.5"' in page and 'quantile="0.99"' in page
+    assert "tm_latency_microseconds_count" in page
+
+
+# ------------------------------------------------------------- aggregate.py
+
+
+def _host_snapshot(rank, world, values, launches):
+    obs.REGISTRY.clear()
+    obs.health.disable()
+    mon = obs.health.enable(flush_every=8)
+    obs.REGISTRY.inc("fused", "launches", launches)
+    for v in values:
+        mon.observe_latency("update", "StreamMean", v)
+    snap = obs.aggregate.host_snapshot()
+    snap["host"], snap["world"] = rank, world
+    obs.health.disable()
+    obs.disable()
+    obs.REGISTRY.clear()
+    return json.loads(json.dumps(snap))  # force a real serialization boundary
+
+
+def test_two_host_aggregate_merges_sketches_exactly():
+    va = [0.001 * (i + 1) for i in range(40)]
+    vb = [0.002 * (i + 1) for i in range(56)]
+    sa = _host_snapshot(0, 2, va, launches=5)
+    sb = _host_snapshot(1, 2, vb, launches=7)
+    fleet = obs.aggregate.aggregate([sa, sb])
+
+    assert fleet["hosts"] == 2 and fleet["world"] == 2
+    assert fleet["counters"]["fused"]["launches"] == 12
+    assert [h["host"] for h in fleet["per_host"]] == [0, 1]
+
+    # exactness: merged sketch state must be bit-identical to one sketch that
+    # ingested both hosts' streams (sum-reduced int32 state; base.py invariant)
+    mon = obs.health.enable(flush_every=8)
+    for v in va + vb:
+        mon.observe_latency("update", "StreamMean", v)
+    ref = mon.export_sketches()["update/StreamMean"]
+    merged = fleet["latency_sketches"]["update/StreamMean"]
+    assert merged["state"] == ref["state"]
+    assert merged["count"] == ref["count"] == 96
+    row = fleet["latency_us"]["update/StreamMean"]
+    assert row["count"] == 96
+    assert row["p50_us"] > 0 and row["p99_us"] >= row["p50_us"]
+
+
+def test_aggregate_is_associative_across_levels():
+    snaps = [
+        _host_snapshot(r, 3, [0.001 * (r + 1)] * 24, launches=r + 1) for r in range(3)
+    ]
+    flat = obs.aggregate.aggregate(snaps)
+    nested_tail = obs.aggregate.aggregate(snaps[1:])
+    assert flat["counters"]["fused"]["launches"] == 6
+    assert nested_tail["counters"]["fused"]["launches"] == 5
+    lhs = flat["latency_sketches"]["update/StreamMean"]["state"]
+    pair = obs.aggregate.aggregate([snaps[0]])
+    merged = {
+        k: obs_aggregate._add_leaves(
+            pair["latency_sketches"]["update/StreamMean"]["state"][k],
+            nested_tail["latency_sketches"]["update/StreamMean"]["state"][k],
+        )
+        for k in lhs
+    }
+    assert merged == lhs, "rack -> pod -> fleet composition is exact"
+
+
+def test_aggregate_rejects_mismatched_sketch_params():
+    sa = _host_snapshot(0, 2, [0.001] * 16, launches=1)
+    sb = _host_snapshot(1, 2, [0.001] * 16, launches=1)
+    sb["latency_sketches"]["update/StreamMean"]["params"]["bits"] = 12
+    with pytest.raises(ValueError, match="disagree on sketch params"):
+        obs.aggregate.aggregate([sa, sb])
+
+
+def test_aggregate_watermark_max_and_world1_fallback():
+    sa = _host_snapshot(0, 2, [0.001] * 8, launches=1)
+    sb = _host_snapshot(1, 2, [0.001] * 8, launches=1)
+    sa["hbm_watermark_bytes"], sb["hbm_watermark_bytes"] = 100, 300
+    fleet = obs.aggregate.aggregate([sa, sb])
+    assert fleet["hbm_watermark_bytes"] == 300
+
+    solo = obs.aggregate.fleet_snapshot()  # world==1 degenerate case
+    assert solo["hosts"] == 1
+    assert solo["latency_us"] == {}
+
+
+def test_publish_aggregate_dir_roundtrip(tmp_path):
+    sa = _host_snapshot(0, 2, [0.001 * (i + 1) for i in range(16)], launches=2)
+    sb = _host_snapshot(1, 2, [0.003] * 16, launches=4)
+    obs.aggregate.publish(str(tmp_path), sa)
+    obs.aggregate.publish(str(tmp_path), sb)
+    assert sorted(os.listdir(tmp_path)) == ["obs-h0000.json", "obs-h0001.json"]
+    fleet = obs.aggregate.aggregate_dir(str(tmp_path), expect_world=2)
+    assert fleet["counters"]["fused"]["launches"] == 6
+    with pytest.raises(ValueError, match="expected 3"):
+        obs.aggregate.aggregate_dir(str(tmp_path), expect_world=3)
+
+
+# ------------------------------------------------------------- bench gate
+
+
+def test_direction_of_units():
+    assert bh.direction_of("Gpreds/s/chip") == 1
+    assert bh.direction_of("images/s") == 1
+    assert bh.direction_of("ms/step") == -1
+    assert bh.direction_of("ms") == -1
+    assert bh.direction_of("s") == -1
+    assert bh.direction_of("configs") == 0
+    assert bh.direction_of(None) == 0
+
+
+def _round_file(tmp_path, num, backend, summary, rc=0):
+    payload = {
+        "n": num,
+        "cmd": "python bench.py",
+        "rc": rc,
+        "tail": "",
+        "parsed": {
+            "metric": "summary_all_configs",
+            "value": len(summary),
+            "unit": "configs",
+            "summary": summary,
+        },
+    }
+    if backend is not None:
+        payload["backend"] = backend
+    path = tmp_path / f"BENCH_r{num:02d}.json"
+    path.write_text(json.dumps(payload))
+    return str(path)
+
+
+def test_gate_flags_seeded_regression_and_passes_clean(tmp_path):
+    base = {"fused_collection_step": {"value": 10.0, "unit": "ms/step"}}
+    _round_file(tmp_path, 1, "cpu", base)
+    _round_file(
+        tmp_path, 2, "cpu", {"fused_collection_step": {"value": 12.0, "unit": "ms/step"}}
+    )
+    rounds = bh.load_rounds(bh.discover(str(tmp_path)))
+    series = bh.build_series(rounds)
+    regs = bh.find_regressions(series, 2)
+    assert len(regs) == 1 and regs[0].change_pct == 20.0
+    assert regs[0].best_round == 1
+
+    # clean: 12 -> 10.5 is within 15% of best 10.0
+    _round_file(
+        tmp_path, 3, "cpu", {"fused_collection_step": {"value": 10.5, "unit": "ms/step"}}
+    )
+    rounds = bh.load_rounds(bh.discover(str(tmp_path)))
+    assert bh.find_regressions(bh.build_series(rounds), 3) == []
+
+
+def test_gate_normalizes_by_backend(tmp_path):
+    _round_file(tmp_path, 1, None, {"x": {"value": 100.0, "unit": "Gpreds/s/chip"}})
+    # CPU round 50x slower than the TPU number must NOT gate against it
+    _round_file(tmp_path, 2, "cpu", {"x": {"value": 2.0, "unit": "Gpreds/s/chip"}})
+    rounds = bh.load_rounds(bh.discover(str(tmp_path)))
+    assert rounds[0].backend == bh.LEGACY_BACKEND
+    assert bh.find_regressions(bh.build_series(rounds), 2) == []
+    # but a same-backend CPU regression in round 3 gates against round 2
+    _round_file(tmp_path, 3, "cpu", {"x": {"value": 1.0, "unit": "Gpreds/s/chip"}})
+    rounds = bh.load_rounds(bh.discover(str(tmp_path)))
+    regs = bh.find_regressions(bh.build_series(rounds), 3)
+    assert len(regs) == 1 and regs[0].backend == "cpu" and regs[0].best == 2.0
+
+
+def test_gate_reads_env_stamp_and_split_fields(tmp_path):
+    payload = {
+        "n": 1,
+        "rc": 0,
+        "tail": "",
+        "parsed": {
+            "metric": "summary_all_configs",
+            "value": 1,
+            "unit": "configs",
+            "summary": {
+                "exact_auroc_throughput": {
+                    "value": 0.2,
+                    "unit": "Gsamples/s/chip",
+                    "sort_ms": 125.0,
+                    "post_sort_ms": 30.0,
+                }
+            },
+            "env": {"backend": "tpu", "jax_version": "0.9", "device_kind": "v4"},
+        },
+    }
+    p = tmp_path / "BENCH_r01.json"
+    p.write_text(json.dumps(payload))
+    rnd = bh.parse_round(str(p))
+    assert rnd.backend == "tpu", "backend comes from the bench.py env stamp"
+    fields = rnd.measurements["exact_auroc_throughput"]
+    assert fields["sort_ms"] == (125.0, "ms")
+    assert fields["post_sort_ms"] == (30.0, "ms")
+    # a 20% sort_ms regression is gated even when the headline value holds
+    payload["parsed"]["summary"]["exact_auroc_throughput"]["sort_ms"] = 150.0
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(payload))
+    rounds = bh.load_rounds(bh.discover(str(tmp_path)))
+    regs = bh.find_regressions(bh.build_series(rounds), 2)
+    assert [r.field for r in regs] == ["sort_ms"]
+
+
+def test_errored_rounds_and_error_rows_are_excluded(tmp_path):
+    _round_file(tmp_path, 1, "cpu", {"x": {"value": 5.0, "unit": "ms"}}, rc=1)
+    _round_file(
+        tmp_path, 2, "cpu", {"x": {"error": "timeout"}, "y": {"value": 1.0, "unit": "ms"}}
+    )
+    rounds = bh.load_rounds(bh.discover(str(tmp_path)))
+    assert rounds[0].measurements == {}, "rc!=0 rounds contribute nothing"
+    assert sorted(rounds[1].measurements) == ["y"], "error rows are skipped"
+
+
+@pytest.mark.slow
+def test_bench_gate_cli_real_history_and_seeded_fixture(tmp_path):
+    """Acceptance: exit 0 on the real BENCH_r01-r07 history, exit 1 on a
+    fixture with a seeded 20% same-backend regression."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    real = subprocess.run(
+        [sys.executable, "scripts/bench_gate.py", "--dir", _REPO],
+        capture_output=True, text=True, timeout=120, cwd=_REPO, env=env,
+    )
+    assert real.returncode == 0, real.stdout + real.stderr
+
+    for name in sorted(os.listdir(_REPO)):
+        if name.startswith("BENCH_r") and name.endswith(".json"):
+            shutil.copy(os.path.join(_REPO, name), tmp_path)
+    nums = [
+        int(n[7:-5]) for n in os.listdir(tmp_path) if n.startswith("BENCH_r")
+    ]
+    seeded = max(nums) + 1
+    _round_file(
+        tmp_path, seeded, "cpu",
+        {"fleet_update_step": {"value": 5.569 * 1.2, "unit": "ms/step"}},
+    )
+    fixture = subprocess.run(
+        [sys.executable, "scripts/bench_gate.py", "--dir", str(tmp_path)],
+        capture_output=True, text=True, timeout=120, cwd=_REPO, env=env,
+    )
+    assert fixture.returncode == 1, fixture.stdout + fixture.stderr
+    assert "REGRESSION" in fixture.stdout
+    report = subprocess.run(
+        [sys.executable, "scripts/bench_gate.py", "--dir", str(tmp_path), "--json"],
+        capture_output=True, text=True, timeout=120, cwd=_REPO, env=env,
+    )
+    parsed = json.loads(report.stdout)
+    assert parsed["regressions"][0]["config"] == "fleet_update_step"
