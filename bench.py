@@ -1105,6 +1105,118 @@ def bench_fleet(fleet_sizes=(16, 256, 4096), rows_per_stream: int = 8,
     }
 
 
+def bench_chaos(n: int = 1 << 18, steps: int = 8, trials: int = 5) -> dict:
+    """``--chaos``: what graceful degradation actually costs (metrics_tpu.fault).
+
+    Three numbers off the tmfault runtime, all measured with real injected
+    faults (FaultSchedule), none inferred:
+
+    - degraded-mode step p50: the canonical fused collection after a
+      ``fused.launch`` fault demoted it to the eager path, vs the healthy
+      fused p50 on identical buffers (``vs_baseline`` = fused/degraded, <1
+      means degraded mode is paying the eager dispatch tax);
+    - ckpt save p50 with exactly one injected ``ckpt.write`` retry vs the
+      clean save p50 — the backoff+rewrite premium;
+    - recovery latency: wall time from a faulted fused launch to the first
+      good ``compute()`` value (demote + same-step eager re-run + compute).
+    """
+    import os
+    import tempfile
+    import warnings as _warnings
+
+    from metrics_tpu import fault as _fault
+    from metrics_tpu.ckpt import save_checkpoint
+    from metrics_tpu.core.fused import canonical_collection
+
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+    preds = jax.random.uniform(k1, (n,), jnp.float32)
+    target = jax.random.randint(k2, (n,), 0, 2, dtype=jnp.int32)
+
+    def leaders_ready(coll):
+        for cg in coll._groups.values():
+            m = coll._modules[cg[0]]
+            jax.block_until_ready(jax.tree_util.tree_leaves(m.state_pytree()))
+
+    def step_p50(coll, label):
+        def one_pass():
+            coll.reset()
+            with _obs().stopwatch("bench", label) as sw:
+                for _ in range(steps):
+                    coll.update(preds, target)
+                leaders_ready(coll)
+            return sw.elapsed / steps * 1000
+        return statistics.median(one_pass() for _ in range(trials))
+
+    # healthy fused path
+    fused_coll = canonical_collection(fused=True)
+    fused_coll.update(preds, target)
+    leaders_ready(fused_coll)
+    fused_ms = step_p50(fused_coll, "chaos_bench_fused")
+
+    # degraded path: one injected launch fault pins every group eager
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("ignore")
+        degraded_coll = canonical_collection(fused=True)
+        with _fault.FaultSchedule(fire_at={"fused.launch": 0}):
+            degraded_coll.update(preds, target)
+        leaders_ready(degraded_coll)
+        degraded_ms = step_p50(degraded_coll, "chaos_bench_degraded")
+
+    # ckpt save p50: clean, and with exactly one injected write retry
+    from metrics_tpu.classification import MulticlassConfusionMatrix
+
+    ck_metric = MulticlassConfusionMatrix(num_classes=64)
+    ck_metric.update(
+        jax.random.randint(k1, (1 << 16,), 0, 64, dtype=jnp.int32),
+        jax.random.randint(k2, (1 << 16,), 0, 64, dtype=jnp.int32),
+    )
+
+    def timed_save(with_retry):
+        with tempfile.TemporaryDirectory() as d:
+            t0 = time.perf_counter()
+            if with_retry:
+                with _fault.FaultSchedule(fire_at={"ckpt.write": 0}):
+                    save_checkpoint(ck_metric, os.path.join(d, "ck"), step=0,
+                                    retry_backoff_s=0.001)
+            else:
+                save_checkpoint(ck_metric, os.path.join(d, "ck"), step=0)
+            return (time.perf_counter() - t0) * 1000
+
+    save_clean_ms = statistics.median(timed_save(False) for _ in range(trials))
+    save_retry_ms = statistics.median(timed_save(True) for _ in range(trials))
+
+    # recovery-to-first-good-compute after a launch failure
+    def recovery_once():
+        with _warnings.catch_warnings():
+            _warnings.simplefilter("ignore")
+            coll = canonical_collection(fused=True)
+            coll.update(preds, target)  # warm the fused executable
+            leaders_ready(coll)
+            t0 = time.perf_counter()
+            with _fault.FaultSchedule(fire_at={"fused.launch": 0}):
+                coll.update(preds, target)  # faults, demotes, re-runs eagerly
+            jax.block_until_ready(list(coll.compute().values()))
+            return (time.perf_counter() - t0) * 1000
+
+    recovery_ms = statistics.median(recovery_once() for _ in range(trials))
+
+    return {
+        "metric": "chaos_degraded_step",
+        "value": round(degraded_ms, 3),
+        "unit": "ms/step",
+        "vs_baseline": round(fused_ms / degraded_ms, 2),
+        "fused_ms_per_step": round(fused_ms, 3),
+        "ckpt_save_clean_p50_ms": round(save_clean_ms, 3),
+        "ckpt_save_1retry_p50_ms": round(save_retry_ms, 3),
+        "recovery_to_first_compute_ms": round(recovery_ms, 3),
+        "bound": "degraded mode pays the eager tier's per-group dispatches"
+                 " (bench_fused's eager bound); the retried save pays one full"
+                 " payload rewrite + backoff; recovery is one demoted eager"
+                 " re-run plus compute — no state is lost, so there is no"
+                 " replay term",
+    }
+
+
 def bench_sketch(sizes=(1 << 20, 1 << 24), trials: int = 3) -> dict:
     """``--sketch``: the mergeable sketch family (metrics_tpu/sketches/) —
     update throughput, compute latency, and merge cost at 2^20 and 2^24 elems.
@@ -1398,7 +1510,7 @@ if __name__ == "__main__":
     parser = argparse.ArgumentParser(description="metrics_tpu benchmarks")
     parser.add_argument(
         "--config",
-        choices=("accuracy", "logits", "confmat", "map", "ssim", "retrieval", "auroc", "fid", "fused", "fleet", "sketch", "lint", "obs_trace", "all"),
+        choices=("accuracy", "logits", "confmat", "map", "ssim", "retrieval", "auroc", "fid", "fused", "fleet", "sketch", "chaos", "lint", "obs_trace", "all"),
         default="all",
     )
     parser.add_argument(
@@ -1424,6 +1536,16 @@ if __name__ == "__main__":
         " one Metric(fleet_size=N) routed launch (core/fleet.py) at N in"
         " {16, 256, 4096} — update p50, launches/step from the obs"
         " `dispatches` counter, and state HBM bytes (also runs under"
+        " --config all)",
+    )
+    parser.add_argument(
+        "--chaos",
+        action="store_true",
+        help="also run the tmfault degradation bench (metrics_tpu/fault/):"
+        " degraded-mode (eager-fallback) step p50 vs the healthy fused p50,"
+        " ckpt save p50 with one injected write retry vs clean, and the"
+        " recovery-to-first-good-compute latency after a launch failure — all"
+        " driven by real FaultSchedule injections (also runs under"
         " --config all)",
     )
     parser.add_argument(
@@ -1499,6 +1621,7 @@ if __name__ == "__main__":
         ("fused", bench_fused),
         ("fleet", bench_fleet),
         ("sketch", bench_sketch),
+        ("chaos", bench_chaos),
         ("ckpt", bench_ckpt),
         ("lint", bench_lint),
         ("san", bench_san),
@@ -1514,11 +1637,13 @@ if __name__ == "__main__":
             continue
         if name == "sketch" and not (cli.sketch or config in ("sketch", "all")):
             continue
+        if name == "chaos" and not (cli.chaos or config in ("chaos", "all")):
+            continue
         if name == "lint" and not (cli.lint_overhead or config in ("lint", "all")):
             continue
         if name == "san" and not (cli.san_overhead or config == "all"):
             continue
-        if config in (name, "all") or name in ("ckpt", "fused", "fleet", "sketch", "lint", "san", "obs_trace"):
+        if config in (name, "all") or name in ("ckpt", "fused", "fleet", "sketch", "chaos", "lint", "san", "obs_trace"):
             try:
                 result = fn()
                 summary[result["metric"]] = {

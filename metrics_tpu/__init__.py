@@ -6,7 +6,7 @@ kernels, and jax.lax collectives over device meshes instead of NCCL process grou
 """
 __version__ = "0.1.0"
 
-from metrics_tpu import ckpt, functional, obs
+from metrics_tpu import ckpt, fault, functional, obs
 
 from metrics_tpu.classification import (
     AUROC,
@@ -277,6 +277,7 @@ __all__ = [
     "functional",
     "ckpt",
     "obs",
+    "fault",
 
     "PerceptualEvaluationSpeechQuality",
     "PermutationInvariantTraining",

@@ -40,6 +40,7 @@ from metrics_tpu.ckpt.errors import (
     CapacityError,
     CheckpointError,
     CheckpointNotFoundError,
+    CheckpointTimeoutError,
     CorruptCheckpointError,
     DtypeDriftError,
     IncompleteCheckpointError,
@@ -62,6 +63,7 @@ __all__ = [
     "CapacityError",
     "CheckpointError",
     "CheckpointNotFoundError",
+    "CheckpointTimeoutError",
     "CheckpointWrite",
     "CorruptCheckpointError",
     "DtypeDriftError",

@@ -25,6 +25,17 @@ class CorruptCheckpointError(CheckpointError):
     JSON, truncated payload blob, CRC mismatch)."""
 
 
+class CheckpointTimeoutError(CheckpointError):
+    """``wait_for_all_saves(timeout_s=...)`` hit its deadline with async saves
+    still in flight (a wedged writer thread or pathologically slow IO).
+    ``steps`` lists the stuck step numbers so callers can requeue or abandon
+    them specifically."""
+
+    def __init__(self, message: str, steps: tuple = ()) -> None:
+        super().__init__(message)
+        self.steps = tuple(steps)
+
+
 class SchemaDriftError(CheckpointError):
     """The saved state tree does not match the live metric tree (different
     metric classes, state names, state kinds, or reduction specs)."""

@@ -40,6 +40,7 @@ writes are tracked so ``wait_for_all_saves()`` can drain them before exit.
 """
 import json
 import os
+import random
 import re
 import shutil
 import threading
@@ -54,9 +55,11 @@ from metrics_tpu.ckpt import serializer as _serializer
 from metrics_tpu.ckpt.errors import (
     CheckpointError,
     CheckpointNotFoundError,
+    CheckpointTimeoutError,
     CorruptCheckpointError,
     IncompleteCheckpointError,
 )
+from metrics_tpu.fault import inject as _fault
 from metrics_tpu.obs import flight as _obs_flight
 from metrics_tpu.obs import registry as _obs
 from metrics_tpu.obs import scopes as _obs_scopes
@@ -129,6 +132,8 @@ def _atomic_write_json(path: str, payload: Dict[str, Any]) -> None:
     with open(tmp, "w") as fh:
         json.dump(payload, fh, sort_keys=True)
         fh.flush()
+        if _fault._SCHEDULE is not None:
+            _fault.fire("ckpt.fsync", path=os.path.basename(path))
         os.fsync(fh.fileno())
     os.replace(tmp, path)
     _fsync_dir(os.path.dirname(path))
@@ -257,7 +262,9 @@ _INFLIGHT_LOCK = threading.Lock()
 _LAST_ASSIGNED: Dict[str, int] = {}
 
 
-def wait_for_all_saves(require_committed: bool = False) -> None:
+def wait_for_all_saves(
+    require_committed: bool = False, timeout_s: Optional[float] = None
+) -> None:
     """Drain every in-flight async save (re-raising the first failure).
 
     A drained save can still be commit-pending on a multi-host run: this
@@ -267,11 +274,29 @@ def wait_for_all_saves(require_committed: bool = False) -> None:
     up; with ``require_committed=True`` it raises
     :class:`IncompleteCheckpointError` instead, for callers that must know
     the checkpoint is readable before moving on.
+
+    ``timeout_s`` bounds the TOTAL wait across all in-flight saves (a wedged
+    writer thread — dead filesystem, injected fault storm — must not block
+    shutdown forever): past the deadline a :class:`CheckpointTimeoutError`
+    is raised listing the stuck steps in its ``steps`` attribute. The stuck
+    writes stay registered, so a later call can still drain them.
     """
     with _INFLIGHT_LOCK:
         pending = list(_INFLIGHT)
+    deadline = None if timeout_s is None else time.monotonic() + timeout_s
+    stuck: List[int] = []
     for handle in pending:
-        handle.result()
+        remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+        try:
+            handle.result(remaining)
+        except TimeoutError:
+            stuck.append(handle.step)
+    if stuck:
+        raise CheckpointTimeoutError(
+            f"checkpoint write(s) for step(s) {sorted(stuck)} still in flight after"
+            f" {timeout_s}s (writer thread wedged or IO stalled)",
+            steps=sorted(stuck),
+        )
     uncommitted = sorted(h.step for h in pending if not h.committed)
     if uncommitted:
         msg = (
@@ -437,6 +462,8 @@ def _try_commit(directory: str, tmp_dir: str, step: int, world: int, generation:
             return True
         raise
     try:
+        if _fault._SCHEDULE is not None:
+            _fault.fire("ckpt.rename", step=step)
         os.rename(tmp_dir, final_dir)
     except OSError:
         # a racing host renamed first; losing the race is success
@@ -471,6 +498,8 @@ def save_checkpoint(
     process_index: Optional[int] = None,
     process_count: Optional[int] = None,
     generation: Optional[str] = None,
+    retries: int = 3,
+    retry_backoff_s: float = 0.05,
 ) -> CheckpointWrite:
     """Save a :class:`Metric` or :class:`MetricCollection` state checkpoint.
 
@@ -500,6 +529,15 @@ def save_checkpoint(
             :func:`_save_generation`'s per-incarnation nonce — pass an
             explicit value (e.g. a launcher attempt id) when overriding the
             topology across separate processes.
+        retries: total save-IO attempts (default 3). Transient ``OSError``
+            from the payload/manifest/commit IO is retried with bounded
+            exponential backoff + jitter (every attempt overwrites the same
+            tmp-dir files, so a retry is idempotent); the last failure is
+            re-raised through the handle. Retries are counted under the
+            ``ckpt.save_retries`` obs counter.
+        retry_backoff_s: base backoff before attempt ``k`` is
+            ``retry_backoff_s * 2**k``, jittered by a uniform factor in
+            ``[0.5, 1.5)`` so preempted fleets do not retry in lockstep.
 
     Returns:
         A :class:`CheckpointWrite` handle (already finished when blocking;
@@ -538,6 +576,57 @@ def save_checkpoint(
         with _PENDING_LOCK:
             _PENDING_SNAPSHOTS.append(snap)
 
+    def attempt_io() -> Tuple[Dict[str, Any], bool]:
+        """One full save-IO attempt: payload + manifest + commit. Idempotent —
+        every file write lands atomically in the same tmp dir, so the retry
+        loop can re-run the whole attempt after a transient failure."""
+        tmp_dir = os.path.join(directory, _TMP_PREFIX + _step_name(step))
+        try:
+            os.makedirs(tmp_dir, exist_ok=True)
+            mine = entries if (rank == 0 or not replicated) else [e for e in entries if e[2]]
+            if _fault._SCHEDULE is not None:
+                _fault.fire("ckpt.write", step=step, host=rank)
+            payload_meta = _serializer.write_payload(
+                os.path.join(tmp_dir, _payload_name(rank)), mine
+            )
+            _atomic_write_json(
+                os.path.join(tmp_dir, _manifest_name(rank)),
+                {
+                    "format": _manifest.FORMAT,
+                    "version": _manifest.FORMAT_VERSION,
+                    "step": step,
+                    "host": rank,
+                    "world": world,
+                    "generation": generation,
+                    "replicated": replicated,
+                    "persistent_only": persistent_only,
+                    "tree": tree,
+                    "payload": payload_meta,
+                },
+            )
+        except FileNotFoundError:
+            # the tmp dir vanished mid-write: a racing host observed
+            # completeness and renamed it into place — if the step is
+            # committed the save's goal is met, anything else is real
+            if not _is_committed(final_dir):
+                raise
+            payload_meta = {"nbytes": 0}
+        if _obs_flight.ckpt_integration_active():
+            # the flight window rides the step dir through the atomic
+            # commit (dump() is best-effort: a vanished tmp_dir — the
+            # racing-host rename above — degrades to no dump, not an
+            # aborted save)
+            _obs_flight.dump(
+                os.path.join(tmp_dir, f"flight-h{rank:04d}.json"),
+                state_objs=[obj],
+            )
+        committed = _try_commit(directory, tmp_dir, step, world, generation)
+        if committed and retain is not None:
+            _prune(directory, retain)
+        return payload_meta, committed
+
+    attempts = max(1, int(retries))
+
     def write() -> None:
         t0 = time.perf_counter()
         try:
@@ -550,47 +639,25 @@ def save_checkpoint(
                     if snap in _PENDING_SNAPSHOTS:
                         _PENDING_SNAPSHOTS.remove(snap)
             with _scope("tm.ckpt/save"):
-                tmp_dir = os.path.join(directory, _TMP_PREFIX + _step_name(step))
-                try:
-                    os.makedirs(tmp_dir, exist_ok=True)
-                    mine = entries if (rank == 0 or not replicated) else [e for e in entries if e[2]]
-                    payload_meta = _serializer.write_payload(
-                        os.path.join(tmp_dir, _payload_name(rank)), mine
-                    )
-                    _atomic_write_json(
-                        os.path.join(tmp_dir, _manifest_name(rank)),
-                        {
-                            "format": _manifest.FORMAT,
-                            "version": _manifest.FORMAT_VERSION,
-                            "step": step,
-                            "host": rank,
-                            "world": world,
-                            "generation": generation,
-                            "replicated": replicated,
-                            "persistent_only": persistent_only,
-                            "tree": tree,
-                            "payload": payload_meta,
-                        },
-                    )
-                except FileNotFoundError:
-                    # the tmp dir vanished mid-write: a racing host observed
-                    # completeness and renamed it into place — if the step is
-                    # committed the save's goal is met, anything else is real
-                    if not _is_committed(final_dir):
-                        raise
-                    payload_meta = {"nbytes": 0}
-                if _obs_flight.ckpt_integration_active():
-                    # the flight window rides the step dir through the atomic
-                    # commit (dump() is best-effort: a vanished tmp_dir — the
-                    # racing-host rename above — degrades to no dump, not an
-                    # aborted save)
-                    _obs_flight.dump(
-                        os.path.join(tmp_dir, f"flight-h{rank:04d}.json"),
-                        state_objs=[obj],
-                    )
-                committed = _try_commit(directory, tmp_dir, step, world, generation)
-                if committed and retain is not None:
-                    _prune(directory, retain)
+                for attempt in range(attempts):
+                    try:
+                        payload_meta, committed = attempt_io()
+                        break
+                    except OSError as err:
+                        # transient IO (or an injected fault wearing its
+                        # shape): bounded exponential backoff with jitter,
+                        # then re-run the idempotent attempt
+                        if attempt + 1 >= attempts:
+                            raise
+                        if _obs._ENABLED:
+                            _obs.REGISTRY.inc("ckpt", "save_retries")
+                            if _obs_flight._RING is not None:
+                                _obs_flight.record(
+                                    "ckpt_save_retry", step=step, host=rank,
+                                    attempt=attempt + 1,
+                                    error=f"{type(err).__name__}: {str(err)[:120]}",
+                                )
+                        time.sleep(retry_backoff_s * (2 ** attempt) * (0.5 + random.random()))
             elapsed_ms = (time.perf_counter() - t0) * 1000
             if _obs._ENABLED:
                 _obs.REGISTRY.inc("ckpt", "saves")
@@ -657,6 +724,7 @@ def restore_checkpoint(
     process_index: Optional[int] = None,
     process_count: Optional[int] = None,
     stream: Optional[int] = None,
+    fallback_steps: int = 0,
 ) -> int:
     """Restore ``obj`` (Metric or MetricCollection) from a committed checkpoint.
 
@@ -670,7 +738,62 @@ def restore_checkpoint(
     ``(N, *base)`` states are indexed at ``stream`` and loaded into a plain
     (non-fleet) instance of the same class — per-tenant extraction without
     materializing the whole fleet.
+
+    ``fallback_steps`` is the preemption-recovery ladder: when the requested
+    (or latest) step turns out :class:`CorruptCheckpointError` or
+    :class:`IncompleteCheckpointError`, walk back to the newest earlier
+    *committed* step and try again, up to ``fallback_steps`` times, instead
+    of dying on the newest write a crash may have mangled. Each fallback is
+    warned, counted under the ``ckpt.restore_fallbacks`` obs counter, and —
+    because every attempt validates before assigning — a failed attempt
+    leaves ``obj`` untouched. Schema/shape drift and misuse errors never
+    fall back: an older checkpoint cannot fix those.
     """
+    fallbacks_left = int(fallback_steps)
+    attempt_step = step
+    while True:
+        try:
+            return _restore_checkpoint_once(
+                obj, directory, attempt_step,
+                process_index=process_index, process_count=process_count,
+                stream=stream,
+            )
+        except (CorruptCheckpointError, IncompleteCheckpointError) as err:
+            if fallbacks_left <= 0:
+                raise
+            failed = attempt_step if attempt_step is not None else latest_step(directory)
+            earlier = [s for s in all_steps(directory) if failed is None or s < failed]
+            if not earlier:
+                raise
+            attempt_step = earlier[-1]
+            fallbacks_left -= 1
+            if _obs._ENABLED:
+                _obs.REGISTRY.inc("ckpt", "restore_fallbacks")
+                if _obs_flight._RING is not None:
+                    _obs_flight.record(
+                        "ckpt_restore_fallback", failed_step=failed,
+                        fallback_step=attempt_step,
+                        error=f"{type(err).__name__}: {str(err)[:120]}",
+                    )
+            warnings.warn(
+                f"checkpoint step {failed} in {directory!r} is unusable"
+                f" ({type(err).__name__}); falling back to committed step"
+                f" {attempt_step} ({fallbacks_left} fallback(s) left)",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+
+
+def _restore_checkpoint_once(
+    obj: Any,
+    directory: str,
+    step: Optional[int] = None,
+    *,
+    process_index: Optional[int] = None,
+    process_count: Optional[int] = None,
+    stream: Optional[int] = None,
+) -> int:
+    """One all-or-nothing restore attempt (see :func:`restore_checkpoint`)."""
     from metrics_tpu.core.collections import MetricCollection
     from metrics_tpu.parallel.collective import process_topology
 

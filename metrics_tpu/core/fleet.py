@@ -36,6 +36,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from metrics_tpu.fault import inject as _fault
 from metrics_tpu.obs import flight as _obs_flight
 from metrics_tpu.obs import registry as _obs
 from metrics_tpu.utils.exceptions import MetricsUserError
@@ -305,6 +306,10 @@ def index_stream(value: Any, stream: Optional[int]) -> Any:
 # finalizers evict the entry when the metric is collected.
 _EXEC_CACHE: Dict[int, Dict[Tuple, Any]] = {}
 
+#: cache sentinel: AOT compile failed for this key once — the step runs
+#: un-jitted (eager, no donation) from now on instead of re-failing per call
+_BROKEN = object()
+
 
 def _cache_for(metric: Any) -> Dict[Tuple, Any]:
     key = id(metric)
@@ -353,9 +358,33 @@ def run_step(
     key = (tag, donate, _fused._aval_key(state), _fused._aval_key(extras), static_key)
     cache = _cache_for(metric)
     compiled = cache.get(key)
+    if compiled is _BROKEN:
+        return step(state, *extras)
     if compiled is None:
-        jitted = jax.jit(step, donate_argnums=(0,) if donate else ())
-        compiled = jitted.lower(state, *extras).compile()
+        try:
+            if _fault._SCHEDULE is not None:
+                _fault.fire("fleet.compile", tag=tag, metric=type(metric).__name__)
+            jitted = jax.jit(step, donate_argnums=(0,) if donate else ())
+            compiled = jitted.lower(state, *extras).compile()
+        except Exception as err:  # noqa: BLE001 — degrade to un-jitted eager
+            cache[key] = _BROKEN
+            if _obs._ENABLED:
+                _obs.REGISTRY.inc("fleet", "degrades")
+                if _obs_flight._RING is not None:
+                    _obs_flight.record(
+                        "degrade",
+                        site="fleet.compile",
+                        tag=tag,
+                        metric=type(metric).__name__,
+                        error=f"{type(err).__name__}: {str(err).splitlines()[0][:120]}",
+                    )
+            _fused._warn_degrade_once(
+                "fleet.compile",
+                err,
+                f"the {tag} step for this signature runs un-jitted (eager,"
+                " no donation) from now on.",
+            )
+            return step(state, *extras)
         cache[key] = compiled
     if donate:
         state = _shield_donation(metric, state)

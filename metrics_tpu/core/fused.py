@@ -35,7 +35,8 @@ snapshots are materialized device->host *before* the donation invalidates the
 arrays they reference (``metrics_tpu.ckpt.manager.secure_pending_snapshots``).
 
 Observability (all behind the usual zero-overhead gate): ``fused.launches`` /
-``fused.cache_hits`` / ``fused.fallbacks`` / ``fused.dispatches`` counters,
+``fused.cache_hits`` / ``fused.fallbacks`` / ``fused.dispatches`` /
+``fused.degrades`` counters,
 ``tm.fused/step`` trace annotation at dispatch, and — independent of the obs
 gate — every leader's ops are wrapped in ``jax.named_scope("tm.fused/<Class>")``
 inside the traced program so XProf attributes HLO per metric even in the fused
@@ -54,6 +55,7 @@ import jax.numpy as jnp
 
 from metrics_tpu.core.metric import Metric
 from metrics_tpu.core.state import CatBuffer
+from metrics_tpu.fault import inject as _fault
 from metrics_tpu.obs import flight as _obs_flight
 from metrics_tpu.obs import recompile as _obs_recompile
 from metrics_tpu.obs import registry as _obs
@@ -70,6 +72,31 @@ __all__ = [
 
 #: placeholder marking a dynamic (array) leaf position in a flattened input
 _DYN = object()
+
+#: (site, error-class-name) pairs already warned about — degradations repeat
+#: every step once a key is broken, the warning must not
+_DEGRADE_WARNED: set = set()
+
+
+def _warn_degrade_once(site: str, err: Exception, detail: str) -> None:
+    """Once-per-(site, error class) warning that a group demoted to eager."""
+    key = (site, type(err).__name__)
+    if key in _DEGRADE_WARNED:
+        return
+    _DEGRADE_WARNED.add(key)
+    warnings.warn(
+        f"metrics_tpu degraded mode: {site} failed"
+        f" ({type(err).__name__}: {str(err).splitlines()[0][:200]}); {detail}"
+        " Further failures of this class stay silent; see the `degrades` obs"
+        " counter and `degrade` flight events for the full record.",
+        RuntimeWarning,
+        stacklevel=4,
+    )
+
+
+def _leaf_deleted(leaf: Any) -> bool:
+    fn = getattr(leaf, "is_deleted", None)
+    return bool(fn()) if callable(fn) else False
 
 
 # ------------------------------------------------------------- eligibility
@@ -94,6 +121,8 @@ def fusion_fallback_reason(
         return "compute_on_cpu moves state off-device after every update"
     if any(isinstance(v, list) for v in (getattr(leader, n) for n in leader._defaults)):
         return "list ('cat') state without cat_capacity is host-ragged"
+    if any(getattr(m, "nan_policy", None) for m in members or (leader,)):
+        return "nan_policy quarantine is a host-side input check in _wrap_update"
     if child_metrics(leader):
         return "holds child metrics (wrapper updates are not pure over registered state)"
     if forward:
@@ -217,7 +246,24 @@ class FusedCollectionUpdate:
             "cache_hits": 0,
             "cache_misses": 0,
             "fallback_groups": 0,
+            "degrades": 0,
         }
+
+    def _record_degrade(
+        self, site: str, err: Exception, groups: List[str], mode: str
+    ) -> None:
+        """Attribute one fused->eager demotion (obs counter + flight event)."""
+        self.stats["degrades"] += 1
+        if _obs._ENABLED:
+            _obs.REGISTRY.inc("fused", "degrades")
+            if _obs_flight._RING is not None:
+                _obs_flight.record(
+                    "degrade",
+                    site=site,
+                    groups=groups,
+                    mode=mode,
+                    error=f"{type(err).__name__}: {str(err).splitlines()[0][:120]}",
+                )
 
     # ---------------------------------------------------------- partition
 
@@ -344,6 +390,12 @@ class FusedCollectionUpdate:
         closures (obs counters firing once per *trace*) are suppressed here
         and steady-state launches stay side-effect-free.
         """
+        if _fault._SCHEDULE is not None:
+            _fault.fire(
+                "fused.compile",
+                groups=[name for name, _ in fused],
+                mode="forward" if forward else "update",
+            )
         step = self._build(collection, fused, split_spec, forward)
         # donate only the accumulated state tree: batch-local `fresh` states
         # never appear in the outputs, so XLA could not alias them anyway
@@ -493,12 +545,16 @@ class FusedCollectionUpdate:
                 )
             except Exception as err:  # noqa: BLE001 — eager is always correct
                 self._broken_keys.add(key)
-                warnings.warn(
-                    "metrics_tpu fused update: compiling the chained step failed"
-                    f" ({type(err).__name__}: {str(err).splitlines()[0][:200]});"
-                    " this input signature stays on the eager path.",
-                    RuntimeWarning,
-                    stacklevel=3,
+                self._record_degrade(
+                    "fused.compile",
+                    err,
+                    [name for name, _ in fused],
+                    "forward" if forward else "update",
+                )
+                _warn_degrade_once(
+                    "fused.compile",
+                    err,
+                    "this input signature stays on the eager path.",
                 )
                 return [], demoted + [list(m) for _, m in fused], {}
             self._cache[key] = compiled
@@ -516,26 +572,55 @@ class FusedCollectionUpdate:
         (states,) = donate_trees
 
         self.stats["launches"] += 1
-        if _obs._ENABLED:
-            _obs.REGISTRY.inc("fused", "launches")
-            _obs.REGISTRY.inc("fused", "dispatches")
-            if _obs_flight._RING is not None:
-                _obs_flight.record(
-                    "fused_launch",
+        try:
+            # the injection point sits BEFORE the donating call so an injected
+            # launch fault always finds the pre-launch buffers intact
+            if _fault._SCHEDULE is not None:
+                _fault.fire(
+                    "fused.launch",
                     groups=[name for name, _ in fused],
                     mode="forward" if forward else "update",
-                    cache_key=f"{key[0]}:{hash(key) & 0xFFFFFFFF:08x}",
                 )
-            with _obs_scopes.annotate("tm.fused/step"):
+            if _obs._ENABLED:
+                _obs.REGISTRY.inc("fused", "launches")
+                _obs.REGISTRY.inc("fused", "dispatches")
+                if _obs_flight._RING is not None:
+                    _obs_flight.record(
+                        "fused_launch",
+                        groups=[name for name, _ in fused],
+                        mode="forward" if forward else "update",
+                        cache_key=f"{key[0]}:{hash(key) & 0xFFFFFFFF:08x}",
+                    )
+                with _obs_scopes.annotate("tm.fused/step"):
+                    if forward:
+                        new_states, results = compiled(states, fresh, dyn)
+                    else:
+                        new_states, results = compiled(states, dyn)
+            else:
                 if forward:
                     new_states, results = compiled(states, fresh, dyn)
                 else:
                     new_states, results = compiled(states, dyn)
-        else:
-            if forward:
-                new_states, results = compiled(states, fresh, dyn)
-            else:
-                new_states, results = compiled(states, dyn)
+        except Exception as err:  # noqa: BLE001 — degrade, never half-write
+            # a launch that already consumed its donated inputs cannot be
+            # recovered here — the state is gone, so the error must propagate
+            if any(_leaf_deleted(leaf) for leaf in jax.tree_util.tree_leaves(states)):
+                raise
+            self._broken_keys.add(key)
+            groups = [name for name, _ in fused]
+            mode = "forward" if forward else "update"
+            self._record_degrade("fused.launch", err, groups, mode)
+            _warn_degrade_once(
+                "fused.launch",
+                err,
+                "the group(s) re-ran eagerly this step and this input"
+                " signature stays on the eager path.",
+            )
+            # re-point leaders at the intact pre-launch buffers (the gathered
+            # tree holds donation-guard copies where aliasing required them)
+            for name, _ in fused:
+                collection._modules[name]._load_state(states[name])
+            return [], demoted + [list(m) for _, m in fused], {}
 
         # re-point live leader state at the donated-in-place output buffers
         for name, _ in fused:

@@ -40,6 +40,7 @@ import numpy as np
 from jax import Array
 
 from metrics_tpu.core.state import CatBuffer, cat_merge
+from metrics_tpu.fault import inject as _fault
 from metrics_tpu.obs import flight as _obs_flight
 from metrics_tpu.obs import recompile as _obs_recompile
 from metrics_tpu.obs import registry as _obs
@@ -195,6 +196,17 @@ class Metric(ABC):
         if self.cat_capacity is not None and (not isinstance(self.cat_capacity, int) or self.cat_capacity < 1):
             raise ValueError(
                 f"Expected keyword argument `cat_capacity` to be a positive int or None but got {self.cat_capacity}"
+            )
+
+        # input-poison quarantine (opt-in): what to do when NaN/Inf rows reach
+        # update(). None keeps today's behavior (values propagate untouched);
+        # "count"/"warn"/"raise" tally rows into the `nonfinite_rows` obs
+        # counter (SLO-able via obs.health) and escalate accordingly.
+        self.nan_policy = kwargs.pop("nan_policy", None)
+        if self.nan_policy not in (None, "warn", "raise", "count"):
+            raise ValueError(
+                "Expected keyword argument `nan_policy` to be one of None,"
+                f" 'warn', 'raise', 'count' but got {self.nan_policy!r}"
             )
 
         # fleet axis (SURVEY.md §7 / ROADMAP item 1): N concurrent streams share
@@ -525,9 +537,60 @@ class Metric(ABC):
     def compute(self) -> Any:
         """Compute the final value from the accumulated states."""
 
+    def _quarantine_inputs(self, args: Tuple[Any, ...], kwargs: Dict[str, Any]) -> None:
+        """The ``nan_policy`` gate: tally NaN/Inf rows arriving at ``update()``.
+
+        Host-side by design (the whole point is to stop poison *before* it
+        melts into sum states), so it only inspects concrete inputs — inside
+        someone else's jit/vmap the check is skipped rather than forcing a
+        device sync on a tracer.
+        """
+        rows = 0
+        for value in tuple(args) + tuple(kwargs.values()):
+            if not is_array(value):
+                continue
+            arr = jnp.asarray(value)
+            if not jnp.issubdtype(arr.dtype, jnp.floating) or arr.size == 0:
+                continue
+            if not _is_concrete(arr):
+                return
+            bad = ~jnp.isfinite(arr)
+            if arr.ndim == 0:
+                rows += int(bad)
+            else:
+                rows += int(jnp.any(bad.reshape(arr.shape[0], -1), axis=-1).sum())
+        if not rows:
+            return
+        name = type(self).__name__
+        if _obs._ENABLED:
+            _obs.REGISTRY.inc(name, "nonfinite_rows", rows)
+            if _obs_flight._RING is not None:
+                _obs_flight.record(
+                    "nonfinite_inputs", metric=name, rows=rows, policy=self.nan_policy
+                )
+        if self.nan_policy == "raise":
+            from metrics_tpu.fault.inject import PoisonedInputError
+
+            raise PoisonedInputError(name, rows)
+        if self.nan_policy == "warn":
+            rank_zero_warn(
+                f"Metric {name}: {rows} update input row(s) contain NaN/Inf"
+                " (nan_policy='warn'); they were accumulated anyway. Use"
+                " nan_policy='raise' to reject poisoned batches.",
+                MetricsUserWarning,
+            )
+
     def _wrap_update(self, update: Callable) -> Callable:
         @functools.wraps(update)
         def wrapped_func(*args: Any, **kwargs: Any) -> None:
+            # fault-injection + quarantine run before ANY bookkeeping mutates:
+            # a rejected batch must leave _update_count and caches untouched
+            if _fault._SCHEDULE is not None:
+                args, kwargs = _fault.poison_inputs(
+                    args, kwargs, metric=type(self).__name__
+                )
+            if self.nan_policy is not None:
+                self._quarantine_inputs(args, kwargs)
             self._computed = None
             self._update_count += 1
             if self.fleet_size is not None:

@@ -1,0 +1,272 @@
+"""tmfault: deterministic, seed-addressed fault injection for the serving path.
+
+Production failures — a flaky filesystem under the checkpoint writer, an XLA
+compile OOM, a preempted peer host, a NaN-poisoned upstream batch — are rare
+enough that the code paths handling them rot unless something exercises them on
+demand. This module is that something: a set of **named injection sites**
+threaded through the runtime's real failure points, armed by a seeded
+:class:`FaultSchedule` context manager. With no schedule active every site
+reduces to one module-attribute load plus an identity check (the same
+single-boolean discipline as ``obs/registry.py``), so the instrumented hot
+paths cost nothing in production.
+
+Injection sites (the name is the contract — tests and post-mortems address
+faults by it):
+
+    ``ckpt.write``     payload blob write in ``ckpt.manager.save_checkpoint``
+    ``ckpt.fsync``     manifest/commit-record fsync (``_atomic_write_json``)
+    ``ckpt.rename``    the publishing ``os.rename`` in ``_try_commit``
+    ``fused.compile``  AOT compile of the chained fused step (``core/fused.py``)
+    ``fused.launch``   execution of the compiled fused step
+    ``fleet.compile``  AOT compile of a fleet routed/broadcast step
+    ``agg.publish``    obs snapshot publish (``obs/aggregate.publish``)
+    ``agg.read``       per-host snapshot read (``obs/aggregate.aggregate_dir``)
+    ``input.poison``   NaN-poisoning of update inputs (``Metric._wrap_update``)
+
+Every site except ``input.poison`` *raises* :class:`InjectedFaultError` (an
+``OSError`` subclass, so the checkpoint retry loop treats injected IO faults
+exactly like real ones) when the schedule says fire. ``input.poison`` instead
+*transforms*: a deterministic subset of rows of every float array input is
+replaced with NaN, simulating a poisoned upstream batch for the
+``nan_policy`` quarantine to catch.
+
+Determinism: each site draws from its own ``random.Random`` stream seeded by
+``(seed, site)``, so whether the *n*-th call at a site fires depends only on
+the schedule's seed and that site's call count — never on interleaving with
+other sites or threads. Explicit plans (``fire_at={"ckpt.rename": 0}``)
+bypass randomness entirely. Every fired fault is appended to
+``schedule.fired`` and, when the flight recorder is on, recorded as a
+``fault`` ring event so post-mortems can attribute degradations.
+"""
+import random
+import threading
+from typing import Any, Dict, Iterable, List, Optional, Tuple, Union
+
+from metrics_tpu.obs import flight as _obs_flight
+
+__all__ = [
+    "SITES",
+    "FaultSchedule",
+    "InjectedFaultError",
+    "PoisonedInputError",
+    "fire",
+    "poison_inputs",
+    "active",
+    "current",
+]
+
+#: the closed set of injection-site names threaded through the runtime
+SITES = (
+    "ckpt.write",
+    "ckpt.fsync",
+    "ckpt.rename",
+    "fused.compile",
+    "fused.launch",
+    "fleet.compile",
+    "agg.publish",
+    "agg.read",
+    "input.poison",
+)
+
+#: the active schedule. ``None`` == injection off == nothing allocated; the
+#: instrumented sites gate on ``_SCHEDULE is not None`` (one module-attribute
+#: load + identity check, mirroring ``obs.registry._ENABLED``).
+_SCHEDULE: Optional["FaultSchedule"] = None
+
+
+class InjectedFaultError(OSError):
+    """A fault site fired. Subclasses ``OSError`` on purpose: the checkpoint
+    retry/backoff loop (and any caller hardened against real IO errors)
+    handles an injected fault through exactly the code path a real disk
+    failure would take."""
+
+    def __init__(self, site: str, occurrence: int, seed: Optional[int] = None) -> None:
+        super().__init__(
+            f"injected fault at site {site!r} (occurrence {occurrence}, seed={seed})"
+        )
+        self.site = site
+        self.occurrence = occurrence
+        self.seed = seed
+
+
+class PoisonedInputError(ValueError):
+    """Raised by ``Metric(nan_policy="raise")`` when NaN/Inf rows reach
+    ``update()``. Carries the offending row count for programmatic handling."""
+
+    def __init__(self, metric: str, rows: int) -> None:
+        super().__init__(
+            f"Metric {metric}: {rows} update input row(s) contain NaN/Inf"
+            " (nan_policy='raise'); quarantine the upstream batch or use"
+            " nan_policy='count' to tally without failing"
+        )
+        self.metric = metric
+        self.rows = rows
+
+
+def _normalize_fire_at(
+    fire_at: Optional[Dict[str, Union[int, Iterable[int]]]]
+) -> Dict[str, frozenset]:
+    plan: Dict[str, frozenset] = {}
+    for site, occs in (fire_at or {}).items():
+        if site not in SITES:
+            raise ValueError(f"unknown fault site {site!r}; valid sites: {SITES}")
+        if isinstance(occs, int) and not isinstance(occs, bool):
+            occs = (occs,)
+        occ_set = frozenset(int(o) for o in occs)
+        if any(o < 0 for o in occ_set):
+            raise ValueError(f"fire_at occurrences must be >= 0, got {sorted(occ_set)}")
+        plan[site] = occ_set
+    return plan
+
+
+class FaultSchedule:
+    """A deterministic plan of which site calls fail, armed as a context manager.
+
+    Two addressing modes, combinable:
+
+    - **Explicit**: ``fire_at={"ckpt.rename": 0, "fused.launch": (0, 2)}``
+      fires on exactly those zero-based occurrences of each site.
+    - **Seeded random**: ``FaultSchedule(seed=7, sites=("ckpt.write",),
+      rate=0.25)`` fires each listed site's call with probability ``rate``,
+      drawn from a per-site ``random.Random`` stream seeded by ``(seed,
+      site)`` — the same seed always yields the same fault pattern for the
+      same call sequence.
+
+    ``max_fires`` caps total fires across all sites (so a high-rate schedule
+    cannot starve a retry loop forever). ``schedule.fired`` lists every fired
+    fault as ``{"site", "occurrence", ...context}``; ``schedule.counts`` maps
+    each site to the number of calls it has seen. Thread-safe: the checkpoint
+    writer threads hit sites concurrently with the main thread.
+
+    Usage::
+
+        with FaultSchedule(fire_at={"ckpt.fsync": 0}):
+            save_checkpoint(metric, tmpdir)   # first fsync fails, retry wins
+    """
+
+    def __init__(
+        self,
+        seed: int = 0,
+        *,
+        fire_at: Optional[Dict[str, Union[int, Iterable[int]]]] = None,
+        sites: Optional[Tuple[str, ...]] = None,
+        rate: float = 0.0,
+        max_fires: Optional[int] = None,
+    ) -> None:
+        if not 0.0 <= float(rate) <= 1.0:
+            raise ValueError(f"rate must be in [0, 1], got {rate}")
+        for site in sites or ():
+            if site not in SITES:
+                raise ValueError(f"unknown fault site {site!r}; valid sites: {SITES}")
+        if rate > 0.0 and not sites:
+            raise ValueError("rate > 0 requires sites=(...) naming which sites misfire")
+        self.seed = int(seed)
+        self.rate = float(rate)
+        self.random_sites = tuple(sites or ())
+        self.max_fires = max_fires
+        self._plan = _normalize_fire_at(fire_at)
+        self._rngs: Dict[str, random.Random] = {
+            site: random.Random(f"{self.seed}:{site}") for site in self.random_sites
+        }
+        self._lock = threading.Lock()
+        self.counts: Dict[str, int] = {}
+        self.fired: List[Dict[str, Any]] = []
+        self._prev: Optional["FaultSchedule"] = None
+
+    # --------------------------------------------------------------- firing
+
+    def _on_call(self, site: str, context: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        """Register one call at ``site``; return the fired-event dict (and
+        record it) when the schedule says this occurrence fails, else None."""
+        with self._lock:
+            occurrence = self.counts.get(site, 0)
+            self.counts[site] = occurrence + 1
+            fires = occurrence in self._plan.get(site, ())
+            if not fires and site in self._rngs and self.rate > 0.0:
+                fires = self._rngs[site].random() < self.rate
+            if fires and self.max_fires is not None and len(self.fired) >= self.max_fires:
+                fires = False
+            if not fires:
+                return None
+            event = {"site": site, "occurrence": occurrence, **context}
+            self.fired.append(event)
+        # flight attribution outside the schedule lock: record() is lock-free
+        # and a post-mortem wants every injected fault in the ring
+        _obs_flight.record("fault", **event)
+        return event
+
+    # ------------------------------------------------------------- arming
+
+    def __enter__(self) -> "FaultSchedule":
+        global _SCHEDULE
+        self._prev = _SCHEDULE
+        _SCHEDULE = self
+        return self
+
+    def __exit__(self, *exc_info: Any) -> None:
+        global _SCHEDULE
+        _SCHEDULE = self._prev
+        self._prev = None
+
+
+# ------------------------------------------------------------------ site API
+
+
+def fire(site: str, **context: Any) -> None:
+    """One call at a raising injection site: no-op without a schedule, raises
+    :class:`InjectedFaultError` when the active schedule fires this occurrence.
+
+    Hot paths gate the call itself (``if inject._SCHEDULE is not None:``) so
+    the disabled cost is the gate check alone, not a function call.
+    """
+    sched = _SCHEDULE
+    if sched is None:
+        return
+    event = sched._on_call(site, context)
+    if event is not None:
+        raise InjectedFaultError(site, event["occurrence"], seed=sched.seed)
+
+
+def poison_inputs(args: Tuple, kwargs: Dict, metric: str = "") -> Tuple[Tuple, Dict]:
+    """One call at the ``input.poison`` site: when it fires, return copies of
+    ``(args, kwargs)`` with a deterministic subset of rows of every float
+    array replaced by NaN (never raises — poisoning simulates a bad upstream
+    batch, the ``nan_policy`` quarantine decides what happens to it)."""
+    sched = _SCHEDULE
+    if sched is None:
+        return args, kwargs
+    event = sched._on_call("input.poison", {"metric": metric})
+    if event is None:
+        return args, kwargs
+    rng = random.Random(f"{sched.seed}:input.poison:{event['occurrence']}")
+    poisoned_rows = 0
+
+    def poison(value: Any) -> Any:
+        nonlocal poisoned_rows
+        import jax.numpy as jnp
+
+        from metrics_tpu.utils.data import is_array
+
+        if not is_array(value):
+            return value
+        arr = jnp.asarray(value)
+        if not jnp.issubdtype(arr.dtype, jnp.floating) or arr.ndim < 1 or arr.shape[0] == 0:
+            return value
+        rows = int(arr.shape[0])
+        k = max(1, rows // 8)
+        idx = rng.sample(range(rows), k)
+        poisoned_rows += k
+        return arr.at[jnp.asarray(idx)].set(jnp.nan)
+
+    new_args = tuple(poison(a) for a in args)
+    new_kwargs = {k: poison(v) for k, v in kwargs.items()}
+    event["rows"] = poisoned_rows
+    return new_args, new_kwargs
+
+
+def active() -> bool:
+    return _SCHEDULE is not None
+
+
+def current() -> Optional[FaultSchedule]:
+    return _SCHEDULE

@@ -32,6 +32,7 @@ import os
 import tempfile
 from typing import Any, Dict, List, Optional
 
+from metrics_tpu.fault import inject as _fault
 from metrics_tpu.obs import health as _health
 from metrics_tpu.obs import registry as _reg
 
@@ -138,6 +139,14 @@ def aggregate(snapshots: List[Dict[str, Any]]) -> Dict[str, Any]:
     "latency_sketches": <merged, still-mergeable states>, "per_host": [...]}``
     — the merged output is itself a valid input to a higher aggregation level
     (rack → pod → fleet composes, because every reduction is associative).
+
+    Coverage annotation: the output carries ``world_observed`` (how many
+    original host snapshots this aggregate covers — inputs default to 1, an
+    aggregate contributes its own count, so the field **sums**) and
+    ``world_expected`` (the largest expected world any input claimed, so the
+    field takes the **max**). Both reductions are associative, which is what
+    lets a partial merge from :func:`aggregate_dir` keep composing up the
+    rack → pod → fleet tree without losing track of who was missing.
     """
     if not snapshots:
         raise ValueError("aggregate() needs at least one host snapshot")
@@ -146,6 +155,8 @@ def aggregate(snapshots: List[Dict[str, Any]]) -> Dict[str, Any]:
     hbm: Optional[int] = None
     per_host: List[Dict[str, Any]] = []
     world = 0
+    world_observed = 0
+    world_expected = 0
     for snap in snapshots:
         if snap.get("schema") != SCHEMA_VERSION:
             raise ValueError(
@@ -157,6 +168,10 @@ def aggregate(snapshots: List[Dict[str, Any]]) -> Dict[str, Any]:
         if host_hbm is not None:
             hbm = host_hbm if hbm is None else max(hbm, host_hbm)
         world = max(world, snap.get("world", 0))
+        world_observed += int(snap.get("world_observed", 1))
+        world_expected = max(
+            world_expected, int(snap.get("world_expected", snap.get("world", 0) or 1))
+        )
         per_host.append(
             {
                 "host": snap.get("host"),
@@ -175,6 +190,8 @@ def aggregate(snapshots: List[Dict[str, Any]]) -> Dict[str, Any]:
         "schema": SCHEMA_VERSION,
         "hosts": len(snapshots),
         "world": world,
+        "world_observed": world_observed,
+        "world_expected": world_expected,
         "counters": counters,
         "hbm_watermark_bytes": hbm,
         "latency_us": {key: _quantiles_of(entry) for key, entry in sketches.items()},
@@ -200,6 +217,8 @@ def publish(dirpath: str, snapshot: Optional[Dict[str, Any]] = None) -> str:
     """
     snap = host_snapshot() if snapshot is None else snapshot
     path = _host_path(dirpath, int(snap["host"]))
+    if _fault._SCHEDULE is not None:
+        _fault.fire("agg.publish", host=snap.get("host"))
     os.makedirs(dirpath, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=dirpath, prefix=".obs-", suffix=".tmp")
     try:
@@ -217,23 +236,82 @@ def publish(dirpath: str, snapshot: Optional[Dict[str, Any]] = None) -> str:
     return path
 
 
-def aggregate_dir(dirpath: str, expect_world: Optional[int] = None) -> Dict[str, Any]:
+def aggregate_dir(
+    dirpath: str,
+    expect_world: Optional[int] = None,
+    *,
+    timeout_s: Optional[float] = None,
+    min_world: Optional[int] = None,
+    poll_interval_s: float = 0.05,
+) -> Dict[str, Any]:
     """Merge every ``obs-h*.json`` under ``dirpath`` (see :func:`aggregate`).
 
-    ``expect_world`` makes a partial exchange loud: fewer published hosts than
-    the expected world raises instead of silently reporting a partial fleet.
+    Two modes:
+
+    - **Strict** (default, neither ``timeout_s`` nor ``min_world`` given):
+      ``expect_world`` makes a partial exchange loud — fewer published hosts
+      than the expected world raises instead of silently reporting a partial
+      fleet, and an unreadable/torn snapshot file propagates its error.
+    - **Tolerant** (``timeout_s`` and/or ``min_world`` given): wait up to
+      ``timeout_s`` seconds (polling every ``poll_interval_s``) for
+      ``expect_world`` hosts to publish, then merge whatever arrived —
+      skipping unreadable files — and return a **coverage-annotated partial
+      aggregate**: ``world_observed`` says how many hosts actually landed,
+      ``world_expected`` what the fleet should have been (both still compose
+      associatively through further :func:`aggregate` levels). ``min_world``
+      is the floor under the partial answer: fewer readable snapshots than
+      that raises, because an "aggregate" covering almost nobody is worse
+      than an error.
     """
-    snapshots = []
-    for entry in sorted(os.listdir(dirpath)):
-        if entry.startswith("obs-h") and entry.endswith(".json"):
-            with open(os.path.join(dirpath, entry)) as f:
-                snapshots.append(json.load(f))
-    if expect_world is not None and len(snapshots) < expect_world:
+    tolerant = timeout_s is not None or min_world is not None
+    target = expect_world if expect_world is not None else min_world
+
+    def read_all() -> tuple:
+        snapshots: List[Dict[str, Any]] = []
+        skipped = 0
+        for entry in sorted(os.listdir(dirpath)):
+            if not (entry.startswith("obs-h") and entry.endswith(".json")):
+                continue
+            try:
+                if _fault._SCHEDULE is not None:
+                    _fault.fire("agg.read", file=entry)
+                with open(os.path.join(dirpath, entry)) as f:
+                    snapshots.append(json.load(f))
+            except (OSError, ValueError):
+                if not tolerant:
+                    raise
+                skipped += 1
+        return snapshots, skipped
+
+    snapshots, skipped = read_all()
+    if timeout_s is not None and target is not None and len(snapshots) < target:
+        from metrics_tpu.parallel.collective import wait_for_world
+
+        latest = {"snaps": snapshots, "skipped": skipped}
+
+        def observed() -> int:
+            latest["snaps"], latest["skipped"] = read_all()
+            return len(latest["snaps"])
+
+        wait_for_world(
+            observed, target, timeout_s=timeout_s, poll_interval_s=poll_interval_s
+        )
+        snapshots, skipped = latest["snaps"], latest["skipped"]
+    if min_world is not None and len(snapshots) < min_world:
+        raise ValueError(
+            f"aggregate_dir: only {len(snapshots)} readable host snapshots under"
+            f" {dirpath!r} after waiting, below min_world={min_world}"
+            f" ({skipped} unreadable)"
+        )
+    if not tolerant and expect_world is not None and len(snapshots) < expect_world:
         raise ValueError(
             f"aggregate_dir: found {len(snapshots)} host snapshots under"
             f" {dirpath!r}, expected {expect_world}"
         )
-    return aggregate(snapshots)
+    out = aggregate(snapshots)
+    if expect_world is not None:
+        out["world_expected"] = max(out["world_expected"], int(expect_world))
+    return out
 
 
 def fleet_snapshot() -> Dict[str, Any]:

@@ -39,6 +39,7 @@ import itertools
 import json
 import os
 import signal as _signal
+import sys
 import threading
 import time
 import weakref
@@ -69,6 +70,10 @@ _STATE_SOURCES: "List[weakref.ref]" = []
 _PREV_HANDLERS: Dict[int, Any] = {}
 _ATEXIT_REGISTERED = False
 
+#: the sys.excepthook in place before ours, for chaining + uninstall
+#: (sentinel None == not installed)
+_PREV_EXCEPTHOOK: Optional[Any] = None
+
 
 def _now_us() -> float:
     """Monotonic microsecond timebase shared with the trace exporter."""
@@ -88,11 +93,12 @@ def enable(
     Args:
         capacity: events retained (the "last K" of every dump).
         dump_path: where crash dumps go; required for ``install_handlers``.
-        install_handlers: register an ``atexit`` hook plus chaining handlers on
-            ``signals`` that write ``dump_path`` before the process dies — the
-            preemption post-mortem. Handlers forward to whatever was installed
-            before them (or re-deliver the signal with the default action, so
-            the exit status stays honest).
+        install_handlers: register an ``atexit`` hook, chaining handlers on
+            ``signals``, and a chaining ``sys.excepthook`` — each writes
+            ``dump_path`` before the process dies, covering preemption,
+            clean exit, and an uncaught exception alike. Handlers forward to
+            whatever was installed before them (or re-deliver the signal with
+            the default action, so the exit status stays honest).
         signals: which signals to hook (default SIGTERM, the preemption
             notice; add SIGINT for interactive runs).
         ckpt_integration: opt-in — every ``ckpt.save_checkpoint`` also writes
@@ -327,11 +333,34 @@ def _on_signal(signum: int, frame: Any) -> None:
     os.kill(os.getpid(), signum)
 
 
+def _on_unhandled(exc_type: Any, exc: Any, tb: Any) -> None:
+    """Chaining ``sys.excepthook``: an uncaught exception is a crash that is
+    neither a signal nor a clean exit — record it, dump the window to the same
+    rank+pid-disambiguated path the other failure handlers use, then hand the
+    exception to whatever hook was installed before us (the interpreter's
+    default printer, unless someone else chained first)."""
+    try:
+        if _RING is not None:
+            record(
+                "unhandled_exception",
+                exc_type=getattr(exc_type, "__name__", str(exc_type)),
+                message=str(exc)[:200],
+            )
+            dump(failure_dump_path())
+    except Exception:  # noqa: BLE001 — the hook must never mask the crash
+        pass
+    prev = _PREV_EXCEPTHOOK
+    (prev if callable(prev) else sys.__excepthook__)(exc_type, exc, tb)
+
+
 def _install_handlers(signals: Tuple[int, ...]) -> None:
-    global _ATEXIT_REGISTERED
+    global _ATEXIT_REGISTERED, _PREV_EXCEPTHOOK
     if not _ATEXIT_REGISTERED:
         atexit.register(_on_exit)
         _ATEXIT_REGISTERED = True
+    if _PREV_EXCEPTHOOK is None:
+        _PREV_EXCEPTHOOK = sys.excepthook
+        sys.excepthook = _on_unhandled
     for signum in signals:
         if signum in _PREV_HANDLERS:
             continue
@@ -342,6 +371,12 @@ def _install_handlers(signals: Tuple[int, ...]) -> None:
 
 
 def _uninstall_handlers() -> None:
+    global _PREV_EXCEPTHOOK
+    if _PREV_EXCEPTHOOK is not None:
+        # only restore if nobody chained on top of us in the meantime
+        if sys.excepthook is _on_unhandled:
+            sys.excepthook = _PREV_EXCEPTHOOK
+        _PREV_EXCEPTHOOK = None
     for signum, prev in list(_PREV_HANDLERS.items()):
         try:
             _signal.signal(signum, prev if prev is not None else _signal.SIG_DFL)

@@ -64,6 +64,9 @@ class SLOBudget:
             each ``check_slos`` call closes one window.
         p99_update_latency_ms: ceiling on any single metric's p99 update
             latency, from the health sketches.
+        max_nonfinite_rows: ceiling on total NaN/Inf input rows tallied by
+            ``Metric(nan_policy=...)`` quarantines (summed ``nonfinite_rows``
+            counters across scopes) — an input-poisoning SLO.
         action: ``"warn"`` | ``"raise"`` | callable(list_of_violations).
     """
 
@@ -72,6 +75,7 @@ class SLOBudget:
         max_launches_per_step: Optional[float] = None,
         max_retraces_per_window: Optional[int] = None,
         p99_update_latency_ms: Optional[float] = None,
+        max_nonfinite_rows: Optional[int] = None,
         action: Union[str, Callable[[List[Dict[str, Any]]], None]] = "warn",
     ) -> None:
         if isinstance(action, str) and action not in ("warn", "raise"):
@@ -79,6 +83,7 @@ class SLOBudget:
         self.max_launches_per_step = max_launches_per_step
         self.max_retraces_per_window = max_retraces_per_window
         self.p99_update_latency_ms = p99_update_latency_ms
+        self.max_nonfinite_rows = max_nonfinite_rows
         self.action = action
 
 
@@ -324,6 +329,22 @@ class HealthMonitor:
                     }
                 )
             self._mark_window()
+
+        if budget.max_nonfinite_rows is not None:
+            poisoned = sum(
+                counters.get("nonfinite_rows", 0)
+                for counters in snap.values()
+                if isinstance(counters.get("nonfinite_rows", 0), (int, float))
+            )
+            if poisoned > budget.max_nonfinite_rows:
+                violations.append(
+                    {
+                        "slo": "max_nonfinite_rows",
+                        "budget": budget.max_nonfinite_rows,
+                        "measured": poisoned,
+                        "detail": "NaN/Inf input rows tallied by nan_policy quarantines",
+                    }
+                )
 
         if budget.p99_update_latency_ms is not None:
             latency = self.report()["latency_us"]

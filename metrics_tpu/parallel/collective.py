@@ -205,6 +205,34 @@ def process_topology(
     return rank, world
 
 
+def wait_for_world(
+    observed_fn: Any,
+    expect: int,
+    timeout_s: Optional[float] = None,
+    poll_interval_s: float = 0.05,
+) -> int:
+    """Deadline-poll until ``observed_fn()`` reports ``expect`` participants.
+
+    The straggler-tolerant rendezvous primitive: re-evaluates ``observed_fn``
+    (e.g. "how many host snapshot files exist") every ``poll_interval_s``
+    until it reaches ``expect`` or the deadline passes, then returns the last
+    observed count — it never raises on a partial world. The caller decides
+    whether partial coverage is acceptable (``obs.aggregate.aggregate_dir``
+    annotates it; other callers may raise). ``timeout_s=None`` means a single
+    immediate observation, not an unbounded wait.
+    """
+    import time
+
+    count = int(observed_fn())
+    if count >= expect or timeout_s is None:
+        return count
+    deadline = time.monotonic() + float(timeout_s)
+    while count < expect and time.monotonic() < deadline:
+        time.sleep(min(poll_interval_s, max(0.0, deadline - time.monotonic())))
+        count = int(observed_fn())
+    return count
+
+
 def distributed_available() -> bool:
     """Default ``distributed_available_fn``: multi-process JAX runtime present.
 

@@ -1,0 +1,174 @@
+"""Partial-host aggregation tolerance: coverage-annotated merges, deadline
+waits for stragglers, torn-file skipping, the ``min_world`` floor, associative
+composition of partial aggregates, and ``wait_for_world``."""
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from metrics_tpu import fault, obs
+from metrics_tpu.obs.aggregate import aggregate, aggregate_dir, host_snapshot, publish
+from metrics_tpu.parallel.collective import wait_for_world
+
+pytestmark = [pytest.mark.fault, pytest.mark.obs]
+
+
+def _snap(host, world):
+    s = host_snapshot()
+    s["host"], s["world"] = host, world
+    return s
+
+
+def _publish_hosts(dirpath, hosts, world):
+    for h in hosts:
+        publish(str(dirpath), _snap(h, world))
+
+
+# ------------------------------------------------------- coverage stamping
+
+
+def test_full_world_coverage_stamp(tmp_path):
+    _publish_hosts(tmp_path, range(4), 4)
+    out = aggregate_dir(str(tmp_path), expect_world=4)
+    assert out["world_observed"] == 4
+    assert out["world_expected"] == 4
+
+
+def test_partial_merge_annotates_coverage(tmp_path):
+    _publish_hosts(tmp_path, (0, 2), 4)
+    out = aggregate_dir(str(tmp_path), expect_world=4, timeout_s=0.0)
+    assert out["hosts"] == 2
+    assert out["world_observed"] == 2
+    assert out["world_expected"] == 4
+
+
+def test_strict_mode_still_raises_on_partial(tmp_path):
+    _publish_hosts(tmp_path, (0,), 4)
+    with pytest.raises(ValueError, match="expected 4"):
+        aggregate_dir(str(tmp_path), expect_world=4)
+
+
+def test_partial_aggregates_compose_associatively(tmp_path):
+    """(h0+h1 partial) + (h2 partial) == observed 3 of expected 4 — the
+    coverage fields keep summing/maxing through higher aggregation levels."""
+    left = aggregate([_snap(0, 4), _snap(1, 4)])
+    right = aggregate([_snap(2, 4)])
+    top = aggregate([left, right])
+    assert top["world_observed"] == 3
+    assert top["world_expected"] == 4
+    # and merging in the straggler completes the picture
+    assert aggregate([top, _snap(3, 4)])["world_observed"] == 4
+
+
+# ------------------------------------------------------------ deadline wait
+
+
+def test_waits_for_late_straggler(tmp_path):
+    _publish_hosts(tmp_path, (0,), 2)
+
+    def late():
+        time.sleep(0.1)
+        publish(str(tmp_path), _snap(1, 2))
+
+    t = threading.Thread(target=late)
+    t.start()
+    try:
+        out = aggregate_dir(
+            str(tmp_path), expect_world=2, timeout_s=2.0, poll_interval_s=0.02
+        )
+    finally:
+        t.join()
+    assert out["world_observed"] == 2
+
+
+def test_deadline_expires_returns_partial(tmp_path):
+    _publish_hosts(tmp_path, (0,), 3)
+    t0 = time.monotonic()
+    out = aggregate_dir(str(tmp_path), expect_world=3, timeout_s=0.15, poll_interval_s=0.02)
+    waited = time.monotonic() - t0
+    assert out["world_observed"] == 1 and out["world_expected"] == 3
+    assert 0.1 < waited < 1.0
+
+
+def test_min_world_floor_raises(tmp_path):
+    _publish_hosts(tmp_path, (0,), 4)
+    with pytest.raises(ValueError, match="min_world=2"):
+        aggregate_dir(str(tmp_path), expect_world=4, min_world=2, timeout_s=0.05)
+
+
+def test_min_world_satisfied_passes(tmp_path):
+    _publish_hosts(tmp_path, (0, 1), 4)
+    out = aggregate_dir(str(tmp_path), expect_world=4, min_world=2, timeout_s=0.0)
+    assert out["world_observed"] == 2
+
+
+# -------------------------------------------------------------- torn files
+
+
+def test_torn_file_skipped_in_tolerant_mode(tmp_path):
+    _publish_hosts(tmp_path, (0, 1), 3)
+    (tmp_path / "obs-h0002.json").write_text("{torn")
+    out = aggregate_dir(str(tmp_path), timeout_s=0.0)
+    assert out["hosts"] == 2
+
+
+def test_torn_file_raises_in_strict_mode(tmp_path):
+    _publish_hosts(tmp_path, (0,), 2)
+    (tmp_path / "obs-h0001.json").write_text("{torn")
+    with pytest.raises(json.JSONDecodeError):
+        aggregate_dir(str(tmp_path))
+
+
+# --------------------------------------------------------- injection sites
+
+
+def test_agg_read_fault_tolerated(tmp_path):
+    _publish_hosts(tmp_path, (0, 1, 2), 3)
+    with fault.FaultSchedule(fire_at={"agg.read": 1}) as sched:
+        out = aggregate_dir(str(tmp_path), timeout_s=0.0)
+    assert sched.fired[0]["site"] == "agg.read"
+    assert out["hosts"] == 2  # the faulted read was skipped, not fatal
+
+
+def test_agg_read_fault_strict_propagates(tmp_path):
+    _publish_hosts(tmp_path, (0,), 1)
+    with fault.FaultSchedule(fire_at={"agg.read": 0}):
+        with pytest.raises(fault.InjectedFaultError):
+            aggregate_dir(str(tmp_path))
+
+
+def test_agg_publish_fault_leaves_no_file(tmp_path):
+    with fault.FaultSchedule(fire_at={"agg.publish": 0}):
+        with pytest.raises(fault.InjectedFaultError):
+            publish(str(tmp_path), _snap(0, 1))
+    assert not os.path.exists(tmp_path / "obs-h0000.json")
+    # retry wins and the snapshot lands
+    publish(str(tmp_path), _snap(0, 1))
+    assert os.path.exists(tmp_path / "obs-h0000.json")
+
+
+# ----------------------------------------------------------- wait_for_world
+
+
+def test_wait_for_world_immediate_when_satisfied():
+    assert wait_for_world(lambda: 3, 3, timeout_s=5.0) == 3
+
+
+def test_wait_for_world_none_timeout_single_observation():
+    calls = []
+    assert wait_for_world(lambda: calls.append(1) or 1, 4, timeout_s=None) == 1
+    assert len(calls) == 1
+
+
+def test_wait_for_world_polls_until_deadline():
+    counts = iter([0, 0, 2])
+    got = wait_for_world(lambda: next(counts, 2), 2, timeout_s=1.0, poll_interval_s=0.01)
+    assert got == 2
+
+
+def test_wait_for_world_returns_partial_on_deadline():
+    t0 = time.monotonic()
+    assert wait_for_world(lambda: 1, 5, timeout_s=0.08, poll_interval_s=0.01) == 1
+    assert time.monotonic() - t0 < 1.0

@@ -1,0 +1,195 @@
+"""The chaos property sweep (ISSUE 12 acceptance criterion): for EVERY seeded
+``FaultSchedule``, a standard serving workload must terminate either
+
+- bit-identical to the fault-free run (retries/degradations healed it), or
+- in a **typed** error (``InjectedFaultError``/``CheckpointError``/
+  ``PoisonedInputError``/``ValueError``), or
+- in an **attributed** degraded mode (the schedule's ``fired`` record plus
+  obs counters say exactly which fault changed the outcome — here, only
+  ``input.poison`` may legitimately alter computed values).
+
+Silent corruption — a completed run whose registered state differs from the
+baseline with no poison attribution — fails the sweep. 26 schedules cover
+explicit single-occurrence faults at all nine sites, repeated-fault and
+multi-site plans, and seeded random storms at several rates.
+"""
+import os
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import fault, obs
+from metrics_tpu.ckpt import CheckpointError, restore_checkpoint, save_checkpoint
+from metrics_tpu.core.collections import MetricCollection
+from metrics_tpu.fault import PoisonedInputError
+from metrics_tpu.obs.aggregate import aggregate_dir, host_snapshot, publish
+from metrics_tpu.regression import MeanAbsoluteError, MeanSquaredError
+
+pytestmark = [pytest.mark.fault, pytest.mark.chaos]
+
+_STEPS = 3
+_IDS = [0, 1, 1, 3]
+
+#: every typed way a chaos run may legitimately terminate early
+_TYPED_ERRORS = (fault.InjectedFaultError, CheckpointError, PoisonedInputError, OSError, ValueError)
+
+
+def _workload(tmpdir):
+    """The standard serving-shaped run: fused collection steps, a fleet
+    update, a blocking save + restore, and a publish + tolerant aggregate.
+    Returns every piece of registered state the invariant compares."""
+    out = {}
+    coll = MetricCollection(
+        {"mse": MeanSquaredError(), "mae": MeanAbsoluteError()}, fused=True
+    )
+    for i in range(_STEPS):
+        preds = jnp.asarray([1.0 + i, 2.0, 3.0, 4.0])
+        target = jnp.asarray([1.0, 3.0, 5.0, 7.0])
+        coll.update(preds, target)
+    out["collection"] = {k: np.asarray(v) for k, v in coll.compute().items()}
+
+    fm = MeanSquaredError(fleet_size=4)
+    fm.update(
+        jnp.asarray([1.0, 2.0, 3.0, 4.0]),
+        jnp.asarray([1.0, 3.0, 5.0, 7.0]),
+        stream_ids=jnp.asarray(_IDS),
+    )
+    out["fleet"] = np.asarray(fm.compute())
+
+    ck = os.path.join(tmpdir, "ck")
+    save_checkpoint(coll, ck, step=0, retry_backoff_s=0.001)
+    fresh = MetricCollection({"mse": MeanSquaredError(), "mae": MeanAbsoluteError()})
+    restore_checkpoint(fresh, ck, fallback_steps=1)
+    out["restored"] = {k: np.asarray(v) for k, v in fresh.compute().items()}
+
+    agg_dir = os.path.join(tmpdir, "agg")
+    publish(agg_dir, {**host_snapshot(), "host": 0, "world": 1})
+    merged = aggregate_dir(agg_dir, expect_world=1, timeout_s=0.0, min_world=1)
+    out["agg_coverage"] = (merged["world_observed"], merged["world_expected"])
+    return out
+
+
+def _equal(a, b):
+    if isinstance(a, dict):
+        return set(a) == set(b) and all(_equal(a[k], b[k]) for k in a)
+    if isinstance(a, np.ndarray) or isinstance(b, np.ndarray):
+        # bit-identical up to NaN placement (fleet slots for unseen streams
+        # are NaN, and NaN != NaN under plain array_equal)
+        return np.array_equal(np.asarray(a), np.asarray(b), equal_nan=True)
+    return a == b
+
+
+def _schedules():
+    scheds = []
+    # one explicit first-occurrence fault per site (9)
+    for site in fault.SITES:
+        scheds.append(("hit0:" + site, dict(fire_at={site: 0})))
+    # repeated faults that exhaust the ckpt retry budget / pin eager mode (4)
+    scheds.append(("exhaust:ckpt.write", dict(fire_at={"ckpt.write": (0, 1, 2)})))
+    scheds.append(("exhaust:ckpt.rename", dict(fire_at={"ckpt.rename": (0, 1, 2)})))
+    scheds.append(("repeat:fused.launch", dict(fire_at={"fused.launch": (0, 1)})))
+    scheds.append(("late:ckpt.fsync", dict(fire_at={"ckpt.fsync": 1})))
+    # multi-site compound plans (3)
+    scheds.append(
+        ("compound:fused+ckpt", dict(fire_at={"fused.launch": 0, "ckpt.write": 0}))
+    )
+    scheds.append(
+        ("compound:fleet+agg", dict(fire_at={"fleet.compile": 0, "agg.read": 0}))
+    )
+    scheds.append(
+        ("compound:poison+fsync", dict(fire_at={"input.poison": 0, "ckpt.fsync": 0}))
+    )
+    # seeded random storms across every raising site (8)
+    storm_sites = tuple(s for s in fault.SITES if s != "input.poison")
+    for seed in range(4):
+        scheds.append((f"storm:r0.15:s{seed}", dict(seed=seed, sites=storm_sites, rate=0.15)))
+    for seed in range(2):
+        scheds.append((f"storm:r0.4:s{seed}", dict(seed=seed, sites=storm_sites, rate=0.4)))
+    scheds.append(("storm:capped", dict(seed=9, sites=storm_sites, rate=0.9, max_fires=2)))
+    scheds.append(
+        ("storm:poison", dict(seed=3, sites=("input.poison",), rate=0.5, fire_at={"input.poison": 0}))
+    )
+    return scheds
+
+
+_SCHEDULES = _schedules()
+assert len(_SCHEDULES) >= 20  # the acceptance-criterion floor
+
+
+@pytest.fixture(scope="module")
+def baseline(tmp_path_factory):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return _workload(str(tmp_path_factory.mktemp("baseline")))
+
+
+@pytest.mark.parametrize("name,kwargs", _SCHEDULES, ids=[n for n, _ in _SCHEDULES])
+def test_chaos_never_silently_corrupts(name, kwargs, baseline, tmp_path):
+    obs.enable()
+    obs.REGISTRY.clear()
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            sched = fault.FaultSchedule(**kwargs)
+            try:
+                with sched:
+                    result = _workload(str(tmp_path))
+            except _TYPED_ERRORS:
+                # branch 1: a typed, attributable termination — and the fault
+                # that caused it is on the record
+                assert sched.fired, f"{name}: typed error with no recorded fault"
+                return
+        if _equal(result, baseline):
+            # branch 2: bit-identical to fault-free (retries/degradations
+            # healed everything, or nothing fired at all)
+            return
+        # branch 3: the outcome differs — ONLY input poisoning may do that,
+        # and it must be attributed in the schedule's fired record
+        poison = [e for e in sched.fired if e["site"] == "input.poison"]
+        assert poison, (
+            f"{name}: registered state diverged from the fault-free baseline"
+            f" without poison attribution — silent corruption. fired={sched.fired}"
+        )
+        # ...and only the computed VALUES may differ, never the shape of the run
+        assert set(result) == set(baseline)
+        assert result["agg_coverage"] == baseline["agg_coverage"]
+    finally:
+        obs.disable()
+
+
+def test_degraded_runs_attribute_via_obs(tmp_path):
+    """A schedule that forces fused+fleet degradation completes with the
+    `degrades` counters telling the post-mortem exactly what happened."""
+    obs.enable()
+    obs.REGISTRY.clear()
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            with fault.FaultSchedule(
+                fire_at={"fused.launch": 0, "fleet.compile": 0}
+            ) as sched:
+                _workload(str(tmp_path))
+        snap = obs.REGISTRY.snapshot()
+        assert snap["fused"]["degrades"] >= 1
+        assert snap["fleet"]["degrades"] >= 1
+        assert {e["site"] for e in sched.fired} == {"fused.launch", "fleet.compile"}
+    finally:
+        obs.disable()
+
+
+def test_retried_save_attributes_via_obs(tmp_path):
+    obs.enable()
+    obs.REGISTRY.clear()
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            with fault.FaultSchedule(fire_at={"ckpt.write": 0}):
+                result = _workload(str(tmp_path))
+        assert obs.REGISTRY.snapshot()["ckpt"]["save_retries"] == 1
+        assert result["restored"] == result["collection"] or _equal(
+            result["restored"], result["collection"]
+        )
+    finally:
+        obs.disable()

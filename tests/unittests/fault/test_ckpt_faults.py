@@ -1,0 +1,266 @@
+"""Checkpoint resilience under injected faults: save retry/backoff, typed
+timeout on stuck async saves, the ``fallback_steps`` restore ladder, and the
+torn multi-host commit schedules (kill-during-rename, fail-after-k-shards)
+that prove ``_try_commit`` never publishes a partial or mixed step."""
+import json
+import os
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import fault, obs
+from metrics_tpu.ckpt import (
+    CheckpointTimeoutError,
+    CorruptCheckpointError,
+    IncompleteCheckpointError,
+    all_steps,
+    restore_checkpoint,
+    save_checkpoint,
+    wait_for_all_saves,
+)
+from metrics_tpu.ckpt import manager as _manager
+from metrics_tpu.regression import MeanSquaredError
+
+pytestmark = [pytest.mark.fault, pytest.mark.ckpt]
+
+
+def _mse(*batches):
+    m = MeanSquaredError()
+    for p, t in batches:
+        m.update(jnp.asarray(p, jnp.float32), jnp.asarray(t, jnp.float32))
+    return m
+
+
+def _corrupt_payloads(step_dir):
+    for f in os.listdir(step_dir):
+        if f.startswith("arrays"):
+            with open(os.path.join(step_dir, f), "wb") as fh:
+                fh.write(b"\x00garbage")
+
+
+# ------------------------------------------------------------- save retries
+
+
+@pytest.mark.parametrize("site", ["ckpt.write", "ckpt.fsync", "ckpt.rename"])
+def test_single_io_fault_retried_to_success(tmp_path, site):
+    d = str(tmp_path)
+    m = _mse(([1.0, 2.0], [1.0, 3.0]))
+    obs.enable()
+    obs.REGISTRY.clear()
+    try:
+        with fault.FaultSchedule(fire_at={site: 0}) as sched:
+            h = save_checkpoint(m, d, step=0, retry_backoff_s=0.001)
+        assert h.committed
+        assert sched.fired[0]["site"] == site
+        assert obs.REGISTRY.snapshot()["ckpt"]["save_retries"] == 1
+    finally:
+        obs.disable()
+    fresh = MeanSquaredError()
+    assert restore_checkpoint(fresh, d) == 0
+    np.testing.assert_allclose(np.asarray(fresh.compute()), np.asarray(m.compute()))
+
+
+def test_retries_exhausted_raises_typed_oserror(tmp_path):
+    m = _mse(([1.0], [2.0]))
+    with fault.FaultSchedule(fire_at={"ckpt.write": (0, 1, 2)}):
+        with pytest.raises(fault.InjectedFaultError):
+            save_checkpoint(m, str(tmp_path), step=0, retry_backoff_s=0.001)
+    assert all_steps(str(tmp_path)) == []
+
+
+def test_async_save_error_raises_through_handle(tmp_path):
+    m = _mse(([1.0], [2.0]))
+    with fault.FaultSchedule(fire_at={"ckpt.write": (0, 1, 2)}):
+        h = save_checkpoint(m, str(tmp_path), step=0, blocking=False, retry_backoff_s=0.001)
+        with pytest.raises(fault.InjectedFaultError):
+            h.result()
+
+
+def test_retries_1_means_no_retry(tmp_path):
+    m = _mse(([1.0], [2.0]))
+    with fault.FaultSchedule(fire_at={"ckpt.write": 0}):
+        with pytest.raises(fault.InjectedFaultError):
+            save_checkpoint(m, str(tmp_path), step=0, retries=1)
+
+
+# --------------------------------------------------- wait_for_all_saves(timeout)
+
+
+def test_wait_for_all_saves_timeout_lists_stuck_steps(tmp_path, monkeypatch):
+    from metrics_tpu.ckpt import serializer as _serializer
+
+    real = _serializer.write_payload
+    release = {"at": time.monotonic() + 0.4}
+
+    def slow(path, entries):
+        while time.monotonic() < release["at"]:
+            time.sleep(0.01)
+        return real(path, entries)
+
+    monkeypatch.setattr(_manager._serializer, "write_payload", slow)
+    m = _mse(([1.0], [2.0]))
+    save_checkpoint(m, str(tmp_path), step=7, blocking=False)
+    with pytest.raises(CheckpointTimeoutError) as exc:
+        wait_for_all_saves(timeout_s=0.05)
+    assert exc.value.steps == (7,)
+    assert "7" in str(exc.value)
+    # the stuck write stays registered: a later, patient wait drains it
+    wait_for_all_saves()
+    fresh = MeanSquaredError()
+    assert restore_checkpoint(fresh, str(tmp_path)) == 7
+
+
+def test_wait_for_all_saves_timeout_noop_when_nothing_inflight():
+    wait_for_all_saves(timeout_s=0.01)
+
+
+# ------------------------------------------------------------ fallback_steps
+
+
+def test_fallback_steps_walks_to_prior_committed_step(tmp_path):
+    d = str(tmp_path)
+    m = _mse(([1.0, 2.0], [1.0, 3.0]))
+    save_checkpoint(m, d, step=0)
+    m.update(jnp.asarray([5.0]), jnp.asarray([6.0]))
+    save_checkpoint(m, d, step=1)
+    step0_compute = float(_restored(d, step=0).compute())
+    _corrupt_payloads(os.path.join(d, "step_0000000001"))
+
+    # default: dies on the newest
+    with pytest.raises(CorruptCheckpointError):
+        restore_checkpoint(MeanSquaredError(), d)
+
+    fresh = MeanSquaredError()
+    with pytest.warns(RuntimeWarning, match="falling back to committed step 0"):
+        step = restore_checkpoint(fresh, d, fallback_steps=1)
+    assert step == 0
+    assert float(fresh.compute()) == step0_compute
+
+
+def _restored(d, **kw):
+    m = MeanSquaredError()
+    restore_checkpoint(m, d, **kw)
+    return m
+
+
+def test_fallback_budget_exhausted_reraises(tmp_path):
+    d = str(tmp_path)
+    for step in range(3):
+        save_checkpoint(_mse(([1.0], [2.0])), d, step=step)
+        _corrupt_payloads(os.path.join(d, f"step_{step:010d}"))
+    with pytest.warns(RuntimeWarning):
+        with pytest.raises(CorruptCheckpointError):
+            restore_checkpoint(MeanSquaredError(), d, fallback_steps=1)
+
+
+def test_fallback_with_no_earlier_step_reraises(tmp_path):
+    d = str(tmp_path)
+    save_checkpoint(_mse(([1.0], [2.0])), d, step=0)
+    _corrupt_payloads(os.path.join(d, "step_0000000000"))
+    with pytest.raises(CorruptCheckpointError):
+        restore_checkpoint(MeanSquaredError(), d, fallback_steps=5)
+
+
+def test_fallback_counted_in_obs(tmp_path):
+    d = str(tmp_path)
+    save_checkpoint(_mse(([1.0], [2.0])), d, step=0)
+    save_checkpoint(_mse(([1.0], [2.0])), d, step=1)
+    _corrupt_payloads(os.path.join(d, "step_0000000001"))
+    obs.enable()
+    obs.REGISTRY.clear()
+    try:
+        with pytest.warns(RuntimeWarning):
+            restore_checkpoint(MeanSquaredError(), d, fallback_steps=1)
+        assert obs.REGISTRY.snapshot()["ckpt"]["restore_fallbacks"] == 1
+    finally:
+        obs.disable()
+
+
+def test_fallback_failed_attempt_leaves_obj_untouched(tmp_path):
+    d = str(tmp_path)
+    save_checkpoint(_mse(([1.0], [2.0])), d, step=0)
+    _corrupt_payloads(os.path.join(d, "step_0000000000"))
+    fresh = MeanSquaredError()
+    with pytest.raises(CorruptCheckpointError):
+        restore_checkpoint(fresh, d, fallback_steps=3)
+    assert fresh._update_count == 0
+    assert float(jnp.asarray(fresh.sum_squared_error)) == 0.0
+
+
+# -------------------------------------------------------- torn commit paths
+
+
+def test_kill_during_rename_never_commits(tmp_path):
+    """The publishing rename dies on every attempt: the step must stay
+    invisible to readers (no COMMIT in a final dir), and a later fault-free
+    save of the same step must publish cleanly."""
+    d = str(tmp_path)
+    m = _mse(([1.0, 2.0], [1.0, 3.0]))
+    with fault.FaultSchedule(fire_at={"ckpt.rename": (0, 1, 2)}):
+        with pytest.raises(fault.InjectedFaultError):
+            save_checkpoint(m, d, step=0, retry_backoff_s=0.001)
+    assert all_steps(d) == []
+    with pytest.raises((IncompleteCheckpointError,)):
+        restore_checkpoint(MeanSquaredError(), d, step=0)
+
+    # recovery: the same incarnation retries the save without faults
+    h = save_checkpoint(m, d, step=0, retry_backoff_s=0.001)
+    assert h.committed
+    fresh = MeanSquaredError()
+    assert restore_checkpoint(fresh, d) == 0
+    np.testing.assert_allclose(np.asarray(fresh.compute()), np.asarray(m.compute()))
+
+
+def test_fail_after_k_shards_commits_no_partial_world(tmp_path):
+    """World=2 save where host 1's shard write always fails: host 0's manifest
+    alone must never produce a COMMIT, and restore falls back to the prior
+    committed step."""
+    d = str(tmp_path)
+    gen = "gen-chaos"
+    prior = _mse(([1.0], [1.5]))
+    save_checkpoint(prior, d, step=0, process_index=0, process_count=1)
+
+    m = _mse(([1.0, 2.0], [1.0, 3.0]))
+    h0 = save_checkpoint(m, d, step=1, process_index=0, process_count=2, generation=gen)
+    assert not h0.committed  # waiting on host 1's shard
+    with fault.FaultSchedule(fire_at={"ckpt.write": (0, 1, 2)}):
+        with pytest.raises(fault.InjectedFaultError):
+            save_checkpoint(
+                m, d, step=1, process_index=1, process_count=2,
+                generation=gen, retry_backoff_s=0.001,
+            )
+    assert all_steps(d) == [0]
+    assert not os.path.isfile(os.path.join(d, "step_0000000001", "COMMIT"))
+
+    fresh = MeanSquaredError()
+    with pytest.warns(RuntimeWarning, match="falling back to committed step 0"):
+        assert restore_checkpoint(fresh, d, step=1, fallback_steps=1) == 0
+    np.testing.assert_allclose(np.asarray(fresh.compute()), np.asarray(prior.compute()))
+
+
+def test_mixed_generation_shards_never_commit(tmp_path):
+    """A dead incarnation's shard plus a live one's must not combine into a
+    COMMIT even when together they cover the world (generation stamps)."""
+    d = str(tmp_path)
+    m = _mse(([1.0], [2.0]))
+    save_checkpoint(m, d, step=0, process_index=1, process_count=2, generation="gen-dead")
+    with fault.FaultSchedule(fire_at={"ckpt.write": (0, 1, 2)}):
+        with pytest.raises(fault.InjectedFaultError):
+            save_checkpoint(
+                m, d, step=0, process_index=0, process_count=2,
+                generation="gen-live", retry_backoff_s=0.001,
+            )
+    # host 0 live shard failed; host 1 has only a dead-generation shard
+    assert all_steps(d) == []
+    # live host 0 succeeds on retry, but commit still waits for live host 1
+    save_checkpoint(m, d, step=0, process_index=0, process_count=2, generation="gen-live")
+    assert all_steps(d) == []
+    # live host 1 lands: now (and only now) the step commits, all-live
+    save_checkpoint(m, d, step=0, process_index=1, process_count=2, generation="gen-live")
+    assert all_steps(d) == [0]
+    step_dir = os.path.join(d, "step_0000000000")
+    for host in (0, 1):
+        man = json.load(open(os.path.join(step_dir, f"manifest-h{host:04d}.json")))
+        assert man["generation"] == "gen-live"

@@ -1,0 +1,193 @@
+"""The degradation ladder: fused/fleet compile and launch failures demote to
+the eager path with bit-identical results, attributed obs counters, flight
+events, and once-per-class warnings — never an exception out of ``update()``."""
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import fault, obs
+from metrics_tpu.core import fused as _fused
+from metrics_tpu.core.collections import MetricCollection
+from metrics_tpu.core.fused import engine_for
+from metrics_tpu.obs import flight
+from metrics_tpu.regression import MeanAbsoluteError, MeanSquaredError
+
+pytestmark = [pytest.mark.fault, pytest.mark.fused]
+
+_P = jnp.asarray([1.0, 2.0, 3.0, 4.0])
+_T = jnp.asarray([1.0, 3.0, 5.0, 7.0])
+
+
+def _collection():
+    return MetricCollection(
+        {"mse": MeanSquaredError(), "mae": MeanAbsoluteError()}, fused=True
+    )
+
+
+def _baseline(steps=2):
+    c = _collection()
+    for _ in range(steps):
+        c.update(_P, _T)
+    return {k: float(v) for k, v in c.compute().items()}
+
+
+@pytest.fixture(autouse=True)
+def _fresh_warn_dedup():
+    """Once-per-class warning dedup is module-global; isolate per test."""
+    _fused._DEGRADE_WARNED.clear()
+    yield
+    _fused._DEGRADE_WARNED.clear()
+
+
+# ------------------------------------------------------------ fused ladder
+
+
+@pytest.mark.parametrize("site", ["fused.compile", "fused.launch"])
+def test_fused_fault_degrades_with_identical_result(site):
+    want = _baseline()
+    c = _collection()
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        with fault.FaultSchedule(fire_at={site: 0}) as sched:
+            c.update(_P, _T)
+        c.update(_P, _T)
+    got = {k: float(v) for k, v in c.compute().items()}
+    assert got == want
+    assert [e["site"] for e in sched.fired] == [site]
+    eng = engine_for(c)
+    assert eng.stats["degrades"] == 1
+    degrade_warnings = [w for w in caught if "degraded mode" in str(w.message)]
+    assert len(degrade_warnings) == 1
+    assert site in str(degrade_warnings[0].message)
+
+
+def test_fused_launch_fault_preserves_state_mid_run():
+    """Fault on the SECOND launch: the first fused step's accumulated state
+    must survive the failed launch (pre-launch buffer re-point)."""
+    want = _baseline(steps=3)
+    c = _collection()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with fault.FaultSchedule(fire_at={"fused.launch": 1}):
+            for _ in range(3):
+                c.update(_P, _T)
+    got = {k: float(v) for k, v in c.compute().items()}
+    assert got == want
+
+
+def test_degrade_warning_is_once_per_class():
+    c1, c2 = _collection(), _collection()
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        with fault.FaultSchedule(fire_at={"fused.launch": (0, 1)}):
+            c1.update(_P, _T)
+            c2.update(_P, _T)
+    degrade_warnings = [w for w in caught if "degraded mode" in str(w.message)]
+    assert len(degrade_warnings) == 1
+
+
+def test_degrade_obs_counter_and_flight_event():
+    obs.enable()
+    obs.REGISTRY.clear()
+    flight.enable(capacity=64)
+    try:
+        c = _collection()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            with fault.FaultSchedule(fire_at={"fused.launch": 0}):
+                c.update(_P, _T)
+        assert obs.REGISTRY.snapshot()["fused"]["degrades"] == 1
+        degrades = [e for e in flight.events() if e["kind"] == "degrade"]
+        assert degrades and degrades[0]["site"] == "fused.launch"
+        faults = [e for e in flight.events() if e["kind"] == "fault"]
+        assert faults and faults[0]["site"] == "fused.launch"
+    finally:
+        flight.disable()
+        obs.disable()
+
+
+def test_broken_key_goes_straight_to_eager_next_step():
+    c = _collection()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with fault.FaultSchedule(fire_at={"fused.launch": 0}):
+            c.update(_P, _T)
+    eng = engine_for(c)
+    launches_after_fault = eng.stats["launches"]
+    c.update(_P, _T)
+    # no new fused launch attempted for the broken signature
+    assert eng.stats["launches"] == launches_after_fault
+    assert {k: float(v) for k, v in c.compute().items()} == _baseline()
+
+
+def test_forward_path_degrades_too():
+    c_base = _collection()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        base_vals = c_base.forward(_P, _T)
+        c = _collection()
+        with fault.FaultSchedule(fire_at={"fused.launch": 0}):
+            vals = c.forward(_P, _T)
+    for k in base_vals:
+        np.testing.assert_allclose(np.asarray(vals[k]), np.asarray(base_vals[k]))
+    np.testing.assert_allclose(
+        np.asarray(c.compute()["mse"]), np.asarray(c_base.compute()["mse"])
+    )
+
+
+# ------------------------------------------------------------ fleet ladder
+
+
+def test_fleet_compile_fault_degrades_with_identical_result():
+    ids = jnp.asarray([0, 1, 1, 3])
+    base = MeanSquaredError(fleet_size=4)
+    base.update(_P, _T, stream_ids=ids)
+
+    m = MeanSquaredError(fleet_size=4)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        with fault.FaultSchedule(fire_at={"fleet.compile": 0}) as sched:
+            m.update(_P, _T, stream_ids=ids)
+    np.testing.assert_array_equal(np.asarray(base.compute()), np.asarray(m.compute()))
+    assert sched.fired[0]["site"] == "fleet.compile"
+    assert any("fleet.compile" in str(w.message) for w in caught)
+
+    # the broken signature stays eager (sentinel) and keeps accumulating right
+    m.update(_P, _T, stream_ids=ids)
+    base.update(_P, _T, stream_ids=ids)
+    np.testing.assert_array_equal(np.asarray(base.compute()), np.asarray(m.compute()))
+
+
+def test_fleet_degrade_obs_counter():
+    obs.enable()
+    obs.REGISTRY.clear()
+    try:
+        m = MeanSquaredError(fleet_size=2)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            with fault.FaultSchedule(fire_at={"fleet.compile": 0}):
+                m.update(_P, _T, stream_ids=jnp.asarray([0, 0, 1, 1]))
+        assert obs.REGISTRY.snapshot()["fleet"]["degrades"] == 1
+    finally:
+        obs.disable()
+
+
+# --------------------------------------------------------------- gate cost
+
+
+def test_no_schedule_no_site_calls():
+    """With no schedule, instrumented paths never call into the fault module
+    (the zero-overhead contract is the gate, not a cheap function call)."""
+    from metrics_tpu.fault import inject
+
+    calls = []
+    real_fire = inject.fire
+    inject.fire = lambda *a, **k: calls.append(a) or real_fire(*a, **k)
+    try:
+        c = _collection()
+        c.update(_P, _T)
+    finally:
+        inject.fire = real_fire
+    assert calls == []

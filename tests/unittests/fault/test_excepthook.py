@@ -1,0 +1,91 @@
+"""Flight-recorder dump on unhandled exceptions: the chaining
+``sys.excepthook`` records the crash, writes the rank+pid-disambiguated dump,
+forwards to the previous hook, and uninstalls cleanly."""
+import json
+import os
+import sys
+
+import pytest
+
+from metrics_tpu import obs
+from metrics_tpu.obs import flight
+
+pytestmark = [pytest.mark.fault, pytest.mark.obs]
+
+
+@pytest.fixture
+def recorder(tmp_path):
+    path = str(tmp_path / "fr.json")
+    flight.enable(capacity=32, dump_path=path, install_handlers=True)
+    yield path
+    flight.disable()
+    obs.disable()
+
+
+def test_excepthook_installed_and_chains(recorder):
+    assert sys.excepthook is flight._on_unhandled
+    seen = []
+    prev = flight._PREV_EXCEPTHOOK
+    flight._PREV_EXCEPTHOOK = lambda *a: seen.append(a)
+    try:
+        try:
+            raise RuntimeError("boom")
+        except RuntimeError:
+            sys.excepthook(*sys.exc_info())
+    finally:
+        flight._PREV_EXCEPTHOOK = prev
+    assert len(seen) == 1 and seen[0][0] is RuntimeError
+
+    events = [e["kind"] for e in flight.events()]
+    assert "unhandled_exception" in events
+    ev = [e for e in flight.events() if e["kind"] == "unhandled_exception"][0]
+    assert ev["exc_type"] == "RuntimeError"
+    assert "boom" in ev["message"]
+
+
+def test_excepthook_writes_disambiguated_dump(recorder, tmp_path):
+    try:
+        raise ValueError("crash payload")
+    except ValueError:
+        sys.excepthook(*sys.exc_info())
+    dumps = [f for f in os.listdir(tmp_path) if f.startswith("fr-h")]
+    assert len(dumps) == 1
+    assert f"p{os.getpid()}" in dumps[0]
+    payload = json.load(open(tmp_path / dumps[0]))
+    kinds = [e["kind"] for e in payload["events"]]
+    assert "unhandled_exception" in kinds
+
+
+def test_disable_restores_previous_hook(tmp_path):
+    before = sys.excepthook
+    flight.enable(capacity=8, dump_path=str(tmp_path / "x.json"), install_handlers=True)
+    assert sys.excepthook is flight._on_unhandled
+    flight.disable()
+    obs.disable()
+    assert sys.excepthook is before
+
+
+def test_no_dump_path_no_hook(tmp_path):
+    before = sys.excepthook
+    flight.enable(capacity=8)  # no handlers requested
+    try:
+        assert sys.excepthook is before
+    finally:
+        flight.disable()
+        obs.disable()
+
+
+def test_hook_never_masks_the_crash(recorder, monkeypatch):
+    """Even if the dump itself dies, the previous hook still runs."""
+    monkeypatch.setattr(flight, "dump", lambda *a, **k: 1 / 0)
+    seen = []
+    prev = flight._PREV_EXCEPTHOOK
+    flight._PREV_EXCEPTHOOK = lambda *a: seen.append(a)
+    try:
+        try:
+            raise KeyError("k")
+        except KeyError:
+            sys.excepthook(*sys.exc_info())
+    finally:
+        flight._PREV_EXCEPTHOOK = prev
+    assert len(seen) == 1

@@ -1,0 +1,226 @@
+"""The injection harness itself: gate discipline, determinism, addressing,
+thread-safety, flight attribution, and the ``input.poison`` transform."""
+import threading
+
+import jax.numpy as jnp
+import pytest
+
+from metrics_tpu import fault, obs
+from metrics_tpu.fault import inject
+from metrics_tpu.obs import flight
+
+pytestmark = pytest.mark.fault
+
+
+# ------------------------------------------------------------------ gating
+
+
+def test_no_schedule_is_inert():
+    assert inject._SCHEDULE is None
+    assert not fault.active()
+    assert fault.current() is None
+    # fire() without a schedule is a no-op, not an error
+    fault.fire("ckpt.write", step=0)
+    args, kwargs = fault.poison_inputs((jnp.ones(4),), {})
+    assert args[0].shape == (4,)
+
+
+def test_context_manager_arms_and_disarms():
+    with fault.FaultSchedule() as sched:
+        assert fault.active()
+        assert fault.current() is sched
+    assert not fault.active()
+
+
+def test_nesting_restores_outer_schedule():
+    with fault.FaultSchedule(seed=1) as outer:
+        with fault.FaultSchedule(seed=2) as inner:
+            assert fault.current() is inner
+        assert fault.current() is outer
+    assert fault.current() is None
+
+
+def test_disarms_on_exception():
+    with pytest.raises(RuntimeError):
+        with fault.FaultSchedule():
+            raise RuntimeError("x")
+    assert not fault.active()
+
+
+# -------------------------------------------------------------- addressing
+
+
+def test_explicit_fire_at_hits_exact_occurrences():
+    with fault.FaultSchedule(fire_at={"ckpt.write": (1, 3)}) as sched:
+        for i in range(5):
+            if i in (1, 3):
+                with pytest.raises(fault.InjectedFaultError) as exc:
+                    fault.fire("ckpt.write", step=i)
+                assert exc.value.site == "ckpt.write"
+                assert exc.value.occurrence == i
+            else:
+                fault.fire("ckpt.write", step=i)
+    assert [e["occurrence"] for e in sched.fired] == [1, 3]
+    assert sched.counts["ckpt.write"] == 5
+
+
+def test_int_fire_at_means_single_occurrence():
+    with fault.FaultSchedule(fire_at={"ckpt.rename": 0}):
+        with pytest.raises(fault.InjectedFaultError):
+            fault.fire("ckpt.rename")
+        fault.fire("ckpt.rename")  # occurrence 1 passes
+
+
+def test_injected_fault_is_oserror():
+    # the ckpt retry loop catches OSError; injected faults must ride that path
+    assert issubclass(fault.InjectedFaultError, OSError)
+
+
+def test_unknown_site_rejected():
+    with pytest.raises(ValueError, match="unknown fault site"):
+        fault.FaultSchedule(fire_at={"nope.site": 0})
+    with pytest.raises(ValueError, match="unknown fault site"):
+        fault.FaultSchedule(sites=("nope.site",), rate=0.5)
+    with pytest.raises(ValueError, match="rate > 0 requires sites"):
+        fault.FaultSchedule(rate=0.5)
+    with pytest.raises(ValueError, match="rate must be in"):
+        fault.FaultSchedule(rate=1.5)
+    with pytest.raises(ValueError, match="occurrences must be >= 0"):
+        fault.FaultSchedule(fire_at={"ckpt.write": -1})
+
+
+# ------------------------------------------------------------- determinism
+
+
+def _drive(sched, calls=40):
+    """Drive two sites under `sched`; return the fired (site, occurrence) set."""
+    with sched:
+        for i in range(calls):
+            for site in ("ckpt.write", "fused.launch"):
+                try:
+                    fault.fire(site, i=i)
+                except fault.InjectedFaultError:
+                    pass
+    return [(e["site"], e["occurrence"]) for e in sched.fired]
+
+
+def test_same_seed_same_fault_pattern():
+    a = _drive(fault.FaultSchedule(seed=11, sites=("ckpt.write", "fused.launch"), rate=0.3))
+    b = _drive(fault.FaultSchedule(seed=11, sites=("ckpt.write", "fused.launch"), rate=0.3))
+    assert a == b
+    assert a  # rate=0.3 over 80 draws fires with near-certainty
+
+
+def test_different_seed_different_pattern():
+    a = _drive(fault.FaultSchedule(seed=1, sites=("ckpt.write",), rate=0.3))
+    b = _drive(fault.FaultSchedule(seed=2, sites=("ckpt.write",), rate=0.3))
+    assert a != b
+
+
+def test_per_site_streams_are_independent_of_interleaving():
+    # drive site A alone vs interleaved with site B: A's pattern is identical
+    def fires_at(sched, site, calls=60):
+        out = []
+        for i in range(calls):
+            try:
+                sched._on_call(site, {}) and out.append(i)
+            except Exception:  # pragma: no cover - _on_call never raises
+                pass
+        return [e["occurrence"] for e in sched.fired if e["site"] == site]
+
+    alone = fault.FaultSchedule(seed=5, sites=("ckpt.write",), rate=0.25)
+    for _ in range(60):
+        alone._on_call("ckpt.write", {})
+
+    mixed = fault.FaultSchedule(seed=5, sites=("ckpt.write", "agg.read"), rate=0.25)
+    for _ in range(60):
+        mixed._on_call("agg.read", {})
+        mixed._on_call("ckpt.write", {})
+
+    a = [e["occurrence"] for e in alone.fired if e["site"] == "ckpt.write"]
+    b = [e["occurrence"] for e in mixed.fired if e["site"] == "ckpt.write"]
+    assert a == b
+
+
+def test_max_fires_caps_total():
+    sched = fault.FaultSchedule(fire_at={"ckpt.write": tuple(range(10))}, max_fires=3)
+    with sched:
+        for _ in range(10):
+            try:
+                fault.fire("ckpt.write")
+            except fault.InjectedFaultError:
+                pass
+    assert len(sched.fired) == 3
+
+
+def test_thread_safe_counting():
+    sched = fault.FaultSchedule(fire_at={"ckpt.fsync": 999999})
+    errs = []
+
+    def hammer():
+        try:
+            with_calls = 500
+            for _ in range(with_calls):
+                sched._on_call("ckpt.fsync", {})
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=hammer) for _ in range(4)]
+    with sched:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    assert not errs
+    assert sched.counts["ckpt.fsync"] == 2000
+
+
+# -------------------------------------------------------- flight attribution
+
+
+def test_fired_faults_land_in_flight_ring():
+    flight.enable(capacity=16, enable_obs=True)
+    try:
+        with fault.FaultSchedule(fire_at={"agg.publish": 0}):
+            with pytest.raises(fault.InjectedFaultError):
+                fault.fire("agg.publish", host=0)
+        kinds = [e["kind"] for e in flight.events()]
+        assert "fault" in kinds
+        ev = [e for e in flight.events() if e["kind"] == "fault"][0]
+        assert ev["site"] == "agg.publish"
+        assert ev["occurrence"] == 0
+    finally:
+        flight.disable()
+        obs.disable()
+
+
+# ------------------------------------------------------------ input.poison
+
+
+def test_poison_inputs_deterministic_and_partial():
+    def poisoned_mask(seed):
+        with fault.FaultSchedule(seed=seed, fire_at={"input.poison": 0}):
+            (arr,), _ = fault.poison_inputs((jnp.zeros(16),), {}, metric="M")
+        return jnp.isnan(arr)
+
+    a, b, c = poisoned_mask(3), poisoned_mask(3), poisoned_mask(4)
+    assert bool(jnp.array_equal(a, b))
+    assert int(a.sum()) == max(1, 16 // 8)
+    assert not bool(jnp.array_equal(a, c)) or int(c.sum()) != int(a.sum())
+
+
+def test_poison_skips_non_float_and_scalars():
+    with fault.FaultSchedule(fire_at={"input.poison": 0}):
+        (ints, scalar), kw = fault.poison_inputs(
+            (jnp.arange(8), jnp.float32(1.0)), {"s": "text"}, metric="M"
+        )
+    assert ints.dtype == jnp.int32 or ints.dtype == jnp.int64
+    assert not bool(jnp.isnan(jnp.asarray(scalar, jnp.float32)))
+    assert kw["s"] == "text"
+
+
+def test_poison_records_rows_in_event():
+    with fault.FaultSchedule(fire_at={"input.poison": 0}) as sched:
+        fault.poison_inputs((jnp.zeros(32),), {}, metric="M")
+    assert sched.fired[0]["rows"] == 4
+    assert sched.fired[0]["metric"] == "M"

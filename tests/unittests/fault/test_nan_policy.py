@@ -1,0 +1,177 @@
+"""The ``nan_policy`` input-poison quarantine: row counting into obs,
+warn/raise/count escalation, fused-path ineligibility, the SLO budget hook,
+and interaction with the ``input.poison`` injection site."""
+import warnings
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from metrics_tpu import fault, obs
+from metrics_tpu.core.collections import MetricCollection
+from metrics_tpu.core.fused import fusion_fallback_reason
+from metrics_tpu.fault import PoisonedInputError
+from metrics_tpu.obs import health
+from metrics_tpu.regression import MeanAbsoluteError, MeanSquaredError
+from metrics_tpu.utils.exceptions import MetricsUserWarning
+
+pytestmark = pytest.mark.fault
+
+_CLEAN_P = jnp.asarray([1.0, 2.0, 3.0])
+_CLEAN_T = jnp.asarray([1.0, 3.0, 5.0])
+_BAD_P = jnp.asarray([1.0, jnp.nan, 3.0])
+_BAD_T = jnp.asarray([1.0, 3.0, jnp.inf])
+
+
+def test_default_policy_unchanged():
+    m = MeanSquaredError()
+    assert m.nan_policy is None
+    m.update(_BAD_P, _BAD_T)  # no warn, no raise, no counter
+    assert not bool(jnp.isfinite(m.compute()))
+
+
+def test_invalid_policy_rejected():
+    with pytest.raises(ValueError, match="nan_policy"):
+        MeanSquaredError(nan_policy="drop")
+
+
+def test_count_tallies_rows_into_obs():
+    obs.enable()
+    obs.REGISTRY.clear()
+    try:
+        m = MeanSquaredError(nan_policy="count")
+        m.update(_BAD_P, _BAD_T)  # rows 1 (nan in preds) and 2 (inf in target)
+        m.update(_CLEAN_P, _CLEAN_T)
+        snap = obs.REGISTRY.snapshot()
+        assert snap["MeanSquaredError"]["nonfinite_rows"] == 2
+    finally:
+        obs.disable()
+
+
+def test_count_without_obs_is_silent():
+    m = MeanSquaredError(nan_policy="count")
+    m.update(_BAD_P, _BAD_T)
+    assert m._update_count == 1
+
+
+def test_warn_policy_warns_and_accumulates():
+    m = MeanSquaredError(nan_policy="warn")
+    with pytest.warns(MetricsUserWarning, match="2 update input row"):
+        m.update(_BAD_P, _BAD_T)
+    assert m._update_count == 1
+
+
+def test_raise_policy_rejects_batch_and_leaves_state_untouched():
+    m = MeanSquaredError(nan_policy="raise")
+    m.update(_CLEAN_P, _CLEAN_T)
+    before = float(m.compute())
+    with pytest.raises(PoisonedInputError) as exc:
+        m.update(_BAD_P, _BAD_T)
+    assert exc.value.rows == 2
+    assert exc.value.metric == "MeanSquaredError"
+    assert m._update_count == 1  # the poisoned batch never counted
+    assert float(m.compute()) == before
+
+
+def test_clean_inputs_cost_nothing_observable():
+    m = MeanSquaredError(nan_policy="raise")
+    m.update(_CLEAN_P, _CLEAN_T)
+    assert m._update_count == 1
+
+
+def test_scalar_and_integer_inputs_handled():
+    m = MeanSquaredError(nan_policy="raise")
+    # 0-d float input rows count as one row
+    with pytest.raises(PoisonedInputError):
+        m.update(jnp.float32(jnp.nan), jnp.float32(1.0))
+    m2 = MeanAbsoluteError(nan_policy="raise")
+    m2.update(jnp.asarray([1, 2, 3]), jnp.asarray([1, 2, 3]))  # ints skip the check
+
+
+def test_traced_inputs_skip_quarantine():
+    m = MeanSquaredError(nan_policy="raise")
+
+    @jax.jit
+    def f(p, t):
+        return m.local_update(m.init_state(), p, t)
+
+    f(_BAD_P, _BAD_T)  # no host sync, no raise inside the trace
+
+
+def test_nan_policy_makes_group_fusion_ineligible():
+    m = MeanSquaredError(nan_policy="count")
+    reason = fusion_fallback_reason(m, [m])
+    assert reason is not None and "nan_policy" in reason
+    assert fusion_fallback_reason(MeanSquaredError(), [MeanSquaredError()]) is None
+
+
+def test_nan_policy_metric_in_fused_collection_still_quarantines():
+    obs.enable()
+    obs.REGISTRY.clear()
+    try:
+        c = MetricCollection(
+            {"mse": MeanSquaredError(nan_policy="count"), "mae": MeanAbsoluteError()},
+            fused=True,
+        )
+        c.update(_BAD_P, _BAD_T)
+        assert obs.REGISTRY.snapshot()["MeanSquaredError"]["nonfinite_rows"] == 2
+    finally:
+        obs.disable()
+
+
+# ------------------------------------------------------------------- SLOs
+
+
+def test_max_nonfinite_rows_slo():
+    obs.enable()
+    obs.REGISTRY.clear()
+    health.enable()
+    try:
+        health.set_slo(max_nonfinite_rows=1, action="warn")
+        m = MeanSquaredError(nan_policy="count")
+        m.update(_BAD_P, _BAD_T)
+        with pytest.warns(health.SLOViolationWarning, match="max_nonfinite_rows"):
+            violations = health.check_slos()
+        assert violations[0]["slo"] == "max_nonfinite_rows"
+        assert violations[0]["measured"] == 2
+    finally:
+        health.disable()
+        obs.disable()
+
+
+def test_max_nonfinite_rows_slo_within_budget():
+    obs.enable()
+    obs.REGISTRY.clear()
+    health.enable()
+    try:
+        health.set_slo(max_nonfinite_rows=10)
+        m = MeanSquaredError(nan_policy="count")
+        m.update(_BAD_P, _BAD_T)
+        assert health.check_slos() == []
+    finally:
+        health.disable()
+        obs.disable()
+
+
+# --------------------------------------------------------- injected poison
+
+
+def test_injected_poison_caught_by_quarantine():
+    obs.enable()
+    obs.REGISTRY.clear()
+    try:
+        m = MeanSquaredError(nan_policy="count")
+        with fault.FaultSchedule(fire_at={"input.poison": 0}) as sched:
+            m.update(jnp.ones(16), jnp.ones(16))
+        assert sched.fired[0]["rows"] == 4  # 2 rows poisoned per array
+        assert obs.REGISTRY.snapshot()["MeanSquaredError"]["nonfinite_rows"] >= 2
+    finally:
+        obs.disable()
+
+
+def test_injected_poison_rejected_by_raise_policy():
+    m = MeanSquaredError(nan_policy="raise")
+    with fault.FaultSchedule(fire_at={"input.poison": 0}):
+        with pytest.raises(PoisonedInputError):
+            m.update(jnp.ones(16), jnp.ones(16))
+    assert m._update_count == 0
