@@ -37,7 +37,11 @@ DEFAULT_THRESHOLD = 0.15
 LEGACY_BACKEND = "tpu"
 
 #: per-config sub-fields gated as ms latencies when a round records them
-GATED_SPLIT_FIELDS = ("sort_ms", "post_sort_ms", "layout_sort_ms", "scan_ms")
+#: (``tick_p50_ms`` is the ingest tier's deepest coalesced-tick latency — the
+#: headline ``ingest_sustained_enqueue`` value gates higher-is-better via its
+#: ``Kenq/s`` unit, so both directions of ISSUE 13 are covered)
+GATED_SPLIT_FIELDS = ("sort_ms", "post_sort_ms", "layout_sort_ms", "scan_ms",
+                      "tick_p50_ms")
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
 

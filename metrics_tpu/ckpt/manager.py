@@ -43,6 +43,7 @@ import os
 import random
 import re
 import shutil
+import sys
 import threading
 import time
 import warnings
@@ -560,6 +561,13 @@ def save_checkpoint(
     final_dir = os.path.join(directory, _step_name(step))
     if _is_committed(final_dir):
         raise CheckpointError(f"checkpoint step {step} already exists in {directory}")
+
+    # flush-before-save: a checkpoint of a queue-fronted metric must carry
+    # every enqueued row. Resolved through sys.modules so the serve tier costs
+    # nothing (not even an import) unless the app already uses it.
+    _ingest = sys.modules.get("metrics_tpu.serve.ingest")
+    if _ingest is not None:
+        _ingest.flush_for(obj)
 
     tree, entries = _snapshot(obj, persistent_only)
     if _obs._ENABLED and _obs_flight._RING is not None:

@@ -186,7 +186,10 @@ def _split_inputs(args: Tuple, kwargs: Dict) -> Tuple[List[Any], Tuple[Any, tupl
     spec: List[Any] = []
     for leaf in leaves:
         if is_array(leaf):
-            dyn.append(jnp.asarray(leaf))
+            # already-device leaves skip the asarray dtype-lattice walk: it is
+            # a ~50us no-op per leaf, which dominates high-rate call sites like
+            # the ingest tick (128 coalesced entries -> 256+ leaves per launch)
+            dyn.append(leaf if isinstance(leaf, jax.Array) else jnp.asarray(leaf))
             spec.append(_DYN)
         else:
             spec.append(leaf)
@@ -218,8 +221,10 @@ def _static_key(spec: Tuple[Any, tuple]) -> Tuple:
 
 
 def _aval_key(tree: Any) -> Tuple:
+    # dtype objects hash/compare directly; stringifying them (numpy's dtype
+    # __str__ is slow python) dominated the per-tick key cost at ingest rates
     leaves, treedef = jax.tree_util.tree_flatten(tree)
-    return (treedef, tuple((tuple(l.shape), str(l.dtype)) for l in leaves))
+    return (treedef, tuple((tuple(l.shape), l.dtype) for l in leaves))
 
 
 # ------------------------------------------------------------------ engine

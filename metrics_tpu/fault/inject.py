@@ -21,6 +21,9 @@ faults by it):
     ``fleet.compile``  AOT compile of a fleet routed/broadcast step
     ``agg.publish``    obs snapshot publish (``obs/aggregate.publish``)
     ``agg.read``       per-host snapshot read (``obs/aggregate.aggregate_dir``)
+    ``ingest.enqueue`` batch admission into the staging ring (``serve/ingest.py``)
+    ``ingest.tick``    the coalescing tick of an ``IngestQueue`` — a fired tick
+                       degrades to applying the pending batches synchronously
     ``input.poison``   NaN-poisoning of update inputs (``Metric._wrap_update``)
 
 Every site except ``input.poison`` *raises* :class:`InjectedFaultError` (an
@@ -65,6 +68,8 @@ SITES = (
     "fleet.compile",
     "agg.publish",
     "agg.read",
+    "ingest.enqueue",
+    "ingest.tick",
     "input.poison",
 )
 

@@ -51,7 +51,8 @@ from metrics_tpu.obs.registry import (
 # `obs.trace` to the XProf capture contextmanager (the documented public name).
 # The exporter stays reachable as `obs.export_chrome_trace` / via
 # `metrics_tpu.obs import trace as trace_export`.
-from metrics_tpu.obs import aggregate, costcheck, flight, health, prom, recompile, registry, series
+from metrics_tpu.obs import aggregate, costcheck, flight, health, prom, recompile, registry, ring, series
+from metrics_tpu.obs.ring import Ring
 from metrics_tpu.obs import trace as _trace_export
 from metrics_tpu.obs.costcheck import CostDriftWarning, crosscheck
 from metrics_tpu.obs.export import SCHEMA_VERSION, dump_jsonl, validate_snapshot
@@ -94,6 +95,7 @@ __all__ = [
     "SCHEMA_VERSION",
     "CostDriftWarning",
     "ObsRegistry",
+    "Ring",
     "SLOBudget",
     "SLOBudgetExceeded",
     "SLOViolationWarning",
@@ -121,6 +123,7 @@ __all__ = [
     "registry",
     "reset_class_detector",
     "reset_detector",
+    "ring",
     "series",
     "snapshot",
     "snapshot_json",

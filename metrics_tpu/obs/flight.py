@@ -4,7 +4,7 @@ A metrics stack that dies on a preempted TPU slice leaves nothing behind but an
 exit code; the questions that matter — *what was the last fused launch? did the
 checkpoint commit? was the job mid-retrace-storm?* — need the last few hundred
 runtime events, not a profiler session that was never started. The flight
-recorder keeps exactly that: a fixed-capacity ring (``collections.deque``) of
+recorder keeps exactly that: a fixed-capacity ring (:class:`obs.ring.Ring`) of
 small structured events appended by the instrumented hot paths, and a
 ``dump()`` that writes the surviving window (plus ``state_report()`` snapshots
 of recently-checkpointed metrics) as one JSON file.
@@ -43,15 +43,16 @@ import sys
 import threading
 import time
 import weakref
-from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
+
+from metrics_tpu.obs.ring import Ring
 
 #: schema stamp of the dump file (bump on breaking layout changes)
 DUMP_SCHEMA_VERSION = 1
 
 #: the ring itself. ``None`` == recorder off == nothing allocated; hot paths
 #: gate on ``_RING is not None`` (one module-attribute load + identity check).
-_RING: Optional[deque] = None
+_RING: Optional[Ring] = None
 
 _SEQ = itertools.count()
 _LOCK = threading.Lock()
@@ -111,7 +112,7 @@ def enable(
     if capacity < 1:
         raise ValueError(f"flight recorder capacity must be >= 1, got {capacity}")
     with _LOCK:
-        _RING = deque(maxlen=capacity)
+        _RING = Ring(capacity)
         _CAPACITY = capacity
         _DUMP_PATH = dump_path
         _CKPT_INTEGRATION = bool(ckpt_integration)
@@ -164,7 +165,7 @@ def record(kind: str, ts_us: Optional[float] = None, **fields: Any) -> None:
         return
     event = {"seq": next(_SEQ), "ts_us": _now_us() if ts_us is None else ts_us, "kind": kind}
     event.update(fields)
-    ring.append(event)  # deque.append with maxlen is atomic under the GIL
+    ring.append(event)  # Ring.append is GIL-atomic and lock-free (obs/ring.py)
 
 
 def _aval_str(x: Any) -> str:
@@ -189,19 +190,13 @@ def record_dispatch(metric_name: str, args: Tuple, kwargs: Dict) -> None:
 def events() -> List[Dict[str, Any]]:
     """Snapshot of the current window, oldest first.
 
-    ``deque.append`` is atomic under the GIL but iterating a deque while
-    another thread appends can raise ``RuntimeError`` — retry rather than
-    locking the hot-path append.
+    ``Ring.snapshot`` retries the rare iterate-during-append ``RuntimeError``
+    rather than locking the hot-path append.
     """
     ring = _RING
     if ring is None:
         return []
-    for _ in range(8):
-        try:
-            return list(ring)
-        except RuntimeError:
-            continue
-    return list(ring)
+    return ring.snapshot()
 
 
 def last(k: int) -> List[Dict[str, Any]]:

@@ -67,6 +67,13 @@ class SLOBudget:
         max_nonfinite_rows: ceiling on total NaN/Inf input rows tallied by
             ``Metric(nan_policy=...)`` quarantines (summed ``nonfinite_rows``
             counters across scopes) — an input-poisoning SLO.
+        max_queue_depth: ceiling on the deepest ingest staging backlog across
+            active ``serve.IngestQueue`` instances at check time — a producer
+            outrunning the tick thread is a serving incident before it is a
+            data-loss incident.
+        p99_ingest_latency_ms: ceiling on any queue's p99 enqueue→applied
+            latency (the ``ingest/<queue>`` health sketches) — the freshness
+            SLO of the async ingestion tier.
         action: ``"warn"`` | ``"raise"`` | callable(list_of_violations).
     """
 
@@ -76,6 +83,8 @@ class SLOBudget:
         max_retraces_per_window: Optional[int] = None,
         p99_update_latency_ms: Optional[float] = None,
         max_nonfinite_rows: Optional[int] = None,
+        max_queue_depth: Optional[int] = None,
+        p99_ingest_latency_ms: Optional[float] = None,
         action: Union[str, Callable[[List[Dict[str, Any]]], None]] = "warn",
     ) -> None:
         if isinstance(action, str) and action not in ("warn", "raise"):
@@ -84,6 +93,8 @@ class SLOBudget:
         self.max_retraces_per_window = max_retraces_per_window
         self.p99_update_latency_ms = p99_update_latency_ms
         self.max_nonfinite_rows = max_nonfinite_rows
+        self.max_queue_depth = max_queue_depth
+        self.p99_ingest_latency_ms = p99_ingest_latency_ms
         self.action = action
 
 
@@ -360,6 +371,42 @@ class HealthMonitor:
                             "measured": round(p99_ms, 4),
                             "detail": f"metric {key.split('/', 1)[1]}"
                             + ("" if row.get("p99_certified") else " (uncertified edge-bin rank)"),
+                        }
+                    )
+
+        if budget.p99_ingest_latency_ms is not None:
+            latency = self.report()["latency_us"]
+            for key, row in latency.items():
+                if not key.startswith("ingest/"):
+                    continue
+                p99_ms = row.get("p99_us", float("nan")) / 1000.0
+                if p99_ms > budget.p99_ingest_latency_ms:
+                    violations.append(
+                        {
+                            "slo": "p99_ingest_latency_ms",
+                            "budget": budget.p99_ingest_latency_ms,
+                            "measured": round(p99_ms, 4),
+                            "detail": f"queue {key.split('/', 1)[1]} enqueue->applied"
+                            + ("" if row.get("p99_certified") else " (uncertified edge-bin rank)"),
+                        }
+                    )
+
+        if budget.max_queue_depth is not None:
+            # pulled on demand, never from a hot path: the ingest tier only
+            # participates once its module has been imported by the app
+            import sys as _sys
+
+            _ingest = _sys.modules.get("metrics_tpu.serve.ingest")
+            if _ingest is not None:
+                depth = _ingest.max_queue_depth()
+                if depth > budget.max_queue_depth:
+                    violations.append(
+                        {
+                            "slo": "max_queue_depth",
+                            "budget": budget.max_queue_depth,
+                            "measured": depth,
+                            "detail": "deepest staging backlog across active"
+                            " serve.IngestQueue instances",
                         }
                     )
 

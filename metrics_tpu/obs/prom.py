@@ -160,6 +160,55 @@ def render() -> str:
             hbm.add("", "", report["hbm_watermark_bytes"])
             families.append(hbm)
 
+    # the async ingestion tier, pulled on demand: nothing here is reachable
+    # from the enqueue/tick hot paths, and the import only resolves when the
+    # app already imported the serve tier itself
+    import sys as _sys
+
+    _ingest = _sys.modules.get("metrics_tpu.serve.ingest")
+    if _ingest is not None:
+        queues = _ingest.active_queues()
+        if queues:
+            depth = _Family(
+                "tm_ingest_queue_depth", "gauge",
+                "Batches currently staged (pending, unapplied) per IngestQueue.",
+            )
+            capacity = _Family(
+                "tm_ingest_queue_capacity", "gauge",
+                "Staging-ring capacity per IngestQueue.",
+            )
+            ing_counters = {
+                "enqueued": _Family(
+                    "tm_ingest_enqueued", "counter",
+                    "Batches admitted into the staging ring per IngestQueue.",
+                ),
+                "ticks": _Family(
+                    "tm_ingest_ticks", "counter",
+                    "Coalescing ticks applied per IngestQueue.",
+                ),
+                "coalesced_rows": _Family(
+                    "tm_ingest_coalesced_rows", "counter",
+                    "Input rows applied through coalescing ticks per IngestQueue.",
+                ),
+                "dropped": _Family(
+                    "tm_ingest_dropped", "counter",
+                    "Batches evicted by drop_oldest backpressure or a drain=False close.",
+                ),
+                "degrades": _Family(
+                    "tm_ingest_degrades", "counter",
+                    "Ticks that fell back to applying their batches synchronously.",
+                ),
+            }
+            for q in queues:
+                labels = _labels(queue=q.name)
+                depth.add("", labels, q.depth)
+                capacity.add("", labels, q.capacity)
+                for stat, family in ing_counters.items():
+                    family.add("_total", labels, q.stats.get(stat, 0))
+            families.append(depth)
+            families.append(capacity)
+            families.extend(ing_counters.values())
+
     smp = _series._SAMPLER
     if smp is not None:
         ticks = _Family(

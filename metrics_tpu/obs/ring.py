@@ -1,0 +1,114 @@
+"""A fixed-capacity ring buffer shared by the flight recorder and ingest tier.
+
+Two very different producers converged on the same discipline: the flight
+recorder (``obs/flight.py``) appends post-mortem events from instrumented hot
+paths, and the async ingestion tier (``serve/ingest.py``) stages pending
+update batches for the coalescing tick thread. Both need the same three
+properties, factored here so there is exactly one implementation with two
+regression-tested users:
+
+- **Fixed capacity, allocate-once**: the backing ``collections.deque`` is
+  sized at construction; a full ring either evicts the oldest item
+  (:meth:`append` — the flight recorder's "last K events" semantics) or
+  refuses the new one (:meth:`try_append` — the ingest tier's backpressure
+  semantics decide what happens next).
+- **GIL-atomic lock-free append**: ``deque.append`` with ``maxlen`` is atomic
+  under the GIL, so the hot-path producer never takes a lock.
+- **Drain-under-lock**: consumers that must not lose or double-see items
+  (:meth:`drain`, :meth:`pop_oldest`, :meth:`try_append`) serialize on one
+  internal lock; the lock-free :meth:`snapshot` instead retries the rare
+  ``RuntimeError`` from iterating concurrently with an append.
+"""
+import threading
+from collections import deque
+from typing import Any, List, Optional
+
+__all__ = ["Ring"]
+
+
+class Ring:
+    """Bounded FIFO ring: lock-free evicting append, locked exact drain."""
+
+    __slots__ = ("_dq", "_capacity", "_lock")
+
+    def __init__(self, capacity: int) -> None:
+        if capacity < 1:
+            raise ValueError(f"ring capacity must be >= 1, got {capacity}")
+        self._capacity = int(capacity)
+        self._dq: deque = deque(maxlen=self._capacity)
+        self._lock = threading.Lock()
+
+    @property
+    def capacity(self) -> int:
+        return self._capacity
+
+    def __len__(self) -> int:
+        return len(self._dq)
+
+    @property
+    def full(self) -> bool:
+        return len(self._dq) >= self._capacity
+
+    # ------------------------------------------------------------ producing
+
+    def append(self, item: Any) -> None:
+        """Lock-free append; silently evicts the oldest item when full.
+
+        ``deque.append`` with ``maxlen`` is atomic under the GIL — this is the
+        flight-recorder hot path and must never block.
+        """
+        self._dq.append(item)
+
+    def try_append(self, item: Any) -> bool:
+        """Locked append that refuses (returns False) instead of evicting.
+
+        The check-then-append runs under the ring lock so concurrent
+        producers can never overshoot capacity or silently drop an item —
+        the contract the ingest backpressure policies are built on.
+        """
+        with self._lock:
+            if len(self._dq) >= self._capacity:
+                return False
+            self._dq.append(item)
+            return True
+
+    # ------------------------------------------------------------ consuming
+
+    def pop_oldest(self) -> Optional[Any]:
+        """Remove and return the oldest item, or None when empty (locked)."""
+        with self._lock:
+            try:
+                return self._dq.popleft()
+            except IndexError:
+                return None
+
+    def drain(self, limit: Optional[int] = None) -> List[Any]:
+        """Remove and return up to ``limit`` oldest items (all, when None).
+
+        Runs under the ring lock: every item lands in exactly one drain call
+        even with concurrent producers and multiple consumers.
+        """
+        out: List[Any] = []
+        with self._lock:
+            n = len(self._dq) if limit is None else min(limit, len(self._dq))
+            for _ in range(n):
+                out.append(self._dq.popleft())
+        return out
+
+    def snapshot(self) -> List[Any]:
+        """Non-destructive copy, oldest first, without locking the producer.
+
+        Iterating a deque while another thread appends can raise
+        ``RuntimeError`` — retry rather than making :meth:`append` pay for a
+        lock it never needs.
+        """
+        for _ in range(8):
+            try:
+                return list(self._dq)
+            except RuntimeError:
+                continue
+        return list(self._dq)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._dq.clear()

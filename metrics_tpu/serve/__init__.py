@@ -1,0 +1,28 @@
+"""metrics_tpu.serve — the serving-path tiers built on top of the core.
+
+Currently one member: the async ingestion tier (:mod:`metrics_tpu.serve.ingest`),
+which decouples host batch arrival from device accumulation with a bounded
+staging ring and a coalescing tick thread::
+
+    from metrics_tpu.serve import IngestQueue
+
+    q = IngestQueue(metric, capacity=1024, backpressure="block")
+    q.enqueue(preds, target, stream_ids=ids)   # host append, no dispatch
+    value = q.compute()                        # flush-before-read, exact
+    q.close()                                  # clean shutdown drain
+"""
+from metrics_tpu.serve.ingest import (
+    IngestBackpressureError,
+    IngestQueue,
+    active_queues,
+    flush_for,
+    max_queue_depth,
+)
+
+__all__ = [
+    "IngestBackpressureError",
+    "IngestQueue",
+    "active_queues",
+    "flush_for",
+    "max_queue_depth",
+]

@@ -1,0 +1,707 @@
+"""Async ingestion tier: a host-side staging ring + coalesced one-launch ticks.
+
+The synchronous serving path pays one host dispatch per ``update()`` call —
+measured host-bound at ~0.5 ms/call on CPU even with the fused and fleet tiers
+already at one launch per step. At traffic scale that per-call floor *is* the
+throughput ceiling. This module removes it by decoupling arrival from
+accumulation:
+
+- :meth:`IngestQueue.enqueue` appends the batch (args + kwargs, including
+  ``stream_ids``) to a bounded host-side ring (:class:`obs.ring.Ring` — the
+  same ring discipline as the flight recorder) and returns immediately. No
+  device work, no jit cache lookup, no dispatch.
+- A background tick thread drains everything pending and applies it as **one
+  compiled launch per tick**: the pending batches are chained through the
+  target's pure ``local_update`` transitions inside a single donated
+  executable, in enqueue order. Chaining — never row concatenation — is what
+  makes the result **bit-equal** to applying the same batches synchronously:
+  each batch keeps its own shapes and reduction order, only the host dispatch
+  is amortized. (Concatenating rows re-associates the float reductions and is
+  *not* bitwise stable; this module never does it.)
+
+Correctness contract:
+
+- **Bit-equal**: after ``flush()``, the target's state is bitwise identical to
+  the state produced by calling ``target.update`` synchronously with the same
+  batches in the same order.
+- **Bounded backpressure**: a full ring either blocks the producer
+  (``backpressure="block"``), evicts the oldest pending batch
+  (``"drop_oldest"``, counted in ``stats["dropped"]``), or raises
+  :class:`IngestBackpressureError` (``"raise"``).
+- **Staleness bound on reads**: :meth:`IngestQueue.compute` flushes pending
+  batches before reading (exact), unless ``max_staleness_s`` allows returning
+  the last ticked state. Reading the target directly requires an explicit
+  ``flush()`` first — same rule the checkpoint writer follows
+  (``ckpt.save_checkpoint`` flushes any active queue for the object being
+  saved, so checkpoints never miss enqueued rows).
+- **Clean shutdown**: ``close(drain=True)`` (and the context-manager exit)
+  stops the tick thread and applies everything still pending.
+- **Graceful degradation**: a failed tick — including an injected
+  ``ingest.tick`` fault — falls back to applying the pending batches
+  synchronously through the public ``update`` path. No rows are lost; the
+  demotion is counted (``stats["degrades"]``, obs ``ingest.degrades``) and
+  recorded as a ``degrade`` flight event.
+
+Donation interaction: the chained launch donates the gathered state tree, so
+it reuses the fused engine's snapshot-before-donate machinery
+(``_secure_ckpt_snapshots`` materializes in-flight async-checkpoint snapshot
+entries) and its donation guard (default-aliased and duplicated buffers are
+copied before the donating call).
+
+Eligibility mirrors the fused engine: a target (or compute-group leader)
+whose update cannot be chained — host-side update, list ('cat') state without
+``cat_capacity``, ``nan_policy`` quarantine, wrapper metrics, ...
+(``fused.fusion_fallback_reason``) — is still served by the queue, but its
+pending batches are applied eagerly inside the tick (one dispatch per batch,
+full synchronous semantics preserved). ``--ingest`` in ``bench.py`` measures
+the coalesced path; ``docs/source/pages/ingestion.rst`` documents when *not*
+to put a queue in front of a metric.
+"""
+import itertools
+import threading
+import time
+import weakref
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.core.fused import (
+    FusedCollectionUpdate,
+    _aval_key,
+    _merge_inputs,
+    _split_inputs,
+    _static_key,
+    _warn_degrade_once,
+    fusion_fallback_reason,
+)
+from metrics_tpu.fault import inject as _fault
+from metrics_tpu.obs import flight as _obs_flight
+from metrics_tpu.obs import health as _health
+from metrics_tpu.obs import registry as _obs
+from metrics_tpu.obs.ring import Ring
+
+__all__ = [
+    "IngestBackpressureError",
+    "IngestQueue",
+    "active_queues",
+    "flush_for",
+    "max_queue_depth",
+]
+
+#: every live, unclosed queue — consulted by ``ckpt.save_checkpoint``
+#: (flush-before-save) and ``obs.prom.render`` (tm_ingest_* gauges). Weak so
+#: a dropped queue never outlives its last strong reference.
+_ACTIVE: "weakref.WeakSet[IngestQueue]" = weakref.WeakSet()
+
+_NAME_SEQ = itertools.count()
+
+_BACKPRESSURE_POLICIES = ("block", "drop_oldest", "raise")
+
+
+class IngestBackpressureError(RuntimeError):
+    """The staging ring is full and the policy refuses the batch: raised
+    immediately under ``backpressure="raise"``, or after ``block_timeout_s``
+    under ``backpressure="block"``."""
+
+
+class _DonatedStateLost(RuntimeError):
+    """A chained launch failed AFTER consuming its donated inputs: the live
+    state cannot be re-pointed and a synchronous retry would double-apply.
+    Never degraded; stashed and re-raised at the next host-call boundary."""
+
+    def __init__(self, queue: str, cause: BaseException) -> None:
+        super().__init__(
+            f"IngestQueue {queue!r}: coalesced launch failed after donation"
+            f" consumed the state buffers ({type(cause).__name__}: {cause});"
+            " the accumulated state is unrecoverable — reset the target"
+        )
+        self.__cause__ = cause
+
+
+class _Entry:
+    """One enqueued batch: inputs verbatim plus arrival bookkeeping."""
+
+    __slots__ = ("args", "kwargs", "rows", "t_enq")
+
+    def __init__(self, args: Tuple, kwargs: Dict, rows: int, t_enq: float) -> None:
+        self.args = args
+        self.kwargs = kwargs
+        self.rows = rows
+        self.t_enq = t_enq
+
+
+def _count_rows(args: Tuple, kwargs: Dict) -> int:
+    """Leading dim of the first array-ish input — the coalesced_rows unit."""
+    for value in itertools.chain(args, kwargs.values()):
+        shape = getattr(value, "shape", None)
+        if shape:
+            return int(shape[0])
+    return 1
+
+
+class IngestQueue:
+    """Bounded async staging for a ``Metric`` or ``MetricCollection``.
+
+    Args:
+        target: the metric or collection every enqueued batch is applied to.
+            The queue never copies it — reads of ``target`` stay live, which
+            is why direct reads require :meth:`flush` first.
+        capacity: staging-ring size (pending batches, not rows).
+        tick_interval_s: how long the background thread sleeps between drain
+            attempts; an enqueue also wakes it immediately.
+        backpressure: ``"block"`` | ``"drop_oldest"`` | ``"raise"`` — what a
+            full ring does to the producer (see module docstring).
+        block_timeout_s: upper bound on a blocked producer's wait before
+            :class:`IngestBackpressureError`.
+        max_staleness_s: when set, :meth:`compute` may serve the last ticked
+            state instead of flushing, as long as the newest applied tick is
+            at most this old. ``None`` (default) = always flush-before-read.
+        max_coalesce: most batches chained into one launch; a deeper backlog
+            drains in successive launches. Bounds both the chained program
+            length and the compile-cache variety.
+        name: label used in obs counters, flight events, health latency keys
+            and ``tm_ingest_*`` Prometheus gauges.
+        start: start the background tick thread (``False`` = manual ticking
+            via :meth:`flush`, the deterministic mode tests and the chaos
+            sweep use).
+    """
+
+    def __init__(
+        self,
+        target: Any,
+        *,
+        capacity: int = 1024,
+        tick_interval_s: float = 0.005,
+        backpressure: str = "block",
+        block_timeout_s: float = 30.0,
+        max_staleness_s: Optional[float] = None,
+        max_coalesce: int = 128,
+        name: Optional[str] = None,
+        start: bool = True,
+    ) -> None:
+        if backpressure not in _BACKPRESSURE_POLICIES:
+            raise ValueError(
+                f"backpressure must be one of {_BACKPRESSURE_POLICIES}, got {backpressure!r}"
+            )
+        if max_coalesce < 1:
+            raise ValueError(f"max_coalesce must be >= 1, got {max_coalesce}")
+        self.target = target
+        self.name = name or f"{type(target).__name__}-{next(_NAME_SEQ)}"
+        self.backpressure = backpressure
+        self.block_timeout_s = float(block_timeout_s)
+        self.max_staleness_s = max_staleness_s
+        self.max_coalesce = int(max_coalesce)
+        self.tick_interval_s = float(tick_interval_s)
+
+        self._ring = Ring(capacity)
+        # producer-side lock/condvar: admission checks and the block policy
+        self._admit = threading.Condition(threading.Lock())
+        # one tick at a time: background thread, flush(), and close() serialize
+        self._tick_lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._closed = False
+        #: first unrecoverable apply error; re-raised at the next host boundary
+        self._error: Optional[BaseException] = None
+
+        # chained-launch executable cache: signature key -> compiled step
+        self._cache: Dict[Tuple, Any] = {}
+        self._broken_keys: set = set()
+
+        self.stats: Dict[str, int] = {
+            "enqueued": 0,
+            "ticks": 0,
+            "launches": 0,
+            "coalesced_rows": 0,
+            "dropped": 0,
+            "degrades": 0,
+            "eager_entries": 0,
+            "max_depth": 0,
+        }
+        self._last_apply_t = time.monotonic()
+
+        self._thread: Optional[threading.Thread] = None
+        _ACTIVE.add(self)
+        if start:
+            self._thread = threading.Thread(
+                target=self._loop, name=f"tm-ingest/{self.name}", daemon=True
+            )
+            self._thread.start()
+
+    # ------------------------------------------------------------- producer
+
+    @property
+    def depth(self) -> int:
+        """Batches currently staged (pending, not yet applied)."""
+        return len(self._ring)
+
+    @property
+    def capacity(self) -> int:
+        return self._ring.capacity
+
+    def enqueue(self, *args: Any, **kwargs: Any) -> None:
+        """Stage one batch; returns without touching the device.
+
+        Accepts exactly what ``target.update`` accepts (``stream_ids=`` rides
+        along for fleet metrics). Admission is the only place backpressure
+        acts; see the class docstring for the three policies.
+        """
+        if self._closed:
+            raise RuntimeError(f"IngestQueue {self.name!r} is closed")
+        self._reraise()
+        if _fault._SCHEDULE is not None:
+            _fault.fire("ingest.enqueue", queue=self.name, depth=len(self._ring))
+        # **kwargs already materialized a fresh dict for this call — no copy
+        entry = _Entry(args, kwargs, _count_rows(args, kwargs), time.monotonic())
+        with self._admit:
+            if self._ring.full:
+                if self.backpressure == "raise":
+                    raise IngestBackpressureError(
+                        f"IngestQueue {self.name!r} is full"
+                        f" ({self._ring.capacity} pending batches) and"
+                        " backpressure='raise'; flush(), widen capacity, or"
+                        " pick 'block'/'drop_oldest'"
+                    )
+                if self.backpressure == "drop_oldest":
+                    if self._ring.pop_oldest() is not None:
+                        self.stats["dropped"] += 1
+                        if _obs._ENABLED:
+                            _obs.REGISTRY.inc("ingest", "dropped")
+                else:  # block
+                    deadline = time.monotonic() + self.block_timeout_s
+                    while self._ring.full:
+                        self._wake.set()
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0 or not self._admit.wait(remaining):
+                            raise IngestBackpressureError(
+                                f"IngestQueue {self.name!r}: producer blocked"
+                                f" > {self.block_timeout_s}s on a full ring"
+                                " (is the tick thread running?)"
+                            )
+                        self._reraise()
+            self._ring.append(entry)
+            self.stats["enqueued"] += 1
+            depth = len(self._ring)
+            if depth > self.stats["max_depth"]:
+                self.stats["max_depth"] = depth
+        if _obs._ENABLED:
+            _obs.REGISTRY.inc("ingest", "enqueued")
+        if self._thread is not None:  # nobody waits on _wake in manual mode
+            self._wake.set()
+
+    # ------------------------------------------------------------- reading
+
+    def flush(self) -> None:
+        """Apply everything pending; on return the target state is exact."""
+        with self._tick_lock:
+            self._run_ticks()
+        self._reraise()
+
+    def compute(self, **kwargs: Any) -> Any:
+        """Staleness-bounded read of ``target.compute()``.
+
+        Default (``max_staleness_s=None``): flush-before-read — pending
+        batches are applied first and the value is exact. With a staleness
+        budget, pending batches are left staged when the last applied tick is
+        fresh enough, and the *last ticked state* is read instead.
+        """
+        self._reraise()
+        if len(self._ring):
+            stale_ok = (
+                self.max_staleness_s is not None
+                and (time.monotonic() - self._last_apply_t) <= self.max_staleness_s
+            )
+            if not stale_ok:
+                self.flush()
+        return self.target.compute(**kwargs)
+
+    # ------------------------------------------------------------ lifecycle
+
+    def close(self, drain: bool = True) -> None:
+        """Stop the tick thread; ``drain=True`` applies everything pending,
+        ``drain=False`` discards it (counted in ``stats['dropped']``)."""
+        if self._closed:
+            return
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=max(10.0, self.block_timeout_s))
+            self._thread = None
+        with self._tick_lock:
+            if drain:
+                self._run_ticks()
+            else:
+                discarded = self._ring.drain()
+                if discarded:
+                    self.stats["dropped"] += len(discarded)
+                    if _obs._ENABLED:
+                        _obs.REGISTRY.inc("ingest", "dropped", len(discarded))
+        self._closed = True
+        _ACTIVE.discard(self)
+        self._reraise()
+
+    def __enter__(self) -> "IngestQueue":
+        return self
+
+    def __exit__(self, *exc_info: Any) -> None:
+        self.close(drain=True)
+
+    def _reraise(self) -> None:
+        err = self._error
+        if err is not None:
+            self._error = None
+            raise err
+
+    # ------------------------------------------------------------- ticking
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait(self.tick_interval_s)
+            self._wake.clear()
+            if self._stop.is_set():
+                break
+            if not len(self._ring):
+                continue
+            with self._tick_lock:
+                self._run_ticks()
+
+    def _run_ticks(self) -> None:
+        """Drain-and-apply until the ring is empty (caller holds _tick_lock).
+
+        Never raises: apply failures degrade to the synchronous path, and an
+        unrecoverable error is stashed for the next host-call boundary
+        (``enqueue``/``flush``/``compute``/``close``) — a background thread
+        has nowhere useful to raise.
+        """
+        while True:
+            with self._admit:
+                entries = self._ring.drain(limit=self.max_coalesce)
+                if entries:
+                    self._admit.notify_all()
+            if not entries:
+                return
+            try:
+                self._apply(entries)
+            except BaseException as err:  # noqa: BLE001 — see docstring
+                if self._error is None:
+                    self._error = err
+                return
+
+    def _apply(self, entries: List[_Entry]) -> None:
+        """One tick: chain the drained batches into one donated launch."""
+        launches_before = self.stats["launches"]
+        if _fault._SCHEDULE is not None:
+            try:
+                _fault.fire("ingest.tick", queue=self.name, entries=len(entries))
+            except _fault.InjectedFaultError as err:
+                self._degrade(entries, err)
+                self._finish_tick(entries, launched=0)
+                return
+        try:
+            launched = self._apply_coalesced(entries)
+        except _DonatedStateLost:
+            # the state is gone; degrading would double-apply — propagate
+            raise
+        except Exception as err:  # noqa: BLE001 — eager is always correct
+            # anything else (trace/compile/shape failures) degrades cleanly:
+            # the donation guard kept the pre-launch buffers intact
+            self._degrade(entries, err)
+            launched = self.stats["launches"] - launches_before
+        self._finish_tick(entries, launched=launched)
+
+    def _finish_tick(self, entries: List[_Entry], launched: int) -> None:
+        now = time.monotonic()
+        rows = sum(e.rows for e in entries)
+        self.stats["ticks"] += 1
+        self.stats["coalesced_rows"] += rows
+        self._last_apply_t = now
+        if _obs._ENABLED:
+            _obs.REGISTRY.inc("ingest", "ticks")
+            _obs.REGISTRY.inc("ingest", "coalesced_rows", rows)
+            if _obs_flight._RING is not None:
+                _obs_flight.record(
+                    "ingest_tick",
+                    queue=self.name,
+                    entries=len(entries),
+                    rows=rows,
+                    launches=launched,
+                )
+        mon = _health._MONITOR
+        if mon is not None:
+            for e in entries:
+                mon.observe_latency("ingest", self.name, now - e.t_enq)
+
+    # ----------------------------------------------------- degradation path
+
+    def _degrade(self, entries: List[_Entry], err: Exception) -> None:
+        """Apply the pending batches synchronously — no rows lost."""
+        self.stats["degrades"] += 1
+        if _obs._ENABLED:
+            _obs.REGISTRY.inc("ingest", "degrades")
+            if _obs_flight._RING is not None:
+                _obs_flight.record(
+                    "degrade",
+                    site="ingest.tick",
+                    queue=self.name,
+                    entries=len(entries),
+                    error=type(err).__name__,
+                )
+        _warn_degrade_once(
+            "ingest.tick",
+            err,
+            "the pending batches were applied synchronously (no rows lost).",
+        )
+        for e in entries:
+            try:
+                self.target.update(*e.args, **e.kwargs)
+            except BaseException as apply_err:  # noqa: BLE001 — keep draining
+                # a rejected batch (quarantine, user error) is the same outcome
+                # the synchronous caller would have seen; stash the first one
+                # and keep the later batches flowing
+                if self._error is None:
+                    self._error = apply_err
+
+    # ------------------------------------------------------- coalesced path
+
+    def _plan(self) -> Tuple[List[Tuple[str, Any]], List[Tuple[str, Any]], bool]:
+        """Resolve the target into (chainable leaders, eager leaders).
+
+        Returns ``(chain, eager, is_collection)`` where each element is a
+        ``(label, metric)`` pair. For a bare ``Metric`` the label is the
+        metric itself under one key; for a ``MetricCollection`` one leader
+        per compute group (members re-alias the leader state afterwards,
+        exactly like the fused engine).
+        """
+        groups = getattr(self.target, "_groups", None)
+        if groups is None:
+            reason = fusion_fallback_reason(self.target, (self.target,))
+            if reason is None:
+                return [("__target__", self.target)], [], False
+            return [], [("__target__", self.target)], False
+        self.target._split_diverged_members()
+        chain: List[Tuple[str, Any]] = []
+        eager: List[Tuple[str, Any]] = []
+        for cg in self.target._groups.values():
+            names = list(cg)
+            leader = self.target._modules[names[0]]
+            members = [self.target._modules[n] for n in names]
+            if fusion_fallback_reason(leader, members) is None:
+                chain.append((names[0], leader))
+            else:
+                eager.append((names[0], leader))
+        return chain, eager, True
+
+    def _apply_coalesced(self, entries: List[_Entry]) -> int:
+        """Apply one drained chunk; returns the number of chained launches.
+
+        Chainable leaders advance through ONE compiled, donated launch that
+        threads every batch (in enqueue order) through their pure
+        ``local_update`` transitions. Non-chainable leaders fall back to one
+        eager update per batch — synchronous semantics, still inside the tick.
+        """
+        chain, eager, is_collection = self._plan()
+        launched = 0
+        if chain:
+            self._launch_chain(chain, entries, filter_kwargs=is_collection)
+            launched = 1
+        for _label, leader in eager:
+            self.stats["eager_entries"] += len(entries)
+            for e in entries:
+                if is_collection:
+                    leader.update(*e.args, **leader._filter_kwargs(**e.kwargs))
+                else:
+                    leader.update(*e.args, **e.kwargs)
+        if is_collection:
+            self.target._state_is_copy = False
+            self.target._compute_groups_create_state_ref()
+        return launched
+
+    def _build_step(
+        self,
+        chain: List[Tuple[str, Any]],
+        specs: List[Tuple[Any, tuple]],
+        filter_kwargs: bool,
+    ) -> Callable:
+        def step(states: Dict[str, Any], dyn_lists: List[List[Any]]) -> Dict[str, Any]:
+            states = dict(states)
+            for dyn, spec in zip(dyn_lists, specs):
+                a, k = _merge_inputs(dyn, spec)
+                for label, m in chain:
+                    kw = m._filter_kwargs(**k) if filter_kwargs else k
+                    with jax.named_scope(f"tm.ingest/{type(m).__name__}"):
+                        states[label] = m.local_update(states[label], *a, **kw)
+            return states
+
+        return step
+
+    def _build_scan_step(
+        self,
+        chain: List[Tuple[str, Any]],
+        spec0: Tuple[Any, tuple],
+        filter_kwargs: bool,
+    ) -> Callable:
+        """Uniform-signature variant: stack the per-entry leaves inside the
+        trace and ``lax.scan`` one update-transition body over them. Trace and
+        compile cost is O(1) in the number of coalesced entries (the unrolled
+        step is O(n)), and the scan body executes the exact per-batch update
+        program in enqueue order, so the bit-equality contract is unchanged.
+        """
+
+        def body(states: Dict[str, Any], dyn: Tuple) -> Tuple[Dict[str, Any], None]:
+            a, k = _merge_inputs(list(dyn), spec0)
+            states = dict(states)
+            for label, m in chain:
+                kw = m._filter_kwargs(**k) if filter_kwargs else k
+                with jax.named_scope(f"tm.ingest/{type(m).__name__}"):
+                    states[label] = m.local_update(states[label], *a, **kw)
+            return states, None
+
+        def step(states: Dict[str, Any], dyn_lists: List[List[Any]]) -> Dict[str, Any]:
+            # stacking happens inside the launch: the tick stays ONE dispatch
+            stacked = tuple(
+                jnp.stack([dyn[i] for dyn in dyn_lists])
+                for i in range(len(dyn_lists[0]))
+            )
+            states, _ = jax.lax.scan(body, states, stacked)
+            return states
+
+        return step
+
+    @staticmethod
+    def _uniform_signature(
+        dyn_lists: List[List[Any]], specs: List[Tuple[Any, tuple]]
+    ) -> bool:
+        """True when every entry shares entry 0's structure, shapes, and
+        dtypes — the steady-state serving shape, and the scan fast path's
+        precondition (stacking requires congruent leaves)."""
+        dyn0, spec0 = dyn_lists[0], specs[0]
+        shapes0 = [(l.shape, l.dtype) for l in dyn0]
+        try:
+            for dyn, spec in zip(dyn_lists[1:], specs[1:]):
+                if len(dyn) != len(dyn0) or spec != spec0:
+                    return False
+                for leaf, (shape, dtype) in zip(dyn, shapes0):
+                    if leaf.shape != shape or leaf.dtype != dtype:
+                        return False
+        except Exception:  # noqa: BLE001 — exotic static __eq__: take the slow path
+            return False
+        return True
+
+    def _launch_chain(
+        self, chain: List[Tuple[str, Any]], entries: List[_Entry], filter_kwargs: bool
+    ) -> None:
+        # split each batch into traced leaves + static spec (jit cache-key
+        # semantics, same split the fused engine and retrace detector use)
+        dyn_lists: List[List[Any]] = []
+        specs: List[Tuple[Any, tuple]] = []
+        for e in entries:
+            dyn, spec = _split_inputs(e.args, e.kwargs)
+            dyn_lists.append(dyn)
+            specs.append(spec)
+        scan = len(entries) > 1 and self._uniform_signature(dyn_lists, specs)
+
+        # gather live leader states, shielding registered defaults from the
+        # donation (same _protected_ids discipline as the fused engine)
+        states: Dict[str, Any] = {}
+        for label, m in chain:
+            protected = FusedCollectionUpdate._protected_ids(m)
+
+            def shield(leaf: Any, _protected: set = protected) -> Any:
+                return leaf.copy() if id(leaf) in _protected else leaf
+
+            states[label] = jax.tree_util.tree_map(shield, m.state_pytree())
+
+        topo = tuple((label, id(m)) for label, m in chain)
+        if scan:
+            # uniform entries: entry 0's signature + the count keys them all
+            sig = ("scan", len(entries), _aval_key(dyn_lists[0]), _static_key(specs[0]))
+        else:
+            sig = tuple(
+                (_aval_key(dyn), _static_key(spec)) for dyn, spec in zip(dyn_lists, specs)
+            )
+        key = (topo, _aval_key(states), sig)
+        if key in self._broken_keys:
+            raise RuntimeError(
+                f"ingest chain signature previously failed for {self.name!r}"
+            )
+
+        compiled = self._cache.get(key)
+        if compiled is None:
+            if scan:
+                step = self._build_scan_step(chain, specs[0], filter_kwargs)
+            else:
+                step = self._build_step(chain, specs, filter_kwargs)
+            jitted = jax.jit(step, donate_argnums=(0,))
+            # suppress obs during the one-time trace: the wrapped update
+            # closures fire counters per TRACE, not per launch
+            prev = _obs._ENABLED
+            _obs._ENABLED = False
+            try:
+                compiled = jitted.lower(states, dyn_lists).compile()
+            except Exception:
+                self._broken_keys.add(key)
+                raise
+            finally:
+                _obs._ENABLED = prev
+            self._cache[key] = compiled
+
+        donate_trees = [states]
+        FusedCollectionUpdate._secure_ckpt_snapshots(donate_trees)
+        FusedCollectionUpdate._donation_guard(donate_trees)
+        (states,) = donate_trees
+
+        try:
+            new_states = compiled(states, dyn_lists)
+        except Exception as err:
+            if any(
+                getattr(leaf, "is_deleted", lambda: False)()
+                for leaf in jax.tree_util.tree_leaves(states)
+            ):
+                raise _DonatedStateLost(self.name, err) from err
+            self._broken_keys.add(key)
+            # live state untouched (the gathered tree held the donation-guard
+            # copies); the caller degrades to the synchronous path
+            for label, m in chain:
+                m._load_state(states[label])
+            raise
+
+        self.stats["launches"] += 1
+        n = len(entries)
+        for label, m in chain:
+            m._load_state(new_states[label])
+            m._update_count += n
+            m._computed = None
+            if _obs._ENABLED:
+                _obs.REGISTRY.inc(type(m).__name__, "updates", n)
+        if _obs._ENABLED:
+            _obs.REGISTRY.inc("ingest", "launches")
+            _obs.REGISTRY.inc("ingest", "dispatches")
+
+
+# --------------------------------------------------------------- module API
+
+
+def active_queues() -> List[IngestQueue]:
+    """Every live, unclosed queue (weakly tracked)."""
+    return [q for q in list(_ACTIVE) if not q._closed]
+
+
+def flush_for(target: Any) -> int:
+    """Flush every active queue attached to ``target``; returns the count.
+
+    ``ckpt.save_checkpoint`` calls this (lazily, only when this module is
+    already imported) before snapshotting, so a checkpoint of a queue-fronted
+    metric never misses enqueued rows.
+    """
+    n = 0
+    for q in active_queues():
+        if q.target is target:
+            q.flush()
+            n += 1
+    return n
+
+
+def max_queue_depth() -> int:
+    """Deepest staging backlog across active queues (the SLO input)."""
+    return max((q.depth for q in active_queues()), default=0)

@@ -9,9 +9,10 @@
   ``input.poison`` may legitimately alter computed values).
 
 Silent corruption — a completed run whose registered state differs from the
-baseline with no poison attribution — fails the sweep. 26 schedules cover
-explicit single-occurrence faults at all nine sites, repeated-fault and
-multi-site plans, and seeded random storms at several rates.
+baseline with no poison attribution — fails the sweep. 27 schedules cover
+explicit single-occurrence faults at all eleven sites (including the ingest
+tier's ``ingest.enqueue``/``ingest.tick``), repeated-fault and multi-site
+plans, and seeded random storms at several rates.
 """
 import os
 import warnings
@@ -26,6 +27,7 @@ from metrics_tpu.core.collections import MetricCollection
 from metrics_tpu.fault import PoisonedInputError
 from metrics_tpu.obs.aggregate import aggregate_dir, host_snapshot, publish
 from metrics_tpu.regression import MeanAbsoluteError, MeanSquaredError
+from metrics_tpu.serve import IngestQueue
 
 pytestmark = [pytest.mark.fault, pytest.mark.chaos]
 
@@ -58,6 +60,19 @@ def _workload(tmpdir):
     )
     out["fleet"] = np.asarray(fm.compute())
 
+    # async ingestion tier: staged enqueues, one coalesced manual tick
+    # (start=False keeps the firing order deterministic — no background thread)
+    qm = MeanSquaredError(fleet_size=4)
+    with IngestQueue(qm, capacity=16, start=False) as q:
+        for i in range(_STEPS):
+            q.enqueue(
+                jnp.asarray([1.0 + i, 2.0, 3.0, 4.0]),
+                jnp.asarray([1.0, 3.0, 5.0, 7.0]),
+                stream_ids=jnp.asarray(_IDS),
+            )
+        q.flush()
+        out["ingest"] = np.asarray(q.compute())
+
     ck = os.path.join(tmpdir, "ck")
     save_checkpoint(coll, ck, step=0, retry_backoff_s=0.001)
     fresh = MetricCollection({"mse": MeanSquaredError(), "mae": MeanAbsoluteError()})
@@ -83,7 +98,7 @@ def _equal(a, b):
 
 def _schedules():
     scheds = []
-    # one explicit first-occurrence fault per site (9)
+    # one explicit first-occurrence fault per site (11)
     for site in fault.SITES:
         scheds.append(("hit0:" + site, dict(fire_at={site: 0})))
     # repeated faults that exhaust the ckpt retry budget / pin eager mode (4)
@@ -100,6 +115,9 @@ def _schedules():
     )
     scheds.append(
         ("compound:poison+fsync", dict(fire_at={"input.poison": 0, "ckpt.fsync": 0}))
+    )
+    scheds.append(
+        ("compound:ingest+ckpt", dict(fire_at={"ingest.tick": 0, "ckpt.write": 0}))
     )
     # seeded random storms across every raising site (8)
     storm_sites = tuple(s for s in fault.SITES if s != "input.poison")
@@ -175,6 +193,23 @@ def test_degraded_runs_attribute_via_obs(tmp_path):
         assert snap["fused"]["degrades"] >= 1
         assert snap["fleet"]["degrades"] >= 1
         assert {e["site"] for e in sched.fired} == {"fused.launch", "fleet.compile"}
+    finally:
+        obs.disable()
+
+
+def test_ingest_degrade_attributes_via_obs(tmp_path, baseline):
+    """A fired ``ingest.tick`` demotes the coalesced tick to the synchronous
+    path: the run completes bit-identical and the demotion is on the record."""
+    obs.enable()
+    obs.REGISTRY.clear()
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            with fault.FaultSchedule(fire_at={"ingest.tick": 0}) as sched:
+                result = _workload(str(tmp_path))
+        assert _equal(result, baseline), "ingest degrade must not lose rows"
+        assert obs.REGISTRY.snapshot()["ingest"]["degrades"] >= 1
+        assert {e["site"] for e in sched.fired} == {"ingest.tick"}
     finally:
         obs.disable()
 

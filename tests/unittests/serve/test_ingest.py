@@ -1,0 +1,525 @@
+"""The async ingestion tier (ISSUE 13, metrics_tpu/serve/ingest.py).
+
+The load-bearing contract is **bit-equality**: a stream of batches staged
+through an ``IngestQueue`` and applied by coalesced one-launch ticks must
+leave the target in exactly the state the synchronous *jitted* path produces.
+The anchors match how the repo actually serves:
+
+- fused ``MetricCollection`` and fleet metrics update through jitted launches
+  synchronously, so sync-vs-async is compared **bitwise** on final state;
+- a bare ``Metric`` updates eagerly (unjitted) when called synchronously, and
+  ``jax.jit`` itself moves the last ulp on CPU/XLA — so bare targets are
+  compared bitwise against a ``jax.jit(local_update)`` per-batch reference
+  (the exact program the tick chains).
+
+The rest of the suite covers the staging ring, the three backpressure
+policies, staleness-bounded reads, the background ticker, shutdown drain,
+checkpoint flush-before-save, fault injection/degradation, and the obs/prom/
+health surfaces the tier feeds.
+"""
+import threading
+import time
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import fault, obs
+from metrics_tpu.ckpt import restore_checkpoint, save_checkpoint
+from metrics_tpu.classification import BinaryAUROC
+from metrics_tpu.core.collections import MetricCollection
+from metrics_tpu.core.fused import canonical_collection
+from metrics_tpu.image import PeakSignalNoiseRatio
+from metrics_tpu.obs import health
+from metrics_tpu.obs import prom
+from metrics_tpu.obs.ring import Ring
+from metrics_tpu.regression import MeanAbsoluteError, MeanSquaredError, SpearmanCorrCoef
+from metrics_tpu.serve import (
+    IngestBackpressureError,
+    IngestQueue,
+    active_queues,
+    flush_for,
+    max_queue_depth,
+)
+
+pytestmark = pytest.mark.ingest
+
+
+def _batches(n, rows=32, seed=7):
+    key = jax.random.PRNGKey(seed)
+    out = []
+    for i in range(n):
+        k1, k2 = jax.random.split(jax.random.fold_in(key, i))
+        out.append(
+            (
+                jax.random.uniform(k1, (rows,), jnp.float32),
+                jax.random.randint(k2, (rows,), 0, 2, dtype=jnp.int32),
+            )
+        )
+    return out
+
+
+def _bitwise(a, b):
+    if isinstance(a, dict):
+        return set(a) == set(b) and all(_bitwise(a[k], b[k]) for k in a)
+    return np.array_equal(np.asarray(a), np.asarray(b), equal_nan=True)
+
+
+# ------------------------------------------------------------------- ring
+
+
+def test_ring_append_evicts_oldest():
+    r = Ring(3)
+    for i in range(5):
+        r.append(i)
+    assert len(r) == 3 and r.full and r.capacity == 3
+    assert [r.pop_oldest() for _ in range(3)] == [2, 3, 4]
+    assert r.pop_oldest() is None
+    assert not r.full
+
+
+def test_ring_try_append_respects_capacity():
+    r = Ring(2)
+    assert r.try_append("a") and r.try_append("b")
+    assert not r.try_append("c")  # full: refused, not evicted
+    assert r.drain() == ["a", "b"]
+    assert len(r) == 0
+
+
+def test_ring_drain_limit_preserves_order():
+    r = Ring(8)
+    for i in range(6):
+        r.append(i)
+    assert r.drain(limit=4) == [0, 1, 2, 3]
+    assert r.drain() == [4, 5]
+    assert r.drain() == []
+
+
+def test_ring_snapshot_and_clear():
+    r = Ring(4)
+    for i in range(3):
+        r.append(i)
+    snap = r.snapshot()
+    assert snap == [0, 1, 2]
+    assert len(r) == 3  # snapshot is non-destructive
+    r.clear()
+    assert len(r) == 0 and r.snapshot() == []
+
+
+# ----------------------------------------------------------- bit-equality
+
+
+def test_fused_collection_bit_equal_sync_vs_async():
+    batches = _batches(12)
+    sync = canonical_collection(fused=True)
+    for p, t in batches:
+        sync.update(p, t)
+    async_coll = canonical_collection(fused=True)
+    with IngestQueue(async_coll, capacity=32, start=False) as q:
+        for p, t in batches:
+            q.enqueue(p, t)
+        q.flush()
+        assert q.stats["launches"] == 1
+        assert _bitwise(sync.compute(), q.compute())
+
+
+def test_fused_collection_bit_equal_mixed_shapes():
+    """Non-uniform batch shapes take the unrolled (per-entry traced) chain
+    rather than the scanned fast path — same contract either way."""
+    batches = _batches(3, rows=8) + _batches(3, rows=16, seed=11)
+    sync = MetricCollection(
+        {"mse": MeanSquaredError(), "mae": MeanAbsoluteError()}, fused=True
+    )
+    for p, t in batches:
+        sync.update(p.astype(jnp.float32), t.astype(jnp.float32))
+    async_coll = MetricCollection(
+        {"mse": MeanSquaredError(), "mae": MeanAbsoluteError()}, fused=True
+    )
+    with IngestQueue(async_coll, capacity=32, start=False) as q:
+        for p, t in batches:
+            q.enqueue(p.astype(jnp.float32), t.astype(jnp.float32))
+        q.flush()
+        assert q.stats["launches"] == 1
+        assert _bitwise(sync.compute(), q.compute())
+
+
+def test_fleet_bit_equal_sync_vs_async():
+    batches = _batches(10, rows=16)
+    ids = jnp.arange(16, dtype=jnp.int32) % 4
+    sync = MeanSquaredError(fleet_size=4)
+    for p, t in batches:
+        sync.update(p, t.astype(jnp.float32), stream_ids=ids)
+    target = MeanSquaredError(fleet_size=4)
+    with IngestQueue(target, capacity=32, start=False) as q:
+        for p, t in batches:
+            q.enqueue(p, t.astype(jnp.float32), stream_ids=ids)
+        q.flush()
+        assert q.stats["launches"] == 1
+        assert _bitwise(sync.compute(), q.compute())
+
+
+@pytest.mark.parametrize(
+    "factory",
+    [
+        lambda: MeanSquaredError(),  # scalar sum state
+        lambda: PeakSignalNoiseRatio(data_range=None),  # max state
+        lambda: SpearmanCorrCoef(cat_capacity=512),  # bounded cat buffer
+    ],
+    ids=["sum", "max", "cat_buffer"],
+)
+def test_bare_metric_bit_equal_vs_jit_reference(factory):
+    """A bare Metric's tick chains its pure ``local_update`` under jit; the
+    bitwise anchor is the same program applied per batch under jit (the
+    unjitted eager loop differs in the final ulp — that is jit vs eager, not
+    sync vs async)."""
+    batches = _batches(8, rows=16)
+    ref = factory()
+    step = jax.jit(ref.local_update)
+    state = ref.state_pytree()
+    for p, t in batches:
+        state = step(state, p, t.astype(jnp.float32))
+    ref._load_state(state)
+    ref._update_count += len(batches)
+    ref._computed = None
+
+    target = factory()
+    with IngestQueue(target, capacity=32, start=False) as q:
+        for p, t in batches:
+            q.enqueue(p, t.astype(jnp.float32))
+        q.flush()
+        assert q.stats["launches"] == 1
+        assert q.stats["eager_entries"] == 0
+        assert _bitwise(ref.compute(), q.compute())
+
+
+def test_unchainable_target_falls_back_eager_with_sync_semantics():
+    """A host-ragged list-cat state can't be chained into one launch; the tick
+    applies each staged batch through the ordinary update path instead —
+    identical code to the synchronous caller, so plain equality holds."""
+    batches = _batches(6, rows=16)
+    sync = BinaryAUROC(thresholds=None)
+    for p, t in batches:
+        sync.update(p, t)
+    target = BinaryAUROC(thresholds=None)
+    with IngestQueue(target, capacity=16, start=False) as q:
+        for p, t in batches:
+            q.enqueue(p, t)
+        q.flush()
+        assert q.stats["launches"] == 0
+        assert q.stats["eager_entries"] == len(batches)
+        assert _bitwise(sync.compute(), q.compute())
+
+
+# ------------------------------------------------------------ backpressure
+
+
+def test_backpressure_raise():
+    with IngestQueue(
+        MeanSquaredError(), capacity=2, backpressure="raise", start=False
+    ) as q:
+        q.enqueue(jnp.ones(4), jnp.zeros(4))
+        q.enqueue(jnp.ones(4), jnp.zeros(4))
+        with pytest.raises(IngestBackpressureError, match="full"):
+            q.enqueue(jnp.ones(4), jnp.zeros(4))
+        assert q.depth == 2
+
+
+def test_backpressure_drop_oldest_keeps_newest():
+    batches = _batches(5, rows=8)
+    sync = MeanSquaredError()
+    step = jax.jit(sync.local_update)
+    state = sync.state_pytree()
+    for p, t in batches[-2:]:  # only the two survivors
+        state = step(state, p, t.astype(jnp.float32))
+    sync._load_state(state)
+    sync._update_count += 2
+    sync._computed = None
+
+    target = MeanSquaredError()
+    with IngestQueue(
+        target, capacity=2, backpressure="drop_oldest", start=False
+    ) as q:
+        for p, t in batches:
+            q.enqueue(p, t.astype(jnp.float32))
+        assert q.stats["dropped"] == 3
+        q.flush()
+        assert _bitwise(sync.compute(), q.compute())
+
+
+def test_backpressure_block_times_out_without_ticker():
+    with IngestQueue(
+        MeanSquaredError(),
+        capacity=1,
+        backpressure="block",
+        block_timeout_s=0.05,
+        start=False,
+    ) as q:
+        q.enqueue(jnp.ones(4), jnp.zeros(4))
+        with pytest.raises(IngestBackpressureError, match="blocked"):
+            q.enqueue(jnp.ones(4), jnp.zeros(4))
+
+
+def test_backpressure_block_unblocks_via_background_ticker():
+    target = MeanSquaredError()
+    q = IngestQueue(
+        target, capacity=4, backpressure="block", tick_interval_s=0.001,
+        block_timeout_s=10.0,
+    )
+    try:
+        batches = _batches(32, rows=8)
+        for p, t in batches:  # > capacity: producer must block and recover
+            q.enqueue(p, t.astype(jnp.float32))
+        q.flush()
+        assert q.stats["enqueued"] == 32
+        assert q.stats["dropped"] == 0
+        assert target._update_count == 32
+    finally:
+        q.close()
+
+
+# ------------------------------------------- background ticker + staleness
+
+
+def test_background_ticker_applies_without_explicit_flush():
+    target = MeanSquaredError()
+    q = IngestQueue(target, capacity=64, tick_interval_s=0.001)
+    try:
+        for p, t in _batches(8, rows=8):
+            q.enqueue(p, t.astype(jnp.float32))
+        # depth drops when the ring drains, before the launch lands — poll the
+        # applied count, which is only advanced once the tick has committed
+        deadline = time.monotonic() + 10.0
+        while target._update_count < 8 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert q.depth == 0
+        assert target._update_count == 8
+        assert q.stats["ticks"] >= 1
+    finally:
+        q.close()
+
+
+def test_concurrent_compute_during_pending_ticks():
+    """Readers may call compute() while the producer is still enqueueing;
+    every read sees a consistent flushed value and nothing deadlocks."""
+    batches = _batches(40, rows=8)
+    target = MeanSquaredError()
+    q = IngestQueue(target, capacity=64, tick_interval_s=0.001)
+    errors = []
+
+    def produce():
+        try:
+            for p, t in batches:
+                q.enqueue(p, t.astype(jnp.float32))
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    try:
+        prod = threading.Thread(target=produce)
+        prod.start()
+        for _ in range(10):
+            np.asarray(q.compute())  # flush-before-read under contention
+        prod.join(timeout=30)
+        assert not prod.is_alive() and not errors
+        q.flush()
+        assert target._update_count == 40
+    finally:
+        q.close()
+
+
+def test_compute_default_is_flush_before_read():
+    batches = _batches(4, rows=8)
+    sync = canonical_collection(fused=True)
+    for p, t in batches:
+        sync.update(p, t)
+    with IngestQueue(canonical_collection(fused=True), capacity=16, start=False) as q:
+        for p, t in batches:
+            q.enqueue(p, t)
+        assert q.depth == 4
+        assert _bitwise(sync.compute(), q.compute())  # implicit flush
+        assert q.depth == 0
+
+
+def test_max_staleness_serves_last_ticked_state():
+    batches = _batches(4, rows=8)
+    target = MeanSquaredError()
+    with IngestQueue(
+        target, capacity=16, max_staleness_s=3600.0, start=False
+    ) as q:
+        for p, t in batches[:2]:
+            q.enqueue(p, t.astype(jnp.float32))
+        q.flush()  # the "last tick": state now holds 2 batches
+        ticked = np.asarray(q.compute())
+        for p, t in batches[2:]:
+            q.enqueue(p, t.astype(jnp.float32))
+        # within budget: the staged batches stay pending, the read is stale
+        assert np.array_equal(np.asarray(q.compute()), ticked)
+        assert q.depth == 2
+        q.flush()
+        assert q.depth == 0
+        assert not np.array_equal(np.asarray(q.compute()), ticked)
+
+
+# ---------------------------------------------------------------- shutdown
+
+
+def test_close_drains_pending_batches():
+    target = MeanSquaredError()
+    q = IngestQueue(target, capacity=16, start=False)
+    for p, t in _batches(5, rows=8):
+        q.enqueue(p, t.astype(jnp.float32))
+    q.close(drain=True)
+    assert target._update_count == 5
+    assert q not in active_queues()
+    with pytest.raises(RuntimeError, match="closed"):
+        q.enqueue(jnp.ones(4), jnp.zeros(4))
+
+
+def test_close_without_drain_counts_drops():
+    target = MeanSquaredError()
+    q = IngestQueue(target, capacity=16, start=False)
+    for p, t in _batches(5, rows=8):
+        q.enqueue(p, t.astype(jnp.float32))
+    q.close(drain=False)
+    assert target._update_count == 0
+    assert q.stats["dropped"] == 5
+
+
+def test_context_manager_drains_on_exit():
+    target = MeanSquaredError()
+    with IngestQueue(target, capacity=16, start=False) as q:
+        for p, t in _batches(3, rows=8):
+            q.enqueue(p, t.astype(jnp.float32))
+    assert target._update_count == 3
+
+
+# -------------------------------------------------------------- checkpoint
+
+
+def test_save_checkpoint_flushes_queue_first(tmp_path):
+    batches = _batches(6, rows=8)
+    ref = MeanSquaredError()
+    step = jax.jit(ref.local_update)
+    state = ref.state_pytree()
+    for p, t in batches:
+        state = step(state, p, t.astype(jnp.float32))
+    ref._load_state(state)
+    ref._update_count += 6
+    ref._computed = None
+
+    target = MeanSquaredError()
+    with IngestQueue(target, capacity=16, start=False) as q:
+        for p, t in batches:
+            q.enqueue(p, t.astype(jnp.float32))
+        assert q.depth == 6
+        save_checkpoint(target, str(tmp_path / "ck"), step=0)
+        assert q.depth == 0  # ckpt.save flushed the queue before snapshotting
+    fresh = MeanSquaredError()
+    restore_checkpoint(fresh, str(tmp_path / "ck"))
+    assert _bitwise(ref.compute(), fresh.compute())
+
+
+def test_flush_for_and_max_queue_depth():
+    t1, t2 = MeanSquaredError(), MeanSquaredError()
+    with IngestQueue(t1, capacity=16, start=False) as q1, IngestQueue(
+        t2, capacity=16, start=False
+    ) as q2:
+        for p, t in _batches(3, rows=8):
+            q1.enqueue(p, t.astype(jnp.float32))
+        q2.enqueue(jnp.ones(4), jnp.zeros(4))
+        assert max_queue_depth() == 3
+        assert flush_for(t1) == 1
+        assert q1.depth == 0 and q2.depth == 1
+        assert flush_for(MeanSquaredError()) == 0
+
+
+# ------------------------------------------------------------------ faults
+
+
+def test_enqueue_fault_raises_typed():
+    with IngestQueue(MeanSquaredError(), capacity=4, start=False) as q:
+        with fault.FaultSchedule(fire_at={"ingest.enqueue": 0}) as sched:
+            with pytest.raises(fault.InjectedFaultError):
+                q.enqueue(jnp.ones(4), jnp.zeros(4))
+        assert {e["site"] for e in sched.fired} == {"ingest.enqueue"}
+        assert q.depth == 0  # the batch was never admitted
+
+
+def test_tick_fault_degrades_to_sync_bit_equal():
+    batches = _batches(5, rows=8)
+    sync = canonical_collection(fused=True)
+    for p, t in batches:
+        sync.update(p, t)
+    with IngestQueue(canonical_collection(fused=True), capacity=16, start=False) as q:
+        for p, t in batches:
+            q.enqueue(p, t)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            with fault.FaultSchedule(fire_at={"ingest.tick": 0}):
+                q.flush()
+        assert q.stats["degrades"] == 1
+        assert q.stats["launches"] == 0
+        assert _bitwise(sync.compute(), q.compute())
+
+
+# --------------------------------------------------------- obs/prom/health
+
+
+def test_obs_counters_attribute_the_tier():
+    obs.enable()
+    obs.REGISTRY.clear()
+    try:
+        batches = _batches(4, rows=8)
+        with IngestQueue(MeanSquaredError(), capacity=16, start=False) as q:
+            for p, t in batches:
+                q.enqueue(p, t.astype(jnp.float32))
+            q.flush()
+        snap = obs.REGISTRY.snapshot()["ingest"]
+        assert snap["enqueued"] == 4
+        assert snap["ticks"] == 1
+        assert snap["launches"] == 1
+        assert snap["coalesced_rows"] == 4 * 8
+    finally:
+        obs.disable()
+
+
+def test_prom_exposes_queue_gauges_and_round_trips():
+    obs.enable()
+    obs.REGISTRY.clear()
+    try:
+        with IngestQueue(
+            MeanSquaredError(), capacity=16, name="promq", start=False
+        ) as q:
+            for p, t in _batches(3, rows=8):
+                q.enqueue(p, t.astype(jnp.float32))
+            text = prom.render()
+            assert 'tm_ingest_queue_depth{queue="promq"} 3' in text
+            assert 'tm_ingest_queue_capacity{queue="promq"} 16' in text
+            assert "tm_ingest_enqueued_total" in text
+            assert prom.validate_exposition(text) > 0
+    finally:
+        obs.disable()
+
+
+def test_health_slo_max_queue_depth_and_ingest_latency():
+    health.enable(flush_every=1)
+    try:
+        with IngestQueue(
+            MeanSquaredError(), capacity=16, start=False
+        ) as q:
+            for p, t in _batches(3, rows=8):
+                q.enqueue(p, t.astype(jnp.float32))
+            health.set_slo(max_queue_depth=2, action=lambda v: None)
+            violations = health.check_slos()
+            assert any(
+                v["slo"] == "max_queue_depth" and v["measured"] == 3
+                for v in violations
+            )
+            q.flush()  # records enqueue->applied latencies into the monitor
+            health.set_slo(p99_ingest_latency_ms=1e-9, action=lambda v: None)
+            violations = health.check_slos()
+            assert any(v["slo"] == "p99_ingest_latency_ms" for v in violations)
+    finally:
+        health.disable()
+        obs.disable()
