@@ -1243,6 +1243,121 @@ def bench_ingest(burst: int = 128, rows: int = 128, depths=(1, 8, 64, 128),
     }
 
 
+_COLDSTART_CHILD = r"""
+import json, os, sys, time
+import jax
+import jax.numpy as jnp
+
+mode, workdir = sys.argv[1], sys.argv[2]
+
+import metrics_tpu.obs as obs
+from metrics_tpu.core.fused import canonical_collection
+from metrics_tpu.serve import excache
+
+cache_dir = os.path.join(workdir, "xla")
+manifest = os.path.join(workdir, excache.MANIFEST_NAME)
+excache.enable_persistent_cache(cache_dir)
+
+# request arrays exist before the window opens, as in a serving process
+key = jax.random.PRNGKey(7)
+k1, k2 = jax.random.split(key)
+preds = jax.random.uniform(k1, (1 << 14,), jnp.float32)
+target = jax.random.randint(k2, (1 << 14,), 0, 2, dtype=jnp.int32)
+jax.block_until_ready((preds, target))
+
+coll = canonical_collection()
+prewarm_s = 0.0
+if mode == "cold":
+    excache.enable_recording()
+else:
+    prewarm_s = excache.prewarm(coll, manifest)["seconds"]
+
+obs.enable(clear=True)
+stats0 = excache.stats()
+t0 = time.perf_counter()
+coll.update(preds, target)
+for m in coll._modules.values():
+    jax.block_until_ready(jax.tree_util.tree_leaves(m.state_pytree()))
+first_step_ms = (time.perf_counter() - t0) * 1000
+snap = obs.REGISTRY.snapshot()
+stats1 = excache.stats()
+if mode == "cold":
+    excache.save_manifest(manifest)
+print(json.dumps({
+    "first_step_ms": first_step_ms,
+    "cache_misses": snap.get("fused", {}).get("cache_misses", 0),
+    "true_compiles": stats1["compiles"] - stats0["compiles"],
+    "prewarm_s": prewarm_s,
+}), flush=True)
+"""
+
+
+def bench_coldstart(trials: int = 3) -> dict:
+    """``--coldstart``: the ISSUE 14 cold-start claim (serve/excache.py).
+
+    Two kinds of fresh subprocess replica, same canonical five-group fused
+    collection, same request: a **cold** replica (empty executable caches —
+    its first ``update()`` pays the full trace+compile bill, and doubles as
+    the recorder that writes the warm manifest + persistent XLA cache), and a
+    **pre-warmed** replica (``prewarm()`` replays the manifest through
+    ``.lower().compile()`` at startup, every lowering served from the on-disk
+    cache). Headline value is the pre-warmed first-step wall
+    (``coldstart_prewarmed_ms``, p50 over ``trials`` fresh processes);
+    ``vs_baseline`` is cold/pre-warmed (acceptance floor: >=10x). Compile
+    counts come off the obs ``fused.cache_misses`` counter and the excache
+    true-compile accounting inside each child's measurement window — cold
+    must show >=1, pre-warmed exactly 0.
+    """
+    import os
+    import shutil
+    import subprocess
+    import sys
+    import tempfile
+
+    workdir = tempfile.mkdtemp(prefix="tm-coldstart-")
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+
+    def run_child(mode: str) -> dict:
+        proc = subprocess.run(
+            [sys.executable, "-c", _COLDSTART_CHILD, mode, workdir],
+            capture_output=True, text=True, timeout=600, env=env,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        return json.loads(proc.stdout.splitlines()[-1])
+
+    try:
+        cold = run_child("cold")
+        warms = [run_child("warm") for _ in range(trials)]
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    assert cold["cache_misses"] >= 1 and cold["true_compiles"] >= 1, cold
+    assert all(w["cache_misses"] == 0 for w in warms), warms
+    assert all(w["true_compiles"] == 0 for w in warms), warms
+
+    prewarmed_ms = statistics.median(w["first_step_ms"] for w in warms)
+    ratio = cold["first_step_ms"] / prewarmed_ms
+    return {
+        "metric": "coldstart_first_step",
+        "value": round(prewarmed_ms, 3),
+        "unit": "ms",
+        "vs_baseline": round(ratio, 1),
+        "coldstart_prewarmed_ms": round(prewarmed_ms, 3),
+        "coldstart_cold_ms": round(cold["first_step_ms"], 3),
+        "cold_compiles": cold["true_compiles"],
+        "prewarmed_compiles": max(w["true_compiles"] for w in warms),
+        "prewarm_p50_ms": round(
+            statistics.median(w["prewarm_s"] for w in warms) * 1000, 3
+        ),
+        "bound": "the cold replica pays trace + XLA compile for the whole"
+                 " fused step on its first request; the pre-warmed replica"
+                 " replays the warm manifest through the persistent on-disk"
+                 " cache at startup, so its first request is a pure in-memory"
+                 " executable-cache hit (zero compiles by counter)",
+    }
+
+
 def bench_chaos(n: int = 1 << 18, steps: int = 8, trials: int = 5) -> dict:
     """``--chaos``: what graceful degradation actually costs (metrics_tpu.fault).
 
@@ -1648,7 +1763,7 @@ if __name__ == "__main__":
     parser = argparse.ArgumentParser(description="metrics_tpu benchmarks")
     parser.add_argument(
         "--config",
-        choices=("accuracy", "logits", "confmat", "map", "ssim", "retrieval", "auroc", "fid", "fused", "fleet", "ingest", "sketch", "chaos", "lint", "obs_trace", "all"),
+        choices=("accuracy", "logits", "confmat", "map", "ssim", "retrieval", "auroc", "fid", "fused", "fleet", "ingest", "coldstart", "sketch", "chaos", "lint", "obs_trace", "all"),
         default="all",
     )
     parser.add_argument(
@@ -1685,6 +1800,15 @@ if __name__ == "__main__":
         " depth, launches/tick from the obs `dispatches` counter, and a"
         " bit-equality check of the final states (also runs under"
         " --config all)",
+    )
+    parser.add_argument(
+        "--coldstart",
+        action="store_true",
+        help="also run the cold-start bench (metrics_tpu/serve/excache.py):"
+        " first-step wall of a fresh subprocess replica cold vs pre-warmed"
+        " (persistent compile cache + warm-manifest prewarm), with compile"
+        " counts off the obs counters — cold >=1, pre-warmed exactly 0"
+        " (also runs under --config all)",
     )
     parser.add_argument(
         "--chaos",
@@ -1769,6 +1893,7 @@ if __name__ == "__main__":
         ("fused", bench_fused),
         ("fleet", bench_fleet),
         ("ingest", bench_ingest),
+        ("coldstart", bench_coldstart),
         ("sketch", bench_sketch),
         ("chaos", bench_chaos),
         ("ckpt", bench_ckpt),
@@ -1786,6 +1911,8 @@ if __name__ == "__main__":
             continue
         if name == "ingest" and not (cli.ingest or config in ("ingest", "all")):
             continue
+        if name == "coldstart" and not (cli.coldstart or config in ("coldstart", "all")):
+            continue
         if name == "sketch" and not (cli.sketch or config in ("sketch", "all")):
             continue
         if name == "chaos" and not (cli.chaos or config in ("chaos", "all")):
@@ -1794,7 +1921,7 @@ if __name__ == "__main__":
             continue
         if name == "san" and not (cli.san_overhead or config == "all"):
             continue
-        if config in (name, "all") or name in ("ckpt", "fused", "fleet", "ingest", "sketch", "chaos", "lint", "san", "obs_trace"):
+        if config in (name, "all") or name in ("ckpt", "fused", "fleet", "ingest", "coldstart", "sketch", "chaos", "lint", "san", "obs_trace"):
             try:
                 result = fn()
                 summary[result["metric"]] = {

@@ -569,6 +569,21 @@ def save_checkpoint(
     if _ingest is not None:
         _ingest.flush_for(obj)
 
+    # warm-manifest-alongside-checkpoint: while excache recording is on, every
+    # save refreshes warm_manifest.json in the series directory so a restarting
+    # replica finds the prewarm signatures next to the state it restores.
+    # Best-effort — losing the manifest only costs warmup, never the save.
+    _excache = sys.modules.get("metrics_tpu.serve.excache")
+    if _excache is not None and _excache.recording() and rank == 0:
+        try:
+            _excache.save_manifest(os.path.join(directory, _excache.MANIFEST_NAME))
+        except Exception as err:  # noqa: BLE001 — the checkpoint must not fail
+            warnings.warn(
+                f"warm-manifest write failed ({type(err).__name__}: {err}); the"
+                " checkpoint proceeds without it.",
+                RuntimeWarning,
+            )
+
     tree, entries = _snapshot(obj, persistent_only)
     if _obs._ENABLED and _obs_flight._RING is not None:
         # the post-mortem wants the state layout of whatever was being saved

@@ -29,6 +29,7 @@ may differ at the ulp level) for float accumulators.
 This module is imported lazily from ``core.metric`` (no import cycle); it
 reuses the fused engine's input split / donation helpers (``core.fused``).
 """
+import sys
 import weakref
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -344,12 +345,19 @@ def run_step(
     state: Dict[str, Any],
     *extras: Any,
     static_key: Tuple = (),
+    record_inputs: Optional[Tuple] = None,
 ) -> Dict[str, Any]:
     """Run a pure ``step(state, *extras) -> new_state``: inline when any input
     is a tracer (we're already inside someone else's jit/vmap program), else
     through a cached AOT-compiled executable that donates the state buffers
     (skipped inside ``local_update`` — the pure contract forbids deleting the
-    caller's arrays)."""
+    caller's arrays).
+
+    ``record_inputs`` is the ``(args, kwargs, stream_ids)`` triple of the
+    originating update call, threaded through by ``apply_update`` purely so a
+    cache-miss compile can be recorded into the excache warm manifest
+    (serve/excache.py) — ``run_step`` itself only sees the closed-over step.
+    """
     from metrics_tpu.core import fused as _fused
 
     if _is_traced(state, extras):
@@ -386,6 +394,18 @@ def run_step(
             )
             return step(state, *extras)
         cache[key] = compiled
+        # warm-manifest recording: compile is the cold path, so the
+        # sys.modules probe costs the steady state nothing
+        _excache = sys.modules.get("metrics_tpu.serve.excache")
+        if _excache is not None and _excache.recording() and record_inputs is not None:
+            _excache.record_fleet_compile(
+                metric,
+                tag,
+                record_inputs[0],
+                record_inputs[1],
+                record_inputs[2],
+                digest=_fused.stable_key_digest(key),
+            )
     if donate:
         state = _shield_donation(metric, state)
     return compiled(state, *extras)
@@ -410,7 +430,15 @@ def apply_update(metric: Any, raw_update: Callable, args: Tuple, kwargs: Dict) -
             a, k = _fused._merge_inputs(dl, spec)
             return broadcast_new_state(metric, raw_update, st, a, k)
 
-        new = run_step(metric, "fleet.bcast", step, state, dyn, static_key=_fused._static_key(spec))
+        new = run_step(
+            metric,
+            "fleet.bcast",
+            step,
+            state,
+            dyn,
+            static_key=_fused._static_key(spec),
+            record_inputs=(args, kwargs, None),
+        )
         if _obs._ENABLED:
             _obs.REGISTRY.inc("fleet", "routed", _batch_rows(dyn))
             _obs.REGISTRY.inc("fleet", "streams", metric.fleet_size)
@@ -440,7 +468,16 @@ def apply_update(metric: Any, raw_update: Callable, args: Tuple, kwargs: Dict) -
             a, k = _fused._merge_inputs(dl, spec)
             return routed_new_state(metric, raw_update, st, a, k, i_)
 
-        new = run_step(metric, "fleet.route", step, state, dyn, ids, static_key=_fused._static_key(spec))
+        new = run_step(
+            metric,
+            "fleet.route",
+            step,
+            state,
+            dyn,
+            ids,
+            static_key=_fused._static_key(spec),
+            record_inputs=(args, kwargs, ids),
+        )
         if _obs._ENABLED:
             from metrics_tpu.utils.checks import _is_concrete
 

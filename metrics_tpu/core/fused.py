@@ -46,6 +46,8 @@ measurable in the JSONL export; sum the ``dispatches`` counter across scopes
 for the per-step launch count.
 """
 import functools
+import hashlib
+import sys
 import warnings
 import weakref
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -68,6 +70,8 @@ __all__ = [
     "fusion_fallback_reason",
     "canonical_fused_update",
     "canonical_fused_case",
+    "stable_key_digest",
+    "fused_key_digest",
 ]
 
 #: placeholder marking a dynamic (array) leaf position in a flattened input
@@ -191,6 +195,12 @@ def _split_inputs(args: Tuple, kwargs: Dict) -> Tuple[List[Any], Tuple[Any, tupl
             # the ingest tick (128 coalesced entries -> 256+ leaves per launch)
             dyn.append(leaf if isinstance(leaf, jax.Array) else jnp.asarray(leaf))
             spec.append(_DYN)
+        elif isinstance(leaf, jax.ShapeDtypeStruct):
+            # abstract leaf from the excache warm-manifest replay: it must take
+            # the dynamic slot so prewarm derives the exact key + lowering the
+            # first real request will (serve/excache.py)
+            dyn.append(leaf)
+            spec.append(_DYN)
         else:
             spec.append(leaf)
     return dyn, (treedef, tuple(spec))
@@ -225,6 +235,48 @@ def _aval_key(tree: Any) -> Tuple:
     # __str__ is slow python) dominated the per-tick key cost at ingest rates
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     return (treedef, tuple((tuple(l.shape), l.dtype) for l in leaves))
+
+
+# ------------------------------------------------------ stable key digests
+
+
+def _stable_repr(x: Any) -> str:
+    """Canonical, cross-process-stable rendering of an engine cache key part.
+
+    ``hash()`` is PYTHONHASHSEED-salted, so two processes render the same key
+    differently — useless for correlating flight events with the warm manifest
+    (serve/excache.py). Treedefs and dtypes stringify structurally; the
+    ``("id", id(obj))`` identity leaves ``_static_key`` emits for exotic
+    statics are process-local and therefore masked.
+    """
+    if x is _DYN:
+        return "dyn"
+    if isinstance(x, tuple):
+        if len(x) == 2 and isinstance(x[0], str) and x[0] == "id":
+            return "id:*"
+        return "(" + ",".join(_stable_repr(e) for e in x) + ")"
+    if isinstance(x, list):
+        return "[" + ",".join(_stable_repr(e) for e in x) + "]"
+    if isinstance(x, jax.tree_util.PyTreeDef):
+        return f"td:{x}"
+    if x is None or isinstance(x, (bool, int, float, str, bytes)):
+        return f"{type(x).__name__}:{x!r}"
+    # np.dtype / jnp dtype objects land here and stringify canonically
+    return f"{type(x).__name__}:{x}"
+
+
+def stable_key_digest(key: Any) -> str:
+    """12-hex sha1 of :func:`_stable_repr` — the cross-process cache-key name
+    shared by flight events and the excache warm manifest."""
+    return hashlib.sha1(_stable_repr(key).encode("utf-8")).hexdigest()[:12]
+
+
+def fused_key_digest(key: Tuple) -> str:
+    """Stable digest of a fused-engine key: the ``id(module)`` component of the
+    topology triples is process-local and dropped before digesting."""
+    mode, topo, state_key, dyn_key, static_key = key
+    view = (mode, tuple((name, members) for name, members, _ in topo), state_key, dyn_key, static_key)
+    return stable_key_digest(view)
 
 
 # ------------------------------------------------------------------ engine
@@ -563,6 +615,17 @@ class FusedCollectionUpdate:
                 )
                 return [], demoted + [list(m) for _, m in fused], {}
             self._cache[key] = compiled
+            # warm-manifest recording (serve/excache.py): compile is the cold
+            # path, so a sys.modules probe here costs the steady state nothing
+            _excache = sys.modules.get("metrics_tpu.serve.excache")
+            if _excache is not None and _excache.recording():
+                _excache.record_fused_compile(
+                    mode="forward" if forward else "update",
+                    groups=fused,
+                    args=args,
+                    kwargs=kwargs,
+                    digest=fused_key_digest(key),
+                )
         else:
             self.stats["cache_hits"] += 1
             if _obs._ENABLED:
@@ -594,7 +657,7 @@ class FusedCollectionUpdate:
                         "fused_launch",
                         groups=[name for name, _ in fused],
                         mode="forward" if forward else "update",
-                        cache_key=f"{key[0]}:{hash(key) & 0xFFFFFFFF:08x}",
+                        cache_key=f"{key[0]}:{fused_key_digest(key)}",
                     )
                 with _obs_scopes.annotate("tm.fused/step"):
                     if forward:

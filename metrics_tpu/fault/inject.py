@@ -24,6 +24,9 @@ faults by it):
     ``ingest.enqueue`` batch admission into the staging ring (``serve/ingest.py``)
     ``ingest.tick``    the coalescing tick of an ``IngestQueue`` — a fired tick
                        degrades to applying the pending batches synchronously
+    ``excache.prewarm`` per-entry warm-manifest replay in ``serve/excache.py``
+                       — a fired entry is skipped (warn once) and its
+                       executable lazily compiles on first use instead
     ``input.poison``   NaN-poisoning of update inputs (``Metric._wrap_update``)
 
 Every site except ``input.poison`` *raises* :class:`InjectedFaultError` (an
@@ -70,6 +73,7 @@ SITES = (
     "agg.read",
     "ingest.enqueue",
     "ingest.tick",
+    "excache.prewarm",
     "input.poison",
 )
 

@@ -18,6 +18,7 @@ Event kinds emitted by the runtime (all behind the obs gate):
     ``fused_cache_miss``  the fused engine compiled a new executable
     ``fleet_route``       one routed fleet batch (rows, streams)
     ``merge``             one ``merge_state`` (sketch merges ride this hook)
+    ``excache_prewarm``   one warm-manifest replay (entries/compiled/seconds)
     ``ckpt_save_begin`` / ``ckpt_save_commit`` / ``ckpt_restore``
 
 Gating contract (the single-boolean rule of ``registry.py``): every call site

@@ -74,6 +74,11 @@ class SLOBudget:
         p99_ingest_latency_ms: ceiling on any queue's p99 enqueue→applied
             latency (the ``ingest/<queue>`` health sketches) — the freshness
             SLO of the async ingestion tier.
+        max_cold_compiles: ceiling on *true* XLA compiles observed since the
+            excache stats were last cleared (``serve.excache.stats()
+            ["compiles"]`` — persistent-cache misses). A pre-warmed replica
+            budgets 0 here: its first request must be served entirely from
+            the seeded executable caches.
         action: ``"warn"`` | ``"raise"`` | callable(list_of_violations).
     """
 
@@ -85,6 +90,7 @@ class SLOBudget:
         max_nonfinite_rows: Optional[int] = None,
         max_queue_depth: Optional[int] = None,
         p99_ingest_latency_ms: Optional[float] = None,
+        max_cold_compiles: Optional[int] = None,
         action: Union[str, Callable[[List[Dict[str, Any]]], None]] = "warn",
     ) -> None:
         if isinstance(action, str) and action not in ("warn", "raise"):
@@ -95,6 +101,7 @@ class SLOBudget:
         self.max_nonfinite_rows = max_nonfinite_rows
         self.max_queue_depth = max_queue_depth
         self.p99_ingest_latency_ms = p99_ingest_latency_ms
+        self.max_cold_compiles = max_cold_compiles
         self.action = action
 
 
@@ -407,6 +414,25 @@ class HealthMonitor:
                             "measured": depth,
                             "detail": "deepest staging backlog across active"
                             " serve.IngestQueue instances",
+                        }
+                    )
+
+        if budget.max_cold_compiles is not None:
+            # same on-demand discipline: the excache tier only participates
+            # once the app imported serve/excache.py
+            import sys as _sys
+
+            _excache = _sys.modules.get("metrics_tpu.serve.excache")
+            if _excache is not None:
+                compiles = _excache.stats()["compiles"]
+                if compiles > budget.max_cold_compiles:
+                    violations.append(
+                        {
+                            "slo": "max_cold_compiles",
+                            "budget": budget.max_cold_compiles,
+                            "measured": compiles,
+                            "detail": "true XLA compiles (persistent-cache"
+                            " misses) since excache stats were cleared",
                         }
                     )
 

@@ -209,6 +209,50 @@ def render() -> str:
             families.append(capacity)
             families.extend(ing_counters.values())
 
+    # the executable-cache tier, same on-demand discipline as ingest above;
+    # gated on live configuration (cache routed or recording on) — a
+    # merely-imported tier, or residue counters from a torn-down one, emit
+    # nothing, keeping the disabled page minimal
+    _excache = _sys.modules.get("metrics_tpu.serve.excache")
+    if _excache is not None and (
+        _excache.cache_dir() is not None or _excache.recording()
+    ):
+        ex_stats = _excache.stats()
+        enabled_f = _Family(
+            "tm_excache_persistent_enabled", "gauge",
+            "1 when JAX's on-disk compilation cache is routed through"
+            " serve.excache.enable_persistent_cache().",
+        )
+        enabled_f.add("", "", 1 if _excache.cache_dir() is not None else 0)
+        families.append(enabled_f)
+        ex_counters = {
+            "disk_hits": _Family(
+                "tm_excache_disk_hits", "counter",
+                "XLA compile requests served from the persistent on-disk cache.",
+            ),
+            "compiles": _Family(
+                "tm_excache_compiles", "counter",
+                "True XLA compiles (persistent-cache misses) while the cache was enabled.",
+            ),
+            "prewarmed": _Family(
+                "tm_excache_prewarmed", "counter",
+                "Warm-manifest entries replayed into engine executable caches by prewarm().",
+            ),
+            "prewarm_failures": _Family(
+                "tm_excache_prewarm_failures", "counter",
+                "Warm-manifest entries whose replay failed and degraded to lazy compile.",
+            ),
+        }
+        for stat, family in ex_counters.items():
+            family.add("_total", "", max(0, ex_stats.get(stat, 0)))
+        families.extend(ex_counters.values())
+        manifest_f = _Family(
+            "tm_excache_manifest_entries", "gauge",
+            "Entries currently recorded in the in-process warm manifest.",
+        )
+        manifest_f.add("", "", len(_excache.manifest_entries()))
+        families.append(manifest_f)
+
     smp = _series._SAMPLER
     if smp is not None:
         ticks = _Family(

@@ -105,7 +105,21 @@ def metric_state_report(metric: Any) -> Dict[str, Any]:
     ckpt_stats = getattr(metric, "_ckpt_stats", None)
     if isinstance(ckpt_stats, dict) and ckpt_stats:
         report["ckpt"] = dict(ckpt_stats)
+    _attach_warmup(report)
     return report
+
+
+def _attach_warmup(report: Dict[str, Any]) -> None:
+    """Stamp the replica's last excache prewarm report (warmup wall time +
+    per-entry outcomes) — on-demand like every serve-tier surface, so the
+    report costs nothing unless the app imported serve/excache.py."""
+    import sys as _sys
+
+    _excache = _sys.modules.get("metrics_tpu.serve.excache")
+    if _excache is not None:
+        warmup = _excache.last_prewarm()
+        if warmup is not None:
+            report["warmup"] = warmup
 
 
 def collection_summary(collection: Any) -> Dict[str, Any]:
@@ -141,4 +155,5 @@ def collection_summary(collection: Any) -> Dict[str, Any]:
 
         engine = _ENGINES.get(collection)
         out["fused"] = dict(engine.stats) if engine is not None else {"launches": 0}
+    _attach_warmup(out)
     return out

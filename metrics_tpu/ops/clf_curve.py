@@ -33,6 +33,7 @@ for +0.0 (numerically equal, bitwise not).
 
 One-vs-rest variants vmap the binary kernel over classes/labels.
 """
+import sys as _sys
 from functools import partial
 from typing import Optional, Tuple
 
@@ -174,6 +175,20 @@ _binary_ap_j = jax.jit(
 )
 
 
+def _warm_record(op: str, tier: str, arrays: Tuple[Array, ...], max_fpr: Optional[float] = None) -> None:
+    """Record a rank-tier dispatch signature into the excache warm manifest.
+
+    The kernels here are module-level jits, so the per-(shape, dtype, tier)
+    compile is the replica cold-start cost prewarm eliminates. Arrays are the
+    *padded* kernel inputs — pow-of-two shapes, so a prewarm replay pads to
+    itself and compiles the exact executable. No-op (one dict probe) unless
+    serve/excache.py is imported and recording.
+    """
+    _excache = _sys.modules.get("metrics_tpu.serve.excache")
+    if _excache is not None and _excache.recording():
+        _excache.record_rank_compile(op, tier, arrays, max_fpr)
+
+
 def _pad_binary(preds: Array, target: Array) -> Tuple[Array, Array, Array]:
     """Pad to the next power of two (bounded recompiles) and build the valid mask."""
     preds = jnp.asarray(preds).ravel()
@@ -238,6 +253,7 @@ def binary_precision_recall_curve_padded(
     contract.
     """
     preds, target, valid = _pad_binary(preds, target)
+    _warm_record("binary_precision_recall_curve_padded", None, (preds, target))
     return _binary_curve_padded_j(preds, target, valid)
 
 
@@ -282,6 +298,7 @@ def binary_roc_curve_padded(preds: Array, target: Array) -> Tuple[Array, Array, 
     Returns ``(fpr, tpr, thresholds, valid_count)``.
     """
     preds, target, valid = _pad_binary(preds, target)
+    _warm_record("binary_roc_curve_padded", None, (preds, target))
     return _binary_roc_padded_j(preds, target, valid)
 
 
@@ -295,6 +312,7 @@ def binary_auroc_exact(preds: Array, target: Array, max_fpr: Optional[float] = N
     preds, target, valid = _pad_binary(preds, target)
     tier = _rank.select_tier(preds)
     _rank.record_dispatch(tier, "binary_auroc")
+    _warm_record("binary_auroc_exact", tier, (preds, target), max_fpr)
     with _rank.rank_scope(tier):
         # max_fpr == 1 short-circuits to the full-AUC path (reference auroc.py:92:
         # `max_fpr is None or max_fpr == 1`), which returns 0.0 — not NaN — on
@@ -309,6 +327,7 @@ def binary_average_precision_exact(preds: Array, target: Array) -> Array:
     preds, target, valid = _pad_binary(preds, target)
     tier = _rank.select_tier(preds)
     _rank.record_dispatch(tier, "binary_ap")
+    _warm_record("binary_average_precision_exact", tier, (preds, target))
     with _rank.rank_scope(tier):
         return _binary_ap_j(preds, target, valid, tier=tier)
 
@@ -386,6 +405,7 @@ def multiclass_auroc_exact(preds2d: Array, target: Array) -> Tuple[Array, Array]
     """Per-class exact AUROC + positive-count weights; rows with target<0 excluded."""
     preds2d, target = _pad_rows(preds2d, target)
     tier = _ovr_tier(preds2d, "multiclass_auroc")
+    _warm_record("multiclass_auroc_exact", tier, (preds2d, target))
     with _rank.rank_scope(tier):
         return _ovr_auroc_j(preds2d, target, tier=tier)
 
@@ -393,6 +413,7 @@ def multiclass_auroc_exact(preds2d: Array, target: Array) -> Tuple[Array, Array]
 def multiclass_average_precision_exact(preds2d: Array, target: Array) -> Tuple[Array, Array]:
     preds2d, target = _pad_rows(preds2d, target)
     tier = _ovr_tier(preds2d, "multiclass_ap")
+    _warm_record("multiclass_average_precision_exact", tier, (preds2d, target))
     with _rank.rank_scope(tier):
         return _ovr_ap_j(preds2d, target, tier=tier)
 
@@ -400,6 +421,7 @@ def multiclass_average_precision_exact(preds2d: Array, target: Array) -> Tuple[A
 def multilabel_auroc_exact(preds2d: Array, target2d: Array) -> Tuple[Array, Array]:
     preds2d, target2d = _pad_rows(preds2d, target2d)
     tier = _ovr_tier(preds2d, "multilabel_auroc")
+    _warm_record("multilabel_auroc_exact", tier, (preds2d, target2d))
     with _rank.rank_scope(tier):
         return _perlabel_auroc_j(preds2d, target2d, tier=tier)
 
@@ -407,5 +429,6 @@ def multilabel_auroc_exact(preds2d: Array, target2d: Array) -> Tuple[Array, Arra
 def multilabel_average_precision_exact(preds2d: Array, target2d: Array) -> Tuple[Array, Array]:
     preds2d, target2d = _pad_rows(preds2d, target2d)
     tier = _ovr_tier(preds2d, "multilabel_ap")
+    _warm_record("multilabel_average_precision_exact", tier, (preds2d, target2d))
     with _rank.rank_scope(tier):
         return _perlabel_ap_j(preds2d, target2d, tier=tier)

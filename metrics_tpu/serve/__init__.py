@@ -1,8 +1,8 @@
 """metrics_tpu.serve — the serving-path tiers built on top of the core.
 
-Currently one member: the async ingestion tier (:mod:`metrics_tpu.serve.ingest`),
-which decouples host batch arrival from device accumulation with a bounded
-staging ring and a coalescing tick thread::
+Two members today. The async ingestion tier (:mod:`metrics_tpu.serve.ingest`)
+decouples host batch arrival from device accumulation with a bounded staging
+ring and a coalescing tick thread::
 
     from metrics_tpu.serve import IngestQueue
 
@@ -10,7 +10,27 @@ staging ring and a coalescing tick thread::
     q.enqueue(preds, target, stream_ids=ids)   # host append, no dispatch
     value = q.compute()                        # flush-before-read, exact
     q.close()                                  # clean shutdown drain
+
+The executable-cache tier (:mod:`metrics_tpu.serve.excache`) makes replica
+restarts cold-start-free: JAX's persistent compilation cache under a library
+config surface, plus a warm manifest of every engine compile that
+``prewarm(target, manifest)`` replays at startup so the first request
+triggers zero compiles::
+
+    from metrics_tpu.serve import excache
+
+    excache.enable_persistent_cache("/var/cache/metrics_tpu/xla")
+    excache.enable_recording()                 # compiles now land in the manifest
+    ...                                        # ckpt writes warm_manifest.json
+    excache.prewarm(collection, "ckpts/warm_manifest.json")   # on restart
 """
+from metrics_tpu.serve import excache
+from metrics_tpu.serve.excache import (
+    enable_persistent_cache,
+    enable_recording,
+    prewarm,
+    save_manifest,
+)
 from metrics_tpu.serve.ingest import (
     IngestBackpressureError,
     IngestQueue,
@@ -23,6 +43,11 @@ __all__ = [
     "IngestBackpressureError",
     "IngestQueue",
     "active_queues",
+    "excache",
+    "enable_persistent_cache",
+    "enable_recording",
     "flush_for",
     "max_queue_depth",
+    "prewarm",
+    "save_manifest",
 ]

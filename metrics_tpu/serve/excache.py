@@ -1,0 +1,748 @@
+"""Cold-start-free serving: persistent executable cache + warm-manifest prewarm.
+
+Every AOT cache in the serving stack (``core/fused.py``, ``core/fleet.py``,
+``serve/ingest.py``, the ``ops/clf_curve.py`` rank kernels) is per-process, so
+a fresh replica pays the full retrace+compile bill before its first request —
+~20s cold on CPU for the canonical collection (ROADMAP item 4). This module
+removes that bill in two layers:
+
+- **Persistent compilation cache** (:func:`enable_persistent_cache`): turns on
+  JAX's on-disk compilation cache under a library-owned config surface, with
+  the entry-size/compile-time write floors zeroed by default (CPU compiles are
+  sub-second and would otherwise silently never be written). A monitoring
+  listener splits the accounting into ``excache.disk_hits`` (XLA compile
+  served from disk) vs ``excache.compiles`` (true compile), mirrored into the
+  obs registry when the obs gate is up.
+- **Warm manifest** (:func:`enable_recording` + :func:`prewarm`): every engine
+  compile records its stable cache-key digest (``fused.stable_key_digest`` —
+  NOT the ``PYTHONHASHSEED``-salted ``hash()``) plus a *reconstructible*
+  abstract-input spec (avals + static leaves) into a JSON manifest. The ckpt
+  manager writes ``warm_manifest.json`` atomically alongside checkpoints;
+  :func:`prewarm` replays each entry through ``.lower().compile()`` at startup
+  and seeds the owning engine's in-memory executable cache, so every lowering
+  hits the disk cache and the first real request triggers **zero** compiles
+  (flight-window provable: ``fused_cache_miss == 0``).
+
+Degradation contract: prewarm never fails startup. Schema drift, a stale
+``jax`` version stamp, entries that no longer match the live target, and
+injected ``excache.prewarm`` faults all warn (once per site) and skip the
+entry — the executable lazily compiles on first use, exactly as without
+prewarm, bit-identically.
+
+The recording hooks in the engines gate on
+``sys.modules.get("metrics_tpu.serve.excache")`` at *compile* time only (the
+cold path), so a process that never imports this module — or never calls
+:func:`enable_recording` — pays nothing on the steady-state path.
+"""
+import json
+import os
+import threading
+import time
+import warnings
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.core import fused as _fused
+from metrics_tpu.fault import inject as _fault
+from metrics_tpu.obs import flight as _obs_flight
+from metrics_tpu.obs import registry as _obs
+from metrics_tpu.utils.exceptions import MetricsUserWarning
+
+__all__ = [
+    "MANIFEST_NAME",
+    "SCHEMA_VERSION",
+    "cache_dir",
+    "clear_manifest",
+    "clear_stats",
+    "disable_persistent_cache",
+    "disable_recording",
+    "enable_persistent_cache",
+    "enable_recording",
+    "last_prewarm",
+    "load_manifest",
+    "manifest_entries",
+    "manifest_payload",
+    "prewarm",
+    "recording",
+    "save_manifest",
+    "stats",
+]
+
+#: manifest file name, written alongside checkpoints by the ckpt manager
+MANIFEST_NAME = "warm_manifest.json"
+
+#: bumped on any incompatible change to the entry encoding below
+SCHEMA_VERSION = 1
+
+# ----------------------------------------------------------- module state
+
+_LOCK = threading.Lock()
+
+#: the active on-disk cache directory (None == persistent cache off)
+_CACHE_DIR: Optional[str] = None
+
+_LISTENER_REGISTERED = False
+
+#: single boolean the engine compile hooks check via ``recording()``
+_RECORDING: bool = False
+
+_ENTRIES: List[Dict[str, Any]] = []
+_SEEN_DIGESTS: set = set()
+#: cheap pre-digest dedup for the per-call rank dispatch hook
+_SEEN_RANK: set = set()
+
+#: always-on plain-int accounting (the obs registry mirror is gated)
+_STATS: Dict[str, int] = {
+    "requests": 0,
+    "disk_hits": 0,
+    "compiles": 0,
+    "prewarmed": 0,
+    "manifest_entries": 0,
+    "prewarm_failures": 0,
+    "unrecordable": 0,
+}
+
+#: report dict of the most recent :func:`prewarm` call (``state_report()``
+#: surfaces it as the replica's warmup cost)
+_LAST_PREWARM: Optional[Dict[str, Any]] = None
+
+
+class _Unrecordable(Exception):
+    """An input leaf that cannot be serialized into the manifest (exotic
+    static object); the entry is dropped, never the update."""
+
+
+# ------------------------------------------------- persistent compile cache
+
+
+def _on_cache_event(event: str, **kwargs: Any) -> None:
+    # jax emits one `compile_requests_use_cache` per cache-eligible compile
+    # and one `cache_hits` when the executable came off disk; there is no
+    # explicit miss event, so true compiles are maintained as requests - hits.
+    if event == "/jax/compilation_cache/compile_requests_use_cache":
+        _STATS["requests"] += 1
+        _STATS["compiles"] += 1
+        if _obs._ENABLED:
+            _obs.REGISTRY.inc("excache", "compiles")
+    elif event == "/jax/compilation_cache/cache_hits":
+        _STATS["disk_hits"] += 1
+        _STATS["compiles"] -= 1
+        if _obs._ENABLED:
+            _obs.REGISTRY.inc("excache", "disk_hits")
+            _obs.REGISTRY.inc("excache", "compiles", -1)
+
+
+def _register_cache_listener() -> None:
+    global _LISTENER_REGISTERED
+    if _LISTENER_REGISTERED:
+        return
+    try:
+        from jax import monitoring
+
+        monitoring.register_event_listener(_on_cache_event)
+        _LISTENER_REGISTERED = True
+    except Exception:  # noqa: BLE001 — accounting must never break serving
+        pass
+
+
+def enable_persistent_cache(
+    cache_dir_: str,
+    *,
+    min_entry_size_bytes: int = 0,
+    min_compile_time_secs: float = 0.0,
+) -> str:
+    """Route every XLA compile through JAX's on-disk compilation cache.
+
+    The write floors default to zero: jax's own default
+    ``min_compile_time_secs=1.0`` silently skips sub-second compiles — which
+    is *every* CPU compile in this library — so a restart would find an empty
+    cache and prewarm would degrade to true compiles.
+    """
+    global _CACHE_DIR
+    cache_dir_ = str(cache_dir_)
+    os.makedirs(cache_dir_, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir_)
+    for name, value in (
+        ("jax_persistent_cache_min_compile_time_secs", min_compile_time_secs),
+        ("jax_persistent_cache_min_entry_size_bytes", min_entry_size_bytes),
+    ):
+        try:
+            jax.config.update(name, value)
+        except Exception:  # noqa: BLE001 — flag absent on this jax version
+            pass
+    try:
+        # the cache object is latched once per process; reset so the new dir
+        # takes effect even if a cache was already initialized
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception:  # noqa: BLE001 — private API drift: lazily picked up
+        pass
+    _register_cache_listener()
+    _CACHE_DIR = cache_dir_
+    return cache_dir_
+
+
+def disable_persistent_cache() -> None:
+    """Turn the on-disk cache back off (tests / config isolation)."""
+    global _CACHE_DIR
+    _CACHE_DIR = None
+    try:
+        jax.config.update("jax_compilation_cache_dir", None)
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def cache_dir() -> Optional[str]:
+    """The active persistent-cache directory, or None when disabled."""
+    return _CACHE_DIR
+
+
+def stats() -> Dict[str, int]:
+    """Copy of the excache accounting: ``disk_hits`` (XLA compiles served off
+    disk), ``compiles`` (true compiles while the cache was enabled),
+    ``prewarmed``/``prewarm_failures``, ``manifest_entries``."""
+    return dict(_STATS)
+
+
+def clear_stats() -> None:
+    for key in _STATS:
+        _STATS[key] = 0
+
+
+# ------------------------------------------------------- input (de)serializing
+
+
+def _encode(obj: Any) -> Any:
+    """Structural JSON encoding of an ``(args, kwargs)`` pytree: array leaves
+    by aval, containers by marker, primitives by python type tag (so json's
+    int/float lattice cannot drift the static cache key)."""
+    if isinstance(obj, jax.ShapeDtypeStruct) or _is_arraylike(obj):
+        return {"t": "aval", "shape": [int(s) for s in obj.shape], "dtype": str(obj.dtype)}
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return {"t": "lit", "py": type(obj).__name__, "v": obj}
+    if isinstance(obj, tuple):
+        return {"t": "tuple", "v": [_encode(e) for e in obj]}
+    if isinstance(obj, list):
+        return {"t": "list", "v": [_encode(e) for e in obj]}
+    if isinstance(obj, dict):
+        if not all(isinstance(k, str) for k in obj):
+            raise _Unrecordable("non-string dict key")
+        return {"t": "dict", "v": {k: _encode(v) for k, v in sorted(obj.items())}}
+    raise _Unrecordable(f"unrecordable static leaf: {type(obj).__name__}")
+
+
+def _is_arraylike(obj: Any) -> bool:
+    from metrics_tpu.utils.data import is_array
+
+    return is_array(obj)
+
+
+_LIT_TYPES = {"NoneType": lambda v: None, "bool": bool, "int": int, "float": float, "str": str}
+
+
+def _decode(obj: Any) -> Any:
+    """Inverse of :func:`_encode`; array leaves come back as
+    :class:`jax.ShapeDtypeStruct` (the prewarm replay is abstract)."""
+    if not isinstance(obj, dict) or "t" not in obj:
+        raise _Unrecordable(f"malformed manifest node: {obj!r}")
+    t = obj["t"]
+    if t == "aval":
+        return jax.ShapeDtypeStruct(tuple(obj["shape"]), np.dtype(obj["dtype"]))
+    if t == "lit":
+        py = obj["py"]
+        if py not in _LIT_TYPES:
+            raise _Unrecordable(f"unknown literal type {py!r}")
+        return None if py == "NoneType" else _LIT_TYPES[py](obj["v"])
+    if t == "tuple":
+        return tuple(_decode(e) for e in obj["v"])
+    if t == "list":
+        return [_decode(e) for e in obj["v"]]
+    if t == "dict":
+        return {k: _decode(v) for k, v in obj["v"].items()}
+    raise _Unrecordable(f"unknown manifest node type {t!r}")
+
+
+def _encode_inputs(args: Tuple, kwargs: Dict) -> Any:
+    return _encode((tuple(args), dict(kwargs)))
+
+
+def _decode_inputs(enc: Any) -> Tuple[Tuple, Dict]:
+    args, kwargs = _decode(enc)
+    return tuple(args), dict(kwargs)
+
+
+def _sds_tree(tree: Any) -> Any:
+    return jax.tree_util.tree_map(
+        lambda leaf: jax.ShapeDtypeStruct(tuple(leaf.shape), leaf.dtype), tree
+    )
+
+
+# ------------------------------------------------------------- recording
+
+
+def recording() -> bool:
+    """True while warm-manifest recording is on — the one check the engine
+    compile hooks make after their ``sys.modules`` probe."""
+    return _RECORDING
+
+
+def enable_recording(clear: bool = False) -> None:
+    """Start recording engine compiles into the warm manifest."""
+    global _RECORDING
+    if clear:
+        clear_manifest()
+    _RECORDING = True
+
+
+def disable_recording() -> None:
+    global _RECORDING
+    _RECORDING = False
+
+
+def clear_manifest() -> None:
+    with _LOCK:
+        _ENTRIES.clear()
+        _SEEN_DIGESTS.clear()
+        _SEEN_RANK.clear()
+
+
+def manifest_entries() -> List[Dict[str, Any]]:
+    with _LOCK:
+        return [dict(e) for e in _ENTRIES]
+
+
+def _add_entry(entry: Dict[str, Any], digest: str) -> None:
+    with _LOCK:
+        if digest in _SEEN_DIGESTS:
+            return
+        _SEEN_DIGESTS.add(digest)
+        entry["key_digest"] = digest
+        _ENTRIES.append(entry)
+    _STATS["manifest_entries"] += 1
+    if _obs._ENABLED:
+        _obs.REGISTRY.inc("excache", "manifest_entries")
+
+
+def record_fused_compile(
+    *, mode: str, groups: List[Tuple[str, Tuple[str, ...]]], args: Tuple, kwargs: Dict, digest: str
+) -> None:
+    """Called by ``FusedCollectionUpdate._launch`` on a cache-miss compile."""
+    if not _RECORDING:
+        return
+    try:
+        entry = {
+            "engine": "fused",
+            "mode": mode,
+            "groups": [[name, list(members)] for name, members in groups],
+            "inputs": _encode_inputs(args, kwargs),
+        }
+    except _Unrecordable:
+        _STATS["unrecordable"] += 1
+        return
+    _add_entry(entry, digest)
+
+
+def record_fleet_compile(
+    metric: Any, tag: str, args: Tuple, kwargs: Dict, stream_ids: Any, digest: str
+) -> None:
+    """Called by ``fleet.run_step`` on a cache-miss compile."""
+    if not _RECORDING:
+        return
+    try:
+        entry = {
+            "engine": "fleet",
+            "tag": tag,
+            "metric": type(metric).__name__,
+            "fleet_size": int(metric.fleet_size),
+            "inputs": _encode_inputs(args, kwargs),
+            "stream_ids": None if stream_ids is None else _encode(stream_ids),
+        }
+    except _Unrecordable:
+        _STATS["unrecordable"] += 1
+        return
+    _add_entry(entry, digest)
+
+
+def record_ingest_compile(
+    queue: Any, chain: List[Tuple[str, Any]], scan: bool, entries: List[Any], key: Tuple
+) -> None:
+    """Called by ``IngestQueue._launch_chain`` on a cache-miss compile. For the
+    scan fast path only entry 0's signature is stored (they are uniform by
+    construction) plus the coalesced count."""
+    if not _RECORDING:
+        return
+    topo, state_key, sig = key
+    digest = _fused.stable_key_digest(
+        (tuple(label for label, _ in topo), state_key, sig)
+    )
+    try:
+        recorded = [entries[0]] if scan else entries
+        entry = {
+            "engine": "ingest",
+            "scan": bool(scan),
+            "count": len(entries),
+            "chain": [label for label, _ in chain],
+            "entries": [_encode_inputs(e.args, e.kwargs) for e in recorded],
+        }
+    except _Unrecordable:
+        _STATS["unrecordable"] += 1
+        return
+    _add_entry(entry, digest)
+
+
+#: rank ops the prewarm replay knows how to call (schema-drift guard: an
+#: unknown op in a manifest is skipped, never getattr'd blindly)
+_RANK_REPLAY_OPS = (
+    "binary_auroc_exact",
+    "binary_average_precision_exact",
+    "binary_precision_recall_curve_padded",
+    "binary_roc_curve_padded",
+    "multiclass_auroc_exact",
+    "multiclass_average_precision_exact",
+    "multilabel_auroc_exact",
+    "multilabel_average_precision_exact",
+)
+
+
+def record_rank_compile(
+    op: str, tier: Optional[str], arrays: Tuple[Any, ...], max_fpr: Optional[float] = None
+) -> None:
+    """Called from the ``ops/clf_curve.py`` dispatch sites (every call while
+    recording, so the dedup check runs *before* any encoding work)."""
+    if not _RECORDING:
+        return
+    cheap = (op, tier, max_fpr, tuple((tuple(a.shape), str(a.dtype)) for a in arrays))
+    with _LOCK:
+        if cheap in _SEEN_RANK:
+            return
+        _SEEN_RANK.add(cheap)
+    entry = {
+        "engine": "rank",
+        "op": op,
+        "tier": tier,
+        "max_fpr": max_fpr,
+        "inputs": [_encode(a) for a in arrays],
+    }
+    _add_entry(entry, _fused.stable_key_digest(cheap))
+
+
+# --------------------------------------------------------------- manifest IO
+
+
+def manifest_payload() -> Dict[str, Any]:
+    """The JSON document :func:`save_manifest` writes: schema + jax version
+    stamps (prewarm skews on either) and the recorded entries."""
+    return {
+        "schema": SCHEMA_VERSION,
+        "jax_version": jax.__version__,
+        "entries": manifest_entries(),
+    }
+
+
+def save_manifest(path: str) -> str:
+    """Atomically write the warm manifest (same tmp+fsync+rename discipline as
+    the checkpoint commit records). The ckpt manager calls this alongside
+    every checkpoint while recording is on."""
+    from metrics_tpu.ckpt.manager import _atomic_write_json
+
+    _atomic_write_json(path, manifest_payload())
+    return path
+
+
+def load_manifest(path: str) -> Dict[str, Any]:
+    with open(path) as fh:
+        return json.load(fh)
+
+
+# ----------------------------------------------------------------- prewarm
+
+
+def _warn_skip(reason: str) -> None:
+    warnings.warn(
+        f"excache.prewarm: {reason} — entry skipped; its executable will"
+        " lazily compile on first use instead.",
+        MetricsUserWarning,
+        stacklevel=3,
+    )
+
+
+def _prewarm_fused(target: Any, entry: Dict[str, Any]) -> bool:
+    if getattr(target, "_groups", None) is None or not hasattr(target, "_modules"):
+        return False
+    groups = [(str(name), tuple(members)) for name, members in entry["groups"]]
+    if any(name not in target._modules for name, _ in groups):
+        _warn_skip("manifest fused groups do not match the live collection")
+        return False
+    forward = entry["mode"] == "forward"
+    args, kwargs = _decode_inputs(entry["inputs"])
+    engine = _fused.engine_for(target)
+    dyn, split_spec = _fused._split_inputs(args, kwargs)
+    states = {
+        name: _sds_tree(target._modules[name].state_pytree()) for name, _ in groups
+    }
+    topo = tuple((name, members, id(target._modules[name])) for name, members in groups)
+    key = (
+        entry["mode"],
+        topo,
+        _fused._aval_key(states),
+        _fused._aval_key(dyn),
+        _fused._static_key(split_spec),
+    )
+    if key in engine._cache or key in engine._broken_keys:
+        return False
+    fresh = (
+        {name: _sds_tree(target._modules[name].init_state()) for name, _ in groups}
+        if forward
+        else None
+    )
+    compiled = engine._compile(target, groups, states, fresh, dyn, split_spec, forward)
+    engine._cache[key] = compiled
+    return True
+
+
+def _prewarm_fleet(target: Any, entry: Dict[str, Any]) -> bool:
+    from metrics_tpu.core import fleet as _fleet
+
+    if getattr(target, "fleet_size", None) is None:
+        return False
+    if (
+        type(target).__name__ != entry["metric"]
+        or int(target.fleet_size) != entry["fleet_size"]
+    ):
+        _warn_skip("manifest fleet entry does not match the live metric")
+        return False
+    args, kwargs = _decode_inputs(entry["inputs"])
+    ids = None if entry.get("stream_ids") is None else _decode(entry["stream_ids"])
+    # the raw (pre-wrap) bound update, exactly what apply_update closes over
+    raw_update = type(target).update.__get__(target)
+    dyn, spec = _fused._split_inputs(args, kwargs)
+    state = {name: _sds_tree(getattr(target, name)) for name in target._defaults}
+    tag = entry["tag"]
+    if tag == "fleet.bcast":
+
+        def step(st, dl):
+            a, k = _fused._merge_inputs(dl, spec)
+            return _fleet.broadcast_new_state(target, raw_update, st, a, k)
+
+        extras: Tuple = (dyn,)
+    elif tag == "fleet.route":
+        if ids is None:
+            _warn_skip("routed fleet entry without stream_ids")
+            return False
+
+        def step(st, dl, i_):
+            a, k = _fused._merge_inputs(dl, spec)
+            return _fleet.routed_new_state(target, raw_update, st, a, k, i_)
+
+        extras = (dyn, ids)
+    else:
+        _warn_skip(f"unknown fleet tag {tag!r}")
+        return False
+    donate = getattr(target, "_pure_call_depth", 0) == 0
+    key = (
+        tag,
+        donate,
+        _fused._aval_key(state),
+        _fused._aval_key(extras),
+        _fused._static_key(spec),
+    )
+    cache = _fleet._cache_for(target)
+    if key in cache:
+        return False
+    jitted = jax.jit(step, donate_argnums=(0,) if donate else ())
+    compiled = jitted.lower(state, *extras).compile()
+    cache[key] = compiled
+    return True
+
+
+def _prewarm_ingest(target: Any, entry: Dict[str, Any]) -> bool:
+    if not hasattr(target, "_plan") or not hasattr(target, "_cache"):
+        return False
+    chain, _eager, is_collection = target._plan()
+    if not chain:
+        return False
+    if [label for label, _ in chain] != list(entry["chain"]):
+        _warn_skip("manifest ingest chain does not match the live queue target")
+        return False
+    scan = bool(entry["scan"])
+    count = int(entry["count"])
+    decoded = [_decode_inputs(e) for e in entry["entries"]]
+    if scan:
+        decoded = decoded * count
+    dyn_lists: List[List[Any]] = []
+    specs: List[Tuple[Any, tuple]] = []
+    for a, k in decoded:
+        dyn, spec = _fused._split_inputs(a, k)
+        dyn_lists.append(dyn)
+        specs.append(spec)
+    states = {label: _sds_tree(m.state_pytree()) for label, m in chain}
+    topo = tuple((label, id(m)) for label, m in chain)
+    if scan:
+        sig: Any = ("scan", count, _fused._aval_key(dyn_lists[0]), _fused._static_key(specs[0]))
+    else:
+        sig = tuple(
+            (_fused._aval_key(dyn), _fused._static_key(spec))
+            for dyn, spec in zip(dyn_lists, specs)
+        )
+    key = (topo, _fused._aval_key(states), sig)
+    if key in target._cache or key in target._broken_keys:
+        return False
+    if scan:
+        step = target._build_scan_step(chain, specs[0], is_collection)
+    else:
+        step = target._build_step(chain, specs, is_collection)
+    jitted = jax.jit(step, donate_argnums=(0,))
+    # suppress obs during the one-time trace, exactly like the live tick path
+    prev = _obs._ENABLED
+    _obs._ENABLED = False
+    try:
+        compiled = jitted.lower(states, dyn_lists).compile()
+    finally:
+        _obs._ENABLED = prev
+    target._cache[key] = compiled
+    return True
+
+
+def _prewarm_rank(entry: Dict[str, Any]) -> bool:
+    from metrics_tpu.ops import clf_curve as _clf
+    from metrics_tpu.ops import rank as _rank
+
+    op = entry["op"]
+    if op not in _RANK_REPLAY_OPS:
+        _warn_skip(f"unknown rank op {op!r}")
+        return False
+    fn = getattr(_clf, op)
+    arrays = [
+        jnp.zeros(tuple(a["shape"]), np.dtype(a["dtype"]))
+        for a in (dict(e) for e in entry["inputs"])
+        if a.get("t") == "aval"
+    ]
+    if len(arrays) != len(entry["inputs"]):
+        raise _Unrecordable("rank entry holds non-aval inputs")
+    kwargs: Dict[str, Any] = {}
+    if entry.get("max_fpr") is not None:
+        kwargs["max_fpr"] = entry["max_fpr"]
+    tier = entry.get("tier")
+    # the rank kernels are ordinary jits: one abstract-shaped call both warms
+    # the disk cache and populates the in-process jit dispatch cache, so the
+    # first real request neither traces nor compiles
+    if tier is not None:
+        with _rank.force_tier(tier):
+            fn(*arrays, **kwargs)
+    else:
+        fn(*arrays, **kwargs)
+    return True
+
+
+def _prewarm_entry(target: Any, entry: Dict[str, Any]) -> bool:
+    engine = entry.get("engine")
+    if engine == "fused":
+        return _prewarm_fused(target, entry)
+    if engine == "fleet":
+        return _prewarm_fleet(target, entry)
+    if engine == "ingest":
+        return _prewarm_ingest(target, entry)
+    if engine == "rank":
+        return _prewarm_rank(entry)
+    _warn_skip(f"unknown manifest engine {engine!r} (schema drift?)")
+    return False
+
+
+def prewarm(target: Any, manifest: Any) -> Dict[str, Any]:
+    """Replay a warm manifest against ``target``, seeding every matching
+    engine's in-memory executable cache via ``.lower().compile()``.
+
+    ``target`` is the live object the replica will serve — a fused
+    ``MetricCollection``, a fleet ``Metric``, or an ``IngestQueue`` (rank
+    entries are module-level and replay regardless of target). Entries that do
+    not match the target are skipped silently, so one manifest can be replayed
+    once per serving object. ``manifest`` is a path or an already-loaded dict.
+
+    Never raises: every failure mode (unreadable file, schema drift, stale
+    jax version, per-entry replay errors, injected ``excache.prewarm``
+    faults) warns and degrades to lazy first-use compilation. Returns a
+    report dict ``{entries, compiled, skipped, failed, seconds}`` — also
+    surfaced by ``state_report()`` and the ``excache_prewarm`` flight event.
+    """
+    global _LAST_PREWARM
+    t0 = time.perf_counter()
+    report = {"entries": 0, "compiled": 0, "skipped": 0, "failed": 0, "seconds": 0.0}
+    if isinstance(manifest, (str, os.PathLike)):
+        try:
+            manifest = load_manifest(str(manifest))
+        except Exception as err:  # noqa: BLE001 — startup must not fail
+            _warn_skip(f"unreadable manifest ({type(err).__name__}: {err})")
+            report["seconds"] = time.perf_counter() - t0
+            _LAST_PREWARM = report
+            return report
+    entries = manifest.get("entries") if isinstance(manifest, dict) else None
+    if not isinstance(entries, list):
+        _warn_skip("manifest has no entry list (schema drift?)")
+        entries = []
+    elif manifest.get("schema") != SCHEMA_VERSION:
+        _warn_skip(
+            f"manifest schema {manifest.get('schema')!r} != supported {SCHEMA_VERSION}"
+        )
+        report["skipped"] = len(entries)
+        entries = []
+    elif manifest.get("jax_version") != jax.__version__:
+        # a different jax version keys different XLA cache entries anyway:
+        # replaying would trigger true compiles at startup, not warm reuse
+        _warn_skip(
+            f"manifest recorded under jax {manifest.get('jax_version')!r}, running"
+            f" {jax.__version__!r}"
+        )
+        report["skipped"] = len(entries)
+        entries = []
+    for entry in entries:
+        report["entries"] += 1
+        try:
+            if _fault._SCHEDULE is not None:
+                _fault.fire(
+                    "excache.prewarm",
+                    engine=entry.get("engine"),
+                    digest=entry.get("key_digest"),
+                )
+            ok = _prewarm_entry(target, entry)
+        except Exception as err:  # noqa: BLE001 — degrade to lazy compile
+            report["failed"] += 1
+            _STATS["prewarm_failures"] += 1
+            _fused._warn_degrade_once(
+                "excache.prewarm",
+                err,
+                "the entry's executable lazily compiles on first use instead.",
+            )
+            if _obs._ENABLED and _obs_flight._RING is not None:
+                _obs_flight.record(
+                    "degrade",
+                    site="excache.prewarm",
+                    engine=entry.get("engine"),
+                    error=f"{type(err).__name__}: {str(err).splitlines()[0][:120]}",
+                )
+            continue
+        if ok:
+            report["compiled"] += 1
+            _STATS["prewarmed"] += 1
+            if _obs._ENABLED:
+                _obs.REGISTRY.inc("excache", "prewarmed")
+        else:
+            report["skipped"] += 1
+    report["seconds"] = time.perf_counter() - t0
+    _LAST_PREWARM = report
+    if _obs._ENABLED:
+        _obs.REGISTRY.observe_duration("excache", "prewarm_s", report["seconds"])
+        if _obs_flight._RING is not None:
+            _obs_flight.record("excache_prewarm", **report)
+    return report
+
+
+def last_prewarm() -> Optional[Dict[str, Any]]:
+    """Report of the most recent :func:`prewarm` call in this process."""
+    return None if _LAST_PREWARM is None else dict(_LAST_PREWARM)

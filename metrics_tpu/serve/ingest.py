@@ -58,6 +58,7 @@ the coalesced path; ``docs/source/pages/ingestion.rst`` documents when *not*
 to put a queue in front of a metric.
 """
 import itertools
+import sys
 import threading
 import time
 import weakref
@@ -645,6 +646,11 @@ class IngestQueue:
             finally:
                 _obs._ENABLED = prev
             self._cache[key] = compiled
+            # warm-manifest recording: the tick compile is the cold path, so
+            # the sys.modules probe costs the steady-state tick nothing
+            _excache = sys.modules.get("metrics_tpu.serve.excache")
+            if _excache is not None and _excache.recording():
+                _excache.record_ingest_compile(self, chain, scan, entries, key)
 
         donate_trees = [states]
         FusedCollectionUpdate._secure_ckpt_snapshots(donate_trees)

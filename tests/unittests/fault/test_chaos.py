@@ -9,10 +9,11 @@
   ``input.poison`` may legitimately alter computed values).
 
 Silent corruption — a completed run whose registered state differs from the
-baseline with no poison attribution — fails the sweep. 27 schedules cover
-explicit single-occurrence faults at all eleven sites (including the ingest
-tier's ``ingest.enqueue``/``ingest.tick``), repeated-fault and multi-site
-plans, and seeded random storms at several rates.
+baseline with no poison attribution — fails the sweep. 28 schedules cover
+explicit single-occurrence faults at all twelve sites (including the ingest
+tier's ``ingest.enqueue``/``ingest.tick`` and the cold-start tier's
+``excache.prewarm``), repeated-fault and multi-site plans, and seeded random
+storms at several rates.
 """
 import os
 import warnings
@@ -27,7 +28,7 @@ from metrics_tpu.core.collections import MetricCollection
 from metrics_tpu.fault import PoisonedInputError
 from metrics_tpu.obs.aggregate import aggregate_dir, host_snapshot, publish
 from metrics_tpu.regression import MeanAbsoluteError, MeanSquaredError
-from metrics_tpu.serve import IngestQueue
+from metrics_tpu.serve import IngestQueue, excache
 
 pytestmark = [pytest.mark.fault, pytest.mark.chaos]
 
@@ -83,6 +84,26 @@ def _workload(tmpdir):
     publish(agg_dir, {**host_snapshot(), "host": 0, "world": 1})
     merged = aggregate_dir(agg_dir, expect_world=1, timeout_s=0.0, min_world=1)
     out["agg_coverage"] = (merged["world_observed"], merged["world_expected"])
+
+    # cold-start tier: record this run's fused compile into a warm manifest,
+    # replay it into a fresh collection (fault site: excache.prewarm — an
+    # injected fault degrades to lazy first-use compile), and prove the first
+    # request after prewarm is bit-identical either way
+    excache.enable_recording(clear=True)
+    try:
+        wcoll = MetricCollection(
+            {"mse": MeanSquaredError(), "mae": MeanAbsoluteError()}, fused=True
+        )
+        wcoll.update(jnp.asarray([1.0, 2.0, 3.0, 4.0]), jnp.asarray([1.0, 3.0, 5.0, 7.0]))
+        manifest = excache.manifest_payload()
+    finally:
+        excache.disable_recording()
+    warm = MetricCollection(
+        {"mse": MeanSquaredError(), "mae": MeanAbsoluteError()}, fused=True
+    )
+    excache.prewarm(warm, manifest)  # never raises; degraded replay = lazy compile
+    warm.update(jnp.asarray([1.0, 2.0, 3.0, 4.0]), jnp.asarray([1.0, 3.0, 5.0, 7.0]))
+    out["warm"] = {k: np.asarray(v) for k, v in warm.compute().items()}
     return out
 
 

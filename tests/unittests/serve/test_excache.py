@@ -1,0 +1,565 @@
+"""Cold-start tier: persistent executable cache + warm-manifest prewarm.
+
+Covers the full ISSUE 14 surface: stable (PYTHONHASHSEED-independent) cache-key
+digests, the manifest codec round trip, the ckpt-manager manifest-alongside-
+checkpoint hook, in-process zero-compile prewarm for every engine (fused,
+fleet, ingest, rank), the never-fail-startup degradation ladder (schema drift,
+stale jax version, injected faults), the obs/prom/health surface, and — the
+acceptance criterion — a true subprocess restart whose first fused+fleet+ingest
+request triggers **zero** compiles, proven off obs counters and a flight
+window.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu as tm
+from metrics_tpu import fault, obs
+from metrics_tpu.core import fleet as _fleet
+from metrics_tpu.core import fused as _fused
+from metrics_tpu.core.collections import MetricCollection
+from metrics_tpu.serve import IngestQueue, excache
+from metrics_tpu.utils.exceptions import MetricsUserWarning
+
+pytestmark = pytest.mark.excache
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+)
+
+PREDS = jnp.asarray([0.2, 0.8, 0.4, 0.9])
+TARGET = jnp.asarray([0.0, 1.0, 1.0, 1.0])
+IDS = jnp.asarray([0, 1, 1, 3])
+
+
+@pytest.fixture(autouse=True)
+def _clean_excache_state():
+    excache.disable_recording()
+    excache.clear_manifest()
+    excache.clear_stats()
+    _fused._DEGRADE_WARNED.clear()
+    yield
+    excache.disable_recording()
+    excache.clear_manifest()
+    excache.clear_stats()
+    excache.disable_persistent_cache()
+
+
+def _canonical_collection():
+    return MetricCollection(
+        {"mse": tm.MeanSquaredError(), "mae": tm.MeanAbsoluteError()}, fused=True
+    )
+
+
+def _record_fused_manifest():
+    excache.enable_recording(clear=True)
+    coll = _canonical_collection()
+    coll.update(PREDS, TARGET)
+    payload = excache.manifest_payload()
+    excache.disable_recording()
+    return coll, payload
+
+
+# ------------------------------------------------------------ stable digests
+
+
+_DIGEST_CHILD = r"""
+import sys
+import jax.numpy as jnp
+import metrics_tpu as tm
+from metrics_tpu.core.collections import MetricCollection
+from metrics_tpu.core.fused import engine_for, fused_key_digest
+
+coll = MetricCollection(
+    {"mse": tm.MeanSquaredError(), "mae": tm.MeanAbsoluteError()}, fused=True
+)
+coll.update(jnp.asarray([0.2, 0.8, 0.4, 0.9]), jnp.asarray([0.0, 1.0, 1.0, 1.0]))
+engine = engine_for(coll)
+(key,) = engine._cache.keys()
+print(fused_key_digest(key), flush=True)
+"""
+
+
+@pytest.mark.smoke
+def test_key_digest_stable_across_hash_seeds():
+    """The manifest digest must not depend on PYTHONHASHSEED — the exact bug
+    the old salted ``hash(key)`` flight cache_key had."""
+    digests = set()
+    for seed in ("1", "2"):
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONHASHSEED=seed)
+        proc = subprocess.run(
+            [sys.executable, "-c", _DIGEST_CHILD],
+            capture_output=True, text=True, timeout=240, env=env, cwd=_REPO_ROOT,
+        )
+        assert proc.returncode == 0, proc.stderr
+        digests.add(proc.stdout.strip())
+    assert len(digests) == 1, f"digest is seed-dependent: {digests}"
+    assert all(len(d) == 12 for d in digests)
+
+
+def test_stable_repr_masks_object_ids():
+    key_a = ("update", ("grp", ("mse",), ("id", 140001)), "static")
+    key_b = ("update", ("grp", ("mse",), ("id", 998877)), "static")
+    assert _fused.stable_key_digest(key_a) == _fused.stable_key_digest(key_b)
+    # ...but genuinely different keys digest differently
+    key_c = ("forward", ("grp", ("mse",), ("id", 140001)), "static")
+    assert _fused.stable_key_digest(key_a) != _fused.stable_key_digest(key_c)
+
+
+def test_flight_cache_key_uses_stable_digest():
+    obs.enable(clear=True)
+    obs.flight.enable(capacity=32)
+    try:
+        coll = _canonical_collection()
+        coll.update(PREDS, TARGET)
+        launches = [e for e in obs.flight.events() if e["kind"] == "fused_launch"]
+        assert launches
+        mode, _, digest = launches[0]["cache_key"].partition(":")
+        assert mode in ("update", "forward")
+        assert len(digest) == 12 and int(digest, 16) >= 0
+    finally:
+        obs.flight.disable()
+        obs.disable()
+
+
+def test_split_inputs_takes_sds_as_dynamic():
+    sds = jax.ShapeDtypeStruct((4,), jnp.float32)
+    dyn, (treedef, leaf_spec) = _fused._split_inputs((sds, 3), {"flag": True})
+    assert dyn == [sds]
+    assert sum(1 for s in leaf_spec if s is _fused._DYN) == 1
+    # the round trip puts the SDS back where it was
+    args, kwargs = _fused._merge_inputs(dyn, (treedef, leaf_spec))
+    assert args == (sds, 3) and kwargs == {"flag": True}
+
+
+# ------------------------------------------------------------ manifest codec
+
+
+def test_encode_decode_round_trip():
+    args = (PREDS, 3, "micro", None, True)
+    kwargs = {"weights": TARGET, "threshold": 0.5}
+    enc = excache._encode_inputs(args, kwargs)
+    # the manifest is JSON on disk: the codec must survive serialization
+    dec_args, dec_kwargs = excache._decode_inputs(json.loads(json.dumps(enc)))
+    assert dec_args[0] == jax.ShapeDtypeStruct((4,), jnp.float32)
+    assert dec_args[1:] == (3, "micro", None, True)
+    assert dec_args[4] is True  # bool, not json-lattice-collapsed int
+    assert dec_kwargs["weights"] == jax.ShapeDtypeStruct((4,), jnp.float32)
+    assert dec_kwargs["threshold"] == 0.5
+
+
+def test_unrecordable_inputs_drop_entry_not_update():
+    excache.enable_recording(clear=True)
+    excache.record_fused_compile(
+        mode="update", groups=[("g", ("mse",))],
+        args=(object(),), kwargs={}, digest="d" * 12,
+    )
+    assert excache.manifest_entries() == []
+    assert excache.stats()["unrecordable"] == 1
+
+
+def test_manifest_save_load_round_trip(tmp_path):
+    _, payload = _record_fused_manifest()
+    path = excache.save_manifest(str(tmp_path / "m.json"))
+    loaded = excache.load_manifest(path)
+    assert loaded == json.loads(json.dumps(payload))
+    assert loaded["schema"] == excache.SCHEMA_VERSION
+    assert loaded["jax_version"] == jax.__version__
+    assert loaded["entries"][0]["engine"] == "fused"
+    assert len(loaded["entries"][0]["key_digest"]) == 12
+
+
+def test_ckpt_save_writes_manifest_alongside(tmp_path):
+    from metrics_tpu.ckpt import save_checkpoint
+
+    excache.enable_recording(clear=True)
+    coll = _canonical_collection()
+    coll.update(PREDS, TARGET)
+    series = str(tmp_path / "series")
+    save_checkpoint(coll, series).result()
+    manifest = os.path.join(series, excache.MANIFEST_NAME)
+    assert os.path.isfile(manifest)
+    assert excache.load_manifest(manifest)["entries"]
+
+
+def test_ckpt_save_without_recording_writes_no_manifest(tmp_path):
+    from metrics_tpu.ckpt import save_checkpoint
+
+    coll = _canonical_collection()
+    coll.update(PREDS, TARGET)
+    series = str(tmp_path / "series")
+    save_checkpoint(coll, series).result()
+    assert not os.path.isfile(os.path.join(series, excache.MANIFEST_NAME))
+
+
+# --------------------------------------------------- in-process prewarm: fused
+
+
+def test_fused_prewarm_first_request_zero_compiles():
+    coll, payload = _record_fused_manifest()
+    fresh = _canonical_collection()
+    report = excache.prewarm(fresh, payload)
+    assert report == {
+        "entries": 1, "compiled": 1, "skipped": 0, "failed": 0,
+        "seconds": report["seconds"],
+    }
+    with obs.observe(clear=True) as reg:
+        fresh.update(PREDS, TARGET)
+        snap = reg.snapshot()
+    assert snap["fused"]["cache_hits"] == 1
+    assert snap["fused"].get("cache_misses", 0) == 0
+    assert snap.get("jax", {}).get("compile_events", 0) == 0
+    coll_vals = {k: np.asarray(v) for k, v in coll.compute().items()}
+    fresh_vals = {k: np.asarray(v) for k, v in fresh.compute().items()}
+    for k in coll_vals:
+        assert np.array_equal(coll_vals[k], fresh_vals[k], equal_nan=True)
+
+
+def test_prewarm_is_idempotent():
+    _, payload = _record_fused_manifest()
+    fresh = _canonical_collection()
+    assert excache.prewarm(fresh, payload)["compiled"] == 1
+    again = excache.prewarm(fresh, payload)
+    assert again["compiled"] == 0 and again["skipped"] == 1
+
+
+# -------------------------------------------- in-process prewarm: fleet+ingest
+
+
+def test_fleet_prewarm_routed_and_broadcast():
+    excache.enable_recording(clear=True)
+    m = tm.BinaryAccuracy(fleet_size=4)
+    m.update(PREDS, TARGET, stream_ids=IDS)
+    m.update(PREDS, TARGET)
+    payload = excache.manifest_payload()
+    excache.disable_recording()
+    tags = {e["tag"] for e in payload["entries"]}
+    assert tags == {"fleet.route", "fleet.bcast"}
+
+    fresh = tm.BinaryAccuracy(fleet_size=4)
+    report = excache.prewarm(fresh, payload)
+    assert report["compiled"] == 2 and report["failed"] == 0
+    assert len(_fleet._cache_for(fresh)) == 2
+    with obs.observe(clear=True) as reg:
+        fresh.update(PREDS, TARGET, stream_ids=IDS)
+        fresh.update(PREDS, TARGET)
+        snap = reg.snapshot()
+    assert snap.get("jax", {}).get("compile_events", 0) == 0
+    assert np.array_equal(np.asarray(m.compute()), np.asarray(fresh.compute()), equal_nan=True)
+
+
+def test_ingest_scan_prewarm():
+    excache.enable_recording(clear=True)
+    with IngestQueue(tm.MeanSquaredError(), capacity=16, start=False) as q:
+        for _ in range(3):
+            q.enqueue(PREDS, TARGET)
+        q.flush()
+        baseline = np.asarray(q.compute())
+    payload = excache.manifest_payload()
+    excache.disable_recording()
+    (entry,) = payload["entries"]
+    assert entry["engine"] == "ingest" and entry["scan"] and entry["count"] == 3
+    assert len(entry["entries"]) == 1  # scan stores entry 0 only — uniform
+
+    with IngestQueue(tm.MeanSquaredError(), capacity=16, start=False) as q2:
+        report = excache.prewarm(q2, payload)
+        assert report["compiled"] == 1 and report["failed"] == 0
+        assert len(q2._cache) == 1
+        with obs.observe(clear=True) as reg:
+            for _ in range(3):
+                q2.enqueue(PREDS, TARGET)
+            q2.flush()
+            snap = reg.snapshot()
+        assert snap.get("jax", {}).get("compile_events", 0) == 0
+        assert np.array_equal(baseline, np.asarray(q2.compute()), equal_nan=True)
+
+
+def test_rank_dispatch_recorded_and_replayed():
+    from metrics_tpu.ops import clf_curve as clf
+
+    excache.enable_recording(clear=True)
+    clf.binary_auroc_exact(PREDS, TARGET.astype(jnp.int32))
+    clf.binary_auroc_exact(PREDS, TARGET.astype(jnp.int32))  # deduped
+    payload = excache.manifest_payload()
+    excache.disable_recording()
+    (entry,) = payload["entries"]
+    assert entry["engine"] == "rank" and entry["op"] == "binary_auroc_exact"
+    report = excache.prewarm(None, payload)
+    assert report["compiled"] == 1 and report["failed"] == 0
+
+
+# --------------------------------------------------------- degradation ladder
+
+
+def test_schema_drift_warns_and_skips_all():
+    _, payload = _record_fused_manifest()
+    payload["schema"] = excache.SCHEMA_VERSION + 1
+    fresh = _canonical_collection()
+    with pytest.warns(MetricsUserWarning, match="schema"):
+        report = excache.prewarm(fresh, payload)
+    assert report["compiled"] == 0 and report["skipped"] == 1
+    fresh.update(PREDS, TARGET)  # lazy compile still works
+
+
+def test_stale_jax_version_warns_and_skips_all():
+    _, payload = _record_fused_manifest()
+    payload["jax_version"] = "0.0.0"
+    fresh = _canonical_collection()
+    with pytest.warns(MetricsUserWarning, match="jax"):
+        report = excache.prewarm(fresh, payload)
+    assert report["compiled"] == 0 and report["skipped"] == 1
+
+
+def test_unreadable_manifest_never_fails_startup(tmp_path):
+    fresh = _canonical_collection()
+    with pytest.warns(MetricsUserWarning, match="unreadable"):
+        report = excache.prewarm(fresh, str(tmp_path / "missing.json"))
+    assert report == {
+        "entries": 0, "compiled": 0, "skipped": 0, "failed": 0,
+        "seconds": report["seconds"],
+    }
+    bad = tmp_path / "corrupt.json"
+    bad.write_text("{not json")
+    with pytest.warns(MetricsUserWarning, match="unreadable"):
+        excache.prewarm(fresh, str(bad))
+    fresh.update(PREDS, TARGET)
+
+
+def test_entry_list_drift_warns_and_skips():
+    fresh = _canonical_collection()
+    with pytest.warns(MetricsUserWarning, match="entry list"):
+        report = excache.prewarm(fresh, {"schema": 1, "entries": "oops"})
+    assert report["entries"] == 0
+
+
+def test_mismatched_entries_skip_silently_cross_target():
+    """One manifest replayed against every serving object: fused entries are
+    skipped by the fleet metric and vice versa, without warnings or failures."""
+    excache.enable_recording(clear=True)
+    coll = _canonical_collection()
+    coll.update(PREDS, TARGET)
+    m = tm.BinaryAccuracy(fleet_size=4)
+    m.update(PREDS, TARGET)
+    payload = excache.manifest_payload()
+    excache.disable_recording()
+    assert len(payload["entries"]) == 2
+    fresh = tm.BinaryAccuracy(fleet_size=4)
+    report = excache.prewarm(fresh, payload)
+    assert report["compiled"] == 1 and report["skipped"] == 1 and report["failed"] == 0
+
+
+def test_injected_prewarm_fault_degrades_bit_identically():
+    coll, payload = _record_fused_manifest()
+    fresh = _canonical_collection()
+    with pytest.warns(RuntimeWarning, match="excache.prewarm"):
+        with fault.FaultSchedule(fire_at={"excache.prewarm": 0}) as sched:
+            report = excache.prewarm(fresh, payload)
+    assert report["failed"] == 1 and report["compiled"] == 0
+    assert [e["site"] for e in sched.fired] == ["excache.prewarm"]
+    assert excache.stats()["prewarm_failures"] == 1
+    # degraded replica lazily compiles on first use, bit-identically
+    fresh.update(PREDS, TARGET)
+    for k, v in coll.compute().items():
+        assert np.array_equal(np.asarray(v), np.asarray(fresh.compute()[k]), equal_nan=True)
+
+
+# ---------------------------------------------------- obs / prom / health
+
+
+def test_prom_exposition_carries_excache_families(tmp_path):
+    from metrics_tpu.obs.prom import render, validate_exposition
+
+    excache.enable_persistent_cache(str(tmp_path / "xla"))
+    _record_fused_manifest()
+    text = render()
+    for family in (
+        "tm_excache_persistent_enabled",
+        "tm_excache_disk_hits_total",
+        "tm_excache_compiles_total",
+        "tm_excache_prewarmed_total",
+        "tm_excache_manifest_entries",
+    ):
+        assert family in text, family
+    assert "tm_excache_persistent_enabled 1" in text
+    validate_exposition(text)
+
+
+def test_health_max_cold_compiles_slo(tmp_path):
+    from metrics_tpu.obs import health
+
+    excache.enable_persistent_cache(str(tmp_path / "xla"))
+    health.enable()
+    try:
+        health.set_slo(max_cold_compiles=0)
+        excache.clear_stats()
+        assert not [
+            v for v in health.check_slos() if v["slo"] == "max_cold_compiles"
+        ]
+        excache._STATS["compiles"] = 3  # as if three true compiles happened
+        with pytest.warns(Warning, match="max_cold_compiles"):
+            violations = [
+                v for v in health.check_slos() if v["slo"] == "max_cold_compiles"
+            ]
+        assert violations and violations[0]["measured"] == 3
+    finally:
+        health.disable()
+
+
+def test_state_report_carries_warmup():
+    _, payload = _record_fused_manifest()
+    fresh = _canonical_collection()
+    excache.prewarm(fresh, payload)
+    summary = fresh.summary()
+    assert summary["warmup"]["compiled"] == 1
+    m = tm.MeanSquaredError()
+    m.update(PREDS, TARGET)
+    assert m.state_report()["warmup"]["compiled"] == 1
+
+
+# ------------------------------------------------- the restart acceptance test
+
+
+_RECORD_CHILD = r"""
+import json, os, sys
+import jax.numpy as jnp
+import numpy as np
+import metrics_tpu as tm
+from metrics_tpu.ckpt import save_checkpoint
+from metrics_tpu.core.collections import MetricCollection
+from metrics_tpu.serve import IngestQueue, excache
+
+cache_dir, series = sys.argv[1], sys.argv[2]
+excache.enable_persistent_cache(cache_dir)
+excache.enable_recording()
+
+preds = jnp.asarray([0.2, 0.8, 0.4, 0.9])
+target = jnp.asarray([0.0, 1.0, 1.0, 1.0])
+coll = MetricCollection(
+    {"mse": tm.MeanSquaredError(), "mae": tm.MeanAbsoluteError()}, fused=True
+)
+coll.update(preds, target)
+fm = tm.MeanSquaredError(fleet_size=4)
+fm.update(preds, target, stream_ids=jnp.asarray([0, 1, 1, 3]))
+with IngestQueue(tm.MeanAbsoluteError(), capacity=16, start=False) as q:
+    for _ in range(3):
+        q.enqueue(preds, target)
+    q.flush()
+    ingest_val = float(np.asarray(q.compute()))
+save_checkpoint(coll, series).result()
+print(json.dumps({
+    "stats": excache.stats(),
+    "collection": {k: float(np.asarray(v)) for k, v in coll.compute().items()},
+    "fleet": [float(x) for x in np.asarray(fm.compute())],
+    "ingest": ingest_val,
+}), flush=True)
+"""
+
+_RESTART_CHILD = r"""
+import json, os, sys
+import jax.numpy as jnp
+import numpy as np
+import metrics_tpu as tm
+import metrics_tpu.obs as obs
+from metrics_tpu.core.collections import MetricCollection
+from metrics_tpu.serve import IngestQueue, excache
+
+cache_dir, manifest = sys.argv[1], sys.argv[2]
+excache.enable_persistent_cache(cache_dir)
+
+coll = MetricCollection(
+    {"mse": tm.MeanSquaredError(), "mae": tm.MeanAbsoluteError()}, fused=True
+)
+fm = tm.MeanSquaredError(fleet_size=4)
+q = IngestQueue(tm.MeanAbsoluteError(), capacity=16, start=False)
+
+reports = [
+    excache.prewarm(t, manifest) for t in (coll, fm, q)
+]
+
+# inputs exist before the measurement window opens, as in a serving process
+# where request arrays arrive on device — their one-time constant/convert
+# compiles are process bring-up, not per-request cost
+preds = jnp.asarray([0.2, 0.8, 0.4, 0.9])
+target = jnp.asarray([0.0, 1.0, 1.0, 1.0])
+ids = jnp.asarray([0, 1, 1, 3])
+
+# ---- the first real requests, under obs + a flight window ----
+obs.enable(clear=True)
+obs.flight.enable(capacity=128)
+stats_before = excache.stats()
+coll.update(preds, target)
+fm.update(preds, target, stream_ids=ids)
+for _ in range(3):
+    q.enqueue(preds, target)
+q.flush()
+snap = obs.REGISTRY.snapshot()
+events = obs.flight.events()
+stats_after = excache.stats()
+ingest_val = float(np.asarray(q.compute()))
+q.close()
+print(json.dumps({
+    "prewarm": reports,
+    "fused": snap.get("fused", {}),
+    "jax": snap.get("jax", {}),
+    "miss_events": [e for e in events if e["kind"] == "fused_cache_miss"],
+    "request_true_compiles": stats_after["compiles"] - stats_before["compiles"],
+    "stats": stats_after,
+    "collection": {k: float(np.asarray(v)) for k, v in coll.compute().items()},
+    "fleet": [float(x) for x in np.asarray(fm.compute())],
+    "ingest": ingest_val,
+}), flush=True)
+"""
+
+
+def _run_child(script, *argv, tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-c", script, *argv],
+        capture_output=True, text=True, timeout=240, env=env, cwd=_REPO_ROOT,
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    return json.loads(proc.stdout.splitlines()[-1])
+
+
+@pytest.mark.smoke
+def test_restarted_replica_first_request_zero_compiles(tmp_path):
+    """ISSUE 14 acceptance: record in one process (checkpoint writes the warm
+    manifest, XLA executables land in the persistent cache), restart into a
+    fresh process, prewarm, and prove the first fused+fleet+ingest request
+    triggers zero compiles and zero ``fused_cache_miss`` flight events —
+    bit-identical to the recording process."""
+    cache_dir = str(tmp_path / "xla")
+    series = str(tmp_path / "series")
+    rec = _run_child(_RECORD_CHILD, cache_dir, series, tmp_path=tmp_path)
+    assert rec["stats"]["manifest_entries"] >= 3  # fused + fleet.route + ingest
+    manifest = os.path.join(series, excache.MANIFEST_NAME)
+    assert os.path.isfile(manifest), "ckpt save must write the manifest"
+
+    res = _run_child(_RESTART_CHILD, cache_dir, manifest, tmp_path=tmp_path)
+    # every manifest entry replayed somewhere, none failed
+    assert sum(r["compiled"] for r in res["prewarm"]) == rec["stats"]["manifest_entries"]
+    assert all(r["failed"] == 0 for r in res["prewarm"])
+    # prewarm's own lowerings were served from the on-disk cache, not compiled
+    assert res["stats"]["disk_hits"] >= 1
+    # the acceptance criterion: zero engine compiles on the first real
+    # requests — every executable came out of the prewarm-seeded caches, and
+    # not one XLA compile missed the persistent cache inside the window
+    assert res["fused"].get("cache_misses", 0) == 0
+    assert res["fused"]["cache_hits"] == 1
+    assert res["request_true_compiles"] == 0
+    assert res["miss_events"] == []
+    # compile-scope wall during the window ~ 0 (any residual events are
+    # sub-millisecond bookkeeping, not XLA compiles — the cold path costs
+    # seconds here)
+    compile_time = res["jax"].get("compile_time") or {}
+    assert compile_time.get("total_s", 0.0) < 0.5, compile_time
+    # ...and bit-identical results to the recording process
+    assert res["collection"] == rec["collection"]
+    assert res["fleet"] == rec["fleet"]
+    assert res["ingest"] == rec["ingest"]
