@@ -1677,6 +1677,52 @@ def bench_san(runs: int = 3) -> dict:
     }
 
 
+def bench_race(runs: int = 3) -> dict:
+    """``--race-overhead``: cold tmrace wall time over the full package.
+
+    Each run is a fresh interpreter (``python -m metrics_tpu.analysis
+    --race``) so the number is the true cold cost the CI lint tier pays:
+    interpreter + jax import + the two-phase AST pass (per-module scan, then
+    the cross-module thread-role/lock fixpoint and the lock-order SCC walk).
+    ``analyze_s`` is the analyzer-internal time from the summary line's own
+    stopwatch — the gap to the cold number is import cost. Recorded so the
+    concurrency tier's cost stays visible as the package (and its thread-role
+    population) grows — the acceptance budget is 60 s cold on CPU.
+    """
+    import os
+    import re
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    wall_s, analyze_s, summary = [], [], ""
+    for _ in range(runs):
+        t0 = time.perf_counter()
+        proc = subprocess.run(
+            [sys.executable, "-m", "metrics_tpu.analysis", "--race"],
+            cwd=repo, capture_output=True, text=True, timeout=900,
+        )
+        wall_s.append(time.perf_counter() - t0)
+        if proc.returncode != 0:
+            raise RuntimeError(f"tmrace reported new findings during bench:\n{proc.stdout[-2000:]}")
+        summary = proc.stdout.strip().rsplit("\n", 1)[-1]
+        m = re.search(r"in ([0-9.]+)s", summary)
+        if m:
+            analyze_s.append(float(m.group(1)))
+    return {
+        "metric": "tmrace_cold_wall_s",
+        "value": round(statistics.median(wall_s), 2),
+        "unit": "s",
+        "vs_baseline": None,
+        "analyze_s": round(statistics.median(analyze_s), 2) if analyze_s else None,
+        "summary_line": summary,
+        "bound": "host-only: interpreter+jax import dominates the cold number;"
+                 " the analyzer itself is one AST pass per module plus a"
+                 " cross-module held-set fixpoint and a Tarjan SCC pass over"
+                 " the lock-order graph",
+    }
+
+
 def bench_obs_trace(out_path=None, steps: int = 3) -> dict:
     """``--obs-trace``: one instrumented fused+fleet window exported as a
     Perfetto/Chrome ``trace_event`` JSON, plus the runtime<->static cost
@@ -1763,7 +1809,7 @@ if __name__ == "__main__":
     parser = argparse.ArgumentParser(description="metrics_tpu benchmarks")
     parser.add_argument(
         "--config",
-        choices=("accuracy", "logits", "confmat", "map", "ssim", "retrieval", "auroc", "fid", "fused", "fleet", "ingest", "coldstart", "sketch", "chaos", "lint", "obs_trace", "all"),
+        choices=("accuracy", "logits", "confmat", "map", "ssim", "retrieval", "auroc", "fid", "fused", "fleet", "ingest", "coldstart", "sketch", "chaos", "lint", "race", "obs_trace", "all"),
         default="all",
     )
     parser.add_argument(
@@ -1844,6 +1890,14 @@ if __name__ == "__main__":
         " (also runs under --config all)",
     )
     parser.add_argument(
+        "--race-overhead",
+        action="store_true",
+        help="also time tmrace (the thread-safety analyzer tier) cold: fresh-"
+        " interpreter p50 of `python -m metrics_tpu.analysis --race`, reported"
+        " as a JSON line so the concurrency tier's own cost stays visible"
+        " against its 60 s acceptance budget (also runs under --config all)",
+    )
+    parser.add_argument(
         "--obs-trace",
         action="store_true",
         help="run one instrumented fused+fleet window with the tmprof stack on"
@@ -1899,6 +1953,7 @@ if __name__ == "__main__":
         ("ckpt", bench_ckpt),
         ("lint", bench_lint),
         ("san", bench_san),
+        ("race", bench_race),
         ("obs_trace", bench_obs_trace),
     ):
         if name == "ckpt" and not cli.ckpt:
@@ -1921,7 +1976,9 @@ if __name__ == "__main__":
             continue
         if name == "san" and not (cli.san_overhead or config == "all"):
             continue
-        if config in (name, "all") or name in ("ckpt", "fused", "fleet", "ingest", "coldstart", "sketch", "chaos", "lint", "san", "obs_trace"):
+        if name == "race" and not (cli.race_overhead or config in ("race", "all")):
+            continue
+        if config in (name, "all") or name in ("ckpt", "fused", "fleet", "ingest", "coldstart", "sketch", "chaos", "lint", "san", "race", "obs_trace"):
             try:
                 result = fn()
                 summary[result["metric"]] = {

@@ -1,8 +1,9 @@
-"""``python -m metrics_tpu.analysis`` — the tmlint/tmsan CLI.
+"""``python -m metrics_tpu.analysis`` — the tmlint/tmsan/tmrace CLI.
 
 Usage:
     python -m metrics_tpu.analysis metrics_tpu/            # lint, baseline-aware
     python -m metrics_tpu.analysis --san                   # + jaxpr/HLO tier (tmsan)
+    python -m metrics_tpu.analysis --race                  # thread-safety tier (tmrace)
     python -m metrics_tpu.analysis --san --write-costs     # refresh tmsan_costs.json
     python -m metrics_tpu.analysis --explain TM-HOSTSYNC   # rule rationale
     python -m metrics_tpu.analysis metrics_tpu/ --write-baseline  # bootstrap waivers
@@ -50,6 +51,16 @@ def main(argv=None) -> int:
         "TM-HOSTSYNC waivers against jaxpr evidence",
     )
     parser.add_argument(
+        "--race",
+        action="store_true",
+        help="run tmrace, the concurrency tier: build the thread-role model "
+        "(spawns, handler installs, @thread_role/@locked_by annotations), "
+        "check lock discipline (TMR-UNLOCKED), the lock-order deadlock graph "
+        "(TMR-ORDER), host work under hot locks (TMR-HOLD-HOST), "
+        "signal/atexit/excepthook safety (TMR-HANDLER), and thread leaks "
+        "(TMR-LEAK)",
+    )
+    parser.add_argument(
         "--write-costs",
         action="store_true",
         help="with --san: write/refresh tmsan_costs.json from the measured "
@@ -76,6 +87,8 @@ def main(argv=None) -> int:
 
     if args.san:
         return _main_san(args, paths[0])
+    if args.race:
+        return _main_race(args, paths[0])
 
     try:
         report = analyze(
@@ -146,6 +159,81 @@ def main(argv=None) -> int:
         f"tmlint: {s['files']} files, {s['functions']} functions "
         f"({s['jit_reachable']} jit-reachable), {s['findings']} findings "
         f"({s['waived']} waived, {len(new)} new) in {s['seconds']}s"
+    )
+    return 1 if new else 0
+
+
+def _main_race(args, target: str) -> int:
+    """The --race path: the tmrace concurrency tier on its own."""
+    import os
+
+    from metrics_tpu.analysis.race.runner import run_race
+    from metrics_tpu.analysis.runner import _find_repo_root
+
+    selected = None
+    if args.select:
+        selected = {r.strip().upper() for r in args.select.split(",")}
+        unknown = selected - set(RULES)
+        if unknown:
+            print(f"unknown rule(s): {', '.join(sorted(unknown))}", file=sys.stderr)
+            return 2
+
+    def keep(f):
+        return selected is None or f.rule in selected
+
+    try:
+        report = run_race(target, baseline_path=args.baseline)
+    except FileNotFoundError as err:
+        print(f"tmrace: {err}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        out = args.baseline or os.path.join(
+            _find_repo_root(target), baseline_mod.BASELINE_FILENAME
+        )
+        n = baseline_mod.write_baseline(
+            out,
+            [f for f in report.findings if keep(f)],
+            reason="bootstrap waiver: pre-existing finding, triage pending",
+        )
+        print(f"tmrace: wrote {n} waivers to {out}")
+        return 0
+
+    new = [f for f in report.new_findings if keep(f)]
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "stats": report.stats,
+                    "roles": report.roles,
+                    "new": [vars(f) for f in new],
+                    "waived": [vars(f) for f in report.waived if keep(f)],
+                    "unused_waivers": [list(k) for k in report.unused_waivers],
+                    "parse_errors": report.parse_errors,
+                },
+                indent=2,
+            )
+        )
+        return 1 if new else 0
+
+    for f in new:
+        print(f.format())
+    if args.verbose:
+        for f in report.waived:
+            if keep(f):
+                print(f.format() + f"  # reason: {f.waive_reason}")
+        for role, n in sorted(report.roles.items()):
+            print(f"# role {role}: {n} functions")
+    for key in report.unused_waivers:
+        print(f"# stale waiver (no matching finding): {':'.join(key)}")
+    for path, err in sorted(report.parse_errors.items()):
+        print(f"# parse error: {path}: {err}")
+    s = report.stats
+    print(
+        f"tmrace: {s['files']} files, {s['functions']} functions, "
+        f"{s['locks']} locks, {s['roles']} roles, {s['threads']} thread spawns, "
+        f"{s['findings']} findings ({s['waived']} waived, {len(new)} new) "
+        f"in {s['seconds']}s"
     )
     return 1 if new else 0
 

@@ -19,6 +19,7 @@ class Rule:
     id: str
     # tmlint: "trace-safety" | "state-contract" | "retrace-hazard"
     # tmsan:  "jaxpr-trace" | "hlo-cost" | "crosscheck"
+    # tmrace: "lock-discipline" | "lock-order" | "handler-safety"
     family: str
     summary: str
     #: obs counter(s) that fire at runtime for this failure class, with
@@ -322,6 +323,112 @@ RULES: Dict[str, Rule] = {
             ),
         ),
         Rule(
+            id="TMR-UNLOCKED",
+            family="lock-discipline",
+            summary="shared attribute mutated from >=2 thread roles outside its governing lock",
+            counter="race.unlocked",
+            runtime_signal=(
+                "lost updates / torn compound state under real concurrency: a counter "
+                "that undercounts, a dict whose check-then-act interleaves — the exact-total "
+                "stress tests (pytest -m race) are the dynamic corroboration"
+            ),
+            rationale=(
+                "An attribute written by two different thread roles (user thread, ingest\n"
+                "ticker, ckpt writer, sampler, prom handler, ...) needs ONE governing\n"
+                "lock covering every non-atomic mutation. tmrace infers governance from\n"
+                "the acquisition context of each write (interprocedural: the held-at-\n"
+                "entry set is the intersection over call sites, or an explicit\n"
+                "`@locked_by(...)` contract) and flags targets where some mutation runs\n"
+                "outside every candidate lock. The documented GIL-atomic idioms are\n"
+                "modeled as atomic and never flagged: a single `deque.append` (the\n"
+                "obs/ring.py hot path), a single attribute store of a fresh object,\n"
+                "`Event.set/clear`. Read-modify-write (`+=`, `x = x + ...`) and\n"
+                "multi-step container surgery are not atomic and need the lock."
+            ),
+        ),
+        Rule(
+            id="TMR-ORDER",
+            family="lock-order",
+            summary="cycle in the interprocedural lock-acquisition order graph",
+            counter="race.order_cycles",
+            runtime_signal=(
+                "a deadlock under the right interleaving: two threads each holding one "
+                "lock of the cycle and blocking on the next — the process wedges with no "
+                "exception, visible only as a stalled tick/scrape/save"
+            ),
+            rationale=(
+                "tmrace records an edge L1 -> L2 whenever code acquires L2 while\n"
+                "holding L1 — including interprocedurally (a call made under L1 to a\n"
+                "function that transitively acquires L2). A cycle in that graph means\n"
+                "two code paths take the same locks in opposite orders, which is a\n"
+                "deadlock waiting for the right preemption point. Fix by ordering the\n"
+                "acquisitions consistently (the repo convention: never call into\n"
+                "another locked subsystem while holding your own lock — snapshot under\n"
+                "the lock, work outside it, e.g. ckpt secure_pending_snapshots)."
+            ),
+        ),
+        Rule(
+            id="TMR-HOLD-HOST",
+            family="lock-discipline",
+            summary="device sync or disk I/O while holding a lock",
+            counter="race.hold_host",
+            runtime_signal=(
+                "latency cliffs on every thread contending the lock: an enqueue/scrape/"
+                "tick blocked behind a listdir or a device->host transfer — shows up as "
+                "p99 spikes in health latency and gaps between ticks in the tmscope series"
+            ),
+            rationale=(
+                "A lock held across host-blocking work (`os.listdir`, `open`/`fsync`,\n"
+                "`time.sleep`, a `block_until_ready` device sync, `np.asarray` on\n"
+                "device values,\n"
+                "thread `.join`) serializes every contending thread behind IO the lock\n"
+                "was never meant to cover. Hot-path locks (ingest `_admit`, the\n"
+                "registry lock) must only guard memory ops: move the IO outside the\n"
+                "critical section (snapshot-then-write) or keep a dedicated coarse\n"
+                "lock for the slow path and document it with a waiver."
+            ),
+        ),
+        Rule(
+            id="TMR-HANDLER",
+            family="handler-safety",
+            summary="signal/atexit/excepthook code blocking on a lock or mutating shared state",
+            counter="race.handler",
+            runtime_signal=(
+                "a dump-on-preemption that deadlocks: the signal arrives while the "
+                "preempted thread holds the lock the handler then blocks on — the process "
+                "dies silently with NO flight dump, defeating the post-mortem"
+            ),
+            rationale=(
+                "Signal handlers run ON TOP of a preempted thread; atexit/excepthook run\n"
+                "while daemon threads may be mid-critical-section. Any blocking\n"
+                "`lock.acquire()` (including `with lock:`) reachable from handler\n"
+                "context can therefore wait on a holder that will never resume —\n"
+                "self-deadlock. Handler paths must use try-lock\n"
+                "(`acquire(blocking=False)`) with a lock-free fallback (the flight\n"
+                "recorder's ring snapshot is the model), and must not perform\n"
+                "non-atomic mutations of state other threads read."
+            ),
+        ),
+        Rule(
+            id="TMR-LEAK",
+            family="lock-discipline",
+            summary="thread spawned without a daemon flag or join/close path",
+            counter="race.leaks",
+            runtime_signal=(
+                "process refuses to exit (non-daemon thread still parked in wait) or "
+                "threads accumulate across restarts — visible as a hanging test run or "
+                "a climbing thread count in the health report"
+            ),
+            rationale=(
+                "Every `threading.Thread(...)` the library starts must either be a\n"
+                "daemon (`daemon=True` — dies with the process, the repo default for\n"
+                "tickers/writers/samplers) or have an owned join/close path (the handle\n"
+                "is stored and `.join()`ed by a close()/stop() method). A spawn with\n"
+                "neither leaks: it pins the interpreter at exit and accumulates under\n"
+                "restart churn."
+            ),
+        ),
+        Rule(
             id="TMS-BUDGET",
             family="hlo-cost",
             summary="compiled cost grew >15% over the checked-in budget",
@@ -348,16 +455,23 @@ RULES: Dict[str, Rule] = {
 INTROSPECTION_RULES: Tuple[str, ...] = ("TM-STATE-UNREG", "TM-REDUCE-MISMATCH", "TM-PERSIST")
 
 #: tmsan (jaxpr/HLO tier) rules — produced by ``metrics_tpu.analysis.san``, not
-#: by the AST pass. Baseline waivers are shared but scoped: a pure tmlint run
-#: ignores TMS-* waivers and a san run ignores unused TM-* ones.
+#: by the AST pass. Baseline waivers are shared but scoped: each tier applies
+#: (and reports staleness for) only the waivers in its own namespace.
 SAN_RULES: Tuple[str, ...] = (
     "TMS-CALLBACK", "TMS-F64", "TMS-UPCAST", "TMS-BIGCONST",
     "TMS-COLLECTIVE", "TMS-DYNSHAPE", "TMS-LINTGAP", "TMS-STALE-WAIVER",
     "TMS-BUDGET",
 )
 
-#: AST/introspection (tmlint) rules — everything that is not a san rule.
-LINT_RULES: Tuple[str, ...] = tuple(r for r in RULES if r not in SAN_RULES)
+#: tmrace (concurrency tier) rules — produced by ``metrics_tpu.analysis.race``.
+RACE_RULES: Tuple[str, ...] = (
+    "TMR-UNLOCKED", "TMR-ORDER", "TMR-HOLD-HOST", "TMR-HANDLER", "TMR-LEAK",
+)
+
+#: AST/introspection (tmlint) rules — everything not owned by another tier.
+LINT_RULES: Tuple[str, ...] = tuple(
+    r for r in RULES if r not in SAN_RULES and r not in RACE_RULES
+)
 
 
 @dataclass

@@ -1,0 +1,13 @@
+"""tmrace — the concurrency tier of the three-tier static analysis.
+
+tmlint reads source text (trace safety), tmsan reads the traced jaxpr/HLO
+(compiler tier); tmrace reads the *threading structure*: which thread roles
+exist, which locks they take in what order, and which shared attributes they
+mutate. Rules: TMR-UNLOCKED, TMR-ORDER, TMR-HOLD-HOST, TMR-HANDLER, TMR-LEAK
+(``metrics_tpu/analysis/findings.py``), reported through the shared
+``tmlint_baseline.json`` waiver machinery scoped to the ``TMR-*`` namespace.
+"""
+from metrics_tpu.analysis.race.runner import RaceReport, run_race
+from metrics_tpu.analysis.race.thread_model import RaceModel, build_model
+
+__all__ = ["RaceModel", "RaceReport", "build_model", "run_race"]

@@ -551,9 +551,13 @@ def save_checkpoint(
         generation = _save_generation(world)
     os.makedirs(directory, exist_ok=True)
     dir_key = os.path.abspath(directory)
+    # the directory scan is disk IO — do it before taking _INFLIGHT_LOCK, which
+    # background writers contend on every commit; the lock only needs to cover
+    # the read-max-assign on _LAST_ASSIGNED (the disk floor can only be stale
+    # in the direction the _LAST_ASSIGNED floor already corrects)
+    last = latest_step(directory) if step is None else None
     with _INFLIGHT_LOCK:
         if step is None:
-            last = latest_step(directory)
             # floor on in-flight assignments too: back-to-back async saves must
             # each get a fresh step even though none has committed yet
             step = max(-1 if last is None else last, _LAST_ASSIGNED.get(dir_key, -1)) + 1

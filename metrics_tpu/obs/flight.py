@@ -225,9 +225,23 @@ def note_state_source(obj: Any) -> None:
 
 
 def _state_reports() -> List[Dict[str, Any]]:
+    """Resolve the registered state sources without ever blocking.
+
+    This runs inside the atexit/signal/excepthook dump path, which can preempt
+    a thread that is *currently inside* :func:`note_state_source` holding
+    ``_LOCK`` — a blocking acquire here would deadlock the post-mortem at the
+    exact moment it matters. Try-lock; on contention fall back to a lock-free
+    ``list()`` snapshot (``_STATE_SOURCES`` only ever holds weakrefs, and a
+    torn read costs at most one stale/missing rider, never a crash).
+    """
     out = []
-    with _LOCK:
-        objs = [r() for r in _STATE_SOURCES]
+    if _LOCK.acquire(blocking=False):
+        try:
+            objs = [r() for r in _STATE_SOURCES]
+        finally:
+            _LOCK.release()
+    else:
+        objs = [r() for r in list(_STATE_SOURCES)]
     for obj in objs:
         if obj is None:
             continue

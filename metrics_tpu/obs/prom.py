@@ -38,6 +38,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from metrics_tpu.obs import health as _health
 from metrics_tpu.obs import registry as _reg
 from metrics_tpu.obs import series as _series
+from metrics_tpu.utils.concurrency import thread_role
 
 #: the Content-Type Prometheus scrapers expect from a text-format endpoint
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
@@ -378,6 +379,9 @@ def _family_of(sample_name: str, types: Dict[str, str]) -> Optional[str]:
 
 
 class _MetricsHandler(BaseHTTPRequestHandler):
+    # ThreadingHTTPServer invokes this on its own per-connection threads —
+    # machinery tmrace cannot see statically, hence the explicit role.
+    @thread_role("prom-handler")
     def do_GET(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler contract
         if self.path.split("?", 1)[0] not in ("/metrics", "/"):
             self.send_response(404)

@@ -325,7 +325,9 @@ def _add_entry(entry: Dict[str, Any], digest: str) -> None:
         _SEEN_DIGESTS.add(digest)
         entry["key_digest"] = digest
         _ENTRIES.append(entry)
-    _STATS["manifest_entries"] += 1
+        # read-modify-write: callers race from every recording thread, so the
+        # counter bump belongs inside the same critical section as the entry
+        _STATS["manifest_entries"] += 1
     if _obs._ENABLED:
         _obs.REGISTRY.inc("excache", "manifest_entries")
 
@@ -344,7 +346,8 @@ def record_fused_compile(
             "inputs": _encode_inputs(args, kwargs),
         }
     except _Unrecordable:
-        _STATS["unrecordable"] += 1
+        with _LOCK:
+            _STATS["unrecordable"] += 1
         return
     _add_entry(entry, digest)
 
@@ -365,7 +368,8 @@ def record_fleet_compile(
             "stream_ids": None if stream_ids is None else _encode(stream_ids),
         }
     except _Unrecordable:
-        _STATS["unrecordable"] += 1
+        with _LOCK:
+            _STATS["unrecordable"] += 1
         return
     _add_entry(entry, digest)
 
@@ -392,7 +396,8 @@ def record_ingest_compile(
             "entries": [_encode_inputs(e.args, e.kwargs) for e in recorded],
         }
     except _Unrecordable:
-        _STATS["unrecordable"] += 1
+        with _LOCK:
+            _STATS["unrecordable"] += 1
         return
     _add_entry(entry, digest)
 

@@ -81,6 +81,7 @@ from metrics_tpu.obs import flight as _obs_flight
 from metrics_tpu.obs import health as _health
 from metrics_tpu.obs import registry as _obs
 from metrics_tpu.obs.ring import Ring
+from metrics_tpu.utils.concurrency import locked_by
 
 __all__ = [
     "IngestBackpressureError",
@@ -367,6 +368,7 @@ class IngestQueue:
             with self._tick_lock:
                 self._run_ticks()
 
+    @locked_by("IngestQueue._tick_lock")
     def _run_ticks(self) -> None:
         """Drain-and-apply until the ring is empty (caller holds _tick_lock).
 

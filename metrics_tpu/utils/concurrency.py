@@ -1,0 +1,49 @@
+"""Concurrency annotation vocabulary for the tmrace static analyzer.
+
+These decorators are runtime no-ops (they tag the function and return it
+unchanged) — their value is *static*: ``metrics_tpu/analysis/race`` reads them
+off the AST to seed its thread-role model and lock-governance facts where
+discovery alone cannot (a thread spawned by a stdlib helper, a caller-holds-
+the-lock contract that only lives in a docstring today).
+
+``@thread_role("prom-handler")``
+    Declares which thread role(s) execute this function. Roles discovered
+    automatically (``threading.Thread(target=...)`` spawns, ``signal.signal``/
+    ``atexit.register``/``sys.excepthook`` installs) never need this; use it
+    for entry points reached through machinery the analyzer cannot see —
+    e.g. ``ThreadingHTTPServer`` invoking ``do_GET`` on its own threads.
+
+``@locked_by("IngestQueue._tick_lock")``
+    Declares the caller-holds contract: every caller of this function holds
+    the named lock(s) for the duration of the call. The analyzer treats the
+    function body as running under those locks (instead of inferring the
+    held-at-entry set as the intersection over call sites) and will anchor
+    TMR-UNLOCKED governance on them. Lock names use the analyzer's identity
+    scheme: ``ClassName._attr`` for instance locks created in ``__init__``,
+    ``module._GLOBAL`` for module-level locks.
+"""
+from typing import Any, Callable, Tuple
+
+__all__ = ["locked_by", "thread_role"]
+
+
+def thread_role(*roles: str) -> Callable[[Any], Any]:
+    """Tag ``fn`` as executing under the given thread role(s) (no-op wrapper)."""
+
+    def deco(fn: Any) -> Any:
+        existing: Tuple[str, ...] = getattr(fn, "__thread_roles__", ())
+        fn.__thread_roles__ = existing + tuple(roles)
+        return fn
+
+    return deco
+
+
+def locked_by(*locks: str) -> Callable[[Any], Any]:
+    """Tag ``fn`` with its caller-holds-lock contract (no-op wrapper)."""
+
+    def deco(fn: Any) -> Any:
+        existing: Tuple[str, ...] = getattr(fn, "__locked_by__", ())
+        fn.__locked_by__ = existing + tuple(locks)
+        return fn
+
+    return deco

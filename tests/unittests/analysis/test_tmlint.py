@@ -440,7 +440,7 @@ def test_tmlint_no_new_findings():
 
 
 def test_every_rule_documented_and_cross_linked():
-    from metrics_tpu.analysis.findings import LINT_RULES, SAN_RULES
+    from metrics_tpu.analysis.findings import LINT_RULES, RACE_RULES, SAN_RULES
 
     assert set(LINT_RULES) == {
         "TM-HOSTSYNC", "TM-PYBRANCH", "TM-DYNSHAPE", "TM-RETRACE",
@@ -451,7 +451,14 @@ def test_every_rule_documented_and_cross_linked():
         "TMS-COLLECTIVE", "TMS-DYNSHAPE", "TMS-LINTGAP", "TMS-STALE-WAIVER",
         "TMS-BUDGET",
     }
-    assert set(RULES) == set(LINT_RULES) | set(SAN_RULES)
+    assert set(RACE_RULES) == {
+        "TMR-UNLOCKED", "TMR-ORDER", "TMR-HOLD-HOST", "TMR-HANDLER", "TMR-LEAK",
+    }
+    assert set(RULES) == set(LINT_RULES) | set(SAN_RULES) | set(RACE_RULES)
+    # the three tiers partition RULES: every waiver has exactly one staleness home
+    assert not set(LINT_RULES) & set(SAN_RULES)
+    assert not set(LINT_RULES) & set(RACE_RULES)
+    assert not set(SAN_RULES) & set(RACE_RULES)
     for rule_id, rule in RULES.items():
         text = explain(rule_id)
         assert rule_id in text and rule.runtime_signal in text

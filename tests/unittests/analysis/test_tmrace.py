@@ -1,0 +1,664 @@
+"""tmrace unit tier: per-rule seeded fixtures (each with a clean twin), the
+thread-role model, annotation semantics, three-tier waiver scoping, the
+repo-wide no-new-findings guard, and end-to-end CLI exit-code regressions.
+
+The threaded *stress* corroboration of these rules lives in
+``test_tmrace_stress.py`` (marker ``race``); this file is pure static
+analysis and rides the ``lint`` CI step alongside it.
+"""
+import pathlib
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+import metrics_tpu
+from metrics_tpu.analysis import BASELINE_FILENAME
+from metrics_tpu.analysis.race import build_model, run_race
+
+pytestmark = [pytest.mark.lint, pytest.mark.race]
+
+REPO_ROOT = pathlib.Path(metrics_tpu.__file__).resolve().parent.parent
+
+
+def _race_snippet(tmp_path, source):
+    path = tmp_path / "mod.py"
+    path.write_text(textwrap.dedent(source))
+    report = run_race(str(path), repo_root=str(tmp_path))
+    assert report.parse_errors == {}
+    return report.new_findings
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+# -------------------------------------------------------------- TMR-UNLOCKED
+
+
+def test_unlocked_bad(tmp_path):
+    """A counter written by the spawned role under the lock and by the user
+    role without it: no common governing lock -> TMR-UNLOCKED."""
+    findings = _race_snippet(
+        tmp_path,
+        """
+        import threading
+
+        _LOCK = threading.Lock()
+        _COUNT = {"n": 0}
+
+        def _worker():
+            with _LOCK:
+                _COUNT["n"] += 1
+
+        def start():
+            threading.Thread(target=_worker, name="bg-worker", daemon=True).start()
+
+        def bump():
+            _COUNT["n"] += 1
+        """,
+    )
+    assert _rules(findings) == ["TMR-UNLOCKED"]
+    (f,) = findings
+    assert f.symbol == 'mod._COUNT[n]'
+    assert "bg-worker" in f.message and "user" in f.message
+
+
+def test_unlocked_clean_twin(tmp_path):
+    """Same shape, but every write holds the lock -> clean."""
+    findings = _race_snippet(
+        tmp_path,
+        """
+        import threading
+
+        _LOCK = threading.Lock()
+        _COUNT = {"n": 0}
+
+        def _worker():
+            with _LOCK:
+                _COUNT["n"] += 1
+
+        def start():
+            threading.Thread(target=_worker, name="bg-worker", daemon=True).start()
+
+        def bump():
+            with _LOCK:
+                _COUNT["n"] += 1
+        """,
+    )
+    assert findings == []
+
+
+def test_unlocked_single_role_not_flagged(tmp_path):
+    """No second thread role -> no interleaving -> no finding."""
+    findings = _race_snippet(
+        tmp_path,
+        """
+        _COUNT = {"n": 0}
+
+        def bump():
+            _COUNT["n"] += 1
+        """,
+    )
+    assert findings == []
+
+
+def test_unlocked_atomic_idioms_not_flagged(tmp_path):
+    """The documented GIL-atomic idioms: plain store, deque.append with
+    maxlen, set.add — lock-free by design, never findings."""
+    findings = _race_snippet(
+        tmp_path,
+        """
+        import threading
+        from collections import deque
+
+        _RING = deque(maxlen=8)
+        _SEEN = set()
+        _LAST = None
+
+        def _worker():
+            global _LAST
+            _RING.append(1)
+            _SEEN.add("k")
+            _LAST = 2
+
+        def start():
+            threading.Thread(target=_worker, name="bg", daemon=True).start()
+
+        def record(x):
+            global _LAST
+            _RING.append(x)
+            _SEEN.add(x)
+            _LAST = x
+        """,
+    )
+    assert findings == []
+
+
+def test_unlocked_subscript_refinement(tmp_path):
+    """Disjoint const-key counters governed by different locks must not alias
+    into one racy target (the IngestQueue.stats pattern)."""
+    findings = _race_snippet(
+        tmp_path,
+        """
+        import threading
+
+        _A = threading.Lock()
+        _B = threading.Lock()
+        _STATS = {"in": 0, "out": 0}
+
+        def _worker():
+            with _B:
+                _STATS["out"] += 1
+
+        def start():
+            threading.Thread(target=_worker, name="bg", daemon=True).start()
+
+        def admit():
+            with _A:
+                _STATS["in"] += 1
+        """,
+    )
+    assert findings == []
+
+
+# ----------------------------------------------------------------- TMR-ORDER
+
+
+def test_order_cycle_bad(tmp_path):
+    findings = _race_snippet(
+        tmp_path,
+        """
+        import threading
+
+        _A = threading.Lock()
+        _B = threading.Lock()
+
+        def fwd():
+            with _A:
+                with _B:
+                    pass
+
+        def rev():
+            with _B:
+                with _A:
+                    pass
+        """,
+    )
+    assert _rules(findings) == ["TMR-ORDER"]
+    (f,) = findings
+    assert f.symbol == "mod._A->mod._B->mod._A"
+
+
+def test_order_consistent_clean_twin(tmp_path):
+    findings = _race_snippet(
+        tmp_path,
+        """
+        import threading
+
+        _A = threading.Lock()
+        _B = threading.Lock()
+
+        def fwd():
+            with _A:
+                with _B:
+                    pass
+
+        def also_fwd():
+            with _A:
+                with _B:
+                    pass
+        """,
+    )
+    assert findings == []
+
+
+def test_order_interprocedural_cycle(tmp_path):
+    """The cycle only exists across call edges: each function takes one lock
+    directly and reaches the other through a callee."""
+    findings = _race_snippet(
+        tmp_path,
+        """
+        import threading
+
+        _A = threading.Lock()
+        _B = threading.Lock()
+
+        def take_a():
+            with _A:
+                pass
+
+        def take_b():
+            with _B:
+                pass
+
+        def fwd():
+            with _A:
+                take_b()
+
+        def rev():
+            with _B:
+                take_a()
+        """,
+    )
+    assert "TMR-ORDER" in _rules(findings)
+
+
+def test_order_rlock_reentry_exempt(tmp_path):
+    findings = _race_snippet(
+        tmp_path,
+        """
+        import threading
+
+        _R = threading.RLock()
+
+        def outer():
+            with _R:
+                inner()
+
+        def inner():
+            with _R:
+                pass
+        """,
+    )
+    assert findings == []
+
+
+# ------------------------------------------------------------- TMR-HOLD-HOST
+
+
+def test_hold_host_bad(tmp_path):
+    findings = _race_snippet(
+        tmp_path,
+        """
+        import os
+        import threading
+
+        _LOCK = threading.Lock()
+
+        def scan(d):
+            with _LOCK:
+                names = os.listdir(d)
+            return names
+        """,
+    )
+    assert _rules(findings) == ["TMR-HOLD-HOST"]
+    (f,) = findings
+    assert f.symbol == "scan" and f.line == 9
+
+
+def test_hold_host_clean_twin(tmp_path):
+    """Disk read before the lock, only the assignment inside -> clean."""
+    findings = _race_snippet(
+        tmp_path,
+        """
+        import os
+        import threading
+
+        _LOCK = threading.Lock()
+        _CACHE = {}
+
+        def scan(d):
+            names = os.listdir(d)
+            with _LOCK:
+                _CACHE["names"] = names
+            return names
+        """,
+    )
+    assert findings == []
+
+
+def test_hold_host_through_call(tmp_path):
+    """Blocking IO reached through a private helper whose every caller holds
+    the lock (held-at-entry inference, no annotation needed)."""
+    findings = _race_snippet(
+        tmp_path,
+        """
+        import os
+        import threading
+
+        _LOCK = threading.Lock()
+
+        def _read(d):
+            return os.listdir(d)
+
+        def scan(d):
+            with _LOCK:
+                return _read(d)
+        """,
+    )
+    assert "TMR-HOLD-HOST" in _rules(findings)
+
+
+# --------------------------------------------------------------- TMR-HANDLER
+
+
+def test_handler_blocking_lock_bad(tmp_path):
+    findings = _race_snippet(
+        tmp_path,
+        """
+        import atexit
+        import threading
+
+        _LOCK = threading.Lock()
+        _STATE = {"dumps": 0}
+
+        def _on_exit():
+            with _LOCK:
+                _STATE["dumps"] += 1
+
+        atexit.register(_on_exit)
+        """,
+    )
+    assert _rules(findings) == ["TMR-HANDLER"]
+    assert all(f.symbol == "_on_exit" for f in findings)
+    # both hazards: the blocking acquire AND the non-atomic mutation
+    assert any("blocking acquire" in f.message for f in findings)
+    assert any("non-atomic mutation" in f.message for f in findings)
+
+
+def test_handler_trylock_clean_twin(tmp_path):
+    """acquire(blocking=False) + lock-free fallback: the sanctioned pattern
+    (the obs/flight.py dump path)."""
+    findings = _race_snippet(
+        tmp_path,
+        """
+        import atexit
+        import threading
+
+        _LOCK = threading.Lock()
+        _SOURCES = []
+
+        def _on_exit():
+            if _LOCK.acquire(blocking=False):
+                try:
+                    objs = [r for r in _SOURCES]
+                finally:
+                    _LOCK.release()
+            else:
+                objs = list(_SOURCES)
+            return objs
+
+        atexit.register(_on_exit)
+        """,
+    )
+    assert findings == []
+
+
+def test_handler_reachable_through_signal_install(tmp_path):
+    """The hazard sits one call away from the installed signal handler."""
+    findings = _race_snippet(
+        tmp_path,
+        """
+        import signal
+        import threading
+
+        _LOCK = threading.Lock()
+
+        def _flush():
+            with _LOCK:
+                pass
+
+        def _on_signal(signum, frame):
+            _flush()
+
+        def install():
+            signal.signal(signal.SIGTERM, _on_signal)
+        """,
+    )
+    assert _rules(findings) == ["TMR-HANDLER"]
+    assert findings[0].symbol == "_flush"
+
+
+# ------------------------------------------------------------------ TMR-LEAK
+
+
+def test_leak_bad(tmp_path):
+    findings = _race_snippet(
+        tmp_path,
+        """
+        import threading
+
+        def start(fn):
+            threading.Thread(target=fn).start()
+        """,
+    )
+    assert _rules(findings) == ["TMR-LEAK"]
+
+
+def test_leak_daemon_clean_twin(tmp_path):
+    findings = _race_snippet(
+        tmp_path,
+        """
+        import threading
+
+        def start(fn):
+            threading.Thread(target=fn, daemon=True).start()
+        """,
+    )
+    assert findings == []
+
+
+def test_leak_joined_clean_twin(tmp_path):
+    findings = _race_snippet(
+        tmp_path,
+        """
+        import threading
+
+        def run(fn):
+            t = threading.Thread(target=fn)
+            t.start()
+            t.join()
+        """,
+    )
+    assert findings == []
+
+
+# ------------------------------------------------- annotations & role model
+
+
+def test_locked_by_annotation_governs(tmp_path):
+    """@locked_by supplies the caller-holds contract a public entry point
+    cannot get from inference; without it the same code is a finding."""
+    src = """
+        import threading
+        from metrics_tpu.utils.concurrency import locked_by, thread_role
+
+        _LOCK = threading.Lock()
+        _STATS = {"n": 0}
+
+        @thread_role("bg")
+        def loop():
+            with _LOCK:
+                bump()
+
+        __DECORATOR__
+        def bump():
+            _STATS["n"] += 1
+        """
+    annotated = _race_snippet(tmp_path, src.replace("__DECORATOR__", '@locked_by("mod._LOCK")'))
+    assert annotated == []
+    bare = _race_snippet(tmp_path, src.replace("__DECORATOR__", "@thread_role()"))
+    assert _rules(bare) == ["TMR-UNLOCKED"]
+
+
+def test_thread_role_annotation_creates_role(tmp_path):
+    """A @thread_role entry point (the prom-handler pattern: spawned by
+    machinery the analyzer cannot see) supplies the second racing role."""
+    findings = _race_snippet(
+        tmp_path,
+        """
+        from metrics_tpu.utils.concurrency import thread_role
+
+        _TOTALS = {"hits": 0}
+
+        @thread_role("handler")
+        def on_request():
+            _TOTALS["hits"] += 1
+
+        def reset():
+            _TOTALS["hits"] = len([])
+        """,
+    )
+    assert "TMR-UNLOCKED" in _rules(findings)
+
+
+def test_annotation_decorators_are_runtime_noops():
+    from metrics_tpu.utils.concurrency import locked_by, thread_role
+
+    @thread_role("a", "b")
+    @locked_by("X._lock")
+    def fn():
+        return 41 + 1
+
+    assert fn() == 42
+    assert fn.__thread_roles__ == ("a", "b")
+    assert fn.__locked_by__ == ("X._lock",)
+
+
+def test_repo_thread_role_model():
+    """The linked model must discover the runtime's actual thread roles."""
+    from metrics_tpu.analysis.jitmap import load_package
+
+    files = load_package(str(REPO_ROOT / "metrics_tpu"), str(REPO_ROOT))
+    model = build_model(files)
+    roles = set()
+    for _m, func in model.all_functions():
+        roles |= func.roles
+    assert {
+        "user", "tm-ingest", "metrics-tpu-ckpt", "tmscope-sampler",
+        "prom-handler", "signal", "atexit", "excepthook",
+    } <= roles
+    # the locks the serving runtime is built on must all be in the model
+    for lock_id in (
+        "IngestQueue._tick_lock", "Ring._lock", "manager._INFLIGHT_LOCK",
+        "manager._PENDING_LOCK", "flight._LOCK", "excache._LOCK",
+        "TelemetrySampler._lock",
+    ):
+        assert lock_id in model.locks, f"missing lock {lock_id}"
+
+
+# ----------------------------------------------- three-tier waiver scoping
+
+
+def test_waiver_scoping_partitions_staleness():
+    """Satellite contract: each tier ignores the other tiers' waivers when
+    checking staleness — a TMR waiver is never 'stale' to tmlint/tmsan."""
+    from metrics_tpu.analysis import baseline as baseline_mod
+    from metrics_tpu.analysis.findings import LINT_RULES, RACE_RULES, SAN_RULES
+
+    waivers = {
+        ("TM-HOSTSYNC", "a.py", "f"): "lint reason",
+        ("TMS-F64", "b.py", "g"): "san reason",
+        ("TMR-ORDER", "c.py", "x->y->x"): "race reason",
+    }
+    race_scope = baseline_mod.scope_waivers(waivers, RACE_RULES)
+    assert set(race_scope) == {("TMR-ORDER", "c.py", "x->y->x")}
+    # a race run with zero findings: only the race-scoped waiver can be stale
+    _new, unused = baseline_mod.apply_baseline([], race_scope)
+    assert unused == [("TMR-ORDER", "c.py", "x->y->x")]
+    assert set(baseline_mod.scope_waivers(waivers, LINT_RULES)) == {
+        ("TM-HOSTSYNC", "a.py", "f")
+    }
+    assert set(baseline_mod.scope_waivers(waivers, SAN_RULES)) == {
+        ("TMS-F64", "b.py", "g")
+    }
+
+
+# ----------------------------------------------------------- repo-wide guard
+
+
+def test_tmrace_no_new_findings():
+    """The whole package must be race-clean against the checked-in baseline,
+    with every waiver carrying a reason and none stale."""
+    report = run_race(
+        str(REPO_ROOT / "metrics_tpu"),
+        baseline_path=str(REPO_ROOT / BASELINE_FILENAME),
+    )
+    assert report.parse_errors == {}
+    msgs = "\n".join(f.format() for f in report.new_findings)
+    assert not report.new_findings, f"new tmrace findings:\n{msgs}"
+    assert not report.unused_waivers, f"stale baseline waivers: {report.unused_waivers}"
+    for f in report.waived:
+        assert f.waive_reason, f"waiver without a reason covers {f.key()}"
+    # the ISSUE's cold-wall budget is 60s on CPU; the AST sweep is ~100x under
+    assert report.stats["seconds"] < 60
+
+
+# ------------------------------------------------------------- CLI end-to-end
+
+
+_CLI_ENV = {"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu", "PYTHONPATH": str(REPO_ROOT)}
+
+
+def _run_cli(pkg, tmp_path):
+    return subprocess.run(
+        [sys.executable, "-m", "metrics_tpu.analysis", "--race", str(pkg)],
+        capture_output=True, text=True, timeout=120, env=_CLI_ENV, cwd=str(tmp_path),
+    )
+
+
+@pytest.mark.smoke
+def test_cli_order_cycle_regression(tmp_path):
+    """Acceptance regression: a seeded lock-order cycle must fail the build
+    end-to-end (exit 1, rule named); the consistent twin passes."""
+    pkg = tmp_path / "toypkg"
+    pkg.mkdir()
+    cyclic = textwrap.dedent(
+        """
+        import threading
+
+        _A = threading.Lock()
+        _B = threading.Lock()
+
+        def fwd():
+            with _A:
+                with _B:
+                    pass
+
+        def rev():
+            with _B:
+                with _A:
+                    pass
+        """
+    )
+    (pkg / "mod.py").write_text(cyclic)
+    result = _run_cli(pkg, tmp_path)
+    assert result.returncode == 1, result.stdout + result.stderr
+    assert "TMR-ORDER" in result.stdout
+
+    (pkg / "mod.py").write_text(cyclic.replace("with _B:\n        with _A:", "with _A:\n        with _B:"))
+    result = _run_cli(pkg, tmp_path)
+    assert result.returncode == 0, result.stdout + result.stderr
+
+
+@pytest.mark.smoke
+def test_cli_unlocked_mutation_regression(tmp_path):
+    """Acceptance regression: a seeded unlocked cross-role mutation must fail
+    the build end-to-end (exit 1, rule named)."""
+    pkg = tmp_path / "toypkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        textwrap.dedent(
+            """
+            import threading
+
+            _LOCK = threading.Lock()
+            _COUNT = {"n": 0}
+
+            def _worker():
+                with _LOCK:
+                    _COUNT["n"] += 1
+
+            def start():
+                threading.Thread(target=_worker, name="bg", daemon=True).start()
+
+            def bump():
+                _COUNT["n"] += 1
+            """
+        )
+    )
+    result = _run_cli(pkg, tmp_path)
+    assert result.returncode == 1, result.stdout + result.stderr
+    assert "TMR-UNLOCKED" in result.stdout

@@ -154,6 +154,36 @@ def test_flight_dump_roundtrip(tmp_path):
     assert payload["state_reports"][0]["metric"] == "StreamMean"
 
 
+def test_flight_dump_never_blocks_on_held_lock(tmp_path):
+    """tmrace TMR-HANDLER regression: dump runs from signal/atexit/excepthook
+    context, where the preempted thread may be parked *inside*
+    ``note_state_source`` holding ``_LOCK`` forever. The dump must still
+    complete (try-lock + lock-free snapshot fallback), not deadlock."""
+    obs.flight.enable(capacity=8)
+    m = StreamMean()
+    m.update(jnp.ones(3))
+    obs.flight.note_state_source(m)
+    path = str(tmp_path / "flight.json")
+
+    assert obs_flight._LOCK.acquire(timeout=5)  # the "stalled thread"
+    try:
+        result = {}
+        t = threading.Thread(
+            target=lambda: result.setdefault("path", obs.flight.dump(path)),
+            daemon=True,
+        )
+        t.start()
+        t.join(timeout=10)
+        assert not t.is_alive(), "dump blocked on _LOCK held by a stalled thread"
+    finally:
+        obs_flight._LOCK.release()
+    assert result["path"] == path
+    payload = json.loads(open(path).read())
+    # the lock-free fallback still resolves the registered state sources
+    assert payload["state_reports"]
+    assert payload["state_reports"][0]["metric"] == "StreamMean"
+
+
 def test_flight_dump_never_raises(tmp_path):
     obs.flight.enable(capacity=4)
     assert obs.flight.dump(str(tmp_path / "no-such-dir" / "x.json")) is None
