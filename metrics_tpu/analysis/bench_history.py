@@ -41,7 +41,9 @@ LEGACY_BACKEND = "tpu"
 #: headline ``ingest_sustained_enqueue`` value gates higher-is-better via its
 #: ``Kenq/s`` unit, so both directions of ISSUE 13 are covered)
 GATED_SPLIT_FIELDS = ("sort_ms", "post_sort_ms", "layout_sort_ms", "scan_ms",
-                      "tick_p50_ms", "coldstart_prewarmed_ms")
+                      "tick_p50_ms", "coldstart_prewarmed_ms",
+                      "flow_untraced_p50_ms", "flow_traced_p50_ms",
+                      "flow_sampled_p50_ms")
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
 

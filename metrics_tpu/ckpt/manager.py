@@ -593,6 +593,20 @@ def save_checkpoint(
         # the post-mortem wants the state layout of whatever was being saved
         _obs_flight.note_state_source(obj)
         _obs_flight.record("ckpt_save_begin", step=step, host=rank, blocking=blocking)
+        # flow containment: the committed checkpoint's flight dump names the
+        # flows (tmflow, obs/flow.py) whose rows it captured — everything
+        # closed against this target since the previous save's drain
+        _flow_mod = sys.modules.get("metrics_tpu.obs.flow")
+        if _flow_mod is not None and _flow_mod.active():
+            flow_ids = _flow_mod.drain_for_ckpt(obj)
+            if flow_ids:
+                _obs_flight.record(
+                    "ckpt_flows",
+                    step=step,
+                    host=rank,
+                    count=len(flow_ids),
+                    flows=flow_ids[-64:],
+                )
     handle = CheckpointWrite(directory, step)
     snap: Optional[_PendingSnapshot] = None
     if not blocking:

@@ -30,6 +30,7 @@ This module is imported lazily from ``core.metric`` (no import cycle); it
 reuses the fused engine's input split / donation helpers (``core.fused``).
 """
 import sys
+import time
 import weakref
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -39,6 +40,7 @@ import numpy as np
 
 from metrics_tpu.fault import inject as _fault
 from metrics_tpu.obs import flight as _obs_flight
+from metrics_tpu.obs import flow as _obs_flow
 from metrics_tpu.obs import registry as _obs
 from metrics_tpu.utils.exceptions import MetricsUserError
 
@@ -369,6 +371,9 @@ def run_step(
     if compiled is _BROKEN:
         return step(state, *extras)
     if compiled is None:
+        trc = _obs_flow._TRACER if _obs._ENABLED else None
+        fl = _obs_flow.current() if trc is not None else None
+        t_compile = time.perf_counter()
         try:
             if _fault._SCHEDULE is not None:
                 _fault.fire("fleet.compile", tag=tag, metric=type(metric).__name__)
@@ -385,6 +390,7 @@ def run_step(
                         tag=tag,
                         metric=type(metric).__name__,
                         error=f"{type(err).__name__}: {str(err).splitlines()[0][:120]}",
+                        **({} if fl is None else {"flow_id": fl.flow_id}),
                     )
             _fused._warn_degrade_once(
                 "fleet.compile",
@@ -392,7 +398,11 @@ def run_step(
                 f"the {tag} step for this signature runs un-jitted (eager,"
                 " no donation) from now on.",
             )
+            if fl is not None:
+                fl.degraded = True
             return step(state, *extras)
+        if fl is not None:
+            trc.add_compile([fl], (time.perf_counter() - t_compile) * 1e6)
         cache[key] = compiled
         # warm-manifest recording: compile is the cold path, so the
         # sys.modules probe costs the steady state nothing
@@ -417,8 +427,32 @@ def run_step(
 def apply_update(metric: Any, raw_update: Callable, args: Tuple, kwargs: Dict) -> None:
     """The fleet body of ``Metric._wrap_update``: pop ``stream_ids``, route or
     broadcast in one launch, and re-point the live state at the result."""
+    trc = _obs_flow._TRACER if _obs._ENABLED else None
+    fl = (
+        trc.open_sync(f"fleet/{type(metric).__name__}", id(metric), args, kwargs)
+        if trc is not None
+        else None
+    )
+    try:
+        _apply_update(metric, raw_update, args, kwargs, trc, fl)
+    finally:
+        if fl is not None:
+            trc.close_sync(fl)
+
+
+def _apply_update(
+    metric: Any,
+    raw_update: Callable,
+    args: Tuple,
+    kwargs: Dict,
+    trc: Optional["_obs_flow.FlowTracer"],
+    fl: Optional[Any],
+) -> None:
     from metrics_tpu.core import fused as _fused
 
+    cur = _obs_flow.current() if trc is not None else None
+    if cur is not None and cur.t_launch is None:
+        trc.stamp_launch([cur])
     kwargs = dict(kwargs)
     stream_ids = kwargs.pop("stream_ids", None)
     state = {name: getattr(metric, name) for name in metric._defaults}
@@ -449,6 +483,7 @@ def apply_update(metric: Any, raw_update: Callable, args: Tuple, kwargs: Dict) -
                     mode="broadcast",
                     rows=_batch_rows(dyn),
                     streams=metric.fleet_size,
+                    **({} if cur is None else {"flow_id": cur.flow_id}),
                 )
     else:
         ids = jnp.asarray(stream_ids)
@@ -484,6 +519,10 @@ def apply_update(metric: Any, raw_update: Callable, args: Tuple, kwargs: Dict) -
             _obs.REGISTRY.inc("fleet", "routed", int(ids.shape[0]))
             if _is_concrete(ids):
                 _obs.REGISTRY.inc("fleet", "streams", int(np.unique(np.asarray(ids)).size))
+            if cur is not None:
+                # per-tenant attribution: merge the streams this launch
+                # actually routed onto the covering flow
+                trc.attribute_streams(cur, _obs_flow.host_stream_ids(ids))
             if _obs_flight._RING is not None:
                 _obs_flight.record(
                     "fleet_route",
@@ -491,8 +530,12 @@ def apply_update(metric: Any, raw_update: Callable, args: Tuple, kwargs: Dict) -
                     mode="routed",
                     rows=int(ids.shape[0]),
                     streams=metric.fleet_size,
+                    **({} if cur is None else {"flow_id": cur.flow_id}),
                 )
     metric._load_state(new)
+    if fl is not None and not fl.dispatched:
+        # a flow minted here is owned here: hand it to the completion watcher
+        trc.dispatch([fl], jax.tree_util.tree_leaves(new))
 
 
 # ------------------------------------------------------------ tmsan entries

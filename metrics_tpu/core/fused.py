@@ -48,6 +48,7 @@ for the per-step launch count.
 import functools
 import hashlib
 import sys
+import time
 import warnings
 import weakref
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -59,6 +60,7 @@ from metrics_tpu.core.metric import Metric
 from metrics_tpu.core.state import CatBuffer
 from metrics_tpu.fault import inject as _fault
 from metrics_tpu.obs import flight as _obs_flight
+from metrics_tpu.obs import flow as _obs_flow
 from metrics_tpu.obs import recompile as _obs_recompile
 from metrics_tpu.obs import registry as _obs
 from metrics_tpu.obs import scopes as _obs_scopes
@@ -307,7 +309,12 @@ class FusedCollectionUpdate:
         }
 
     def _record_degrade(
-        self, site: str, err: Exception, groups: List[str], mode: str
+        self,
+        site: str,
+        err: Exception,
+        groups: List[str],
+        mode: str,
+        flow_id: Optional[str] = None,
     ) -> None:
         """Attribute one fused->eager demotion (obs counter + flight event)."""
         self.stats["degrades"] += 1
@@ -320,6 +327,7 @@ class FusedCollectionUpdate:
                     groups=groups,
                     mode=mode,
                     error=f"{type(err).__name__}: {str(err).splitlines()[0][:120]}",
+                    **({} if flow_id is None else {"flow_id": flow_id}),
                 )
 
     # ---------------------------------------------------------- partition
@@ -548,6 +556,12 @@ class FusedCollectionUpdate:
     ) -> Tuple[List[Tuple[str, Tuple[str, ...]]], List[List[str]], Dict[str, Any]]:
         """Compile-or-reuse, donate, execute, re-point. Returns
         (fused groups actually launched, demoted groups, member results)."""
+        trc = _obs_flow._TRACER if _obs._ENABLED else None
+        fl = _obs_flow.current() if trc is not None else None
+        if fl is not None and fl.t_launch is None:
+            # a flow re-entering from an ingest degrade keeps its original
+            # launch stamp; a fresh synchronous flow starts its launch here
+            trc.stamp_launch([fl])
         dyn, split_spec = _split_inputs(args, kwargs)
         topo = tuple((name, members, id(collection._modules[name])) for name, members in fused)
         states = self._gather_states(collection, fused)
@@ -575,6 +589,7 @@ class FusedCollectionUpdate:
                         "fused_cache_miss",
                         groups=[name for name, _ in fused],
                         mode="forward" if forward else "update",
+                        **({} if fl is None else {"flow_id": fl.flow_id}),
                     )
             self.stats["cache_misses"] += 1
             fused, demoted = self._probe(collection, fused, states, dyn, split_spec, forward)
@@ -596,6 +611,7 @@ class FusedCollectionUpdate:
                 _aval_key(dyn),
                 _static_key(split_spec),
             )
+            t_compile = time.perf_counter()
             try:
                 compiled = self._compile(
                     collection, fused, states, fresh, dyn, split_spec, forward
@@ -607,6 +623,7 @@ class FusedCollectionUpdate:
                     err,
                     [name for name, _ in fused],
                     "forward" if forward else "update",
+                    flow_id=None if fl is None else fl.flow_id,
                 )
                 _warn_degrade_once(
                     "fused.compile",
@@ -614,6 +631,8 @@ class FusedCollectionUpdate:
                     "this input signature stays on the eager path.",
                 )
                 return [], demoted + [list(m) for _, m in fused], {}
+            if fl is not None:
+                trc.add_compile([fl], (time.perf_counter() - t_compile) * 1e6)
             self._cache[key] = compiled
             # warm-manifest recording (serve/excache.py): compile is the cold
             # path, so a sys.modules probe here costs the steady state nothing
@@ -658,6 +677,7 @@ class FusedCollectionUpdate:
                         groups=[name for name, _ in fused],
                         mode="forward" if forward else "update",
                         cache_key=f"{key[0]}:{fused_key_digest(key)}",
+                        **({} if fl is None else {"flow_id": fl.flow_id}),
                     )
                 with _obs_scopes.annotate("tm.fused/step"):
                     if forward:
@@ -677,7 +697,12 @@ class FusedCollectionUpdate:
             self._broken_keys.add(key)
             groups = [name for name, _ in fused]
             mode = "forward" if forward else "update"
-            self._record_degrade("fused.launch", err, groups, mode)
+            self._record_degrade(
+                "fused.launch", err, groups, mode,
+                flow_id=None if fl is None else fl.flow_id,
+            )
+            if fl is not None:
+                fl.degraded = True
             _warn_degrade_once(
                 "fused.launch",
                 err,
@@ -689,6 +714,11 @@ class FusedCollectionUpdate:
             for name, _ in fused:
                 collection._modules[name]._load_state(states[name])
             return [], demoted + [list(m) for _, m in fused], {}
+
+        if fl is not None and fl.sync and not fl.dispatched:
+            # synchronous flows are owned here: hand off to the completion
+            # watcher (ingest-minted flows are dispatched by their tick)
+            trc.dispatch([fl], jax.tree_util.tree_leaves(new_states))
 
         # re-point live leader state at the donated-in-place output buffers
         for name, _ in fused:
@@ -702,49 +732,73 @@ class FusedCollectionUpdate:
 
     def update(self, collection: Any, *args: Any, **kwargs: Any) -> None:
         """One fused accumulation step (plus eager fallback groups)."""
-        fused, eager, _ = self._partition(collection, forward=False)
-        for name, _members in fused:
-            _check_update_arity(name, collection._modules[name], args)
-        if fused:
-            _launched, demoted, _ = self._launch(collection, fused, args, kwargs, forward=False)
-            eager = eager + demoted
-        if eager:
-            self.stats["fallback_groups"] += len(eager)
-            if _obs._ENABLED:
-                _obs.REGISTRY.inc("fused", "fallbacks", len(eager))
-            for cg in eager:
-                m0 = collection._modules[cg[0]]
-                m0.update(*args, **m0._filter_kwargs(**kwargs))
-        collection._state_is_copy = False
-        collection._compute_groups_create_state_ref()
+        trc = _obs_flow._TRACER if _obs._ENABLED else None
+        fl = (
+            trc.open_sync(
+                f"fused/{type(collection).__name__}", id(collection), args, kwargs
+            )
+            if trc is not None
+            else None
+        )
+        try:
+            fused, eager, _ = self._partition(collection, forward=False)
+            for name, _members in fused:
+                _check_update_arity(name, collection._modules[name], args)
+            if fused:
+                _launched, demoted, _ = self._launch(collection, fused, args, kwargs, forward=False)
+                eager = eager + demoted
+            if eager:
+                self.stats["fallback_groups"] += len(eager)
+                if _obs._ENABLED:
+                    _obs.REGISTRY.inc("fused", "fallbacks", len(eager))
+                for cg in eager:
+                    m0 = collection._modules[cg[0]]
+                    m0.update(*args, **m0._filter_kwargs(**kwargs))
+            collection._state_is_copy = False
+            collection._compute_groups_create_state_ref()
+        finally:
+            if fl is not None:
+                trc.close_sync(fl)
 
     def forward(self, collection: Any, *args: Any, **kwargs: Any) -> Dict[str, Any]:
         """One fused dual-purpose step: accumulate AND return batch values."""
-        res: Dict[str, Any] = {}
-        fused, eager, _ = self._partition(collection, forward=True)
-        for name, _members in fused:
-            _check_update_arity(name, collection._modules[name], args)
-        if fused:
-            launched, demoted, results = self._launch(collection, fused, args, kwargs, forward=True)
-            eager = eager + demoted
-            for name, members in launched:
-                for member_name in members:
-                    mi = collection._modules[member_name]
-                    val = _squeeze_if_scalar(results[member_name])
-                    mi._forward_cache = val
-                    mi._computed = None
-                    res[member_name] = val
-        if eager:
-            self.stats["fallback_groups"] += len(eager)
-            if _obs._ENABLED:
-                _obs.REGISTRY.inc("fused", "fallbacks", len(eager))
-            for cg in eager:
-                for name in cg:
-                    m = collection._modules[name]
-                    res[name] = m(*args, **m._filter_kwargs(**kwargs))
-        collection._state_is_copy = False
-        collection._compute_groups_create_state_ref()
-        return res
+        trc = _obs_flow._TRACER if _obs._ENABLED else None
+        fl = (
+            trc.open_sync(
+                f"fused/{type(collection).__name__}", id(collection), args, kwargs
+            )
+            if trc is not None
+            else None
+        )
+        try:
+            res: Dict[str, Any] = {}
+            fused, eager, _ = self._partition(collection, forward=True)
+            for name, _members in fused:
+                _check_update_arity(name, collection._modules[name], args)
+            if fused:
+                launched, demoted, results = self._launch(collection, fused, args, kwargs, forward=True)
+                eager = eager + demoted
+                for name, members in launched:
+                    for member_name in members:
+                        mi = collection._modules[member_name]
+                        val = _squeeze_if_scalar(results[member_name])
+                        mi._forward_cache = val
+                        mi._computed = None
+                        res[member_name] = val
+            if eager:
+                self.stats["fallback_groups"] += len(eager)
+                if _obs._ENABLED:
+                    _obs.REGISTRY.inc("fused", "fallbacks", len(eager))
+                for cg in eager:
+                    for name in cg:
+                        m = collection._modules[name]
+                        res[name] = m(*args, **m._filter_kwargs(**kwargs))
+            collection._state_is_copy = False
+            collection._compute_groups_create_state_ref()
+            return res
+        finally:
+            if fl is not None:
+                trc.close_sync(fl)
 
 
 #: engines keyed weakly by collection: the collection itself stays free of

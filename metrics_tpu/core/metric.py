@@ -42,6 +42,7 @@ from jax import Array
 from metrics_tpu.core.state import CatBuffer, cat_merge
 from metrics_tpu.fault import inject as _fault
 from metrics_tpu.obs import flight as _obs_flight
+from metrics_tpu.obs import flow as _obs_flow
 from metrics_tpu.obs import recompile as _obs_recompile
 from metrics_tpu.obs import registry as _obs
 from metrics_tpu.obs import scopes as _obs_scopes
@@ -615,7 +616,13 @@ class Metric(ABC):
                 # ROADMAP item 4).
                 _obs.REGISTRY.inc(name, "dispatches")
                 if _obs_flight._RING is not None:
-                    _obs_flight.record_dispatch(name, args, kwargs)
+                    # correlate the dispatch with the covering tmflow flow (if
+                    # any); None keeps the event byte-identical to v1 dumps
+                    cur = _obs_flow.current() if _obs_flow._TRACER is not None else None
+                    _obs_flight.record_dispatch(
+                        name, args, kwargs,
+                        flow_id=None if cur is None else cur.flow_id,
+                    )
                 _obs_recompile.check_update(self, args, kwargs)
                 with _obs_scopes.update_scope(name):
                     run()

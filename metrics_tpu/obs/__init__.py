@@ -30,6 +30,13 @@ tmscope — continuous monitoring on the same gate::
     obs.prom.start_server(port=9464)     # GET /metrics, Prometheus text format
     obs.aggregate.fleet_snapshot()       # cross-host merge (sketch-exact p99s)
 
+tmflow — causal request tracing on the same gate::
+
+    obs.flow.enable(sample_rate=1)       # flow IDs: enqueue -> tick -> device
+    queue.enqueue(preds, target)         # traced end to end, per-tenant
+    obs.export_spans("/tmp/spans.jsonl") # OTLP-shaped spans; the chrome-trace
+                                         # export grows flow arrows too
+
 Off by default: with obs disabled every instrumented hot path reduces to a
 single boolean check (see ``registry.py``), keeping the library's measured
 throughput identical to the uninstrumented build — and none of the tmprof
@@ -51,10 +58,11 @@ from metrics_tpu.obs.registry import (
 # `obs.trace` to the XProf capture contextmanager (the documented public name).
 # The exporter stays reachable as `obs.export_chrome_trace` / via
 # `metrics_tpu.obs import trace as trace_export`.
-from metrics_tpu.obs import aggregate, costcheck, flight, health, prom, recompile, registry, ring, series
+from metrics_tpu.obs import aggregate, costcheck, flight, flow, health, prom, recompile, registry, ring, series
 from metrics_tpu.obs.ring import Ring
 from metrics_tpu.obs import trace as _trace_export
 from metrics_tpu.obs.costcheck import CostDriftWarning, crosscheck
+from metrics_tpu.obs.flow import export_spans, validate_spans
 from metrics_tpu.obs.export import SCHEMA_VERSION, dump_jsonl, validate_snapshot
 from metrics_tpu.obs.export import snapshot as export_snapshot
 from metrics_tpu.obs.health import (
@@ -112,8 +120,10 @@ __all__ = [
     "enabled",
     "export_chrome_trace",
     "export_snapshot",
+    "export_spans",
     "fingerprint",
     "flight",
+    "flow",
     "forward_scope",
     "health",
     "metric_state_report",
@@ -133,4 +143,5 @@ __all__ = [
     "update_scope",
     "validate_chrome_trace",
     "validate_snapshot",
+    "validate_spans",
 ]

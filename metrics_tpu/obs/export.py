@@ -11,7 +11,9 @@ tests and CI run against it):
 - ``schema_version``: integer stamp, bumped on breaking layout changes so
   downstream dashboards can evolve safely. Version history: 1 = the original
   ``{enabled, registry}`` pair; 2 added ``schema_version`` + ``enabled_now``
-  and fixed ``enabled`` to describe the *recorded* counters.
+  and fixed ``enabled`` to describe the *recorded* counters; 3 added the
+  optional ``flows`` stats object emitted while ``obs.flow`` is tracing
+  (``validate_snapshot`` accepts every prior version — v3 only adds fields).
 - ``enabled``: the gate state in effect for the counters in this line. A
   scoped ``observe()`` window that recorded counters and then exited leaves
   the instantaneous gate off while the snapshot is full of enabled-mode data —
@@ -26,11 +28,13 @@ from typing import Any, Dict, Optional
 from metrics_tpu.obs import registry as _reg
 
 #: current layout stamp of exported lines (see module docstring for history)
-SCHEMA_VERSION = 2
+SCHEMA_VERSION = 3
 
 
 def snapshot(extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
     """Registry contents as one JSON-serializable dict (plus caller extras)."""
+    import sys
+
     enabled_now = _reg.enabled()
     out: Dict[str, Any] = {
         "schema_version": SCHEMA_VERSION,
@@ -38,6 +42,10 @@ def snapshot(extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
         "enabled_now": enabled_now,
         "registry": _reg.snapshot(),
     }
+    # tracing tier, on demand: the field only appears while a tracer is live
+    _flow = sys.modules.get("metrics_tpu.obs.flow")
+    if _flow is not None and _flow.active():
+        out["flows"] = _flow.stats()
     if extra:
         out.update(extra)
     return out
@@ -94,3 +102,10 @@ def validate_snapshot(record: Dict[str, Any]) -> None:
             )
     if "time_unix" in record and not isinstance(record["time_unix"], (int, float)):
         raise ValueError("`time_unix` must be numeric when present")
+    if "flows" in record:
+        flows = record["flows"]
+        if not isinstance(flows, dict):
+            raise ValueError("`flows` must be an object when present")
+        for name, value in flows.items():
+            if isinstance(value, bool) or not isinstance(value, (int, float)):
+                raise ValueError(f"flows[{name!r}] must be numeric, got {value!r}")

@@ -20,6 +20,13 @@ Event kinds emitted by the runtime (all behind the obs gate):
     ``merge``             one ``merge_state`` (sketch merges ride this hook)
     ``excache_prewarm``   one warm-manifest replay (entries/compiled/seconds)
     ``ckpt_save_begin`` / ``ckpt_save_commit`` / ``ckpt_restore``
+    ``flow_begin`` / ``flow_complete`` / ``flow_dropped`` / ``flow_readback``
+                          tmflow request-tracing lifecycle (obs/flow.py)
+    ``ckpt_flows``        flow IDs contained in a checkpoint being saved
+
+Correlation: events on a traced request path carry an optional ``flow_id``
+field (the tmflow trace id, ``obs/flow.py``); pre-flow events simply omit it
+— schema_version 2 of the dump admits both forms.
 
 Gating contract (the single-boolean rule of ``registry.py``): every call site
 lives inside an existing ``if registry._ENABLED:`` block and additionally
@@ -48,8 +55,11 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from metrics_tpu.obs.ring import Ring
 
-#: schema stamp of the dump file (bump on breaking layout changes)
-DUMP_SCHEMA_VERSION = 1
+#: schema stamp of the dump file (bump on breaking layout changes).
+#: History: 1 = original layout; 2 = flow-era dumps (``flow_*``/``ckpt_flows``
+#: event kinds, optional ``flow_id`` correlation field on request-path
+#: events). Readers accept both — v2 only *adds* fields and kinds.
+DUMP_SCHEMA_VERSION = 2
 
 #: the ring itself. ``None`` == recorder off == nothing allocated; hot paths
 #: gate on ``_RING is not None`` (one module-attribute load + identity check).
@@ -179,13 +189,23 @@ def _aval_str(x: Any) -> str:
     return type(x).__name__
 
 
-def record_dispatch(metric_name: str, args: Tuple, kwargs: Dict) -> None:
-    """One eager update dispatch, args summarized as avals (never values)."""
+def record_dispatch(
+    metric_name: str, args: Tuple, kwargs: Dict, flow_id: Optional[str] = None
+) -> None:
+    """One eager update dispatch, args summarized as avals (never values).
+
+    ``flow_id`` is the optional tmflow correlation id (``obs/flow.py``);
+    ``None`` — every pre-flow caller — keeps the event byte-identical to
+    schema_version 1 dumps.
+    """
     if _RING is None:
         return
     avals = [_aval_str(a) for a in args]
     avals += [f"{k}={_aval_str(v)}" for k, v in kwargs.items()]
-    record("dispatch", metric=metric_name, avals=avals)
+    if flow_id is None:
+        record("dispatch", metric=metric_name, avals=avals)
+    else:
+        record("dispatch", metric=metric_name, avals=avals, flow_id=flow_id)
 
 
 def events() -> List[Dict[str, Any]]:

@@ -79,6 +79,10 @@ class SLOBudget:
             ["compiles"]`` — persistent-cache misses). A pre-warmed replica
             budgets 0 here: its first request must be served entirely from
             the seeded executable caches.
+        p99_flow_latency_ms: ceiling on any traced flow population's p99
+            end-to-end latency (the ``flow/<queue>`` and per-tenant
+            ``flow/<queue>/<stream>`` sketches fed by ``obs.flow``) — the
+            request-level SLO of the tracing tier.
         action: ``"warn"`` | ``"raise"`` | callable(list_of_violations).
     """
 
@@ -91,6 +95,7 @@ class SLOBudget:
         max_queue_depth: Optional[int] = None,
         p99_ingest_latency_ms: Optional[float] = None,
         max_cold_compiles: Optional[int] = None,
+        p99_flow_latency_ms: Optional[float] = None,
         action: Union[str, Callable[[List[Dict[str, Any]]], None]] = "warn",
     ) -> None:
         if isinstance(action, str) and action not in ("warn", "raise"):
@@ -102,6 +107,7 @@ class SLOBudget:
         self.max_queue_depth = max_queue_depth
         self.p99_ingest_latency_ms = p99_ingest_latency_ms
         self.max_cold_compiles = max_cold_compiles
+        self.p99_flow_latency_ms = p99_flow_latency_ms
         self.action = action
 
 
@@ -394,6 +400,23 @@ class HealthMonitor:
                             "budget": budget.p99_ingest_latency_ms,
                             "measured": round(p99_ms, 4),
                             "detail": f"queue {key.split('/', 1)[1]} enqueue->applied"
+                            + ("" if row.get("p99_certified") else " (uncertified edge-bin rank)"),
+                        }
+                    )
+
+        if budget.p99_flow_latency_ms is not None:
+            latency = self.report()["latency_us"]
+            for key, row in latency.items():
+                if not key.startswith("flow/"):
+                    continue
+                p99_ms = row.get("p99_us", float("nan")) / 1000.0
+                if p99_ms > budget.p99_flow_latency_ms:
+                    violations.append(
+                        {
+                            "slo": "p99_flow_latency_ms",
+                            "budget": budget.p99_flow_latency_ms,
+                            "measured": round(p99_ms, 4),
+                            "detail": f"flow {key.split('/', 1)[1]} end-to-end"
                             + ("" if row.get("p99_certified") else " (uncertified edge-bin rank)"),
                         }
                     )

@@ -19,7 +19,16 @@ Track model — one track per metric/engine:
   routed rows, commit steps — in ``args`` where the Perfetto UI shows them on
   click;
 - tracks are named via ``thread_name`` metadata events, so the timeline reads
-  as one row per metric/engine rather than anonymous tids.
+  as one row per metric/engine rather than anonymous tids. Events that carry a
+  ``queue``/``engine`` instance field get the instance suffixed onto the track
+  (``ingest_tick/replica-a``) so two queues sharing a metric class never
+  collide on one row;
+- ``flow_complete`` events (tmflow, ``obs/flow.py``) are rendered as **flow
+  arrows**: per flow an enqueue slice on ``ingest/<queue>``, ONE launch slice
+  per coalesced tick on ``launcher/<queue>``, a device slice on
+  ``compute/<queue>``, and ``ph s/t/f`` flow events (keyed by the flow's
+  integer id) linking them — the Perfetto UI draws the fan-in arrows from
+  every staged batch into its single launch.
 
 Naming note: the *module* ``metrics_tpu.obs.trace`` (this file) is the
 exporter; the *attribute* ``metrics_tpu.obs.trace`` remains the XProf capture
@@ -91,12 +100,18 @@ def chrome_trace_events(events: Optional[List[Dict[str, Any]]] = None) -> List[D
             )
         return tid
 
+    #: coalesced ticks already given their single launch/device slice
+    flow_ticks: set = set()
+
     for ev in events:
         kind = ev.get("kind")
         args = {
             k: v for k, v in ev.items() if k not in ("kind", "ts_us", "seq", "dur_us")
         }
         args["seq"] = ev.get("seq")
+        if kind == "flow_complete":
+            out.extend(_flow_events(ev, pid, tid_for, flow_ticks))
+            continue
         if kind == "scope":
             label = ev.get("name", "tm.scope")
             out.append(
@@ -115,6 +130,11 @@ def chrome_trace_events(events: Optional[List[Dict[str, Any]]] = None) -> List[D
         track = _INSTANT_TRACKS.get(kind)
         if track is None:
             track = str(ev.get("metric", kind))
+        # two queues (or engines) sharing a metric class must not share a
+        # track: suffix with the instance name whenever the event carries one
+        instance = ev.get("queue") or ev.get("engine")
+        if instance is not None:
+            track = f"{track}/{instance}"
         out.append(
             {
                 "ph": "i",
@@ -127,6 +147,78 @@ def chrome_trace_events(events: Optional[List[Dict[str, Any]]] = None) -> List[D
                 "args": args,
             }
         )
+    return out
+
+
+def _flow_events(
+    ev: Dict[str, Any], pid: int, tid_for: Any, ticks_done: set
+) -> List[Dict[str, Any]]:
+    """One ``flow_complete`` flight event -> slices + flow-arrow events.
+
+    Per flow: an enqueue slice on ``ingest/<queue>`` holding the arrow start
+    (``ph s``); per coalesced tick (shared by every flow the launch served):
+    ONE launch slice on ``launcher/<queue>`` and one device slice on
+    ``compute/<queue>``; per flow again: a ``ph t`` step bound to the launch
+    slice and a ``ph f`` finish bound to the device slice. Flows that never
+    launched (degraded/dropped before dispatch) render their enqueue slice
+    only — an arrow needs both ends.
+    """
+    queue = str(ev.get("queue", "?"))
+    fid = ev.get("id")
+    t_enq = ev.get("t_enq_us")
+    if t_enq is None:
+        return []
+    out: List[Dict[str, Any]] = []
+    enq_tid = tid_for(f"ingest/{queue}")
+    args = {
+        "flow_id": ev.get("flow_id"),
+        "rows": ev.get("rows"),
+        "streams": ev.get("streams"),
+        "degraded": ev.get("degraded"),
+        "dropped": ev.get("dropped"),
+        "seq": ev.get("seq"),
+        **{k: ev.get(k) for k in ("queue_wait_us", "coalesce_us", "compile_us",
+                                  "launch_us", "device_us", "readback_us")},
+    }
+    queue_wait = float(ev.get("queue_wait_us") or 0.0)
+    out.append(
+        {
+            "ph": "X", "name": "flow/enqueue", "cat": "flow",
+            "ts": t_enq, "dur": max(queue_wait, 0.001),
+            "pid": pid, "tid": enq_tid, "args": args,
+        }
+    )
+    t_launch = ev.get("t_launch_us")
+    t_dispatch = ev.get("t_dispatch_us")
+    t_device = ev.get("t_device_us")
+    tick = ev.get("tick")
+    if fid is None or t_launch is None or t_dispatch is None or t_device is None:
+        return out
+    launch_tid = tid_for(f"launcher/{queue}")
+    device_tid = tid_for(f"compute/{queue}")
+    tick_key = (queue, tick)
+    if tick_key not in ticks_done:
+        ticks_done.add(tick_key)
+        out.append(
+            {
+                "ph": "X", "name": "flow/launch", "cat": "flow",
+                "ts": t_launch, "dur": max(t_dispatch - t_launch, 0.001),
+                "pid": pid, "tid": launch_tid,
+                "args": {"tick": tick, "queue": queue},
+            }
+        )
+        out.append(
+            {
+                "ph": "X", "name": "flow/device", "cat": "flow",
+                "ts": t_dispatch, "dur": max(t_device - t_dispatch, 0.001),
+                "pid": pid, "tid": device_tid,
+                "args": {"tick": tick, "queue": queue},
+            }
+        )
+    arrow = {"name": "flow", "cat": "flow", "id": fid, "pid": pid}
+    out.append({"ph": "s", "ts": t_enq, "tid": enq_tid, **arrow})
+    out.append({"ph": "t", "ts": t_launch, "tid": launch_tid, **arrow})
+    out.append({"ph": "f", "bp": "e", "ts": t_device, "tid": device_tid, **arrow})
     return out
 
 
@@ -165,16 +257,20 @@ def validate_chrome_trace(obj: Dict[str, Any]) -> int:
         if not isinstance(ev, dict):
             raise ValueError(f"traceEvents[{i}] is not an object")
         ph = ev.get("ph")
-        if ph not in ("X", "i", "I", "M", "B", "E", "C"):
+        if ph not in ("X", "i", "I", "M", "B", "E", "C", "s", "t", "f"):
             raise ValueError(f"traceEvents[{i}] has unsupported ph={ph!r}")
         if not isinstance(ev.get("name"), str):
             raise ValueError(f"traceEvents[{i}] missing string `name`")
         if not isinstance(ev.get("pid"), int) or not isinstance(ev.get("tid"), int):
             raise ValueError(f"traceEvents[{i}] missing integer pid/tid")
-        if ph in ("X", "i", "I", "B", "E", "C") and not isinstance(
+        if ph in ("X", "i", "I", "B", "E", "C", "s", "t", "f") and not isinstance(
             ev.get("ts"), (int, float)
         ):
             raise ValueError(f"traceEvents[{i}] ({ph}) missing numeric `ts`")
+        if ph in ("s", "t", "f") and not isinstance(ev.get("id"), (int, str)):
+            raise ValueError(
+                f"traceEvents[{i}] ({ph}) flow event missing its `id` binding"
+            )
         if ph == "X" and not isinstance(ev.get("dur"), (int, float)):
             raise ValueError(f"traceEvents[{i}] (X) missing numeric `dur`")
         if ph == "M" and "args" not in ev:

@@ -78,6 +78,7 @@ from metrics_tpu.core.fused import (
 )
 from metrics_tpu.fault import inject as _fault
 from metrics_tpu.obs import flight as _obs_flight
+from metrics_tpu.obs import flow as _obs_flow
 from metrics_tpu.obs import health as _health
 from metrics_tpu.obs import registry as _obs
 from metrics_tpu.obs.ring import Ring
@@ -122,15 +123,19 @@ class _DonatedStateLost(RuntimeError):
 
 
 class _Entry:
-    """One enqueued batch: inputs verbatim plus arrival bookkeeping."""
+    """One enqueued batch: inputs verbatim plus arrival bookkeeping.
 
-    __slots__ = ("args", "kwargs", "rows", "t_enq")
+    ``flow`` is the tmflow record minted at admission (``obs/flow.py``) —
+    ``None`` whenever tracing is off or the flow was sampled out."""
+
+    __slots__ = ("args", "kwargs", "rows", "t_enq", "flow")
 
     def __init__(self, args: Tuple, kwargs: Dict, rows: int, t_enq: float) -> None:
         self.args = args
         self.kwargs = kwargs
         self.rows = rows
         self.t_enq = t_enq
+        self.flow = None
 
 
 def _count_rows(args: Tuple, kwargs: Dict) -> int:
@@ -256,6 +261,13 @@ class IngestQueue:
             _fault.fire("ingest.enqueue", queue=self.name, depth=len(self._ring))
         # **kwargs already materialized a fresh dict for this call — no copy
         entry = _Entry(args, kwargs, _count_rows(args, kwargs), time.monotonic())
+        if _obs._ENABLED and _obs_flow._TRACER is not None:
+            entry.flow = _obs_flow._TRACER.mint(
+                self.name,
+                id(self.target),
+                rows=entry.rows,
+                streams=_obs_flow.host_stream_ids(kwargs.get("stream_ids")),
+            )
         with self._admit:
             if self._ring.full:
                 if self.backpressure == "raise":
@@ -266,10 +278,10 @@ class IngestQueue:
                         " pick 'block'/'drop_oldest'"
                     )
                 if self.backpressure == "drop_oldest":
-                    if self._ring.pop_oldest() is not None:
+                    evicted = self._ring.pop_oldest()
+                    if evicted is not None:
                         self.stats["dropped"] += 1
-                        if _obs._ENABLED:
-                            _obs.REGISTRY.inc("ingest", "dropped")
+                        self._note_dropped(evicted, site="backpressure")
                 else:  # block
                     deadline = time.monotonic() + self.block_timeout_s
                     while self._ring.full:
@@ -316,6 +328,15 @@ class IngestQueue:
             )
             if not stale_ok:
                 self.flush()
+        if _obs._ENABLED and _obs_flow._TRACER is not None:
+            # readback stage: the compute() host transfer, stamped onto the
+            # completed-but-unread flows this read serves
+            t0 = time.perf_counter()
+            value = self.target.compute(**kwargs)
+            trc = _obs_flow._TRACER
+            if trc is not None:
+                trc.note_readback(self.name, time.perf_counter() - t0)
+            return value
         return self.target.compute(**kwargs)
 
     # ------------------------------------------------------------ lifecycle
@@ -337,8 +358,8 @@ class IngestQueue:
                 discarded = self._ring.drain()
                 if discarded:
                     self.stats["dropped"] += len(discarded)
-                    if _obs._ENABLED:
-                        _obs.REGISTRY.inc("ingest", "dropped", len(discarded))
+                    for e in discarded:
+                        self._note_dropped(e, site="close")
         self._closed = True
         _ACTIVE.discard(self)
         self._reraise()
@@ -354,6 +375,33 @@ class IngestQueue:
         if err is not None:
             self._error = None
             raise err
+
+    def _note_dropped(self, e: _Entry, site: str) -> None:
+        """Attribute one evicted batch (drop_oldest backpressure or a
+        drain=False close). A dropped batch previously vanished from the
+        health sketch entirely — enqueue→applied latency is only measured at
+        tick time — so drops get their own ``flow_dropped`` flight event and
+        an ``ingest.dropped_latency`` observation, and the batch's flow (when
+        traced) closes as dropped instead of orphaning."""
+        waited_s = time.monotonic() - e.t_enq
+        if _obs._ENABLED:
+            _obs.REGISTRY.inc("ingest", "dropped")
+            if _obs_flight._RING is not None:
+                _obs_flight.record(
+                    "flow_dropped",
+                    queue=self.name,
+                    site=site,
+                    rows=e.rows,
+                    waited_us=round(waited_s * 1e6, 1),
+                    flow_id=None if e.flow is None else e.flow.flow_id,
+                )
+        mon = _health._MONITOR
+        if mon is not None:
+            mon.observe_latency("ingest.dropped_latency", self.name, waited_s)
+        if e.flow is not None:
+            trc = _obs_flow._TRACER
+            if trc is not None:
+                trc.close_dropped(e.flow)
 
     # ------------------------------------------------------------- ticking
 
@@ -394,6 +442,11 @@ class IngestQueue:
     def _apply(self, entries: List[_Entry]) -> None:
         """One tick: chain the drained batches into one donated launch."""
         launches_before = self.stats["launches"]
+        trc = _obs_flow._TRACER if _obs._ENABLED else None
+        if trc is not None:
+            flows = [e.flow for e in entries if e.flow is not None]
+            if flows:
+                trc.stamp_drain(flows)
         if _fault._SCHEDULE is not None:
             try:
                 _fault.fire("ingest.tick", queue=self.name, entries=len(entries))
@@ -404,7 +457,13 @@ class IngestQueue:
         try:
             launched = self._apply_coalesced(entries)
         except _DonatedStateLost:
-            # the state is gone; degrading would double-apply — propagate
+            # the state is gone; degrading would double-apply — propagate,
+            # but close the traced flows first (an unrecoverable tick must
+            # not leave orphaned spans behind)
+            if trc is not None:
+                for e in entries:
+                    if e.flow is not None and not e.flow.closed:
+                        trc.close_degraded(e.flow)
             raise
         except Exception as err:  # noqa: BLE001 — eager is always correct
             # anything else (trace/compile/shape failures) degrades cleanly:
@@ -434,6 +493,18 @@ class IngestQueue:
         if mon is not None:
             for e in entries:
                 mon.observe_latency("ingest", self.name, now - e.t_enq)
+        if _obs._ENABLED:
+            trc = _obs_flow._TRACER
+            if trc is not None:
+                # anything the tick neither launched nor explicitly closed
+                # (e.g. an eager-only plan) ends here — no orphaned flows
+                leftovers = [
+                    e.flow
+                    for e in entries
+                    if e.flow is not None and not e.flow.dispatched and not e.flow.closed
+                ]
+                if leftovers:
+                    trc.close_now(leftovers)
 
     # ----------------------------------------------------- degradation path
 
@@ -455,7 +526,13 @@ class IngestQueue:
             err,
             "the pending batches were applied synchronously (no rows lost).",
         )
+        trc = _obs_flow._TRACER if _obs._ENABLED else None
         for e in entries:
+            # push the originating flow as the ambient context so the fused
+            # engine attributes the synchronous re-apply to it instead of
+            # minting a second flow for the same batch
+            if trc is not None and e.flow is not None:
+                _obs_flow._push(e.flow)
             try:
                 self.target.update(*e.args, **e.kwargs)
             except BaseException as apply_err:  # noqa: BLE001 — keep draining
@@ -464,6 +541,11 @@ class IngestQueue:
                 # and keep the later batches flowing
                 if self._error is None:
                     self._error = apply_err
+            finally:
+                if trc is not None and e.flow is not None:
+                    _obs_flow._pop()
+                    if not e.flow.closed:
+                        trc.close_degraded(e.flow)
 
     # ------------------------------------------------------- coalesced path
 
@@ -508,13 +590,20 @@ class IngestQueue:
         if chain:
             self._launch_chain(chain, entries, filter_kwargs=is_collection)
             launched = 1
+        trc = _obs_flow._TRACER if _obs._ENABLED else None
         for _label, leader in eager:
             self.stats["eager_entries"] += len(entries)
             for e in entries:
-                if is_collection:
-                    leader.update(*e.args, **leader._filter_kwargs(**e.kwargs))
-                else:
-                    leader.update(*e.args, **e.kwargs)
+                if trc is not None and e.flow is not None:
+                    _obs_flow._push(e.flow)
+                try:
+                    if is_collection:
+                        leader.update(*e.args, **leader._filter_kwargs(**e.kwargs))
+                    else:
+                        leader.update(*e.args, **e.kwargs)
+                finally:
+                    if trc is not None and e.flow is not None:
+                        _obs_flow._pop()
         if is_collection:
             self.target._state_is_copy = False
             self.target._compute_groups_create_state_ref()
@@ -594,6 +683,12 @@ class IngestQueue:
     def _launch_chain(
         self, chain: List[Tuple[str, Any]], entries: List[_Entry], filter_kwargs: bool
     ) -> None:
+        trc = _obs_flow._TRACER if _obs._ENABLED else None
+        flows = (
+            [e.flow for e in entries if e.flow is not None] if trc is not None else []
+        )
+        if flows:
+            trc.stamp_launch(flows)
         # split each batch into traced leaves + static spec (jit cache-key
         # semantics, same split the fused engine and retrace detector use)
         dyn_lists: List[List[Any]] = []
@@ -640,6 +735,7 @@ class IngestQueue:
             # closures fire counters per TRACE, not per launch
             prev = _obs._ENABLED
             _obs._ENABLED = False
+            t_compile = time.perf_counter()
             try:
                 compiled = jitted.lower(states, dyn_lists).compile()
             except Exception:
@@ -647,6 +743,8 @@ class IngestQueue:
                 raise
             finally:
                 _obs._ENABLED = prev
+            if flows:
+                trc.add_compile(flows, (time.perf_counter() - t_compile) * 1e6)
             self._cache[key] = compiled
             # warm-manifest recording: the tick compile is the cold path, so
             # the sys.modules probe costs the steady-state tick nothing
@@ -673,6 +771,11 @@ class IngestQueue:
             for label, m in chain:
                 m._load_state(states[label])
             raise
+
+        if flows:
+            # hand the flows to the completion watcher: it stamps device time
+            # off `block_until_ready` on the freshly returned state buffers
+            trc.dispatch(flows, jax.tree_util.tree_leaves(new_states))
 
         self.stats["launches"] += 1
         n = len(entries)

@@ -164,10 +164,31 @@ def baseline(tmp_path_factory):
         return _workload(str(tmp_path_factory.mktemp("baseline")))
 
 
+def _assert_no_orphaned_flows(name):
+    """tmflow invariant, checked after EVERY schedule: whatever the faults
+    did, no flow is left open and the span export stays structurally valid
+    (every degraded path must close its flow — an orphan means a traced
+    request that "never finished" in the telemetry)."""
+    assert obs.flow.wait_idle(15.0), f"{name}: completion watcher stuck"
+    orphans = obs.flow.tracer().open_flows()
+    assert orphans == [], (
+        f"{name}: {len(orphans)} flow(s) left open after the run — orphaned"
+        f" spans: {[fl.queue for fl in orphans]}"
+    )
+    obs.validate_spans(obs.export_spans())
+
+
 @pytest.mark.parametrize("name,kwargs", _SCHEDULES, ids=[n for n, _ in _SCHEDULES])
 def test_chaos_never_silently_corrupts(name, kwargs, baseline, tmp_path):
     obs.enable()
     obs.REGISTRY.clear()
+    # the sweep runs traced: tracing must never change outcomes, and every
+    # schedule must terminate with zero orphaned flows (see helper above).
+    # enable_obs=False keeps the health monitor out of the sweep — its sketch
+    # exports would dominate the workload's aggregation phase, and the orphan
+    # invariant needs only the tracer; the flow→health rollups have their own
+    # tier in tests/unittests/obs/test_tmflow.py
+    obs.flow.enable(sample_rate=4, enable_obs=False)
     try:
         with warnings.catch_warnings():
             warnings.simplefilter("ignore")
@@ -179,7 +200,9 @@ def test_chaos_never_silently_corrupts(name, kwargs, baseline, tmp_path):
                 # branch 1: a typed, attributable termination — and the fault
                 # that caused it is on the record
                 assert sched.fired, f"{name}: typed error with no recorded fault"
+                _assert_no_orphaned_flows(name)
                 return
+        _assert_no_orphaned_flows(name)
         if _equal(result, baseline):
             # branch 2: bit-identical to fault-free (retries/degradations
             # healed everything, or nothing fired at all)
@@ -195,6 +218,7 @@ def test_chaos_never_silently_corrupts(name, kwargs, baseline, tmp_path):
         assert set(result) == set(baseline)
         assert result["agg_coverage"] == baseline["agg_coverage"]
     finally:
+        obs.flow.disable()
         obs.disable()
 
 
@@ -232,6 +256,38 @@ def test_ingest_degrade_attributes_via_obs(tmp_path, baseline):
         assert obs.REGISTRY.snapshot()["ingest"]["degrades"] >= 1
         assert {e["site"] for e in sched.fired} == {"ingest.tick"}
     finally:
+        obs.disable()
+
+
+def test_chaos_degraded_flows_close_with_attribute(tmp_path):
+    """tmflow × tmfault interaction (ISSUE 16 satellite): under an armed
+    ``ingest.tick`` + ``fused.launch`` schedule the degraded flows still
+    complete — each closes with ``degraded=true`` on its span, and no span is
+    orphaned."""
+    obs.enable()
+    obs.REGISTRY.clear()
+    obs.flow.enable(enable_obs=False)
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            with fault.FaultSchedule(
+                fire_at={"ingest.tick": 0, "fused.launch": 0}
+            ) as sched:
+                _workload(str(tmp_path))
+        assert {e["site"] for e in sched.fired} == {"ingest.tick", "fused.launch"}
+        assert obs.flow.wait_idle(15.0)
+        assert obs.flow.tracer().open_flows() == []
+        degraded = [r for r in obs.flow.records() if r.degraded]
+        # one degraded flow per faulted path: the fused launch and every
+        # batch the demoted ingest tick re-applied synchronously
+        assert len(degraded) >= 1 + _STEPS, obs.flow.stats()
+        spans = obs.export_spans()
+        assert obs.validate_spans(spans) > 0
+        by_id = {s["attributes"]["flow.id"]: s for s in spans if s["name"] == "flow"}
+        for rec in degraded:
+            assert by_id[rec.flow_id]["attributes"]["degraded"] is True
+    finally:
+        obs.flow.disable()
         obs.disable()
 
 
