@@ -1472,6 +1472,168 @@ def bench_coldstart(trials: int = 3) -> dict:
     }
 
 
+def bench_serve(burst: int = 96, rows: int = 128, trials: int = 5) -> dict:
+    """``--serve``: the tmserve front end (metrics_tpu/serve/server.py) —
+    the ISSUE 17 deployable-service claim, measured across a restart.
+
+    One 3-collection :class:`MetricsServer` (each collection a fused
+    MSE+MAE pair with its own checkpoint dir), driven with the ticker held
+    (``ticker=False``) so every number is deterministic. Four splits:
+
+    * **Sustained enqueues/s** — ``burst`` batches fanned round-robin over
+      the three request queues, drained with DRR ``_tick_round`` passes,
+      p50 of ``trials``; measured *before* the restart and again *after*,
+      and ``vs_baseline`` is post/pre (floor: >=0.5 — a restart must not
+      cost steady-state throughput; the restored server reuses the same
+      chained executables, so ~1.0 is expected).
+    * **restart_to_ready_ms** — the ``drain`` commits every collection +
+      warm manifest; a second server over the same config then pays the
+      full ``restore → prewarm → ready`` startup, timed by the server's
+      own ``startup_s`` clock. Restored ``update_count`` must equal the
+      drain report's committed counts (the zero-lost-rows acceptance) and
+      the prewarm replay must skip nothing.
+    * **serve_round_p50_ms** — one contended DRR round (every queue loaded
+      with exactly ``quantum`` entries), p50 over ``trials``.
+    * **fairness_spread** — every queue loaded with ``4*quantum`` entries,
+      one round, per-queue served = enqueued - depth; spread is
+      max(served)/min(served) and must be 1.0 under equal quanta (asserted
+      <= 1.5 so CPU scheduling jitter can't flake the bench).
+    """
+    import os
+    import shutil
+    import tempfile
+
+    from metrics_tpu.serve import MetricsServer, ServerConfig
+    from metrics_tpu.serve import excache as _serve_excache
+
+    names = ("quality", "latency", "calib")
+    workdir = tempfile.mkdtemp(prefix="tm-serve-bench-")
+
+    def make_config() -> ServerConfig:
+        return ServerConfig(
+            [
+                {
+                    "name": n,
+                    "metrics": {"mse": "MeanSquaredError", "mae": "MeanAbsoluteError"},
+                    "ckpt_dir": os.path.join(workdir, n),
+                }
+                for n in names
+            ],
+            adaptive=False,
+            quantum=8,
+        )
+
+    key = jax.random.PRNGKey(17)
+    batches = []
+    for i in range(burst):
+        k1, k2 = jax.random.split(jax.random.fold_in(key, i))
+        batches.append((jax.random.uniform(k1, (rows,), jnp.float32),
+                        jax.random.uniform(k2, (rows,), jnp.float32)))
+    jax.block_until_ready(batches[-1][0])
+
+    def block(srv) -> None:
+        for coll in srv._collections.values():
+            target = coll.target
+            for group in target._groups.values():
+                m = target._modules[group[0]]
+                jax.block_until_ready(jax.tree_util.tree_leaves(m.state_pytree()))
+
+    def drain_rounds(srv) -> None:
+        while srv._tick_round():
+            pass
+
+    def sustained_eps(srv) -> float:
+        def one_pass() -> float:
+            t0 = time.perf_counter()
+            for i, (p, t) in enumerate(batches):
+                srv.enqueue(names[i % len(names)], p, t)
+            drain_rounds(srv)
+            block(srv)
+            return time.perf_counter() - t0
+
+        one_pass()  # warm: identical structure keys identical chain lengths
+        return burst / statistics.median(one_pass() for _ in range(trials))
+
+    def round_p50_ms(srv) -> float:
+        quantum = srv.config.quantum
+
+        def one_round() -> float:
+            for n in names:
+                for p, t in batches[:quantum]:
+                    srv.enqueue(n, p, t)
+            t0 = time.perf_counter()
+            srv._tick_round()
+            block(srv)
+            ms = (time.perf_counter() - t0) * 1000
+            drain_rounds(srv)
+            return ms
+
+        one_round()  # warm the exact-depth chain
+        return statistics.median(one_round() for _ in range(trials))
+
+    def fairness(srv):
+        per_queue = srv.config.quantum * 4
+        for n in names:
+            for p, t in batches[:per_queue]:
+                srv.enqueue(n, p, t)
+        srv._tick_round()
+        snap = srv.status()["collections"]
+        served = {n: per_queue - snap[n]["depth"] for n in names}
+        drain_rounds(srv)
+        spread = max(served.values()) / max(1, min(served.values()))
+        assert spread <= 1.5, f"DRR fairness spread {spread} from {served}"
+        return served, spread
+
+    try:
+        srv = MetricsServer(make_config(), ticker=False)
+        pre_eps = sustained_eps(srv)
+        tick_ms = round_p50_ms(srv)
+        served, spread = fairness(srv)
+        committed = srv.drain()
+        srv.stop()
+
+        # --- kill-and-restart: restore -> prewarm -> ready, zero lost rows
+        srv2 = MetricsServer(make_config(), ticker=False)
+        restart_ms = srv2.startup_s * 1000
+        prewarm = _serve_excache.last_prewarm() or {}
+        snap = srv2.status()["collections"]
+        for n in names:
+            assert snap[n]["update_count"] == committed[n]["update_count"], (
+                f"{n}: restored {snap[n]['update_count']} != committed"
+                f" {committed[n]['update_count']}"
+            )
+            assert snap[n]["restored_step"] is not None, f"{n} did not restore"
+        assert prewarm.get("skipped", 0) == 0, prewarm
+        post_eps = sustained_eps(srv2)
+        srv2.drain()
+        srv2.stop()
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    return {
+        "metric": "serve_sustained_enqueue",
+        "value": round(post_eps / 1e3, 2),
+        "unit": "Kenq/s",
+        "vs_baseline": round(post_eps / pre_eps, 2),
+        "collections": len(names),
+        "burst": burst,
+        "rows_per_batch": rows,
+        "pre_restart_keps": round(pre_eps / 1e3, 2),
+        "post_restart_keps": round(post_eps / 1e3, 2),
+        "restart_to_ready_ms": round(restart_ms, 3),
+        "serve_round_p50_ms": round(tick_ms, 3),
+        "fairness_spread": round(spread, 3),
+        "fairness_served": served,
+        "committed_update_counts": {n: committed[n]["update_count"] for n in names},
+        "prewarm": {k: prewarm.get(k) for k in ("launched", "skipped") if k in prewarm},
+        "bound": "enqueue cost is a host-side ring append + admission check"
+                 " under _req_lock; drain cost is one chained donated launch"
+                 " per DRR round per backlogged queue; restart-to-ready is"
+                 " checkpoint restore (owned-copy materialization) plus the"
+                 " warm-manifest prewarm replay, both off the request path",
+    }
+
+
 def bench_chaos(n: int = 1 << 18, steps: int = 8, trials: int = 5) -> dict:
     """``--chaos``: what graceful degradation actually costs (metrics_tpu.fault).
 
@@ -1923,7 +2085,7 @@ if __name__ == "__main__":
     parser = argparse.ArgumentParser(description="metrics_tpu benchmarks")
     parser.add_argument(
         "--config",
-        choices=("accuracy", "logits", "confmat", "map", "ssim", "retrieval", "auroc", "fid", "fused", "fleet", "ingest", "coldstart", "sketch", "chaos", "lint", "race", "obs_trace", "flow", "all"),
+        choices=("accuracy", "logits", "confmat", "map", "ssim", "retrieval", "auroc", "fid", "fused", "fleet", "ingest", "coldstart", "serve", "sketch", "chaos", "lint", "race", "obs_trace", "flow", "all"),
         default="all",
     )
     parser.add_argument(
@@ -1968,6 +2130,16 @@ if __name__ == "__main__":
         " first-step wall of a fresh subprocess replica cold vs pre-warmed"
         " (persistent compile cache + warm-manifest prewarm), with compile"
         " counts off the obs counters — cold >=1, pre-warmed exactly 0"
+        " (also runs under --config all)",
+    )
+    parser.add_argument(
+        "--serve",
+        action="store_true",
+        help="also run the tmserve front-end bench (metrics_tpu/serve/server.py):"
+        " sustained enqueues/s through a 3-collection server before vs after a"
+        " drain + restore-prewarm restart (zero lost committed rows asserted),"
+        " restart-to-ready ms off the server's own startup clock, contended"
+        " DRR round p50, and the fairness spread across the three queues"
         " (also runs under --config all)",
     )
     parser.add_argument(
@@ -2072,6 +2244,7 @@ if __name__ == "__main__":
         ("ingest", bench_ingest),
         ("flow", bench_flow_overhead),
         ("coldstart", bench_coldstart),
+        ("serve", bench_serve),
         ("sketch", bench_sketch),
         ("chaos", bench_chaos),
         ("ckpt", bench_ckpt),
@@ -2094,6 +2267,8 @@ if __name__ == "__main__":
             continue
         if name == "coldstart" and not (cli.coldstart or config in ("coldstart", "all")):
             continue
+        if name == "serve" and not (cli.serve or config in ("serve", "all")):
+            continue
         if name == "sketch" and not (cli.sketch or config in ("sketch", "all")):
             continue
         if name == "chaos" and not (cli.chaos or config in ("chaos", "all")):
@@ -2104,7 +2279,7 @@ if __name__ == "__main__":
             continue
         if name == "race" and not (cli.race_overhead or config in ("race", "all")):
             continue
-        if config in (name, "all") or name in ("ckpt", "fused", "fleet", "ingest", "flow", "coldstart", "sketch", "chaos", "lint", "san", "race", "obs_trace"):
+        if config in (name, "all") or name in ("ckpt", "fused", "fleet", "ingest", "flow", "coldstart", "serve", "sketch", "chaos", "lint", "san", "race", "obs_trace"):
             try:
                 result = fn()
                 summary[result["metric"]] = {

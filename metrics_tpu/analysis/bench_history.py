@@ -43,7 +43,8 @@ LEGACY_BACKEND = "tpu"
 GATED_SPLIT_FIELDS = ("sort_ms", "post_sort_ms", "layout_sort_ms", "scan_ms",
                       "tick_p50_ms", "coldstart_prewarmed_ms",
                       "flow_untraced_p50_ms", "flow_traced_p50_ms",
-                      "flow_sampled_p50_ms")
+                      "flow_sampled_p50_ms", "restart_to_ready_ms",
+                      "serve_round_p50_ms")
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
 

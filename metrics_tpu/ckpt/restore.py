@@ -43,6 +43,21 @@ def _require(payload: Dict[str, np.ndarray], key: str) -> np.ndarray:
         raise CorruptCheckpointError(f"checkpoint payload is missing entry `{key}`") from None
 
 
+def _owned(value: Any, dtype: Any = None) -> Any:
+    """Materialize one restored leaf as a device buffer jax owns outright.
+
+    ``jnp.asarray`` over a host numpy array can produce a zero-copy buffer
+    that aliases the numpy memory (the payload decoder hands out
+    ``np.frombuffer`` views of the blob). Aliased state must never reach a
+    donating executable — the ingest tier donates the whole state pytree
+    (``donate_argnums=(0,)``), and donating an aliased buffer into an
+    executable deserialized from the persistent compilation cache corrupts
+    the heap (intermittent SIGSEGV/SIGBUS under concurrent tick load). One
+    explicit copy per leaf at restore time buys a state tree that is always
+    safe to donate."""
+    return jnp.array(value, dtype=dtype, copy=True)
+
+
 def split_items(items: List[Any], world: int, rank: int) -> List[Any]:
     """Contiguous split of ``items`` into ``world`` near-equal parts; part ``rank``.
 
@@ -90,7 +105,7 @@ def _restore_cat_buffer(metric: Any, name: str, prefix: str, payloads: List[Dict
         # exact resume: same topology and capacity — keep the true (possibly
         # over-capacity) count and the saved flag bit-for-bit
         return CatBuffer(
-            jnp.asarray(datas[rank]),
+            _owned(datas[rank]),
             jnp.asarray(counts[rank], jnp.int32),
             jnp.asarray(bool(_require(payloads[rank], f"{key}@overflow")), jnp.bool_),
         )
@@ -114,11 +129,11 @@ def _restore_cat_buffer(metric: Any, name: str, prefix: str, payloads: List[Dict
 def _restore_list(name: str, prefix: str, payloads: List[Dict[str, np.ndarray]],
                   rank: int, world: int, saved_world: int) -> List[Any]:
     if world == saved_world:
-        return [jnp.asarray(v) for v in iter_list_items(payloads[rank], prefix, name)]
+        return [_owned(v) for v in iter_list_items(payloads[rank], prefix, name)]
     items: List[np.ndarray] = []
     for p in payloads:
         items.extend(iter_list_items(p, prefix, name))
-    return [jnp.asarray(v) for v in split_items(items, world, rank)]
+    return [_owned(v) for v in split_items(items, world, rank)]
 
 
 def assign_metric_state(
@@ -146,12 +161,12 @@ def assign_metric_state(
             setattr(metric, name, _restore_list(name, prefix, payloads, rank, world, saved_world))
         elif replicated:
             # replicated arrays: one copy exists (host 0 wrote it), all hosts load it
-            setattr(metric, name, jnp.asarray(_require(payloads[0], key)))
+            setattr(metric, name, _owned(_require(payloads[0], key)))
         elif world == saved_world:
-            setattr(metric, name, jnp.asarray(_require(payloads[rank], key)))
+            setattr(metric, name, _owned(_require(payloads[rank], key)))
         else:
             merged = _merge_arrays(key, spec["reduce"], payloads, metric._defaults[name], rank)
-            setattr(metric, name, jnp.asarray(merged))
+            setattr(metric, name, _owned(merged))
     for attr, child_schema in saved_schema["children"].items():
         live_child = child_metrics(metric)[attr]
         if isinstance(child_schema, list):

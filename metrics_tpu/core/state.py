@@ -131,8 +131,10 @@ class CatBuffer:
             raise ValueError(f"{rows.shape[0]} rows do not fit capacity {capacity}")
         data = np.full((capacity, *rows.shape[1:]), fill_value, dtype=rows.dtype)
         data[: rows.shape[0]] = rows
+        # copy=True: restored state may be donated later, so it must own its
+        # buffer rather than zero-copy alias `data` (see ckpt.restore._owned)
         return cls(
-            jnp.asarray(data),
+            jnp.array(data, copy=True),
             jnp.asarray(rows.shape[0], jnp.int32),
             jnp.asarray(bool(overflow), jnp.bool_),
         )

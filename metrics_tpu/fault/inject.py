@@ -27,6 +27,12 @@ faults by it):
     ``excache.prewarm`` per-entry warm-manifest replay in ``serve/excache.py``
                        — a fired entry is skipped (warn once) and its
                        executable lazily compiles on first use instead
+    ``server.request`` request admission in ``serve/server.py`` — a fired
+                       admission rejects the batch before it is staged, so
+                       nothing is half-applied
+    ``server.drain``   the drain transition of a ``MetricsServer`` — fired
+                       BEFORE any queue is flushed or checkpoint written, so
+                       a killed drain never loses a committed row
     ``input.poison``   NaN-poisoning of update inputs (``Metric._wrap_update``)
 
 Every site except ``input.poison`` *raises* :class:`InjectedFaultError` (an
@@ -74,6 +80,8 @@ SITES = (
     "ingest.enqueue",
     "ingest.tick",
     "excache.prewarm",
+    "server.request",
+    "server.drain",
     "input.poison",
 )
 

@@ -26,9 +26,14 @@ rendered page through it.
 
 :func:`start_server` serves ``GET /metrics`` from a stdlib ``http.server``
 on a daemon thread — zero new dependencies, one call to make a process
-scrapeable. Nothing in this module is reachable from the instrumented hot
-paths: exposition *pulls* registry/health/series state on demand, and no
-server or buffer exists until :func:`start_server`.
+scrapeable. The same server answers ``GET /healthz`` as a readiness probe:
+``200 ok`` by default, or whatever ``(status, body)`` the provider installed
+via :func:`set_readiness` returns — ``serve.server.MetricsServer`` registers
+its lifecycle state here, so a rolling-restart orchestrator sees ``503
+starting`` until restore+prewarm complete, ``200 ready`` while admitting, and
+``503 draining`` during shutdown. Nothing in this module is reachable from
+the instrumented hot paths: exposition *pulls* registry/health/series state
+on demand, and no server or buffer exists until :func:`start_server`.
 """
 import re
 import threading
@@ -56,6 +61,40 @@ _LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
 
 _SERVER: Optional[ThreadingHTTPServer] = None
 _SERVER_THREAD: Optional[threading.Thread] = None
+
+#: the installed readiness provider for ``GET /healthz`` (None == always ok).
+#: A provider is a zero-arg callable returning ``(http_status, body_text)``.
+_READINESS: Optional[Any] = None
+
+
+def set_readiness(provider: Any) -> None:
+    """Install the ``/healthz`` provider — a zero-arg callable returning
+    ``(status_code, body)``. Last caller wins (one probe per process)."""
+    global _READINESS
+    _READINESS = provider
+
+
+def clear_readiness(provider: Any = None) -> None:
+    """Remove the readiness provider. With ``provider`` given, only removes
+    it if it is still the installed one (so a stopping server cannot clobber
+    its replacement's registration)."""
+    global _READINESS
+    if provider is None or _READINESS is provider:
+        _READINESS = None
+
+
+def readiness_probe() -> Tuple[int, str]:
+    """Evaluate the installed readiness provider; ``(200, "ok\\n")`` when none
+    is installed, ``(500, ...)`` if the provider itself fails — a broken probe
+    must read as not-ready, never crash the scrape thread."""
+    provider = _READINESS
+    if provider is None:
+        return 200, "ok\n"
+    try:
+        status, body = provider()
+        return int(status), str(body)
+    except Exception as exc:  # noqa: BLE001 — a probe answers, never raises
+        return 500, f"readiness provider failed: {exc}\n"
 
 
 def _escape_label(value: str) -> str:
@@ -307,6 +346,56 @@ def render() -> str:
             if flow_lat.samples:
                 families.append(flow_lat)
 
+    # the serving front end (tmserve), same on-demand discipline: families
+    # render only while a MetricsServer is live in this process
+    _srv = _sys.modules.get("metrics_tpu.serve.server")
+    if _srv is not None:
+        servers = _srv.active_servers()
+        if servers:
+            state_f = _Family(
+                "tm_server_state", "gauge",
+                "Lifecycle state of each MetricsServer (1 on the current state's sample).",
+            )
+            interval_f = _Family(
+                "tm_server_tick_interval_seconds", "gauge",
+                "Current (possibly adaptive) shared ticker interval per MetricsServer.",
+            )
+            colls_f = _Family(
+                "tm_server_collections", "gauge",
+                "Collections served per MetricsServer.",
+            )
+            srv_counters = {
+                "requests": _Family(
+                    "tm_server_requests", "counter",
+                    "Update batches admitted through MetricsServer.enqueue().",
+                ),
+                "rejected": _Family(
+                    "tm_server_rejected", "counter",
+                    "Requests rejected for lifecycle state (not ready).",
+                ),
+                "rounds": _Family(
+                    "tm_server_rounds", "counter",
+                    "Deficit-round-robin ticker rounds that applied at least one entry.",
+                ),
+                "slo_breaches": _Family(
+                    "tm_server_slo_breaches", "counter",
+                    "Per-collection SLO budget violations observed by the control loop.",
+                ),
+                "drift_alerts": _Family(
+                    "tm_server_drift_alerts", "counter",
+                    "Drift-canary alerts (live PSI past the spec threshold).",
+                ),
+            }
+            for s in servers:
+                labels = _labels(server=s.name)
+                state_f.add("", _labels(server=s.name, state=s.state), 1)
+                interval_f.add("", labels, s.tick_interval_s)
+                colls_f.add("", labels, len(s._collections))
+                for stat, family in srv_counters.items():
+                    family.add("_total", labels, s.stats.get(stat, 0))
+            families.extend([state_f, interval_f, colls_f])
+            families.extend(srv_counters.values())
+
     smp = _series._SAMPLER
     if smp is not None:
         ticks = _Family(
@@ -436,7 +525,17 @@ class _MetricsHandler(BaseHTTPRequestHandler):
     # machinery tmrace cannot see statically, hence the explicit role.
     @thread_role("prom-handler")
     def do_GET(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler contract
-        if self.path.split("?", 1)[0] not in ("/metrics", "/"):
+        path = self.path.split("?", 1)[0]
+        if path == "/healthz":
+            status, text = readiness_probe()
+            body = text.encode("utf-8")
+            self.send_response(status)
+            self.send_header("Content-Type", "text/plain; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        if path not in ("/metrics", "/"):
             self.send_response(404)
             self.end_headers()
             return

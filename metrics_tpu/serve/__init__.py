@@ -1,6 +1,6 @@
 """metrics_tpu.serve — the serving-path tiers built on top of the core.
 
-Two members today. The async ingestion tier (:mod:`metrics_tpu.serve.ingest`)
+Three members today. The async ingestion tier (:mod:`metrics_tpu.serve.ingest`)
 decouples host batch arrival from device accumulation with a bounded staging
 ring and a coalescing tick thread::
 
@@ -23,6 +23,18 @@ triggers zero compiles::
     excache.enable_recording()                 # compiles now land in the manifest
     ...                                        # ckpt writes warm_manifest.json
     excache.prewarm(collection, "ckpts/warm_manifest.json")   # on restart
+
+The serving front end (:mod:`metrics_tpu.serve.server`) composes both — plus
+checkpoints, fault sites, and the obs stack — into a deployable process
+(``python -m metrics_tpu.serve``): N named collections from a declarative
+config, one fair shared ticker, restore→prewarm→ready startup and
+drain→ckpt→stop shutdown::
+
+    from metrics_tpu.serve import MetricsServer, load_config
+
+    with MetricsServer(load_config("serve.json")) as server:
+        server.enqueue("quality", preds, target, stream_ids=ids)
+        value = server.compute("quality")
 """
 from metrics_tpu.serve import excache
 from metrics_tpu.serve.excache import (
@@ -38,15 +50,33 @@ from metrics_tpu.serve.ingest import (
     flush_for,
     max_queue_depth,
 )
+from metrics_tpu.serve.server import (
+    CollectionSpec,
+    DriftAlert,
+    DriftAlertError,
+    MetricsServer,
+    ServerConfig,
+    ServerConfigError,
+    ServerStateError,
+    load_config,
+)
 
 __all__ = [
+    "CollectionSpec",
+    "DriftAlert",
+    "DriftAlertError",
     "IngestBackpressureError",
     "IngestQueue",
+    "MetricsServer",
+    "ServerConfig",
+    "ServerConfigError",
+    "ServerStateError",
     "active_queues",
     "excache",
     "enable_persistent_cache",
     "enable_recording",
     "flush_for",
+    "load_config",
     "max_queue_depth",
     "prewarm",
     "save_manifest",

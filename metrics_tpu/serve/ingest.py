@@ -312,6 +312,36 @@ class IngestQueue:
             self._run_ticks()
         self._reraise()
 
+    def tick(self, limit: Optional[int] = None) -> int:
+        """One bounded drain-and-apply; returns the number of entries applied.
+
+        The hand-off point for an *external* ticker: ``flush()`` drains to
+        empty, which is the wrong primitive when one thread shares its tick
+        budget across several queues (a saturated queue would monopolize the
+        round). ``tick(limit=n)`` applies at most ``min(n, max_coalesce)``
+        staged batches as one coalesced launch and returns, so a deficit
+        round-robin scheduler (``serve.server.MetricsServer``) can hold every
+        queue to its per-round quantum. Error semantics match the background
+        tick exactly: apply failures degrade or stash, never raise here —
+        the stashed error surfaces at the next host-call boundary.
+        """
+        budget = self.max_coalesce if limit is None else min(int(limit), self.max_coalesce)
+        if budget < 1:
+            return 0
+        with self._tick_lock:
+            with self._admit:
+                entries = self._ring.drain(limit=budget)
+                if entries:
+                    self._admit.notify_all()
+            if not entries:
+                return 0
+            try:
+                self._apply(entries)
+            except BaseException as err:  # noqa: BLE001 — same stash as _run_ticks
+                if self._error is None:
+                    self._error = err
+        return len(entries)
+
     def compute(self, **kwargs: Any) -> Any:
         """Staleness-bounded read of ``target.compute()``.
 

@@ -527,14 +527,15 @@ def test_repo_thread_role_model():
     for _m, func in model.all_functions():
         roles |= func.roles
     assert {
-        "user", "tm-ingest", "metrics-tpu-ckpt", "tmscope-sampler",
+        "user", "tm-ingest", "tm-serve/ticker", "metrics-tpu-ckpt", "tmscope-sampler",
         "prom-handler", "signal", "atexit", "excepthook",
     } <= roles
     # the locks the serving runtime is built on must all be in the model
     for lock_id in (
         "IngestQueue._tick_lock", "Ring._lock", "manager._INFLIGHT_LOCK",
         "manager._PENDING_LOCK", "flight._LOCK", "excache._LOCK",
-        "TelemetrySampler._lock",
+        "TelemetrySampler._lock", "MetricsServer._lock", "MetricsServer._req_lock",
+        "AdaptiveTickController._lock",
     ):
         assert lock_id in model.locks, f"missing lock {lock_id}"
 

@@ -227,3 +227,64 @@ def test_prom_scrape_storm_during_enqueue_exact_totals():
     finally:
         q.close()
         obs.prom.stop_server()
+
+
+# --------------------------------------------------------- tm-serve lifecycle
+
+
+def test_server_drain_racing_producers_exact_totals():
+    """Corroborates the ``tm-serve/ticker`` role model and the server's
+    counter partitioning: N producer threads (all role ``user``, counters
+    under ``MetricsServer._req_lock``) race the shared DRR ticker AND a
+    mid-stream ``drain()``. Admission is atomic — every enqueue either
+    returns (and its batch is applied exactly once by the drain) or raises a
+    typed rejection — so the drained ``update_count`` and the ``requests``
+    counter both equal the number of successful enqueues exactly."""
+    from metrics_tpu.serve import MetricsServer, ServerConfig, ServerStateError
+
+    producers, per_producer = 4, 40
+    batches = _mse_batches(per_producer)
+    cfg = ServerConfig(
+        [{"name": "q", "metrics": {"mse": "MeanSquaredError"}}],
+        tick_interval_s=0.001,
+        adaptive=False,
+    )
+    server = MetricsServer(cfg)  # real tm-serve/ticker thread
+    admitted = [0] * producers
+    errors = []
+    go = threading.Event()
+
+    def produce(k):
+        try:
+            go.wait(5)
+            for p, t in batches:
+                try:
+                    server.enqueue("q", p, t)
+                except ServerStateError:
+                    return  # the drain won the race: typed rejection, no row
+                except RuntimeError:
+                    return  # admission lost to queue close mid-drain
+                admitted[k] += 1
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=produce, args=(k,)) for k in range(producers)]
+    try:
+        for t in threads:
+            t.start()
+        go.set()
+        import time as _time
+
+        _time.sleep(0.05)  # let the ticker interleave real applies first
+        report = server.drain()
+        for t in threads:
+            t.join(timeout=30)
+        assert not any(t.is_alive() for t in threads) and not errors
+        total = sum(admitted)
+        assert total > 0
+        # exactly-once apply: nothing admitted is lost, nothing double-applied
+        assert report["q"]["update_count"] == total
+        assert server.stats["requests"] == total
+        assert int(server._collections["q"].queue.stats["dropped"]) == 0
+    finally:
+        server.stop()

@@ -9,10 +9,11 @@
   ``input.poison`` may legitimately alter computed values).
 
 Silent corruption — a completed run whose registered state differs from the
-baseline with no poison attribution — fails the sweep. 28 schedules cover
-explicit single-occurrence faults at all twelve sites (including the ingest
-tier's ``ingest.enqueue``/``ingest.tick`` and the cold-start tier's
-``excache.prewarm``), repeated-fault and multi-site plans, and seeded random
+baseline with no poison attribution — fails the sweep. 31 schedules cover
+explicit single-occurrence faults at all fourteen sites (including the ingest
+tier's ``ingest.enqueue``/``ingest.tick``, the cold-start tier's
+``excache.prewarm``, and the serving front end's ``server.request``/
+``server.drain``), repeated-fault and multi-site plans, and seeded random
 storms at several rates.
 """
 import os
@@ -28,7 +29,7 @@ from metrics_tpu.core.collections import MetricCollection
 from metrics_tpu.fault import PoisonedInputError
 from metrics_tpu.obs.aggregate import aggregate_dir, host_snapshot, publish
 from metrics_tpu.regression import MeanAbsoluteError, MeanSquaredError
-from metrics_tpu.serve import IngestQueue, excache
+from metrics_tpu.serve import IngestQueue, MetricsServer, ServerConfig, excache
 
 pytestmark = [pytest.mark.fault, pytest.mark.chaos]
 
@@ -104,6 +105,36 @@ def _workload(tmpdir):
     excache.prewarm(warm, manifest)  # never raises; degraded replay = lazy compile
     warm.update(jnp.asarray([1.0, 2.0, 3.0, 4.0]), jnp.asarray([1.0, 3.0, 5.0, 7.0]))
     out["warm"] = {k: np.asarray(v) for k, v in warm.compute().items()}
+
+    # serving front end: a manual-tick one-collection server through its full
+    # lifecycle — request admission (site: server.request), one DRR round,
+    # drain→ckpt commit (site: server.drain), restart→restore. A drain killed
+    # by injection salvage-closes the queue (staged rows dropped WITH
+    # attribution, traced flows closed), so the zero-orphaned-flows invariant
+    # below holds on the typed branch too; the last committed checkpoint is
+    # never touched by a dead drain.
+    sdir = os.path.join(tmpdir, "srv")
+
+    def _server_config():
+        return ServerConfig(
+            [{"name": "q", "metrics": {"mse": "MeanSquaredError"}, "ckpt_dir": sdir}],
+            adaptive=False,
+            record_manifest=False,  # keep the sweep hermetic: no global recording
+        )
+
+    with MetricsServer(_server_config(), ticker=False) as srv:
+        for i in range(_STEPS):
+            srv.enqueue(
+                "q", jnp.asarray([1.0 + i, 2.0, 3.0, 4.0]), jnp.asarray([1.0, 3.0, 5.0, 7.0])
+            )
+        srv._tick_round()
+        committed = srv.drain()["q"]["update_count"]
+    with MetricsServer(_server_config(), ticker=False) as srv2:
+        out["server"] = (
+            committed,
+            srv2._collections["q"].update_count(),
+            np.asarray(srv2.compute("q")["mse"]),
+        )
     return out
 
 
@@ -139,6 +170,9 @@ def _schedules():
     )
     scheds.append(
         ("compound:ingest+ckpt", dict(fire_at={"ingest.tick": 0, "ckpt.write": 0}))
+    )
+    scheds.append(
+        ("compound:drain+ckpt", dict(fire_at={"server.drain": 0, "ckpt.write": 0}))
     )
     # seeded random storms across every raising site (8)
     storm_sites = tuple(s for s in fault.SITES if s != "input.poison")

@@ -1,0 +1,675 @@
+"""The tmserve front end (ISSUE 17, metrics_tpu/serve/server.py).
+
+The load-bearing contracts:
+
+- **Bit-parity**: values served through ``enqueue → shared ticker → compute``
+  equal the synchronous jitted path exactly (the server adds scheduling, never
+  arithmetic).
+- **Lifecycle**: ``starting → ready → draining → stopped`` with typed
+  rejections outside ``ready``, ``/healthz`` mirroring every transition, and a
+  drain that commits each collection's checkpoint exactly once.
+- **Fairness**: the shared ticker is deficit-round-robin — a backlogged
+  neighbour cannot starve a light collection (deterministic unit test here;
+  the latency-spread experiment lives in ``bench.py --serve``).
+- **Control**: the adaptive tick controller converges on a stepped latency
+  trace; SLO budgets and the drift canary follow the warn/raise/callable
+  ladder.
+- **Faults**: the ``server.request`` / ``server.drain`` sites reject cleanly —
+  an injected drain salvages every queue (no orphaned flows, last committed
+  checkpoint untouched).
+
+The subprocess acceptance test (kill-and-restart, zero lost committed rows,
+zero first-request compiles after restore) is marked ``slow`` and runs in the
+serve tier, not tier-1.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+import warnings
+
+import numpy as np
+import pytest
+
+from metrics_tpu import fault, obs
+from metrics_tpu.ckpt import latest_step
+from metrics_tpu.core.collections import MetricCollection
+from metrics_tpu.obs import health
+from metrics_tpu.obs import prom
+from metrics_tpu.regression import MeanAbsoluteError, MeanSquaredError
+from metrics_tpu.serve import excache
+from metrics_tpu.serve.server import (
+    AdaptiveTickController,
+    CollectionSpec,
+    DriftAlert,
+    DriftAlertError,
+    DriftSpec,
+    MetricsServer,
+    ServerConfig,
+    ServerConfigError,
+    ServerStateError,
+    active_servers,
+    load_config,
+)
+
+pytestmark = pytest.mark.serve
+
+_REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+
+
+@pytest.fixture(autouse=True)
+def _clean_serve_state():
+    excache.disable_recording()
+    excache.clear_manifest()
+    excache.clear_stats()
+    yield
+    excache.disable_recording()
+    excache.clear_manifest()
+    excache.clear_stats()
+    excache.disable_persistent_cache()
+    health.disable()
+    obs.disable()
+    prom.clear_readiness()
+    prom.stop_server()
+
+
+def _config(tmp_path=None, *, names=("a",), fleet=None, **overrides):
+    collections = []
+    for name in names:
+        spec = {"name": name, "metrics": {"mse": "MeanSquaredError"}}
+        if fleet is not None:
+            spec["fleet_size"] = fleet
+        if tmp_path is not None:
+            spec["ckpt_dir"] = str(tmp_path / f"ck_{name}")
+        collections.append(spec)
+    return ServerConfig(collections, **overrides)
+
+
+def _batches(n, rows=32, seed=0, fleet=None):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        batch = {
+            "args": (
+                rng.random_sample(rows).astype(np.float32),
+                rng.random_sample(rows).astype(np.float32),
+            )
+        }
+        if fleet is not None:
+            batch["stream_ids"] = rng.randint(0, fleet, size=rows).astype(np.int32)
+        out.append(batch)
+    return out
+
+
+def _feed(server, name, batches):
+    for b in batches:
+        server.enqueue(name, *b["args"], stream_ids=b.get("stream_ids"))
+
+
+# ------------------------------------------------------------------- config
+
+
+def test_load_config_from_json_file(tmp_path):
+    path = tmp_path / "serve.json"
+    path.write_text(
+        json.dumps(
+            {
+                "name": "eval",
+                "collections": [
+                    {
+                        "name": "quality",
+                        "metrics": {"mse": "MeanSquaredError", "mae": "MeanAbsoluteError"},
+                        "fleet_size": 4,
+                        "slo_p99_ingest_ms": 50.0,
+                        "drift": {"max_psi": 0.3, "reference_rows": 128},
+                    }
+                ],
+                "ticker": {"tick_interval_s": 0.01, "quantum": 4, "adaptive": False},
+                "prom": {"port": 0, "host": "127.0.0.1"},
+                "excache": {"persistent_dir": str(tmp_path / "xla"), "record": False},
+            }
+        )
+    )
+    cfg = load_config(str(path))
+    assert cfg.name == "eval"
+    assert cfg.tick_interval_s == 0.01 and cfg.quantum == 4 and cfg.adaptive is False
+    assert cfg.prom_port == 0 and cfg.prom_host == "127.0.0.1"
+    assert cfg.persistent_cache_dir == str(tmp_path / "xla") and cfg.record_manifest is False
+    (spec,) = cfg.collections
+    assert spec.fleet_size == 4 and spec.slo_p99_ingest_ms == 50.0
+    assert spec.drift.max_psi == 0.3 and spec.drift.reference_rows == 128
+    # fleet_size is injected into every member's kwargs
+    assert all(kw["fleet_size"] == 4 for _, kw in spec.metrics.values())
+    # identity on an already-built config
+    assert load_config(cfg) is cfg
+
+
+@pytest.mark.parametrize(
+    "mutate, match",
+    [
+        (lambda d: d.update(collections=[]), "at least one collection"),
+        (lambda d: d.update(collections=[{"name": "a", "metrics": {"m": "NoSuchMetric"}}]), "unknown metric class"),
+        (
+            lambda d: d.update(
+                collections=[
+                    {"name": "a", "metrics": {"m": "MeanSquaredError"}},
+                    {"name": "a", "metrics": {"m": "MeanAbsoluteError"}},
+                ]
+            ),
+            "duplicate collection",
+        ),
+        (
+            lambda d: d.update(collections=[{"name": "a", "metrics": {"m": "MeanSquaredError"}, "queue": {"nope": 1}}]),
+            "unknown queue option",
+        ),
+        (lambda d: d.update(bogus=True), "unknown server config keys"),
+        (lambda d: d.update(ticker={"bogus": 1}), "unknown ticker options"),
+        (lambda d: d.update(prom={"bogus": 1}), "unknown prom options"),
+        (lambda d: d.update(excache={"bogus": 1}), "unknown excache options"),
+        (
+            lambda d: d.update(collections=[{"name": "a", "metrics": {"m": "MeanSquaredError"}, "drift": {"action": "explode"}}]),
+            "drift action",
+        ),
+    ],
+)
+def test_config_rejects_malformed(mutate, match):
+    d = {"collections": [{"name": "a", "metrics": {"m": "MeanSquaredError"}}]}
+    mutate(d)
+    with pytest.raises(ServerConfigError, match=match):
+        load_config(d)
+
+
+def test_config_rejects_unreadable_and_invalid_json(tmp_path):
+    with pytest.raises(ServerConfigError, match="cannot read config"):
+        load_config(str(tmp_path / "missing.json"))
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    with pytest.raises(ServerConfigError, match="not valid JSON"):
+        load_config(str(bad))
+
+
+def test_collection_spec_builds_collection():
+    spec = CollectionSpec("q", {"mse": "MeanSquaredError", "mae": {"class": "MeanAbsoluteError"}})
+    target = spec.build()
+    assert isinstance(target, MetricCollection)
+    assert set(target._modules) == {"mse", "mae"}
+
+
+# ---------------------------------------------------------------- lifecycle
+
+
+def test_lifecycle_and_request_api_parity():
+    batches = _batches(7, seed=3)
+    ref = MetricCollection({"mse": MeanSquaredError()}, fused=True)
+    for b in batches:
+        ref.update(*b["args"])
+    server = MetricsServer(_config(), start=False, ticker=False)
+    assert server.state == "starting"
+    with pytest.raises(ServerStateError, match="requires ready"):
+        server.enqueue("a", *batches[0]["args"])
+    server.start()
+    assert server.state == "ready"
+    _feed(server, "a", batches)
+    served = server.compute("a")
+    expected = ref.compute()
+    assert np.asarray(served["mse"]) == np.asarray(expected["mse"])
+    report = server.drain()
+    assert server.state == "draining"
+    assert report["a"]["update_count"] == len(batches)
+    with pytest.raises(ServerStateError):
+        server.enqueue("a", *batches[0]["args"])
+    assert server.stats["rejected"] == 2  # one pre-start reject, one post-drain
+    # reads stay open during drain; everything closes at stop
+    assert np.asarray(server.compute("a")["mse"]) == np.asarray(expected["mse"])
+    server.stop()
+    assert server.state == "stopped"
+    with pytest.raises(ServerStateError):
+        server.compute("a")
+    with pytest.raises(ServerStateError, match="single-use"):
+        server.start()
+    assert server not in active_servers()
+
+
+def test_context_manager_and_unknown_collection():
+    with MetricsServer(_config(), ticker=False) as server:
+        assert server in active_servers()
+        with pytest.raises(ServerConfigError, match="unknown collection"):
+            server.enqueue("nope", np.zeros(4, np.float32))
+    assert server.state == "stopped"
+
+
+def test_fleet_stream_compute_and_reduce():
+    fleet = 3
+    batches = _batches(6, seed=11, fleet=fleet)
+    ref = MetricCollection({"mse": MeanSquaredError(fleet_size=fleet)}, fused=True)
+    for b in batches:
+        ref.update(*b["args"], stream_ids=b["stream_ids"])
+    with MetricsServer(_config(names=("f",), fleet=fleet), ticker=False) as server:
+        _feed(server, "f", batches)
+        ref_mse = ref._modules["mse"]
+        for stream in range(fleet):
+            got = server.compute("f", stream=stream)
+            want = ref_mse.compute(stream=stream)
+            assert np.asarray(got["mse"]) == np.asarray(want)
+        reduced = server.reduce_fleet("f")
+        assert np.asarray(reduced["mse"]) == np.asarray(ref_mse.reduce_fleet())
+    with MetricsServer(_config(), ticker=False) as server:
+        with pytest.raises(ServerStateError, match="no fleet members"):
+            server.reduce_fleet("a")
+
+
+def test_drain_is_idempotent_and_stop_via_exit():
+    server = MetricsServer(_config(), ticker=False)
+    _feed(server, "a", _batches(3))
+    first = server.drain()
+    assert server.drain() is not None and server.drain() == first
+
+
+# ------------------------------------------------------------------ healthz
+
+
+def _probe(host, port):
+    try:
+        with urllib.request.urlopen(f"http://{host}:{port}/healthz", timeout=10) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as err:
+        return err.code, err.read().decode()
+
+
+def test_healthz_transitions_over_http():
+    seen = {}
+
+    def on_starting(server):
+        seen["starting"] = _probe(*server._prom_address)
+
+    def on_draining(server):
+        seen["draining"] = _probe(*server._prom_address)
+
+    server = MetricsServer(
+        _config(prom_port=0), start=False, ticker=False,
+        starting_hook=on_starting, draining_hook=on_draining,
+    )
+    server.start()
+    try:
+        host, port = server._prom_address
+        assert seen["starting"] == (503, "starting\n")
+        assert _probe(host, port) == (200, "ready\n")
+        server.drain()
+        assert seen["draining"] == (503, "draining\n")
+        assert _probe(host, port) == (503, "draining\n")
+    finally:
+        server.stop()
+    # stop() released the readiness registration: a bare probe is 200 ok again
+    assert prom.readiness_probe() == (200, "ok\n")
+
+
+def test_server_families_render_and_roundtrip():
+    obs.enable()
+    with MetricsServer(_config(names=("a", "b")), ticker=False) as server:
+        _feed(server, "a", _batches(4))
+        server._tick_round()
+        page = prom.render()
+        assert prom.validate_exposition(page) > 0
+        assert 'tm_server_state{server="metrics-server",state="ready"} 1' in page
+        assert "tm_server_collections" in page
+        assert "tm_server_requests_total" in page
+        assert "tm_server_rounds_total" in page
+
+
+# ----------------------------------------------------------------- fairness
+
+
+def test_tick_round_is_deficit_round_robin():
+    cfg = _config(names=("hog", "light"), quantum=2, adaptive=False)
+    with MetricsServer(cfg, ticker=False) as server:
+        _feed(server, "hog", _batches(10, seed=1))
+        _feed(server, "light", _batches(2, seed=2))
+        applied = server._tick_round()
+        # round 1: each queue is served at most its quantum; the light queue
+        # fully drains even though the hog is backlogged (starvation-proof)
+        assert applied == 4
+        assert server._collections["hog"].queue.depth == 8
+        assert server._collections["light"].queue.depth == 0
+        # reset-on-empty: no credit hoarding for the drained queue
+        assert server._deficit["light"] == 0.0
+        rounds = 1
+        while server._collections["hog"].queue.depth > 0:
+            server._tick_round()
+            rounds += 1
+            assert rounds < 50
+        # 8 remaining entries at quantum 2 -> exactly 4 more rounds
+        assert rounds == 5
+        assert server.stats["applied_entries"] == 12
+        assert server.stats["rounds"] == rounds
+
+
+def test_quantum_larger_than_tick_limit_is_honoured():
+    cfg = _config(names=("a",), quantum=64, adaptive=False)
+    cfg.collections[0].queue["max_coalesce"] = 4  # cap each tick() call below quantum
+    with MetricsServer(cfg, ticker=False) as server:
+        _feed(server, "a", _batches(12, seed=5))
+        assert server._tick_round() == 12  # inner loop spends the whole credit
+        assert server._collections["a"].queue.depth == 0
+
+
+# --------------------------------------------------------------- controller
+
+
+def test_adaptive_controller_converges_on_stepped_trace():
+    ctl = AdaptiveTickController(10.0, interval_s=0.005, min_interval_s=0.0005, max_interval_s=0.25)
+    # quiet phase: p99 far under budget -> grow slowly to the ceiling
+    for _ in range(40):
+        ctl.observe(0.5)
+    assert ctl.interval_s == 0.25
+    grows_to_ceiling = ctl.grows
+    # load step: p99 breaches the high-water mark -> shrink fast to the floor
+    shrinks = 0
+    while ctl.interval_s > 0.0005:
+        ctl.observe(20.0)
+        shrinks += 1
+        assert shrinks < 100
+    # asymmetry: recovery is strictly faster than relaxation
+    assert shrinks < grows_to_ceiling
+    assert ctl.shrinks == shrinks
+    # mid-band p99 holds the interval steady
+    before = ctl.interval_s
+    ctl.observe(5.0)
+    assert ctl.interval_s == before
+    # standing backlog forces a shrink even with a healthy p99
+    ctl.interval_s = 0.01
+    ctl.observe(0.5, depth=3)
+    assert ctl.interval_s == 0.005
+    # no observation, no move
+    assert ctl.observe(None) == 0.005
+
+
+def test_adaptive_controller_rejects_bad_params():
+    with pytest.raises(ValueError):
+        AdaptiveTickController(0.0)
+    with pytest.raises(ValueError):
+        AdaptiveTickController(1.0, min_interval_s=0.1, max_interval_s=0.01)
+    with pytest.raises(ValueError):
+        AdaptiveTickController(1.0, high_water=0.2, low_water=0.7)
+
+
+def test_server_control_loop_shrinks_tick_interval_under_slo_pressure():
+    health.enable()
+    cfg = _config(adaptive=True, tick_interval_s=0.05)
+    cfg.collections[0].slo_p99_ingest_ms = 1e-6  # any real latency breaches
+    server = MetricsServer(cfg, start=False, ticker=False)
+    server.controller = AdaptiveTickController(
+        1e-6, interval_s=0.05, min_interval_s=0.0005, max_interval_s=0.25
+    )
+    server.start()
+    try:
+        _feed(server, "a", _batches(4))
+        server._collections["a"].queue.flush()  # records ingest/<name> latency
+        with pytest.warns(health.SLOViolationWarning, match="SLO violation"):
+            server._run_control()
+        assert server.tick_interval_s < 0.05
+        assert server.stats["slo_breaches"] >= 1
+    finally:
+        server.stop()
+
+
+def test_slo_action_raise_and_callable():
+    health.enable()
+    cfg = _config(slo_action="raise", adaptive=False)
+    cfg.collections[0].slo_p99_ingest_ms = 1e-6
+    server = MetricsServer(cfg, start=False, ticker=False)
+    server.start()
+    try:
+        _feed(server, "a", _batches(2))
+        server._collections["a"].queue.flush()
+        with pytest.raises(health.SLOBudgetExceeded):
+            server._run_control()
+    finally:
+        server.stop()
+    seen = []
+    cfg = _config(slo_action=seen.append, adaptive=False)
+    cfg.collections[0].slo_p99_ingest_ms = 1e-6
+    with MetricsServer(cfg, ticker=False) as server:
+        _feed(server, "a", _batches(2))
+        server._collections["a"].queue.flush()
+        server._run_control()
+    (violations,) = seen
+    assert violations[0]["collection"] == "a" and violations[0]["observed"] > 0
+
+
+# -------------------------------------------------------------------- drift
+
+
+def _drift_config(action, **spec_kw):
+    cfg = _config(adaptive=False)
+    cfg.collections[0].drift = DriftSpec(
+        reference_rows=64, min_live_rows=64, sample_every=1, action=action, **spec_kw
+    )
+    return cfg
+
+
+def _drive_drift(server):
+    rng = np.random.RandomState(0)
+    ref = rng.random_sample(64).astype(np.float32)  # uniform reference window
+    server.enqueue("a", ref, rng.random_sample(64).astype(np.float32))
+    server._run_control()  # absorbs the reference window; no live rows yet
+    shifted = (0.9 + 0.1 * rng.random_sample(64)).astype(np.float32)  # collapsed live
+    for _ in range(2):
+        server.enqueue("a", shifted, rng.random_sample(64).astype(np.float32))
+    return server._run_control
+
+
+def test_drift_canary_warns():
+    with MetricsServer(_drift_config("warn"), ticker=False) as server:
+        run = _drive_drift(server)
+        with pytest.warns(DriftAlert, match="input drift"):
+            run()
+        assert server.stats["drift_alerts"] == 1
+        status = server.status()["collections"]["a"]["drift"]
+        assert status["alerts"] == 1 and status["psi"] > 0.25
+
+
+def test_drift_canary_raises_and_calls():
+    with MetricsServer(_drift_config("raise"), ticker=False) as server:
+        run = _drive_drift(server)
+        with pytest.raises(DriftAlertError, match="input drift"):
+            run()
+    alerts = []
+    with MetricsServer(_drift_config(alerts.append), ticker=False) as server:
+        _drive_drift(server)()
+    (alert,) = alerts
+    assert alert["collection"] == "a" and alert["psi"] > alert["max_psi"]
+
+
+def test_drift_canary_quiet_on_stationary_input():
+    # coarse bins + wide windows: sampling noise alone must stay under max_psi
+    cfg = _drift_config("raise", num_bins=8)
+    cfg.collections[0].drift.reference_rows = 512
+    cfg.collections[0].drift.min_live_rows = 512
+    with MetricsServer(cfg, ticker=False) as server:
+        rng = np.random.RandomState(1)
+        for _ in range(4):  # reference and live drawn from the same law
+            server.enqueue(
+                "a",
+                rng.random_sample(512).astype(np.float32),
+                rng.random_sample(512).astype(np.float32),
+            )
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            server._run_control()
+            server._run_control()
+        assert server.stats["drift_alerts"] == 0
+
+
+# -------------------------------------------------------------------- faults
+
+
+def test_server_request_fault_site():
+    batches = _batches(3)
+    with MetricsServer(_config(), ticker=False) as server:
+        with fault.FaultSchedule(fire_at={"server.request": 0}) as sched:
+            with pytest.raises(fault.InjectedFaultError):
+                server.enqueue("a", *batches[0]["args"])
+            server.enqueue("a", *batches[1]["args"])  # next occurrence admits
+        assert sched.fired[0]["collection"] == "a"
+        assert server.stats["requests"] == 1
+        assert server._collections["a"].queue.depth == 1  # the failed admit staged nothing
+
+
+def test_server_drain_fault_salvages_queues(tmp_path):
+    server = MetricsServer(_config(tmp_path), ticker=False)
+    _feed(server, "a", _batches(3))
+    try:
+        with fault.FaultSchedule(fire_at={"server.drain": 0}):
+            with pytest.raises(fault.InjectedFaultError):
+                server.drain()
+        # the drain died before any flush: staged rows dropped with
+        # attribution, nothing committed, every queue released
+        assert server._collections["a"].queue._closed
+        assert int(server._collections["a"].queue.stats["dropped"]) == 3
+        assert latest_step(str(tmp_path / "ck_a")) is None
+    finally:
+        server.stop()
+
+
+# --------------------------------------------------------------- checkpoints
+
+
+def test_drain_commits_and_restart_restores(tmp_path):
+    excache.enable_persistent_cache(str(tmp_path / "xla"))
+    excache.enable_recording()
+    batches = _batches(9, seed=21)
+    cfg = _config(tmp_path)
+    with MetricsServer(cfg, ticker=False) as one:
+        _feed(one, "a", batches)
+        value = np.asarray(one.compute("a")["mse"])
+        report = one.drain()
+    assert report["a"]["step"] == 0 and report["a"]["update_count"] == 9
+    manifest = tmp_path / "ck_a" / excache.MANIFEST_NAME
+    assert manifest.is_file()  # the warm manifest rides the drain checkpoint
+    with MetricsServer(_config(tmp_path), ticker=False) as two:
+        coll = two._collections["a"]
+        assert coll.restored_step == 0
+        assert coll.update_count() == 9
+        assert np.asarray(two.compute("a")["mse"]) == value
+        assert excache.last_prewarm() is not None
+        assert excache.last_prewarm()["skipped"] == 0
+
+
+def test_multi_collection_prewarm_partitions_manifest(tmp_path):
+    # one process-wide manifest holds BOTH collections' entries; restart must
+    # replay each collection's share without schema-drift warnings
+    excache.enable_persistent_cache(str(tmp_path / "xla"))
+    excache.enable_recording()
+    cfg = _config(tmp_path, names=("a", "b"))
+    with MetricsServer(cfg, ticker=False) as one:
+        _feed(one, "a", _batches(4, seed=1))
+        _feed(one, "b", _batches(4, seed=2))
+        one.drain()
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        with MetricsServer(_config(tmp_path, names=("a", "b")), ticker=False) as two:
+            assert two._collections["a"].update_count() == 4
+            assert two._collections["b"].update_count() == 4
+
+
+# ------------------------------------------------------------------- status
+
+
+def test_status_snapshot():
+    with MetricsServer(_config(names=("a", "b")), ticker=False) as server:
+        _feed(server, "a", _batches(2))
+        snap = server.status()
+        assert snap["state"] == "ready" and snap["server"] == "metrics-server"
+        assert snap["stats"]["requests"] == 2
+        assert snap["collections"]["a"]["depth"] == 2
+        assert snap["collections"]["b"]["depth"] == 0
+        assert snap["startup_s"] > 0
+
+
+def test_background_ticker_applies_without_compute():
+    with MetricsServer(_config(tick_interval_s=0.002)) as server:
+        _feed(server, "a", _batches(5, seed=8))
+        deadline = time.monotonic() + 10.0
+        # poll the counter, not the depth: the ticker updates stats after the
+        # round, so depth can read 0 a moment before applied_entries lands
+        while server.stats["applied_entries"] < 5:
+            assert time.monotonic() < deadline, "shared ticker never drained the queue"
+            time.sleep(0.01)
+        assert server._collections["a"].queue.depth == 0
+
+
+# -------------------------------------------------- subprocess acceptance
+
+
+@pytest.mark.slow
+def test_subprocess_kill_and_restart_acceptance(tmp_path):
+    """The ISSUE 17 acceptance run: a 3-collection server is SIGTERM-killed
+    mid-traffic and restarted twice. Every restart restores exactly the rows
+    the previous drain committed, performs zero first-request compiles, and
+    walks /healthz through 503 starting → 200 ready → 503 draining."""
+    cfg = {
+        "name": "sub",
+        "collections": [
+            {"name": "a", "metrics": {"mse": "MeanSquaredError"}, "ckpt_dir": str(tmp_path / "ck_a")},
+            {"name": "b", "metrics": {"mae": "MeanAbsoluteError"}, "ckpt_dir": str(tmp_path / "ck_b")},
+            {"name": "c", "metrics": {"mse": "MeanSquaredError"}, "fleet_size": 2, "ckpt_dir": str(tmp_path / "ck_c")},
+        ],
+        "prom": {"port": 0},
+        "excache": {"persistent_dir": str(tmp_path / "xla"), "record": True},
+    }
+    cfg_path = tmp_path / "config.json"
+    cfg_path.write_text(json.dumps(cfg))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    prev_committed = None
+    for cycle in range(3):
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "metrics_tpu.serve", "--config", str(cfg_path), "--drive", "--wait-stdin"],
+            stdout=subprocess.PIPE, stdin=subprocess.PIPE, text=True, env=env, cwd=_REPO_ROOT,
+        )
+        try:
+            events = {}
+
+            def read_until(name):
+                for line in proc.stdout:
+                    ev = json.loads(line)
+                    events[ev["event"]] = ev
+                    if ev["event"] == name:
+                        return ev
+                raise AssertionError(f"subprocess exited before emitting {name!r}")
+
+            serving = read_until("serving")
+            host, port = serving["prom"]
+            assert _probe(host, port) == (503, "starting\n")
+            proc.stdin.write("\n")
+            proc.stdin.flush()
+            ready = read_until("ready")
+            assert _probe(host, port) == (200, "ready\n")
+            time.sleep(1.2)
+            proc.send_signal(signal.SIGTERM)
+            read_until("draining")
+            assert _probe(host, port) == (503, "draining\n")
+            proc.stdin.write("\n")
+            proc.stdin.flush()
+            stopped = read_until("stopped")
+            assert proc.wait(timeout=120) == 0
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+        assert set(ready["restored_update_counts"]) == {"a", "b", "c"}
+        assert all(stopped["launches_eq_ticks"].values()), stopped["launches_eq_ticks"]
+        committed = {k: v["update_count"] for k, v in stopped["committed"].items()}
+        assert all(count > 0 for count in committed.values())
+        if cycle == 0:
+            assert ready["restored"] == {"a": None, "b": None, "c": None}
+        else:
+            # zero lost committed rows + cold-start-free restart
+            assert ready["restored_update_counts"] == prev_committed
+            assert ready["first_request_compiles"] == 0
+            assert ready["prewarm"]["skipped"] == 0
+        prev_committed = committed
