@@ -816,6 +816,30 @@ def bench_retrieval(n_docs: int = 1 << 24, trials: int = 5) -> dict:
     layout_s = statistics.median(timed_layout() for _ in range(3))
     cycle_s = n_docs / statistics.median(rates)
 
+    # ---- round 10: the fused multi-scan timed alone — pass A's whole tuple
+    # carry (within-segment rank + relevant count) in ONE segmented scan
+    # (ops/segment.py:segment_multi_scan); the r9 path issued a cumsum scan
+    # pair per statistic, so this split is what the fusion collapsed
+    from metrics_tpu.ops.segment import segment_multi_scan
+
+    @jax.jit
+    def fused_probe(i, t):
+        new_seg = jnp.concatenate([jnp.ones(1, dtype=bool), i[1:] != i[:-1]])
+        ones = jnp.ones(i.shape, jnp.int32)
+        out = segment_multi_scan((ones, (t > 0).astype(jnp.int32)), new_seg)
+        return out[0][-1] + out[1][-1]
+
+    float(fused_probe(idx, rel))  # compile + warm
+
+    def timed_fused() -> float:
+        t0 = time.perf_counter()
+        vals = [fused_probe(idx, rel) for _ in range(4)]
+        float(vals[-1])
+        return (time.perf_counter() - t0) / 4
+
+    timed_fused()  # queue warm-up
+    fused_s = statistics.median(timed_fused() for _ in range(3))
+
     vs = None
     tm = _reference_torchmetrics()
     if tm is not None:
@@ -838,9 +862,12 @@ def bench_retrieval(n_docs: int = 1 << 24, trials: int = 5) -> dict:
             "ndcg_mdocs_per_s": round(statistics.median(ndcg_rates) / 1e6, 2),
             "layout_sort_ms": round(layout_s * 1000, 1),
             "scan_ms": round(max(cycle_s - layout_s, 0.0) * 1000, 1),
+            "scan_fused_ms": round(fused_s * 1000, 1),
             "bound": "sort+scan kernel bound: the layout sort (since r6 the slimmed"
                      " 3-operand (indexes, -preds, target) form, 12 B/row vs 20 —"
-                     " ops/segment.py) plus ~5 cumsum/cummax scans, zero"
+                     " ops/segment.py) plus since r10 ONE fused multi-scan carry"
+                     " for the ungated statistics (scan_fused_ms times that pass"
+                     " alone) and at most one rank-gated second pass, zero"
                      " scatters/gathers; the layout_sort_ms/scan_ms split is"
                      " measured per round. Radix partition-by-query rejected:"
                      " experiments/rank_exp.py verdict"}

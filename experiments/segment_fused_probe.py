@@ -1,0 +1,91 @@
+"""Probe: is the fused tuple-carry segmented multi-scan viable at stream scale?
+
+Three questions behind ops/segment.py's round-10 fusion, answered empirically:
+
+1. **Compile time.** Round 5 rejected ``lax.associative_scan`` at 2^24 rows for
+   the per-element FLOAT scan variants (minutes-long compiles on the tunneled
+   v5e backend). The integer tuple-carry form is a different program: one scan
+   over a ``(flags, lane0, lane1, ...)`` carry with a branchless segmented
+   monoid. Measured here: ~5 s at 2^24 rows / 3 lanes on current jaxlib (and
+   ~0.7 s even at test-suite shapes) — acceptable for a warm serving process
+   (paid once per shape through the persistent compile cache), which is why
+   the dispatcher reserves this tier for min/max lanes over real segment
+   flags and routes sum-only / statically-global requests to native
+   cumsum/cummax scans that compile in milliseconds.
+2. **Run time vs unfused.** k statistics in one pass vs k cumsum passes: the
+   fused carry reads the flag column once and keeps the lanes in the same
+   scan network, so wall time scales well below k× a single scan.
+3. **Pallas crossover.** On TPU the block-streaming kernel (flag-aware
+   Hillis-Steele in-register, open-segment carry in scratch) takes over at
+   ``SEGSCAN_PALLAS_MIN_SIZE``; on CPU it only runs in interpret mode, so this
+   probe times it on a small slice purely as a parity check.
+
+Run: JAX_PLATFORMS=cpu python experiments/segment_fused_probe.py   (1+2, parity)
+     python experiments/segment_fused_probe.py                      (TPU: adds 3)
+"""
+import statistics
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.ops.segment import force_scan_impl, segment_multi_scan
+
+N_GRID_TPU = (1 << 21, 1 << 24)
+N_GRID_CPU = (1 << 18, 1 << 21)
+LANES = 3
+
+
+def timed(fn, *args, reps=5):
+    t0 = time.perf_counter()
+    out = fn(*args)
+    jax.tree_util.tree_map(lambda a: a.block_until_ready(), out)
+    compile_s = time.perf_counter() - t0
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.tree_util.tree_map(lambda a: a.block_until_ready(), out)
+        times.append(time.perf_counter() - t0)
+    return compile_s, statistics.median(times)
+
+
+def main():
+    on_tpu = jax.default_backend() == "tpu"
+    rng = np.random.default_rng(0)
+    print(f"backend={jax.default_backend()}")
+    print(f"{'n':>10} {'lanes':>5} {'compile_s':>9} {'fused_ms':>9} {'unfused_ms':>10} {'speedup':>7}")
+
+    for n in N_GRID_TPU if on_tpu else N_GRID_CPU:
+        vals = tuple(jnp.asarray(rng.integers(0, 7, n).astype(np.int32)) for _ in range(LANES))
+        flags = jnp.asarray(rng.random(n) < 0.01)
+        ops = ("sum", "sum", "min")
+
+        with force_scan_impl("assoc"):
+            fused = jax.jit(lambda *a: segment_multi_scan(a[:-1], a[-1], ops=ops))
+            c_fused, t_fused = timed(fused, *vals, flags)
+            unfused = jax.jit(
+                lambda *a: tuple(
+                    segment_multi_scan((v,), a[-1], ops=(o,))[0] for v, o in zip(a[:-1], ops)
+                )
+            )
+            c_unf, t_unf = timed(unfused, *vals, flags)
+        print(
+            f"{n:>10} {LANES:>5} {c_fused:>9.2f} {t_fused * 1e3:>9.2f}"
+            f" {t_unf * 1e3:>10.2f} {t_unf / t_fused:>7.2f}"
+        )
+
+        # parity across tiers (interpret mode on CPU: small slice only)
+        sl = slice(0, 1 << 16)
+        with force_scan_impl("pallas_interpret" if not on_tpu else "pallas"):
+            pal = segment_multi_scan(tuple(v[sl] for v in vals), flags[sl], ops=ops)
+        with force_scan_impl("assoc"):
+            ref = segment_multi_scan(tuple(v[sl] for v in vals), flags[sl], ops=ops)
+        for p, r in zip(pal, ref):
+            assert jnp.array_equal(p, r)
+        print(f"{'':>10} parity assoc == {'pallas' if on_tpu else 'pallas_interpret'}: ok")
+
+
+if __name__ == "__main__":
+    main()

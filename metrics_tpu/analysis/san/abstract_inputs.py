@@ -318,6 +318,13 @@ def _ops_entrypoints() -> Dict[str, Tuple[Callable, Callable[[int], list]]]:
             segment.grouped_retrieval_scores,
             lambda n: _one(i32(n), f32(n), i32(n), metric="precision", top_k=2),
         ),
+        # the fused segmented multi-scan (ops/segment.py): two statistics in
+        # one pass — the round-10 fusion every post-sort curve/retrieval
+        # consumer routes through
+        "ops.segment_multi_scan": (
+            segment.segment_multi_scan,
+            lambda n: _one((i32(n), i32(n)), b8(n), ops=("sum", "min")),
+        ),
         "ops.confusion_counts": (
             confmat.confusion_counts,
             lambda n: _one(i32(n), i32(n), b8(n), num_classes=5),

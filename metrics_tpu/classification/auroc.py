@@ -16,6 +16,7 @@ from metrics_tpu.functional.classification.auroc import (
     _multiclass_auroc_compute,
     _multilabel_auroc_arg_validation,
     _multilabel_auroc_compute,
+    _reduce_scores,
 )
 from metrics_tpu.utils.enums import ClassificationTask
 
@@ -38,6 +39,7 @@ class BinaryAUROC(BinaryPrecisionRecallCurve):
     full_state_update: bool = False
     plot_lower_bound: float = 0.0
     plot_upper_bound: float = 1.0
+    _sketch_computable: bool = True  # tolerance= routes to the certified sketch tier
 
     def __init__(
         self,
@@ -50,10 +52,16 @@ class BinaryAUROC(BinaryPrecisionRecallCurve):
         super().__init__(thresholds=thresholds, ignore_index=ignore_index, validate_args=False, **kwargs)
         if validate_args:
             _binary_auroc_arg_validation(max_fpr, thresholds, ignore_index)
+        if self.tolerance > 0 and max_fpr is not None and max_fpr != 1:
+            raise ValueError(
+                "`tolerance > 0` certifies full-range AUROC only; partial-AUC `max_fpr` needs the exact tier."
+            )
         self.max_fpr = max_fpr
         self.validate_args = validate_args
 
     def compute(self) -> Array:
+        if self.thresholds is None and self.tolerance > 0:
+            return self._sketch_scores("auroc", "binary_auroc")[0]
         state = self._curve_state()
         return _binary_auroc_compute(state, self.thresholds, self.max_fpr)
 
@@ -77,6 +85,7 @@ class MulticlassAUROC(MulticlassPrecisionRecallCurve):
     plot_lower_bound: float = 0.0
     plot_upper_bound: float = 1.0
     plot_legend_name: str = "Class"
+    _sketch_computable: bool = True  # tolerance= routes to the certified sketch tier
 
     def __init__(
         self,
@@ -96,6 +105,9 @@ class MulticlassAUROC(MulticlassPrecisionRecallCurve):
         self.validate_args = validate_args
 
     def compute(self) -> Array:
+        if self.thresholds is None and self.tolerance > 0:
+            res, pos = self._sketch_scores("auroc", "multiclass_auroc")
+            return _reduce_scores(res, self.average, weights=pos)
         state = self._curve_state()
         return _multiclass_auroc_compute(state, self.num_classes, self.average, self.thresholds)
 
@@ -109,6 +121,7 @@ class MultilabelAUROC(MultilabelPrecisionRecallCurve):
     plot_lower_bound: float = 0.0
     plot_upper_bound: float = 1.0
     plot_legend_name: str = "Label"
+    _sketch_computable: bool = True  # tolerance= routes to the certified sketch tier
 
     def __init__(
         self,
@@ -128,6 +141,12 @@ class MultilabelAUROC(MultilabelPrecisionRecallCurve):
         self.validate_args = validate_args
 
     def compute(self) -> Array:
+        if self.thresholds is None and self.tolerance > 0:
+            if self.average == "micro":
+                # summed hist lanes == the exact micro flatten (shared key space)
+                return self._sketch_scores("auroc", "multilabel_auroc", micro=True)[0]
+            res, pos = self._sketch_scores("auroc", "multilabel_auroc")
+            return _reduce_scores(res, self.average, weights=pos)
         state = self._curve_state()
         return _multilabel_auroc_compute(state, self.num_labels, self.average, self.thresholds, self.ignore_index)
 

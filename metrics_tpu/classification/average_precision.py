@@ -9,6 +9,7 @@ from metrics_tpu.classification.precision_recall_curve import (
     MultilabelPrecisionRecallCurve,
 )
 from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.classification.auroc import _reduce_scores
 from metrics_tpu.functional.classification.average_precision import (
     _binary_average_precision_compute,
     _multiclass_average_precision_arg_validation,
@@ -37,8 +38,11 @@ class BinaryAveragePrecision(BinaryPrecisionRecallCurve):
     full_state_update: bool = False
     plot_lower_bound: float = 0.0
     plot_upper_bound: float = 1.0
+    _sketch_computable: bool = True  # tolerance= routes to the certified sketch tier
 
     def compute(self) -> Array:
+        if self.thresholds is None and self.tolerance > 0:
+            return self._sketch_scores("ap", "binary_ap")[0]
         state = self._curve_state()
         return _binary_average_precision_compute(state, self.thresholds)
 
@@ -52,6 +56,7 @@ class MulticlassAveragePrecision(MulticlassPrecisionRecallCurve):
     plot_lower_bound: float = 0.0
     plot_upper_bound: float = 1.0
     plot_legend_name: str = "Class"
+    _sketch_computable: bool = True  # tolerance= routes to the certified sketch tier
 
     def __init__(
         self,
@@ -71,6 +76,9 @@ class MulticlassAveragePrecision(MulticlassPrecisionRecallCurve):
         self.validate_args = validate_args
 
     def compute(self) -> Array:
+        if self.thresholds is None and self.tolerance > 0:
+            res, pos = self._sketch_scores("ap", "multiclass_ap")
+            return _reduce_scores(res, self.average, weights=pos)
         state = self._curve_state()
         return _multiclass_average_precision_compute(state, self.num_classes, self.average, self.thresholds)
 
@@ -84,6 +92,7 @@ class MultilabelAveragePrecision(MultilabelPrecisionRecallCurve):
     plot_lower_bound: float = 0.0
     plot_upper_bound: float = 1.0
     plot_legend_name: str = "Label"
+    _sketch_computable: bool = True  # tolerance= routes to the certified sketch tier
 
     def __init__(
         self,
@@ -103,6 +112,12 @@ class MultilabelAveragePrecision(MultilabelPrecisionRecallCurve):
         self.validate_args = validate_args
 
     def compute(self) -> Array:
+        if self.thresholds is None and self.tolerance > 0:
+            if self.average == "micro":
+                # summed hist lanes == the exact micro flatten (shared key space)
+                return self._sketch_scores("ap", "multilabel_ap", micro=True)[0]
+            res, pos = self._sketch_scores("ap", "multilabel_ap")
+            return _reduce_scores(res, self.average, weights=pos)
         state = self._curve_state()
         return _multilabel_average_precision_compute(
             state, self.num_labels, self.average, self.thresholds, self.ignore_index

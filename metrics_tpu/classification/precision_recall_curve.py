@@ -2,12 +2,16 @@
 
 Capability parity with reference ``classification/precision_recall_curve.py``
 (Binary :35-180, Multiclass :182-340, Multilabel :342-500, dispatcher :502-560).
-State is either cat-lists of raw scores (``thresholds=None``, exact mode) or one
+State is either cat-lists of raw scores (``thresholds=None``, exact mode), one
 summed ``(T, ..., 2, 2)`` confusion tensor (binned mode — the TPU streaming path,
-constant memory, single psum to sync).
+constant memory, single psum to sync), or — for the scalar AUROC/AP subclasses
+with ``tolerance > 0`` — per-class bucket histograms (sketch mode: O(1) integer
+state, no cat buffer, no sort; compute serves the certified-bracket midpoint,
+see ops/rank.py's sketch tier and sketches/auroc_bound.py).
 """
 from typing import Any, List, Optional, Tuple, Union
 
+import jax
 import jax.numpy as jnp
 from jax import Array
 
@@ -30,8 +34,10 @@ from metrics_tpu.functional.classification.precision_recall_curve import (
     _multilabel_precision_recall_curve_tensor_validation,
     _multilabel_precision_recall_curve_update,
 )
+from metrics_tpu.utils.checks import _is_concrete
 from metrics_tpu.utils.data import _count_dtype, dim_zero_cat
 from metrics_tpu.utils.enums import ClassificationTask
+from metrics_tpu.utils.prints import rank_zero_warn
 
 
 def _exact_cat_state(preds_state: Any, target_state: Any) -> Tuple[Array, Array]:
@@ -54,6 +60,118 @@ def _exact_cat_state(preds_state: Any, target_state: Any) -> Tuple[Array, Array]
 
 class _PrecisionRecallCurvePlotMixin:
     """Shared curve plot + state accessor for the three PR-curve tasks."""
+
+    # Scalar AUROC/AP subclasses opt in to the tolerance-routed sketch tier;
+    # curve-shaped metrics (PR curve, ROC) cannot — a certified bracket exists
+    # for the scalar summaries only, so they keep the exact cat/confmat state.
+    _sketch_computable: bool = False
+
+    def _init_tolerance(
+        self, tolerance: float, tolerance_bits: int, thresholds: Any, n_lanes: Optional[int] = None
+    ) -> bool:
+        """Validate + store the sketch knobs; register hist states when routed.
+
+        Returns True when ``tolerance > 0`` routed this instance to the sketch
+        tier (the caller then skips cat-state registration). Checks here are
+        structural, not advisory, so they run even with ``validate_args=False``
+        — a curve-shaped metric with hist state would fail only at compute.
+        """
+        self.tolerance = float(tolerance)
+        self.tolerance_bits = int(tolerance_bits)
+        if self.tolerance < 0:
+            raise ValueError(f"Expected argument `tolerance` to be non-negative, but got {tolerance}")
+        if not 4 <= self.tolerance_bits <= 14:
+            raise ValueError(
+                f"Expected argument `tolerance_bits` to be an int in [4, 14], but got {tolerance_bits}"
+            )
+        if self.tolerance == 0:
+            return False
+        if not self._sketch_computable:
+            raise ValueError(
+                "`tolerance > 0` requires a scalar sketch-computable metric (AUROC / AveragePrecision); "
+                f"{self.__class__.__name__} emits curve-shaped outputs that need the exact state."
+            )
+        if thresholds is not None:
+            raise ValueError(
+                "`tolerance > 0` applies to exact mode only — binned mode (`thresholds` set) "
+                "is already constant-memory."
+            )
+        nbuckets = 1 << self.tolerance_bits
+        shape = (nbuckets,) if n_lanes is None else (n_lanes, nbuckets)
+        self.add_state("pos_hist", jnp.zeros(shape, jnp.int32), dist_reduce_fx="sum")
+        self.add_state("neg_hist", jnp.zeros(shape, jnp.int32), dist_reduce_fx="sum")
+        return True
+
+    def _sketch_update(self, preds: Array, target: Array) -> None:
+        """Accumulate per-class bucket histograms (sketch tier, O(1) state).
+
+        Inputs are the *formatted* arrays (sigmoid/softmax applied, ignored
+        targets already -1). 2-D preds are one-vs-rest lanes: multiclass pairs
+        them with a 1-D label vector, multilabel with per-label targets whose
+        validity is masked per lane.
+        """
+        from metrics_tpu.ops import rank as _rank
+
+        from metrics_tpu.ops.clf_curve import _warm_record
+
+        bits = self.tolerance_bits
+        if preds.ndim == 1:
+            valid = target >= 0
+            pos_mask = target == 1
+            _warm_record("hist_class_counts", "sketch", (preds, pos_mask, valid), bits=bits)
+            pos, neg = _rank.hist_class_counts(preds, pos_mask, valid, bits=bits)
+        else:
+            pos_rows, neg_rows = [], []
+            for lane in range(preds.shape[1]):
+                if target.ndim == 1:  # multiclass one-vs-rest
+                    valid_l, pos_l = target >= 0, target == lane
+                else:  # multilabel: per-label validity
+                    valid_l, pos_l = target[:, lane] >= 0, target[:, lane] == 1
+                if lane == 0:  # lanes share one signature: record once
+                    _warm_record("hist_class_counts", "sketch", (preds[:, 0], pos_l, valid_l), bits=bits)
+                p, q = _rank.hist_class_counts(preds[:, lane], pos_l, valid_l, bits=bits)
+                pos_rows.append(p)
+                neg_rows.append(q)
+            pos, neg = jnp.stack(pos_rows), jnp.stack(neg_rows)
+        self.pos_hist = self.pos_hist + pos
+        self.neg_hist = self.neg_hist + neg
+
+    def _sketch_scores(self, kind: str, op: str, micro: bool = False) -> Tuple[Array, Array]:
+        """Serve (bracket midpoint, positive totals) from the hist states.
+
+        ``micro`` sums the per-label histogram lanes first — exact equivalent
+        of the micro flatten (all lanes share one key space). Emits the
+        ``rank.dispatch/sketch`` obs counter; eagerly warns when the realized
+        certificate is wider than the configured tolerance (scores concentrated
+        in one binade can defeat the exponent-keyed buckets — raise
+        ``tolerance_bits`` or drop to the exact tier).
+        """
+        from metrics_tpu.ops import rank as _rank
+        from metrics_tpu.ops.clf_curve import _warm_record
+
+        pos, neg = self.pos_hist, self.neg_hist
+        if micro:
+            pos, neg = pos.sum(axis=0), neg.sum(axis=0)
+        if kind == "auroc":
+            lo, hi = _rank.hist_auroc_bounds(pos, neg)
+            _warm_record("hist_auroc_bounds", "sketch", (pos, neg), bits=self.tolerance_bits)
+        else:
+            lo, hi = _rank.hist_ap_bounds(pos, neg)
+            _warm_record("hist_ap_bounds", "sketch", (pos, neg), bits=self.tolerance_bits)
+        pos_tot = jnp.sum(pos, axis=-1)
+        _rank.record_dispatch("sketch", op)
+        width = jnp.max(hi - lo)
+        if _is_concrete(width) and float(width) > self.tolerance:
+            rank_zero_warn(
+                f"Certified bound width {float(width):.3g} exceeds tolerance={self.tolerance} at "
+                f"tolerance_bits={self.tolerance_bits}. The served midpoint still lies inside the "
+                "certificate; raise `tolerance_bits` or use `tolerance=0` (exact tier) if needed.",
+                UserWarning,
+            )
+        mid = 0.5 * (lo + hi)
+        if kind == "ap":
+            mid = jnp.where(pos_tot > 0, mid, jnp.nan)  # exact tier's no-positives NaN
+        return mid.astype(jnp.float32), pos_tot.astype(jnp.float32)
 
     def _curve_state(self):
         """Confusion tensor (binned) or dense (preds, target) exact state.
@@ -93,13 +211,15 @@ class BinaryPrecisionRecallCurve(_PrecisionRecallCurvePlotMixin, Metric):
     full_state_update: bool = False
 
     # update-relevant ctor args (static compute-group signature; see core/metric.py)
-    _update_signature_attrs = ("thresholds", "ignore_index")
+    _update_signature_attrs = ("thresholds", "ignore_index", "tolerance", "tolerance_bits")
 
     def __init__(
         self,
         thresholds: Optional[Union[int, List[float], Array]] = None,
         ignore_index: Optional[int] = None,
         validate_args: bool = True,
+        tolerance: float = 0.0,
+        tolerance_bits: int = 12,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -108,11 +228,13 @@ class BinaryPrecisionRecallCurve(_PrecisionRecallCurvePlotMixin, Metric):
         self.ignore_index = ignore_index
         self.validate_args = validate_args
 
+        sketch_routed = self._init_tolerance(tolerance, tolerance_bits, thresholds)
         thresholds = _adjust_threshold_arg(thresholds)
         if thresholds is None:
             self.thresholds = None
-            self.add_state("preds", [], dist_reduce_fx="cat", cat_dtype=jnp.float32)
-            self.add_state("target", [], dist_reduce_fx="cat", cat_dtype=jnp.int32)
+            if not sketch_routed:
+                self.add_state("preds", [], dist_reduce_fx="cat", cat_dtype=jnp.float32)
+                self.add_state("target", [], dist_reduce_fx="cat", cat_dtype=jnp.int32)
         else:
             self.register_threshold_state(thresholds, (len(thresholds), 2, 2))
 
@@ -124,6 +246,9 @@ class BinaryPrecisionRecallCurve(_PrecisionRecallCurvePlotMixin, Metric):
         if self.validate_args:
             _binary_precision_recall_curve_tensor_validation(preds, target, self.ignore_index)
         preds, target, _ = _binary_precision_recall_curve_format(preds, target, self.thresholds, self.ignore_index)
+        if self.thresholds is None and self.tolerance > 0:
+            self._sketch_update(preds, target)
+            return
         state = _binary_precision_recall_curve_update(preds, target, self.thresholds)
         if isinstance(state, tuple):
             self.preds.append(state[0])
@@ -143,7 +268,7 @@ class MulticlassPrecisionRecallCurve(_PrecisionRecallCurvePlotMixin, Metric):
     full_state_update: bool = False
 
     # update-relevant ctor args (static compute-group signature; see core/metric.py)
-    _update_signature_attrs = ("num_classes", "thresholds", "ignore_index")
+    _update_signature_attrs = ("num_classes", "thresholds", "ignore_index", "tolerance", "tolerance_bits")
 
     def __init__(
         self,
@@ -151,6 +276,8 @@ class MulticlassPrecisionRecallCurve(_PrecisionRecallCurvePlotMixin, Metric):
         thresholds: Optional[Union[int, List[float], Array]] = None,
         ignore_index: Optional[int] = None,
         validate_args: bool = True,
+        tolerance: float = 0.0,
+        tolerance_bits: int = 12,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -160,11 +287,15 @@ class MulticlassPrecisionRecallCurve(_PrecisionRecallCurvePlotMixin, Metric):
         self.ignore_index = ignore_index
         self.validate_args = validate_args
 
+        sketch_routed = self._init_tolerance(tolerance, tolerance_bits, thresholds, n_lanes=num_classes)
         thresholds = _adjust_threshold_arg(thresholds)
         if thresholds is None:
             self.thresholds = None
-            self.add_state("preds", [], dist_reduce_fx="cat", cat_item_shape=(num_classes,), cat_dtype=jnp.float32)
-            self.add_state("target", [], dist_reduce_fx="cat", cat_dtype=jnp.int32)
+            if not sketch_routed:
+                self.add_state(
+                    "preds", [], dist_reduce_fx="cat", cat_item_shape=(num_classes,), cat_dtype=jnp.float32
+                )
+                self.add_state("target", [], dist_reduce_fx="cat", cat_dtype=jnp.int32)
         else:
             self.thresholds = thresholds
             self.add_state(
@@ -177,6 +308,9 @@ class MulticlassPrecisionRecallCurve(_PrecisionRecallCurvePlotMixin, Metric):
         preds, target, _ = _multiclass_precision_recall_curve_format(
             preds, target, self.num_classes, self.thresholds, self.ignore_index
         )
+        if self.thresholds is None and self.tolerance > 0:
+            self._sketch_update(preds, target)
+            return
         state = _multiclass_precision_recall_curve_update(preds, target, self.num_classes, self.thresholds)
         if isinstance(state, tuple):
             self.preds.append(state[0])
@@ -196,7 +330,7 @@ class MultilabelPrecisionRecallCurve(_PrecisionRecallCurvePlotMixin, Metric):
     full_state_update: bool = False
 
     # update-relevant ctor args (static compute-group signature; see core/metric.py)
-    _update_signature_attrs = ("num_labels", "thresholds", "ignore_index")
+    _update_signature_attrs = ("num_labels", "thresholds", "ignore_index", "tolerance", "tolerance_bits")
 
     def __init__(
         self,
@@ -204,6 +338,8 @@ class MultilabelPrecisionRecallCurve(_PrecisionRecallCurvePlotMixin, Metric):
         thresholds: Optional[Union[int, List[float], Array]] = None,
         ignore_index: Optional[int] = None,
         validate_args: bool = True,
+        tolerance: float = 0.0,
+        tolerance_bits: int = 12,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -213,11 +349,17 @@ class MultilabelPrecisionRecallCurve(_PrecisionRecallCurvePlotMixin, Metric):
         self.ignore_index = ignore_index
         self.validate_args = validate_args
 
+        sketch_routed = self._init_tolerance(tolerance, tolerance_bits, thresholds, n_lanes=num_labels)
         thresholds = _adjust_threshold_arg(thresholds)
         if thresholds is None:
             self.thresholds = None
-            self.add_state("preds", [], dist_reduce_fx="cat", cat_item_shape=(num_labels,), cat_dtype=jnp.float32)
-            self.add_state("target", [], dist_reduce_fx="cat", cat_item_shape=(num_labels,), cat_dtype=jnp.int32)
+            if not sketch_routed:
+                self.add_state(
+                    "preds", [], dist_reduce_fx="cat", cat_item_shape=(num_labels,), cat_dtype=jnp.float32
+                )
+                self.add_state(
+                    "target", [], dist_reduce_fx="cat", cat_item_shape=(num_labels,), cat_dtype=jnp.int32
+                )
         else:
             self.thresholds = thresholds
             self.add_state(
@@ -230,6 +372,9 @@ class MultilabelPrecisionRecallCurve(_PrecisionRecallCurvePlotMixin, Metric):
         preds, target, _ = _multilabel_precision_recall_curve_format(
             preds, target, self.num_labels, self.thresholds, self.ignore_index
         )
+        if self.thresholds is None and self.tolerance > 0:
+            self._sketch_update(preds, target)
+            return
         state = _multilabel_precision_recall_curve_update(preds, target, self.num_labels, self.thresholds)
         if isinstance(state, tuple):
             self.preds.append(state[0])

@@ -94,14 +94,20 @@ def _binary_auroc_compute(
     thresholds: Optional[Array],
     max_fpr: Optional[float] = None,
     pos_label: int = 1,
+    tolerance: float = 0.0,
+    tolerance_bits: int = 12,
 ) -> Array:
     """Reference: auroc.py:82-106 (incl. McClish-corrected partial AUC).
 
     Exact mode (``thresholds=None``) runs fully on device — sort+cumsum with
     tie-run collapsing (ops/clf_curve.py) instead of the reference's host path.
+    ``tolerance > 0`` opts into the certified sublinear sketch tier when the
+    bracket width fits (ops/clf_curve.py `_sketch_dispatch`).
     """
     if not _is_confmat_state(state):
-        return binary_auroc_exact(state[0], state[1], max_fpr=max_fpr)
+        return binary_auroc_exact(
+            state[0], state[1], max_fpr=max_fpr, tolerance=tolerance, tolerance_bits=tolerance_bits
+        )
     fpr, tpr, _ = _binary_roc_compute(state, thresholds, pos_label)
     if max_fpr is None or max_fpr == 1:
         return _auc_compute_without_check(fpr, tpr, 1.0)
@@ -120,14 +126,21 @@ def binary_auroc(
     thresholds=None,
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
+    tolerance: float = 0.0,
+    tolerance_bits: int = 12,
 ) -> Array:
-    """Binary AUROC (reference: auroc.py:109-188)."""
+    """Binary AUROC (reference: auroc.py:109-188).
+
+    ``tolerance > 0`` permits the sublinear sketch tier: when the certified
+    bracket width at ``tolerance_bits`` fits, the bracket midpoint is served
+    (no sort); otherwise the exact tier runs unchanged.
+    """
     if validate_args:
         _binary_auroc_arg_validation(max_fpr, thresholds, ignore_index)
         _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
     preds, target, thresholds = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
     state = _binary_precision_recall_curve_update(preds, target, thresholds)
-    return _binary_auroc_compute(state, thresholds, max_fpr)
+    return _binary_auroc_compute(state, thresholds, max_fpr, tolerance=tolerance, tolerance_bits=tolerance_bits)
 
 
 def _multiclass_auroc_arg_validation(

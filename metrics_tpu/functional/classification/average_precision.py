@@ -54,11 +54,16 @@ def _reduce_average_precision(
 def _binary_average_precision_compute(
     state: Union[Array, Tuple[Array, Array]],
     thresholds: Optional[Array],
+    tolerance: float = 0.0,
+    tolerance_bits: int = 12,
 ) -> Array:
     """Reference: average_precision.py:70-75. Exact mode runs fully on device
-    (sort+cumsum kernel, ops/clf_curve.py)."""
+    (sort+cumsum kernel, ops/clf_curve.py); ``tolerance > 0`` opts into the
+    certified sublinear sketch tier when the bracket width fits."""
     if not _is_confmat_state(state):
-        return binary_average_precision_exact(state[0], state[1])
+        return binary_average_precision_exact(
+            state[0], state[1], tolerance=tolerance, tolerance_bits=tolerance_bits
+        )
     precision, recall, _ = _binary_precision_recall_curve_compute(state, thresholds)
     return -jnp.sum((recall[1:] - recall[:-1]) * precision[:-1])
 
@@ -69,14 +74,21 @@ def binary_average_precision(
     thresholds=None,
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
+    tolerance: float = 0.0,
+    tolerance_bits: int = 12,
 ) -> Array:
-    """Binary AP (reference: average_precision.py:78-160)."""
+    """Binary AP (reference: average_precision.py:78-160).
+
+    ``tolerance > 0`` permits the sublinear sketch tier: when the certified
+    bracket width at ``tolerance_bits`` fits, the bracket midpoint is served
+    (no sort); otherwise the exact tier runs unchanged.
+    """
     if validate_args:
         _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
         _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
     preds, target, thresholds = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
     state = _binary_precision_recall_curve_update(preds, target, thresholds)
-    return _binary_average_precision_compute(state, thresholds)
+    return _binary_average_precision_compute(state, thresholds, tolerance=tolerance, tolerance_bits=tolerance_bits)
 
 
 def _multiclass_average_precision_arg_validation(

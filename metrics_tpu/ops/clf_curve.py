@@ -42,12 +42,8 @@ import jax.numpy as jnp
 from jax import Array
 
 from metrics_tpu.ops import rank as _rank
+from metrics_tpu.ops.segment import segment_multi_scan
 from metrics_tpu.utils.data import _next_pow2
-
-
-def _suffix_min(x: Array) -> Array:
-    """Minimum over the suffix x[i:] for every i (reverse cumulative min)."""
-    return jnp.flip(jax.lax.cummin(jnp.flip(x)))
 
 
 def _run_end_counts(
@@ -68,7 +64,11 @@ def _run_end_counts(
     (argsort + gathers cost ~90 ms per 16M-element gather on TPU), and tie-run ends
     propagate by a reverse cummin scan of the boundary-masked cumsums —
     ``searchsorted`` is a serialized gather loop under XLA (~3.7 s at 16M vs ~35 ms
-    for the scan).
+    for the scan). Since round 10 the post-sort tail is exactly TWO scan passes:
+    the forward label cumsum, and ONE fused reverse multi-scan
+    (ops/segment.py:segment_multi_scan) propagating both run-end streams (tps,
+    run position) together — int mins are exact under reassociation, so the
+    result is bit-identical to the two independent suffix-min scans it replaces.
     """
     if tier == "rank":
         return _rank.rank_run_end_counts(preds, target, valid)
@@ -84,8 +84,12 @@ def _run_end_counts(
     boundary = jnp.concatenate([sk[1:] != sk[:-1], jnp.ones((1,), bool)])
     big = jnp.int32(2**31 - 1)
     pos = jnp.arange(n, dtype=jnp.int32)
-    tps = _suffix_min(jnp.where(boundary, tps_all, big))
-    run_end = _suffix_min(jnp.where(boundary, pos, n - 1))
+    tps, run_end = segment_multi_scan(
+        (jnp.where(boundary, tps_all, big), jnp.where(boundary, pos, n - 1)),
+        None,  # statically one global segment: suffix-min over the whole array
+        ops=("min", "min"),
+        reverse=True,
+    )
     # valid rows sort first, so the valid count up to run_end is min(run_end+1, n_valid)
     n_valid = jnp.sum((st >= 0).astype(jnp.int32))
     fps = jnp.minimum(run_end + 1, n_valid) - tps
@@ -175,18 +179,76 @@ _binary_ap_j = jax.jit(
 )
 
 
-def _warm_record(op: str, tier: str, arrays: Tuple[Array, ...], max_fpr: Optional[float] = None) -> None:
+def _warm_record(
+    op: str,
+    tier: str,
+    arrays: Tuple[Array, ...],
+    max_fpr: Optional[float] = None,
+    bits: Optional[int] = None,
+) -> None:
     """Record a rank-tier dispatch signature into the excache warm manifest.
 
     The kernels here are module-level jits, so the per-(shape, dtype, tier)
     compile is the replica cold-start cost prewarm eliminates. Arrays are the
     *padded* kernel inputs — pow-of-two shapes, so a prewarm replay pads to
-    itself and compiles the exact executable. No-op (one dict probe) unless
-    serve/excache.py is imported and recording.
+    itself and compiles the exact executable. ``bits`` rides along for
+    sketch-tier entries (the bracket kernel's static bit depth is part of its
+    compile key). No-op (one dict probe) unless serve/excache.py is imported
+    and recording.
     """
     _excache = _sys.modules.get("metrics_tpu.serve.excache")
     if _excache is not None and _excache.recording():
-        _excache.record_rank_compile(op, tier, arrays, max_fpr)
+        _excache.record_rank_compile(op, tier, arrays, max_fpr, bits)
+
+
+def _sketch_dispatch(
+    op: str,
+    obs_op: str,
+    preds: Array,
+    target: Array,
+    valid: Array,
+    tolerance: float,
+    bits: int,
+    kind: str,
+) -> Optional[Array]:
+    """Tolerance-routed sublinear tier for the scalar AUROC/AP entry points.
+
+    Returns the certified bracket midpoint when the route is taken, None when
+    the caller must fall back to the exact sort tier. The route is taken when
+    (a) dispatch is forced to ``"sketch"`` (tests/prewarm replay — the width
+    check is skipped), or (b) ``tolerance > 0``, the inputs are CONCRETE, and
+    the bracket width at ``bits`` comes out <= tolerance. The width check needs
+    the realized histogram (one O(N) compare pass, the probe cost of
+    auto-dispatch; ~2-8 ms at 2^24 vs ~125 ms for the sort it replaces), so
+    under a trace the certificate cannot be consulted and tolerance routing
+    degrades to the exact tier — tolerance-routed METRIC classes avoid this by
+    carrying histogram state directly (classification/precision_recall_curve.py).
+    Served midpoints are never more than width/2 <= tolerance from the exact
+    value, with the exact tier's degenerate semantics preserved (AUROC -> 0.0,
+    AP -> NaN when the relevant class is absent).
+    """
+    forced = _rank.forced_tier()
+    if forced not in (None, "sketch"):
+        return None
+    if forced != "sketch":
+        if not tolerance or tolerance <= 0:
+            return None
+        if isinstance(preds, jax.core.Tracer) or isinstance(target, jax.core.Tracer):
+            return None
+    if kind == "auroc":
+        lo, hi = _rank.sketch_auroc_bracket(preds, target, valid, bits=bits)
+        pos_tot = None
+    else:
+        lo, hi, pos_tot = _rank.sketch_ap_bracket(preds, target, valid, bits=bits)
+    if forced != "sketch" and float(hi - lo) > tolerance:
+        return None
+    _rank.record_dispatch("sketch", obs_op)
+    _warm_record(op, "sketch", (preds, target), bits=bits)
+    with _rank.rank_scope("sketch"):
+        mid = 0.5 * (lo + hi)
+        if pos_tot is not None:
+            mid = jnp.where(pos_tot > 0, mid, jnp.nan)
+        return mid
 
 
 def _pad_binary(preds: Array, target: Array) -> Tuple[Array, Array, Array]:
@@ -302,14 +364,33 @@ def binary_roc_curve_padded(preds: Array, target: Array) -> Tuple[Array, Array, 
     return _binary_roc_padded_j(preds, target, valid)
 
 
-def binary_auroc_exact(preds: Array, target: Array, max_fpr: Optional[float] = None) -> Array:
+def binary_auroc_exact(
+    preds: Array,
+    target: Array,
+    max_fpr: Optional[float] = None,
+    tolerance: float = 0.0,
+    tolerance_bits: int = 12,
+) -> Array:
     """Exact (``thresholds=None``) binary AUROC fully on device.
 
     ``target`` entries < 0 (ignore_index masks / buffer padding) are excluded.
-    Dispatches between the f32 oracle sort and the rank engine's reduced-payload
-    tier (ops/rank.py); the choice is visible under obs as ``rank.dispatch/*``.
+    Dispatches between the f32 oracle sort, the rank engine's reduced-payload
+    tier, and — when ``tolerance > 0`` certifies it — the sublinear sketch tier
+    (ops/rank.py); the choice is visible under obs as ``rank.dispatch/*``.
+
+    ``tolerance`` opts into the sketch tier: if the certified bracket width at
+    ``tolerance_bits`` histogram bits is <= tolerance, the bracket midpoint is
+    served from one histogram pass (no sort; true error <= width/2); otherwise
+    the exact tier runs as if tolerance were 0. ``max_fpr`` not in (None, 1)
+    always takes the exact tier (no partial-AUC certificate exists).
     """
     preds, target, valid = _pad_binary(preds, target)
+    if max_fpr is None or max_fpr == 1:
+        routed = _sketch_dispatch(
+            "binary_auroc_exact", "binary_auroc", preds, target, valid, tolerance, tolerance_bits, "auroc"
+        )
+        if routed is not None:
+            return routed
     tier = _rank.select_tier(preds)
     _rank.record_dispatch(tier, "binary_auroc")
     _warm_record("binary_auroc_exact", tier, (preds, target), max_fpr)
@@ -322,9 +403,18 @@ def binary_auroc_exact(preds: Array, target: Array, max_fpr: Optional[float] = N
         return _binary_auroc_partial_j(preds, target, valid, jnp.float32(max_fpr), tier=tier)
 
 
-def binary_average_precision_exact(preds: Array, target: Array) -> Array:
-    """Exact binary average precision fully on device (tiered like AUROC)."""
+def binary_average_precision_exact(
+    preds: Array, target: Array, tolerance: float = 0.0, tolerance_bits: int = 12
+) -> Array:
+    """Exact binary average precision fully on device (tiered like AUROC,
+    including the ``tolerance``-certified sublinear sketch route; no-positive
+    data returns NaN on every tier)."""
     preds, target, valid = _pad_binary(preds, target)
+    routed = _sketch_dispatch(
+        "binary_average_precision_exact", "binary_ap", preds, target, valid, tolerance, tolerance_bits, "ap"
+    )
+    if routed is not None:
+        return routed
     tier = _rank.select_tier(preds)
     _rank.record_dispatch(tier, "binary_ap")
     _warm_record("binary_average_precision_exact", tier, (preds, target))

@@ -16,11 +16,17 @@ magnitude under the memory roofline. This module provides the TPU-native tiers:
    measured element-compare bandwidth (~8.8 Gelem/s at 25 bins, +6% over the
    fused XLA form) and keeps VMEM bounded. Since round 6 the output block is
    additionally TILED over bins (``_BIN_TILE`` = 64 bins per grid column), so
-   the kernel's ceiling is no longer the 64 bins one output block could hold:
-   ``PALLAS_MAX_BINS`` now sits at 256. The compare work is O(num_bins * N) in
-   BOTH this tier and the fused-XLA tier, so the only measured anchor for the
-   crossover is the +6% at 25 bins; the 256..2048 range keeps the XLA form
-   until experiments/rank_exp.py's tier grid is run on the TPU chip.
+   the kernel's ceiling is no longer the 64 bins one output block could hold.
+   Round 10 closed the open 256..2048 crossover question
+   (experiments/histogram_crossover.py): compare work is O(num_bins * N) in
+   BOTH this tier and the fused-XLA tier, the grid confirms the compare tier
+   scales linearly in bins across 256..2048 with bit-parity to the kernel
+   (weighted and unweighted), and the kernel's per-element work is identical
+   at every 64-bin column — the only added cost at 2048 bins is 32x grid-step
+   bookkeeping on a VMEM-resident input block, «1% of a block's compare work
+   at ``PALLAS_MIN_SIZE``. Verdict: the +6% anchor carries the whole range, so
+   ``PALLAS_MAX_BINS`` is now 2048 (the full compare range; directional until
+   a TPU round of the grid re-pins the measured ratio).
 
 3. **One-hot MXU pair-split** (TPU only): for ``2048 < num_bins <= 2^14`` the
    bin index splits as ``hi*64 + lo`` and the histogram is the flattened
@@ -42,7 +48,7 @@ import jax.numpy as jnp
 from jax import Array
 
 COMPARE_MAX_BINS = 2048
-PALLAS_MAX_BINS = 256
+PALLAS_MAX_BINS = 2048  # round 10: full compare range (experiments/histogram_crossover.py)
 PAIRSPLIT_MAX_BINS = 1 << 14
 PAIRSPLIT_MIN_SIZE = 1 << 18
 PALLAS_MIN_SIZE = 1 << 18

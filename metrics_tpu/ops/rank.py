@@ -62,6 +62,7 @@ the oracle's two 64 MB key negations); bucket histograms 2-8 ms per pass
 (Pallas/MXU tiers).
 """
 from contextlib import contextmanager
+from functools import partial
 from typing import Iterator, Optional, Tuple
 
 import jax
@@ -124,14 +125,19 @@ def key_to_f32_descending(keys: Array) -> Array:
 
 @contextmanager
 def force_tier(tier: Optional[str]) -> Iterator[None]:
-    """Pin rank-engine dispatch to ``"rank"``/``"sort"`` (None restores auto).
+    """Pin rank-engine dispatch to ``"rank"``/``"sort"``/``"sketch"`` (None
+    restores auto).
 
     Trace-time effect only: callers thread the selected tier into their jitted
     kernels as a static argument, so a pinned tier forms its own compile key
-    and cannot leak through a stale cache entry.
+    and cannot leak through a stale cache entry. ``"sketch"`` applies only to
+    the scalar AUROC/AP entry points (ops/clf_curve.py), which probe
+    :func:`forced_tier` directly and then skip the certificate-width check;
+    ops without a sketch form (curve-shaped outputs, retrieval) see
+    :func:`select_tier` degrade a forced sketch to the ``"sort"`` oracle.
     """
     global _FORCED_TIER
-    if tier not in (None, "rank", "sort"):
+    if tier not in (None, "rank", "sort", "sketch"):
         raise ValueError(f"unknown rank tier: {tier!r}")
     prev = _FORCED_TIER
     _FORCED_TIER = tier
@@ -141,15 +147,23 @@ def force_tier(tier: Optional[str]) -> Iterator[None]:
         _FORCED_TIER = prev
 
 
+def forced_tier() -> Optional[str]:
+    """The tier pinned by :func:`force_tier`, or None under auto dispatch."""
+    return _FORCED_TIER
+
+
 def select_tier(x: Array) -> str:
     """histogram.py-style tier choice: TPU + unsharded + large-N -> "rank".
 
     Everything else keeps the f32 oracle sort — including sharded inputs (the
     reduced-payload sort is still a global op) and small batches where the
-    key-conversion passes outweigh the byte savings.
+    key-conversion passes outweigh the byte savings. Never returns
+    ``"sketch"`` on its own: the sublinear tier is entered only through a
+    caller-supplied error tolerance (or a forced tier) at the scalar AUROC/AP
+    entry points — exactness is the default contract.
     """
     if _FORCED_TIER is not None:
-        return _FORCED_TIER
+        return "sort" if _FORCED_TIER == "sketch" else _FORCED_TIER
     if x.size >= RANK_MIN_SIZE and _on_tpu(x) and _provably_unsharded(x):
         return "rank"
     return "sort"
@@ -180,11 +194,6 @@ def rank_scope(tier: str):
 # ------------------------------------------------------- reduced-payload tier
 
 
-def _suffix_min(x: Array) -> Array:
-    """Minimum over the suffix x[i:] for every i (reverse cumulative min)."""
-    return jnp.flip(jax.lax.cummin(jnp.flip(x)))
-
-
 def rank_run_end_counts(preds: Array, target: Array, valid: Array) -> Tuple[Array, Array, Array, Array]:
     """Rank-tier construction of ``(fps, tps, sk, boundary)`` — bit-identical to
     the f32 oracle (ops/clf_curve.py:_run_end_counts).
@@ -195,8 +204,12 @@ def rank_run_end_counts(preds: Array, target: Array, valid: Array) -> Tuple[Arra
     (identical under the bijection), and ``tps``/``fps`` read only run-END
     cumulative counts (per-run label totals are multiset properties). The f32
     ``sk`` is reconstructed through the exact inverse bijection, so downstream
-    float code sees bit-identical inputs.
+    float code sees bit-identical inputs. The post-sort tail is the same two
+    scan passes as the oracle: one cumsum + ONE fused reverse multi-scan for
+    both run-end streams (ops/segment.py:segment_multi_scan).
     """
+    from metrics_tpu.ops.segment import segment_multi_scan
+
     n = preds.shape[0]
     key = monotone_key_descending(preds, valid)
     lab = jnp.where(valid, (target == 1).astype(jnp.uint8), jnp.uint8(2))
@@ -205,8 +218,12 @@ def rank_run_end_counts(preds: Array, target: Array, valid: Array) -> Tuple[Arra
     boundary = jnp.concatenate([skey[1:] != skey[:-1], jnp.ones((1,), bool)])
     big = jnp.int32(2**31 - 1)
     pos = jnp.arange(n, dtype=jnp.int32)
-    tps = _suffix_min(jnp.where(boundary, tps_all, big))
-    run_end = _suffix_min(jnp.where(boundary, pos, n - 1))
+    tps, run_end = segment_multi_scan(
+        (jnp.where(boundary, tps_all, big), jnp.where(boundary, pos, n - 1)),
+        None,  # statically one global segment: suffix-min over the whole array
+        ops=("min", "min"),
+        reverse=True,
+    )
     n_valid = jnp.sum((slab != 2).astype(jnp.int32))
     fps = jnp.minimum(run_end + 1, n_valid) - tps
     return fps, tps, key_to_f32_descending(skey), boundary
@@ -354,6 +371,84 @@ def average_precision_bounds_from_hists(pos_hist: Array, neg_hist: Array) -> Tup
     lo = jnp.where(any_pos, jnp.sum(worst) / denom, 0.0)
     hi = jnp.where(any_pos, jnp.sum(best) / denom, 0.0)
     return lo, hi
+
+
+# ------------------------------------------------- sketch tier (tolerance route)
+#
+# Round 10, the sublinear serving tier: when the caller supplies an error
+# ``tolerance``, the scalar AUROC/AP entry points (ops/clf_curve.py) probe one
+# bucket-histogram pass — O(N) compares, no sort — and serve the certified
+# bracket MIDPOINT whenever the bracket width fits the tolerance, falling back
+# to the exact sort tier otherwise. The same histogram algebra backs the O(1)-
+# state ``sketches.StreamingAUROCBound`` and the tolerance-routed Metric
+# classes (classification/*, ``tolerance=`` ctor knob): continuous traffic then
+# never materializes, sorts, or checkpoints a cat buffer unless it asked for
+# exactness. The midpoint is inside the certificate by construction, so the
+# served value's true error is at most width/2 <= tolerance.
+
+#: Default histogram bit depth for tolerance-routed dispatch; matches
+#: sketches.StreamingAUROCBound. 2^bits buckets over the key space — +1 bit
+#: halves the expected bracket width for spread-spectrum scores.
+SKETCH_DEFAULT_BITS = 12
+
+
+@partial(jax.jit, static_argnames=("bits",))
+def sketch_auroc_bracket(preds: Array, target: Array, valid: Array, bits: int = SKETCH_DEFAULT_BITS) -> Tuple[Array, Array]:
+    """Certified [lower, upper] AUROC bracket in one histogram pass (no sort).
+
+    Degenerate (single-class) data collapses the bracket to [0, 0] — the same
+    0.0 the exact full-AUC tier returns, so the midpoint agrees with the exact
+    tier's degenerate semantics.
+    """
+    keys = monotone_key_descending(preds, valid)
+    pos_hist, neg_hist = class_bucket_counts(keys, target == 1, valid, bits)
+    return auroc_bounds_from_hists(pos_hist, neg_hist)
+
+
+@partial(jax.jit, static_argnames=("bits",))
+def sketch_ap_bracket(
+    preds: Array, target: Array, valid: Array, bits: int = SKETCH_DEFAULT_BITS
+) -> Tuple[Array, Array, Array]:
+    """Certified [lower, upper] average-precision bracket plus the positive
+    count (callers map ``pos_total == 0`` to the exact tier's NaN)."""
+    keys = monotone_key_descending(preds, valid)
+    pos_hist, neg_hist = class_bucket_counts(keys, target == 1, valid, bits)
+    lo, hi = average_precision_bounds_from_hists(pos_hist, neg_hist)
+    return lo, hi, jnp.sum(pos_hist)
+
+
+@partial(jax.jit, static_argnames=("bits",))
+def hist_class_counts(
+    preds: Array, pos_mask: Array, valid: Array, bits: int = SKETCH_DEFAULT_BITS
+) -> Tuple[Array, Array]:
+    """One lane of sketch-tier accumulation: scores -> (pos_hist, neg_hist).
+
+    The update-side compile unit of the tolerance-routed Metric classes
+    (classification/precision_recall_curve.py) — they carry histogram state
+    directly, so split the bracket into this accumulating half plus the
+    :func:`hist_auroc_bounds` / :func:`hist_ap_bounds` compute half. Jitted
+    module-level so excache prewarm can replay the exact executable.
+    """
+    keys = monotone_key_descending(preds, valid)
+    return class_bucket_counts(keys, pos_mask, valid, bits)
+
+
+@jax.jit
+def hist_auroc_bounds(pos_hist: Array, neg_hist: Array) -> Tuple[Array, Array]:
+    """Certified AUROC bounds from accumulated histograms; 2-D hists are
+    treated as per-class lanes (vmapped — multiclass OvR / multilabel)."""
+    if pos_hist.ndim == 1:
+        return auroc_bounds_from_hists(pos_hist, neg_hist)
+    return jax.vmap(auroc_bounds_from_hists)(pos_hist, neg_hist)
+
+
+@jax.jit
+def hist_ap_bounds(pos_hist: Array, neg_hist: Array) -> Tuple[Array, Array]:
+    """Certified average-precision bounds from accumulated histograms; 2-D
+    hists are treated as per-class lanes (vmapped)."""
+    if pos_hist.ndim == 1:
+        return average_precision_bounds_from_hists(pos_hist, neg_hist)
+    return jax.vmap(average_precision_bounds_from_hists)(pos_hist, neg_hist)
 
 
 # --------------------------------------------------------- sort-slim helpers

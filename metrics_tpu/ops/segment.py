@@ -25,10 +25,13 @@ Measured design notes (4.2M docs / 65k queries, v5e, device_get-synced p50 —
 - **Within-segment positions come from scans, not segment_min+gather**:
   ``cummax(where(new_seg, pos, 0))`` broadcasts each segment's start row to its
   members in one associative scan.
-- Net: RetrievalMAP end-to-end went 8.4 -> 22.0 Mdocs/s (the remaining time is
-  the sort at ~45 ms + ~4 linear scans/scatters at ~15-25 ms each; a fused
-  one-pass segmented scan would need a hand-written kernel for <2x more).
-  Experiment grid: experiments/retrieval_exp.py.
+- Net: RetrievalMAP end-to-end went 8.4 -> 22.0 Mdocs/s (the remaining time was
+  the sort at ~45 ms + ~4 linear scans/scatters at ~15-25 ms each).
+  Experiment grid: experiments/retrieval_exp.py. Round 10 landed that fused
+  kernel: :func:`segment_multi_scan` folds every integer statistic into one
+  pass (associative_scan tuple carry portable / Pallas streaming on TPU), so
+  the post-sort integer scan count is now <= 2 fused passes per metric
+  (3 for r_precision's total-gated re-count), down from ~5 global scan pairs.
 - **Round 6, the sort's operand bytes** (the bitonic network costs ~passes x
   bytes, see ops/rank.py): the layout sort now carries (indexes, -preds,
   target) only — 12 B/row vs the old 20 (sorted keys come out of ``lax.sort``
@@ -42,11 +45,14 @@ Measured design notes (4.2M docs / 65k queries, v5e, device_get-synced p50 —
   experiments/rank_exp.py.
 """
 
-from typing import Optional, Tuple
+from contextlib import contextmanager
+from typing import Iterator, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import Array
+
+from metrics_tpu.ops.histogram import _on_tpu, _provably_unsharded
 
 
 def _segment_cumsum_nonneg(values: Array, new_seg: Array) -> Array:
@@ -141,6 +147,296 @@ def _segment_suffix_sum_nonneg(values: Array, is_last: Array) -> Array:
     return rev(_segment_cumsum_nonneg(rev(values), rev(is_last)))
 
 
+# ------------------------------------------------------ fused segmented multi-scan
+#
+# Round 10: every retrieval/curve compute used to issue one GLOBAL scan pair per
+# statistic (~5 cumsum/cummax passes post-sort, each a full read+write of the
+# sorted rows). ``segment_multi_scan`` computes ALL the integer per-segment
+# running statistics behind one entry point with three tiers:
+#
+# - **Pallas (TPU, n >= SEGSCAN_PALLAS_MIN_SIZE)** — the tier the fusion exists
+#   for: streams blocks through VMEM, runs a flag-aware Hillis-Steele doubling
+#   scan in-register, carries the open segment across blocks in scratch — ONE
+#   HBM read + one write for all k statistics.
+# - **assoc** — a single ``lax.associative_scan`` over a tuple carry under the
+#   segmented monoid  (fa, a) ⊕ (fb, b) = (fa | fb,  fb ? b : op(a, b)).
+#   Fully general (min/max lanes over arbitrary flags) but costs ~0.7 s of XLA
+#   compile PER JITTED SHAPE on CPU (~5 s at 2^24; probe in
+#   experiments/segment_fused_probe.py) — fine for a warm serving process
+#   (excache pays it once), hostile to multi-shape cold paths and CI.
+# - **native** — per-lane ``cumsum``/``cummax``/``cummin`` XLA scan primitives:
+#   sum lanes via the sign-split cummax-base trick, and any op when the caller
+#   statically declares ONE global segment (``new_seg=None``). Compiles in
+#   milliseconds; the off-TPU default whenever it applies.
+#
+# Int sums/mins/maxes are exact under any association, so all tiers are
+# bit-identical to the unfused scans. The 2^24-row associative_scan
+# compile-time rejection recorded above applied to the per-element FLOAT scan
+# variants tried in round 5 on the tunneled v5e backend. Float streams keep
+# :func:`_segment_cumsum_float` (precision contract).
+
+#: Below this row count the associative_scan tier wins (kernel launch + padding
+#: overheads dominate); mirrors histogram.py's PALLAS_MIN_SIZE.
+SEGSCAN_PALLAS_MIN_SIZE = 1 << 18
+#: Pallas block width: a lane multiple; log2(block) doubling steps in-register.
+SEGSCAN_BLOCK = 1024
+
+_SCAN_OPS = ("sum", "min", "max")
+_FORCED_SCAN_IMPL: Optional[str] = None
+
+
+@contextmanager
+def force_scan_impl(impl: Optional[str]) -> Iterator[None]:
+    """Pin the multi-scan tier: ``"native"`` (per-lane cumsum/cummax XLA scans —
+    sum ops or a single global segment only), ``"assoc"``, ``"pallas"``,
+    ``"pallas_interpret"`` (the TPU kernel under the Pallas interpreter — how
+    CPU CI exercises it), or None to restore auto dispatch."""
+    global _FORCED_SCAN_IMPL
+    if impl not in (None, "native", "assoc", "pallas", "pallas_interpret"):
+        raise ValueError(f"unknown segment scan impl: {impl!r}")
+    prev = _FORCED_SCAN_IMPL
+    _FORCED_SCAN_IMPL = impl
+    try:
+        yield
+    finally:
+        _FORCED_SCAN_IMPL = prev
+
+
+def _scan_identity(op: str, dtype) -> Array:
+    if op == "sum":
+        return jnp.zeros((), dtype)
+    info = jnp.iinfo(dtype)
+    return jnp.asarray(info.max if op == "min" else info.min, dtype)
+
+
+def _scan_combine(op: str, a: Array, b: Array) -> Array:
+    if op == "sum":
+        return a + b
+    if op == "min":
+        return jnp.minimum(a, b)
+    return jnp.maximum(a, b)
+
+
+def _multi_scan_native_sum(values: Tuple[Array, ...], flags: Array) -> Tuple[Array, ...]:
+    """Native tier, sum lanes: one cummax-base segmented cumsum per lane.
+
+    ``jnp.cumsum``/``lax.cummax`` are first-class XLA scan primitives — they
+    compile in milliseconds where the tuple-carry ``associative_scan`` costs
+    ~0.7 s PER JITTED SHAPE (measured on CPU jaxlib; the recursive odd/even
+    decomposition emits hundreds of slice/concat ops XLA must re-optimize every
+    compile). Serving pays compile once through excache, but the test suite and
+    any cold multi-shape client pay it per shape — so sum-only requests (the
+    dominant case: rank/count/gated-count lanes) take this tier by default off
+    TPU. The sign-split keeps :func:`_segment_cumsum_nonneg`'s non-negativity
+    precondition honest for arbitrary int lanes; int addition is exact, so the
+    result is bit-identical to the fused carry.
+    """
+    out = []
+    for v in values:
+        pos = _segment_cumsum_nonneg(jnp.maximum(v, 0), flags)
+        neg = _segment_cumsum_nonneg(jnp.maximum(-v, 0), flags)
+        out.append((pos - neg).astype(v.dtype))
+    return tuple(out)
+
+
+def _multi_scan_native_global(values: Tuple[Array, ...], ops: Tuple[str, ...]) -> Tuple[Array, ...]:
+    """Native tier, single-global-segment requests (``new_seg=None``): every op
+    — min/max included — is one plain XLA scan, no segmented monoid needed."""
+    out = []
+    for op, v in zip(ops, values):
+        if op == "sum":
+            out.append(jnp.cumsum(v).astype(v.dtype))
+        elif op == "min":
+            out.append(jax.lax.cummin(v))
+        else:
+            out.append(jax.lax.cummax(v))
+    return tuple(out)
+
+
+def _multi_scan_assoc(values: Tuple[Array, ...], flags: Array, ops: Tuple[str, ...]) -> Tuple[Array, ...]:
+    """Portable tier: ONE ``associative_scan`` with a (flag, *stats) tuple carry."""
+
+    def combine(a, b):
+        af, bf = a[0], b[0]
+        out = [af | bf]
+        for op, av, bv in zip(ops, a[1:], b[1:]):
+            out.append(jnp.where(bf, bv, _scan_combine(op, av, bv)))
+        return tuple(out)
+
+    res = jax.lax.associative_scan(combine, (flags,) + tuple(values))
+    return tuple(res[1:])
+
+
+def _multi_scan_pallas(
+    values: Tuple[Array, ...], flags: Array, ops: Tuple[str, ...], interpret: bool = False
+) -> Tuple[Array, ...]:
+    """TPU tier: blocked streaming kernel, carry in scratch across a sequential grid.
+
+    Each grid step loads one ``(1, SEGSCAN_BLOCK)`` block per statistic, runs a
+    flag-aware Hillis-Steele doubling scan (log2(block) vector steps — handles
+    sum/min/max and negative values uniformly, no cummax-base trick needed),
+    splices the carried-in open segment onto rows before the block's first
+    boundary, and writes the next carry (the block's last row) back to scratch.
+    One pass over HBM for all k statistics. ``interpret=True`` runs the same
+    kernel under the Pallas interpreter (CPU tests; tracer-identical program).
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    k = len(values)
+    n = values[0].shape[0]
+    block = SEGSCAN_BLOCK
+    pad = (-n) % block
+    f_i = flags.astype(jnp.int32)
+    if pad:
+        # padding rows open their own segments with identity values: they can
+        # never extend a carry, and outputs past n are sliced away
+        f_i = jnp.concatenate([f_i, jnp.ones((pad,), jnp.int32)])
+        values = tuple(
+            jnp.concatenate([v, jnp.full((pad,), _scan_identity(op, v.dtype), v.dtype)])
+            for op, v in zip(ops, values)
+        )
+    m = n + pad
+    grid = m // block
+    v2 = tuple(v.reshape(grid, block) for v in values)
+    f2 = f_i.reshape(grid, block)
+
+    def kernel(*refs):
+        v_refs, f_ref = refs[:k], refs[k]
+        o_refs, c_refs = refs[k + 1 : 2 * k + 1], refs[2 * k + 1 :]
+
+        @pl.when(pl.program_id(0) == 0)
+        def _init():
+            for j, op in enumerate(ops):
+                c_refs[j][0, 0] = _scan_identity(op, v_refs[j].dtype)
+
+        f_in = f_ref[...] != 0
+        idx = jax.lax.broadcasted_iota(jnp.int32, (1, block), 1)
+        vals = [r[...] for r in v_refs]
+        f = f_in
+        d = 1
+        while d < block:  # static: unrolled log2(block) doubling steps
+            f_prev = jnp.where(idx < d, True, jnp.roll(f, d, axis=1))
+            vals = [
+                jnp.where(
+                    f,
+                    v,
+                    _scan_combine(
+                        op, jnp.where(idx < d, _scan_identity(op, v.dtype), jnp.roll(v, d, axis=1)), v
+                    ),
+                )
+                for op, v in zip(ops, vals)
+            ]
+            f = f | f_prev
+            d *= 2
+        # rows before the block's first boundary extend the carried-in segment
+        before_first = jnp.cumsum(f_in.astype(jnp.int32), axis=1) == 0
+        for j, (op, v) in enumerate(zip(ops, vals)):
+            out = jnp.where(before_first, _scan_combine(op, c_refs[j][0, 0], v), v)
+            o_refs[j][...] = out
+            c_refs[j][0, 0] = out[0, block - 1]
+
+    spec = pl.BlockSpec((1, block), lambda i: (i, 0))
+    outs = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[spec] * (k + 1),
+        out_specs=[spec] * k,
+        out_shape=[jax.ShapeDtypeStruct((grid, block), v.dtype) for v in values],
+        scratch_shapes=[pltpu.VMEM((1, 1), v.dtype) for v in values],
+        interpret=interpret,
+    )(*v2, f2)
+    return tuple(o.reshape(-1)[:n] for o in outs)
+
+
+def segment_multi_scan(
+    values: Sequence[Array],
+    new_seg: Optional[Array],
+    *,
+    ops: Optional[Sequence[str]] = None,
+    reverse: bool = False,
+) -> Tuple[Array, ...]:
+    """All per-segment inclusive running statistics in ONE pass over sorted rows.
+
+    ``values`` is a tuple of equal-length INTEGER arrays; ``ops`` names the
+    per-array reduction (``"sum"`` default, ``"min"``, ``"max"``). ``new_seg``
+    marks segment-start rows (forward) — with ``reverse=True`` it marks segment
+    LAST rows and the result is the within-segment inclusive SUFFIX statistic
+    (the fused replacement for the flip-scan-flip suffix helpers). Pass
+    ``new_seg=None`` to declare ONE GLOBAL segment statically — a global
+    running statistic (e.g. the curve tail's suffix-min) that no runtime flag
+    column can promise at trace time. A position-in-segment / rank column is a
+    ``"sum"`` over ones; a segment-start broadcast is ``pos - rank + 1``.
+
+    Integer-only by contract: int add/min/max are exact under any association,
+    so every tier — the native per-lane XLA scans, the ``associative_scan``
+    tuple-carry portable form, the Pallas TPU kernel, and the legacy
+    per-statistic global scans — produces bit-identical results
+    (property-tested across the adversarial suite in
+    tests/unittests/classification/test_segment_multi_scan.py). Float streams
+    must keep :func:`_segment_cumsum_float`'s blocked form instead.
+
+    Dispatch: TPU + provably-unsharded + n >= ``SEGSCAN_PALLAS_MIN_SIZE`` takes
+    the Pallas kernel (ONE fused HBM pass for k lanes — the tier the fusion
+    exists for); otherwise sum-only or ``new_seg=None`` requests take the
+    native per-lane scans (milliseconds to compile vs ~0.7 s/shape for the
+    tuple carry — see :func:`_multi_scan_native_sum`), and only min/max lanes
+    over real segment flags need the ``associative_scan`` tuple carry.
+    :func:`force_scan_impl` pins a tier for tests.
+    """
+    values = tuple(jnp.asarray(v) for v in values)
+    if not values:
+        raise ValueError("segment_multi_scan needs at least one values array")
+    ops = ("sum",) * len(values) if ops is None else tuple(ops)
+    if len(ops) != len(values):
+        raise ValueError(f"got {len(values)} values arrays but {len(ops)} ops")
+    for op, v in zip(ops, values):
+        if op not in _SCAN_OPS:
+            raise ValueError(f"unknown scan op {op!r}; expected one of {_SCAN_OPS}")
+        if not jnp.issubdtype(v.dtype, jnp.integer):
+            raise ValueError(
+                f"segment_multi_scan is integer-only (exact under reassociation); got {v.dtype}. "
+                "Float streams go through _segment_cumsum_float."
+            )
+    global_seg = new_seg is None
+    flags = None if global_seg else jnp.asarray(new_seg)
+    if reverse:
+        values = tuple(v[::-1] for v in values)
+        if flags is not None:
+            flags = flags[::-1]
+    sum_only = all(op == "sum" for op in ops)
+    impl = _FORCED_SCAN_IMPL
+    if impl is None:
+        x = values[0]
+        if x.shape[0] >= SEGSCAN_PALLAS_MIN_SIZE and _on_tpu(x) and _provably_unsharded(x):
+            impl = "pallas"
+        elif global_seg or sum_only:
+            impl = "native"
+        else:
+            impl = "assoc"
+    if impl == "native":
+        if global_seg:
+            outs = _multi_scan_native_global(values, ops)
+        elif sum_only:
+            outs = _multi_scan_native_sum(values, flags)
+        else:
+            raise ValueError(
+                "the native tier covers sum lanes (or any op with new_seg=None); "
+                "min/max over real segment flags need the assoc or pallas tier"
+            )
+    else:
+        if flags is None:
+            # materialize the static single-segment claim for the generic tiers
+            flags = jnp.zeros((values[0].shape[0],), bool).at[0].set(True)
+        if impl == "assoc":
+            outs = _multi_scan_assoc(values, flags, ops)
+        else:
+            outs = _multi_scan_pallas(values, flags, ops, interpret=(impl == "pallas_interpret"))
+    if reverse:
+        outs = tuple(o[::-1] for o in outs)
+    return outs
+
+
 # every retrieval metric's per-query value is a segmented-scan read at the
 # segment's last row: the whole family runs with ZERO segment scatters
 # (one or two payload sorts + a handful of cumsum/cummax scans + plain sums)
@@ -167,10 +463,11 @@ def _scan_retrieval_scores(
     Why: ``segment_sum`` (a scatter) costs ~174 ms per call at 2^24 rows on v5e
     even with sorted indices, while ``cumsum``/``cummax`` scans cost ~30 ms; AP
     needs 4+ per-segment reductions. Expressing each as "segmented cumsum value
-    at the last row" (base broadcast by ``cummax`` — exact for the non-negative
-    summands used here) removes every scatter: 715 -> ~300 ms for the full AP
-    kernel at 2^24. (``lax.associative_scan`` segmented scans were rejected:
-    the recursive decomposition takes minutes to compile at this size.)
+    at the last row" removes every scatter: 715 -> ~300 ms for the full AP
+    kernel at 2^24. Since round 10 the integer statistics ride ONE fused
+    multi-scan carry (:func:`segment_multi_scan`) instead of a cumsum+cummax
+    scan pair per statistic; a second fused pass exists only where a statistic
+    is GATED on the first pass's rank (top_k masks, r_precision's total).
     """
     n = indexes.shape[0]
     # the sorted KEYS come out of lax.sort too: carrying (indexes, preds) again
@@ -181,28 +478,60 @@ def _scan_retrieval_scores(
     new_seg = jnp.concatenate([jnp.ones(1, dtype=bool), s_idx[1:] != s_idx[:-1]])
     is_last = jnp.concatenate([new_seg[1:], jnp.ones(1, dtype=bool)])
     pos = jnp.arange(n)
-    seg_start_row = jax.lax.cummax(jnp.where(new_seg, pos, 0))
-    rank = pos - seg_start_row + 1
 
-    # counts run in int32 through the cumsum-base trick: exact to 2^31 rows
+    # counts run in int32 through the fused segmented scan: exact to 2^31 rows
     # (f32 would drift past 2^24 positive rows); cast at the read points
     binary_i = (s_target > 0).astype(jnp.int32)
     binary_t = binary_i.astype(jnp.float32)
+    big = jnp.int32(2**31 - 1)
+
+    # ---- fused pass A (ONE scan): every statistic that does not depend on the
+    # within-segment rank rides the same tuple carry — rank itself (a segmented
+    # sum of ones), the ungated relevant/non-relevant counts, and
+    # reciprocal_rank's first-relevant position (a segmented min). The old path
+    # issued one cumsum+cummax scan pair PER statistic (~5 global passes).
+    a_vals = [jnp.ones((n,), jnp.int32)]
+    a_ops = ["sum"]
+    if metric == "fall_out":
+        nonrel = 1 - binary_i
+        a_vals.append(nonrel)
+        a_ops.append("sum")
+    else:
+        a_vals.append(binary_i)
+        a_ops.append("sum")
+    if metric == "reciprocal_rank":
+        # 1-based global position of the segment's first relevant row: read at
+        # last rows of segments that HAVE one (n_pos > 0), where the segmented
+        # min equals the old global-cummax marker value bit-for-bit
+        a_vals.append(jnp.where(binary_i > 0, pos.astype(jnp.int32) + 1, big))
+        a_ops.append("min")
+    a_out = segment_multi_scan(tuple(a_vals), new_seg, ops=tuple(a_ops))
+    rank = a_out[0]  # 1-based position within its segment
     in_k = jnp.ones(n, dtype=bool) if top_k is None else rank <= top_k
-    in_k_i = in_k.astype(jnp.int32)
 
-    def segcumsum(v):  # within-segment cumsum, v >= 0 (see _segment_cumsum_nonneg)
-        return _segment_cumsum_nonneg(v, new_seg)
-
-    cum_rel_k = segcumsum(binary_i * in_k_i).astype(jnp.float32)
-    cum_rel = cum_rel_k if top_k is None else segcumsum(binary_i).astype(jnp.float32)
-    n_pos = jnp.where(is_last, cum_rel, 0.0)
+    cum_rel_i = None if metric == "fall_out" else a_out[1]
+    cum_rel = None if cum_rel_i is None else cum_rel_i.astype(jnp.float32)
+    if metric != "fall_out":
+        if top_k is None:
+            cum_rel_k = cum_rel
+        elif metric in ("average_precision", "precision", "recall", "hit_rate"):
+            # ---- fused pass B: the rank-gated count (depends on pass A's rank,
+            # so it cannot share its carry — a real data dependency, not a
+            # missed fusion). ndcg/reciprocal_rank never consume it.
+            (cum_rel_k_i,) = segment_multi_scan((binary_i * in_k.astype(jnp.int32),), new_seg)
+            cum_rel_k = cum_rel_k_i.astype(jnp.float32)
+        else:
+            cum_rel_k = None
+        n_pos = jnp.where(is_last, cum_rel, 0.0)
     valid = is_last & (s_idx >= 0)
 
     if metric == "fall_out":
-        nonrel = 1 - binary_i
-        cum_nonrel_k = segcumsum(nonrel * in_k_i).astype(jnp.float32)
-        cum_nonrel = cum_nonrel_k if top_k is None else segcumsum(nonrel).astype(jnp.float32)
+        cum_nonrel = a_out[1].astype(jnp.float32)
+        if top_k is None:
+            cum_nonrel_k = cum_nonrel
+        else:
+            (cum_nonrel_k_i,) = segment_multi_scan((nonrel * in_k.astype(jnp.int32),), new_seg)
+            cum_nonrel_k = cum_nonrel_k_i.astype(jnp.float32)
         n_neg = jnp.where(is_last, cum_nonrel, 0.0)
         scores = jnp.where(is_last & (n_neg > 0), cum_nonrel_k / jnp.maximum(n_neg, 1.0), 0.0)
         return scores, n_neg, valid  # n_positive slot carries negatives for empty handling
@@ -215,11 +544,10 @@ def _scan_retrieval_scores(
         return scores, n_pos, valid
 
     if metric == "reciprocal_rank":
-        # global cummax of "position+1 of each segment's first relevant row":
-        # later segments' markers dominate earlier ones, and the value is only
-        # read at last rows of segments that HAVE a relevant row (n_pos > 0)
-        marker = jnp.where((binary_t > 0) & (cum_rel == 1), pos + 1, 0)
-        first_rel_pos = jax.lax.cummax(marker)
+        # rank of the first relevant row = its global position relative to the
+        # segment start, recovered from pass A as pos - rank + 1
+        first_rel_pos = a_out[2]
+        seg_start_row = pos - rank + 1
         first_rel_rank = (first_rel_pos - 1 - seg_start_row + 1).astype(jnp.float32)
         scores = jnp.where(is_last & (n_pos > 0), 1.0 / jnp.maximum(first_rel_rank, 1.0), 0.0)
         return scores, n_pos, valid
@@ -244,12 +572,16 @@ def _scan_retrieval_scores(
 
     if metric == "r_precision":
         # relevant among the top-(n_pos) ranked docs; the segment's positive
-        # total reaches every row as prefix + suffix - value (two scans), not
-        # the per-row gather the old segment-reduction path needed
-        suffix = _segment_suffix_sum_nonneg(binary_i, is_last)
-        total = (segcumsum(binary_i) + suffix - binary_i).astype(jnp.float32)
+        # total reaches every row as prefix + suffix - value (pass A already
+        # carries the prefix; one fused reverse pass adds the suffix), not the
+        # per-row gather the old segment-reduction path needed. The gated
+        # re-count is a third pass — the gate depends on the total, a true
+        # data dependency unique to this metric.
+        (suffix,) = segment_multi_scan((binary_i,), is_last, reverse=True)
+        total = (cum_rel_i + suffix - binary_i).astype(jnp.float32)
         in_r = rank.astype(jnp.float32) <= total
-        rel_in_r = segcumsum(binary_i * in_r.astype(jnp.int32)).astype(jnp.float32)
+        (rel_in_r_i,) = segment_multi_scan((binary_i * in_r.astype(jnp.int32),), new_seg)
+        rel_in_r = rel_in_r_i.astype(jnp.float32)
         scores = jnp.where(is_last & (n_pos > 0), rel_in_r / jnp.maximum(n_pos, 1.0), 0.0)
         return scores, n_pos, valid
 

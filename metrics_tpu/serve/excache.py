@@ -415,15 +415,30 @@ _RANK_REPLAY_OPS = (
     "multilabel_average_precision_exact",
 )
 
+#: sketch-tier histogram units (ops/rank.py, tolerance-routed Metric classes).
+#: ``bits`` is the static half of the counts unit's compile key; the bounds
+#: units carry it in the histogram shape instead.
+_RANK_HIST_REPLAY_OPS = (
+    "hist_class_counts",
+    "hist_auroc_bounds",
+    "hist_ap_bounds",
+)
+
 
 def record_rank_compile(
-    op: str, tier: Optional[str], arrays: Tuple[Any, ...], max_fpr: Optional[float] = None
+    op: str,
+    tier: Optional[str],
+    arrays: Tuple[Any, ...],
+    max_fpr: Optional[float] = None,
+    bits: Optional[int] = None,
 ) -> None:
-    """Called from the ``ops/clf_curve.py`` dispatch sites (every call while
-    recording, so the dedup check runs *before* any encoding work)."""
+    """Called from the ``ops/clf_curve.py`` dispatch sites and the sketch-tier
+    Metric classes (every call while recording, so the dedup check runs
+    *before* any encoding work). ``bits`` rides along for sketch entries —
+    the bracket kernels' static bit depth is part of their compile key."""
     if not _RECORDING:
         return
-    cheap = (op, tier, max_fpr, tuple((tuple(a.shape), str(a.dtype)) for a in arrays))
+    cheap = (op, tier, max_fpr, bits, tuple((tuple(a.shape), str(a.dtype)) for a in arrays))
     with _LOCK:
         if cheap in _SEEN_RANK:
             return
@@ -433,6 +448,7 @@ def record_rank_compile(
         "op": op,
         "tier": tier,
         "max_fpr": max_fpr,
+        "bits": bits,
         "inputs": [_encode(a) for a in arrays],
     }
     _add_entry(entry, _fused.stable_key_digest(cheap))
@@ -620,10 +636,13 @@ def _prewarm_rank(entry: Dict[str, Any]) -> bool:
     from metrics_tpu.ops import rank as _rank
 
     op = entry["op"]
-    if op not in _RANK_REPLAY_OPS:
+    if op in _RANK_HIST_REPLAY_OPS:
+        fn = getattr(_rank, op)
+    elif op in _RANK_REPLAY_OPS:
+        fn = getattr(_clf, op)
+    else:
         _warn_skip(f"unknown rank op {op!r}")
         return False
-    fn = getattr(_clf, op)
     arrays = [
         jnp.zeros(tuple(a["shape"]), np.dtype(a["dtype"]))
         for a in (dict(e) for e in entry["inputs"])
@@ -635,6 +654,13 @@ def _prewarm_rank(entry: Dict[str, Any]) -> bool:
     if entry.get("max_fpr") is not None:
         kwargs["max_fpr"] = entry["max_fpr"]
     tier = entry.get("tier")
+    bits = entry.get("bits")
+    if bits is not None:
+        if op == "hist_class_counts":
+            kwargs["bits"] = int(bits)
+        elif op in _RANK_REPLAY_OPS and tier == "sketch":
+            # forced sketch replay compiles the bracket kernels at this depth
+            kwargs["tolerance_bits"] = int(bits)
     # the rank kernels are ordinary jits: one abstract-shaped call both warms
     # the disk cache and populates the in-process jit dispatch cache, so the
     # first real request neither traces nor compiles

@@ -192,11 +192,16 @@ class CollectionSpec:
     ``metrics`` maps result label → ``{"class": <name in metrics_tpu>,
     "kwargs": {...}}`` (a bare string is shorthand for the class name alone).
     A spec-level ``fleet_size`` is injected into every member's kwargs so the
-    whole collection shares the fleet axis. ``queue`` overrides IngestQueue
-    knobs (capacity, backpressure, max_coalesce, ...); ``ckpt_dir`` enables
-    restore-on-start and checkpoint-on-drain; ``slo_p99_ingest_ms`` arms the
-    per-collection latency budget the control loop checks; ``drift`` attaches
-    a canary watch.
+    whole collection shares the fleet axis. A spec-level ``tolerance`` (plus
+    optional ``tolerance_bits``) is injected into every *sketch-computable*
+    member (AUROC / AveragePrecision with ``thresholds=None``) — those members
+    then serve certified-bracket midpoints from O(1) histogram state instead
+    of cat-buffer + sort (ops/rank.py sketch tier); other members are left
+    untouched, and per-metric kwargs still win. ``queue`` overrides
+    IngestQueue knobs (capacity, backpressure, max_coalesce, ...);
+    ``ckpt_dir`` enables restore-on-start and checkpoint-on-drain;
+    ``slo_p99_ingest_ms`` arms the per-collection latency budget the control
+    loop checks; ``drift`` attaches a canary watch.
     """
 
     def __init__(
@@ -206,6 +211,8 @@ class CollectionSpec:
         *,
         fused: bool = True,
         fleet_size: Optional[int] = None,
+        tolerance: Optional[float] = None,
+        tolerance_bits: Optional[int] = None,
         ckpt_dir: Optional[str] = None,
         queue: Optional[Dict[str, Any]] = None,
         slo_p99_ingest_ms: Optional[float] = None,
@@ -218,6 +225,19 @@ class CollectionSpec:
         self.fleet_size = None if fleet_size is None else int(fleet_size)
         if self.fleet_size is not None:
             _require(self.fleet_size >= 1, f"collection {name!r}: fleet_size must be >= 1")
+        self.tolerance = None if tolerance is None else float(tolerance)
+        if self.tolerance is not None:
+            _require(self.tolerance >= 0, f"collection {name!r}: tolerance must be >= 0")
+        self.tolerance_bits = None if tolerance_bits is None else int(tolerance_bits)
+        if self.tolerance_bits is not None:
+            _require(
+                4 <= self.tolerance_bits <= 14,
+                f"collection {name!r}: tolerance_bits must be an int in [4, 14]",
+            )
+            _require(
+                self.tolerance is not None,
+                f"collection {name!r}: tolerance_bits without tolerance has no effect",
+            )
         self.ckpt_dir = ckpt_dir
         self.queue = dict(queue or {})
         for key in self.queue:
@@ -248,6 +268,14 @@ class CollectionSpec:
             kwargs = dict(md.get("kwargs") or {})
             if self.fleet_size is not None:
                 kwargs.setdefault("fleet_size", self.fleet_size)
+            if (
+                self.tolerance is not None
+                and getattr(klass, "_sketch_computable", False)
+                and kwargs.get("thresholds") is None
+            ):
+                kwargs.setdefault("tolerance", self.tolerance)
+                if self.tolerance_bits is not None:
+                    kwargs.setdefault("tolerance_bits", self.tolerance_bits)
             self.metrics[label] = (klass, kwargs)
 
     @classmethod

@@ -42,6 +42,16 @@ class StreamingAUROCBound(SketchMetric):
     1 for positive, anything else negative. Scores must be NaN-free (the
     rank-engine contract).
 
+    Since round 10 this certificate also backs the tolerance-routed dispatch
+    tier: ``BinaryAUROC(tolerance=...)`` / ``binary_auroc_exact(...,
+    tolerance=...)`` (and the AP twins, plus ``CollectionSpec(...,
+    tolerance=...)`` at the serving layer) accumulate the same two histograms
+    and serve the bracket midpoint when the certified width fits the
+    tolerance — see classification/precision_recall_curve.py and
+    ops/clf_curve.py:_sketch_dispatch. Reach for this class directly when you
+    want the bracket itself (both endpoints), a dict of AUROC *and* AP from
+    one state, or the sketch-family merge/ckpt surface.
+
     Args:
         bits: histogram resolution (``2^bits`` buckets over the key space);
             +1 bit halves the expected bracket width for continuous scores.

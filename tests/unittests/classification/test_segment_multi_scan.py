@@ -1,0 +1,224 @@
+"""Fused segmented multi-scan (ops/segment.py): bit-parity of every tier —
+native per-lane XLA scans, associative_scan tuple carry, Pallas kernel
+(interpret mode on CPU), and the legacy unfused per-statistic scans — across
+the adversarial input suite.
+
+The load-bearing property: ``segment_multi_scan`` is integer-only, and int
+add/min/max are exact under any association, so ALL tiers must agree
+bit-for-bit on every input class — ties, ±inf-driven segment boundaries,
+single-segment and every-row-a-segment extremes, and sizes that pad/straddle
+the Pallas block.
+
+The Pallas interpreter executes block-by-block in Python, so the full
+case × op × reverse cross product only runs it on ``PALLAS_CASES`` — the
+cases that exercise its distinct machinery (padding, multi-block carries,
+every-row flags); the dedicated carry test covers the long-segment splice.
+"""
+from functools import partial
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.ops import segment as seg
+from metrics_tpu.ops.segment import (
+    SEGSCAN_BLOCK,
+    _segment_cumsum_nonneg,
+    _segment_suffix_sum_nonneg,
+    force_scan_impl,
+    segment_multi_scan,
+)
+
+_rng = np.random.RandomState(4321)
+
+_NP_OP = {"sum": np.add, "min": np.minimum, "max": np.maximum}
+
+
+def _np_segment_scan(values, flags, op, reverse=False):
+    """Per-element reference: inclusive within-segment running statistic."""
+    v = np.asarray(values).copy()
+    f = np.asarray(flags).astype(bool).copy()
+    if reverse:
+        v, f = v[::-1], f[::-1]
+    out = np.empty_like(v)
+    acc = None
+    for i in range(len(v)):
+        acc = v[i] if (f[i] or acc is None) else _NP_OP[op](acc, v[i])
+        out[i] = acc
+    return out[::-1] if reverse else out
+
+
+def _flags_from_preds(preds):
+    """Segment-start flags the rank/retrieval pipelines build: boundaries where
+    the sorted score changes (ties collapse into one segment)."""
+    order = np.argsort(-preds, kind="stable")
+    s = preds[order]
+    flags = np.ones(len(s), bool)
+    flags[1:] = s[1:] != s[:-1]
+    return flags
+
+
+# name -> (values int32, flags bool); sizes chosen to pad and straddle the
+# Pallas block (777 and 900 pad, 1300 crosses one boundary, 3072 is a multiple)
+def _cases():
+    cases = {}
+    for name, preds in {
+        "tie_heavy": (_rng.randint(0, 5, 1300) / 4.0).astype(np.float32),
+        "pm_inf": np.where(
+            _rng.rand(777) < 0.2, np.inf, np.where(_rng.rand(777) < 0.2, -np.inf, _rng.randn(777))
+        ).astype(np.float32),
+        "random": _rng.randn(900).astype(np.float32),
+    }.items():
+        flags = _flags_from_preds(preds)
+        vals = _rng.randint(-7, 8, len(preds)).astype(np.int32)
+        cases[name] = (vals, flags)
+    n = 2048  # exactly two Pallas blocks, no padding
+    cases["every_row_a_segment"] = (_rng.randint(0, 100, n).astype(np.int32), np.ones(n, bool))
+    cases["one_global_segment"] = (_rng.randint(-100, 100, n).astype(np.int32), np.eye(1, n, 0, dtype=bool)[0])
+    cases["block_multiple"] = (_rng.randint(0, 3, SEGSCAN_BLOCK * 3).astype(np.int32), _rng.rand(SEGSCAN_BLOCK * 3) < 0.01)
+    cases["tiny"] = (np.array([5, -2, 3], np.int32), np.array([True, False, True]))
+    return cases
+
+
+CASES = _cases()
+# the interpreter-run Pallas subset: padding (tiny, pm_inf), multi-block
+# carries (block_multiple), densest flag pattern (every_row_a_segment)
+PALLAS_CASES = ("tiny", "pm_inf", "block_multiple", "every_row_a_segment")
+OPS3 = ("sum", "min", "max")
+
+
+@partial(jax.jit, static_argnames=("ops", "reverse", "impl"))
+def _scan_jit(values, flags, ops, reverse, impl):
+    # jit matters for suite runtime: EAGER associative_scan pays one tiny-kernel
+    # compile per slice/concat per new shape (3-7 s per first case visit);
+    # jitted, each (shape, ops, reverse, impl) signature compiles once
+    with force_scan_impl(impl):
+        return segment_multi_scan(values, flags, ops=ops, reverse=reverse)
+
+
+@pytest.mark.parametrize("case", sorted(CASES))
+@pytest.mark.parametrize("reverse", (False, True))
+def test_all_tiers_match_reference(case, reverse):
+    vals, flags = CASES[case]
+    refs = [_np_segment_scan(vals, flags, op, reverse=reverse) for op in OPS3]
+    vj, fj = jnp.asarray(vals), jnp.asarray(flags)
+    # reverse is a value/flag flip in the dispatcher, outside the tiers — the
+    # python-per-block interpreter only needs to see the forward direction
+    impls = ("assoc", "pallas_interpret") if case in PALLAS_CASES and not reverse else ("assoc",)
+    for impl in impls:
+        outs = _scan_jit((vj, vj, vj), fj, OPS3, reverse, impl)
+        for op, out, ref in zip(OPS3, outs, refs):
+            assert np.array_equal(np.asarray(out), ref), f"{case}/{op}/{impl} reverse={reverse}"
+    # the native tier serves sum lanes over real flags
+    (out,) = _scan_jit((vj,), fj, ("sum",), reverse, "native")
+    assert np.array_equal(np.asarray(out), refs[0]), f"{case}/sum/native reverse={reverse}"
+
+
+@pytest.mark.parametrize("case", sorted(CASES))
+def test_fused_tuple_equals_independent_scans(case):
+    """The tentpole contract: k statistics in ONE pass == k independent scans."""
+    vals, flags = CASES[case]
+    ones = np.ones_like(vals)
+    big = np.where(flags, vals, vals * 2).astype(np.int32)
+    triples = ((jnp.asarray(ones), "sum"), (jnp.asarray(vals), "min"), (jnp.asarray(big), "max"))
+    fj = jnp.asarray(flags)
+    # interpret-mode singles are pure-python-per-block slow; two cases (padding
+    # + multi-block carry) cover the kernel's combine logic, the rest ride assoc
+    impls = ("assoc", "pallas_interpret") if case in ("tiny", "block_multiple") else ("assoc",)
+    for impl in impls:
+        fused = _scan_jit(tuple(v for v, _ in triples), fj, OPS3, False, impl)
+        singles = [_scan_jit((v,), fj, (o,), False, impl)[0] for v, o in triples]
+        for f, s, (_, o) in zip(fused, singles, triples):
+            assert np.array_equal(np.asarray(f), np.asarray(s)), f"{case}/{impl}/{o}"
+
+
+@pytest.mark.parametrize("reverse", (False, True))
+def test_global_segment_none_matches_explicit_flags(reverse):
+    """``new_seg=None`` (static single-segment claim) must equal the same scan
+    over explicit one-segment flags, on every tier that accepts the request."""
+    vals, _ = CASES["random"]
+    flags = np.zeros(len(vals), bool)
+    flags[-1 if reverse else 0] = True
+    refs = [_np_segment_scan(vals, flags, op, reverse=reverse) for op in OPS3]
+    vj = jnp.asarray(vals)
+    # auto dispatch (native off-TPU), the generic carry, and the kernel
+    for impl in (None, "assoc", "pallas_interpret"):
+        outs = _scan_jit((vj, vj, vj), None, OPS3, reverse, impl)
+        for op, out, ref in zip(OPS3, outs, refs):
+            assert np.array_equal(np.asarray(out), ref), f"{op}/{impl} reverse={reverse}"
+
+
+def test_native_tier_rejects_min_over_real_flags():
+    vals, flags = CASES["tiny"]
+    with force_scan_impl("native"):
+        with pytest.raises(ValueError, match="native tier"):
+            segment_multi_scan((jnp.asarray(vals),), jnp.asarray(flags), ops=("min",))
+
+
+@pytest.mark.parametrize("case", sorted(CASES))
+def test_matches_legacy_unfused_helpers(case):
+    """sum forward == _segment_cumsum_nonneg; sum reverse == _segment_suffix_sum_nonneg."""
+    vals, flags = CASES[case]
+    nonneg = np.abs(vals).astype(np.int32)
+    (fwd,) = segment_multi_scan((jnp.asarray(nonneg),), jnp.asarray(flags))
+    legacy_fwd = _segment_cumsum_nonneg(jnp.asarray(nonneg).astype(jnp.float32), jnp.asarray(flags))
+    assert np.array_equal(np.asarray(fwd), np.asarray(legacy_fwd).astype(np.int32)), case
+
+    # reverse flags mark segment LAST rows: derive them from the start flags
+    last = np.roll(flags, -1)
+    last[-1] = True
+    (rev,) = segment_multi_scan((jnp.asarray(nonneg),), jnp.asarray(last), reverse=True)
+    legacy_rev = _segment_suffix_sum_nonneg(jnp.asarray(nonneg).astype(jnp.float32), jnp.asarray(last))
+    assert np.array_equal(np.asarray(rev), np.asarray(legacy_rev).astype(np.int32)), case
+
+
+def test_jit_parity_and_trace_safety():
+    # a short slice keeps the EAGER side cheap (eager associative_scan pays a
+    # per-slice-kernel compile storm on each new shape)
+    vals, flags = (a[:64] for a in CASES["tie_heavy"])
+    args = (jnp.asarray(vals), jnp.asarray(np.ones_like(vals)))
+
+    @jax.jit
+    def fused(v, ones, f):
+        return segment_multi_scan((v, ones), f, ops=("min", "sum"))
+
+    eager = segment_multi_scan(args, jnp.asarray(flags), ops=("min", "sum"))
+    jitted = fused(args[0], args[1], jnp.asarray(flags))
+    for a, b in zip(eager, jitted):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_pallas_interpret_carry_across_blocks():
+    """A single segment spanning many blocks forces the carry splice on every
+    block after the first — the exact path the register carry optimizes."""
+    n = SEGSCAN_BLOCK * 4 + 123
+    vals = _rng.randint(0, 2, n).astype(np.int32)
+    flags = np.zeros(n, bool)
+    flags[0] = True
+    ref = np.cumsum(vals).astype(np.int32)
+    with force_scan_impl("pallas_interpret"):
+        (out,) = segment_multi_scan((jnp.asarray(vals),), jnp.asarray(flags))
+    assert np.array_equal(np.asarray(out), ref)
+
+
+def test_rejects_float_values_and_bad_ops():
+    v = jnp.arange(8, dtype=jnp.float32)
+    f = jnp.zeros(8, bool)
+    with pytest.raises(ValueError, match="integer-only"):
+        segment_multi_scan((v,), f)
+    vi = v.astype(jnp.int32)
+    with pytest.raises(ValueError, match="unknown scan op"):
+        segment_multi_scan((vi,), f, ops=("prod",))
+    with pytest.raises(ValueError, match="ops"):
+        segment_multi_scan((vi, vi), f, ops=("sum",))
+    with pytest.raises(ValueError, match="at least one"):
+        segment_multi_scan((), f)
+
+
+def test_force_scan_impl_restores_dispatch():
+    assert seg._FORCED_SCAN_IMPL is None
+    with force_scan_impl("assoc"):
+        assert seg._FORCED_SCAN_IMPL == "assoc"
+    assert seg._FORCED_SCAN_IMPL is None

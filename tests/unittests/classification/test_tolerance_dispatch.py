@@ -1,0 +1,291 @@
+"""Tolerance-routed sketch dispatch contract sweep (ISSUE 18 satellite).
+
+Three contracts, enforced for every AUROC/AP Metric class and the scalar ops
+entry points:
+
+1. ``tolerance=0`` (the default) is BIT-IDENTICAL to the exact tier — passing
+   the knob explicitly changes nothing, state registration included.
+2. A routed metric's result is the certified-bracket midpoint, the f32 oracle
+   lies inside the bracket, and the true error is ≤ width/2.
+3. Routing is O(1)-state: the only registered states are the two class
+   histograms (no cat buffer ever exists), their byte size never grows with
+   the stream, and the ``rank.dispatch/sketch`` obs counter records the route.
+"""
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu import obs
+from metrics_tpu.classification import (
+    BinaryAUROC,
+    BinaryAveragePrecision,
+    BinaryPrecisionRecallCurve,
+    MulticlassAUROC,
+    MulticlassAveragePrecision,
+    MultilabelAUROC,
+    MultilabelAveragePrecision,
+)
+from metrics_tpu.ops import rank as _rank
+from metrics_tpu.ops.clf_curve import binary_auroc_exact, binary_average_precision_exact
+
+_rng = np.random.RandomState(99)
+
+N = 1 << 12
+NC = 4
+
+PREDS_B = jnp.asarray(_rng.rand(N), jnp.float32)
+TARGET_B = jnp.asarray(_rng.randint(0, 2, N), jnp.int32)
+PREDS_MC = jax.nn.softmax(jnp.asarray(_rng.randn(N, NC), jnp.float32), axis=-1)
+TARGET_MC = jnp.asarray(_rng.randint(0, NC, N), jnp.int32)
+PREDS_ML = jnp.asarray(_rng.rand(N, NC), jnp.float32)
+TARGET_ML = jnp.asarray(_rng.randint(0, 2, (N, NC)), jnp.int32)
+
+SWEEP = [
+    ("binary_auroc", BinaryAUROC, {}, PREDS_B, TARGET_B),
+    ("binary_ap", BinaryAveragePrecision, {}, PREDS_B, TARGET_B),
+    ("multiclass_auroc", MulticlassAUROC, {"num_classes": NC}, PREDS_MC, TARGET_MC),
+    ("multiclass_ap", MulticlassAveragePrecision, {"num_classes": NC}, PREDS_MC, TARGET_MC),
+    ("multilabel_auroc", MultilabelAUROC, {"num_labels": NC}, PREDS_ML, TARGET_ML),
+    ("multilabel_ap", MultilabelAveragePrecision, {"num_labels": NC}, PREDS_ML, TARGET_ML),
+]
+
+
+def _bitwise_equal(a, b):
+    a, b = np.asarray(a), np.asarray(b)
+    return np.array_equal(a, b, equal_nan=True) and np.array_equal(np.signbit(a), np.signbit(b))
+
+
+# ------------------------------------------------- contract 1: tolerance=0
+
+
+@pytest.mark.parametrize("name,klass,kw,preds,target", SWEEP, ids=[s[0] for s in SWEEP])
+def test_tolerance_zero_is_bit_identical(name, klass, kw, preds, target):
+    plain = klass(**kw)
+    explicit = klass(tolerance=0.0, **kw)
+    for m in (plain, explicit):
+        m.update(preds, target)
+    assert _bitwise_equal(plain.compute(), explicit.compute())
+    # tolerance=0 must leave the exact cat-state layout untouched
+    assert hasattr(explicit, "preds") and not hasattr(explicit, "pos_hist")
+
+
+def test_ops_level_tolerance_zero_and_fallback_bit_identical():
+    base_auroc = binary_auroc_exact(PREDS_B, TARGET_B)
+    base_ap = binary_average_precision_exact(PREDS_B, TARGET_B)
+    assert _bitwise_equal(base_auroc, binary_auroc_exact(PREDS_B, TARGET_B, tolerance=0.0))
+    assert _bitwise_equal(base_ap, binary_average_precision_exact(PREDS_B, TARGET_B, tolerance=0.0))
+    # a tolerance the certificate cannot meet falls back to the exact tier
+    assert _bitwise_equal(base_auroc, binary_auroc_exact(PREDS_B, TARGET_B, tolerance=1e-12))
+    assert _bitwise_equal(base_ap, binary_average_precision_exact(PREDS_B, TARGET_B, tolerance=1e-12))
+
+
+# --------------------------------------------- contract 2: certified bracket
+
+
+@pytest.mark.parametrize("name,klass,kw,preds,target", SWEEP, ids=[s[0] for s in SWEEP])
+def test_routed_result_is_midpoint_and_oracle_inside_bracket(name, klass, kw, preds, target):
+    oracle_kw = dict(kw)
+    if "num_classes" in kw or "num_labels" in kw:
+        oracle_kw["average"] = "none"
+        kw = {**kw, "average": "none"}
+    oracle_m = klass(**oracle_kw)
+    oracle_m.update(preds, target)
+    oracle = np.asarray(oracle_m.compute())
+
+    m = klass(tolerance=0.05, tolerance_bits=12, **kw)
+    m.update(preds, target)
+    got = np.asarray(m.compute())
+
+    bounds_fn = _rank.hist_auroc_bounds if "auroc" in name else _rank.hist_ap_bounds
+    lo, hi = (np.asarray(a) for a in bounds_fn(m.pos_hist, m.neg_hist))
+    eps = 1e-6
+    finite = np.isfinite(oracle)
+    assert np.all((oracle[finite] >= lo[np.broadcast_to(finite, lo.shape)] - eps))
+    assert np.all((oracle[finite] <= hi[np.broadcast_to(finite, hi.shape)] + eps))
+    mid = 0.5 * (lo + hi)
+    assert np.allclose(got[finite], mid[np.broadcast_to(finite, mid.shape)], atol=eps, equal_nan=True)
+    assert np.all(np.abs(got[finite] - oracle[finite]) <= 0.5 * (hi - lo)[np.broadcast_to(finite, lo.shape)] + eps)
+
+
+def test_multilabel_micro_bracket_uses_summed_lanes():
+    oracle_m = MultilabelAUROC(num_labels=NC, average="micro")
+    oracle_m.update(PREDS_ML, TARGET_ML)
+    oracle = float(np.asarray(oracle_m.compute()))
+
+    m = MultilabelAUROC(num_labels=NC, average="micro", tolerance=0.05)
+    m.update(PREDS_ML, TARGET_ML)
+    got = float(np.asarray(m.compute()))
+    lo, hi = (float(a) for a in _rank.hist_auroc_bounds(m.pos_hist.sum(0), m.neg_hist.sum(0)))
+    assert lo - 1e-6 <= oracle <= hi + 1e-6
+    assert abs(got - 0.5 * (lo + hi)) <= 1e-6
+
+
+def test_degenerate_lanes_match_exact_conventions():
+    # class 3 never appears -> exact multiclass AUROC reports 0.0 for it;
+    # a label with no positives -> exact AP reports NaN
+    target = jnp.asarray(_rng.randint(0, NC - 1, N), jnp.int32)
+    m = MulticlassAUROC(num_classes=NC, average="none", tolerance=0.1)
+    m.update(PREDS_MC, target)
+    assert float(np.asarray(m.compute())[NC - 1]) == 0.0
+
+    tml = TARGET_ML.at[:, 0].set(0)
+    m2 = MultilabelAveragePrecision(num_labels=NC, average="none", tolerance=0.1)
+    m2.update(PREDS_ML, tml)
+    res = np.asarray(m2.compute())
+    assert np.isnan(res[0]) and not np.any(np.isnan(res[1:]))
+
+
+# ------------------------------------- contract 3: O(1) state, no cat buffer
+
+
+def test_streaming_is_o1_state_with_obs_dispatch_counter():
+    m = BinaryAUROC(tolerance=0.02, tolerance_bits=12)
+    assert not hasattr(m, "preds") and not hasattr(m, "target")
+    assert set(m._defaults) >= {"pos_hist", "neg_hist"}
+
+    chunks_p, chunks_t = [], []
+    state_bytes = None
+    for i in range(32):
+        p = _rng.rand(2048).astype(np.float32)
+        t = _rng.randint(0, 2, 2048).astype(np.int32)
+        chunks_p.append(p)
+        chunks_t.append(t)
+        m.update(jnp.asarray(p), jnp.asarray(t))
+        nbytes = int(m.pos_hist.nbytes + m.neg_hist.nbytes)
+        if state_bytes is None:
+            state_bytes = nbytes
+        assert nbytes == state_bytes == 2 * 4 * (1 << 12)  # O(1): never grows
+
+    with obs.observe(clear=True) as reg:
+        got = float(np.asarray(m.compute()))
+        snap = reg.snapshot()
+    assert snap["rank"]["dispatch/sketch"] >= 1
+    assert snap["rank"]["op/binary_auroc"] >= 1
+
+    oracle_m = BinaryAUROC()
+    oracle_m.update(jnp.asarray(np.concatenate(chunks_p)), jnp.asarray(np.concatenate(chunks_t)))
+    oracle = float(np.asarray(oracle_m.compute()))
+    lo, hi = (float(a) for a in _rank.hist_auroc_bounds(m.pos_hist, m.neg_hist))
+    assert lo - 1e-6 <= oracle <= hi + 1e-6
+    assert abs(got - oracle) <= 0.5 * (hi - lo) + 1e-6
+
+
+@pytest.mark.slow
+def test_2pow24_stream_never_materializes_cat_buffer():
+    """ISSUE 18 acceptance: a 2^24-row AUROC stream at tolerance=0.01 keeps
+    O(1) state (two 2^12-bucket int32 hists), the result lands inside the
+    certified bracket, and dispatch is observable."""
+    m = BinaryAUROC(tolerance=0.01, tolerance_bits=12)
+    total = 1 << 24
+    batch = 1 << 16
+    rng = np.random.default_rng(7)
+    # separable scores so the certificate at 12 bits can actually meet 0.01
+    for _ in range(total // batch):
+        t = rng.integers(0, 2, batch).astype(np.int32)
+        p = (rng.random(batch) * 0.5 + t * 0.4).astype(np.float32)
+        m.update(jnp.asarray(p), jnp.asarray(t))
+        assert not hasattr(m, "preds")
+        assert int(m.pos_hist.nbytes + m.neg_hist.nbytes) == 2 * 4 * (1 << 12)
+    with obs.observe(clear=True) as reg:
+        got = float(np.asarray(m.compute()))
+        snap = reg.snapshot()
+    assert snap["rank"]["dispatch/sketch"] >= 1
+    lo, hi = (float(a) for a in _rank.hist_auroc_bounds(m.pos_hist, m.neg_hist))
+    assert hi - lo <= 2 * 0.01 + 1e-6  # certificate met the tolerance
+    assert lo - 1e-6 <= got <= hi + 1e-6
+
+
+def test_checkpoint_roundtrip_is_o1_and_exactly_resumable():
+    m = BinaryAUROC(tolerance=0.05)
+    m.update(PREDS_B, TARGET_B)
+    ph, nh = np.asarray(m.pos_hist), np.asarray(m.neg_hist)
+    m2 = BinaryAUROC(tolerance=0.05)
+    m2.pos_hist = jnp.asarray(ph)
+    m2.neg_hist = jnp.asarray(nh)
+    assert _bitwise_equal(m.compute(), m2.compute())
+
+
+# ----------------------------------------------------- constructor contracts
+
+
+def test_structural_validation_errors():
+    with pytest.raises(ValueError):
+        BinaryPrecisionRecallCurve(tolerance=0.1)  # curves need full state
+    with pytest.raises(ValueError):
+        BinaryAUROC(tolerance=0.1, thresholds=5)  # binned tier is already O(1)
+    with pytest.raises(ValueError):
+        BinaryAUROC(tolerance=-0.5)
+    with pytest.raises(ValueError):
+        BinaryAUROC(tolerance=0.1, tolerance_bits=2)
+    with pytest.raises(ValueError):
+        BinaryAUROC(tolerance=0.1, tolerance_bits=20)
+    with pytest.raises(ValueError):
+        BinaryAUROC(tolerance=0.1, max_fpr=0.5)  # partial AUC needs exact tier
+    # validate_args=False must NOT disable the structural checks
+    with pytest.raises(ValueError):
+        BinaryAUROC(tolerance=0.1, thresholds=5, validate_args=False)
+
+
+def test_tolerance_participates_in_update_signature():
+    assert "tolerance" in BinaryAUROC._update_signature_attrs
+    assert "tolerance_bits" in BinaryAUROC._update_signature_attrs
+
+
+# ----------------------------------------------- serving-layer integration
+
+
+def test_collection_spec_injects_tolerance_into_sketch_members():
+    from metrics_tpu.serve.server import CollectionSpec
+
+    spec = CollectionSpec(
+        "rank",
+        {"auroc": "BinaryAUROC", "ap": "BinaryAveragePrecision", "acc": "BinaryAccuracy"},
+        tolerance=0.05,
+        tolerance_bits=13,
+    )
+    col = spec.build()
+    assert col["auroc"].tolerance == 0.05 and col["auroc"].tolerance_bits == 13
+    assert col["ap"].tolerance == 0.05
+    assert hasattr(col["auroc"], "pos_hist") and not hasattr(col["auroc"], "preds")
+    assert not hasattr(col["acc"], "pos_hist")
+
+    # per-metric kwargs beat the spec default; binned members stay exact
+    spec2 = CollectionSpec(
+        "rank2", {"auroc": {"class": "BinaryAUROC", "kwargs": {"tolerance": 0.0}}}, tolerance=0.05
+    )
+    assert spec2.build()["auroc"].tolerance == 0.0
+    spec3 = CollectionSpec(
+        "rank3", {"auroc": {"class": "BinaryAUROC", "kwargs": {"thresholds": 5}}}, tolerance=0.05
+    )
+    assert spec3.build()["auroc"].tolerance == 0.0
+
+    with pytest.raises(ValueError):
+        CollectionSpec("bad", {"a": "BinaryAUROC"}, tolerance=-1.0)
+    with pytest.raises(ValueError):
+        CollectionSpec("bad", {"a": "BinaryAUROC"}, tolerance_bits=12)  # bits need tolerance
+
+
+def test_excache_records_and_replays_sketch_entries():
+    from metrics_tpu.serve import excache
+
+    excache.enable_recording(clear=True)
+    m = BinaryAUROC(tolerance=0.05, tolerance_bits=12)
+    m.update(PREDS_B, TARGET_B)
+    m.compute()
+    binary_auroc_exact(PREDS_B, TARGET_B, tolerance=0.5, tolerance_bits=10)
+    excache.disable_recording()
+
+    rank_entries = [e for e in excache.manifest_entries() if e.get("engine") == "rank"]
+    ops = {(e["op"], e.get("tier"), e.get("bits")) for e in rank_entries}
+    assert ("hist_class_counts", "sketch", 12) in ops, ops
+    assert ("hist_auroc_bounds", "sketch", 12) in ops, ops
+    assert ("binary_auroc_exact", "sketch", 10) in ops, ops
+
+    payload = json.loads(json.dumps(excache.manifest_payload()))  # disk round-trip
+    report = excache.prewarm(None, payload)
+    assert report["failed"] == 0
+    assert report["compiled"] >= len(rank_entries)
