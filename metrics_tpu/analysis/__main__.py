@@ -1,9 +1,11 @@
-"""``python -m metrics_tpu.analysis`` — the tmlint/tmsan/tmrace CLI.
+"""``python -m metrics_tpu.analysis`` — the tmlint/tmsan/tmrace/tmown CLI.
 
 Usage:
     python -m metrics_tpu.analysis metrics_tpu/            # lint, baseline-aware
     python -m metrics_tpu.analysis --san                   # + jaxpr/HLO tier (tmsan)
     python -m metrics_tpu.analysis --race                  # thread-safety tier (tmrace)
+    python -m metrics_tpu.analysis --own                   # buffer-ownership tier (tmown)
+    python -m metrics_tpu.analysis --own --write-drift     # refresh tmown_engine_drift.json
     python -m metrics_tpu.analysis --san --write-costs     # refresh tmsan_costs.json
     python -m metrics_tpu.analysis --explain TM-HOSTSYNC   # rule rationale
     python -m metrics_tpu.analysis metrics_tpu/ --write-baseline  # bootstrap waivers
@@ -61,6 +63,23 @@ def main(argv=None) -> int:
         "(TMR-LEAK)",
     )
     parser.add_argument(
+        "--own",
+        action="store_true",
+        help="run tmown, the buffer-ownership tier: model the lifetime of "
+        "array values through donate_argnums boundaries — aliased buffers "
+        "reaching a donated position (TMO-DONATE-ALIAS, the PR 16 class), "
+        "reads of donated-and-dead state (TMO-USE-AFTER-DONATE), duplicate "
+        "donation (TMO-DOUBLE-DONATE), missing snapshot-before-donate guards "
+        "(TMO-SNAPSHOT-GAP), executable-cache key gaps (TMO-KEY-GAP), and "
+        "launch-engine contract drift (TMO-ENGINE-DRIFT)",
+    )
+    parser.add_argument(
+        "--write-drift",
+        action="store_true",
+        help="with --own: write/refresh tmown_engine_drift.json, the "
+        "per-engine contract worksheet for ROADMAP item 5 (commit the diff)",
+    )
+    parser.add_argument(
         "--write-costs",
         action="store_true",
         help="with --san: write/refresh tmsan_costs.json from the measured "
@@ -89,6 +108,8 @@ def main(argv=None) -> int:
         return _main_san(args, paths[0])
     if args.race:
         return _main_race(args, paths[0])
+    if args.own:
+        return _main_own(args, paths[0])
 
     try:
         report = analyze(
@@ -234,6 +255,88 @@ def _main_race(args, target: str) -> int:
         f"{s['locks']} locks, {s['roles']} roles, {s['threads']} thread spawns, "
         f"{s['findings']} findings ({s['waived']} waived, {len(new)} new) "
         f"in {s['seconds']}s"
+    )
+    return 1 if new else 0
+
+
+def _main_own(args, target: str) -> int:
+    """The --own path: the tmown buffer-ownership tier on its own."""
+    import os
+
+    from metrics_tpu.analysis.own import engine_contract
+    from metrics_tpu.analysis.own.runner import run_own
+    from metrics_tpu.analysis.runner import _find_repo_root
+
+    selected = None
+    if args.select:
+        selected = {r.strip().upper() for r in args.select.split(",")}
+        unknown = selected - set(RULES)
+        if unknown:
+            print(f"unknown rule(s): {', '.join(sorted(unknown))}", file=sys.stderr)
+            return 2
+
+    def keep(f):
+        return selected is None or f.rule in selected
+
+    try:
+        report = run_own(target, baseline_path=args.baseline)
+    except FileNotFoundError as err:
+        print(f"tmown: {err}", file=sys.stderr)
+        return 2
+
+    if args.write_drift:
+        out = os.path.join(_find_repo_root(target), engine_contract.DRIFT_FILENAME)
+        engine_contract.write_worksheet(out, report.drift_worksheet())
+        print(f"tmown: wrote {len(report.contract)} engine contracts to {out}")
+
+    if args.write_baseline:
+        out = args.baseline or os.path.join(
+            _find_repo_root(target), baseline_mod.BASELINE_FILENAME
+        )
+        n = baseline_mod.write_baseline(
+            out,
+            [f for f in report.findings if keep(f)],
+            reason="bootstrap waiver: pre-existing finding, triage pending",
+        )
+        print(f"tmown: wrote {n} waivers to {out}")
+        return 0
+
+    new = [f for f in report.new_findings if keep(f)]
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "stats": report.stats,
+                    "contract": report.contract,
+                    "new": [vars(f) for f in new],
+                    "waived": [vars(f) for f in report.waived if keep(f)],
+                    "unused_waivers": [list(k) for k in report.unused_waivers],
+                    "parse_errors": report.parse_errors,
+                },
+                indent=2,
+            )
+        )
+        return 1 if new else 0
+
+    for f in new:
+        print(f.format())
+    if args.verbose:
+        for f in report.waived:
+            if keep(f):
+                print(f.format() + f"  # reason: {f.waive_reason}")
+        for engine, facts in sorted(report.contract.items()):
+            have = [c for c, ev in facts["components"].items() if ev]
+            print(f"# engine {engine}: {len(have)}/{len(facts['components'])} components")
+    for key in report.unused_waivers:
+        print(f"# stale waiver (no matching finding): {':'.join(key)}")
+    for path, err in sorted(report.parse_errors.items()):
+        print(f"# parse error: {path}: {err}")
+    s = report.stats
+    print(
+        f"tmown: {s['files']} files, {s['functions']} functions, "
+        f"{s['donating']} donating, {s['exec_sites']} exec sites, "
+        f"{s['engines']} engines, {s['findings']} findings "
+        f"({s['waived']} waived, {len(new)} new) in {s['seconds']}s"
     )
     return 1 if new else 0
 

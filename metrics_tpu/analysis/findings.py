@@ -429,6 +429,135 @@ RULES: Dict[str, Rule] = {
             ),
         ),
         Rule(
+            id="TMO-DONATE-ALIAS",
+            family="buffer-ownership",
+            summary="possibly-host-aliasing buffer reaches a donated argument position",
+            counter="own.donate_alias",
+            runtime_signal=(
+                "intermittent SIGSEGV/SIGBUS (heap corruption) when the donating "
+                "executable was deserialized from the persistent compile cache — the "
+                "exact PR 16 restore-path crash (~40-88% reproducible under "
+                "concurrent tick load, invisible in single-threaded tests)"
+            ),
+            rationale=(
+                "`jnp.asarray` over a host numpy array (an `np.frombuffer` payload\n"
+                "view, a `memoryview`, any np-allocated buffer) can produce a\n"
+                "ZERO-COPY device buffer aliasing host memory on the CPU backend.\n"
+                "Donating such a buffer (`donate_argnums`) hands XLA memory it does\n"
+                "not own: with a freshly-traced executable this happens to work, but\n"
+                "an executable deserialized from the persistent compilation cache\n"
+                "writes through the alias and corrupts the heap (the PR 16 triple:\n"
+                "numpy-backed restored state x disk-cache executable x donation).\n"
+                "Materialize an owning copy first: `jnp.array(x, copy=True)` — the\n"
+                "`ckpt.restore._owned` idiom — or `.copy()` on the device array."
+            ),
+        ),
+        Rule(
+            id="TMO-USE-AFTER-DONATE",
+            family="buffer-ownership",
+            summary="donated state read on a path after the donating call, before re-pointing",
+            counter="own.use_after_donate",
+            runtime_signal=(
+                "jax raises `Array has been deleted` on the read — or, in an "
+                "exception path, a recovery handler silently re-points live state at "
+                "deleted buffers and the next compute returns garbage"
+            ),
+            rationale=(
+                "A donated input buffer is DELETED by the launch: every read of the\n"
+                "donated name after the call observes a dead array until the name is\n"
+                "re-pointed at the executable's returned buffers. The sanctioned\n"
+                "exception-path idiom is the ingest/fused recovery handler: probe\n"
+                "`.is_deleted()` first and raise `_DonatedStateLost` when the\n"
+                "donation already consumed the buffers — handlers that consult\n"
+                "`is_deleted` are recognized and exempt (the runtime probe is the\n"
+                "dynamic twin of this static rule)."
+            ),
+        ),
+        Rule(
+            id="TMO-DOUBLE-DONATE",
+            family="buffer-ownership",
+            summary="one value reachable at two donated positions of one call",
+            counter="own.double_donate",
+            runtime_signal=(
+                "XLA rejects the launch (`Donation of buffer ... already donated`) "
+                "or — through two pytree leaves sharing one buffer — writes the same "
+                "HBM twice, corrupting whichever accumulation lands first"
+            ),
+            rationale=(
+                "XLA donation is per-buffer: the same underlying buffer arriving at\n"
+                "two donated positions (the same name passed twice, or two state\n"
+                "leaves aliasing one array after manual state surgery) is either\n"
+                "rejected at dispatch or silently double-written. The repo's\n"
+                "sanctioned pass is `FusedCollectionUpdate._donation_guard`, which\n"
+                "dedups by `id(leaf)` and copies the second occurrence — donating\n"
+                "call sites dominated by the guard are exempt."
+            ),
+        ),
+        Rule(
+            id="TMO-SNAPSHOT-GAP",
+            family="buffer-ownership",
+            summary="donating call not dominated by the snapshot-before-donate guard",
+            counter="own.snapshot_gap",
+            runtime_signal=(
+                "an async checkpoint racing the donation serializes deleted buffers: "
+                "`ckpt.saves` commits a step whose payload CRCs were computed over "
+                "freed memory — restore later fails Corrupt, or worse, restores noise"
+            ),
+            rationale=(
+                "Async checkpointing snapshots immutable array REFERENCES and\n"
+                "materializes device->host lazily on the writer thread. A donation\n"
+                "deletes those arrays in place, so every donating call site must\n"
+                "first materialize in-flight snapshot entries that reference the\n"
+                "about-to-be-donated buffers: `ckpt.manager.secure_pending_snapshots`\n"
+                "(via `_secure_ckpt_snapshots` / `_shield_donation`). A donating\n"
+                "launch with no dominating snapshot guard races the ckpt writer."
+            ),
+        ),
+        Rule(
+            id="TMO-KEY-GAP",
+            family="buffer-ownership",
+            summary="executable-cache key omits an input the cached program depends on",
+            counter="own.key_gap",
+            runtime_signal=(
+                "a stale-cache hit: the engine replays an executable compiled for a "
+                "different closed-over value — wrong results with no error, or an "
+                "aval mismatch crash at dispatch (`Argument types differ`)"
+            ),
+            rationale=(
+                "An AOT executable cache (`self._cache[key] = jitted.lower(...)\n"
+                ".compile()`) is only sound when `key` covers everything the compiled\n"
+                "program was specialized on: the avals of every runtime argument AND\n"
+                "every static value the traced step function closes over (builder\n"
+                "arguments, static specs). An argument or closure input missing from\n"
+                "the key means two call sites with different values share one\n"
+                "executable — the stale-cache hazard the fused/fleet/ingest key\n"
+                "tuples (`_aval_key`/`_static_key` components) exist to prevent."
+            ),
+        ),
+        Rule(
+            id="TMO-ENGINE-DRIFT",
+            family="engine-contract",
+            summary="launch-engine donation ladder diverges from the shared contract",
+            counter="own.engine_drift",
+            runtime_signal=(
+                "a hazard fixed in one engine recurs in another: e.g. a snapshot-"
+                "before-donate fix landed in fused but not ingest shows up as the "
+                "same ckpt corruption, months later, in a different code path"
+            ),
+            rationale=(
+                "fused, fleet, ingest, and the rank dispatch each hand-roll the same\n"
+                "launch contract: donation shielding (default-copy + dedup +\n"
+                "snapshot-before-donate), a keyed executable cache, demote-on-failure,\n"
+                "and warm-manifest record/replay. tmown extracts each engine's\n"
+                "implementation of every contract component and flags divergence —\n"
+                "a component present in most engines but missing (or differently\n"
+                "shaped) in one. The full per-engine component matrix is written to\n"
+                "`tmown_engine_drift.json`: it is the design worksheet for ROADMAP\n"
+                "item 5 (the unified serve/engine.py must absorb exactly these\n"
+                "divergences). Waive entries that are by-design until that refactor."
+            ),
+        ),
+        Rule(
             id="TMS-BUDGET",
             family="hlo-cost",
             summary="compiled cost grew >15% over the checked-in budget",
@@ -468,9 +597,16 @@ RACE_RULES: Tuple[str, ...] = (
     "TMR-UNLOCKED", "TMR-ORDER", "TMR-HOLD-HOST", "TMR-HANDLER", "TMR-LEAK",
 )
 
+#: tmown (buffer-ownership tier) rules — produced by ``metrics_tpu.analysis.own``.
+OWN_RULES: Tuple[str, ...] = (
+    "TMO-DONATE-ALIAS", "TMO-USE-AFTER-DONATE", "TMO-DOUBLE-DONATE",
+    "TMO-SNAPSHOT-GAP", "TMO-KEY-GAP", "TMO-ENGINE-DRIFT",
+)
+
 #: AST/introspection (tmlint) rules — everything not owned by another tier.
 LINT_RULES: Tuple[str, ...] = tuple(
-    r for r in RULES if r not in SAN_RULES and r not in RACE_RULES
+    r for r in RULES
+    if r not in SAN_RULES and r not in RACE_RULES and r not in OWN_RULES
 )
 
 

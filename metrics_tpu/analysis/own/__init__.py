@@ -1,0 +1,18 @@
+"""tmown — the buffer-ownership & donation-lifetime tier (TMO-* rules).
+
+The fourth analysis tier: tmlint reasons about traces, tmsan about jaxprs,
+tmrace about threads; tmown reasons about *device-buffer ownership* — the
+lifetime of every array value flowing through a ``donate_argnums`` boundary.
+Born from the PR 16 incident: ``jnp.asarray`` over numpy-backed restored
+state zero-copy aliased host memory, and donating that buffer into an
+executable deserialized from the persistent compile cache corrupted the heap.
+No existing tier could see it; this one exists so nothing like it lands again.
+
+Entry point: ``metrics_tpu.analysis.own.runner.run_own`` /
+``python -m metrics_tpu.analysis --own``. Kept import-light like the san and
+race tiers — importing ``metrics_tpu.analysis`` does not pull this package.
+"""
+
+from metrics_tpu.analysis.own.runner import OwnReport, run_own  # noqa: F401
+
+__all__ = ["OwnReport", "run_own"]

@@ -1,0 +1,67 @@
+"""tmown dataflow rules: turn the flow walk's events into findings.
+
+The model (``buffer_model.py``) records *facts*; this module is the *policy*
+layer — which events become findings, under which rule id, with what message.
+The split mirrors tmrace's model / rule-module layering, and keeps the
+fixture-facing behavior (exact rule id + symbol) in one place.
+
+Symbols: the function qualname for value-lifetime rules, and
+``qualname.<name>`` for TMO-KEY-GAP (one waiver per missing key input, so a
+triaged by-design gap — fused's ``fresh``, ingest's ``filter_kwargs`` — stays
+waived when a new gap appears in the same function).
+"""
+from typing import List
+
+from metrics_tpu.analysis.findings import Finding
+from metrics_tpu.analysis.own.buffer_model import OwnModel
+
+#: event kind -> (rule id, message prefix)
+_EVENT_RULES = {
+    "donate_alias": (
+        "TMO-DONATE-ALIAS",
+        "possibly-aliasing buffer donated without an owning copy "
+        "(materialize with jnp.array(..., copy=True) / ckpt.restore._owned): ",
+    ),
+    "use_after_donate": (
+        "TMO-USE-AFTER-DONATE",
+        "read after donation, before re-pointing: ",
+    ),
+    "double_donate": (
+        "TMO-DOUBLE-DONATE",
+        "one buffer donated twice in one call: ",
+    ),
+    "snapshot_gap": (
+        "TMO-SNAPSHOT-GAP",
+        "snapshot-before-donate guard missing: ",
+    ),
+    "key_gap": (
+        "TMO-KEY-GAP",
+        "executable-cache key gap: ",
+    ),
+}
+
+
+def dataflow_findings(model: OwnModel) -> List[Finding]:
+    """All findings from the five per-function dataflow rules, deduplicated
+    on (rule, path, symbol, line) and sorted for stable output."""
+    out: List[Finding] = []
+    seen = set()
+    for _m, func in model.all_functions():
+        for event in func.events:
+            rule, prefix = _EVENT_RULES[event.kind]
+            key = (rule, event.path, event.symbol, event.line)
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append(
+                Finding(
+                    rule=rule,
+                    path=event.path,
+                    line=event.line,
+                    col=event.col,
+                    symbol=event.symbol,
+                    message=prefix + event.detail,
+                )
+            )
+    out.sort(key=lambda f: (f.path, f.line, f.rule, f.symbol))
+    return out

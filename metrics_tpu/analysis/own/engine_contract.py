@@ -1,0 +1,223 @@
+"""TMO-ENGINE-DRIFT: machine-checked inventory of the four launch engines.
+
+fused, fleet, ingest, and the rank dispatch each hand-roll the same launch
+contract — donation shielding, a keyed executable cache, demote-on-failure,
+warm-manifest record/replay. ROADMAP item 5 wants them collapsed into one
+``serve/engine.py``; this module extracts each engine's implementation of
+every contract component from the ownership model and flags divergence, and
+its full per-engine matrix is the checked-in design worksheet
+(``tmown_engine_drift.json``) the unification refactor starts from.
+
+A component is *drifted* when an engine lacks it while at least two other
+engines implement it — "everyone but you" is the signal that one copy of the
+contract went its own way (a component nobody has is just not part of the
+contract yet).
+"""
+import json
+from typing import Dict, List, Optional, Tuple
+
+from metrics_tpu.analysis.findings import Finding
+from metrics_tpu.analysis.own.buffer_model import OwnFunc, OwnModel, OwnModuleModel
+
+DRIFT_FILENAME = "tmown_engine_drift.json"
+
+#: engine -> (repo-relative path, anchor qualname or None for whole-module).
+#: The anchor is the donating launch path; component evidence is gathered over
+#: the anchor plus its transitively-called package functions.
+ENGINES: Dict[str, Tuple[str, Optional[str]]] = {
+    "fused": ("metrics_tpu/core/fused.py", "FusedCollectionUpdate._launch"),
+    "fleet": ("metrics_tpu/core/fleet.py", "run_step"),
+    "ingest": ("metrics_tpu/serve/ingest.py", "IngestQueue._launch_chain"),
+    "rank": ("metrics_tpu/ops/clf_curve.py", None),  # module-level jit kernels
+}
+
+#: the shared contract: component -> human description (worksheet rows)
+COMPONENTS: Dict[str, str] = {
+    "donation": "in-place accumulation via donate_argnums on the launch step",
+    "donation_guard": "duplicate-buffer dedup before donation (_donation_guard)",
+    "snapshot_before_donate": "materialize pending async-ckpt snapshots first",
+    "default_shield": "registered-default leaves copied before donation (_protected_ids)",
+    "executable_cache": "keyed AOT executable cache (.lower().compile() reuse)",
+    "demote_on_failure": "broken-key sentinel: failed signature degrades, never retries",
+    "warm_manifest_record": "compile recorded for excache prewarm replay (record_*_compile)",
+}
+
+
+def _reachable(
+    model: OwnModel, module: OwnModuleModel, func: OwnFunc, _seen=None
+) -> List[OwnFunc]:
+    """The anchor plus every package function it transitively calls — walked
+    over the raw AST call symbols so helper evidence (``_gather_states`` ->
+    ``_protected_ids``) counts toward its engine."""
+    import ast
+
+    from metrics_tpu.analysis.jitmap import dotted_name
+
+    if _seen is None:
+        _seen = set()
+    key = (module.path, func.qualname)
+    if key in _seen:
+        return []
+    _seen.add(key)
+    out = [func]
+    node = module.find_def(func.qualname)
+    if node is None:
+        return out
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            name = dotted_name(sub.func)
+            if not name:
+                continue
+            hit = model.resolve_call(module, name, func)
+            if hit:
+                out.extend(_reachable(model, hit[0], hit[1], _seen))
+    return out
+
+
+def _engine_facts(
+    model: OwnModel, path: str, anchor: Optional[str]
+) -> Optional[Dict]:
+    module = model.modules.get(path)
+    if module is None:
+        return None
+    if anchor is not None:
+        root = module.functions.get(anchor)
+        if root is None:
+            return None
+        funcs = _reachable(model, module, root)
+        anchor_line = root.line
+    else:
+        funcs = list(module.functions.values())
+        anchor_line = 1
+
+    def evidence(pred) -> Optional[str]:
+        for f in funcs:
+            if pred(f):
+                return f.qualname
+        return None
+
+    present: Dict[str, Optional[str]] = {
+        "donation": evidence(lambda f: f.exec_sites > 0 or f.builds_donating),
+        "donation_guard": evidence(lambda f: "dedup" in f.shield_calls or f.dedup_shield),
+        "snapshot_before_donate": evidence(
+            lambda f: "snapshot" in f.shield_calls or f.snapshot_shield
+        ),
+        "default_shield": evidence(
+            lambda f: "_protected_ids" in f.qualname
+            or any("_protected_ids" in e for e in _called_names(model, module, f))
+        ),
+        "executable_cache": evidence(lambda f: f.cache_get or f.cache_store),
+        "demote_on_failure": evidence(lambda f: f.demote_sentinel),
+        "warm_manifest_record": evidence(lambda f: bool(f.warm_records)),
+    }
+    key_fields: List[str] = []
+    for f in funcs:
+        if f.key_fields:
+            key_fields = f.key_fields
+            break
+    return {
+        "path": path,
+        "anchor": anchor or "<module>",
+        "anchor_line": anchor_line,
+        "components": present,
+        "key_fields": key_fields,
+    }
+
+
+def _called_names(model: OwnModel, module: OwnModuleModel, func: OwnFunc) -> List[str]:
+    import ast
+
+    from metrics_tpu.analysis.jitmap import dotted_name
+
+    node = module.find_def(func.qualname)
+    if node is None:
+        return []
+    out = []
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            name = dotted_name(sub.func)
+            if name:
+                out.append(name)
+    return out
+
+
+def extract_contract(
+    model: OwnModel, engines: Optional[Dict[str, Tuple[str, Optional[str]]]] = None
+) -> Dict[str, Dict]:
+    """Per-engine component matrix; engines whose anchor file is absent from
+    the analyzed tree are skipped (fixture runs never see the repo anchors)."""
+    engines = ENGINES if engines is None else engines
+    out: Dict[str, Dict] = {}
+    for name, (path, anchor) in engines.items():
+        facts = _engine_facts(model, path, anchor)
+        if facts is not None:
+            out[name] = facts
+    return out
+
+
+def drift_findings(matrix: Dict[str, Dict]) -> List[Finding]:
+    """One finding per (engine, component absent while >= 2 peers have it)."""
+    out: List[Finding] = []
+    for component, description in COMPONENTS.items():
+        holders = [e for e, facts in matrix.items() if facts["components"].get(component)]
+        if len(holders) < 2:
+            continue
+        for engine, facts in sorted(matrix.items()):
+            if facts["components"].get(component):
+                continue
+            out.append(
+                Finding(
+                    rule="TMO-ENGINE-DRIFT",
+                    path=facts["path"],
+                    line=facts["anchor_line"],
+                    col=0,
+                    symbol=f"{engine}.{component}",
+                    message=(
+                        f"engine contract drift: {engine} lacks "
+                        f"'{component}' ({description}) implemented by "
+                        f"{', '.join(sorted(holders))} — ROADMAP item 5 input, "
+                        f"see {DRIFT_FILENAME}"
+                    ),
+                )
+            )
+    out.sort(key=lambda f: (f.path, f.symbol))
+    return out
+
+
+def worksheet(matrix: Dict[str, Dict], findings: List[Finding]) -> Dict:
+    """The checked-in ROADMAP-item-5 worksheet payload (deterministic)."""
+    return {
+        "version": 1,
+        "comment": (
+            "tmown engine-contract worksheet: what the unified serve/engine.py"
+            " (ROADMAP item 5) must absorb from each launch engine. Regenerate"
+            " with `python -m metrics_tpu.analysis --own --write-drift` after"
+            " engine changes; test_tmown.py compares this file to a fresh run."
+        ),
+        "contract": COMPONENTS,
+        "engines": {
+            name: {
+                "path": facts["path"],
+                "anchor": facts["anchor"],
+                "components": {
+                    comp: facts["components"].get(comp) for comp in COMPONENTS
+                },
+                "key_fields": facts["key_fields"],
+            }
+            for name, facts in sorted(matrix.items())
+        },
+        "divergences": [
+            {"symbol": f.symbol, "message": f.message} for f in findings
+        ],
+    }
+
+
+def write_worksheet(path: str, payload: Dict) -> None:
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=2, sort_keys=False)
+        fh.write("\n")
+
+
+def load_worksheet(path: str) -> Dict:
+    with open(path, "r", encoding="utf-8") as fh:
+        return json.load(fh)

@@ -440,7 +440,9 @@ def test_tmlint_no_new_findings():
 
 
 def test_every_rule_documented_and_cross_linked():
-    from metrics_tpu.analysis.findings import LINT_RULES, RACE_RULES, SAN_RULES
+    from metrics_tpu.analysis.findings import (
+        LINT_RULES, OWN_RULES, RACE_RULES, SAN_RULES,
+    )
 
     assert set(LINT_RULES) == {
         "TM-HOSTSYNC", "TM-PYBRANCH", "TM-DYNSHAPE", "TM-RETRACE",
@@ -454,11 +456,18 @@ def test_every_rule_documented_and_cross_linked():
     assert set(RACE_RULES) == {
         "TMR-UNLOCKED", "TMR-ORDER", "TMR-HOLD-HOST", "TMR-HANDLER", "TMR-LEAK",
     }
-    assert set(RULES) == set(LINT_RULES) | set(SAN_RULES) | set(RACE_RULES)
-    # the three tiers partition RULES: every waiver has exactly one staleness home
-    assert not set(LINT_RULES) & set(SAN_RULES)
-    assert not set(LINT_RULES) & set(RACE_RULES)
-    assert not set(SAN_RULES) & set(RACE_RULES)
+    assert set(OWN_RULES) == {
+        "TMO-DONATE-ALIAS", "TMO-USE-AFTER-DONATE", "TMO-DOUBLE-DONATE",
+        "TMO-SNAPSHOT-GAP", "TMO-KEY-GAP", "TMO-ENGINE-DRIFT",
+    }
+    assert set(RULES) == (
+        set(LINT_RULES) | set(SAN_RULES) | set(RACE_RULES) | set(OWN_RULES)
+    )
+    # the four tiers partition RULES: every waiver has exactly one staleness home
+    tiers = [set(LINT_RULES), set(SAN_RULES), set(RACE_RULES), set(OWN_RULES)]
+    for i, a in enumerate(tiers):
+        for b in tiers[i + 1:]:
+            assert not a & b
     for rule_id, rule in RULES.items():
         text = explain(rule_id)
         assert rule_id in text and rule.runtime_signal in text

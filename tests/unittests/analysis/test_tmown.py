@@ -1,0 +1,670 @@
+"""tmown unit tier: per-rule seeded fixtures (each with a clean twin — the
+TMO-DONATE-ALIAS pair reproduces the PR 16 restore-aliasing incident), the
+engine-contract drift matrix, the checked-in ROADMAP-item-5 worksheet, the
+four-tier waiver scoping, the repo-wide no-new-findings guard, and end-to-end
+CLI exit-code regressions.
+
+Pure static analysis — nothing here executes the analyzed code; it rides the
+``lint`` CI step next to tmlint/tmsan/tmrace and also carries the ``own``
+marker for the dedicated CI step.
+"""
+import pathlib
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+import metrics_tpu
+from metrics_tpu.analysis import BASELINE_FILENAME
+from metrics_tpu.analysis.own import run_own
+from metrics_tpu.analysis.own import engine_contract
+from metrics_tpu.analysis.own.buffer_model import build_model
+
+pytestmark = [pytest.mark.lint, pytest.mark.own]
+
+REPO_ROOT = pathlib.Path(metrics_tpu.__file__).resolve().parent.parent
+
+
+def _own_snippet(tmp_path, source):
+    path = tmp_path / "mod.py"
+    path.write_text(textwrap.dedent(source))
+    report = run_own(str(path), repo_root=str(tmp_path))
+    assert report.parse_errors == {}
+    # fixture runs never see the repo engine anchors: no contract, no drift
+    assert report.contract == {}
+    return report.new_findings
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+# --------------------------------------------------------- TMO-DONATE-ALIAS
+
+
+def test_donate_alias_bad_pr16_twin(tmp_path):
+    """The PR 16 heap-corruption shape: jnp.asarray over an np.frombuffer
+    payload view zero-copy aliases host memory, then flows into a donated
+    position of a compiled step -> TMO-DONATE-ALIAS."""
+    findings = _own_snippet(
+        tmp_path,
+        """
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+
+        def secure_pending_snapshots(buffers):
+            return buffers
+
+        def step(state):
+            return state + 1
+
+        def restore(payload):
+            view = np.frombuffer(payload, dtype="float32")
+            state = jnp.asarray(view)
+            jitted = jax.jit(step, donate_argnums=(0,))
+            secure_pending_snapshots([state])
+            out = jitted(state)
+            return out
+        """,
+    )
+    assert _rules(findings) == ["TMO-DONATE-ALIAS"]
+    (f,) = findings
+    assert f.symbol == "restore"
+    assert "aliases host memory" in f.message
+
+
+def test_donate_alias_clean_twin_owned_copy(tmp_path):
+    """Same flow through the ckpt.restore._owned() fix — jnp.array(...,
+    copy=True) materializes an owned device buffer -> clean."""
+    findings = _own_snippet(
+        tmp_path,
+        """
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+
+        def secure_pending_snapshots(buffers):
+            return buffers
+
+        def step(state):
+            return state + 1
+
+        def restore(payload):
+            view = np.frombuffer(payload, dtype="float32")
+            state = jnp.array(view, copy=True)
+            jitted = jax.jit(step, donate_argnums=(0,))
+            secure_pending_snapshots([state])
+            out = jitted(state)
+            return out
+        """,
+    )
+    assert findings == []
+
+
+def test_donate_alias_host_numpy_bad(tmp_path):
+    """Donating host-allocated numpy memory directly (zero-copy on the CPU
+    backend) is the same class of bug, phrased differently."""
+    findings = _own_snippet(
+        tmp_path,
+        """
+        import numpy as np
+        import jax
+
+        def secure_pending_snapshots(buffers):
+            return buffers
+
+        def step(state):
+            return state
+
+        def launch(n):
+            state = np.zeros(n, dtype="float32")
+            jitted = jax.jit(step, donate_argnums=(0,))
+            secure_pending_snapshots([state])
+            return jitted(state)
+        """,
+    )
+    assert _rules(findings) == ["TMO-DONATE-ALIAS"]
+    assert "host-allocated numpy memory" in findings[0].message
+
+
+# ----------------------------------------------------- TMO-USE-AFTER-DONATE
+
+
+def test_use_after_donate_bad(tmp_path):
+    """Reading a donated name before re-pointing it: the buffer is dead."""
+    findings = _own_snippet(
+        tmp_path,
+        """
+        import jax
+        import jax.numpy as jnp
+
+        def secure_pending_snapshots(buffers):
+            return buffers
+
+        def step(state):
+            return state + 1
+
+        def launch(n):
+            state = jnp.zeros(n)
+            jitted = jax.jit(step, donate_argnums=(0,))
+            secure_pending_snapshots([state])
+            out = jitted(state)
+            norm = out - state
+            return norm
+        """,
+    )
+    assert _rules(findings) == ["TMO-USE-AFTER-DONATE"]
+    (f,) = findings
+    assert f.symbol == "launch"
+    assert "`state` was donated" in f.message
+
+
+def test_use_after_donate_repoint_clean_twin(tmp_path):
+    """Reassigning the name to the exec result re-points it -> clean."""
+    findings = _own_snippet(
+        tmp_path,
+        """
+        import jax
+        import jax.numpy as jnp
+
+        def secure_pending_snapshots(buffers):
+            return buffers
+
+        def step(state):
+            return state + 1
+
+        def launch(n):
+            state = jnp.zeros(n)
+            jitted = jax.jit(step, donate_argnums=(0,))
+            secure_pending_snapshots([state])
+            state = jitted(state)
+            norm = state.sum()
+            return norm
+        """,
+    )
+    assert findings == []
+
+
+def test_use_after_donate_is_deleted_handler_exempt(tmp_path):
+    """The sanctioned recovery idiom — an except handler probing
+    ``.is_deleted()`` before reloading — reads a maybe-dead buffer on
+    purpose and must not be flagged (the fused/ingest recovery path)."""
+    findings = _own_snippet(
+        tmp_path,
+        """
+        import jax
+        import jax.numpy as jnp
+
+        def secure_pending_snapshots(buffers):
+            return buffers
+
+        def step(state):
+            return state + 1
+
+        def launch(n):
+            state = jnp.zeros(n)
+            jitted = jax.jit(step, donate_argnums=(0,))
+            secure_pending_snapshots([state])
+            try:
+                out = jitted(state)
+            except RuntimeError:
+                if state.is_deleted():
+                    out = jnp.zeros(n)
+                else:
+                    raise
+            return out
+        """,
+    )
+    assert findings == []
+
+
+# ------------------------------------------------------- TMO-DOUBLE-DONATE
+
+
+def test_double_donate_bad(tmp_path):
+    """One buffer reaching two donated positions of one call with no dedup
+    guard: XLA frees it twice."""
+    findings = _own_snippet(
+        tmp_path,
+        """
+        import jax
+
+        def secure_pending_snapshots(buffers):
+            return buffers
+
+        def step2(a, b):
+            return a + b, b
+
+        def launch(x):
+            jitted = jax.jit(step2, donate_argnums=(0, 1))
+            secure_pending_snapshots([x])
+            out, aux = jitted(x, x)
+            return out
+        """,
+    )
+    assert _rules(findings) == ["TMO-DOUBLE-DONATE"]
+    (f,) = findings
+    assert f.symbol == "launch"
+    assert "positions 0 and 1" in f.message
+
+
+def test_double_donate_distinct_args_clean_twin(tmp_path):
+    findings = _own_snippet(
+        tmp_path,
+        """
+        import jax
+
+        def secure_pending_snapshots(buffers):
+            return buffers
+
+        def step2(a, b):
+            return a + b, b
+
+        def launch(x, y):
+            jitted = jax.jit(step2, donate_argnums=(0, 1))
+            secure_pending_snapshots([x, y])
+            out, aux = jitted(x, y)
+            return out
+        """,
+    )
+    assert findings == []
+
+
+def test_double_donate_guard_clean_twin(tmp_path):
+    """A dominating _donation_guard call (the fused dedup) sanctions the
+    duplicate — the guard replaces dupes with copies at runtime."""
+    findings = _own_snippet(
+        tmp_path,
+        """
+        import jax
+
+        def secure_pending_snapshots(buffers):
+            return buffers
+
+        def _donation_guard(buffers):
+            return buffers
+
+        def step2(a, b):
+            return a + b, b
+
+        def launch(x):
+            jitted = jax.jit(step2, donate_argnums=(0, 1))
+            secure_pending_snapshots([x])
+            _donation_guard([x, x])
+            out, aux = jitted(x, x)
+            return out
+        """,
+    )
+    assert findings == []
+
+
+# -------------------------------------------------------- TMO-SNAPSHOT-GAP
+
+
+def test_snapshot_gap_bad(tmp_path):
+    """A donating exec with no dominating snapshot shield: a pending async
+    ckpt may still reference the about-to-be-freed buffers."""
+    findings = _own_snippet(
+        tmp_path,
+        """
+        import jax
+        import jax.numpy as jnp
+
+        def step(state):
+            return state + 1
+
+        def launch(n):
+            state = jnp.zeros(n)
+            jitted = jax.jit(step, donate_argnums=(0,))
+            out = jitted(state)
+            return out
+        """,
+    )
+    assert _rules(findings) == ["TMO-SNAPSHOT-GAP"]
+    (f,) = findings
+    assert f.symbol == "launch"
+    assert "secure_pending_snapshots" in f.message
+
+
+def test_snapshot_gap_shield_clean_twin(tmp_path):
+    findings = _own_snippet(
+        tmp_path,
+        """
+        import jax
+        import jax.numpy as jnp
+
+        def secure_pending_snapshots(buffers):
+            return buffers
+
+        def step(state):
+            return state + 1
+
+        def launch(n):
+            state = jnp.zeros(n)
+            jitted = jax.jit(step, donate_argnums=(0,))
+            secure_pending_snapshots([state])
+            out = jitted(state)
+            return out
+        """,
+    )
+    assert findings == []
+
+
+def test_snapshot_gap_fleet_shield_assignment_with_starred_args(tmp_path):
+    """Regression for the fleet false positive: the shield runs in a branch
+    (not dominating), the donated value is the *result* of _shield_donation,
+    and the exec passes trailing *extras — a Starred after the donated
+    position must not disable the donated-argument mapping."""
+    findings = _own_snippet(
+        tmp_path,
+        """
+        import jax
+
+        def _shield_donation(metric, state):
+            return state
+
+        def step(state, *extras):
+            return state
+
+        def launch(metric, state, extras, donate):
+            jitted = jax.jit(step, donate_argnums=(0,))
+            if donate:
+                state = _shield_donation(metric, state)
+            out = jitted(state, *extras)
+            return out
+        """,
+    )
+    assert findings == []
+
+
+# ------------------------------------------------------------- TMO-KEY-GAP
+
+
+def test_key_gap_bad(tmp_path):
+    """The executable-cache key omits a runtime argument of the compiled
+    call (`dyn`) and a local the traced step closes over (`scale`): a cache
+    hit replays an executable specialized on stale values."""
+    findings = _own_snippet(
+        tmp_path,
+        """
+        import jax
+
+        def secure_pending_snapshots(buffers):
+            return buffers
+
+        class Engine:
+            def __init__(self):
+                self._cache = {}
+
+            def launch(self, tag, state, dyn, scale):
+                def step(s, d):
+                    return s * scale + d
+
+                key = (tag, state.shape)
+                compiled = self._cache.get(key)
+                if compiled is None:
+                    compiled = jax.jit(step, donate_argnums=(0,))
+                    self._cache[key] = compiled
+                secure_pending_snapshots([state])
+                out = compiled(state, dyn)
+                return out
+        """,
+    )
+    assert _rules(findings) == ["TMO-KEY-GAP"]
+    assert sorted(f.symbol for f in findings) == [
+        "Engine.launch.dyn", "Engine.launch.scale",
+    ]
+    by_symbol = {f.symbol: f.message for f in findings}
+    assert "runtime argument of the compiled call" in by_symbol["Engine.launch.dyn"]
+    assert "closed over by the traced step" in by_symbol["Engine.launch.scale"]
+
+
+def test_key_gap_clean_twin(tmp_path):
+    """Same engine with both inputs folded into the key -> clean."""
+    findings = _own_snippet(
+        tmp_path,
+        """
+        import jax
+
+        def secure_pending_snapshots(buffers):
+            return buffers
+
+        class Engine:
+            def __init__(self):
+                self._cache = {}
+
+            def launch(self, tag, state, dyn, scale):
+                def step(s, d):
+                    return s * scale + d
+
+                key = (tag, state.shape, dyn.shape, scale)
+                compiled = self._cache.get(key)
+                if compiled is None:
+                    compiled = jax.jit(step, donate_argnums=(0,))
+                    self._cache[key] = compiled
+                secure_pending_snapshots([state])
+                out = compiled(state, dyn)
+                return out
+        """,
+    )
+    assert findings == []
+
+
+# -------------------------------------------------------- TMO-ENGINE-DRIFT
+
+
+_FULL_ENGINE = textwrap.dedent(
+    """
+    import jax
+
+    _CACHE = {}
+
+    def secure_pending_snapshots(buffers):
+        return buffers
+
+    def step(s):
+        return s + 1
+
+    def launch(tag, state):
+        key = (tag, state.shape)
+        compiled = _CACHE.get(key)
+        if compiled is None:
+            compiled = jax.jit(step, donate_argnums=(0,))
+            _CACHE[key] = compiled
+        secure_pending_snapshots([state])
+        return compiled(state)
+    """
+)
+
+_NO_SNAPSHOT_ENGINE = textwrap.dedent(
+    """
+    import jax
+
+    _CACHE = {}
+
+    def step(s):
+        return s + 1
+
+    def launch(tag, state):
+        key = (tag, state.shape)
+        compiled = _CACHE.get(key)
+        if compiled is None:
+            compiled = jax.jit(step, donate_argnums=(0,))
+            _CACHE[key] = compiled
+        return compiled(state)
+    """
+)
+
+
+def _mini_fleet(third_engine_src):
+    model = build_model(
+        {
+            "eng_a.py": ("eng_a", _FULL_ENGINE),
+            "eng_b.py": ("eng_b", _FULL_ENGINE),
+            "eng_c.py": ("eng_c", third_engine_src),
+        }
+    )
+    engines = {
+        "a": ("eng_a.py", "launch"),
+        "b": ("eng_b.py", "launch"),
+        "c": ("eng_c.py", "launch"),
+    }
+    return engine_contract.extract_contract(model, engines=engines)
+
+
+def test_engine_drift_fires_on_everyone_but_you():
+    """A component two peers implement and one engine lacks is drift; the
+    components nobody implements are just not part of the contract."""
+    matrix = _mini_fleet(_NO_SNAPSHOT_ENGINE)
+    findings = engine_contract.drift_findings(matrix)
+    assert _rules(findings) == ["TMO-ENGINE-DRIFT"]
+    (f,) = findings
+    assert f.symbol == "c.snapshot_before_donate"
+    assert f.path == "eng_c.py"
+    assert "implemented by a, b" in f.message
+    # the worksheet payload carries the same divergence
+    payload = engine_contract.worksheet(matrix, findings)
+    assert [d["symbol"] for d in payload["divergences"]] == ["c.snapshot_before_donate"]
+    assert payload["engines"]["a"]["components"]["executable_cache"] == "launch"
+    assert payload["engines"]["a"]["key_fields"] == ["tag", "state.shape"]
+
+
+def test_engine_drift_uniform_fleet_clean():
+    matrix = _mini_fleet(_FULL_ENGINE)
+    assert engine_contract.drift_findings(matrix) == []
+
+
+# --------------------------------------------- repo-wide guard + worksheet
+
+
+@pytest.fixture(scope="module")
+def repo_report():
+    return run_own(
+        str(REPO_ROOT / "metrics_tpu"),
+        baseline_path=str(REPO_ROOT / BASELINE_FILENAME),
+    )
+
+
+def test_tmown_no_new_findings(repo_report):
+    """The whole package must be ownership-clean against the checked-in
+    baseline, with every waiver carrying a reason and none stale."""
+    assert repo_report.parse_errors == {}
+    msgs = "\n".join(f.format() for f in repo_report.new_findings)
+    assert not repo_report.new_findings, f"new tmown findings:\n{msgs}"
+    assert not repo_report.unused_waivers, (
+        f"stale baseline waivers: {repo_report.unused_waivers}"
+    )
+    for f in repo_report.waived:
+        assert f.waive_reason, f"waiver without a reason covers {f.key()}"
+    # the ISSUE's cold-wall budget is 60s on CPU; the AST sweep is ~20x under
+    assert repo_report.stats["seconds"] < 60
+
+
+def test_repo_engine_contract(repo_report):
+    """The model must see all four launch engines, with the shared contract
+    fully present on fused/fleet/ingest (their divergence set is empty)."""
+    assert set(repo_report.contract) == {"fused", "fleet", "ingest", "rank"}
+    for engine in ("fused", "fleet", "ingest"):
+        components = repo_report.contract[engine]["components"]
+        missing = [c for c, ev in components.items() if not ev]
+        assert not missing, f"{engine} lost contract components: {missing}"
+        # every stateful engine keys its executable cache on something real
+        assert repo_report.contract[engine]["key_fields"]
+
+
+def test_drift_worksheet_in_sync(repo_report):
+    """`tmown_engine_drift.json` is the checked-in ROADMAP-item-5 worksheet;
+    it must match a fresh extraction (regenerate with --own --write-drift)."""
+    checked_in = engine_contract.load_worksheet(
+        str(REPO_ROOT / engine_contract.DRIFT_FILENAME)
+    )
+    assert checked_in == repo_report.drift_worksheet()
+    # every rank divergence the worksheet records is triaged in the baseline
+    recorded = {d["symbol"] for d in checked_in["divergences"]}
+    waived = {f.symbol for f in repo_report.waived if f.rule == "TMO-ENGINE-DRIFT"}
+    assert recorded == waived
+
+
+def test_own_obs_counters(tmp_path):
+    """A seeded run increments the own.* counters when obs is enabled."""
+    import metrics_tpu.obs as obs
+
+    path = tmp_path / "mod.py"
+    path.write_text(
+        textwrap.dedent(
+            """
+            import numpy as np
+            import jax
+
+            def secure_pending_snapshots(buffers):
+                return buffers
+
+            def step(state):
+                return state
+
+            def launch(payload):
+                state = np.frombuffer(payload, dtype="float32")
+                jitted = jax.jit(step, donate_argnums=(0,))
+                secure_pending_snapshots([state])
+                return jitted(state)
+            """
+        )
+    )
+    with obs.observe() as reg:
+        before = reg.get("own", "donate_alias")
+        report = run_own(str(path), repo_root=str(tmp_path))
+        assert _rules(report.new_findings) == ["TMO-DONATE-ALIAS"]
+        assert reg.get("own", "donate_alias") == before + 1
+
+
+# ------------------------------------------------------------ CLI end-to-end
+
+
+_CLI_ENV = {"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu", "PYTHONPATH": str(REPO_ROOT)}
+
+
+def _run_cli(pkg, tmp_path):
+    return subprocess.run(
+        [sys.executable, "-m", "metrics_tpu.analysis", "--own", str(pkg)],
+        capture_output=True, text=True, timeout=120, env=_CLI_ENV, cwd=str(tmp_path),
+    )
+
+
+@pytest.mark.smoke
+def test_cli_donate_alias_regression(tmp_path):
+    """Acceptance regression: the seeded PR 16 aliasing shape must fail the
+    build end-to-end (exit 1, rule named); the owned-copy twin passes."""
+    pkg = tmp_path / "toypkg"
+    pkg.mkdir()
+    bad = textwrap.dedent(
+        """
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+
+        def secure_pending_snapshots(buffers):
+            return buffers
+
+        def step(state):
+            return state + 1
+
+        def restore(payload):
+            view = np.frombuffer(payload, dtype="float32")
+            state = jnp.asarray(view)
+            jitted = jax.jit(step, donate_argnums=(0,))
+            secure_pending_snapshots([state])
+            return jitted(state)
+        """
+    )
+    (pkg / "mod.py").write_text(bad)
+    result = _run_cli(pkg, tmp_path)
+    assert result.returncode == 1, result.stdout + result.stderr
+    assert "TMO-DONATE-ALIAS" in result.stdout
+
+    (pkg / "mod.py").write_text(
+        bad.replace("jnp.asarray(view)", "jnp.array(view, copy=True)")
+    )
+    result = _run_cli(pkg, tmp_path)
+    assert result.returncode == 0, result.stdout + result.stderr

@@ -1,5 +1,5 @@
 """tmrace unit tier: per-rule seeded fixtures (each with a clean twin), the
-thread-role model, annotation semantics, three-tier waiver scoping, the
+thread-role model, annotation semantics, four-tier waiver scoping, the
 repo-wide no-new-findings guard, and end-to-end CLI exit-code regressions.
 
 The threaded *stress* corroboration of these rules lives in
@@ -540,19 +540,22 @@ def test_repo_thread_role_model():
         assert lock_id in model.locks, f"missing lock {lock_id}"
 
 
-# ----------------------------------------------- three-tier waiver scoping
+# ----------------------------------------------- four-tier waiver scoping
 
 
 def test_waiver_scoping_partitions_staleness():
     """Satellite contract: each tier ignores the other tiers' waivers when
-    checking staleness — a TMR waiver is never 'stale' to tmlint/tmsan."""
+    checking staleness — a TMR waiver is never 'stale' to tmlint/tmsan/tmown."""
     from metrics_tpu.analysis import baseline as baseline_mod
-    from metrics_tpu.analysis.findings import LINT_RULES, RACE_RULES, SAN_RULES
+    from metrics_tpu.analysis.findings import (
+        LINT_RULES, OWN_RULES, RACE_RULES, SAN_RULES,
+    )
 
     waivers = {
         ("TM-HOSTSYNC", "a.py", "f"): "lint reason",
         ("TMS-F64", "b.py", "g"): "san reason",
         ("TMR-ORDER", "c.py", "x->y->x"): "race reason",
+        ("TMO-DONATE-ALIAS", "d.py", "restore"): "own reason",
     }
     race_scope = baseline_mod.scope_waivers(waivers, RACE_RULES)
     assert set(race_scope) == {("TMR-ORDER", "c.py", "x->y->x")}
@@ -564,6 +567,9 @@ def test_waiver_scoping_partitions_staleness():
     }
     assert set(baseline_mod.scope_waivers(waivers, SAN_RULES)) == {
         ("TMS-F64", "b.py", "g")
+    }
+    assert set(baseline_mod.scope_waivers(waivers, OWN_RULES)) == {
+        ("TMO-DONATE-ALIAS", "d.py", "restore")
     }
 
 
