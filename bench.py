@@ -2073,6 +2073,52 @@ def bench_own(runs: int = 3) -> dict:
     }
 
 
+def bench_shard(runs: int = 3) -> dict:
+    """``--shard-overhead``: cold tmshard wall time over the full package.
+
+    Each run is a fresh interpreter (``python -m metrics_tpu.analysis
+    --shard``) so the number is the true cold cost the CI lint tier pays:
+    interpreter + jax import + one AST walk per function, the bound-axis-set
+    and axis-param fixpoints, and the mesh-awareness matrix over the five
+    engines. ``analyze_s`` is the analyzer-internal time from the summary
+    line's own stopwatch — the gap to the cold number is import cost.
+    Recorded so the sharding tier's cost stays visible as ROADMAP items 1 & 4
+    grow the SPMD surface — the acceptance budget is 60 s cold on CPU.
+    """
+    import os
+    import re
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    wall_s, analyze_s, summary = [], [], ""
+    for _ in range(runs):
+        t0 = time.perf_counter()
+        proc = subprocess.run(
+            [sys.executable, "-m", "metrics_tpu.analysis", "--shard"],
+            cwd=repo, capture_output=True, text=True, timeout=900,
+        )
+        wall_s.append(time.perf_counter() - t0)
+        if proc.returncode != 0:
+            raise RuntimeError(f"tmshard reported new findings during bench:\n{proc.stdout[-2000:]}")
+        summary = proc.stdout.strip().rsplit("\n", 1)[-1]
+        m = re.search(r"in ([0-9.]+)s", summary)
+        if m:
+            analyze_s.append(float(m.group(1)))
+    return {
+        "metric": "tmshard_cold_wall_s",
+        "value": round(statistics.median(wall_s), 2),
+        "unit": "s",
+        "vs_baseline": None,
+        "analyze_s": round(statistics.median(analyze_s), 2) if analyze_s else None,
+        "summary_line": summary,
+        "bound": "host-only: interpreter+jax import dominates the cold number;"
+                 " the analyzer itself is one AST fact walk per function plus"
+                 " two bounded (<=8 pass) fixpoints over the call graph and"
+                 " the reachable-set walk that builds the mesh matrix",
+    }
+
+
 def bench_obs_trace(out_path=None, steps: int = 3) -> dict:
     """``--obs-trace``: one instrumented fused+fleet window exported as a
     Perfetto/Chrome ``trace_event`` JSON, plus the runtime<->static cost
@@ -2159,7 +2205,7 @@ if __name__ == "__main__":
     parser = argparse.ArgumentParser(description="metrics_tpu benchmarks")
     parser.add_argument(
         "--config",
-        choices=("accuracy", "logits", "confmat", "map", "ssim", "retrieval", "auroc", "fid", "fused", "fleet", "ingest", "coldstart", "serve", "sketch", "chaos", "lint", "race", "own", "obs_trace", "flow", "all"),
+        choices=("accuracy", "logits", "confmat", "map", "ssim", "retrieval", "auroc", "fid", "fused", "fleet", "ingest", "coldstart", "serve", "sketch", "chaos", "lint", "race", "own", "shard", "obs_trace", "flow", "all"),
         default="all",
     )
     parser.add_argument(
@@ -2267,6 +2313,14 @@ if __name__ == "__main__":
         " --config all)",
     )
     parser.add_argument(
+        "--shard-overhead",
+        action="store_true",
+        help="also time tmshard (the sharding/collective analyzer tier) cold:"
+        " fresh-interpreter p50 of `python -m metrics_tpu.analysis --shard`,"
+        " reported as a JSON line so the SPMD tier's own cost stays visible"
+        " against its 60 s acceptance budget (also runs under --config all)",
+    )
+    parser.add_argument(
         "--flow-overhead",
         action="store_true",
         help="also run the tmflow tracing-cost bench (metrics_tpu/obs/flow.py):"
@@ -2335,6 +2389,7 @@ if __name__ == "__main__":
         ("san", bench_san),
         ("race", bench_race),
         ("own", bench_own),
+        ("shard", bench_shard),
         ("obs_trace", bench_obs_trace),
     ):
         if name == "ckpt" and not cli.ckpt:
@@ -2365,7 +2420,9 @@ if __name__ == "__main__":
             continue
         if name == "own" and not (cli.own_overhead or config in ("own", "all")):
             continue
-        if config in (name, "all") or name in ("ckpt", "fused", "fleet", "ingest", "flow", "coldstart", "serve", "sketch", "chaos", "lint", "san", "race", "own", "obs_trace"):
+        if name == "shard" and not (cli.shard_overhead or config in ("shard", "all")):
+            continue
+        if config in (name, "all") or name in ("ckpt", "fused", "fleet", "ingest", "flow", "coldstart", "serve", "sketch", "chaos", "lint", "san", "race", "own", "shard", "obs_trace"):
             try:
                 result = fn()
                 summary[result["metric"]] = {

@@ -1,11 +1,13 @@
-"""``python -m metrics_tpu.analysis`` — the tmlint/tmsan/tmrace/tmown CLI.
+"""``python -m metrics_tpu.analysis`` — the tmlint/tmsan/tmrace/tmown/tmshard CLI.
 
 Usage:
     python -m metrics_tpu.analysis metrics_tpu/            # lint, baseline-aware
     python -m metrics_tpu.analysis --san                   # + jaxpr/HLO tier (tmsan)
     python -m metrics_tpu.analysis --race                  # thread-safety tier (tmrace)
     python -m metrics_tpu.analysis --own                   # buffer-ownership tier (tmown)
+    python -m metrics_tpu.analysis --shard                 # sharding/collective tier (tmshard)
     python -m metrics_tpu.analysis --own --write-drift     # refresh tmown_engine_drift.json
+    python -m metrics_tpu.analysis --shard --write-plan    # refresh tmshard_state_plan.json
     python -m metrics_tpu.analysis --san --write-costs     # refresh tmsan_costs.json
     python -m metrics_tpu.analysis --explain TM-HOSTSYNC   # rule rationale
     python -m metrics_tpu.analysis metrics_tpu/ --write-baseline  # bootstrap waivers
@@ -74,6 +76,25 @@ def main(argv=None) -> int:
         "launch-engine contract drift (TMO-ENGINE-DRIFT)",
     )
     parser.add_argument(
+        "--shard",
+        action="store_true",
+        help="run tmshard, the sharding/collective tier: build the axis/"
+        "placement model (shard_map/pmap entries, collective sites, "
+        "PartitionSpec placements, donating launches) and check axis "
+        "binding (TMH-AXIS-UNBOUND), reduction-vs-spec algebra "
+        "(TMH-SPEC-ALGEBRA), replica-divergent host reads "
+        "(TMH-REPLICA-DIVERGE), donation across a reshard "
+        "(TMH-DONATE-RESHARD), sharding-blind cache keys (TMH-KEY-SHARD), "
+        "and per-engine mesh-awareness drift (TMH-MESH-DRIFT)",
+    )
+    parser.add_argument(
+        "--write-plan",
+        action="store_true",
+        help="with --shard: write/refresh tmshard_state_plan.json, the "
+        "per-state shard-plan worksheet for ROADMAP items 1 & 4 (commit "
+        "the diff)",
+    )
+    parser.add_argument(
         "--write-drift",
         action="store_true",
         help="with --own: write/refresh tmown_engine_drift.json, the "
@@ -110,6 +131,8 @@ def main(argv=None) -> int:
         return _main_race(args, paths[0])
     if args.own:
         return _main_own(args, paths[0])
+    if args.shard:
+        return _main_shard(args, paths[0])
 
     try:
         report = analyze(
@@ -337,6 +360,93 @@ def _main_own(args, target: str) -> int:
         f"{s['donating']} donating, {s['exec_sites']} exec sites, "
         f"{s['engines']} engines, {s['findings']} findings "
         f"({s['waived']} waived, {len(new)} new) in {s['seconds']}s"
+    )
+    return 1 if new else 0
+
+
+def _main_shard(args, target: str) -> int:
+    """The --shard path: the tmshard sharding/collective tier on its own."""
+    import os
+
+    from metrics_tpu.analysis.runner import _find_repo_root
+    from metrics_tpu.analysis.shard import plan as plan_mod
+    from metrics_tpu.analysis.shard.runner import run_shard
+
+    selected = None
+    if args.select:
+        selected = {r.strip().upper() for r in args.select.split(",")}
+        unknown = selected - set(RULES)
+        if unknown:
+            print(f"unknown rule(s): {', '.join(sorted(unknown))}", file=sys.stderr)
+            return 2
+
+    def keep(f):
+        return selected is None or f.rule in selected
+
+    try:
+        report = run_shard(target, baseline_path=args.baseline)
+    except FileNotFoundError as err:
+        print(f"tmshard: {err}", file=sys.stderr)
+        return 2
+
+    if args.write_plan:
+        out = os.path.join(_find_repo_root(target), plan_mod.PLAN_FILENAME)
+        payload = report.plan_worksheet()
+        plan_mod.write_worksheet(out, payload)
+        print(
+            f"tmshard: wrote {len(payload['classes'])} class plans"
+            f" ({len(payload['skipped'])} skipped) to {out}"
+        )
+
+    if args.write_baseline:
+        out = args.baseline or os.path.join(
+            _find_repo_root(target), baseline_mod.BASELINE_FILENAME
+        )
+        n = baseline_mod.write_baseline(
+            out,
+            [f for f in report.findings if keep(f)],
+            reason="bootstrap waiver: pre-existing finding, triage pending",
+        )
+        print(f"tmshard: wrote {n} waivers to {out}")
+        return 0
+
+    new = [f for f in report.new_findings if keep(f)]
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "stats": report.stats,
+                    "mesh_matrix": report.mesh_matrix,
+                    "new": [vars(f) for f in new],
+                    "waived": [vars(f) for f in report.waived if keep(f)],
+                    "unused_waivers": [list(k) for k in report.unused_waivers],
+                    "parse_errors": report.parse_errors,
+                },
+                indent=2,
+            )
+        )
+        return 1 if new else 0
+
+    for f in new:
+        print(f.format())
+    if args.verbose:
+        for f in report.waived:
+            if keep(f):
+                print(f.format() + f"  # reason: {f.waive_reason}")
+        for engine, facts in sorted(report.mesh_matrix.items()):
+            have = [c for c, ev in facts["components"].items() if ev]
+            print(f"# engine {engine}: {len(have)}/{len(facts['components'])} components")
+    for key in report.unused_waivers:
+        print(f"# stale waiver (no matching finding): {':'.join(key)}")
+    for path, err in sorted(report.parse_errors.items()):
+        print(f"# parse error: {path}: {err}")
+    s = report.stats
+    print(
+        f"tmshard: {s['files']} files, {s['functions']} functions, "
+        f"{s['mapped_bodies']} mapped bodies, {s['collectives']} collectives, "
+        f"{s['placements']} placements, {s['engines']} engines, "
+        f"{s['findings']} findings ({s['waived']} waived, {len(new)} new) "
+        f"in {s['seconds']}s"
     )
     return 1 if new else 0
 

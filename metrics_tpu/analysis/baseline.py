@@ -39,15 +39,15 @@ def scope_waivers(
 ) -> Dict[Tuple[str, str, str], str]:
     """Restrict a waiver table to the given rule ids.
 
-    The baseline file is shared between the four analysis tiers — tmlint
-    (TM-*), tmsan (TMS-*), tmrace (TMR-*), and tmown (TMO-*). Each tier
-    scopes the table to its own rule namespace before :func:`apply_baseline`,
-    so a tier applies — and reports staleness for — only the waivers it can
-    possibly match: a TMR-* waiver is never "stale" to a tmlint run that by
-    construction emits no TMR findings, and vice versa. The scope sets
-    (``LINT_RULES``, ``SAN_RULES``, ``RACE_RULES``, ``OWN_RULES`` in
-    ``findings.py``) partition ``RULES``, so every waiver belongs to exactly
-    one tier's staleness check.
+    The baseline file is shared between the five analysis tiers — tmlint
+    (TM-*), tmsan (TMS-*), tmrace (TMR-*), tmown (TMO-*), and tmshard
+    (TMH-*). Each tier scopes the table to its own rule namespace before
+    :func:`apply_baseline`, so a tier applies — and reports staleness for —
+    only the waivers it can possibly match: a TMR-* waiver is never "stale"
+    to a tmlint run that by construction emits no TMR findings, and vice
+    versa. The scope sets (``LINT_RULES``, ``SAN_RULES``, ``RACE_RULES``,
+    ``OWN_RULES``, ``SHARD_RULES`` in ``findings.py``) partition ``RULES``,
+    so every waiver belongs to exactly one tier's staleness check.
     """
     allowed = set(rules)
     return {k: v for k, v in waivers.items() if k[0] in allowed}

@@ -577,6 +577,142 @@ RULES: Dict[str, Rule] = {
                 "--write-costs` and commit the diff with the explanation."
             ),
         ),
+        Rule(
+            id="TMH-AXIS-UNBOUND",
+            family="axis-binding",
+            summary="collective over an axis name no reaching mapped context binds",
+            counter="shard.axis_unbound",
+            runtime_signal=(
+                "NameError: unbound axis name at trace time when the function is "
+                "reached outside a map; under a *different* mesh, a silent wrong-"
+                "world reduction (tmsan's TMS-COLLECTIVE is the jaxpr-level twin: "
+                "it sees the trace, tmshard sees every call path statically)"
+            ),
+            rationale=(
+                "`psum(x, 'data')` only means something inside a shard_map/pmap whose\n"
+                "mesh binds 'data'. tmshard's bound-axis fixpoint intersects the axis\n"
+                "names guaranteed bound over every caller chain (mapped bodies are\n"
+                "pinned to their entry's mesh axes; a dynamic mesh pins to TOP, which\n"
+                "never flags): a literal axis outside that set means some reaching\n"
+                "path traces the collective with the axis unbound. Fix by threading\n"
+                "the axis name from the mapped entry (the `axis_name=` parameter\n"
+                "idiom every parallel/collective.py helper uses), or by mapping the\n"
+                "function before calling it."
+            ),
+        ),
+        Rule(
+            id="TMH-SPEC-ALGEBRA",
+            family="spec-algebra",
+            summary="state reduction algebra incompatible with its partition spec",
+            counter="shard.spec_algebra",
+            runtime_signal=(
+                "silent wrong results: psum over the partitioned axis double-counts "
+                "(each shard holds *distinct* rows, not replicas); the runtime twin "
+                "is TM-REDUCE-MISMATCH's merge-vs-sync divergence, and the contract "
+                "sweep's sharded-vs-single-device equality tests catch it only for "
+                "covered classes"
+            ),
+            rationale=(
+                "A shard_map in-spec `P('data')` means each shard owns a distinct\n"
+                "block of rows. Reducing that operand *over the same axis* with\n"
+                "psum/pmean/pmax/pmin mixes different logical rows — the classic\n"
+                "double-count. The legal idiom reduces the local block first\n"
+                "(`x.sum(axis=0)`), producing a replica-shaped value, then syncs;\n"
+                "or gathers with all_gather when rows must survive. The shard-plan\n"
+                "worksheet (tmshard_state_plan.json) records which reduction each\n"
+                "registered state declares so the item-1 sharded-state design can\n"
+                "pick legal axes per state family."
+            ),
+        ),
+        Rule(
+            id="TMH-REPLICA-DIVERGE",
+            family="axis-binding",
+            summary="replica-divergent host value inside a mapped trace or collective",
+            counter="shard.replica_diverge",
+            runtime_signal=(
+                "collective deadlock (replicas disagree on trace constants and "
+                "compile different programs) or a silent per-replica result skew; "
+                "multi-host, the hang surfaces as a DCN barrier timeout"
+            ),
+            rationale=(
+                "`jax.process_index()`, wall clock reads, host RNG, and\n"
+                "`len(jax.devices())` return different values per process. Traced\n"
+                "under shard_map/pmap they become per-replica *constants*: every\n"
+                "replica compiles a different program, and the first collective\n"
+                "either deadlocks or combines incomparable values. Hoist the host\n"
+                "read into the eager launcher and pass the value in as an operand\n"
+                "(how parallel/collective.py's process_topology is consumed), or\n"
+                "derive replica identity inside the trace with `jax.lax.axis_index`."
+            ),
+        ),
+        Rule(
+            id="TMH-DONATE-RESHARD",
+            family="spec-algebra",
+            summary="buffer donated into a launch whose in-spec differs from its placement",
+            counter="shard.donate_reshard",
+            runtime_signal=(
+                "no error: XLA inserts a resharding copy, the donated buffer is "
+                "consumed by the *copy*, and peak HBM stays at two live buffers — "
+                "visible only as the donation saving never materializing "
+                "(obs buffer stats; tmown's TMO-DONATE-ALIAS lattice is the "
+                "host-memory sibling of this device-placement facet)"
+            ),
+            rationale=(
+                "Donation frees the input buffer only when XLA can reuse it in\n"
+                "place, which requires the argument's sharding to match the\n"
+                "executable's in-spec. `device_put(x, NamedSharding(mesh, P('data')))`\n"
+                "followed by a donating jit with `in_shardings=P(None)` silently\n"
+                "copies-to-reshard first: the donation is dead, and a state buffer\n"
+                "sized near one chip's HBM (the ROADMAP item 1 target) OOMs where\n"
+                "the un-donated math said it fits. Align the placement with the\n"
+                "launch spec, or drop the misleading donate_argnums."
+            ),
+        ),
+        Rule(
+            id="TMH-KEY-SHARD",
+            family="mesh-contract",
+            summary="executable-cache key lacks a sharding/mesh facet for placed inputs",
+            counter="shard.key_shard",
+            runtime_signal=(
+                "stale-executable replay after a mesh or placement change: output "
+                "placed on the wrong devices, or an XLA donation/layout error deep "
+                "in serving — the same failure class TMO-KEY-GAP guards for shapes, "
+                "one facet further (feeds ROADMAP item 5's unified engine key)"
+            ),
+            rationale=(
+                "The four launch engines key their AOT caches on aval shapes/dtypes\n"
+                "and static config. Once inputs are *placed* arrays, two calls with\n"
+                "identical avals but different shardings must not share an\n"
+                "executable: the compiled program bakes in the input sharding.\n"
+                "Any cache consuming placed arrays needs a sharding/mesh/topology\n"
+                "component in its key (core/fused.py `_aval_key` now appends the\n"
+                "NamedSharding spec for committed non-replicated inputs — the\n"
+                "engine-shared facet this rule checks for)."
+            ),
+        ),
+        Rule(
+            id="TMH-MESH-DRIFT",
+            family="mesh-contract",
+            summary="launch engine missing a mesh-awareness component its siblings have",
+            counter="shard.mesh_drift",
+            runtime_signal=(
+                "none directly — the drift is the *absence* of machinery: the "
+                "engine without the component fails later (stale executable, "
+                "unsharded launch, missing topology seed) exactly where its "
+                "siblings survive; TMO-ENGINE-DRIFT is the ownership-facet analog"
+            ),
+            rationale=(
+                "fused, fleet, ingest, the rank dispatch, and the shard_map serving\n"
+                "program in parallel/mesh.py each grew their own slice of SPMD\n"
+                "machinery (axis binding, collective sync, spec plumbing, placed\n"
+                "I/O, sharded cache keys, topology seeding). A component present in\n"
+                ">=2 engines but absent in another is either a latent gap the\n"
+                "item-1/item-4 designs must fill, or a deliberate exemption worth a\n"
+                "waiver with its reason. The matrix is embedded in\n"
+                "tmshard_state_plan.json (`engine_mesh_matrix`), regenerated by\n"
+                "`--shard --write-plan` and kept in sync by test."
+            ),
+        ),
     )
 }
 
@@ -603,10 +739,17 @@ OWN_RULES: Tuple[str, ...] = (
     "TMO-SNAPSHOT-GAP", "TMO-KEY-GAP", "TMO-ENGINE-DRIFT",
 )
 
+#: tmshard (sharding/collective tier) rules — ``metrics_tpu.analysis.shard``.
+SHARD_RULES: Tuple[str, ...] = (
+    "TMH-AXIS-UNBOUND", "TMH-SPEC-ALGEBRA", "TMH-REPLICA-DIVERGE",
+    "TMH-DONATE-RESHARD", "TMH-KEY-SHARD", "TMH-MESH-DRIFT",
+)
+
 #: AST/introspection (tmlint) rules — everything not owned by another tier.
 LINT_RULES: Tuple[str, ...] = tuple(
     r for r in RULES
     if r not in SAN_RULES and r not in RACE_RULES and r not in OWN_RULES
+    and r not in SHARD_RULES
 )
 
 

@@ -1,4 +1,4 @@
-"""tmrace — the concurrency tier of the four-tier static analysis.
+"""tmrace — the concurrency tier of the five-tier static analysis.
 
 tmlint reads source text (trace safety), tmsan reads the traced jaxpr/HLO
 (compiler tier); tmrace reads the *threading structure*: which thread roles

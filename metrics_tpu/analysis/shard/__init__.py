@@ -1,0 +1,13 @@
+"""tmshard — the static sharding & collective-correctness tier.
+
+The fifth whole-package analysis tier (tmlint / tmsan / tmrace / tmown /
+**tmshard**): an AST axis-and-placement model of the package's SPMD surface —
+shard_map/pmap/vmap entries, collective sites, ``PartitionSpec``/
+``NamedSharding`` placements, donating launches, executable-cache keys — with
+a bound-axis-set must-fixpoint feeding six rules (TMH-*, findings.py) and the
+``tmshard_state_plan.json`` worksheet ROADMAP items 1 & 4 design from.
+
+Entry points: :func:`metrics_tpu.analysis.shard.runner.run_shard` and
+``python -m metrics_tpu.analysis --shard [--write-plan]``.
+"""
+from metrics_tpu.analysis.shard.runner import ShardReport, run_shard  # noqa: F401

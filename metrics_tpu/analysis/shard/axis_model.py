@@ -1,0 +1,1095 @@
+"""The axis/placement model: which mesh axes are bound where, and what flows in.
+
+Phase A parses every module of the analyzed tree into a
+:class:`ShardModuleModel`: the function index (methods and nested defs), the
+import table, the class index, and the module-level assignment table (so a
+module-level ``MESH = jax.make_mesh((8,), ("data",))`` resolves as a mapped
+entry's mesh) — the same skeleton tmown builds, but the per-function pass here
+collects *SPMD facts* instead of a provenance walk:
+
+- mapped entries: ``shard_map``/``pmap``/``jax.vmap(..., axis_name=)`` launch
+  sites and decorated bodies, with their bound axis names and per-parameter
+  in-spec axes when the mesh / specs are statically resolvable;
+- collective sites: ``psum``/``pmean``/``pmax``/``pmin``/``all_gather``/
+  ``pvary``/``pcast``/... with the axis argument classified as literal,
+  parameter-fed, or opaque;
+- placements: ``jax.device_put(x, NamedSharding(mesh, P(...)))`` with the
+  normalized spec text, plus every ``PartitionSpec``/``NamedSharding``
+  construction and ``.sharding`` read (the mesh-contract evidence);
+- donating wrappers with ``in_shardings`` and executable-cache key traffic
+  (the TMH-DONATE-RESHARD / TMH-KEY-SHARD inputs);
+- replica-divergent host reads (``jax.process_index``, wall clock, host RNG,
+  ``jax.devices()``-family) and the local names they taint.
+
+Phase B (:class:`ShardModel`) links the package and runs two fixpoints:
+
+- ``axis_params``: which parameters transitively reach a collective's axis
+  slot (so ``sync_array(x, fx, axis_name)`` three calls deep still classifies
+  a caller-side literal axis as a *derived* collective site);
+- ``bound``: a must-analysis of the axis names guaranteed bound when each
+  function runs — mapped bodies are pinned to their entry's axes (or TOP when
+  the mesh is dynamic), everything else is the intersection over its callers,
+  and a function no mapped context reaches ends at the empty set.  A literal
+  collective axis outside its function's bound set is TMH-AXIS-UNBOUND.
+
+``spec_rules.py`` turns the linked model into findings (facts vs policy, the
+same split every sibling tier uses).
+"""
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from metrics_tpu.analysis.jitmap import dotted_name
+
+#: collective primitives reached through jax.lax (axis slot: positional index
+#: 1 except ``axis_index``, whose only argument is the axis).
+_COLLECTIVE_PRIMS = {
+    "psum", "pmean", "pmax", "pmin", "all_gather", "ppermute", "all_to_all",
+    "psum_scatter", "pvary", "pcast", "pbroadcast", "axis_index",
+}
+#: the reduce family: cross-shard combine of the operand (TMH-SPEC-ALGEBRA).
+_REDUCE_PRIMS = {"psum", "pmean", "pmax", "pmin"}
+
+#: map-launch callables (last path component).
+_MAP_LAUNCHERS = {"shard_map", "pmap", "vmap"}
+
+_TIME_READS = {
+    "time", "monotonic", "perf_counter", "time_ns", "monotonic_ns",
+    "perf_counter_ns",
+}
+
+#: key fields whose text proves the cache key covers placement (TMH-KEY-SHARD).
+import re
+
+_KEY_SHARD_RE = re.compile(r"shard|mesh|topo|layout", re.IGNORECASE)
+
+
+# ------------------------------------------------------------------ records
+
+
+@dataclass
+class ShardEvent:
+    """One rule-relevant fact found by the walk (pre-finding)."""
+
+    kind: str  # donate_reshard | key_shard
+    path: str
+    line: int
+    col: int
+    symbol: str
+    detail: str
+
+
+@dataclass
+class MapEntry:
+    """One shard_map/pmap/vmap launch site (decorator or call form)."""
+
+    kind: str  # shard_map | pmap | vmap
+    line: int
+    #: axis names the entry binds; None when the mesh/axis_name is dynamic
+    axes: Optional[FrozenSet[str]]
+    #: qualname of the mapped body when it is a package function, else None
+    target: Optional[str]
+    #: per-positional-parameter in-spec axes (None = spec not a literal P())
+    in_spec_axes: Tuple[Optional[FrozenSet[str]], ...] = ()
+
+
+@dataclass
+class CollectiveSite:
+    """One collective call (or a derived caller-side wrapper site)."""
+
+    op: str
+    line: int
+    col: int
+    #: literal axis names at the site; None when the axis value is dynamic
+    axes: Optional[FrozenSet[str]]
+    #: parameter name feeding the axis slot, when the axis is a bare param
+    axis_param: Optional[str]
+    #: operand (arg 0) when it is a bare parameter name
+    operand_param: Optional[str]
+    #: every Name appearing in the operand expression (divergence taint check)
+    operand_names: FrozenSet[str] = frozenset()
+    #: callee qualname for derived wrapper sites (literal axis into axis_param)
+    derived_from: Optional[str] = None
+
+
+@dataclass
+class CallFact:
+    """One resolved in-package call with per-callee-parameter arg summaries."""
+
+    target_path: str
+    target_qual: str
+    line: int
+    #: callee param -> ("lit", frozenset[str]) | ("name", caller local name)
+    args: Dict[str, Tuple[str, object]] = field(default_factory=dict)
+
+
+@dataclass
+class ShardFunc:
+    """Per-function facts: identity plus the Phase B analysis output."""
+
+    qualname: str
+    modname: str
+    path: str
+    line: int
+    cls: Optional[str]
+    params: Tuple[str, ...] = ()
+    nested: Tuple[str, ...] = ()  # immediate child def qualnames
+    # filled by the walk:
+    map_entries: List[MapEntry] = field(default_factory=list)
+    collectives: List[CollectiveSite] = field(default_factory=list)
+    calls: List[CallFact] = field(default_factory=list)
+    divergent_calls: List[Tuple[int, int, str, str]] = field(default_factory=list)
+    divergent_names: Set[str] = field(default_factory=set)
+    placements: Dict[str, Tuple[str, int]] = field(default_factory=dict)
+    spec_ctors: int = 0
+    device_puts: int = 0
+    touches_sharding: bool = False
+    cache_get: bool = False
+    cache_store: bool = False
+    key_fields: List[str] = field(default_factory=list)
+    events: List[ShardEvent] = field(default_factory=list)
+    # filled by the link fixpoints:
+    is_mapped_body: bool = False
+    body_axes: Optional[FrozenSet[str]] = None  # None = dynamic entry (TOP)
+    in_spec_axes: Dict[str, Optional[FrozenSet[str]]] = field(default_factory=dict)
+    axis_params: Set[str] = field(default_factory=set)
+    #: must-bound axis set: None = TOP (unknown/universe), frozenset otherwise
+    bound: Optional[FrozenSet[str]] = None
+
+    def key(self) -> Tuple[str, str]:
+        return (self.path, self.qualname)
+
+
+# ------------------------------------------------------------- module model
+
+
+class ShardModuleModel:
+    """Phase A: one file's function index, import table, module assigns."""
+
+    def __init__(self, path: str, modname: str, source: str) -> None:
+        self.path = path
+        self.modname = modname
+        self.short = modname.split(".")[-1]
+        self.tree = ast.parse(source)
+        self.imports: Dict[str, str] = {}
+        self.functions: Dict[str, ShardFunc] = {}
+        self.classes: Set[str] = set()
+        self.module_assigns: Dict[str, ast.expr] = {}
+        # imports are collected from the WHOLE tree, not just module scope:
+        # the repo routinely does `from metrics_tpu.core import fused as
+        # _fused` inside function bodies to break import cycles (fleet.py,
+        # serve/*), and those aliases must still resolve cross-module calls
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    local = alias.asname or alias.name.split(".")[0]
+                    self.imports[local] = alias.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for alias in node.names:
+                    local = alias.asname or alias.name
+                    self.imports[local] = f"{node.module}:{alias.name}"
+        for stmt in self.tree.body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                tgt = stmt.targets[0]
+                if isinstance(tgt, ast.Name):
+                    self.module_assigns[tgt.id] = stmt.value
+        self._walk_defs(self.tree.body, prefix="", cls=None)
+
+    def _walk_defs(self, body: Sequence[ast.stmt], prefix: str, cls: Optional[str]) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = prefix + stmt.name
+                args = stmt.args
+                params = tuple(
+                    a.arg
+                    for a in (args.posonlyargs + args.args + args.kwonlyargs)
+                ) + tuple(a.arg for a in (args.vararg, args.kwarg) if a)
+                nested = tuple(
+                    qual + "." + s.name
+                    for s in stmt.body
+                    if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))
+                )
+                self.functions[qual] = ShardFunc(
+                    qualname=qual, modname=self.modname, path=self.path,
+                    line=stmt.lineno, cls=cls, params=params, nested=nested,
+                )
+                self._walk_defs(stmt.body, prefix=qual + ".", cls=cls)
+            elif isinstance(stmt, ast.ClassDef):
+                self.classes.add(stmt.name)
+                self._walk_defs(stmt.body, prefix=prefix + stmt.name + ".", cls=stmt.name)
+
+    def find_def(self, qualname: str):
+        """Locate the (possibly nested) def node for a dotted qualname."""
+        parts = qualname.split(".")
+        scope: Sequence[ast.stmt] = self.tree.body
+        node = None
+        for part in parts:
+            node = None
+            for stmt in scope:
+                if (
+                    isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef))
+                    and stmt.name == part
+                ):
+                    node = stmt
+                    break
+            if node is None:
+                return None
+            scope = node.body
+        return node if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) else None
+
+    # ---- name classification through the import table
+
+    def _base_of(self, name: str) -> str:
+        return name.split(".")[0]
+
+    def is_lax_prim(self, name: str) -> bool:
+        """Whether ``name`` denotes a jax.lax collective primitive."""
+        last = name.split(".")[-1]
+        if last not in _COLLECTIVE_PRIMS:
+            return False
+        if name.startswith("jax.lax."):
+            return True
+        base = self._base_of(name)
+        imported = self.imports.get(base, "")
+        if "." in name:
+            # lax.psum with `from jax import lax` / `import jax.lax as lax`
+            return imported in ("jax.lax",) or imported == "jax:lax"
+        # bare psum with `from jax.lax import psum`
+        return imported == f"jax.lax:{last}"
+
+    def is_map_launcher(self, name: str) -> Optional[str]:
+        """shard_map/pmap/vmap launcher kind for a callable name, or None."""
+        last = name.split(".")[-1]
+        if last not in _MAP_LAUNCHERS:
+            return None
+        if last == "shard_map":
+            return "shard_map"  # only jax exports this name in practice
+        base = self._base_of(name)
+        imported = self.imports.get(base, "")
+        if base == "jax" or imported.startswith("jax"):
+            return last
+        return last if self.imports.get(last, "").startswith("jax") else None
+
+    def is_spec_ctor(self, name: str) -> bool:
+        last = name.split(".")[-1]
+        if last in ("PartitionSpec", "NamedSharding"):
+            return True
+        if last == "P":
+            imported = self.imports.get("P", "")
+            return imported.endswith(":PartitionSpec") or imported == ""
+        return False
+
+    def divergent_kind(self, name: str) -> Optional[str]:
+        """Classify a call name as a replica-divergent host read, or None."""
+        parts = name.split(".")
+        base, last = parts[0], parts[-1]
+        imported = self.imports.get(base, "")
+        if last in ("process_index", "process_count", "host_id"):
+            if base == "jax" or imported == "jax" or imported == f"jax:{last}":
+                return "process identity"
+            return None
+        if base == "jax" or imported == "jax":
+            if last in ("devices", "local_devices", "device_count", "local_device_count"):
+                return "device topology"
+            return None
+        if last in _TIME_READS and (base == "time" or imported == "time"):
+            return "wall clock"
+        if last in ("now", "utcnow") and "datetime" in parts:
+            return "wall clock"
+        if last == "uuid4":
+            return "fresh uuid"
+        if last in ("getpid", "gethostname"):
+            return "host identity"
+        if base == "random" and imported in ("", "random") and len(parts) > 1:
+            return "host RNG"
+        if len(parts) >= 2 and parts[-2] == "random" and (
+            base in ("np", "numpy") or imported.startswith("numpy")
+        ):
+            return "host RNG"
+        return None
+
+
+# ------------------------------------------------------------ package model
+
+
+class ShardModel:
+    """Phase B: linked package + axis_params / bound fixpoints."""
+
+    def __init__(self, files: Dict[str, Tuple[str, str]]) -> None:
+        self.modules: Dict[str, ShardModuleModel] = {}
+        self.errors: Dict[str, str] = {}
+        for path, (modname, source) in files.items():
+            try:
+                self.modules[path] = ShardModuleModel(path, modname, source)
+            except SyntaxError as err:
+                self.errors[path] = f"SyntaxError: {err}"
+        self.by_modname = {m.modname: m for m in self.modules.values()}
+        self.class_index: Dict[str, ShardModuleModel] = {}
+        for m in self.modules.values():
+            for cls in m.classes:
+                self.class_index.setdefault(cls, m)
+        self.link()
+
+    def all_functions(self):
+        for m in self.modules.values():
+            for func in m.functions.values():
+                yield m, func
+
+    # ------------------------------------------------------------ resolver
+
+    def resolve_call(
+        self, module: ShardModuleModel, symbol: str, caller: ShardFunc
+    ) -> Optional[Tuple[ShardModuleModel, ShardFunc]]:
+        """Resolve a call symbol to a package function, or None (external)."""
+        if symbol.startswith("self."):
+            rest = symbol[5:]
+            if caller.cls:
+                hit = module.functions.get(f"{caller.cls}.{rest}")
+                if hit:
+                    return module, hit
+            return None
+        if "." not in symbol:
+            prefix = caller.qualname.rsplit(".", 1)[0] + "." if "." in caller.qualname else ""
+            for cand in (
+                prefix + symbol,
+                (caller.cls + "." + symbol) if caller.cls else "",
+                symbol,
+            ):
+                if cand and cand in module.functions:
+                    return module, module.functions[cand]
+            imported = module.imports.get(symbol)
+            if imported and ":" in imported:
+                modname, _, name = imported.partition(":")
+                other = self.by_modname.get(modname)
+                if other and name in other.functions:
+                    return other, other.functions[name]
+            return None
+        base, _, attr = symbol.partition(".")
+        imported = module.imports.get(base)
+        if imported:
+            if ":" in imported:
+                mn, _, nm = imported.partition(":")
+                sub = self.by_modname.get(f"{mn}.{nm}")
+                if sub and attr in sub.functions:
+                    return sub, sub.functions[attr]
+                if nm in self.class_index:
+                    tmod = self.class_index[nm]
+                    hit = tmod.functions.get(f"{nm}.{attr.split('.')[-1]}")
+                    if hit:
+                        return tmod, hit
+                return None
+            other = self.by_modname.get(imported)
+            if other:
+                hit = other.functions.get(attr)
+                if hit:
+                    return other, hit
+        if base in self.class_index:
+            tmod = self.class_index[base]
+            hit = tmod.functions.get(symbol)
+            if hit:
+                return tmod, hit
+        return None
+
+    def find_func(self, path: str, qualname: str) -> Optional[ShardFunc]:
+        m = self.modules.get(path)
+        return m.functions.get(qualname) if m else None
+
+    # ------------------------------------------------------------- linking
+
+    def link(self) -> None:
+        # one raw fact walk per function (no summaries feed back into it)
+        for m, func in self.all_functions():
+            _AxisWalker(self, m, func).run()
+        self._mark_mapped_bodies()
+        self._axis_param_fixpoint()
+        self._derive_wrapper_sites()
+        self._bound_fixpoint()
+
+    def _mark_mapped_bodies(self) -> None:
+        """Pin every resolvable mapped body to its entry's axes + in-specs."""
+        for m, func in self.all_functions():
+            for entry in func.map_entries:
+                if entry.target is None:
+                    continue
+                body = m.functions.get(entry.target)
+                if body is None:
+                    continue
+                body.is_mapped_body = True
+                # two entries mapping one body: keep the less-precise axes
+                if body.body_axes is not None and body.body_axes != entry.axes:
+                    body.body_axes = None
+                else:
+                    body.body_axes = entry.axes
+                offset = 1 if body.params[:1] in (("self",), ("cls",)) else 0
+                for i, axes in enumerate(entry.in_spec_axes):
+                    if i + offset < len(body.params):
+                        p = body.params[i + offset]
+                        if p in body.in_spec_axes and body.in_spec_axes[p] != axes:
+                            body.in_spec_axes[p] = None
+                        else:
+                            body.in_spec_axes[p] = axes
+
+    def _callee_of(self, fact: CallFact) -> Optional[ShardFunc]:
+        return self.find_func(fact.target_path, fact.target_qual)
+
+    def _axis_param_fixpoint(self) -> None:
+        """Params that transitively reach a collective's axis slot."""
+        for _m, func in self.all_functions():
+            for site in func.collectives:
+                if site.axis_param and site.axis_param in func.params:
+                    func.axis_params.add(site.axis_param)
+        for _ in range(8):
+            changed = False
+            for _m, func in self.all_functions():
+                for fact in func.calls:
+                    callee = self._callee_of(fact)
+                    if callee is None or not callee.axis_params:
+                        continue
+                    for p in callee.axis_params:
+                        summary = fact.args.get(p)
+                        if (
+                            summary is not None
+                            and summary[0] == "name"
+                            and summary[1] in func.params
+                            and summary[1] not in func.axis_params
+                        ):
+                            func.axis_params.add(summary[1])
+                            changed = True
+            if not changed:
+                break
+
+    def _derive_wrapper_sites(self) -> None:
+        """A literal axis passed into a callee's axis param is a collective
+        site *at the caller* — the caller's bound set governs it."""
+        for _m, func in self.all_functions():
+            for fact in func.calls:
+                callee = self._callee_of(fact)
+                if callee is None:
+                    continue
+                for p in callee.axis_params:
+                    summary = fact.args.get(p)
+                    if summary is not None and summary[0] == "lit":
+                        func.collectives.append(
+                            CollectiveSite(
+                                op=callee.qualname.split(".")[-1],
+                                line=fact.line, col=0,
+                                axes=summary[1], axis_param=None,
+                                operand_param=None,
+                                derived_from=callee.qualname,
+                            )
+                        )
+
+    def _bound_fixpoint(self) -> None:
+        """Must-bound axes: intersection over callers; mapped bodies pinned."""
+        callers: Dict[Tuple[str, str], Set[Tuple[str, str]]] = {}
+        for m, func in self.all_functions():
+            for fact in func.calls:
+                callee = self._callee_of(fact)
+                if callee is not None:
+                    callers.setdefault(callee.key(), set()).add(func.key())
+            for qual in func.nested:
+                child = m.functions.get(qual)
+                if child is not None:
+                    callers.setdefault(child.key(), set()).add(func.key())
+        TOP = None
+        bound: Dict[Tuple[str, str], Optional[FrozenSet[str]]] = {}
+        for _m, func in self.all_functions():
+            bound[func.key()] = func.body_axes if func.is_mapped_body else TOP
+        for _ in range(8):
+            changed = False
+            for _m, func in self.all_functions():
+                if func.is_mapped_body:
+                    continue
+                ins = [bound.get(c, TOP) for c in callers.get(func.key(), ())]
+                if not ins:
+                    new: Optional[FrozenSet[str]] = frozenset()
+                elif all(b is TOP for b in ins):
+                    new = TOP
+                else:
+                    acc: Optional[FrozenSet[str]] = None
+                    for b in ins:
+                        if b is TOP:
+                            continue
+                        acc = b if acc is None else (acc & b)
+                    new = acc
+                if new != bound[func.key()]:
+                    bound[func.key()] = new
+                    changed = True
+            if not changed:
+                break
+        for _m, func in self.all_functions():
+            func.bound = bound[func.key()]
+
+    # -------------------------------------------------------- reachability
+
+    def reachable_from(self, module: ShardModuleModel, qualname: Optional[str]):
+        """Functions reachable from an anchor (whole module when qualname is
+        None), following resolved calls and lexical nesting."""
+        seeds: List[ShardFunc] = []
+        if qualname is None:
+            seeds = [f for f in module.functions.values()]
+        else:
+            f = module.functions.get(qualname)
+            if f is not None:
+                seeds = [f]
+        seen: Dict[Tuple[str, str], ShardFunc] = {}
+        stack = list(seeds)
+        while stack:
+            func = stack.pop()
+            if func.key() in seen:
+                continue
+            seen[func.key()] = func
+            m = self.modules.get(func.path)
+            for qual in func.nested:
+                child = m.functions.get(qual) if m else None
+                if child is not None:
+                    stack.append(child)
+            for fact in func.calls:
+                callee = self._callee_of(fact)
+                if callee is not None:
+                    stack.append(callee)
+        return list(seen.values())
+
+    def mapped_reachable(self):
+        """Functions reachable from any mapped body (traced under a map)."""
+        seen: Dict[Tuple[str, str], ShardFunc] = {}
+        stack = [f for _m, f in self.all_functions() if f.is_mapped_body]
+        while stack:
+            func = stack.pop()
+            if func.key() in seen:
+                continue
+            seen[func.key()] = func
+            m = self.modules.get(func.path)
+            for qual in func.nested:
+                child = m.functions.get(qual) if m else None
+                if child is not None:
+                    stack.append(child)
+            for fact in func.calls:
+                callee = self._callee_of(fact)
+                if callee is not None:
+                    stack.append(callee)
+        return seen
+
+
+# ----------------------------------------------------------------- walkers
+
+
+def _own_nodes(def_node: ast.AST):
+    """Every node lexically owned by a def: nested def/class bodies are their
+    own functions, but their *decorators* evaluate in this scope."""
+    stack = list(ast.iter_child_nodes(def_node))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            stack.extend(getattr(node, "decorator_list", ()))
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _safe_unparse(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # noqa: BLE001 — display only
+        return "<expr>"
+
+
+def _literal_axes(node: ast.AST) -> Optional[FrozenSet[str]]:
+    """Axis names when the expression is a literal str / tuple of strs."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return frozenset({node.value})
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: Set[str] = set()
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                out.add(elt.value)
+            elif isinstance(elt, ast.Constant) and elt.value is None:
+                continue
+            else:
+                return None
+        return frozenset(out)
+    return None
+
+
+def _parse_donate_positions(node: ast.AST) -> Optional[Tuple[int, ...]]:
+    """The donate_argnums value as concrete positions; (0,) when dynamic."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, ast.Tuple):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                out.append(elt.value)
+        return tuple(out)
+    if isinstance(node, ast.IfExp):
+        for branch in (node.body, node.orelse):
+            pos = _parse_donate_positions(branch)
+            if pos:
+                return pos
+        return ()
+    return (0,)
+
+
+class _AxisWalker:
+    """One function's fact walk: fills every raw field of its ShardFunc."""
+
+    def __init__(self, model: ShardModel, module: ShardModuleModel, func: ShardFunc) -> None:
+        self.model = model
+        self.module = module
+        self.func = func
+        self.node = module.find_def(func.qualname)
+        self.assigns: Dict[str, List[ast.expr]] = {}
+        #: wrapper name -> (donate positions, per-position in-spec text or None)
+        self.wrappers: Dict[str, Tuple[Tuple[int, ...], Dict[int, str]]] = {}
+        self.cache_key_nodes: List[ast.AST] = []
+        self.placed_arg_uses: List[Tuple[str, ast.AST]] = []
+
+    # ---------------------------------------------------------------- run
+
+    def run(self) -> None:
+        if self.node is None:
+            return
+        f = self.func
+        # prepass: local assignment table
+        for node in _own_nodes(self.node):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt = node.targets[0]
+                if isinstance(tgt, ast.Name):
+                    self.assigns.setdefault(tgt.id, []).append(node.value)
+        # own decorators: a partial(shard_map, ...) on this def marks *itself*
+        for deco in getattr(self.node, "decorator_list", ()):
+            entry = self._map_entry_of(deco, target=f.qualname)
+            if entry is not None:
+                f.map_entries.append(entry)
+        # main walk, two passes: assignments register wrappers/placements
+        # first so an exec site is recognized regardless of lexical order
+        for node in _own_nodes(self.node):
+            if isinstance(node, ast.Assign):
+                self._scan_assign(node)
+        for node in _own_nodes(self.node):
+            if isinstance(node, ast.Call):
+                self._scan_call(node)
+            elif isinstance(node, ast.Attribute):
+                if node.attr == "sharding":
+                    f.touches_sharding = True
+        # decorators of nested defs: partial(shard_map, ...) in this scope
+        for child in ast.iter_child_nodes(self.node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for deco in child.decorator_list:
+                    entry = self._map_entry_of(
+                        deco, target=f.qualname + "." + child.name
+                    )
+                    if entry is not None:
+                        f.map_entries.append(entry)
+        f.key_fields = self._key_fields()
+        self._finish_events()
+
+    # -------------------------------------------------------- assignments
+
+    def _scan_assign(self, node: ast.Assign) -> None:
+        f = self.func
+        value = node.value
+        # cache stores: cache[key] = compiled
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Subscript):
+                recv = dotted_name(tgt.value) or ""
+                if "cache" in recv.lower():
+                    f.cache_store = True
+                    self.cache_key_nodes.append(tgt.slice)
+        if len(node.targets) != 1 or not isinstance(node.targets[0], ast.Name):
+            return
+        name = node.targets[0].id
+        # replica-divergent taint
+        for call in ast.walk(value):
+            if isinstance(call, ast.Call):
+                cn = dotted_name(call.func) or ""
+                if cn and self.module.divergent_kind(cn):
+                    f.divergent_names.add(name)
+                    break
+        # placements: x = jax.device_put(y, <sharding>)
+        if isinstance(value, ast.Call):
+            vn = dotted_name(value.func) or ""
+            if vn.split(".")[-1] == "device_put" and len(value.args) >= 2:
+                spec = self._spec_text(value.args[1])
+                if spec is not None:
+                    f.placements[name] = (spec, value.lineno)
+            # donating wrappers: run = jax.jit(step, donate_argnums=..,
+            #                                  in_shardings=(...))
+            wrapper = self._wrapper_of(value)
+            if wrapper is not None:
+                self.wrappers[name] = wrapper
+
+    # --------------------------------------------------------------- calls
+
+    def _scan_call(self, call: ast.Call) -> None:
+        f = self.func
+        name = dotted_name(call.func) or ""
+        last = name.split(".")[-1]
+
+        # spec constructions + device_put evidence (contract components)
+        if name and self.module.is_spec_ctor(name):
+            f.spec_ctors += 1
+        if last == "device_put":
+            if len(call.args) >= 2 or any(
+                kw.arg in ("device", "sharding") for kw in call.keywords
+            ):
+                f.device_puts += 1
+        if last == "getattr" and len(call.args) >= 2:
+            key = call.args[1]
+            if isinstance(key, ast.Constant) and key.value in ("sharding", "spec"):
+                f.touches_sharding = True
+
+        # cache gets
+        if isinstance(call.func, ast.Attribute) and call.func.attr == "get":
+            recv = dotted_name(call.func.value) or ""
+            if "cache" in recv.lower() and call.args:
+                f.cache_get = True
+                self.cache_key_nodes.append(call.args[0])
+
+        # replica-divergent host reads
+        kind = self.module.divergent_kind(name) if name else None
+        if kind:
+            f.divergent_calls.append((call.lineno, call.col_offset, name, kind))
+
+        # map launches (call form): shard_map(body, mesh=..., in_specs=...)
+        launcher = self.module.is_map_launcher(name) if name else None
+        if launcher and call.args:
+            entry = self._map_entry_from_call(launcher, call)
+            if entry is not None:
+                f.map_entries.append(entry)
+
+        # collective sites
+        if name and self.module.is_lax_prim(name):
+            hit = self.model.resolve_call(self.module, name, f)
+            if hit is None:  # a real primitive, not a shadowing package def
+                f.collectives.append(self._collective_site(last, call))
+
+        # resolved in-package calls -> CallFacts
+        if name and not name.startswith(("jax.", "jnp.", "np.", "numpy.")):
+            hit = self.model.resolve_call(self.module, name, f)
+            if hit is not None:
+                f.calls.append(self._call_fact(hit[1], call))
+
+        # donating executions of known wrappers: run(x, ...)
+        if isinstance(call.func, ast.Name) and call.func.id in self.wrappers:
+            self._check_donate_reshard(call, self.wrappers[call.func.id])
+        elif isinstance(call.func, ast.Call):
+            wrapper = self._wrapper_of(call.func)
+            if wrapper is not None:
+                self._check_donate_reshard(call, wrapper)
+
+        # placed arrays flowing into any call (TMH-KEY-SHARD evidence)
+        for arg in call.args:
+            if isinstance(arg, ast.Name) and arg.id in f.placements:
+                self.placed_arg_uses.append((arg.id, arg))
+
+    def _collective_site(self, op: str, call: ast.Call) -> CollectiveSite:
+        f = self.func
+        axis_node: Optional[ast.AST] = None
+        for kw in call.keywords:
+            if kw.arg in ("axis_name", "axes"):
+                axis_node = kw.value
+        if axis_node is None:
+            idx = 0 if op == "axis_index" else 1
+            if idx < len(call.args):
+                axis_node = call.args[idx]
+        axes = _literal_axes(axis_node) if axis_node is not None else None
+        axis_param: Optional[str] = None
+        if axes is None and isinstance(axis_node, ast.Name):
+            if axis_node.id in f.params:
+                axis_param = axis_node.id
+            else:
+                for value in self.assigns.get(axis_node.id, ()):
+                    lit = _literal_axes(value)
+                    if lit is not None:
+                        axes = lit
+                        break
+        operand = call.args[0] if (call.args and op != "axis_index") else None
+        operand_param = (
+            operand.id
+            if isinstance(operand, ast.Name) and operand.id in f.params
+            else None
+        )
+        return CollectiveSite(
+            op=op, line=call.lineno, col=call.col_offset,
+            axes=axes, axis_param=axis_param, operand_param=operand_param,
+            operand_names=frozenset(_names_in(operand)) if operand is not None else frozenset(),
+        )
+
+    def _call_fact(self, callee: ShardFunc, call: ast.Call) -> CallFact:
+        offset = 1 if callee.params[:1] in (("self",), ("cls",)) else 0
+        args: Dict[str, Tuple[str, object]] = {}
+
+        def summarize(node: ast.AST) -> Optional[Tuple[str, object]]:
+            lit = _literal_axes(node)
+            if lit is not None:
+                return ("lit", lit)
+            if isinstance(node, ast.Name):
+                if node.id in self.func.params:
+                    return ("name", node.id)
+                for value in self.assigns.get(node.id, ()):
+                    vlit = _literal_axes(value)
+                    if vlit is not None:
+                        return ("lit", vlit)
+            return None
+
+        for i, arg in enumerate(call.args):
+            if i + offset < len(callee.params):
+                s = summarize(arg)
+                if s is not None:
+                    args[callee.params[i + offset]] = s
+        for kw in call.keywords:
+            if kw.arg:
+                s = summarize(kw.value)
+                if s is not None:
+                    args[kw.arg] = s
+        return CallFact(
+            target_path=callee.path, target_qual=callee.qualname,
+            line=call.lineno, args=args,
+        )
+
+    # -------------------------------------------------------- map entries
+
+    def _map_entry_of(self, deco: ast.AST, target: str) -> Optional[MapEntry]:
+        """partial(shard_map, mesh=..., in_specs=...) / jax.pmap(...) deco."""
+        if not isinstance(deco, ast.Call):
+            return None
+        name = dotted_name(deco.func) or ""
+        last = name.split(".")[-1]
+        if last == "partial" and deco.args:
+            inner = dotted_name(deco.args[0]) or ""
+            launcher = self.module.is_map_launcher(inner) if inner else None
+            if launcher is None:
+                return None
+            return self._entry(launcher, deco, target, lineno=deco.lineno)
+        launcher = self.module.is_map_launcher(name) if name else None
+        if launcher is not None:
+            return self._entry(launcher, deco, target, lineno=deco.lineno)
+        return None
+
+    def _map_entry_from_call(self, launcher: str, call: ast.Call) -> Optional[MapEntry]:
+        body = call.args[0]
+        target: Optional[str] = None
+        if isinstance(body, ast.Name):
+            hit = self.model.resolve_call(self.module, body.id, self.func)
+            if hit is not None and hit[0] is self.module:
+                target = hit[1].qualname
+        entry = self._entry(launcher, call, target, lineno=call.lineno)
+        return entry
+
+    def _entry(
+        self, launcher: str, call: ast.Call, target: Optional[str], lineno: int
+    ) -> Optional[MapEntry]:
+        kw = {k.arg: k.value for k in call.keywords if k.arg}
+        axes: Optional[FrozenSet[str]] = None
+        if launcher == "shard_map":
+            mesh = kw.get("mesh")
+            if mesh is None and len(call.args) >= 2:
+                mesh = call.args[1]  # shard_map(f, mesh, in_specs, out_specs)
+            if mesh is not None:
+                axes = self._mesh_axes(mesh)
+            if "in_specs" not in kw and len(call.args) >= 3:
+                kw["in_specs"] = call.args[2]
+        else:
+            axis_name = kw.get("axis_name")
+            if axis_name is not None:
+                axes = _literal_axes(axis_name)
+            elif launcher == "vmap":
+                return None  # positional vmap without axis_name binds nothing
+        in_specs = kw.get("in_specs")
+        spec_axes: List[Optional[FrozenSet[str]]] = []
+        if in_specs is not None:
+            elts = (
+                list(in_specs.elts)
+                if isinstance(in_specs, (ast.Tuple, ast.List))
+                else [in_specs]
+            )
+            for elt in elts:
+                spec_axes.append(self._p_axes(elt))
+        return MapEntry(
+            kind=launcher, line=lineno, axes=axes, target=target,
+            in_spec_axes=tuple(spec_axes),
+        )
+
+    def _p_axes(self, node: ast.AST) -> Optional[FrozenSet[str]]:
+        """Axis names in a literal P(...)/PartitionSpec(...) expression."""
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func) or ""
+            if self.module.is_spec_ctor(name) and name.split(".")[-1] != "NamedSharding":
+                out: Set[str] = set()
+                for arg in node.args:
+                    lit = _literal_axes(arg)
+                    if lit is None and not (
+                        isinstance(arg, ast.Constant) and arg.value is None
+                    ):
+                        return None
+                    out |= lit or set()
+                return frozenset(out)
+        return None
+
+    def _mesh_axes(self, node: ast.AST, depth: int = 0) -> Optional[FrozenSet[str]]:
+        """Axis names of a mesh expression, through <=2 levels of local or
+        module-level assignment; None when the mesh is dynamic."""
+        if depth > 2:
+            return None
+        if isinstance(node, ast.Name):
+            for value in self.assigns.get(node.id, ()):
+                axes = self._mesh_axes(value, depth + 1)
+                if axes is not None:
+                    return axes
+            mod_value = self.module.module_assigns.get(node.id)
+            if mod_value is not None:
+                return self._mesh_axes(mod_value, depth + 1)
+            return None
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func) or ""
+            last = name.split(".")[-1]
+            if last in ("make_mesh", "Mesh") and len(node.args) >= 2:
+                return _literal_axes(node.args[1])
+            if last == "make_data_mesh":
+                for kw in node.keywords:
+                    if kw.arg == "axis_name":
+                        return _literal_axes(kw.value)
+                return frozenset({"data"})
+        return None
+
+    # ------------------------------------------- wrappers / donate-reshard
+
+    def _wrapper_of(
+        self, expr: ast.AST
+    ) -> Optional[Tuple[Tuple[int, ...], Dict[int, str]]]:
+        """(donate positions, {position: in-spec text}) for a jax.jit call
+        with donate_argnums, following .lower/.compile chains."""
+        if isinstance(expr, ast.Name):
+            return self.wrappers.get(expr.id)
+        if not isinstance(expr, ast.Call):
+            return None
+        fn = expr.func
+        if isinstance(fn, ast.Attribute) and fn.attr in ("lower", "compile"):
+            return self._wrapper_of(fn.value)
+        name = dotted_name(fn) or ""
+        if name.split(".")[-1] != "jit":
+            return None
+        donate: Optional[Tuple[int, ...]] = None
+        specs: Dict[int, str] = {}
+        for kw in expr.keywords:
+            if kw.arg == "donate_argnums":
+                donate = _parse_donate_positions(kw.value)
+            elif kw.arg in ("in_shardings", "in_specs"):
+                elts = (
+                    list(kw.value.elts)
+                    if isinstance(kw.value, (ast.Tuple, ast.List))
+                    else [kw.value]
+                )
+                for i, elt in enumerate(elts):
+                    text = self._spec_text(elt)
+                    if text is not None:
+                        specs[i] = text
+        if not donate:
+            return None
+        return donate, specs
+
+    def _spec_text(self, node: ast.AST, depth: int = 0) -> Optional[str]:
+        """Normalized text of the P(...) inside a sharding expression."""
+        if depth > 2:
+            return None
+        if isinstance(node, ast.Name):
+            for value in self.assigns.get(node.id, ()):
+                text = self._spec_text(value, depth + 1)
+                if text is not None:
+                    return text
+            mod_value = self.module.module_assigns.get(node.id)
+            if mod_value is not None:
+                return self._spec_text(mod_value, depth + 1)
+            return None
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func) or ""
+            last = name.split(".")[-1]
+            if last == "NamedSharding" and len(node.args) >= 2:
+                return self._spec_text(node.args[1], depth + 1)
+            if self.module.is_spec_ctor(name) and last != "NamedSharding":
+                return _safe_unparse(node).replace(" ", "").replace(
+                    "PartitionSpec(", "P("
+                )
+        return None
+
+    def _check_donate_reshard(
+        self, call: ast.Call, wrapper: Tuple[Tuple[int, ...], Dict[int, str]]
+    ) -> None:
+        positions, specs = wrapper
+        for pos in positions:
+            if pos >= len(call.args) or any(
+                isinstance(a, ast.Starred) for a in call.args[: pos + 1]
+            ):
+                continue
+            arg = call.args[pos]
+            if not isinstance(arg, ast.Name) or arg.id not in self.func.placements:
+                continue
+            placed_spec, _line = self.func.placements[arg.id]
+            wrapper_spec = specs.get(pos)
+            if wrapper_spec is not None and wrapper_spec != placed_spec:
+                self.func.events.append(
+                    ShardEvent(
+                        "donate_reshard", self.func.path, arg.lineno,
+                        arg.col_offset, self.func.qualname,
+                        f"`{arg.id}` is placed {placed_spec} but donated into a"
+                        f" launch whose in-spec is {wrapper_spec}; XLA inserts a"
+                        " resharding copy, so the donation frees nothing",
+                    )
+                )
+
+    # ------------------------------------------------------------- finish
+
+    def _key_fields(self) -> List[str]:
+        """Cache-key tuple components with one level of name expansion."""
+        for node in self.cache_key_nodes:
+            tup = node
+            if isinstance(node, ast.Name):
+                for value in self.assigns.get(node.id, ()):
+                    if isinstance(value, ast.Tuple):
+                        tup = value
+                        break
+            if not isinstance(tup, ast.Tuple):
+                continue
+            fields: List[str] = []
+            for elt in tup.elts:
+                if isinstance(elt, ast.Name) and elt.id in self.assigns:
+                    alts = " | ".join(
+                        sorted({_safe_unparse(v) for v in self.assigns[elt.id]})
+                    )
+                    fields.append(f"{elt.id} := {alts}")
+                else:
+                    fields.append(_safe_unparse(elt))
+            return fields
+        return []
+
+    def _finish_events(self) -> None:
+        """TMH-KEY-SHARD: a cached launch consumes placed arrays, but no key
+        component covers their sharding/mesh/topology."""
+        f = self.func
+        if not self.cache_key_nodes or not self.placed_arg_uses:
+            return
+        key_text = " ".join(f.key_fields) or " ".join(
+            _safe_unparse(n) for n in self.cache_key_nodes
+        )
+        if _KEY_SHARD_RE.search(key_text):
+            return
+        name, node = self.placed_arg_uses[0]
+        f.events.append(
+            ShardEvent(
+                "key_shard", f.path, node.lineno, node.col_offset,
+                f"{f.qualname}.sharding",
+                f"cache key ({key_text}) has no sharding/mesh facet but the"
+                f" launch consumes placed array `{name}`; a mesh or placement"
+                " change replays a stale executable",
+            )
+        )
+
+
+def build_model(files: Dict[str, Tuple[str, str]]) -> ShardModel:
+    """Build the linked axis/placement model for ``load_package`` output."""
+    return ShardModel(files)

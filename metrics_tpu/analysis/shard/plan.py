@@ -1,0 +1,242 @@
+"""The shard-plan worksheet: the machine-readable input to ROADMAP items 1 & 4.
+
+``python -m metrics_tpu.analysis --shard --write-plan`` regenerates the
+checked-in ``tmshard_state_plan.json``: for every registered state of every
+constructible Metric class (the tmlint ctor registry, the same sweep the
+contract tests use), its reduction algebra, shape family, and the statically
+derived legal shard axes — fleet-axis partitionable? psum-safe? cat-shard-only?
+replicate-only? — each with a reason string, plus the per-engine mesh-contract
+matrix from ``spec_rules.extract_mesh_contract``.  The
+``test_plan_worksheet_in_sync`` test keeps the checked-in copy honest, exactly
+like tmown's drift worksheet.
+
+Verdict model (pure function of reduction x family x host-side contract):
+
+- ``psum_safe``: sum/mean/max/min states are fixed-shape arithmetic reduces —
+  one ``psum``/``pmean``/``pmax``/``pmin`` over a *replica* axis is exact.
+- ``cat_shard_only``: cat states concatenate; they shard only by splitting
+  rows (the per-host cat shards ckpt already writes), never by psum.
+- ``fleet_partitionable``: sum/max/min states of a non-host-side class can
+  live sharded ``P('fleet')`` — rows are independent streams and the fold
+  algebra matches ``core/fleet.py``'s eligibility gate.  The cross-host sync
+  must then reduce over a *data/host* axis, never the fleet axis itself (the
+  TMH-SPEC-ALGEBRA double-count class).
+- ``replicate_only``: None/callable reductions have no distributable algebra;
+  state must stay replicated and merge through the host path.
+"""
+import json
+import os
+from typing import Any, Dict, Optional
+
+PLAN_FILENAME = "tmshard_state_plan.json"
+
+_COMMENT = (
+    "Machine-extracted shard plan for every registered metric state: reduction"
+    " algebra, shape family, and statically-derived legal shard axes, plus the"
+    " per-engine mesh-awareness matrix. Regenerate with `python -m"
+    " metrics_tpu.analysis --shard --write-plan`; consumed by ROADMAP items 1"
+    " (P('fleet') sharded state) and 4 (pod-scale shard_map serving)."
+)
+
+_AXIS_LEGEND = {
+    "psum_safe": (
+        "state syncs with one fixed-shape arithmetic collective (psum/pmean/"
+        "pmax/pmin) over a replica axis"
+    ),
+    "cat_shard_only": (
+        "state concatenates: shard by splitting rows across hosts/devices"
+        " (all_gather to merge), never by arithmetic reduce"
+    ),
+    "fleet_partitionable": (
+        "rows are independent per-stream slots: legal to shard P('fleet')"
+        " across the ICI mesh, syncing over a data/host axis only"
+    ),
+    "replicate_only": (
+        "no distributable reduce algebra: keep replicated, merge on host"
+    ),
+}
+
+
+def _reduction_repr(reduce_kind: Any) -> str:
+    if reduce_kind is None:
+        return "none"
+    if isinstance(reduce_kind, str):
+        return reduce_kind
+    return "callable"
+
+
+def _family_of(default: Any) -> str:
+    if isinstance(default, list):
+        return "cat_list"
+    if type(default).__name__ == "CatBuffer":
+        return "cat_buffer"
+    ndim = getattr(default, "ndim", None)
+    if ndim == 0:
+        return "scalar"
+    if ndim == 1:
+        return "vector"
+    if ndim == 2:
+        return "matrix"
+    return "tensor"
+
+
+def _shape_of(default: Any):
+    shape = getattr(default, "shape", None)
+    if shape is not None:
+        return list(shape)
+    data = getattr(default, "data", None)
+    if data is not None and hasattr(data, "shape"):
+        return list(data.shape)
+    return None
+
+
+def _dtype_of(default: Any) -> Optional[str]:
+    dtype = getattr(default, "dtype", None)
+    if dtype is not None:
+        return str(dtype)
+    data = getattr(default, "data", None)
+    if data is not None and hasattr(data, "dtype"):
+        return str(data.dtype)
+    return None
+
+
+def state_verdicts(reduction: str, family: str, host_side: bool) -> Dict[str, Dict]:
+    """The per-state shard verdicts (pure; unit-tested directly)."""
+    is_cat = family in ("cat_list", "cat_buffer")
+    psum_safe = reduction in ("sum", "mean", "max", "min") and not is_cat
+    fleet_ok = reduction in ("sum", "max", "min") and not is_cat and not host_side
+    replicate_only = not psum_safe and not is_cat
+
+    verdicts = {
+        "psum_safe": {
+            "ok": psum_safe,
+            "reason": (
+                f"`{reduction}` reduce of a fixed-shape {family} state maps to"
+                " one psum/pmean/pmax/pmin over the replica axis"
+                if psum_safe
+                else (
+                    "cat states merge by concatenation (all_gather), an"
+                    " arithmetic reduce would destroy rows"
+                    if is_cat
+                    else f"`{reduction}` reduction has no collective arithmetic"
+                    " equivalent; syncing gathers + merges on each replica"
+                )
+            ),
+        },
+        "cat_shard_only": {
+            "ok": is_cat,
+            "reason": (
+                "rows partition cleanly across hosts/devices; ckpt already"
+                " writes per-host cat shards and re-reduces across topology"
+                " change"
+                if is_cat
+                else f"{family} state is fixed-shape, row-splitting semantics"
+                " do not apply"
+            ),
+        },
+        "fleet_partitionable": {
+            "ok": fleet_ok,
+            "reason": (
+                f"per-stream rows fold independently under `{reduction}` (the"
+                " core/fleet.py eligibility algebra), so P('fleet') over the"
+                " ICI mesh is legal — provided the cross-host sync reduces"
+                " over a data/host axis, never the fleet axis itself (that is"
+                " the TMH-SPEC-ALGEBRA double-count)"
+                if fleet_ok
+                else (
+                    "fleet metrics cannot register cat state (no per-stream"
+                    " segment fold)"
+                    if is_cat
+                    else (
+                        "host-side update/compute contract: state transits the"
+                        " host each step, a device-sharded table would thrash"
+                        if host_side
+                        else f"`{reduction}` is outside the fleet fold algebra"
+                        " (sum/max/min)"
+                    )
+                )
+            ),
+        },
+        "replicate_only": {
+            "ok": replicate_only,
+            "reason": (
+                f"`{reduction}` reduction: keep replicated and merge through"
+                " the host merge_state path"
+                if replicate_only
+                else "a distributable algebra exists (see the other verdicts)"
+            ),
+        },
+    }
+    return verdicts
+
+
+def _plan_of(verdicts: Dict[str, Dict]) -> str:
+    if verdicts["fleet_partitionable"]["ok"]:
+        return "shard P('fleet'); sync over data/host axis"
+    if verdicts["cat_shard_only"]["ok"]:
+        return "shard rows per host/device; all_gather to merge"
+    if verdicts["psum_safe"]["ok"]:
+        return "replicate; one psum-family sync"
+    return "replicate; host-path merge"
+
+
+def worksheet(mesh_matrix: Dict[str, Dict]) -> Dict:
+    """Build the full plan payload (imports the live registry: only the
+    ``--write-plan`` path and the in-sync test pay the introspection cost)."""
+    from metrics_tpu.analysis import registry
+
+    classes: Dict[str, Dict] = {}
+    skipped: Dict[str, str] = {}
+    for item in list(registry.introspect_classes()) + list(
+        registry.introspect_fleet_variants()
+    ):
+        if item.instance is None:
+            skipped[item.name] = item.skip_reason
+            continue
+        inst = item.instance
+        host_side = bool(getattr(type(inst), "_host_side_update", False))
+        host_compute = bool(getattr(type(inst), "_host_side_compute", False))
+        states: Dict[str, Dict] = {}
+        for name in sorted(inst._reductions):
+            reduction = _reduction_repr(inst._reductions[name])
+            default = inst._defaults.get(name)
+            family = _family_of(default)
+            verdicts = state_verdicts(reduction, family, host_side)
+            states[name] = {
+                "reduction": reduction,
+                "family": family,
+                "shape": _shape_of(default),
+                "dtype": _dtype_of(default),
+                "persistent": bool(inst._persistent.get(name, False)),
+                "verdicts": verdicts,
+                "plan": _plan_of(verdicts),
+            }
+        classes[item.name] = {
+            "host_side_update": host_side,
+            "host_side_compute": host_compute,
+            "fleet_size": getattr(inst, "fleet_size", None),
+            "states": states,
+        }
+    return {
+        "version": 1,
+        "comment": _COMMENT,
+        "axis_legend": _AXIS_LEGEND,
+        "classes": {k: classes[k] for k in sorted(classes)},
+        "skipped": {k: skipped[k] for k in sorted(skipped)},
+        "engine_mesh_matrix": {
+            k: mesh_matrix[k] for k in sorted(mesh_matrix)
+        },
+    }
+
+
+def write_worksheet(path: str, payload: Dict) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=2, sort_keys=False)
+        fh.write("\n")
+    os.replace(tmp, path)
+
+
+def load_worksheet(path: str) -> Dict:
+    with open(path, "r", encoding="utf-8") as fh:
+        return json.load(fh)

@@ -1,0 +1,122 @@
+"""tmshard orchestration: parse -> link -> rules -> baseline -> report.
+
+Pure host AST work — nothing imports or executes the analyzed modules (the
+plan worksheet's introspection pass runs only under ``--write-plan`` and the
+in-sync test), so the sweep is CI-safe on an accelerator-free box and costs
+cold-start seconds (the ISSUE budget is <= 60 s; the package parses and
+fixpoints in well under one).
+"""
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from metrics_tpu.analysis import baseline as baseline_mod
+from metrics_tpu.analysis.findings import SHARD_RULES, Finding
+from metrics_tpu.analysis.jitmap import load_package
+from metrics_tpu.analysis.runner import _find_repo_root
+from metrics_tpu.analysis.shard import plan as plan_mod
+from metrics_tpu.analysis.shard import spec_rules
+from metrics_tpu.analysis.shard.axis_model import ShardModel, build_model
+
+
+@dataclass
+class ShardReport:
+    """One tmshard run: the linked model plus rule output and baseline split."""
+
+    findings: List[Finding] = field(default_factory=list)  # waived included
+    new_findings: List[Finding] = field(default_factory=list)
+    unused_waivers: List[Tuple[str, str, str]] = field(default_factory=list)
+    parse_errors: Dict[str, str] = field(default_factory=dict)
+    #: engine -> mesh-awareness matrix (the item 1/4 worksheet component)
+    mesh_matrix: Dict[str, Dict] = field(default_factory=dict)
+    stats: Dict[str, float] = field(default_factory=dict)
+    model: Optional[ShardModel] = None
+
+    @property
+    def waived(self) -> List[Finding]:
+        return [f for f in self.findings if f.waived]
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.new_findings else 0
+
+    def plan_worksheet(self) -> Dict:
+        return plan_mod.worksheet(self.mesh_matrix)
+
+
+def _obs_inc(name: str, value: float = 1) -> None:
+    from metrics_tpu.obs import registry as _obs
+
+    if _obs._ENABLED:
+        _obs.REGISTRY.inc("shard", name, value)
+
+
+#: rule id -> obs counter suffix (mirrors Rule.counter in findings.py)
+_RULE_COUNTERS = {
+    "TMH-AXIS-UNBOUND": "axis_unbound",
+    "TMH-SPEC-ALGEBRA": "spec_algebra",
+    "TMH-REPLICA-DIVERGE": "replica_diverge",
+    "TMH-DONATE-RESHARD": "donate_reshard",
+    "TMH-KEY-SHARD": "key_shard",
+    "TMH-MESH-DRIFT": "mesh_drift",
+}
+
+
+def run_shard(
+    target: str = "metrics_tpu",
+    baseline_path: Optional[str] = None,
+    repo_root: Optional[str] = None,
+) -> ShardReport:
+    """Analyze ``target`` (package dir or single file) for sharding safety."""
+    t0 = time.perf_counter()
+    report = ShardReport()
+    repo_root = repo_root or _find_repo_root(target)
+
+    files = load_package(target, repo_root)
+    model = build_model(files)
+    report.model = model
+    report.parse_errors = dict(model.errors)
+
+    report.findings.extend(spec_rules.dataflow_findings(model))
+    report.mesh_matrix = spec_rules.extract_mesh_contract(model)
+    report.findings.extend(spec_rules.drift_findings(report.mesh_matrix))
+    report.findings.sort(key=lambda f: (f.path, f.line, f.rule, f.symbol))
+
+    if baseline_path is None:
+        baseline_path = baseline_mod.default_baseline_path(repo_root)
+    waivers = baseline_mod.load_baseline(baseline_path) if baseline_path else {}
+    shard_waivers = baseline_mod.scope_waivers(waivers, SHARD_RULES)
+    report.new_findings, report.unused_waivers = baseline_mod.apply_baseline(
+        report.findings, shard_waivers
+    )
+
+    n_funcs = 0
+    n_mapped = 0
+    n_collectives = 0
+    n_placements = 0
+    for _m, func in model.all_functions():
+        n_funcs += 1
+        if func.is_mapped_body:
+            n_mapped += 1
+        n_collectives += sum(1 for s in func.collectives if s.derived_from is None)
+        n_placements += len(func.placements)
+
+    _obs_inc("findings", len(report.findings))
+    for f in report.findings:
+        suffix = _RULE_COUNTERS.get(f.rule)
+        if suffix:
+            _obs_inc(suffix)
+
+    report.stats = {
+        "files": len(model.modules),
+        "functions": n_funcs,
+        "mapped_bodies": n_mapped,
+        "collectives": n_collectives,
+        "placements": n_placements,
+        "engines": len(report.mesh_matrix),
+        "findings": len(report.findings),
+        "waived": len(report.waived),
+        "new": len(report.new_findings),
+        "seconds": round(time.perf_counter() - t0, 3),
+    }
+    return report

@@ -1,0 +1,234 @@
+"""tmshard policy: turn the linked axis/placement model into findings.
+
+Five of the six rules read the model directly; TMH-MESH-DRIFT is the
+item-1/item-4 analog of tmown's engine contract: a per-engine *mesh-awareness*
+matrix over the four launch engines plus the shard_map serving program in
+``parallel/mesh.py``, where a component absent from one engine while two or
+more siblings have it is drift the unified engine (ROADMAP item 5) — or the
+sharded-state design (item 1) — must resolve or deliberately exclude.
+"""
+from typing import Dict, List, Optional, Tuple
+
+from metrics_tpu.analysis.findings import Finding
+from metrics_tpu.analysis.shard.axis_model import (
+    _KEY_SHARD_RE, _REDUCE_PRIMS, ShardModel,
+)
+
+#: engine -> (repo-relative path, anchor qualname or None for whole-module).
+#: fused/fleet/ingest/rank mirror tmown's launch anchors; ``mesh`` is the
+#: shard_map serving program the matrix exists to compare them against.
+ENGINES: Dict[str, Tuple[str, Optional[str]]] = {
+    "fused": ("metrics_tpu/core/fused.py", "FusedCollectionUpdate._launch"),
+    "fleet": ("metrics_tpu/core/fleet.py", "run_step"),
+    "ingest": ("metrics_tpu/serve/ingest.py", "IngestQueue._launch_chain"),
+    "rank": ("metrics_tpu/ops/clf_curve.py", None),
+    "mesh": ("metrics_tpu/parallel/mesh.py", None),
+}
+
+#: matrix rows: component -> what counts as evidence (docs + --explain text)
+COMPONENTS = (
+    "axis_binding",      # enters a shard_map/pmap/vmap-with-axis context
+    "collective_sync",   # issues psum/pmax/all_gather/pvary-family collectives
+    "spec_plumbing",     # constructs PartitionSpec/NamedSharding specs
+    "placed_io",         # places arrays (device_put+sharding) or reads .sharding
+    "sharded_key_facet", # executable-cache key covers sharding/mesh/topology
+    "topology_seed",     # derives work from process_topology/process identity
+)
+
+
+def dataflow_findings(model: ShardModel) -> List[Finding]:
+    """TMH-AXIS-UNBOUND / SPEC-ALGEBRA / REPLICA-DIVERGE / DONATE-RESHARD /
+    KEY-SHARD over every function of the linked model."""
+    out: List[Finding] = []
+    mapped_reach = model.mapped_reachable()
+
+    for _m, func in model.all_functions():
+        # ---- TMH-AXIS-UNBOUND: literal axes outside the must-bound set
+        for site in func.collectives:
+            if site.axes is None or not site.axes or func.bound is None:
+                continue
+            missing = site.axes - func.bound
+            if not missing:
+                continue
+            via = f" (via {site.derived_from})" if site.derived_from else ""
+            out.append(
+                Finding(
+                    rule="TMH-AXIS-UNBOUND", path=func.path, line=site.line,
+                    col=site.col, symbol=func.qualname,
+                    message=(
+                        f"`{site.op}`{via} reduces over axis"
+                        f" {sorted(missing)} but no mapped context reaching"
+                        f" `{func.qualname}` binds it"
+                        + (
+                            f" (bound here: {sorted(func.bound)})"
+                            if func.bound
+                            else " (no shard_map/pmap reaches this function)"
+                        )
+                    ),
+                )
+            )
+        # ---- TMH-SPEC-ALGEBRA: reduce of an operand partitioned on that axis
+        if func.is_mapped_body:
+            for site in func.collectives:
+                if (
+                    site.op in _REDUCE_PRIMS
+                    and site.axes
+                    and site.operand_param is not None
+                ):
+                    spec = func.in_spec_axes.get(site.operand_param)
+                    if spec and (spec & site.axes):
+                        shared = sorted(spec & site.axes)
+                        out.append(
+                            Finding(
+                                rule="TMH-SPEC-ALGEBRA", path=func.path,
+                                line=site.line, col=site.col,
+                                symbol=func.qualname,
+                                message=(
+                                    f"`{site.op}` over axis {shared} of"
+                                    f" `{site.operand_param}`, which the"
+                                    f" in-spec *partitions* along {shared}:"
+                                    " each shard holds distinct logical rows,"
+                                    " so the cross-shard reduce mixes (psum:"
+                                    " double-counts) them; reduce the local"
+                                    " block first, then sync"
+                                ),
+                            )
+                        )
+        # ---- TMH-REPLICA-DIVERGE (a): host reads traced under a map
+        if func.key() in mapped_reach:
+            for line, col, name, kind in func.divergent_calls:
+                out.append(
+                    Finding(
+                        rule="TMH-REPLICA-DIVERGE", path=func.path, line=line,
+                        col=col, symbol=func.qualname,
+                        message=(
+                            f"`{name}` ({kind}) executes inside a mapped"
+                            " trace: each replica bakes its own value into"
+                            " the program, and any collective downstream"
+                            " deadlocks or silently diverges; hoist the host"
+                            " read into the eager launcher"
+                        ),
+                    )
+                )
+        # ---- TMH-REPLICA-DIVERGE (b): divergent value into a collective
+        for site in func.collectives:
+            tainted = sorted(site.operand_names & func.divergent_names)
+            if tainted:
+                out.append(
+                    Finding(
+                        rule="TMH-REPLICA-DIVERGE", path=func.path,
+                        line=site.line, col=site.col, symbol=func.qualname,
+                        message=(
+                            f"`{site.op}` operand depends on"
+                            f" {tainted}, assigned from a replica-divergent"
+                            " host read; the collective combines different"
+                            " values per replica (silent wrong result)"
+                        ),
+                    )
+                )
+        # ---- events (TMH-DONATE-RESHARD / TMH-KEY-SHARD)
+        for ev in func.events:
+            rule = {
+                "donate_reshard": "TMH-DONATE-RESHARD",
+                "key_shard": "TMH-KEY-SHARD",
+            }[ev.kind]
+            out.append(
+                Finding(
+                    rule=rule, path=ev.path, line=ev.line, col=ev.col,
+                    symbol=ev.symbol, message=ev.detail,
+                )
+            )
+    return out
+
+
+# ------------------------------------------------------------ mesh contract
+
+
+def extract_mesh_contract(
+    model: ShardModel, engines: Optional[Dict[str, Tuple[str, Optional[str]]]] = None
+) -> Dict[str, Dict]:
+    """engine -> {path, anchor, components: {name: evidence | None}}."""
+    matrix: Dict[str, Dict] = {}
+    for engine, (path, anchor) in (engines or ENGINES).items():
+        module = model.modules.get(path)
+        if module is None:
+            continue  # fixture runs analyze partial trees
+        if anchor is not None and anchor not in module.functions:
+            continue
+        reach = model.reachable_from(module, anchor)
+        comp: Dict[str, Optional[str]] = {c: None for c in COMPONENTS}
+        has_cache = False
+        for func in reach:
+            if comp["axis_binding"] is None and (
+                func.map_entries or func.is_mapped_body
+            ):
+                comp["axis_binding"] = func.qualname
+            if comp["collective_sync"] is None and any(
+                s.derived_from is None for s in func.collectives
+            ):
+                comp["collective_sync"] = func.qualname
+            if comp["spec_plumbing"] is None and func.spec_ctors:
+                comp["spec_plumbing"] = func.qualname
+            if comp["placed_io"] is None and (
+                func.device_puts or func.touches_sharding
+            ):
+                comp["placed_io"] = func.qualname
+            if func.cache_get or func.cache_store:
+                has_cache = True
+                if comp["sharded_key_facet"] is None and any(
+                    _KEY_SHARD_RE.search(field) for field in func.key_fields
+                ):
+                    comp["sharded_key_facet"] = func.qualname
+            if comp["topology_seed"] is None:
+                if any("process" in n.split(".")[-1] for _l, _c, n, _k in func.divergent_calls):
+                    comp["topology_seed"] = func.qualname
+                elif any(
+                    fact.target_qual.split(".")[-1] == "process_topology"
+                    for fact in func.calls
+                ):
+                    comp["topology_seed"] = func.qualname
+        # a cache whose key functions read .sharding covers placement too
+        if has_cache and comp["sharded_key_facet"] is None:
+            for func in reach:
+                if func.touches_sharding:
+                    comp["sharded_key_facet"] = func.qualname
+                    break
+        anchor_line = 1
+        anchor_func = module.functions.get(anchor) if anchor else None
+        if anchor_func is not None:
+            anchor_line = anchor_func.line
+        matrix[engine] = {
+            "path": path,
+            "anchor": anchor,
+            "anchor_line": anchor_line,
+            "components": comp,
+            "has_cache": has_cache,
+        }
+    return matrix
+
+
+def drift_findings(matrix: Dict[str, Dict]) -> List[Finding]:
+    """A component absent from one engine while >=2 siblings have it."""
+    out: List[Finding] = []
+    for comp in COMPONENTS:
+        holders = [e for e, facts in matrix.items() if facts["components"][comp]]
+        if len(holders) < 2:
+            continue
+        for engine, facts in matrix.items():
+            if facts["components"][comp]:
+                continue
+            out.append(
+                Finding(
+                    rule="TMH-MESH-DRIFT", path=facts["path"],
+                    line=facts["anchor_line"], col=0,
+                    symbol=f"{engine}.{comp}",
+                    message=(
+                        f"engine `{engine}` lacks `{comp}` while"
+                        f" {sorted(holders)} have it — the sharded-state /"
+                        " pod-topology design (ROADMAP items 1 & 4) must add"
+                        " it or record why this engine is exempt"
+                    ),
+                )
+            )
+    out.sort(key=lambda f: (f.path, f.symbol))
+    return out

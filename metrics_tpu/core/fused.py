@@ -232,11 +232,35 @@ def _static_key(spec: Tuple[Any, tuple]) -> Tuple:
     return (treedef, tuple(parts))
 
 
+def _sharding_facet(leaf: Any) -> Optional[str]:
+    """Cache-key facet for a committed, genuinely partitioned placement.
+
+    Default-placed / single-device / fully-replicated leaves return None so
+    the legacy two-tuple key shape — and every recorded warm-manifest digest
+    (serve/excache.py) — is unchanged. Only a NamedSharding that actually
+    partitions an axis adds a facet: two launches with identical avals but
+    different partitions must not share an executable, because the compiled
+    program bakes in the input sharding (tmshard's TMH-KEY-SHARD class).
+    """
+    sharding = getattr(leaf, "sharding", None)
+    spec = getattr(sharding, "spec", None)
+    if spec is None or all(part is None for part in spec):
+        return None
+    return str(spec)
+
+
 def _aval_key(tree: Any) -> Tuple:
     # dtype objects hash/compare directly; stringifying them (numpy's dtype
     # __str__ is slow python) dominated the per-tick key cost at ingest rates
     leaves, treedef = jax.tree_util.tree_flatten(tree)
-    return (treedef, tuple((tuple(l.shape), l.dtype) for l in leaves))
+    parts = []
+    for leaf in leaves:
+        facet = _sharding_facet(leaf)
+        if facet is None:
+            parts.append((tuple(leaf.shape), leaf.dtype))
+        else:
+            parts.append((tuple(leaf.shape), leaf.dtype, facet))
+    return (treedef, tuple(parts))
 
 
 # ------------------------------------------------------ stable key digests

@@ -441,7 +441,7 @@ def test_tmlint_no_new_findings():
 
 def test_every_rule_documented_and_cross_linked():
     from metrics_tpu.analysis.findings import (
-        LINT_RULES, OWN_RULES, RACE_RULES, SAN_RULES,
+        LINT_RULES, OWN_RULES, RACE_RULES, SAN_RULES, SHARD_RULES,
     )
 
     assert set(LINT_RULES) == {
@@ -460,11 +460,19 @@ def test_every_rule_documented_and_cross_linked():
         "TMO-DONATE-ALIAS", "TMO-USE-AFTER-DONATE", "TMO-DOUBLE-DONATE",
         "TMO-SNAPSHOT-GAP", "TMO-KEY-GAP", "TMO-ENGINE-DRIFT",
     }
+    assert set(SHARD_RULES) == {
+        "TMH-AXIS-UNBOUND", "TMH-SPEC-ALGEBRA", "TMH-REPLICA-DIVERGE",
+        "TMH-DONATE-RESHARD", "TMH-KEY-SHARD", "TMH-MESH-DRIFT",
+    }
     assert set(RULES) == (
         set(LINT_RULES) | set(SAN_RULES) | set(RACE_RULES) | set(OWN_RULES)
+        | set(SHARD_RULES)
     )
-    # the four tiers partition RULES: every waiver has exactly one staleness home
-    tiers = [set(LINT_RULES), set(SAN_RULES), set(RACE_RULES), set(OWN_RULES)]
+    # the five tiers partition RULES: every waiver has exactly one staleness home
+    tiers = [
+        set(LINT_RULES), set(SAN_RULES), set(RACE_RULES), set(OWN_RULES),
+        set(SHARD_RULES),
+    ]
     for i, a in enumerate(tiers):
         for b in tiers[i + 1:]:
             assert not a & b

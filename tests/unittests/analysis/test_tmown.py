@@ -1,7 +1,7 @@
 """tmown unit tier: per-rule seeded fixtures (each with a clean twin — the
 TMO-DONATE-ALIAS pair reproduces the PR 16 restore-aliasing incident), the
 engine-contract drift matrix, the checked-in ROADMAP-item-5 worksheet, the
-four-tier waiver scoping, the repo-wide no-new-findings guard, and end-to-end
+five-tier waiver scoping, the repo-wide no-new-findings guard, and end-to-end
 CLI exit-code regressions.
 
 Pure static analysis — nothing here executes the analyzed code; it rides the
@@ -585,6 +585,21 @@ def test_drift_worksheet_in_sync(repo_report):
     recorded = {d["symbol"] for d in checked_in["divergences"]}
     waived = {f.symbol for f in repo_report.waived if f.rule == "TMO-ENGINE-DRIFT"}
     assert recorded == waived
+
+
+def test_own_scope_excludes_shard_waivers(repo_report):
+    """The tmown staleness check must never see the TMH-* (tmshard) waivers
+    that share the baseline file: the repo baseline holds both, and every
+    waiver tmown applied is strictly TMO-*."""
+    from metrics_tpu.analysis.baseline import load_baseline, scope_waivers
+    from metrics_tpu.analysis.findings import OWN_RULES, SHARD_RULES
+
+    waivers = load_baseline(str(REPO_ROOT / BASELINE_FILENAME))
+    own_scope = scope_waivers(waivers, OWN_RULES)
+    shard_scope = scope_waivers(waivers, SHARD_RULES)
+    assert own_scope and shard_scope
+    assert not set(own_scope) & set(shard_scope)
+    assert all(f.rule.startswith("TMO-") for f in repo_report.waived)
 
 
 def test_own_obs_counters(tmp_path):

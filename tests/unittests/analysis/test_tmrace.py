@@ -1,5 +1,5 @@
 """tmrace unit tier: per-rule seeded fixtures (each with a clean twin), the
-thread-role model, annotation semantics, four-tier waiver scoping, the
+thread-role model, annotation semantics, five-tier waiver scoping, the
 repo-wide no-new-findings guard, and end-to-end CLI exit-code regressions.
 
 The threaded *stress* corroboration of these rules lives in
@@ -540,15 +540,16 @@ def test_repo_thread_role_model():
         assert lock_id in model.locks, f"missing lock {lock_id}"
 
 
-# ----------------------------------------------- four-tier waiver scoping
+# ----------------------------------------------- five-tier waiver scoping
 
 
 def test_waiver_scoping_partitions_staleness():
     """Satellite contract: each tier ignores the other tiers' waivers when
-    checking staleness — a TMR waiver is never 'stale' to tmlint/tmsan/tmown."""
+    checking staleness — a TMR waiver is never 'stale' to
+    tmlint/tmsan/tmown/tmshard."""
     from metrics_tpu.analysis import baseline as baseline_mod
     from metrics_tpu.analysis.findings import (
-        LINT_RULES, OWN_RULES, RACE_RULES, SAN_RULES,
+        LINT_RULES, OWN_RULES, RACE_RULES, SAN_RULES, SHARD_RULES,
     )
 
     waivers = {
@@ -556,6 +557,7 @@ def test_waiver_scoping_partitions_staleness():
         ("TMS-F64", "b.py", "g"): "san reason",
         ("TMR-ORDER", "c.py", "x->y->x"): "race reason",
         ("TMO-DONATE-ALIAS", "d.py", "restore"): "own reason",
+        ("TMH-MESH-DRIFT", "e.py", "rank.sharded_key_facet"): "shard reason",
     }
     race_scope = baseline_mod.scope_waivers(waivers, RACE_RULES)
     assert set(race_scope) == {("TMR-ORDER", "c.py", "x->y->x")}
@@ -570,6 +572,9 @@ def test_waiver_scoping_partitions_staleness():
     }
     assert set(baseline_mod.scope_waivers(waivers, OWN_RULES)) == {
         ("TMO-DONATE-ALIAS", "d.py", "restore")
+    }
+    assert set(baseline_mod.scope_waivers(waivers, SHARD_RULES)) == {
+        ("TMH-MESH-DRIFT", "e.py", "rank.sharded_key_facet")
     }
 
 

@@ -1,0 +1,584 @@
+"""tmshard unit tier: per-rule seeded fixtures (each with a clean twin
+encoding the repo's guard idiom), the mesh-awareness drift matrix, the
+checked-in ROADMAP-item-1/4 shard-plan worksheet, the five-tier waiver
+scoping, the repo-wide no-new-findings guard, and end-to-end CLI exit-code
+regressions.
+
+Pure static analysis — nothing here executes the analyzed code except the
+worksheet in-sync test, which pays the registry introspection cost the same
+way ``--shard --write-plan`` does; it rides the ``lint`` CI step next to the
+other tiers and also carries the ``shard`` marker for the dedicated CI step.
+"""
+import pathlib
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+import metrics_tpu
+from metrics_tpu.analysis import BASELINE_FILENAME
+from metrics_tpu.analysis.baseline import load_baseline, scope_waivers
+from metrics_tpu.analysis.findings import SHARD_RULES
+from metrics_tpu.analysis.shard import plan, run_shard, spec_rules
+from metrics_tpu.analysis.shard.axis_model import build_model
+
+pytestmark = [pytest.mark.lint, pytest.mark.shard]
+
+REPO_ROOT = pathlib.Path(metrics_tpu.__file__).resolve().parent.parent
+
+
+def _shard_snippet(tmp_path, source):
+    path = tmp_path / "mod.py"
+    path.write_text(textwrap.dedent(source))
+    report = run_shard(str(path), repo_root=str(tmp_path))
+    assert report.parse_errors == {}
+    # fixture runs never see the repo engine anchors: no matrix, no drift
+    assert report.mesh_matrix == {}
+    return report.new_findings
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+# --------------------------------------------------------- TMH-AXIS-UNBOUND
+
+
+def test_axis_unbound_bad(tmp_path):
+    """A literal collective axis in a function no shard_map/pmap context
+    reaches: the trace fails at best, silently degenerates at worst."""
+    findings = _shard_snippet(
+        tmp_path,
+        """
+        import jax
+
+        def merge(x):
+            return jax.lax.psum(x, "fleet")
+
+        def launch(x):
+            run = jax.jit(merge)
+            return run(x)
+        """,
+    )
+    assert _rules(findings) == ["TMH-AXIS-UNBOUND"]
+    (f,) = findings
+    assert f.symbol == "merge"
+    assert "no shard_map/pmap reaches this function" in f.message
+
+
+def test_axis_unbound_shard_map_clean_twin(tmp_path):
+    """Same reduce under a shard_map whose mesh binds the axis -> clean."""
+    findings = _shard_snippet(
+        tmp_path,
+        """
+        from functools import partial
+
+        import jax
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        MESH = jax.make_mesh((8,), ("fleet",))
+
+        @partial(shard_map, mesh=MESH, in_specs=(P(),), out_specs=P())
+        def merge(x):
+            return jax.lax.psum(x, "fleet")
+
+        def launch(x):
+            return merge(x)
+        """,
+    )
+    assert findings == []
+
+
+def test_axis_unbound_must_analysis_intersects_callers(tmp_path):
+    """A helper reached from two mapped contexts is bound only to the
+    *intersection* of their axes — the axis one caller lacks is flagged."""
+    findings = _shard_snippet(
+        tmp_path,
+        """
+        from functools import partial
+
+        import jax
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        FLEET = jax.make_mesh((8,), ("fleet",))
+        DATA = jax.make_mesh((8,), ("data",))
+
+        def helper(x):
+            return jax.lax.psum(x, "fleet")
+
+        @partial(shard_map, mesh=FLEET, in_specs=(P(),), out_specs=P())
+        def fleet_body(x):
+            return helper(x)
+
+        @partial(shard_map, mesh=DATA, in_specs=(P(),), out_specs=P())
+        def data_body(x):
+            return helper(x)
+        """,
+    )
+    assert _rules(findings) == ["TMH-AXIS-UNBOUND"]
+    (f,) = findings
+    assert f.symbol == "helper"
+
+
+# --------------------------------------------------------- TMH-SPEC-ALGEBRA
+
+
+def test_spec_algebra_partitioned_psum_bad(tmp_path):
+    """The double-count shape: psum over an axis the in-spec *partitions* —
+    each shard holds distinct rows, so the reduce mixes them."""
+    findings = _shard_snippet(
+        tmp_path,
+        """
+        from functools import partial
+
+        import jax
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        MESH = jax.make_mesh((8,), ("data",))
+
+        @partial(shard_map, mesh=MESH, in_specs=(P("data"),), out_specs=P())
+        def sync(state):
+            return jax.lax.psum(state, "data")
+        """,
+    )
+    assert _rules(findings) == ["TMH-SPEC-ALGEBRA"]
+    (f,) = findings
+    assert f.symbol == "sync"
+    assert "double-counts" in f.message
+
+
+def test_spec_algebra_local_reduce_clean_twin(tmp_path):
+    """The guard idiom: fold the local block first, then sync the folded
+    scalar — the reduced operand is no longer the partitioned parameter."""
+    findings = _shard_snippet(
+        tmp_path,
+        """
+        from functools import partial
+
+        import jax
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        MESH = jax.make_mesh((8,), ("data",))
+
+        @partial(shard_map, mesh=MESH, in_specs=(P("data"),), out_specs=P())
+        def sync(state):
+            return jax.lax.psum(state.sum(axis=0), "data")
+        """,
+    )
+    assert findings == []
+
+
+def test_spec_algebra_replicated_operand_clean(tmp_path):
+    """psum over a *replicated* (P()) operand is the evaluate_sharded idiom
+    and must not be flagged."""
+    findings = _shard_snippet(
+        tmp_path,
+        """
+        from functools import partial
+
+        import jax
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        MESH = jax.make_mesh((8,), ("data",))
+
+        @partial(shard_map, mesh=MESH, in_specs=(P(),), out_specs=P())
+        def sync(state):
+            return jax.lax.psum(state, "data")
+        """,
+    )
+    assert findings == []
+
+
+# ------------------------------------------------------ TMH-REPLICA-DIVERGE
+
+
+def test_replica_diverge_bad(tmp_path):
+    """A host read traced under a map bakes a different value into each
+    replica (a), and feeding it to a collective combines them (b)."""
+    findings = _shard_snippet(
+        tmp_path,
+        """
+        import time
+        from functools import partial
+
+        import jax
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        MESH = jax.make_mesh((4,), ("fleet",))
+
+        @partial(shard_map, mesh=MESH, in_specs=(P(),), out_specs=P())
+        def merge(x):
+            seed = time.time()
+            return jax.lax.pmax(x + seed, "fleet")
+        """,
+    )
+    assert _rules(findings) == ["TMH-REPLICA-DIVERGE"]
+    msgs = sorted(f.message for f in findings)
+    assert len(findings) == 2
+    assert any("executes inside a mapped trace" in m for m in msgs)
+    assert any("replica-divergent host read" in m for m in msgs)
+
+
+def test_replica_diverge_hoisted_clean_twin(tmp_path):
+    """The guard idiom: the host read runs in the eager launcher and enters
+    the mapped body as data."""
+    findings = _shard_snippet(
+        tmp_path,
+        """
+        import time
+        from functools import partial
+
+        import jax
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        MESH = jax.make_mesh((4,), ("fleet",))
+
+        @partial(shard_map, mesh=MESH, in_specs=(P(), P()), out_specs=P())
+        def merge(x, seed):
+            return jax.lax.pmax(x + seed, "fleet")
+
+        def launch(x):
+            seed = time.time()
+            return merge(x, seed)
+        """,
+    )
+    assert findings == []
+
+
+# ------------------------------------------------------ TMH-DONATE-RESHARD
+
+
+def test_donate_reshard_bad(tmp_path):
+    """Donating a P('data')-placed buffer into a launch whose in-spec is
+    replicated: XLA inserts a resharding copy, the donation frees nothing."""
+    findings = _shard_snippet(
+        tmp_path,
+        """
+        import jax
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        def step(s):
+            return s + 1
+
+        def launch(mesh, x):
+            x = jax.device_put(x, NamedSharding(mesh, P("data")))
+            run = jax.jit(
+                step,
+                donate_argnums=(0,),
+                in_shardings=(NamedSharding(mesh, P(None)),),
+            )
+            return run(x)
+        """,
+    )
+    assert _rules(findings) == ["TMH-DONATE-RESHARD"]
+    (f,) = findings
+    assert f.symbol == "launch"
+    assert "the donation frees nothing" in f.message
+
+
+def test_donate_reshard_matching_spec_clean_twin(tmp_path):
+    findings = _shard_snippet(
+        tmp_path,
+        """
+        import jax
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        def step(s):
+            return s + 1
+
+        def launch(mesh, x):
+            x = jax.device_put(x, NamedSharding(mesh, P("data")))
+            run = jax.jit(
+                step,
+                donate_argnums=(0,),
+                in_shardings=(NamedSharding(mesh, P("data")),),
+            )
+            return run(x)
+        """,
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------- TMH-KEY-SHARD
+
+
+_KEYED_ENGINE = """
+    import jax
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    class Engine:
+        def __init__(self):
+            self._cache = {}
+
+        def launch(self, tag, x, mesh):
+            x = jax.device_put(x, NamedSharding(mesh, P("data")))
+            key = (tag, x.shape)
+            compiled = self._cache.get(key)
+            if compiled is None:
+                compiled = jax.jit(lambda s: s + 1)
+                self._cache[key] = compiled
+            return compiled(x)
+    """
+
+
+def test_key_shard_bad(tmp_path):
+    """A cached launch consuming a placed array whose cache key has no
+    sharding/mesh facet: a placement change replays a stale executable."""
+    findings = _shard_snippet(tmp_path, _KEYED_ENGINE)
+    assert _rules(findings) == ["TMH-KEY-SHARD"]
+    (f,) = findings
+    assert f.symbol == "Engine.launch.sharding"
+    assert "no sharding/mesh facet" in f.message
+
+
+def test_key_shard_facet_clean_twin(tmp_path):
+    """The guard idiom (the fused._aval_key fix): fold the placement spec
+    into the key tuple."""
+    findings = _shard_snippet(
+        tmp_path,
+        _KEYED_ENGINE.replace(
+            "key = (tag, x.shape)", "key = (tag, x.shape, str(x.sharding))"
+        ),
+    )
+    assert findings == []
+
+
+# --------------------------------------------------------- TMH-MESH-DRIFT
+
+
+_SHARDED_ENGINE = textwrap.dedent(
+    """
+    import jax
+
+    _CACHE = {}
+
+    def step(s):
+        return s + 1
+
+    def launch(tag, state):
+        key = (tag, state.shape, str(state.sharding))
+        compiled = _CACHE.get(key)
+        if compiled is None:
+            compiled = jax.jit(step)
+            _CACHE[key] = compiled
+        return compiled(state)
+    """
+)
+
+_UNSHARDED_ENGINE = _SHARDED_ENGINE.replace(
+    "key = (tag, state.shape, str(state.sharding))", "key = (tag, state.shape)"
+)
+
+
+def _mini_fleet(third_engine_src):
+    model = build_model(
+        {
+            "eng_a.py": ("eng_a", _SHARDED_ENGINE),
+            "eng_b.py": ("eng_b", _SHARDED_ENGINE),
+            "eng_c.py": ("eng_c", third_engine_src),
+        }
+    )
+    engines = {
+        "a": ("eng_a.py", "launch"),
+        "b": ("eng_b.py", "launch"),
+        "c": ("eng_c.py", "launch"),
+    }
+    return spec_rules.extract_mesh_contract(model, engines=engines)
+
+
+def test_mesh_drift_fires_on_everyone_but_you():
+    """A component two peers implement and one engine lacks is drift; the
+    components nobody implements are just not part of the contract."""
+    matrix = _mini_fleet(_UNSHARDED_ENGINE)
+    findings = spec_rules.drift_findings(matrix)
+    assert _rules(findings) == ["TMH-MESH-DRIFT"]
+    assert sorted(f.symbol for f in findings) == [
+        "c.placed_io", "c.sharded_key_facet",
+    ]
+    assert all(f.path == "eng_c.py" for f in findings)
+    assert matrix["a"]["components"]["sharded_key_facet"] == "launch"
+
+
+def test_mesh_drift_uniform_fleet_clean():
+    matrix = _mini_fleet(_SHARDED_ENGINE)
+    assert spec_rules.drift_findings(matrix) == []
+
+
+# --------------------------------------------- repo-wide guard + worksheet
+
+
+@pytest.fixture(scope="module")
+def repo_report():
+    return run_shard(
+        str(REPO_ROOT / "metrics_tpu"),
+        baseline_path=str(REPO_ROOT / BASELINE_FILENAME),
+    )
+
+
+def test_tmshard_no_new_findings(repo_report):
+    """The whole package must be sharding-clean against the checked-in
+    baseline, with every waiver carrying a reason and none stale."""
+    assert repo_report.parse_errors == {}
+    msgs = "\n".join(f.format() for f in repo_report.new_findings)
+    assert not repo_report.new_findings, f"new tmshard findings:\n{msgs}"
+    assert not repo_report.unused_waivers, (
+        f"stale baseline waivers: {repo_report.unused_waivers}"
+    )
+    for f in repo_report.waived:
+        assert f.waive_reason, f"waiver without a reason covers {f.key()}"
+    # the ISSUE's cold-wall budget is 60s on CPU; the AST sweep is ~15x under
+    assert repo_report.stats["seconds"] < 60
+
+
+def test_repo_mesh_matrix(repo_report):
+    """The matrix must see all five engines; the three keyed-cache engines
+    share the _aval_key sharding facet, and only the two triaged gaps
+    (rank/mesh sharded_key_facet — jax.jit keys on shardings natively)
+    survive as waived drift."""
+    assert set(repo_report.mesh_matrix) == {
+        "fused", "fleet", "ingest", "rank", "mesh",
+    }
+    for engine in ("fused", "fleet", "ingest"):
+        comp = repo_report.mesh_matrix[engine]["components"]
+        assert comp["placed_io"], f"{engine} lost placed_io"
+        assert comp["sharded_key_facet"], f"{engine} lost sharded_key_facet"
+        assert repo_report.mesh_matrix[engine]["has_cache"]
+    mesh = repo_report.mesh_matrix["mesh"]["components"]
+    for component in ("axis_binding", "collective_sync", "spec_plumbing", "placed_io"):
+        assert mesh[component], f"mesh program lost {component}"
+    waived = {f.symbol for f in repo_report.waived if f.rule == "TMH-MESH-DRIFT"}
+    assert waived == {"rank.sharded_key_facet", "mesh.sharded_key_facet"}
+
+
+def test_repo_collective_axes_all_parameterized(repo_report):
+    """The package idiom the dataflow rules rest on: every repo collective
+    takes its axis as a parameter (or from a mapped context), never a free
+    literal — so the five dataflow rules run clean without any waiver."""
+    dataflow = [f for f in repo_report.findings if f.rule != "TMH-MESH-DRIFT"]
+    assert dataflow == []
+    assert repo_report.stats["collectives"] > 0
+    assert repo_report.stats["mapped_bodies"] >= 1  # evaluate_sharded.run
+
+
+def test_plan_worksheet_in_sync(repo_report):
+    """`tmshard_state_plan.json` is the checked-in ROADMAP-item-1/4
+    worksheet; it must match a fresh extraction (regenerate with
+    --shard --write-plan) and cover the whole constructible registry."""
+    checked_in = plan.load_worksheet(str(REPO_ROOT / plan.PLAN_FILENAME))
+    fresh = __import__("json").loads(
+        __import__("json").dumps(repo_report.plan_worksheet())
+    )
+    assert checked_in == fresh
+    assert len(checked_in["classes"]) > 100
+    # every class got a verdict for every registered state, with a reason
+    for name, entry in checked_in["classes"].items():
+        for state, facts in entry["states"].items():
+            assert set(facts["verdicts"]) == set(plan._AXIS_LEGEND), (name, state)
+            for verdict in facts["verdicts"].values():
+                assert verdict["reason"], (name, state)
+            assert facts["plan"]
+
+
+def test_state_verdicts_algebra():
+    """The pure verdict function matches the fleet eligibility gate."""
+    v = plan.state_verdicts("sum", "vector", host_side=False)
+    assert v["psum_safe"]["ok"] and v["fleet_partitionable"]["ok"]
+    v = plan.state_verdicts("mean", "scalar", host_side=False)
+    assert v["psum_safe"]["ok"] and not v["fleet_partitionable"]["ok"]
+    v = plan.state_verdicts("cat", "cat_list", host_side=False)
+    assert v["cat_shard_only"]["ok"] and not v["psum_safe"]["ok"]
+    v = plan.state_verdicts("sum", "vector", host_side=True)
+    assert not v["fleet_partitionable"]["ok"]
+    v = plan.state_verdicts("none", "scalar", host_side=False)
+    assert v["replicate_only"]["ok"]
+
+
+def test_waiver_scoping_partitions_staleness():
+    """The shared baseline is scoped per tier: the tmshard view holds
+    exactly the TMH-* waivers and nothing from the other four tiers."""
+    waivers = load_baseline(str(REPO_ROOT / BASELINE_FILENAME))
+    scoped = scope_waivers(waivers, SHARD_RULES)
+    assert scoped, "repo baseline lost its TMH waivers"
+    assert all(rule.startswith("TMH-") for rule, _p, _s in scoped)
+    dropped = set(waivers) - set(scoped)
+    assert all(not rule.startswith("TMH-") for rule, _p, _s in dropped)
+
+
+def test_shard_obs_counters(tmp_path):
+    """A seeded run increments the shard.* counters when obs is enabled."""
+    import metrics_tpu.obs as obs
+
+    path = tmp_path / "mod.py"
+    path.write_text(
+        textwrap.dedent(
+            """
+            import jax
+
+            def merge(x):
+                return jax.lax.psum(x, "fleet")
+            """
+        )
+    )
+    with obs.observe() as reg:
+        before = reg.get("shard", "axis_unbound")
+        report = run_shard(str(path), repo_root=str(tmp_path))
+        assert _rules(report.new_findings) == ["TMH-AXIS-UNBOUND"]
+        assert reg.get("shard", "axis_unbound") == before + 1
+
+
+# ------------------------------------------------------------ CLI end-to-end
+
+
+_CLI_ENV = {"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu", "PYTHONPATH": str(REPO_ROOT)}
+
+
+def _run_cli(pkg, tmp_path):
+    return subprocess.run(
+        [sys.executable, "-m", "metrics_tpu.analysis", "--shard", str(pkg)],
+        capture_output=True, text=True, timeout=120, env=_CLI_ENV, cwd=str(tmp_path),
+    )
+
+
+@pytest.mark.smoke
+def test_cli_partitioned_psum_regression(tmp_path):
+    """Acceptance regression: the seeded partitioned-psum double-count must
+    fail the build end-to-end (exit 1, rule named); the local-reduce twin
+    passes."""
+    pkg = tmp_path / "toypkg"
+    pkg.mkdir()
+    bad = textwrap.dedent(
+        """
+        from functools import partial
+
+        import jax
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        MESH = jax.make_mesh((8,), ("data",))
+
+        @partial(shard_map, mesh=MESH, in_specs=(P("data"),), out_specs=P())
+        def sync(state):
+            return jax.lax.psum(state, "data")
+        """
+    )
+    (pkg / "mod.py").write_text(bad)
+    result = _run_cli(pkg, tmp_path)
+    assert result.returncode == 1, result.stdout + result.stderr
+    assert "TMH-SPEC-ALGEBRA" in result.stdout
+
+    (pkg / "mod.py").write_text(
+        bad.replace("psum(state, ", "psum(state.sum(axis=0), ")
+    )
+    result = _run_cli(pkg, tmp_path)
+    assert result.returncode == 0, result.stdout + result.stderr

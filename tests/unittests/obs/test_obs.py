@@ -187,6 +187,48 @@ def test_state_report_nbytes_and_catbuffer_fill():
     assert all(s["sharding"] for s in rep["states"])
 
 
+def test_state_report_live_layout_fused_and_fleet():
+    """The report's `layout` block is read live from ``Array.sharding`` at
+    report time (not a static annotation): a device_put with a NamedSharding
+    shows up in the next report — for a fused collection and a fleet metric."""
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from metrics_tpu.core.fused import canonical_collection
+    from metrics_tpu.regression import MeanSquaredError
+
+    # fused collection: every array state row carries a live layout block
+    coll = canonical_collection(fused=True)
+    summary = coll.summary()
+    rows = [
+        s
+        for rep in summary["metrics"].values()
+        for s in rep["states"]
+        if s["kind"] == "array"
+    ]
+    assert rows
+    for s in rows:
+        assert s["layout"] is not None
+        assert s["layout"]["addressable"] is True
+        assert s["layout"]["replicated"] is True  # nothing placed yet
+        assert s["layout"]["num_devices"] >= 1
+
+    # fleet metric: re-placing a state table changes the *next* report
+    m = MeanSquaredError(fleet_size=4)
+    before = {s["name"]: s for s in m.state_report()["states"]}
+    assert before["total"]["layout"]["replicated"] is True
+    mesh = Mesh(np.array(jax.devices()[:1]), ("fleet",))
+    m.total = jax.device_put(m.total, NamedSharding(mesh, P("fleet")))
+    after = {s["name"]: s for s in m.state_report()["states"]}
+    layout = after["total"]["layout"]
+    assert layout["replicated"] is False
+    assert "fleet" in layout["spec"]
+    assert layout["mesh"] == {"fleet": 1}
+    # the legacy string column reports the same live spec
+    assert after["total"]["sharding"] == layout["spec"]
+    assert m.state_report()["fleet_size"] == 4
+
+
 def test_state_report_flags_overflow():
     m = CatMetric(cat_capacity=2)
     with warnings.catch_warnings():
